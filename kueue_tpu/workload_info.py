@@ -1,0 +1,135 @@
+"""Workload accounting helpers — the equivalent of the reference's
+pkg/workload (workload.Info, usage computation, eviction/admission helpers).
+
+A ``WorkloadInfo`` wraps an api.Workload with its resolved ClusterQueue and
+per-PodSet total requests plus (once assigned/admitted) the per-resource
+flavor assignment, from which quota usage is derived.
+Reference: pkg/workload/workload.go:215 (Info), resources.go.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.api.types import (
+    Admission,
+    FlavorResource,
+    PodSetAssignmentStatus,
+    Workload,
+)
+
+
+@dataclass
+class PodSetResources:
+    """Total (count-scaled) requests of one PodSet with flavor assignment.
+
+    Reference: pkg/workload/workload.go (PodSetResources).
+    """
+
+    name: str
+    count: int
+    requests: dict[str, int] = field(default_factory=dict)  # total, not per-pod
+    flavors: dict[str, str] = field(default_factory=dict)  # resource -> flavor
+
+    def scaled_to(self, count: int) -> "PodSetResources":
+        if self.count == count or self.count == 0:
+            return PodSetResources(self.name, count, dict(self.requests),
+                                   dict(self.flavors))
+        scaled = {r: (q // self.count) * count for r, q in self.requests.items()}
+        return PodSetResources(self.name, count, scaled, dict(self.flavors))
+
+    def single_pod_requests(self) -> dict[str, int]:
+        if self.count == 0:
+            return {}
+        return {r: q // self.count for r, q in self.requests.items()}
+
+
+@dataclass
+class WorkloadInfo:
+    """Reference: pkg/workload/workload.go:215 (Info)."""
+
+    obj: Workload
+    cluster_queue: str = ""
+    total_requests: list[PodSetResources] = field(default_factory=list)
+    # Flavor-assignment resume state (reference: AssignmentClusterQueueState).
+    last_assignment_flavor_idx: Optional[list[dict[str, int]]] = None
+    last_assignment_generation: int = -1
+    # AdmissionFairSharing: LocalQueue's historical usage, if AFS is on.
+    local_queue_fs_usage: Optional[float] = None
+
+    @classmethod
+    def from_workload(cls, wl: Workload, cluster_queue: str = "") -> "WorkloadInfo":
+        info = cls(obj=wl, cluster_queue=cluster_queue)
+        info.total_requests = [
+            PodSetResources(
+                name=ps.name,
+                count=ps.count,
+                requests={r: q * ps.count for r, q in ps.requests.items()},
+            )
+            for ps in wl.pod_sets
+        ]
+        if wl.status.admission is not None:
+            info.apply_admission(wl.status.admission)
+        return info
+
+    @property
+    def key(self) -> str:
+        return self.obj.key
+
+    def apply_admission(self, admission: Admission) -> None:
+        """Sync flavors (and possibly reduced counts) from an Admission."""
+        self.cluster_queue = admission.cluster_queue
+        by_name = {psa.name: psa for psa in admission.pod_set_assignments}
+        for psr in self.total_requests:
+            psa = by_name.get(psr.name)
+            if psa is None:
+                continue
+            if psa.count and psa.count != psr.count:
+                scaled = psr.scaled_to(psa.count)
+                psr.count = scaled.count
+                psr.requests = scaled.requests
+            psr.flavors = dict(psa.flavors)
+
+    def usage(self) -> dict[FlavorResource, int]:
+        """FlavorResource quantities this workload counts against quota.
+
+        Reference: workload.Info.Usage / FlavorResourceUsage.
+        """
+        out: dict[FlavorResource, int] = {}
+        for psr in self.total_requests:
+            for res, qty in psr.requests.items():
+                if qty == 0:
+                    continue
+                flavor = psr.flavors.get(res)
+                if flavor is None:
+                    continue
+                fr = FlavorResource(flavor, res)
+                out[fr] = out.get(fr, 0) + qty
+        return out
+
+    def uses_any(self, frs: set[FlavorResource]) -> bool:
+        """Reference: classical.WorkloadUsesResources
+        (candidate_generator.go:54)."""
+        for psr in self.total_requests:
+            for res, flavor in psr.flavors.items():
+                if FlavorResource(flavor, res) in frs:
+                    return True
+        return False
+
+
+def admission_from_assignment(cluster_queue: str, pod_sets) -> Admission:
+    """Build an api Admission from scheduler PodSetAssignments."""
+    return Admission(
+        cluster_queue=cluster_queue,
+        pod_set_assignments=tuple(
+            PodSetAssignmentStatus(
+                name=psa.name,
+                flavors=dict(psa.flavors),
+                resource_usage=dict(psa.requests),
+                count=psa.count,
+                topology_assignment=psa.topology_assignment,
+            )
+            for psa in pod_sets
+        ),
+    )

@@ -1,0 +1,13 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX imports.
+
+Multi-chip hardware is not available in CI; all sharding tests run on a
+virtual CPU mesh (jax.sharding.Mesh over 8 host-platform devices).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,150 @@
+"""Unit tests for the hierarchical quota math (cache/snapshot.py).
+
+Mirrors the semantics of the reference's resource_node.go: subtree quota
+accumulation with lending limits, available() with borrowing limits, usage
+bubbling, and FindHeightOfLowestSubtreeThatFits.
+"""
+
+from kueue_tpu.api.types import (
+    INF,
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    FlavorResource,
+    ResourceGroup,
+    ResourceQuota,
+)
+from kueue_tpu.cache.snapshot import (
+    build_snapshot,
+    find_height_of_lowest_subtree_that_fits,
+)
+
+CPU = "cpu"
+FR = FlavorResource("default", CPU)
+
+
+def make_cq(name, nominal, cohort=None, borrowing_limit=None,
+            lending_limit=None, flavor="default"):
+    return ClusterQueue(
+        name=name,
+        cohort=cohort,
+        resource_groups=(
+            ResourceGroup(
+                covered_resources=(CPU,),
+                flavors=(FlavorQuotas(flavor, {CPU: ResourceQuota(
+                    nominal=nominal,
+                    borrowing_limit=borrowing_limit,
+                    lending_limit=lending_limit)}),),
+            ),
+        ),
+    )
+
+
+def test_standalone_cq_available():
+    snap = build_snapshot([make_cq("a", 1000)], [], [], [])
+    cq = snap.cluster_queue("a")
+    assert cq.available(FR) == 1000
+    assert cq.potential_available(FR) == 1000
+    cq.add_usage({FR: 400})
+    assert cq.available(FR) == 600
+    assert not cq.borrowing(FR)
+    cq.add_usage({FR: 700})
+    assert cq.available(FR) == 0  # clipped; overadmitted
+    cq.remove_usage({FR: 1100})
+    assert cq.available(FR) == 1000
+
+
+def test_cohort_borrowing():
+    snap = build_snapshot(
+        [make_cq("a", 1000, "co"), make_cq("b", 500, "co")], [], [], [])
+    a, b = snap.cluster_queue("a"), snap.cluster_queue("b")
+    # Full cohort capacity visible to both.
+    assert a.available(FR) == 1500
+    assert b.available(FR) == 1500
+    # a uses beyond nominal -> borrows from b's lendable quota.
+    a.add_usage({FR: 1200})
+    assert a.borrowing(FR)
+    assert a.available(FR) == 300
+    assert b.available(FR) == 300
+    assert snap.cohorts["co"].node.usage[FR] == 1200
+
+
+def test_borrowing_limit():
+    snap = build_snapshot(
+        [make_cq("a", 1000, "co", borrowing_limit=200),
+         make_cq("b", 500, "co")], [], [], [])
+    a = snap.cluster_queue("a")
+    assert a.available(FR) == 1200
+    assert a.potential_available(FR) == 1200
+    a.add_usage({FR: 1200})
+    assert a.available(FR) == 0
+
+
+def test_lending_limit():
+    snap = build_snapshot(
+        [make_cq("a", 1000, "co", lending_limit=300),
+         make_cq("b", 500, "co")], [], [], [])
+    a, b = snap.cluster_queue("a"), snap.cluster_queue("b")
+    # b can only see a's lending-limited 300.
+    assert b.available(FR) == 800
+    # a keeps its local 700 plus cohort capacity 800.
+    assert a.available(FR) == 1500
+    # a's local usage below localQuota doesn't consume cohort capacity.
+    a.add_usage({FR: 600})
+    assert b.available(FR) == 800
+    a.add_usage({FR: 300})  # 900 total: 200 past localQuota of 700
+    assert b.available(FR) == 600
+
+
+def test_hierarchical_cohorts():
+    cohorts = [Cohort("root"), Cohort("left", "root"), Cohort("right", "root")]
+    cqs = [make_cq("a", 1000, "left"), make_cq("b", 0, "left"),
+           make_cq("c", 2000, "right")]
+    snap = build_snapshot(cqs, cohorts, [], [])
+    a, b, c = (snap.cluster_queue(x) for x in "abc")
+    assert snap.cohorts["root"].node.subtree_quota[FR] == 3000
+    assert b.available(FR) == 3000
+    c.add_usage({FR: 2500})
+    assert c.borrowing(FR)
+    assert b.available(FR) == 500
+    # Without lending limits localQuota is 0, so full usage bubbles to root.
+    assert snap.cohorts["right"].node.usage[FR] == 2500
+    assert snap.cohorts["root"].node.usage[FR] == 2500
+
+
+def test_cohort_interior_quota():
+    cohorts = [Cohort(
+        "co",
+        resource_groups=(ResourceGroup(
+            (CPU,), (FlavorQuotas("default", {CPU: ResourceQuota(700)}),)),))]
+    snap = build_snapshot([make_cq("a", 100, "co")], cohorts, [], [])
+    a = snap.cluster_queue("a")
+    assert a.available(FR) == 800
+
+
+def test_height_of_lowest_subtree_that_fits():
+    cohorts = [Cohort("root"), Cohort("mid", "root")]
+    cqs = [make_cq("a", 100, "mid"), make_cq("b", 300, "mid"),
+           make_cq("c", 1000, "root")]
+    snap = build_snapshot(cqs, cohorts, [], [])
+    a = snap.cluster_queue("a")
+    # Fits in own quota -> borrow height 0.
+    assert find_height_of_lowest_subtree_that_fits(a, FR, 100) == (0, True)
+    # Needs mid's capacity (height 1).
+    h, smaller = find_height_of_lowest_subtree_that_fits(a, FR, 300)
+    assert (h, smaller) == (1, True)
+    # Needs root (height 2).
+    h, smaller = find_height_of_lowest_subtree_that_fits(a, FR, 900)
+    assert (h, smaller) == (2, False)
+    # Doesn't fit anywhere: returns root height, False.
+    h, smaller = find_height_of_lowest_subtree_that_fits(a, FR, 5000)
+    assert (h, smaller) == (2, False)
+
+
+def test_unlimited_sentinel_saturation():
+    snap = build_snapshot(
+        [make_cq("a", INF, "co"), make_cq("b", INF, "co")], [], [], [])
+    a = snap.cluster_queue("a")
+    assert a.available(FR) == INF
+    a.add_usage({FR: 10**9})
+    assert a.available(FR) == INF
