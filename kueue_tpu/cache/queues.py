@@ -1,0 +1,200 @@
+"""Pending-side queue manager: per-ClusterQueue heaps, LocalQueue mapping,
+inadmissible bookkeeping with backoff.
+
+Reference: pkg/cache/queue/{manager.go,cluster_queue.go}.
+  * heap order: higher effective priority first, then earlier queue-order
+    timestamp (cluster_queue.go heap less).
+  * StrictFIFO keeps a sticky head and does not surface deeper workloads;
+    BestEffortFIFO pops past inadmissible heads (cluster_queue.go:124+).
+  * NoFit requeues park the workload in an ``inadmissible`` side map until a
+    relevant event (cluster_queue.go:451 backoffWaitingTimeExpired,
+    QueueInadmissibleWorkloads).
+  * scheduling-equivalence hashing: identical pending workloads are bulk
+    moved to inadmissible on a NoFit (cluster_queue.go:615
+    handleInadmissibleHash; workload.go:236 SchedulingHash).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    LocalQueue,
+    QueueingStrategy,
+    Workload,
+)
+from kueue_tpu.scheduler.cycle import RequeueReason
+from kueue_tpu.workload_info import WorkloadInfo
+
+_seq = itertools.count()
+
+
+def scheduling_hash(wl: Workload, cluster_queue: str) -> tuple:
+    """pkg/workload/workload.go:236 (SchedulingHash): workloads with equal
+    shape share admission outcomes within a cycle."""
+    return (
+        cluster_queue,
+        wl.priority,
+        tuple(sorted(
+            (ps.name, ps.count, tuple(sorted(ps.requests.items())),
+             tuple(sorted(ps.node_selector.items())))
+            for ps in wl.pod_sets)),
+    )
+
+
+@dataclass(order=True)
+class _HeapItem:
+    sort_key: tuple
+    info: WorkloadInfo = field(compare=False)
+
+
+class PendingClusterQueue:
+    """pkg/cache/queue/cluster_queue.go:124 (ClusterQueue pending heap)."""
+
+    def __init__(self, spec: ClusterQueue):
+        self.spec = spec
+        self.name = spec.name
+        self.heap: list[_HeapItem] = []
+        self.items: dict[str, WorkloadInfo] = {}  # key -> live entry
+        self.inadmissible: dict[str, WorkloadInfo] = {}
+        self.in_flight: Optional[str] = None  # popped, not yet requeued
+
+    def _key(self, info: WorkloadInfo) -> tuple:
+        wl = info.obj
+        return (-wl.effective_priority, wl.creation_time, next(_seq))
+
+    def push_or_update(self, info: WorkloadInfo) -> None:
+        """cluster_queue.go:356 (PushOrUpdate)."""
+        key = info.key
+        self.inadmissible.pop(key, None)
+        self.items[key] = info
+        heapq.heappush(self.heap, _HeapItem(self._key(info), info))
+
+    def delete(self, key: str) -> None:
+        self.items.pop(key, None)
+        self.inadmissible.pop(key, None)
+        if self.in_flight == key:
+            self.in_flight = None
+
+    def requeue_if_not_present(self, info: WorkloadInfo,
+                               reason: RequeueReason) -> bool:
+        """cluster_queue.go requeueIfNotPresent: NoFit and
+        PreemptionNoCandidates park the workload as inadmissible under
+        BestEffortFIFO; other reasons go straight back to the heap."""
+        key = info.key
+        if self.in_flight == key:
+            self.in_flight = None
+        if key in self.items or key in self.inadmissible:
+            return False
+        immediate = reason not in (RequeueReason.NO_FIT,
+                                   RequeueReason.PREEMPTION_NO_CANDIDATES)
+        if (immediate
+                or self.spec.queueing_strategy
+                == QueueingStrategy.STRICT_FIFO):
+            # StrictFIFO blocks the queue on its head rather than parking it.
+            self.push_or_update(info)
+        else:
+            self.inadmissible[key] = info
+        return True
+
+    def queue_inadmissible(self) -> bool:
+        """manager.go QueueInadmissibleWorkloads — move all inadmissible
+        workloads back into the heap (on relevant cluster events)."""
+        moved = bool(self.inadmissible)
+        for info in self.inadmissible.values():
+            self.items[info.key] = info
+            heapq.heappush(self.heap, _HeapItem(self._key(info), info))
+        self.inadmissible.clear()
+        return moved
+
+    def pop(self) -> Optional[WorkloadInfo]:
+        """cluster_queue.go:715 (Pop) — skip stale heap entries."""
+        while self.heap:
+            item = heapq.heappop(self.heap)
+            key = item.info.key
+            if self.items.get(key) is item.info:
+                del self.items[key]
+                self.in_flight = key
+                return item.info
+        return None
+
+    def pending(self) -> int:
+        return len(self.items) + len(self.inadmissible)
+
+    def pending_active(self) -> int:
+        return len(self.items)
+
+
+class QueueManager:
+    """pkg/cache/queue/manager.go:147 (Manager)."""
+
+    def __init__(self) -> None:
+        self.cluster_queues: dict[str, PendingClusterQueue] = {}
+        self.local_queues: dict[str, LocalQueue] = {}
+
+    def add_cluster_queue(self, cq: ClusterQueue) -> None:
+        self.cluster_queues[cq.name] = PendingClusterQueue(cq)
+
+    def delete_cluster_queue(self, name: str) -> None:
+        self.cluster_queues.pop(name, None)
+
+    def add_local_queue(self, lq: LocalQueue) -> None:
+        self.local_queues[lq.key] = lq
+
+    def delete_local_queue(self, key: str) -> None:
+        self.local_queues.pop(key, None)
+
+    def cluster_queue_for_workload(self, wl: Workload) -> Optional[str]:
+        lq = self.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
+        if lq is None:
+            return None
+        return lq.cluster_queue or None
+
+    def add_or_update_workload(self, wl: Workload) -> Optional[WorkloadInfo]:
+        """manager.go AddOrUpdateWorkload."""
+        cq_name = self.cluster_queue_for_workload(wl)
+        if cq_name is None or cq_name not in self.cluster_queues:
+            return None
+        info = WorkloadInfo.from_workload(wl, cq_name)
+        self.cluster_queues[cq_name].push_or_update(info)
+        return info
+
+    def delete_workload(self, wl: Workload) -> None:
+        for pcq in self.cluster_queues.values():
+            pcq.delete(wl.key)
+
+    def requeue_workload(self, info: WorkloadInfo,
+                         reason: RequeueReason) -> bool:
+        """manager.go:734 (RequeueWorkload)."""
+        pcq = self.cluster_queues.get(info.cluster_queue)
+        if pcq is None:
+            return False
+        return pcq.requeue_if_not_present(info, reason)
+
+    def queue_inadmissible_workloads(self,
+                                     cq_names: Optional[set[str]] = None) -> None:
+        for name, pcq in self.cluster_queues.items():
+            if cq_names is None or name in cq_names:
+                pcq.queue_inadmissible()
+
+    def heads(self) -> list[WorkloadInfo]:
+        """manager.go:872 (Heads) — one head per ClusterQueue.  Non-blocking
+        variant: returns [] when nothing is pending."""
+        out = []
+        for pcq in self.cluster_queues.values():
+            head = pcq.pop()
+            if head is not None:
+                out.append(head)
+        return out
+
+    def pending_workloads(self, cq_name: str) -> int:
+        pcq = self.cluster_queues.get(cq_name)
+        return pcq.pending() if pcq else 0
+
+    def has_pending(self) -> bool:
+        return any(pcq.pending_active() > 0
+                   for pcq in self.cluster_queues.values())

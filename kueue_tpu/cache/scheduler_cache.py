@@ -1,0 +1,96 @@
+"""Admitted-side live cache: the in-memory world model the scheduler
+snapshots each cycle.
+
+Reference: pkg/cache/scheduler/cache.go:129 (Cache) — CQ/cohort/flavor
+registries, admitted-workload usage, assume/forget, snapshotting.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    ResourceFlavor,
+    StopPolicy,
+    Workload,
+)
+from kueue_tpu.cache.snapshot import Snapshot, build_snapshot
+from kueue_tpu.workload_info import WorkloadInfo
+
+
+class Cache:
+    """pkg/cache/scheduler/cache.go:129."""
+
+    def __init__(self) -> None:
+        self.cluster_queues: dict[str, ClusterQueue] = {}
+        self.cohorts: dict[str, Cohort] = {}
+        self.resource_flavors: dict[str, ResourceFlavor] = {}
+        # key -> admitted/assumed WorkloadInfo
+        self.workloads: dict[str, WorkloadInfo] = {}
+
+    # -- object lifecycle --
+
+    def add_or_update_cluster_queue(self, cq: ClusterQueue) -> None:
+        self.cluster_queues[cq.name] = cq
+
+    def delete_cluster_queue(self, name: str) -> None:
+        self.cluster_queues.pop(name, None)
+
+    def add_or_update_cohort(self, cohort: Cohort) -> None:
+        self.cohorts[cohort.name] = cohort
+
+    def delete_cohort(self, name: str) -> None:
+        self.cohorts.pop(name, None)
+
+    def add_or_update_resource_flavor(self, rf: ResourceFlavor) -> None:
+        self.resource_flavors[rf.name] = rf
+
+    def delete_resource_flavor(self, name: str) -> None:
+        self.resource_flavors.pop(name, None)
+
+    # -- workloads (cache.go:766 AddOrUpdateWorkload / assume) --
+
+    def add_or_update_workload(self, wl: Workload) -> bool:
+        if wl.status.admission is None:
+            return False
+        info = WorkloadInfo.from_workload(wl,
+                                          wl.status.admission.cluster_queue)
+        if info.cluster_queue not in self.cluster_queues:
+            return False
+        self.workloads[wl.key] = info
+        return True
+
+    def delete_workload(self, key: str) -> bool:
+        return self.workloads.pop(key, None) is not None
+
+    def is_assumed(self, key: str) -> bool:
+        return key in self.workloads
+
+    # -- status / metrics inputs --
+
+    def usage_for_cq(self, name: str):
+        snap = self.snapshot()
+        cq = snap.cluster_queue(name)
+        return dict(cq.node.usage) if cq else {}
+
+    def admitted_count(self, name: str) -> int:
+        return sum(1 for w in self.workloads.values()
+                   if w.cluster_queue == name)
+
+    # -- snapshot (cache.go Snapshot / snapshot.go:161) --
+
+    def inactive_cluster_queues(self) -> set[str]:
+        return {name for name, cq in self.cluster_queues.items()
+                if cq.stop_policy != StopPolicy.NONE}
+
+    def snapshot(self) -> Snapshot:
+        return build_snapshot(
+            list(self.cluster_queues.values()),
+            list(self.cohorts.values()),
+            list(self.resource_flavors.values()),
+            [w for w in self.workloads.values()
+             if w.cluster_queue in self.cluster_queues],
+            inactive_cluster_queues=self.inactive_cluster_queues(),
+        )

@@ -1,0 +1,244 @@
+"""The standalone control-plane engine: queue manager + cache + scheduler
+cycle + workload lifecycle, wired together in-process.
+
+This is the framework's equivalent of the reference's minimalkueue
+(test/performance/scheduler/minimalkueue/main.go:73): core controllers and
+the scheduler only, no API server. The full controller layer (job
+integrations, admission checks, webhooks) builds on the same engine.
+
+Lifecycle semantics mirrored from the reference:
+  * admit: set QuotaReserved + Admitted, write Admission, assume in cache
+    (scheduler.go:856 admit, :920 assumeWorkload).
+  * preemption: targets get Evicted/Preempted conditions, their usage is
+    released, and they are requeued pending
+    (preemption.go:194 IssuePreemptions + core/workload_controller.go).
+  * finish: Finished condition, removal from cache, and inadmissible
+    workloads of the cohort are re-queued (workload event handlers,
+    core/workload_controller.go:1228+).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    LocalQueue,
+    ResourceFlavor,
+    Workload,
+    WorkloadConditionType,
+)
+from kueue_tpu.cache.queues import QueueManager
+from kueue_tpu.cache.scheduler_cache import Cache
+from kueue_tpu.scheduler.cycle import (
+    CycleResult,
+    EntryStatus,
+    RequeueReason,
+    SchedulerCycle,
+)
+from kueue_tpu.workload_info import WorkloadInfo, admission_from_assignment
+
+
+@dataclass
+class EngineEvent:
+    time: float
+    kind: str  # Admitted | Preempted | Requeued | Finished | Submitted
+    workload: str
+    cluster_queue: str = ""
+    detail: str = ""
+
+
+@dataclass
+class EngineMetrics:
+    """The north-star self-metrics (pkg/metrics/metrics.go:345-383)."""
+
+    admission_attempts_total: int = 0
+    admission_cycles: int = 0
+    admissions_total: int = 0
+    preemptions_total: int = 0
+    admission_cycle_preemption_skips: dict[str, int] = field(
+        default_factory=dict)
+    cycle_durations: list[float] = field(default_factory=list)
+
+
+class Engine:
+    def __init__(self, enable_fair_sharing: bool = False,
+                 cycle: Optional[SchedulerCycle] = None):
+        self.queues = QueueManager()
+        self.cache = Cache()
+        self.cycle = cycle or SchedulerCycle(
+            enable_fair_sharing=enable_fair_sharing)
+        self.clock: float = 0.0
+        self.events: list[EngineEvent] = []
+        self.metrics = EngineMetrics()
+        self.workloads: dict[str, Workload] = {}
+        # hook: called with (workload, admission) after each admission.
+        self.on_admit: Optional[Callable] = None
+
+    # -- object admin --
+
+    def create_cluster_queue(self, cq: ClusterQueue) -> None:
+        self.cache.add_or_update_cluster_queue(cq)
+        self.queues.add_cluster_queue(cq)
+
+    def create_cohort(self, cohort: Cohort) -> None:
+        self.cache.add_or_update_cohort(cohort)
+
+    def create_resource_flavor(self, rf: ResourceFlavor) -> None:
+        self.cache.add_or_update_resource_flavor(rf)
+
+    def create_local_queue(self, lq: LocalQueue) -> None:
+        self.queues.add_local_queue(lq)
+
+    # -- workload lifecycle --
+
+    def submit(self, wl: Workload) -> bool:
+        if not wl.creation_time:
+            wl.creation_time = self.clock
+        self.workloads[wl.key] = wl
+        info = self.queues.add_or_update_workload(wl)
+        if info is None:
+            return False
+        self._event("Submitted", wl.key,
+                    cluster_queue=info.cluster_queue)
+        return True
+
+    def finish(self, key: str) -> None:
+        wl = self.workloads.get(key)
+        if wl is None:
+            return
+        wl.set_condition(WorkloadConditionType.FINISHED, True,
+                         reason="Succeeded", now=self.clock)
+        cq_name = (wl.status.admission.cluster_queue
+                   if wl.status.admission else "")
+        self.cache.delete_workload(key)
+        self.queues.delete_workload(wl)
+        self._event("Finished", key, cluster_queue=cq_name)
+        self._requeue_cohort_inadmissible(cq_name)
+
+    # -- the scheduling loop --
+
+    def schedule_once(self) -> Optional[CycleResult]:
+        """One schedule() cycle (scheduler.go:286)."""
+        heads = self.queues.heads()
+        if not heads:
+            return None
+        self.metrics.admission_cycles += 1
+        snapshot = self.cache.snapshot()
+        already = set(self.cache.workloads)
+        result = self.cycle.schedule(heads, snapshot, now=self.clock,
+                                     already_admitted=already)
+        for e in result.entries:
+            self.metrics.admission_attempts_total += 1
+            if e.status == EntryStatus.ASSUMED:
+                self._admit(e)
+            elif e.status == EntryStatus.PREEMPTING:
+                self._issue_preemptions(e)
+                self._requeue(e)
+            else:
+                self._requeue(e)
+        for e in result.inadmissible:
+            self._requeue(e)
+        for cq_name, skips in result.stats.preemption_skips.items():
+            m = self.metrics.admission_cycle_preemption_skips
+            m[cq_name] = m.get(cq_name, 0) + skips
+        return result
+
+    def run_until_quiescent(self, max_cycles: int = 10_000) -> int:
+        """Drive cycles until no progress is possible (tests/bench)."""
+        cycles = 0
+        while cycles < max_cycles:
+            result = self.schedule_once()
+            cycles += 1
+            if result is None:
+                break
+            if not result.assumed and not any(
+                    e.status == EntryStatus.PREEMPTING
+                    for e in result.entries):
+                break
+        return cycles
+
+    # -- internals --
+
+    def _admit(self, entry) -> None:
+        wl = entry.obj
+        admission = admission_from_assignment(entry.info.cluster_queue,
+                                              entry.assignment.pod_sets)
+        wl.status.admission = admission
+        wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
+                         reason="QuotaReserved", now=self.clock)
+        wl.set_condition(WorkloadConditionType.ADMITTED, True,
+                         reason="Admitted", now=self.clock)
+        entry.info.apply_admission(admission)
+        self.cache.add_or_update_workload(wl)
+        self.metrics.admissions_total += 1
+        self._event("Admitted", wl.key,
+                    cluster_queue=entry.info.cluster_queue)
+        if self.on_admit is not None:
+            self.on_admit(wl, admission)
+
+    def _issue_preemptions(self, entry) -> None:
+        for target in entry.preemption_targets:
+            twl = self.workloads.get(target.workload.key)
+            if twl is None or twl.is_finished:
+                continue
+            twl.set_condition(WorkloadConditionType.EVICTED, True,
+                              reason="Preempted", message=target.reason,
+                              now=self.clock)
+            twl.set_condition(WorkloadConditionType.PREEMPTED, True,
+                              reason=target.reason, now=self.clock)
+            twl.set_condition(WorkloadConditionType.ADMITTED, False,
+                              reason="Preempted", now=self.clock)
+            twl.set_condition(WorkloadConditionType.QUOTA_RESERVED, False,
+                              reason="Preempted", now=self.clock)
+            cq_name = target.workload.cluster_queue
+            twl.status.admission = None
+            self.cache.delete_workload(twl.key)
+            self.metrics.preemptions_total += 1
+            self._event("Preempted", twl.key, cluster_queue=cq_name,
+                        detail=target.reason)
+            # Back to pending (workload controller requeue-after-evict).
+            requeued = self.queues.add_or_update_workload(twl)
+            if requeued is not None:
+                requeued.obj.status.requeue_count += 1
+
+    def _requeue(self, entry) -> None:
+        """scheduler.go:1016 (requeueAndUpdate)."""
+        wl = entry.obj
+        if wl.is_finished:
+            return
+        reason = entry.requeue_reason
+        if (entry.status not in (EntryStatus.NOT_NOMINATED,
+                                 EntryStatus.INADMISSIBLE)
+                and reason == RequeueReason.GENERIC):
+            reason = RequeueReason.FAILED_AFTER_NOMINATION
+        self.queues.requeue_workload(entry.info, reason)
+        self._event("Requeued", wl.key,
+                    cluster_queue=entry.info.cluster_queue,
+                    detail=f"{reason.value}: {entry.inadmissible_msg}")
+
+    def _requeue_cohort_inadmissible(self, cq_name: str) -> None:
+        """Capacity freed: re-activate inadmissible workloads of the cohort
+        (manager.go QueueAssociatedInadmissibleWorkloadsAfter)."""
+        cq = self.cache.cluster_queues.get(cq_name)
+        if cq is None:
+            return
+        if cq.cohort is None:
+            self.queues.queue_inadmissible_workloads({cq_name})
+            return
+        # All CQs sharing the cohort forest root.
+        snap = self.cache.snapshot()
+        cqs = snap.cluster_queue(cq_name)
+        if cqs is None or not cqs.has_parent():
+            self.queues.queue_inadmissible_workloads({cq_name})
+            return
+        root = cqs.parent.root()
+        names = {c.name for c in root.subtree_cluster_queues()}
+        self.queues.queue_inadmissible_workloads(names)
+
+    def _event(self, kind: str, workload: str, cluster_queue: str = "",
+               detail: str = "") -> None:
+        self.events.append(EngineEvent(self.clock, kind, workload,
+                                       cluster_queue, detail))

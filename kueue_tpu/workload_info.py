@@ -125,7 +125,8 @@ def admission_from_assignment(cluster_queue: str, pod_sets) -> Admission:
         pod_set_assignments=tuple(
             PodSetAssignmentStatus(
                 name=psa.name,
-                flavors=dict(psa.flavors),
+                flavors={res: getattr(fa, "name", fa)
+                         for res, fa in psa.flavors.items()},
                 resource_usage=dict(psa.requests),
                 count=psa.count,
                 topology_assignment=psa.topology_assignment,

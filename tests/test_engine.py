@@ -1,0 +1,148 @@
+"""End-to-end engine tests: submit → schedule → admit/preempt/finish,
+mirroring the reference's integration-test scenarios in miniature."""
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    ClusterQueuePreemption,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    QueueingStrategy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+
+CPU = "cpu"
+
+
+def make_engine(nominal=1000, cohort=None, preemption=None, n_cqs=1,
+                strategy=QueueingStrategy.BEST_EFFORT_FIFO):
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    for i in range(n_cqs):
+        name = f"cq{i}"
+        eng.create_cluster_queue(ClusterQueue(
+            name=name, cohort=cohort, queueing_strategy=strategy,
+            preemption=preemption or ClusterQueuePreemption(),
+            resource_groups=(ResourceGroup(
+                (CPU,),
+                (FlavorQuotas("default", {CPU: ResourceQuota(nominal)}),)),),
+        ))
+        eng.create_local_queue(LocalQueue(f"lq{i}", "default", name))
+    return eng
+
+
+def submit(eng, name, cpu, lq="lq0", priority=0, count=1):
+    eng.clock += 0.001  # distinct creation timestamps
+    wl = Workload(name=name, queue_name=lq, priority=priority,
+                  pod_sets=(PodSet("main", count, {CPU: cpu}),))
+    assert eng.submit(wl)
+    return wl
+
+
+def test_end_to_end_admission_and_finish():
+    eng = make_engine(nominal=1000)
+    w1 = submit(eng, "w1", 600)
+    w2 = submit(eng, "w2", 600)
+    eng.schedule_once()
+    assert w1.is_admitted
+    assert not w2.is_admitted  # no room
+    eng.schedule_once()
+    assert not w2.is_admitted
+    eng.clock = 10.0
+    eng.finish("default/w1")
+    eng.schedule_once()
+    assert w2.is_admitted
+    assert eng.metrics.admissions_total == 2
+
+
+def test_fifo_order_within_queue():
+    eng = make_engine(nominal=1000)
+    ws = [submit(eng, f"w{i}", 400) for i in range(4)]
+    for _ in range(4):
+        eng.schedule_once()
+    admitted = [w.name for w in ws if w.is_admitted]
+    assert admitted == ["w0", "w1"]
+
+
+def test_priority_order_within_queue():
+    eng = make_engine(nominal=400)
+    submit(eng, "lo", 400, priority=0)
+    hi = submit(eng, "hi", 400, priority=10)
+    eng.schedule_once()
+    eng.schedule_once()
+    assert hi.is_admitted
+
+
+def test_preemption_end_to_end_requeues_victim():
+    eng = make_engine(
+        nominal=1000,
+        preemption=ClusterQueuePreemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY))
+    low = submit(eng, "low", 800, priority=0)
+    eng.schedule_once()
+    assert low.is_admitted
+    eng.clock = 5.0
+    high = submit(eng, "high", 800, priority=10)
+    eng.schedule_once()  # issues preemption of low
+    assert low.is_evicted
+    assert not high.is_admitted
+    eng.schedule_once()  # high admits into freed capacity
+    assert high.is_admitted
+    assert eng.metrics.preemptions_total == 1
+    # low is pending again
+    assert eng.queues.pending_workloads("cq0") == 1
+
+
+def test_inadmissible_parked_and_reactivated_on_finish():
+    eng = make_engine(nominal=1000)
+    big = submit(eng, "big", 900)
+    eng.schedule_once()
+    assert big.is_admitted
+    blocked = submit(eng, "blocked", 900)
+    eng.schedule_once()
+    # parked as inadmissible, not busy-looped
+    pcq = eng.queues.cluster_queues["cq0"]
+    assert "default/blocked" in pcq.inadmissible
+    assert eng.schedule_once() is None  # no heads -> idle
+    eng.clock = 3.0
+    eng.finish("default/big")
+    eng.schedule_once()
+    assert blocked.is_admitted
+
+
+def test_cohort_borrowing_end_to_end():
+    eng = make_engine(nominal=500, cohort="co", n_cqs=2)
+    w = submit(eng, "big", 900, lq="lq0")
+    eng.schedule_once()
+    assert w.is_admitted  # borrowed from cq1's unused quota
+    w2 = submit(eng, "other", 500, lq="lq1")
+    eng.schedule_once()
+    assert not w2.is_admitted  # capacity lent out
+    eng.clock = 2.0
+    eng.finish("default/big")
+    eng.schedule_once()
+    assert w2.is_admitted
+
+
+def test_strict_fifo_blocks_behind_head():
+    eng = make_engine(nominal=1000, strategy=QueueingStrategy.STRICT_FIFO)
+    submit(eng, "huge", 2000)  # can never fit
+    small = submit(eng, "small", 100)
+    for _ in range(3):
+        eng.schedule_once()
+    # StrictFIFO: small must NOT be admitted while the head is blocked.
+    assert not small.is_admitted
+
+
+def test_best_effort_fifo_skips_blocked_head():
+    eng = make_engine(nominal=1000)
+    submit(eng, "huge", 2000)
+    small = submit(eng, "small", 100)
+    eng.schedule_once()
+    eng.schedule_once()
+    assert small.is_admitted
