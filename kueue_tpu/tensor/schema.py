@@ -1,0 +1,279 @@
+"""Dense tensor encoding of the scheduling world — the real API between the
+control plane and the TPU oracle.
+
+Mirrors (in array form) the reference's snapshot structures:
+  * cohort forest → parent-index / ancestor arrays (depth-capped, padded)
+    [pkg/cache/hierarchy, pkg/cache/scheduler/snapshot.go:51]
+  * per-node quota knobs → [N, R] arrays over flavor-resource pairs
+    [resource_node.go:30]
+  * per-CQ resource-group flavor orderings → [C, G, F] index arrays
+    [clusterqueue_snapshot.go ResourceGroups]
+  * workloads → request matrix [W, S] + priority/timestamp/cq vectors
+    [workload.Info, pkg/workload/workload.go:215]
+
+Layout conventions:
+  * Nodes 0..C-1 are ClusterQueues, C..N-1 are Cohorts. -1 = "none".
+  * A flavor-resource index is fl * S + s (dense NF x S grid); quotas
+    default to nominal 0, no borrowing beyond, nothing lendable... i.e.
+    nominal=0, borrowing_limit=INF, lending_limit=INF for undefined pairs
+    (matching map-miss semantics of the Go code: missing quota = zero
+    nominal, nil limits).
+
+All quantity arrays are int64 (milli-units, INF sentinel = api.types.INF).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from kueue_tpu.api.types import (
+    INF,
+    BorrowWithinCohortPolicy,
+    ClusterQueue,
+    FungibilityPolicy,
+    FungibilityPreference,
+    PreemptionPolicy,
+)
+from kueue_tpu.cache.snapshot import Snapshot
+from kueue_tpu.workload_info import WorkloadInfo
+
+
+@dataclass
+class WorldTensors:
+    """The dense snapshot. All numpy here; ops/ moves them to device."""
+
+    # -- dimensions --
+    num_cqs: int
+    num_nodes: int
+    num_flavors: int
+    num_resources: int
+    max_flavors_per_group: int
+    max_groups: int
+    depth: int  # max ancestor-chain length
+
+    # -- name maps (host-only) --
+    cq_names: list
+    cohort_names: list
+    flavor_names: list
+    resource_names: list
+
+    # -- cohort forest --
+    parent: np.ndarray  # int32[N] node index, -1 = root
+    ancestors: np.ndarray  # int32[N, depth], padded -1, [i,0] = parent
+    height: np.ndarray  # int32[N] subtree height (cohorts; CQs = 0)
+
+    # -- quotas [N, R] where R = NF * S --
+    nominal: np.ndarray  # int64
+    borrow_limit: np.ndarray  # int64, INF = unlimited
+    lend_limit: np.ndarray  # int64, INF = everything lendable
+    usage: np.ndarray  # int64 — CQ rows only; cohort rows derived in ops
+
+    # -- per-CQ config --
+    group_of_res: np.ndarray  # int32[C, S] resource-group id, -1 = uncovered
+    group_flavors: np.ndarray  # int32[C, G, F] flavor ids in try order, -1 pad
+    # static policy flags for the kernel
+    no_preemption: np.ndarray  # bool[C] — all preemption policies Never
+    can_preempt_while_borrowing: np.ndarray  # bool[C]
+    fung_borrow_try_next: np.ndarray  # bool[C] whenCanBorrow == TryNextFlavor
+    fung_preempt_try_next: np.ndarray  # bool[C] whenCanPreempt == TryNextFlavor
+    fung_pref_preempt_first: np.ndarray  # bool[C] PreemptionOverBorrowing
+    fair_weight: np.ndarray  # float64[N]
+
+    def fr_index(self, flavor: str, resource: str) -> int:
+        return (self.flavor_names.index(flavor) * self.num_resources
+                + self.resource_names.index(resource))
+
+
+@dataclass
+class WorkloadTensors:
+    """Pending workloads (single-podset fast path)."""
+
+    num_workloads: int
+    keys: list  # host-side workload keys, aligned with rows
+    cq: np.ndarray  # int32[W] CQ index
+    priority: np.ndarray  # int64[W] effective priority
+    timestamp: np.ndarray  # float64[W] queue-order timestamp
+    requests: np.ndarray  # int64[W, S] count-scaled totals
+    has_quota_reservation: np.ndarray  # bool[W]
+    eligible: np.ndarray  # bool[W] — encodable on the fast path
+
+
+def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
+    """Flatten a Snapshot into dense arrays."""
+    cq_names = sorted(snap.cluster_queues)
+    cohort_names = sorted(snap.cohorts)
+    cq_idx = {n: i for i, n in enumerate(cq_names)}
+    cohort_idx = {n: len(cq_names) + i for i, n in enumerate(cohort_names)}
+    C = len(cq_names)
+    N = C + len(cohort_names)
+
+    flavor_names = sorted(snap.resource_flavors)
+    resource_names = sorted({
+        fr.resource
+        for cqs in snap.cluster_queues.values()
+        for fr in cqs.node.quotas
+    } | {
+        fr.resource
+        for cs in snap.cohorts.values()
+        for fr in cs.node.quotas
+    })
+    # Flavors referenced in quotas but not registered as ResourceFlavor
+    # objects still need ids (reference logs "flavor not found").
+    referenced = {
+        fr.flavor
+        for node in list(snap.cluster_queues.values()) + list(
+            snap.cohorts.values())
+        for fr in node.node.quotas
+    }
+    for f in sorted(referenced - set(flavor_names)):
+        flavor_names.append(f)
+    fl_idx = {n: i for i, n in enumerate(flavor_names)}
+    s_idx = {n: i for i, n in enumerate(resource_names)}
+    NF, S = len(flavor_names), len(resource_names)
+    R = max(NF * S, 1)
+
+    parent = np.full(N, -1, np.int32)
+    fair_weight = np.ones(N, np.float64)
+
+    def node_of(obj) -> int:
+        from kueue_tpu.cache.snapshot import ClusterQueueSnapshot
+        if isinstance(obj, ClusterQueueSnapshot):
+            return cq_idx[obj.name]
+        return cohort_idx[obj.name]
+
+    all_nodes = [snap.cluster_queues[n] for n in cq_names] + \
+                [snap.cohorts[n] for n in cohort_names]
+    for i, node in enumerate(all_nodes):
+        if node.parent is not None:
+            parent[i] = node_of(node.parent)
+        fair_weight[i] = node.fair_weight
+
+    ancestors = np.full((N, max_depth), -1, np.int32)
+    for i in range(N):
+        a, d = parent[i], 0
+        while a >= 0 and d < max_depth:
+            ancestors[i, d] = a
+            a = parent[a]
+            d += 1
+
+    height = np.zeros(N, np.int32)
+    for name, cs in snap.cohorts.items():
+        height[cohort_idx[name]] = cs.height()
+
+    nominal = np.zeros((N, R), np.int64)
+    borrow_limit = np.full((N, R), INF, np.int64)
+    lend_limit = np.full((N, R), INF, np.int64)
+    usage = np.zeros((N, R), np.int64)
+    for i, node in enumerate(all_nodes):
+        for fr, q in node.node.quotas.items():
+            if fr.flavor not in fl_idx or fr.resource not in s_idx:
+                continue
+            r = fl_idx[fr.flavor] * S + s_idx[fr.resource]
+            nominal[i, r] = q.nominal
+            if q.borrowing_limit is not None:
+                borrow_limit[i, r] = q.borrowing_limit
+            if q.lending_limit is not None:
+                lend_limit[i, r] = q.lending_limit
+        for fr, u in node.node.usage.items():
+            if i >= C:
+                continue  # cohort usage is derived
+            if fr.flavor not in fl_idx or fr.resource not in s_idx:
+                continue
+            usage[i, fl_idx[fr.flavor] * S + s_idx[fr.resource]] = u
+
+    G = max((len(snap.cluster_queues[n].spec.resource_groups)
+             for n in cq_names), default=1) or 1
+    F = 1
+    for n in cq_names:
+        for rg in snap.cluster_queues[n].spec.resource_groups:
+            F = max(F, len(rg.flavors))
+
+    group_of_res = np.full((C, S), -1, np.int32)
+    group_flavors = np.full((C, G, F), -1, np.int32)
+    no_preemption = np.zeros(C, bool)
+    can_pwb = np.zeros(C, bool)
+    fung_b_try = np.zeros(C, bool)
+    fung_p_try = np.zeros(C, bool)
+    fung_pref_p = np.zeros(C, bool)
+    for ci, n in enumerate(cq_names):
+        spec = snap.cluster_queues[n].spec
+        for gi, rg in enumerate(spec.resource_groups):
+            for res in rg.covered_resources:
+                if res in s_idx:
+                    group_of_res[ci, s_idx[res]] = gi
+            for fi, fq in enumerate(rg.flavors):
+                group_flavors[ci, gi, fi] = fl_idx[fq.name]
+        p = spec.preemption
+        no_preemption[ci] = (
+            p.within_cluster_queue == PreemptionPolicy.NEVER
+            and p.reclaim_within_cohort == PreemptionPolicy.NEVER)
+        can_pwb[ci] = (
+            (p.borrow_within_cohort is not None
+             and p.borrow_within_cohort.policy
+             != BorrowWithinCohortPolicy.NEVER)
+            or (snap.cluster_queues[n].fair_sharing_enabled
+                and p.reclaim_within_cohort != PreemptionPolicy.NEVER))
+        fung = spec.flavor_fungibility
+        fung_b_try[ci] = (fung.when_can_borrow
+                          == FungibilityPolicy.TRY_NEXT_FLAVOR)
+        fung_p_try[ci] = (fung.when_can_preempt
+                          == FungibilityPolicy.TRY_NEXT_FLAVOR)
+        fung_pref_p[ci] = (fung.preference
+                           == FungibilityPreference.PREEMPTION_OVER_BORROWING)
+
+    return WorldTensors(
+        num_cqs=C, num_nodes=N, num_flavors=NF, num_resources=S,
+        max_flavors_per_group=F, max_groups=G, depth=max_depth,
+        cq_names=cq_names, cohort_names=cohort_names,
+        flavor_names=flavor_names, resource_names=resource_names,
+        parent=parent, ancestors=ancestors, height=height,
+        nominal=nominal, borrow_limit=borrow_limit, lend_limit=lend_limit,
+        usage=usage, group_of_res=group_of_res, group_flavors=group_flavors,
+        no_preemption=no_preemption, can_preempt_while_borrowing=can_pwb,
+        fung_borrow_try_next=fung_b_try, fung_preempt_try_next=fung_p_try,
+        fung_pref_preempt_first=fung_pref_p, fair_weight=fair_weight,
+    )
+
+
+def encode_workloads(world: WorldTensors,
+                     infos: list[WorkloadInfo]) -> WorkloadTensors:
+    """Encode pending workloads. Multi-podset workloads are marked
+    ineligible for the fast path (host fallback handles them)."""
+    W = len(infos)
+    S = world.num_resources
+    cq_idx = {n: i for i, n in enumerate(world.cq_names)}
+    s_idx = {n: i for i, n in enumerate(world.resource_names)}
+
+    cq = np.full(W, -1, np.int32)
+    priority = np.zeros(W, np.int64)
+    timestamp = np.zeros(W, np.float64)
+    requests = np.zeros((W, S), np.int64)
+    has_qr = np.zeros(W, bool)
+    eligible = np.ones(W, bool)
+    keys = []
+    for i, info in enumerate(infos):
+        keys.append(info.key)
+        cq[i] = cq_idx.get(info.cluster_queue, -1)
+        priority[i] = info.obj.effective_priority
+        timestamp[i] = info.obj.creation_time
+        has_qr[i] = info.obj.has_quota_reservation
+        if cq[i] < 0 or len(info.total_requests) != 1:
+            eligible[i] = False
+            continue
+        psr = info.total_requests[0]
+        # Implicit pods resource when the CQ covers it.
+        reqs = dict(psr.requests)
+        if "pods" in s_idx and world.group_of_res[cq[i], s_idx["pods"]] >= 0:
+            reqs["pods"] = psr.count
+        for res, q in reqs.items():
+            if res not in s_idx:
+                if q > 0:
+                    eligible[i] = False
+                continue
+            requests[i, s_idx[res]] = q
+    return WorkloadTensors(
+        num_workloads=W, keys=keys, cq=cq, priority=priority,
+        timestamp=timestamp, requests=requests,
+        has_quota_reservation=has_qr, eligible=eligible)

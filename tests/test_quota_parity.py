@@ -1,0 +1,155 @@
+"""Differential tests: batched quota kernels (ops/quota.py) vs the
+sequential snapshot math (cache/snapshot.py) on random worlds.
+
+This is the round-1 instance of the golden-decision gate from SURVEY.md §7:
+every kernel is pinned to the sequential oracle on randomized inputs.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jax.config.update("jax_enable_x64", True)
+
+from kueue_tpu.api.types import (  # noqa: E402
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    FlavorResource,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+    PodSet,
+)
+from kueue_tpu.cache.snapshot import (  # noqa: E402
+    build_snapshot,
+    find_height_of_lowest_subtree_that_fits,
+)
+from kueue_tpu.ops import quota as qops  # noqa: E402
+from kueue_tpu.tensor.schema import encode_snapshot  # noqa: E402
+from kueue_tpu.workload_info import WorkloadInfo  # noqa: E402
+
+RESOURCES = ["cpu", "mem"]
+FLAVORS = ["f0", "f1"]
+
+
+def random_world(rng: random.Random, n_cohorts=4, n_cqs=8, admitted=10):
+    cohorts = []
+    for i in range(n_cohorts):
+        parent = None
+        if i > 0 and rng.random() < 0.6:
+            parent = f"co{rng.randrange(i)}"
+        rgs = ()
+        if rng.random() < 0.4:
+            rgs = (_rg(rng),)
+        cohorts.append(Cohort(f"co{i}", parent=parent, resource_groups=rgs))
+    cqs = []
+    for i in range(n_cqs):
+        cohort = f"co{rng.randrange(n_cohorts)}" if rng.random() < 0.8 else None
+        cqs.append(ClusterQueue(
+            name=f"cq{i}", cohort=cohort, resource_groups=(_rg(rng),)))
+    flavors = [ResourceFlavor(f) for f in FLAVORS]
+
+    infos = []
+    for i in range(admitted):
+        cq = rng.choice(cqs)
+        flavor = rng.choice(
+            [fq.name for fq in cq.resource_groups[0].flavors])
+        reqs = {r: rng.randrange(0, 2000) for r in RESOURCES}
+        w = Workload(name=f"w{i}", creation_time=float(i),
+                     pod_sets=(PodSet("main", 1, reqs),))
+        info = WorkloadInfo.from_workload(w, cq.name)
+        for psr in info.total_requests:
+            psr.flavors = {r: flavor for r in RESOURCES}
+        infos.append(info)
+    return build_snapshot(cqs, cohorts, flavors, infos)
+
+
+def _rg(rng: random.Random):
+    n_flavors = rng.randrange(1, len(FLAVORS) + 1)
+    fqs = []
+    for f in rng.sample(FLAVORS, n_flavors):
+        quotas = {}
+        for r in RESOURCES:
+            nominal = rng.choice([0, 500, 1000, 5000])
+            bl = rng.choice([None, None, 0, 1000])
+            ll = rng.choice([None, None, 0, 300])
+            quotas[r] = ResourceQuota(nominal, borrowing_limit=bl,
+                                      lending_limit=ll)
+        fqs.append(FlavorQuotas(f, quotas))
+    return ResourceGroup(tuple(RESOURCES), tuple(fqs))
+
+
+def derive(world):
+    return qops.derive_world(
+        world.nominal, world.lend_limit, world.borrow_limit, world.usage,
+        world.parent, depth=world.depth)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_derived_quantities_match_sequential(seed):
+    rng = random.Random(seed)
+    snap = random_world(rng)
+    world = encode_snapshot(snap)
+    d = jax.tree.map(np.asarray, derive(world))
+
+    S = world.num_resources
+    for ci, name in enumerate(world.cq_names):
+        cqs = snap.cluster_queue(name)
+        for fl_i, fl in enumerate(world.flavor_names):
+            for s_i, res in enumerate(world.resource_names):
+                fr = FlavorResource(fl, res)
+                r = fl_i * S + s_i
+                assert d["subtree_quota"][ci, r] == \
+                    cqs.node.subtree_quota.get(fr, 0), (name, fr)
+                assert d["usage"][ci, r] == cqs.node.usage.get(fr, 0)
+                assert d["available"][ci, r] == cqs.available_raw(fr), \
+                    (name, fr)
+                assert d["potential"][ci, r] == cqs.potential_available(fr)
+                assert d["local_available"][ci, r] == cqs.local_available(fr)
+    for i, name in enumerate(world.cohort_names):
+        ni = world.num_cqs + i
+        cs = snap.cohorts[name]
+        for fl_i, fl in enumerate(world.flavor_names):
+            for s_i, res in enumerate(world.resource_names):
+                fr = FlavorResource(fl, res)
+                r = fl_i * S + s_i
+                assert d["subtree_quota"][ni, r] == \
+                    cs.node.subtree_quota.get(fr, 0), (name, fr)
+                assert d["usage"][ni, r] == cs.node.usage.get(fr, 0), \
+                    (name, fr)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_borrow_height_matches_sequential(seed):
+    rng = random.Random(seed + 100)
+    snap = random_world(rng)
+    world = encode_snapshot(snap)
+    d = derive(world)
+
+    cq_nodes, frs, vals, expected = [], [], [], []
+    S = world.num_resources
+    for ci, name in enumerate(world.cq_names):
+        cqs = snap.cluster_queue(name)
+        for fl_i, fl in enumerate(world.flavor_names):
+            for s_i, res in enumerate(world.resource_names):
+                for val in (0, 100, 1000, 10_000):
+                    fr = FlavorResource(fl, res)
+                    cq_nodes.append(ci)
+                    frs.append(fl_i * S + s_i)
+                    vals.append(val)
+                    expected.append(
+                        find_height_of_lowest_subtree_that_fits(cqs, fr, val))
+
+    h, may = qops.borrow_height(
+        np.array(cq_nodes, np.int32), np.array(frs, np.int32),
+        np.array(vals, np.int64), d, world.ancestors, world.height,
+        world.nominal, depth=world.depth)
+    h, may = np.asarray(h), np.asarray(may)
+    for i, (eh, em) in enumerate(expected):
+        assert h[i] == eh, (i, world.cq_names[cq_nodes[i]], frs[i], vals[i],
+                            (h[i], eh))
+        assert bool(may[i]) == em, (i, "may_reclaim mismatch")
