@@ -1,0 +1,193 @@
+"""Batched flavor assignment: the vmapped nomination kernel.
+
+Replaces the reference's per-workload flavor loop
+(flavorassigner.go:932 findFlavorForPodSets, :1198 fitsResourceQuota) with
+one vectorized pass over ALL pending workloads at once: for each workload,
+scan its ClusterQueue's flavor order per resource group, classify each
+flavor as Fit / NoCandidates / NoFit with a borrowing level, and fold with
+the FlavorFungibility preference lattice (flavorassigner.go:483
+isPreferred, :1127 shouldTryNextFlavor).
+
+Fast-path scope (round 1): single-podset workloads, no taint/affinity
+filtering (worlds using those route through the host path), preemption
+candidate search not simulated on device — workloads whose CQ has a
+non-Never preemption policy and that need preemption are flagged
+``needs_oracle`` and fall back to the sequential preemptor. For CQs with
+all-Never policies the kernel computes the exact NoCandidates outcome the
+sequential path produces (preemption_oracle.go:58).
+
+Mode encoding matches scheduler/flavorassigner.PMode:
+  0=NO_FIT, 1=NO_CANDIDATES, 2/3=preempt/reclaim (host only), 4=FIT.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from kueue_tpu.api.types import INF
+from kueue_tpu.ops.quota import borrow_height, sat_add
+
+P_NO_FIT = 0
+P_NO_CANDIDATES = 1
+P_FIT = 4
+# Representative-mode key: big multiplier so pmode dominates borrow.
+_BIG = 1 << 20
+
+
+def _mode_key(pmode, borrow, pref_preempt_first):
+    """Total order matching isPreferred: larger key = more preferred.
+    Default (BorrowingOverPreemption): pmode major, -borrow minor.
+    PreemptionOverBorrowing: -borrow major, pmode minor.
+    NO_FIT is always least preferred (pmode 0 dominates either way because
+    borrow <= depth << _BIG)."""
+    pmode = pmode.astype(jnp.int64)
+    borrow = borrow.astype(jnp.int64)
+    default_key = pmode * _BIG - borrow
+    pref_key = -borrow * _BIG + pmode
+    # Keep NO_FIT at the absolute bottom under either preference.
+    pref_key = jnp.where(pmode == P_NO_FIT, -_BIG * _BIG, pref_key)
+    default_key = jnp.where(pmode == P_NO_FIT, -_BIG * _BIG, default_key)
+    return jnp.where(pref_preempt_first, pref_key, default_key)
+
+
+@partial(jax.jit, static_argnames=("depth", "num_resources"))
+def assign_flavors(
+    wl_cq,  # int32[W]
+    wl_req,  # int64[W, S]
+    derived,  # dict from quota.derive_world (usage-current)
+    nominal,  # int64[N, R]
+    ancestors,  # int32[N, D]
+    height,  # int32[N]
+    group_of_res,  # int32[C, S]
+    group_flavors,  # int32[C, G, F]
+    no_preemption,  # bool[C]
+    can_pwb,  # bool[C]
+    fung_borrow_try_next,  # bool[C]
+    fung_pref_preempt_first,  # bool[C]
+    *,
+    depth: int,
+    num_resources: int,
+):
+    """Returns per-workload:
+      flavor_of_res: int32[W, S] chosen flavor id per resource (-1 none)
+      pmode: int32[W] representative preemption-mode
+      borrows: int32[W] assignment borrowing level (max over resources)
+      needs_oracle: bool[W]
+      usage_fr: int32[W, S] flavor-resource index per resource (-1 none)
+    """
+    S = num_resources
+    avail = jnp.maximum(0, derived["available"])  # CQ available clipped
+    potential = derived["potential"]
+
+    G, F = group_flavors.shape[1], group_flavors.shape[2]
+
+    def per_workload(c, req):
+        g_of_res = group_of_res[c]  # [S]
+        active = req > 0  # [S]
+
+        def eval_flavor(fl):
+            """Classify flavor fl for every resource: (pmode[S], borrow[S],
+            needs_oracle[S])."""
+            fr = fl * S + jnp.arange(S)  # [S]
+            a = avail[c, fr]
+            p = potential[c, fr]
+            nom = nominal[c, fr]
+            no_fit = req > p
+            fit = req <= a
+            bh, may_reclaim = borrow_height(
+                jnp.full((S,), c, jnp.int32), fr, req, derived, ancestors,
+                height, nominal, depth=depth)
+            preempt_gate = (nom >= req) | may_reclaim | can_pwb[c]
+            pmode = jnp.where(
+                no_fit, P_NO_FIT,
+                jnp.where(fit, P_FIT,
+                          jnp.where(preempt_gate, P_NO_CANDIDATES,
+                                    P_NO_FIT)))
+            oracle = (~no_fit) & (~fit) & preempt_gate & ~no_preemption[c]
+            return pmode, bh, oracle
+
+        def eval_group(g):
+            in_group = (g_of_res == g) & active  # [S]
+            flavors = group_flavors[c, g]  # [F]
+
+            def scan_step(carry, fl):
+                (best_key, best_fl, best_pmode_s, best_borrow_s,
+                 best_oracle, stopped) = carry
+                valid = fl >= 0
+                pmode_s, borrow_s, oracle_s = eval_flavor(
+                    jnp.maximum(fl, 0))
+                # Mask resources outside the group as perfectly-fitting.
+                pmode_s = jnp.where(in_group, pmode_s, P_FIT)
+                borrow_s = jnp.where(in_group, borrow_s, 0)
+                oracle_s = jnp.where(in_group, oracle_s, False)
+                # Representative = worst (min key) over group resources.
+                keys = _mode_key(pmode_s, borrow_s,
+                                 fung_pref_preempt_first[c])
+                rep_key = jnp.min(jnp.where(in_group, keys, keys.max()))
+                rep_pmode = pmode_s[jnp.argmin(
+                    jnp.where(in_group, keys, keys.max()))]
+                rep_borrow = jnp.max(jnp.where(in_group, borrow_s, 0))
+                # shouldTryNextFlavor (kernel modes only).
+                try_next = (rep_pmode <= P_NO_CANDIDATES) | (
+                    (rep_borrow > 0) & fung_borrow_try_next[c])
+                consider = valid & ~stopped
+                better = consider & (rep_key > best_key)
+                stop_here = consider & ~try_next
+                new = (
+                    jnp.where(better | stop_here, rep_key, best_key),
+                    jnp.where(better | stop_here, fl, best_fl),
+                    jnp.where(better | stop_here, pmode_s, best_pmode_s),
+                    jnp.where(better | stop_here, borrow_s, best_borrow_s),
+                    jnp.where(better | stop_here, jnp.any(oracle_s),
+                              best_oracle),
+                    stopped | stop_here,
+                )
+                return new, None
+
+            init = (
+                jnp.asarray(-(_BIG * _BIG) - 1),
+                jnp.asarray(-1, jnp.int32),
+                jnp.full((S,), P_NO_FIT, jnp.int32),
+                jnp.zeros((S,), jnp.int32),
+                jnp.asarray(False),
+                jnp.asarray(False),
+            )
+            (key, fl, pmode_s, borrow_s, oracle, _), _ = jax.lax.scan(
+                scan_step, init, flavors)
+            group_active = jnp.any(in_group)
+            # representative pmode of the chosen flavor over group resources
+            keys = _mode_key(pmode_s, borrow_s, fung_pref_preempt_first[c])
+            rep_pmode = jnp.where(
+                group_active,
+                pmode_s[jnp.argmin(jnp.where(in_group, keys, keys.max()))],
+                P_FIT)
+            rep_pmode = jnp.where(fl < 0, jnp.where(group_active, P_NO_FIT,
+                                                    P_FIT), rep_pmode)
+            group_borrow = jnp.where(group_active & (fl >= 0),
+                                     jnp.max(jnp.where(in_group, borrow_s,
+                                                       0)), 0)
+            return fl, rep_pmode, group_borrow, oracle & group_active
+
+        g_ids = jnp.arange(G)
+        g_fl, g_pmode, g_borrow, g_oracle = jax.vmap(eval_group)(g_ids)
+
+        # Workload-level aggregation.
+        pmode = jnp.min(g_pmode)
+        borrows = jnp.max(g_borrow)
+        needs_oracle = jnp.any(g_oracle)
+        # Resources not covered by any group with a positive request make
+        # the whole assignment NoFit (flavorassigner.go:939-941).
+        uncovered = jnp.any(active & (g_of_res < 0))
+        pmode = jnp.where(uncovered, P_NO_FIT, pmode)
+        flavor_of_res = jnp.where(
+            active & (g_of_res >= 0),
+            g_fl[jnp.maximum(g_of_res, 0)], -1)
+        flavor_of_res = jnp.where(pmode == P_NO_FIT, -1, flavor_of_res)
+        usage_fr = jnp.where(flavor_of_res >= 0,
+                             flavor_of_res * S + jnp.arange(S), -1)
+        return flavor_of_res, pmode, borrows, needs_oracle, usage_fr
+
+    return jax.vmap(per_workload)(wl_cq, wl_req)

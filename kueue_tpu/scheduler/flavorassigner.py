@@ -143,7 +143,9 @@ class PodSetAssignment:
     topology_assignment: Optional[object] = None
 
     def representative_mode(self) -> Mode:
-        if not self.reasons and self.flavors:
+        # Status-clean means Fit even with no flavors (empty requests)
+        # (flavorassigner.go:340-343).
+        if not self.reasons:
             return Mode.FIT
         if not self.flavors:
             return Mode.NO_FIT

@@ -61,11 +61,15 @@ class WorkloadInfo:
     @classmethod
     def from_workload(cls, wl: Workload, cluster_queue: str = "") -> "WorkloadInfo":
         info = cls(obj=wl, cluster_queue=cluster_queue)
+        # Zero-quantity requests carry no scheduling information and are
+        # dropped (pod specs don't list zero resources; reference skips
+        # them in usage accounting, flavorassigner.go:229-234).
         info.total_requests = [
             PodSetResources(
                 name=ps.name,
                 count=ps.count,
-                requests={r: q * ps.count for r, q in ps.requests.items()},
+                requests={r: q * ps.count for r, q in ps.requests.items()
+                          if q != 0},
             )
             for ps in wl.pod_sets
         ]

@@ -1,13 +1,24 @@
-"""Test bootstrap: force an 8-device virtual CPU mesh before JAX imports.
+"""Test bootstrap: force a pure-CPU JAX backend with 8 virtual devices.
 
 Multi-chip hardware is not available in CI; all sharding tests run on a
 virtual CPU mesh (jax.sharding.Mesh over 8 host-platform devices).
+
+Note: the environment's axon sitecustomize registers a remote-TPU backend
+and sets jax.config jax_platforms="axon,cpu" — overriding the JAX_PLATFORMS
+env var. We override it back to "cpu" via jax.config BEFORE any backend
+initialization so unit tests never touch the TPU tunnel (which is reserved
+for bench.py runs).
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,137 @@
+"""Differential tests: batched flavor assignment (ops/assign.py) vs the
+sequential FlavorAssigner on random no-preemption worlds."""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jax.config.update("jax_enable_x64", True)
+
+from kueue_tpu.api.types import (  # noqa: E402
+    ClusterQueue,
+    Cohort,
+    FlavorFungibility,
+    FlavorQuotas,
+    FungibilityPolicy,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.cache.snapshot import build_snapshot  # noqa: E402
+from kueue_tpu.ops import quota as qops  # noqa: E402
+from kueue_tpu.ops import assign as aops  # noqa: E402
+from kueue_tpu.scheduler.flavorassigner import (  # noqa: E402
+    FlavorAssigner,
+    Mode,
+    PMode,
+)
+from kueue_tpu.tensor.schema import encode_snapshot, encode_workloads  # noqa: E402
+from kueue_tpu.workload_info import WorkloadInfo  # noqa: E402
+
+RESOURCES = ["cpu", "mem"]
+FLAVORS = ["f0", "f1", "f2"]
+
+
+def random_world(rng, n_cohorts=3, n_cqs=6, admitted=8):
+    cohorts = [Cohort(f"co{i}",
+                      parent=(f"co{rng.randrange(i)}"
+                              if i and rng.random() < 0.5 else None))
+               for i in range(n_cohorts)]
+    cqs = []
+    for i in range(n_cqs):
+        fung = FlavorFungibility(
+            when_can_borrow=rng.choice([FungibilityPolicy.BORROW,
+                                        FungibilityPolicy.TRY_NEXT_FLAVOR]))
+        n_fl = rng.randrange(1, len(FLAVORS) + 1)
+        fqs = []
+        for f in rng.sample(FLAVORS, n_fl):
+            quotas = {r: ResourceQuota(
+                rng.choice([0, 500, 1000, 4000]),
+                borrowing_limit=rng.choice([None, None, 500]),
+                lending_limit=rng.choice([None, None, 200]))
+                for r in RESOURCES}
+            fqs.append(FlavorQuotas(f, quotas))
+        cqs.append(ClusterQueue(
+            name=f"cq{i}",
+            cohort=f"co{rng.randrange(n_cohorts)}" if rng.random() < 0.8
+            else None,
+            flavor_fungibility=fung,
+            resource_groups=(ResourceGroup(tuple(RESOURCES), tuple(fqs)),)))
+    flavors = [ResourceFlavor(f) for f in FLAVORS]
+
+    infos = []
+    for i in range(admitted):
+        cq = rng.choice(cqs)
+        flavor = rng.choice([fq.name for fq in cq.resource_groups[0].flavors])
+        reqs = {r: rng.randrange(0, 1500) for r in RESOURCES}
+        w = Workload(name=f"adm{i}", creation_time=float(i),
+                     pod_sets=(PodSet("main", 1, reqs),))
+        info = WorkloadInfo.from_workload(w, cq.name)
+        for psr in info.total_requests:
+            psr.flavors = {r: flavor for r in RESOURCES}
+        infos.append(info)
+    return build_snapshot(cqs, cohorts, flavors, infos)
+
+
+def pending_workloads(rng, snap, n=40):
+    out = []
+    cq_names = list(snap.cluster_queues)
+    for i in range(n):
+        reqs = {r: rng.choice([0, 100, 600, 1200, 3000, 9000])
+                for r in RESOURCES}
+        w = Workload(name=f"p{i}", creation_time=100.0 + i,
+                     pod_sets=(PodSet("main", 1, reqs),))
+        out.append(WorkloadInfo.from_workload(w, rng.choice(cq_names)))
+    return out
+
+
+PMODE_TO_MODE = {0: Mode.NO_FIT, 1: Mode.PREEMPT, 4: Mode.FIT}
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_batched_assignment_matches_sequential(seed):
+    rng = random.Random(seed)
+    snap = random_world(rng)
+    pend = pending_workloads(rng, snap)
+
+    world = encode_snapshot(snap)
+    wls = encode_workloads(world, pend)
+    derived = qops.derive_world(
+        world.nominal, world.lend_limit, world.borrow_limit, world.usage,
+        world.parent, depth=world.depth)
+    flavor_of_res, pmode, borrows, needs_oracle, usage_fr = jax.tree.map(
+        np.asarray,
+        aops.assign_flavors(
+            wls.cq, wls.requests, derived, world.nominal, world.ancestors,
+            world.height, world.group_of_res, world.group_flavors,
+            world.no_preemption, world.can_preempt_while_borrowing,
+            world.fung_borrow_try_next, world.fung_pref_preempt_first,
+            depth=world.depth, num_resources=world.num_resources))
+
+    for i, info in enumerate(pend):
+        assert wls.eligible[i]
+        assert not needs_oracle[i]  # all-Never preemption worlds
+        cqs = snap.cluster_queue(info.cluster_queue)
+        seq = FlavorAssigner(info, cqs, snap.resource_flavors).assign()
+        seq_mode = seq.representative_mode()
+        got_mode = PMODE_TO_MODE[pmode[i]]
+        ctx = (seed, i, info.cluster_queue,
+               {r: info.total_requests[0].requests.get(r)
+                for r in RESOURCES})
+        assert got_mode == seq_mode, (ctx, got_mode, seq_mode)
+        if seq_mode == Mode.NO_FIT:
+            continue
+        assert borrows[i] == seq.borrowing, (ctx, borrows[i], seq.borrowing)
+        seq_flavors = {r: fa.name
+                       for r, fa in seq.pod_sets[0].flavors.items()}
+        for s_i, res in enumerate(world.resource_names):
+            want = seq_flavors.get(res)
+            got = (world.flavor_names[flavor_of_res[i, s_i]]
+                   if flavor_of_res[i, s_i] >= 0 else None)
+            if info.total_requests[0].requests.get(res, 0) == 0:
+                continue
+            assert got == want, (ctx, res, got, want)
