@@ -1,0 +1,139 @@
+"""Sequential-equivalent commit as a lax.scan: the cycle's steps 4-5
+(scheduler.go:945 makeIterator ordering + :371 processEntry usage
+accumulation) as one compiled scan over the ordered entries.
+
+The subtle part of the batched design (SURVEY.md §7.4): nomination is
+embarrassingly parallel, but the reference commits entries one at a time
+against evolving usage. We reproduce that exactly with a scan whose carry
+is the [N, R] usage matrix: each step re-checks fit along the entry's
+ancestor chain from current carry (scheduler.go:680 fits) and, on success,
+adds usage with the localQuota bubbling of resource_node.go:144.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from kueue_tpu.api.types import INF
+from kueue_tpu.ops.quota import local_quota, sat_add, sat_sub
+
+
+ENTRY_SKIP = 0  # never commits (NoFit / ineligible slot)
+ENTRY_FIT = 1  # commits if it still fits against evolving usage
+ENTRY_RESERVE = 2  # preempt-mode w/o candidates: reserve capacity
+#   (scheduler.go:499 reserveCapacityForUnreclaimablePreempt)
+ENTRY_FORCE = 3  # adds full usage unconditionally (replay of a decided
+#   admission, e.g. the reservation-free second pass)
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def commit_scan(
+    order,  # int32[K] entry indices in commit order
+    entry_cq,  # int32[K] CQ node per entry
+    entry_fr,  # int32[K, S] flavor-resource index per resource (-1 none)
+    entry_req,  # int64[K, S] request per resource
+    entry_kind,  # int32[K] ENTRY_SKIP / ENTRY_FIT / ENTRY_RESERVE
+    entry_borrows,  # int32[K] assignment borrowing level
+    usage0,  # int64[N, R] usage at cycle start
+    subtree_quota,  # int64[N, R] (static within the cycle)
+    lend_limit,  # int64[N, R]
+    borrow_limit,  # int64[N, R]
+    nominal,  # int64[N, R]
+    ancestors,  # int32[N, D]
+    *,
+    depth: int,
+):
+    """Returns (admitted bool[K] aligned with `order`, final usage)."""
+    lq = local_quota(subtree_quota, lend_limit)
+
+    def step(usage, k):
+        cq = entry_cq[k]
+        frs = entry_fr[k]  # [S]
+        req = entry_req[k]  # [S]
+        active = (frs >= 0) & (req > 0)
+        frs_safe = jnp.maximum(frs, 0)
+
+        # Chain cq -> root as [D+1] node indices (-1 padded).
+        chain = jnp.concatenate(
+            [jnp.asarray([cq], jnp.int32), ancestors[cq]])  # [D+1]
+        chain_ok = chain >= 0
+        chain_safe = jnp.maximum(chain, 0)
+
+        # Gather per-(chain-node, fr) scalars: [D+1, S].
+        g_sq = subtree_quota[chain_safe[:, None], frs_safe[None, :]]
+        g_lq = lq[chain_safe[:, None], frs_safe[None, :]]
+        g_bl = borrow_limit[chain_safe[:, None], frs_safe[None, :]]
+        g_usage = usage[chain_safe[:, None], frs_safe[None, :]]
+        g_local_avail = jnp.maximum(0, sat_sub(g_lq, g_usage))
+
+        # available: walk root -> cq (resource_node.go:106). Root is the
+        # last valid chain node.
+        avail = jnp.zeros_like(req)  # [S]
+        for d in range(depth, -1, -1):
+            is_valid = chain_ok[d]
+            is_root = is_valid & (
+                (d == depth) | (~chain_ok[min(d + 1, depth)]))
+            root_avail = sat_sub(g_sq[d], g_usage[d])
+            stored = sat_sub(g_sq[d], g_lq[d])
+            used_in_parent = jnp.maximum(0, sat_sub(g_usage[d], g_lq[d]))
+            with_max = sat_add(sat_sub(stored, used_in_parent), g_bl[d])
+            clipped = jnp.where(g_bl[d] >= INF, avail,
+                                jnp.minimum(with_max, avail))
+            non_root_avail = sat_add(g_local_avail[d], clipped)
+            avail = jnp.where(
+                is_valid,
+                jnp.where(is_root, root_avail, non_root_avail),
+                avail)
+        # CQ-level clip at zero (clusterqueue_snapshot.go:170).
+        avail = jnp.maximum(0, avail)
+
+        kind = entry_kind[k]
+        fits = (kind == ENTRY_FIT) & jnp.all(
+            jnp.where(active, req <= avail, True))
+
+        # Reservation amount (scheduler.go:708 quotaResourcesToReserve):
+        # when borrowing, cap at nominal+borrowingLimit-usage (or full
+        # usage if no limit); else clamp into remaining nominal headroom.
+        cq_nom = nominal[cq, frs_safe]
+        cq_bl = borrow_limit[cq, frs_safe]
+        cq_usage_now = usage[cq, frs_safe]
+        borrowing_amt = jnp.where(
+            cq_bl >= INF, req,
+            jnp.minimum(req, sat_sub(sat_add(cq_nom, cq_bl), cq_usage_now)))
+        nominal_amt = jnp.maximum(
+            0, jnp.minimum(req, sat_sub(cq_nom, cq_usage_now)))
+        reserve_req = jnp.where(entry_borrows[k] > 0, borrowing_amt,
+                                nominal_amt)
+
+        do_add = fits | (kind == ENTRY_RESERVE) | (kind == ENTRY_FORCE)
+        v = jnp.where(kind == ENTRY_RESERVE, reserve_req, req)
+        v = jnp.where(active & do_add, v, 0)  # [S]
+
+        # Usage bubbling (resource_node.go:144): node gets v, parent gets
+        # max(0, v - localAvailable(node)).
+        new_usage = usage
+        for d in range(depth + 1):
+            add = jnp.where(chain_ok[d], v, 0)
+            new_usage = new_usage.at[chain_safe[d], frs_safe].add(
+                jnp.where(active, add, 0))
+            v = jnp.maximum(0, v - g_local_avail[d])
+        return new_usage, fits
+
+    usage_final, admitted = jax.lax.scan(step, usage0, order)
+    return admitted, usage_final
+
+
+def make_commit_order_key(has_qr, borrows, priority, ts_rank):
+    """Classical iterator sort key (scheduler.go:971): quota-reserved
+    first, fewer borrows, higher priority, FIFO. Composite int64 for a
+    single argsort."""
+    hq = jnp.where(has_qr, 0, 1).astype(jnp.int64)
+    b = jnp.clip(borrows, 0, 31).astype(jnp.int64)
+    # Invert priority into a non-negative ascending component.
+    p_inv = (jnp.int64(1 << 31) - 1 - priority.astype(jnp.int64))
+    r = ts_rank.astype(jnp.int64)
+    return (hq << 62) | (b << 56) | (p_inv << 24) | jnp.clip(r, 0,
+                                                             (1 << 24) - 1)

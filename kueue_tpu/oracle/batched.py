@@ -1,0 +1,267 @@
+"""The batched scheduling oracle: whole scheduling cycles as one compiled
+device program, driven to quiescence by a small host loop.
+
+This is the north-star component (BASELINE.json): the reference's
+per-workload admission loop — heads → snapshot → nominate → order → commit
+(scheduler.go:286) — lifted into Workloads x ClusterQueues x
+FlavorResources array programs:
+
+  cycle_step (jit):
+    1. derive quota state from current usage        [ops/quota.derive_world]
+    2. pick per-CQ heads (priority/ts ranks)        [segment-min]
+    3. nominate ALL heads at once                   [ops/assign.assign_flavors]
+    4. order entries (classical iterator key)       [argsort of composite key]
+    5. sequential-equivalent commit                 [ops/commit.commit_scan]
+    6. park NoFit heads (BestEffortFIFO inadmissible semantics)
+
+Fast-path scope (round 1): classical ordering (no fair-sharing tournament),
+no-preemption-policy ClusterQueues decided entirely on device; workloads
+flagged `needs_oracle` (preemption candidates required) are returned for
+the host's sequential preemptor. Multi-podset workloads are pre-filtered
+by the encoder (schema.encode_workloads eligible mask).
+
+Decision parity with the sequential engine is enforced by
+tests/test_drain_parity.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kueue_tpu.ops import assign as aops
+from kueue_tpu.ops import commit as cops
+from kueue_tpu.ops import quota as qops
+from kueue_tpu.tensor.schema import (
+    WorkloadTensors,
+    WorldTensors,
+    encode_snapshot,
+    encode_workloads,
+)
+
+BIG_RANK = np.int64(1) << 40
+
+
+@dataclass
+class DrainDecision:
+    key: str
+    cluster_queue: str
+    cycle: int
+    position: int  # commit position within the cycle
+    flavors: dict  # resource -> flavor name
+
+
+@partial(jax.jit, static_argnames=("depth", "num_resources", "num_cqs"))
+def cycle_step(
+    pending,  # bool[W]
+    inadmissible,  # bool[W]
+    usage,  # int64[N, R] (full node usage, invariant-consistent)
+    rank,  # int64[W] global head-order rank (priority desc, ts asc)
+    commit_rank,  # int64[W] FIFO tiebreak rank for the commit order
+    wl_cq,  # int32[W]
+    wl_req,  # int64[W, S]
+    wl_priority,  # int64[W]
+    wl_has_qr,  # bool[W]
+    nominal, lend_limit, borrow_limit, parent, ancestors, height,
+    group_of_res, group_flavors, no_preemption, can_pwb, can_always_reclaim,
+    best_effort, fung_borrow_try_next, fung_pref_preempt_first,
+    *,
+    depth: int, num_resources: int, num_cqs: int,
+):
+    W = pending.shape[0]
+    C = num_cqs
+    S = num_resources
+
+    # 1. Derive quota state from CQ usage rows.
+    is_cq_row = (jnp.arange(usage.shape[0]) < C)[:, None]
+    cq_usage = jnp.where(is_cq_row, usage, 0)
+    derived = qops.derive_world(nominal, lend_limit, borrow_limit, cq_usage,
+                                parent, depth=depth)
+
+    # 2. Heads: per CQ, lowest rank among active pending workloads
+    # (manager.go:872 Heads / cluster_queue.go:715 Pop).
+    active = pending & ~inadmissible
+    eff_rank = jnp.where(active, rank, BIG_RANK)
+    head_rank = jax.ops.segment_min(eff_rank, wl_cq, num_segments=C)
+    w_ids = jnp.arange(W, dtype=jnp.int32)
+    is_head = active & (eff_rank == head_rank[wl_cq]) & (eff_rank < BIG_RANK)
+    # Map CQ -> head workload index (-1 none). Heads are unique per CQ
+    # because rank embeds the workload index; non-heads scatter out of
+    # bounds and are dropped.
+    head_idx = jnp.full((C,), -1, jnp.int32).at[
+        jnp.where(is_head, wl_cq, C)].max(w_ids, mode="drop")
+
+    slot_valid = head_idx >= 0
+    h_safe = jnp.maximum(head_idx, 0)
+    h_cq = jnp.where(slot_valid, wl_cq[h_safe], 0).astype(jnp.int32)
+    h_req = jnp.where(slot_valid[:, None], wl_req[h_safe], 0)
+
+    # 3. Nominate all heads at once.
+    flavor_of_res, pmode, borrows, needs_oracle, usage_fr = \
+        aops.assign_flavors(
+            h_cq, h_req, derived, nominal, ancestors, height, group_of_res,
+            group_flavors, no_preemption, can_pwb, fung_borrow_try_next,
+            fung_pref_preempt_first, depth=depth, num_resources=S)
+
+    # 4. Commit order (scheduler.go:971).
+    key = cops.make_commit_order_key(
+        wl_has_qr[h_safe] & slot_valid, borrows,
+        jnp.where(slot_valid, wl_priority[h_safe], 0),
+        jnp.where(slot_valid, commit_rank[h_safe], (1 << 24) - 1))
+    order = jnp.argsort(key).astype(jnp.int32)
+
+    # 5. Commit. Entry kinds: FIT commits; preempt-mode-no-candidates
+    # reserves capacity unless the CQ can always reclaim
+    # (scheduler.go:499); everything else skips.
+    kind = jnp.where(
+        ~slot_valid | needs_oracle, cops.ENTRY_SKIP,
+        jnp.where(pmode == aops.P_FIT, cops.ENTRY_FIT,
+                  jnp.where((pmode == aops.P_NO_CANDIDATES)
+                            & ~can_always_reclaim[h_cq],
+                            cops.ENTRY_RESERVE, cops.ENTRY_SKIP)))
+    # Commit against the freshly-aggregated full usage (cohort rows are
+    # derived from CQ rows; the raw carry may predate aggregation).
+    full_usage = derived["usage"]
+    admitted_in_order, usage_after = cops.commit_scan(
+        order, h_cq, usage_fr, h_req, kind, borrows, full_usage,
+        derived["subtree_quota"], lend_limit, borrow_limit, nominal,
+        ancestors, depth=depth)
+
+    # Scatter admission back to head slots, then to workloads.
+    slot_admitted = jnp.zeros((C,), bool).at[order].set(admitted_in_order)
+    slot_position = jnp.zeros((C,), jnp.int32).at[order].set(
+        jnp.arange(C, dtype=jnp.int32))
+    adm_target = jnp.where(slot_valid & slot_admitted, h_safe, W)
+    wl_admitted = jnp.zeros((W,), bool).at[adm_target].set(True, mode="drop")
+
+    # 6. Park NoFit / no-candidate heads on BestEffortFIFO CQs
+    # (cluster_queue.go requeueIfNotPresent + inadmissible map).
+    parked_slot = slot_valid & ~slot_admitted & best_effort & (
+        (pmode == aops.P_NO_FIT) | (pmode == aops.P_NO_CANDIDATES))
+    wl_parked = jnp.zeros((W,), bool).at[
+        jnp.where(parked_slot, h_safe, W)].set(True, mode="drop")
+
+    new_pending = pending & ~wl_admitted
+    new_inadmissible = inadmissible | (wl_parked & new_pending)
+
+    # Reservations are cycle-local (snapshot-local in the reference):
+    # recompute post-cycle usage from admissions only.
+    committed_kind = jnp.where(slot_admitted, cops.ENTRY_FORCE,
+                               cops.ENTRY_SKIP)
+    _, usage_clean = cops.commit_scan(
+        order, h_cq, usage_fr, h_req, committed_kind, borrows, full_usage,
+        derived["subtree_quota"], lend_limit, borrow_limit, nominal,
+        ancestors, depth=depth)
+
+    any_needs_oracle = jnp.any(needs_oracle & slot_valid)
+    return (new_pending, new_inadmissible, usage_clean, wl_admitted,
+            slot_admitted, slot_position, flavor_of_res, any_needs_oracle)
+
+
+class BatchedDrainSolver:
+    """Drive cycle_step to quiescence over a pending set.
+
+    Used by the perf harness and by differential tests; the serving-path
+    integration (engine oracle mode) wraps the same step.
+    """
+
+    def __init__(self, snapshot, pending_infos, max_depth: int = 4):
+        self.world = encode_snapshot(snapshot, max_depth=max_depth)
+        self.wls = encode_workloads(self.world, pending_infos)
+        self.infos = pending_infos
+
+    def head_ranks(self) -> np.ndarray:
+        """Heap order: priority desc, timestamp asc, stable by index
+        (cluster_queue.go heap less)."""
+        W = self.wls.num_workloads
+        order = np.lexsort((np.arange(W), self.wls.timestamp,
+                            -self.wls.priority))
+        rank = np.empty(W, np.int64)
+        rank[order] = np.arange(W)
+        return rank
+
+    def commit_ranks(self) -> np.ndarray:
+        """FIFO tiebreak for the commit ordering: creation/queue-order
+        timestamp ascending (scheduler.go:1001)."""
+        W = self.wls.num_workloads
+        order = np.lexsort((np.arange(W), self.wls.timestamp))
+        rank = np.empty(W, np.int64)
+        rank[order] = np.arange(W)
+        return rank
+
+    def solve(self, max_cycles: int = 10_000):
+        """Drain until no cycle admits anything. Returns
+        (decisions, stats)."""
+        w, wl = self.world, self.wls
+        W = wl.num_workloads
+        pending = jnp.asarray(wl.eligible & (wl.cq >= 0))
+        inadmissible = jnp.zeros(W, bool)
+        usage = jnp.asarray(np.broadcast_to(
+            w.usage, (w.num_nodes, w.nominal.shape[1])).copy())
+        rank = jnp.asarray(self.head_ranks())
+        crank = jnp.asarray(self.commit_ranks())
+
+        args = dict(
+            rank=rank, commit_rank=crank, wl_cq=jnp.asarray(wl.cq),
+            wl_req=jnp.asarray(wl.requests),
+            wl_priority=jnp.asarray(wl.priority),
+            wl_has_qr=jnp.asarray(wl.has_quota_reservation),
+            nominal=jnp.asarray(w.nominal),
+            lend_limit=jnp.asarray(w.lend_limit),
+            borrow_limit=jnp.asarray(w.borrow_limit),
+            parent=jnp.asarray(w.parent),
+            ancestors=jnp.asarray(w.ancestors),
+            height=jnp.asarray(w.height),
+            group_of_res=jnp.asarray(w.group_of_res),
+            group_flavors=jnp.asarray(w.group_flavors),
+            no_preemption=jnp.asarray(w.no_preemption),
+            can_pwb=jnp.asarray(w.can_preempt_while_borrowing),
+            can_always_reclaim=jnp.asarray(w.can_always_reclaim),
+            best_effort=jnp.asarray(w.best_effort),
+            fung_borrow_try_next=jnp.asarray(w.fung_borrow_try_next),
+            fung_pref_preempt_first=jnp.asarray(w.fung_pref_preempt_first),
+        )
+
+        decisions: list[DrainDecision] = []
+        cycles = 0
+        oracle_flag = False
+        for cycle in range(max_cycles):
+            (pending, inadmissible, usage, wl_admitted, slot_admitted,
+             slot_position, flavor_of_res, any_oracle) = cycle_step(
+                pending, inadmissible, usage, **args,
+                depth=w.depth, num_resources=w.num_resources,
+                num_cqs=w.num_cqs)
+            cycles += 1
+            oracle_flag = oracle_flag or bool(any_oracle)
+            adm = np.asarray(wl_admitted)
+            if not adm.any():
+                break
+            slot_adm = np.asarray(slot_admitted)
+            slot_pos = np.asarray(slot_position)
+            flv = np.asarray(flavor_of_res)
+            # Map admitted slots back to workloads for reporting.
+            wl_cq_np = self.wls.cq
+            admitted_ids = np.nonzero(adm)[0]
+            for wid in admitted_ids:
+                ci = wl_cq_np[wid]
+                flavors = {}
+                for s_i, res in enumerate(w.resource_names):
+                    fl = flv[ci, s_i]
+                    if fl >= 0 and self.wls.requests[wid, s_i] > 0:
+                        flavors[res] = w.flavor_names[fl]
+                decisions.append(DrainDecision(
+                    key=self.wls.keys[wid],
+                    cluster_queue=w.cq_names[ci],
+                    cycle=cycle, position=int(slot_pos[ci]),
+                    flavors=flavors))
+        return decisions, {
+            "cycles": cycles,
+            "needs_oracle": oracle_flag,
+            "admitted": len(decisions),
+            "final_usage": np.asarray(usage),
+        }

@@ -81,6 +81,7 @@ class Entry:
     inadmissible_msg: str = ""
     requeue_reason: RequeueReason = RequeueReason.GENERIC
     cq_snapshot: Optional[ClusterQueueSnapshot] = None
+    commit_position: int = -1  # order processed within the cycle
 
     @property
     def obj(self):
@@ -135,7 +136,8 @@ class SchedulerCycle:
                                  already_admitted or set(), now)
         ordered = self._make_iterator(entries, snapshot)
         preempted_workloads: dict[str, WorkloadInfo] = {}
-        for e in ordered:
+        for pos, e in enumerate(ordered):
+            e.commit_position = pos
             self._process_entry(e, snapshot, preempted_workloads, result, now)
         for e in entries:
             if e.status == EntryStatus.ASSUMED:

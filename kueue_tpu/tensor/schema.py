@@ -76,6 +76,8 @@ class WorldTensors:
     # static policy flags for the kernel
     no_preemption: np.ndarray  # bool[C] — all preemption policies Never
     can_preempt_while_borrowing: np.ndarray  # bool[C]
+    can_always_reclaim: np.ndarray  # bool[C] reclaimWithinCohort == Any
+    best_effort: np.ndarray  # bool[C] BestEffortFIFO (parks NoFit heads)
     fung_borrow_try_next: np.ndarray  # bool[C] whenCanBorrow == TryNextFlavor
     fung_preempt_try_next: np.ndarray  # bool[C] whenCanPreempt == TryNextFlavor
     fung_pref_preempt_first: np.ndarray  # bool[C] PreemptionOverBorrowing
@@ -194,6 +196,8 @@ def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
     group_flavors = np.full((C, G, F), -1, np.int32)
     no_preemption = np.zeros(C, bool)
     can_pwb = np.zeros(C, bool)
+    can_always_reclaim = np.zeros(C, bool)
+    best_effort = np.zeros(C, bool)
     fung_b_try = np.zeros(C, bool)
     fung_p_try = np.zeros(C, bool)
     fung_pref_p = np.zeros(C, bool)
@@ -205,7 +209,12 @@ def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
                     group_of_res[ci, s_idx[res]] = gi
             for fi, fq in enumerate(rg.flavors):
                 group_flavors[ci, gi, fi] = fl_idx[fq.name]
+        from kueue_tpu.api.types import QueueingStrategy
+        best_effort[ci] = (spec.queueing_strategy
+                           == QueueingStrategy.BEST_EFFORT_FIFO)
         p = spec.preemption
+        can_always_reclaim[ci] = (p.reclaim_within_cohort
+                                  == PreemptionPolicy.ANY)
         no_preemption[ci] = (
             p.within_cluster_queue == PreemptionPolicy.NEVER
             and p.reclaim_within_cohort == PreemptionPolicy.NEVER)
@@ -232,6 +241,7 @@ def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
         nominal=nominal, borrow_limit=borrow_limit, lend_limit=lend_limit,
         usage=usage, group_of_res=group_of_res, group_flavors=group_flavors,
         no_preemption=no_preemption, can_preempt_while_borrowing=can_pwb,
+        can_always_reclaim=can_always_reclaim, best_effort=best_effort,
         fung_borrow_try_next=fung_b_try, fung_preempt_try_next=fung_p_try,
         fung_pref_preempt_first=fung_pref_p, fair_weight=fair_weight,
     )

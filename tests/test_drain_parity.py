@@ -1,0 +1,122 @@
+"""Whole-cycle differential tests: BatchedDrainSolver vs the sequential
+Engine on random no-preemption worlds — identical admission sets, identical
+admission order, identical flavor assignments (the SURVEY.md §7.4/§7.9
+golden-decision gate)."""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.api.types import (  # noqa: E402
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine  # noqa: E402
+from kueue_tpu.oracle.batched import BatchedDrainSolver  # noqa: E402
+from kueue_tpu.workload_info import WorkloadInfo  # noqa: E402
+
+RESOURCES = ["cpu", "mem"]
+FLAVORS = ["f0", "f1"]
+
+
+def build_world(rng, n_cohorts=3, n_cqs=6):
+    cohorts = [Cohort(f"co{i}",
+                      parent=(f"co{rng.randrange(i)}"
+                              if i and rng.random() < 0.5 else None))
+               for i in range(n_cohorts)]
+    cqs = []
+    for i in range(n_cqs):
+        n_fl = rng.randrange(1, len(FLAVORS) + 1)
+        fqs = []
+        for f in rng.sample(FLAVORS, n_fl):
+            quotas = {r: ResourceQuota(
+                rng.choice([500, 1000, 3000]),
+                borrowing_limit=rng.choice([None, None, 500]),
+                lending_limit=rng.choice([None, None, 200]))
+                for r in RESOURCES}
+            fqs.append(FlavorQuotas(f, quotas))
+        cqs.append(ClusterQueue(
+            name=f"cq{i}",
+            cohort=f"co{rng.randrange(n_cohorts)}" if rng.random() < 0.8
+            else None,
+            resource_groups=(ResourceGroup(tuple(RESOURCES), tuple(fqs)),)))
+    return cqs, cohorts
+
+
+def build_workloads(rng, n_cqs, n=60):
+    out = []
+    for i in range(n):
+        reqs = {r: rng.choice([100, 400, 900, 2500]) for r in RESOURCES}
+        out.append(Workload(
+            name=f"w{i}", queue_name=f"lq{rng.randrange(n_cqs)}",
+            priority=rng.choice([0, 0, 5, 10]),
+            creation_time=float(i) + 1.0,
+            pod_sets=(PodSet("main", 1, reqs),)))
+    return out
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_drain_decisions_match_engine(seed):
+    import copy
+
+    rng = random.Random(seed + 7)
+    cqs, cohorts = build_world(rng)
+    workloads = build_workloads(rng, len(cqs))
+    # The engine mutates workload status; keep pristine copies for the
+    # batched path.
+    workloads_pristine = copy.deepcopy(workloads)
+
+    # Sequential engine drain.
+    eng = Engine()
+    for f in FLAVORS:
+        eng.create_resource_flavor(ResourceFlavor(f))
+    for co in cohorts:
+        eng.create_cohort(co)
+    for cq in cqs:
+        eng.create_cluster_queue(cq)
+    for i in range(len(cqs)):
+        eng.create_local_queue(LocalQueue(f"lq{i}", "default", f"cq{i}"))
+    for wl in workloads:
+        eng.submit(wl)
+    seq_order = []
+    while True:
+        result = eng.schedule_once()
+        if result is None or not result.assumed:
+            break
+        for e in sorted(result.assumed, key=lambda e: e.commit_position):
+            seq_order.append(e.obj.key)
+    seq_flavors = {}
+    for key in seq_order:
+        wl = eng.workloads[key]
+        seq_flavors[key] = dict(
+            wl.status.admission.pod_set_assignments[0].flavors)
+
+    # Batched drain on the same initial world.
+    flavors = [ResourceFlavor(f) for f in FLAVORS]
+    from kueue_tpu.cache.snapshot import build_snapshot
+    snap = build_snapshot(cqs, cohorts, flavors, [])
+    lq_to_cq = {f"lq{i}": f"cq{i}" for i in range(len(cqs))}
+    infos = [WorkloadInfo.from_workload(w, lq_to_cq[w.queue_name])
+             for w in workloads_pristine]
+    solver = BatchedDrainSolver(snap, infos)
+    decisions, stats = solver.solve()
+    assert not stats["needs_oracle"]
+
+    bat_order = [d.key for d in sorted(decisions,
+                                       key=lambda d: (d.cycle, d.position))]
+    assert bat_order == seq_order, (
+        seed, "admission order mismatch",
+        [k for k in bat_order if k not in seq_order],
+        [k for k in seq_order if k not in bat_order])
+    for d in decisions:
+        assert d.flavors == seq_flavors[d.key], (seed, d.key)
