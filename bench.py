@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Headline benchmark: sustained admission throughput of the batched TPU
+scheduling oracle on the baseline-like scenario.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "admissions/s", "vs_baseline": N}
+
+Baseline: the reference admits 15k workloads in ~351 s in its CI baseline
+scenario == ~43 admissions/s sustained (BASELINE.md). We measure the
+batched oracle draining a scaled scenario (1k ClusterQueues in cohorts,
+~50k single-podset workloads) to quiescence: every admission decision goes
+through the full pipeline (derive quota state -> select heads -> nominate
+-> order -> sequential-equivalent commit), so this is decision throughput,
+not a microbenchmark.
+
+The TPU tunnel can be unavailable; if device init does not complete within
+a timeout we fall back to CPU (and say so in the metric name).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+PROBE = "import jax; jax.devices(); print('ok')"
+
+
+def tpu_available(timeout_s: int = 90) -> bool:
+    try:
+        r = subprocess.run([sys.executable, "-c", PROBE],
+                           capture_output=True, timeout=timeout_s)
+        return b"ok" in r.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def main() -> None:
+    platform = os.environ.get("KUEUE_TPU_BENCH_PLATFORM")
+    if platform is None:
+        platform = "default" if tpu_available() else "cpu"
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    dev = jax.devices()[0]
+
+    from kueue_tpu.bench.scenario import baseline_like
+    from kueue_tpu.cache.snapshot import build_snapshot
+    from kueue_tpu.oracle.batched import BatchedDrainSolver
+
+    n_workloads = int(os.environ.get("KUEUE_TPU_BENCH_WORKLOADS", "50000"))
+    n_cohorts = int(os.environ.get("KUEUE_TPU_BENCH_COHORTS", "200"))
+    scen = baseline_like(n_cohorts=n_cohorts, n_workloads=n_workloads)
+    snap = build_snapshot(scen.cluster_queues, scen.cohorts, scen.flavors, [])
+    infos = scen.pending_infos()
+
+    solver = BatchedDrainSolver(snap, infos)
+    # Warm-up: compile the cycle step once (excluded from timing).
+    warm = BatchedDrainSolver(snap, infos)
+    warm.solve(max_cycles=1)
+
+    t0 = time.perf_counter()
+    decisions, stats = solver.solve()
+    elapsed = time.perf_counter() - t0
+
+    admitted = stats["admitted"]
+    value = admitted / elapsed if elapsed > 0 else 0.0
+    baseline = 43.0  # reference sustained admissions/s (BASELINE.md)
+    print(json.dumps({
+        "metric": (
+            f"batched admission throughput, {len(scen.workloads)} workloads"
+            f" x {len(scen.cluster_queues)} CQs, {stats['cycles']} cycles"
+            f" ({dev.platform})"),
+        "value": round(value, 1),
+        "unit": "admissions/s",
+        "vs_baseline": round(value / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Multi-chip sharding of the batched oracle over a jax.sharding.Mesh.
+
+The scaling story (SURVEY.md §2.7/§5): the problem's big axis is Workloads
+(50k+ pending), the small one is the node set (~1k CQs + cohorts). So:
+
+  * workload-axis arrays ([W], [W, S]) are sharded over the mesh's "wl"
+    axis — this is the framework's analog of data/sequence parallelism;
+  * world/node arrays ([N, R], [C, ...]) are replicated (they're KBs);
+  * heads selection (segment-min by CQ over all workloads) becomes a
+    sharded reduction — XLA inserts the psum-style collectives over
+    ICI when the workload axis spans chips;
+  * nomination + commit operate on the [C]-sized head set, which is
+    replicated — the commit scan is sequential by semantics and tiny.
+
+On multi-host TPU (jax.distributed), the same jit works unchanged: the
+mesh spans hosts and the workload shards ride ICI/DCN. No hand-written
+collectives — the sharding annotations are the whole communication layer.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kueue_tpu.oracle.batched import cycle_step
+
+WL_AXIS = "wl"
+
+
+def make_mesh(devices=None, axis: str = WL_AXIS) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def sharded_cycle_step(mesh: Mesh, depth: int, num_resources: int,
+                       num_cqs: int):
+    """Build a pjit-ed cycle step with the workload axis sharded over the
+    mesh. Returns a callable with the same signature as
+    oracle.batched.cycle_step (minus the static kwargs)."""
+    wl_sharded = NamedSharding(mesh, P(WL_AXIS))
+    wl_sharded2 = NamedSharding(mesh, P(WL_AXIS, None))
+    repl = NamedSharding(mesh, P())
+    repl2 = NamedSharding(mesh, P(None, None))
+    repl3 = NamedSharding(mesh, P(None, None, None))
+
+    in_shardings = (
+        wl_sharded,  # pending
+        wl_sharded,  # inadmissible
+        repl2,  # usage
+        wl_sharded,  # rank
+        wl_sharded,  # commit_rank
+        wl_sharded,  # wl_cq
+        wl_sharded2,  # wl_req
+        wl_sharded,  # wl_priority
+        wl_sharded,  # wl_has_qr
+        repl2,  # nominal
+        repl2,  # lend_limit
+        repl2,  # borrow_limit
+        repl,  # parent
+        repl2,  # ancestors
+        repl,  # height
+        repl2,  # group_of_res
+        repl3,  # group_flavors
+        repl,  # no_preemption
+        repl,  # can_pwb
+        repl,  # can_always_reclaim
+        repl,  # best_effort
+        repl,  # fung_borrow_try_next
+        repl,  # fung_pref_preempt_first
+    )
+    out_shardings = (
+        wl_sharded,  # new_pending
+        wl_sharded,  # new_inadmissible
+        repl2,  # usage
+        wl_sharded,  # wl_admitted
+        repl,  # slot_admitted
+        repl,  # slot_position
+        repl2,  # flavor_of_res
+        repl,  # any_needs_oracle
+    )
+
+    fn = partial(cycle_step.__wrapped__, depth=depth,
+                 num_resources=num_resources, num_cqs=num_cqs)
+    return jax.jit(fn, in_shardings=in_shardings,
+                   out_shardings=out_shardings)
+
+
+def shard_workload_arrays(mesh: Mesh, *arrays):
+    """Device-put workload-axis arrays with the wl sharding."""
+    out = []
+    for a in arrays:
+        spec = P(WL_AXIS) if a.ndim == 1 else P(WL_AXIS, *([None] *
+                                                           (a.ndim - 1)))
+        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+    return tuple(out)
