@@ -27,6 +27,8 @@ class Cache:
         self.cluster_queues: dict[str, ClusterQueue] = {}
         self.cohorts: dict[str, Cohort] = {}
         self.resource_flavors: dict[str, ResourceFlavor] = {}
+        self.topologies: dict[str, object] = {}  # api.Topology
+        self.nodes: dict[str, object] = {}  # tas.Node
         # key -> admitted/assumed WorkloadInfo
         self.workloads: dict[str, WorkloadInfo] = {}
 
@@ -49,6 +51,18 @@ class Cache:
 
     def delete_resource_flavor(self, name: str) -> None:
         self.resource_flavors.pop(name, None)
+
+    def add_or_update_topology(self, topology) -> None:
+        self.topologies[topology.name] = topology
+
+    def delete_topology(self, name: str) -> None:
+        self.topologies.pop(name, None)
+
+    def add_or_update_node(self, node) -> None:
+        self.nodes[node.name] = node
+
+    def delete_node(self, name: str) -> None:
+        self.nodes.pop(name, None)
 
     # -- workloads (cache.go:766 AddOrUpdateWorkload / assume) --
 
@@ -93,4 +107,6 @@ class Cache:
             [w for w in self.workloads.values()
              if w.cluster_queue in self.cluster_queues],
             inactive_cluster_queues=self.inactive_cluster_queues(),
+            topologies=list(self.topologies.values()),
+            nodes=list(self.nodes.values()),
         )

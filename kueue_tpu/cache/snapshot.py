@@ -366,6 +366,9 @@ class Snapshot:
         self.cohorts: dict[str, CohortSnapshot] = {}
         self.resource_flavors: dict[str, ResourceFlavor] = {}
         self.inactive_cluster_queues: set[str] = set()
+        # flavor name -> tas.TASFlavorSnapshot, shared by all CQs
+        # referencing the flavor (snapshot-level, like the reference).
+        self.tas_flavors: dict[str, object] = {}
 
     def cluster_queue(self, name: str) -> Optional[ClusterQueueSnapshot]:
         return self.cluster_queues.get(name)
@@ -376,11 +379,17 @@ class Snapshot:
         cq = self.cluster_queues[info.cluster_queue]
         cq.workloads[info.key] = info
         cq.add_usage(info.usage())
+        for flavor, values, single, count in info.tas_domains(
+                self.tas_flavors):
+            self.tas_flavors[flavor].add_usage(values, single, count)
 
     def remove_workload(self, info: WorkloadInfo) -> None:
         cq = self.cluster_queues[info.cluster_queue]
         cq.workloads.pop(info.key, None)
         cq.remove_usage(info.usage())
+        for flavor, values, single, count in info.tas_domains(
+                self.tas_flavors):
+            self.tas_flavors[flavor].remove_usage(values, single, count)
 
     def simulate_workload_removal(
             self, infos: list[WorkloadInfo]) -> Callable[[], None]:
@@ -400,12 +409,30 @@ def build_snapshot(
     resource_flavors: list[ResourceFlavor],
     admitted_workloads: list[WorkloadInfo],
     inactive_cluster_queues: Optional[set[str]] = None,
+    topologies: Optional[list] = None,
+    nodes: Optional[list] = None,
 ) -> Snapshot:
     """Assemble a Snapshot and run the tree-resource accumulation
     (resource_node.go:178 updateCohortTreeResources)."""
     snap = Snapshot()
     snap.resource_flavors = {f.name: f for f in resource_flavors}
     snap.inactive_cluster_queues = set(inactive_cluster_queues or ())
+
+    # TAS flavor snapshots (tas_cache.go): one per flavor with a topology,
+    # fed by the nodes matching the flavor's nodeLabels.
+    if topologies:
+        from kueue_tpu.tas.snapshot import TASFlavorSnapshot
+        topo_by_name = {t.name: t for t in topologies}
+        for rf in resource_flavors:
+            if rf.topology_name and rf.topology_name in topo_by_name:
+                tas_snap = TASFlavorSnapshot(
+                    topo_by_name[rf.topology_name],
+                    flavor_tolerations=tuple(rf.tolerations))
+                for node in nodes or []:
+                    if all(node.labels.get(k) == v
+                           for k, v in rf.node_labels.items()):
+                        tas_snap.add_node(node)
+                snap.tas_flavors[rf.name] = tas_snap
 
     for co in cohorts:
         cs = CohortSnapshot(co.name, co.fair_weight)
@@ -432,6 +459,10 @@ def build_snapshot(
         if cq.cohort:
             cqs.parent = snap.cohorts[cq.cohort]
             snap.cohorts[cq.cohort].child_cqs.append(cqs)
+        for rg in cq.resource_groups:
+            for fq in rg.flavors:
+                if fq.name in snap.tas_flavors:
+                    cqs.tas_flavors[fq.name] = snap.tas_flavors[fq.name]
 
     # Bottom-up subtree quota accumulation from the roots.
     for cs in snap.cohorts.values():

@@ -92,6 +92,18 @@ class Engine:
     def create_local_queue(self, lq: LocalQueue) -> None:
         self.queues.add_local_queue(lq)
 
+    def create_topology(self, topology) -> None:
+        self.cache.add_or_update_topology(topology)
+
+    def create_node(self, node) -> None:
+        """Node lifecycle (tas/node_controller.go)."""
+        self.cache.add_or_update_node(node)
+        self.queues.queue_inadmissible_workloads()
+
+    def delete_node(self, name: str) -> None:
+        self.cache.delete_node(name)
+        self.queues.queue_inadmissible_workloads()
+
     # -- workload lifecycle --
 
     def submit(self, wl: Workload) -> bool:

@@ -181,12 +181,15 @@ class SchedulerCycle:
     def _get_assignments(self, wl: WorkloadInfo, snapshot: Snapshot,
                          now: float) -> tuple[Assignment, list[Target]]:
         """scheduler.go:733,762 (getAssignments / getInitialAssignments)."""
+        from kueue_tpu.tas.assigner import apply_tas_pass
+
         cq = snapshot.cluster_queue(wl.cluster_queue)
         oracle = Oracle(self.preemptor, snapshot, now)
         assigner = FlavorAssigner(
             wl, cq, snapshot.resource_flavors,
             enable_fair_sharing=self.enable_fair_sharing, oracle=oracle)
         full = assigner.assign()
+        apply_tas_pass(full, wl, cq)
         mode = full.representative_mode()
         if mode == Mode.FIT:
             return full, []
@@ -198,6 +201,7 @@ class SchedulerCycle:
                 and wl.obj.can_be_partially_admitted()):
             def try_counts(counts):
                 assignment = assigner.assign(counts)
+                apply_tas_pass(assignment, wl, cq)
                 m = assignment.representative_mode()
                 if m == Mode.FIT:
                     return (assignment, []), True
@@ -255,9 +259,12 @@ class SchedulerCycle:
                 result.stats.preemption_skips.get(cq.name, 0) + 1
             return
 
+        from kueue_tpu.tas.assigner import tas_usage_of_assignment
+
         usage = e.assignment_usage()
+        tas_usage = tas_usage_of_assignment(e.assignment, e.info, cq)
         if not self._fits(snapshot, cq, usage, preempted_workloads,
-                          e.preemption_targets):
+                          e.preemption_targets, tas_usage):
             e.status = EntryStatus.SKIPPED
             e.inadmissible_msg = (
                 "Workload no longer fits after processing another workload")
@@ -269,6 +276,8 @@ class SchedulerCycle:
         for t in e.preemption_targets:
             preempted_workloads[t.workload.key] = t.workload
         cq.add_usage(usage)
+        for flavor, values, single, count in tas_usage:
+            cq.tas_flavors[flavor].add_usage(values, single, count)
 
         if mode == Mode.PREEMPT:
             e.status = EntryStatus.PREEMPTING
@@ -282,13 +291,20 @@ class SchedulerCycle:
     def _fits(snapshot: Snapshot, cq: ClusterQueueSnapshot,
               usage: dict[FlavorResource, int],
               preempted_workloads: dict[str, WorkloadInfo],
-              targets: list[Target]) -> bool:
-        """scheduler.go:680 (fits)."""
+              targets: list[Target], tas_usage=()) -> bool:
+        """scheduler.go:680 (fits), incl. the TAS domain capacity check
+        (clusterqueue_snapshot.go:143-150)."""
         to_remove = list(preempted_workloads.values()) + [
             t.workload for t in targets]
         revert = snapshot.simulate_workload_removal(to_remove)
         try:
-            return cq.fits(usage)
+            if not cq.fits(usage):
+                return False
+            for flavor, values, single, count in tas_usage:
+                if not cq.tas_flavors[flavor].fits([(values, single,
+                                                     count)]):
+                    return False
+            return True
         finally:
             revert()
 

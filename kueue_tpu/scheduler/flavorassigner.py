@@ -234,6 +234,12 @@ NEVER_PREEMPT_ORACLE = _NeverPreemptOracle()
 def flavor_matches_podset(flavor, pod_set) -> Optional[str]:
     """Taint/selector eligibility (flavorassigner.go:1076
     checkFlavorForPodSets). Returns a reason string if ineligible."""
+    # TAS match (tas_flavorassigner.go checkPodSetAndFlavorMatchForTAS):
+    # a pod set with an explicit topology request needs a TAS flavor.
+    if (pod_set.topology_request is not None
+            and flavor.topology_name is None):
+        return (f"Flavor {flavor.name} does not support "
+                "TopologyAwareScheduling")
     tolerations = tuple(pod_set.tolerations) + tuple(flavor.tolerations)
     for taint in flavor.node_taints:
         if taint.effect not in ("NoSchedule", "NoExecute"):

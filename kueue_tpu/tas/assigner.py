@@ -1,0 +1,121 @@
+"""TAS x flavor-assignment glue: after quota-level flavor assignment,
+compute topology placements and adjust the assignment mode.
+
+Reference: pkg/scheduler/flavorassigner/tas_flavorassigner.go and the TAS
+block of assignFlavors (flavorassigner.go:783-821):
+  * Fit assignment -> try real placement; failure downgrades the pod set
+    to Preempt;
+  * Preempt assignment -> re-try with simulate-empty; failure downgrades
+    to NoFit; success keeps Preempt and records the reservation
+    assignment (scheduler.go:836-847).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kueue_tpu.scheduler.flavorassigner import Assignment, Mode
+from kueue_tpu.tas.snapshot import TASPodSetRequest
+from kueue_tpu.workload_info import WorkloadInfo
+
+
+def workload_tas_requests(assignment: Assignment, wl: WorkloadInfo,
+                          cq_snapshot) -> dict[str, list]:
+    """Group the workload's TAS-needing pod sets by assigned TAS flavor
+    (flavorassigner.Assignment.WorkloadsTopologyRequests)."""
+    requests: dict[str, list] = {}
+    for i, psa in enumerate(assignment.pod_sets):
+        ps = wl.obj.pod_sets[i]
+        flavor = next((fa.name for fa in psa.flavors.values()
+                       if fa.name in cq_snapshot.tas_flavors), None)
+        if flavor is None:
+            continue
+        if ps.topology_request is None and not _tas_only(cq_snapshot):
+            continue
+        psr = wl.total_requests[i]
+        single = psr.single_pod_requests()
+        requests.setdefault(flavor, []).append(
+            (psa, TASPodSetRequest(ps, single, psa.count)))
+    return requests
+
+
+def _tas_only(cq_snapshot) -> bool:
+    return bool(cq_snapshot.tas_flavors) and set(
+        cq_snapshot.tas_flavors) >= {
+        fq.name for rg in cq_snapshot.spec.resource_groups
+        for fq in rg.flavors}
+
+
+def find_assignments(cq_snapshot, tas_requests: dict[str, list],
+                     simulate_empty: bool = False):
+    """Run placement per flavor, accumulating assumed usage between pod
+    sets of the same workload
+    (clusterqueue_snapshot.go:207 FindTopologyAssignmentsForWorkload).
+    Returns (results {psa_name: TopologyAssignment}, failure_reason)."""
+    results = {}
+    for flavor in sorted(tas_requests):
+        tas_snap = cq_snapshot.tas_flavors[flavor]
+        assumed: dict[tuple, dict[str, int]] = {}
+        for psa, request in tas_requests[flavor]:
+            assignment, reason = tas_snap.find_topology_assignment(
+                request, simulate_empty=simulate_empty,
+                assumed_usage=assumed)
+            if assignment is None:
+                return None, (psa.name, reason)
+            results[psa.name] = assignment
+            for dom in assignment.domains:
+                bucket = assumed.setdefault(tuple(dom.values), {})
+                for res, per_pod in request.single_pod_requests.items():
+                    bucket[res] = bucket.get(res, 0) + per_pod * dom.count
+                bucket["pods"] = bucket.get("pods", 0) + dom.count
+    return results, None
+
+
+def apply_tas_pass(assignment: Assignment, wl: WorkloadInfo,
+                   cq_snapshot) -> None:
+    """The flavorassigner.go:783-821 TAS block."""
+    tas_requests = workload_tas_requests(assignment, wl, cq_snapshot)
+    if not tas_requests:
+        return
+    if assignment.representative_mode() == Mode.FIT:
+        results, failure = find_assignments(cq_snapshot, tas_requests)
+        if failure is not None:
+            ps_name, reason = failure
+            for psa in assignment.pod_sets:
+                if psa.name == ps_name:
+                    psa.reasons.append(reason)
+            assignment.update_mode(ps_name, Mode.PREEMPT)
+        else:
+            for psa in assignment.pod_sets:
+                if psa.name in results:
+                    psa.topology_assignment = results[psa.name]
+    if assignment.representative_mode() == Mode.PREEMPT:
+        results, failure = find_assignments(cq_snapshot, tas_requests,
+                                            simulate_empty=True)
+        if failure is not None:
+            ps_name, _ = failure
+            assignment.update_mode(ps_name, Mode.NO_FIT)
+        else:
+            # Quota may fit in aggregate while placement is fragmented:
+            # keep Preempt and record the simulated reservation.
+            for psa in assignment.pod_sets:
+                if psa.name in results:
+                    psa.topology_assignment = results[psa.name]
+
+
+def tas_usage_of_assignment(assignment: Assignment, wl: WorkloadInfo,
+                            cq_snapshot) -> list:
+    """(flavor, values, single_pod_requests, count) tuples for the
+    assignment's topology placements (Assignment.ComputeTASNetUsage)."""
+    out = []
+    for i, psa in enumerate(assignment.pod_sets):
+        if psa.topology_assignment is None:
+            continue
+        flavor = next((fa.name for fa in psa.flavors.values()
+                       if fa.name in cq_snapshot.tas_flavors), None)
+        if flavor is None:
+            continue
+        single = wl.total_requests[i].single_pod_requests()
+        for dom in psa.topology_assignment.domains:
+            out.append((flavor, tuple(dom.values), single, dom.count))
+    return out

@@ -112,6 +112,29 @@ class WorkloadInfo:
                 out[fr] = out.get(fr, 0) + qty
         return out
 
+    def tas_domains(self, tas_flavor_names) -> list:
+        """TAS usage tuples (flavor, values, single_pod_requests, count)
+        from the admission's topology assignments
+        (workload.Info TASUsage)."""
+        adm = self.obj.status.admission
+        if adm is None:
+            return []
+        out = []
+        by_name = {psr.name: psr for psr in self.total_requests}
+        for psa in adm.pod_set_assignments:
+            ta = psa.topology_assignment
+            if ta is None:
+                continue
+            flavor = next((f for f in psa.flavors.values()
+                           if f in tas_flavor_names), None)
+            if flavor is None:
+                continue
+            psr = by_name.get(psa.name)
+            single = psr.single_pod_requests() if psr else {}
+            for dom in ta.domains:
+                out.append((flavor, tuple(dom.values), single, dom.count))
+        return out
+
     def uses_any(self, frs: set[FlavorResource]) -> bool:
         """Reference: classical.WorkloadUsesResources
         (candidate_generator.go:54)."""

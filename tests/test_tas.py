@@ -1,0 +1,173 @@
+"""TAS placement algorithm tests, modeled on the reference's
+tas_flavor_snapshot semantics (KEP 2724): two-phase fit counting + level
+descent, required/preferred/unconstrained, slices, usage accounting."""
+
+import pytest
+
+from kueue_tpu.api.types import (
+    PodSet,
+    PodSetTopologyRequest,
+    Topology,
+    TopologyLevel,
+    TopologyMode,
+)
+from kueue_tpu.tas.snapshot import (
+    HOSTNAME_LABEL,
+    Node,
+    TASFlavorSnapshot,
+    TASPodSetRequest,
+)
+
+TOPOLOGY = Topology("default", (
+    TopologyLevel("block"),
+    TopologyLevel("rack"),
+    TopologyLevel(HOSTNAME_LABEL),
+))
+
+
+def make_snapshot(blocks=2, racks=2, hosts=2, cpu=4000):
+    snap = TASFlavorSnapshot(TOPOLOGY)
+    for b in range(blocks):
+        for r in range(racks):
+            for h in range(hosts):
+                name = f"b{b}-r{r}-h{h}"
+                snap.add_node(Node(
+                    name=name,
+                    labels={"block": f"b{b}", "rack": f"b{b}-r{r}",
+                            HOSTNAME_LABEL: name},
+                    capacity={"cpu": cpu, "pods": 100_000}))
+    return snap
+
+
+def req(count, cpu=1000, mode=TopologyMode.REQUIRED, level="rack",
+        slice_size=None, slice_level=None):
+    tr = PodSetTopologyRequest(mode=mode, level=level,
+                               slice_size=slice_size,
+                               slice_level=slice_level)
+    ps = PodSet("main", count, {"cpu": cpu}, topology_request=tr)
+    return TASPodSetRequest(ps, {"cpu": cpu}, count)
+
+
+def test_required_rack_fits_single_rack():
+    snap = make_snapshot()
+    assignment, reason = snap.find_topology_assignment(req(8, cpu=1000))
+    assert reason == ""
+    # 8 pods x 1 cpu -> one rack has 2 hosts x 4 = 8 capacity.
+    racks = {d.values[1] for d in assignment.domains}
+    assert len(racks) == 1
+    assert sum(d.count for d in assignment.domains) == 8
+
+
+def test_required_rack_too_big_fails():
+    snap = make_snapshot()
+    assignment, reason = snap.find_topology_assignment(req(9, cpu=1000))
+    assert assignment is None
+    assert "only 8 out of 9" in reason
+
+
+def test_preferred_climbs_to_block():
+    snap = make_snapshot()
+    assignment, reason = snap.find_topology_assignment(
+        req(9, cpu=1000, mode=TopologyMode.PREFERRED))
+    assert reason == ""
+    blocks = {d.values[0] for d in assignment.domains}
+    assert len(blocks) == 1  # fits within one block (16 capacity)
+    racks = {d.values[1] for d in assignment.domains}
+    assert len(racks) == 2
+
+
+def test_preferred_spans_blocks_when_needed():
+    snap = make_snapshot()
+    assignment, reason = snap.find_topology_assignment(
+        req(20, cpu=1000, mode=TopologyMode.PREFERRED))
+    assert reason == ""
+    assert sum(d.count for d in assignment.domains) == 20
+    assert len({d.values[0] for d in assignment.domains}) == 2
+
+
+def test_best_fit_prefers_smallest_fitting_domain():
+    snap = TASFlavorSnapshot(TOPOLOGY)
+    # rack r0 has 3 hosts, rack r1 has 1 host: a 4-pod job (1 host each)
+    # fits neither; a 2-pod job should land on the smaller fitting rack.
+    for r, hosts in (("r0", 3), ("r1", 2)):
+        for h in range(hosts):
+            name = f"b0-{r}-h{h}"
+            snap.add_node(Node(name=name,
+                               labels={"block": "b0", "rack": r,
+                                       HOSTNAME_LABEL: name},
+                               capacity={"cpu": 1000, "pods": 10}))
+    assignment, reason = snap.find_topology_assignment(req(2, cpu=1000))
+    assert reason == ""
+    assert {d.values[1] for d in assignment.domains} == {"r1"}
+
+
+def test_usage_accounting_blocks_capacity():
+    snap = make_snapshot()
+    a1, reason = snap.find_topology_assignment(req(8, cpu=1000))
+    assert reason == ""
+    for d in a1.domains:
+        snap.add_usage(d.values, {"cpu": 1000}, d.count)
+    # The used rack is full now; next 8-pod job takes another rack.
+    a2, reason = snap.find_topology_assignment(req(8, cpu=1000))
+    assert reason == ""
+    assert {d.values[1] for d in a1.domains}.isdisjoint(
+        {d.values[1] for d in a2.domains})
+    # Remove usage: capacity restored.
+    for d in a1.domains:
+        snap.remove_usage(d.values, {"cpu": 1000}, d.count)
+    a3, reason = snap.find_topology_assignment(req(16, cpu=1000,
+                                                   level="block"))
+    assert reason == ""
+
+
+def test_simulate_empty_ignores_usage():
+    snap = make_snapshot(blocks=1, racks=1, hosts=2)
+    for h in range(2):
+        snap.add_usage(("b0", "b0-r0", f"b0-r0-h{h}"), {"cpu": 4000}, 1)
+    a, reason = snap.find_topology_assignment(req(8, cpu=1000))
+    assert a is None
+    a, reason = snap.find_topology_assignment(req(8, cpu=1000),
+                                              simulate_empty=True)
+    assert reason == ""
+
+
+def test_slices_placed_whole():
+    snap = make_snapshot(blocks=2, racks=2, hosts=4, cpu=4000)
+    # slices of 8 pods at rack level: each rack holds 16 pods (4 hosts x4).
+    a, reason = snap.find_topology_assignment(req(
+        32, cpu=1000, mode=TopologyMode.REQUIRED, level="block",
+        slice_size=8, slice_level="rack"))
+    assert reason == ""
+    assert sum(d.count for d in a.domains) == 32
+    # Each rack must hold whole slices (multiples of 8).
+    per_rack = {}
+    for d in a.domains:
+        per_rack[d.values[1]] = per_rack.get(d.values[1], 0) + d.count
+    assert all(v % 8 == 0 for v in per_rack.values())
+
+
+def test_slice_size_not_divisible():
+    snap = make_snapshot()
+    a, reason = snap.find_topology_assignment(req(
+        10, cpu=1000, slice_size=3, slice_level="rack"))
+    assert a is None
+    assert "not divisible" in reason
+
+
+def test_unconstrained_uses_any_capacity():
+    snap = make_snapshot()
+    a, reason = snap.find_topology_assignment(req(
+        30, cpu=1000, mode=TopologyMode.UNCONSTRAINED, level=None))
+    assert reason == ""
+    assert sum(d.count for d in a.domains) == 30
+
+
+def test_node_selector_restricts_leaves():
+    snap = make_snapshot()
+    tr = PodSetTopologyRequest(mode=TopologyMode.REQUIRED, level="rack")
+    ps = PodSet("main", 4, {"cpu": 1000}, topology_request=tr,
+                node_selector={"block": "b1"})
+    a, reason = snap.find_topology_assignment(
+        TASPodSetRequest(ps, {"cpu": 1000}, 4))
+    assert reason == ""
+    assert all(d.values[0] == "b1" for d in a.domains)

@@ -1,0 +1,125 @@
+"""End-to-end TAS: topology-aware gang admission through the engine —
+flavor assignment + placement + usage accounting + eviction recovery."""
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    ClusterQueuePreemption,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PodSetTopologyRequest,
+    PreemptionPolicy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Topology,
+    TopologyLevel,
+    TopologyMode,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.tas.snapshot import HOSTNAME_LABEL, Node
+
+CPU = "cpu"
+
+
+def make_engine(preemption=None):
+    eng = Engine()
+    eng.create_topology(Topology("tas-topo", (
+        TopologyLevel("block"), TopologyLevel("rack"),
+        TopologyLevel(HOSTNAME_LABEL))))
+    eng.create_resource_flavor(ResourceFlavor(
+        "tas-flavor", node_labels={"pool": "tas"},
+        topology_name="tas-topo"))
+    for b in range(2):
+        for r in range(2):
+            for h in range(2):
+                name = f"b{b}-r{r}-h{h}"
+                eng.create_node(Node(
+                    name=name,
+                    labels={"pool": "tas", "block": f"b{b}",
+                            "rack": f"b{b}r{r}", HOSTNAME_LABEL: name},
+                    capacity={CPU: 4000, "pods": 100}))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", preemption=preemption or ClusterQueuePreemption(),
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("tas-flavor", {CPU: ResourceQuota(32000)}),)),),
+    ))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng
+
+
+def tas_wl(name, count, cpu=1000, mode=TopologyMode.REQUIRED, level="rack",
+           priority=0):
+    return Workload(
+        name=name, queue_name="lq", priority=priority,
+        pod_sets=(PodSet(
+            "main", count, {CPU: cpu},
+            topology_request=PodSetTopologyRequest(mode=mode, level=level)),
+        ))
+
+
+def test_tas_admission_with_assignment():
+    eng = make_engine()
+    w = tas_wl("gang", 8)
+    eng.submit(w)
+    eng.schedule_once()
+    assert w.is_admitted
+    ta = w.status.admission.pod_set_assignments[0].topology_assignment
+    assert ta is not None
+    assert sum(d.count for d in ta.domains) == 8
+    # All in one rack (required).
+    assert len({d.values[1] for d in ta.domains}) == 1
+
+
+def test_tas_capacity_tracked_across_workloads():
+    eng = make_engine()
+    ws = [tas_wl(f"g{i}", 8) for i in range(5)]
+    for w in ws:
+        eng.clock += 1
+        eng.submit(w)
+    for _ in range(6):
+        eng.schedule_once()
+    admitted = [w for w in ws if w.is_admitted]
+    # 4 racks of capacity 8 -> exactly 4 gangs admitted.
+    assert len(admitted) == 4
+    racks = [w.status.admission.pod_set_assignments[0]
+             .topology_assignment.domains[0].values[1] for w in admitted]
+    assert len(set(racks)) == 4
+
+
+def test_tas_freed_on_finish():
+    eng = make_engine()
+    ws = [tas_wl(f"g{i}", 8) for i in range(5)]
+    for w in ws:
+        eng.clock += 1
+        eng.submit(w)
+    for _ in range(6):
+        eng.schedule_once()
+    blocked = next(w for w in ws if not w.is_admitted)
+    first = next(w for w in ws if w.is_admitted)
+    eng.clock += 10
+    eng.finish(first.key)
+    eng.schedule_once()
+    assert blocked.is_admitted
+
+
+def test_tas_quota_fits_but_placement_fragmented():
+    eng = make_engine()
+    # 9 pods at rack level required: no rack has 9 slots although quota
+    # (32 cpu) is plentiful.
+    w = tas_wl("toobig", 9)
+    eng.submit(w)
+    eng.schedule_once()
+    assert not w.is_admitted
+
+
+def test_tas_preferred_spreads():
+    eng = make_engine()
+    w = tas_wl("spread", 12, mode=TopologyMode.PREFERRED)
+    eng.submit(w)
+    eng.schedule_once()
+    assert w.is_admitted
+    ta = w.status.admission.pod_set_assignments[0].topology_assignment
+    assert sum(d.count for d in ta.domains) == 12
