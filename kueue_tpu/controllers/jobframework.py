@@ -1,0 +1,324 @@
+"""Job integration framework: the job <-> Workload contract.
+
+Reference: pkg/controller/jobframework — the GenericJob plugin interface
+(interface.go:36), the generic reconciler (reconciler.go:286
+ReconcileGenericJob) and the integration registry
+(integrationmanager.go). Any job-like object type plugs in by
+implementing GenericJob; the reconciler owns the Workload lifecycle:
+
+  * ensure exactly one Workload per job (reconciler.go:399
+    ensureOneWorkload), built from the job's pod sets;
+  * when the Workload is admitted, start the job with the admission's
+    per-PodSet node selectors / counts (RunWithPodSetsInfo);
+  * when the Workload is evicted/preempted, stop the job and restore pod
+    set info; when the job finishes, mark the Workload Finished.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional, Protocol, runtime_checkable
+
+from kueue_tpu.api.types import (
+    PodSet,
+    Workload,
+    WorkloadConditionType,
+)
+
+
+@dataclass
+class PodSetInfo:
+    """Injected per-PodSet scheduling directives (podset.PodSetInfo):
+    node selectors from the assigned flavor + count from admission."""
+
+    name: str
+    count: int
+    node_selector: dict[str, str] = field(default_factory=dict)
+
+
+@runtime_checkable
+class GenericJob(Protocol):
+    """interface.go:36 (GenericJob)."""
+
+    name: str
+    namespace: str
+    queue_name: str
+
+    def is_suspended(self) -> bool: ...
+
+    def suspend(self) -> None: ...
+
+    def run_with_pod_sets_info(self, infos: list[PodSetInfo]) -> None: ...
+
+    def restore_pod_sets_info(self, infos: list[PodSetInfo]) -> None: ...
+
+    def pod_sets(self) -> list[PodSet]: ...
+
+    def is_active(self) -> bool: ...
+
+    def finished(self) -> tuple[bool, bool]:
+        """Returns (finished, success)."""
+        ...
+
+    @property
+    def key(self) -> str: ...
+
+
+@dataclass
+class BatchJob:
+    """The batch/v1 Job adapter (pkg/controller/jobs/job/)."""
+
+    name: str
+    namespace: str = "default"
+    queue_name: str = ""
+    parallelism: int = 1
+    completions: Optional[int] = None
+    requests: dict[str, int] = field(default_factory=dict)  # per pod
+    priority: int = 0
+    min_parallelism: Optional[int] = None  # partial admission
+    node_selector: dict[str, str] = field(default_factory=dict)
+    suspended: bool = True
+    active_pods: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    injected_info: Optional[list[PodSetInfo]] = None
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def is_suspended(self) -> bool:
+        return self.suspended
+
+    def suspend(self) -> None:
+        self.suspended = True
+        self.active_pods = 0
+
+    def run_with_pod_sets_info(self, infos: list[PodSetInfo]) -> None:
+        self.injected_info = infos
+        self.suspended = False
+        self.active_pods = infos[0].count if infos else self.parallelism
+
+    def restore_pod_sets_info(self, infos: list[PodSetInfo]) -> None:
+        self.injected_info = None
+
+    def pod_sets(self) -> list[PodSet]:
+        return [PodSet(
+            name="main", count=self.parallelism,
+            requests=dict(self.requests),
+            min_count=self.min_parallelism,
+            node_selector=dict(self.node_selector))]
+
+    def is_active(self) -> bool:
+        return self.active_pods > 0
+
+    def finished(self) -> tuple[bool, bool]:
+        target = self.completions if self.completions is not None \
+            else self.parallelism
+        if self.succeeded >= target:
+            return True, True
+        if self.failed > 0:
+            return True, False
+        return False, False
+
+
+@dataclass
+class JobSetJob:
+    """A JobSet-style multi-pod-set gang job
+    (pkg/controller/jobs/jobset/)."""
+
+    name: str
+    namespace: str = "default"
+    queue_name: str = ""
+    # replicated jobs: list of (name, replicas, per-pod requests, topology)
+    replicated_jobs: list = field(default_factory=list)
+    priority: int = 0
+    suspended: bool = True
+    active: bool = False
+    done: bool = False
+    success: bool = False
+    injected_info: Optional[list[PodSetInfo]] = None
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def is_suspended(self) -> bool:
+        return self.suspended
+
+    def suspend(self) -> None:
+        self.suspended = True
+        self.active = False
+
+    def run_with_pod_sets_info(self, infos: list[PodSetInfo]) -> None:
+        self.injected_info = infos
+        self.suspended = False
+        self.active = True
+
+    def restore_pod_sets_info(self, infos) -> None:
+        self.injected_info = None
+
+    def pod_sets(self) -> list[PodSet]:
+        out = []
+        for rj in self.replicated_jobs:
+            name, replicas, requests = rj[0], rj[1], rj[2]
+            topology = rj[3] if len(rj) > 3 else None
+            out.append(PodSet(name=name, count=replicas,
+                              requests=dict(requests),
+                              topology_request=topology))
+        return out
+
+    def is_active(self) -> bool:
+        return self.active
+
+    def finished(self) -> tuple[bool, bool]:
+        return self.done, self.success
+
+
+class IntegrationManager:
+    """integrationmanager.go: the registry of enabled integrations."""
+
+    def __init__(self) -> None:
+        self._types: dict[str, type] = {}
+
+    def register(self, kind: str, job_type: type) -> None:
+        self._types[kind] = job_type
+
+    def kind_of(self, job) -> Optional[str]:
+        for kind, t in self._types.items():
+            if isinstance(job, t):
+                return kind
+        return None
+
+    def kinds(self) -> list[str]:
+        return sorted(self._types)
+
+
+DEFAULT_INTEGRATIONS = IntegrationManager()
+DEFAULT_INTEGRATIONS.register("batch/job", BatchJob)
+DEFAULT_INTEGRATIONS.register("jobset.x-k8s.io/jobset", JobSetJob)
+
+_wl_suffix = itertools.count(1)
+
+
+class JobReconciler:
+    """reconciler.go:286 (ReconcileGenericJob), driven by the engine."""
+
+    def __init__(self, engine, integrations: IntegrationManager = None,
+                 manage_jobs_without_queue_name: bool = False):
+        self.engine = engine
+        self.integrations = integrations or DEFAULT_INTEGRATIONS
+        self.manage_all = manage_jobs_without_queue_name
+        self.jobs: dict[str, GenericJob] = {}
+        self.job_to_workload: dict[str, str] = {}
+        self.workload_to_job: dict[str, str] = {}
+        engine.on_admit = self._chain(engine.on_admit, self._on_admit)
+
+    @staticmethod
+    def _chain(prev, new):
+        if prev is None:
+            return new
+
+        def both(*a, **k):
+            prev(*a, **k)
+            new(*a, **k)
+        return both
+
+    # -- the job-side reconcile loop --
+
+    def create_job(self, job: GenericJob) -> None:
+        self.jobs[job.key] = job
+        self.reconcile(job)
+
+    def delete_job(self, job_key: str) -> None:
+        job = self.jobs.pop(job_key, None)
+        wl_key = self.job_to_workload.pop(job_key, None)
+        if wl_key:
+            self.workload_to_job.pop(wl_key, None)
+            wl = self.engine.workloads.get(wl_key)
+            if wl is not None and not wl.is_finished:
+                self.engine.finish(wl_key)
+
+    def reconcile(self, job: GenericJob) -> None:
+        """One ReconcileGenericJob pass."""
+        if not job.queue_name and not self.manage_all:
+            return  # queue-name management gating (reconciler.go:313-377)
+        wl = self._ensure_one_workload(job)
+        if wl is None:
+            return
+        finished, success = job.finished()
+        if finished and not wl.is_finished:
+            # workloadfinish.Finish (reconciler.go:453-465).
+            wl.set_condition(
+                WorkloadConditionType.FINISHED, True,
+                reason="Succeeded" if success else "Failed",
+                now=self.engine.clock)
+            self.engine.finish(wl.key)
+            return
+        if wl.is_admitted and job.is_suspended():
+            self._start_job(job, wl)
+        elif not wl.is_admitted and not job.is_suspended():
+            # stopJob on eviction (reconciler.go:379-394).
+            job.suspend()
+            job.restore_pod_sets_info([])
+
+    def reconcile_all(self) -> None:
+        for job in list(self.jobs.values()):
+            self.reconcile(job)
+
+    # -- internals --
+
+    def _ensure_one_workload(self, job: GenericJob) -> Optional[Workload]:
+        """reconciler.go:399 (ensureOneWorkload): the Workload must match
+        the job's pod sets; replaced if the shape changed."""
+        wl_key = self.job_to_workload.get(job.key)
+        pod_sets = job.pod_sets()
+        if wl_key is not None:
+            wl = self.engine.workloads.get(wl_key)
+            if wl is not None and _pod_sets_match(wl, pod_sets):
+                return wl
+            if wl is not None:
+                self.engine.finish(wl_key)
+                self.workload_to_job.pop(wl_key, None)
+        wl = Workload(
+            name=f"{job.name}-wl-{next(_wl_suffix)}",
+            namespace=job.namespace,
+            queue_name=job.queue_name,
+            priority=getattr(job, "priority", 0),
+            pod_sets=tuple(pod_sets),
+        )
+        if not self.engine.submit(wl):
+            return None
+        self.job_to_workload[job.key] = wl.key
+        self.workload_to_job[wl.key] = job.key
+        return wl
+
+    def _start_job(self, job: GenericJob, wl: Workload) -> None:
+        """startJob -> RunWithPodSetsInfo (reconciler.go admitted path):
+        inject node selectors of the assigned flavors + admitted counts."""
+        infos = []
+        flavors = self.engine.cache.resource_flavors
+        for psa in wl.status.admission.pod_set_assignments:
+            selector: dict[str, str] = {}
+            for flavor_name in psa.flavors.values():
+                rf = flavors.get(flavor_name)
+                if rf is not None:
+                    selector.update(rf.node_labels)
+            infos.append(PodSetInfo(name=psa.name, count=psa.count,
+                                    node_selector=selector))
+        job.run_with_pod_sets_info(infos)
+
+    def _on_admit(self, wl: Workload, admission) -> None:
+        job_key = self.workload_to_job.get(wl.key)
+        if job_key and job_key in self.jobs:
+            self.reconcile(self.jobs[job_key])
+
+
+def _pod_sets_match(wl: Workload, pod_sets: list[PodSet]) -> bool:
+    if len(wl.pod_sets) != len(pod_sets):
+        return False
+    for a, b in zip(wl.pod_sets, pod_sets):
+        if (a.name, a.count, a.requests) != (b.name, b.count, b.requests):
+            return False
+    return True

@@ -1,0 +1,121 @@
+"""Jobframework tests: the job <-> Workload contract end to end
+(suspend/unsuspend, pod-set info injection, finish, eviction restore)."""
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    ClusterQueuePreemption,
+    FlavorQuotas,
+    LocalQueue,
+    PreemptionPolicy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.controllers.jobframework import (
+    BatchJob,
+    JobReconciler,
+    JobSetJob,
+)
+
+CPU = "cpu"
+
+
+def make_stack(nominal=4000, preemption=None):
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor(
+        "default", node_labels={"pool": "main"}))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", preemption=preemption or ClusterQueuePreemption(),
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(nominal)}),)),),
+    ))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    rec = JobReconciler(eng)
+    return eng, rec
+
+
+def test_job_admission_unsuspends_with_node_selectors():
+    eng, rec = make_stack()
+    job = BatchJob(name="train", queue_name="lq", parallelism=2,
+                   requests={CPU: 1000})
+    rec.create_job(job)
+    assert job.is_suspended()
+    eng.schedule_once()
+    assert not job.is_suspended()
+    assert job.injected_info[0].count == 2
+    assert job.injected_info[0].node_selector == {"pool": "main"}
+
+
+def test_job_finish_releases_quota():
+    eng, rec = make_stack(nominal=2000)
+    j1 = BatchJob(name="j1", queue_name="lq", parallelism=2,
+                  requests={CPU: 1000})
+    j2 = BatchJob(name="j2", queue_name="lq", parallelism=2,
+                  requests={CPU: 1000})
+    rec.create_job(j1)
+    eng.clock += 1
+    rec.create_job(j2)
+    eng.schedule_once()
+    assert not j1.is_suspended()
+    assert j2.is_suspended()
+    j1.succeeded = 2
+    rec.reconcile(j1)
+    eng.schedule_once()
+    rec.reconcile_all()
+    assert not j2.is_suspended()
+
+
+def test_no_queue_name_not_managed():
+    eng, rec = make_stack()
+    job = BatchJob(name="unmanaged", parallelism=1, requests={CPU: 100})
+    rec.create_job(job)
+    eng.schedule_once()
+    assert job.is_suspended()
+    assert not eng.workloads
+
+
+def test_preemption_resuspends_job():
+    eng, rec = make_stack(
+        nominal=2000,
+        preemption=ClusterQueuePreemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY))
+    low = BatchJob(name="low", queue_name="lq", parallelism=2,
+                   requests={CPU: 1000}, priority=0)
+    rec.create_job(low)
+    eng.schedule_once()
+    assert not low.is_suspended()
+    eng.clock += 1
+    high = BatchJob(name="high", queue_name="lq", parallelism=2,
+                    requests={CPU: 1000}, priority=10)
+    rec.create_job(high)
+    eng.schedule_once()  # preempts low's workload
+    rec.reconcile_all()
+    assert low.is_suspended()
+    eng.schedule_once()  # admits high
+    rec.reconcile_all()
+    assert not high.is_suspended()
+
+
+def test_jobset_gang_multi_podset():
+    eng, rec = make_stack(nominal=10_000)
+    js = JobSetJob(name="gang", queue_name="lq", replicated_jobs=[
+        ("driver", 1, {CPU: 500}),
+        ("workers", 4, {CPU: 1000}),
+    ])
+    rec.create_job(js)
+    eng.schedule_once()
+    assert not js.is_suspended()
+    assert [i.name for i in js.injected_info] == ["driver", "workers"]
+    assert [i.count for i in js.injected_info] == [1, 4]
+
+
+def test_partial_admission_reduced_count_injected():
+    eng, rec = make_stack(nominal=3000)
+    job = BatchJob(name="elastic", queue_name="lq", parallelism=10,
+                   min_parallelism=2, requests={CPU: 1000})
+    rec.create_job(job)
+    eng.schedule_once()
+    assert not job.is_suspended()
+    assert job.injected_info[0].count == 3
