@@ -111,16 +111,29 @@ class PendingClusterQueue:
         self.inadmissible.clear()
         return moved
 
-    def pop(self) -> Optional[WorkloadInfo]:
-        """cluster_queue.go:715 (Pop) — skip stale heap entries."""
+    def pop(self, now: Optional[float] = None) -> Optional[WorkloadInfo]:
+        """cluster_queue.go:715 (Pop) — skip stale heap entries; entries
+        with a future requeueAt (eviction backoff, workload_types.go:774
+        requeueState) are held back until due."""
+        held: list[_HeapItem] = []
+        result = None
         while self.heap:
             item = heapq.heappop(self.heap)
             key = item.info.key
-            if self.items.get(key) is item.info:
-                del self.items[key]
-                self.in_flight = key
-                return item.info
-        return None
+            if self.items.get(key) is not item.info:
+                continue
+            requeue_at = item.info.obj.status.requeue_at
+            if (now is not None and requeue_at is not None
+                    and requeue_at > now):
+                held.append(item)
+                continue
+            del self.items[key]
+            self.in_flight = key
+            result = item.info
+            break
+        for item in held:
+            heapq.heappush(self.heap, item)
+        return result
 
     def pending(self) -> int:
         return len(self.items) + len(self.inadmissible)
@@ -181,12 +194,12 @@ class QueueManager:
             if cq_names is None or name in cq_names:
                 pcq.queue_inadmissible()
 
-    def heads(self) -> list[WorkloadInfo]:
+    def heads(self, now: Optional[float] = None) -> list[WorkloadInfo]:
         """manager.go:872 (Heads) — one head per ClusterQueue.  Non-blocking
         variant: returns [] when nothing is pending."""
         out = []
         for pcq in self.cluster_queues.values():
-            head = pcq.pop()
+            head = pcq.pop(now)
             if head is not None:
                 out.append(head)
         return out

@@ -1,0 +1,148 @@
+"""Two-phase admission: AdmissionChecks.
+
+Reference: apis/kueue AdmissionCheck CRD + pkg/controller/core
+(reconcileSyncAdmissionChecks / reconcileCheckBasedEviction,
+workload_controller.go:901-951) + the ProvisioningRequest check controller
+(pkg/controller/admissionchecks/provisioning/controller.go:123).
+
+Flow (SURVEY.md §3.4): the scheduler reserves quota (QuotaReserved);
+check controllers then flip their AdmissionCheckState to Ready /
+Retry / Rejected; the workload controller admits when ALL required
+checks are Ready, and evicts + requeues (Retry) or deactivates
+(Rejected) otherwise.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+from kueue_tpu.api.types import Workload, WorkloadConditionType
+
+
+class CheckState(str, Enum):
+    PENDING = "Pending"
+    READY = "Ready"
+    RETRY = "Retry"
+    REJECTED = "Rejected"
+
+
+@dataclass
+class AdmissionCheck:
+    """Reference: admissioncheck_types.go:48."""
+
+    name: str
+    controller_name: str = ""
+    retry_delay_seconds: int = 60
+
+
+class AdmissionCheckManager:
+    """Holds check definitions and per-workload states; drives the
+    admit-when-all-ready rule for the engine."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.checks: dict[str, AdmissionCheck] = {}
+        engine.admission_checks = self
+
+    def create_admission_check(self, check: AdmissionCheck) -> None:
+        self.checks[check.name] = check
+
+    def delete_admission_check(self, name: str) -> None:
+        self.checks.pop(name, None)
+
+    def required_for(self, cq_name: str) -> tuple[str, ...]:
+        cq = self.engine.cache.cluster_queues.get(cq_name)
+        return cq.admission_checks if cq else ()
+
+    def sync_states(self, wl: Workload, cq_name: str) -> None:
+        """reconcileSyncAdmissionChecks: seed Pending states for the CQ's
+        checks (workload_controller.go:934)."""
+        for name in self.required_for(cq_name):
+            wl.status.admission_check_states.setdefault(
+                name, CheckState.PENDING)
+
+    def all_ready(self, wl: Workload, cq_name: str) -> bool:
+        """workload.HasAllRequiredChecks (scheduler.go:914)."""
+        return all(
+            wl.status.admission_check_states.get(name) == CheckState.READY
+            for name in self.required_for(cq_name))
+
+    def set_state(self, wl_key: str, check: str, state: CheckState) -> None:
+        """A check controller reporting its verdict; triggers the workload
+        controller pass."""
+        wl = self.engine.workloads.get(wl_key)
+        if wl is None:
+            return
+        wl.status.admission_check_states[check] = state
+        self.engine.reconcile_workload(wl)
+
+
+@dataclass
+class ProvisioningRequest:
+    """The external provisioning object the check controller creates
+    (provisioning/controller.go:248 syncOwnedProvisionRequest)."""
+
+    name: str
+    workload_key: str
+    check_name: str
+    provisioned: bool = False
+    failed: bool = False
+    attempts: int = 1
+
+
+class ProvisioningController:
+    """admissionchecks/provisioning: creates a ProvisioningRequest per
+    quota-reserved workload carrying this check, then mirrors the
+    request's outcome into the check state."""
+
+    def __init__(self, engine, check_name: str, max_retries: int = 3):
+        self.engine = engine
+        self.check_name = check_name
+        self.max_retries = max_retries
+        self.requests: dict[str, ProvisioningRequest] = {}
+
+    def reconcile(self) -> None:
+        """provisioning/controller.go:123 (Reconcile over workloads)."""
+        acm = self.engine.admission_checks
+        for wl in self.engine.workloads.values():
+            if wl.is_finished or not wl.has_quota_reservation:
+                continue
+            cq = (wl.status.admission.cluster_queue
+                  if wl.status.admission else "")
+            if self.check_name not in acm.required_for(cq):
+                continue
+            state = wl.status.admission_check_states.get(self.check_name)
+            if state in (CheckState.READY, CheckState.REJECTED):
+                continue
+            req = self.requests.get(wl.key)
+            if req is None:
+                req = ProvisioningRequest(
+                    name=f"prov-{wl.name}", workload_key=wl.key,
+                    check_name=self.check_name)
+                self.requests[wl.key] = req
+            if req.provisioned:
+                acm.set_state(wl.key, self.check_name, CheckState.READY)
+            elif req.failed:
+                if req.attempts >= self.max_retries:
+                    acm.set_state(wl.key, self.check_name,
+                                  CheckState.REJECTED)
+                else:
+                    req.attempts += 1
+                    req.failed = False
+                    acm.set_state(wl.key, self.check_name, CheckState.RETRY)
+
+    # -- the "cluster autoscaler" side, driven by tests/mimics --
+
+    def mark_provisioned(self, wl_key: str) -> None:
+        req = self.requests.get(wl_key)
+        if req is not None:
+            req.provisioned = True
+        self.reconcile()
+
+    def mark_failed(self, wl_key: str) -> None:
+        req = self.requests.get(wl_key)
+        if req is not None:
+            req.failed = True
+        self.reconcile()

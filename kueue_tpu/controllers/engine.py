@@ -76,6 +76,8 @@ class Engine:
         self.workloads: dict[str, Workload] = {}
         # hook: called with (workload, admission) after each admission.
         self.on_admit: Optional[Callable] = None
+        # AdmissionCheckManager attaches itself here (two-phase admission).
+        self.admission_checks = None
 
     # -- object admin --
 
@@ -132,9 +134,26 @@ class Engine:
 
     # -- the scheduling loop --
 
+    def tick(self, dt: float) -> None:
+        """Advance the clock and run time-based lifecycle: maximum
+        execution time enforcement (workload_controller.go:838
+        reconcileMaxExecutionTime)."""
+        self.clock += dt
+        for wl in list(self.workloads.values()):
+            if not wl.is_admitted or wl.is_finished:
+                continue
+            max_s = wl.maximum_execution_time_seconds
+            if max_s is None:
+                continue
+            adm = wl.condition(WorkloadConditionType.ADMITTED)
+            if adm and self.clock - adm.last_transition_time > max_s:
+                wl.active = False
+                self.evict(wl, "MaximumExecutionTimeExceeded",
+                           requeue=False)
+
     def schedule_once(self) -> Optional[CycleResult]:
         """One schedule() cycle (scheduler.go:286)."""
-        heads = self.queues.heads()
+        heads = self.queues.heads(self.clock)
         if not heads:
             return None
         self.metrics.admission_cycles += 1
@@ -175,46 +194,96 @@ class Engine:
     # -- internals --
 
     def _admit(self, entry) -> None:
+        """scheduler.go:856 (admit): reserve quota, assume in cache; the
+        Admitted condition follows only when all AdmissionChecks are Ready
+        (prepareWorkload :912)."""
         wl = entry.obj
         admission = admission_from_assignment(entry.info.cluster_queue,
                                               entry.assignment.pod_sets)
         wl.status.admission = admission
         wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
                          reason="QuotaReserved", now=self.clock)
-        wl.set_condition(WorkloadConditionType.ADMITTED, True,
-                         reason="Admitted", now=self.clock)
         entry.info.apply_admission(admission)
         self.cache.add_or_update_workload(wl)
-        self.metrics.admissions_total += 1
-        self._event("Admitted", wl.key,
+        self._event("QuotaReserved", wl.key,
                     cluster_queue=entry.info.cluster_queue)
+        if self.admission_checks is not None:
+            self.admission_checks.sync_states(wl,
+                                              entry.info.cluster_queue)
+        self._sync_admitted(wl, entry.info.cluster_queue)
+
+    def _sync_admitted(self, wl: Workload, cq_name: str) -> None:
+        """workload.SyncAdmittedCondition."""
+        if wl.is_admitted:
+            return
+        if (self.admission_checks is not None
+                and not self.admission_checks.all_ready(wl, cq_name)):
+            return
+        wl.set_condition(WorkloadConditionType.ADMITTED, True,
+                         reason="Admitted", now=self.clock)
+        self.metrics.admissions_total += 1
+        self._event("Admitted", wl.key, cluster_queue=cq_name)
         if self.on_admit is not None:
-            self.on_admit(wl, admission)
+            self.on_admit(wl, wl.status.admission)
+
+    def reconcile_workload(self, wl: Workload) -> None:
+        """The workload-controller pass (core/workload_controller.go:257):
+        check-based eviction (:901) and admitted-condition sync."""
+        if wl.is_finished or wl.status.admission is None:
+            return
+        cq_name = wl.status.admission.cluster_queue
+        from kueue_tpu.controllers.admissionchecks import CheckState
+        states = wl.status.admission_check_states
+        required = (self.admission_checks.required_for(cq_name)
+                    if self.admission_checks else ())
+        if any(states.get(c) == CheckState.REJECTED for c in required):
+            self.evict(wl, "AdmissionCheckRejected", requeue=False)
+            wl.active = False
+            return
+        if any(states.get(c) == CheckState.RETRY for c in required):
+            self.evict(wl, "AdmissionCheckRetry")
+            for c in required:
+                if states.get(c) == CheckState.RETRY:
+                    states[c] = CheckState.PENDING
+            return
+        self._sync_admitted(wl, cq_name)
+
+    def evict(self, wl: Workload, reason: str, requeue: bool = True,
+              backoff_seconds: float = 0.0) -> None:
+        """Shared eviction path (pkg/workload/evict)."""
+        cq_name = (wl.status.admission.cluster_queue
+                   if wl.status.admission else "")
+        wl.set_condition(WorkloadConditionType.EVICTED, True,
+                         reason=reason, now=self.clock)
+        wl.set_condition(WorkloadConditionType.ADMITTED, False,
+                         reason=reason, now=self.clock)
+        wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, False,
+                         reason=reason, now=self.clock)
+        wl.status.admission = None
+        wl.status.admission_check_states = {}
+        self.cache.delete_workload(wl.key)
+        self._event("Evicted", wl.key, cluster_queue=cq_name, detail=reason)
+        if requeue and wl.active:
+            wl.status.requeue_count += 1
+            if backoff_seconds:
+                wl.status.requeue_at = self.clock + backoff_seconds
+            self.queues.add_or_update_workload(wl)
+        self._requeue_cohort_inadmissible(cq_name)
 
     def _issue_preemptions(self, entry) -> None:
+        """preemption.go:194 (IssuePreemptions) + the workload controller's
+        requeue-after-evict."""
         for target in entry.preemption_targets:
             twl = self.workloads.get(target.workload.key)
             if twl is None or twl.is_finished:
                 continue
-            twl.set_condition(WorkloadConditionType.EVICTED, True,
-                              reason="Preempted", message=target.reason,
-                              now=self.clock)
             twl.set_condition(WorkloadConditionType.PREEMPTED, True,
                               reason=target.reason, now=self.clock)
-            twl.set_condition(WorkloadConditionType.ADMITTED, False,
-                              reason="Preempted", now=self.clock)
-            twl.set_condition(WorkloadConditionType.QUOTA_RESERVED, False,
-                              reason="Preempted", now=self.clock)
-            cq_name = target.workload.cluster_queue
-            twl.status.admission = None
-            self.cache.delete_workload(twl.key)
+            self.evict(twl, "Preempted")
             self.metrics.preemptions_total += 1
-            self._event("Preempted", twl.key, cluster_queue=cq_name,
+            self._event("Preempted", twl.key,
+                        cluster_queue=target.workload.cluster_queue,
                         detail=target.reason)
-            # Back to pending (workload controller requeue-after-evict).
-            requeued = self.queues.add_or_update_workload(twl)
-            if requeued is not None:
-                requeued.obj.status.requeue_count += 1
 
     def _requeue(self, entry) -> None:
         """scheduler.go:1016 (requeueAndUpdate)."""

@@ -1,0 +1,154 @@
+"""Two-phase admission tests: QuotaReserved -> checks -> Admitted, with
+Retry/Reject eviction semantics and the provisioning check controller."""
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.admissionchecks import (
+    AdmissionCheck,
+    AdmissionCheckManager,
+    CheckState,
+    ProvisioningController,
+)
+from kueue_tpu.controllers.engine import Engine
+
+CPU = "cpu"
+
+
+def make_stack(checks=("prov",)):
+    eng = Engine()
+    acm = AdmissionCheckManager(eng)
+    for c in checks:
+        acm.create_admission_check(AdmissionCheck(c))
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", admission_checks=tuple(checks),
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(4000)}),)),),
+    ))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng, acm
+
+
+def submit(eng, name, cpu=1000):
+    eng.clock += 0.001
+    wl = Workload(name=name, queue_name="lq",
+                  pod_sets=(PodSet("main", 1, {CPU: cpu}),))
+    eng.submit(wl)
+    return wl
+
+
+def test_quota_reserved_but_not_admitted_until_check_ready():
+    eng, acm = make_stack()
+    wl = submit(eng, "w")
+    eng.schedule_once()
+    assert wl.has_quota_reservation
+    assert not wl.is_admitted
+    assert wl.status.admission_check_states == {"prov": CheckState.PENDING}
+    acm.set_state(wl.key, "prov", CheckState.READY)
+    assert wl.is_admitted
+
+
+def test_quota_held_while_check_pending():
+    eng, acm = make_stack()
+    w1 = submit(eng, "w1", cpu=3000)
+    w2 = submit(eng, "w2", cpu=3000)
+    eng.schedule_once()
+    eng.schedule_once()
+    assert w1.has_quota_reservation
+    assert not w2.has_quota_reservation  # quota held by w1 pending checks
+
+
+def test_check_retry_evicts_and_requeues():
+    eng, acm = make_stack()
+    wl = submit(eng, "w")
+    eng.schedule_once()
+    acm.set_state(wl.key, "prov", CheckState.RETRY)
+    assert wl.is_evicted
+    assert not wl.has_quota_reservation
+    # back in the queue; next cycle reserves again
+    eng.schedule_once()
+    assert wl.has_quota_reservation
+
+
+def test_check_reject_deactivates():
+    eng, acm = make_stack()
+    wl = submit(eng, "w")
+    eng.schedule_once()
+    acm.set_state(wl.key, "prov", CheckState.REJECTED)
+    assert wl.is_evicted
+    assert not wl.active
+    eng.schedule_once()
+    assert not wl.has_quota_reservation
+
+
+def test_provisioning_controller_flow():
+    eng, acm = make_stack()
+    prov = ProvisioningController(eng, "prov")
+    wl = submit(eng, "w")
+    eng.schedule_once()
+    prov.reconcile()
+    assert wl.key in prov.requests
+    assert not wl.is_admitted
+    prov.mark_provisioned(wl.key)
+    assert wl.is_admitted
+
+
+def test_provisioning_failure_retries_then_rejects():
+    eng, acm = make_stack()
+    prov = ProvisioningController(eng, "prov", max_retries=2)
+    wl = submit(eng, "w")
+    eng.schedule_once()
+    prov.reconcile()
+    prov.mark_failed(wl.key)
+    assert wl.is_evicted  # retry -> evicted + requeued
+    eng.schedule_once()  # re-reserves quota
+    assert wl.has_quota_reservation
+    prov.reconcile()
+    prov.mark_failed(wl.key)
+    assert not wl.active  # attempts exhausted -> rejected
+
+
+def test_multiple_checks_all_required():
+    eng, acm = make_stack(checks=("a", "b"))
+    wl = submit(eng, "w")
+    eng.schedule_once()
+    acm.set_state(wl.key, "a", CheckState.READY)
+    assert not wl.is_admitted
+    acm.set_state(wl.key, "b", CheckState.READY)
+    assert wl.is_admitted
+
+
+def test_requeue_backoff_delays_retry():
+    eng, acm = make_stack(checks=())
+    wl = submit(eng, "w")
+    eng.schedule_once()
+    assert wl.is_admitted
+    eng.evict(wl, "Test", backoff_seconds=30.0)
+    eng.schedule_once()
+    assert not wl.has_quota_reservation  # still backing off
+    eng.tick(31.0)
+    eng.schedule_once()
+    assert wl.has_quota_reservation
+
+
+def test_maximum_execution_time():
+    eng, acm = make_stack(checks=())
+    eng.clock += 0.001
+    wl = Workload(name="limited", queue_name="lq",
+                  maximum_execution_time_seconds=10,
+                  pod_sets=(PodSet("main", 1, {CPU: 100}),))
+    eng.submit(wl)
+    eng.schedule_once()
+    assert wl.is_admitted
+    eng.tick(11.0)
+    assert wl.is_evicted
+    assert not wl.active
