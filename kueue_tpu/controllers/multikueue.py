@@ -1,0 +1,191 @@
+"""MultiKueue: multi-cluster workload dispatch, modeled as an
+AdmissionCheck on the manager cluster.
+
+Reference: pkg/controller/admissionchecks/multikueue (workload.go:185
+wlReconciler, multikueuecluster.go remote clients) and
+pkg/controller/workloaddispatcher (AllAtOnce / Incremental strategies,
+incrementaldispatcher.go:50).
+
+Semantics:
+  * a manager-side Workload that reserves quota and carries the MultiKueue
+    check is mirrored to the nominated worker clusters;
+  * the first worker to ADMIT the copy wins; the other copies are removed
+    (wlGroup.RemoveRemoteObjects :159) and the manager check flips Ready
+    with clusterName recorded;
+  * remote finish/failure is synced back to the manager workload;
+  * losing a worker cluster evicts the manager workloads placed there and
+    requeues them (worker-lost timeout, multikueuecluster.go:98).
+
+Worker "clusters" are Engine instances — the same way the reference tests
+multi-cluster with two envtest apiservers (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.api.types import Workload, WorkloadConditionType
+from kueue_tpu.controllers.admissionchecks import CheckState
+
+
+@dataclass
+class MultiKueueConfig:
+    """multikueue_types.go:124 (MultiKueueConfig): ordered cluster list."""
+
+    clusters: list[str] = field(default_factory=list)
+
+
+@dataclass
+class _RemoteState:
+    nominated: list[str] = field(default_factory=list)
+    created: dict[str, str] = field(default_factory=dict)  # cluster -> key
+    cluster_name: Optional[str] = None
+    last_round_time: float = 0.0
+
+
+class Dispatcher:
+    """pkg/controller/workloaddispatcher strategies."""
+
+    ALL_AT_ONCE = "AllAtOnce"
+    INCREMENTAL = "Incremental"
+
+
+class MultiKueueController:
+    def __init__(self, manager_engine, check_name: str,
+                 config: MultiKueueConfig,
+                 dispatcher: str = Dispatcher.ALL_AT_ONCE,
+                 increment: int = 1, round_seconds: float = 300.0):
+        self.engine = manager_engine
+        self.check_name = check_name
+        self.config = config
+        self.dispatcher = dispatcher
+        self.increment = increment
+        self.round_seconds = round_seconds
+        self.clusters: dict[str, object] = {}  # name -> worker Engine
+        self.states: dict[str, _RemoteState] = {}
+
+    def connect_cluster(self, name: str, engine) -> None:
+        self.clusters[name] = engine
+
+    def disconnect_cluster(self, name: str) -> None:
+        """Worker lost: evict manager workloads placed there."""
+        self.clusters.pop(name, None)
+        for wl_key, state in list(self.states.items()):
+            if state.cluster_name == name:
+                wl = self.engine.workloads.get(wl_key)
+                del self.states[wl_key]
+                if wl is not None and not wl.is_finished:
+                    self.engine.evict(wl, "MultiKueueClusterLost")
+            else:
+                state.created.pop(name, None)
+
+    # -- the reconcile pass (workload.go:185) --
+
+    def reconcile(self) -> None:
+        acm = self.engine.admission_checks
+        for wl in list(self.engine.workloads.values()):
+            if wl.is_finished:
+                self._gc(wl)
+                continue
+            if not wl.has_quota_reservation:
+                if wl.key in self.states:
+                    self._remove_remotes(wl.key, except_cluster=None)
+                    del self.states[wl.key]
+                continue
+            cq = wl.status.admission.cluster_queue
+            if self.check_name not in acm.required_for(cq):
+                continue
+            state = self.states.setdefault(wl.key, _RemoteState())
+            if state.cluster_name is None:
+                self._nominate(wl, state)
+                self._sync_remotes(wl, state)
+                self._check_remote_admission(wl, state, acm)
+            else:
+                self._sync_back(wl, state)
+
+    # -- internals --
+
+    def _nominate(self, wl: Workload, state: _RemoteState) -> None:
+        available = [c for c in self.config.clusters if c in self.clusters]
+        if self.dispatcher == Dispatcher.ALL_AT_ONCE:
+            state.nominated = available
+            return
+        # Incremental: +increment clusters every round_seconds
+        # (incrementaldispatcher.go:50).
+        if not state.nominated:
+            state.nominated = available[:self.increment]
+            state.last_round_time = self.engine.clock
+        elif (self.engine.clock - state.last_round_time
+              >= self.round_seconds
+              and len(state.nominated) < len(available)):
+            n = len(state.nominated) + self.increment
+            state.nominated = available[:n]
+            state.last_round_time = self.engine.clock
+
+    def _sync_remotes(self, wl: Workload, state: _RemoteState) -> None:
+        for cluster in state.nominated:
+            if cluster in state.created:
+                continue
+            worker = self.clusters.get(cluster)
+            if worker is None:
+                continue
+            copy_wl = copy.deepcopy(wl)
+            copy_wl.status = type(copy_wl.status)()
+            if worker.submit(copy_wl):
+                state.created[cluster] = copy_wl.key
+
+    def _check_remote_admission(self, wl: Workload, state: _RemoteState,
+                                acm) -> None:
+        for cluster in state.nominated:
+            key = state.created.get(cluster)
+            worker = self.clusters.get(cluster)
+            if key is None or worker is None:
+                continue
+            remote = worker.workloads.get(key)
+            if remote is not None and remote.is_admitted:
+                state.cluster_name = cluster
+                self._remove_remotes(wl.key, except_cluster=cluster)
+                acm.set_state(wl.key, self.check_name, CheckState.READY)
+                return
+
+    def _sync_back(self, wl: Workload, state: _RemoteState) -> None:
+        worker = self.clusters.get(state.cluster_name)
+        key = state.created.get(state.cluster_name)
+        if worker is None or key is None:
+            return
+        remote = worker.workloads.get(key)
+        if remote is None:
+            # Remote object lost: evict & retry.
+            del self.states[wl.key]
+            self.engine.evict(wl, "MultiKueueRemoteLost")
+            return
+        if remote.is_finished:
+            cond = remote.condition(WorkloadConditionType.FINISHED)
+            wl.set_condition(WorkloadConditionType.FINISHED, True,
+                             reason=cond.reason if cond else "Finished",
+                             now=self.engine.clock)
+            self.engine.finish(wl.key)
+
+    def _remove_remotes(self, wl_key: str,
+                        except_cluster: Optional[str]) -> None:
+        state = self.states.get(wl_key)
+        if state is None:
+            return
+        for cluster, key in list(state.created.items()):
+            if cluster == except_cluster:
+                continue
+            worker = self.clusters.get(cluster)
+            if worker is not None:
+                remote = worker.workloads.pop(key, None)
+                if remote is not None:
+                    worker.cache.delete_workload(key)
+                    worker.queues.delete_workload(remote)
+            del state.created[cluster]
+
+    def _gc(self, wl: Workload) -> None:
+        """Orphan GC of remote objects for finished workloads."""
+        if wl.key in self.states:
+            self._remove_remotes(wl.key, except_cluster=None)
+            del self.states[wl.key]

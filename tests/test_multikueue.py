@@ -1,0 +1,134 @@
+"""MultiKueue tests: manager + two worker engines (the reference tests
+multi-cluster with two envtest clusters the same way)."""
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+    WorkloadConditionType,
+)
+from kueue_tpu.controllers.admissionchecks import (
+    AdmissionCheck,
+    AdmissionCheckManager,
+    CheckState,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.controllers.multikueue import (
+    Dispatcher,
+    MultiKueueConfig,
+    MultiKueueController,
+)
+
+CPU = "cpu"
+
+
+def make_cluster(nominal=4000, checks=()):
+    eng = Engine()
+    if checks:
+        acm = AdmissionCheckManager(eng)
+        for c in checks:
+            acm.create_admission_check(AdmissionCheck(c))
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", admission_checks=tuple(checks),
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(nominal)}),)),),
+    ))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng
+
+
+def make_stack(dispatcher=Dispatcher.ALL_AT_ONCE, w1_capacity=4000):
+    manager = make_cluster(checks=("multikueue",))
+    w1 = make_cluster(nominal=w1_capacity)
+    w2 = make_cluster()
+    mk = MultiKueueController(
+        manager, "multikueue",
+        MultiKueueConfig(clusters=["worker1", "worker2"]),
+        dispatcher=dispatcher, round_seconds=300.0)
+    mk.connect_cluster("worker1", w1)
+    mk.connect_cluster("worker2", w2)
+    return manager, w1, w2, mk
+
+
+def submit(eng, name, cpu=1000):
+    eng.clock += 0.001
+    wl = Workload(name=name, queue_name="lq",
+                  pod_sets=(PodSet("main", 1, {CPU: cpu}),))
+    eng.submit(wl)
+    return wl
+
+
+def pump(manager, workers, mk, cycles=2):
+    for _ in range(cycles):
+        manager.schedule_once()
+        mk.reconcile()
+        for w in workers:
+            w.schedule_once()
+        mk.reconcile()
+
+
+def test_first_cluster_to_admit_wins():
+    manager, w1, w2, mk = make_stack()
+    wl = submit(manager, "job")
+    pump(manager, [w1, w2], mk)
+    assert wl.is_admitted
+    assert mk.states[wl.key].cluster_name == "worker1"
+    # loser copy cleaned up
+    assert not w2.workloads
+
+
+def test_busy_first_cluster_falls_through():
+    manager, w1, w2, mk = make_stack()
+    filler = submit(w1, "filler", cpu=4000)
+    w1.schedule_once()
+    assert filler.is_admitted
+    wl = submit(manager, "job", cpu=2000)
+    pump(manager, [w1, w2], mk)
+    assert wl.is_admitted
+    assert mk.states[wl.key].cluster_name == "worker2"
+
+
+def test_remote_finish_syncs_back():
+    manager, w1, w2, mk = make_stack()
+    wl = submit(manager, "job")
+    pump(manager, [w1, w2], mk)
+    remote_key = mk.states[wl.key].created["worker1"]
+    w1.clock += 10
+    w1.finish(remote_key)
+    mk.reconcile()
+    assert wl.is_finished
+
+
+def test_cluster_lost_evicts_and_retries():
+    manager, w1, w2, mk = make_stack()
+    wl = submit(manager, "job")
+    pump(manager, [w1, w2], mk)
+    assert mk.states[wl.key].cluster_name == "worker1"
+    mk.disconnect_cluster("worker1")
+    assert wl.is_evicted
+    # retried on remaining cluster
+    pump(manager, [w2], mk)
+    assert wl.is_admitted
+    assert mk.states[wl.key].cluster_name == "worker2"
+
+
+def test_incremental_dispatcher_rounds():
+    manager, w1, w2, mk = make_stack(dispatcher=Dispatcher.INCREMENTAL,
+                                     w1_capacity=500)
+    # worker1 can't fit the job; incremental starts with worker1 only.
+    wl = submit(manager, "job", cpu=2000)
+    pump(manager, [w1, w2], mk)
+    assert not wl.is_admitted
+    assert mk.states[wl.key].nominated == ["worker1"]
+    # next round after round_seconds adds worker2
+    manager.clock += 301
+    pump(manager, [w1, w2], mk)
+    assert wl.is_admitted
+    assert mk.states[wl.key].cluster_name == "worker2"
