@@ -1,0 +1,130 @@
+"""Configuration API: the manager's startup config file schema.
+
+Reference: apis/config/v1beta2/configuration_types.go:35 (Configuration)
++ pkg/config (load/validate/defaults). Standalone: dataclasses loaded from
+JSON/YAML with defaulting and validation."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class WaitForPodsReady:
+    """configuration_types.go (WaitForPodsReady)."""
+
+    enable: bool = False
+    timeout_seconds: int = 300
+    block_admission: bool = False
+    requeuing_backoff_base_seconds: int = 60
+    requeuing_backoff_limit_count: Optional[int] = None
+    requeuing_backoff_max_seconds: int = 3600
+
+
+@dataclass
+class FairSharingConfig:
+    enable: bool = False
+    preemption_strategies: tuple[str, ...] = (
+        "LessThanOrEqualToFinalShare", "LessThanInitialShare")
+
+
+@dataclass
+class AdmissionFairSharingConfig:
+    usage_half_life_seconds: int = 600
+    usage_sampling_interval_seconds: int = 60
+    resource_weights: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class MultiKueueConfigSpec:
+    gc_interval_seconds: int = 60
+    origin: str = "multikueue"
+    worker_lost_timeout_seconds: int = 900
+    dispatcher_name: str = "AllAtOnce"
+
+
+@dataclass
+class Configuration:
+    """configuration_types.go:35."""
+
+    namespace: str = "kueue-system"
+    manage_jobs_without_queue_name: bool = False
+    integrations: tuple[str, ...] = ("batch/job",)
+    wait_for_pods_ready: WaitForPodsReady = field(
+        default_factory=WaitForPodsReady)
+    fair_sharing: FairSharingConfig = field(
+        default_factory=FairSharingConfig)
+    admission_fair_sharing: Optional[AdmissionFairSharingConfig] = None
+    multikueue: MultiKueueConfigSpec = field(
+        default_factory=MultiKueueConfigSpec)
+    feature_gates: dict[str, bool] = field(default_factory=dict)
+    # oracle: the batched TPU decision path configuration
+    oracle_enabled: bool = True
+    oracle_max_depth: int = 4
+
+    def validate(self) -> list[str]:
+        """pkg/config/validation.go."""
+        errs = []
+        if self.wait_for_pods_ready.timeout_seconds <= 0:
+            errs.append("waitForPodsReady.timeout must be > 0")
+        if self.wait_for_pods_ready.requeuing_backoff_base_seconds < 1:
+            errs.append("waitForPodsReady.requeuingBackoffBaseSeconds >= 1")
+        for s in self.fair_sharing.preemption_strategies:
+            if s not in ("LessThanOrEqualToFinalShare",
+                         "LessThanInitialShare"):
+                errs.append(f"unknown preemption strategy {s}")
+        if self.oracle_max_depth < 1:
+            errs.append("oracleMaxDepth must be >= 1")
+        return errs
+
+
+def load(path: str) -> Configuration:
+    """pkg/config/config.go (Load): read, default, validate."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        raw = json.loads(text)
+    except json.JSONDecodeError:
+        import yaml  # baked in with flax/orbax deps
+
+        raw = yaml.safe_load(text)
+    cfg = from_dict(raw or {})
+    errs = cfg.validate()
+    if errs:
+        raise ValueError("invalid configuration: " + "; ".join(errs))
+    return cfg
+
+
+def from_dict(raw: dict) -> Configuration:
+    cfg = Configuration()
+    cfg.namespace = raw.get("namespace", cfg.namespace)
+    cfg.manage_jobs_without_queue_name = raw.get(
+        "manageJobsWithoutQueueName", cfg.manage_jobs_without_queue_name)
+    cfg.integrations = tuple(
+        raw.get("integrations", {}).get("frameworks", cfg.integrations)
+        if isinstance(raw.get("integrations"), dict)
+        else raw.get("integrations", cfg.integrations))
+    w = raw.get("waitForPodsReady") or {}
+    cfg.wait_for_pods_ready = WaitForPodsReady(
+        enable=w.get("enable", False),
+        timeout_seconds=w.get("timeout", 300),
+        block_admission=w.get("blockAdmission", False),
+        requeuing_backoff_base_seconds=(w.get("requeuingStrategy") or {})
+        .get("backoffBaseSeconds", 60),
+        requeuing_backoff_limit_count=(w.get("requeuingStrategy") or {})
+        .get("backoffLimitCount"),
+        requeuing_backoff_max_seconds=(w.get("requeuingStrategy") or {})
+        .get("backoffMaxSeconds", 3600),
+    )
+    fs = raw.get("fairSharing") or {}
+    cfg.fair_sharing = FairSharingConfig(
+        enable=fs.get("enable", False),
+        preemption_strategies=tuple(fs.get(
+            "preemptionStrategies",
+            FairSharingConfig().preemption_strategies)))
+    cfg.feature_gates = dict(raw.get("featureGates", {}))
+    cfg.oracle_enabled = raw.get("oracle", {}).get("enable", True)
+    cfg.oracle_max_depth = raw.get("oracle", {}).get("maxDepth", 4)
+    return cfg

@@ -1,0 +1,63 @@
+"""Feature gates.
+
+Reference: pkg/features/kube_features.go:35-492 (~70 gates). We carry the
+gates that affect decision semantics or enable subsystems; unknown gates
+are accepted (forward compatibility) but default to False."""
+
+from __future__ import annotations
+
+# gate -> default-enabled
+_DEFAULTS: dict[str, bool] = {
+    # decision semantics
+    "FlavorFungibility": True,
+    "PartialAdmission": True,
+    "PrioritySortingWithinCohort": True,
+    "FairSharing": False,
+    "AdmissionFairSharing": False,
+    "QuotaCheckStrategy": False,
+    "SchedulerTimestampPreemptionBuffer": False,
+    "FairSharingPreemptWithinNominal": False,
+    "FairSharingPrioritizeNonBorrowing": False,
+    # TAS
+    "TopologyAwareScheduling": True,
+    "TASBalancedPlacement": False,
+    "TASReplaceNodeOnPodTermination": False,
+    "TASFailedNodeReplacementFailFast": False,
+    "TASRecomputeAssignmentWithinSchedulingCycle": False,
+    # subsystems
+    "MultiKueue": True,
+    "MultiKueueOrchestratedPreemption": False,
+    "ElasticJobsViaWorkloadSlices": False,
+    "ConcurrentAdmission": False,
+    "WaitForPodsReady": False,
+    "ObjectRetentionPolicies": False,
+    "PriorityBoost": False,
+    # the TPU oracle fast path
+    "BatchedOracle": True,
+}
+
+_overrides: dict[str, bool] = {}
+
+
+def enabled(name: str) -> bool:
+    if name in _overrides:
+        return _overrides[name]
+    return _DEFAULTS.get(name, False)
+
+
+def set_feature(name: str, value: bool) -> None:
+    _overrides[name] = value
+
+
+def apply(gates: dict[str, bool]) -> None:
+    _overrides.update(gates)
+
+
+def reset() -> None:
+    _overrides.clear()
+
+
+def all_gates() -> dict[str, bool]:
+    out = dict(_DEFAULTS)
+    out.update(_overrides)
+    return out

@@ -73,6 +73,8 @@ class Engine:
         self.clock: float = 0.0
         self.events: list[EngineEvent] = []
         self.metrics = EngineMetrics()
+        from kueue_tpu.metrics.registry import MetricsRegistry
+        self.registry = MetricsRegistry()
         self.workloads: dict[str, Workload] = {}
         # hook: called with (workload, admission) after each admission.
         self.on_admit: Optional[Callable] = None
@@ -153,9 +155,12 @@ class Engine:
 
     def schedule_once(self) -> Optional[CycleResult]:
         """One schedule() cycle (scheduler.go:286)."""
+        import time as _time
+
         heads = self.queues.heads(self.clock)
         if not heads:
             return None
+        t0 = _time.perf_counter()
         self.metrics.admission_cycles += 1
         snapshot = self.cache.snapshot()
         already = set(self.cache.workloads)
@@ -175,6 +180,16 @@ class Engine:
         for cq_name, skips in result.stats.preemption_skips.items():
             m = self.metrics.admission_cycle_preemption_skips
             m[cq_name] = m.get(cq_name, 0) + skips
+            self.registry.counter("admission_cycle_preemption_skips").inc(
+                (cq_name,), skips)
+        outcome = "success" if result.assumed else "inadmissible"
+        self.registry.report_admission_attempt(
+            outcome, _time.perf_counter() - t0)
+        for name, pcq in self.queues.cluster_queues.items():
+            self.registry.report_pending(name, len(pcq.items),
+                                         len(pcq.inadmissible))
+            self.registry.gauge("admitted_active_workloads").set(
+                (name,), self.cache.admitted_count(name))
         return result
 
     def run_until_quiescent(self, max_cycles: int = 10_000) -> int:
@@ -207,6 +222,11 @@ class Engine:
         self.cache.add_or_update_workload(wl)
         self._event("QuotaReserved", wl.key,
                     cluster_queue=entry.info.cluster_queue)
+        cq_name = entry.info.cluster_queue
+        self.registry.counter("quota_reserved_workloads_total").inc(
+            (cq_name,))
+        self.registry.histogram("quota_reserved_wait_time_seconds").observe(
+            max(0.0, self.clock - wl.creation_time), (cq_name,))
         if self.admission_checks is not None:
             self.admission_checks.sync_states(wl,
                                               entry.info.cluster_queue)
@@ -222,6 +242,9 @@ class Engine:
         wl.set_condition(WorkloadConditionType.ADMITTED, True,
                          reason="Admitted", now=self.clock)
         self.metrics.admissions_total += 1
+        self.registry.counter("admitted_workloads_total").inc((cq_name,))
+        self.registry.histogram("admission_wait_time_seconds").observe(
+            max(0.0, self.clock - wl.creation_time), (cq_name,))
         self._event("Admitted", wl.key, cluster_queue=cq_name)
         if self.on_admit is not None:
             self.on_admit(wl, wl.status.admission)
@@ -262,6 +285,8 @@ class Engine:
         wl.status.admission = None
         wl.status.admission_check_states = {}
         self.cache.delete_workload(wl.key)
+        self.registry.counter("evicted_workloads_total").inc(
+            (cq_name, reason))
         self._event("Evicted", wl.key, cluster_queue=cq_name, detail=reason)
         if requeue and wl.active:
             wl.status.requeue_count += 1

@@ -1,0 +1,189 @@
+"""Metrics: the framework's Prometheus-equivalent series.
+
+Reference: pkg/metrics/metrics.go:345-870 — admission attempts/duration,
+pending/admitted/evicted/preempted counts, wait-time histograms, per-CQ
+resource usage, and the north-star self-metrics
+(admission_attempt_duration_seconds, admission_cycle_preemption_skips).
+
+Standalone design: a tiny in-process registry with counters, gauges and
+histograms, exposable as Prometheus text format (render()).
+"""
+
+from __future__ import annotations
+
+import bisect
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Counter:
+    name: str
+    help: str = ""
+    values: dict[tuple, float] = field(default_factory=lambda:
+                                       defaultdict(float))
+
+    def inc(self, labels: tuple = (), amount: float = 1.0) -> None:
+        self.values[labels] += amount
+
+    def get(self, labels: tuple = ()) -> float:
+        return self.values.get(labels, 0.0)
+
+
+@dataclass
+class Gauge:
+    name: str
+    help: str = ""
+    values: dict[tuple, float] = field(default_factory=dict)
+
+    def set(self, labels: tuple, value: float) -> None:
+        self.values[labels] = value
+
+    def get(self, labels: tuple = ()) -> float:
+        return self.values.get(labels, 0.0)
+
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60, 300,
+                   1800)
+
+
+@dataclass
+class Histogram:
+    name: str
+    help: str = ""
+    buckets: tuple = DEFAULT_BUCKETS
+    counts: dict[tuple, list] = field(default_factory=dict)
+    sums: dict[tuple, float] = field(default_factory=lambda:
+                                     defaultdict(float))
+    totals: dict[tuple, int] = field(default_factory=lambda:
+                                     defaultdict(int))
+
+    def observe(self, value: float, labels: tuple = ()) -> None:
+        if labels not in self.counts:
+            self.counts[labels] = [0] * (len(self.buckets) + 1)
+        idx = bisect.bisect_left(self.buckets, value)
+        self.counts[labels][idx] += 1
+        self.sums[labels] += value
+        self.totals[labels] += 1
+
+    def quantile(self, q: float, labels: tuple = ()) -> float:
+        """Approximate quantile from bucket counts (upper bound)."""
+        counts = self.counts.get(labels)
+        if not counts:
+            return 0.0
+        total = self.totals[labels]
+        target = q * total
+        acc = 0
+        for i, c in enumerate(counts):
+            acc += c
+            if acc >= target:
+                return (self.buckets[i] if i < len(self.buckets)
+                        else float("inf"))
+        return float("inf")
+
+
+class MetricsRegistry:
+    """The kueue metric families (metrics.go), standalone."""
+
+    def __init__(self) -> None:
+        self._metrics: dict[str, object] = {}
+        c, g, h = self._counter, self._gauge, self._histogram
+        # scheduler north-star metrics (metrics.go:345-383)
+        c("admission_attempts_total", "scheduling attempts by result")
+        h("admission_attempt_duration_seconds", "cycle latency by result")
+        c("admission_cycle_preemption_skips",
+          "preemptions skipped per cycle per CQ")
+        # workload lifecycle
+        c("quota_reserved_workloads_total", "per CQ")
+        h("quota_reserved_wait_time_seconds", "queued->reserved per CQ")
+        c("admitted_workloads_total", "per CQ")
+        h("admission_wait_time_seconds", "queued->admitted per CQ")
+        c("evicted_workloads_total", "per CQ x reason")
+        c("preempted_workloads_total", "per preempting CQ x reason")
+        # queue state
+        g("pending_workloads", "per CQ x status(active|inadmissible)")
+        g("admitted_active_workloads", "per CQ")
+        g("cluster_queue_status", "per CQ x status")
+        # resource state (per CQ x flavor x resource)
+        g("cluster_queue_resource_usage", "")
+        g("cluster_queue_nominal_quota", "")
+        g("cluster_queue_borrowing_limit", "")
+        g("cluster_queue_lending_limit", "")
+        g("cluster_queue_weighted_share", "fair sharing share per CQ")
+        c("ready_wait_time_seconds_total", "admitted->ready")
+
+    def _counter(self, name, help=""):
+        self._metrics[name] = Counter(name, help)
+
+    def _gauge(self, name, help=""):
+        self._metrics[name] = Gauge(name, help)
+
+    def _histogram(self, name, help=""):
+        self._metrics[name] = Histogram(name, help)
+
+    def __getitem__(self, name: str):
+        return self._metrics[name]
+
+    def counter(self, name: str) -> Counter:
+        return self._metrics[name]
+
+    def gauge(self, name: str) -> Gauge:
+        return self._metrics[name]
+
+    def histogram(self, name: str) -> Histogram:
+        return self._metrics[name]
+
+    # -- update hooks used by the engine --
+
+    def report_admission_attempt(self, result: str, seconds: float) -> None:
+        self.counter("admission_attempts_total").inc((result,))
+        self.histogram("admission_attempt_duration_seconds").observe(
+            seconds, (result,))
+
+    def report_pending(self, cq: str, active: int, inadmissible: int) -> None:
+        self.gauge("pending_workloads").set((cq, "active"), active)
+        self.gauge("pending_workloads").set((cq, "inadmissible"),
+                                            inadmissible)
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines = []
+        prefix = "kueue_tpu_"
+        for name, metric in sorted(self._metrics.items()):
+            lines.append(f"# HELP {prefix}{name} {metric.help}")
+            if isinstance(metric, Counter):
+                lines.append(f"# TYPE {prefix}{name} counter")
+                for labels, v in sorted(metric.values.items()):
+                    lines.append(f"{prefix}{name}{_fmt(labels)} {v}")
+            elif isinstance(metric, Gauge):
+                lines.append(f"# TYPE {prefix}{name} gauge")
+                for labels, v in sorted(metric.values.items()):
+                    lines.append(f"{prefix}{name}{_fmt(labels)} {v}")
+            else:
+                lines.append(f"# TYPE {prefix}{name} histogram")
+                for labels, counts in sorted(metric.counts.items()):
+                    acc = 0
+                    for i, b in enumerate(metric.buckets):
+                        acc += counts[i]
+                        lines.append(
+                            f"{prefix}{name}_bucket"
+                            f"{_fmt(labels + (('le', b),))} {acc}")
+                    lines.append(
+                        f"{prefix}{name}_sum{_fmt(labels)} "
+                        f"{metric.sums[labels]}")
+                    lines.append(
+                        f"{prefix}{name}_count{_fmt(labels)} "
+                        f"{metric.totals[labels]}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(labels: tuple) -> str:
+    if not labels:
+        return ""
+    parts = []
+    for i, item in enumerate(labels):
+        if isinstance(item, tuple) and len(item) == 2:
+            parts.append(f'{item[0]}="{item[1]}"')
+        else:
+            parts.append(f'label_{i}="{item}"')
+    return "{" + ",".join(parts) + "}"

@@ -1,0 +1,85 @@
+"""Visibility: on-demand pending-workloads summaries with queue positions.
+
+Reference: pkg/visibility (server.go:82, storage/pending_workloads_*.go)
+— an aggregated API serving PendingWorkloadsSummary per ClusterQueue /
+LocalQueue, computed live from the queue manager. Standalone: a query
+object over the engine (an HTTP layer can wrap it)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class PendingWorkload:
+    """apis/visibility/v1beta2/types.go:66 (PendingWorkload)."""
+
+    name: str
+    namespace: str
+    local_queue: str
+    priority: int
+    position_in_cluster_queue: int
+    position_in_local_queue: int
+
+
+@dataclass
+class PendingWorkloadsSummary:
+    cluster_queue: str
+    items: list
+
+
+class VisibilityServer:
+    def __init__(self, engine):
+        self.engine = engine
+
+    def pending_workloads_for_cq(self, cq_name: str,
+                                 limit: int = 1000,
+                                 offset: int = 0) -> PendingWorkloadsSummary:
+        """storage/pending_workloads_cq.go — heap-ordered positions."""
+        pcq = self.engine.queues.cluster_queues.get(cq_name)
+        items: list[PendingWorkload] = []
+        if pcq is not None:
+            ordered = sorted(
+                pcq.items.values(),
+                key=lambda info: (-info.obj.effective_priority,
+                                  info.obj.creation_time))
+            lq_positions: dict[str, int] = {}
+            for pos, info in enumerate(ordered):
+                lq = info.obj.queue_name
+                lq_pos = lq_positions.get(lq, 0)
+                lq_positions[lq] = lq_pos + 1
+                if pos < offset or len(items) >= limit:
+                    continue
+                items.append(PendingWorkload(
+                    name=info.obj.name, namespace=info.obj.namespace,
+                    local_queue=lq, priority=info.obj.effective_priority,
+                    position_in_cluster_queue=pos,
+                    position_in_local_queue=lq_pos))
+        return PendingWorkloadsSummary(cluster_queue=cq_name, items=items)
+
+    def pending_workloads_for_lq(self, namespace: str,
+                                 lq_name: str) -> list:
+        lq = self.engine.queues.local_queues.get(f"{namespace}/{lq_name}")
+        if lq is None:
+            return []
+        summary = self.pending_workloads_for_cq(lq.cluster_queue)
+        return [it for it in summary.items
+                if it.local_queue == lq_name and it.namespace == namespace]
+
+
+def dump_state(engine) -> dict:
+    """pkg/debugger/debugger.go:42 — cache + queues dump for diagnostics."""
+    queues = {}
+    for name, pcq in engine.queues.cluster_queues.items():
+        queues[name] = {
+            "active": sorted(pcq.items),
+            "inadmissible": sorted(pcq.inadmissible),
+        }
+    admitted = {}
+    for key, info in engine.cache.workloads.items():
+        admitted[key] = {
+            "clusterQueue": info.cluster_queue,
+            "usage": {f"{fr.flavor}/{fr.resource}": q
+                      for fr, q in info.usage().items()},
+        }
+    return {"queues": queues, "admitted": admitted}

@@ -1,0 +1,119 @@
+"""Metrics registry, visibility server, debugger dump, config, feature
+gates."""
+
+import json
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.config import features
+from kueue_tpu.config.api import Configuration, from_dict, load
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.visibility.server import VisibilityServer, dump_state
+
+CPU = "cpu"
+
+
+def make_engine(nominal=1000):
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(nominal)}),)),),
+    ))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    eng.create_local_queue(LocalQueue("lq2", "default", "cq"))
+    return eng
+
+
+def submit(eng, name, cpu, lq="lq", priority=0):
+    eng.clock += 0.5
+    wl = Workload(name=name, queue_name=lq, priority=priority,
+                  pod_sets=(PodSet("main", 1, {CPU: cpu}),))
+    eng.submit(wl)
+    return wl
+
+
+def test_metrics_counters_and_render():
+    eng = make_engine()
+    submit(eng, "a", 600)
+    submit(eng, "b", 600)
+    eng.schedule_once()
+    eng.schedule_once()
+    reg = eng.registry
+    assert reg.counter("admitted_workloads_total").get(("cq",)) == 1
+    assert reg.counter("quota_reserved_workloads_total").get(("cq",)) == 1
+    assert reg.counter("admission_attempts_total").get(("success",)) >= 1
+    assert reg.gauge("pending_workloads").get(("cq", "inadmissible")) == 1
+    text = reg.render()
+    assert "kueue_tpu_admitted_workloads_total" in text
+    assert "kueue_tpu_admission_attempt_duration_seconds_bucket" in text
+
+
+def test_visibility_positions():
+    eng = make_engine(nominal=100)
+    submit(eng, "w1", 600, lq="lq", priority=0)
+    submit(eng, "w2", 600, lq="lq2", priority=10)
+    submit(eng, "w3", 600, lq="lq", priority=5)
+    vis = VisibilityServer(eng)
+    summary = vis.pending_workloads_for_cq("cq")
+    names = [i.name for i in summary.items]
+    assert names == ["w2", "w3", "w1"]  # priority order
+    assert [i.position_in_cluster_queue for i in summary.items] == [0, 1, 2]
+    lq_items = vis.pending_workloads_for_lq("default", "lq")
+    assert [i.name for i in lq_items] == ["w3", "w1"]
+    assert [i.position_in_local_queue for i in lq_items] == [0, 1]
+
+
+def test_debugger_dump():
+    eng = make_engine()
+    submit(eng, "a", 600)
+    submit(eng, "b", 600)
+    eng.schedule_once()
+    state = dump_state(eng)
+    assert state["admitted"]["default/a"]["clusterQueue"] == "cq"
+    assert "default/b" in (state["queues"]["cq"]["active"]
+                           + state["queues"]["cq"]["inadmissible"])
+    json.dumps(state)  # serializable
+
+
+def test_config_load_and_validate(tmp_path):
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps({
+        "namespace": "scheduling",
+        "manageJobsWithoutQueueName": True,
+        "waitForPodsReady": {"enable": True, "timeout": 120,
+                             "requeuingStrategy": {"backoffBaseSeconds": 10}},
+        "fairSharing": {"enable": True},
+        "featureGates": {"TASBalancedPlacement": True},
+    }))
+    cfg = load(str(p))
+    assert cfg.namespace == "scheduling"
+    assert cfg.manage_jobs_without_queue_name
+    assert cfg.wait_for_pods_ready.timeout_seconds == 120
+    assert cfg.fair_sharing.enable
+    assert cfg.feature_gates["TASBalancedPlacement"]
+
+
+def test_config_validation_rejects_bad():
+    cfg = from_dict({"waitForPodsReady": {"enable": True, "timeout": -1}})
+    assert cfg.validate()
+
+
+def test_feature_gates():
+    assert features.enabled("FlavorFungibility")
+    assert not features.enabled("ConcurrentAdmission")
+    features.set_feature("ConcurrentAdmission", True)
+    assert features.enabled("ConcurrentAdmission")
+    features.reset()
+    assert not features.enabled("ConcurrentAdmission")
+    assert not features.enabled("SomeUnknownGate")
