@@ -1,0 +1,216 @@
+"""kueuectl-equivalent CLI for the standalone engine.
+
+Reference: cmd/kueuectl (app/cmd.go:79): create {cq,lq,rf}, list
+{clusterqueues,localqueues,workloads,resourceflavors}, stop/resume
+{workload,clusterqueue,localqueue}, delete, version.
+
+The CLI operates on an Engine instance (in-process) or on a state file; an
+RPC transport can front the same command surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    StopPolicy,
+)
+from kueue_tpu.webhooks.validators import (
+    validate_cluster_queue,
+    validate_resource_flavor,
+)
+
+VERSION = "kueue-tpu v0.1 (round 1)"
+
+
+class Kueuectl:
+    def __init__(self, engine):
+        self.engine = engine
+
+    # -- create --
+
+    def create_cluster_queue(self, name: str, cohort: Optional[str] = None,
+                             nominal_quota: Optional[dict] = None,
+                             borrowing_limit: Optional[dict] = None,
+                             lending_limit: Optional[dict] = None,
+                             queueing_strategy: str = "BestEffortFIFO"
+                             ) -> ClusterQueue:
+        """kueuectl create cq."""
+        nominal_quota = nominal_quota or {}
+        flavors: dict[str, dict[str, ResourceQuota]] = {}
+        for key, val in nominal_quota.items():
+            flavor, res = key.split(":", 1)
+            flavors.setdefault(flavor, {})[res] = ResourceQuota(
+                nominal=val,
+                borrowing_limit=(borrowing_limit or {}).get(key),
+                lending_limit=(lending_limit or {}).get(key))
+        covered = tuple(sorted({res for f in flavors.values()
+                                for res in f}))
+        # Pad every flavor to cover all resources of the group.
+        for f in flavors.values():
+            for res in covered:
+                f.setdefault(res, ResourceQuota(0))
+        cq = ClusterQueue(
+            name=name, cohort=cohort,
+            resource_groups=(ResourceGroup(
+                covered,
+                tuple(FlavorQuotas(fn, fr)
+                      for fn, fr in flavors.items())),) if flavors else (),
+        )
+        errs = validate_cluster_queue(cq) if flavors else []
+        if errs:
+            raise ValueError("; ".join(errs))
+        self.engine.create_cluster_queue(cq)
+        return cq
+
+    def create_local_queue(self, name: str, cluster_queue: str,
+                           namespace: str = "default") -> LocalQueue:
+        lq = LocalQueue(name, namespace, cluster_queue)
+        self.engine.create_local_queue(lq)
+        return lq
+
+    def create_resource_flavor(self, name: str,
+                               node_labels: Optional[dict] = None
+                               ) -> ResourceFlavor:
+        rf = ResourceFlavor(name, node_labels=node_labels or {})
+        errs = validate_resource_flavor(rf)
+        if errs:
+            raise ValueError("; ".join(errs))
+        self.engine.create_resource_flavor(rf)
+        return rf
+
+    # -- list --
+
+    def list_cluster_queues(self) -> list[dict]:
+        out = []
+        for name, cq in sorted(self.engine.cache.cluster_queues.items()):
+            pcq = self.engine.queues.cluster_queues.get(name)
+            out.append({
+                "name": name,
+                "cohort": cq.cohort or "",
+                "pending": pcq.pending() if pcq else 0,
+                "admitted": self.engine.cache.admitted_count(name),
+                "active": cq.stop_policy == StopPolicy.NONE,
+            })
+        return out
+
+    def list_local_queues(self, namespace: Optional[str] = None
+                          ) -> list[dict]:
+        out = []
+        for key, lq in sorted(self.engine.queues.local_queues.items()):
+            if namespace and lq.namespace != namespace:
+                continue
+            out.append({"name": lq.name, "namespace": lq.namespace,
+                        "clusterQueue": lq.cluster_queue})
+        return out
+
+    def list_workloads(self, namespace: Optional[str] = None) -> list[dict]:
+        out = []
+        for key, wl in sorted(self.engine.workloads.items()):
+            if namespace and wl.namespace != namespace:
+                continue
+            status = "Pending"
+            if wl.is_finished:
+                status = "Finished"
+            elif wl.is_admitted:
+                status = "Admitted"
+            elif wl.has_quota_reservation:
+                status = "QuotaReserved"
+            elif wl.is_evicted:
+                status = "Evicted"
+            out.append({
+                "name": wl.name, "namespace": wl.namespace,
+                "queue": wl.queue_name, "priority": wl.effective_priority,
+                "status": status, "active": wl.active,
+            })
+        return out
+
+    def list_resource_flavors(self) -> list[dict]:
+        return [{"name": rf.name, "nodeLabels": dict(rf.node_labels)}
+                for rf in sorted(
+                    self.engine.cache.resource_flavors.values(),
+                    key=lambda r: r.name)]
+
+    # -- stop / resume --
+
+    def stop_workload(self, key: str) -> None:
+        wl = self.engine.workloads.get(key)
+        if wl is None:
+            raise KeyError(key)
+        wl.active = False
+        if wl.has_quota_reservation:
+            self.engine.evict(wl, "WorkloadStopped", requeue=False)
+        self.engine.queues.delete_workload(wl)
+
+    def resume_workload(self, key: str) -> None:
+        wl = self.engine.workloads.get(key)
+        if wl is None:
+            raise KeyError(key)
+        wl.active = True
+        self.engine.queues.add_or_update_workload(wl)
+
+    def stop_cluster_queue(self, name: str,
+                           drain: bool = False) -> None:
+        cq = self.engine.cache.cluster_queues.get(name)
+        if cq is None:
+            raise KeyError(name)
+        cq.stop_policy = (StopPolicy.HOLD_AND_DRAIN if drain
+                          else StopPolicy.HOLD)
+        if drain:
+            for key, info in list(self.engine.cache.workloads.items()):
+                if info.cluster_queue == name:
+                    wl = self.engine.workloads.get(key)
+                    if wl is not None:
+                        self.engine.evict(wl, "ClusterQueueStopped")
+
+    def resume_cluster_queue(self, name: str) -> None:
+        cq = self.engine.cache.cluster_queues.get(name)
+        if cq is None:
+            raise KeyError(name)
+        cq.stop_policy = StopPolicy.NONE
+        self.engine.queues.queue_inadmissible_workloads({name})
+
+    def delete_workload(self, key: str) -> None:
+        wl = self.engine.workloads.pop(key, None)
+        if wl is not None:
+            self.engine.cache.delete_workload(key)
+            self.engine.queues.delete_workload(wl)
+
+    def version(self) -> str:
+        return VERSION
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kueuectl")
+    sub = p.add_subparsers(dest="command")
+    sub.add_parser("version")
+    lst = sub.add_parser("list")
+    lst.add_argument("kind", choices=["clusterqueues", "localqueues",
+                                      "workloads", "resourceflavors"])
+    lst.add_argument("--namespace")
+    return p
+
+
+def run(engine, argv: list[str]) -> str:
+    """Entry point: returns rendered output."""
+    ctl = Kueuectl(engine)
+    args = build_parser().parse_args(argv)
+    if args.command == "version":
+        return ctl.version()
+    if args.command == "list":
+        fn = {
+            "clusterqueues": ctl.list_cluster_queues,
+            "localqueues": lambda: ctl.list_local_queues(args.namespace),
+            "workloads": lambda: ctl.list_workloads(args.namespace),
+            "resourceflavors": ctl.list_resource_flavors,
+        }[args.kind]
+        return json.dumps(fn(), indent=2)
+    return ""

@@ -1,0 +1,140 @@
+"""CLI (kueuectl) and webhook-validator tests."""
+
+import json
+
+import pytest
+
+from kueue_tpu.api.types import (
+    BorrowWithinCohort,
+    BorrowWithinCohortPolicy,
+    ClusterQueue,
+    ClusterQueuePreemption,
+    Cohort,
+    FlavorQuotas,
+    PodSet,
+    PodSetTopologyRequest,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.cli.kueuectl import Kueuectl, run
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.webhooks.validators import (
+    find_cohort_cycle,
+    validate_cluster_queue,
+    validate_workload,
+    validate_workload_update,
+)
+
+CPU = "cpu"
+
+
+def test_cli_create_and_list_flow():
+    eng = Engine()
+    ctl = Kueuectl(eng)
+    ctl.create_resource_flavor("default", node_labels={"pool": "x"})
+    ctl.create_cluster_queue("cq", nominal_quota={"default:cpu": 4000})
+    ctl.create_local_queue("lq", "cq")
+    wl = Workload(name="w", queue_name="lq",
+                  pod_sets=(PodSet("main", 1, {CPU: 1000}),))
+    eng.submit(wl)
+    eng.schedule_once()
+    cqs = ctl.list_cluster_queues()
+    assert cqs == [{"name": "cq", "cohort": "", "pending": 0,
+                    "admitted": 1, "active": True}]
+    wls = ctl.list_workloads()
+    assert wls[0]["status"] == "Admitted"
+    out = run(eng, ["list", "workloads"])
+    assert json.loads(out)[0]["name"] == "w"
+    assert "kueue-tpu" in run(eng, ["version"])
+
+
+def test_cli_stop_resume_workload():
+    eng = Engine()
+    ctl = Kueuectl(eng)
+    ctl.create_resource_flavor("default")
+    ctl.create_cluster_queue("cq", nominal_quota={"default:cpu": 4000})
+    ctl.create_local_queue("lq", "cq")
+    wl = Workload(name="w", queue_name="lq",
+                  pod_sets=(PodSet("main", 1, {CPU: 1000}),))
+    eng.submit(wl)
+    eng.schedule_once()
+    assert wl.is_admitted
+    ctl.stop_workload(wl.key)
+    assert not wl.has_quota_reservation and not wl.active
+    ctl.resume_workload(wl.key)
+    eng.schedule_once()
+    assert wl.has_quota_reservation
+
+
+def test_cli_stop_cluster_queue_holds_admission():
+    eng = Engine()
+    ctl = Kueuectl(eng)
+    ctl.create_resource_flavor("default")
+    ctl.create_cluster_queue("cq", nominal_quota={"default:cpu": 4000})
+    ctl.create_local_queue("lq", "cq")
+    ctl.stop_cluster_queue("cq")
+    wl = Workload(name="w", queue_name="lq",
+                  pod_sets=(PodSet("main", 1, {CPU: 100}),))
+    eng.submit(wl)
+    eng.schedule_once()
+    assert not wl.has_quota_reservation
+    ctl.resume_cluster_queue("cq")
+    eng.schedule_once()
+    assert wl.has_quota_reservation
+
+
+def _valid_cq(**kw):
+    return ClusterQueue(
+        name="cq", cohort=kw.get("cohort"),
+        preemption=kw.get("preemption", ClusterQueuePreemption()),
+        resource_groups=(ResourceGroup(
+            (CPU,), (FlavorQuotas("f", {CPU: ResourceQuota(
+                1000,
+                borrowing_limit=kw.get("bl"),
+                lending_limit=kw.get("ll"))}),)),))
+
+
+def test_validate_cluster_queue():
+    assert validate_cluster_queue(_valid_cq()) == []
+    assert validate_cluster_queue(_valid_cq(cohort="co", bl=100)) == []
+    # limits without cohort
+    assert validate_cluster_queue(_valid_cq(bl=100))
+    assert validate_cluster_queue(_valid_cq(ll=100))
+    # borrowWithinCohort without reclaim
+    bad = _valid_cq(cohort="co", preemption=ClusterQueuePreemption(
+        borrow_within_cohort=BorrowWithinCohort(
+            policy=BorrowWithinCohortPolicy.LOWER_PRIORITY)))
+    assert validate_cluster_queue(bad)
+    # bad name
+    assert validate_cluster_queue(ClusterQueue(name="Bad_Name"))
+
+
+def test_validate_workload():
+    ok = Workload(name="w", pod_sets=(PodSet("main", 2, {CPU: 100}),))
+    assert validate_workload(ok) == []
+    assert validate_workload(Workload(name="w", pod_sets=()))
+    assert validate_workload(Workload(
+        name="w", pod_sets=(PodSet("a", 0, {}),)))
+    assert validate_workload(Workload(
+        name="w", pod_sets=(PodSet("a", 2, {}, min_count=3),)))
+    assert validate_workload(Workload(
+        name="w", pod_sets=(PodSet(
+            "a", 5, {},
+            topology_request=PodSetTopologyRequest(slice_size=2)),)))
+
+
+def test_validate_workload_update_immutability():
+    old = Workload(name="w", pod_sets=(PodSet("main", 2, {CPU: 100}),))
+    old.set_condition("QuotaReserved", True)
+    new = Workload(name="w", pod_sets=(PodSet("main", 3, {CPU: 100}),))
+    assert validate_workload_update(old, new)
+    same = Workload(name="w", pod_sets=(PodSet("main", 2, {CPU: 100}),))
+    assert validate_workload_update(old, same) == []
+
+
+def test_cohort_cycle_detection():
+    assert find_cohort_cycle([Cohort("a", "b"), Cohort("b")]) is None
+    cycle = find_cohort_cycle(
+        [Cohort("a", "b"), Cohort("b", "c"), Cohort("c", "a")])
+    assert cycle is not None and set(cycle) == {"a", "b", "c"}
