@@ -178,6 +178,9 @@ class ClusterQueue:
     namespace_selector: Optional[dict[str, str]] = None  # None = match all
     stop_policy: StopPolicy = StopPolicy.NONE
     admission_checks: tuple[str, ...] = ()
+    # "UsageBasedAdmissionFairSharing" orders within the CQ by LocalQueue
+    # usage (clusterqueue_types.go admissionScope).
+    admission_scope: Optional[str] = None
 
     def flavor_resources(self) -> list[FlavorResource]:
         out = []
@@ -373,6 +376,7 @@ class Workload:
     queue_name: str = ""  # LocalQueue name
     pod_sets: tuple[PodSet, ...] = ()
     priority: int = 0
+    priority_class_name: Optional[str] = None  # WorkloadPriorityClass ref
     priority_boost: int = 0  # priority-booster annotation equivalent
     creation_time: float = 0.0
     active: bool = True

@@ -55,9 +55,10 @@ class _HeapItem:
 class PendingClusterQueue:
     """pkg/cache/queue/cluster_queue.go:124 (ClusterQueue pending heap)."""
 
-    def __init__(self, spec: ClusterQueue):
+    def __init__(self, spec: ClusterQueue, manager=None):
         self.spec = spec
         self.name = spec.name
+        self.manager = manager
         self.heap: list[_HeapItem] = []
         self.items: dict[str, WorkloadInfo] = {}  # key -> live entry
         self.inadmissible: dict[str, WorkloadInfo] = {}
@@ -65,7 +66,17 @@ class PendingClusterQueue:
 
     def _key(self, info: WorkloadInfo) -> tuple:
         wl = info.obj
-        return (-wl.effective_priority, wl.creation_time, next(_seq))
+        # AFS ordering: lower LocalQueue decayed usage first
+        # (cluster_queue.go:208 AFS hooks).
+        usage = 0.0
+        if (self.manager is not None
+                and self.manager.lq_usage_fn is not None
+                and self.spec.admission_scope
+                == "UsageBasedAdmissionFairSharing"):
+            usage = self.manager.lq_usage_fn(
+                f"{wl.namespace}/{wl.queue_name}")
+            info.local_queue_fs_usage = usage
+        return (usage, -wl.effective_priority, wl.creation_time, next(_seq))
 
     def push_or_update(self, info: WorkloadInfo) -> None:
         """cluster_queue.go:356 (PushOrUpdate)."""
@@ -148,9 +159,11 @@ class QueueManager:
     def __init__(self) -> None:
         self.cluster_queues: dict[str, PendingClusterQueue] = {}
         self.local_queues: dict[str, LocalQueue] = {}
+        # AFS hook: lq key -> decayed usage (manager.go:68).
+        self.lq_usage_fn = None
 
     def add_cluster_queue(self, cq: ClusterQueue) -> None:
-        self.cluster_queues[cq.name] = PendingClusterQueue(cq)
+        self.cluster_queues[cq.name] = PendingClusterQueue(cq, manager=self)
 
     def delete_cluster_queue(self, name: str) -> None:
         self.cluster_queues.pop(name, None)

@@ -80,6 +80,10 @@ class Engine:
         self.on_admit: Optional[Callable] = None
         # AdmissionCheckManager attaches itself here (two-phase admission).
         self.admission_checks = None
+        # PodsReadyManager attaches itself here (WaitForPodsReady).
+        self.pods_ready = None
+        # WorkloadPriorityClass registry (workloadpriorityclass_types.go).
+        self.workload_priority_classes: dict[str, int] = {}
 
     # -- object admin --
 
@@ -110,9 +114,17 @@ class Engine:
 
     # -- workload lifecycle --
 
+    def create_workload_priority_class(self, name: str, value: int) -> None:
+        self.workload_priority_classes[name] = value
+
     def submit(self, wl: Workload) -> bool:
         if not wl.creation_time:
             wl.creation_time = self.clock
+        # Resolve priorityClassRef (pkg/util/priority).
+        if (wl.priority_class_name
+                and wl.priority_class_name in self.workload_priority_classes):
+            wl.priority = self.workload_priority_classes[
+                wl.priority_class_name]
         self.workloads[wl.key] = wl
         info = self.queues.add_or_update_workload(wl)
         if info is None:
@@ -159,6 +171,12 @@ class Engine:
 
         heads = self.queues.heads(self.clock)
         if not heads:
+            return None
+        if self.pods_ready is not None and self.pods_ready.admission_blocked():
+            # BlockAdmission: hold everything until admitted workloads are
+            # ready (scheduler.go:535).
+            for info in heads:
+                self.queues.requeue_workload(info, RequeueReason.GENERIC)
             return None
         t0 = _time.perf_counter()
         self.metrics.admission_cycles += 1

@@ -1,0 +1,129 @@
+"""WaitForPodsReady, WorkloadPriorityClass, and AdmissionFairSharing."""
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.config.api import (
+    AdmissionFairSharingConfig,
+    WaitForPodsReady,
+)
+from kueue_tpu.controllers.afs import AfsManager
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.controllers.podsready import PodsReadyManager
+
+CPU = "cpu"
+
+
+def make_engine(nominal=4000, admission_scope=None, n_lqs=1):
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", admission_scope=admission_scope,
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(nominal)}),)),),
+    ))
+    for i in range(n_lqs):
+        eng.create_local_queue(LocalQueue(f"lq{i}", "default", "cq"))
+    return eng
+
+
+def submit(eng, name, cpu=1000, lq="lq0", priority=0, pclass=None):
+    eng.clock += 0.25
+    wl = Workload(name=name, queue_name=lq, priority=priority,
+                  priority_class_name=pclass,
+                  pod_sets=(PodSet("main", 1, {CPU: cpu}),))
+    eng.submit(wl)
+    return wl
+
+
+def test_block_admission_until_pods_ready():
+    eng = make_engine()
+    pr = PodsReadyManager(eng, WaitForPodsReady(enable=True,
+                                                block_admission=True))
+    w1 = submit(eng, "w1")
+    eng.schedule_once()
+    assert w1.is_admitted
+    w2 = submit(eng, "w2")
+    eng.schedule_once()
+    assert not w2.is_admitted  # blocked: w1 pods not ready
+    pr.mark_pods_ready(w1.key)
+    eng.schedule_once()
+    assert w2.is_admitted
+
+
+def test_pods_ready_timeout_evicts_with_backoff():
+    eng = make_engine()
+    pr = PodsReadyManager(eng, WaitForPodsReady(
+        enable=True, timeout_seconds=60,
+        requeuing_backoff_base_seconds=30))
+    wl = submit(eng, "slow")
+    eng.schedule_once()
+    assert wl.is_admitted
+    eng.tick(61.0)
+    pr.reconcile()
+    assert wl.is_evicted
+    assert wl.status.requeue_count == 1
+    assert wl.status.requeue_at is not None
+    eng.schedule_once()
+    assert not wl.has_quota_reservation  # backing off
+    eng.tick(31.0)
+    eng.schedule_once()
+    assert wl.has_quota_reservation
+
+
+def test_pods_ready_deactivation_after_limit():
+    eng = make_engine()
+    pr = PodsReadyManager(eng, WaitForPodsReady(
+        enable=True, timeout_seconds=10,
+        requeuing_backoff_base_seconds=1,
+        requeuing_backoff_limit_count=1))
+    wl = submit(eng, "bad")
+    eng.schedule_once()
+    eng.tick(11.0)
+    pr.reconcile()  # first eviction (requeue_count=1)
+    eng.tick(2.0)
+    eng.schedule_once()  # re-admitted
+    assert wl.is_admitted
+    eng.tick(11.0)
+    pr.reconcile()  # hits limit -> deactivated
+    assert not wl.active
+
+
+def test_workload_priority_class_resolution():
+    eng = make_engine(nominal=1000)
+    eng.create_workload_priority_class("high", 1000)
+    lo = submit(eng, "lo", cpu=1000, priority=5)
+    hi = submit(eng, "hi", cpu=1000, pclass="high")
+    assert hi.priority == 1000
+    eng.schedule_once()
+    eng.schedule_once()
+    assert hi.is_admitted
+    assert not lo.is_admitted
+
+
+def test_afs_orders_by_local_queue_usage():
+    eng = make_engine(nominal=1000, n_lqs=2,
+                      admission_scope="UsageBasedAdmissionFairSharing")
+    AfsManager(eng, AdmissionFairSharingConfig(
+        usage_half_life_seconds=10_000))
+    # lq0 historically heavy: admit + finish a big workload from lq0.
+    hog = submit(eng, "hog", cpu=1000, lq="lq0")
+    eng.schedule_once()
+    assert hog.is_admitted
+    eng.clock += 10
+    eng.finish(hog.key)
+    # Now both LQs race: lq1 should win despite later submission.
+    a = submit(eng, "from-lq0", cpu=1000, lq="lq0")
+    b = submit(eng, "from-lq1", cpu=1000, lq="lq1")
+    eng.schedule_once()
+    eng.schedule_once()
+    assert b.is_admitted
+    assert not a.is_admitted
