@@ -82,6 +82,10 @@ class Engine:
         self.admission_checks = None
         # PodsReadyManager attaches itself here (WaitForPodsReady).
         self.pods_ready = None
+        # AfsManager attaches itself here (admission fair sharing).
+        self.afs = None
+        # OracleBridge (batched TPU fast path), via attach_oracle().
+        self.oracle = None
         # WorkloadPriorityClass registry (workloadpriorityclass_types.go).
         self.workload_priority_classes: dict[str, int] = {}
 
@@ -165,9 +169,28 @@ class Engine:
                 self.evict(wl, "MaximumExecutionTimeExceeded",
                            requeue=False)
 
+    def attach_oracle(self, max_depth: int = 4) -> None:
+        """Enable the batched TPU fast path for scheduling cycles."""
+        from kueue_tpu.oracle.engine_bridge import OracleBridge
+        self.oracle = OracleBridge(self, max_depth=max_depth)
+
     def schedule_once(self) -> Optional[CycleResult]:
         """One schedule() cycle (scheduler.go:286)."""
         import time as _time
+
+        if self.oracle is not None:
+            t0 = _time.perf_counter()
+            result = self.oracle.try_cycle()
+            if result is not None:
+                if not result.entries and not result.inadmissible:
+                    return None  # idle
+                self.metrics.admission_cycles += 1
+                outcome = ("success" if result.stats.admitted
+                           else "inadmissible")
+                self.registry.report_admission_attempt(
+                    outcome, _time.perf_counter() - t0)
+                return result
+            self.oracle.cycles_fallback += 1
 
         heads = self.queues.heads(self.clock)
         if not heads:

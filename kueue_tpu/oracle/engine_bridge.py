@@ -1,0 +1,174 @@
+"""Engine <-> batched-oracle bridge: run whole scheduling cycles on the
+accelerator when the pending population is fast-path eligible, falling
+back to the sequential decision core otherwise.
+
+This is the serving-path form of the north star (BASELINE.json): the
+control plane snapshots its caches into dense tensors, the device solves
+nominate+order+commit for every ClusterQueue head at once
+(oracle/batched.cycle_step), and verdicts are applied through the same
+assume/patch path the sequential scheduler uses. The BestEffortFIFO
+sequential path remains both the fallback and the decision-equivalence
+oracle (tests/test_oracle_engine.py).
+
+Fallback triggers (conservative, correctness-first):
+  * any pending workload not encodable on the fast path (multi-podset,
+    partial admission, TAS, node selectors);
+  * any head that would need the preemption oracle;
+  * fair sharing / AFS enabled;
+  * flavors with taints or topologies in any referenced CQ.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from kueue_tpu.api.types import FlavorResource
+from kueue_tpu.scheduler.cycle import (
+    CycleResult,
+    Entry,
+    EntryStatus,
+    RequeueReason,
+)
+from kueue_tpu.scheduler.flavorassigner import (
+    Assignment,
+    FlavorAssignment,
+    Mode,
+    PodSetAssignment,
+)
+
+
+class OracleBridge:
+    def __init__(self, engine, max_depth: int = 4):
+        self.engine = engine
+        self.max_depth = max_depth
+        self.cycles_on_device = 0
+        self.cycles_fallback = 0
+
+    def world_is_fast_path_safe(self) -> bool:
+        eng = self.engine
+        if eng.cycle.enable_fair_sharing:
+            return False
+        if getattr(eng, "afs", None) is not None:
+            return False
+        for rf in eng.cache.resource_flavors.values():
+            if rf.node_taints or rf.topology_name:
+                return False
+        return True
+
+    def try_cycle(self) -> Optional[CycleResult]:
+        """Attempt one batched cycle. Returns None to request sequential
+        fallback (nothing has been mutated in that case)."""
+        import jax.numpy as jnp
+
+        from kueue_tpu.oracle import batched as B
+
+        eng = self.engine
+        if not self.world_is_fast_path_safe():
+            return None
+
+        # Gather all active pending workloads (without popping).
+        pending_infos = []
+        for pcq in eng.queues.cluster_queues.values():
+            pending_infos.extend(pcq.items.values())
+        if not pending_infos:
+            return None if any(
+                pcq.inadmissible for pcq in
+                eng.queues.cluster_queues.values()) else CycleResult()
+
+        snapshot = eng.cache.snapshot()
+        solver = B.BatchedDrainSolver(snapshot, pending_infos,
+                                      max_depth=self.max_depth)
+        wl = solver.wls
+        if not wl.eligible.all():
+            return None
+        w = solver.world
+
+        W = wl.num_workloads
+        args = dict(
+            rank=jnp.asarray(solver.head_ranks()),
+            commit_rank=jnp.asarray(solver.commit_ranks()),
+            wl_cq=jnp.asarray(wl.cq), wl_req=jnp.asarray(wl.requests),
+            wl_priority=jnp.asarray(wl.priority),
+            wl_has_qr=jnp.asarray(wl.has_quota_reservation),
+            nominal=jnp.asarray(w.nominal),
+            lend_limit=jnp.asarray(w.lend_limit),
+            borrow_limit=jnp.asarray(w.borrow_limit),
+            parent=jnp.asarray(w.parent),
+            ancestors=jnp.asarray(w.ancestors),
+            height=jnp.asarray(w.height),
+            group_of_res=jnp.asarray(w.group_of_res),
+            group_flavors=jnp.asarray(w.group_flavors),
+            no_preemption=jnp.asarray(w.no_preemption),
+            can_pwb=jnp.asarray(w.can_preempt_while_borrowing),
+            can_always_reclaim=jnp.asarray(w.can_always_reclaim),
+            best_effort=jnp.asarray(w.best_effort),
+            fung_borrow_try_next=jnp.asarray(w.fung_borrow_try_next),
+            fung_pref_preempt_first=jnp.asarray(w.fung_pref_preempt_first),
+        )
+        pending = jnp.ones(W, bool)
+        inadmissible = jnp.zeros(W, bool)
+        usage = jnp.asarray(w.usage)
+        (new_pending, new_inadmissible, usage2, wl_admitted, slot_admitted,
+         slot_position, flavor_of_res, any_oracle) = B.cycle_step(
+            pending, inadmissible, usage, **args, depth=w.depth,
+            num_resources=w.num_resources, num_cqs=w.num_cqs)
+        if bool(any_oracle):
+            return None  # preemption simulation required -> sequential
+
+        self.cycles_on_device += 1
+        return self._apply(solver, pending_infos,
+                           np.asarray(wl_admitted),
+                           np.asarray(new_inadmissible),
+                           np.asarray(slot_position),
+                           np.asarray(flavor_of_res))
+
+    def _apply(self, solver, pending_infos, wl_admitted, parked,
+               slot_position, flavor_of_res) -> CycleResult:
+        """Apply verdicts through the engine's assume path."""
+        eng = self.engine
+        w, wls = solver.world, solver.wls
+        result = CycleResult()
+        order = np.argsort([
+            slot_position[wls.cq[i]] if wl_admitted[i] else 1 << 30
+            for i in range(len(pending_infos))])
+        for i in order:
+            info = pending_infos[i]
+            if wl_admitted[i]:
+                entry = self._make_entry(info, w, wls, flavor_of_res, i)
+                entry.status = EntryStatus.ASSUMED
+                entry.commit_position = int(slot_position[wls.cq[i]])
+                eng.queues.delete_workload(info.obj)
+                eng._admit(entry)
+                result.entries.append(entry)
+                result.stats.admitted += 1
+            elif parked[i]:
+                pcq = eng.queues.cluster_queues.get(info.cluster_queue)
+                if pcq is not None:
+                    pcq.delete(info.key)
+                    pcq.inadmissible[info.key] = info
+                entry = Entry(info=info,
+                              requeue_reason=RequeueReason.NO_FIT)
+                entry.inadmissible_msg = "NoFit (batched oracle)"
+                result.entries.append(entry)
+        return result
+
+    def _make_entry(self, info, w, wls, flavor_of_res, i) -> Entry:
+        ci = wls.cq[i]
+        psr = info.total_requests[0]
+        flavors = {}
+        usage: dict[FlavorResource, int] = {}
+        for s_i, res in enumerate(w.resource_names):
+            fl = flavor_of_res[ci, s_i]
+            if fl < 0 or wls.requests[i, s_i] <= 0:
+                continue
+            name = w.flavor_names[fl]
+            flavors[res] = FlavorAssignment(name=name, mode=Mode.FIT)
+            fr = FlavorResource(name, res)
+            usage[fr] = usage.get(fr, 0) + int(wls.requests[i, s_i])
+        psa = PodSetAssignment(
+            name=psr.name, flavors=flavors,
+            requests=dict(psr.requests), count=psr.count)
+        assignment = Assignment(pod_sets=[psa], usage=usage)
+        return Entry(info=info, assignment=assignment)

@@ -272,6 +272,13 @@ def encode_workloads(world: WorldTensors,
         if cq[i] < 0 or len(info.total_requests) != 1:
             eligible[i] = False
             continue
+        ps = info.obj.pod_sets[0]
+        if (ps.min_count is not None or ps.topology_request is not None
+                or ps.node_selector or ps.tolerations):
+            # Partial admission, TAS, and node-affinity paths run on the
+            # sequential host path in round 1.
+            eligible[i] = False
+            continue
         psr = info.total_requests[0]
         # Implicit pods resource when the CQ covers it.
         reqs = dict(psr.requests)
