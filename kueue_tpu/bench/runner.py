@@ -1,0 +1,199 @@
+"""Performance runner: generate a scenario, drive the engine with a
+workload-execution mimic, and check results against a rangespec.
+
+Reference: test/performance/scheduler — the runner generates
+CQs/cohorts/workloads from generator.yaml, mimics execution by finishing
+workloads after runtimeMs (no pods), and a checker asserts wall time /
+utilization / time-to-admission classes against rangespec.yaml
+(SURVEY.md §4, BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+
+CPU = "cpu"
+
+
+@dataclass
+class WorkloadClass:
+    """generator.yaml class: count of quota units, share, runtime."""
+
+    name: str
+    units: int  # quota units (1 unit = 1000 milli)
+    share: float
+    runtime_s: float
+
+
+@dataclass
+class GeneratorConfig:
+    """configs/baseline/generator.yaml shape."""
+
+    n_cohorts: int = 5
+    cqs_per_cohort: int = 6
+    nominal_units_per_cq: int = 20
+    n_workloads: int = 1500
+    classes: tuple[WorkloadClass, ...] = (
+        WorkloadClass("small", 1, 0.70, 5.0),
+        WorkloadClass("medium", 5, 0.20, 10.0),
+        WorkloadClass("large", 20, 0.10, 15.0),
+    )
+    seed: int = 0
+
+
+@dataclass
+class RangeSpec:
+    """configs/baseline/rangespec.yaml shape."""
+
+    max_wall_time_s: Optional[float] = None
+    min_avg_cq_utilization: Optional[float] = None
+    max_avg_time_to_admission_s: dict[str, float] = field(
+        default_factory=dict)
+
+
+@dataclass
+class RunStats:
+    wall_time_s: float = 0.0
+    sim_time_s: float = 0.0
+    admitted: int = 0
+    cycles: int = 0
+    avg_cq_utilization: float = 0.0
+    avg_time_to_admission_s: dict[str, float] = field(default_factory=dict)
+
+
+def generate(engine: Engine, cfg: GeneratorConfig) -> dict[str, str]:
+    """Create the scenario objects; returns workload key -> class name."""
+    rng = random.Random(cfg.seed)
+    engine.create_resource_flavor(ResourceFlavor("default"))
+    n_cqs = cfg.n_cohorts * cfg.cqs_per_cohort
+    for i in range(cfg.n_cohorts):
+        engine.create_cohort(Cohort(f"cohort-{i}"))
+    for i in range(n_cqs):
+        engine.create_cluster_queue(ClusterQueue(
+            name=f"cq-{i}", cohort=f"cohort-{i % cfg.n_cohorts}",
+            resource_groups=(ResourceGroup(
+                (CPU,),
+                (FlavorQuotas("default", {CPU: ResourceQuota(
+                    cfg.nominal_units_per_cq * 1000)}),)),),
+        ))
+        engine.create_local_queue(LocalQueue(f"lq-{i}", "default", f"cq-{i}"))
+
+    class_of: dict[str, str] = {}
+    for i in range(cfg.n_workloads):
+        r = rng.random()
+        acc = 0.0
+        cls = cfg.classes[-1]
+        for c in cfg.classes:
+            acc += c.share
+            if r < acc:
+                cls = c
+                break
+        wl = Workload(
+            name=f"wl-{i}", queue_name=f"lq-{rng.randrange(n_cqs)}",
+            creation_time=0.0,
+            pod_sets=(PodSet("main", 1, {CPU: cls.units * 1000}),))
+        engine.submit(wl)
+        class_of[wl.key] = cls.name
+    return class_of
+
+
+def run(engine: Engine, cfg: GeneratorConfig,
+        tick_s: float = 1.0, max_sim_s: float = 100_000.0) -> RunStats:
+    """Drive scheduling with the execution mimic: admitted workloads
+    finish after their class runtime (simulated clock)."""
+    class_of = generate(engine, cfg)
+    runtime_of = {c.name: c.runtime_s for c in cfg.classes}
+    finish_at: dict[str, float] = {}
+    admitted_at: dict[str, float] = {}
+    total = len(class_of)
+    utilization_samples: list[float] = []
+    n_cqs = cfg.n_cohorts * cfg.cqs_per_cohort
+    capacity = n_cqs * cfg.nominal_units_per_cq * 1000
+
+    t_start = time.perf_counter()
+    stats = RunStats()
+    while len(finish_at) < total and engine.clock < max_sim_s:
+        # Scheduling until quiescent at this instant.
+        while True:
+            result = engine.schedule_once()
+            stats.cycles += 1
+            if result is None or not result.assumed:
+                break
+            for e in result.assumed:
+                key = e.obj.key
+                admitted_at[key] = engine.clock
+                finish_at[key] = engine.clock + runtime_of[class_of[key]]
+        # Sample utilization.
+        used = sum(sum(info.usage().values())
+                   for info in engine.cache.workloads.values())
+        utilization_samples.append(used / capacity if capacity else 0.0)
+        # Advance to the next finish event (or tick).
+        pending_finishes = [t for k, t in finish_at.items()
+                            if t > engine.clock]
+        if pending_finishes:
+            next_t = min(min(pending_finishes), engine.clock + tick_s)
+        else:
+            next_t = engine.clock + tick_s
+        engine.tick(next_t - engine.clock)
+        for key, t in list(finish_at.items()):
+            if t <= engine.clock and key in engine.workloads \
+                    and not engine.workloads[key].is_finished:
+                engine.finish(key)
+        if not engine.queues.has_pending() and len(admitted_at) == total:
+            # Everything admitted; fast-forward the remaining finishes.
+            for key, t in finish_at.items():
+                if t > engine.clock:
+                    engine.clock = t
+                    engine.finish(key)
+            break
+
+    stats.wall_time_s = time.perf_counter() - t_start
+    stats.sim_time_s = engine.clock
+    stats.admitted = len(admitted_at)
+    if utilization_samples:
+        stats.avg_cq_utilization = (sum(utilization_samples)
+                                    / len(utilization_samples))
+    sums: dict[str, float] = {}
+    counts: dict[str, int] = {}
+    for key, t in admitted_at.items():
+        cls = class_of[key]
+        sums[cls] = sums.get(cls, 0.0) + t
+        counts[cls] = counts.get(cls, 0) + 1
+    stats.avg_time_to_admission_s = {
+        cls: sums[cls] / counts[cls] for cls in sums}
+    return stats
+
+
+def check(stats: RunStats, spec: RangeSpec) -> list[str]:
+    """The rangespec checker (test/performance/scheduler checker)."""
+    errs = []
+    if (spec.max_wall_time_s is not None
+            and stats.sim_time_s > spec.max_wall_time_s):
+        errs.append(f"wall time {stats.sim_time_s:.1f}s > "
+                    f"{spec.max_wall_time_s}s")
+    if (spec.min_avg_cq_utilization is not None
+            and stats.avg_cq_utilization < spec.min_avg_cq_utilization):
+        errs.append(
+            f"utilization {stats.avg_cq_utilization:.2f} < "
+            f"{spec.min_avg_cq_utilization}")
+    for cls, limit in spec.max_avg_time_to_admission_s.items():
+        got = stats.avg_time_to_admission_s.get(cls)
+        if got is not None and got > limit:
+            errs.append(f"time-to-admission[{cls}] {got:.1f}s > {limit}s")
+    return errs

@@ -1,0 +1,45 @@
+"""Scaled-down perf-runner scenario (the reference baseline shape at 1/10
+size) with rangespec assertions — the CI analog of
+test/performance/scheduler."""
+
+from kueue_tpu.bench.runner import (
+    GeneratorConfig,
+    RangeSpec,
+    WorkloadClass,
+    check,
+    run,
+)
+from kueue_tpu.controllers.engine import Engine
+
+
+def small_cfg(n_workloads=300):
+    return GeneratorConfig(
+        n_cohorts=5, cqs_per_cohort=6, nominal_units_per_cq=20,
+        n_workloads=n_workloads,
+        classes=(
+            WorkloadClass("small", 1, 0.70, 3.0),
+            WorkloadClass("medium", 5, 0.20, 6.0),
+            WorkloadClass("large", 20, 0.10, 9.0),
+        ))
+
+
+def test_baseline_scenario_completes_with_good_utilization():
+    eng = Engine()
+    stats = run(eng, small_cfg(), max_sim_s=10_000)
+    assert stats.admitted == 300
+    errs = check(stats, RangeSpec(
+        min_avg_cq_utilization=0.40,
+        max_wall_time_s=2_000.0,
+    ))
+    assert errs == [], errs
+    # Larger classes admit sooner (they head the queues less often but
+    # borrow effectively); all classes eventually admit.
+    assert set(stats.avg_time_to_admission_s) == {"small", "medium",
+                                                  "large"}
+
+
+def test_rangespec_checker_flags_violations():
+    stats_like = run(Engine(), small_cfg(n_workloads=50), max_sim_s=5_000)
+    errs = check(stats_like, RangeSpec(max_wall_time_s=0.0001,
+                                       min_avg_cq_utilization=1.01))
+    assert len(errs) == 2
