@@ -381,6 +381,9 @@ class Workload:
     creation_time: float = 0.0
     active: bool = True
     maximum_execution_time_seconds: Optional[int] = None
+    # Elastic scale-up: key of the admitted slice this workload replaces
+    # (pkg/workloadslicing annotation equivalent).
+    replaced_workload_slice: Optional[str] = None
     uid: str = ""
     status: WorkloadStatus = field(default_factory=WorkloadStatus)
 

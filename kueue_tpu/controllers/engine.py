@@ -272,6 +272,11 @@ class Engine:
             self.admission_checks.sync_states(wl,
                                               entry.info.cluster_queue)
         self._sync_admitted(wl, entry.info.cluster_queue)
+        # Replace-old-slice after successful admission
+        # (scheduler.go:558 replaceOldWorkloadSlice).
+        for target in entry.preemption_targets:
+            if target.reason == "WorkloadSliceReplaced":
+                self.finish(target.workload.key)
 
     def _sync_admitted(self, wl: Workload, cq_name: str) -> None:
         """workload.SyncAdmittedCondition."""
@@ -340,6 +345,11 @@ class Engine:
         """preemption.go:194 (IssuePreemptions) + the workload controller's
         requeue-after-evict."""
         for target in entry.preemption_targets:
+            if target.reason == "WorkloadSliceReplaced":
+                # The old slice keeps running until the replacement admits
+                # (workloadslicing.FindReplacedSliceTarget,
+                # scheduler.go:450-454).
+                continue
             twl = self.workloads.get(target.workload.key)
             if twl is None or twl.is_finished:
                 continue

@@ -1,0 +1,92 @@
+"""Importer: adopt pre-existing running workloads as admitted.
+
+Reference: cmd/importer — check phase (validate queue mapping and flavor
+assignment) + import phase (create admitted Workloads without scheduling
+them)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_tpu.api.types import (
+    Admission,
+    PodSetAssignmentStatus,
+    Workload,
+    WorkloadConditionType,
+)
+
+
+@dataclass
+class ImportResult:
+    imported: list[str] = field(default_factory=list)
+    errors: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+
+def check(engine, workloads: list[Workload],
+          flavor_mapping: dict[str, str]) -> ImportResult:
+    """The dry-run check phase."""
+    result = ImportResult()
+    for wl in workloads:
+        lq = engine.queues.local_queues.get(
+            f"{wl.namespace}/{wl.queue_name}")
+        if lq is None:
+            result.errors[wl.key] = (
+                f"no LocalQueue {wl.queue_name} in {wl.namespace}")
+            continue
+        cq = engine.cache.cluster_queues.get(lq.cluster_queue)
+        if cq is None:
+            result.errors[wl.key] = (
+                f"LocalQueue {lq.name} points to missing ClusterQueue")
+            continue
+        for ps in wl.pod_sets:
+            for res in ps.requests:
+                flavor = flavor_mapping.get(res)
+                if flavor is None:
+                    result.errors[wl.key] = f"no flavor mapping for {res}"
+                    break
+                from kueue_tpu.api.types import FlavorResource
+                if cq.quota_for(FlavorResource(flavor, res)).nominal == 0 \
+                        and not any(
+                            fq.name == flavor
+                            for rg in cq.resource_groups
+                            for fq in rg.flavors):
+                    result.errors[wl.key] = (
+                        f"flavor {flavor} not in ClusterQueue "
+                        f"{cq.name}")
+                    break
+        result.imported.append(wl.key)
+    return result
+
+
+def import_workloads(engine, workloads: list[Workload],
+                     flavor_mapping: dict[str, str]) -> ImportResult:
+    """The import phase: admit directly (bypassing scheduling), matching
+    the reference's adoption of already-running pods."""
+    precheck = check(engine, workloads, flavor_mapping)
+    if not precheck.ok:
+        return precheck
+    result = ImportResult()
+    for wl in workloads:
+        lq = engine.queues.local_queues[f"{wl.namespace}/{wl.queue_name}"]
+        psas = []
+        for ps in wl.pod_sets:
+            flavors = {res: flavor_mapping[res] for res in ps.requests}
+            usage = {res: q * ps.count for res, q in ps.requests.items()}
+            psas.append(PodSetAssignmentStatus(
+                name=ps.name, flavors=flavors, resource_usage=usage,
+                count=ps.count))
+        wl.status.admission = Admission(
+            cluster_queue=lq.cluster_queue,
+            pod_set_assignments=tuple(psas))
+        wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
+                         reason="Imported", now=engine.clock)
+        wl.set_condition(WorkloadConditionType.ADMITTED, True,
+                         reason="Imported", now=engine.clock)
+        engine.workloads[wl.key] = wl
+        engine.cache.add_or_update_workload(wl)
+        result.imported.append(wl.key)
+    return result

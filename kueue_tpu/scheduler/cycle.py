@@ -185,18 +185,37 @@ class SchedulerCycle:
 
         cq = snapshot.cluster_queue(wl.cluster_queue)
         oracle = Oracle(self.preemptor, snapshot, now)
-        assigner = FlavorAssigner(
-            wl, cq, snapshot.resource_flavors,
-            enable_fair_sharing=self.enable_fair_sharing, oracle=oracle)
-        full = assigner.assign()
-        apply_tas_pass(full, wl, cq)
+
+        # Elastic workload slices (workloadslicing.ReplacedWorkloadSlice,
+        # scheduler.go:765): the replaced slice's usage is freed for this
+        # workload's assignment and it becomes a replacement target.
+        slice_targets: list[Target] = []
+        revert_slice = None
+        old_key = wl.obj.replaced_workload_slice
+        if old_key is not None:
+            old_info = cq.workloads.get(old_key)
+            if old_info is not None:
+                slice_targets.append(
+                    Target(old_info, "WorkloadSliceReplaced"))
+                revert_slice = snapshot.simulate_workload_removal(
+                    [old_info])
+
+        try:
+            assigner = FlavorAssigner(
+                wl, cq, snapshot.resource_flavors,
+                enable_fair_sharing=self.enable_fair_sharing, oracle=oracle)
+            full = assigner.assign()
+            apply_tas_pass(full, wl, cq)
+        finally:
+            if revert_slice is not None:
+                revert_slice()
         mode = full.representative_mode()
         if mode == Mode.FIT:
-            return full, []
+            return full, slice_targets
         if mode == Mode.PREEMPT:
             targets = self.preemptor.get_targets(wl, full, snapshot, now)
             if targets:
-                return full, targets
+                return full, slice_targets + targets
         if (self.enable_partial_admission
                 and wl.obj.can_be_partially_admitted()):
             def try_counts(counts):
@@ -215,7 +234,7 @@ class SchedulerCycle:
             reducer = PodSetReducer(wl.obj.pod_sets, try_counts)
             found, ok = reducer.search()
             if ok:
-                return found[0], found[1]
+                return found[0], slice_targets + found[1]
         return full, []
 
     # -- ordering (scheduler.go:945, fair_sharing_iterator.go) --

@@ -1,0 +1,99 @@
+"""Elastic workload slices and the importer."""
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.controllers.importer import check, import_workloads
+
+CPU = "cpu"
+
+
+def make_engine(nominal=4000):
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(nominal)}),)),),
+    ))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng
+
+
+def test_elastic_slice_scale_up_replaces_old():
+    eng = make_engine(nominal=4000)
+    eng.clock += 0.1
+    old = Workload(name="train-v1", queue_name="lq",
+                   pod_sets=(PodSet("main", 2, {CPU: 1000}),))
+    eng.submit(old)
+    eng.schedule_once()
+    assert old.is_admitted
+    # Scale up 2 -> 3: the new slice needs 3000 total but only the delta
+    # (1000) beyond the old slice's reservation.
+    eng.clock += 1
+    new = Workload(name="train-v2", queue_name="lq",
+                   replaced_workload_slice=old.key,
+                   pod_sets=(PodSet("main", 3, {CPU: 1000}),))
+    eng.submit(new)
+    eng.schedule_once()
+    assert new.is_admitted
+    assert old.is_finished  # replaced, not evicted
+    assert not old.is_evicted
+
+
+def test_elastic_slice_fits_only_with_replacement():
+    # Capacity 4000; old slice holds 3000. A new 4000-slice fits only
+    # because the old 3000 is freed by replacement.
+    eng = make_engine(nominal=4000)
+    eng.clock += 0.1
+    old = Workload(name="v1", queue_name="lq",
+                   pod_sets=(PodSet("main", 3, {CPU: 1000}),))
+    eng.submit(old)
+    eng.schedule_once()
+    eng.clock += 1
+    new = Workload(name="v2", queue_name="lq",
+                   replaced_workload_slice=old.key,
+                   pod_sets=(PodSet("main", 4, {CPU: 1000}),))
+    eng.submit(new)
+    eng.schedule_once()
+    assert new.is_admitted
+    assert old.is_finished
+
+
+def test_importer_check_and_import():
+    eng = make_engine()
+    running = [
+        Workload(name=f"adopted-{i}", queue_name="lq",
+                 pod_sets=(PodSet("main", 1, {CPU: 500}),))
+        for i in range(3)
+    ]
+    res = check(eng, running, {CPU: "default"})
+    assert res.ok
+    res = import_workloads(eng, running, {CPU: "default"})
+    assert res.ok and len(res.imported) == 3
+    for wl in running:
+        assert wl.is_admitted
+    # Imported usage counts against quota for new admissions.
+    eng.clock += 1
+    newcomer = Workload(name="new", queue_name="lq",
+                        pod_sets=(PodSet("main", 1, {CPU: 3000}),))
+    eng.submit(newcomer)
+    eng.schedule_once()
+    assert not newcomer.is_admitted  # 1500 used by imports, 2500 left
+
+
+def test_importer_rejects_unmapped_queue():
+    eng = make_engine()
+    bad = [Workload(name="orphan", queue_name="nope",
+                    pod_sets=(PodSet("main", 1, {CPU: 100}),))]
+    res = check(eng, bad, {CPU: "default"})
+    assert not res.ok
