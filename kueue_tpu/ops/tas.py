@@ -1,0 +1,125 @@
+"""Batched TAS phase-1: per-domain fit counting as array programs.
+
+The reference's placement hot loop (tas_flavor_snapshot.go:1748
+fillInCounts) walks every leaf domain per pod set per scheduling attempt.
+Here the whole forest is computed at once:
+
+  * leaf_states: [L] pods-that-fit per leaf = min over resources of
+    floor(free / per-pod), vectorized over leaves x resources — and
+    vmappable over many pod sets at once;
+  * bubble_counts: level-wise segment sums up the topology tree, plus the
+    slice conversion at the slice level.
+
+Phase 2 (sorted level descent) operates on the tiny per-level domain sets
+and stays host-side in round 1; with phase 1 on device the expensive
+O(leaves x podsets) part is a single fused kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+INT_MAX = (1 << 62)
+
+
+@jax.jit
+def leaf_states(free_capacity, tas_usage, assumed_usage, per_pod,
+                leaf_mask):
+    """Pods that fit per leaf.
+
+    free_capacity, tas_usage, assumed_usage: int64[L, S]
+    per_pod: int64[S] (zero = resource not requested)
+    leaf_mask: bool[L] (selector/taint-eligible leaves)
+    Returns int32[L].
+    """
+    free = free_capacity - tas_usage - assumed_usage
+    free = jnp.maximum(0, free)
+    requested = per_pod > 0
+    counts = jnp.where(requested[None, :],
+                       free // jnp.maximum(per_pod, 1)[None, :],
+                       INT_MAX)
+    state = jnp.min(counts, axis=1)
+    state = jnp.where(jnp.any(requested), state, 0)
+    return jnp.where(leaf_mask, state, 0).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("num_levels", "level_sizes_max"))
+def bubble_counts(leaf_state, parent_of_level, level_sizes_max,
+                  slice_size, slice_level_idx, *, num_levels):
+    """Roll leaf pod counts up the topology tree and derive slice counts.
+
+    leaf_state: int32[L] (deepest level)
+    parent_of_level: int32[num_levels-1, max_domains] — parent index (into
+      the level above) for each domain at each non-root level, -1 padded;
+      row d maps level d+1 -> level d.
+    level_sizes_max: static max domain count per level (padded arrays).
+    Returns (state int32[num_levels, max_domains],
+             slice_state int32[num_levels, max_domains]).
+    """
+    M = level_sizes_max
+    states = [None] * num_levels
+    pad = M - leaf_state.shape[0]
+    states[num_levels - 1] = jnp.pad(leaf_state, (0, pad))
+    for lvl in range(num_levels - 2, -1, -1):
+        parent = parent_of_level[lvl]
+        child_state = states[lvl + 1]
+        safe = jnp.where(parent >= 0, parent, M - 1)
+        contrib = jnp.where(parent >= 0, child_state, 0)
+        states[lvl] = jax.ops.segment_sum(contrib, safe, num_segments=M)
+    state = jnp.stack(states)
+
+    slice_states = []
+    for lvl in range(num_levels):
+        slice_states.append(jnp.where(
+            lvl == slice_level_idx, state[lvl] // slice_size, 0))
+    slice_state = jnp.stack(slice_states)
+    # Above the slice level: aggregate child slice counts upward.
+    for lvl in range(num_levels - 2, -1, -1):
+        parent = parent_of_level[lvl]
+        safe = jnp.where(parent >= 0, parent, M - 1)
+        contrib = jnp.where(parent >= 0, slice_state[lvl + 1], 0)
+        agg = jax.ops.segment_sum(contrib, safe, num_segments=M)
+        slice_state = slice_state.at[lvl].set(
+            jnp.where(lvl < slice_level_idx, agg, slice_state[lvl]))
+    return state, slice_state
+
+
+def encode_tas_snapshot(tas_snap, resources: list[str]):
+    """Flatten a tas.TASFlavorSnapshot into the arrays bubble_counts
+    needs. Returns a dict of numpy arrays + the per-level domain lists
+    (host-side, for phase-2 mapping back)."""
+    import numpy as np
+
+    num_levels = len(tas_snap.level_keys)
+    level_domains = [sorted(tas_snap.domains_per_level[lvl].values(),
+                            key=lambda d: d.values)
+                     for lvl in range(num_levels)]
+    index_of = [{d.id: i for i, d in enumerate(doms)}
+                for doms in level_domains]
+    M = max((len(d) for d in level_domains), default=1)
+
+    parent_of_level = np.full((max(num_levels - 1, 1), M), -1, np.int32)
+    for lvl in range(1, num_levels):
+        for i, d in enumerate(level_domains[lvl]):
+            parent_of_level[lvl - 1, i] = index_of[lvl - 1][d.parent.id]
+
+    leaves = level_domains[-1] if num_levels else []
+    L = len(leaves)
+    S = len(resources)
+    free = np.zeros((L, S), np.int64)
+    usage = np.zeros((L, S), np.int64)
+    for i, leaf in enumerate(leaves):
+        for s_i, res in enumerate(resources):
+            free[i, s_i] = leaf.free_capacity.get(res, 0)
+            usage[i, s_i] = leaf.tas_usage.get(res, 0)
+    return {
+        "num_levels": num_levels,
+        "max_domains": M,
+        "parent_of_level": parent_of_level,
+        "free_capacity": free,
+        "tas_usage": usage,
+        "level_domains": level_domains,
+    }

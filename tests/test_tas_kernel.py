@@ -1,0 +1,96 @@
+"""Differential test: batched TAS phase-1 (ops/tas.py) vs the sequential
+fillInCounts on random topologies."""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.api.types import (  # noqa: E402
+    PodSet,
+    PodSetTopologyRequest,
+    Topology,
+    TopologyLevel,
+    TopologyMode,
+)
+from kueue_tpu.ops.tas import (  # noqa: E402
+    bubble_counts,
+    encode_tas_snapshot,
+    leaf_states,
+)
+from kueue_tpu.tas.snapshot import (  # noqa: E402
+    HOSTNAME_LABEL,
+    Node,
+    TASFlavorSnapshot,
+)
+
+TOPOLOGY = Topology("t", (TopologyLevel("block"), TopologyLevel("rack"),
+                          TopologyLevel(HOSTNAME_LABEL)))
+RESOURCES = ["cpu", "pods"]
+
+
+def random_tas(rng, blocks=3, racks=3, hosts=3):
+    snap = TASFlavorSnapshot(TOPOLOGY)
+    for b in range(blocks):
+        for r in range(rng.randrange(1, racks + 1)):
+            for h in range(rng.randrange(1, hosts + 1)):
+                name = f"b{b}-r{r}-h{h}"
+                snap.add_node(Node(
+                    name=name,
+                    labels={"block": f"b{b}", "rack": f"b{b}-r{r}",
+                            HOSTNAME_LABEL: name},
+                    capacity={"cpu": rng.choice([0, 2000, 4000, 8000]),
+                              "pods": rng.choice([4, 16, 64])}))
+    # Random usage.
+    for leaf in snap.leaves.values():
+        if rng.random() < 0.5:
+            snap.add_usage(leaf.values,
+                           {"cpu": rng.randrange(0, 3000)},
+                           rng.randrange(0, 3))
+    return snap
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_phase1_counts_match_sequential(seed):
+    rng = random.Random(seed)
+    snap = random_tas(rng)
+    per_pod_cpu = rng.choice([500, 1000, 2000])
+    slice_size = rng.choice([1, 2, 4])
+    slice_level_idx = rng.choice([1, 2])
+
+    # Sequential fillInCounts.
+    tr = PodSetTopologyRequest(
+        mode=TopologyMode.REQUIRED, level="block",
+        slice_size=slice_size if slice_size > 1 else None,
+        slice_level=TOPOLOGY.levels[slice_level_idx].node_label
+        if slice_size > 1 else None)
+    ps = PodSet("main", 8, {"cpu": per_pod_cpu}, topology_request=tr)
+    per_pod = {"cpu": per_pod_cpu, "pods": 1}
+    eff_slice_level = slice_level_idx if slice_size > 1 else 2
+    snap._fill_in_counts(ps, per_pod, slice_size, eff_slice_level,
+                         False, {})
+
+    # Batched.
+    enc = encode_tas_snapshot(snap, RESOURCES)
+    L = enc["free_capacity"].shape[0]
+    per_pod_vec = np.array([per_pod_cpu, 1], np.int64)
+    states = leaf_states(
+        jnp_arr(enc["free_capacity"]), jnp_arr(enc["tas_usage"]),
+        np.zeros_like(enc["free_capacity"]), per_pod_vec,
+        np.ones(L, bool))
+    state, slice_state = bubble_counts(
+        states, enc["parent_of_level"], enc["max_domains"],
+        slice_size, eff_slice_level, num_levels=enc["num_levels"])
+    state, slice_state = np.asarray(state), np.asarray(slice_state)
+
+    for lvl, domains in enumerate(enc["level_domains"]):
+        for i, d in enumerate(domains):
+            assert state[lvl, i] == d.state, (seed, lvl, d.id)
+            assert slice_state[lvl, i] == d.slice_state, (seed, lvl, d.id)
+
+
+def jnp_arr(x):
+    import jax.numpy as jnp
+    return jnp.asarray(x)
