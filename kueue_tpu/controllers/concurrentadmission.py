@@ -1,0 +1,86 @@
+"""Concurrent admission (KEP 8691): evaluate a job against several
+ClusterQueues at once via per-CQ Workload variants; the most favorable
+admitted variant wins and the siblings are cleaned up.
+
+Reference: pkg/controller/concurrentadmission + pkg/workload/
+concurrentadmission + the scheduler hooks (scheduler.go:386-393,469-479).
+
+Round-1 scope: variants fan out across LocalQueues; the first admitted
+variant (by candidate-list preference order on ties within a cycle) wins;
+pending siblings are withdrawn. Migration of an already-admitted
+less-favorable variant lands with orchestrated preemption in a later
+round.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.api.types import Workload
+
+
+@dataclass
+class _VariantGroup:
+    original: Workload
+    candidates: list[str]  # LocalQueue names in preference order
+    variants: dict[str, str] = field(default_factory=dict)  # lq -> wl key
+    winner: Optional[str] = None
+
+
+class ConcurrentAdmissionController:
+    def __init__(self, engine):
+        self.engine = engine
+        self.groups: dict[str, _VariantGroup] = {}
+
+    def submit_concurrent(self, wl: Workload,
+                          candidate_queues: list[str]) -> list[Workload]:
+        """Fan a workload out into per-queue variants."""
+        group = _VariantGroup(original=wl, candidates=candidate_queues)
+        created = []
+        for lq in candidate_queues:
+            variant = copy.deepcopy(wl)
+            variant.name = f"{wl.name}-{lq}"
+            variant.queue_name = lq
+            variant.uid = ""
+            variant.__post_init__()
+            if self.engine.submit(variant):
+                group.variants[lq] = variant.key
+                created.append(variant)
+        self.groups[wl.key] = group
+        return created
+
+    def reconcile(self) -> None:
+        """Pick winners; withdraw losing variants."""
+        for group in self.groups.values():
+            if group.winner is not None:
+                continue
+            for lq in group.candidates:  # preference order
+                key = group.variants.get(lq)
+                if key is None:
+                    continue
+                variant = self.engine.workloads.get(key)
+                if variant is not None and variant.is_admitted:
+                    group.winner = lq
+                    self._withdraw_losers(group)
+                    break
+
+    def winner_of(self, original_key: str) -> Optional[Workload]:
+        group = self.groups.get(original_key)
+        if group is None or group.winner is None:
+            return None
+        return self.engine.workloads.get(group.variants[group.winner])
+
+    def _withdraw_losers(self, group: _VariantGroup) -> None:
+        for lq, key in group.variants.items():
+            if lq == group.winner:
+                continue
+            wl = self.engine.workloads.get(key)
+            if wl is None:
+                continue
+            if wl.has_quota_reservation:
+                self.engine.evict(wl, "ConcurrentAdmissionLost",
+                                  requeue=False)
+            wl.active = False
+            self.engine.queues.delete_workload(wl)

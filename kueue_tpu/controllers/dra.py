@@ -1,0 +1,62 @@
+"""DRA (Dynamic Resource Allocation) mapping.
+
+Reference: pkg/dra — DeviceClass -> extended-resource mapping
+(extended_resource_cache.go:30, mapper.go) and per-workload ResourceClaim
+counting (claims.go). Workloads request devices via claims; the mapper
+translates them into the quota-space resource names the scheduler
+understands."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class DeviceClass:
+    """A device class exposed as an extended resource."""
+
+    name: str  # e.g. "tpu.google.com/v5e"
+    extended_resource: str  # e.g. "tpu-v5e"
+
+
+@dataclass
+class ResourceClaim:
+    """A claim for N devices of a class (claims.go)."""
+
+    device_class: str
+    count: int = 1
+
+
+class DeviceClassMapper:
+    """extended_resource_cache.go + mapper.go."""
+
+    def __init__(self) -> None:
+        self.classes: dict[str, DeviceClass] = {}
+
+    def add_device_class(self, dc: DeviceClass) -> None:
+        self.classes[dc.name] = dc
+
+    def delete_device_class(self, name: str) -> None:
+        self.classes.pop(name, None)
+
+    def resolve(self, claims: list[ResourceClaim]) -> dict[str, int]:
+        """Claims -> extended-resource requests; raises on unknown class."""
+        out: dict[str, int] = {}
+        for claim in claims:
+            dc = self.classes.get(claim.device_class)
+            if dc is None:
+                raise KeyError(
+                    f"unknown device class {claim.device_class}")
+            out[dc.extended_resource] = out.get(dc.extended_resource, 0) \
+                + claim.count
+        return out
+
+    def apply_claims(self, pod_set, claims: list[ResourceClaim]):
+        """Merge claim-derived requests into a pod set's requests."""
+        resolved = self.resolve(claims)
+        merged = dict(pod_set.requests)
+        for res, count in resolved.items():
+            merged[res] = merged.get(res, 0) + count
+        from dataclasses import replace as _replace
+
+        return _replace(pod_set, requests=merged)

@@ -1,0 +1,83 @@
+"""Failure detection and recovery.
+
+Reference: pkg/controller/tas/node_controller.go (unhealthy-node
+detection), workload unhealthyNodes status (workload_types.go:766),
+fail-fast eviction (scheduler.go:403,804-817), and the
+FailureRecoveryPolicy controller (pkg/controller/failurerecovery): on
+node failure, reschedule affected workloads — to a replacement domain,
+a different flavor, or (MultiKueue) a different cluster.
+
+Round-1 behavior: mark workloads with placements on failed nodes
+unhealthy; recovery evicts + requeues them (the scheduler then finds a
+new placement — possibly another flavor/cluster). In-place replacement
+search lands with the TAS replacement path in a later round.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kueue_tpu.api.types import WorkloadConditionType
+from kueue_tpu.tas.snapshot import HOSTNAME_LABEL
+
+
+@dataclass
+class FailureRecoveryPolicy:
+    """FailureRecoveryPolicy CRD equivalent."""
+
+    name: str = "default"
+    # evict & requeue on the same queue (other flavors/clusters are
+    # naturally retried by the scheduler / MultiKueue).
+    action: str = "Requeue"
+
+
+class FailureRecoveryController:
+    def __init__(self, engine, policy: FailureRecoveryPolicy = None):
+        self.engine = engine
+        self.policy = policy or FailureRecoveryPolicy()
+        self.unhealthy_nodes: set[str] = set()
+
+    def node_failed(self, node_name: str) -> list[str]:
+        """Node health event (tas/node_controller.go). Returns affected
+        workload keys."""
+        self.unhealthy_nodes.add(node_name)
+        node = self.engine.cache.nodes.get(node_name)
+        if node is not None:
+            node.ready = False
+        affected = self._workloads_on_node(node_name)
+        for key in affected:
+            wl = self.engine.workloads.get(key)
+            if wl is None or wl.is_finished:
+                continue
+            wl.set_condition(WorkloadConditionType.EVICTED, False,
+                             reason="", now=self.engine.clock)
+            self.engine.evict(wl, "NodeFailure")
+        self.engine.queues.queue_inadmissible_workloads()
+        return affected
+
+    def node_recovered(self, node_name: str) -> None:
+        self.unhealthy_nodes.discard(node_name)
+        node = self.engine.cache.nodes.get(node_name)
+        if node is not None:
+            node.ready = True
+        self.engine.queues.queue_inadmissible_workloads()
+
+    def _workloads_on_node(self, node_name: str) -> list[str]:
+        """Workloads whose topology assignment lands on the node (matched
+        by the hostname level value)."""
+        affected = []
+        for key, info in list(self.engine.cache.workloads.items()):
+            wl = self.engine.workloads.get(key)
+            if wl is None or wl.status.admission is None:
+                continue
+            for psa in wl.status.admission.pod_set_assignments:
+                ta = psa.topology_assignment
+                if ta is None:
+                    continue
+                if HOSTNAME_LABEL not in ta.levels:
+                    continue
+                idx = list(ta.levels).index(HOSTNAME_LABEL)
+                if any(d.values[idx] == node_name for d in ta.domains):
+                    affected.append(key)
+                    break
+        return affected

@@ -1,0 +1,133 @@
+"""Additional job integrations on the GenericJob contract.
+
+Reference: pkg/controller/jobs/* — 15 adapters. Beyond BatchJob and
+JobSetJob (jobframework.py), these cover the common framework shapes:
+  * TrainingJob — Kubeflow TFJob/PyTorchJob/XGBoost/Paddle/JAXJob style
+    (named replica specs, a master/chief plus workers);
+  * RayClusterJob — head + worker groups;
+  * PodJob — a single plain pod (scheduling-gate based in the reference);
+  * ServingJob — Deployment/StatefulSet style (no completion; runs until
+    deleted).
+Each is a thin shape over pod sets; the jobframework reconciler owns the
+Workload lifecycle for all of them identically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.api.types import PodSet, PodSetTopologyRequest
+from kueue_tpu.controllers.jobframework import (
+    DEFAULT_INTEGRATIONS,
+    PodSetInfo,
+)
+
+
+@dataclass
+class _BaseJob:
+    name: str
+    namespace: str = "default"
+    queue_name: str = ""
+    priority: int = 0
+    suspended: bool = True
+    active: bool = False
+    done: bool = False
+    success: bool = False
+    injected_info: Optional[list[PodSetInfo]] = None
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def is_suspended(self) -> bool:
+        return self.suspended
+
+    def suspend(self) -> None:
+        self.suspended = True
+        self.active = False
+
+    def run_with_pod_sets_info(self, infos: list[PodSetInfo]) -> None:
+        self.injected_info = infos
+        self.suspended = False
+        self.active = True
+
+    def restore_pod_sets_info(self, infos) -> None:
+        self.injected_info = None
+
+    def is_active(self) -> bool:
+        return self.active
+
+    def finished(self) -> tuple[bool, bool]:
+        return self.done, self.success
+
+
+@dataclass
+class TrainingJob(_BaseJob):
+    """Kubeflow-style job: replica specs {name: (replicas, requests)}.
+    (pkg/controller/jobs/kubeflow/*)."""
+
+    framework: str = "pytorch"  # tf | pytorch | xgboost | paddle | jax
+    replica_specs: dict = field(default_factory=dict)
+    topology_request: Optional[PodSetTopologyRequest] = None
+
+    def pod_sets(self) -> list[PodSet]:
+        out = []
+        for rname in sorted(self.replica_specs):
+            replicas, requests = self.replica_specs[rname]
+            out.append(PodSet(name=rname, count=replicas,
+                              requests=dict(requests),
+                              topology_request=self.topology_request))
+        return out
+
+
+@dataclass
+class RayClusterJob(_BaseJob):
+    """Ray cluster: head + worker groups (pkg/controller/jobs/raycluster)."""
+
+    head_requests: dict = field(default_factory=dict)
+    worker_groups: list = field(default_factory=list)  # (name, n, requests)
+
+    def pod_sets(self) -> list[PodSet]:
+        out = [PodSet(name="head", count=1,
+                      requests=dict(self.head_requests))]
+        for gname, replicas, requests in self.worker_groups:
+            out.append(PodSet(name=gname, count=replicas,
+                              requests=dict(requests)))
+        return out
+
+
+@dataclass
+class PodJob(_BaseJob):
+    """A plain pod (pkg/controller/jobs/pod, scheduling gates)."""
+
+    requests: dict = field(default_factory=dict)
+    pod_group: Optional[str] = None
+    group_total_count: int = 1
+
+    def pod_sets(self) -> list[PodSet]:
+        return [PodSet(name=self.pod_group or "main",
+                       count=self.group_total_count,
+                       requests=dict(self.requests))]
+
+
+@dataclass
+class ServingJob(_BaseJob):
+    """Deployment/StatefulSet-style serving workload: admission-managed,
+    never 'finishes' (pkg/controller/jobs/{deployment,statefulset})."""
+
+    replicas: int = 1
+    requests: dict = field(default_factory=dict)
+
+    def pod_sets(self) -> list[PodSet]:
+        return [PodSet(name="pods", count=self.replicas,
+                       requests=dict(self.requests))]
+
+    def finished(self) -> tuple[bool, bool]:
+        return False, False
+
+
+DEFAULT_INTEGRATIONS.register("kubeflow.org/trainingjob", TrainingJob)
+DEFAULT_INTEGRATIONS.register("ray.io/raycluster", RayClusterJob)
+DEFAULT_INTEGRATIONS.register("core/pod", PodJob)
+DEFAULT_INTEGRATIONS.register("apps/serving", ServingJob)

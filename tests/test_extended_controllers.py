@@ -1,0 +1,153 @@
+"""Extended controllers: job adapters, failure recovery, DRA, concurrent
+admission."""
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PodSetTopologyRequest,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Topology,
+    TopologyLevel,
+    TopologyMode,
+    Workload,
+)
+from kueue_tpu.controllers.concurrentadmission import (
+    ConcurrentAdmissionController,
+)
+from kueue_tpu.controllers.dra import (
+    DeviceClass,
+    DeviceClassMapper,
+    ResourceClaim,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.controllers.failurerecovery import FailureRecoveryController
+from kueue_tpu.controllers.integrations import (
+    PodJob,
+    RayClusterJob,
+    ServingJob,
+    TrainingJob,
+)
+from kueue_tpu.controllers.jobframework import JobReconciler
+from kueue_tpu.tas.snapshot import HOSTNAME_LABEL, Node
+
+CPU = "cpu"
+
+
+def make_engine(nominal=20_000, n_cqs=1):
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    for i in range(n_cqs):
+        eng.create_cluster_queue(ClusterQueue(
+            name=f"cq{i}",
+            resource_groups=(ResourceGroup(
+                (CPU,),
+                (FlavorQuotas("default",
+                              {CPU: ResourceQuota(nominal)}),)),),
+        ))
+        eng.create_local_queue(LocalQueue(f"lq{i}", "default", f"cq{i}"))
+    return eng
+
+
+def test_training_and_ray_and_pod_and_serving_adapters():
+    eng = make_engine()
+    rec = JobReconciler(eng)
+    tj = TrainingJob(name="pt", queue_name="lq0", framework="pytorch",
+                     replica_specs={"master": (1, {CPU: 500}),
+                                    "worker": (4, {CPU: 1000})})
+    ray = RayClusterJob(name="ray", queue_name="lq0",
+                        head_requests={CPU: 500},
+                        worker_groups=[("gpu-group", 2, {CPU: 1000})])
+    pod = PodJob(name="p", queue_name="lq0", requests={CPU: 100})
+    srv = ServingJob(name="web", queue_name="lq0", replicas=3,
+                     requests={CPU: 200})
+    for j in (tj, ray, pod, srv):
+        eng.clock += 0.1
+        rec.create_job(j)
+    for _ in range(4):
+        eng.schedule_once()
+    assert not tj.is_suspended()
+    assert [i.name for i in tj.injected_info] == ["master", "worker"]
+    assert not ray.is_suspended()
+    assert not pod.is_suspended()
+    assert not srv.is_suspended()
+    assert srv.finished() == (False, False)  # serving never completes
+
+
+def test_failure_recovery_evicts_workloads_on_failed_node():
+    eng = Engine()
+    eng.create_topology(Topology("t", (TopologyLevel("rack"),
+                                       TopologyLevel(HOSTNAME_LABEL))))
+    eng.create_resource_flavor(ResourceFlavor(
+        "tas", node_labels={"pool": "t"}, topology_name="t"))
+    for h in range(2):
+        eng.create_node(Node(
+            name=f"h{h}", labels={"pool": "t", "rack": "r0",
+                                  HOSTNAME_LABEL: f"h{h}"},
+            capacity={CPU: 4000, "pods": 10}))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            (CPU,), (FlavorQuotas("tas", {CPU: ResourceQuota(8000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    fr = FailureRecoveryController(eng)
+    eng.clock += 0.1
+    wl = Workload(name="gang", queue_name="lq", pod_sets=(PodSet(
+        "main", 2, {CPU: 3000},
+        topology_request=PodSetTopologyRequest(
+            mode=TopologyMode.REQUIRED, level="rack")),))
+    eng.submit(wl)
+    eng.schedule_once()
+    assert wl.is_admitted
+    ta = wl.status.admission.pod_set_assignments[0].topology_assignment
+    failed_node = ta.domains[0].values[-1]
+    affected = fr.node_failed(failed_node)
+    assert wl.key in affected
+    assert wl.is_evicted
+    # Reschedules onto the surviving node (one host still fits 1 pod?
+    # 2 pods x 3000 need 6000 > 4000 -> stays pending).
+    eng.schedule_once()
+    assert not wl.is_admitted
+    fr.node_recovered(failed_node)
+    eng.schedule_once()
+    assert wl.is_admitted
+
+
+def test_dra_mapper():
+    m = DeviceClassMapper()
+    m.add_device_class(DeviceClass("tpu.google.com/v5e", "tpu-v5e"))
+    ps = PodSet("main", 4, {CPU: 1000})
+    out = m.apply_claims(ps, [ResourceClaim("tpu.google.com/v5e", 4)])
+    assert out.requests == {CPU: 1000, "tpu-v5e": 4}
+    with pytest.raises(KeyError):
+        m.resolve([ResourceClaim("unknown", 1)])
+
+
+def test_concurrent_admission_variants():
+    eng = make_engine(nominal=1000, n_cqs=3)
+    ca = ConcurrentAdmissionController(eng)
+    # cq0 is full; cq1 and cq2 are free.
+    eng.clock += 0.1
+    filler = Workload(name="filler", queue_name="lq0",
+                      pod_sets=(PodSet("main", 1, {CPU: 1000}),))
+    eng.submit(filler)
+    eng.schedule_once()
+    eng.clock += 0.1
+    wl = Workload(name="flex", queue_name="",
+                  pod_sets=(PodSet("main", 1, {CPU: 800}),))
+    variants = ca.submit_concurrent(wl, ["lq0", "lq1", "lq2"])
+    assert len(variants) == 3
+    eng.schedule_once()
+    ca.reconcile()
+    winner = ca.winner_of(wl.key)
+    assert winner is not None and winner.queue_name == "lq1"
+    # losers withdrawn: the lq2 variant no longer holds quota or pends.
+    lq2_variant = eng.workloads["default/flex-lq2"]
+    assert not lq2_variant.active
+    assert eng.queues.pending_workloads("cq2") == 0
+    lq0_variant = eng.workloads["default/flex-lq0"]
+    assert not lq0_variant.active
