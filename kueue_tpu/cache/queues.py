@@ -110,7 +110,18 @@ class PendingClusterQueue:
             self.push_or_update(info)
         else:
             self.inadmissible[key] = info
+            self._park_same_hash(info)
         return True
+
+    def _park_same_hash(self, info: WorkloadInfo) -> None:
+        """Scheduling-equivalence hashing (cluster_queue.go:615
+        handleInadmissibleHash): pending workloads identical in shape to a
+        NoFit head would get the same verdict — bulk-park them."""
+        h = scheduling_hash(info.obj, self.name)
+        for key, other in list(self.items.items()):
+            if scheduling_hash(other.obj, self.name) == h:
+                del self.items[key]
+                self.inadmissible[key] = other
 
     def queue_inadmissible(self) -> bool:
         """manager.go QueueInadmissibleWorkloads — move all inadmissible

@@ -1,0 +1,46 @@
+"""Priority booster: age-based priority boost for long-pending workloads.
+
+Reference: cmd/experimental/kueue-priority-booster (pairs with the
+PriorityBoost gate) — boosts the effective priority of workloads that
+have waited too long so they stop starving."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class BoostPolicy:
+    after_seconds: float = 600.0
+    boost_per_interval: int = 1
+    interval_seconds: float = 300.0
+    max_boost: int = 100
+
+
+class PriorityBooster:
+    def __init__(self, engine, policy: BoostPolicy = None):
+        self.engine = engine
+        self.policy = policy or BoostPolicy()
+
+    def reconcile(self) -> int:
+        """Boost pending workloads by age; returns number boosted."""
+        p = self.policy
+        now = self.engine.clock
+        boosted = 0
+        for pcq in self.engine.queues.cluster_queues.values():
+            infos = list(pcq.items.values()) + \
+                list(pcq.inadmissible.values())
+            for info in infos:
+                wl = info.obj
+                waited = now - wl.creation_time
+                if waited < p.after_seconds:
+                    continue
+                intervals = int((waited - p.after_seconds)
+                                // p.interval_seconds) + 1
+                boost = min(p.max_boost,
+                            intervals * p.boost_per_interval)
+                if boost > wl.priority_boost:
+                    wl.priority_boost = boost
+                    pcq.push_or_update(info)  # re-heapify with new priority
+                    boosted += 1
+        return boosted

@@ -1,0 +1,79 @@
+"""HTTP serving layer: metrics + visibility + debugger over stdlib HTTP.
+
+Reference: the manager's metrics endpoint, the visibility aggregated API
+(pkg/visibility/server.go), the debugger dump, and the KueueViz backend's
+REST surface (cmd/kueueviz/backend) — collapsed into one small server
+over the standalone engine."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse
+
+from kueue_tpu.visibility.server import VisibilityServer, dump_state
+
+
+def make_handler(engine):
+    vis = VisibilityServer(engine)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _send(self, body: str, content_type="application/json",
+                  code=200):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802
+            path = urlparse(self.path).path.rstrip("/")
+            parts = [p for p in path.split("/") if p]
+            if path == "/metrics":
+                self._send(engine.registry.render(),
+                           content_type="text/plain")
+            elif path == "/healthz":
+                self._send('{"status":"ok"}')
+            elif path == "/debug/dump":
+                self._send(json.dumps(dump_state(engine), indent=2))
+            elif parts[:1] == ["clusterqueues"] and len(parts) == 1:
+                from kueue_tpu.cli.kueuectl import Kueuectl
+                self._send(json.dumps(
+                    Kueuectl(engine).list_cluster_queues()))
+            elif (parts[:1] == ["clusterqueues"] and len(parts) == 3
+                    and parts[2] == "pendingworkloads"):
+                s = vis.pending_workloads_for_cq(parts[1])
+                self._send(json.dumps({
+                    "clusterQueue": s.cluster_queue,
+                    "items": [vars(i) for i in s.items]}))
+            elif parts[:1] == ["workloads"]:
+                from kueue_tpu.cli.kueuectl import Kueuectl
+                self._send(json.dumps(Kueuectl(engine).list_workloads()))
+            else:
+                self._send('{"error":"not found"}', code=404)
+
+    return Handler
+
+
+class ServingEndpoint:
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
+        self.httpd = ThreadingHTTPServer((host, port),
+                                         make_handler(engine))
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> None:
+        self.thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -1,0 +1,103 @@
+"""HTTP serving endpoint, priority booster, scheduling-equivalence
+hashing."""
+
+import json
+import urllib.request
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.booster import BoostPolicy, PriorityBooster
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.visibility.http_server import ServingEndpoint
+
+CPU = "cpu"
+
+
+def make_engine(nominal=1000):
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(nominal)}),)),),
+    ))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng
+
+
+def submit(eng, name, cpu, priority=0):
+    eng.clock += 0.1
+    wl = Workload(name=name, queue_name="lq", priority=priority,
+                  pod_sets=(PodSet("main", 1, {CPU: cpu}),))
+    eng.submit(wl)
+    return wl
+
+
+def test_http_endpoints():
+    eng = make_engine()
+    submit(eng, "a", 600)
+    submit(eng, "b", 600)
+    eng.schedule_once()
+    srv = ServingEndpoint(eng)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=5) as r:
+                return r.read().decode()
+
+        assert json.loads(get("/healthz"))["status"] == "ok"
+        assert "kueue_tpu_admitted_workloads_total" in get("/metrics")
+        cqs = json.loads(get("/clusterqueues"))
+        assert cqs[0]["name"] == "cq" and cqs[0]["admitted"] == 1
+        pend = json.loads(get("/clusterqueues/cq/pendingworkloads"))
+        assert [i["name"] for i in pend["items"]] == ["b"]
+        dump = json.loads(get("/debug/dump"))
+        assert "default/a" in dump["admitted"]
+    finally:
+        srv.stop()
+
+
+def test_priority_booster_unstarves():
+    eng = make_engine(nominal=1000)
+    booster = PriorityBooster(eng, BoostPolicy(
+        after_seconds=100, boost_per_interval=5, interval_seconds=50,
+        max_boost=50))
+    old = submit(eng, "old", 800, priority=0)
+    # Fill the queue so "old" keeps losing to a newer high-priority flood.
+    hog = submit(eng, "hog", 900, priority=10)
+    eng.schedule_once()
+    assert hog.is_admitted and not old.is_admitted
+    eng.tick(200.0)
+    boosted = booster.reconcile()
+    assert boosted == 1
+    assert old.effective_priority > 0
+    eng.finish(hog.key)
+    eng.schedule_once()
+    assert old.is_admitted
+
+
+def test_scheduling_hash_bulk_parks_identical_workloads():
+    eng = make_engine(nominal=1000)
+    big1 = submit(eng, "big1", 900)
+    big2 = submit(eng, "big2", 900)  # identical shape
+    small = submit(eng, "small", 100)
+    filler = submit(eng, "filler", 1000)
+    eng.schedule_once()  # admits filler? No: FIFO order big1 first
+    # big1 NoFit after filler admitted... drive a couple of cycles:
+    eng.schedule_once()
+    eng.schedule_once()
+    pcq = eng.queues.cluster_queues["cq"]
+    # once big1 was parked NoFit, big2 (same hash) was parked with it
+    if "default/big1" in pcq.inadmissible:
+        assert "default/big2" in pcq.inadmissible
