@@ -67,6 +67,7 @@ def cycle_step(
     wl_req,  # int64[W, S]
     wl_priority,  # int64[W]
     wl_has_qr,  # bool[W]
+    wl_hash,  # int32[W] scheduling-equivalence hash id
     nominal, lend_limit, borrow_limit, parent, ancestors, height,
     group_of_res, group_flavors, no_preemption, can_pwb, can_always_reclaim,
     best_effort, fung_borrow_try_next, fung_pref_preempt_first,
@@ -141,10 +142,16 @@ def cycle_step(
 
     # 6. Park NoFit / no-candidate heads on BestEffortFIFO CQs
     # (cluster_queue.go requeueIfNotPresent + inadmissible map).
-    parked_slot = slot_valid & ~slot_admitted & best_effort & (
+    parked_slot = slot_valid & ~slot_admitted & best_effort[h_cq] & (
         (pmode == aops.P_NO_FIT) | (pmode == aops.P_NO_CANDIDATES))
     wl_parked = jnp.zeros((W,), bool).at[
         jnp.where(parked_slot, h_safe, W)].set(True, mode="drop")
+    # Scheduling-equivalence bulk parking (cluster_queue.go:615): pending
+    # workloads identical in shape to a parked head share its verdict.
+    parked_hash_mask = jnp.zeros((W + 1,), bool).at[
+        jnp.where(parked_slot, wl_hash[h_safe], W)].set(True, mode="drop")
+    wl_parked = wl_parked | (active
+                             & parked_hash_mask[jnp.minimum(wl_hash, W)])
 
     new_pending = pending & ~wl_admitted
     new_inadmissible = inadmissible | (wl_parked & new_pending)
@@ -211,6 +218,7 @@ class BatchedDrainSolver:
             wl_req=jnp.asarray(wl.requests),
             wl_priority=jnp.asarray(wl.priority),
             wl_has_qr=jnp.asarray(wl.has_quota_reservation),
+            wl_hash=jnp.asarray(wl.hash_id),
             nominal=jnp.asarray(w.nominal),
             lend_limit=jnp.asarray(w.lend_limit),
             borrow_limit=jnp.asarray(w.borrow_limit),

@@ -92,6 +92,7 @@ class OracleBridge:
             wl_cq=jnp.asarray(wl.cq), wl_req=jnp.asarray(wl.requests),
             wl_priority=jnp.asarray(wl.priority),
             wl_has_qr=jnp.asarray(wl.has_quota_reservation),
+            wl_hash=jnp.asarray(wl.hash_id),
             nominal=jnp.asarray(w.nominal),
             lend_limit=jnp.asarray(w.lend_limit),
             borrow_limit=jnp.asarray(w.borrow_limit),

@@ -56,6 +56,7 @@ def sharded_cycle_step(mesh: Mesh, depth: int, num_resources: int,
         wl_sharded2,  # wl_req
         wl_sharded,  # wl_priority
         wl_sharded,  # wl_has_qr
+        wl_sharded,  # wl_hash
         repl2,  # nominal
         repl2,  # lend_limit
         repl2,  # borrow_limit

@@ -100,6 +100,9 @@ class WorkloadTensors:
     requests: np.ndarray  # int64[W, S] count-scaled totals
     has_quota_reservation: np.ndarray  # bool[W]
     eligible: np.ndarray  # bool[W] — encodable on the fast path
+    # Scheduling-equivalence hash id (workload.go:236 SchedulingHash),
+    # dense-coded: equal ids => identical admission verdicts.
+    hash_id: np.ndarray = None  # int32[W]
 
 
 def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
@@ -262,9 +265,14 @@ def encode_workloads(world: WorldTensors,
     requests = np.zeros((W, S), np.int64)
     has_qr = np.zeros(W, bool)
     eligible = np.ones(W, bool)
+    hash_id = np.zeros(W, np.int32)
+    hash_codes: dict = {}
     keys = []
+    from kueue_tpu.cache.queues import scheduling_hash
     for i, info in enumerate(infos):
         keys.append(info.key)
+        h = scheduling_hash(info.obj, info.cluster_queue)
+        hash_id[i] = hash_codes.setdefault(h, len(hash_codes))
         cq[i] = cq_idx.get(info.cluster_queue, -1)
         priority[i] = info.obj.effective_priority
         timestamp[i] = info.obj.creation_time
@@ -293,4 +301,4 @@ def encode_workloads(world: WorldTensors,
     return WorkloadTensors(
         num_workloads=W, keys=keys, cq=cq, priority=priority,
         timestamp=timestamp, requests=requests,
-        has_quota_reservation=has_qr, eligible=eligible)
+        has_quota_reservation=has_qr, eligible=eligible, hash_id=hash_id)
