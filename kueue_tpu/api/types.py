@@ -297,6 +297,7 @@ class PodSetTopologyRequest:
     slice_level: Optional[str] = None
     slice_size: Optional[int] = None
     pod_set_group_name: Optional[str] = None
+    pod_index_label: Optional[str] = None  # rank label for the ungater
 
 
 @dataclass
@@ -358,6 +359,9 @@ class WorkloadStatus:
     requeue_count: int = 0
     requeue_at: Optional[float] = None
     admission_check_states: dict[str, str] = field(default_factory=dict)
+    # TAS node replacement (workload_types.go:766): names of failed nodes
+    # whose domains need re-placement (tas/node_controller.go).
+    unhealthy_nodes: tuple[str, ...] = ()
 
 
 _uid_counter = itertools.count(1)

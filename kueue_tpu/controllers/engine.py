@@ -88,6 +88,8 @@ class Engine:
         self.oracle = None
         # WorkloadPriorityClass registry (workloadpriorityclass_types.go).
         self.workload_priority_classes: dict[str, int] = {}
+        # Second-pass retry bookkeeping (second_pass_queue.go backoff).
+        self._second_pass_attempts: dict[str, int] = {}
 
     # -- object admin --
 
@@ -115,6 +117,97 @@ class Engine:
     def delete_node(self, name: str) -> None:
         self.cache.delete_node(name)
         self.queues.queue_inadmissible_workloads()
+
+    def mark_node_unhealthy(self, name: str, reason: str = "") -> None:
+        """tas/node_controller.go: a node failed — record it on every
+        admitted TAS workload placed there (status.unhealthyNodes,
+        workload_types.go:766) and arm the second-pass queue so the next
+        scheduling pass runs the replacement algorithm."""
+        self.cache.delete_node(name)
+        for wl in self.workloads.values():
+            if wl.is_finished or wl.status.admission is None:
+                continue
+            touched = any(
+                dom.values[-1] == name
+                for psa in wl.status.admission.pod_set_assignments
+                if psa.topology_assignment is not None
+                for dom in psa.topology_assignment.domains)
+            if touched and name not in wl.status.unhealthy_nodes:
+                wl.status.unhealthy_nodes = \
+                    wl.status.unhealthy_nodes + (name,)
+                info = WorkloadInfo.from_workload(
+                    wl, wl.status.admission.cluster_queue)
+                self.queues.second_pass.prequeue(wl.key)
+                self.queues.second_pass.queue(info, now=self.clock)
+                self._event("NodeUnhealthy", wl.key,
+                            cluster_queue=info.cluster_queue,
+                            detail=f"{name}: {reason}")
+        self.queues.queue_inadmissible_workloads()
+
+    def _process_second_pass(self) -> None:
+        """Replacement pass for workloads with unhealthy nodes
+        (scheduler.go second-pass handling + tas_flavor_snapshot.go:747).
+        On success the admission's TopologyAssignments are patched in
+        place (pods on healthy nodes keep running); on failure either
+        fail-fast evict (TASFailedNodeReplacementFailFast) or retry with
+        backoff."""
+        from kueue_tpu.config import features
+        from kueue_tpu.tas.snapshot import TASPodSetRequest
+
+        for info in self.queues.second_pass.take_all_ready(self.clock):
+            wl = self.workloads.get(info.key)
+            if wl is None or wl.is_finished \
+                    or wl.status.admission is None \
+                    or not wl.status.unhealthy_nodes:
+                continue
+            snapshot = self.cache.snapshot()
+            by_flavor: dict[str, list[TASPodSetRequest]] = {}
+            for i, psa in enumerate(wl.status.admission.pod_set_assignments):
+                if psa.topology_assignment is None:
+                    continue
+                flavor = next((f for f in psa.flavors.values()
+                               if f in snapshot.tas_flavors), None)
+                if flavor is None:
+                    continue
+                by_flavor.setdefault(flavor, []).append(TASPodSetRequest(
+                    wl.pod_sets[i],
+                    info.total_requests[i].single_pod_requests(),
+                    psa.count))
+            reason = ""
+            patches: dict[str, object] = {}
+            for flavor in sorted(by_flavor):
+                # One grouped call per flavor: the replacement path threads
+                # a shared assumed-usage dict across the workload's pod
+                # sets so two replacements can't double-book one free slot.
+                results, reason = snapshot.tas_flavors[flavor] \
+                    .find_topology_assignments_for_flavor(
+                        by_flavor[flavor], workload=wl)
+                if reason:
+                    break
+                patches.update(results)
+            if reason:
+                if features.enabled("TASFailedNodeReplacementFailFast"):
+                    self.evict(wl, "NodeFailureReplacementFailed")
+                    wl.status.unhealthy_nodes = ()
+                else:
+                    attempt = self._second_pass_attempts.get(info.key, 0) + 1
+                    self._second_pass_attempts[info.key] = attempt
+                    self.queues.second_pass.prequeue(info.key)
+                    self.queues.second_pass.queue(info, now=self.clock,
+                                                  iteration=attempt)
+                continue
+            from dataclasses import replace as _dc_replace
+            adm = wl.status.admission
+            wl.status.admission = _dc_replace(adm, pod_set_assignments=tuple(
+                _dc_replace(psa, topology_assignment=patches[psa.name])
+                if psa.name in patches else psa
+                for psa in adm.pod_set_assignments))
+            self._second_pass_attempts.pop(info.key, None)
+            replaced = ", ".join(wl.status.unhealthy_nodes)
+            wl.status.unhealthy_nodes = ()
+            self.cache.add_or_update_workload(wl)
+            self._event("NodeReplaced", wl.key,
+                        cluster_queue=info.cluster_queue, detail=replaced)
 
     # -- workload lifecycle --
 
@@ -178,6 +271,7 @@ class Engine:
         """One schedule() cycle (scheduler.go:286)."""
         import time as _time
 
+        self._process_second_pass()
         if self.oracle is not None:
             t0 = _time.perf_counter()
             result = self.oracle.try_cycle()

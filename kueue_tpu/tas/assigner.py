@@ -47,27 +47,26 @@ def _tas_only(cq_snapshot) -> bool:
 
 
 def find_assignments(cq_snapshot, tas_requests: dict[str, list],
-                     simulate_empty: bool = False):
+                     simulate_empty: bool = False, workload=None):
     """Run placement per flavor, accumulating assumed usage between pod
     sets of the same workload
-    (clusterqueue_snapshot.go:207 FindTopologyAssignmentsForWorkload).
+    (clusterqueue_snapshot.go:207 FindTopologyAssignmentsForWorkload;
+    grouping/leader/replacement handled by
+    FindTopologyAssignmentsForFlavor, tas_flavor_snapshot.go:642).
     Returns (results {psa_name: TopologyAssignment}, failure_reason)."""
     results = {}
     for flavor in sorted(tas_requests):
         tas_snap = cq_snapshot.tas_flavors[flavor]
-        assumed: dict[tuple, dict[str, int]] = {}
-        for psa, request in tas_requests[flavor]:
-            assignment, reason = tas_snap.find_topology_assignment(
-                request, simulate_empty=simulate_empty,
-                assumed_usage=assumed)
-            if assignment is None:
-                return None, (psa.name, reason)
-            results[psa.name] = assignment
-            for dom in assignment.domains:
-                bucket = assumed.setdefault(tuple(dom.values), {})
-                for res, per_pod in request.single_pod_requests.items():
-                    bucket[res] = bucket.get(res, 0) + per_pod * dom.count
-                bucket["pods"] = bucket.get("pods", 0) + dom.count
+        pairs = tas_requests[flavor]
+        flavor_results, reason = tas_snap.find_topology_assignments_for_flavor(
+            [request for _, request in pairs], workload=workload,
+            simulate_empty=simulate_empty)
+        if reason:
+            failed = next((psa.name for psa, request in pairs
+                           if psa.name not in flavor_results),
+                          pairs[0][0].name)
+            return None, (failed, reason)
+        results.update(flavor_results)
     return results, None
 
 

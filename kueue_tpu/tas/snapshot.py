@@ -6,25 +6,30 @@ of placing jobs onto TPU pod slices over ICI (within-domain) and DCN
 (across domains).
 
 Algorithm (tas_flavor_snapshot.go:933-945):
-  Phase 1 (fillInCounts :1748): per leaf domain, compute how many pods fit
-  in free capacity; bubble counts up the topology tree; at the slice level
+  Phase 1 (fillInCounts :1750): per leaf domain, compute how many pods fit
+  in free capacity (plus leader-aware variants stateWithLeader /
+  sliceStateWithLeader / leaderState, fillLeafCounts :1864); bubble counts
+  up the topology tree (fillInCountsHelper :1906); at the slice level
   convert pod counts to whole-slice counts.
   Phase 2 (findTopologyAssignment :946): pick the assignment level — the
   requested level for `required`, climbing up for `preferred`, the whole
   forest for `unconstrained`; then descend level-by-level, each time
   sorting child domains (BestFit: sliceState desc, state asc, values asc —
-  :1722 sortedDomains) and taking a minimal prefix, with a best-fit
-  optimization for the final domain (:1390 findBestFitDomainForSlices).
+  sortedDomains :1722; LeastFreeCapacity ascending for unconstrained) and
+  taking a minimal prefix, with a best-fit optimization for the final
+  domain (findBestFitDomainForSlices).
 
-Round-1 scope: required/preferred/unconstrained modes, pod-set slices
-(single slice level), taint/selector node filtering, TAS usage accounting.
-Leaders, balanced placement, multi-layer slices, and node replacement land
-in later rounds.
+Covered here: required/preferred/unconstrained modes, pod-set slices
+(single slice level), leader+workers co-placement (findLeaderAndWorkers
+:729, consumeWithLeadersGeneric :1510), balanced placement
+(tas_balanced_placement.go, see balanced.py), unhealthy-node replacement
+(findReplacementAssignment :747, deleteDomain :884, staleness :878),
+taint/selector node filtering, TAS usage accounting.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Optional
 
 from kueue_tpu.api.types import (
@@ -35,8 +40,11 @@ from kueue_tpu.api.types import (
     Topology,
     TopologyMode,
 )
+from kueue_tpu.config import features
 
 HOSTNAME_LABEL = "kubernetes.io/hostname"
+
+_INF = 1 << 60
 
 
 @dataclass
@@ -65,7 +73,9 @@ class TopologyAssignment:
 
 class _Domain:
     __slots__ = ("id", "values", "parent", "children", "state",
-                 "slice_state", "free_capacity", "tas_usage", "node_name")
+                 "slice_state", "state_with_leader",
+                 "slice_state_with_leader", "leader_state",
+                 "free_capacity", "tas_usage", "node_name")
 
     def __init__(self, domain_id, values):
         self.id = domain_id
@@ -74,9 +84,49 @@ class _Domain:
         self.children: list[_Domain] = []
         self.state = 0  # pods that fit (phase-1), then assigned count
         self.slice_state = 0
+        self.state_with_leader = 0
+        self.slice_state_with_leader = 0
+        self.leader_state = 0
         self.free_capacity: dict[str, int] = {}
         self.tas_usage: dict[str, int] = {}
         self.node_name: Optional[str] = None
+
+    def clear_state(self):
+        """tas_balanced_placement.go clearState."""
+        self.state = 0
+        self.slice_state = 0
+        self.state_with_leader = 0
+        self.slice_state_with_leader = 0
+        self.leader_state = 0
+        for c in self.children:
+            c.clear_state()
+
+    def clear_leader_capacity(self):
+        """tas_balanced_placement.go clearLeaderCapacity."""
+        self.state_with_leader = 0
+        self.slice_state_with_leader = 0
+        self.leader_state = 0
+        for c in self.children:
+            c.clear_leader_capacity()
+
+
+def clone_domains(domains: list[_Domain]) -> list[_Domain]:
+    """Deep-clone a forest of domains (tas_balanced_placement.go
+    cloneDomains) so what-if pruning never mutates phase-1 state."""
+    def clone(d: _Domain, parent) -> _Domain:
+        c = _Domain(d.id, d.values)
+        c.parent = parent
+        c.state = d.state
+        c.slice_state = d.slice_state
+        c.state_with_leader = d.state_with_leader
+        c.slice_state_with_leader = d.slice_state_with_leader
+        c.leader_state = d.leader_state
+        c.free_capacity = d.free_capacity
+        c.tas_usage = d.tas_usage
+        c.node_name = d.node_name
+        c.children = [clone(ch, c) for ch in d.children]
+        return c
+    return [clone(d, None) for d in domains]
 
 
 @dataclass
@@ -84,6 +134,18 @@ class TASPodSetRequest:
     pod_set: PodSet
     single_pod_requests: dict[str, int]
     count: int
+
+
+@dataclass
+class _AssignState:
+    """findTopologyAssignmentState (the per-call scratch)."""
+    count: int
+    slice_size: int
+    requested_level_idx: int
+    slice_level_idx: int
+    required: bool
+    unconstrained: bool
+    leader_count: int = 0
 
 
 class TASFlavorSnapshot:
@@ -117,6 +179,18 @@ class TASFlavorSnapshot:
             used = (non_tas_usage or {}).get(res, 0)
             leaf.free_capacity[res] = leaf.free_capacity.get(res, 0) \
                 + max(0, cap - used)
+
+    def remove_node(self, node: Node) -> None:
+        """Node deletion / NotReady transition (tas_nodes_cache.go): the
+        leaf domain disappears, making assignments on it stale."""
+        values = tuple(node.labels.get(k, "") for k in self.level_keys)
+        leaf = self.leaves.pop(values, None)
+        if leaf is None:
+            return
+        self.domains.pop(values, None)
+        self.domains_per_level[len(values) - 1].pop(values, None)
+        if leaf.parent is not None:
+            leaf.parent.children.remove(leaf)
 
     def _ensure_domain(self, values: tuple) -> _Domain:
         domain = self.domains.get(values)
@@ -169,7 +243,62 @@ class TASFlavorSnapshot:
                     return False
         return True
 
-    # -- the placement algorithm --
+    # -- the placement entry points --
+
+    def find_topology_assignments_for_flavor(
+        self,
+        requests: list[TASPodSetRequest],
+        workload=None,
+        simulate_empty: bool = False,
+        assumed_usage: Optional[dict[tuple, dict[str, int]]] = None,
+    ) -> tuple[dict[str, TopologyAssignment], str]:
+        """FindTopologyAssignmentsForFlavor (tas_flavor_snapshot.go:642):
+        group pod sets by topology group, pick leader+workers per group
+        (findLeaderAndWorkers :729), route to the replacement path when the
+        workload reports unhealthy nodes. Returns ({name: assignment},
+        failure_reason); partial results on failure."""
+        assumed = assumed_usage if assumed_usage is not None else {}
+        groups: dict[str, list[TASPodSetRequest]] = {}
+        order: list[str] = []
+        for idx, tr in enumerate(requests):
+            key = (tr.pod_set.topology_request.pod_set_group_name
+                   if tr.pod_set.topology_request else None) or str(idx)
+            if key not in groups:
+                order.append(key)
+            groups.setdefault(key, []).append(tr)
+
+        unhealthy = tuple(getattr(
+            getattr(workload, "status", None), "unhealthy_nodes", ()) or ())
+
+        results: dict[str, TopologyAssignment] = {}
+        for key in order:
+            trs = groups[key]
+            if unhealthy:
+                for tr in trs:
+                    existing = _existing_assignment(workload,
+                                                   tr.pod_set.name)
+                    if existing is None:
+                        continue
+                    new_assignment, repl, reason = \
+                        self.find_replacement_assignment(
+                            tr, existing, unhealthy, assumed)
+                    if reason:
+                        return results, reason
+                    results[tr.pod_set.name] = new_assignment
+                    _add_assumed(assumed, repl, tr)
+                continue
+            leader, workers = _find_leader_and_workers(trs)
+            assignments, reason = self.find_topology_assignments(
+                workers, leader, simulate_empty=simulate_empty,
+                assumed_usage=assumed)
+            if reason:
+                return results, reason
+            for tr in trs:
+                ta = assignments.get(tr.pod_set.name)
+                if ta is not None:
+                    results[tr.pod_set.name] = ta
+                    _add_assumed(assumed, ta, tr)
+        return results, ""
 
     def find_topology_assignment(
         self,
@@ -177,10 +306,27 @@ class TASFlavorSnapshot:
         simulate_empty: bool = False,
         assumed_usage: Optional[dict[tuple, dict[str, int]]] = None,
     ) -> tuple[Optional[TopologyAssignment], str]:
+        """Single-pod-set compatibility wrapper over
+        find_topology_assignments."""
+        assignments, reason = self.find_topology_assignments(
+            request, None, simulate_empty=simulate_empty,
+            assumed_usage=assumed_usage)
+        if reason:
+            return None, reason
+        return assignments[request.pod_set.name], ""
+
+    def find_topology_assignments(
+        self,
+        workers: TASPodSetRequest,
+        leader: Optional[TASPodSetRequest] = None,
+        simulate_empty: bool = False,
+        assumed_usage: Optional[dict[tuple, dict[str, int]]] = None,
+        required_replacement_domain: tuple = (),
+    ) -> tuple[Optional[dict[str, TopologyAssignment]], str]:
         """tas_flavor_snapshot.go:946 (findTopologyAssignment). Returns
-        (assignment, failure_reason)."""
-        tr = request.pod_set.topology_request or PodSetTopologyRequest()
-        count = request.count
+        ({pod_set_name: assignment}, failure_reason)."""
+        tr = workers.pod_set.topology_request or PodSetTopologyRequest()
+        count = workers.count
         required = tr.mode == TopologyMode.REQUIRED
         unconstrained = tr.mode == TopologyMode.UNCONSTRAINED
 
@@ -208,134 +354,408 @@ class TASFlavorSnapshot:
                 f"podset slice topology {slice_level_key} is above the "
                 f"podset topology {tr.level}")
 
-        per_pod = dict(request.single_pod_requests)
+        per_pod = dict(workers.single_pod_requests)
         per_pod["pods"] = per_pod.get("pods", 0) + 1
+        leader_per_pod = None
+        if leader is not None:
+            leader_per_pod = dict(leader.single_pod_requests)
+            leader_per_pod["pods"] = leader_per_pod.get("pods", 0) + 1
+
+        state = _AssignState(
+            count=count, slice_size=slice_size,
+            requested_level_idx=requested_level_idx,
+            slice_level_idx=slice_level_idx, required=required,
+            unconstrained=unconstrained,
+            leader_count=1 if leader is not None else 0)
 
         # Phase 1: per-domain fit counts.
-        self._fill_in_counts(request.pod_set, per_pod, slice_size,
-                             slice_level_idx, simulate_empty,
-                             assumed_usage or {})
+        self._fill_in_counts(workers.pod_set, per_pod, leader_per_pod,
+                             state, simulate_empty, assumed_usage or {},
+                             required_replacement_domain)
 
         slice_count = count // slice_size
 
-        # Phase 2a: find the level with fitting domains.
-        fit_level_idx, fit_domains, reason = self._find_level_with_fit(
-            requested_level_idx, slice_count, required, unconstrained)
-        if reason:
-            return None, reason
+        # Phase 2a: balanced placement (preferred mode only), else find
+        # the level with fitting domains (tas_flavor_snapshot.go:1060-1087).
+        fit_domains = None
+        fit_level_idx = 0
+        used_balanced = False
+        if (features.enabled("TASBalancedPlacement")
+                and not required and not unconstrained):
+            from kueue_tpu.tas import balanced
+            cand, threshold = balanced.find_best_domains(self, state)
+            if threshold > 0:
+                fit_domains, fit_level_idx, reason = balanced.apply(
+                    self, state, threshold, cand)
+                used_balanced = not reason
+        if not used_balanced:
+            fit_level_idx, fit_domains, reason = self._find_level_with_fit(
+                requested_level_idx, slice_count, state)
+            if reason:
+                return None, reason
 
         # Phase 2b: minimize the chosen domains, then descend.
         fit_domains = self._update_counts_to_minimum(
-            fit_domains, count, slice_size, use_slices=True)
+            fit_domains, count, state.leader_count, slice_size,
+            unconstrained, use_slices=True)
+        if fit_domains is None:
+            return None, "internal: assignment accounting underflow"
         level = fit_level_idx
-        while level < min(len(self.level_keys) - 1, slice_level_idx):
-            lower = self._sorted(
-                [c for d in fit_domains for c in d.children], unconstrained)
+        while level < min(len(self.level_keys) - 1, slice_level_idx) \
+                and not used_balanced:
+            # Leader still to place: order children so leader-capable
+            # domains come first (sortedDomainsWithLeader), otherwise the
+            # leader branch of the consume loop skips worker-only domains.
+            children = [c for d in fit_domains for c in d.children]
+            lower = (self._sorted_with_leader(children, unconstrained)
+                     if state.leader_count > 0
+                     else self._sorted(children, unconstrained))
             fit_domains = self._update_counts_to_minimum(
-                lower, count, slice_size, use_slices=True)
+                lower, count, state.leader_count, slice_size, unconstrained,
+                use_slices=True)
+            if fit_domains is None:
+                return None, self._not_fit_message(0, slice_count)
             level += 1
         while level < len(self.level_keys) - 1:
-            # Below the slice level, pods are distributed per parent domain
-            # (tas_flavor_snapshot.go:1095-1120).
+            # At/below the slice level (or after balanced placement), pods
+            # are distributed per parent domain
+            # (tas_flavor_snapshot.go:1095-1130).
+            slice_on_level = slice_size if level < slice_level_idx else 1
             new_fit = []
             for d in fit_domains:
-                lower = self._sorted(d.children, unconstrained)
-                new_fit.extend(self._update_counts_to_minimum(
-                    lower, d.state, 1, use_slices=False))
+                lower = (self._sorted_with_leader(d.children, unconstrained)
+                         if d.leader_state > 0
+                         else self._sorted(d.children, unconstrained))
+                if slice_on_level > 1:
+                    for c in lower:
+                        c.slice_state = c.state // slice_on_level
+                        c.slice_state_with_leader = \
+                            c.state_with_leader // slice_on_level
+                out = self._update_counts_to_minimum(
+                    lower, d.state, d.leader_state, slice_on_level,
+                    unconstrained, use_slices=slice_on_level > 1)
+                if out is None:
+                    return None, "internal: assignment accounting underflow"
+                new_fit.extend(out)
             fit_domains = new_fit
             level += 1
+
+        # Leader/worker split (tas_flavor_snapshot.go:1134-1157): leaders
+        # land in the chosen domains that reserved leader capacity.
+        assignments: dict[str, TopologyAssignment] = {}
+        if leader is not None:
+            leader_domains = []
+            worker_domains = []
+            for d in fit_domains:
+                if d.leader_state > 0:
+                    leader_domains.append(
+                        TopologyDomainAssignment(d.values, d.leader_state))
+                if d.state > 0:
+                    worker_domains.append(d)
+            assignments[leader.pod_set.name] = TopologyAssignment(
+                tuple(self.level_keys),
+                tuple(sorted(leader_domains, key=lambda a: a.values)))
+            fit_domains = worker_domains
 
         domains = sorted(
             (TopologyDomainAssignment(d.values, d.state)
              for d in fit_domains if d.state > 0),
             key=lambda a: a.values)
-        return TopologyAssignment(tuple(self.level_keys),
-                                  tuple(domains)), ""
+        assignments[workers.pod_set.name] = TopologyAssignment(
+            tuple(self.level_keys), tuple(domains))
+        return assignments, ""
+
+    # -- unhealthy-node replacement (tas_flavor_snapshot.go:747) --
+
+    def is_topology_assignment_stale(
+            self, assignment: TopologyAssignment) -> tuple[bool, str]:
+        """IsTopologyAssignmentStale :878 — domains that no longer exist
+        (node deleted / NotReady)."""
+        for dom in assignment.domains:
+            if tuple(dom.values) not in self.domains:
+                return True, dom.values[0]
+        return False, ""
+
+    def find_replacement_assignment(
+        self,
+        tr: TASPodSetRequest,
+        existing: TopologyAssignment,
+        unhealthy_nodes,
+        assumed_usage: dict,
+    ) -> tuple[Optional[TopologyAssignment], Optional[TopologyAssignment],
+               str]:
+        """findReplacementAssignment :747: drop the unhealthy nodes'
+        domains from the existing assignment, re-place only the affected
+        pods (pinned to the required replacement domain when
+        slices/required demand it), and merge. Unlike the reference (one
+        node per pass), all currently-unhealthy nodes are replaced in one
+        shot — in our model a failed node leaves the topology immediately,
+        so a second dead node would otherwise trip the staleness check
+        forever. Returns (new_full_assignment, replacement_only,
+        reason)."""
+        if isinstance(unhealthy_nodes, str):
+            unhealthy_nodes = (unhealthy_nodes,)
+        kept, affected = _delete_domains(existing, unhealthy_nodes)
+        stale, stale_domain = self.is_topology_assignment_stale(kept)
+        if stale:
+            return None, None, (
+                "cannot replace the node: existing topologyAssignment "
+                f"contains the stale domain {stale_domain!r}")
+        if affected == 0:
+            return kept, TopologyAssignment(existing.levels, ()), ""
+        required_domain = self._required_replacement_domain(tr, kept,
+                                                           affected)
+        tr_copy = TASPodSetRequest(tr.pod_set, tr.single_pod_requests,
+                                   affected)
+        treq = tr.pod_set.topology_request
+        slice_size = (treq.slice_size or 1) if treq else 1
+        if slice_size > 1 and required_domain and affected % slice_size != 0:
+            # The replacement alone is not whole slices; keep leaf-level
+            # grouping by dropping the slice constraint for the re-find
+            # (the innermost dividing constraint, :768-789).
+            tr_copy = TASPodSetRequest(
+                replace(tr.pod_set,
+                        topology_request=replace(treq, slice_size=None,
+                                                 slice_level=None)),
+                tr.single_pod_requests, affected)
+        assignments, reason = self.find_topology_assignments(
+            tr_copy, None, assumed_usage=assumed_usage,
+            required_replacement_domain=required_domain)
+        if reason:
+            return None, None, reason
+        repl = assignments[tr.pod_set.name]
+        if not repl.domains:
+            return None, None, (
+                f"cannot find replacement assignment for unhealthy "
+                f"node(s): {', '.join(unhealthy_nodes)}")
+        merged = _merge_assignments(repl, kept)
+        return merged, repl, ""
+
+    def _required_replacement_domain(self, tr: TASPodSetRequest,
+                                     kept: TopologyAssignment,
+                                     missing: int) -> tuple:
+        """requiredReplacementDomain :826: the domain the replacement must
+        stay inside — the incomplete-slice domain for sliced pod sets, or
+        the original required-level domain for required mode."""
+        treq = tr.pod_set.topology_request
+        if treq is None or not kept.domains:
+            return ()
+        slice_size = treq.slice_size or 1
+        remaining = sum(d.count for d in kept.domains)
+        if slice_size > 1 and (remaining + missing) % slice_size == 0 \
+                and remaining % slice_size != 0:
+            # findIncompleteSliceDomain :905: the slice-level domain whose
+            # pod count needs topping up to a whole slice.
+            slice_key = treq.slice_level or self.level_keys[-1]
+            if slice_key not in self.level_keys:
+                return ()
+            slice_idx = self.level_keys.index(slice_key)
+            usage: dict[tuple, int] = {}
+            for dom in kept.domains:
+                usage[tuple(dom.values[:slice_idx + 1])] = \
+                    usage.get(tuple(dom.values[:slice_idx + 1]), 0) \
+                    + dom.count
+            for values, count in sorted(usage.items()):
+                if (count + missing) % slice_size == 0:
+                    return values
+            return ()
+        if treq.mode != TopologyMode.REQUIRED or treq.level is None:
+            return ()
+        if treq.level not in self.level_keys:
+            return ()
+        level_idx = self.level_keys.index(treq.level)
+        return tuple(kept.domains[0].values[:level_idx + 1])
 
     # -- internals --
 
     def _leaf_fits(self, pod_set: PodSet, per_pod: dict[str, int],
+                   leader_per_pod: Optional[dict[str, int]],
                    leaf: _Domain, simulate_empty: bool,
-                   assumed_usage: dict) -> int:
-        """How many pods fit in this leaf (fillLeafCounts)."""
+                   assumed_usage: dict,
+                   required_replacement_domain: tuple) -> None:
+        """fillLeafCounts :1864 — pods that fit, plus leader variants."""
+        leaf.state = 0
+        leaf.leader_state = 0
+        leaf.state_with_leader = 0
+        if required_replacement_domain and \
+                leaf.values[:len(required_replacement_domain)] != \
+                required_replacement_domain:
+            return
         if self.is_lowest_level_node:
-            # Taints/selector filtering against the node.
-            tolerations = tuple(pod_set.tolerations) + \
-                self.flavor_tolerations
-            # Leaf nodes carry no taint info here (filtered at add_node
-            # when implemented at cache layer); selector match on values.
             for key, val in pod_set.node_selector.items():
                 if key in self.level_keys:
                     idx = self.level_keys.index(key)
                     if leaf.values[idx] != val:
-                        return 0
-        counts = []
-        for res, need in per_pod.items():
-            if need == 0:
-                continue
-            free = leaf.free_capacity.get(res, 0)
-            if not simulate_empty:
-                free -= leaf.tas_usage.get(res, 0)
-                free -= assumed_usage.get(leaf.id, {}).get(res, 0)
-            if res == "pods" and res not in leaf.free_capacity:
-                continue  # node without explicit pod capacity: unlimited
-            counts.append(max(0, free) // need)
-        return min(counts) if counts else 0
+                        return
+
+        remaining = dict(leaf.free_capacity)
+        if not simulate_empty:
+            for res, used in leaf.tas_usage.items():
+                remaining[res] = remaining.get(res, 0) - used
+            for res, used in assumed_usage.get(leaf.id, {}).items():
+                remaining[res] = remaining.get(res, 0) - used
+
+        def count_in(requests: dict[str, int]) -> int:
+            counts = []
+            for res, need in requests.items():
+                if need == 0:
+                    continue
+                if res == "pods" and res not in leaf.free_capacity:
+                    continue  # no explicit pod capacity: unlimited
+                counts.append(max(0, remaining.get(res, 0)) // need)
+            return min(counts) if counts else 0
+
+        leaf.state = count_in(per_pod)
+        if leader_per_pod is not None and count_in(leader_per_pod) > 0:
+            leaf.leader_state = 1
+            for res, need in leader_per_pod.items():
+                remaining[res] = remaining.get(res, 0) - need
+            leaf.state_with_leader = count_in(per_pod)
+        else:
+            leaf.state_with_leader = leaf.state if leader_per_pod is None \
+                else 0
 
     def _fill_in_counts(self, pod_set: PodSet, per_pod: dict[str, int],
-                        slice_size: int, slice_level_idx: int,
-                        simulate_empty: bool, assumed_usage: dict) -> None:
-        """tas_flavor_snapshot.go:1748 (fillInCounts)."""
+                        leader_per_pod: Optional[dict[str, int]],
+                        state: _AssignState, simulate_empty: bool,
+                        assumed_usage: dict,
+                        required_replacement_domain: tuple = ()) -> None:
+        """fillInCounts :1750."""
         for d in self.domains.values():
             d.state = 0
             d.slice_state = 0
+            d.state_with_leader = 0
+            d.slice_state_with_leader = 0
+            d.leader_state = 0
         for leaf in self.leaves.values():
-            leaf.state = self._leaf_fits(pod_set, per_pod, leaf,
-                                         simulate_empty, assumed_usage)
-        # Bubble up from deepest level.
-        for level in range(len(self.level_keys) - 1, -1, -1):
-            for d in self.domains_per_level[level].values():
-                if d.children:
-                    d.state = sum(c.state for c in d.children)
-                if level == slice_level_idx:
-                    d.slice_state = d.state // slice_size
-                elif level < slice_level_idx:
-                    d.slice_state = sum(c.slice_state for c in d.children)
+            self._leaf_fits(pod_set, per_pod, leader_per_pod, leaf,
+                            simulate_empty, assumed_usage,
+                            required_replacement_domain)
+        for root in self.roots.values():
+            self.bubble_up(root, state.slice_size, state.slice_level_idx,
+                           0, leader_required=state.leader_count > 0)
+
+    def bubble_up(self, domain: _Domain, slice_size: int,
+                  slice_level_idx: int, level: int,
+                  leader_required: bool) -> None:
+        """fillInCountsHelper :1906 — roll child capacities up one subtree.
+        Also used by balanced-placement pruning to re-aggregate clones."""
+        if not domain.children:
+            if level == slice_level_idx:
+                domain.slice_state = domain.state // slice_size
+                domain.slice_state_with_leader = \
+                    domain.state_with_leader // slice_size
+            return
+        children_capacity = 0
+        slice_capacity = 0
+        has_leader_contributor = False
+        min_state_diff = _INF
+        min_slice_diff = _INF
+        leader_state = 0
+        for child in domain.children:
+            self.bubble_up(child, slice_size, slice_level_idx, level + 1,
+                           leader_required)
+            children_capacity += child.state
+            slice_capacity += child.slice_state
+            if not leader_required or child.leader_state > 0:
+                has_leader_contributor = True
+                min_state_diff = min(min_state_diff,
+                                     child.state - child.state_with_leader)
+                min_slice_diff = min(
+                    min_slice_diff,
+                    child.slice_state - child.slice_state_with_leader)
+            leader_state = max(leader_state, child.leader_state)
+        domain.state = children_capacity
+        slice_with_leader = 0
+        if has_leader_contributor:
+            domain.state_with_leader = children_capacity - min_state_diff
+            slice_with_leader = slice_capacity - min_slice_diff
+        else:
+            domain.state_with_leader = 0
+        domain.leader_state = leader_state
+        if level == slice_level_idx:
+            slice_capacity = domain.state // slice_size
+            slice_with_leader = domain.state_with_leader // slice_size
+        domain.slice_state = slice_capacity
+        domain.slice_state_with_leader = slice_with_leader
 
     def _sorted(self, domains: list, unconstrained: bool) -> list:
-        """tas_flavor_snapshot.go:1722 (sortedDomains) — BestFit order."""
+        """sortedDomains :1722 — BestFit order (sliceState desc, state asc,
+        values asc), or LeastFreeCapacity ascending for unconstrained."""
+        if unconstrained:
+            return sorted(domains,
+                          key=lambda d: (d.slice_state, d.state, d.values))
         return sorted(domains,
                       key=lambda d: (-d.slice_state, d.state, d.values))
 
+    def _sorted_with_leader(self, domains: list,
+                            unconstrained: bool) -> list:
+        """sortedDomainsWithLeader :1683 — leader capacity first."""
+        if unconstrained:
+            return sorted(domains, key=lambda d: (
+                -d.leader_state, d.slice_state_with_leader,
+                d.state_with_leader, d.values))
+        return sorted(domains, key=lambda d: (
+            -d.leader_state, -d.slice_state_with_leader,
+            d.state_with_leader, d.values))
+
     def _find_level_with_fit(self, level_idx: int, slice_count: int,
-                             required: bool, unconstrained: bool):
-        """tas_flavor_snapshot.go findLevelWithFitDomains."""
+                             state: _AssignState):
+        """findLevelWithFitDomains :1377."""
         domains = list(self.domains_per_level[level_idx].values()) \
             if self.level_keys else []
         if not domains:
             return 0, [], "no topology domains at level"
-        sorted_domains = self._sorted(domains, unconstrained)
+        sorted_domains = self._sorted_with_leader(domains,
+                                                 state.unconstrained)
         top = sorted_domains[0]
-        if top.slice_state >= slice_count:
-            # Best-fit: the smallest single domain that fits.
-            best = self._best_fit_domain(sorted_domains, slice_count)
+        if not state.unconstrained \
+                and top.slice_state_with_leader >= slice_count \
+                and top.leader_state >= state.leader_count:
+            best = _best_fit_for_slices(sorted_domains, slice_count,
+                                        state.leader_count)
             return level_idx, [best], ""
-        if required:
-            return 0, [], self._not_fit_message(top.slice_state, slice_count)
-        if level_idx > 0 and not unconstrained:
-            return self._find_level_with_fit(level_idx - 1, slice_count,
-                                             required, unconstrained)
-        # Multi-domain greedy at the top (or unconstrained anywhere).
+        if state.unconstrained:
+            # LeastFreeCapacity: the fullest single domain that fits.
+            for d in sorted_domains:
+                if d.slice_state >= slice_count:
+                    return level_idx, [d], ""
+        if top.slice_state_with_leader < slice_count or \
+                top.leader_state < state.leader_count:
+            if state.required:
+                return 0, [], self._not_fit_message(
+                    top.slice_state, slice_count)
+            if level_idx > 0 and not state.unconstrained:
+                return self._find_level_with_fit(level_idx - 1, slice_count,
+                                                 state)
+        # Multi-domain greedy at the top (or unconstrained anywhere):
+        # leaders first (:1430-1469), then remaining workers.
         results = []
         remaining = slice_count
-        for i, d in enumerate(sorted_domains):
+        remaining_leaders = state.leader_count
+        idx = 0
+        while remaining_leaders > 0 and idx < len(sorted_domains) \
+                and sorted_domains[idx].leader_state > 0:
+            d = sorted_domains[idx]
+            if not state.unconstrained and \
+                    d.slice_state_with_leader >= remaining:
+                d = _best_fit_for_slices(sorted_domains[idx:], remaining,
+                                         remaining_leaders)
+            results.append(d)
+            remaining_leaders -= d.leader_state
+            remaining -= d.slice_state_with_leader
+            idx += 1
+        if remaining_leaders > 0:
+            return 0, [], self._not_fit_message(
+                state.leader_count - remaining_leaders, slice_count)
+        rest = self._sorted(sorted_domains[idx:], state.unconstrained)
+        for i, d in enumerate(rest):
             if remaining <= 0:
                 break
-            if d.slice_state >= remaining:
-                results.append(self._best_fit_domain(sorted_domains[i:],
-                                                     remaining))
-                remaining = 0
-                break
+            if d.slice_state <= 0:
+                continue
+            if not state.unconstrained and d.slice_state >= remaining:
+                d = _best_fit_for_slices(rest[i:], remaining, 0)
             results.append(d)
             remaining -= d.slice_state
         if remaining > 0:
@@ -343,46 +763,71 @@ class TASFlavorSnapshot:
                                                 slice_count)
         return level_idx, results, ""
 
-    @staticmethod
-    def _best_fit_domain(sorted_domains: list, slice_count: int):
-        """findBestFitDomainForSlices: among fitting domains, the one with
-        the least leftover capacity (first in sorted order on ties)."""
-        best = None
-        for d in sorted_domains:
-            if d.slice_state >= slice_count and (
-                    best is None or d.slice_state < best.slice_state):
-                best = d
-        return best if best is not None else sorted_domains[0]
-
     def _update_counts_to_minimum(self, sorted_domains: list, count: int,
-                                  slice_size: int,
-                                  use_slices: bool) -> list:
-        """updateCountsToMinimumGeneric: distribute ``count`` pods over a
-        minimal prefix of the sorted domains. ``use_slices`` selects the
-        capacity field (sliceState for whole-slice placement, state for
-        per-pod placement below the slice level)."""
-        def cap(d):
-            return d.slice_state if use_slices else d.state
-
+                                  leader_count: int, slice_size: int,
+                                  unconstrained: bool,
+                                  use_slices: bool) -> Optional[list]:
+        """updateCountsToMinimumGeneric :1575 + consumeWithLeadersGeneric
+        :1510: distribute ``count`` pods (and the leader) over a minimal
+        prefix of the sorted domains, clamping each domain's state to its
+        assigned amount."""
         results = []
         remaining = count // slice_size if use_slices else count
-        unit = slice_size if use_slices else 1
+        remaining_leaders = leader_count
+
+        def primary(d):
+            return d.slice_state if use_slices else d.state
+
+        def primary_with_leader(d):
+            return d.slice_state_with_leader if use_slices \
+                else d.state_with_leader
+
         for i, d in enumerate(sorted_domains):
-            if remaining <= 0:
+            if remaining <= 0 and remaining_leaders <= 0:
                 break
-            if cap(d) >= remaining:
-                best = d
-                for cand in sorted_domains[i:]:
-                    if remaining <= cap(cand) <= cap(best):
-                        best = cand
-                best.state = remaining * unit
-                best.slice_state = remaining if use_slices else 0
-                results.append(best)
-                remaining = 0
-                break
-            d.state = cap(d) * unit
-            remaining -= cap(d)
+            if remaining_leaders > 0:
+                if not unconstrained \
+                        and primary_with_leader(d) >= remaining \
+                        and d.leader_state >= remaining_leaders:
+                    d = (_best_fit_for_slices if use_slices
+                         else _best_fit_for_pods)(
+                        sorted_domains[i:], remaining, remaining_leaders)
+                take = primary_with_leader(d)
+                if take >= remaining and d.leader_state >= remaining_leaders:
+                    d.leader_state = remaining_leaders
+                    d.state = remaining * slice_size if use_slices \
+                        else remaining
+                    if use_slices:
+                        d.slice_state = remaining
+                    results.append(d)
+                    return results
+                take = min(take, remaining)
+                d.leader_state = min(d.leader_state, remaining_leaders)
+                d.state = take * slice_size if use_slices else take
+                if use_slices:
+                    d.slice_state = take
+                remaining_leaders -= d.leader_state
+                remaining -= take
+                results.append(d)
+                continue
+            d.leader_state = 0
+            if not unconstrained and primary(d) >= remaining:
+                d = (_best_fit_for_slices if use_slices
+                     else _best_fit_for_pods)(sorted_domains[i:],
+                                              remaining, 0)
+                d.leader_state = 0
+            take = primary(d)
+            if take >= remaining:
+                d.state = remaining * slice_size if use_slices else remaining
+                if use_slices:
+                    d.slice_state = remaining
+                results.append(d)
+                return results
+            d.state = take * slice_size if use_slices else take
+            remaining -= take
             results.append(d)
+        if remaining > 0 or remaining_leaders > 0:
+            return None  # accounting violated upstream
         return results
 
     def _not_fit_message(self, fit: int, want: int) -> str:
@@ -392,3 +837,95 @@ class TASFlavorSnapshot:
                 self.topology_name
         return (f"topology {self.topology_name!r} allows to fit only "
                 f"{fit} out of {want} slice(s)/pod(s)")
+
+
+def _best_fit_for_slices(sorted_domains: list, slice_count: int,
+                         leader_count: int):
+    """findBestFitDomainForSlices: among fitting domains, the one with the
+    least leftover slice capacity (first in sorted order on ties)."""
+    def cap(d):
+        return d.slice_state_with_leader if leader_count > 0 \
+            else d.slice_state
+
+    best = None
+    for d in sorted_domains:
+        if cap(d) >= slice_count and d.leader_state >= leader_count and (
+                best is None or cap(d) < cap(best)):
+            best = d
+    return best if best is not None else sorted_domains[0]
+
+
+def _best_fit_for_pods(sorted_domains: list, count: int, leader_count: int):
+    """findBestFitDomain — pod-count flavor of the above."""
+    def cap(d):
+        return d.state_with_leader if leader_count > 0 else d.state
+
+    best = None
+    for d in sorted_domains:
+        if cap(d) >= count and d.leader_state >= leader_count and (
+                best is None or cap(d) < cap(best)):
+            best = d
+    return best if best is not None else sorted_domains[0]
+
+
+def _find_leader_and_workers(trs: list[TASPodSetRequest]):
+    """findLeaderAndWorkers :729 — in a 2-pod-set group the smaller-count
+    pod set is the leader."""
+    workers = trs[0]
+    leader = None
+    if len(trs) > 1:
+        leader = trs[1]
+        if leader.count > workers.count:
+            leader, workers = workers, leader
+    return leader, workers
+
+
+def _existing_assignment(workload, pod_set_name: str):
+    """findPSA :810."""
+    status = getattr(workload, "status", None)
+    admission = getattr(status, "admission", None)
+    if admission is None:
+        return None
+    for psa in admission.pod_set_assignments:
+        if psa.name == pod_set_name and psa.topology_assignment is not None:
+            return psa.topology_assignment
+    return None
+
+
+def _delete_domains(assignment: TopologyAssignment,
+                    unhealthy_nodes) -> tuple[TopologyAssignment, int]:
+    """deleteDomain :884 — drop the domains whose leaf value is an
+    unhealthy node; return (kept, affected_pod_count)."""
+    failed = set(unhealthy_nodes)
+    kept = []
+    affected = 0
+    for dom in assignment.domains:
+        if dom.values[-1] in failed:
+            affected += dom.count
+        else:
+            kept.append(dom)
+    return TopologyAssignment(assignment.levels, tuple(kept)), affected
+
+
+def _merge_assignments(repl: TopologyAssignment,
+                       kept: TopologyAssignment) -> TopologyAssignment:
+    """mergeTopologyAssignments — sum counts per domain, lex order."""
+    counts: dict[tuple, int] = {}
+    for dom in list(kept.domains) + list(repl.domains):
+        counts[tuple(dom.values)] = counts.get(tuple(dom.values), 0) \
+            + dom.count
+    return TopologyAssignment(kept.levels, tuple(
+        TopologyDomainAssignment(values, count)
+        for values, count in sorted(counts.items())))
+
+
+def _add_assumed(assumed: dict, assignment: TopologyAssignment,
+                 tr: TASPodSetRequest) -> None:
+    """addAssumedUsage :799."""
+    if assignment is None:
+        return
+    for dom in assignment.domains:
+        bucket = assumed.setdefault(tuple(dom.values), {})
+        for res, per_pod in tr.single_pod_requests.items():
+            bucket[res] = bucket.get(res, 0) + per_pod * dom.count
+        bucket["pods"] = bucket.get("pods", 0) + dom.count

@@ -1,0 +1,341 @@
+"""TAS extended surfaces: leader+workers co-placement, balanced
+placement, unconstrained least-free-capacity, unhealthy-node replacement
+(second pass), and the topology ungater."""
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PodSetTopologyRequest,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Topology,
+    TopologyLevel,
+    TopologyMode,
+    Workload,
+)
+from kueue_tpu.config import features
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.controllers.tas_nodes import NodeHealthController
+from kueue_tpu.tas.snapshot import (
+    HOSTNAME_LABEL,
+    Node,
+    TASFlavorSnapshot,
+    TASPodSetRequest,
+    TopologyAssignment,
+    TopologyDomainAssignment,
+)
+from kueue_tpu.tas.ungater import PodStub, assign_pods_to_domains
+
+CPU = "cpu"
+
+TOPOLOGY = Topology("topo", (
+    TopologyLevel("block"), TopologyLevel("rack"),
+    TopologyLevel(HOSTNAME_LABEL)))
+
+
+@pytest.fixture(autouse=True)
+def reset_features():
+    yield
+    features.reset()
+
+
+def snap_with_nodes(node_cpu_by_name):
+    snap = TASFlavorSnapshot(TOPOLOGY)
+    for name, cpu in node_cpu_by_name.items():
+        block, rack, _ = name.split("-")
+        snap.add_node(Node(
+            name=name,
+            labels={"block": block, "rack": f"{block}{rack}",
+                    HOSTNAME_LABEL: name},
+            capacity={CPU: cpu, "pods": 100}))
+    return snap
+
+
+def ps(name, count, cpu=1000, mode=TopologyMode.PREFERRED, level="rack",
+       group=None, slice_size=None, slice_level=None):
+    return PodSet(name, count, {CPU: cpu},
+                  topology_request=PodSetTopologyRequest(
+                      mode=mode, level=level, pod_set_group_name=group,
+                      slice_size=slice_size, slice_level=slice_level))
+
+
+def test_leader_placed_with_workers():
+    """findLeaderAndWorkers (tas_flavor_snapshot.go:729): the leader pod
+    lands in a domain co-selected with the workers."""
+    snap = snap_with_nodes({
+        "b0-r0-h0": 4000, "b0-r0-h1": 4000,
+        "b0-r1-h0": 4000, "b0-r1-h1": 4000})
+    workers = TASPodSetRequest(
+        ps("workers", 7, group="g"), {CPU: 1000}, 7)
+    leader = TASPodSetRequest(
+        ps("leader", 1, group="g"), {CPU: 1000}, 1)
+    results, reason = snap.find_topology_assignments_for_flavor(
+        [workers, leader])
+    assert reason == ""
+    worker_ta = results["workers"]
+    leader_ta = results["leader"]
+    assert sum(d.count for d in worker_ta.domains) == 7
+    assert sum(d.count for d in leader_ta.domains) == 1
+    # Leader + its rack's workers share capacity: total per node <= 4.
+    per_node = {}
+    for ta in (worker_ta, leader_ta):
+        for d in ta.domains:
+            per_node[d.values] = per_node.get(d.values, 0) + d.count
+    assert all(v <= 4 for v in per_node.values())
+    # The leader shares a rack with workers (same domain set).
+    leader_racks = {d.values[1] for d in leader_ta.domains}
+    worker_racks = {d.values[1] for d in worker_ta.domains}
+    assert leader_racks <= worker_racks
+
+
+def test_group_without_leader_unaffected():
+    snap = snap_with_nodes({"b0-r0-h0": 4000})
+    workers = TASPodSetRequest(ps("main", 4), {CPU: 1000}, 4)
+    results, reason = snap.find_topology_assignments_for_flavor([workers])
+    assert reason == ""
+    assert sum(d.count for d in results["main"].domains) == 4
+
+
+def test_balanced_placement_spreads_evenly():
+    """tas_balanced_placement.go: preferred-mode placement spreads slices
+    at the balance threshold instead of best-fit packing."""
+    nodes = {"b0-r0-h0": 6000, "b0-r1-h0": 6000}
+    # Best-fit would pack 6 + 2; balanced spreads 4 + 4.
+    features.set_feature("TASBalancedPlacement", True)
+    snap = snap_with_nodes(nodes)
+    req = TASPodSetRequest(ps("main", 8, mode=TopologyMode.PREFERRED,
+                              level="rack"), {CPU: 1000}, 8)
+    ta, reason = snap.find_topology_assignment(req)
+    assert reason == ""
+    counts = sorted(d.count for d in ta.domains)
+    assert counts == [4, 4]
+
+    features.set_feature("TASBalancedPlacement", False)
+    snap2 = snap_with_nodes(nodes)
+    ta2, reason2 = snap2.find_topology_assignment(req)
+    assert reason2 == ""
+    assert sorted(d.count for d in ta2.domains) == [2, 6]
+
+
+def test_balanced_placement_falls_back_when_impossible():
+    features.set_feature("TASBalancedPlacement", True)
+    snap = snap_with_nodes({"b0-r0-h0": 8000})
+    req = TASPodSetRequest(ps("main", 8, mode=TopologyMode.PREFERRED,
+                              level="rack"), {CPU: 1000}, 8)
+    ta, reason = snap.find_topology_assignment(req)
+    assert reason == ""
+    assert sum(d.count for d in ta.domains) == 8
+
+
+def test_unconstrained_uses_least_free_capacity():
+    """sortedDomains (tas_flavor_snapshot.go:1722): unconstrained requests
+    fill the fullest domain that still fits, preserving big holes."""
+    snap = snap_with_nodes({"b0-r0-h0": 2000, "b0-r1-h0": 8000})
+    req = TASPodSetRequest(
+        ps("main", 2, mode=TopologyMode.UNCONSTRAINED, level=None),
+        {CPU: 1000}, 2)
+    ta, reason = snap.find_topology_assignment(req)
+    assert reason == ""
+    assert [d.values[-1] for d in ta.domains] == ["b0-r0-h0"]
+
+
+def test_leader_descent_when_largest_child_cannot_host_leader():
+    """Regression: the leader needs a resource only the smaller host has
+    (gpu); descent must order leader-capable domains first instead of
+    skipping the big worker-only host (or crashing on underflow)."""
+    snap = TASFlavorSnapshot(Topology("t", (
+        TopologyLevel("rack"), TopologyLevel(HOSTNAME_LABEL))))
+    snap.add_node(Node("hostA", labels={"rack": "r0",
+                                        HOSTNAME_LABEL: "hostA"},
+                       capacity={CPU: 20000, "pods": 100}))
+    snap.add_node(Node("hostB", labels={"rack": "r0",
+                                        HOSTNAME_LABEL: "hostB"},
+                       capacity={CPU: 5000, "gpu": 1, "pods": 100}))
+    workers = TASPodSetRequest(
+        ps("workers", 24, mode=TopologyMode.REQUIRED, level="rack",
+           group="g"), {CPU: 1000}, 24)
+    leader = TASPodSetRequest(
+        ps("leader", 1, mode=TopologyMode.REQUIRED, level="rack",
+           group="g"), {CPU: 1000, "gpu": 1}, 1)
+    results, reason = snap.find_topology_assignments_for_flavor(
+        [workers, leader])
+    assert reason == ""
+    assert sum(d.count for d in results["workers"].domains) == 24
+    assert [d.values[-1] for d in results["leader"].domains] == ["hostB"]
+
+
+def test_leader_descent_infeasible_returns_reason_not_crash():
+    snap = TASFlavorSnapshot(Topology("t", (
+        TopologyLevel("rack"), TopologyLevel(HOSTNAME_LABEL))))
+    snap.add_node(Node("hostA", labels={"rack": "r0",
+                                        HOSTNAME_LABEL: "hostA"},
+                       capacity={CPU: 2000, "pods": 100}))
+    workers = TASPodSetRequest(
+        ps("workers", 8, mode=TopologyMode.REQUIRED, level="rack",
+           group="g"), {CPU: 1000}, 8)
+    leader = TASPodSetRequest(
+        ps("leader", 1, mode=TopologyMode.REQUIRED, level="rack",
+           group="g"), {CPU: 1000, "gpu": 1}, 1)
+    results, reason = snap.find_topology_assignments_for_flavor(
+        [workers, leader])
+    assert reason != ""
+
+
+# -- unhealthy-node replacement through the engine --
+
+def make_engine():
+    eng = Engine()
+    eng.create_topology(Topology("tas-topo", (
+        TopologyLevel("block"), TopologyLevel("rack"),
+        TopologyLevel(HOSTNAME_LABEL))))
+    eng.create_resource_flavor(ResourceFlavor(
+        "tas-flavor", node_labels={"pool": "tas"},
+        topology_name="tas-topo"))
+    for b in range(2):
+        for r in range(2):
+            for h in range(2):
+                name = f"b{b}-r{r}-h{h}"
+                eng.create_node(Node(
+                    name=name,
+                    labels={"pool": "tas", "block": f"b{b}",
+                            "rack": f"b{b}r{r}", HOSTNAME_LABEL: name},
+                    capacity={CPU: 4000, "pods": 100}))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("tas-flavor", {CPU: ResourceQuota(32000)}),)),),
+    ))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng
+
+
+def admitted_nodes(wl):
+    ta = wl.status.admission.pod_set_assignments[0].topology_assignment
+    return {d.values[-1]: d.count for d in ta.domains}
+
+
+def test_node_replacement_keeps_healthy_domains():
+    eng = make_engine()
+    w = Workload(name="gang", queue_name="lq", pod_sets=(PodSet(
+        "main", 8, {CPU: 1000},
+        topology_request=PodSetTopologyRequest(
+            mode=TopologyMode.PREFERRED, level="rack")),))
+    eng.submit(w)
+    eng.schedule_once()
+    assert w.is_admitted
+    before = admitted_nodes(w)
+    failed = next(iter(before))
+    kept = {n: c for n, c in before.items() if n != failed}
+
+    eng.mark_node_unhealthy(failed, reason="NodeDeleted")
+    assert w.status.unhealthy_nodes == (failed,)
+    eng.schedule_once()
+
+    assert w.status.unhealthy_nodes == ()
+    after = admitted_nodes(w)
+    assert failed not in after
+    assert sum(after.values()) == 8
+    for node, count in kept.items():
+        assert after[node] >= count  # healthy domains untouched or topped
+
+
+def test_two_node_failures_replaced_together():
+    """Regression: a second dead node must not trip the staleness check
+    forever — all unhealthy nodes are replaced in one pass."""
+    eng = make_engine()
+    w = Workload(name="gang", queue_name="lq", pod_sets=(PodSet(
+        "main", 8, {CPU: 1000},
+        topology_request=PodSetTopologyRequest(
+            mode=TopologyMode.PREFERRED, level="rack")),))
+    eng.submit(w)
+    eng.schedule_once()
+    assert w.is_admitted
+    before = list(admitted_nodes(w))
+    eng.mark_node_unhealthy(before[0], reason="NodeDeleted")
+    eng.mark_node_unhealthy(before[1], reason="NodeDeleted")
+    assert set(w.status.unhealthy_nodes) == {before[0], before[1]}
+    eng.schedule_once()
+    assert w.status.unhealthy_nodes == ()
+    after = admitted_nodes(w)
+    assert before[0] not in after and before[1] not in after
+    assert sum(after.values()) == 8
+
+
+def test_node_replacement_fail_fast_evicts():
+    features.set_feature("TASFailedNodeReplacementFailFast", True)
+    eng = make_engine()
+    # Fill the whole pool so no replacement capacity exists.
+    w = Workload(name="gang", queue_name="lq", pod_sets=(PodSet(
+        "main", 32, {CPU: 1000},
+        topology_request=PodSetTopologyRequest(
+            mode=TopologyMode.PREFERRED, level="block")),))
+    eng.submit(w)
+    eng.schedule_once()
+    assert w.is_admitted
+    failed = next(iter(admitted_nodes(w)))
+    eng.mark_node_unhealthy(failed, reason="PodTerminated")
+    eng.schedule_once()
+    assert not w.is_admitted
+    assert any(e.kind == "Evicted" for e in eng.events)
+
+
+def test_node_health_controller_not_ready_window():
+    features.set_feature("TASReplaceNodeNotReadyOverFixedTime", True)
+    eng = make_engine()
+    w = Workload(name="gang", queue_name="lq", pod_sets=(PodSet(
+        "main", 4, {CPU: 1000},
+        topology_request=PodSetTopologyRequest(
+            mode=TopologyMode.PREFERRED, level="rack")),))
+    eng.submit(w)
+    eng.schedule_once()
+    assert w.is_admitted
+    failed = next(iter(admitted_nodes(w)))
+
+    ctl = NodeHealthController(eng)
+    ctl.node_not_ready(failed, now=0.0)
+    ctl.tick(now=10.0)
+    assert w.status.unhealthy_nodes == ()  # within the window
+    ctl.tick(now=40.0)
+    assert w.status.unhealthy_nodes == (failed,)
+
+
+# -- ungater --
+
+ASSIGNMENT = TopologyAssignment(
+    ("block", "rack", HOSTNAME_LABEL),
+    (TopologyDomainAssignment(("b0", "b0r0", "h0"), 2),
+     TopologyDomainAssignment(("b0", "b0r1", "h1"), 1)))
+
+
+def test_ungater_by_rank():
+    pods = [PodStub(f"p{i}", labels={"rank": str(i)}) for i in (2, 0, 1)]
+    out = assign_pods_to_domains(ASSIGNMENT, pods, pod_index_label="rank")
+    by_pod = {p.name: dom for p, dom in out}
+    assert by_pod["p0"][-1] == "h0"
+    assert by_pod["p1"][-1] == "h0"
+    assert by_pod["p2"][-1] == "h1"
+
+
+def test_ungater_greedy_accounts_running_pods():
+    pods = [
+        PodStub("running", gated=False,
+                domain_values=("b0", "b0r0", "h0")),
+        PodStub("g1"), PodStub("g2"),
+    ]
+    out = assign_pods_to_domains(ASSIGNMENT, pods)
+    domains = [dom[-1] for _, dom in out]
+    assert sorted(domains) == ["h0", "h1"]  # h0 has room for 1 more
+
+
+def test_ungater_bad_ranks_falls_back_to_greedy():
+    pods = [PodStub("p0", labels={"rank": "7"}),  # out of range
+            PodStub("p1", labels={"rank": "1"}),
+            PodStub("p2", labels={"rank": "2"})]
+    out = assign_pods_to_domains(ASSIGNMENT, pods, pod_index_label="rank")
+    assert len(out) == 3

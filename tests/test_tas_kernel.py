@@ -69,8 +69,14 @@ def test_phase1_counts_match_sequential(seed):
     ps = PodSet("main", 8, {"cpu": per_pod_cpu}, topology_request=tr)
     per_pod = {"cpu": per_pod_cpu, "pods": 1}
     eff_slice_level = slice_level_idx if slice_size > 1 else 2
-    snap._fill_in_counts(ps, per_pod, slice_size, eff_slice_level,
-                         False, {})
+    from kueue_tpu.tas.snapshot import _AssignState
+    snap._fill_in_counts(
+        ps, per_pod, None,
+        _AssignState(count=8, slice_size=slice_size,
+                     requested_level_idx=0,
+                     slice_level_idx=eff_slice_level, required=True,
+                     unconstrained=False),
+        False, {})
 
     # Batched.
     enc = encode_tas_snapshot(snap, RESOURCES)
