@@ -362,6 +362,9 @@ class WorkloadStatus:
     # TAS node replacement (workload_types.go:766): names of failed nodes
     # whose domains need re-placement (tas/node_controller.go).
     unhealthy_nodes: tuple[str, ...] = ()
+    # Pods no longer needed per pod set (workload_types.go:874
+    # reclaimablePods): frees their quota while the workload runs.
+    reclaimable_pods: dict[str, int] = field(default_factory=dict)
 
 
 _uid_counter = itertools.count(1)

@@ -1,13 +1,20 @@
 """Additional job integrations on the GenericJob contract.
 
 Reference: pkg/controller/jobs/* — 15 adapters. Beyond BatchJob and
-JobSetJob (jobframework.py), these cover the common framework shapes:
+JobSetJob (jobframework.py), these cover:
   * TrainingJob — Kubeflow TFJob/PyTorchJob/XGBoost/Paddle/JAXJob style
     (named replica specs, a master/chief plus workers);
-  * RayClusterJob — head + worker groups;
-  * PodJob — a single plain pod (scheduling-gate based in the reference);
-  * ServingJob — Deployment/StatefulSet style (no completion; runs until
-    deleted).
+  * TrainJobV2 — Kubeflow TrainJob (trainer + optional initializer);
+  * MPIJob — launcher + workers;
+  * RayClusterJob / RayJob / RayServiceJob — head + worker groups, with
+    the job/serving lifecycles on top;
+  * AppWrapperJob — a wrapper over heterogeneous components;
+  * LeaderWorkerSetJob — replicated leader+workers groups, co-placed via
+    the TAS pod-set group (the leader rides with its workers);
+  * PodJob / PodGroup — plain pods with scheduling-gate semantics;
+    PodGroup composes N pods into one gang Workload (ComposableJob);
+  * StatefulSetJob / DeploymentJob — serving shapes (never finish);
+  * SparkApplicationJob — driver + executors.
 Each is a thin shape over pod sets; the jobframework reconciler owns the
 Workload lifecycle for all of them identically.
 """
@@ -98,17 +105,279 @@ class RayClusterJob(_BaseJob):
 
 
 @dataclass
+class MPIJob(_BaseJob):
+    """MPI launcher + workers (pkg/controller/jobs/mpijob)."""
+
+    launcher_requests: dict = field(default_factory=dict)
+    worker_replicas: int = 1
+    worker_requests: dict = field(default_factory=dict)
+    run_launcher_as_worker: bool = False
+    topology_request: Optional[PodSetTopologyRequest] = None
+
+    def pod_sets(self) -> list[PodSet]:
+        out = []
+        if not self.run_launcher_as_worker:
+            out.append(PodSet(name="launcher", count=1,
+                              requests=dict(self.launcher_requests)))
+        out.append(PodSet(name="worker", count=self.worker_replicas,
+                          requests=dict(self.worker_requests),
+                          topology_request=self.topology_request))
+        return out
+
+
+@dataclass
+class TrainJobV2(_BaseJob):
+    """Kubeflow TrainJob v2 (pkg/controller/jobs/trainjob): trainer nodes
+    plus an optional dataset/model initializer."""
+
+    num_nodes: int = 1
+    trainer_requests: dict = field(default_factory=dict)
+    initializer_requests: Optional[dict] = None
+    topology_request: Optional[PodSetTopologyRequest] = None
+
+    def pod_sets(self) -> list[PodSet]:
+        out = []
+        if self.initializer_requests is not None:
+            out.append(PodSet(name="initializer", count=1,
+                              requests=dict(self.initializer_requests)))
+        out.append(PodSet(name="node", count=self.num_nodes,
+                          requests=dict(self.trainer_requests),
+                          topology_request=self.topology_request))
+        return out
+
+
+@dataclass
+class RayJob(_BaseJob):
+    """RayJob: a batch job over an ephemeral Ray cluster
+    (pkg/controller/jobs/rayjob): optional submitter pod + head +
+    worker groups; finishes when the job completes."""
+
+    submitter_requests: Optional[dict] = None
+    head_requests: dict = field(default_factory=dict)
+    worker_groups: list = field(default_factory=list)  # (name, n, requests)
+
+    def pod_sets(self) -> list[PodSet]:
+        out = []
+        if self.submitter_requests is not None:
+            out.append(PodSet(name="submitter", count=1,
+                              requests=dict(self.submitter_requests)))
+        out.append(PodSet(name="head", count=1,
+                          requests=dict(self.head_requests)))
+        for gname, replicas, requests in self.worker_groups:
+            out.append(PodSet(name=gname, count=replicas,
+                              requests=dict(requests)))
+        return out
+
+
+@dataclass
+class RayServiceJob(_BaseJob):
+    """RayService: a serving Ray cluster
+    (pkg/controller/jobs/rayservice) — admission-managed, never
+    finishes."""
+
+    head_requests: dict = field(default_factory=dict)
+    worker_groups: list = field(default_factory=list)
+
+    def pod_sets(self) -> list[PodSet]:
+        out = [PodSet(name="head", count=1,
+                      requests=dict(self.head_requests))]
+        for gname, replicas, requests in self.worker_groups:
+            out.append(PodSet(name=gname, count=replicas,
+                              requests=dict(requests)))
+        return out
+
+    def finished(self) -> tuple[bool, bool]:
+        return False, False
+
+
+@dataclass
+class AppWrapperJob(_BaseJob):
+    """AppWrapper (pkg/controller/jobs/appwrapper): wraps heterogeneous
+    components, each contributing its pod sets."""
+
+    # components: list of (name, replicas, per-pod requests)
+    components: list = field(default_factory=list)
+
+    def pod_sets(self) -> list[PodSet]:
+        return [PodSet(name=cname, count=replicas, requests=dict(requests))
+                for cname, replicas, requests in self.components]
+
+
+@dataclass
+class LeaderWorkerSetJob(_BaseJob):
+    """LeaderWorkerSet (pkg/controller/jobs/leaderworkerset): N replicated
+    groups of 1 leader + (size-1) workers. Leader and workers of a group
+    are co-placed via the TAS pod-set group
+    (findLeaderAndWorkers, tas_flavor_snapshot.go:729)."""
+
+    replicas: int = 1  # number of groups
+    size: int = 2  # pods per group incl. leader
+    leader_requests: dict = field(default_factory=dict)
+    worker_requests: dict = field(default_factory=dict)
+    topology_request: Optional[PodSetTopologyRequest] = None
+
+    def pod_sets(self) -> list[PodSet]:
+        from dataclasses import replace as _replace
+        out = []
+        for g in range(self.replicas):
+            tr = self.topology_request or PodSetTopologyRequest()
+            tr = _replace(tr, pod_set_group_name=f"group-{g}")
+            out.append(PodSet(name=f"leader-{g}", count=1,
+                              requests=dict(self.leader_requests),
+                              topology_request=tr))
+            if self.size > 1:
+                out.append(PodSet(name=f"workers-{g}",
+                                  count=self.size - 1,
+                                  requests=dict(self.worker_requests),
+                                  topology_request=tr))
+        return out
+
+    def finished(self) -> tuple[bool, bool]:
+        return False, False  # serving semantics
+
+
+@dataclass
 class PodJob(_BaseJob):
-    """A plain pod (pkg/controller/jobs/pod, scheduling gates)."""
+    """A plain pod (pkg/controller/jobs/pod): starts behind a scheduling
+    gate; admission ungates it."""
 
     requests: dict = field(default_factory=dict)
     pod_group: Optional[str] = None
     group_total_count: int = 1
+    gated: bool = True
 
     def pod_sets(self) -> list[PodSet]:
         return [PodSet(name=self.pod_group or "main",
                        count=self.group_total_count,
                        requests=dict(self.requests))]
+
+    def run_with_pod_sets_info(self, infos) -> None:
+        super().run_with_pod_sets_info(infos)
+        self.gated = False  # gate removed on admission
+
+    def suspend(self) -> None:
+        super().suspend()
+        self.gated = True
+
+
+class PodGroup:
+    """Pod groups (pkg/controller/jobs/pod pod-group mode, ComposableJob):
+    pods sharing a group name compose into ONE gang Workload with one pod
+    set per distinct shape; the Workload is created only when all
+    ``group_total_count`` pods exist."""
+
+    def __init__(self, name: str, namespace: str = "default",
+                 queue_name: str = "", total_count: int = 1):
+        self.name = name
+        self.namespace = namespace
+        self.queue_name = queue_name
+        self.total_count = total_count
+        self.pods: list[PodJob] = []
+        self.suspended = True
+        self.active = False
+        self.injected_info = None
+        self.priority = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def add_pod(self, pod: PodJob) -> None:
+        self.pods.append(pod)
+
+    def complete(self) -> bool:
+        return len(self.pods) >= self.total_count
+
+    def pod_sets(self) -> list[PodSet]:
+        # One pod set per distinct resource shape (pod/pod_controller.go
+        # constructGroupPodSets).
+        shapes: dict[tuple, list[PodJob]] = {}
+        for pod in self.pods:
+            shape = tuple(sorted(pod.requests.items()))
+            shapes.setdefault(shape, []).append(pod)
+        out = []
+        for i, (shape, pods) in enumerate(sorted(shapes.items())):
+            out.append(PodSet(name=f"shape-{i}", count=len(pods),
+                              requests=dict(shape)))
+        return out
+
+    def is_suspended(self) -> bool:
+        return self.suspended
+
+    def suspend(self) -> None:
+        self.suspended = True
+        self.active = False
+        for pod in self.pods:
+            pod.gated = True
+
+    def run_with_pod_sets_info(self, infos) -> None:
+        self.injected_info = infos
+        self.suspended = False
+        self.active = True
+        for pod in self.pods:
+            pod.gated = False
+
+    def restore_pod_sets_info(self, infos) -> None:
+        self.injected_info = None
+
+    def is_active(self) -> bool:
+        return self.active
+
+    def finished(self) -> tuple[bool, bool]:
+        if self.pods and all(p.done for p in self.pods):
+            return True, all(p.success for p in self.pods)
+        return False, False
+
+
+@dataclass
+class StatefulSetJob(_BaseJob):
+    """StatefulSet (pkg/controller/jobs/statefulset): serving pods behind
+    gates; scale-ups flow through workload slices."""
+
+    replicas: int = 1
+    requests: dict = field(default_factory=dict)
+
+    def pod_sets(self) -> list[PodSet]:
+        return [PodSet(name="pods", count=self.replicas,
+                       requests=dict(self.requests))]
+
+    def finished(self) -> tuple[bool, bool]:
+        return False, False
+
+
+@dataclass
+class DeploymentJob(_BaseJob):
+    """Deployment (pkg/controller/jobs/deployment): each replica is
+    admitted independently in the reference; modeled as one pod set with
+    per-replica pods."""
+
+    replicas: int = 1
+    requests: dict = field(default_factory=dict)
+
+    def pod_sets(self) -> list[PodSet]:
+        return [PodSet(name="pods", count=self.replicas,
+                       requests=dict(self.requests))]
+
+    def finished(self) -> tuple[bool, bool]:
+        return False, False
+
+
+@dataclass
+class SparkApplicationJob(_BaseJob):
+    """SparkApplication (pkg/controller/jobs/sparkapplication): driver +
+    executors."""
+
+    driver_requests: dict = field(default_factory=dict)
+    executor_instances: int = 1
+    executor_requests: dict = field(default_factory=dict)
+
+    def pod_sets(self) -> list[PodSet]:
+        return [
+            PodSet(name="driver", count=1,
+                   requests=dict(self.driver_requests)),
+            PodSet(name="executor", count=self.executor_instances,
+                   requests=dict(self.executor_requests)),
+        ]
 
 
 @dataclass
@@ -128,6 +397,19 @@ class ServingJob(_BaseJob):
 
 
 DEFAULT_INTEGRATIONS.register("kubeflow.org/trainingjob", TrainingJob)
+DEFAULT_INTEGRATIONS.register("kubeflow.org/trainjob", TrainJobV2)
+DEFAULT_INTEGRATIONS.register("kubeflow.org/mpijob", MPIJob)
 DEFAULT_INTEGRATIONS.register("ray.io/raycluster", RayClusterJob)
+DEFAULT_INTEGRATIONS.register("ray.io/rayjob", RayJob)
+DEFAULT_INTEGRATIONS.register("ray.io/rayservice", RayServiceJob)
+DEFAULT_INTEGRATIONS.register("workload.codeflare.dev/appwrapper",
+                              AppWrapperJob)
+DEFAULT_INTEGRATIONS.register("leaderworkerset.x-k8s.io/leaderworkerset",
+                              LeaderWorkerSetJob)
 DEFAULT_INTEGRATIONS.register("core/pod", PodJob)
+DEFAULT_INTEGRATIONS.register("core/podgroup", PodGroup)
+DEFAULT_INTEGRATIONS.register("apps/statefulset", StatefulSetJob)
+DEFAULT_INTEGRATIONS.register("apps/deployment", DeploymentJob)
+DEFAULT_INTEGRATIONS.register("sparkoperator.k8s.io/sparkapplication",
+                              SparkApplicationJob)
 DEFAULT_INTEGRATIONS.register("apps/serving", ServingJob)

@@ -113,6 +113,11 @@ class BatchJob:
     def is_active(self) -> bool:
         return self.active_pods > 0
 
+    def reclaimable_pods(self) -> dict[str, int]:
+        """JobWithReclaimablePods: succeeded pods won't be re-created
+        (jobs/job/job_controller.go ReclaimablePods)."""
+        return {"main": min(self.succeeded, self.parallelism)}
+
     def finished(self) -> tuple[bool, bool]:
         target = self.completions if self.completions is not None \
             else self.parallelism
@@ -244,6 +249,8 @@ class JobReconciler:
         """One ReconcileGenericJob pass."""
         if not job.queue_name and not self.manage_all:
             return  # queue-name management gating (reconciler.go:313-377)
+        if getattr(job, "complete", None) is not None and not job.complete():
+            return  # ComposableJob: wait for the whole group to exist
         wl = self._ensure_one_workload(job)
         if wl is None:
             return
@@ -262,6 +269,22 @@ class JobReconciler:
             # stopJob on eviction (reconciler.go:379-394).
             job.suspend()
             job.restore_pod_sets_info([])
+        self._sync_reclaimable(job, wl)
+
+    def _sync_reclaimable(self, job: GenericJob, wl: Workload) -> None:
+        """JobWithReclaimablePods (interface.go): pods the job no longer
+        needs release their quota share while the workload runs."""
+        getter = getattr(job, "reclaimable_pods", None)
+        if getter is None:
+            return
+        reclaimable = {k: v for k, v in getter().items() if v > 0}
+        if reclaimable == wl.status.reclaimable_pods:
+            return
+        wl.status.reclaimable_pods = reclaimable
+        if wl.status.admission is not None:
+            self.engine.cache.add_or_update_workload(wl)
+            self.engine._requeue_cohort_inadmissible(
+                wl.status.admission.cluster_queue)
 
     def reconcile_all(self) -> None:
         for job in list(self.jobs.values()):

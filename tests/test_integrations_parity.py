@@ -1,0 +1,188 @@
+"""Extended job integrations: MPI, LeaderWorkerSet (TAS co-placement),
+pod groups (composable gang), Spark, AppWrapper, TrainJob v2, and
+reclaimable pods."""
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSetTopologyRequest,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Topology,
+    TopologyLevel,
+    TopologyMode,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.controllers.integrations import (
+    AppWrapperJob,
+    DeploymentJob,
+    LeaderWorkerSetJob,
+    MPIJob,
+    PodGroup,
+    PodJob,
+    RayJob,
+    SparkApplicationJob,
+    StatefulSetJob,
+    TrainJobV2,
+)
+from kueue_tpu.controllers.jobframework import BatchJob, JobReconciler
+from kueue_tpu.tas.snapshot import HOSTNAME_LABEL, Node
+
+CPU = "cpu"
+
+
+def make_stack(nominal=32000, tas=False):
+    eng = Engine()
+    if tas:
+        eng.create_topology(Topology("dc", (
+            TopologyLevel("rack"), TopologyLevel(HOSTNAME_LABEL))))
+        eng.create_resource_flavor(ResourceFlavor(
+            "default", node_labels={"pool": "main"}, topology_name="dc"))
+        for r in range(2):
+            for h in range(2):
+                name = f"r{r}-h{h}"
+                eng.create_node(Node(
+                    name=name,
+                    labels={"pool": "main", "rack": f"r{r}",
+                            HOSTNAME_LABEL: name},
+                    capacity={CPU: 8000, "pods": 100}))
+    else:
+        eng.create_resource_flavor(ResourceFlavor(
+            "default", node_labels={"pool": "main"}))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(nominal)}),)),),
+    ))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    rec = JobReconciler(eng)
+    return eng, rec
+
+
+def test_mpi_job_launcher_and_workers():
+    eng, rec = make_stack()
+    job = MPIJob(name="mpi", queue_name="lq",
+                 launcher_requests={CPU: 500}, worker_replicas=4,
+                 worker_requests={CPU: 2000})
+    rec.create_job(job)
+    eng.schedule_once()
+    assert not job.is_suspended()
+    names = {i.name: i.count for i in job.injected_info}
+    assert names == {"launcher": 1, "worker": 4}
+
+
+def test_trainjob_v2_with_initializer():
+    eng, rec = make_stack()
+    job = TrainJobV2(name="tj", queue_name="lq", num_nodes=2,
+                     trainer_requests={CPU: 1000},
+                     initializer_requests={CPU: 200})
+    rec.create_job(job)
+    eng.schedule_once()
+    assert not job.is_suspended()
+    assert [i.name for i in job.injected_info] == ["initializer", "node"]
+
+
+def test_rayjob_and_spark_and_appwrapper_shapes():
+    eng, rec = make_stack()
+    jobs = [
+        RayJob(name="rj", queue_name="lq", submitter_requests={CPU: 100},
+               head_requests={CPU: 1000},
+               worker_groups=[("small", 2, {CPU: 500})]),
+        SparkApplicationJob(name="spark", queue_name="lq",
+                            driver_requests={CPU: 1000},
+                            executor_instances=3,
+                            executor_requests={CPU: 500}),
+        AppWrapperJob(name="aw", queue_name="lq", components=[
+            ("svc", 1, {CPU: 200}), ("workers", 2, {CPU: 400})]),
+        StatefulSetJob(name="ss", queue_name="lq", replicas=2,
+                       requests={CPU: 300}),
+        DeploymentJob(name="dep", queue_name="lq", replicas=2,
+                      requests={CPU: 300}),
+    ]
+    for j in jobs:
+        rec.create_job(j)
+    eng.run_until_quiescent()
+    for j in jobs:
+        assert not j.is_suspended(), j.name
+    # Serving jobs never finish.
+    assert jobs[3].finished() == (False, False)
+
+
+def test_leaderworkerset_groups_coplaced():
+    eng, rec = make_stack(tas=True)
+    job = LeaderWorkerSetJob(
+        name="lws", queue_name="lq", replicas=2, size=4,
+        leader_requests={CPU: 1000}, worker_requests={CPU: 1000},
+        topology_request=PodSetTopologyRequest(
+            mode=TopologyMode.REQUIRED, level="rack"))
+    rec.create_job(job)
+    eng.schedule_once()
+    assert not job.is_suspended()
+    wl = eng.workloads[rec.job_to_workload[job.key]]
+    # Each group's leader shares the group's rack.
+    by_name = {psa.name: psa.topology_assignment
+               for psa in wl.status.admission.pod_set_assignments}
+    for g in range(2):
+        leader_racks = {d.values[0] for d in by_name[f"leader-{g}"].domains}
+        worker_racks = {d.values[0]
+                       for d in by_name[f"workers-{g}"].domains}
+        assert len(worker_racks) == 1  # required rack placement
+        assert leader_racks == worker_racks
+
+
+def test_pod_group_composes_gang():
+    eng, rec = make_stack(nominal=4000)
+    group = PodGroup("grp", queue_name="lq", total_count=3)
+    rec.create_job(group)
+    group.add_pod(PodJob(name="p0", requests={CPU: 1000}))
+    rec.reconcile(group)
+    # Incomplete group: no workload yet (pod_controller.go group gating).
+    assert group.key not in rec.job_to_workload
+    group.add_pod(PodJob(name="p1", requests={CPU: 1000}))
+    group.add_pod(PodJob(name="p2", requests={CPU: 2000}))
+    rec.reconcile(group)
+    assert group.key in rec.job_to_workload
+    eng.schedule_once()
+    assert not group.is_suspended()
+    assert all(not p.gated for p in group.pods)
+    wl = eng.workloads[rec.job_to_workload[group.key]]
+    # Two distinct shapes -> two pod sets.
+    assert len(wl.pod_sets) == 2
+    assert sum(ps.count for ps in wl.pod_sets) == 3
+
+
+def test_pod_gate_restored_on_eviction():
+    eng, rec = make_stack(nominal=1000)
+    pod = PodJob(name="solo", queue_name="lq", requests={CPU: 1000})
+    rec.create_job(pod)
+    eng.schedule_once()
+    assert not pod.gated
+    wl = eng.workloads[rec.job_to_workload[pod.key]]
+    eng.evict(wl, "Preempted")
+    rec.reconcile_all()
+    assert pod.gated
+
+
+def test_reclaimable_pods_free_quota():
+    """JobWithReclaimablePods: succeeded pods release quota so a waiting
+    job admits without the first finishing."""
+    eng, rec = make_stack(nominal=4000)
+    big = BatchJob(name="big", queue_name="lq", parallelism=4,
+                   completions=4, requests={CPU: 1000})
+    rec.create_job(big)
+    eng.schedule_once()
+    assert not big.is_suspended()
+    waiting = BatchJob(name="waiting", queue_name="lq", parallelism=2,
+                       requests={CPU: 1000})
+    eng.clock += 1
+    rec.create_job(waiting)
+    eng.schedule_once()
+    assert waiting.is_suspended()  # no room yet
+    big.succeeded = 2  # two pods done, their quota is reclaimable
+    rec.reconcile(big)
+    eng.schedule_once()
+    assert not waiting.is_suspended()
+    assert not big.finished()[0]  # big still running
