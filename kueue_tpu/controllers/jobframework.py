@@ -114,9 +114,18 @@ class BatchJob:
         return self.active_pods > 0
 
     def reclaimable_pods(self) -> dict[str, int]:
-        """JobWithReclaimablePods: succeeded pods won't be re-created
-        (jobs/job/job_controller.go ReclaimablePods)."""
-        return {"main": min(self.succeeded, self.parallelism)}
+        """JobWithReclaimablePods (jobs/job/job_controller.go:213):
+        reclaim only the parallelism the job can no longer use — while
+        remaining completions >= parallelism every finished pod is
+        replaced, so nothing is reclaimable."""
+        if self.parallelism == 1 or self.succeeded == 0:
+            return {}
+        target = self.completions if self.completions is not None \
+            else self.parallelism
+        remaining = target - self.succeeded
+        if remaining >= self.parallelism:
+            return {}
+        return {"main": self.parallelism - remaining}
 
     def finished(self) -> tuple[bool, bool]:
         target = self.completions if self.completions is not None \

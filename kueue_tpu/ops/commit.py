@@ -29,6 +29,64 @@ ENTRY_FORCE = 3  # adds full usage unconditionally (replay of a decided
 #   admission, e.g. the reservation-free second pass)
 
 
+def _entry_verdict(g_sq, g_lq, g_bl, g_usage, chain_ok, frs, req, kind,
+                   borrows_k, cq_nom, cq_bl, cq_usage_now, *, depth):
+    """The per-entry fit check + usage-bubbling amounts, shared by the
+    global scan and the root-grouped commit.
+
+    g_* are [D+1, S] gathers along the entry's ancestor chain (index 0 =
+    the CQ itself). Returns (fits bool, adds int64[D+1, S] — the usage to
+    add at each chain level, already masked)."""
+    active = (frs >= 0) & (req > 0)
+    g_local_avail = jnp.maximum(0, sat_sub(g_lq, g_usage))
+
+    # available: walk root -> cq (resource_node.go:106). Root is the
+    # last valid chain node.
+    avail = jnp.zeros_like(req)  # [S]
+    for d in range(depth, -1, -1):
+        is_valid = chain_ok[d]
+        is_root = is_valid & (
+            (d == depth) | (~chain_ok[min(d + 1, depth)]))
+        root_avail = sat_sub(g_sq[d], g_usage[d])
+        stored = sat_sub(g_sq[d], g_lq[d])
+        used_in_parent = jnp.maximum(0, sat_sub(g_usage[d], g_lq[d]))
+        with_max = sat_add(sat_sub(stored, used_in_parent), g_bl[d])
+        clipped = jnp.where(g_bl[d] >= INF, avail,
+                            jnp.minimum(with_max, avail))
+        non_root_avail = sat_add(g_local_avail[d], clipped)
+        avail = jnp.where(
+            is_valid,
+            jnp.where(is_root, root_avail, non_root_avail),
+            avail)
+    # CQ-level clip at zero (clusterqueue_snapshot.go:170).
+    avail = jnp.maximum(0, avail)
+
+    fits = (kind == ENTRY_FIT) & jnp.all(
+        jnp.where(active, req <= avail, True))
+
+    # Reservation amount (scheduler.go:708 quotaResourcesToReserve):
+    # when borrowing, cap at nominal+borrowingLimit-usage (or full
+    # usage if no limit); else clamp into remaining nominal headroom.
+    borrowing_amt = jnp.where(
+        cq_bl >= INF, req,
+        jnp.minimum(req, sat_sub(sat_add(cq_nom, cq_bl), cq_usage_now)))
+    nominal_amt = jnp.maximum(
+        0, jnp.minimum(req, sat_sub(cq_nom, cq_usage_now)))
+    reserve_req = jnp.where(borrows_k > 0, borrowing_amt, nominal_amt)
+
+    do_add = fits | (kind == ENTRY_RESERVE) | (kind == ENTRY_FORCE)
+    v = jnp.where(kind == ENTRY_RESERVE, reserve_req, req)
+    v = jnp.where(active & do_add, v, 0)  # [S]
+
+    # Usage bubbling (resource_node.go:144): node gets v, parent gets
+    # max(0, v - localAvailable(node)).
+    adds = []
+    for d in range(depth + 1):
+        adds.append(jnp.where(chain_ok[d] & active, v, 0))
+        v = jnp.maximum(0, v - g_local_avail[d])
+    return fits, jnp.stack(adds)
+
+
 @partial(jax.jit, static_argnames=("depth",))
 def commit_scan(
     order,  # int32[K] entry indices in commit order
@@ -53,7 +111,6 @@ def commit_scan(
         cq = entry_cq[k]
         frs = entry_fr[k]  # [S]
         req = entry_req[k]  # [S]
-        active = (frs >= 0) & (req > 0)
         frs_safe = jnp.maximum(frs, 0)
 
         # Chain cq -> root as [D+1] node indices (-1 padded).
@@ -67,62 +124,119 @@ def commit_scan(
         g_lq = lq[chain_safe[:, None], frs_safe[None, :]]
         g_bl = borrow_limit[chain_safe[:, None], frs_safe[None, :]]
         g_usage = usage[chain_safe[:, None], frs_safe[None, :]]
-        g_local_avail = jnp.maximum(0, sat_sub(g_lq, g_usage))
 
-        # available: walk root -> cq (resource_node.go:106). Root is the
-        # last valid chain node.
-        avail = jnp.zeros_like(req)  # [S]
-        for d in range(depth, -1, -1):
-            is_valid = chain_ok[d]
-            is_root = is_valid & (
-                (d == depth) | (~chain_ok[min(d + 1, depth)]))
-            root_avail = sat_sub(g_sq[d], g_usage[d])
-            stored = sat_sub(g_sq[d], g_lq[d])
-            used_in_parent = jnp.maximum(0, sat_sub(g_usage[d], g_lq[d]))
-            with_max = sat_add(sat_sub(stored, used_in_parent), g_bl[d])
-            clipped = jnp.where(g_bl[d] >= INF, avail,
-                                jnp.minimum(with_max, avail))
-            non_root_avail = sat_add(g_local_avail[d], clipped)
-            avail = jnp.where(
-                is_valid,
-                jnp.where(is_root, root_avail, non_root_avail),
-                avail)
-        # CQ-level clip at zero (clusterqueue_snapshot.go:170).
-        avail = jnp.maximum(0, avail)
+        fits, adds = _entry_verdict(
+            g_sq, g_lq, g_bl, g_usage, chain_ok, frs, req, entry_kind[k],
+            entry_borrows[k], nominal[cq, frs_safe],
+            borrow_limit[cq, frs_safe], usage[cq, frs_safe], depth=depth)
 
-        kind = entry_kind[k]
-        fits = (kind == ENTRY_FIT) & jnp.all(
-            jnp.where(active, req <= avail, True))
-
-        # Reservation amount (scheduler.go:708 quotaResourcesToReserve):
-        # when borrowing, cap at nominal+borrowingLimit-usage (or full
-        # usage if no limit); else clamp into remaining nominal headroom.
-        cq_nom = nominal[cq, frs_safe]
-        cq_bl = borrow_limit[cq, frs_safe]
-        cq_usage_now = usage[cq, frs_safe]
-        borrowing_amt = jnp.where(
-            cq_bl >= INF, req,
-            jnp.minimum(req, sat_sub(sat_add(cq_nom, cq_bl), cq_usage_now)))
-        nominal_amt = jnp.maximum(
-            0, jnp.minimum(req, sat_sub(cq_nom, cq_usage_now)))
-        reserve_req = jnp.where(entry_borrows[k] > 0, borrowing_amt,
-                                nominal_amt)
-
-        do_add = fits | (kind == ENTRY_RESERVE) | (kind == ENTRY_FORCE)
-        v = jnp.where(kind == ENTRY_RESERVE, reserve_req, req)
-        v = jnp.where(active & do_add, v, 0)  # [S]
-
-        # Usage bubbling (resource_node.go:144): node gets v, parent gets
-        # max(0, v - localAvailable(node)).
         new_usage = usage
         for d in range(depth + 1):
-            add = jnp.where(chain_ok[d], v, 0)
-            new_usage = new_usage.at[chain_safe[d], frs_safe].add(
-                jnp.where(active, add, 0))
-            v = jnp.maximum(0, v - g_local_avail[d])
+            new_usage = new_usage.at[chain_safe[d], frs_safe].add(adds[d])
         return new_usage, fits
 
     usage_final, admitted = jax.lax.scan(step, usage0, order)
+    return admitted, usage_final
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def commit_grouped(
+    entry_key,  # int64[C] commit-order sort key (lower = earlier)
+    entry_valid,  # bool[C] slot participates this cycle
+    entry_fr,  # int32[C, S]
+    entry_req,  # int64[C, S]
+    entry_kind,  # int32[C]
+    entry_borrows,  # int32[C]
+    usage0,  # int64[N, R]
+    subtree_quota, lend_limit, borrow_limit, nominal, ancestors,
+    root_members,  # int32[Rn, M] CQ/slot ids per root, -1 pad
+    root_nodes,  # int32[Rn, K] subtree node ids per root, -1 pad
+    local_chain,  # int32[C, D+1] chain positions into the root's node row
+    *,
+    depth: int,
+):
+    """Sequential-equivalent commit, parallel across root subtrees.
+
+    Admissions never interact across roots (all quota math — borrowing,
+    lending, usage bubbling — stays under the entry's root cohort), so the
+    reference's one-at-a-time commit order is reproduced exactly by
+    scanning each root's entries in global key order, vmapped over roots.
+    Scan length drops from C (all slots) to max-CQs-per-root — the
+    difference between a 1000-step and an ~8-step sequential section per
+    cycle on TPU.
+
+    Returns (admitted bool[C] by slot, final usage int64[N, R]).
+    """
+    N, R = usage0.shape
+    Rn, M = root_members.shape
+    K = root_nodes.shape[1]
+    BIGKEY = jnp.int64((1 << 62))
+    lq = local_quota(subtree_quota, lend_limit)
+
+    member_ok = root_members >= 0
+    members_safe = jnp.maximum(root_members, 0)
+    mkey = jnp.where(member_ok & entry_valid[members_safe],
+                     entry_key[members_safe], BIGKEY)
+    morder = jnp.argsort(mkey, axis=1)
+    sorted_members = jnp.take_along_axis(root_members, morder, axis=1)
+
+    nodes_safe = jnp.maximum(root_nodes, 0)
+    init_local = jnp.where((root_nodes >= 0)[:, :, None],
+                           usage0[nodes_safe], 0)  # [Rn, K, R]
+
+    def per_root(members, local_usage):
+        def step(usage_l, c):  # usage_l: [K, R]
+            ok = c >= 0
+            c_safe = jnp.maximum(c, 0)
+            frs = entry_fr[c_safe]
+            req = jnp.where(ok, entry_req[c_safe], 0)
+            frs_safe = jnp.maximum(frs, 0)
+
+            chain = jnp.concatenate(
+                [jnp.asarray([c_safe], jnp.int32), ancestors[c_safe]])
+            chain_ok = (chain >= 0) & ok
+            chain_safe = jnp.maximum(chain, 0)
+            loc = local_chain[c_safe]  # [D+1] positions into K
+            loc_safe = jnp.maximum(loc, 0)
+
+            g_sq = subtree_quota[chain_safe[:, None], frs_safe[None, :]]
+            g_lq = lq[chain_safe[:, None], frs_safe[None, :]]
+            g_bl = borrow_limit[chain_safe[:, None], frs_safe[None, :]]
+            g_usage = usage_l[loc_safe[:, None], frs_safe[None, :]]
+
+            kind = jnp.where(ok, entry_kind[c_safe], ENTRY_SKIP)
+            fits, adds = _entry_verdict(
+                g_sq, g_lq, g_bl, g_usage, chain_ok, frs, req, kind,
+                entry_borrows[c_safe], nominal[c_safe, frs_safe],
+                borrow_limit[c_safe, frs_safe], usage_l[loc_safe[0],
+                                                        frs_safe],
+                depth=depth)
+
+            new_usage = usage_l
+            for d in range(depth + 1):
+                new_usage = new_usage.at[loc_safe[d], frs_safe].add(adds[d])
+            return new_usage, fits & ok
+
+        return jax.lax.scan(step, local_usage, members)
+
+    final_local, admitted_seq = jax.vmap(per_root)(sorted_members,
+                                                   init_local)
+
+    # Scatter per-root verdicts back to slot order.
+    flat_members = sorted_members.reshape(-1)
+    flat_adm = admitted_seq.reshape(-1)
+    C = entry_key.shape[0]
+    admitted = jnp.zeros((C,), bool).at[
+        jnp.where(flat_members >= 0, flat_members, C)].max(
+        flat_adm, mode="drop")
+
+    # Scatter local usage back into the global node matrix (subtrees are
+    # disjoint and cover every node).
+    flat_nodes = root_nodes.reshape(-1)
+    flat_usage = final_local.reshape(-1, R)
+    usage_final = usage0.at[
+        jnp.where(flat_nodes >= 0, flat_nodes, N)].set(
+        flat_usage, mode="drop")
     return admitted, usage_final
 
 

@@ -25,10 +25,28 @@ import jax.numpy as jnp
 INT_MAX = (1 << 62)
 
 
-@jax.jit
 def leaf_states(free_capacity, tas_usage, assumed_usage, per_pod,
                 leaf_mask):
-    """Pods that fit per leaf.
+    """Pods that fit per leaf — public entry; dispatches to the Pallas
+    kernel on TPU backends when the quantities are int32-exact
+    (ops/pallas_kernels.leaf_fit_counts), else the int64 jnp path."""
+    from kueue_tpu.ops.pallas_kernels import (
+        leaf_fit_counts_in_range,
+        pallas_enabled,
+    )
+    if pallas_enabled() and leaf_fit_counts_in_range(
+            free_capacity, tas_usage, assumed_usage, per_pod):
+        from kueue_tpu.ops.pallas_kernels import _leaf_pallas
+        return _leaf_pallas(free_capacity, tas_usage + assumed_usage,
+                            per_pod, leaf_mask)
+    return _leaf_states_jnp(free_capacity, tas_usage, assumed_usage,
+                            per_pod, leaf_mask)
+
+
+@jax.jit
+def _leaf_states_jnp(free_capacity, tas_usage, assumed_usage, per_pod,
+                     leaf_mask):
+    """Pods that fit per leaf (int64 reference implementation).
 
     free_capacity, tas_usage, assumed_usage: int64[L, S]
     per_pod: int64[S] (zero = resource not requested)

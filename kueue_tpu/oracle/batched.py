@@ -36,6 +36,7 @@ import numpy as np
 
 from kueue_tpu.ops import assign as aops
 from kueue_tpu.ops import commit as cops
+from kueue_tpu.ops import pallas_kernels as pk
 from kueue_tpu.ops import quota as qops
 from kueue_tpu.tensor.schema import (
     WorkloadTensors,
@@ -56,8 +57,7 @@ class DrainDecision:
     flavors: dict  # resource -> flavor name
 
 
-@partial(jax.jit, static_argnames=("depth", "num_resources", "num_cqs"))
-def cycle_step(
+def _cycle_core(
     pending,  # bool[W]
     inadmissible,  # bool[W]
     usage,  # int64[N, R] (full node usage, invariant-consistent)
@@ -71,6 +71,7 @@ def cycle_step(
     nominal, lend_limit, borrow_limit, parent, ancestors, height,
     group_of_res, group_flavors, no_preemption, can_pwb, can_always_reclaim,
     best_effort, fung_borrow_try_next, fung_pref_preempt_first,
+    root_members, root_nodes, local_chain,
     *,
     depth: int, num_resources: int, num_cqs: int,
 ):
@@ -88,7 +89,7 @@ def cycle_step(
     # (manager.go:872 Heads / cluster_queue.go:715 Pop).
     active = pending & ~inadmissible
     eff_rank = jnp.where(active, rank, BIG_RANK)
-    head_rank = jax.ops.segment_min(eff_rank, wl_cq, num_segments=C)
+    head_rank = pk.select_heads(eff_rank, wl_cq, C, BIG_RANK)
     w_ids = jnp.arange(W, dtype=jnp.int32)
     is_head = active & (eff_rank == head_rank[wl_cq]) & (eff_rank < BIG_RANK)
     # Map CQ -> head workload index (-1 none). Heads are unique per CQ
@@ -127,14 +128,14 @@ def cycle_step(
                             cops.ENTRY_RESERVE, cops.ENTRY_SKIP)))
     # Commit against the freshly-aggregated full usage (cohort rows are
     # derived from CQ rows; the raw carry may predate aggregation).
+    # Root-grouped: subtrees commit independently (ops/commit.py).
     full_usage = derived["usage"]
-    admitted_in_order, usage_after = cops.commit_scan(
-        order, h_cq, usage_fr, h_req, kind, borrows, full_usage,
+    slot_admitted, usage_after = cops.commit_grouped(
+        key, slot_valid, usage_fr, h_req, kind, borrows, full_usage,
         derived["subtree_quota"], lend_limit, borrow_limit, nominal,
-        ancestors, depth=depth)
+        ancestors, root_members, root_nodes, local_chain, depth=depth)
 
-    # Scatter admission back to head slots, then to workloads.
-    slot_admitted = jnp.zeros((C,), bool).at[order].set(admitted_in_order)
+    # Positions report the global commit order (scheduler.go:971 sort).
     slot_position = jnp.zeros((C,), jnp.int32).at[order].set(
         jnp.arange(C, dtype=jnp.int32))
     adm_target = jnp.where(slot_valid & slot_admitted, h_safe, W)
@@ -160,14 +161,85 @@ def cycle_step(
     # recompute post-cycle usage from admissions only.
     committed_kind = jnp.where(slot_admitted, cops.ENTRY_FORCE,
                                cops.ENTRY_SKIP)
-    _, usage_clean = cops.commit_scan(
-        order, h_cq, usage_fr, h_req, committed_kind, borrows, full_usage,
-        derived["subtree_quota"], lend_limit, borrow_limit, nominal,
-        ancestors, depth=depth)
+    _, usage_clean = cops.commit_grouped(
+        key, slot_valid, usage_fr, h_req, committed_kind, borrows,
+        full_usage, derived["subtree_quota"], lend_limit, borrow_limit,
+        nominal, ancestors, root_members, root_nodes, local_chain,
+        depth=depth)
 
     any_needs_oracle = jnp.any(needs_oracle & slot_valid)
     return (new_pending, new_inadmissible, usage_clean, wl_admitted,
             slot_admitted, slot_position, flavor_of_res, any_needs_oracle)
+
+
+cycle_step = partial(jax.jit,
+                     static_argnames=("depth", "num_resources",
+                                      "num_cqs"))(_cycle_core)
+
+
+@partial(jax.jit, static_argnames=("depth", "num_resources", "num_cqs"))
+def drain_loop(
+    pending, inadmissible, usage, rank, commit_rank, wl_cq, wl_req,
+    wl_priority, wl_has_qr, wl_hash, nominal, lend_limit, borrow_limit,
+    parent, ancestors, height, group_of_res, group_flavors, no_preemption,
+    can_pwb, can_always_reclaim, best_effort, fung_borrow_try_next,
+    fung_pref_preempt_first, root_members, root_nodes, local_chain,
+    max_cycles,
+    *,
+    depth: int, num_resources: int, num_cqs: int,
+):
+    """Whole drain as ONE device program: run scheduling cycles until a
+    cycle admits nothing (or max_cycles), recording per-workload verdicts.
+
+    This removes the per-cycle host round-trip of the naive driver — on a
+    remote-attached TPU each cycle's host sync costs orders of magnitude
+    more than the cycle itself. Returns:
+      admit_cycle int32[W]  (-1 = not admitted)
+      admit_pos   int32[W]  commit position within its cycle
+      wl_flavor   int32[W, S] chosen flavor per resource (-1 none)
+      usage       final usage tensor
+      cycles      int32 number of cycles executed (incl. the empty one)
+      oracle_flag bool  any workload flagged for the host preemptor
+    """
+    W = pending.shape[0]
+    S = num_resources
+
+    def step(pending, inadmissible, usage):
+        return _cycle_core(
+            pending, inadmissible, usage, rank, commit_rank, wl_cq, wl_req,
+            wl_priority, wl_has_qr, wl_hash, nominal, lend_limit,
+            borrow_limit, parent, ancestors, height, group_of_res,
+            group_flavors, no_preemption, can_pwb, can_always_reclaim,
+            best_effort, fung_borrow_try_next, fung_pref_preempt_first,
+            root_members, root_nodes, local_chain,
+            depth=depth, num_resources=num_resources, num_cqs=num_cqs)
+
+    max_cycles = jnp.asarray(max_cycles, jnp.int32)
+
+    def cond(state):
+        (_, _, _, cycle, progress, _, _, _, _) = state
+        return progress & (cycle < max_cycles)
+
+    def body(state):
+        (pending, inadmissible, usage, cycle, _, admit_cycle, admit_pos,
+         wl_flavor, oracle_flag) = state
+        (pending, inadmissible, usage, wl_admitted, _slot_admitted,
+         slot_position, flavor_of_res, any_oracle) = step(
+            pending, inadmissible, usage)
+        admit_cycle = jnp.where(wl_admitted, cycle, admit_cycle)
+        admit_pos = jnp.where(wl_admitted, slot_position[wl_cq], admit_pos)
+        wl_flavor = jnp.where(wl_admitted[:, None], flavor_of_res[wl_cq],
+                              wl_flavor)
+        progress = jnp.any(wl_admitted)
+        return (pending, inadmissible, usage, cycle + 1, progress,
+                admit_cycle, admit_pos, wl_flavor, oracle_flag | any_oracle)
+
+    init = (pending, inadmissible, usage, jnp.int32(0), jnp.asarray(True),
+            jnp.full((W,), -1, jnp.int32), jnp.zeros((W,), jnp.int32),
+            jnp.full((W, S), -1, jnp.int32), jnp.asarray(False))
+    (pending, inadmissible, usage, cycles, _, admit_cycle, admit_pos,
+     wl_flavor, oracle_flag) = jax.lax.while_loop(cond, body, init)
+    return admit_cycle, admit_pos, wl_flavor, usage, cycles, oracle_flag
 
 
 class BatchedDrainSolver:
@@ -233,43 +305,40 @@ class BatchedDrainSolver:
             best_effort=jnp.asarray(w.best_effort),
             fung_borrow_try_next=jnp.asarray(w.fung_borrow_try_next),
             fung_pref_preempt_first=jnp.asarray(w.fung_pref_preempt_first),
+            root_members=jnp.asarray(w.root_members),
+            root_nodes=jnp.asarray(w.root_nodes),
+            local_chain=jnp.asarray(w.local_chain),
         )
 
+        # ONE device program for the whole drain (no per-cycle host sync).
+        admit_cycle, admit_pos, wl_flavor, usage, cycles, oracle_flag = \
+            drain_loop(pending, inadmissible, usage, **args,
+                       max_cycles=max_cycles,
+                       depth=w.depth, num_resources=w.num_resources,
+                       num_cqs=w.num_cqs)
+        admit_cycle = np.asarray(admit_cycle)
+        admit_pos = np.asarray(admit_pos)
+        wl_flavor = np.asarray(wl_flavor)
+
         decisions: list[DrainDecision] = []
-        cycles = 0
-        oracle_flag = False
-        for cycle in range(max_cycles):
-            (pending, inadmissible, usage, wl_admitted, slot_admitted,
-             slot_position, flavor_of_res, any_oracle) = cycle_step(
-                pending, inadmissible, usage, **args,
-                depth=w.depth, num_resources=w.num_resources,
-                num_cqs=w.num_cqs)
-            cycles += 1
-            oracle_flag = oracle_flag or bool(any_oracle)
-            adm = np.asarray(wl_admitted)
-            if not adm.any():
-                break
-            slot_adm = np.asarray(slot_admitted)
-            slot_pos = np.asarray(slot_position)
-            flv = np.asarray(flavor_of_res)
-            # Map admitted slots back to workloads for reporting.
-            wl_cq_np = self.wls.cq
-            admitted_ids = np.nonzero(adm)[0]
-            for wid in admitted_ids:
-                ci = wl_cq_np[wid]
-                flavors = {}
-                for s_i, res in enumerate(w.resource_names):
-                    fl = flv[ci, s_i]
-                    if fl >= 0 and self.wls.requests[wid, s_i] > 0:
-                        flavors[res] = w.flavor_names[fl]
-                decisions.append(DrainDecision(
-                    key=self.wls.keys[wid],
-                    cluster_queue=w.cq_names[ci],
-                    cycle=cycle, position=int(slot_pos[ci]),
-                    flavors=flavors))
+        admitted_ids = np.nonzero(admit_cycle >= 0)[0]
+        order = admitted_ids[np.lexsort((admit_pos[admitted_ids],
+                                         admit_cycle[admitted_ids]))]
+        for wid in order:
+            ci = self.wls.cq[wid]
+            flavors = {}
+            for s_i, res in enumerate(w.resource_names):
+                fl = wl_flavor[wid, s_i]
+                if fl >= 0 and self.wls.requests[wid, s_i] > 0:
+                    flavors[res] = w.flavor_names[fl]
+            decisions.append(DrainDecision(
+                key=self.wls.keys[wid],
+                cluster_queue=w.cq_names[ci],
+                cycle=int(admit_cycle[wid]), position=int(admit_pos[wid]),
+                flavors=flavors))
         return decisions, {
-            "cycles": cycles,
-            "needs_oracle": oracle_flag,
+            "cycles": int(cycles),
+            "needs_oracle": bool(oracle_flag),
             "admitted": len(decisions),
             "final_usage": np.asarray(usage),
         }

@@ -107,6 +107,9 @@ class OracleBridge:
             best_effort=jnp.asarray(w.best_effort),
             fung_borrow_try_next=jnp.asarray(w.fung_borrow_try_next),
             fung_pref_preempt_first=jnp.asarray(w.fung_pref_preempt_first),
+            root_members=jnp.asarray(w.root_members),
+            root_nodes=jnp.asarray(w.root_nodes),
+            local_chain=jnp.asarray(w.local_chain),
         )
         pending = jnp.ones(W, bool)
         inadmissible = jnp.zeros(W, bool)

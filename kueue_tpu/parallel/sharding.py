@@ -71,6 +71,9 @@ def sharded_cycle_step(mesh: Mesh, depth: int, num_resources: int,
         repl,  # best_effort
         repl,  # fung_borrow_try_next
         repl,  # fung_pref_preempt_first
+        repl2,  # root_members
+        repl2,  # root_nodes
+        repl2,  # local_chain
     )
     out_shardings = (
         wl_sharded,  # new_pending

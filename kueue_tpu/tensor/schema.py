@@ -83,6 +83,16 @@ class WorldTensors:
     fung_pref_preempt_first: np.ndarray  # bool[C] PreemptionOverBorrowing
     fair_weight: np.ndarray  # float64[N]
 
+    # -- root grouping (commit parallelism) --
+    # Admissions only interact within a root subtree (all quota math stays
+    # under the root cohort), so the sequential-equivalent commit runs as a
+    # short scan per root, vmapped across roots (ops/commit.commit_grouped).
+    num_roots: int = 1
+    root_members: np.ndarray = None  # int32[Rn, M] CQ ids per root, -1 pad
+    root_nodes: np.ndarray = None  # int32[Rn, K] subtree node ids, -1 pad
+    local_chain: np.ndarray = None  # int32[C, depth+1] chain positions
+    #   into root_nodes[root_of(cq)], -1 pad
+
     def fr_index(self, flavor: str, resource: str) -> int:
         return (self.flavor_names.index(flavor) * self.num_resources
                 + self.resource_names.index(resource))
@@ -103,6 +113,53 @@ class WorkloadTensors:
     # Scheduling-equivalence hash id (workload.go:236 SchedulingHash),
     # dense-coded: equal ids => identical admission verdicts.
     hash_id: np.ndarray = None  # int32[W]
+
+
+def build_root_grouping(parent: np.ndarray, ancestors: np.ndarray,
+                        num_cqs: int, max_depth: int):
+    """Group the cohort forest by root subtree for the parallel commit
+    (ops/commit.commit_grouped). Nodes 0..num_cqs-1 must be the CQ rows.
+
+    Returns (num_roots, root_members int32[Rn, M], root_nodes
+    int32[Rn, K], local_chain int32[C, max_depth+1])."""
+    N = parent.shape[0]
+    C = num_cqs
+    root_of = np.arange(N, dtype=np.int32)
+    for i in range(N):
+        a = i
+        while parent[a] >= 0:
+            a = parent[a]
+        root_of[i] = a
+    roots = sorted(set(int(r) for r in root_of))
+    root_idx = {r: i for i, r in enumerate(roots)}
+    Rn = len(roots)
+    members_of = [[] for _ in range(Rn)]
+    nodes_of = [[] for _ in range(Rn)]
+    for i in range(N):
+        ri = root_idx[int(root_of[i])]
+        nodes_of[ri].append(i)
+        if i < C:
+            members_of[ri].append(i)
+    M = max((len(m) for m in members_of), default=1) or 1
+    K = max((len(n) for n in nodes_of), default=1) or 1
+    root_members = np.full((Rn, M), -1, np.int32)
+    root_nodes = np.full((Rn, K), -1, np.int32)
+    node_pos = {}
+    for ri in range(Rn):
+        for j, m in enumerate(members_of[ri]):
+            root_members[ri, j] = m
+        for j, nd in enumerate(nodes_of[ri]):
+            root_nodes[ri, j] = nd
+            node_pos[nd] = j
+    local_chain = np.full((C, max_depth + 1), -1, np.int32)
+    for ci in range(C):
+        local_chain[ci, 0] = node_pos[ci]
+        for d in range(max_depth):
+            a = ancestors[ci, d]
+            if a < 0:
+                break
+            local_chain[ci, d + 1] = node_pos[int(a)]
+    return Rn, root_members, root_nodes, local_chain
 
 
 def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
@@ -235,6 +292,9 @@ def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
         fung_pref_p[ci] = (fung.preference
                            == FungibilityPreference.PREEMPTION_OVER_BORROWING)
 
+    Rn, root_members, root_nodes, local_chain = build_root_grouping(
+        parent, ancestors, C, max_depth)
+
     return WorldTensors(
         num_cqs=C, num_nodes=N, num_flavors=NF, num_resources=S,
         max_flavors_per_group=F, max_groups=G, depth=max_depth,
@@ -247,6 +307,8 @@ def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
         can_always_reclaim=can_always_reclaim, best_effort=best_effort,
         fung_borrow_try_next=fung_b_try, fung_preempt_try_next=fung_p_try,
         fung_pref_preempt_first=fung_pref_p, fair_weight=fair_weight,
+        num_roots=Rn, root_members=root_members, root_nodes=root_nodes,
+        local_chain=local_chain,
     )
 
 

@@ -1,0 +1,112 @@
+"""commit_grouped must reproduce commit_scan exactly: the root-grouped
+parallel commit is only a reformulation (admissions never interact across
+root subtrees), so admitted sets and final usage must be bit-identical on
+random worlds."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kueue_tpu.ops import commit as cops
+
+
+def random_world(rng, n_roots, cqs_per_root, depth_extra, R):
+    """Build parent/ancestors plus grouping arrays for a random forest:
+    each root cohort optionally has an interior cohort layer."""
+    C = n_roots * cqs_per_root
+    nodes = []  # cohort ids come after CQs
+    parent = []
+    for _ in range(C):
+        parent.append(-1)
+    cohort_base = C
+    n_cohorts = n_roots * (1 + depth_extra)
+    parent += [-1] * n_cohorts
+    # Wire: root r cohort = cohort_base + r; interior (if any) chains up.
+    for r in range(n_roots):
+        chain = [cohort_base + r]
+        for d in range(depth_extra):
+            inner = cohort_base + n_roots + r * depth_extra + d
+            parent[inner] = chain[-1]
+            chain.append(inner)
+        for i in range(cqs_per_root):
+            cq = r * cqs_per_root + i
+            parent[cq] = chain[-1] if rng.random() < 0.9 else -1
+    N = C + n_cohorts
+    parent = np.asarray(parent, np.int32)
+    D = depth_extra + 2
+    ancestors = np.full((N, D), -1, np.int32)
+    for i in range(N):
+        a, d = parent[i], 0
+        while a >= 0 and d < D:
+            ancestors[i, d] = a
+            a = parent[a]
+            d += 1
+    from kueue_tpu.tensor.schema import build_root_grouping
+    _, root_members, root_nodes, local_chain = build_root_grouping(
+        parent, ancestors, C, D)
+
+    from kueue_tpu.api.types import INF
+    nominal = rng.integers(0, 50, (N, R)).astype(np.int64)
+    borrow_limit = np.where(rng.random((N, R)) < 0.5, INF,
+                            rng.integers(0, 30, (N, R))).astype(np.int64)
+    lend_limit = np.where(rng.random((N, R)) < 0.5, INF,
+                          rng.integers(0, 30, (N, R))).astype(np.int64)
+    usage0 = rng.integers(0, 20, (N, R)).astype(np.int64)
+    return dict(C=C, N=N, D=D, parent=parent, ancestors=ancestors,
+                root_members=root_members, root_nodes=root_nodes,
+                local_chain=local_chain, nominal=nominal,
+                borrow_limit=borrow_limit, lend_limit=lend_limit,
+                usage0=usage0)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_grouped_matches_scan(seed):
+    rng = np.random.default_rng(seed)
+    R = int(rng.integers(1, 4))
+    S = R  # one flavor; fr index == resource index
+    w = random_world(rng, n_roots=int(rng.integers(2, 5)),
+                     cqs_per_root=int(rng.integers(1, 5)),
+                     depth_extra=int(rng.integers(0, 2)), R=R)
+    C, D = w["C"], w["D"]
+
+    from kueue_tpu.ops.quota import compute_level, compute_subtree_quota
+    level = compute_level(jnp.asarray(w["parent"]), D)
+    sq = compute_subtree_quota(jnp.asarray(w["nominal"]),
+                               jnp.asarray(w["lend_limit"]),
+                               jnp.asarray(w["parent"]), level, depth=D)
+
+    entry_fr = np.tile(np.arange(S, dtype=np.int32), (C, 1))
+    entry_fr[rng.random((C, S)) < 0.2] = -1
+    entry_req = rng.integers(0, 40, (C, S)).astype(np.int64)
+    entry_kind = rng.choice(
+        [cops.ENTRY_SKIP, cops.ENTRY_FIT, cops.ENTRY_RESERVE,
+         cops.ENTRY_FORCE], C).astype(np.int32)
+    entry_borrows = rng.integers(0, 3, C).astype(np.int32)
+    entry_key = rng.permutation(C).astype(np.int64)
+    entry_valid = np.ones(C, bool)
+
+    order = np.argsort(entry_key).astype(np.int32)
+    adm_scan, usage_scan = cops.commit_scan(
+        jnp.asarray(order), jnp.arange(C, dtype=jnp.int32),
+        jnp.asarray(entry_fr), jnp.asarray(entry_req),
+        jnp.asarray(entry_kind), jnp.asarray(entry_borrows),
+        jnp.asarray(w["usage0"]), sq, jnp.asarray(w["lend_limit"]),
+        jnp.asarray(w["borrow_limit"]), jnp.asarray(w["nominal"]),
+        jnp.asarray(w["ancestors"]), depth=D)
+    # Scatter scan verdicts (aligned with `order`) back to slots.
+    slot_adm_scan = np.zeros(C, bool)
+    slot_adm_scan[order] = np.asarray(adm_scan)
+
+    adm_grp, usage_grp = cops.commit_grouped(
+        jnp.asarray(entry_key), jnp.asarray(entry_valid),
+        jnp.asarray(entry_fr), jnp.asarray(entry_req),
+        jnp.asarray(entry_kind), jnp.asarray(entry_borrows),
+        jnp.asarray(w["usage0"]), sq, jnp.asarray(w["lend_limit"]),
+        jnp.asarray(w["borrow_limit"]), jnp.asarray(w["nominal"]),
+        jnp.asarray(w["ancestors"]), jnp.asarray(w["root_members"]),
+        jnp.asarray(w["root_nodes"]), jnp.asarray(w["local_chain"]),
+        depth=D)
+
+    np.testing.assert_array_equal(slot_adm_scan, np.asarray(adm_grp))
+    np.testing.assert_array_equal(np.asarray(usage_scan),
+                                  np.asarray(usage_grp))

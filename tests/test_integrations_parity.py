@@ -186,3 +186,24 @@ def test_reclaimable_pods_free_quota():
     eng.schedule_once()
     assert not waiting.is_suspended()
     assert not big.finished()[0]  # big still running
+
+
+def test_reclaimable_pods_formula_matches_reference():
+    """jobs/job/job_controller.go:213 — nothing is reclaimable while
+    remaining completions >= parallelism (finished pods are replaced)."""
+    j = BatchJob(name="j", queue_name="lq", parallelism=2, completions=4,
+                 requests={CPU: 1000})
+    j.succeeded = 1
+    assert j.reclaimable_pods() == {}  # remaining=3 >= parallelism=2
+    j.succeeded = 3
+    assert j.reclaimable_pods() == {"main": 1}  # remaining=1 -> free 1
+    # parallelism == 1 never reclaims; nil completions defaults to
+    # parallelism.
+    one = BatchJob(name="one", queue_name="lq", parallelism=1,
+                   requests={CPU: 1000})
+    one.succeeded = 1
+    assert one.reclaimable_pods() == {}
+    wq = BatchJob(name="wq", queue_name="lq", parallelism=3,
+                  requests={CPU: 1000})
+    wq.succeeded = 2
+    assert wq.reclaimable_pods() == {"main": 2}  # remaining=1 -> free 2

@@ -1,0 +1,126 @@
+"""Parity tests for the Pallas TPU kernels (interpret mode on CPU).
+
+Each kernel must agree exactly with its jnp reference implementation, and
+the batched drain must make identical decisions with the Pallas path forced
+on.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kueue_tpu.ops import pallas_kernels as pk
+from kueue_tpu.ops.tas import _leaf_states_jnp, leaf_states
+
+
+@pytest.fixture
+def force_pallas(monkeypatch):
+    monkeypatch.setenv("KUEUE_TPU_PALLAS", "1")
+    jax.clear_caches()
+    yield
+    monkeypatch.delenv("KUEUE_TPU_PALLAS", raising=False)
+    jax.clear_caches()
+
+
+def test_pallas_enabled_dispatch(monkeypatch):
+    monkeypatch.setenv("KUEUE_TPU_PALLAS", "1")
+    assert pk.pallas_enabled()
+    monkeypatch.setenv("KUEUE_TPU_PALLAS", "0")
+    assert not pk.pallas_enabled()
+    monkeypatch.delenv("KUEUE_TPU_PALLAS")
+    # On the CPU test backend the default is off.
+    assert not pk.pallas_enabled()
+
+
+@pytest.mark.parametrize("w,c", [(1, 1), (37, 3), (256, 7), (1000, 130),
+                                 (5000, 1000)])
+def test_select_heads_parity(force_pallas, w, c):
+    rng = np.random.default_rng(w * 1000 + c)
+    big = np.int64(1) << 40
+    rank = rng.permutation(w).astype(np.int64)
+    cq = rng.integers(0, c, w).astype(np.int32)
+    active = rng.random(w) > 0.3
+    eff = jnp.where(jnp.asarray(active), jnp.asarray(rank), big)
+
+    got = pk.select_heads(eff, jnp.asarray(cq), c, big)
+    want = jax.ops.segment_min(eff, jnp.asarray(cq), num_segments=c)
+    # Contract: any value >= big means "no head" (empty segments yield the
+    # int64-max identity on the jnp path and big on the pallas path).
+    np.testing.assert_array_equal(np.minimum(np.asarray(got), big),
+                                  np.minimum(np.asarray(want), big))
+
+
+def test_select_heads_all_inactive(force_pallas):
+    big = np.int64(1) << 40
+    eff = jnp.full((64,), big)
+    cq = jnp.zeros(64, jnp.int32)
+    got = pk.select_heads(eff, cq, 4, big)
+    assert np.all(np.asarray(got) == big)
+
+
+@pytest.mark.parametrize("leaves,res", [(1, 1), (100, 3), (640, 2),
+                                        (1000, 5)])
+def test_leaf_fit_counts_parity(force_pallas, leaves, res):
+    rng = np.random.default_rng(leaves * 10 + res)
+    free = rng.integers(0, 1000, (leaves, res)).astype(np.int64)
+    used = rng.integers(0, 500, (leaves, res)).astype(np.int64)
+    assumed = rng.integers(0, 100, (leaves, res)).astype(np.int64)
+    per_pod = rng.integers(0, 8, res).astype(np.int64)
+    mask = rng.random(leaves) > 0.2
+
+    got = pk.leaf_fit_counts(jnp.asarray(free), jnp.asarray(used),
+                             jnp.asarray(assumed), jnp.asarray(per_pod),
+                             jnp.asarray(mask))
+    want = _leaf_states_jnp(jnp.asarray(free), jnp.asarray(used),
+                            jnp.asarray(assumed), jnp.asarray(per_pod),
+                            jnp.asarray(mask))
+    np.testing.assert_array_equal(
+        np.asarray(got), np.minimum(np.asarray(want), pk.INT32_BIG))
+
+
+def test_leaf_fit_counts_big_values_fall_back(force_pallas):
+    """Quantities >= 2^31 (memory in bytes) must take the exact int64
+    path, not the clamped int32 kernel."""
+    free = jnp.asarray(np.array([[300 * 2**30]], np.int64))  # 300 GiB
+    used = jnp.asarray(np.array([[200 * 2**30]], np.int64))
+    per_pod = jnp.asarray(np.array([10 * 2**30], np.int64))
+    mask = jnp.asarray(np.array([True]))
+    got = pk.leaf_fit_counts(free, used, jnp.zeros_like(used), per_pod,
+                             mask)
+    assert int(np.asarray(got)[0]) == 10
+    # The public ops.tas.leaf_states entry dispatches identically.
+    got2 = leaf_states(free, used, jnp.zeros_like(used), per_pod, mask)
+    assert int(np.asarray(got2)[0]) == 10
+
+
+def test_drain_parity_with_pallas(monkeypatch):
+    """The batched drain makes identical decisions with Pallas forced."""
+    from kueue_tpu.bench.scenario import baseline_like
+    from kueue_tpu.cache.snapshot import build_snapshot
+    from kueue_tpu.oracle.batched import BatchedDrainSolver
+
+    scen = baseline_like(n_cohorts=2, cqs_per_cohort=3, n_workloads=120,
+                         nominal_per_cq=2000, sized_to_fit=False)
+
+    def run():
+        jax.clear_caches()
+        snap = build_snapshot(scen.cluster_queues, scen.cohorts,
+                              scen.flavors, [])
+        solver = BatchedDrainSolver(snap, scen.pending_infos())
+        decisions, stats = solver.solve()
+        return [(d.key, d.cluster_queue, d.cycle, d.position, tuple(
+            sorted(d.flavors.items()))) for d in decisions], stats
+
+    monkeypatch.setenv("KUEUE_TPU_PALLAS", "0")
+    base, base_stats = run()
+    monkeypatch.setenv("KUEUE_TPU_PALLAS", "1")
+    with_pallas, p_stats = run()
+    monkeypatch.delenv("KUEUE_TPU_PALLAS")
+    jax.clear_caches()
+
+    assert base == with_pallas
+    assert base_stats["cycles"] == p_stats["cycles"]
+    assert len(base) > 0
