@@ -16,10 +16,10 @@ Reference: pkg/cache/queue/{manager.go,cluster_queue.go}.
 
 from __future__ import annotations
 
-import heapq
 import itertools
-from dataclasses import dataclass, field
 from typing import Optional
+
+from kueue_tpu.utils.native import make_indexed_heap
 
 from kueue_tpu.api.types import (
     ClusterQueue,
@@ -46,12 +46,6 @@ def scheduling_hash(wl: Workload, cluster_queue: str) -> tuple:
     )
 
 
-@dataclass(order=True)
-class _HeapItem:
-    sort_key: tuple
-    info: WorkloadInfo = field(compare=False)
-
-
 class PendingClusterQueue:
     """pkg/cache/queue/cluster_queue.go:124 (ClusterQueue pending heap)."""
 
@@ -59,7 +53,11 @@ class PendingClusterQueue:
         self.spec = spec
         self.name = spec.name
         self.manager = manager
-        self.heap: list[_HeapItem] = []
+        # Indexed heap (native C++ when available, Python fallback) —
+        # push-or-update / remove by id in O(log n), no stale entries.
+        self._hp = make_indexed_heap()
+        self._id_of: dict[str, int] = {}  # workload key -> heap id
+        self._entry_of: dict[int, tuple] = {}  # heap id -> (info, key)
         self.items: dict[str, WorkloadInfo] = {}  # key -> live entry
         self.inadmissible: dict[str, WorkloadInfo] = {}
         self.in_flight: Optional[str] = None  # popped, not yet requeued
@@ -78,16 +76,34 @@ class PendingClusterQueue:
             info.local_queue_fs_usage = usage
         return (usage, -wl.effective_priority, wl.creation_time, next(_seq))
 
+    def _heap_push(self, info: WorkloadInfo,
+                   sort_key: Optional[tuple] = None) -> None:
+        sort_key = sort_key if sort_key is not None else self._key(info)
+        id_ = self._id_of.get(info.key)
+        if id_ is None:
+            id_ = next(_seq)
+            self._id_of[info.key] = id_
+        self._entry_of[id_] = (info, sort_key)
+        self._hp.push(id_, sort_key[0], sort_key[1], sort_key[2],
+                      sort_key[3])
+
+    def _heap_remove(self, key: str) -> None:
+        id_ = self._id_of.pop(key, None)
+        if id_ is not None:
+            self._hp.remove(id_)
+            self._entry_of.pop(id_, None)
+
     def push_or_update(self, info: WorkloadInfo) -> None:
         """cluster_queue.go:356 (PushOrUpdate)."""
         key = info.key
         self.inadmissible.pop(key, None)
         self.items[key] = info
-        heapq.heappush(self.heap, _HeapItem(self._key(info), info))
+        self._heap_push(info)
 
     def delete(self, key: str) -> None:
         self.items.pop(key, None)
         self.inadmissible.pop(key, None)
+        self._heap_remove(key)
         if self.in_flight == key:
             self.in_flight = None
 
@@ -121,6 +137,7 @@ class PendingClusterQueue:
         for key, other in list(self.items.items()):
             if scheduling_hash(other.obj, self.name) == h:
                 del self.items[key]
+                self._heap_remove(key)
                 self.inadmissible[key] = other
 
     def queue_inadmissible(self) -> bool:
@@ -129,7 +146,7 @@ class PendingClusterQueue:
         moved = bool(self.inadmissible)
         for info in self.inadmissible.values():
             self.items[info.key] = info
-            heapq.heappush(self.heap, _HeapItem(self._key(info), info))
+            self._heap_push(info)
         self.inadmissible.clear()
         return moved
 
@@ -137,24 +154,27 @@ class PendingClusterQueue:
         """cluster_queue.go:715 (Pop) — skip stale heap entries; entries
         with a future requeueAt (eviction backoff, workload_types.go:774
         requeueState) are held back until due."""
-        held: list[_HeapItem] = []
+        held: list[tuple] = []  # (info, original sort key)
         result = None
-        while self.heap:
-            item = heapq.heappop(self.heap)
-            key = item.info.key
-            if self.items.get(key) is not item.info:
+        while True:
+            id_ = self._hp.pop()
+            if id_ is None:
+                break
+            info, sort_key = self._entry_of.pop(id_)
+            self._id_of.pop(info.key, None)
+            if self.items.get(info.key) is not info:
                 continue
-            requeue_at = item.info.obj.status.requeue_at
+            requeue_at = info.obj.status.requeue_at
             if (now is not None and requeue_at is not None
                     and requeue_at > now):
-                held.append(item)
+                held.append((info, sort_key))
                 continue
-            del self.items[key]
-            self.in_flight = key
-            result = item.info
+            del self.items[info.key]
+            self.in_flight = info.key
+            result = info
             break
-        for item in held:
-            heapq.heappush(self.heap, item)
+        for info, sort_key in held:
+            self._heap_push(info, sort_key)
         return result
 
     def pending(self) -> int:

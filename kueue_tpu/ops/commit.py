@@ -172,6 +172,10 @@ def commit_grouped(
     K = root_nodes.shape[1]
     BIGKEY = jnp.int64((1 << 62))
     lq = local_quota(subtree_quota, lend_limit)
+    # Invalid slots must never commit regardless of their kind value (the
+    # BIGKEY demotion alone is not a guarantee: valid non-quota-reserved
+    # keys also carry bit 62).
+    entry_kind = jnp.where(entry_valid, entry_kind, ENTRY_SKIP)
 
     member_ok = root_members >= 0
     members_safe = jnp.maximum(root_members, 0)

@@ -27,20 +27,13 @@ INT_MAX = (1 << 62)
 
 def leaf_states(free_capacity, tas_usage, assumed_usage, per_pod,
                 leaf_mask):
-    """Pods that fit per leaf — public entry; dispatches to the Pallas
-    kernel on TPU backends when the quantities are int32-exact
-    (ops/pallas_kernels.leaf_fit_counts), else the int64 jnp path."""
-    from kueue_tpu.ops.pallas_kernels import (
-        leaf_fit_counts_in_range,
-        pallas_enabled,
-    )
-    if pallas_enabled() and leaf_fit_counts_in_range(
-            free_capacity, tas_usage, assumed_usage, per_pod):
-        from kueue_tpu.ops.pallas_kernels import _leaf_pallas
-        return _leaf_pallas(free_capacity, tas_usage + assumed_usage,
-                            per_pod, leaf_mask)
-    return _leaf_states_jnp(free_capacity, tas_usage, assumed_usage,
-                            per_pod, leaf_mask)
+    """Pods that fit per leaf — public entry. Delegates to the single
+    dispatcher (ops/pallas_kernels.leaf_fit_counts): Pallas kernel on TPU
+    backends when the quantities are int32-exact, else the int64 jnp path
+    (_leaf_states_jnp)."""
+    from kueue_tpu.ops.pallas_kernels import leaf_fit_counts
+    return leaf_fit_counts(free_capacity, tas_usage, assumed_usage,
+                           per_pod, leaf_mask)
 
 
 @jax.jit

@@ -1,0 +1,191 @@
+"""ctypes bindings for the native (C++) runtime pieces.
+
+The reference's control-plane runtime is native (Go); this rebuild keeps
+the JAX/Pallas device path for the decision math and implements the
+host-runtime hot structures in C++ (native/kueue_native.cpp), loaded here
+via ctypes with a pure-Python fallback so the framework never hard-depends
+on a toolchain at import time.
+
+Currently bound: the indexed pending-queue heap (pkg/util/heap/heap.go;
+ordering of pkg/cache/queue/cluster_queue.go's heap less).
+
+Dispatch: `make_indexed_heap()` returns the native heap when the shared
+library is present (built on demand with `make -C native`, cached) and
+KUEUE_TPU_NATIVE != 0; else the Python implementation.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import heapq
+import os
+import subprocess
+import threading
+import warnings
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libkueue_native.so")
+
+_lib = None
+_lib_failed = False
+_build_thread: Optional[threading.Thread] = None
+_build_lock = threading.Lock()
+
+
+def _run_build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True,
+                       timeout=120, check=True)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        global _lib_failed
+        _lib_failed = True
+        warnings.warn(f"kueue_tpu native build failed ({e!r}); "
+                      "using the Python heap fallback")
+        return False
+
+
+def ensure_built(block: bool = True) -> bool:
+    """Make sure the native library exists. With block=False, kick off a
+    background build (once) and return immediately — callers get the
+    Python fallback until the build lands, so the first scheduler touch
+    never stalls on a compile."""
+    global _build_thread
+    if os.path.exists(_SO_PATH):
+        return True
+    if _lib_failed or not os.path.exists(
+            os.path.join(_NATIVE_DIR, "Makefile")):
+        return False
+    if block:
+        return _run_build()
+    with _build_lock:
+        if _build_thread is None:
+            _build_thread = threading.Thread(target=_run_build,
+                                             daemon=True)
+            _build_thread.start()
+    return False
+
+
+def _load_library() -> Optional[ctypes.CDLL]:
+    """Load the native library; None if unavailable (a background build
+    may still be in flight — later calls pick it up)."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if _lib_failed:
+        return None
+    if os.environ.get("KUEUE_TPU_NATIVE", "1") in ("0", "false", ""):
+        return None
+    if not ensure_built(block=False):
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    lib.kq_heap_new.restype = ctypes.c_void_p
+    lib.kq_heap_free.argtypes = [ctypes.c_void_p]
+    lib.kq_heap_push.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                 ctypes.c_double, ctypes.c_int64,
+                                 ctypes.c_double, ctypes.c_int64]
+    lib.kq_heap_remove.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.kq_heap_remove.restype = ctypes.c_int
+    lib.kq_heap_peek.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_int64)]
+    lib.kq_heap_peek.restype = ctypes.c_int
+    lib.kq_heap_pop.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_int64)]
+    lib.kq_heap_pop.restype = ctypes.c_int
+    lib.kq_heap_len.argtypes = [ctypes.c_void_p]
+    lib.kq_heap_len.restype = ctypes.c_int64
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load_library() is not None
+
+
+class NativeIndexedHeap:
+    """Min-heap over (usage, -priority, ts, seq) keyed by int id, with
+    O(log n) push-or-update and remove-by-id."""
+
+    def __init__(self):
+        self._lib = _load_library()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._h = self._lib.kq_heap_new()
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        h = getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.kq_heap_free(h)
+            self._h = None
+
+    def push(self, id_: int, usage: float, neg_priority: int, ts: float,
+             seq: int) -> None:
+        self._lib.kq_heap_push(self._h, id_, usage, neg_priority, ts, seq)
+
+    def remove(self, id_: int) -> bool:
+        return bool(self._lib.kq_heap_remove(self._h, id_))
+
+    def peek(self) -> Optional[int]:
+        out = ctypes.c_int64()
+        if self._lib.kq_heap_peek(self._h, ctypes.byref(out)):
+            return out.value
+        return None
+
+    def pop(self) -> Optional[int]:
+        out = ctypes.c_int64()
+        if self._lib.kq_heap_pop(self._h, ctypes.byref(out)):
+            return out.value
+        return None
+
+    def __len__(self) -> int:
+        return int(self._lib.kq_heap_len(self._h))
+
+
+class PyIndexedHeap:
+    """Pure-Python fallback with identical semantics (lazy deletion)."""
+
+    def __init__(self):
+        self._heap: list = []
+        self._live: dict[int, tuple] = {}
+
+    def push(self, id_: int, usage: float, neg_priority: int, ts: float,
+             seq: int) -> None:
+        key = (usage, neg_priority, ts, seq)
+        self._live[id_] = key
+        heapq.heappush(self._heap, (key, id_))
+
+    def remove(self, id_: int) -> bool:
+        return self._live.pop(id_, None) is not None
+
+    def _prune(self) -> None:
+        while self._heap and self._live.get(
+                self._heap[0][1]) != self._heap[0][0]:
+            heapq.heappop(self._heap)
+
+    def peek(self) -> Optional[int]:
+        self._prune()
+        return self._heap[0][1] if self._heap else None
+
+    def pop(self) -> Optional[int]:
+        self._prune()
+        if not self._heap:
+            return None
+        _, id_ = heapq.heappop(self._heap)
+        del self._live[id_]
+        return id_
+
+    def __len__(self) -> int:
+        return len(self._live)
+
+
+def make_indexed_heap():
+    if native_available():
+        return NativeIndexedHeap()
+    return PyIndexedHeap()

@@ -1,0 +1,146 @@
+// Native runtime for kueue_tpu: the pending-queue indexed heap.
+//
+// This is the rebuild's counterpart of the reference's typed heap
+// (pkg/util/heap/heap.go) that backs every ClusterQueue pending queue
+// (pkg/cache/queue/cluster_queue.go:124): a binary heap with O(log n)
+// push/update/remove by id, ordered by
+//   (afs_usage ASC, priority DESC, timestamp ASC, seq ASC)
+// — the cluster_queue.go heap "less" with the admission-fair-sharing
+// usage prefix. Exposed through a plain C ABI for ctypes
+// (kueue_tpu/utils/native.py); the Python heapq path remains the
+// fallback when the toolchain is unavailable.
+//
+// Build: make -C native   (g++ -O2 -shared -fPIC)
+
+#include <cstddef>
+#include <cstdint>
+#include <unordered_map>
+#include <vector>
+
+using std::size_t;
+
+namespace {
+
+struct Entry {
+  int64_t id;
+  double usage;        // AFS decayed usage (ascending)
+  int64_t neg_priority;  // -effective_priority (ascending == priority desc)
+  double ts;           // creation / queue-order timestamp (ascending)
+  int64_t seq;         // insertion tie-break (ascending)
+};
+
+inline bool less(const Entry& a, const Entry& b) {
+  if (a.usage != b.usage) return a.usage < b.usage;
+  if (a.neg_priority != b.neg_priority) return a.neg_priority < b.neg_priority;
+  if (a.ts != b.ts) return a.ts < b.ts;
+  return a.seq < b.seq;
+}
+
+class IndexedHeap {
+ public:
+  void push(const Entry& e) {
+    auto it = pos_.find(e.id);
+    if (it != pos_.end()) {
+      size_t i = it->second;
+      data_[i] = e;
+      if (!sift_up(i)) sift_down(i);
+      return;
+    }
+    data_.push_back(e);
+    pos_[e.id] = data_.size() - 1;
+    sift_up(data_.size() - 1);
+  }
+
+  bool remove(int64_t id) {
+    auto it = pos_.find(id);
+    if (it == pos_.end()) return false;
+    size_t i = it->second;
+    swap_at(i, data_.size() - 1);
+    pos_.erase(data_.back().id);
+    data_.pop_back();
+    if (i < data_.size()) {
+      if (!sift_up(i)) sift_down(i);
+    }
+    return true;
+  }
+
+  bool peek(int64_t* out) const {
+    if (data_.empty()) return false;
+    *out = data_[0].id;
+    return true;
+  }
+
+  bool pop(int64_t* out) {
+    if (!peek(out)) return false;
+    remove(*out);
+    return true;
+  }
+
+  int64_t size() const { return static_cast<int64_t>(data_.size()); }
+
+ private:
+  void swap_at(size_t i, size_t j) {
+    if (i == j) return;
+    std::swap(data_[i], data_[j]);
+    pos_[data_[i].id] = i;
+    pos_[data_[j].id] = j;
+  }
+
+  bool sift_up(size_t i) {
+    bool moved = false;
+    while (i > 0) {
+      size_t p = (i - 1) / 2;
+      if (!less(data_[i], data_[p])) break;
+      swap_at(i, p);
+      i = p;
+      moved = true;
+    }
+    return moved;
+  }
+
+  void sift_down(size_t i) {
+    size_t n = data_.size();
+    for (;;) {
+      size_t l = 2 * i + 1, r = 2 * i + 2, m = i;
+      if (l < n && less(data_[l], data_[m])) m = l;
+      if (r < n && less(data_[r], data_[m])) m = r;
+      if (m == i) return;
+      swap_at(i, m);
+      i = m;
+    }
+  }
+
+  std::vector<Entry> data_;
+  std::unordered_map<int64_t, size_t> pos_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kq_heap_new() { return new IndexedHeap(); }
+
+void kq_heap_free(void* h) { delete static_cast<IndexedHeap*>(h); }
+
+void kq_heap_push(void* h, int64_t id, double usage, int64_t neg_priority,
+                  double ts, int64_t seq) {
+  static_cast<IndexedHeap*>(h)->push({id, usage, neg_priority, ts, seq});
+}
+
+int kq_heap_remove(void* h, int64_t id) {
+  return static_cast<IndexedHeap*>(h)->remove(id) ? 1 : 0;
+}
+
+int kq_heap_peek(void* h, int64_t* out) {
+  return static_cast<IndexedHeap*>(h)->peek(out) ? 1 : 0;
+}
+
+int kq_heap_pop(void* h, int64_t* out) {
+  return static_cast<IndexedHeap*>(h)->pop(out) ? 1 : 0;
+}
+
+int64_t kq_heap_len(void* h) {
+  return static_cast<IndexedHeap*>(h)->size();
+}
+
+}  // extern "C"

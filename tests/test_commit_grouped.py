@@ -110,3 +110,31 @@ def test_grouped_matches_scan(seed):
     np.testing.assert_array_equal(slot_adm_scan, np.asarray(adm_grp))
     np.testing.assert_array_equal(np.asarray(usage_scan),
                                   np.asarray(usage_grp))
+
+
+def test_invalid_slots_never_commit():
+    """entry_valid=False must force SKIP even when the caller leaves a
+    committing kind on the slot."""
+    rng = np.random.default_rng(42)
+    w = random_world(rng, n_roots=2, cqs_per_root=2, depth_extra=0, R=1)
+    C, D = w["C"], w["D"]
+    from kueue_tpu.ops.quota import compute_level, compute_subtree_quota
+    level = compute_level(jnp.asarray(w["parent"]), D)
+    sq = compute_subtree_quota(jnp.asarray(w["nominal"]),
+                               jnp.asarray(w["lend_limit"]),
+                               jnp.asarray(w["parent"]), level, depth=D)
+    entry_fr = np.zeros((C, 1), np.int32)
+    entry_req = np.ones((C, 1), np.int64)
+    entry_kind = np.full(C, cops.ENTRY_FORCE, np.int32)
+    entry_valid = np.zeros(C, bool)  # nothing participates
+    adm, usage = cops.commit_grouped(
+        jnp.asarray(np.arange(C, dtype=np.int64)), jnp.asarray(entry_valid),
+        jnp.asarray(entry_fr), jnp.asarray(entry_req),
+        jnp.asarray(entry_kind), jnp.zeros(C, jnp.int32),
+        jnp.asarray(w["usage0"]), sq, jnp.asarray(w["lend_limit"]),
+        jnp.asarray(w["borrow_limit"]), jnp.asarray(w["nominal"]),
+        jnp.asarray(w["ancestors"]), jnp.asarray(w["root_members"]),
+        jnp.asarray(w["root_nodes"]), jnp.asarray(w["local_chain"]),
+        depth=D)
+    assert not np.asarray(adm).any()
+    np.testing.assert_array_equal(np.asarray(usage), w["usage0"])

@@ -1,0 +1,82 @@
+"""Native (C++) indexed heap vs the Python fallback: identical behavior
+under randomized push/update/remove/pop/peek sequences, and the pending
+queue works on either backend."""
+
+import random
+
+import pytest
+
+from kueue_tpu.utils.native import (
+    NativeIndexedHeap,
+    PyIndexedHeap,
+    ensure_built,
+    native_available,
+)
+
+ensure_built(block=True)  # deterministic backend for the parity tests
+
+
+@pytest.mark.skipif(not native_available(),
+                    reason="native toolchain unavailable")
+@pytest.mark.parametrize("seed", range(10))
+def test_native_matches_python(seed):
+    rng = random.Random(seed)
+    n, p = NativeIndexedHeap(), PyIndexedHeap()
+    ids = list(range(50))
+    for _ in range(400):
+        op = rng.random()
+        i = rng.choice(ids)
+        if op < 0.5:
+            args = (i, rng.choice([0.0, 1.5, 2.5]), rng.randrange(-5, 5),
+                    rng.random(), rng.randrange(1000))
+            n.push(*args)
+            p.push(*args)
+        elif op < 0.7:
+            assert n.remove(i) == p.remove(i)
+        elif op < 0.9:
+            assert n.pop() == p.pop()
+        else:
+            assert n.peek() == p.peek()
+        assert len(n) == len(p)
+    while True:
+        a, b = n.pop(), p.pop()
+        assert a == b
+        if a is None:
+            break
+
+
+def test_push_updates_in_place():
+    for hp in ([NativeIndexedHeap()] if native_available() else []) + [
+            PyIndexedHeap()]:
+        hp.push(1, 0.0, -5, 1.0, 1)  # high priority
+        hp.push(2, 0.0, -1, 2.0, 2)
+        assert hp.peek() == 1
+        hp.push(1, 0.0, 0, 1.0, 1)  # demote id 1 below id 2
+        assert hp.peek() == 2
+        assert len(hp) == 2
+        assert hp.pop() == 2
+        assert hp.pop() == 1
+        assert hp.pop() is None
+
+
+def test_pending_queue_ordering_on_active_backend():
+    """PendingClusterQueue ordering semantics hold regardless of heap
+    backend: priority desc, then creation time asc."""
+    from kueue_tpu.api.types import ClusterQueue, PodSet, Workload
+    from kueue_tpu.cache.queues import PendingClusterQueue
+    from kueue_tpu.workload_info import WorkloadInfo
+
+    pcq = PendingClusterQueue(ClusterQueue(name="cq"))
+    for name, prio, ts in [("a", 0, 3.0), ("b", 5, 2.0), ("c", 5, 1.0),
+                           ("d", 1, 0.0)]:
+        wl = Workload(name=name, queue_name="lq", creation_time=ts,
+                      priority=prio,
+                      pod_sets=(PodSet("main", 1, {"cpu": 1000}),))
+        pcq.push_or_update(WorkloadInfo(wl, "cq"))
+    order = []
+    while True:
+        info = pcq.pop()
+        if info is None:
+            break
+        order.append(info.obj.name)
+    assert order == ["c", "b", "d", "a"]
