@@ -139,6 +139,44 @@ def commit_scan(
     return admitted, usage_final
 
 
+def _commit_one_local(usage_l, c, entry_fr, entry_req, entry_kind,
+                      entry_borrows, subtree_quota, lq, borrow_limit,
+                      nominal, ancestors, local_chain, *, depth):
+    """Commit one entry (slot id c, -1 = none) against a root-local usage
+    carry [K, R]: gather along the chain, run _entry_verdict, bubble the
+    adds. Shared by the grouped classical and fair commits. Returns
+    (new_usage_l, fits)."""
+    ok = c >= 0
+    c_safe = jnp.maximum(c, 0)
+    frs = entry_fr[c_safe]
+    req = jnp.where(ok, entry_req[c_safe], 0)
+    frs_safe = jnp.maximum(frs, 0)
+
+    chain = jnp.concatenate(
+        [jnp.asarray([c_safe], jnp.int32), ancestors[c_safe]])
+    chain_ok = (chain >= 0) & ok
+    chain_safe = jnp.maximum(chain, 0)
+    loc = local_chain[c_safe]  # [D+1] positions into K
+    loc_safe = jnp.maximum(loc, 0)
+
+    g_sq = subtree_quota[chain_safe[:, None], frs_safe[None, :]]
+    g_lq = lq[chain_safe[:, None], frs_safe[None, :]]
+    g_bl = borrow_limit[chain_safe[:, None], frs_safe[None, :]]
+    g_usage = usage_l[loc_safe[:, None], frs_safe[None, :]]
+
+    kind = jnp.where(ok, entry_kind[c_safe], ENTRY_SKIP)
+    fits, adds = _entry_verdict(
+        g_sq, g_lq, g_bl, g_usage, chain_ok, frs, req, kind,
+        entry_borrows[c_safe], nominal[c_safe, frs_safe],
+        borrow_limit[c_safe, frs_safe], usage_l[loc_safe[0], frs_safe],
+        depth=depth)
+
+    new_usage = usage_l
+    for d in range(depth + 1):
+        new_usage = new_usage.at[loc_safe[d], frs_safe].add(adds[d])
+    return new_usage, fits & ok
+
+
 @partial(jax.jit, static_argnames=("depth",))
 def commit_grouped(
     entry_key,  # int64[C] commit-order sort key (lower = earlier)
@@ -190,36 +228,10 @@ def commit_grouped(
 
     def per_root(members, local_usage):
         def step(usage_l, c):  # usage_l: [K, R]
-            ok = c >= 0
-            c_safe = jnp.maximum(c, 0)
-            frs = entry_fr[c_safe]
-            req = jnp.where(ok, entry_req[c_safe], 0)
-            frs_safe = jnp.maximum(frs, 0)
-
-            chain = jnp.concatenate(
-                [jnp.asarray([c_safe], jnp.int32), ancestors[c_safe]])
-            chain_ok = (chain >= 0) & ok
-            chain_safe = jnp.maximum(chain, 0)
-            loc = local_chain[c_safe]  # [D+1] positions into K
-            loc_safe = jnp.maximum(loc, 0)
-
-            g_sq = subtree_quota[chain_safe[:, None], frs_safe[None, :]]
-            g_lq = lq[chain_safe[:, None], frs_safe[None, :]]
-            g_bl = borrow_limit[chain_safe[:, None], frs_safe[None, :]]
-            g_usage = usage_l[loc_safe[:, None], frs_safe[None, :]]
-
-            kind = jnp.where(ok, entry_kind[c_safe], ENTRY_SKIP)
-            fits, adds = _entry_verdict(
-                g_sq, g_lq, g_bl, g_usage, chain_ok, frs, req, kind,
-                entry_borrows[c_safe], nominal[c_safe, frs_safe],
-                borrow_limit[c_safe, frs_safe], usage_l[loc_safe[0],
-                                                        frs_safe],
-                depth=depth)
-
-            new_usage = usage_l
-            for d in range(depth + 1):
-                new_usage = new_usage.at[loc_safe[d], frs_safe].add(adds[d])
-            return new_usage, fits & ok
+            return _commit_one_local(
+                usage_l, c, entry_fr, entry_req, entry_kind, entry_borrows,
+                subtree_quota, lq, borrow_limit, nominal, ancestors,
+                local_chain, depth=depth)
 
         return jax.lax.scan(step, local_usage, members)
 
@@ -242,6 +254,160 @@ def commit_grouped(
         jnp.where(flat_nodes >= 0, flat_nodes, N)].set(
         flat_usage, mode="drop")
     return admitted, usage_final
+
+
+@partial(jax.jit, static_argnames=("depth", "num_flavors"))
+def commit_grouped_fair(
+    entry_valid,  # bool[C]
+    entry_fr,  # int32[C, S]
+    entry_req,  # int64[C, S]
+    entry_kind,  # int32[C]
+    entry_borrows,  # int32[C]
+    entry_priority,  # int64[C]
+    entry_ts,  # float64[C] creation time (ascending tiebreak)
+    usage0,  # int64[N, R]
+    subtree_quota, lend_limit, borrow_limit, nominal, ancestors,
+    potential,  # int64[N, R] from quota.derive_world
+    fair_weight,  # float64[N]
+    parent,  # int32[N]
+    root_members, root_nodes, local_chain,
+    *,
+    depth: int,
+    num_flavors: int,
+):
+    """Fair-sharing commit order (KEP 1714): the admission-side DRS
+    tournament (fair_sharing_iterator.go:47,125) fused with the grouped
+    commit. Per root subtree, repeat: simulate each candidate head's
+    usage on its ClusterQueue, compute the CQ's DominantResourceShare
+    (fair_sharing.go:140 — max over borrowed resources of
+    borrowed*1000/lendable, weighted by fairSharing.weight, zero-weight
+    borrowers last), pick the minimum (priority desc / timestamp asc
+    tiebreaks, fair_sharing_iterator.go:176), commit it against evolving
+    usage, and re-run — exactly the reference's pop-one-recompute loop,
+    but vmapped across roots on device.
+
+    Fast-path scope: single-level cohort trees (every CQ's parent is a
+    root). Exact full ties (equal share, priority, and timestamp) break
+    by CQ index rather than the reference's child-list insertion order.
+
+    Returns (admitted bool[C], round int32[C] commit round within the
+    root (-1 = not admitted), usage int64[N, R]).
+    """
+    N, R = usage0.shape
+    Rn, M = root_members.shape
+    S = entry_fr.shape[1]
+    NF = num_flavors
+    lq = local_quota(subtree_quota, lend_limit)
+    entry_kind = jnp.where(entry_valid, entry_kind, ENTRY_SKIP)
+    INF_F = jnp.float64(jnp.inf)
+
+    member_ok = root_members >= 0
+
+    # Per-root lendable[res]: the root's potentialAvailable summed over
+    # flavors (fair_sharing.go:177 calculateLendable with node=parent on
+    # a flat tree — the parent IS the root).
+    root_is = jnp.argmax(
+        jnp.where(root_nodes >= 0,
+                  parent[jnp.maximum(root_nodes, 0)] < 0, False),
+        axis=1)
+    root_id = jnp.take_along_axis(root_nodes, root_is[:, None],
+                                  axis=1)[:, 0]  # [Rn]
+    root_id_safe = jnp.maximum(root_id, 0)
+    lendable = jnp.sum(
+        jnp.minimum(potential[root_id_safe], INF).reshape(Rn, NF, S),
+        axis=1)  # int64[Rn, S] per resource
+
+    def per_root(r_i, members, m_ok, local_usage):
+        lend_r = lendable[r_i]  # [S]
+
+        def drs_keys(usage_l):
+            """(zero_weight_borrows, share) per member after simulated
+            addition of its nominated usage; [M] each. Computed for every
+            member — the caller masks dead ones in the tournament."""
+            c = jnp.maximum(members, 0)
+            frs = entry_fr[c]  # [M, S]
+            req = entry_req[c]  # [M, S]
+            frs_safe = jnp.maximum(frs, 0)
+            # Scatter per-resource requests onto the fr grid: [M, R].
+            add_fr = jnp.zeros((M, R), entry_req.dtype).at[
+                jnp.arange(M)[:, None],
+                jnp.where(frs >= 0, frs_safe, R - 1)].add(
+                jnp.where(frs >= 0, req, 0), mode="drop")
+            loc0 = local_chain[c, 0]  # CQ row in the local carry
+            cq_usage = usage_l[jnp.maximum(loc0, 0)]  # [M, R]
+            cq_sq = subtree_quota[c]  # [M, R]
+            borrowed = jnp.maximum(0, cq_usage + add_fr - cq_sq)
+            by_res = jnp.sum(borrowed.reshape(M, NF, S), axis=1)  # [M, S]
+            ratio_rs = jnp.where(
+                (by_res > 0) & (lend_r[None, :] > 0),
+                by_res.astype(jnp.float64) * 1000.0
+                / jnp.maximum(lend_r[None, :], 1).astype(jnp.float64),
+                0.0)
+            share = jnp.max(ratio_rs, axis=1)  # [M] unweighted
+            w = fair_weight[c]  # [M]
+            zwb = (w == 0) & (share > 0)
+            weighted = jnp.where(w > 0, share / jnp.maximum(w, 1e-300),
+                                 0.0)
+            key_share = jnp.where(zwb, share, weighted)
+            return zwb.astype(jnp.float64), key_share
+
+        def round_step(carry, r):
+            usage_l, remaining = carry
+            zwb, share = drs_keys(usage_l)
+            # Winner: lexicographic min over (zwb, share, -priority, ts,
+            # member index); invalid/committed/headless members sort last
+            # (a CQ without a pending head never competes for a round —
+            # rounds mirror the reference's pop order).
+            c = jnp.maximum(members, 0)
+            pri = entry_priority[c].astype(jnp.float64)
+            ts = entry_ts[c]
+            alive = remaining & m_ok & entry_valid[c]
+            big = jnp.where(alive, 0.0, INF_F)
+
+            def lex_min(keys):
+                mask = jnp.ones((M,), bool)
+                for k in keys:
+                    k = jnp.where(mask, k, INF_F)
+                    mask = mask & (k == jnp.min(k))
+                return jnp.argmax(mask)
+
+            win = lex_min([zwb + big, share + big, -pri + big, ts + big])
+            cw = jnp.where(jnp.any(alive), members[win], -1)
+
+            new_usage, fits = _commit_one_local(
+                usage_l, cw, entry_fr, entry_req, entry_kind,
+                entry_borrows, subtree_quota, lq, borrow_limit, nominal,
+                ancestors, local_chain, depth=depth)
+            remaining = remaining & ~(jnp.arange(M) == win)
+            return (new_usage, remaining), (cw, fits)
+
+        init = (local_usage, jnp.ones((M,), bool))
+        (final_usage, _), (win_seq, fit_seq) = jax.lax.scan(
+            round_step, init, jnp.arange(M))
+        return final_usage, win_seq, fit_seq
+
+    nodes_safe = jnp.maximum(root_nodes, 0)
+    init_local = jnp.where((root_nodes >= 0)[:, :, None],
+                           usage0[nodes_safe], 0)
+    final_local, win_seq, fit_seq = jax.vmap(per_root)(
+        jnp.arange(Rn), root_members, member_ok, init_local)
+
+    C = entry_valid.shape[0]
+    flat_win = win_seq.reshape(-1)
+    flat_fit = fit_seq.reshape(-1)
+    rounds = jnp.broadcast_to(jnp.arange(M)[None, :], (Rn, M)).reshape(-1)
+    target = jnp.where(flat_win >= 0, flat_win, C)
+    admitted = jnp.zeros((C,), bool).at[target].max(flat_fit, mode="drop")
+    entry_round = jnp.full((C,), -1, jnp.int32).at[
+        jnp.where(flat_fit, target, C)].max(
+        rounds.astype(jnp.int32), mode="drop")
+
+    flat_nodes = root_nodes.reshape(-1)
+    flat_usage = final_local.reshape(-1, R)
+    usage_final = usage0.at[
+        jnp.where(flat_nodes >= 0, flat_nodes, N)].set(
+        flat_usage, mode="drop")
+    return admitted, entry_round, usage_final
 
 
 def make_commit_order_key(has_qr, borrows, priority, ts_rank):

@@ -14,7 +14,8 @@ FlavorResources array programs:
     5. sequential-equivalent commit                 [ops/commit.commit_scan]
     6. park NoFit heads (BestEffortFIFO inadmissible semantics)
 
-Fast-path scope (round 1): classical ordering (no fair-sharing tournament),
+Fast-path scope: classical ordering AND flat-cohort fair sharing (the
+device DRS tournament, ops/commit.commit_grouped_fair via fair_mode);
 no-preemption-policy ClusterQueues decided entirely on device; workloads
 flagged `needs_oracle` (preemption candidates required) are returned for
 the host's sequential preemptor. Multi-podset workloads are pre-filtered
@@ -72,8 +73,11 @@ def _cycle_core(
     group_of_res, group_flavors, no_preemption, can_pwb, can_always_reclaim,
     best_effort, fung_borrow_try_next, fung_pref_preempt_first,
     root_members, root_nodes, local_chain,
+    wl_ts=None,  # float64[W] creation time (fair mode ordering)
+    fair_weight=None,  # float64[N]
     *,
     depth: int, num_resources: int, num_cqs: int,
+    fair_mode: bool = False, num_flavors: int = 1,
 ):
     W = pending.shape[0]
     C = num_cqs
@@ -110,13 +114,6 @@ def _cycle_core(
             group_flavors, no_preemption, can_pwb, fung_borrow_try_next,
             fung_pref_preempt_first, depth=depth, num_resources=S)
 
-    # 4. Commit order (scheduler.go:971).
-    key = cops.make_commit_order_key(
-        wl_has_qr[h_safe] & slot_valid, borrows,
-        jnp.where(slot_valid, wl_priority[h_safe], 0),
-        jnp.where(slot_valid, commit_rank[h_safe], (1 << 24) - 1))
-    order = jnp.argsort(key).astype(jnp.int32)
-
     # 5. Commit. Entry kinds: FIT commits; preempt-mode-no-candidates
     # reserves capacity unless the CQ can always reclaim
     # (scheduler.go:499); everything else skips.
@@ -130,14 +127,36 @@ def _cycle_core(
     # derived from CQ rows; the raw carry may predate aggregation).
     # Root-grouped: subtrees commit independently (ops/commit.py).
     full_usage = derived["usage"]
-    slot_admitted, usage_after = cops.commit_grouped(
-        key, slot_valid, usage_fr, h_req, kind, borrows, full_usage,
-        derived["subtree_quota"], lend_limit, borrow_limit, nominal,
-        ancestors, root_members, root_nodes, local_chain, depth=depth)
-
-    # Positions report the global commit order (scheduler.go:971 sort).
-    slot_position = jnp.zeros((C,), jnp.int32).at[order].set(
-        jnp.arange(C, dtype=jnp.int32))
+    if fair_mode:
+        # 4f/5f. Fair-sharing tournament ordering fused with the commit
+        # (fair_sharing_iterator.go:47): per-root DRS recomputation after
+        # every winner, on device.
+        slot_admitted, slot_round, _ = cops.commit_grouped_fair(
+            slot_valid, usage_fr, h_req, kind, borrows,
+            jnp.where(slot_valid, wl_priority[h_safe], 0),
+            jnp.where(slot_valid, wl_ts[h_safe], 0.0),
+            full_usage, derived["subtree_quota"], lend_limit, borrow_limit,
+            nominal, ancestors, derived["potential"], fair_weight, parent,
+            root_members, root_nodes, local_chain, depth=depth,
+            num_flavors=num_flavors)
+        # Positions: tournament round within the root (rounds are the
+        # reference's pop order; roots are independent).
+        slot_position = jnp.maximum(slot_round, 0)
+        key = slot_round.astype(jnp.int64)  # replay order for usage_clean
+    else:
+        # 4. Commit order (scheduler.go:971).
+        key = cops.make_commit_order_key(
+            wl_has_qr[h_safe] & slot_valid, borrows,
+            jnp.where(slot_valid, wl_priority[h_safe], 0),
+            jnp.where(slot_valid, commit_rank[h_safe], (1 << 24) - 1))
+        order = jnp.argsort(key).astype(jnp.int32)
+        slot_admitted, usage_after = cops.commit_grouped(
+            key, slot_valid, usage_fr, h_req, kind, borrows, full_usage,
+            derived["subtree_quota"], lend_limit, borrow_limit, nominal,
+            ancestors, root_members, root_nodes, local_chain, depth=depth)
+        # Positions report the global commit order (scheduler.go:971).
+        slot_position = jnp.zeros((C,), jnp.int32).at[order].set(
+            jnp.arange(C, dtype=jnp.int32))
     adm_target = jnp.where(slot_valid & slot_admitted, h_safe, W)
     wl_admitted = jnp.zeros((W,), bool).at[adm_target].set(True, mode="drop")
 
@@ -173,20 +192,23 @@ def _cycle_core(
 
 
 cycle_step = partial(jax.jit,
-                     static_argnames=("depth", "num_resources",
-                                      "num_cqs"))(_cycle_core)
+                     static_argnames=("depth", "num_resources", "num_cqs",
+                                      "fair_mode",
+                                      "num_flavors"))(_cycle_core)
 
 
-@partial(jax.jit, static_argnames=("depth", "num_resources", "num_cqs"))
+@partial(jax.jit, static_argnames=("depth", "num_resources", "num_cqs",
+                                   "fair_mode", "num_flavors"))
 def drain_loop(
     pending, inadmissible, usage, rank, commit_rank, wl_cq, wl_req,
     wl_priority, wl_has_qr, wl_hash, nominal, lend_limit, borrow_limit,
     parent, ancestors, height, group_of_res, group_flavors, no_preemption,
     can_pwb, can_always_reclaim, best_effort, fung_borrow_try_next,
     fung_pref_preempt_first, root_members, root_nodes, local_chain,
-    max_cycles,
+    max_cycles, wl_ts=None, fair_weight=None,
     *,
     depth: int, num_resources: int, num_cqs: int,
+    fair_mode: bool = False, num_flavors: int = 1,
 ):
     """Whole drain as ONE device program: run scheduling cycles until a
     cycle admits nothing (or max_cycles), recording per-workload verdicts.
@@ -211,8 +233,9 @@ def drain_loop(
             borrow_limit, parent, ancestors, height, group_of_res,
             group_flavors, no_preemption, can_pwb, can_always_reclaim,
             best_effort, fung_borrow_try_next, fung_pref_preempt_first,
-            root_members, root_nodes, local_chain,
-            depth=depth, num_resources=num_resources, num_cqs=num_cqs)
+            root_members, root_nodes, local_chain, wl_ts, fair_weight,
+            depth=depth, num_resources=num_resources, num_cqs=num_cqs,
+            fair_mode=fair_mode, num_flavors=num_flavors)
 
     max_cycles = jnp.asarray(max_cycles, jnp.int32)
 
@@ -249,10 +272,12 @@ class BatchedDrainSolver:
     integration (engine oracle mode) wraps the same step.
     """
 
-    def __init__(self, snapshot, pending_infos, max_depth: int = 4):
+    def __init__(self, snapshot, pending_infos, max_depth: int = 4,
+                 fair: bool = False):
         self.world = encode_snapshot(snapshot, max_depth=max_depth)
         self.wls = encode_workloads(self.world, pending_infos)
         self.infos = pending_infos
+        self.fair = fair
 
     def head_ranks(self) -> np.ndarray:
         """Heap order: priority desc, timestamp asc, stable by index
@@ -308,6 +333,8 @@ class BatchedDrainSolver:
             root_members=jnp.asarray(w.root_members),
             root_nodes=jnp.asarray(w.root_nodes),
             local_chain=jnp.asarray(w.local_chain),
+            wl_ts=jnp.asarray(wl.timestamp),
+            fair_weight=jnp.asarray(w.fair_weight),
         )
 
         # ONE device program for the whole drain (no per-cycle host sync).
@@ -315,7 +342,8 @@ class BatchedDrainSolver:
             drain_loop(pending, inadmissible, usage, **args,
                        max_cycles=max_cycles,
                        depth=w.depth, num_resources=w.num_resources,
-                       num_cqs=w.num_cqs)
+                       num_cqs=w.num_cqs, fair_mode=self.fair,
+                       num_flavors=max(w.num_flavors, 1))
         admit_cycle = np.asarray(admit_cycle)
         admit_pos = np.asarray(admit_pos)
         wl_flavor = np.asarray(wl_flavor)

@@ -14,7 +14,8 @@ Fallback triggers (conservative, correctness-first):
   * any pending workload not encodable on the fast path (multi-podset,
     partial admission, TAS, node selectors);
   * any head that would need the preemption oracle;
-  * fair sharing / AFS enabled;
+  * fair sharing over NESTED cohort trees (flat trees run the device DRS
+    tournament, ops/commit.commit_grouped_fair) or AFS enabled;
   * flavors with taints or topologies in any referenced CQ.
 """
 
@@ -49,7 +50,11 @@ class OracleBridge:
     def world_is_fast_path_safe(self) -> bool:
         eng = self.engine
         if eng.cycle.enable_fair_sharing:
-            return False
+            # Fair sharing runs on device for single-level cohort trees
+            # (commit_grouped_fair); deeper tournaments stay host-side.
+            for co in eng.cache.cohorts.values():
+                if co.parent:
+                    return False
         if getattr(eng, "afs", None) is not None:
             return False
         for rf in eng.cache.resource_flavors.values():
@@ -110,6 +115,8 @@ class OracleBridge:
             root_members=jnp.asarray(w.root_members),
             root_nodes=jnp.asarray(w.root_nodes),
             local_chain=jnp.asarray(w.local_chain),
+            wl_ts=jnp.asarray(wl.timestamp),
+            fair_weight=jnp.asarray(w.fair_weight),
         )
         pending = jnp.ones(W, bool)
         inadmissible = jnp.zeros(W, bool)
@@ -117,7 +124,9 @@ class OracleBridge:
         (new_pending, new_inadmissible, usage2, wl_admitted, slot_admitted,
          slot_position, flavor_of_res, any_oracle) = B.cycle_step(
             pending, inadmissible, usage, **args, depth=w.depth,
-            num_resources=w.num_resources, num_cqs=w.num_cqs)
+            num_resources=w.num_resources, num_cqs=w.num_cqs,
+            fair_mode=eng.cycle.enable_fair_sharing,
+            num_flavors=max(w.num_flavors, 1))
         if bool(any_oracle):
             return None  # preemption simulation required -> sequential
 
