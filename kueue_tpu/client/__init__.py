@@ -1,0 +1,17 @@
+"""Typed client layer — the rebuild's client-go analog.
+
+The reference generates a full clientset/informers/listers stack
+(client-go/, ~27k LoC) so external consumers program against typed
+interfaces instead of raw API machinery. Here the same roles are:
+
+  * clientset.KueueClient — typed per-kind CRUD handles over a running
+    engine (client-go/clientset/versioned/typed/...);
+  * informers.Informer / Lister — event-driven local caches with
+    add/update/delete handlers (client-go/informers, listers);
+  * http_client.RemoteClient — the same read surface over the serving
+    endpoint's REST API for out-of-process consumers.
+"""
+
+from kueue_tpu.client.clientset import KueueClient  # noqa: F401
+from kueue_tpu.client.informers import Informer  # noqa: F401
+from kueue_tpu.client.http_client import RemoteClient  # noqa: F401

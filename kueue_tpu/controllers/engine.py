@@ -72,6 +72,9 @@ class Engine:
             enable_fair_sharing=enable_fair_sharing)
         self.clock: float = 0.0
         self.events: list[EngineEvent] = []
+        # Watch fan-out (client-go informer analog): called with each
+        # EngineEvent as it is recorded.
+        self.event_listeners: list[Callable] = []
         self.metrics = EngineMetrics()
         from kueue_tpu.metrics.registry import MetricsRegistry
         self.registry = MetricsRegistry()
@@ -491,5 +494,13 @@ class Engine:
 
     def _event(self, kind: str, workload: str, cluster_queue: str = "",
                detail: str = "") -> None:
-        self.events.append(EngineEvent(self.clock, kind, workload,
-                                       cluster_queue, detail))
+        ev = EngineEvent(self.clock, kind, workload, cluster_queue, detail)
+        self.events.append(ev)
+        for fn in self.event_listeners:
+            # Handler errors must not unwind the scheduling cycle
+            # (client-go informers isolate handler panics the same way).
+            try:
+                fn(ev)
+            except Exception as e:  # noqa: BLE001
+                import warnings
+                warnings.warn(f"event listener {fn!r} raised: {e!r}")

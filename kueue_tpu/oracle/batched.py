@@ -150,7 +150,7 @@ def _cycle_core(
             jnp.where(slot_valid, wl_priority[h_safe], 0),
             jnp.where(slot_valid, commit_rank[h_safe], (1 << 24) - 1))
         order = jnp.argsort(key).astype(jnp.int32)
-        slot_admitted, usage_after = cops.commit_grouped(
+        slot_admitted, _ = cops.commit_grouped(
             key, slot_valid, usage_fr, h_req, kind, borrows, full_usage,
             derived["subtree_quota"], lend_limit, borrow_limit, nominal,
             ancestors, root_members, root_nodes, local_chain, depth=depth)

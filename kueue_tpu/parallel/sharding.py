@@ -36,10 +36,13 @@ def make_mesh(devices=None, axis: str = WL_AXIS) -> Mesh:
 
 
 def sharded_cycle_step(mesh: Mesh, depth: int, num_resources: int,
-                       num_cqs: int):
+                       num_cqs: int, fair_mode: bool = False,
+                       num_flavors: int = 1):
     """Build a pjit-ed cycle step with the workload axis sharded over the
     mesh. Returns a callable with the same signature as
-    oracle.batched.cycle_step (minus the static kwargs)."""
+    oracle.batched.cycle_step (minus the static kwargs); pass wl_ts and
+    fair_weight positionally after local_chain (required when
+    fair_mode=True, accepted otherwise)."""
     wl_sharded = NamedSharding(mesh, P(WL_AXIS))
     wl_sharded2 = NamedSharding(mesh, P(WL_AXIS, None))
     repl = NamedSharding(mesh, P())
@@ -74,6 +77,8 @@ def sharded_cycle_step(mesh: Mesh, depth: int, num_resources: int,
         repl2,  # root_members
         repl2,  # root_nodes
         repl2,  # local_chain
+        wl_sharded,  # wl_ts
+        repl,  # fair_weight
     )
     out_shardings = (
         wl_sharded,  # new_pending
@@ -87,7 +92,8 @@ def sharded_cycle_step(mesh: Mesh, depth: int, num_resources: int,
     )
 
     fn = partial(cycle_step.__wrapped__, depth=depth,
-                 num_resources=num_resources, num_cqs=num_cqs)
+                 num_resources=num_resources, num_cqs=num_cqs,
+                 fair_mode=fair_mode, num_flavors=num_flavors)
     return jax.jit(fn, in_shardings=in_shardings,
                    out_shardings=out_shardings)
 

@@ -1,0 +1,93 @@
+"""Dashboard page for the serving endpoint — the KueueViz equivalent.
+
+The reference ships a React dashboard over a Go REST/WebSocket backend
+(cmd/kueueviz). Here the backend REST surface already exists on the
+serving endpoint; this module serves a single self-contained HTML page
+that polls those JSON endpoints (/clusterqueues, /workloads,
+/clusterqueues/<cq>/pendingworkloads) and renders live queue state —
+no build step, no external assets.
+"""
+
+DASHBOARD_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>kueue-tpu dashboard</title>
+<style>
+  body { font-family: system-ui, sans-serif; margin: 1.5rem;
+         background: #fafafa; color: #1a1a1a; }
+  h1 { font-size: 1.3rem; }
+  h2 { font-size: 1.05rem; margin-top: 1.5rem; }
+  table { border-collapse: collapse; width: 100%; background: #fff; }
+  th, td { text-align: left; padding: .35rem .6rem;
+           border-bottom: 1px solid #e3e3e3; font-size: .85rem; }
+  th { background: #f0f0f0; }
+  .phase-Admitted { color: #0a7d32; }
+  .phase-Pending { color: #9a6b00; }
+  .phase-Finished { color: #666; }
+  #updated { color: #888; font-size: .75rem; }
+</style>
+</head>
+<body>
+<h1>kueue-tpu dashboard</h1>
+<div id="updated"></div>
+<h2>ClusterQueues</h2>
+<table id="cqs"><thead><tr>
+  <th>Name</th><th>Cohort</th><th>Pending</th><th>Admitted</th>
+  <th>Usage</th></tr></thead><tbody></tbody></table>
+<h2>Workloads</h2>
+<table id="wls"><thead><tr>
+  <th>Key</th><th>Queue</th><th>Status</th><th>Priority</th>
+  <th>Position</th></tr></thead><tbody></tbody></table>
+<script>
+async function getJSON(p) { const r = await fetch(p); return r.json(); }
+function fill(id, rows) {
+  const tb = document.querySelector(id + " tbody");
+  tb.innerHTML = "";
+  for (const cells of rows) {
+    const tr = document.createElement("tr");
+    for (const c of cells) {
+      const td = document.createElement("td");
+      if (typeof c === "object") { td.textContent = c.text;
+        td.className = c.cls || ""; }
+      else td.textContent = c;
+      tr.appendChild(td);
+    }
+    tb.appendChild(tr);
+  }
+}
+async function refresh() {
+  try {
+    const cqs = await getJSON("/clusterqueues");
+    const wls = await getJSON("/workloads");
+    const positions = {};
+    for (const cq of cqs) {
+      try {
+        const p = await getJSON("/clusterqueues/" + cq.name +
+                                "/pendingworkloads");
+        for (const it of p.items)
+          positions[it.namespace + "/" + it.name] =
+            it.position_in_cluster_queue;
+      } catch (e) {}
+    }
+    fill("#cqs", cqs.map(c => [c.name, c.cohort || "-",
+      c.pending ?? "-", c.admitted ?? "-",
+      JSON.stringify(c.usage || {})]));
+    fill("#wls", wls.map(w => {
+      const key = (w.namespace || "default") + "/" + w.name;
+      return [key, w.queue || w.local_queue || "-",
+        {text: w.status || "-", cls: "phase-" + (w.status || "")},
+        w.priority ?? 0, positions[key] ?? "-"];
+    }));
+    document.getElementById("updated").textContent =
+      "updated " + new Date().toLocaleTimeString();
+  } catch (e) {
+    document.getElementById("updated").textContent = "error: " + e;
+  }
+}
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

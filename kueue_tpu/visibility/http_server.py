@@ -34,7 +34,10 @@ def make_handler(engine):
         def do_GET(self):  # noqa: N802
             path = urlparse(self.path).path.rstrip("/")
             parts = [p for p in path.split("/") if p]
-            if path == "/metrics":
+            if path in ("", "/dashboard"):
+                from kueue_tpu.visibility.dashboard import DASHBOARD_HTML
+                self._send(DASHBOARD_HTML, content_type="text/html")
+            elif path == "/metrics":
                 self._send(engine.registry.render(),
                            content_type="text/plain")
             elif path == "/healthz":
