@@ -18,7 +18,12 @@ import jax
 import jax.numpy as jnp
 
 from kueue_tpu.api.types import INF
-from kueue_tpu.ops.quota import local_quota, sat_add, sat_sub
+from kueue_tpu.ops.quota import (
+    available_along_chain,
+    local_quota,
+    sat_add,
+    sat_sub,
+)
 
 
 ENTRY_SKIP = 0  # never commits (NoFit / ineligible slot)
@@ -27,6 +32,12 @@ ENTRY_RESERVE = 2  # preempt-mode w/o candidates: reserve capacity
 #   (scheduler.go:499 reserveCapacityForUnreclaimablePreempt)
 ENTRY_FORCE = 3  # adds full usage unconditionally (replay of a decided
 #   admission, e.g. the reservation-free second pass)
+ENTRY_PREEMPT = 4  # preempt-mode with device-selected targets: fit is
+#   checked with the entry's victims removed (scheduler.go:680 fits with
+#   preemption targets); on success the removal persists in the carry
+#   (victim usage is gone for later entries, like preempted_workloads)
+#   and the entry's usage is added, but the entry is PREEMPTING, not
+#   admitted (the job restarts only after evictions complete).
 
 
 def _entry_verdict(g_sq, g_lq, g_bl, g_usage, chain_ok, frs, req, kind,
@@ -39,29 +50,10 @@ def _entry_verdict(g_sq, g_lq, g_bl, g_usage, chain_ok, frs, req, kind,
     add at each chain level, already masked)."""
     active = (frs >= 0) & (req > 0)
     g_local_avail = jnp.maximum(0, sat_sub(g_lq, g_usage))
+    avail = available_along_chain(chain_ok, g_sq, g_lq, g_bl, g_usage,
+                                  depth=depth)
 
-    # available: walk root -> cq (resource_node.go:106). Root is the
-    # last valid chain node.
-    avail = jnp.zeros_like(req)  # [S]
-    for d in range(depth, -1, -1):
-        is_valid = chain_ok[d]
-        is_root = is_valid & (
-            (d == depth) | (~chain_ok[min(d + 1, depth)]))
-        root_avail = sat_sub(g_sq[d], g_usage[d])
-        stored = sat_sub(g_sq[d], g_lq[d])
-        used_in_parent = jnp.maximum(0, sat_sub(g_usage[d], g_lq[d]))
-        with_max = sat_add(sat_sub(stored, used_in_parent), g_bl[d])
-        clipped = jnp.where(g_bl[d] >= INF, avail,
-                            jnp.minimum(with_max, avail))
-        non_root_avail = sat_add(g_local_avail[d], clipped)
-        avail = jnp.where(
-            is_valid,
-            jnp.where(is_root, root_avail, non_root_avail),
-            avail)
-    # CQ-level clip at zero (clusterqueue_snapshot.go:170).
-    avail = jnp.maximum(0, avail)
-
-    fits = (kind == ENTRY_FIT) & jnp.all(
+    fits = ((kind == ENTRY_FIT) | (kind == ENTRY_PREEMPT)) & jnp.all(
         jnp.where(active, req <= avail, True))
 
     # Reservation amount (scheduler.go:708 quotaResourcesToReserve):
@@ -141,10 +133,15 @@ def commit_scan(
 
 def _commit_one_local(usage_l, c, entry_fr, entry_req, entry_kind,
                       entry_borrows, subtree_quota, lq, borrow_limit,
-                      nominal, ancestors, local_chain, *, depth):
+                      nominal, ancestors, local_chain, *, depth,
+                      entry_removal=None):
     """Commit one entry (slot id c, -1 = none) against a root-local usage
     carry [K, R]: gather along the chain, run _entry_verdict, bubble the
-    adds. Shared by the grouped classical and fair commits. Returns
+    adds. Shared by the grouped classical and fair commits.
+
+    entry_removal (int64[C, S], optional): per-entry victim usage for
+    ENTRY_PREEMPT slots — the fit check runs with it removed from the
+    entry's chain, and the removal persists on success. Returns
     (new_usage_l, fits)."""
     ok = c >= 0
     c_safe = jnp.maximum(c, 0)
@@ -165,13 +162,29 @@ def _commit_one_local(usage_l, c, entry_fr, entry_req, entry_kind,
     g_usage = usage_l[loc_safe[:, None], frs_safe[None, :]]
 
     kind = jnp.where(ok, entry_kind[c_safe], ENTRY_SKIP)
+
+    if entry_removal is not None:
+        from kueue_tpu.ops.preempt import _adjust_chain_usage
+        removal = jnp.where(ok & (kind == ENTRY_PREEMPT),
+                            entry_removal[c_safe], 0)
+        g_usage_adj = _adjust_chain_usage(g_usage, g_lq, removal,
+                                          depth=depth)
+        g_usage_adj = jnp.where(chain_ok[:, None], g_usage_adj, g_usage)
+    else:
+        g_usage_adj = g_usage
+
     fits, adds = _entry_verdict(
-        g_sq, g_lq, g_bl, g_usage, chain_ok, frs, req, kind,
+        g_sq, g_lq, g_bl, g_usage_adj, chain_ok, frs, req, kind,
         entry_borrows[c_safe], nominal[c_safe, frs_safe],
-        borrow_limit[c_safe, frs_safe], usage_l[loc_safe[0], frs_safe],
-        depth=depth)
+        borrow_limit[c_safe, frs_safe], g_usage_adj[0], depth=depth)
 
     new_usage = usage_l
+    if entry_removal is not None:
+        # Persist the removal on success (victims leave the carry).
+        delta = jnp.where(fits, g_usage_adj - g_usage, 0)
+        for d in range(depth + 1):
+            new_usage = new_usage.at[loc_safe[d], frs_safe].add(
+                jnp.where(chain_ok[d] & (frs >= 0), delta[d], 0))
     for d in range(depth + 1):
         new_usage = new_usage.at[loc_safe[d], frs_safe].add(adds[d])
     return new_usage, fits & ok
@@ -190,6 +203,7 @@ def commit_grouped(
     root_members,  # int32[Rn, M] CQ/slot ids per root, -1 pad
     root_nodes,  # int32[Rn, K] subtree node ids per root, -1 pad
     local_chain,  # int32[C, D+1] chain positions into the root's node row
+    entry_removal=None,  # int64[C, S] victim usage for ENTRY_PREEMPT slots
     *,
     depth: int,
 ):
@@ -231,7 +245,7 @@ def commit_grouped(
             return _commit_one_local(
                 usage_l, c, entry_fr, entry_req, entry_kind, entry_borrows,
                 subtree_quota, lq, borrow_limit, nominal, ancestors,
-                local_chain, depth=depth)
+                local_chain, depth=depth, entry_removal=entry_removal)
 
         return jax.lax.scan(step, local_usage, members)
 

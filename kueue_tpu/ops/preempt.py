@@ -1,0 +1,200 @@
+"""Batched within-ClusterQueue preemption: target selection on device.
+
+The reference's classical preemptor (preemption.go:277) is, for the
+within-CQ case (reclaimWithinCohort=Never — the candidate set is the
+preemptor's own CQ), a pure function of the cycle-start snapshot:
+
+  1. candidates = admitted workloads in the CQ that use any resource
+     needing preemption and satisfy withinClusterQueue policy
+     (common/preemption_policy.go:32);
+  2. sort by CandidatesOrdering (common/ordering.go:42 — evicted first,
+     priority asc, quota-reservation recency desc, uid);
+  3. greedily remove until the preemptor fits (prefix property: the set
+     removed after k steps is the first k candidates, so all prefixes
+     can be checked at once);
+  4. fill back (preemption.go:334): walk targets in reverse (skipping
+     the last), re-adding any whose re-addition keeps the fit.
+
+Here all C heads are solved together: candidate classification and
+ordering are masked sorts over the admitted-workload tensors, prefix
+fits is one [C, V] availability evaluation with exact usage-removal
+bubbling along the cohort chain, and fill-back is a short reverse scan
+bounded by V_MAX targets.
+
+Differential parity vs scheduler.preemption.Preemptor is enforced by
+tests/test_preempt_device.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from kueue_tpu.ops.quota import (
+    available_along_chain,
+    local_quota,
+    sat_sub,
+)
+
+# withinClusterQueue policy codes (api.types.PreemptionPolicy).
+POLICY_NEVER = 0
+POLICY_LOWER = 1
+POLICY_LOWER_OR_NEWER_EQ = 2
+POLICY_ANY = 3
+
+
+def _policy_ok(policy, p_pri, p_ts, c_pri, c_ts):
+    """common/preemption_policy.go:32."""
+    lower = p_pri > c_pri
+    newer_eq = (p_pri == c_pri) & (p_ts < c_ts)
+    return jnp.where(
+        policy == POLICY_LOWER, lower,
+        jnp.where(policy == POLICY_LOWER_OR_NEWER_EQ, lower | newer_eq,
+                  policy == POLICY_ANY))
+
+
+# The availability walk is shared with the commit fit check so the
+# kernel's "this victim set makes the entry fit" decision and the
+# commit's re-check can never drift apart.
+_avail_with_removal = available_along_chain
+
+
+def _adjust_chain_usage(g_usage, g_lq, removed, *, depth):
+    """Usage rows along the chain after removing `removed` [S] from the
+    CQ (row 0): the CQ row drops by `removed`; each ancestor drops by the
+    change in the child's above-local-quota overflow (the exact inverse
+    of the addUsage bubbling, resource_node.go:144)."""
+    rows = []
+    cq_old = g_usage[0]
+    cq_new = jnp.maximum(0, cq_old - removed)
+    rows.append(cq_new)
+    # Overflow contribution delta bubbles upward.
+    over_old = jnp.maximum(0, sat_sub(cq_old, g_lq[0]))
+    over_new = jnp.maximum(0, sat_sub(cq_new, g_lq[0]))
+    delta = over_old - over_new
+    for d in range(1, depth + 1):
+        a_old = g_usage[d]
+        a_new = jnp.maximum(0, a_old - delta)
+        rows.append(a_new)
+        over_old = jnp.maximum(0, sat_sub(a_old, g_lq[d]))
+        over_new = jnp.maximum(0, sat_sub(a_new, g_lq[d]))
+        delta = over_old - over_new
+    return jnp.stack(rows)
+
+
+@partial(jax.jit, static_argnames=("depth", "v_max"))
+def within_cq_targets(
+    slot_need,  # bool[C] head needs within-CQ preemption on this slot
+    slot_pri,  # int64[C] preemptor effective priority
+    slot_ts,  # float64[C] preemptor creation time
+    slot_fr,  # int32[C, S] chosen flavor-resource per resource (-1 none)
+    slot_req,  # int64[C, S] requested amount per resource
+    wcq_policy,  # int32[C] POLICY_* code per CQ
+    adm_cq,  # int32[A] admitted workload's CQ
+    adm_pri,  # int64[A]
+    adm_ts,  # float64[A] creation time
+    adm_qrt,  # float64[A] quota-reservation timestamp (recent = larger)
+    adm_uid,  # int64[A] uid rank (ascending tie-break)
+    adm_evicted,  # bool[A]
+    adm_usage,  # int64[A, R] usage on the fr grid
+    usage,  # int64[N, R] cycle-start usage (aggregated)
+    subtree_quota, lend_limit, borrow_limit, ancestors,
+    *,
+    depth: int,
+    v_max: int,
+):
+    """Returns per slot:
+      found bool[C] — a fitting target set exists within v_max victims
+      overflow bool[C] — needed more than v_max victims (host fallback)
+      target_mask bool[C, A] — admitted workloads to preempt
+      n_targets int32[C]
+    """
+    C, S = slot_req.shape
+    A = adm_cq.shape[0]
+    V = min(v_max, A)  # cannot take more victims than admitted rows
+    lq = local_quota(subtree_quota, lend_limit)
+
+    def per_slot(c, need, p_pri, p_ts, frs, req, policy):
+        frs_safe = jnp.maximum(frs, 0)
+        active = (frs >= 0) & (req > 0)
+
+        chain = jnp.concatenate(
+            [jnp.asarray([c], jnp.int32), ancestors[c]])
+        chain_ok = chain >= 0
+        chain_safe = jnp.maximum(chain, 0)
+        g_sq = subtree_quota[chain_safe[:, None], frs_safe[None, :]]
+        g_lq = lq[chain_safe[:, None], frs_safe[None, :]]
+        g_bl = borrow_limit[chain_safe[:, None], frs_safe[None, :]]
+        g_usage = usage[chain_safe[:, None], frs_safe[None, :]]
+
+        # Resources needing preemption: request exceeds current available.
+        avail0 = _avail_with_removal(chain_ok, g_sq, g_lq, g_bl, g_usage,
+                                     depth=depth)
+        need_fr = active & (req > avail0)
+
+        # Candidate classification (classifyPreemptionVariant, within-CQ).
+        cand_usage_s = adm_usage[:, frs_safe] * active[None, :]  # [A, S]
+        uses_any = jnp.any(jnp.where(need_fr[None, :], cand_usage_s > 0,
+                                     False), axis=1)
+        is_cand = need & (adm_cq == c) & uses_any & _policy_ok(
+            policy, p_pri, p_ts, adm_pri, adm_ts)
+
+        # CandidatesOrdering (common/ordering.go:42): evicted first,
+        # priority asc, admitted more recently first (reservation
+        # timestamp desc), uid asc; non-candidates last. lexsort's last
+        # key is the primary.
+        order = jnp.lexsort((
+            adm_uid,
+            -adm_qrt,
+            adm_pri,
+            jnp.where(adm_evicted, 0, 1),
+            jnp.where(is_cand, 0, 1),
+        )).astype(jnp.int32)
+
+        n_cand = jnp.sum(is_cand.astype(jnp.int32))
+        # Prefix removal sums over the first V candidates in order.
+        v_ids = order[:V]  # [V]
+        v_valid = is_cand[v_ids] & (jnp.arange(V) < n_cand)
+        v_usage = jnp.where(v_valid[:, None], cand_usage_s[v_ids], 0)
+        prefix = jnp.cumsum(v_usage, axis=0)  # [V, S] removed after k+1
+
+        def fits_with(removed):
+            adj = _adjust_chain_usage(g_usage, g_lq, removed, depth=depth)
+            avail = _avail_with_removal(chain_ok, g_sq, g_lq, g_bl, adj,
+                                        depth=depth)
+            return jnp.all(jnp.where(active, req <= avail, True))
+
+        fits_k = jax.vmap(fits_with)(prefix)  # [V] fits after k+1 removals
+        fits_k = fits_k & v_valid  # only meaningful where a victim exists
+        any_fit = jnp.any(fits_k)
+        kstar = jnp.argmax(fits_k)  # first k with fit (0-based)
+        overflow = need & ~any_fit & (n_cand > V)
+        found = need & any_fit
+
+        # Fill-back (preemption.go:334): reverse over targets 0..kstar-1
+        # (the last target, kstar, never fills back), re-adding any whose
+        # re-addition preserves the fit.
+        kept0 = (jnp.arange(V) <= kstar) & v_valid & found
+
+        def fb_step(kept, i):
+            idx = kstar - 1 - i  # reverse order, skipping the last
+            in_range = (idx >= 0) & found
+            idx_safe = jnp.maximum(idx, 0)
+            trial = kept & ~(jnp.arange(V) == idx_safe)
+            removed = jnp.sum(
+                jnp.where(trial[:, None], v_usage, 0), axis=0)
+            ok = in_range & kept[idx_safe] & fits_with(removed)
+            return jnp.where(ok, trial, kept), None
+
+        kept, _ = jax.lax.scan(fb_step, kept0, jnp.arange(V))
+
+        target_mask = jnp.zeros((A,), bool).at[
+            jnp.where(kept, v_ids, A)].set(True, mode="drop")
+        return found, overflow, target_mask, jnp.sum(
+            kept.astype(jnp.int32))
+
+    return jax.vmap(per_slot)(
+        jnp.arange(C, dtype=jnp.int32), slot_need, slot_pri, slot_ts,
+        slot_fr, slot_req, wcq_policy)

@@ -122,6 +122,33 @@ def compute_potential_available(subtree_quota, lend_limit, borrow_limit,
     return pot
 
 
+def available_along_chain(chain_ok, g_sq, g_lq, g_bl, g_usage, *, depth):
+    """available(fr) for a CQ given gathers along its ancestor chain
+    (resource_node.go:106 walked root -> cq): the root's headroom clipped
+    at each level by the child's borrowingLimit window, plus local
+    available; clipped at zero at the CQ (clusterqueue_snapshot.go:170).
+
+    chain_ok: bool[D+1] (index 0 = the CQ); g_*: [D+1, ...] gathers of
+    subtree_quota / local_quota / borrow_limit / usage. Shared by the
+    commit fit check (ops/commit._entry_verdict) and the preemption
+    kernel (ops/preempt)."""
+    local_avail = jnp.maximum(0, sat_sub(g_lq, g_usage))
+    avail = jnp.zeros_like(g_sq[0])
+    for d in range(depth, -1, -1):
+        is_valid = chain_ok[d]
+        is_root = is_valid & ((d == depth) | (~chain_ok[min(d + 1, depth)]))
+        root_avail = sat_sub(g_sq[d], g_usage[d])
+        stored = sat_sub(g_sq[d], g_lq[d])
+        used_in_parent = jnp.maximum(0, sat_sub(g_usage[d], g_lq[d]))
+        with_max = sat_add(sat_sub(stored, used_in_parent), g_bl[d])
+        clipped = jnp.where(g_bl[d] >= INF, avail,
+                            jnp.minimum(with_max, avail))
+        non_root = sat_add(local_avail[d], clipped)
+        avail = jnp.where(is_valid,
+                          jnp.where(is_root, root_avail, non_root), avail)
+    return jnp.maximum(0, avail)
+
+
 def compute_level(parent, depth: int):
     """Distance from root per node, as an array op."""
     level = jnp.zeros_like(parent)

@@ -75,6 +75,10 @@ def _cycle_core(
     root_members, root_nodes, local_chain,
     wl_ts=None,  # float64[W] creation time (fair mode ordering)
     fair_weight=None,  # float64[N]
+    slot_kind_override=None,  # int32[C] ENTRY_* (-1 = use computed kind);
+    #   set to ENTRY_PREEMPT/ENTRY_RESERVE by the bridge after device
+    #   preemption target selection (ops/preempt.within_cq_targets)
+    slot_removal=None,  # int64[C, S] victim usage for ENTRY_PREEMPT slots
     *,
     depth: int, num_resources: int, num_cqs: int,
     fair_mode: bool = False, num_flavors: int = 1,
@@ -123,6 +127,14 @@ def _cycle_core(
                   jnp.where((pmode == aops.P_NO_CANDIDATES)
                             & ~can_always_reclaim[h_cq],
                             cops.ENTRY_RESERVE, cops.ENTRY_SKIP)))
+    # Bridge-provided verdict overrides (device preemption): a slot with
+    # an override is no longer an oracle fallback.
+    overridden = jnp.zeros((C,), bool)
+    if slot_kind_override is not None:
+        overridden = slot_valid & (slot_kind_override >= 0)
+        kind = jnp.where(overridden, slot_kind_override, kind)
+        needs_oracle = needs_oracle & ~overridden
+    slot_oracle = needs_oracle & slot_valid
     # Commit against the freshly-aggregated full usage (cohort rows are
     # derived from CQ rows; the raw carry may predate aggregation).
     # Root-grouped: subtrees commit independently (ops/commit.py).
@@ -139,6 +151,7 @@ def _cycle_core(
             nominal, ancestors, derived["potential"], fair_weight, parent,
             root_members, root_nodes, local_chain, depth=depth,
             num_flavors=num_flavors)
+        slot_preempting = jnp.zeros((C,), bool)  # overrides: classical only
         # Positions: tournament round within the root (rounds are the
         # reference's pop order; roots are independent).
         slot_position = jnp.maximum(slot_round, 0)
@@ -150,10 +163,13 @@ def _cycle_core(
             jnp.where(slot_valid, wl_priority[h_safe], 0),
             jnp.where(slot_valid, commit_rank[h_safe], (1 << 24) - 1))
         order = jnp.argsort(key).astype(jnp.int32)
-        slot_admitted, _ = cops.commit_grouped(
+        slot_committed, _ = cops.commit_grouped(
             key, slot_valid, usage_fr, h_req, kind, borrows, full_usage,
             derived["subtree_quota"], lend_limit, borrow_limit, nominal,
-            ancestors, root_members, root_nodes, local_chain, depth=depth)
+            ancestors, root_members, root_nodes, local_chain,
+            slot_removal, depth=depth)
+        slot_admitted = slot_committed & (kind != cops.ENTRY_PREEMPT)
+        slot_preempting = slot_committed & (kind == cops.ENTRY_PREEMPT)
         # Positions report the global commit order (scheduler.go:971).
         slot_position = jnp.zeros((C,), jnp.int32).at[order].set(
             jnp.arange(C, dtype=jnp.int32))
@@ -162,8 +178,13 @@ def _cycle_core(
 
     # 6. Park NoFit / no-candidate heads on BestEffortFIFO CQs
     # (cluster_queue.go requeueIfNotPresent + inadmissible map).
+    # PREEMPT-overridden slots never park: with targets they are
+    # PREEMPTING (plain requeue awaiting evictions); a failed commit fit
+    # is a SKIPPED entry (plain requeue) in the reference.
+    preempt_override = overridden & (kind == cops.ENTRY_PREEMPT)
     parked_slot = slot_valid & ~slot_admitted & best_effort[h_cq] & (
-        (pmode == aops.P_NO_FIT) | (pmode == aops.P_NO_CANDIDATES))
+        (pmode == aops.P_NO_FIT) | (pmode == aops.P_NO_CANDIDATES)) \
+        & ~preempt_override
     wl_parked = jnp.zeros((W,), bool).at[
         jnp.where(parked_slot, h_safe, W)].set(True, mode="drop")
     # Scheduling-equivalence bulk parking (cluster_queue.go:615): pending
@@ -186,9 +207,10 @@ def _cycle_core(
         nominal, ancestors, root_members, root_nodes, local_chain,
         depth=depth)
 
-    any_needs_oracle = jnp.any(needs_oracle & slot_valid)
+    any_needs_oracle = jnp.any(slot_oracle)
     return (new_pending, new_inadmissible, usage_clean, wl_admitted,
-            slot_admitted, slot_position, flavor_of_res, any_needs_oracle)
+            slot_admitted, slot_position, flavor_of_res, any_needs_oracle,
+            slot_oracle, slot_preempting, head_idx)
 
 
 cycle_step = partial(jax.jit,
@@ -247,7 +269,8 @@ def drain_loop(
         (pending, inadmissible, usage, cycle, _, admit_cycle, admit_pos,
          wl_flavor, oracle_flag) = state
         (pending, inadmissible, usage, wl_admitted, _slot_admitted,
-         slot_position, flavor_of_res, any_oracle) = step(
+         slot_position, flavor_of_res, any_oracle, _slot_oracle,
+         _slot_preempting, _head_idx) = step(
             pending, inadmissible, usage)
         admit_cycle = jnp.where(wl_admitted, cycle, admit_cycle)
         admit_pos = jnp.where(wl_admitted, slot_position[wl_cq], admit_pos)

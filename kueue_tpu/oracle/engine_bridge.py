@@ -46,6 +46,8 @@ class OracleBridge:
         self.max_depth = max_depth
         self.cycles_on_device = 0
         self.cycles_fallback = 0
+        # Why try_cycle returned None, by label (diagnostics + tests).
+        self.fallback_reasons: dict[str, int] = {}
 
     def world_is_fast_path_safe(self) -> bool:
         eng = self.engine
@@ -62,6 +64,11 @@ class OracleBridge:
                 return False
         return True
 
+    def _fallback(self, reason: str) -> None:
+        self.fallback_reasons[reason] = \
+            self.fallback_reasons.get(reason, 0) + 1
+        return None
+
     def try_cycle(self) -> Optional[CycleResult]:
         """Attempt one batched cycle. Returns None to request sequential
         fallback (nothing has been mutated in that case)."""
@@ -71,23 +78,26 @@ class OracleBridge:
 
         eng = self.engine
         if not self.world_is_fast_path_safe():
-            return None
+            return self._fallback("world")
 
         # Gather all active pending workloads (without popping).
         pending_infos = []
         for pcq in eng.queues.cluster_queues.values():
             pending_infos.extend(pcq.items.values())
         if not pending_infos:
-            return None if any(
-                pcq.inadmissible for pcq in
-                eng.queues.cluster_queues.values()) else CycleResult()
+            if any(pcq.inadmissible for pcq in
+                   eng.queues.cluster_queues.values()):
+                # Only parked workloads remain; the sequential path owns
+                # the inadmissible re-queueing bookkeeping.
+                return self._fallback("idle-inadmissible")
+            return CycleResult()
 
         snapshot = eng.cache.snapshot()
         solver = B.BatchedDrainSolver(snapshot, pending_infos,
                                       max_depth=self.max_depth)
         wl = solver.wls
         if not wl.eligible.all():
-            return None
+            return self._fallback("ineligible-workload")
         w = solver.world
 
         W = wl.num_workloads
@@ -118,28 +128,187 @@ class OracleBridge:
             wl_ts=jnp.asarray(wl.timestamp),
             fair_weight=jnp.asarray(w.fair_weight),
         )
-        pending = jnp.ones(W, bool)
-        inadmissible = jnp.zeros(W, bool)
+        # Bucket-pad the workload axis so recurring cycles with varying
+        # pending counts reuse one compiled program per bucket.
+        Wp = max(64, 1 << (W - 1).bit_length())
+        if Wp != W:
+            pad = Wp - W
+            big = np.int64(1) << 40
+
+            def pad1(key, fill):
+                a = np.asarray(args[key])
+                args[key] = jnp.asarray(np.concatenate(
+                    [a, np.full((pad,) + a.shape[1:], fill, a.dtype)]))
+
+            pad1("rank", big)
+            pad1("commit_rank", big)
+            pad1("wl_cq", 0)
+            pad1("wl_req", 0)
+            pad1("wl_priority", 0)
+            pad1("wl_has_qr", False)
+            pad1("wl_hash", 0)
+            pad1("wl_ts", 0.0)
+        pending = jnp.asarray(np.arange(Wp) < W)
+        inadmissible = jnp.zeros(Wp, bool)
         usage = jnp.asarray(w.usage)
+        statics = dict(depth=w.depth, num_resources=w.num_resources,
+                       num_cqs=w.num_cqs,
+                       fair_mode=eng.cycle.enable_fair_sharing,
+                       num_flavors=max(w.num_flavors, 1))
+        out = B.cycle_step(pending, inadmissible, usage, **args, **statics)
         (new_pending, new_inadmissible, usage2, wl_admitted, slot_admitted,
-         slot_position, flavor_of_res, any_oracle) = B.cycle_step(
-            pending, inadmissible, usage, **args, depth=w.depth,
-            num_resources=w.num_resources, num_cqs=w.num_cqs,
-            fair_mode=eng.cycle.enable_fair_sharing,
-            num_flavors=max(w.num_flavors, 1))
+         slot_position, flavor_of_res, any_oracle, slot_oracle,
+         slot_preempting, head_idx) = out
+
+        preempt_targets: dict[int, list] = {}
         if bool(any_oracle):
-            return None  # preemption simulation required -> sequential
+            # Device preemption: within-CQ target selection for the
+            # flagged heads (ops/preempt.within_cq_targets); anything out
+            # of its scope falls back to the sequential preemptor.
+            res = self._device_preemption(
+                snapshot, w, solver.wls, args, statics, pending,
+                inadmissible, usage, np.asarray(slot_oracle),
+                np.asarray(flavor_of_res), np.asarray(head_idx))
+            if res is None:
+                return self._fallback("preemption-scope")
+            out, preempt_targets = res
+            (new_pending, new_inadmissible, usage2, wl_admitted,
+             slot_admitted, slot_position, flavor_of_res, any_oracle,
+             slot_oracle, slot_preempting, head_idx) = out
+            if bool(any_oracle):
+                return self._fallback("preemption-scope")
 
         self.cycles_on_device += 1
         return self._apply(solver, pending_infos,
                            np.asarray(wl_admitted),
                            np.asarray(new_inadmissible),
                            np.asarray(slot_position),
-                           np.asarray(flavor_of_res))
+                           np.asarray(flavor_of_res),
+                           slot_preempting=np.asarray(slot_preempting),
+                           head_idx=np.asarray(head_idx),
+                           preempt_targets=preempt_targets)
+
+    def _device_preemption(self, snapshot, w, wls, args, statics, pending,
+                           inadmissible, usage, slot_oracle, flavor_of_res,
+                           head_idx, v_max: int = 32):
+        """Run within-CQ preemption target selection on device and re-run
+        the cycle with kind overrides. Returns (outputs, targets_by_slot)
+        or None for sequential fallback."""
+        import jax.numpy as jnp
+
+        from kueue_tpu.api.types import (
+            BorrowWithinCohortPolicy,
+            PreemptionPolicy,
+        )
+        from kueue_tpu.ops import preempt as pops
+        from kueue_tpu.ops import quota as qops
+        from kueue_tpu.oracle import batched as B
+        from kueue_tpu.scheduler.preemption import IN_CLUSTER_QUEUE
+        from kueue_tpu.tensor.schema import encode_admitted
+
+        eng = self.engine
+        if eng.cycle.enable_fair_sharing:
+            return None
+        # Single-flavor worlds only: flavor choice cannot depend on the
+        # preemption simulation (flavorassigner preemption oracle).
+        if w.group_flavors.shape[2] > 1 and np.any(
+                w.group_flavors[:, :, 1:] >= 0):
+            return None
+
+        policy_code = {
+            PreemptionPolicy.LOWER_PRIORITY: pops.POLICY_LOWER,
+            PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY:
+                pops.POLICY_LOWER_OR_NEWER_EQ,
+        }
+        C = w.num_cqs
+        S = w.num_resources
+        flagged = np.nonzero(slot_oracle)[0]
+        wcq_policy = np.zeros(C, np.int32)
+        for ci in flagged:
+            spec = snapshot.cluster_queues[w.cq_names[ci]].spec
+            p = spec.preemption
+            bwc_never = (p.borrow_within_cohort is None
+                         or p.borrow_within_cohort.policy
+                         == BorrowWithinCohortPolicy.NEVER)
+            if (p.reclaim_within_cohort != PreemptionPolicy.NEVER
+                    or not bwc_never
+                    or p.within_cluster_queue not in policy_code):
+                return None
+            wcq_policy[ci] = policy_code[p.within_cluster_queue]
+
+        admitted = [info for cqs in snapshot.cluster_queues.values()
+                    for info in cqs.workloads.values()]
+        adm = encode_admitted(w, admitted, now=eng.clock)
+        if adm.num_admitted == 0:
+            return None
+
+        slot_need = np.zeros(C, bool)
+        slot_pri = np.zeros(C, np.int64)
+        slot_ts = np.zeros(C, np.float64)
+        slot_fr = np.full((C, S), -1, np.int32)
+        slot_req = np.zeros((C, S), np.int64)
+        for ci in flagged:
+            wid = head_idx[ci]
+            slot_need[ci] = True
+            slot_pri[ci] = wls.priority[wid]
+            slot_ts[ci] = wls.timestamp[wid]
+            # flavor_of_res holds flavor ids; the kernel addresses the
+            # dense flavor-resource grid (fr = flavor * S + resource).
+            slot_fr[ci] = np.where(flavor_of_res[ci] >= 0,
+                                   flavor_of_res[ci] * S + np.arange(S),
+                                   -1)
+            slot_req[ci] = wls.requests[wid]
+
+        derived = qops.derive_world(
+            jnp.asarray(w.nominal), jnp.asarray(w.lend_limit),
+            jnp.asarray(w.borrow_limit), usage, jnp.asarray(w.parent),
+            depth=w.depth)
+        found, overflow, mask, _n = pops.within_cq_targets(
+            jnp.asarray(slot_need), jnp.asarray(slot_pri),
+            jnp.asarray(slot_ts), jnp.asarray(slot_fr),
+            jnp.asarray(slot_req), jnp.asarray(wcq_policy),
+            jnp.asarray(adm.cq), jnp.asarray(adm.priority),
+            jnp.asarray(adm.timestamp), jnp.asarray(adm.qr_time),
+            jnp.asarray(adm.uid_rank), jnp.asarray(adm.evicted),
+            jnp.asarray(adm.usage), derived["usage"],
+            derived["subtree_quota"], jnp.asarray(w.lend_limit),
+            jnp.asarray(w.borrow_limit), jnp.asarray(w.ancestors),
+            depth=w.depth, v_max=v_max)
+        found = np.asarray(found)
+        if np.asarray(overflow).any():
+            return None  # more victims than v_max: host preemptor
+        mask = np.asarray(mask)
+
+        from kueue_tpu.ops import commit as cops
+        override = np.full(C, -1, np.int32)
+        removal = np.zeros((C, S), np.int64)
+        targets_by_slot: dict[int, list] = {}
+        for ci in flagged:
+            if found[ci]:
+                override[ci] = cops.ENTRY_PREEMPT
+                victims = np.nonzero(mask[ci])[0]
+                targets_by_slot[int(ci)] = [
+                    (admitted[v], IN_CLUSTER_QUEUE) for v in victims]
+                frs_safe = np.maximum(slot_fr[ci], 0)
+                vict_usage = adm.usage[victims][:, frs_safe].sum(axis=0)
+                removal[ci] = np.where(slot_fr[ci] >= 0, vict_usage, 0)
+            else:
+                override[ci] = (cops.ENTRY_SKIP
+                                if w.can_always_reclaim[ci]
+                                else cops.ENTRY_RESERVE)
+
+        out = B.cycle_step(
+            pending, inadmissible, usage, **args,
+            slot_kind_override=jnp.asarray(override),
+            slot_removal=jnp.asarray(removal), **statics)
+        return out, targets_by_slot
 
     def _apply(self, solver, pending_infos, wl_admitted, parked,
-               slot_position, flavor_of_res) -> CycleResult:
+               slot_position, flavor_of_res, slot_preempting=None,
+               head_idx=None, preempt_targets=None) -> CycleResult:
         """Apply verdicts through the engine's assume path."""
+        from kueue_tpu.scheduler.preemption import Target
+
         eng = self.engine
         w, wls = solver.world, solver.wls
         result = CycleResult()
@@ -165,6 +334,21 @@ class OracleBridge:
                               requeue_reason=RequeueReason.NO_FIT)
                 entry.inadmissible_msg = "NoFit (batched oracle)"
                 result.entries.append(entry)
+        if slot_preempting is not None and slot_preempting.any():
+            for ci in np.nonzero(slot_preempting)[0]:
+                wid = int(head_idx[ci])
+                info = pending_infos[wid]
+                entry = self._make_entry(info, w, wls, flavor_of_res, wid)
+                entry.status = EntryStatus.PREEMPTING
+                entry.preemption_targets = [
+                    Target(victim, reason)
+                    for victim, reason in preempt_targets.get(int(ci), [])]
+                entry.inadmissible_msg = (
+                    f"Preempting {len(entry.preemption_targets)} "
+                    "workload(s)")
+                eng._issue_preemptions(entry)
+                result.entries.append(entry)
+                result.stats.preempting += 1
         return result
 
     def _make_entry(self, info, w, wls, flavor_of_res, i) -> Entry:

@@ -89,6 +89,9 @@ def sharded_cycle_step(mesh: Mesh, depth: int, num_resources: int,
         repl,  # slot_position
         repl2,  # flavor_of_res
         repl,  # any_needs_oracle
+        repl,  # slot_oracle
+        repl,  # slot_preempting
+        repl,  # head_idx
     )
 
     fn = partial(cycle_step.__wrapped__, depth=depth,

@@ -99,7 +99,8 @@ def test_oracle_engine_continues_after_finish():
     assert w2.is_admitted
 
 
-def test_oracle_falls_back_for_preemption_worlds():
+def test_oracle_handles_within_cq_preemption_on_device():
+    """Within-CQ preemption runs on device (ops/preempt) — no fallback."""
     eng = make_engine(
         oracle=True, n_cqs=1, nominal=1000,
         preemption=ClusterQueuePreemption(
@@ -114,8 +115,32 @@ def test_oracle_falls_back_for_preemption_worlds():
     high = Workload(name="high", queue_name="lq0", priority=10,
                     pod_sets=(PodSet("main", 1, {"cpu": 800}),))
     eng.submit(high)
-    eng.schedule_once()  # needs the preemption oracle -> sequential
-    assert eng.oracle.cycles_fallback >= 1
+    eng.schedule_once()
+    assert eng.oracle.cycles_fallback == 0
     assert low.is_evicted
     eng.schedule_once()
+    assert high.is_admitted
+    assert eng.oracle.cycles_fallback == 0
+
+
+def test_oracle_falls_back_for_cross_cq_reclaim():
+    """Cohort reclaim preemption is out of the device kernel's scope."""
+    eng = make_engine(
+        oracle=True, n_cqs=2, nominal=1000,
+        preemption=ClusterQueuePreemption(
+            reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY))
+    eng.clock += 0.1
+    # cq1 borrows beyond nominal from the cohort.
+    for i in range(2):
+        eng.submit(Workload(name=f"borrow{i}", queue_name="lq1",
+                            priority=0,
+                            pod_sets=(PodSet("main", 1, {"cpu": 900}),)))
+        eng.schedule_once()
+    eng.clock += 0.1
+    high = Workload(name="high", queue_name="lq0", priority=10,
+                    pod_sets=(PodSet("main", 1, {"cpu": 900}),))
+    eng.submit(high)
+    for _ in range(4):
+        eng.schedule_once()
+    assert eng.oracle.cycles_fallback >= 1
     assert high.is_admitted

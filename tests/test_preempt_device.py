@@ -1,0 +1,159 @@
+"""Device within-CQ preemption vs the host Preemptor: target sets must
+match exactly on randomized single-flavor worlds."""
+
+import random
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from kueue_tpu.api.types import (  # noqa: E402
+    ClusterQueue,
+    ClusterQueuePreemption,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine  # noqa: E402
+from kueue_tpu.ops import preempt as pops  # noqa: E402
+from kueue_tpu.ops import quota as qops  # noqa: E402
+from kueue_tpu.scheduler.preemption import Preemptor  # noqa: E402
+from kueue_tpu.tensor.schema import (  # noqa: E402
+    encode_admitted,
+    encode_snapshot,
+)
+
+_POLICY_CODE = {
+    PreemptionPolicy.LOWER_PRIORITY: pops.POLICY_LOWER,
+    PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY:
+        pops.POLICY_LOWER_OR_NEWER_EQ,
+}
+
+
+def build_engine(rng, n_cqs, policy, nominal=4000):
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    for i in range(n_cqs):
+        eng.create_cluster_queue(ClusterQueue(
+            name=f"cq{i}", cohort="co",
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue=policy,
+                reclaim_within_cohort=PreemptionPolicy.NEVER),
+            resource_groups=(ResourceGroup(
+                ("cpu",),
+                (FlavorQuotas("default",
+                              {"cpu": ResourceQuota(nominal)}),)),)))
+        eng.create_local_queue(LocalQueue(f"lq{i}", "default", f"cq{i}"))
+    # Fill with low/mid priority admitted workloads.
+    for i in range(rng.randrange(6, 16)):
+        eng.clock += rng.random()
+        eng.submit(Workload(
+            name=f"low{i}", queue_name=f"lq{rng.randrange(n_cqs)}",
+            priority=rng.choice([0, 1, 2]),
+            pod_sets=(PodSet("main", 1,
+                             {"cpu": rng.choice([500, 900, 1300])}),)))
+    for _ in range(60):
+        r = eng.schedule_once()
+        if r is None or not r.assumed:
+            break
+    return eng
+
+
+def host_targets(eng, wl_info, now):
+    from kueue_tpu.scheduler.cycle import SchedulerCycle
+    snapshot = eng.cache.snapshot()
+    cyc = SchedulerCycle()
+    assignment, targets = cyc._get_assignments(wl_info, snapshot, now)
+    return assignment, sorted(t.workload.key for t in targets)
+
+
+def device_targets(eng, wl_info, assignment, now, v_max=16):
+    snapshot = eng.cache.snapshot()
+    world = encode_snapshot(snapshot, max_depth=4)
+    admitted = [info for cqs in snapshot.cluster_queues.values()
+                for info in cqs.workloads.values()]
+    adm = encode_admitted(world, admitted, now=now)
+    C = world.num_cqs
+    S = world.num_resources
+    ci = world.cq_names.index(wl_info.cluster_queue)
+
+    slot_need = np.zeros(C, bool)
+    slot_pri = np.zeros(C, np.int64)
+    slot_ts = np.zeros(C, np.float64)
+    slot_fr = np.full((C, S), -1, np.int32)
+    slot_req = np.zeros((C, S), np.int64)
+    wcq_policy = np.zeros(C, np.int32)
+    for i, name in enumerate(world.cq_names):
+        spec = snapshot.cluster_queues[name].spec
+        wcq_policy[i] = _POLICY_CODE.get(
+            spec.preemption.within_cluster_queue, pops.POLICY_NEVER)
+
+    slot_need[ci] = True
+    slot_pri[ci] = wl_info.obj.effective_priority
+    slot_ts[ci] = wl_info.obj.creation_time
+    for fr, v in assignment.usage.items():
+        s = world.resource_names.index(fr.resource)
+        slot_fr[ci, s] = world.fr_index(fr.flavor, fr.resource)
+        slot_req[ci, s] = v
+
+    usage = np.zeros((world.num_nodes, world.nominal.shape[1]), np.int64)
+    usage[:world.num_cqs] = world.usage[:world.num_cqs]
+    level = qops.compute_level(jnp.asarray(world.parent), world.depth)
+    derived = qops.derive_world(
+        jnp.asarray(world.nominal), jnp.asarray(world.lend_limit),
+        jnp.asarray(world.borrow_limit), jnp.asarray(usage),
+        jnp.asarray(world.parent), depth=world.depth)
+
+    found, overflow, mask, n = pops.within_cq_targets(
+        jnp.asarray(slot_need), jnp.asarray(slot_pri),
+        jnp.asarray(slot_ts), jnp.asarray(slot_fr),
+        jnp.asarray(slot_req), jnp.asarray(wcq_policy),
+        jnp.asarray(adm.cq), jnp.asarray(adm.priority),
+        jnp.asarray(adm.timestamp), jnp.asarray(adm.qr_time),
+        jnp.asarray(adm.uid_rank), jnp.asarray(adm.evicted),
+        jnp.asarray(adm.usage), derived["usage"],
+        derived["subtree_quota"], jnp.asarray(world.lend_limit),
+        jnp.asarray(world.borrow_limit), jnp.asarray(world.ancestors),
+        depth=world.depth, v_max=v_max)
+    found = bool(np.asarray(found)[ci])
+    mask = np.asarray(mask)[ci]
+    keys = sorted(adm.keys[i] for i in np.nonzero(mask)[0])
+    return found, keys, bool(np.asarray(overflow)[ci])
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("policy", [
+    PreemptionPolicy.LOWER_PRIORITY,
+    PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY,
+])
+def test_within_cq_targets_match_host(seed, policy):
+    rng = random.Random(1000 * seed + 7)
+    eng = build_engine(rng, n_cqs=rng.randrange(1, 4), policy=policy)
+    now = eng.clock + 1.0
+    eng.clock = now
+    # A high-priority head that may need preemption.
+    wl = Workload(name="pre", queue_name="lq0",
+                  priority=rng.choice([3, 5]),
+                  creation_time=now,
+                  pod_sets=(PodSet("main", 1,
+                                   {"cpu": rng.choice([1500, 2500])}),))
+    eng.submit(wl)
+    pcq = eng.queues.cluster_queues["cq0"]
+    info = pcq.items.get(wl.key) or next(iter(pcq.items.values()))
+
+    assignment, h_targets = host_targets(eng, info, now)
+    from kueue_tpu.scheduler.flavorassigner import Mode
+    if assignment.representative_mode() != Mode.PREEMPT:
+        pytest.skip("scenario did not require preemption")
+    d_found, d_targets, d_overflow = device_targets(eng, info, assignment,
+                                                    now)
+    assert not d_overflow
+    assert d_found == bool(h_targets)
+    assert d_targets == h_targets
