@@ -41,7 +41,14 @@ def scheduling_hash(wl: Workload, cluster_queue: str) -> tuple:
         wl.priority,
         tuple(sorted(
             (ps.name, ps.count, tuple(sorted(ps.requests.items())),
-             tuple(sorted(ps.node_selector.items())))
+             tuple(sorted(ps.node_selector.items())),
+             ps.min_count,
+             (ps.topology_request.mode.value,
+              ps.topology_request.level,
+              ps.topology_request.slice_level,
+              ps.topology_request.slice_size)
+             if ps.topology_request is not None else None,
+             ps.tolerations)
             for ps in wl.pod_sets)),
     )
 

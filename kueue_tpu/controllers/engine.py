@@ -267,6 +267,11 @@ class Engine:
 
     def attach_oracle(self, max_depth: int = 4) -> None:
         """Enable the batched TPU fast path for scheduling cycles."""
+        import jax
+
+        # The dense quota math uses int64 quantities with an INF sentinel
+        # (api.types.INF); the oracle is unusable without x64.
+        jax.config.update("jax_enable_x64", True)
         from kueue_tpu.oracle.engine_bridge import OracleBridge
         self.oracle = OracleBridge(self, max_depth=max_depth)
 
@@ -298,8 +303,21 @@ class Engine:
             for info in heads:
                 self.queues.requeue_workload(info, RequeueReason.GENERIC)
             return None
+        return self._sequential_cycle(heads)
+
+    def _sequential_cycle(self, heads, count_cycle: bool = True) \
+            -> CycleResult:
+        """The sequential decision path for a set of popped heads. Also
+        used by the oracle bridge for the host-handled cohort roots of a
+        hybrid cycle (roots never interact, so running them after the
+        device roots is cycle-equivalent). The bridge passes
+        count_cycle=False: the host tail is part of ONE hybrid cycle,
+        which schedule_once() counts and times as a whole."""
+        import time as _time
+
         t0 = _time.perf_counter()
-        self.metrics.admission_cycles += 1
+        if count_cycle:
+            self.metrics.admission_cycles += 1
         snapshot = self.cache.snapshot()
         already = set(self.cache.workloads)
         result = self.cycle.schedule(heads, snapshot, now=self.clock,
@@ -320,9 +338,10 @@ class Engine:
             m[cq_name] = m.get(cq_name, 0) + skips
             self.registry.counter("admission_cycle_preemption_skips").inc(
                 (cq_name,), skips)
-        outcome = "success" if result.assumed else "inadmissible"
-        self.registry.report_admission_attempt(
-            outcome, _time.perf_counter() - t0)
+        if count_cycle:
+            outcome = "success" if result.assumed else "inadmissible"
+            self.registry.report_admission_attempt(
+                outcome, _time.perf_counter() - t0)
         for name, pcq in self.queues.cluster_queues.items():
             self.registry.report_pending(name, len(pcq.items),
                                          len(pcq.inadmissible))

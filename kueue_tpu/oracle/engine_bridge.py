@@ -1,6 +1,4 @@
-"""Engine <-> batched-oracle bridge: run whole scheduling cycles on the
-accelerator when the pending population is fast-path eligible, falling
-back to the sequential decision core otherwise.
+"""Engine <-> batched-oracle bridge: hybrid device/host scheduling cycles.
 
 This is the serving-path form of the north star (BASELINE.json): the
 control plane snapshots its caches into dense tensors, the device solves
@@ -10,13 +8,26 @@ assume/patch path the sequential scheduler uses. The BestEffortFIFO
 sequential path remains both the fallback and the decision-equivalence
 oracle (tests/test_oracle_engine.py).
 
-Fallback triggers (conservative, correctness-first):
-  * any pending workload not encodable on the fast path (multi-podset,
-    partial admission, TAS, node selectors);
-  * any head that would need the preemption oracle;
+Hybrid partitioning (round 2): admissions only interact within a cohort
+root subtree (all quota math stays under the root), so eligibility is
+decided PER ROOT, not per cycle. A root runs on device unless one of its
+member ClusterQueues needs the host this cycle:
+  * its current head is not fast-path encodable (multi-podset, partial
+    admission, TAS, node selectors, uncovered resources);
+  * one of its flavors carries taints or a topology (host assigner path);
+  * its head needs preemption outside the device preemptor's scope
+    (non-classical ordering, reclaim/borrow-within-cohort, multi-flavor
+    resource groups, > v_max victims).
+Host roots are handed to the engine's sequential path in the same
+schedule_once() call (engine._sequential_cycle); because roots never
+share quota, device-then-host commit order is cycle-equivalent to the
+reference's single interleaved cycle (scheduler.go:286).
+
+Remaining whole-cycle fallbacks (conservative, correctness-first):
+  * admission fair sharing (AFS heap ordering is host-side);
   * fair sharing over NESTED cohort trees (flat trees run the device DRS
-    tournament, ops/commit.commit_grouped_fair) or AFS enabled;
-  * flavors with taints or topologies in any referenced CQ.
+    tournament, ops/commit.commit_grouped_fair);
+  * WaitForPodsReady admission blocking.
 """
 
 from __future__ import annotations
@@ -39,6 +50,8 @@ from kueue_tpu.scheduler.flavorassigner import (
     PodSetAssignment,
 )
 
+_HOST_BIG = np.int64(1) << 60
+
 
 class OracleBridge:
     def __init__(self, engine, max_depth: int = 4):
@@ -46,8 +59,11 @@ class OracleBridge:
         self.max_depth = max_depth
         self.cycles_on_device = 0
         self.cycles_fallback = 0
+        self.cycles_hybrid = 0  # device cycles with a host-root tail
         # Why try_cycle returned None, by label (diagnostics + tests).
         self.fallback_reasons: dict[str, int] = {}
+        # Why individual roots were handed to the host path.
+        self.host_root_reasons: dict[str, int] = {}
 
     def world_is_fast_path_safe(self) -> bool:
         eng = self.engine
@@ -59,9 +75,11 @@ class OracleBridge:
                     return False
         if getattr(eng, "afs", None) is not None:
             return False
-        for rf in eng.cache.resource_flavors.values():
-            if rf.node_taints or rf.topology_name:
-                return False
+        if (eng.pods_ready is not None
+                and eng.pods_ready.admission_blocked()):
+            # BlockAdmission (scheduler.go:535): the host path owns the
+            # hold-everything requeue bookkeeping.
+            return False
         return True
 
     def _fallback(self, reason: str) -> None:
@@ -69,9 +87,66 @@ class OracleBridge:
             self.fallback_reasons.get(reason, 0) + 1
         return None
 
+    def _host_root(self, reason: str, count: int = 1) -> None:
+        self.host_root_reasons[reason] = \
+            self.host_root_reasons.get(reason, 0) + count
+
+    def _cq_flavor_safe(self, snapshot, w) -> np.ndarray:
+        """bool[C]: none of the CQ's flavors carries taints or a topology
+        (those route through the host flavorassigner/TAS path)."""
+        eng = self.engine
+        safe = np.ones(w.num_cqs, bool)
+        for ci, name in enumerate(w.cq_names):
+            spec = snapshot.cluster_queues[name].spec
+            for rg in spec.resource_groups:
+                for fq in rg.flavors:
+                    rf = eng.cache.resource_flavors.get(fq.name)
+                    if rf is not None and (rf.node_taints
+                                           or rf.topology_name):
+                        safe[ci] = False
+        return safe
+
+    def _cq_preempt_scope(self, snapshot, w):
+        """Per-CQ device-preemption scope: classical ordering, within-CQ
+        candidates only (reclaimWithinCohort=Never, borrowWithinCohort
+        Never), a supported withinClusterQueue policy, and single-flavor
+        resource groups (flavor choice independent of the preemption
+        simulation). Returns (ok bool[C], policy int32[C])."""
+        from kueue_tpu.api.types import (
+            BorrowWithinCohortPolicy,
+            PreemptionPolicy,
+        )
+        from kueue_tpu.ops import preempt as pops
+
+        policy_code = {
+            PreemptionPolicy.LOWER_PRIORITY: pops.POLICY_LOWER,
+            PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY:
+                pops.POLICY_LOWER_OR_NEWER_EQ,
+        }
+        C = w.num_cqs
+        ok = np.zeros(C, bool)
+        policy = np.zeros(C, np.int32)
+        if w.group_flavors.shape[2] > 1:
+            multi_flavor = np.any(w.group_flavors[:, :, 1:] >= 0,
+                                  axis=(1, 2))
+        else:
+            multi_flavor = np.zeros(C, bool)
+        for ci, name in enumerate(w.cq_names):
+            p = snapshot.cluster_queues[name].spec.preemption
+            bwc_never = (p.borrow_within_cohort is None
+                         or p.borrow_within_cohort.policy
+                         == BorrowWithinCohortPolicy.NEVER)
+            if (p.reclaim_within_cohort == PreemptionPolicy.NEVER
+                    and bwc_never
+                    and p.within_cluster_queue in policy_code
+                    and not multi_flavor[ci]):
+                ok[ci] = True
+                policy[ci] = policy_code[p.within_cluster_queue]
+        return ok, policy
+
     def try_cycle(self) -> Optional[CycleResult]:
-        """Attempt one batched cycle. Returns None to request sequential
-        fallback (nothing has been mutated in that case)."""
+        """Attempt one hybrid cycle. Returns None to request full
+        sequential fallback (nothing has been mutated in that case)."""
         import jax.numpy as jnp
 
         from kueue_tpu.oracle import batched as B
@@ -96,13 +171,60 @@ class OracleBridge:
         solver = B.BatchedDrainSolver(snapshot, pending_infos,
                                       max_depth=self.max_depth)
         wl = solver.wls
-        if not wl.eligible.all():
-            return self._fallback("ineligible-workload")
         w = solver.world
-
         W = wl.num_workloads
+        C = w.num_cqs
+        Rn = w.root_members.shape[0]
+        now = eng.clock
+
+        # --- host-side head + root partitioning ---
+        ready = np.fromiter(
+            ((i.obj.status.requeue_at is None
+              or i.obj.status.requeue_at <= now) for i in pending_infos),
+            bool, count=W)
+        active = ready & (wl.cq >= 0)
+        rank = solver.head_ranks()
+        cq_safe_idx = np.maximum(wl.cq, 0)
+        eff = np.where(active, rank, _HOST_BIG)
+        head_rank = np.full(C, _HOST_BIG, np.int64)
+        np.minimum.at(head_rank, cq_safe_idx,
+                      np.where(wl.cq >= 0, eff, _HOST_BIG))
+        has_head = head_rank < _HOST_BIG
+        is_head = active & (wl.cq >= 0) & (eff == head_rank[cq_safe_idx])
+        head_wid = np.full(C, -1, np.int64)
+        head_wid[wl.cq[is_head]] = np.nonzero(is_head)[0]
+
+        head_eligible = np.zeros(C, bool)
+        head_eligible[has_head] = wl.eligible[head_wid[has_head]]
+        flavor_safe = self._cq_flavor_safe(snapshot, w)
+
+        root_of_cq = np.zeros(C, np.int32)
+        for ri in range(Rn):
+            ms = w.root_members[ri]
+            root_of_cq[ms[ms >= 0]] = ri
+        host_root = np.zeros(Rn, bool)
+
+        def demote(cq_mask: np.ndarray, reason: str) -> None:
+            """Hand every root containing a flagged CQ to the host path;
+            reason counters are per newly-demoted root."""
+            roots = np.unique(root_of_cq[cq_mask])
+            new = roots[~host_root[roots]]
+            if new.size:
+                self._host_root(reason, int(new.size))
+                host_root[new] = True
+
+        demote(has_head & ~head_eligible, "head-ineligible")
+        demote(has_head & ~flavor_safe, "flavor-unsafe")
+        cq_on_device = ~host_root[root_of_cq]
+
+        device_w = active & wl.eligible & (wl.cq >= 0) \
+            & cq_on_device[cq_safe_idx]
+        if not device_w.any():
+            return self._fallback("all-host")
+
+        # --- device cycle ---
         args = dict(
-            rank=jnp.asarray(solver.head_ranks()),
+            rank=jnp.asarray(rank),
             commit_rank=jnp.asarray(solver.commit_ranks()),
             wl_cq=jnp.asarray(wl.cq), wl_req=jnp.asarray(wl.requests),
             wl_priority=jnp.asarray(wl.priority),
@@ -131,6 +253,7 @@ class OracleBridge:
         # Bucket-pad the workload axis so recurring cycles with varying
         # pending counts reuse one compiled program per bucket.
         Wp = max(64, 1 << (W - 1).bit_length())
+        device_w_padded = device_w
         if Wp != W:
             pad = Wp - W
             big = np.int64(1) << 40
@@ -148,7 +271,9 @@ class OracleBridge:
             pad1("wl_has_qr", False)
             pad1("wl_hash", 0)
             pad1("wl_ts", 0.0)
-        pending = jnp.asarray(np.arange(Wp) < W)
+            device_w_padded = np.concatenate(
+                [device_w, np.zeros(pad, bool)])
+        pending = jnp.asarray(device_w_padded)
         inadmissible = jnp.zeros(Wp, bool)
         usage = jnp.asarray(w.usage)
         statics = dict(depth=w.depth, num_resources=w.num_resources,
@@ -162,44 +287,85 @@ class OracleBridge:
 
         preempt_targets: dict[int, list] = {}
         if bool(any_oracle):
-            # Device preemption: within-CQ target selection for the
-            # flagged heads (ops/preempt.within_cq_targets); anything out
-            # of its scope falls back to the sequential preemptor.
-            res = self._device_preemption(
-                snapshot, w, solver.wls, args, statics, pending,
-                inadmissible, usage, np.asarray(slot_oracle),
-                np.asarray(flavor_of_res), np.asarray(head_idx))
-            if res is None:
-                return self._fallback("preemption-scope")
-            out, preempt_targets = res
-            (new_pending, new_inadmissible, usage2, wl_admitted,
-             slot_admitted, slot_position, flavor_of_res, any_oracle,
-             slot_oracle, slot_preempting, head_idx) = out
-            if bool(any_oracle):
-                return self._fallback("preemption-scope")
+            flagged = np.asarray(slot_oracle)
+            preempt_ok, wcq_policy = self._cq_preempt_scope(snapshot, w)
+            if eng.cycle.enable_fair_sharing:
+                preempt_ok[:] = False
+            out_scope = flagged & ~preempt_ok
+            if out_scope.any():
+                demote(out_scope, "preemption-scope")
+                cq_on_device = ~host_root[root_of_cq]
+            in_scope = flagged & preempt_ok & cq_on_device
+            if in_scope.any():
+                res = self._device_preemption(
+                    snapshot, w, solver.wls, args, statics, pending,
+                    inadmissible, usage, in_scope, wcq_policy,
+                    np.asarray(flavor_of_res), np.asarray(head_idx))
+                out, preempt_targets, overflow = res
+                (new_pending, new_inadmissible, usage2, wl_admitted,
+                 slot_admitted, slot_position, flavor_of_res, any_oracle,
+                 slot_oracle, slot_preempting, head_idx) = out
+                if overflow.any():
+                    # More victims than v_max: the host preemptor owns
+                    # those roots this cycle.
+                    demote(overflow, "preemption-overflow")
+                    cq_on_device = ~host_root[root_of_cq]
+            # Defensive: any slot still flagged must be on a host root.
+            still = np.asarray(slot_oracle) & cq_on_device
+            if still.any():
+                demote(still, "unexpected-oracle-flag")
+                cq_on_device = ~host_root[root_of_cq]
 
         self.cycles_on_device += 1
-        return self._apply(solver, pending_infos,
-                           np.asarray(wl_admitted),
-                           np.asarray(new_inadmissible),
-                           np.asarray(slot_position),
-                           np.asarray(flavor_of_res),
-                           slot_preempting=np.asarray(slot_preempting),
-                           head_idx=np.asarray(head_idx),
-                           preempt_targets=preempt_targets)
+        apply_rows = device_w & cq_on_device[cq_safe_idx]
+        result = self._apply(solver, pending_infos,
+                             np.asarray(wl_admitted),
+                             np.asarray(new_inadmissible),
+                             np.asarray(slot_position),
+                             np.asarray(flavor_of_res),
+                             apply_rows=apply_rows,
+                             slot_mask=cq_on_device,
+                             slot_preempting=np.asarray(slot_preempting),
+                             head_idx=np.asarray(head_idx),
+                             preempt_targets=preempt_targets)
+
+        # --- host tail: sequential cycle over the host roots ---
+        host_cqs = np.nonzero(has_head & ~cq_on_device)[0]
+        if host_cqs.size:
+            self.cycles_hybrid += 1
+            heads = []
+            for ci in host_cqs:
+                pcq = eng.queues.cluster_queues.get(w.cq_names[ci])
+                if pcq is None:
+                    continue
+                h = pcq.pop(now)
+                if h is not None:
+                    heads.append(h)
+            if heads:
+                host_result = eng._sequential_cycle(heads,
+                                                    count_cycle=False)
+                result.entries.extend(host_result.entries)
+                result.inadmissible.extend(host_result.inadmissible)
+                st, hst = result.stats, host_result.stats
+                st.admitted += hst.admitted
+                st.preempting += hst.preempting
+                st.skipped += hst.skipped
+                st.inadmissible += hst.inadmissible
+                for k, v in hst.preemption_skips.items():
+                    st.preemption_skips[k] = \
+                        st.preemption_skips.get(k, 0) + v
+        return result
 
     def _device_preemption(self, snapshot, w, wls, args, statics, pending,
-                           inadmissible, usage, slot_oracle, flavor_of_res,
-                           head_idx, v_max: int = 32):
-        """Run within-CQ preemption target selection on device and re-run
-        the cycle with kind overrides. Returns (outputs, targets_by_slot)
-        or None for sequential fallback."""
+                           inadmissible, usage, in_scope, wcq_policy,
+                           flavor_of_res, head_idx, v_max: int = 32):
+        """Run within-CQ preemption target selection on device for the
+        in-scope flagged slots and re-run the cycle with kind overrides.
+        Returns (outputs, targets_by_slot, overflow bool[C]); overflow
+        slots' roots must be handed to the host preemptor by the caller."""
         import jax.numpy as jnp
 
-        from kueue_tpu.api.types import (
-            BorrowWithinCohortPolicy,
-            PreemptionPolicy,
-        )
+        from kueue_tpu.ops import commit as cops
         from kueue_tpu.ops import preempt as pops
         from kueue_tpu.ops import quota as qops
         from kueue_tpu.oracle import batched as B
@@ -207,40 +373,13 @@ class OracleBridge:
         from kueue_tpu.tensor.schema import encode_admitted
 
         eng = self.engine
-        if eng.cycle.enable_fair_sharing:
-            return None
-        # Single-flavor worlds only: flavor choice cannot depend on the
-        # preemption simulation (flavorassigner preemption oracle).
-        if w.group_flavors.shape[2] > 1 and np.any(
-                w.group_flavors[:, :, 1:] >= 0):
-            return None
-
-        policy_code = {
-            PreemptionPolicy.LOWER_PRIORITY: pops.POLICY_LOWER,
-            PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY:
-                pops.POLICY_LOWER_OR_NEWER_EQ,
-        }
         C = w.num_cqs
         S = w.num_resources
-        flagged = np.nonzero(slot_oracle)[0]
-        wcq_policy = np.zeros(C, np.int32)
-        for ci in flagged:
-            spec = snapshot.cluster_queues[w.cq_names[ci]].spec
-            p = spec.preemption
-            bwc_never = (p.borrow_within_cohort is None
-                         or p.borrow_within_cohort.policy
-                         == BorrowWithinCohortPolicy.NEVER)
-            if (p.reclaim_within_cohort != PreemptionPolicy.NEVER
-                    or not bwc_never
-                    or p.within_cluster_queue not in policy_code):
-                return None
-            wcq_policy[ci] = policy_code[p.within_cluster_queue]
+        flagged = np.nonzero(in_scope)[0]
 
         admitted = [info for cqs in snapshot.cluster_queues.values()
                     for info in cqs.workloads.values()]
         adm = encode_admitted(w, admitted, now=eng.clock)
-        if adm.num_admitted == 0:
-            return None
 
         slot_need = np.zeros(C, bool)
         slot_pri = np.zeros(C, np.int64)
@@ -259,32 +398,37 @@ class OracleBridge:
                                    -1)
             slot_req[ci] = wls.requests[wid]
 
-        derived = qops.derive_world(
-            jnp.asarray(w.nominal), jnp.asarray(w.lend_limit),
-            jnp.asarray(w.borrow_limit), usage, jnp.asarray(w.parent),
-            depth=w.depth)
-        found, overflow, mask, _n = pops.within_cq_targets(
-            jnp.asarray(slot_need), jnp.asarray(slot_pri),
-            jnp.asarray(slot_ts), jnp.asarray(slot_fr),
-            jnp.asarray(slot_req), jnp.asarray(wcq_policy),
-            jnp.asarray(adm.cq), jnp.asarray(adm.priority),
-            jnp.asarray(adm.timestamp), jnp.asarray(adm.qr_time),
-            jnp.asarray(adm.uid_rank), jnp.asarray(adm.evicted),
-            jnp.asarray(adm.usage), derived["usage"],
-            derived["subtree_quota"], jnp.asarray(w.lend_limit),
-            jnp.asarray(w.borrow_limit), jnp.asarray(w.ancestors),
-            depth=w.depth, v_max=v_max)
-        found = np.asarray(found)
-        if np.asarray(overflow).any():
-            return None  # more victims than v_max: host preemptor
-        mask = np.asarray(mask)
+        if adm.num_admitted == 0:
+            found = np.zeros(C, bool)
+            overflow = np.zeros(C, bool)
+            mask = np.zeros((C, 0), bool)
+        else:
+            derived = qops.derive_world(
+                jnp.asarray(w.nominal), jnp.asarray(w.lend_limit),
+                jnp.asarray(w.borrow_limit), usage, jnp.asarray(w.parent),
+                depth=w.depth)
+            found, overflow, mask, _n = pops.within_cq_targets(
+                jnp.asarray(slot_need), jnp.asarray(slot_pri),
+                jnp.asarray(slot_ts), jnp.asarray(slot_fr),
+                jnp.asarray(slot_req), jnp.asarray(wcq_policy),
+                jnp.asarray(adm.cq), jnp.asarray(adm.priority),
+                jnp.asarray(adm.timestamp), jnp.asarray(adm.qr_time),
+                jnp.asarray(adm.uid_rank), jnp.asarray(adm.evicted),
+                jnp.asarray(adm.usage), derived["usage"],
+                derived["subtree_quota"], jnp.asarray(w.lend_limit),
+                jnp.asarray(w.borrow_limit), jnp.asarray(w.ancestors),
+                depth=w.depth, v_max=v_max)
+            found = np.asarray(found)
+            overflow = np.asarray(overflow) & in_scope
+            mask = np.asarray(mask)
 
-        from kueue_tpu.ops import commit as cops
         override = np.full(C, -1, np.int32)
         removal = np.zeros((C, S), np.int64)
         targets_by_slot: dict[int, list] = {}
         for ci in flagged:
-            if found[ci]:
+            if overflow[ci]:
+                override[ci] = cops.ENTRY_SKIP  # root dropped by caller
+            elif found[ci]:
                 override[ci] = cops.ENTRY_PREEMPT
                 victims = np.nonzero(mask[ci])[0]
                 targets_by_slot[int(ci)] = [
@@ -301,21 +445,30 @@ class OracleBridge:
             pending, inadmissible, usage, **args,
             slot_kind_override=jnp.asarray(override),
             slot_removal=jnp.asarray(removal), **statics)
-        return out, targets_by_slot
+        return out, targets_by_slot, overflow
 
     def _apply(self, solver, pending_infos, wl_admitted, parked,
-               slot_position, flavor_of_res, slot_preempting=None,
+               slot_position, flavor_of_res, apply_rows=None,
+               slot_mask=None, slot_preempting=None,
                head_idx=None, preempt_targets=None) -> CycleResult:
-        """Apply verdicts through the engine's assume path."""
+        """Apply verdicts through the engine's assume path. Rows outside
+        ``apply_rows`` / slots outside ``slot_mask`` belong to host roots
+        and are left untouched (the sequential tail owns them)."""
         from kueue_tpu.scheduler.preemption import Target
 
         eng = self.engine
         w, wls = solver.world, solver.wls
         result = CycleResult()
+        if apply_rows is None:
+            apply_rows = np.ones(len(pending_infos), bool)
+        if slot_mask is None:
+            slot_mask = np.ones(w.num_cqs, bool)
         order = np.argsort([
             slot_position[wls.cq[i]] if wl_admitted[i] else 1 << 30
             for i in range(len(pending_infos))])
         for i in order:
+            if not apply_rows[i]:
+                continue
             info = pending_infos[i]
             if wl_admitted[i]:
                 entry = self._make_entry(info, w, wls, flavor_of_res, i)
@@ -336,6 +489,8 @@ class OracleBridge:
                 result.entries.append(entry)
         if slot_preempting is not None and slot_preempting.any():
             for ci in np.nonzero(slot_preempting)[0]:
+                if not slot_mask[ci]:
+                    continue
                 wid = int(head_idx[ci])
                 info = pending_infos[wid]
                 entry = self._make_entry(info, w, wls, flavor_of_res, wid)
