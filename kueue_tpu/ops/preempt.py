@@ -44,6 +44,17 @@ POLICY_LOWER = 1
 POLICY_LOWER_OR_NEWER_EQ = 2
 POLICY_ANY = 3
 
+# Candidate variants (classical/hierarchical_preemption.go:31), matching
+# scheduler/preemption.{WITHIN_CQ,...}.
+V_NEVER = 0
+V_WITHIN_CQ = 1
+V_HIERARCHICAL_RECLAIM = 2
+V_RECLAIM_WITHOUT_BORROWING = 3
+V_RECLAIM_WHILE_BORROWING = 4
+
+# bwc_threshold sentinel: "no maxPriorityThreshold".
+NO_THRESHOLD = (1 << 62)
+
 
 def _policy_ok(policy, p_pri, p_ts, c_pri, c_ts):
     """common/preemption_policy.go:32."""
@@ -198,3 +209,309 @@ def within_cq_targets(
     return jax.vmap(per_slot)(
         jnp.arange(C, dtype=jnp.int32), slot_need, slot_pri, slot_ts,
         slot_fr, slot_req, wcq_policy)
+
+
+@partial(jax.jit, static_argnames=("depth", "v_cap"))
+def classical_targets(
+    slot_need,  # bool[C] head needs preemption on this slot
+    slot_pri,  # int64[C] preemptor effective priority
+    slot_ts,  # float64[C] preemptor creation time
+    slot_fr,  # int32[C, S] chosen flavor-resource per resource (-1 none)
+    slot_req,  # int64[C, S] requested amount per resource
+    wcq_policy,  # int32[C] withinClusterQueue POLICY_* code
+    reclaim_policy,  # int32[C] reclaimWithinCohort POLICY_* code
+    bwc_forbidden,  # bool[C] borrowWithinCohort is Never/absent
+    bwc_threshold,  # int64[C] maxPriorityThreshold (NO_THRESHOLD = none)
+    cq_has_parent,  # bool[C]
+    adm_cq,  # int32[A] admitted workload's CQ
+    adm_pri,  # int64[A]
+    adm_ts,  # float64[A] creation time
+    adm_qrt,  # float64[A] quota-reservation timestamp (recent = larger)
+    adm_uid,  # int64[A] uid rank (ascending tie-break)
+    adm_evicted,  # bool[A]
+    adm_usage,  # int64[A, R] usage on the fr grid
+    usage,  # int64[N, R] cycle-start usage (aggregated)
+    subtree_quota, lend_limit, borrow_limit, nominal,  # int64[N, R]
+    ancestors,  # int32[N, D]
+    local_chain,  # int32[C, D+1] positions into the CQ root's node row
+    root_nodes,  # int32[Rn, K]
+    root_of_cq,  # int32[C]
+    *,
+    depth: int,
+    v_cap: int,
+):
+    """The full classical preemptor (preemption.go:277 classicalPreemptions
+    + classical/hierarchical_preemption.go + candidate_generator.go) for
+    ALL ClusterQueue heads at once.
+
+    Per slot: classify every admitted workload of the slot's cohort root
+    (WithinCQ / HierarchicalReclaim / ReclaimWithoutBorrowing /
+    ReclaimWhileBorrowing), order candidates (evicted first, then
+    hierarchy < priority < same-queue buckets, then priority asc /
+    reservation recency desc / uid), sequence the borrowing attempts
+    (preemption.go:287-311), greedily remove candidates until the
+    preemptor fits (dynamic within-nominal validity per
+    candidate_generator.go:136), then fill back spared victims
+    (preemption.go:334).
+
+    The greedy scan is bounded at v_cap ordered candidates; slots that
+    fail to fit with more candidates available report overflow=True and
+    must fall back to the host preemptor.
+
+    Returns per slot:
+      found bool[C], overflow bool[C],
+      target_mask bool[C, A], n_targets int32[C],
+      variant int32[C, A] (candidate variants, for preemption reasons).
+    """
+    C, S = slot_req.shape
+    A = adm_cq.shape[0]
+    V = min(v_cap, A)
+    K = root_nodes.shape[1]
+    lq_all = local_quota(subtree_quota, lend_limit)
+    INF_F = jnp.float64(jnp.inf)
+
+    adm_chain = jnp.concatenate(
+        [adm_cq[:, None], ancestors[jnp.maximum(adm_cq, 0)]],
+        axis=1)  # [A, D+1] global node ids
+    adm_loc = local_chain[jnp.maximum(adm_cq, 0)]  # [A, D+1]
+
+    def per_slot(c, need, p_pri, p_ts, frs, req):
+        frs_safe = jnp.maximum(frs, 0)
+        active = (frs >= 0) & (req > 0)
+
+        # Root-local state over the slot's root, columns = the slot's
+        # chosen flavor-resources.
+        nodes = root_nodes[root_of_cq[c]]  # [K]
+        nodes_safe = jnp.maximum(nodes, 0)
+        node_ok = nodes >= 0
+
+        def gather_l(arr):
+            g = arr[nodes_safe[:, None], frs_safe[None, :]]
+            return jnp.where(node_ok[:, None], g, 0)
+
+        usage_l0 = gather_l(usage)
+        sq_l = gather_l(subtree_quota)
+        lq_l = gather_l(lq_all)
+        bl_l = jnp.where(node_ok[:, None],
+                         borrow_limit[nodes_safe[:, None],
+                                      frs_safe[None, :]], 0)
+        nom_l = gather_l(nominal)
+
+        loc_c = local_chain[c]  # [D+1] positions into K
+        chain_ok_c = loc_c >= 0
+        loc_c_safe = jnp.maximum(loc_c, 0)
+
+        def fits_with(usage_l, allow_borrow):
+            g_usage = usage_l[loc_c_safe]
+            avail = available_along_chain(
+                chain_ok_c, sq_l[loc_c_safe], lq_l[loc_c_safe],
+                bl_l[loc_c_safe], g_usage, depth=depth)
+            ok = jnp.all(jnp.where(active, req <= avail, True))
+            # workloadFits without borrowing: usage + req must stay within
+            # the CQ's guaranteed quota (preemption.go:624 borrowingWith).
+            cq_row = loc_c_safe[0]
+            nb_ok = jnp.all(jnp.where(
+                active, usage_l[cq_row] + req <= sq_l[cq_row], True))
+            return ok & (allow_borrow | nb_ok)
+
+        avail0 = available_along_chain(
+            chain_ok_c, sq_l[loc_c_safe], lq_l[loc_c_safe],
+            bl_l[loc_c_safe], usage_l0[loc_c_safe], depth=depth)
+        need_fr = active & (req > avail0)
+        any_need = need & jnp.any(need_fr)
+
+        # Hierarchical-advantage walk (hierarchical_preemption.go:149):
+        # adv_before[d] = whether any strict subtree below level d already
+        # fits the (remaining) request within quota.
+        def lavail_row(r):
+            return jnp.maximum(0, lq_l[r] - usage_l0[r])
+
+        cq_row = loc_c_safe[0]
+        fits_cq = jnp.all(jnp.where(
+            active, sq_l[cq_row] >= usage_l0[cq_row] + req, True))
+        rem = jnp.where(active, jnp.maximum(0, req - lavail_row(cq_row)), 0)
+        adv = fits_cq
+        adv_before_list = [jnp.asarray(False)]  # level 0 unused
+        for d in range(1, depth + 1):
+            adv_before_list.append(adv)
+            r = loc_c_safe[d]
+            okd = chain_ok_c[d]
+            fits_d = jnp.all(jnp.where(
+                active, sq_l[r] >= usage_l0[r] + rem, True))
+            adv = adv | (fits_d & okd)
+            rem = jnp.where(active, jnp.maximum(0, rem - lavail_row(r)), 0)
+        adv_before = jnp.stack(adv_before_list)  # [D+1]
+
+        # --- candidate classification over all admitted workloads ---
+        c_chain = jnp.concatenate(
+            [jnp.asarray([c], jnp.int32), ancestors[c]])  # [D+1]
+        same_cq = adm_cq == c
+        same_root = root_of_cq[jnp.maximum(adm_cq, 0)] == root_of_cq[c]
+        # LCA level: lowest d >= 1 with c_chain[d] on the candidate's
+        # chain. Loops over the (short) depth axes to keep peak memory at
+        # O(A) per slot.
+        NO_LCA = depth + 9
+        lca_level = jnp.full((A,), NO_LCA, jnp.int32)
+        for d in range(depth, 0, -1):
+            on_chain = jnp.zeros((A,), bool)
+            for e in range(depth + 1):
+                on_chain = on_chain | (adm_chain[:, e] == c_chain[d])
+            on_chain = on_chain & (c_chain[d] >= 0)
+            lca_level = jnp.where(on_chain, d, lca_level)
+        has_lca = lca_level <= depth
+        lca_node = c_chain[jnp.clip(lca_level, 0, depth)]  # [A]
+        # Candidate-chain position of the LCA.
+        lca_pos = jnp.full((A,), NO_LCA, jnp.int32)
+        for e in range(depth, -1, -1):
+            lca_pos = jnp.where(adm_chain[:, e] == lca_node, e, lca_pos)
+
+        uses_any = jnp.any(
+            (adm_usage[:, frs_safe] > 0) & need_fr[None, :], axis=1)
+        pol = jnp.where(same_cq, wcq_policy[c], reclaim_policy[c])
+        pol_gate = jnp.where(
+            same_cq, wcq_policy[c] != POLICY_NEVER,
+            (reclaim_policy[c] != POLICY_NEVER) & cq_has_parent[c])
+        pol_ok = _policy_ok(pol, p_pri, p_ts, adm_pri, adm_ts)
+
+        adv_at_lca = adv_before[jnp.clip(lca_level, 0, depth)]
+        rwob = (bwc_forbidden[c] | (adm_pri >= p_pri)
+                | (adm_pri > bwc_threshold[c]))
+        variant = jnp.where(
+            same_cq, V_WITHIN_CQ,
+            jnp.where(adv_at_lca, V_HIERARCHICAL_RECLAIM,
+                      jnp.where(rwob, V_RECLAIM_WITHOUT_BORROWING,
+                                V_RECLAIM_WHILE_BORROWING)))
+
+        # Static within-nominal pruning (collectCandidatesInSubtree +
+        # candidateIsValid at cycle start): every node on the candidate's
+        # chain strictly below the LCA must be above nominal in some
+        # needed resource. Level-wise loop keeps peak memory at O(A * S).
+        wn_rownominal = jnp.all(jnp.where(
+            need_fr[None, :], sq_l >= usage_l0, True), axis=1)  # [K]
+        static_bad = jnp.zeros((A,), bool)
+        for e in range(depth + 1):
+            rows = adm_loc[:, e]
+            below = (e < lca_pos) & (rows >= 0)
+            static_bad = static_bad | (
+                below & wn_rownominal[jnp.maximum(rows, 0)])
+        static_path_ok = ~static_bad
+
+        is_cand = (any_need & uses_any & pol_gate & pol_ok
+                   & (same_cq | (same_root & has_lca & static_path_ok)))
+        bucket = jnp.where(same_cq, 2, jnp.where(adv_at_lca, 0, 1))
+
+        no_other = ~jnp.any(is_cand & ~same_cq)
+        no_hier = ~jnp.any(is_cand & (bucket == 0))
+        under_nominal = jnp.all(jnp.where(
+            need_fr, nom_l[cq_row] > usage_l0[cq_row], True))
+
+        # Attempt sequencing (preemption.go:287-311).
+        case1 = no_other | (bwc_forbidden[c] & ~under_nominal)
+        case2 = ~case1 & bwc_forbidden[c] & no_hier
+        b1 = jnp.where(case2, False, True)
+        b2 = jnp.where(case2, True, False)
+        en2 = ~case1
+
+        # Ordering: evicted first, bucket, priority asc, reservation
+        # recency desc, uid asc; non-candidates last (lexsort: last key
+        # is primary).
+        order = jnp.lexsort((
+            adm_uid,
+            -adm_qrt,
+            adm_pri,
+            bucket,
+            jnp.where(adm_evicted, 0, 1),
+            jnp.where(is_cand, 0, 1),
+        )).astype(jnp.int32)
+        v_ids = order[:V]  # [V]
+        v_cand = is_cand[v_ids]
+        v_variant = variant[v_ids]
+        v_same = same_cq[v_ids]
+        v_loc = adm_loc[v_ids]  # [V, D+1]
+        v_lca_pos = lca_pos[v_ids]
+        v_usage = adm_usage[v_ids][:, frs_safe]  # [V, S]
+        n_cand = jnp.sum(is_cand.astype(jnp.int32))
+
+        def remove_chain(usage_l, loc, val):
+            """resource_node.go:156 removeUsage along one chain."""
+            for e in range(depth + 1):
+                row_ok = loc[e] >= 0
+                r = jnp.maximum(loc[e], 0)
+                ssp = usage_l[r] - lq_l[r]
+                usage_l = usage_l.at[r].add(jnp.where(row_ok, -val, 0))
+                val = jnp.where(row_ok & (ssp > 0),
+                                jnp.minimum(val, ssp), 0)
+            return usage_l
+
+        def add_chain(usage_l, loc, val):
+            """resource_node.go:144 addUsage along one chain."""
+            for e in range(depth + 1):
+                row_ok = loc[e] >= 0
+                r = jnp.maximum(loc[e], 0)
+                la = jnp.maximum(0, lq_l[r] - usage_l[r])
+                usage_l = usage_l.at[r].add(jnp.where(row_ok, val, 0))
+                val = jnp.where(row_ok, jnp.maximum(0, val - la), 0)
+            return usage_l
+
+        def run_attempt(allow_borrow):
+            def step(carry, i):
+                usage_l, taken, found = carry
+                ok = v_cand[i] & ~found
+                # candidateIsValid (candidate_generator.go:136), dynamic.
+                bad_borrow = (allow_borrow
+                              & (v_variant[i]
+                                 == V_RECLAIM_WITHOUT_BORROWING)
+                              & ~v_same[i])
+                wn_bad = jnp.asarray(False)
+                for e in range(depth + 1):
+                    below = (e < v_lca_pos[i]) & (v_loc[i, e] >= 0)
+                    r = jnp.maximum(v_loc[i, e], 0)
+                    wn = jnp.all(jnp.where(need_fr,
+                                           sq_l[r] >= usage_l[r], True))
+                    wn_bad = wn_bad | (below & wn)
+                valid = ok & ~bad_borrow & (v_same[i] | ~wn_bad)
+                removed = remove_chain(usage_l, v_loc[i], v_usage[i])
+                usage_l = jnp.where(valid, removed, usage_l)
+                taken = taken.at[i].set(valid)
+                fit = fits_with(usage_l, allow_borrow)
+                found = found | (valid & fit)
+                return (usage_l, taken, found), None
+
+            init = (usage_l0, jnp.zeros((V,), bool), jnp.asarray(False))
+            (usage_f, taken, found), _ = jax.lax.scan(
+                step, init, jnp.arange(V))
+
+            # Fill-back (preemption.go:334): reverse over targets except
+            # the last, re-adding any whose re-addition keeps the fit.
+            last_idx = jnp.max(jnp.where(taken, jnp.arange(V), -1))
+
+            def fb(carry, j):
+                usage_l, taken = carry
+                i = V - 1 - j
+                consider = found & taken[i] & (i != last_idx)
+                trial = add_chain(usage_l, v_loc[i], v_usage[i])
+                spared = consider & fits_with(trial, allow_borrow)
+                usage_l = jnp.where(spared, trial, usage_l)
+                taken = taken.at[i].set(taken[i] & ~spared)
+                return (usage_l, taken), None
+
+            (_, taken_fb), _ = jax.lax.scan(fb, (usage_f, taken),
+                                            jnp.arange(V))
+            return found, taken_fb
+
+        f1, t1 = run_attempt(b1)
+        f2, t2 = run_attempt(b2)
+        use2 = ~f1 & en2 & f2
+        found = (f1 | use2) & any_need
+        taken = jnp.where(f1, t1, jnp.where(use2, t2,
+                                            jnp.zeros((V,), bool)))
+        overflow = need & any_need & ~found & (n_cand > V)
+
+        target_mask = jnp.zeros((A,), bool).at[
+            jnp.where(taken, v_ids, A)].set(True, mode="drop")
+        return (found, overflow, target_mask,
+                jnp.sum(taken.astype(jnp.int32)), variant)
+
+    return jax.vmap(per_slot)(
+        jnp.arange(C, dtype=jnp.int32), slot_need, slot_pri, slot_ts,
+        slot_fr, slot_req)

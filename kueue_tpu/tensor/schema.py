@@ -92,6 +92,9 @@ class WorldTensors:
     root_nodes: np.ndarray = None  # int32[Rn, K] subtree node ids, -1 pad
     local_chain: np.ndarray = None  # int32[C, depth+1] chain positions
     #   into root_nodes[root_of(cq)], -1 pad
+    root_parent_local: np.ndarray = None  # int32[Rn, K] parent position
+    #   within the same root row, -1 = root/pad (victim-removal bubbling)
+    root_of_cq: np.ndarray = None  # int32[C] root row per ClusterQueue
 
     def fr_index(self, flavor: str, resource: str) -> int:
         return (self.flavor_names.index(flavor) * self.num_resources
@@ -159,7 +162,18 @@ def build_root_grouping(parent: np.ndarray, ancestors: np.ndarray,
             if a < 0:
                 break
             local_chain[ci, d + 1] = node_pos[int(a)]
-    return Rn, root_members, root_nodes, local_chain
+    root_parent_local = np.full((Rn, K), -1, np.int32)
+    for ri in range(Rn):
+        for j, nd in enumerate(nodes_of[ri]):
+            p = parent[nd]
+            if p >= 0:
+                root_parent_local[ri, j] = node_pos[int(p)]
+    root_of_cq = np.zeros(max(C, 1), np.int32)
+    for ri in range(Rn):
+        for m in members_of[ri]:
+            root_of_cq[m] = ri
+    return (Rn, root_members, root_nodes, local_chain, root_parent_local,
+            root_of_cq)
 
 
 def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
@@ -292,8 +306,8 @@ def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
         fung_pref_p[ci] = (fung.preference
                            == FungibilityPreference.PREEMPTION_OVER_BORROWING)
 
-    Rn, root_members, root_nodes, local_chain = build_root_grouping(
-        parent, ancestors, C, max_depth)
+    (Rn, root_members, root_nodes, local_chain, root_parent_local,
+     root_of_cq) = build_root_grouping(parent, ancestors, C, max_depth)
 
     return WorldTensors(
         num_cqs=C, num_nodes=N, num_flavors=NF, num_resources=S,
@@ -308,7 +322,8 @@ def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
         fung_borrow_try_next=fung_b_try, fung_preempt_try_next=fung_p_try,
         fung_pref_preempt_first=fung_pref_p, fair_weight=fair_weight,
         num_roots=Rn, root_members=root_members, root_nodes=root_nodes,
-        local_chain=local_chain,
+        local_chain=local_chain, root_parent_local=root_parent_local,
+        root_of_cq=root_of_cq,
     )
 
 
