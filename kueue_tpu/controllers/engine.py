@@ -270,8 +270,12 @@ class Engine:
         import jax
 
         # The dense quota math uses int64 quantities with an INF sentinel
-        # (api.types.INF); the oracle is unusable without x64.
-        jax.config.update("jax_enable_x64", True)
+        # (api.types.INF); the oracle is unusable without x64. This is a
+        # process-global flip — deliberate: the engine is a control-plane
+        # service that owns its process. Embedders sharing the process
+        # with float32 JAX code should enable x64 themselves at startup.
+        if not jax.config.jax_enable_x64:
+            jax.config.update("jax_enable_x64", True)
         from kueue_tpu.oracle.engine_bridge import OracleBridge
         self.oracle = OracleBridge(self, max_depth=max_depth)
 

@@ -131,18 +131,45 @@ def commit_scan(
     return admitted, usage_final
 
 
+def _apply_victims(usage_l, lq_l, parent_local, rows, vals, *, depth):
+    """Aggregated victim-usage removal (resource_node.go:156 removeUsage)
+    over a root-local node set: scatter victim usage at their CQ rows,
+    then propagate each row's above-local-quota share to its parent,
+    level by level. Exact vs sequential per-victim removal: headroom
+    consumption is monotone, so min-sum aggregation per row equals the
+    per-victim walk.
+
+    usage_l, lq_l: int64[K, R]; parent_local: int32[K]; rows: int32[V]
+    victim CQ positions (-1 = none); vals: int64[V, R]."""
+    K = usage_l.shape[0]
+    rem = jnp.zeros_like(usage_l).at[
+        jnp.where(rows >= 0, rows, K)].add(vals, mode="drop")
+    p_safe = jnp.where(parent_local >= 0, parent_local, K)
+    for _ in range(depth + 1):
+        prop = jnp.minimum(rem, jnp.maximum(0, usage_l - lq_l))
+        prop = jnp.maximum(prop, 0)
+        usage_l = usage_l - rem
+        rem = jnp.zeros_like(rem).at[p_safe].add(
+            jnp.where((parent_local >= 0)[:, None], prop, 0), mode="drop")
+    return usage_l
+
+
 def _commit_one_local(usage_l, c, entry_fr, entry_req, entry_kind,
                       entry_borrows, subtree_quota, lq, borrow_limit,
                       nominal, ancestors, local_chain, *, depth,
-                      entry_removal=None):
+                      victims=None, claimed=None):
     """Commit one entry (slot id c, -1 = none) against a root-local usage
     carry [K, R]: gather along the chain, run _entry_verdict, bubble the
     adds. Shared by the grouped classical and fair commits.
 
-    entry_removal (int64[C, S], optional): per-entry victim usage for
-    ENTRY_PREEMPT slots — the fit check runs with it removed from the
-    entry's chain, and the removal persists on success. Returns
-    (new_usage_l, fits)."""
+    victims (optional): (row int32[C, V], vals int64[C, V, R],
+    ids int32[C, V], lq_l [K, R], parent_local [K]) — per-entry victim
+    sets for ENTRY_PREEMPT slots. The fit check runs with the victims'
+    usage removed (exact removeUsage bubbling along the victims' own
+    chains), the removal persists on success, and an entry whose victim
+    ids intersect `claimed` (workloads already preempted by an earlier
+    entry this cycle) is skipped — the one-admission-per-cohort overlap
+    rule (scheduler.go:432). Returns (new_usage_l, new_claimed, fits)."""
     ok = c >= 0
     c_safe = jnp.maximum(c, 0)
     frs = entry_fr[c_safe]
@@ -159,35 +186,45 @@ def _commit_one_local(usage_l, c, entry_fr, entry_req, entry_kind,
     g_sq = subtree_quota[chain_safe[:, None], frs_safe[None, :]]
     g_lq = lq[chain_safe[:, None], frs_safe[None, :]]
     g_bl = borrow_limit[chain_safe[:, None], frs_safe[None, :]]
-    g_usage = usage_l[loc_safe[:, None], frs_safe[None, :]]
 
     kind = jnp.where(ok, entry_kind[c_safe], ENTRY_SKIP)
+    is_pre = ok & (kind == ENTRY_PREEMPT)
 
-    if entry_removal is not None:
-        from kueue_tpu.ops.preempt import _adjust_chain_usage
-        removal = jnp.where(ok & (kind == ENTRY_PREEMPT),
-                            entry_removal[c_safe], 0)
-        g_usage_adj = _adjust_chain_usage(g_usage, g_lq, removal,
-                                          depth=depth)
-        g_usage_adj = jnp.where(chain_ok[:, None], g_usage_adj, g_usage)
+    overlap = jnp.asarray(False)
+    if victims is not None:
+        v_row, v_vals, v_ids, lq_l, parent_local = victims
+        rows = jnp.where(is_pre, v_row[c_safe], -1)  # [V]
+        vals = v_vals[c_safe]  # [V, R]
+        trial = _apply_victims(usage_l, lq_l, parent_local, rows, vals,
+                               depth=depth)
+        ids = v_ids[c_safe]
+        A = claimed.shape[0]
+        overlap = is_pre & jnp.any(
+            (ids >= 0) & claimed[jnp.clip(ids, 0, A - 1)])
     else:
-        g_usage_adj = g_usage
+        trial = usage_l
 
+    g_usage = trial[loc_safe[:, None], frs_safe[None, :]]
     fits, adds = _entry_verdict(
-        g_sq, g_lq, g_bl, g_usage_adj, chain_ok, frs, req, kind,
+        g_sq, g_lq, g_bl, g_usage, chain_ok, frs, req, kind,
         entry_borrows[c_safe], nominal[c_safe, frs_safe],
-        borrow_limit[c_safe, frs_safe], g_usage_adj[0], depth=depth)
+        borrow_limit[c_safe, frs_safe], g_usage[0], depth=depth)
+    fits = fits & ~overlap
 
-    new_usage = usage_l
-    if entry_removal is not None:
-        # Persist the removal on success (victims leave the carry).
-        delta = jnp.where(fits, g_usage_adj - g_usage, 0)
-        for d in range(depth + 1):
-            new_usage = new_usage.at[loc_safe[d], frs_safe].add(
-                jnp.where(chain_ok[d] & (frs >= 0), delta[d], 0))
+    # ENTRY_PREEMPT: the victim removal persists only when the entry
+    # commits; otherwise the carry is untouched. `adds` is already masked
+    # to zero for non-committing kinds inside _entry_verdict.
+    new_usage = usage_l if victims is None else jnp.where(
+        fits & is_pre, trial, usage_l)
     for d in range(depth + 1):
         new_usage = new_usage.at[loc_safe[d], frs_safe].add(adds[d])
-    return new_usage, fits & ok
+    new_claimed = claimed
+    if victims is not None:
+        commit_pre = fits & is_pre
+        new_claimed = claimed.at[
+            jnp.where(commit_pre & (ids >= 0), ids,
+                      claimed.shape[0])].set(True, mode="drop")
+    return new_usage, new_claimed, fits & ok
 
 
 @partial(jax.jit, static_argnames=("depth",))
@@ -203,7 +240,11 @@ def commit_grouped(
     root_members,  # int32[Rn, M] CQ/slot ids per root, -1 pad
     root_nodes,  # int32[Rn, K] subtree node ids per root, -1 pad
     local_chain,  # int32[C, D+1] chain positions into the root's node row
-    entry_removal=None,  # int64[C, S] victim usage for ENTRY_PREEMPT slots
+    root_parent_local=None,  # int32[Rn, K] parent positions (victims)
+    slot_victim_row=None,  # int32[C, V] victim CQ local positions
+    slot_victim_vals=None,  # int64[C, V, R] victim usage rows
+    slot_victim_ids=None,  # int32[C, V] admitted-workload ids (overlap)
+    claimed0=None,  # bool[A] initially-claimed victims (usually zeros)
     *,
     depth: int,
 ):
@@ -216,6 +257,12 @@ def commit_grouped(
     Scan length drops from C (all slots) to max-CQs-per-root — the
     difference between a 1000-step and an ~8-step sequential section per
     cycle on TPU.
+
+    slot_victim_* carry device-selected preemption victims for
+    ENTRY_PREEMPT slots (ops/preempt.classical_targets output): the fit
+    check runs with the victims removed along their own chains, removals
+    persist on success, and victim overlap between entries applies the
+    one-admission-per-cohort rule (scheduler.go:432).
 
     Returns (admitted bool[C] by slot, final usage int64[N, R]).
     """
@@ -237,20 +284,35 @@ def commit_grouped(
     sorted_members = jnp.take_along_axis(root_members, morder, axis=1)
 
     nodes_safe = jnp.maximum(root_nodes, 0)
-    init_local = jnp.where((root_nodes >= 0)[:, :, None],
+    node_ok = root_nodes >= 0
+    init_local = jnp.where(node_ok[:, :, None],
                            usage0[nodes_safe], 0)  # [Rn, K, R]
+    has_victims = slot_victim_row is not None
+    if has_victims:
+        lq_locals = jnp.where(node_ok[:, :, None], lq[nodes_safe], 0)
+    else:
+        claimed0 = jnp.zeros((1,), bool)
+        lq_locals = jnp.zeros((Rn, 1, 1), lq.dtype)
+        root_parent_local = jnp.full((Rn, K), -1, jnp.int32)
 
-    def per_root(members, local_usage):
-        def step(usage_l, c):  # usage_l: [K, R]
-            return _commit_one_local(
+    def per_root(members, local_usage, lq_l, parent_local):
+        def step(carry, c):  # usage_l: [K, R]
+            usage_l, claimed = carry
+            victims = ((slot_victim_row, slot_victim_vals,
+                        slot_victim_ids, lq_l, parent_local)
+                       if has_victims else None)
+            usage_l, claimed, fits = _commit_one_local(
                 usage_l, c, entry_fr, entry_req, entry_kind, entry_borrows,
                 subtree_quota, lq, borrow_limit, nominal, ancestors,
-                local_chain, depth=depth, entry_removal=entry_removal)
+                local_chain, depth=depth, victims=victims, claimed=claimed)
+            return (usage_l, claimed), fits
 
-        return jax.lax.scan(step, local_usage, members)
+        (usage_f, _), fits_seq = jax.lax.scan(
+            step, (local_usage, claimed0), members)
+        return usage_f, fits_seq
 
-    final_local, admitted_seq = jax.vmap(per_root)(sorted_members,
-                                                   init_local)
+    final_local, admitted_seq = jax.vmap(per_root)(
+        sorted_members, init_local, lq_locals, root_parent_local)
 
     # Scatter per-root verdicts back to slot order.
     flat_members = sorted_members.reshape(-1)
@@ -388,7 +450,7 @@ def commit_grouped_fair(
             win = lex_min([zwb + big, share + big, -pri + big, ts + big])
             cw = jnp.where(jnp.any(alive), members[win], -1)
 
-            new_usage, fits = _commit_one_local(
+            new_usage, _, fits = _commit_one_local(
                 usage_l, cw, entry_fr, entry_req, entry_kind,
                 entry_borrows, subtree_quota, lq, borrow_limit, nominal,
                 ancestors, local_chain, depth=depth)

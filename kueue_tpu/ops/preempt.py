@@ -233,6 +233,7 @@ def classical_targets(
     usage,  # int64[N, R] cycle-start usage (aggregated)
     subtree_quota, lend_limit, borrow_limit, nominal,  # int64[N, R]
     ancestors,  # int32[N, D]
+    height,  # int32[N] subtree height per node
     local_chain,  # int32[C, D+1] positions into the CQ root's node row
     root_nodes,  # int32[Rn, K]
     root_of_cq,  # int32[C]
@@ -261,14 +262,17 @@ def classical_targets(
     Returns per slot:
       found bool[C], overflow bool[C],
       target_mask bool[C, A], n_targets int32[C],
-      variant int32[C, A] (candidate variants, for preemption reasons).
+      variant int32[C, A] (candidate variants, for preemption reasons),
+      borrow_after int32[C] — the assignment borrow level with the
+        victims removed (preemption_oracle.go:41 SimulatePreemption →
+        FindHeightOfLowestSubtreeThatFits), which is what the commit
+        iterator orders preempting entries by (scheduler.go:971).
     """
     C, S = slot_req.shape
     A = adm_cq.shape[0]
     V = min(v_cap, A)
     K = root_nodes.shape[1]
     lq_all = local_quota(subtree_quota, lend_limit)
-    INF_F = jnp.float64(jnp.inf)
 
     adm_chain = jnp.concatenate(
         [adm_cq[:, None], ancestors[jnp.maximum(adm_cq, 0)]],
@@ -292,6 +296,7 @@ def classical_targets(
         usage_l0 = gather_l(usage)
         sq_l = gather_l(subtree_quota)
         lq_l = gather_l(lq_all)
+        height_l = jnp.where(node_ok, height[nodes_safe], 0)
         bl_l = jnp.where(node_ok[:, None],
                          borrow_limit[nodes_safe[:, None],
                                       frs_safe[None, :]], 0)
@@ -495,22 +500,53 @@ def classical_targets(
                 taken = taken.at[i].set(taken[i] & ~spared)
                 return (usage_l, taken), None
 
-            (_, taken_fb), _ = jax.lax.scan(fb, (usage_f, taken),
-                                            jnp.arange(V))
-            return found, taken_fb
+            (usage_fb, taken_fb), _ = jax.lax.scan(fb, (usage_f, taken),
+                                                   jnp.arange(V))
+            return found, taken_fb, usage_fb
 
-        f1, t1 = run_attempt(b1)
-        f2, t2 = run_attempt(b2)
+        def borrow_after_height(usage_l):
+            """FindHeightOfLowestSubtreeThatFits
+            (classical/hierarchical_preemption.go:221) against a
+            root-local usage state; max over the slot's resources."""
+            lavail = jnp.maximum(0, lq_l - usage_l)  # [K, S]
+            borrowing_cq = nom_l[cq_row] < usage_l[cq_row] + req  # [S]
+            has_par = chain_ok_c[1] if depth >= 1 else jnp.asarray(False)
+            remaining = jnp.maximum(0, req - lavail[cq_row])
+            found_b = jnp.zeros((req.shape[0],), bool)
+            found_h = jnp.zeros((req.shape[0],), jnp.int32)
+            for d in range(1, depth + 1):
+                r = loc_c_safe[d]
+                okd = chain_ok_c[d]
+                borrowing = sq_l[r] < usage_l[r] + remaining
+                fits_here = okd & ~borrowing & ~found_b
+                found_h = jnp.where(fits_here, height_l[r], found_h)
+                found_b = found_b | fits_here
+                remaining = jnp.where(okd & ~found_b,
+                                      jnp.maximum(0, remaining - lavail[r]),
+                                      remaining)
+            root_h = jnp.int32(0)
+            for d in range(depth + 1):
+                root_h = jnp.where(chain_ok_c[d], height_l[loc_c_safe[d]],
+                                   root_h)
+            h = jnp.where(~borrowing_cq | ~has_par, 0,
+                          jnp.where(found_b, found_h, root_h))
+            return jnp.max(jnp.where(active, h, 0))
+
+        f1, t1, u1 = run_attempt(b1)
+        f2, t2, u2 = run_attempt(b2)
         use2 = ~f1 & en2 & f2
         found = (f1 | use2) & any_need
         taken = jnp.where(f1, t1, jnp.where(use2, t2,
                                             jnp.zeros((V,), bool)))
         overflow = need & any_need & ~found & (n_cand > V)
+        borrow_after = jnp.where(
+            f1, borrow_after_height(u1),
+            jnp.where(use2, borrow_after_height(u2), 0)).astype(jnp.int32)
 
         target_mask = jnp.zeros((A,), bool).at[
             jnp.where(taken, v_ids, A)].set(True, mode="drop")
         return (found, overflow, target_mask,
-                jnp.sum(taken.astype(jnp.int32)), variant)
+                jnp.sum(taken.astype(jnp.int32)), variant, borrow_after)
 
     return jax.vmap(per_slot)(
         jnp.arange(C, dtype=jnp.int32), slot_need, slot_pri, slot_ts,

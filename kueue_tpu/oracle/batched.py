@@ -77,8 +77,15 @@ def _cycle_core(
     fair_weight=None,  # float64[N]
     slot_kind_override=None,  # int32[C] ENTRY_* (-1 = use computed kind);
     #   set to ENTRY_PREEMPT/ENTRY_RESERVE by the bridge after device
-    #   preemption target selection (ops/preempt.within_cq_targets)
-    slot_removal=None,  # int64[C, S] victim usage for ENTRY_PREEMPT slots
+    #   preemption target selection (ops/preempt.classical_targets)
+    slot_borrows_override=None,  # int32[C] post-preemption borrow level
+    #   (-1 = keep): the commit iterator orders preempting entries by the
+    #   borrow level WITH their victims removed (preemption_oracle.go:41)
+    root_parent_local=None,  # int32[Rn, K] (victim-removal bubbling)
+    slot_victim_row=None,  # int32[C, V] victim CQ local positions
+    slot_victim_vals=None,  # int64[C, V, R] victim usage rows
+    slot_victim_ids=None,  # int32[C, V] admitted ids (overlap rule)
+    claimed0=None,  # bool[A] initially-claimed victims
     *,
     depth: int, num_resources: int, num_cqs: int,
     fair_mode: bool = False, num_flavors: int = 1,
@@ -117,6 +124,9 @@ def _cycle_core(
             h_cq, h_req, derived, nominal, ancestors, height, group_of_res,
             group_flavors, no_preemption, can_pwb, fung_borrow_try_next,
             fung_pref_preempt_first, depth=depth, num_resources=S)
+    if slot_borrows_override is not None:
+        borrows = jnp.where(slot_borrows_override >= 0,
+                            slot_borrows_override, borrows)
 
     # 5. Commit. Entry kinds: FIT commits; preempt-mode-no-candidates
     # reserves capacity unless the CQ can always reclaim
@@ -167,7 +177,8 @@ def _cycle_core(
             key, slot_valid, usage_fr, h_req, kind, borrows, full_usage,
             derived["subtree_quota"], lend_limit, borrow_limit, nominal,
             ancestors, root_members, root_nodes, local_chain,
-            slot_removal, depth=depth)
+            root_parent_local, slot_victim_row, slot_victim_vals,
+            slot_victim_ids, claimed0, depth=depth)
         slot_admitted = slot_committed & (kind != cops.ENTRY_PREEMPT)
         slot_preempting = slot_committed & (kind == cops.ENTRY_PREEMPT)
         # Positions report the global commit order (scheduler.go:971).

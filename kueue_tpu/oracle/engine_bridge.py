@@ -107,11 +107,13 @@ class OracleBridge:
         return safe
 
     def _cq_preempt_scope(self, snapshot, w):
-        """Per-CQ device-preemption scope: classical ordering, within-CQ
-        candidates only (reclaimWithinCohort=Never, borrowWithinCohort
-        Never), a supported withinClusterQueue policy, and single-flavor
-        resource groups (flavor choice independent of the preemption
-        simulation). Returns (ok bool[C], policy int32[C])."""
+        """Per-CQ device-preemption scope and policy encoding. The device
+        classical preemptor (ops/preempt.classical_targets) covers the
+        full classical policy surface; the remaining restriction is
+        multi-flavor resource groups (the flavor choice would depend on
+        the preemption simulation — flavorassigner.go:1198 +
+        preemption_oracle.go:30). Returns (ok bool[C], cfg dict of
+        per-CQ policy arrays for the kernel)."""
         from kueue_tpu.api.types import (
             BorrowWithinCohortPolicy,
             PreemptionPolicy,
@@ -119,30 +121,43 @@ class OracleBridge:
         from kueue_tpu.ops import preempt as pops
 
         policy_code = {
+            PreemptionPolicy.NEVER: pops.POLICY_NEVER,
             PreemptionPolicy.LOWER_PRIORITY: pops.POLICY_LOWER,
             PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY:
                 pops.POLICY_LOWER_OR_NEWER_EQ,
+            PreemptionPolicy.ANY: pops.POLICY_ANY,
         }
         C = w.num_cqs
         ok = np.zeros(C, bool)
-        policy = np.zeros(C, np.int32)
+        wcq_policy = np.zeros(C, np.int32)
+        reclaim_policy = np.zeros(C, np.int32)
+        bwc_forbidden = np.ones(C, bool)
+        bwc_threshold = np.full(C, pops.NO_THRESHOLD, np.int64)
+        cq_has_parent = np.zeros(C, bool)
         if w.group_flavors.shape[2] > 1:
             multi_flavor = np.any(w.group_flavors[:, :, 1:] >= 0,
                                   axis=(1, 2))
         else:
             multi_flavor = np.zeros(C, bool)
         for ci, name in enumerate(w.cq_names):
-            p = snapshot.cluster_queues[name].spec.preemption
-            bwc_never = (p.borrow_within_cohort is None
-                         or p.borrow_within_cohort.policy
-                         == BorrowWithinCohortPolicy.NEVER)
-            if (p.reclaim_within_cohort == PreemptionPolicy.NEVER
-                    and bwc_never
-                    and p.within_cluster_queue in policy_code
-                    and not multi_flavor[ci]):
-                ok[ci] = True
-                policy[ci] = policy_code[p.within_cluster_queue]
-        return ok, policy
+            spec = snapshot.cluster_queues[name].spec
+            p = spec.preemption
+            wcq_policy[ci] = policy_code[p.within_cluster_queue]
+            reclaim_policy[ci] = policy_code[p.reclaim_within_cohort]
+            if (p.borrow_within_cohort is not None
+                    and p.borrow_within_cohort.policy
+                    != BorrowWithinCohortPolicy.NEVER):
+                bwc_forbidden[ci] = False
+                thr = p.borrow_within_cohort.max_priority_threshold
+                if thr is not None:
+                    bwc_threshold[ci] = thr
+            cq_has_parent[ci] = spec.cohort is not None
+            ok[ci] = not multi_flavor[ci]
+        cfg = dict(wcq_policy=wcq_policy, reclaim_policy=reclaim_policy,
+                   bwc_forbidden=bwc_forbidden,
+                   bwc_threshold=bwc_threshold,
+                   cq_has_parent=cq_has_parent)
+        return ok, cfg
 
     def try_cycle(self) -> Optional[CycleResult]:
         """Attempt one hybrid cycle. Returns None to request full
@@ -198,10 +213,7 @@ class OracleBridge:
         head_eligible[has_head] = wl.eligible[head_wid[has_head]]
         flavor_safe = self._cq_flavor_safe(snapshot, w)
 
-        root_of_cq = np.zeros(C, np.int32)
-        for ri in range(Rn):
-            ms = w.root_members[ri]
-            root_of_cq[ms[ms >= 0]] = ri
+        root_of_cq = w.root_of_cq
         host_root = np.zeros(Rn, bool)
 
         def demote(cq_mask: np.ndarray, reason: str) -> None:
@@ -288,7 +300,7 @@ class OracleBridge:
         preempt_targets: dict[int, list] = {}
         if bool(any_oracle):
             flagged = np.asarray(slot_oracle)
-            preempt_ok, wcq_policy = self._cq_preempt_scope(snapshot, w)
+            preempt_ok, pcfg = self._cq_preempt_scope(snapshot, w)
             if eng.cycle.enable_fair_sharing:
                 preempt_ok[:] = False
             out_scope = flagged & ~preempt_ok
@@ -299,7 +311,7 @@ class OracleBridge:
             if in_scope.any():
                 res = self._device_preemption(
                     snapshot, w, solver.wls, args, statics, pending,
-                    inadmissible, usage, in_scope, wcq_policy,
+                    inadmissible, usage, in_scope, pcfg,
                     np.asarray(flavor_of_res), np.asarray(head_idx))
                 out, preempt_targets, overflow = res
                 (new_pending, new_inadmissible, usage2, wl_admitted,
@@ -357,24 +369,39 @@ class OracleBridge:
         return result
 
     def _device_preemption(self, snapshot, w, wls, args, statics, pending,
-                           inadmissible, usage, in_scope, wcq_policy,
-                           flavor_of_res, head_idx, v_max: int = 32):
-        """Run within-CQ preemption target selection on device for the
-        in-scope flagged slots and re-run the cycle with kind overrides.
-        Returns (outputs, targets_by_slot, overflow bool[C]); overflow
-        slots' roots must be handed to the host preemptor by the caller."""
+                           inadmissible, usage, in_scope, pcfg,
+                           flavor_of_res, head_idx, v_cap: int = 32):
+        """Run classical preemption target selection on device
+        (ops/preempt.classical_targets — within-CQ, cross-CQ reclaim,
+        borrowWithinCohort) for the in-scope flagged slots and re-run the
+        cycle with kind overrides + victim sets. Returns (outputs,
+        targets_by_slot, overflow bool[C]); overflow slots' roots must be
+        handed to the host preemptor by the caller."""
         import jax.numpy as jnp
 
         from kueue_tpu.ops import commit as cops
         from kueue_tpu.ops import preempt as pops
         from kueue_tpu.ops import quota as qops
         from kueue_tpu.oracle import batched as B
-        from kueue_tpu.scheduler.preemption import IN_CLUSTER_QUEUE
+        from kueue_tpu.scheduler.preemption import (
+            IN_CLUSTER_QUEUE,
+            IN_COHORT_RECLAIM_WHILE_BORROWING,
+            IN_COHORT_RECLAMATION,
+        )
         from kueue_tpu.tensor.schema import encode_admitted
+
+        variant_reason = {
+            pops.V_WITHIN_CQ: IN_CLUSTER_QUEUE,
+            pops.V_HIERARCHICAL_RECLAIM: IN_COHORT_RECLAMATION,
+            pops.V_RECLAIM_WITHOUT_BORROWING: IN_COHORT_RECLAMATION,
+            pops.V_RECLAIM_WHILE_BORROWING:
+                IN_COHORT_RECLAIM_WHILE_BORROWING,
+        }
 
         eng = self.engine
         C = w.num_cqs
         S = w.num_resources
+        R = max(w.num_flavors, 1) * max(S, 1)
         flagged = np.nonzero(in_scope)[0]
 
         admitted = [info for cqs in snapshot.cluster_queues.values()
@@ -402,49 +429,77 @@ class OracleBridge:
             found = np.zeros(C, bool)
             overflow = np.zeros(C, bool)
             mask = np.zeros((C, 0), bool)
+            variant = np.zeros((C, 0), np.int32)
+            borrow_after = np.zeros(C, np.int32)
         else:
             derived = qops.derive_world(
                 jnp.asarray(w.nominal), jnp.asarray(w.lend_limit),
                 jnp.asarray(w.borrow_limit), usage, jnp.asarray(w.parent),
                 depth=w.depth)
-            found, overflow, mask, _n = pops.within_cq_targets(
+            found, overflow, mask, _n, variant, borrow_after = \
+                pops.classical_targets(
                 jnp.asarray(slot_need), jnp.asarray(slot_pri),
                 jnp.asarray(slot_ts), jnp.asarray(slot_fr),
-                jnp.asarray(slot_req), jnp.asarray(wcq_policy),
+                jnp.asarray(slot_req),
+                jnp.asarray(pcfg["wcq_policy"]),
+                jnp.asarray(pcfg["reclaim_policy"]),
+                jnp.asarray(pcfg["bwc_forbidden"]),
+                jnp.asarray(pcfg["bwc_threshold"]),
+                jnp.asarray(pcfg["cq_has_parent"]),
                 jnp.asarray(adm.cq), jnp.asarray(adm.priority),
                 jnp.asarray(adm.timestamp), jnp.asarray(adm.qr_time),
                 jnp.asarray(adm.uid_rank), jnp.asarray(adm.evicted),
                 jnp.asarray(adm.usage), derived["usage"],
                 derived["subtree_quota"], jnp.asarray(w.lend_limit),
-                jnp.asarray(w.borrow_limit), jnp.asarray(w.ancestors),
-                depth=w.depth, v_max=v_max)
-            found = np.asarray(found)
+                    jnp.asarray(w.borrow_limit), jnp.asarray(w.nominal),
+                    jnp.asarray(w.ancestors), jnp.asarray(w.height),
+                    jnp.asarray(w.local_chain),
+                    jnp.asarray(w.root_nodes), jnp.asarray(w.root_of_cq),
+                    depth=w.depth, v_cap=v_cap)
+            found = np.asarray(found) & in_scope
             overflow = np.asarray(overflow) & in_scope
             mask = np.asarray(mask)
+            variant = np.asarray(variant)
+            borrow_after = np.asarray(borrow_after)
 
+        V = v_cap
         override = np.full(C, -1, np.int32)
-        removal = np.zeros((C, S), np.int64)
+        borrows_override = np.full(C, -1, np.int32)
+        victim_row = np.full((C, V), -1, np.int32)
+        victim_vals = np.zeros((C, V, R), np.int64)
+        victim_ids = np.full((C, V), -1, np.int32)
         targets_by_slot: dict[int, list] = {}
         for ci in flagged:
             if overflow[ci]:
                 override[ci] = cops.ENTRY_SKIP  # root dropped by caller
             elif found[ci]:
                 override[ci] = cops.ENTRY_PREEMPT
-                victims = np.nonzero(mask[ci])[0]
+                borrows_override[ci] = borrow_after[ci]
+                victims = np.nonzero(mask[ci])[0][:V]
                 targets_by_slot[int(ci)] = [
-                    (admitted[v], IN_CLUSTER_QUEUE) for v in victims]
-                frs_safe = np.maximum(slot_fr[ci], 0)
-                vict_usage = adm.usage[victims][:, frs_safe].sum(axis=0)
-                removal[ci] = np.where(slot_fr[ci] >= 0, vict_usage, 0)
+                    (admitted[v],
+                     variant_reason.get(int(variant[ci, v]),
+                                        IN_CLUSTER_QUEUE))
+                    for v in victims]
+                for j, v in enumerate(victims):
+                    victim_row[ci, j] = w.local_chain[adm.cq[v], 0]
+                    victim_vals[ci, j] = adm.usage[v]
+                    victim_ids[ci, j] = v
             else:
                 override[ci] = (cops.ENTRY_SKIP
                                 if w.can_always_reclaim[ci]
                                 else cops.ENTRY_RESERVE)
 
+        A_pad = max(8, 1 << (max(adm.num_admitted, 1) - 1).bit_length())
         out = B.cycle_step(
             pending, inadmissible, usage, **args,
             slot_kind_override=jnp.asarray(override),
-            slot_removal=jnp.asarray(removal), **statics)
+            slot_borrows_override=jnp.asarray(borrows_override),
+            root_parent_local=jnp.asarray(w.root_parent_local),
+            slot_victim_row=jnp.asarray(victim_row),
+            slot_victim_vals=jnp.asarray(victim_vals),
+            slot_victim_ids=jnp.asarray(victim_ids),
+            claimed0=jnp.zeros(A_pad, bool), **statics)
         return out, targets_by_slot, overflow
 
     def _apply(self, solver, pending_infos, wl_admitted, parked,
@@ -459,38 +514,49 @@ class OracleBridge:
         eng = self.engine
         w, wls = solver.world, solver.wls
         result = CycleResult()
+        W = len(pending_infos)
         if apply_rows is None:
-            apply_rows = np.ones(len(pending_infos), bool)
+            apply_rows = np.ones(W, bool)
         if slot_mask is None:
             slot_mask = np.ones(w.num_cqs, bool)
-        order = np.argsort([
-            slot_position[wls.cq[i]] if wl_admitted[i] else 1 << 30
-            for i in range(len(pending_infos))])
-        for i in order:
+        if slot_preempting is None:
+            slot_preempting = np.zeros(w.num_cqs, bool)
+
+        # Group verdict rows per slot.
+        admit_of_slot: dict[int, int] = {}
+        parked_of_slot: dict[int, list[int]] = {}
+        for i in range(W):
             if not apply_rows[i]:
                 continue
-            info = pending_infos[i]
+            ci = int(wls.cq[i])
             if wl_admitted[i]:
+                admit_of_slot[ci] = i
+            elif parked[i]:
+                parked_of_slot.setdefault(ci, []).append(i)
+
+        # Apply per slot in the host's nominate order (the queue manager's
+        # ClusterQueue iteration order): the interleaving of parking and
+        # evictions matters, because an eviction re-activates the cohort's
+        # inadmissible workloads — a head parked BEFORE a later entry's
+        # eviction comes back, one parked after stays parked
+        # (engine._sequential_cycle processes entries the same way).
+        cq_idx = {n: i for i, n in enumerate(w.cq_names)}
+        nominate_order = [cq_idx[n] for n in eng.queues.cluster_queues
+                          if n in cq_idx]
+        for ci in nominate_order:
+            if not slot_mask[ci]:
+                continue
+            i = admit_of_slot.get(ci)
+            if i is not None:
+                info = pending_infos[i]
                 entry = self._make_entry(info, w, wls, flavor_of_res, i)
                 entry.status = EntryStatus.ASSUMED
-                entry.commit_position = int(slot_position[wls.cq[i]])
+                entry.commit_position = int(slot_position[ci])
                 eng.queues.delete_workload(info.obj)
                 eng._admit(entry)
                 result.entries.append(entry)
                 result.stats.admitted += 1
-            elif parked[i]:
-                pcq = eng.queues.cluster_queues.get(info.cluster_queue)
-                if pcq is not None:
-                    pcq.delete(info.key)
-                    pcq.inadmissible[info.key] = info
-                entry = Entry(info=info,
-                              requeue_reason=RequeueReason.NO_FIT)
-                entry.inadmissible_msg = "NoFit (batched oracle)"
-                result.entries.append(entry)
-        if slot_preempting is not None and slot_preempting.any():
-            for ci in np.nonzero(slot_preempting)[0]:
-                if not slot_mask[ci]:
-                    continue
+            if slot_preempting[ci]:
                 wid = int(head_idx[ci])
                 info = pending_infos[wid]
                 entry = self._make_entry(info, w, wls, flavor_of_res, wid)
@@ -504,6 +570,16 @@ class OracleBridge:
                 eng._issue_preemptions(entry)
                 result.entries.append(entry)
                 result.stats.preempting += 1
+            for i in parked_of_slot.get(ci, ()):
+                info = pending_infos[i]
+                pcq = eng.queues.cluster_queues.get(info.cluster_queue)
+                if pcq is not None:
+                    pcq.delete(info.key)
+                    pcq.inadmissible[info.key] = info
+                entry = Entry(info=info,
+                              requeue_reason=RequeueReason.NO_FIT)
+                entry.inadmissible_msg = "NoFit (batched oracle)"
+                result.entries.append(entry)
         return result
 
     def _make_entry(self, info, w, wls, flavor_of_res, i) -> Entry:

@@ -160,7 +160,7 @@ def device_targets(eng, wl_info, assignment, now, v_cap=16):
         jnp.asarray(world.borrow_limit), jnp.asarray(usage),
         jnp.asarray(world.parent), depth=world.depth)
 
-    found, overflow, mask, n, variant = pops.classical_targets(
+    found, overflow, mask, n, variant, _borrow = pops.classical_targets(
         jnp.asarray(slot_need), jnp.asarray(slot_pri),
         jnp.asarray(slot_ts), jnp.asarray(slot_fr),
         jnp.asarray(slot_req), jnp.asarray(wcq_policy),
@@ -172,7 +172,8 @@ def device_targets(eng, wl_info, assignment, now, v_cap=16):
         jnp.asarray(adm.usage), derived["usage"],
         derived["subtree_quota"], jnp.asarray(world.lend_limit),
         jnp.asarray(world.borrow_limit), jnp.asarray(world.nominal),
-        jnp.asarray(world.ancestors), jnp.asarray(world.local_chain),
+        jnp.asarray(world.ancestors), jnp.asarray(world.height),
+        jnp.asarray(world.local_chain),
         jnp.asarray(world.root_nodes), jnp.asarray(world.root_of_cq),
         depth=world.depth, v_cap=v_cap)
     found = bool(np.asarray(found)[ci])

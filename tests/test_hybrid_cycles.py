@@ -178,3 +178,63 @@ def test_requeue_backoff_respected_on_device():
     eng.clock = 101.0
     eng.schedule_once()
     assert w1.is_admitted
+
+
+def test_cross_cq_reclaim_on_device():
+    """Non-Never reclaimWithinCohort / borrowWithinCohort policies now run
+    on the device preemptor (ops/preempt.classical_targets) — outcomes
+    match the sequential engine with no preemption-scope handoffs."""
+    from kueue_tpu.api.types import (
+        BorrowWithinCohort,
+        BorrowWithinCohortPolicy,
+    )
+
+    def pre_of(idx):
+        if idx % 3 == 0:
+            return ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                reclaim_within_cohort=PreemptionPolicy.ANY)
+        if idx % 3 == 1:
+            return ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY,
+                borrow_within_cohort=BorrowWithinCohort(
+                    policy=BorrowWithinCohortPolicy.LOWER_PRIORITY,
+                    max_priority_threshold=5))
+        return ClusterQueuePreemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY)
+
+    def build(oracle):
+        rng = random.Random(17)
+        eng = make_engine(oracle, n_cohorts=2, cqs_per_cohort=3,
+                          nominal=1500, preemption_of=pre_of)
+        wls = []
+        # Overfill some CQs so siblings borrow, then reclaim.
+        for i in range(18):
+            eng.clock += 0.1
+            wl = Workload(name=f"low{i}",
+                          queue_name=f"lq{rng.randrange(6)}",
+                          priority=rng.choice([0, 1]),
+                          pod_sets=(PodSet("main", 1,
+                                           {"cpu": rng.choice(
+                                               [600, 1000])}),))
+            eng.submit(wl)
+            wls.append(wl)
+        drain(eng)
+        for i in range(6):
+            eng.clock += 0.1
+            wl = Workload(name=f"high{i}", queue_name=f"lq{i}",
+                          priority=10,
+                          pod_sets=(PodSet("main", 1, {"cpu": 1400}),))
+            eng.submit(wl)
+            wls.append(wl)
+        drain(eng)
+        return eng, wls
+
+    seq, seq_wls = build(False)
+    bat, bat_wls = build(True)
+    assert outcomes(seq_wls) == outcomes(bat_wls)
+    assert (sorted(w.name for w in seq_wls if w.is_evicted)
+            == sorted(w.name for w in bat_wls if w.is_evicted))
+    assert bat.oracle.cycles_on_device > 0
+    assert bat.oracle.host_root_reasons.get("preemption-scope", 0) == 0
