@@ -34,6 +34,9 @@ _DEFAULTS: dict[str, bool] = {
     "PriorityBoost": False,
     # the TPU oracle fast path
     "BatchedOracle": True,
+    # TAS placement solved by the device kernel (ops/tas.tas_place);
+    # off = sequential host path only.
+    "DeviceTAS": True,
 }
 
 _overrides: dict[str, bool] = {}

@@ -1,18 +1,36 @@
-"""Batched TAS phase-1: per-domain fit counting as array programs.
+"""Batched TAS: the whole placement algorithm as device array programs.
 
-The reference's placement hot loop (tas_flavor_snapshot.go:1748
-fillInCounts) walks every leaf domain per pod set per scheduling attempt.
-Here the whole forest is computed at once:
+The reference's placement kernel (tas_flavor_snapshot.go) runs per
+scheduling attempt:
 
-  * leaf_states: [L] pods-that-fit per leaf = min over resources of
-    floor(free / per-pod), vectorized over leaves x resources — and
-    vmappable over many pod sets at once;
-  * bubble_counts: level-wise segment sums up the topology tree, plus the
-    slice conversion at the slice level.
+  Phase 1 (fillInCounts :1748): per-leaf pods-that-fit (plus the leader
+  variants stateWithLeader / sliceStateWithLeader / leaderState,
+  fillLeafCounts :1864) bubbled up the topology tree
+  (fillInCountsHelper :1906), with the slice conversion at the slice
+  level.
 
-Phase 2 (sorted level descent) operates on the tiny per-level domain sets
-and stays host-side in round 1; with phase 1 on device the expensive
-O(leaves x podsets) part is a single fused kernel.
+  Phase 2 (findTopologyAssignment :946): pick the assignment level
+  (required names it, preferred climbs, unconstrained scans), then
+  descend level by level, each time sorting child domains
+  (sortedDomains :1722 / sortedDomainsWithLeader :1683) and taking a
+  minimal prefix with a best-fit terminal domain
+  (updateCountsToMinimumGeneric :1575, consumeWithLeadersGeneric :1510,
+  findBestFitDomainForSlices).
+
+Both phases live here as jitted jnp programs:
+
+  * leaf_states / bubble_counts — the standalone phase-1 kernels
+    (segment reductions up the tree), vmappable over pod sets;
+  * tas_place — the full placement: phase 1 with leader variants fused
+    with the phase-2 sorted descent. Sorting is lax.sort with
+    lexicographic keys; the greedy minimal-prefix consumption is a
+    cumsum + first-fit + best-fit-over-suffix formulation that
+    reproduces the sequential walk exactly (tests/test_tas_device.py
+    differential suite vs tas/snapshot.py).
+
+The serving path dispatches to tas_place via tas/device.py (feature gate
+"DeviceTAS"); the sequential implementation in tas/snapshot.py remains
+the correctness oracle.
 """
 
 from __future__ import annotations
@@ -96,6 +114,431 @@ def bubble_counts(leaf_state, parent_of_level, level_sizes_max,
         slice_state = slice_state.at[lvl].set(
             jnp.where(lvl < slice_level_idx, agg, slice_state[lvl]))
     return state, slice_state
+
+
+# ---------------------------------------------------------------------------
+# Full placement: phase 1 (with leader variants) + phase 2 (sorted level
+# descent) as one jitted program.  Status codes map to the sequential
+# implementation's failure strings (tas/device.py renders the messages).
+# ---------------------------------------------------------------------------
+
+OK = 0
+ERR_NOT_FIT = 1          # fit_arg = how much fit, want = slice_count
+ERR_UNDERFLOW = 2        # "internal: assignment accounting underflow"
+
+_IBIG = jnp.int64(1) << 60
+
+
+def _count_in(rem, req, has_pods_cap, pods_col):
+    """count_in of fillLeafCounts (tas_flavor_snapshot.go:1864): pods that
+    fit per leaf given remaining capacity. A leaf without explicit "pods"
+    capacity is unlimited on that resource; a leaf with zero applicable
+    constraints fits zero pods."""
+    app = jnp.broadcast_to(req > 0, rem.shape)
+    if pods_col >= 0:
+        app = app.at[:, pods_col].set((req[pods_col] > 0) & has_pods_cap)
+    cnt = jnp.where(app,
+                    jnp.maximum(rem, 0) // jnp.maximum(req, 1)[None, :],
+                    _IBIG)
+    n_app = app.sum(axis=1)
+    return jnp.where(n_app > 0, cnt.min(axis=1), 0)
+
+
+def _phase1(free, usage, assumed, per_pod, leader_per_pod, leaf_mask,
+            has_pods_cap, valid, parent, slice_size, *, num_levels,
+            max_domains, pods_col, slice_level, has_leader):
+    """fillInCounts :1750 with the leader variants. Returns the five
+    stacked state arrays, each int64[num_levels, max_domains]."""
+    M = max_domains
+    rem0 = free - usage - assumed
+    st_leaf = jnp.where(leaf_mask,
+                        _count_in(rem0, per_pod, has_pods_cap, pods_col), 0)
+    if has_leader:
+        lead_fit = leaf_mask & (
+            _count_in(rem0, leader_per_pod, has_pods_cap, pods_col) > 0)
+        rem1 = rem0 - leader_per_pod[None, :]
+        swl_leaf = jnp.where(
+            lead_fit, _count_in(rem1, per_pod, has_pods_cap, pods_col), 0)
+        ls_leaf = jnp.where(lead_fit, 1, 0).astype(jnp.int64)
+    else:
+        swl_leaf = st_leaf
+        ls_leaf = jnp.zeros(M, jnp.int64)
+
+    st = [None] * num_levels
+    sst = [None] * num_levels
+    swl = [None] * num_levels
+    sstl = [None] * num_levels
+    ls = [None] * num_levels
+    leaf_lvl = num_levels - 1
+    st[leaf_lvl] = st_leaf
+    swl[leaf_lvl] = swl_leaf
+    ls[leaf_lvl] = ls_leaf
+    if leaf_lvl == slice_level:
+        sst[leaf_lvl] = st_leaf // slice_size
+        sstl[leaf_lvl] = swl_leaf // slice_size
+    else:
+        sst[leaf_lvl] = jnp.zeros(M, jnp.int64)
+        sstl[leaf_lvl] = jnp.zeros(M, jnp.int64)
+
+    for lvl in range(num_levels - 2, -1, -1):
+        child_valid = valid[lvl + 1]
+        seg = jnp.where(child_valid, parent[lvl + 1], M)
+        stc, sstc, swlc, sstlc, lsc = (st[lvl + 1], sst[lvl + 1],
+                                       swl[lvl + 1], sstl[lvl + 1],
+                                       ls[lvl + 1])
+        sum_st = jax.ops.segment_sum(jnp.where(child_valid, stc, 0), seg,
+                                     num_segments=M + 1)[:M]
+        sum_sst = jax.ops.segment_sum(jnp.where(child_valid, sstc, 0), seg,
+                                      num_segments=M + 1)[:M]
+        # fillInCountsHelper: leader-capable children bound the
+        # with-leader variants (min of state - stateWithLeader).
+        cond = child_valid & ((lsc > 0) if has_leader
+                              else jnp.ones(M, bool))
+        min_diff = jax.ops.segment_min(
+            jnp.where(cond, stc - swlc, _IBIG), seg,
+            num_segments=M + 1)[:M]
+        min_sdiff = jax.ops.segment_min(
+            jnp.where(cond, sstc - sstlc, _IBIG), seg,
+            num_segments=M + 1)[:M]
+        has_contrib = jax.ops.segment_max(
+            cond.astype(jnp.int64), seg, num_segments=M + 1)[:M] > 0
+        st_p = sum_st
+        swl_p = jnp.where(has_contrib, sum_st - min_diff, 0)
+        sstl_p = jnp.where(has_contrib, sum_sst - min_sdiff, 0)
+        ls_p = jax.ops.segment_max(
+            jnp.where(child_valid, lsc, 0), seg, num_segments=M + 1)[:M]
+        if lvl == slice_level:
+            sst_p = st_p // slice_size
+            sstl_p = swl_p // slice_size
+        else:
+            sst_p = sum_sst
+        v = valid[lvl]
+        st[lvl] = jnp.where(v, st_p, 0)
+        sst[lvl] = jnp.where(v, sst_p, 0)
+        swl[lvl] = jnp.where(v, swl_p, 0)
+        sstl[lvl] = jnp.where(v, sstl_p, 0)
+        ls[lvl] = jnp.where(v, ls_p, 0)
+    return (jnp.stack(st), jnp.stack(sst), jnp.stack(swl),
+            jnp.stack(sstl), jnp.stack(ls))
+
+
+def _rank_of(keys, M):
+    """Sort permutation + per-slot rank for a lexicographic key tuple."""
+    ops = tuple(keys) + (jnp.arange(M, dtype=jnp.int64),)
+    out = jax.lax.sort(ops, num_keys=len(keys), is_stable=True)
+    perm = out[-1]
+    rank = jnp.zeros(M, jnp.int64).at[perm].set(
+        jnp.arange(M, dtype=jnp.int64))
+    return perm, rank
+
+
+def _leader_keys(stl, sstl, ls, vr, valid, unconstrained):
+    """sortedDomainsWithLeader :1683."""
+    k0 = jnp.where(valid, 0, 1).astype(jnp.int64)
+    if unconstrained:
+        return (k0, -ls, sstl, stl, vr)
+    return (k0, -ls, -sstl, stl, vr)
+
+
+def _normal_keys(st, sst, vr, valid, unconstrained):
+    """sortedDomains :1722 — BestFit, or LeastFreeCapacity ascending."""
+    k0 = jnp.where(valid, 0, 1).astype(jnp.int64)
+    if unconstrained:
+        return (k0, sst, st, vr)
+    return (k0, -sst, st, vr)
+
+
+def _consume(seg, cap, capwl, ls, tie_rank, need, leadp, *, nseg,
+             unconstrained):
+    """The greedy minimal-prefix walk of updateCountsToMinimumGeneric
+    :1575 / consumeWithLeadersGeneric :1510, segmented.
+
+    Elements are given in walk order; ``seg[i]`` is the segment id
+    (>= nseg marks padding). Per segment: walk elements, the first one
+    consuming the leader when ``leadp``; full takes until the first
+    element whose own capacity covers the remainder; that terminal take
+    goes to the best-fit domain in the suffix (least leftover capacity,
+    earliest ``tie_rank`` on ties) unless unconstrained.
+
+    Returns (cnt[i] units, lead[i], seg_ok[nseg], leader_ok[nseg],
+    consumed[nseg])."""
+    N = seg.shape[0]
+    idx = jnp.arange(N, dtype=jnp.int64)
+    valid = seg < nseg
+    segc = jnp.clip(seg, 0, nseg - 1)
+    segfull = jnp.where(valid, seg, nseg)
+    is_first = jnp.concatenate(
+        [jnp.ones(1, bool), seg[1:] != seg[:-1]]) & valid
+    lp_e = valid & leadp[segc]
+    need_e = jnp.where(valid, need[segc], 0)
+    eff = jnp.where(is_first & lp_e, capwl, cap)
+    eff = jnp.where(valid, eff, 0)
+    cs = jnp.cumsum(eff)
+    excl = cs - eff
+    base = jax.ops.segment_sum(jnp.where(is_first, excl, 0), segfull,
+                               num_segments=nseg + 1)
+    prefix = excl - base[segfull]
+    remaining = jnp.maximum(need_e - prefix, 0)
+    # Terminal fit: own capacity covers the remainder (the leader-first
+    # element additionally needs leader capacity, preemption of which is
+    # what capwl already accounts for).
+    fit = valid & (eff >= remaining) & (~(is_first & lp_e) | (ls >= 1))
+    t_pos = jax.ops.segment_min(jnp.where(fit, idx, _IBIG), segfull,
+                                num_segments=nseg + 1)
+    seg_ok = t_pos[:nseg] < _IBIG
+    f_pos = jax.ops.segment_min(jnp.where(valid, idx, _IBIG), segfull,
+                                num_segments=nseg + 1)
+    f_safe = jnp.clip(f_pos[:nseg], 0, N - 1)
+    leader_ok = ~leadp | ((f_pos[:nseg] < _IBIG) & (ls[f_safe] > 0))
+    t_e = t_pos[segfull]
+    t_safe = jnp.clip(t_e, 0, N - 1)
+    rem_t_e = jnp.where(t_e < _IBIG, remaining[t_safe.astype(jnp.int32)], 0)
+    flt_e = lp_e & (t_e == f_pos[segfull])  # leader consumed at terminal
+    bkey = jnp.where(flt_e, capwl, cap)
+    in_suf = valid & (idx >= t_e)
+    cond = in_suf & (bkey >= rem_t_e) & (~flt_e | (ls >= 1))
+    mk = jax.ops.segment_min(jnp.where(cond, bkey, _IBIG), segfull,
+                             num_segments=nseg + 1)
+    if unconstrained:
+        is_b = valid & (idx == t_e)
+    else:
+        tie = jnp.where(flt_e, tie_rank, idx)
+        bt = jax.ops.segment_min(
+            jnp.where(cond & (bkey == mk[segfull]), tie, _IBIG), segfull,
+            num_segments=nseg + 1)
+        is_b = cond & (bkey == mk[segfull]) & (
+            jnp.where(flt_e, tie_rank, idx) == bt[segfull])
+    cnt = jnp.where(valid & (idx < t_e), eff, 0)
+    cnt = cnt + jnp.where(is_b, rem_t_e, 0)
+    lead = jnp.where(flt_e & is_b, 1, 0) + jnp.where(
+        lp_e & is_first & ~flt_e, jnp.minimum(ls, 1), 0)
+    consumed = jax.ops.segment_sum(eff, segfull,
+                                   num_segments=nseg + 1)[:nseg]
+    return cnt, lead, seg_ok, leader_ok, consumed
+
+
+@partial(jax.jit, static_argnames=(
+    "num_levels", "max_domains", "num_resources", "pods_col", "req_level",
+    "slice_level", "required", "unconstrained", "has_leader"))
+def tas_place(free, usage, assumed, per_pod, leader_per_pod, leaf_mask,
+              has_pods_cap, valid, vrank, parent, count, slice_size, *,
+              num_levels, max_domains, num_resources, pods_col, req_level,
+              slice_level, required, unconstrained, has_leader):
+    """findTopologyAssignment :946 end-to-end on device.
+
+    free/usage/assumed: int64[M, S] leaf-slot capacity state;
+    per_pod/leader_per_pod: int64[S]; leaf_mask/has_pods_cap: bool[M];
+    valid: bool[NL, M]; vrank: int64[NL, M] lexicographic value rank;
+    parent: int64[NL, M] parent slot at the level above.
+
+    Returns (status, fit_arg, cnt int64[M], lead int64[M]) — cnt/lead are
+    the per-leaf-slot worker pod counts and leader placements."""
+    NL, M = num_levels, max_domains
+    slice_count = count // slice_size
+    st, sst, swl, sstl, ls = _phase1(
+        free, usage, assumed, per_pod, leader_per_pod, leaf_mask,
+        has_pods_cap, valid, parent, slice_size, num_levels=NL,
+        max_domains=M, pods_col=pods_col, slice_level=slice_level,
+        has_leader=has_leader)
+    leader_count = 1 if has_leader else 0
+
+    def level_arrays(lvl):
+        return st[lvl], sst[lvl], swl[lvl], sstl[lvl], ls[lvl]
+
+    # --- per-level leader-order ranks and top-fit flags ---
+    lperm, lrank, topfit, topslice = {}, {}, {}, {}
+    for lvl in range(req_level + 1):
+        stl_, sst_, swl_, sstl_, ls_ = level_arrays(lvl)
+        perm, rank = _rank_of(
+            _leader_keys(swl_, sstl_, ls_, vrank[lvl], valid[lvl],
+                         unconstrained), M)
+        lperm[lvl], lrank[lvl] = perm, rank
+        top = perm[0]
+        topfit[lvl] = (valid[lvl][top] & (sstl_[top] >= slice_count)
+                       & (ls_[top] >= leader_count))
+        topslice[lvl] = jnp.where(valid[lvl][top], sst_[top], 0)
+
+    # findLevelWithFitDomains recursion: deepest level whose best domain
+    # fits; preferred climbs toward the root, required stays put.
+    if required or unconstrained:
+        fit_level = jnp.int64(req_level)
+    else:
+        fit_level = jnp.int64(0)
+        for lvl in range(req_level + 1):
+            fit_level = jnp.where(topfit[lvl], lvl, fit_level)
+
+    def single_pick(lvl):
+        """Top domain fits: findBestFitDomainForSlices over the whole
+        level (ties in leader-sort order)."""
+        _, sst_, _, sstl_, ls_ = level_arrays(lvl)
+        cond = valid[lvl] & (sstl_ >= slice_count) & (ls_ >= leader_count)
+        key = jnp.where(cond, sstl_, _IBIG)
+        mn = key.min()
+        pick = jnp.argmin(jnp.where(cond & (key == mn), lrank[lvl], _IBIG))
+        cnt = jnp.zeros(M, jnp.int64).at[pick].set(count)
+        lead = jnp.zeros(M, jnp.int64).at[pick].set(leader_count)
+        return cnt, lead, jnp.int64(OK), jnp.int64(0)
+
+    def unconstrained_pick(lvl):
+        """LeastFreeCapacity scan: fullest single domain that fits
+        (by slice_state; the leader consume can then underflow, which the
+        sequential path reports as an accounting underflow)."""
+        _, sst_, _, sstl_, ls_ = level_arrays(lvl)
+        cond = valid[lvl] & (sst_ >= slice_count)
+        pick = jnp.argmin(jnp.where(cond, lrank[lvl], _IBIG))
+        ok = (sstl_[pick] >= slice_count) & (ls_[pick] >= leader_count) \
+            if has_leader else jnp.bool_(True)
+        cnt = jnp.zeros(M, jnp.int64).at[pick].set(count)
+        lead = jnp.zeros(M, jnp.int64).at[pick].set(leader_count)
+        status = jnp.where(ok, OK, ERR_UNDERFLOW).astype(jnp.int64)
+        return cnt, lead, status, jnp.int64(0)
+
+    def greedy_pick(lvl):
+        """Multi-domain greedy (:1430-1469): the leader-capable pick
+        first, then the rest re-sorted without the leader keys; one
+        consume walk yields the same takes as the select+minimize pair."""
+        st_, sst_, swl_, sstl_, ls_ = level_arrays(lvl)
+        nk = _normal_keys(st_, sst_, vrank[lvl], valid[lvl], unconstrained)
+        if has_leader:
+            f0 = lperm[lvl][0]
+            leader_bad = ls_[f0] <= 0
+            kf = jnp.where(jnp.arange(M) == f0, 0, 1).astype(jnp.int64)
+            perm, _ = _rank_of((kf,) + nk, M)
+        else:
+            leader_bad = jnp.bool_(False)
+            perm, _ = _rank_of(nk, M)
+        validp = valid[lvl][perm]
+        seg = jnp.where(validp, 0, 1)
+        cnt_u, lead_u, seg_ok, _lok, consumed = _consume(
+            seg, sst_[perm], sstl_[perm], ls_[perm], lrank[lvl][perm],
+            jnp.reshape(slice_count, (1,)), jnp.array([has_leader]),
+            nseg=1, unconstrained=unconstrained)
+        cnt = jnp.zeros(M, jnp.int64).at[perm].set(
+            jnp.where(validp, cnt_u * slice_size, 0))
+        lead = jnp.zeros(M, jnp.int64).at[perm].set(
+            jnp.where(validp, lead_u, 0))
+        status = jnp.where(
+            leader_bad, ERR_NOT_FIT,
+            jnp.where(seg_ok[0], OK, ERR_NOT_FIT)).astype(jnp.int64)
+        fit_arg = jnp.where(leader_bad, 0, consumed[0])
+        return cnt, lead, status, fit_arg
+
+    def selection_at(lvl):
+        if required:
+            cnt, lead, status, fit_arg = single_pick(lvl)
+            status = jnp.where(topfit[lvl], status, ERR_NOT_FIT)
+            fit_arg = jnp.where(topfit[lvl], fit_arg, topslice[lvl])
+            return cnt, lead, status, fit_arg
+        if unconstrained:
+            s_cnt, s_lead, s_st, s_fa = unconstrained_pick(lvl)
+            g_cnt, g_lead, g_st, g_fa = greedy_pick(lvl)
+            _, sst_, _, _, _ = level_arrays(lvl)
+            found = jnp.any(valid[lvl] & (sst_ >= slice_count))
+            return (jnp.where(found, s_cnt, g_cnt),
+                    jnp.where(found, s_lead, g_lead),
+                    jnp.where(found, s_st, g_st),
+                    jnp.where(found, s_fa, g_fa))
+        # preferred
+        if lvl == 0:
+            s_cnt, s_lead, s_st, s_fa = single_pick(lvl)
+            g_cnt, g_lead, g_st, g_fa = greedy_pick(lvl)
+            return (jnp.where(topfit[lvl], s_cnt, g_cnt),
+                    jnp.where(topfit[lvl], s_lead, g_lead),
+                    jnp.where(topfit[lvl], s_st, g_st),
+                    jnp.where(topfit[lvl], s_fa, g_fa))
+        return single_pick(lvl)
+
+    def pooled_step(lvl, cnt, lead):
+        """First descent loop (:1089-1094): children of all chosen
+        domains pooled, one global sort + consume in slice units."""
+        chosen = (cnt > 0) | (lead > 0)
+        cv = valid[lvl + 1]
+        par = jnp.clip(parent[lvl + 1], 0, M - 1)
+        elig = cv & chosen[par]
+        stc, sstc, swlc, sstlc, lsc = level_arrays(lvl + 1)
+        if has_leader:
+            keys = _leader_keys(swlc, sstlc, lsc, vrank[lvl + 1], elig,
+                                unconstrained)
+        else:
+            keys = _normal_keys(stc, sstc, vrank[lvl + 1], elig,
+                                unconstrained)
+        perm, _ = _rank_of(keys, M)
+        eligp = elig[perm]
+        seg = jnp.where(eligp, 0, 1)
+        pos = jnp.arange(M, dtype=jnp.int64)
+        cnt_u, lead_u, seg_ok, lok, _cons = _consume(
+            seg, sstc[perm], sstlc[perm], lsc[perm], pos,
+            jnp.reshape(slice_count, (1,)), jnp.array([has_leader]),
+            nseg=1, unconstrained=unconstrained)
+        new_cnt = jnp.zeros(M, jnp.int64).at[perm].set(
+            jnp.where(eligp, cnt_u * slice_size, 0))
+        new_lead = jnp.zeros(M, jnp.int64).at[perm].set(
+            jnp.where(eligp, lead_u, 0))
+        status = jnp.where(seg_ok[0] & lok[0], OK,
+                           ERR_NOT_FIT).astype(jnp.int64)
+        return new_cnt, new_lead, status, jnp.int64(0)
+
+    def per_parent_step(lvl, cnt, lead):
+        """Second descent loop (:1095-1130): pods distributed per chosen
+        parent, pod units (the device path never runs balanced placement,
+        so slices are always already anchored here)."""
+        chosen = (cnt > 0) | (lead > 0)
+        cv = valid[lvl + 1]
+        par = jnp.clip(parent[lvl + 1], 0, M - 1)
+        elig = cv & chosen[par]
+        leadp_parent = lead > 0
+        child_lp = elig & leadp_parent[par]
+        stc, sstc, swlc, sstlc, lsc = level_arrays(lvl + 1)
+        vr = vrank[lvl + 1]
+        if unconstrained:
+            lk = (-lsc, sstlc, swlc, vr)
+            nk = (sstc, stc, vr, jnp.zeros(M, jnp.int64))
+        else:
+            lk = (-lsc, -sstlc, swlc, vr)
+            nk = (-sstc, stc, vr, jnp.zeros(M, jnp.int64))
+        ka = [jnp.where(child_lp, a, b) for a, b in zip(lk, nk)]
+        pkey = jnp.where(elig, parent[lvl + 1], M)
+        perm, _ = _rank_of((pkey,) + tuple(ka), M)
+        seg = pkey[perm]
+        pos = jnp.arange(M, dtype=jnp.int64)
+        cnt_u, lead_u, seg_ok, lok, _cons = _consume(
+            seg, stc[perm], swlc[perm], lsc[perm], pos, cnt,
+            leadp_parent, nseg=M, unconstrained=unconstrained)
+        new_cnt = jnp.zeros(M, jnp.int64).at[perm].set(
+            jnp.where(elig[perm], cnt_u, 0))
+        new_lead = jnp.zeros(M, jnp.int64).at[perm].set(
+            jnp.where(elig[perm], lead_u, 0))
+        bad = chosen & (~seg_ok | (leadp_parent & ~lok))
+        status = jnp.where(jnp.any(bad), ERR_UNDERFLOW,
+                           OK).astype(jnp.int64)
+        return new_cnt, new_lead, status, jnp.int64(0)
+
+    cnt = jnp.zeros(M, jnp.int64)
+    lead = jnp.zeros(M, jnp.int64)
+    status = jnp.int64(OK)
+    fit_arg = jnp.int64(0)
+    cand = range(req_level + 1) if not (required or unconstrained) \
+        else (req_level,)
+    sels = {lvl: selection_at(lvl) for lvl in cand}
+    for lvl in range(NL):
+        if lvl in sels:
+            here = fit_level == lvl
+            s_cnt, s_lead, s_st, s_fa = sels[lvl]
+            cnt = jnp.where(here, s_cnt, cnt)
+            lead = jnp.where(here, s_lead, lead)
+            status = jnp.where(here, s_st, status)
+            fit_arg = jnp.where(here, s_fa, fit_arg)
+        if lvl < NL - 1:
+            act = (fit_level <= lvl) & (status == OK)
+            if lvl + 1 <= slice_level:
+                n_cnt, n_lead, d_st, d_fa = pooled_step(lvl, cnt, lead)
+            else:
+                n_cnt, n_lead, d_st, d_fa = per_parent_step(lvl, cnt, lead)
+            cnt = jnp.where(act, n_cnt, cnt)
+            lead = jnp.where(act, n_lead, lead)
+            status = jnp.where(act, d_st, status)
+            fit_arg = jnp.where(act, d_fa, fit_arg)
+    return status, fit_arg, cnt, lead
 
 
 def encode_tas_snapshot(tas_snap, resources: list[str]):

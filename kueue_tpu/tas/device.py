@@ -1,0 +1,206 @@
+"""Device TAS: serving-path adapter for the ops/tas.tas_place kernel.
+
+TASFlavorSnapshot.find_topology_assignments dispatches here when the
+"DeviceTAS" gate is on (the default); the sequential implementation in
+tas/snapshot.py stays as the fallback and the differential-test oracle
+(tests/test_tas_device.py). The adapter:
+
+  * encodes the topology forest once per structure change (slots sorted
+    by values per level, parent pointers, value ranks), cached on the
+    snapshot keyed by a structure version counter;
+  * gathers the per-call leaf capacity state (free / TAS usage / assumed
+    usage), the pod-set's resource vectors, and the selector /
+    replacement-domain leaf mask;
+  * launches the placement program and renders the reference's failure
+    strings from the kernel's status codes
+    (tas_flavor_snapshot.go:946 findTopologyAssignment semantics).
+
+Unsupported corners fall back to the sequential path by returning
+NotImplemented: balanced placement (tas_balanced_placement.go is
+host-side; it only engages for preferred mode under the
+TASBalancedPlacement gate) and level-less topologies.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kueue_tpu.api.types import PodSetTopologyRequest, TopologyMode
+from kueue_tpu.config import features
+
+_VRANK_PAD = 1 << 40
+
+
+def _structure(snap):
+    """Padded per-level slot arrays for the snapshot's forest, cached by
+    the snapshot's structure version."""
+    cached = getattr(snap, "_device_struct", None)
+    version = getattr(snap, "_version", 0)
+    if cached is not None and cached["version"] == version:
+        return cached
+    nl = len(snap.level_keys)
+    level_domains = [
+        sorted(snap.domains_per_level[lvl].values(),
+               key=lambda d: d.values)
+        for lvl in range(nl)]
+    m = max(1, max((len(doms) for doms in level_domains), default=1))
+    mp = max(8, 1 << (m - 1).bit_length())
+    valid = np.zeros((nl, mp), bool)
+    vrank = np.full((nl, mp), _VRANK_PAD, np.int64)
+    parent = np.full((nl, mp), -1, np.int64)
+    slot_of = [{d.id: i for i, d in enumerate(doms)}
+               for doms in level_domains]
+    for lvl, doms in enumerate(level_domains):
+        for i, d in enumerate(doms):
+            valid[lvl, i] = True
+            vrank[lvl, i] = i
+            if lvl > 0:
+                parent[lvl, i] = slot_of[lvl - 1][d.parent.id]
+    leaves = level_domains[nl - 1] if nl else []
+    res_axis = sorted({res for leaf in leaves
+                       for res in leaf.free_capacity} | {"pods"})
+    has_pods_cap = np.zeros(mp, bool)
+    for i, leaf in enumerate(leaves):
+        has_pods_cap[i] = "pods" in leaf.free_capacity
+    cached = dict(version=version, nl=nl, m=mp,
+                  level_domains=level_domains, leaves=leaves,
+                  res_axis=res_axis, valid=valid, vrank=vrank,
+                  parent=parent, has_pods_cap=has_pods_cap)
+    snap._device_struct = cached
+    return cached
+
+
+def _req_vector(requests: dict, cols: list[str]) -> np.ndarray:
+    out = np.zeros(len(cols), np.int64)
+    for i, res in enumerate(cols):
+        out[i] = requests.get(res, 0)
+    return out
+
+
+def try_find(snap, workers, leader=None, simulate_empty=False,
+             assumed_usage=None, required_replacement_domain=()):
+    """Device counterpart of find_topology_assignments. Returns
+    NotImplemented when the world needs the sequential path."""
+    if not snap.level_keys:
+        return NotImplemented
+    tr = workers.pod_set.topology_request or PodSetTopologyRequest()
+    required = tr.mode == TopologyMode.REQUIRED
+    unconstrained = tr.mode == TopologyMode.UNCONSTRAINED
+    if (features.enabled("TASBalancedPlacement") and not required
+            and not unconstrained):
+        return NotImplemented
+
+    count = workers.count
+    slice_size = tr.slice_size or 1
+    if count % slice_size != 0:
+        return None, (
+            f"pod count {count} not divisible by slice size {slice_size}")
+    if tr.level is not None:
+        if tr.level not in snap.level_keys:
+            return None, f"no requested topology level: {tr.level}"
+        req_idx = snap.level_keys.index(tr.level)
+    else:
+        req_idx = 0
+    slice_level_key = tr.slice_level or snap.level_keys[-1]
+    if slice_level_key not in snap.level_keys:
+        return None, (
+            f"no requested topology level for slices: {slice_level_key}")
+    slice_idx = snap.level_keys.index(slice_level_key)
+    if req_idx > slice_idx:
+        return None, (
+            f"podset slice topology {slice_level_key} is above the "
+            f"podset topology {tr.level}")
+
+    struct = _structure(snap)
+    if not struct["level_domains"][req_idx]:
+        return None, "no topology domains at level"
+
+    per_pod = dict(workers.single_pod_requests)
+    per_pod["pods"] = per_pod.get("pods", 0) + 1
+    leader_per_pod = {}
+    has_leader = leader is not None
+    if has_leader:
+        leader_per_pod = dict(leader.single_pod_requests)
+        leader_per_pod["pods"] = leader_per_pod.get("pods", 0) + 1
+
+    axis = struct["res_axis"]
+    extras = sorted((set(per_pod) | set(leader_per_pod)) - set(axis))
+    cols = axis + extras
+    sp = max(4, -(-len(cols) // 4) * 4)  # pad to a multiple of 4
+    cols = cols + [f"__pad{i}" for i in range(sp - len(cols))]
+
+    mp = struct["m"]
+    leaves = struct["leaves"]
+    free = np.zeros((mp, sp), np.int64)
+    usage = np.zeros((mp, sp), np.int64)
+    assumed = np.zeros((mp, sp), np.int64)
+    col_of = {res: i for i, res in enumerate(cols)}
+    for i, leaf in enumerate(leaves):
+        for res, cap in leaf.free_capacity.items():
+            free[i, col_of[res]] = cap
+        if not simulate_empty:
+            for res, used in leaf.tas_usage.items():
+                usage[i, col_of[res]] = used
+            if assumed_usage:
+                for res, used in assumed_usage.get(leaf.id, {}).items():
+                    if res in col_of:
+                        assumed[i, col_of[res]] = used
+
+    # Selector / replacement-domain leaf filtering (fillLeafCounts
+    # :1864 early returns).
+    leaf_mask = struct["valid"][struct["nl"] - 1].copy()
+    rrd = tuple(required_replacement_domain or ())
+    for i, leaf in enumerate(leaves):
+        if rrd and leaf.values[:len(rrd)] != rrd:
+            leaf_mask[i] = False
+            continue
+        if snap.is_lowest_level_node:
+            for key, val in workers.pod_set.node_selector.items():
+                if key in snap.level_keys and \
+                        leaf.values[snap.level_keys.index(key)] != val:
+                    leaf_mask[i] = False
+                    break
+
+    import jax.numpy as jnp
+
+    from kueue_tpu.ops import tas as tops
+    from kueue_tpu.tas.snapshot import (
+        TopologyAssignment,
+        TopologyDomainAssignment,
+    )
+
+    status, fit_arg, cnt, lead = tops.tas_place(
+        jnp.asarray(free), jnp.asarray(usage), jnp.asarray(assumed),
+        jnp.asarray(_req_vector(per_pod, cols)),
+        jnp.asarray(_req_vector(leader_per_pod, cols)),
+        jnp.asarray(leaf_mask), jnp.asarray(struct["has_pods_cap"]),
+        jnp.asarray(struct["valid"]), jnp.asarray(struct["vrank"]),
+        jnp.asarray(struct["parent"]), np.int64(count),
+        np.int64(slice_size), num_levels=struct["nl"], max_domains=mp,
+        num_resources=sp, pods_col=col_of["pods"], req_level=req_idx,
+        slice_level=slice_idx, required=required,
+        unconstrained=unconstrained, has_leader=has_leader)
+    status = int(status)
+    if status == tops.ERR_NOT_FIT:
+        return None, snap._not_fit_message(int(fit_arg),
+                                           count // slice_size)
+    if status == tops.ERR_UNDERFLOW:
+        return None, "internal: assignment accounting underflow"
+
+    cnt = np.asarray(cnt)
+    lead = np.asarray(lead)
+    assignments = {}
+    if has_leader:
+        leader_domains = sorted(
+            (TopologyDomainAssignment(leaves[i].values, int(lead[i]))
+             for i in range(len(leaves)) if lead[i] > 0),
+            key=lambda a: a.values)
+        assignments[leader.pod_set.name] = TopologyAssignment(
+            tuple(snap.level_keys), tuple(leader_domains))
+    domains = sorted(
+        (TopologyDomainAssignment(leaves[i].values, int(cnt[i]))
+         for i in range(len(leaves)) if cnt[i] > 0),
+        key=lambda a: a.values)
+    assignments[workers.pod_set.name] = TopologyAssignment(
+        tuple(snap.level_keys), tuple(domains))
+    return assignments, ""

@@ -163,6 +163,10 @@ class TASFlavorSnapshot:
         self.roots: dict[tuple, _Domain] = {}
         self.domains_per_level: list[dict[tuple, _Domain]] = [
             {} for _ in self.level_keys]
+        # Structure version for the device-path encoding cache
+        # (tas/device.py): bumped whenever the forest or capacities
+        # change shape.
+        self._version = 0
 
     # -- construction (tas_flavor.go / tas_nodes_cache.go) --
 
@@ -170,6 +174,7 @@ class TASFlavorSnapshot:
                  non_tas_usage: Optional[dict[str, int]] = None) -> None:
         if not node.ready:
             return
+        self._version += 1
         values = tuple(node.labels.get(k, "") for k in self.level_keys)
         if "" in values:
             return  # node not labeled for this topology
@@ -187,6 +192,7 @@ class TASFlavorSnapshot:
         leaf = self.leaves.pop(values, None)
         if leaf is None:
             return
+        self._version += 1
         self.domains.pop(values, None)
         self.domains_per_level[len(values) - 1].pop(values, None)
         if leaf.parent is not None:
@@ -324,7 +330,32 @@ class TASFlavorSnapshot:
         required_replacement_domain: tuple = (),
     ) -> tuple[Optional[dict[str, TopologyAssignment]], str]:
         """tas_flavor_snapshot.go:946 (findTopologyAssignment). Returns
-        ({pod_set_name: assignment}, failure_reason)."""
+        ({pod_set_name: assignment}, failure_reason).
+
+        The device placement program (ops/tas.tas_place via
+        tas/device.py) is the serving path; this sequential
+        implementation below is the fallback and the differential-test
+        oracle (tests/test_tas_device.py)."""
+        if features.enabled("DeviceTAS"):
+            from kueue_tpu.tas import device
+            out = device.try_find(
+                self, workers, leader, simulate_empty, assumed_usage,
+                required_replacement_domain)
+            if out is not NotImplemented:
+                return out
+        return self.find_topology_assignments_host(
+            workers, leader, simulate_empty, assumed_usage,
+            required_replacement_domain)
+
+    def find_topology_assignments_host(
+        self,
+        workers: TASPodSetRequest,
+        leader: Optional[TASPodSetRequest] = None,
+        simulate_empty: bool = False,
+        assumed_usage: Optional[dict[tuple, dict[str, int]]] = None,
+        required_replacement_domain: tuple = (),
+    ) -> tuple[Optional[dict[str, TopologyAssignment]], str]:
+        """The sequential oracle path of find_topology_assignments."""
         tr = workers.pod_set.topology_request or PodSetTopologyRequest()
         count = workers.count
         required = tr.mode == TopologyMode.REQUIRED

@@ -1,0 +1,179 @@
+"""Differential suite: device TAS placement (ops/tas.tas_place via
+tas/device.try_find) vs the sequential oracle
+(TASFlavorSnapshot.find_topology_assignments_host) on randomized
+topologies x modes x slices x leaders x selectors x usage."""
+
+import random
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.api.types import (  # noqa: E402
+    PodSet,
+    PodSetTopologyRequest,
+    Topology,
+    TopologyLevel,
+    TopologyMode,
+)
+from kueue_tpu.tas import device  # noqa: E402
+from kueue_tpu.tas.snapshot import (  # noqa: E402
+    HOSTNAME_LABEL,
+    Node,
+    TASFlavorSnapshot,
+    TASPodSetRequest,
+)
+
+TOPOLOGY3 = Topology("t3", (TopologyLevel("block"), TopologyLevel("rack"),
+                            TopologyLevel(HOSTNAME_LABEL)))
+TOPOLOGY2 = Topology("t2", (TopologyLevel("rack"),
+                            TopologyLevel(HOSTNAME_LABEL)))
+TOPOLOGY1 = Topology("t1", (TopologyLevel("rack"),))
+
+
+def random_world(rng, topology):
+    snap = TASFlavorSnapshot(topology)
+    n_levels = len(topology.levels)
+    for b in range(rng.randrange(1, 4)):
+        for r in range(rng.randrange(1, 4)):
+            for h in range(rng.randrange(1, 4)):
+                name = f"b{b}-r{r}-h{h}"
+                labels = {"block": f"b{b}", "rack": f"b{b}-r{r}",
+                          HOSTNAME_LABEL: name}
+                capacity = {"cpu": rng.choice([0, 2000, 4000, 8000])}
+                if rng.random() < 0.6:
+                    capacity["pods"] = rng.choice([2, 8, 32])
+                if rng.random() < 0.3:
+                    capacity["mem"] = rng.choice([1024, 4096])
+                snap.add_node(Node(name=name, labels=labels,
+                                   capacity=capacity))
+                if n_levels == 1 and b == 0 and r == 0:
+                    break
+            if n_levels <= 2 and b == 0:
+                break
+    for leaf in list(snap.leaves.values()):
+        if rng.random() < 0.5:
+            snap.add_usage(leaf.values,
+                           {"cpu": rng.randrange(0, 3000)},
+                           rng.randrange(0, 3))
+    return snap
+
+
+def random_request(rng, snap, name="main"):
+    levels = snap.level_keys
+    mode = rng.choice([TopologyMode.REQUIRED, TopologyMode.PREFERRED,
+                       TopologyMode.UNCONSTRAINED])
+    level = None
+    if mode != TopologyMode.UNCONSTRAINED:
+        level = rng.choice(levels)
+    slice_size = None
+    slice_level = None
+    if rng.random() < 0.4:
+        slice_size = rng.choice([2, 4])
+        cand = levels if level is None else \
+            levels[levels.index(level):]
+        slice_level = rng.choice(cand)
+    tr = PodSetTopologyRequest(mode=mode, level=level,
+                               slice_size=slice_size,
+                               slice_level=slice_level)
+    node_selector = {}
+    if rng.random() < 0.2 and snap.is_lowest_level_node:
+        any_leaf = rng.choice(list(snap.leaves.values()))
+        node_selector = {HOSTNAME_LABEL: any_leaf.values[-1]}
+    count = rng.choice([1, 2, 3, 4, 6, 8, 12, 16, 31])
+    if slice_size:
+        count = max(1, count // slice_size) * slice_size
+    ps = PodSet(name=name, count=count, topology_request=tr,
+                node_selector=node_selector)
+    requests = {"cpu": rng.choice([100, 500, 1000, 2000])}
+    if rng.random() < 0.3:
+        requests["mem"] = rng.choice([128, 1024])
+    if rng.random() < 0.1:
+        requests["exotic/resource"] = 1
+    return TASPodSetRequest(ps, requests, count)
+
+
+def assert_same(snap, workers, leader=None, **kw):
+    got = device.try_find(snap, workers, leader, **kw)
+    assert got is not NotImplemented
+    want = snap.find_topology_assignments_host(snap_args_workers(workers),
+                                               leader, **kw)
+    assert got == want, (
+        f"device={got}\nhost={want}\nworkers={workers}\nleader={leader}")
+
+
+def snap_args_workers(workers):
+    return workers
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_random_worlds_match(seed):
+    rng = random.Random(seed)
+    topology = rng.choice([TOPOLOGY3, TOPOLOGY3, TOPOLOGY2, TOPOLOGY1])
+    snap = random_world(rng, topology)
+    workers = random_request(rng, snap)
+    assert_same(snap, workers)
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_random_worlds_with_leader_match(seed):
+    rng = random.Random(1000 + seed)
+    topology = rng.choice([TOPOLOGY3, TOPOLOGY2])
+    snap = random_world(rng, topology)
+    workers = random_request(rng, snap, name="workers")
+    leader_ps = PodSet(name="leader", count=1,
+                       topology_request=workers.pod_set.topology_request)
+    leader = TASPodSetRequest(
+        leader_ps, {"cpu": rng.choice([100, 1000, 4000])}, 1)
+    assert_same(snap, workers, leader)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_assumed_usage_and_simulate_empty_match(seed):
+    rng = random.Random(2000 + seed)
+    snap = random_world(rng, TOPOLOGY3)
+    workers = random_request(rng, snap)
+    assumed = {}
+    for leaf in list(snap.leaves.values()):
+        if rng.random() < 0.4:
+            assumed[leaf.id] = {"cpu": rng.randrange(0, 2000),
+                                "pods": rng.randrange(0, 3)}
+    assert_same(snap, workers, assumed_usage=dict(assumed))
+    assert_same(snap, workers, simulate_empty=True,
+                assumed_usage=dict(assumed))
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_replacement_domain_match(seed):
+    rng = random.Random(3000 + seed)
+    snap = random_world(rng, TOPOLOGY3)
+    workers = random_request(rng, snap)
+    roots = sorted(snap.roots)
+    rrd = rng.choice(roots)
+    assert_same(snap, workers, required_replacement_domain=rrd)
+
+
+def test_dispatch_serving_path_uses_device(monkeypatch):
+    """find_topology_assignments routes through the device kernel when
+    the gate is on, and both paths agree."""
+    from kueue_tpu.config import features
+
+    rng = random.Random(7)
+    snap = random_world(rng, TOPOLOGY3)
+    workers = random_request(rng, snap)
+    calls = []
+    orig = device.try_find
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(device, "try_find", spy)
+    got = snap.find_topology_assignments(workers)
+    assert calls, "device path not taken"
+    features.set_feature("DeviceTAS", False)
+    try:
+        want = snap.find_topology_assignments(workers)
+    finally:
+        features.reset()
+    assert got == want
