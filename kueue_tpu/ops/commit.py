@@ -347,114 +347,156 @@ def commit_grouped_fair(
     fair_weight,  # float64[N]
     parent,  # int32[N]
     root_members, root_nodes, local_chain,
+    child_rank,  # int64[N] position in the parent's ordered child list
+    local_depth,  # int32[Rn, K] chain distance from the root row
+    root_parent_local,  # int32[Rn, K]
     *,
     depth: int,
     num_flavors: int,
 ):
-    """Fair-sharing commit order (KEP 1714): the admission-side DRS
-    tournament (fair_sharing_iterator.go:47,125) fused with the grouped
-    commit. Per root subtree, repeat: simulate each candidate head's
-    usage on its ClusterQueue, compute the CQ's DominantResourceShare
-    (fair_sharing.go:140 — max over borrowed resources of
-    borrowed*1000/lendable, weighted by fairSharing.weight, zero-weight
-    borrowers last), pick the minimum (priority desc / timestamp asc
-    tiebreaks, fair_sharing_iterator.go:176), commit it against evolving
-    usage, and re-run — exactly the reference's pop-one-recompute loop,
-    but vmapped across roots on device.
-
-    Fast-path scope: single-level cohort trees (every CQ's parent is a
-    root). Exact full ties (equal share, priority, and timestamp) break
-    by CQ index rather than the reference's child-list insertion order.
+    """Fair-sharing commit order (KEP 1714): the admission-side
+    hierarchical DRS tournament (fair_sharing_iterator.go:47,125 +
+    computeDRS :220) fused with the grouped commit. Per root subtree,
+    repeat: simulate each candidate head's nominated usage bubbled along
+    its ancestor chain (resource_node.go:144), compute the
+    DominantResourceShare of every chain node (fair_sharing.go:140 — max
+    over borrowed resources of borrowed*1000/lendable(parent), weighted
+    by fairSharing.weight, zero-weight borrowers last), then run the
+    bottom-up tournament over the cohort tree: at each cohort the
+    surviving candidate per child subtree competes on the DRS of ITS
+    child-of-this-cohort node (the LCA semantics of
+    preemption/fairsharing/least_common_ancestor.go), with priority
+    desc / timestamp asc / child-list order tiebreaks
+    (fair_sharing_iterator.go:176). The root winner commits against
+    evolving usage and the loop re-runs — the reference's
+    pop-one-recompute loop, vmapped across roots on device.
 
     Returns (admitted bool[C], round int32[C] commit round within the
     root (-1 = not admitted), usage int64[N, R]).
     """
     N, R = usage0.shape
     Rn, M = root_members.shape
+    K = root_nodes.shape[1]
     S = entry_fr.shape[1]
     NF = num_flavors
+    D = depth
     lq = local_quota(subtree_quota, lend_limit)
     entry_kind = jnp.where(entry_valid, entry_kind, ENTRY_SKIP)
     INF_F = jnp.float64(jnp.inf)
 
     member_ok = root_members >= 0
 
-    # Per-root lendable[res]: the root's potentialAvailable summed over
-    # flavors (fair_sharing.go:177 calculateLendable with node=parent on
-    # a flat tree — the parent IS the root).
-    root_is = jnp.argmax(
-        jnp.where(root_nodes >= 0,
-                  parent[jnp.maximum(root_nodes, 0)] < 0, False),
-        axis=1)
-    root_id = jnp.take_along_axis(root_nodes, root_is[:, None],
-                                  axis=1)[:, 0]  # [Rn]
-    root_id_safe = jnp.maximum(root_id, 0)
-    lendable = jnp.sum(
-        jnp.minimum(potential[root_id_safe], INF).reshape(Rn, NF, S),
-        axis=1)  # int64[Rn, S] per resource
+    # lendable seen by node n = calculateLendable(parent(n))
+    # (fair_sharing.go:177): the parent's potentialAvailable summed over
+    # flavors, per resource.
+    lendable_node = jnp.sum(
+        jnp.minimum(potential, INF).reshape(N, NF, S), axis=1)  # [N, S]
 
-    def per_root(r_i, members, m_ok, local_usage):
-        lend_r = lendable[r_i]  # [S]
+    def per_root(members, m_ok, local_usage, nodes, p_local, ld):
+        c = jnp.maximum(members, 0)  # [M] member CQ node ids
+        frs = entry_fr[c]  # [M, S]
+        req = entry_req[c]
+        frs_safe = jnp.maximum(frs, 0)
+        active_fr = (frs >= 0) & (req > 0)
+        chain = jnp.concatenate(
+            [c[:, None].astype(jnp.int32), ancestors[c]], axis=1)
+        chain_ok = chain >= 0  # [M, D+1]
+        chain_safe = jnp.maximum(chain, 0)
+        rows = local_chain[c]  # [M, D+1] rows into the local carry
+        rows_safe = jnp.maximum(rows, 0)
+        g_lq_fr = lq[chain_safe[:, :, None],
+                     frs_safe[:, None, :]]  # [M, D+1, S]
+        sq_full = subtree_quota[chain_safe]  # [M, D+1, R]
+        par_of_chain = parent[chain_safe]  # [M, D+1]
+        lend = lendable_node[jnp.maximum(par_of_chain, 0)]  # [M, D+1, S]
+        wgt = fair_weight[chain_safe]  # [M, D+1]
+        has_par = chain_ok & (par_of_chain >= 0)
+        pri_f = entry_priority[c].astype(jnp.float64)
+        ts = entry_ts[c]
+        crank_row = child_rank[jnp.maximum(nodes, 0)].astype(jnp.float64)
+        row0 = rows_safe[:, 0]
+        kidx = jnp.arange(K)
 
         def drs_keys(usage_l):
-            """(zero_weight_borrows, share) per member after simulated
-            addition of its nominated usage; [M] each. Computed for every
-            member — the caller masks dead ones in the tournament."""
-            c = jnp.maximum(members, 0)
-            frs = entry_fr[c]  # [M, S]
-            req = entry_req[c]  # [M, S]
-            frs_safe = jnp.maximum(frs, 0)
-            # Scatter per-resource requests onto the fr grid: [M, R].
-            add_fr = jnp.zeros((M, R), entry_req.dtype).at[
-                jnp.arange(M)[:, None],
-                jnp.where(frs >= 0, frs_safe, R - 1)].add(
-                jnp.where(frs >= 0, req, 0), mode="drop")
-            loc0 = local_chain[c, 0]  # CQ row in the local carry
-            cq_usage = usage_l[jnp.maximum(loc0, 0)]  # [M, R]
-            cq_sq = subtree_quota[c]  # [M, R]
-            borrowed = jnp.maximum(0, cq_usage + add_fr - cq_sq)
-            by_res = jnp.sum(borrowed.reshape(M, NF, S), axis=1)  # [M, S]
+            """(zwb, key) per member per chain position: the DRS of chain
+            node j after the member's simulated usage addition — the
+            value the tournament reads when the member competes at chain
+            node j+1 (computeDRS stores the child's DRS at the parent).
+            """
+            g_u_fr = usage_l[rows_safe[:, :, None],
+                             frs_safe[:, None, :]]  # [M, D+1, S]
+            local_avail = jnp.maximum(0, g_lq_fr - g_u_fr)
+            v = jnp.where(active_fr, req, 0)  # [M, S]
+            adds = []
+            for d in range(D + 1):
+                adds.append(jnp.where(chain_ok[:, d:d + 1] & active_fr,
+                                      v, 0))
+                v = jnp.maximum(0, v - local_avail[:, d, :])
+            adds = jnp.stack(adds, axis=1)  # [M, D+1, S]
+            u_full = usage_l[rows_safe]  # [M, D+1, R]
+            u_full = u_full.at[
+                jnp.arange(M)[:, None, None],
+                jnp.arange(D + 1)[None, :, None],
+                jnp.where(frs >= 0, frs_safe, R - 1)[:, None, :]].add(
+                jnp.where(frs[:, None, :] >= 0, adds, 0), mode="drop")
+            borrowed = jnp.maximum(0, u_full - sq_full)
+            by_res = borrowed.reshape(M, D + 1, NF, S).sum(axis=2)
             ratio_rs = jnp.where(
-                (by_res > 0) & (lend_r[None, :] > 0),
+                (by_res > 0) & (lend > 0),
                 by_res.astype(jnp.float64) * 1000.0
-                / jnp.maximum(lend_r[None, :], 1).astype(jnp.float64),
-                0.0)
-            share = jnp.max(ratio_rs, axis=1)  # [M] unweighted
-            w = fair_weight[c]  # [M]
-            zwb = (w == 0) & (share > 0)
-            weighted = jnp.where(w > 0, share / jnp.maximum(w, 1e-300),
-                                 0.0)
-            key_share = jnp.where(zwb, share, weighted)
-            return zwb.astype(jnp.float64), key_share
+                / jnp.maximum(lend, 1).astype(jnp.float64), 0.0)
+            ratio = jnp.where(has_par, jnp.max(ratio_rs, axis=2), 0.0)
+            zwb = (wgt == 0) & (ratio > 0)
+            keyv = jnp.where(
+                zwb, ratio,
+                jnp.where(wgt > 0, ratio / jnp.maximum(wgt, 1e-300), 0.0))
+            return zwb.astype(jnp.float64), keyv
+
+        def tournament(zwb, keyv, alive):
+            """runTournament :125 bottom-up: rows at depth d promote
+            their surviving candidate to the parent row, competing on
+            the candidate's DRS at its current chain position."""
+            cand = jnp.full((K,), -1, jnp.int32).at[
+                jnp.where(alive, row0, K)].set(
+                jnp.arange(M, dtype=jnp.int32), mode="drop")
+            candj = jnp.zeros((K,), jnp.int32)
+            for d in range(D, 0, -1):
+                at_d = (ld == d) & (cand >= 0)
+                m = jnp.maximum(cand, 0)
+                kz = jnp.where(at_d, zwb[m, candj], INF_F)
+                ks = jnp.where(at_d, keyv[m, candj], INF_F)
+                kp = jnp.where(at_d, -pri_f[m], INF_F)
+                kt = jnp.where(at_d, ts[m], INF_F)
+                kr = jnp.where(at_d, crank_row, INF_F)
+                seg = jnp.where(at_d & (p_local >= 0), p_local, K)
+                mask = at_d
+                for kk in (kz, ks, kp, kt, kr):
+                    kk = jnp.where(mask, kk, INF_F)
+                    mn = jax.ops.segment_min(kk, seg, num_segments=K + 1)
+                    mask = mask & (kk == mn[seg])
+                wrow = jax.ops.segment_min(
+                    jnp.where(mask, kidx, K), seg,
+                    num_segments=K + 1)[:K]
+                got = wrow < K
+                wsafe = jnp.minimum(wrow, K - 1)
+                cand = jnp.where(got, cand[wsafe], cand)
+                candj = jnp.where(got, candj[wsafe] + 1, candj)
+            root_row = jnp.argmax((ld == 0) & (nodes >= 0))
+            return cand[root_row]
 
         def round_step(carry, r):
             usage_l, remaining = carry
-            zwb, share = drs_keys(usage_l)
-            # Winner: lexicographic min over (zwb, share, -priority, ts,
-            # member index); invalid/committed/headless members sort last
-            # (a CQ without a pending head never competes for a round —
-            # rounds mirror the reference's pop order).
-            c = jnp.maximum(members, 0)
-            pri = entry_priority[c].astype(jnp.float64)
-            ts = entry_ts[c]
             alive = remaining & m_ok & entry_valid[c]
-            big = jnp.where(alive, 0.0, INF_F)
-
-            def lex_min(keys):
-                mask = jnp.ones((M,), bool)
-                for k in keys:
-                    k = jnp.where(mask, k, INF_F)
-                    mask = mask & (k == jnp.min(k))
-                return jnp.argmax(mask)
-
-            win = lex_min([zwb + big, share + big, -pri + big, ts + big])
-            cw = jnp.where(jnp.any(alive), members[win], -1)
-
+            zwb, keyv = drs_keys(usage_l)
+            win = tournament(zwb, keyv, alive)
+            win_safe = jnp.maximum(win, 0)
+            cw = jnp.where(win >= 0, members[win_safe], -1)
             new_usage, _, fits = _commit_one_local(
                 usage_l, cw, entry_fr, entry_req, entry_kind,
                 entry_borrows, subtree_quota, lq, borrow_limit, nominal,
                 ancestors, local_chain, depth=depth)
-            remaining = remaining & ~(jnp.arange(M) == win)
+            remaining = remaining & ~(
+                (jnp.arange(M) == win_safe) & (win >= 0))
             return (new_usage, remaining), (cw, fits)
 
         init = (local_usage, jnp.ones((M,), bool))
@@ -466,7 +508,8 @@ def commit_grouped_fair(
     init_local = jnp.where((root_nodes >= 0)[:, :, None],
                            usage0[nodes_safe], 0)
     final_local, win_seq, fit_seq = jax.vmap(per_root)(
-        jnp.arange(Rn), root_members, member_ok, init_local)
+        root_members, member_ok, init_local, root_nodes,
+        root_parent_local, local_depth)
 
     C = entry_valid.shape[0]
     flat_win = win_seq.reshape(-1)

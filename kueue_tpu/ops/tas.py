@@ -318,11 +318,11 @@ def _consume(seg, cap, capwl, ls, tie_rank, need, leadp, *, nseg,
 
 
 @partial(jax.jit, static_argnames=(
-    "num_levels", "max_domains", "num_resources", "pods_col", "req_level",
+    "num_levels", "max_domains", "pods_col", "req_level",
     "slice_level", "required", "unconstrained", "has_leader"))
 def tas_place(free, usage, assumed, per_pod, leader_per_pod, leaf_mask,
               has_pods_cap, valid, vrank, parent, count, slice_size, *,
-              num_levels, max_domains, num_resources, pods_col, req_level,
+              num_levels, max_domains, pods_col, req_level,
               slice_level, required, unconstrained, has_leader):
     """findTopologyAssignment :946 end-to-end on device.
 

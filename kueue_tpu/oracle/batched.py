@@ -14,8 +14,9 @@ FlavorResources array programs:
     5. sequential-equivalent commit                 [ops/commit.commit_scan]
     6. park NoFit heads (BestEffortFIFO inadmissible semantics)
 
-Fast-path scope: classical ordering AND flat-cohort fair sharing (the
-device DRS tournament, ops/commit.commit_grouped_fair via fair_mode);
+Fast-path scope: classical ordering AND fair sharing over arbitrary
+cohort forests (the hierarchical device DRS tournament,
+ops/commit.commit_grouped_fair via fair_mode);
 no-preemption-policy ClusterQueues decided entirely on device; workloads
 flagged `needs_oracle` (preemption candidates required) are returned for
 the host's sequential preemptor. Multi-podset workloads are pre-filtered
@@ -75,6 +76,8 @@ def _cycle_core(
     root_members, root_nodes, local_chain,
     wl_ts=None,  # float64[W] creation time (fair mode ordering)
     fair_weight=None,  # float64[N]
+    child_rank=None,  # int64[N] fair-tournament child-order tiebreak
+    local_depth=None,  # int32[Rn, K] fair-tournament level structure
     slot_kind_override=None,  # int32[C] ENTRY_* (-1 = use computed kind);
     #   set to ENTRY_PREEMPT/ENTRY_RESERVE by the bridge after device
     #   preemption target selection (ops/preempt.classical_targets)
@@ -159,8 +162,8 @@ def _cycle_core(
             jnp.where(slot_valid, wl_ts[h_safe], 0.0),
             full_usage, derived["subtree_quota"], lend_limit, borrow_limit,
             nominal, ancestors, derived["potential"], fair_weight, parent,
-            root_members, root_nodes, local_chain, depth=depth,
-            num_flavors=num_flavors)
+            root_members, root_nodes, local_chain, child_rank, local_depth,
+            root_parent_local, depth=depth, num_flavors=num_flavors)
         slot_preempting = jnp.zeros((C,), bool)  # overrides: classical only
         # Positions: tournament round within the root (rounds are the
         # reference's pop order; roots are independent).
@@ -238,7 +241,8 @@ def drain_loop(
     parent, ancestors, height, group_of_res, group_flavors, no_preemption,
     can_pwb, can_always_reclaim, best_effort, fung_borrow_try_next,
     fung_pref_preempt_first, root_members, root_nodes, local_chain,
-    max_cycles, wl_ts=None, fair_weight=None,
+    max_cycles, wl_ts=None, fair_weight=None, child_rank=None,
+    local_depth=None, root_parent_local=None,
     *,
     depth: int, num_resources: int, num_cqs: int,
     fair_mode: bool = False, num_flavors: int = 1,
@@ -267,6 +271,8 @@ def drain_loop(
             group_flavors, no_preemption, can_pwb, can_always_reclaim,
             best_effort, fung_borrow_try_next, fung_pref_preempt_first,
             root_members, root_nodes, local_chain, wl_ts, fair_weight,
+            child_rank, local_depth,
+            root_parent_local=root_parent_local,
             depth=depth, num_resources=num_resources, num_cqs=num_cqs,
             fair_mode=fair_mode, num_flavors=num_flavors)
 
@@ -369,6 +375,9 @@ class BatchedDrainSolver:
             local_chain=jnp.asarray(w.local_chain),
             wl_ts=jnp.asarray(wl.timestamp),
             fair_weight=jnp.asarray(w.fair_weight),
+            child_rank=jnp.asarray(w.child_rank),
+            local_depth=jnp.asarray(w.local_depth),
+            root_parent_local=jnp.asarray(w.root_parent_local),
         )
 
         # ONE device program for the whole drain (no per-cycle host sync).

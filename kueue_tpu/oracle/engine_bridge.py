@@ -25,9 +25,10 @@ reference's single interleaved cycle (scheduler.go:286).
 
 Remaining whole-cycle fallbacks (conservative, correctness-first):
   * admission fair sharing (AFS heap ordering is host-side);
-  * fair sharing over NESTED cohort trees (flat trees run the device DRS
-    tournament, ops/commit.commit_grouped_fair);
   * WaitForPodsReady admission blocking.
+
+Fair sharing runs on device for arbitrary cohort forests: the
+hierarchical LCA tournament is ops/commit.commit_grouped_fair.
 """
 
 from __future__ import annotations
@@ -67,12 +68,6 @@ class OracleBridge:
 
     def world_is_fast_path_safe(self) -> bool:
         eng = self.engine
-        if eng.cycle.enable_fair_sharing:
-            # Fair sharing runs on device for single-level cohort trees
-            # (commit_grouped_fair); deeper tournaments stay host-side.
-            for co in eng.cache.cohorts.values():
-                if co.parent:
-                    return False
         if getattr(eng, "afs", None) is not None:
             return False
         if (eng.pods_ready is not None
@@ -261,6 +256,9 @@ class OracleBridge:
             local_chain=jnp.asarray(w.local_chain),
             wl_ts=jnp.asarray(wl.timestamp),
             fair_weight=jnp.asarray(w.fair_weight),
+            child_rank=jnp.asarray(w.child_rank),
+            local_depth=jnp.asarray(w.local_depth),
+            root_parent_local=jnp.asarray(w.root_parent_local),
         )
         # Bucket-pad the workload axis so recurring cycles with varying
         # pending counts reuse one compiled program per bucket.
@@ -495,7 +493,6 @@ class OracleBridge:
             pending, inadmissible, usage, **args,
             slot_kind_override=jnp.asarray(override),
             slot_borrows_override=jnp.asarray(borrows_override),
-            root_parent_local=jnp.asarray(w.root_parent_local),
             slot_victim_row=jnp.asarray(victim_row),
             slot_victim_vals=jnp.asarray(victim_vals),
             slot_victim_ids=jnp.asarray(victim_ids),

@@ -140,7 +140,11 @@ def try_find(snap, workers, leader=None, simulate_empty=False,
             free[i, col_of[res]] = cap
         if not simulate_empty:
             for res, used in leaf.tas_usage.items():
-                usage[i, col_of[res]] = used
+                # Usage may name resources no node advertises anymore
+                # (recorded before a capacity change); they cannot affect
+                # any fit count, like the host's remaining-dict misses.
+                if res in col_of:
+                    usage[i, col_of[res]] = used
             if assumed_usage:
                 for res, used in assumed_usage.get(leaf.id, {}).items():
                     if res in col_of:
@@ -177,7 +181,7 @@ def try_find(snap, workers, leader=None, simulate_empty=False,
         jnp.asarray(struct["valid"]), jnp.asarray(struct["vrank"]),
         jnp.asarray(struct["parent"]), np.int64(count),
         np.int64(slice_size), num_levels=struct["nl"], max_domains=mp,
-        num_resources=sp, pods_col=col_of["pods"], req_level=req_idx,
+        pods_col=col_of["pods"], req_level=req_idx,
         slice_level=slice_idx, required=required,
         unconstrained=unconstrained, has_leader=has_leader)
     status = int(status)

@@ -95,6 +95,11 @@ class WorldTensors:
     root_parent_local: np.ndarray = None  # int32[Rn, K] parent position
     #   within the same root row, -1 = root/pad (victim-removal bubbling)
     root_of_cq: np.ndarray = None  # int32[C] root row per ClusterQueue
+    child_rank: np.ndarray = None  # int64[N] position within the parent's
+    #   ordered child list (cohorts first, then CQs — the fair tournament's
+    #   first-candidate-wins tiebreak, fair_sharing_iterator.go:125)
+    local_depth: np.ndarray = None  # int32[Rn, K] chain distance from the
+    #   root row (root = 0, -1 pad) for the hierarchical fair tournament
 
     def fr_index(self, flavor: str, resource: str) -> int:
         return (self.flavor_names.index(flavor) * self.num_resources
@@ -172,8 +177,16 @@ def build_root_grouping(parent: np.ndarray, ancestors: np.ndarray,
     for ri in range(Rn):
         for m in members_of[ri]:
             root_of_cq[m] = ri
+    local_depth = np.full((Rn, K), -1, np.int32)
+    for ri in range(Rn):
+        for j, nd in enumerate(nodes_of[ri]):
+            d, a = 0, j
+            while root_parent_local[ri, a] >= 0:
+                a = int(root_parent_local[ri, a])
+                d += 1
+            local_depth[ri, j] = d
     return (Rn, root_members, root_nodes, local_chain, root_parent_local,
-            root_of_cq)
+            root_of_cq, local_depth)
 
 
 def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
@@ -307,7 +320,17 @@ def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
                            == FungibilityPreference.PREEMPTION_OVER_BORROWING)
 
     (Rn, root_members, root_nodes, local_chain, root_parent_local,
-     root_of_cq) = build_root_grouping(parent, ancestors, C, max_depth)
+     root_of_cq, local_depth) = build_root_grouping(parent, ancestors, C,
+                                                    max_depth)
+
+    # Fair-tournament tiebreak: the reference iterates child cohorts then
+    # child CQs in list order, first candidate winning exact ties
+    # (fair_sharing_iterator.go:125).
+    child_rank = np.zeros(N, np.int64)
+    for name, cs in snap.cohorts.items():
+        children = list(cs.child_cohorts) + list(cs.child_cqs)
+        for j, ch in enumerate(children):
+            child_rank[node_of(ch)] = j
 
     return WorldTensors(
         num_cqs=C, num_nodes=N, num_flavors=NF, num_resources=S,
@@ -323,7 +346,8 @@ def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
         fung_pref_preempt_first=fung_pref_p, fair_weight=fair_weight,
         num_roots=Rn, root_members=root_members, root_nodes=root_nodes,
         local_chain=local_chain, root_parent_local=root_parent_local,
-        root_of_cq=root_of_cq,
+        root_of_cq=root_of_cq, child_rank=child_rank,
+        local_depth=local_depth,
     )
 
 

@@ -43,7 +43,8 @@ def random_world(rng, n_roots, cqs_per_root, depth_extra, R):
             d += 1
     from kueue_tpu.tensor.schema import build_root_grouping
     (_, root_members, root_nodes, local_chain, root_parent_local,
-     root_of_cq) = build_root_grouping(parent, ancestors, C, D)
+     root_of_cq, _local_depth) = build_root_grouping(parent, ancestors,
+                                                     C, D)
 
     from kueue_tpu.api.types import INF
     nominal = rng.integers(0, 50, (N, R)).astype(np.int64)

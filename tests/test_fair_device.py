@@ -103,22 +103,69 @@ def test_fair_device_zero_weight_borrower_loses():
     assert bat.oracle.cycles_on_device > 0
 
 
-def test_fair_device_hierarchical_falls_back():
-    """Nested cohorts route fair sharing to the host tournament."""
+def make_nested_engine(oracle: bool, rng, n_mids=2, cqs_per_mid=2,
+                       deep=False):
+    """Random >=3-deep cohort forest: root -> mids (-> deeps) -> CQs,
+    with random weights and nominal quotas at every level."""
     from kueue_tpu.api.types import Cohort
+
     eng = Engine(enable_fair_sharing=True)
     eng.create_resource_flavor(ResourceFlavor("default"))
-    eng.create_cohort(Cohort("root"))
-    eng.create_cohort(Cohort("mid", parent="root"))
-    eng.create_cluster_queue(ClusterQueue(
-        name="cq0", cohort="mid",
+    eng.create_cohort(Cohort(
+        "root", fair_sharing=FairSharing(
+            weight=rng.choice([0.5, 1.0, 2.0])),
         resource_groups=(ResourceGroup(
             ("cpu",), (FlavorQuotas("default",
-                                    {"cpu": ResourceQuota(1000)}),)),)))
-    eng.create_local_queue(LocalQueue("lq0", "default", "cq0"))
-    eng.attach_oracle()
-    eng.submit(Workload(name="w", queue_name="lq0",
-                        pod_sets=(PodSet("main", 1, {"cpu": 500}),)))
-    drain(eng)
-    assert eng.oracle.cycles_fallback > 0
-    assert eng.workloads["default/w"].is_admitted
+                                    {"cpu": ResourceQuota(2000)}),)),)))
+    ci = 0
+    for m in range(n_mids):
+        eng.create_cohort(Cohort(
+            f"mid{m}", parent="root",
+            fair_sharing=FairSharing(weight=rng.choice([0.5, 1.0, 3.0])),
+            resource_groups=(ResourceGroup(
+                ("cpu",),
+                (FlavorQuotas("default",
+                              {"cpu": ResourceQuota(
+                                  rng.choice([0, 1000]))}),)),)))
+        parent_name = f"mid{m}"
+        if deep:
+            eng.create_cohort(Cohort(
+                f"deep{m}", parent=parent_name,
+                fair_sharing=FairSharing(weight=rng.choice([1.0, 2.0]))))
+            parent_name = f"deep{m}"
+        for _ in range(cqs_per_mid):
+            eng.create_cluster_queue(ClusterQueue(
+                name=f"cq{ci}", cohort=parent_name,
+                fair_sharing=FairSharing(
+                    weight=rng.choice([0.0, 0.5, 1.0, 2.0])),
+                resource_groups=(ResourceGroup(
+                    ("cpu",),
+                    (FlavorQuotas("default",
+                                  {"cpu": ResourceQuota(
+                                      rng.choice([500, 1000, 2000]))}),
+                     )),)))
+            eng.create_local_queue(LocalQueue(f"lq{ci}", "default",
+                                              f"cq{ci}"))
+            ci += 1
+    if oracle:
+        eng.attach_oracle()
+    return eng, ci
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fair_device_hierarchical_matches_sequential(seed):
+    """Nested (>=3-deep) cohort forests run the device LCA tournament and
+    match the sequential fair iterator's admissions and order."""
+    rng = random.Random(seed)
+    deep = seed % 2 == 1
+    seq, n_cqs = make_nested_engine(False, random.Random(seed), deep=deep)
+    bat, _ = make_nested_engine(True, random.Random(seed), deep=deep)
+    seq_wls = populate(seq, n_cqs, n=24, seed=seed * 11 + 1)
+    bat_wls = populate(bat, n_cqs, n=24, seed=seed * 11 + 1)
+    seq_order = drain(seq)
+    bat_order = drain(bat)
+    assert bat.oracle.cycles_on_device > 0, "fair fast path not used"
+    assert seq_order == bat_order
+    seq_admitted = sorted(w.name for w in seq_wls if w.is_admitted)
+    bat_admitted = sorted(w.name for w in bat_wls if w.is_admitted)
+    assert seq_admitted == bat_admitted

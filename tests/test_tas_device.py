@@ -96,14 +96,9 @@ def random_request(rng, snap, name="main"):
 def assert_same(snap, workers, leader=None, **kw):
     got = device.try_find(snap, workers, leader, **kw)
     assert got is not NotImplemented
-    want = snap.find_topology_assignments_host(snap_args_workers(workers),
-                                               leader, **kw)
+    want = snap.find_topology_assignments_host(workers, leader, **kw)
     assert got == want, (
         f"device={got}\nhost={want}\nworkers={workers}\nleader={leader}")
-
-
-def snap_args_workers(workers):
-    return workers
 
 
 @pytest.mark.parametrize("seed", range(40))
@@ -151,6 +146,22 @@ def test_replacement_domain_match(seed):
     roots = sorted(snap.roots)
     rrd = rng.choice(roots)
     assert_same(snap, workers, required_replacement_domain=rrd)
+
+
+def test_stale_usage_resource_ignored():
+    """Usage recorded for a resource no node advertises (capacity changed
+    after admission) must not crash the device path and must match the
+    host's remaining-dict-miss semantics."""
+    snap = TASFlavorSnapshot(TOPOLOGY2)
+    snap.add_node(Node(name="h0",
+                       labels={"rack": "r0", HOSTNAME_LABEL: "h0"},
+                       capacity={"cpu": 4000}))
+    snap.add_usage(("r0", "h0"), {"gpu": 1}, 1)
+    ps = PodSet(name="main", count=2,
+                topology_request=PodSetTopologyRequest(
+                    mode=TopologyMode.REQUIRED, level="rack"))
+    workers = TASPodSetRequest(ps, {"cpu": 1000}, 2)
+    assert_same(snap, workers)
 
 
 def test_dispatch_serving_path_uses_device(monkeypatch):
