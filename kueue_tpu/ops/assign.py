@@ -53,6 +53,77 @@ def _mode_key(pmode, borrow, pref_preempt_first):
     return jnp.where(pref_preempt_first, pref_key, default_key)
 
 
+def _classify_flavor(c, req, fl, avail, potential, nominal, derived,
+                     ancestors, height, no_preemption, can_pwb, *, depth):
+    """fitsResourceQuota before the oracle consult
+    (flavorassigner.go:1198): classify one flavor for every resource of
+    one workload. Shared by the nomination kernel and the sim-grid so
+    the two folds can never diverge. Returns (pmode[S], borrow[S],
+    oracle[S] — gate open and the CQ can actually preempt)."""
+    S = req.shape[0]
+    fl_safe = jnp.maximum(fl, 0)
+    fr = fl_safe * S + jnp.arange(S)
+    a = avail[c, fr]
+    p = potential[c, fr]
+    nom = nominal[c, fr]
+    no_fit = req > p
+    fit = req <= a
+    bh, may_reclaim = borrow_height(
+        jnp.full((S,), c, jnp.int32), fr, req, derived, ancestors,
+        height, nominal, depth=depth)
+    preempt_gate = (nom >= req) | may_reclaim | can_pwb[c]
+    pmode = jnp.where(
+        no_fit, P_NO_FIT,
+        jnp.where(fit, P_FIT,
+                  jnp.where(preempt_gate, P_NO_CANDIDATES, P_NO_FIT)))
+    oracle = (~no_fit) & (~fit) & preempt_gate & ~no_preemption[c]
+    return pmode, bh, oracle
+
+
+@partial(jax.jit, static_argnames=("depth", "num_resources"))
+def flavor_grid(
+    wl_cq,  # int32[C] head CQ per slot
+    wl_req,  # int64[C, S]
+    derived, nominal, ancestors, height, group_of_res, group_flavors,
+    no_preemption, can_pwb,
+    *,
+    depth: int,
+    num_resources: int,
+):
+    """Per-(slot, group, flavor, resource) granular classification — the
+    pre-oracle part of fitsResourceQuota (flavorassigner.go:1198) exposed
+    for the sim-augmented nomination: cells flagged ``sim`` need a
+    preemption simulation (preemption_oracle.go:41) before the
+    fungibility lattice can pick the flavor; the bridge runs those sims
+    with ops/preempt.classical_targets and folds the lattice host-side
+    with the exact scheduler/flavorassigner code.
+
+    Returns (pmode int32[C, G, F, S] in {NO_FIT, NO_CANDIDATES, FIT},
+    borrow int32[C, G, F, S] pre-sim, sim bool[C, G, F, S],
+    in_group bool[C, G, S])."""
+    S = num_resources
+    avail = jnp.maximum(0, derived["available"])
+    potential = derived["potential"]
+    G = group_flavors.shape[1]
+
+    def per_slot(c, req):
+        g_of_res = group_of_res[c]
+        active = req > 0
+
+        def eval_fl(fl):
+            pmode, bh, oracle = _classify_flavor(
+                c, req, fl, avail, potential, nominal, derived, ancestors,
+                height, no_preemption, can_pwb, depth=depth)
+            return pmode, bh, oracle & active & (fl >= 0)
+
+        pmode, borrow, sim = jax.vmap(jax.vmap(eval_fl))(group_flavors[c])
+        in_group = (g_of_res[None, :] == jnp.arange(G)[:, None]) \
+            & active[None, :]  # [G, S]
+        return pmode, borrow, sim & in_group[:, None, :], in_group
+
+    return jax.vmap(per_slot)(wl_cq, wl_req)
+
+
 @partial(jax.jit, static_argnames=("depth", "num_resources"))
 def assign_flavors(
     wl_cq,  # int32[W]
@@ -91,23 +162,9 @@ def assign_flavors(
         def eval_flavor(fl):
             """Classify flavor fl for every resource: (pmode[S], borrow[S],
             needs_oracle[S])."""
-            fr = fl * S + jnp.arange(S)  # [S]
-            a = avail[c, fr]
-            p = potential[c, fr]
-            nom = nominal[c, fr]
-            no_fit = req > p
-            fit = req <= a
-            bh, may_reclaim = borrow_height(
-                jnp.full((S,), c, jnp.int32), fr, req, derived, ancestors,
-                height, nominal, depth=depth)
-            preempt_gate = (nom >= req) | may_reclaim | can_pwb[c]
-            pmode = jnp.where(
-                no_fit, P_NO_FIT,
-                jnp.where(fit, P_FIT,
-                          jnp.where(preempt_gate, P_NO_CANDIDATES,
-                                    P_NO_FIT)))
-            oracle = (~no_fit) & (~fit) & preempt_gate & ~no_preemption[c]
-            return pmode, bh, oracle
+            return _classify_flavor(
+                c, req, fl, avail, potential, nominal, derived, ancestors,
+                height, no_preemption, can_pwb, depth=depth)
 
         def eval_group(g):
             in_group = (g_of_res == g) & active  # [S]

@@ -84,6 +84,10 @@ def _cycle_core(
     slot_borrows_override=None,  # int32[C] post-preemption borrow level
     #   (-1 = keep): the commit iterator orders preempting entries by the
     #   borrow level WITH their victims removed (preemption_oracle.go:41)
+    slot_flavor_override=None,  # int32[C, S] flavor per resource (-1 =
+    #   keep computed): set by the bridge's sim-augmented nomination when
+    #   the fungibility lattice needed preemption simulations to pick the
+    #   flavor (multi-flavor groups, flavorassigner.go:1127)
     root_parent_local=None,  # int32[Rn, K] (victim-removal bubbling)
     slot_victim_row=None,  # int32[C, V] victim CQ local positions
     slot_victim_vals=None,  # int64[C, V, R] victim usage rows
@@ -130,6 +134,13 @@ def _cycle_core(
     if slot_borrows_override is not None:
         borrows = jnp.where(slot_borrows_override >= 0,
                             slot_borrows_override, borrows)
+    if slot_flavor_override is not None:
+        has_fo = jnp.any(slot_flavor_override >= 0, axis=1)
+        flavor_of_res = jnp.where(has_fo[:, None], slot_flavor_override,
+                                  flavor_of_res)
+        usage_fr = jnp.where(
+            flavor_of_res >= 0,
+            flavor_of_res * S + jnp.arange(S)[None, :], -1)
 
     # 5. Commit. Entry kinds: FIT commits; preempt-mode-no-candidates
     # reserves capacity unless the CQ can always reclaim

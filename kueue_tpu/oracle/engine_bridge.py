@@ -16,8 +16,14 @@ member ClusterQueues needs the host this cycle:
     admission, TAS, node selectors, uncovered resources);
   * one of its flavors carries taints or a topology (host assigner path);
   * its head needs preemption outside the device preemptor's scope
-    (non-classical ordering, reclaim/borrow-within-cohort, multi-flavor
-    resource groups, > v_max victims).
+    (fair-sharing preemption strategies, > v_max victims).
+
+Multi-flavor resource groups on preemption-enabled ClusterQueues run
+the sim-augmented nomination: the pre-oracle flavor grid
+(ops/assign.flavor_grid) plus per-cell preemption simulations
+(ops/preempt.classical_targets standing in for
+preemption_oracle.go:41), folded through the exact host fungibility
+lattice, then committed via kernel overrides.
 Host roots are handed to the engine's sequential path in the same
 schedule_once() call (engine._sequential_cycle); because roots never
 share quota, device-then-host commit order is cycle-equivalent to the
@@ -101,14 +107,10 @@ class OracleBridge:
                         safe[ci] = False
         return safe
 
-    def _cq_preempt_scope(self, snapshot, w):
-        """Per-CQ device-preemption scope and policy encoding. The device
-        classical preemptor (ops/preempt.classical_targets) covers the
-        full classical policy surface; the remaining restriction is
-        multi-flavor resource groups (the flavor choice would depend on
-        the preemption simulation — flavorassigner.go:1198 +
-        preemption_oracle.go:30). Returns (ok bool[C], cfg dict of
-        per-CQ policy arrays for the kernel)."""
+    def _cq_policy_cfg(self, snapshot, w):
+        """Per-CQ preemption-policy encoding for the device classical
+        preemptor (ops/preempt.classical_targets), which covers the full
+        classical policy surface."""
         from kueue_tpu.api.types import (
             BorrowWithinCohortPolicy,
             PreemptionPolicy,
@@ -123,17 +125,11 @@ class OracleBridge:
             PreemptionPolicy.ANY: pops.POLICY_ANY,
         }
         C = w.num_cqs
-        ok = np.zeros(C, bool)
         wcq_policy = np.zeros(C, np.int32)
         reclaim_policy = np.zeros(C, np.int32)
         bwc_forbidden = np.ones(C, bool)
         bwc_threshold = np.full(C, pops.NO_THRESHOLD, np.int64)
         cq_has_parent = np.zeros(C, bool)
-        if w.group_flavors.shape[2] > 1:
-            multi_flavor = np.any(w.group_flavors[:, :, 1:] >= 0,
-                                  axis=(1, 2))
-        else:
-            multi_flavor = np.zeros(C, bool)
         for ci, name in enumerate(w.cq_names):
             spec = snapshot.cluster_queues[name].spec
             p = spec.preemption
@@ -147,12 +143,293 @@ class OracleBridge:
                 if thr is not None:
                     bwc_threshold[ci] = thr
             cq_has_parent[ci] = spec.cohort is not None
-            ok[ci] = not multi_flavor[ci]
-        cfg = dict(wcq_policy=wcq_policy, reclaim_policy=reclaim_policy,
-                   bwc_forbidden=bwc_forbidden,
-                   bwc_threshold=bwc_threshold,
-                   cq_has_parent=cq_has_parent)
-        return ok, cfg
+        return dict(wcq_policy=wcq_policy, reclaim_policy=reclaim_policy,
+                    bwc_forbidden=bwc_forbidden,
+                    bwc_threshold=bwc_threshold,
+                    cq_has_parent=cq_has_parent)
+
+    def _encode_admitted(self, snapshot, w):
+        from kueue_tpu.tensor.schema import encode_admitted
+
+        admitted = [info for cqs in snapshot.cluster_queues.values()
+                    for info in cqs.workloads.values()]
+        return admitted, encode_admitted(w, admitted, now=self.engine.clock)
+
+    def _classical_call(self, w, adm, pcfg, usage, slot_need, slot_pri,
+                        slot_ts, slot_fr, slot_req, v_cap=32,
+                        derived=None):
+        """One batched classical_targets launch; returns numpy
+        (found, overflow, mask, variant, borrow_after). Pass ``derived``
+        when the caller already ran quota.derive_world for this usage."""
+        import jax.numpy as jnp
+
+        from kueue_tpu.ops import preempt as pops
+        from kueue_tpu.ops import quota as qops
+
+        C = w.num_cqs
+        if adm.num_admitted == 0:
+            return (np.zeros(C, bool), np.zeros(C, bool),
+                    np.zeros((C, 0), bool), np.zeros((C, 0), np.int32),
+                    np.zeros(C, np.int32))
+        if derived is None:
+            derived = qops.derive_world(
+                jnp.asarray(w.nominal), jnp.asarray(w.lend_limit),
+                jnp.asarray(w.borrow_limit), usage, jnp.asarray(w.parent),
+                depth=w.depth)
+        out = pops.classical_targets(
+            jnp.asarray(slot_need), jnp.asarray(slot_pri),
+            jnp.asarray(slot_ts), jnp.asarray(slot_fr),
+            jnp.asarray(slot_req),
+            jnp.asarray(pcfg["wcq_policy"]),
+            jnp.asarray(pcfg["reclaim_policy"]),
+            jnp.asarray(pcfg["bwc_forbidden"]),
+            jnp.asarray(pcfg["bwc_threshold"]),
+            jnp.asarray(pcfg["cq_has_parent"]),
+            jnp.asarray(adm.cq), jnp.asarray(adm.priority),
+            jnp.asarray(adm.timestamp), jnp.asarray(adm.qr_time),
+            jnp.asarray(adm.uid_rank), jnp.asarray(adm.evicted),
+            jnp.asarray(adm.usage), derived["usage"],
+            derived["subtree_quota"], jnp.asarray(w.lend_limit),
+            jnp.asarray(w.borrow_limit), jnp.asarray(w.nominal),
+            jnp.asarray(w.ancestors), jnp.asarray(w.height),
+            jnp.asarray(w.local_chain), jnp.asarray(w.root_nodes),
+            jnp.asarray(w.root_of_cq), depth=w.depth, v_cap=v_cap)
+        found, overflow, mask, _n, variant, borrow_after = out
+        return (np.array(found), np.array(overflow), np.array(mask),
+                np.array(variant), np.array(borrow_after))
+
+    def _sim_nomination(self, snapshot, w, wls, usage, head_idx, sim_slots,
+                        adm, admitted, pcfg, v_cap=32):
+        """Sim-augmented nomination for heads whose flavor choice depends
+        on preemption simulations (multi-flavor groups on
+        preemption-enabled CQs): run the pre-oracle flavor grid on
+        device, simulate each Preempt-gated (group, flavor, resource)
+        cell with the device classical preemptor
+        (preemption_oracle.go:41 SimulatePreemption), fold the
+        fungibility lattice host-side with the exact
+        scheduler/flavorassigner semantics, and return slot overrides
+        for the cycle kernel.
+
+        Returns (override, borrows_override, flavor_override, victims
+        (row, vals, ids) or None, targets_by_slot, demote_cq bool[C])."""
+        import jax.numpy as jnp
+
+        from kueue_tpu.ops import assign as aops
+        from kueue_tpu.ops import commit as cops
+        from kueue_tpu.ops import quota as qops
+        from kueue_tpu.scheduler.flavorassigner import (
+            BEST,
+            WORST,
+            GranularMode,
+            PMode,
+            is_preferred,
+            should_try_next_flavor,
+        )
+
+        C, S = w.num_cqs, w.num_resources
+        G, F = w.group_flavors.shape[1], w.group_flavors.shape[2]
+        demote_cq = np.zeros(C, bool)
+        override = np.full(C, -1, np.int32)
+        borrows_override = np.full(C, -1, np.int32)
+        flavor_override = np.full((C, S), -1, np.int32)
+        targets_by_slot: dict[int, list] = {}
+
+        slots = np.nonzero(sim_slots)[0]
+        h = head_idx[slots]
+        h_cq = np.zeros(C, np.int32)
+        h_req = np.zeros((C, S), np.int64)
+        h_cq[slots] = slots  # head CQ == slot for valid heads
+        h_req[slots] = wls.requests[h]
+
+        derived = qops.derive_world(
+            jnp.asarray(w.nominal), jnp.asarray(w.lend_limit),
+            jnp.asarray(w.borrow_limit), usage, jnp.asarray(w.parent),
+            depth=w.depth)
+        g_pmode, g_borrow, g_sim, _g_in = aops.flavor_grid(
+            jnp.asarray(h_cq), jnp.asarray(h_req), derived,
+            jnp.asarray(w.nominal), jnp.asarray(w.ancestors),
+            jnp.asarray(w.height), jnp.asarray(w.group_of_res),
+            jnp.asarray(w.group_flavors), jnp.asarray(w.no_preemption),
+            jnp.asarray(w.can_preempt_while_borrowing),
+            depth=w.depth, num_resources=S)
+        g_pmode = np.asarray(g_pmode)
+        g_borrow = np.asarray(g_borrow)
+        g_sim = np.array(g_sim)  # writable copy
+        g_sim[~sim_slots] = False
+
+        # Batched per-cell sims: one classical_targets launch per
+        # (group, flavor, resource) cell any slot needs.
+        sim_out: dict[tuple, tuple] = {}
+        for g, f, s in zip(*np.nonzero(np.any(g_sim, axis=0))):
+            cell_need = g_sim[:, g, f, s]
+            fl = w.group_flavors[:, g, f]
+            slot_fr = np.full((C, S), -1, np.int32)
+            slot_fr[cell_need, s] = fl[cell_need] * S + s
+            slot_req = np.zeros((C, S), np.int64)
+            slot_req[cell_need, s] = h_req[cell_need, s]
+            found, overflow, mask, variant, borrow_after = \
+                self._classical_call(
+                    w, adm, pcfg, usage, cell_need,
+                    np.where(sim_slots, self._head_pri(wls, head_idx), 0),
+                    np.where(sim_slots, self._head_ts(wls, head_idx), 0.0),
+                    slot_fr, slot_req, v_cap=v_cap, derived=derived)
+            demote_cq |= overflow & cell_need
+            sim_out[(g, f, s)] = (found, mask, borrow_after)
+
+        # Host-side fungibility fold (findFlavorForPodSets semantics)
+        # on the device-computed granular modes.
+        rep_of_slot = np.full(C, -1, np.int64)
+        for ci in slots:
+            if demote_cq[ci]:
+                continue
+            spec = snapshot.cluster_queues[w.cq_names[ci]].spec
+            fung = spec.flavor_fungibility
+            req = h_req[ci]
+            choice = np.full(S, -1, np.int32)
+            rep_overall = int(PMode.FIT)
+            overall_borrow = 0
+            ok = True
+            for g in range(G):
+                res_ids = [s for s in range(S)
+                           if w.group_of_res[ci, s] == g and req[s] > 0]
+                if not res_ids:
+                    continue
+                best_mode = WORST
+                best_fl = -1
+                for f in range(F):
+                    fl = int(w.group_flavors[ci, g, f])
+                    if fl < 0:
+                        continue
+                    rep = BEST
+                    for s in res_ids:
+                        pm = int(g_pmode[ci, g, f, s])
+                        br = int(g_borrow[ci, g, f, s])
+                        if g_sim[ci, g, f, s]:
+                            found, mask, borrow_after = sim_out[(g, f, s)]
+                            if found[ci]:
+                                vs = np.nonzero(mask[ci])[0]
+                                same = any(adm.cq[v] == ci for v in vs)
+                                pm = int(PMode.PREEMPT if same
+                                         else PMode.RECLAIM)
+                                br = int(borrow_after[ci])
+                            else:
+                                pm = int(PMode.NO_CANDIDATES)
+                        mode = GranularMode(PMode(pm), br)
+                        if is_preferred(rep, mode, fung):
+                            rep = mode
+                        if rep.pmode == PMode.NO_FIT:
+                            break
+                    if not should_try_next_flavor(rep, fung):
+                        best_mode, best_fl = rep, fl
+                        break
+                    if is_preferred(rep, best_mode, fung):
+                        best_mode, best_fl = rep, fl
+                if best_fl < 0 or best_mode.pmode == PMode.NO_FIT:
+                    ok = False
+                    break
+                for s in res_ids:
+                    choice[s] = best_fl
+                rep_overall = min(rep_overall, int(best_mode.pmode))
+                overall_borrow = max(overall_borrow, best_mode.borrow)
+            if not ok:
+                continue  # NO_FIT: the plain assign pass parks identically
+            flavor_override[ci] = choice
+            rep_of_slot[ci] = rep_overall
+            borrows_override[ci] = overall_borrow
+            if rep_overall == int(PMode.FIT):
+                override[ci] = cops.ENTRY_FIT
+
+        # Final target selection for every preempt-mode representative
+        # (NO_CANDIDATES included — the scheduler runs GetTargets for any
+        # RepresentativeMode()==Preempt entry, preemption.go:129), with
+        # the chosen assignment's full flavor-resource set.
+        pre_slots = np.nonzero(
+            (rep_of_slot >= int(PMode.NO_CANDIDATES))
+            & (rep_of_slot < int(PMode.FIT)))[0]
+        victims = None
+        if pre_slots.size:
+            need = np.zeros(C, bool)
+            need[pre_slots] = True
+            slot_fr = np.where(
+                flavor_override >= 0,
+                flavor_override.astype(np.int64) * S
+                + np.arange(S)[None, :], -1).astype(np.int32)
+            slot_fr[~need] = -1
+            slot_req = np.where(need[:, None], h_req, 0)
+            found, overflow, mask, variant, borrow_after = \
+                self._classical_call(
+                    w, adm, pcfg, usage, need,
+                    np.where(sim_slots, self._head_pri(wls, head_idx), 0),
+                    np.where(sim_slots, self._head_ts(wls, head_idx), 0.0),
+                    slot_fr, slot_req, v_cap=v_cap, derived=derived)
+            demote_cq |= overflow & need
+            V = v_cap
+            R = max(w.num_flavors, 1) * max(S, 1)
+            victim_row = np.full((C, V), -1, np.int32)
+            victim_vals = np.zeros((C, V, R), np.int64)
+            victim_ids = np.full((C, V), -1, np.int32)
+            variant_reason = self._variant_reason()
+            for ci in pre_slots:
+                if demote_cq[ci]:
+                    continue
+                if found[ci]:
+                    override[ci] = cops.ENTRY_PREEMPT
+                    borrows_override[ci] = borrow_after[ci]
+                    self._fill_victims(
+                        ci, np.nonzero(mask[ci])[0][:V], variant[ci],
+                        admitted, adm, w, victim_row, victim_vals,
+                        victim_ids, targets_by_slot, variant_reason)
+                else:
+                    override[ci] = (cops.ENTRY_SKIP
+                                    if w.can_always_reclaim[ci]
+                                    else cops.ENTRY_RESERVE)
+            victims = (victim_row, victim_vals, victim_ids)
+        return (override, borrows_override, flavor_override, victims,
+                targets_by_slot, demote_cq)
+
+    @staticmethod
+    def _fill_victims(ci, vs, variant_row, admitted, adm, w, victim_row,
+                      victim_vals, victim_ids, targets_by_slot,
+                      variant_reason):
+        """Pack one slot's chosen victims into the kernel's victim arrays
+        and the host-side target list (shared by the sim-nomination and
+        the flagged-slot preemption pass)."""
+        from kueue_tpu.scheduler.preemption import IN_CLUSTER_QUEUE
+
+        targets_by_slot[int(ci)] = [
+            (admitted[v],
+             variant_reason.get(int(variant_row[v]), IN_CLUSTER_QUEUE))
+            for v in vs]
+        for j, v in enumerate(vs):
+            victim_row[ci, j] = w.local_chain[adm.cq[v], 0]
+            victim_vals[ci, j] = adm.usage[v]
+            victim_ids[ci, j] = v
+
+    @staticmethod
+    def _head_pri(wls, head_idx):
+        h = np.maximum(head_idx, 0)
+        return np.where(head_idx >= 0, wls.priority[h], 0)
+
+    @staticmethod
+    def _head_ts(wls, head_idx):
+        h = np.maximum(head_idx, 0)
+        return np.where(head_idx >= 0, wls.timestamp[h], 0.0)
+
+    @staticmethod
+    def _variant_reason():
+        from kueue_tpu.ops import preempt as pops
+        from kueue_tpu.scheduler.preemption import (
+            IN_CLUSTER_QUEUE,
+            IN_COHORT_RECLAIM_WHILE_BORROWING,
+            IN_COHORT_RECLAMATION,
+        )
+        return {
+            pops.V_WITHIN_CQ: IN_CLUSTER_QUEUE,
+            pops.V_HIERARCHICAL_RECLAIM: IN_COHORT_RECLAMATION,
+            pops.V_RECLAIM_WITHOUT_BORROWING: IN_COHORT_RECLAMATION,
+            pops.V_RECLAIM_WHILE_BORROWING:
+                IN_COHORT_RECLAIM_WHILE_BORROWING,
+        }
 
     def try_cycle(self) -> Optional[CycleResult]:
         """Attempt one hybrid cycle. Returns None to request full
@@ -224,6 +501,46 @@ class OracleBridge:
         demote(has_head & ~flavor_safe, "flavor-unsafe")
         cq_on_device = ~host_root[root_of_cq]
 
+        # Multi-flavor groups on preemption-enabled CQs: the flavor
+        # choice depends on preemption simulations
+        # (flavorassigner.go:1198 + preemption_oracle.go:30), so those
+        # heads get the sim-augmented nomination before the cycle runs.
+        if w.group_flavors.shape[2] > 1:
+            mf = np.any(w.group_flavors[:, :, 1:] >= 0, axis=(1, 2))
+        else:
+            mf = np.zeros(C, bool)
+        sim_cq = (mf & ~w.no_preemption & has_head & head_eligible
+                  & flavor_safe & cq_on_device)
+        pre = None
+        pcfg = adm = admitted = None
+        if sim_cq.any():
+            if eng.cycle.enable_fair_sharing:
+                # Fair-sharing preemption stays host-side; so do heads
+                # whose nomination would need it.
+                demote(sim_cq, "fair-needs-sim")
+                cq_on_device = ~host_root[root_of_cq]
+            else:
+                pcfg = self._cq_policy_cfg(snapshot, w)
+                admitted, adm = self._encode_admitted(snapshot, w)
+                (p_override, p_borrows, p_flavor, p_victims, p_targets,
+                 demote_cq) = self._sim_nomination(
+                    snapshot, w, wl, jnp.asarray(w.usage), head_wid,
+                    sim_cq, adm, admitted, pcfg)
+                if demote_cq.any():
+                    demote(demote_cq, "sim-overflow")
+                    cq_on_device = ~host_root[root_of_cq]
+                off = ~cq_on_device
+                p_override[off] = -1
+                p_borrows[off] = -1
+                p_flavor[off] = -1
+                if p_victims is not None:
+                    p_victims[0][off] = -1
+                    p_victims[2][off] = -1
+                p_targets = {ci: t for ci, t in p_targets.items()
+                             if cq_on_device[ci]}
+                pre = (p_override, p_borrows, p_flavor, p_victims,
+                       p_targets)
+
         device_w = active & wl.eligible & (wl.cq >= 0) \
             & cq_on_device[cq_safe_idx]
         if not device_w.any():
@@ -290,28 +607,47 @@ class OracleBridge:
                        num_cqs=w.num_cqs,
                        fair_mode=eng.cycle.enable_fair_sharing,
                        num_flavors=max(w.num_flavors, 1))
-        out = B.cycle_step(pending, inadmissible, usage, **args, **statics)
+        pre_kwargs = {}
+        preempt_targets: dict[int, list] = {}
+        if pre is not None:
+            p_override, p_borrows, p_flavor, p_victims, p_targets = pre
+            preempt_targets.update(p_targets)
+            pre_kwargs = dict(
+                slot_kind_override=jnp.asarray(p_override),
+                slot_borrows_override=jnp.asarray(p_borrows),
+                slot_flavor_override=jnp.asarray(p_flavor))
+            if p_victims is not None:
+                a_pad = max(8, 1 << (max(adm.num_admitted, 1)
+                                     - 1).bit_length())
+                pre_kwargs.update(
+                    slot_victim_row=jnp.asarray(p_victims[0]),
+                    slot_victim_vals=jnp.asarray(p_victims[1]),
+                    slot_victim_ids=jnp.asarray(p_victims[2]),
+                    claimed0=jnp.zeros(a_pad, bool))
+        out = B.cycle_step(pending, inadmissible, usage, **args,
+                           **pre_kwargs, **statics)
         (new_pending, new_inadmissible, usage2, wl_admitted, slot_admitted,
          slot_position, flavor_of_res, any_oracle, slot_oracle,
          slot_preempting, head_idx) = out
 
-        preempt_targets: dict[int, list] = {}
         if bool(any_oracle):
             flagged = np.asarray(slot_oracle)
-            preempt_ok, pcfg = self._cq_preempt_scope(snapshot, w)
             if eng.cycle.enable_fair_sharing:
-                preempt_ok[:] = False
-            out_scope = flagged & ~preempt_ok
-            if out_scope.any():
-                demote(out_scope, "preemption-scope")
+                # Fair-sharing preemption strategies stay host-side.
+                demote(flagged, "preemption-scope")
                 cq_on_device = ~host_root[root_of_cq]
-            in_scope = flagged & preempt_ok & cq_on_device
+            in_scope = flagged & cq_on_device
             if in_scope.any():
+                if pcfg is None:
+                    pcfg = self._cq_policy_cfg(snapshot, w)
+                if adm is None:
+                    admitted, adm = self._encode_admitted(snapshot, w)
                 res = self._device_preemption(
-                    snapshot, w, solver.wls, args, statics, pending,
-                    inadmissible, usage, in_scope, pcfg,
-                    np.asarray(flavor_of_res), np.asarray(head_idx))
-                out, preempt_targets, overflow = res
+                    w, solver.wls, args, statics, pending,
+                    inadmissible, usage, in_scope, pcfg, adm, admitted,
+                    np.asarray(flavor_of_res), np.asarray(head_idx), pre)
+                out, second_targets, overflow = res
+                preempt_targets.update(second_targets)
                 (new_pending, new_inadmissible, usage2, wl_admitted,
                  slot_admitted, slot_position, flavor_of_res, any_oracle,
                  slot_oracle, slot_preempting, head_idx) = out
@@ -366,45 +702,27 @@ class OracleBridge:
                         st.preemption_skips.get(k, 0) + v
         return result
 
-    def _device_preemption(self, snapshot, w, wls, args, statics, pending,
-                           inadmissible, usage, in_scope, pcfg,
-                           flavor_of_res, head_idx, v_cap: int = 32):
+    def _device_preemption(self, w, wls, args, statics, pending,
+                           inadmissible, usage, in_scope, pcfg, adm,
+                           admitted, flavor_of_res, head_idx, pre,
+                           v_cap: int = 32):
         """Run classical preemption target selection on device
         (ops/preempt.classical_targets — within-CQ, cross-CQ reclaim,
-        borrowWithinCohort) for the in-scope flagged slots and re-run the
-        cycle with kind overrides + victim sets. Returns (outputs,
-        targets_by_slot, overflow bool[C]); overflow slots' roots must be
-        handed to the host preemptor by the caller."""
+        borrowWithinCohort) for the in-scope flagged slots, merge with
+        any sim-nomination overrides (``pre``), and re-run the cycle with
+        kind overrides + victim sets. Returns (outputs, targets_by_slot,
+        overflow bool[C]); overflow slots' roots must be handed to the
+        host preemptor by the caller."""
         import jax.numpy as jnp
 
         from kueue_tpu.ops import commit as cops
-        from kueue_tpu.ops import preempt as pops
-        from kueue_tpu.ops import quota as qops
         from kueue_tpu.oracle import batched as B
-        from kueue_tpu.scheduler.preemption import (
-            IN_CLUSTER_QUEUE,
-            IN_COHORT_RECLAIM_WHILE_BORROWING,
-            IN_COHORT_RECLAMATION,
-        )
-        from kueue_tpu.tensor.schema import encode_admitted
 
-        variant_reason = {
-            pops.V_WITHIN_CQ: IN_CLUSTER_QUEUE,
-            pops.V_HIERARCHICAL_RECLAIM: IN_COHORT_RECLAMATION,
-            pops.V_RECLAIM_WITHOUT_BORROWING: IN_COHORT_RECLAMATION,
-            pops.V_RECLAIM_WHILE_BORROWING:
-                IN_COHORT_RECLAIM_WHILE_BORROWING,
-        }
-
-        eng = self.engine
+        variant_reason = self._variant_reason()
         C = w.num_cqs
         S = w.num_resources
         R = max(w.num_flavors, 1) * max(S, 1)
         flagged = np.nonzero(in_scope)[0]
-
-        admitted = [info for cqs in snapshot.cluster_queues.values()
-                    for info in cqs.workloads.values()]
-        adm = encode_admitted(w, admitted, now=eng.clock)
 
         slot_need = np.zeros(C, bool)
         slot_pri = np.zeros(C, np.int64)
@@ -423,49 +741,35 @@ class OracleBridge:
                                    -1)
             slot_req[ci] = wls.requests[wid]
 
-        if adm.num_admitted == 0:
-            found = np.zeros(C, bool)
-            overflow = np.zeros(C, bool)
-            mask = np.zeros((C, 0), bool)
-            variant = np.zeros((C, 0), np.int32)
-            borrow_after = np.zeros(C, np.int32)
-        else:
-            derived = qops.derive_world(
-                jnp.asarray(w.nominal), jnp.asarray(w.lend_limit),
-                jnp.asarray(w.borrow_limit), usage, jnp.asarray(w.parent),
-                depth=w.depth)
-            found, overflow, mask, _n, variant, borrow_after = \
-                pops.classical_targets(
-                jnp.asarray(slot_need), jnp.asarray(slot_pri),
-                jnp.asarray(slot_ts), jnp.asarray(slot_fr),
-                jnp.asarray(slot_req),
-                jnp.asarray(pcfg["wcq_policy"]),
-                jnp.asarray(pcfg["reclaim_policy"]),
-                jnp.asarray(pcfg["bwc_forbidden"]),
-                jnp.asarray(pcfg["bwc_threshold"]),
-                jnp.asarray(pcfg["cq_has_parent"]),
-                jnp.asarray(adm.cq), jnp.asarray(adm.priority),
-                jnp.asarray(adm.timestamp), jnp.asarray(adm.qr_time),
-                jnp.asarray(adm.uid_rank), jnp.asarray(adm.evicted),
-                jnp.asarray(adm.usage), derived["usage"],
-                derived["subtree_quota"], jnp.asarray(w.lend_limit),
-                    jnp.asarray(w.borrow_limit), jnp.asarray(w.nominal),
-                    jnp.asarray(w.ancestors), jnp.asarray(w.height),
-                    jnp.asarray(w.local_chain),
-                    jnp.asarray(w.root_nodes), jnp.asarray(w.root_of_cq),
-                    depth=w.depth, v_cap=v_cap)
-            found = np.asarray(found) & in_scope
-            overflow = np.asarray(overflow) & in_scope
-            mask = np.asarray(mask)
-            variant = np.asarray(variant)
-            borrow_after = np.asarray(borrow_after)
+        found, overflow, mask, variant, borrow_after = \
+            self._classical_call(w, adm, pcfg, usage, slot_need, slot_pri,
+                                 slot_ts, slot_fr, slot_req, v_cap=v_cap)
+        found &= in_scope
+        overflow &= in_scope
 
+        # Start from the sim-nomination overrides, fill in the flagged
+        # slots (disjoint: overridden slots never flag needs_oracle).
         V = v_cap
-        override = np.full(C, -1, np.int32)
-        borrows_override = np.full(C, -1, np.int32)
-        victim_row = np.full((C, V), -1, np.int32)
-        victim_vals = np.zeros((C, V, R), np.int64)
-        victim_ids = np.full((C, V), -1, np.int32)
+        if pre is not None:
+            p_override, p_borrows, p_flavor, p_victims, _pt = pre
+            override = p_override.copy()
+            borrows_override = p_borrows.copy()
+            flavor_override = p_flavor.copy()
+            if p_victims is not None:
+                victim_row = p_victims[0].copy()
+                victim_vals = p_victims[1].copy()
+                victim_ids = p_victims[2].copy()
+            else:
+                victim_row = np.full((C, V), -1, np.int32)
+                victim_vals = np.zeros((C, V, R), np.int64)
+                victim_ids = np.full((C, V), -1, np.int32)
+        else:
+            override = np.full(C, -1, np.int32)
+            borrows_override = np.full(C, -1, np.int32)
+            flavor_override = np.full((C, S), -1, np.int32)
+            victim_row = np.full((C, V), -1, np.int32)
+            victim_vals = np.zeros((C, V, R), np.int64)
+            victim_ids = np.full((C, V), -1, np.int32)
         targets_by_slot: dict[int, list] = {}
         for ci in flagged:
             if overflow[ci]:
@@ -473,16 +777,10 @@ class OracleBridge:
             elif found[ci]:
                 override[ci] = cops.ENTRY_PREEMPT
                 borrows_override[ci] = borrow_after[ci]
-                victims = np.nonzero(mask[ci])[0][:V]
-                targets_by_slot[int(ci)] = [
-                    (admitted[v],
-                     variant_reason.get(int(variant[ci, v]),
-                                        IN_CLUSTER_QUEUE))
-                    for v in victims]
-                for j, v in enumerate(victims):
-                    victim_row[ci, j] = w.local_chain[adm.cq[v], 0]
-                    victim_vals[ci, j] = adm.usage[v]
-                    victim_ids[ci, j] = v
+                self._fill_victims(
+                    ci, np.nonzero(mask[ci])[0][:V], variant[ci],
+                    admitted, adm, w, victim_row, victim_vals, victim_ids,
+                    targets_by_slot, variant_reason)
             else:
                 override[ci] = (cops.ENTRY_SKIP
                                 if w.can_always_reclaim[ci]
@@ -493,6 +791,7 @@ class OracleBridge:
             pending, inadmissible, usage, **args,
             slot_kind_override=jnp.asarray(override),
             slot_borrows_override=jnp.asarray(borrows_override),
+            slot_flavor_override=jnp.asarray(flavor_override),
             slot_victim_row=jnp.asarray(victim_row),
             slot_victim_vals=jnp.asarray(victim_vals),
             slot_victim_ids=jnp.asarray(victim_ids),

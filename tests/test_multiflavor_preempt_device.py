@@ -1,0 +1,173 @@
+"""Differential suite: multi-flavor preemption on the device fast path.
+
+The flavor choice on a preemption-enabled ClusterQueue with multi-flavor
+resource groups depends on preemption simulations
+(flavorassigner.go:1198 + preemption_oracle.go:41): with the default
+whenCanPreempt=Preempt the scan STOPS at the first preempt-capable
+flavor even when a later flavor would fit. The bridge's sim-augmented
+nomination must reproduce the sequential engine's decisions exactly.
+"""
+
+import random
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.api.types import (  # noqa: E402
+    ClusterQueue,
+    Cohort,
+    FlavorFungibility,
+    FlavorQuotas,
+    FungibilityPolicy,
+    LocalQueue,
+    PodSet,
+    ClusterQueuePreemption,
+    PreemptionPolicy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine  # noqa: E402
+
+
+def build_engine(oracle: bool, rng: random.Random, n_cqs=3,
+                 when_can_preempt=FungibilityPolicy.PREEMPT):
+    eng = Engine()
+    for f in ("on-demand", "spot", "reserved"):
+        eng.create_resource_flavor(ResourceFlavor(f))
+    eng.create_cohort(Cohort("co"))
+    for i in range(n_cqs):
+        flavors = tuple(
+            FlavorQuotas(f, {"cpu": ResourceQuota(
+                rng.choice([1000, 2000, 4000]))})
+            for f in rng.sample(["on-demand", "spot", "reserved"],
+                                rng.choice([2, 3])))
+        eng.create_cluster_queue(ClusterQueue(
+            name=f"cq{i}", cohort="co",
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                reclaim_within_cohort=rng.choice(
+                    [PreemptionPolicy.NEVER, PreemptionPolicy.ANY,
+                     PreemptionPolicy.LOWER_PRIORITY])),
+            flavor_fungibility=FlavorFungibility(
+                when_can_preempt=when_can_preempt),
+            resource_groups=(ResourceGroup(("cpu",), flavors),)))
+        eng.create_local_queue(LocalQueue(f"lq{i}", "default", f"cq{i}"))
+    if oracle:
+        eng.attach_oracle()
+    return eng
+
+
+def churn(eng, rng: random.Random, n=30):
+    names = []
+    for i in range(n):
+        eng.clock += 0.5
+        wl = Workload(
+            name=f"w{i}", queue_name=f"lq{rng.randrange(3)}",
+            priority=rng.choice([0, 2, 5, 9]),
+            pod_sets=(PodSet("main", 1,
+                             {"cpu": rng.choice([500, 900, 1500,
+                                                 2500])}),))
+        eng.submit(wl)
+        names.append(wl.name)
+        if rng.random() < 0.4:
+            eng.schedule_once()
+        if rng.random() < 0.2:
+            admitted = [k for k, x in eng.workloads.items()
+                        if x.is_admitted]
+            if admitted:
+                eng.finish(rng.choice(admitted))
+    for _ in range(120):
+        r = eng.schedule_once()
+        if r is None or (not r.assumed and not any(
+                e.preemption_targets for e in r.entries)):
+            break
+        # Complete issued evictions so preempted workloads requeue.
+        eng.tick(0.0)
+    return names
+
+
+def state_of(eng):
+    out = {}
+    for key, wl in sorted(eng.workloads.items()):
+        out[key] = (wl.is_admitted, wl.is_finished,
+                    sorted((str(psa.flavors[r]), r)
+                           for psa in (wl.status.admission.
+                                       pod_set_assignments
+                                       if wl.status.admission else ())
+                           for r in psa.flavors))
+    return out
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_multiflavor_preempt_matches_sequential(seed):
+    rng_seq = random.Random(seed)
+    rng_bat = random.Random(seed)
+    seq = build_engine(False, random.Random(1000 + seed))
+    bat = build_engine(True, random.Random(1000 + seed))
+    churn(seq, rng_seq)
+    churn(bat, rng_bat)
+    assert bat.oracle.cycles_on_device > 0, "fast path never used"
+    assert state_of(seq) == state_of(bat)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_multiflavor_try_next_matches_sequential(seed):
+    """whenCanPreempt=TryNextFlavor: the scan continues past
+    preempt-capable flavors; mode-lattice ranking of PREEMPT vs
+    NO_CANDIDATES still needs the sims."""
+    rng_seq = random.Random(seed)
+    rng_bat = random.Random(seed)
+    seq = build_engine(False, random.Random(2000 + seed),
+                       when_can_preempt=FungibilityPolicy.TRY_NEXT_FLAVOR)
+    bat = build_engine(True, random.Random(2000 + seed),
+                       when_can_preempt=FungibilityPolicy.TRY_NEXT_FLAVOR)
+    churn(seq, rng_seq)
+    churn(bat, rng_bat)
+    assert bat.oracle.cycles_on_device > 0
+    assert state_of(seq) == state_of(bat)
+
+
+def test_stops_at_preempt_capable_flavor():
+    """The regression the sim-augmented nomination exists for: flavor 1
+    is full but preempt-capable, flavor 2 is free; the host stops at
+    flavor 1 and preempts — the device path must not admit on flavor 2.
+    """
+    def build(oracle):
+        eng = Engine()
+        eng.create_resource_flavor(ResourceFlavor("f1"))
+        eng.create_resource_flavor(ResourceFlavor("f2"))
+        eng.create_cluster_queue(ClusterQueue(
+            name="cq",
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY),
+            flavor_fungibility=FlavorFungibility(
+                when_can_preempt=FungibilityPolicy.PREEMPT),
+            resource_groups=(ResourceGroup(("cpu",), (
+                FlavorQuotas("f1", {"cpu": ResourceQuota(1000)}),
+                FlavorQuotas("f2", {"cpu": ResourceQuota(1000)}),)),)))
+        eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+        if oracle:
+            eng.attach_oracle()
+        eng.clock += 1
+        eng.submit(Workload(name="low", queue_name="lq", priority=0,
+                            pod_sets=(PodSet("main", 1,
+                                             {"cpu": 1000}),)))
+        eng.schedule_once()
+        eng.clock += 1
+        eng.submit(Workload(name="high", queue_name="lq", priority=10,
+                            pod_sets=(PodSet("main", 1,
+                                             {"cpu": 1000}),)))
+        r = eng.schedule_once()
+        return eng, r
+
+    seq, seq_r = build(False)
+    bat, bat_r = build(True)
+    seq_pre = [e.obj.name for e in seq_r.entries if e.preemption_targets]
+    bat_pre = [e.obj.name for e in bat_r.entries if e.preemption_targets]
+    assert seq_pre == ["high"], "sequential must preempt on flavor f1"
+    assert bat_pre == seq_pre, (
+        "device path admitted on f2 instead of preempting on f1")
+    assert bat.oracle.cycles_on_device > 0
