@@ -1,20 +1,34 @@
 #!/usr/bin/env python
-"""Headline benchmark: sustained admission throughput of the batched TPU
-scheduling oracle on the baseline-like scenario.
+"""Benchmark suite: the batched TPU scheduling oracle vs the reference's
+perf-runner scenarios (BASELINE.json configs 2-5).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "admissions/s", "vs_baseline": N}
+Prints ONE JSON line. The required headline keys report sustained
+admission throughput on the baseline-like scenario; a "scenarios" map
+carries the full per-scenario results:
 
-Baseline: the reference admits 15k workloads in ~351 s in its CI baseline
-scenario == ~43 admissions/s sustained (BASELINE.md). We measure the
-batched oracle draining a scaled scenario (1k ClusterQueues in cohorts,
-~50k single-podset workloads) to quiescence: every admission decision goes
-through the full pipeline (derive quota state -> select heads -> nominate
--> order -> sequential-equivalent commit), so this is decision throughput,
-not a microbenchmark.
+  throughput_flat  whole-drain device program, 50k workloads x 1k CQs
+                   (flat cohorts, classical ordering) — admissions/s
+  cycle_latency    the north-star per-cycle number at the same scale:
+                   snapshot encode + transfer + one cycle solve + verdict
+                   decode, p50/p95 seconds vs the <500 ms target
+  hier_fair        3-level cohort tree + fair-sharing DRS tournament on
+                   device, oversubscribed demand — admissions/s
+  preempt_churn    engine serving path (hybrid device cycles + device
+                   classical preemptor): high-priority wave preempting an
+                   admitted low-priority population — decisions/s
+                   (admissions + preemptions)
+  tas              640-node topology (8 blocks x 8 racks x 10 hosts),
+                   gang pod sets placed by the device TAS kernel through
+                   the engine — admissions/s
 
-The TPU tunnel can be unavailable; if device init does not complete within
-a timeout we fall back to CPU (and say so in the metric name).
+Baselines: the reference admits 15k workloads in ~351 s (≈43/s) in its
+CI baseline scenario and 15k TAS workloads in ~401.5 s (≈37/s)
+(test/performance/scheduler/configs/*/rangespec.yaml, BASELINE.md); the
+north-star cycle target is 500 ms (BASELINE.json).
+
+The TPU tunnel can be unavailable; if device init does not complete
+within a timeout we fall back to CPU (and say so in the metric name).
+Scale knobs: KUEUE_TPU_BENCH_WORKLOADS / _COHORTS / _FAST=1.
 """
 
 import json
@@ -24,6 +38,9 @@ import sys
 import time
 
 PROBE = "import jax; jax.devices(); print('ok')"
+REF_BASELINE_ADM_S = 43.0   # 15k workloads / ~351 s
+REF_TAS_ADM_S = 37.4        # 15k TAS workloads / ~401.5 s
+CYCLE_TARGET_S = 0.5
 
 
 def tpu_available(timeout_s: int = 90) -> bool:
@@ -33,6 +50,274 @@ def tpu_available(timeout_s: int = 90) -> bool:
         return b"ok" in r.stdout
     except (subprocess.TimeoutExpired, OSError):
         return False
+
+
+def bench_throughput_flat(n_workloads, n_cohorts):
+    from kueue_tpu.bench.scenario import baseline_like
+    from kueue_tpu.cache.snapshot import build_snapshot
+    from kueue_tpu.oracle.batched import BatchedDrainSolver
+
+    scen = baseline_like(n_cohorts=n_cohorts, n_workloads=n_workloads)
+    snap = build_snapshot(scen.cluster_queues, scen.cohorts, scen.flavors,
+                          [])
+    infos = scen.pending_infos()
+    solver = BatchedDrainSolver(snap, infos)
+    BatchedDrainSolver(snap, infos).solve(max_cycles=1)  # compile
+    t0 = time.perf_counter()
+    decisions, stats = solver.solve()
+    elapsed = time.perf_counter() - t0
+    value = stats["admitted"] / elapsed if elapsed > 0 else 0.0
+    return {
+        "value": round(value, 1), "unit": "admissions/s",
+        "vs_baseline": round(value / REF_BASELINE_ADM_S, 2),
+        "detail": {"workloads": len(scen.workloads),
+                   "cqs": len(scen.cluster_queues),
+                   "admitted": stats["admitted"],
+                   "cycles": stats["cycles"],
+                   "elapsed_s": round(elapsed, 3)},
+    }, scen, snap, infos
+
+
+def bench_cycle_latency(snap, infos, n_cycles=6):
+    """The serving-path cycle: re-encode the snapshot + pending set,
+    one device solve, decode verdicts — all inside the timed region
+    (the north-star <500 ms target includes encode and transfer)."""
+    from kueue_tpu.oracle.batched import BatchedDrainSolver
+
+    pending = list(infos)
+    usage = None
+    times = []
+    for k in range(n_cycles + 1):
+        t0 = time.perf_counter()
+        solver = BatchedDrainSolver(snap, pending)
+        admitted, usage = solver.solve_one_cycle(usage)
+        elapsed = time.perf_counter() - t0
+        if k > 0:  # first iteration pays compilation
+            times.append(elapsed)
+        if admitted.size == 0:
+            break
+        dead = set(admitted.tolist())
+        pending = [inf for j, inf in enumerate(pending) if j not in dead]
+    if not times:
+        return {"value": 0.0, "unit": "s/cycle (p95)", "vs_baseline": 0.0,
+                "detail": {"error": "no cycle admitted anything"}}
+    times.sort()
+    p50 = times[len(times) // 2]
+    p95 = times[min(len(times) - 1, int(len(times) * 0.95))]
+    return {
+        "value": round(p95, 4), "unit": "s/cycle (p95)",
+        "vs_baseline": round(CYCLE_TARGET_S / p95, 2),
+        "detail": {"p50_s": round(p50, 4), "p95_s": round(p95, 4),
+                   "cycles_timed": len(times),
+                   "target_s": CYCLE_TARGET_S},
+    }
+
+
+def bench_hier_fair(n_workloads):
+    from kueue_tpu.bench.scenario import hierarchical_fair
+    from kueue_tpu.cache.snapshot import build_snapshot
+    from kueue_tpu.oracle.batched import BatchedDrainSolver
+
+    scen = hierarchical_fair(n_workloads=n_workloads)
+    snap = build_snapshot(scen.cluster_queues, scen.cohorts, scen.flavors,
+                          [])
+    infos = scen.pending_infos()
+    solver = BatchedDrainSolver(snap, infos, fair=True)
+    BatchedDrainSolver(snap, infos, fair=True).solve(max_cycles=1)
+    t0 = time.perf_counter()
+    decisions, stats = solver.solve()
+    elapsed = time.perf_counter() - t0
+    value = stats["admitted"] / elapsed if elapsed > 0 else 0.0
+    return {
+        "value": round(value, 1), "unit": "admissions/s",
+        "vs_baseline": round(value / REF_BASELINE_ADM_S, 2),
+        "detail": {"workloads": len(scen.workloads),
+                   "cqs": len(scen.cluster_queues),
+                   "admitted": stats["admitted"],
+                   "cycles": stats["cycles"],
+                   "elapsed_s": round(elapsed, 3)},
+    }
+
+
+def _drain_engine(eng, max_cycles=5_000):
+    admitted = preempting = 0
+    while max_cycles > 0:
+        max_cycles -= 1
+        r = eng.schedule_once()
+        if r is None:
+            break
+        admitted += r.stats.admitted
+        preempting += r.stats.preempting
+        if r.stats.preempting:
+            eng.tick(0.0)  # evictions land; victims requeue
+        elif not r.stats.admitted:
+            break
+    return admitted, preempting
+
+
+def bench_preempt_churn(n_pending, n_cohorts=20, cqs_per_cohort=5):
+    """BASELINE.json config 4 shape: admitted low-priority population,
+    then a high-priority wave that must preempt/reclaim its way in —
+    through the engine's hybrid device cycles. Runs the identical wave
+    twice: the first pass compiles every device program (untimed), the
+    second measures steady-state decision throughput."""
+    import random
+
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        ClusterQueuePreemption,
+        Cohort,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        PreemptionPolicy,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Workload,
+    )
+    from kueue_tpu.controllers.engine import Engine
+
+    n_cqs = n_cohorts * cqs_per_cohort
+    nominal = 4000
+
+    def build():
+        rng = random.Random(7)
+        eng = Engine()
+        eng.create_resource_flavor(ResourceFlavor("default"))
+        for c in range(n_cohorts):
+            eng.create_cohort(Cohort(f"co-{c}"))
+        for i in range(n_cqs):
+            eng.create_cluster_queue(ClusterQueue(
+                name=f"cq-{i}", cohort=f"co-{i % n_cohorts}",
+                preemption=ClusterQueuePreemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=(
+                        PreemptionPolicy.LOWER_PRIORITY if i % 2
+                        else PreemptionPolicy.NEVER)),
+                resource_groups=(ResourceGroup(
+                    ("cpu",), (FlavorQuotas("default",
+                                            {"cpu": ResourceQuota(
+                                                nominal)}),)),)))
+            eng.create_local_queue(LocalQueue(f"lq-{i}", "default",
+                                              f"cq-{i}"))
+        # Low-priority fill to ~80% of capacity (untimed; strictly-lower
+        # reclaim priorities keep the churn convergent).
+        fill = n_cqs * nominal * 8 // (10 * 1000)
+        for i in range(fill):
+            eng.clock += 0.001
+            eng.submit(Workload(
+                name=f"low-{i}", queue_name=f"lq-{rng.randrange(n_cqs)}",
+                priority=0,
+                pod_sets=(PodSet("main", 1, {"cpu": 1000}),)))
+        eng.attach_oracle()
+        _drain_engine(eng)
+        for i in range(n_pending):
+            eng.clock += 0.001
+            eng.submit(Workload(
+                name=f"high-{i}", queue_name=f"lq-{rng.randrange(n_cqs)}",
+                priority=rng.choice([10, 50]),
+                pod_sets=(PodSet("main", 1,
+                                 {"cpu": rng.choice([1000, 2000])}),)))
+        return eng
+
+    _drain_engine(build())  # warm-up: compile all device programs
+    eng = build()
+    t0 = time.perf_counter()
+    admitted, preempting = _drain_engine(eng)
+    elapsed = time.perf_counter() - t0
+    decisions = admitted + preempting
+    value = decisions / elapsed if elapsed > 0 else 0.0
+    b = eng.oracle
+    return {
+        "value": round(value, 1), "unit": "decisions/s",
+        "vs_baseline": round(value / REF_BASELINE_ADM_S, 2),
+        "detail": {"pending": n_pending, "cqs": n_cqs,
+                   "admitted": admitted, "preemptions": preempting,
+                   "device_cycles": b.cycles_on_device,
+                   "fallback_cycles": b.cycles_fallback,
+                   "elapsed_s": round(elapsed, 3)},
+    }
+
+
+def bench_tas(n_workloads, n_cqs=8):
+    """BASELINE.json config 5 shape (640-node analog of
+    configs/tas/generator.yaml): topology-constrained gang pod sets
+    placed by the device TAS kernel through the engine."""
+    import random
+
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        PodSetTopologyRequest,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Topology,
+        TopologyLevel,
+        TopologyMode,
+        Workload,
+    )
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.tas.snapshot import HOSTNAME_LABEL, Node
+
+    def build():
+        rng = random.Random(11)
+        eng = Engine()
+        eng.create_topology(Topology("dc", (
+            TopologyLevel("block"), TopologyLevel("rack"),
+            TopologyLevel(HOSTNAME_LABEL))))
+        eng.create_resource_flavor(ResourceFlavor(name="tas",
+                                                  topology_name="dc"))
+        for b in range(8):
+            for r in range(8):
+                for h in range(10):
+                    name = f"b{b}-r{r}-h{h}"
+                    eng.create_node(Node(
+                        name=name,
+                        labels={"block": f"b{b}", "rack": f"b{b}-r{r}",
+                                HOSTNAME_LABEL: name},
+                        capacity={"cpu": 8000, "pods": 32}))
+        total = 8 * 8 * 10 * 8000
+        for i in range(n_cqs):
+            eng.create_cluster_queue(ClusterQueue(
+                name=f"cq-{i}", resource_groups=(ResourceGroup(
+                    ("cpu",), (FlavorQuotas("tas",
+                                            {"cpu": ResourceQuota(
+                                                total // n_cqs)}),)),)))
+            eng.create_local_queue(LocalQueue(f"lq-{i}", "default",
+                                              f"cq-{i}"))
+        eng.attach_oracle()
+        for i in range(n_workloads):
+            eng.clock += 0.001
+            mode = rng.choice([TopologyMode.REQUIRED,
+                               TopologyMode.PREFERRED,
+                               TopologyMode.UNCONSTRAINED])
+            level = None if mode == TopologyMode.UNCONSTRAINED else \
+                rng.choice(["block", "rack"])
+            eng.submit(Workload(
+                name=f"tas-{i}", queue_name=f"lq-{rng.randrange(n_cqs)}",
+                pod_sets=(PodSet(
+                    "main", rng.choice([2, 4, 8]), {"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(
+                        mode=mode, level=level)),)))
+        return eng
+
+    _drain_engine(build())  # warm-up: compile all device programs
+    eng = build()
+    t0 = time.perf_counter()
+    admitted, _ = _drain_engine(eng)
+    elapsed = time.perf_counter() - t0
+    value = admitted / elapsed if elapsed > 0 else 0.0
+    return {
+        "value": round(value, 1), "unit": "admissions/s",
+        "vs_baseline": round(value / REF_TAS_ADM_S, 2),
+        "detail": {"workloads": n_workloads, "nodes": 640,
+                   "admitted": admitted,
+                   "elapsed_s": round(elapsed, 3)},
+    }
 
 
 def main() -> None:
@@ -46,38 +331,46 @@ def main() -> None:
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
+    try:
+        # Persistent compile cache: repeated bench runs (and rounds)
+        # skip XLA compilation entirely.
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
     dev = jax.devices()[0]
 
-    from kueue_tpu.bench.scenario import baseline_like
-    from kueue_tpu.cache.snapshot import build_snapshot
-    from kueue_tpu.oracle.batched import BatchedDrainSolver
+    fast = os.environ.get("KUEUE_TPU_BENCH_FAST") == "1"
+    n_workloads = int(os.environ.get(
+        "KUEUE_TPU_BENCH_WORKLOADS", "2000" if fast else "50000"))
+    n_cohorts = int(os.environ.get(
+        "KUEUE_TPU_BENCH_COHORTS", "20" if fast else "200"))
 
-    n_workloads = int(os.environ.get("KUEUE_TPU_BENCH_WORKLOADS", "50000"))
-    n_cohorts = int(os.environ.get("KUEUE_TPU_BENCH_COHORTS", "200"))
-    scen = baseline_like(n_cohorts=n_cohorts, n_workloads=n_workloads)
-    snap = build_snapshot(scen.cluster_queues, scen.cohorts, scen.flavors, [])
-    infos = scen.pending_infos()
+    scenarios = {}
+    flat, scen, snap, infos = bench_throughput_flat(n_workloads, n_cohorts)
+    scenarios["throughput_flat"] = flat
+    scenarios["cycle_latency"] = bench_cycle_latency(
+        snap, infos, n_cycles=3 if fast else 6)
+    scenarios["hier_fair"] = bench_hier_fair(500 if fast else 20_000)
+    scenarios["preempt_churn"] = bench_preempt_churn(
+        200 if fast else 4_000, n_cohorts=4 if fast else 20)
+    scenarios["tas"] = bench_tas(60 if fast else 800,
+                                 n_cqs=4 if fast else 8)
 
-    solver = BatchedDrainSolver(snap, infos)
-    # Warm-up: compile the cycle step once (excluded from timing).
-    warm = BatchedDrainSolver(snap, infos)
-    warm.solve(max_cycles=1)
-
-    t0 = time.perf_counter()
-    decisions, stats = solver.solve()
-    elapsed = time.perf_counter() - t0
-
-    admitted = stats["admitted"]
-    value = admitted / elapsed if elapsed > 0 else 0.0
-    baseline = 43.0  # reference sustained admissions/s (BASELINE.md)
     print(json.dumps({
         "metric": (
-            f"batched admission throughput, {len(scen.workloads)} workloads"
-            f" x {len(scen.cluster_queues)} CQs, {stats['cycles']} cycles"
-            f" ({dev.platform})"),
-        "value": round(value, 1),
+            f"batched admission throughput, {flat['detail']['workloads']}"
+            f" workloads x {flat['detail']['cqs']} CQs,"
+            f" {flat['detail']['cycles']} cycles ({dev.platform});"
+            " scenarios: cycle-latency p95, hierarchical fair sharing,"
+            " preemption churn, TAS 640 nodes"),
+        "value": flat["value"],
         "unit": "admissions/s",
-        "vs_baseline": round(value / baseline, 2),
+        "vs_baseline": flat["vs_baseline"],
+        "scenarios": scenarios,
     }))
 
 

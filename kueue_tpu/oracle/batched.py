@@ -349,6 +349,73 @@ class BatchedDrainSolver:
         rank[order] = np.arange(W)
         return rank
 
+    def _host_args(self):
+        """The cycle-step argument set as numpy arrays (pre-transfer)."""
+        w, wl = self.world, self.wls
+        return dict(
+            rank=self.head_ranks(), commit_rank=self.commit_ranks(),
+            wl_cq=wl.cq, wl_req=wl.requests, wl_priority=wl.priority,
+            wl_has_qr=wl.has_quota_reservation, wl_hash=wl.hash_id,
+            nominal=w.nominal, lend_limit=w.lend_limit,
+            borrow_limit=w.borrow_limit, parent=w.parent,
+            ancestors=w.ancestors, height=w.height,
+            group_of_res=w.group_of_res, group_flavors=w.group_flavors,
+            no_preemption=w.no_preemption,
+            can_pwb=w.can_preempt_while_borrowing,
+            can_always_reclaim=w.can_always_reclaim,
+            best_effort=w.best_effort,
+            fung_borrow_try_next=w.fung_borrow_try_next,
+            fung_pref_preempt_first=w.fung_pref_preempt_first,
+            root_members=w.root_members, root_nodes=w.root_nodes,
+            local_chain=w.local_chain, wl_ts=wl.timestamp,
+            fair_weight=w.fair_weight, child_rank=w.child_rank,
+            local_depth=w.local_depth,
+            root_parent_local=w.root_parent_local,
+        )
+
+    def _device_args(self):
+        return {k: jnp.asarray(v) for k, v in self._host_args().items()}
+
+    def solve_one_cycle(self, usage=None):
+        """Run exactly one scheduling cycle (the serving-path unit:
+        encode happened at construction; this is transfer + solve +
+        decode). Returns (admitted_row_ids np.int64[], usage np[N, R])
+        so a caller can carry usage across re-encoded cycles.
+
+        The workload axis is bucket-padded to a power of two so repeated
+        cycles over a shrinking pending set reuse one compiled program
+        per bucket (the engine bridge does the same); padding happens on
+        the numpy side, before the single host->device transfer."""
+        w, wl = self.world, self.wls
+        W = wl.num_workloads
+        Wp = max(64, 1 << (max(W, 1) - 1).bit_length())
+        args = self._host_args()
+        if Wp != W:
+            pad = Wp - W
+            big = np.int64(1) << 40
+            fills = dict(rank=big, commit_rank=big, wl_cq=0, wl_req=0,
+                         wl_priority=0, wl_has_qr=False, wl_hash=0,
+                         wl_ts=0.0)
+            for key, fill in fills.items():
+                a = np.asarray(args[key])
+                args[key] = np.concatenate(
+                    [a, np.full((pad,) + a.shape[1:], fill, a.dtype)])
+        args = {k: jnp.asarray(v) for k, v in args.items()}
+        active = np.zeros(Wp, bool)
+        active[:W] = wl.eligible & (wl.cq >= 0)
+        pending = jnp.asarray(active)
+        inadmissible = jnp.zeros(Wp, bool)
+        if usage is None:
+            usage = w.usage
+        out = cycle_step(pending, inadmissible, jnp.asarray(usage),
+                         **args,
+                         depth=w.depth, num_resources=w.num_resources,
+                         num_cqs=w.num_cqs, fair_mode=self.fair,
+                         num_flavors=max(w.num_flavors, 1))
+        wl_admitted = np.asarray(out[3])[:W]
+        new_usage = np.asarray(out[2])
+        return np.nonzero(wl_admitted)[0], new_usage
+
     def solve(self, max_cycles: int = 10_000):
         """Drain until no cycle admits anything. Returns
         (decisions, stats)."""
@@ -358,38 +425,7 @@ class BatchedDrainSolver:
         inadmissible = jnp.zeros(W, bool)
         usage = jnp.asarray(np.broadcast_to(
             w.usage, (w.num_nodes, w.nominal.shape[1])).copy())
-        rank = jnp.asarray(self.head_ranks())
-        crank = jnp.asarray(self.commit_ranks())
-
-        args = dict(
-            rank=rank, commit_rank=crank, wl_cq=jnp.asarray(wl.cq),
-            wl_req=jnp.asarray(wl.requests),
-            wl_priority=jnp.asarray(wl.priority),
-            wl_has_qr=jnp.asarray(wl.has_quota_reservation),
-            wl_hash=jnp.asarray(wl.hash_id),
-            nominal=jnp.asarray(w.nominal),
-            lend_limit=jnp.asarray(w.lend_limit),
-            borrow_limit=jnp.asarray(w.borrow_limit),
-            parent=jnp.asarray(w.parent),
-            ancestors=jnp.asarray(w.ancestors),
-            height=jnp.asarray(w.height),
-            group_of_res=jnp.asarray(w.group_of_res),
-            group_flavors=jnp.asarray(w.group_flavors),
-            no_preemption=jnp.asarray(w.no_preemption),
-            can_pwb=jnp.asarray(w.can_preempt_while_borrowing),
-            can_always_reclaim=jnp.asarray(w.can_always_reclaim),
-            best_effort=jnp.asarray(w.best_effort),
-            fung_borrow_try_next=jnp.asarray(w.fung_borrow_try_next),
-            fung_pref_preempt_first=jnp.asarray(w.fung_pref_preempt_first),
-            root_members=jnp.asarray(w.root_members),
-            root_nodes=jnp.asarray(w.root_nodes),
-            local_chain=jnp.asarray(w.local_chain),
-            wl_ts=jnp.asarray(wl.timestamp),
-            fair_weight=jnp.asarray(w.fair_weight),
-            child_rank=jnp.asarray(w.child_rank),
-            local_depth=jnp.asarray(w.local_depth),
-            root_parent_local=jnp.asarray(w.root_parent_local),
-        )
+        args = self._device_args()
 
         # ONE device program for the whole drain (no per-cycle host sync).
         admit_cycle, admit_pos, wl_flavor, usage, cycles, oracle_flag = \

@@ -176,6 +176,27 @@ class OracleBridge:
                 jnp.asarray(w.nominal), jnp.asarray(w.lend_limit),
                 jnp.asarray(w.borrow_limit), usage, jnp.asarray(w.parent),
                 depth=w.depth)
+        # Bucket-pad the admitted axis so churn cycles with a drifting
+        # admitted count reuse one compiled program per bucket. Padded
+        # rows have cq=-1 and zero usage, so they never classify as
+        # candidates.
+        A = adm.num_admitted
+        Ap = max(8, 1 << (A - 1).bit_length())
+        adm_cq, adm_pri, adm_ts, adm_qrt, adm_uid, adm_ev, adm_usage = (
+            adm.cq, adm.priority, adm.timestamp, adm.qr_time,
+            adm.uid_rank, adm.evicted, adm.usage)
+        if Ap != A:
+            padn = Ap - A
+            adm_cq = np.concatenate([adm_cq, np.full(padn, -1, np.int32)])
+            adm_pri = np.concatenate([adm_pri, np.zeros(padn, np.int64)])
+            adm_ts = np.concatenate([adm_ts, np.zeros(padn)])
+            adm_qrt = np.concatenate([adm_qrt, np.zeros(padn)])
+            adm_uid = np.concatenate(
+                [adm_uid, np.arange(A, Ap, dtype=np.int64)])
+            adm_ev = np.concatenate([adm_ev, np.zeros(padn, bool)])
+            adm_usage = np.concatenate(
+                [adm_usage, np.zeros((padn, adm_usage.shape[1]),
+                                     np.int64)])
         out = pops.classical_targets(
             jnp.asarray(slot_need), jnp.asarray(slot_pri),
             jnp.asarray(slot_ts), jnp.asarray(slot_fr),
@@ -185,10 +206,10 @@ class OracleBridge:
             jnp.asarray(pcfg["bwc_forbidden"]),
             jnp.asarray(pcfg["bwc_threshold"]),
             jnp.asarray(pcfg["cq_has_parent"]),
-            jnp.asarray(adm.cq), jnp.asarray(adm.priority),
-            jnp.asarray(adm.timestamp), jnp.asarray(adm.qr_time),
-            jnp.asarray(adm.uid_rank), jnp.asarray(adm.evicted),
-            jnp.asarray(adm.usage), derived["usage"],
+            jnp.asarray(adm_cq), jnp.asarray(adm_pri),
+            jnp.asarray(adm_ts), jnp.asarray(adm_qrt),
+            jnp.asarray(adm_uid), jnp.asarray(adm_ev),
+            jnp.asarray(adm_usage), derived["usage"],
             derived["subtree_quota"], jnp.asarray(w.lend_limit),
             jnp.asarray(w.borrow_limit), jnp.asarray(w.nominal),
             jnp.asarray(w.ancestors), jnp.asarray(w.height),

@@ -12,6 +12,12 @@ The scaling story (SURVEY.md §2.7/§5): the problem's big axis is Workloads
   * nomination + commit operate on the [C]-sized head set, which is
     replicated — the commit scan is sequential by semantics and tiny.
 
+Both the single cycle (sharded_cycle_step) and the WHOLE drain
+(sharded_drain_loop — the jax.lax.while_loop over cycles runs entirely
+on the mesh, no per-cycle host sync) are exposed. Decision parity of the
+sharded programs against the single-device ones is enforced by
+tests/test_multichip_parity.py.
+
 On multi-host TPU (jax.distributed), the same jit works unchanged: the
 mesh spans hosts and the workload shards ride ICI/DCN. No hand-written
 collectives — the sharding annotations are the whole communication layer.
@@ -19,13 +25,11 @@ collectives — the sharding annotations are the whole communication layer.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kueue_tpu.oracle.batched import cycle_step
+from kueue_tpu.oracle.batched import cycle_step, drain_loop
 
 WL_AXIS = "wl"
 
@@ -35,77 +39,127 @@ def make_mesh(devices=None, axis: str = WL_AXIS) -> Mesh:
     return Mesh(np.asarray(devices), (axis,))
 
 
+def _shardings(mesh: Mesh):
+    return dict(
+        wl=NamedSharding(mesh, P(WL_AXIS)),
+        wl2=NamedSharding(mesh, P(WL_AXIS, None)),
+        r=NamedSharding(mesh, P()),
+        r2=NamedSharding(mesh, P(None, None)),
+        r3=NamedSharding(mesh, P(None, None, None)),
+    )
+
+
+# (workload-sharded?, rank) of the common positional prefix:
+# pending, inadmissible, usage, rank, commit_rank, wl_cq, wl_req,
+# wl_priority, wl_has_qr, wl_hash, nominal, lend_limit, borrow_limit,
+# parent, ancestors, height, group_of_res, group_flavors, no_preemption,
+# can_pwb, can_always_reclaim, best_effort, fung_borrow_try_next,
+# fung_pref_preempt_first, root_members, root_nodes, local_chain
+_PREFIX = ("wl", "wl", "r2", "wl", "wl", "wl", "wl2", "wl", "wl", "wl",
+           "r2", "r2", "r2", "r", "r2", "r", "r2", "r3", "r", "r", "r",
+           "r", "r", "r", "r2", "r2", "r2")
+# wl_ts, fair_weight, child_rank, local_depth, root_parent_local
+_TAIL = ("wl", "r", "r", "r2", "r2")
+
+
 def sharded_cycle_step(mesh: Mesh, depth: int, num_resources: int,
                        num_cqs: int, fair_mode: bool = False,
                        num_flavors: int = 1):
-    """Build a pjit-ed cycle step with the workload axis sharded over the
-    mesh. Returns a callable with the same signature as
-    oracle.batched.cycle_step (minus the static kwargs); pass wl_ts and
-    fair_weight positionally after local_chain (required when
-    fair_mode=True, accepted otherwise)."""
-    wl_sharded = NamedSharding(mesh, P(WL_AXIS))
-    wl_sharded2 = NamedSharding(mesh, P(WL_AXIS, None))
-    repl = NamedSharding(mesh, P())
-    repl2 = NamedSharding(mesh, P(None, None))
-    repl3 = NamedSharding(mesh, P(None, None, None))
-
-    in_shardings = (
-        wl_sharded,  # pending
-        wl_sharded,  # inadmissible
-        repl2,  # usage
-        wl_sharded,  # rank
-        wl_sharded,  # commit_rank
-        wl_sharded,  # wl_cq
-        wl_sharded2,  # wl_req
-        wl_sharded,  # wl_priority
-        wl_sharded,  # wl_has_qr
-        wl_sharded,  # wl_hash
-        repl2,  # nominal
-        repl2,  # lend_limit
-        repl2,  # borrow_limit
-        repl,  # parent
-        repl2,  # ancestors
-        repl,  # height
-        repl2,  # group_of_res
-        repl3,  # group_flavors
-        repl,  # no_preemption
-        repl,  # can_pwb
-        repl,  # can_always_reclaim
-        repl,  # best_effort
-        repl,  # fung_borrow_try_next
-        repl,  # fung_pref_preempt_first
-        repl2,  # root_members
-        repl2,  # root_nodes
-        repl2,  # local_chain
-        wl_sharded,  # wl_ts
-        repl,  # fair_weight
-    )
+    """One scheduling cycle with the workload axis sharded over the mesh.
+    Takes the _PREFIX args, then wl_ts, fair_weight, child_rank,
+    local_depth, root_parent_local."""
+    sh = _shardings(mesh)
+    in_shardings = tuple(sh[n] for n in list(_PREFIX) + list(_TAIL))
     out_shardings = (
-        wl_sharded,  # new_pending
-        wl_sharded,  # new_inadmissible
-        repl2,  # usage
-        wl_sharded,  # wl_admitted
-        repl,  # slot_admitted
-        repl,  # slot_position
-        repl2,  # flavor_of_res
-        repl,  # any_needs_oracle
-        repl,  # slot_oracle
-        repl,  # slot_preempting
-        repl,  # head_idx
-    )
+        sh["wl"], sh["wl"], sh["r2"], sh["wl"], sh["r"], sh["r"],
+        sh["r2"], sh["r"], sh["r"], sh["r"], sh["r"])
 
-    fn = partial(cycle_step.__wrapped__, depth=depth,
-                 num_resources=num_resources, num_cqs=num_cqs,
-                 fair_mode=fair_mode, num_flavors=num_flavors)
+    def fn(pending, inadmissible, usage, rank, commit_rank, wl_cq,
+           wl_req, wl_priority, wl_has_qr, wl_hash, nominal,
+           lend_limit, borrow_limit, parent, ancestors, height,
+           group_of_res, group_flavors, no_preemption, can_pwb,
+           can_always_reclaim, best_effort, fung_borrow_try_next,
+           fung_pref_preempt_first, root_members, root_nodes,
+           local_chain, wl_ts, fair_weight, child_rank, local_depth,
+           root_parent_local):
+        return cycle_step.__wrapped__(
+            pending, inadmissible, usage, rank, commit_rank, wl_cq,
+            wl_req, wl_priority, wl_has_qr, wl_hash, nominal,
+            lend_limit, borrow_limit, parent, ancestors, height,
+            group_of_res, group_flavors, no_preemption, can_pwb,
+            can_always_reclaim, best_effort, fung_borrow_try_next,
+            fung_pref_preempt_first, root_members, root_nodes,
+            local_chain, wl_ts, fair_weight, child_rank, local_depth,
+            root_parent_local=root_parent_local,
+            depth=depth, num_resources=num_resources,
+            num_cqs=num_cqs, fair_mode=fair_mode,
+            num_flavors=num_flavors)
+
     return jax.jit(fn, in_shardings=in_shardings,
                    out_shardings=out_shardings)
 
 
-def shard_workload_arrays(mesh: Mesh, *arrays):
-    """Device-put workload-axis arrays with the wl sharding."""
-    out = []
-    for a in arrays:
-        spec = P(WL_AXIS) if a.ndim == 1 else P(WL_AXIS, *([None] *
-                                                           (a.ndim - 1)))
-        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
-    return tuple(out)
+def sharded_drain_loop(mesh: Mesh, depth: int, num_resources: int,
+                       num_cqs: int, fair_mode: bool = False,
+                       num_flavors: int = 1):
+    """The WHOLE drain (oracle.batched.drain_loop) on the mesh: the
+    while-loop over cycles compiles into one sharded program; per-cycle
+    heads selection reduces across workload shards via mesh collectives.
+    Takes the _PREFIX args, then max_cycles (int), wl_ts, fair_weight,
+    child_rank, local_depth, root_parent_local."""
+    sh = _shardings(mesh)
+    names = list(_PREFIX) + ["r"] + list(_TAIL)
+    in_shardings = tuple(sh[n] for n in names)
+    out_shardings = (sh["wl"], sh["wl"], sh["wl2"], sh["r2"], sh["r"],
+                     sh["r"])
+
+    def fn(pending, inadmissible, usage, rank, commit_rank, wl_cq,
+           wl_req, wl_priority, wl_has_qr, wl_hash, nominal, lend_limit,
+           borrow_limit, parent, ancestors, height, group_of_res,
+           group_flavors, no_preemption, can_pwb, can_always_reclaim,
+           best_effort, fung_borrow_try_next, fung_pref_preempt_first,
+           root_members, root_nodes, local_chain, max_cycles, wl_ts,
+           fair_weight, child_rank, local_depth, root_parent_local):
+        return drain_loop.__wrapped__(
+            pending, inadmissible, usage, rank, commit_rank, wl_cq,
+            wl_req, wl_priority, wl_has_qr, wl_hash, nominal, lend_limit,
+            borrow_limit, parent, ancestors, height, group_of_res,
+            group_flavors, no_preemption, can_pwb, can_always_reclaim,
+            best_effort, fung_borrow_try_next, fung_pref_preempt_first,
+            root_members, root_nodes, local_chain, max_cycles, wl_ts,
+            fair_weight, child_rank, local_depth, root_parent_local,
+            depth=depth, num_resources=num_resources, num_cqs=num_cqs,
+            fair_mode=fair_mode, num_flavors=num_flavors)
+
+    return jax.jit(fn, in_shardings=in_shardings,
+                   out_shardings=out_shardings)
+
+
+def solver_mesh_args(solver, mesh: Mesh):
+    """Assemble a BatchedDrainSolver's arrays in the positional order the
+    sharded programs take (_PREFIX then tail), device_put with the right
+    shardings. Workload counts must be divisible by the mesh size (pad
+    upstream). Returns (prefix_list, tail_list)."""
+    w, wl = solver.world, solver.wls
+    W = wl.num_workloads
+    sh = _shardings(mesh)
+    prefix_vals = [
+        wl.eligible & (wl.cq >= 0),                     # pending
+        np.zeros(W, bool),                              # inadmissible
+        np.broadcast_to(w.usage,
+                        (w.num_nodes, w.nominal.shape[1])).copy(),
+        solver.head_ranks(), solver.commit_ranks(),
+        wl.cq, wl.requests, wl.priority, wl.has_quota_reservation,
+        wl.hash_id,
+        w.nominal, w.lend_limit, w.borrow_limit, w.parent, w.ancestors,
+        w.height, w.group_of_res, w.group_flavors, w.no_preemption,
+        w.can_preempt_while_borrowing, w.can_always_reclaim,
+        w.best_effort, w.fung_borrow_try_next, w.fung_pref_preempt_first,
+        w.root_members, w.root_nodes, w.local_chain,
+    ]
+    tail_vals = [wl.timestamp, w.fair_weight, w.child_rank, w.local_depth,
+                 w.root_parent_local]
+    prefix = [jax.device_put(v, sh[n])
+              for v, n in zip(prefix_vals, _PREFIX)]
+    tail = [jax.device_put(v, sh[n]) for v, n in zip(tail_vals, _TAIL)]
+    return prefix, tail

@@ -1,0 +1,185 @@
+"""Multi-chip decision parity: the sharded oracle programs (workload
+axis over an 8-device CPU mesh, jax.sharding) must produce bit-identical
+decisions to the single-device programs — classical drains at >=10k
+workloads, fair-sharing drains over hierarchical cohort forests, and the
+engine's hybrid cycles with device preemption."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.bench.scenario import (  # noqa: E402
+    baseline_like,
+    hierarchical_fair,
+)
+from kueue_tpu.cache.snapshot import build_snapshot  # noqa: E402
+from kueue_tpu.oracle.batched import BatchedDrainSolver  # noqa: E402
+from kueue_tpu.parallel.sharding import (  # noqa: E402
+    make_mesh,
+    sharded_drain_loop,
+    solver_mesh_args,
+)
+
+N_DEV = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < N_DEV:
+        pytest.skip(f"need {N_DEV} devices")
+    return make_mesh(jax.devices()[:N_DEV])
+
+
+def drain_both(solver, mesh, fair=False):
+    w = solver.world
+    decisions, stats = solver.solve()
+    prefix, tail = solver_mesh_args(solver, mesh)
+    drain = sharded_drain_loop(
+        mesh, depth=w.depth, num_resources=w.num_resources,
+        num_cqs=w.num_cqs, fair_mode=fair,
+        num_flavors=max(w.num_flavors, 1))
+    out = drain(*prefix, np.int32(10_000), *tail)
+    jax.block_until_ready(out)
+    return stats, out
+
+
+def test_classical_drain_parity_10k(mesh):
+    scen = baseline_like(n_cohorts=16, cqs_per_cohort=4,
+                         n_workloads=10_240, seed=3,
+                         sized_to_fit=False, nominal_per_cq=120_000)
+    snap = build_snapshot(scen.cluster_queues, scen.cohorts,
+                          scen.flavors, [])
+    solver = BatchedDrainSolver(snap, scen.pending_infos())
+    assert solver.wls.num_workloads == 10_240
+    stats, out = drain_both(solver, mesh)
+    admit_cycle, admit_pos, wl_flavor, usage, cycles, _ = out
+
+    # Re-derive the single-device per-row verdicts for comparison.
+    solver2 = BatchedDrainSolver(snap, scen.pending_infos())
+    decisions, stats2 = solver2.solve()
+    assert stats["admitted"] == stats2["admitted"]
+    admitted_rows = np.asarray(admit_cycle) >= 0
+    assert int(admitted_rows.sum()) == stats["admitted"]
+    # Identical final usage tensor => identical committed decisions.
+    np.testing.assert_array_equal(np.asarray(usage), stats["final_usage"])
+    # And identical per-workload commit schedule.
+    key_to_cycle_pos = {d.key: (d.cycle, d.position, d.flavors)
+                        for d in decisions}
+    ac = np.asarray(admit_cycle)
+    ap = np.asarray(admit_pos)
+    fl = np.asarray(wl_flavor)
+    w = solver.world
+    for row in np.nonzero(admitted_rows)[0]:
+        key = solver.wls.keys[row]
+        cyc, pos, flavors = key_to_cycle_pos[key]
+        assert (int(ac[row]), int(ap[row])) == (cyc, pos)
+        got = {w.resource_names[s]: w.flavor_names[fl[row, s]]
+               for s in range(w.num_resources)
+               if fl[row, s] >= 0 and solver.wls.requests[row, s] > 0}
+        assert got == flavors
+
+
+def test_fair_drain_parity_hierarchical(mesh):
+    scen = hierarchical_fair(n_roots=8, mids_per_root=2, cqs_per_mid=4,
+                             n_workloads=4096, seed=5)
+    # Pad the population to a mesh-divisible count.
+    while len(scen.workloads) % N_DEV:
+        scen.workloads.pop()
+    snap = build_snapshot(scen.cluster_queues, scen.cohorts,
+                          scen.flavors, [])
+    solver = BatchedDrainSolver(snap, scen.pending_infos(), fair=True)
+    stats, out = drain_both(solver, mesh, fair=True)
+    admit_cycle, admit_pos, _, usage, cycles, _ = out
+    assert int((np.asarray(admit_cycle) >= 0).sum()) == stats["admitted"]
+    assert stats["admitted"] > 0
+    np.testing.assert_array_equal(np.asarray(usage), stats["final_usage"])
+
+
+def test_engine_device_preemption_under_mesh(mesh, monkeypatch):
+    """Hybrid engine cycles — including the device classical preemptor
+    and its victim/claimed overrides — run with the workload axis
+    sharded over the mesh and still match the sequential engine."""
+    import random
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import kueue_tpu.oracle.batched as B
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        ClusterQueuePreemption,
+        Cohort,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        PreemptionPolicy,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Workload,
+    )
+    from kueue_tpu.controllers.engine import Engine
+
+    wl_sh = NamedSharding(mesh, P("wl"))
+    wl_sh2 = NamedSharding(mesh, P("wl", None))
+    orig = B.cycle_step
+    WL1 = ("rank", "commit_rank", "wl_cq", "wl_priority", "wl_has_qr",
+           "wl_hash", "wl_ts")
+    calls = []
+
+    def sharded_call(pending, inadmissible, usage, **kw):
+        calls.append(1)
+        pending = jax.device_put(pending, wl_sh)
+        inadmissible = jax.device_put(inadmissible, wl_sh)
+        for k in WL1:
+            kw[k] = jax.device_put(kw[k], wl_sh)
+        kw["wl_req"] = jax.device_put(kw["wl_req"], wl_sh2)
+        return orig(pending, inadmissible, usage, **kw)
+
+    monkeypatch.setattr(B, "cycle_step", sharded_call)
+
+    def build(oracle):
+        rng = random.Random(42)
+        eng = Engine()
+        eng.create_resource_flavor(ResourceFlavor("default"))
+        eng.create_cohort(Cohort("co"))
+        for i in range(4):
+            eng.create_cluster_queue(ClusterQueue(
+                name=f"cq{i}", cohort="co",
+                preemption=ClusterQueuePreemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=PreemptionPolicy.ANY),
+                resource_groups=(ResourceGroup(
+                    ("cpu",), (FlavorQuotas("default",
+                                            {"cpu": ResourceQuota(
+                                                2000)}),)),)))
+            eng.create_local_queue(LocalQueue(f"lq{i}", "default",
+                                              f"cq{i}"))
+        if oracle:
+            eng.attach_oracle()
+        for i in range(24):
+            eng.clock += 0.5
+            eng.submit(Workload(
+                name=f"w{i}", queue_name=f"lq{rng.randrange(4)}",
+                priority=rng.choice([0, 5, 9]),
+                pod_sets=(PodSet("main", 1,
+                                 {"cpu": rng.choice([700, 1400])}),)))
+        for _ in range(60):
+            r = eng.schedule_once()
+            if r is None or (not r.assumed and not any(
+                    e.preemption_targets for e in r.entries)):
+                break
+            eng.tick(0.0)
+        return eng
+
+    bat = build(True)
+    assert calls, "sharded cycle_step never invoked"
+    assert bat.oracle.cycles_on_device > 0
+    monkeypatch.setattr(B, "cycle_step", orig)
+    seq = build(False)
+
+    def state(eng):
+        return {k: (wl.is_admitted, wl.is_finished)
+                for k, wl in sorted(eng.workloads.items())}
+
+    assert state(seq) == state(bat)
