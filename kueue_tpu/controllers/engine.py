@@ -93,33 +93,95 @@ class Engine:
         self.workload_priority_classes: dict[str, int] = {}
         # Second-pass retry bookkeeping (second_pass_queue.go backoff).
         self._second_pass_attempts: dict[str, int] = {}
+        # Durable store (store/journal.py) — the "K8s API as durable
+        # store" analog; attach via attach_journal().
+        self.journal = None
+
+    # -- durability (store/journal.py) --
+
+    def attach_journal(self, journal, record_existing: bool = True) -> None:
+        """Journal every object creation and workload status transition.
+        With ``record_existing``, the engine's current state is
+        snapshotted first (journal adoption after boot)."""
+        self.journal = journal
+        if record_existing:
+            for cohort in self.cache.cohorts.values():
+                journal.apply("cohort", cohort, ts=self.clock)
+            for rf in self.cache.resource_flavors.values():
+                journal.apply("resource_flavor", rf, ts=self.clock)
+            for cq in self.cache.cluster_queues.values():
+                journal.apply("cluster_queue", cq, ts=self.clock)
+            for lq in self.queues.local_queues.values():
+                journal.apply("local_queue", lq, ts=self.clock)
+            for topo in self.cache.topologies.values():
+                journal.apply("topology", topo, ts=self.clock)
+            for node in self.cache.nodes.values():
+                journal.apply("node", node, ts=self.clock)
+            for name, value in self.workload_priority_classes.items():
+                journal.apply("workload_priority_class",
+                              {"name": name, "value": value},
+                              ts=self.clock)
+            for wl in self.workloads.values():
+                journal.apply("workload", wl, ts=self.clock)
+
+    def _journal_obj(self, kind: str, obj) -> None:
+        if self.journal is not None:
+            self.journal.apply(kind, obj, ts=self.clock)
+
+    def restore_workload(self, wl: Workload) -> None:
+        """The informer-rebuild path (restart recovery): re-register a
+        workload from durable state WITHOUT resetting its status —
+        admitted workloads re-assume cache usage, pending ones re-enter
+        the queues with requeue backoff intact."""
+        self.workloads[wl.key] = wl
+        if wl.is_finished:
+            return
+        if wl.status.admission is not None:
+            self.cache.add_or_update_workload(wl)
+            if wl.status.unhealthy_nodes:
+                # Pending node replacement: re-arm the second pass
+                # (mark_node_unhealthy had queued it pre-restart).
+                info = WorkloadInfo.from_workload(
+                    wl, wl.status.admission.cluster_queue)
+                self.queues.second_pass.prequeue(wl.key)
+                self.queues.second_pass.queue(info, now=self.clock)
+        elif wl.active:
+            self.queues.add_or_update_workload(wl)
 
     # -- object admin --
 
     def create_cluster_queue(self, cq: ClusterQueue) -> None:
         self.cache.add_or_update_cluster_queue(cq)
         self.queues.add_cluster_queue(cq)
+        self._journal_obj("cluster_queue", cq)
 
     def create_cohort(self, cohort: Cohort) -> None:
         self.cache.add_or_update_cohort(cohort)
+        self._journal_obj("cohort", cohort)
 
     def create_resource_flavor(self, rf: ResourceFlavor) -> None:
         self.cache.add_or_update_resource_flavor(rf)
+        self._journal_obj("resource_flavor", rf)
 
     def create_local_queue(self, lq: LocalQueue) -> None:
         self.queues.add_local_queue(lq)
+        self._journal_obj("local_queue", lq)
 
     def create_topology(self, topology) -> None:
         self.cache.add_or_update_topology(topology)
+        self._journal_obj("topology", topology)
 
     def create_node(self, node) -> None:
         """Node lifecycle (tas/node_controller.go)."""
         self.cache.add_or_update_node(node)
         self.queues.queue_inadmissible_workloads()
+        self._journal_obj("node", node)
 
     def delete_node(self, name: str) -> None:
         self.cache.delete_node(name)
         self.queues.queue_inadmissible_workloads()
+        if self.journal is not None:
+            self.journal.delete("node", name, ts=self.clock)
 
     def mark_node_unhealthy(self, name: str, reason: str = "") -> None:
         """tas/node_controller.go: a node failed — record it on every
@@ -127,6 +189,8 @@ class Engine:
         workload_types.go:766) and arm the second-pass queue so the next
         scheduling pass runs the replacement algorithm."""
         self.cache.delete_node(name)
+        if self.journal is not None:
+            self.journal.delete("node", name, ts=self.clock)
         for wl in self.workloads.values():
             if wl.is_finished or wl.status.admission is None:
                 continue
@@ -190,8 +254,10 @@ class Engine:
                 patches.update(results)
             if reason:
                 if features.enabled("TASFailedNodeReplacementFailFast"):
-                    self.evict(wl, "NodeFailureReplacementFailed")
+                    # Clear before evicting so the journaled eviction
+                    # state is final.
                     wl.status.unhealthy_nodes = ()
+                    self.evict(wl, "NodeFailureReplacementFailed")
                 else:
                     attempt = self._second_pass_attempts.get(info.key, 0) + 1
                     self._second_pass_attempts[info.key] = attempt
@@ -216,6 +282,8 @@ class Engine:
 
     def create_workload_priority_class(self, name: str, value: int) -> None:
         self.workload_priority_classes[name] = value
+        self._journal_obj("workload_priority_class",
+                          {"name": name, "value": value})
 
     def submit(self, wl: Workload) -> bool:
         if not wl.creation_time:
@@ -228,6 +296,9 @@ class Engine:
         self.workloads[wl.key] = wl
         info = self.queues.add_or_update_workload(wl)
         if info is None:
+            # Registered but unqueued (unknown LocalQueue): persist so a
+            # restarted engine carries the same object.
+            self._journal_obj("workload", wl)
             return False
         self._event("Submitted", wl.key,
                     cluster_queue=info.cluster_queue)
@@ -265,8 +336,13 @@ class Engine:
                 self.evict(wl, "MaximumExecutionTimeExceeded",
                            requeue=False)
 
-    def attach_oracle(self, max_depth: int = 4) -> None:
-        """Enable the batched TPU fast path for scheduling cycles."""
+    def attach_oracle(self, max_depth: int = 4,
+                      remote_address: Optional[tuple] = None) -> None:
+        """Enable the batched TPU fast path for scheduling cycles. With
+        ``remote_address`` ((host, port)), device programs run in a
+        standalone oracle service process (oracle/service.py) over the
+        socket boundary; transport failures fall back to the sequential
+        path per cycle."""
         import jax
 
         # The dense quota math uses int64 quantities with an INF sentinel
@@ -277,7 +353,12 @@ class Engine:
         if not jax.config.jax_enable_x64:
             jax.config.update("jax_enable_x64", True)
         from kueue_tpu.oracle.engine_bridge import OracleBridge
-        self.oracle = OracleBridge(self, max_depth=max_depth)
+        executor = None
+        if remote_address is not None:
+            from kueue_tpu.oracle.service import RemoteExecutor
+            executor = RemoteExecutor(*remote_address)
+        self.oracle = OracleBridge(self, max_depth=max_depth,
+                                   executor=executor)
 
     def schedule_once(self) -> Optional[CycleResult]:
         """One schedule() cycle (scheduler.go:286)."""
@@ -285,8 +366,17 @@ class Engine:
 
         self._process_second_pass()
         if self.oracle is not None:
+            from kueue_tpu.oracle.service import RemoteOracleError
+
             t0 = _time.perf_counter()
-            result = self.oracle.try_cycle()
+            try:
+                result = self.oracle.try_cycle()
+            except RemoteOracleError:
+                # Transport failure before any verdict was applied: the
+                # sequential path owns this cycle (the BestEffortFIFO
+                # fallback contract).
+                self.oracle._fallback("remote-error")
+                result = None
             if result is not None:
                 if not result.entries and not result.inadmissible:
                     return None  # idle
@@ -426,8 +516,10 @@ class Engine:
         required = (self.admission_checks.required_for(cq_name)
                     if self.admission_checks else ())
         if any(states.get(c) == CheckState.REJECTED for c in required):
-            self.evict(wl, "AdmissionCheckRejected", requeue=False)
+            # Deactivate before evicting so the journaled eviction state
+            # carries active=False (restart must not requeue it).
             wl.active = False
+            self.evict(wl, "AdmissionCheckRejected", requeue=False)
             return
         if any(states.get(c) == CheckState.RETRY for c in required):
             self.evict(wl, "AdmissionCheckRetry")
@@ -459,6 +551,9 @@ class Engine:
             if backoff_seconds:
                 wl.status.requeue_at = self.clock + backoff_seconds
             self.queues.add_or_update_workload(wl)
+            # The requeue bookkeeping mutated status after the Evicted
+            # event — persist the final state.
+            self._journal_obj("workload", wl)
         self._requeue_cohort_inadmissible(cq_name)
 
     def _issue_preemptions(self, entry) -> None:
@@ -519,6 +614,11 @@ class Engine:
                detail: str = "") -> None:
         ev = EngineEvent(self.clock, kind, workload, cluster_queue, detail)
         self.events.append(ev)
+        # Every workload transition flows through here — persist the
+        # post-transition state (the SSA status-patch analog).
+        if self.journal is not None and workload in self.workloads:
+            self.journal.apply("workload", self.workloads[workload],
+                               ts=self.clock)
         for fn in self.event_listeners:
             # Handler errors must not unwind the scheduling cycle
             # (client-go informers isolate handler panics the same way).

@@ -386,20 +386,19 @@ class BatchedDrainSolver:
         cycles over a shrinking pending set reuse one compiled program
         per bucket (the engine bridge does the same); padding happens on
         the numpy side, before the single host->device transfer."""
+        from kueue_tpu.tensor.schema import (
+            WL_PAD_FILLS,
+            pad_axis0,
+            pow2_bucket,
+        )
+
         w, wl = self.world, self.wls
         W = wl.num_workloads
-        Wp = max(64, 1 << (max(W, 1) - 1).bit_length())
+        Wp = pow2_bucket(W, 64)
         args = self._host_args()
         if Wp != W:
-            pad = Wp - W
-            big = np.int64(1) << 40
-            fills = dict(rank=big, commit_rank=big, wl_cq=0, wl_req=0,
-                         wl_priority=0, wl_has_qr=False, wl_hash=0,
-                         wl_ts=0.0)
-            for key, fill in fills.items():
-                a = np.asarray(args[key])
-                args[key] = np.concatenate(
-                    [a, np.full((pad,) + a.shape[1:], fill, a.dtype)])
+            for key, fill in WL_PAD_FILLS.items():
+                args[key] = pad_axis0(args[key], Wp, fill)
         args = {k: jnp.asarray(v) for k, v in args.items()}
         active = np.zeros(Wp, bool)
         active[:W] = wl.eligible & (wl.cq >= 0)

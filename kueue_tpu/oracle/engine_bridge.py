@@ -61,9 +61,16 @@ _HOST_BIG = np.int64(1) << 60
 
 
 class OracleBridge:
-    def __init__(self, engine, max_depth: int = 4):
+    def __init__(self, engine, max_depth: int = 4, executor=None):
         self.engine = engine
         self.max_depth = max_depth
+        if executor is None:
+            from kueue_tpu.oracle.service import LocalExecutor
+            executor = LocalExecutor()
+        # Where device programs run: in-process (LocalExecutor) or a
+        # standalone oracle service over the socket boundary
+        # (service.RemoteExecutor).
+        self.executor = executor
         self.cycles_on_device = 0
         self.cycles_fallback = 0
         self.cycles_hybrid = 0  # device cycles with a host-root tail
@@ -158,63 +165,49 @@ class OracleBridge:
     def _classical_call(self, w, adm, pcfg, usage, slot_need, slot_pri,
                         slot_ts, slot_fr, slot_req, v_cap=32,
                         derived=None):
-        """One batched classical_targets launch; returns numpy
-        (found, overflow, mask, variant, borrow_after). Pass ``derived``
-        when the caller already ran quota.derive_world for this usage."""
-        import jax.numpy as jnp
-
-        from kueue_tpu.ops import preempt as pops
-        from kueue_tpu.ops import quota as qops
-
+        """One batched classical_targets launch via the executor;
+        returns numpy (found, overflow, mask, variant, borrow_after).
+        Pass ``derived`` when the caller already ran quota.derive_world
+        for this usage (in-process execution reuses it)."""
         C = w.num_cqs
         if adm.num_admitted == 0:
             return (np.zeros(C, bool), np.zeros(C, bool),
                     np.zeros((C, 0), bool), np.zeros((C, 0), np.int32),
                     np.zeros(C, np.int32))
-        if derived is None:
-            derived = qops.derive_world(
-                jnp.asarray(w.nominal), jnp.asarray(w.lend_limit),
-                jnp.asarray(w.borrow_limit), usage, jnp.asarray(w.parent),
-                depth=w.depth)
         # Bucket-pad the admitted axis so churn cycles with a drifting
         # admitted count reuse one compiled program per bucket. Padded
         # rows have cq=-1 and zero usage, so they never classify as
         # candidates.
+        from kueue_tpu.tensor.schema import pad_axis0, pow2_bucket
+
         A = adm.num_admitted
-        Ap = max(8, 1 << (A - 1).bit_length())
-        adm_cq, adm_pri, adm_ts, adm_qrt, adm_uid, adm_ev, adm_usage = (
-            adm.cq, adm.priority, adm.timestamp, adm.qr_time,
-            adm.uid_rank, adm.evicted, adm.usage)
-        if Ap != A:
-            padn = Ap - A
-            adm_cq = np.concatenate([adm_cq, np.full(padn, -1, np.int32)])
-            adm_pri = np.concatenate([adm_pri, np.zeros(padn, np.int64)])
-            adm_ts = np.concatenate([adm_ts, np.zeros(padn)])
-            adm_qrt = np.concatenate([adm_qrt, np.zeros(padn)])
-            adm_uid = np.concatenate(
-                [adm_uid, np.arange(A, Ap, dtype=np.int64)])
-            adm_ev = np.concatenate([adm_ev, np.zeros(padn, bool)])
-            adm_usage = np.concatenate(
-                [adm_usage, np.zeros((padn, adm_usage.shape[1]),
-                                     np.int64)])
-        out = pops.classical_targets(
-            jnp.asarray(slot_need), jnp.asarray(slot_pri),
-            jnp.asarray(slot_ts), jnp.asarray(slot_fr),
-            jnp.asarray(slot_req),
-            jnp.asarray(pcfg["wcq_policy"]),
-            jnp.asarray(pcfg["reclaim_policy"]),
-            jnp.asarray(pcfg["bwc_forbidden"]),
-            jnp.asarray(pcfg["bwc_threshold"]),
-            jnp.asarray(pcfg["cq_has_parent"]),
-            jnp.asarray(adm_cq), jnp.asarray(adm_pri),
-            jnp.asarray(adm_ts), jnp.asarray(adm_qrt),
-            jnp.asarray(adm_uid), jnp.asarray(adm_ev),
-            jnp.asarray(adm_usage), derived["usage"],
-            derived["subtree_quota"], jnp.asarray(w.lend_limit),
-            jnp.asarray(w.borrow_limit), jnp.asarray(w.nominal),
-            jnp.asarray(w.ancestors), jnp.asarray(w.height),
-            jnp.asarray(w.local_chain), jnp.asarray(w.root_nodes),
-            jnp.asarray(w.root_of_cq), depth=w.depth, v_cap=v_cap)
+        Ap = pow2_bucket(A, 8)
+        adm_cq = pad_axis0(adm.cq, Ap, -1)
+        adm_pri = pad_axis0(adm.priority, Ap, 0)
+        adm_ts = pad_axis0(adm.timestamp, Ap, 0.0)
+        adm_qrt = pad_axis0(adm.qr_time, Ap, 0.0)
+        adm_uid = np.concatenate(
+            [adm.uid_rank, np.arange(A, Ap, dtype=np.int64)]) \
+            if Ap != A else adm.uid_rank
+        adm_ev = pad_axis0(adm.evicted, Ap, False)
+        adm_usage = pad_axis0(adm.usage, Ap, 0)
+        tensors = dict(
+            slot_need=slot_need, slot_pri=slot_pri, slot_ts=slot_ts,
+            slot_fr=slot_fr, slot_req=slot_req,
+            wcq_policy=pcfg["wcq_policy"],
+            reclaim_policy=pcfg["reclaim_policy"],
+            bwc_forbidden=pcfg["bwc_forbidden"],
+            bwc_threshold=pcfg["bwc_threshold"],
+            cq_has_parent=pcfg["cq_has_parent"],
+            adm_cq=adm_cq, adm_pri=adm_pri, adm_ts=adm_ts,
+            adm_qrt=adm_qrt, adm_uid=adm_uid, adm_ev=adm_ev,
+            adm_usage=adm_usage, usage=usage, nominal=w.nominal,
+            lend_limit=w.lend_limit, borrow_limit=w.borrow_limit,
+            parent=w.parent, ancestors=w.ancestors, height=w.height,
+            local_chain=w.local_chain, root_nodes=w.root_nodes,
+            root_of_cq=w.root_of_cq)
+        out = self.executor.classical_targets(
+            tensors, {"depth": w.depth, "v_cap": v_cap}, derived=derived)
         found, overflow, mask, _n, variant, borrow_after = out
         return (np.array(found), np.array(overflow), np.array(mask),
                 np.array(variant), np.array(borrow_after))
@@ -600,27 +593,18 @@ class OracleBridge:
         )
         # Bucket-pad the workload axis so recurring cycles with varying
         # pending counts reuse one compiled program per bucket.
-        Wp = max(64, 1 << (W - 1).bit_length())
+        from kueue_tpu.tensor.schema import (
+            WL_PAD_FILLS,
+            pad_axis0,
+            pow2_bucket,
+        )
+
+        Wp = pow2_bucket(W, 64)
         device_w_padded = device_w
         if Wp != W:
-            pad = Wp - W
-            big = np.int64(1) << 40
-
-            def pad1(key, fill):
-                a = np.asarray(args[key])
-                args[key] = jnp.asarray(np.concatenate(
-                    [a, np.full((pad,) + a.shape[1:], fill, a.dtype)]))
-
-            pad1("rank", big)
-            pad1("commit_rank", big)
-            pad1("wl_cq", 0)
-            pad1("wl_req", 0)
-            pad1("wl_priority", 0)
-            pad1("wl_has_qr", False)
-            pad1("wl_hash", 0)
-            pad1("wl_ts", 0.0)
-            device_w_padded = np.concatenate(
-                [device_w, np.zeros(pad, bool)])
+            for key, fill in WL_PAD_FILLS.items():
+                args[key] = jnp.asarray(pad_axis0(args[key], Wp, fill))
+            device_w_padded = pad_axis0(device_w, Wp, False)
         pending = jnp.asarray(device_w_padded)
         inadmissible = jnp.zeros(Wp, bool)
         usage = jnp.asarray(w.usage)
@@ -638,15 +622,16 @@ class OracleBridge:
                 slot_borrows_override=jnp.asarray(p_borrows),
                 slot_flavor_override=jnp.asarray(p_flavor))
             if p_victims is not None:
-                a_pad = max(8, 1 << (max(adm.num_admitted, 1)
-                                     - 1).bit_length())
+                from kueue_tpu.tensor.schema import pow2_bucket
+                a_pad = pow2_bucket(adm.num_admitted, 8)
                 pre_kwargs.update(
                     slot_victim_row=jnp.asarray(p_victims[0]),
                     slot_victim_vals=jnp.asarray(p_victims[1]),
                     slot_victim_ids=jnp.asarray(p_victims[2]),
                     claimed0=jnp.zeros(a_pad, bool))
-        out = B.cycle_step(pending, inadmissible, usage, **args,
-                           **pre_kwargs, **statics)
+        out = self.executor.cycle_step(
+            dict(pending=pending, inadmissible=inadmissible, usage=usage,
+                 **args, **pre_kwargs), statics)
         (new_pending, new_inadmissible, usage2, wl_admitted, slot_admitted,
          slot_position, flavor_of_res, any_oracle, slot_oracle,
          slot_preempting, head_idx) = out
@@ -734,10 +719,7 @@ class OracleBridge:
         kind overrides + victim sets. Returns (outputs, targets_by_slot,
         overflow bool[C]); overflow slots' roots must be handed to the
         host preemptor by the caller."""
-        import jax.numpy as jnp
-
         from kueue_tpu.ops import commit as cops
-        from kueue_tpu.oracle import batched as B
 
         variant_reason = self._variant_reason()
         C = w.num_cqs
@@ -807,16 +789,18 @@ class OracleBridge:
                                 if w.can_always_reclaim[ci]
                                 else cops.ENTRY_RESERVE)
 
-        A_pad = max(8, 1 << (max(adm.num_admitted, 1) - 1).bit_length())
-        out = B.cycle_step(
-            pending, inadmissible, usage, **args,
-            slot_kind_override=jnp.asarray(override),
-            slot_borrows_override=jnp.asarray(borrows_override),
-            slot_flavor_override=jnp.asarray(flavor_override),
-            slot_victim_row=jnp.asarray(victim_row),
-            slot_victim_vals=jnp.asarray(victim_vals),
-            slot_victim_ids=jnp.asarray(victim_ids),
-            claimed0=jnp.zeros(A_pad, bool), **statics)
+        from kueue_tpu.tensor.schema import pow2_bucket
+        A_pad = pow2_bucket(adm.num_admitted, 8)
+        out = self.executor.cycle_step(
+            dict(pending=pending, inadmissible=inadmissible, usage=usage,
+                 **args,
+                 slot_kind_override=override,
+                 slot_borrows_override=borrows_override,
+                 slot_flavor_override=flavor_override,
+                 slot_victim_row=victim_row,
+                 slot_victim_vals=victim_vals,
+                 slot_victim_ids=victim_ids,
+                 claimed0=np.zeros(A_pad, bool)), statics)
         return out, targets_by_slot, overflow
 
     def _apply(self, solver, pending_infos, wl_admitted, parked,

@@ -1,0 +1,225 @@
+"""The oracle serving boundary: the decision core as a standalone
+process — snapshot tensors in, verdict tensors out.
+
+SURVEY §7's architecture stance ("the decision core as a JAX/TPU service
+exposed over an AdmissionCheck-style RPC API") as it actually ships:
+
+  * OracleServer — a standalone process (``python -m
+    kueue_tpu.oracle.service --port N``) hosting the two device programs
+    the hybrid cycle needs: the batched cycle step
+    (oracle/batched.cycle_step) and the classical preemption targets
+    kernel (ops/preempt.classical_targets). It is stateless: every
+    request carries the full dense snapshot (tensor/schema.py), every
+    response the verdicts — the reference's "the API server is the
+    durable store; the scheduler assumes and patches"
+    (scheduler.go:856-910) maps to the engine applying verdicts through
+    its own assume path.
+  * RemoteExecutor — the engine-side client. OracleBridge routes its
+    device calls through an executor; LocalExecutor runs in-process
+    (the default), RemoteExecutor ships frames over a socket
+    (oracle/wire.py) and raises RemoteOracleError on transport failure,
+    which the bridge turns into a sequential-path fallback for the
+    cycle (the BestEffortFIFO fallback contract).
+
+Scope: cycle_step and classical_targets cross the boundary (the hot
+decision programs); the sim-augmented nomination grid and TAS placement
+currently run in the engine process (they share the device through the
+same jit cache when local).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+import numpy as np
+
+from kueue_tpu.oracle import wire
+
+
+class RemoteOracleError(Exception):
+    """Transport failure talking to the oracle service; the bridge falls
+    back to the sequential path for the cycle."""
+
+
+_CYCLE_STATICS = ("depth", "num_resources", "num_cqs", "fair_mode",
+                  "num_flavors")
+
+
+def _run_cycle_step(tensors: dict, statics: dict):
+    import jax.numpy as jnp
+
+    from kueue_tpu.oracle import batched as B
+
+    kwargs = {k: jnp.asarray(v) for k, v in tensors.items()}
+    out = B.cycle_step(**kwargs, **statics)
+    return [np.asarray(o) for o in out]
+
+
+def _run_classical_targets(tensors: dict, statics: dict, derived=None):
+    import jax.numpy as jnp
+
+    from kueue_tpu.ops import preempt as pops
+    from kueue_tpu.ops import quota as qops
+
+    t = {k: jnp.asarray(v) for k, v in tensors.items()}
+    if derived is None:
+        derived = qops.derive_world(
+            t["nominal"], t["lend_limit"], t["borrow_limit"], t["usage"],
+            t["parent"], depth=statics["depth"])
+    out = pops.classical_targets(
+        t["slot_need"], t["slot_pri"], t["slot_ts"], t["slot_fr"],
+        t["slot_req"], t["wcq_policy"], t["reclaim_policy"],
+        t["bwc_forbidden"], t["bwc_threshold"], t["cq_has_parent"],
+        t["adm_cq"], t["adm_pri"], t["adm_ts"], t["adm_qrt"],
+        t["adm_uid"], t["adm_ev"], t["adm_usage"], derived["usage"],
+        derived["subtree_quota"], t["lend_limit"], t["borrow_limit"],
+        t["nominal"], t["ancestors"], t["height"], t["local_chain"],
+        t["root_nodes"], t["root_of_cq"], depth=statics["depth"],
+        v_cap=statics["v_cap"])
+    return [np.asarray(o) for o in out]
+
+
+class LocalExecutor:
+    """In-process execution (the default): the engine and the oracle
+    share one JAX runtime and jit cache."""
+
+    def cycle_step(self, tensors: dict, statics: dict):
+        return _run_cycle_step(tensors, statics)
+
+    def classical_targets(self, tensors: dict, statics: dict,
+                          derived=None):
+        return _run_classical_targets(tensors, statics, derived=derived)
+
+
+class RemoteExecutor:
+    """Client side of the serving boundary: one persistent connection,
+    reconnect-per-error, RemoteOracleError on transport failure."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            try:
+                self._sock = socket.create_connection(
+                    (self.host, self.port), timeout=self.timeout)
+            except OSError as e:
+                raise RemoteOracleError(str(e)) from e
+        return self._sock
+
+    def _call(self, op: str, tensors: dict, meta: dict):
+        with self._lock:
+            try:
+                sock = self._connect()
+                wire.send_msg(sock, wire.pack(op, tensors, meta))
+                body = wire.recv_msg(sock)
+            except (OSError, ConnectionError) as e:
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                raise RemoteOracleError(str(e)) from e
+        rop, out_tensors, out_meta = wire.unpack(body)
+        if rop == "error":
+            raise RemoteOracleError(out_meta.get("message", "remote error"))
+        n = out_meta["n"]
+        return [out_tensors[f"out{i}"] for i in range(n)]
+
+    def cycle_step(self, tensors: dict, statics: dict):
+        tensors = {k: np.asarray(v) for k, v in tensors.items()}
+        return self._call("cycle_step", tensors, statics)
+
+    def classical_targets(self, tensors: dict, statics: dict,
+                          derived=None):
+        # The service re-derives quota state server-side.
+        tensors = {k: np.asarray(v) for k, v in tensors.items()}
+        return self._call("classical_targets", tensors, statics)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
+class OracleServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._listener = socket.create_server((host, port))
+        self.address = self._listener.getsockname()
+
+    def serve_forever(self) -> None:
+        while True:
+            conn, _ = self._listener.accept()
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while True:
+                try:
+                    body = wire.recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                op, tensors, meta = wire.unpack(body)
+                try:
+                    if op == "ping":
+                        reply = wire.pack("pong", {}, {"n": 0})
+                    elif op == "cycle_step":
+                        outs = _run_cycle_step(tensors, meta)
+                        reply = wire.pack(
+                            "ok", {f"out{i}": o
+                                   for i, o in enumerate(outs)},
+                            {"n": len(outs)})
+                    elif op == "classical_targets":
+                        outs = _run_classical_targets(tensors, meta)
+                        reply = wire.pack(
+                            "ok", {f"out{i}": o
+                                   for i, o in enumerate(outs)},
+                            {"n": len(outs)})
+                    else:
+                        reply = wire.pack("error", {},
+                                          {"message": f"unknown op {op}"})
+                except Exception as e:  # noqa: BLE001 — report, keep serving
+                    reply = wire.pack("error", {}, {"message": repr(e)})
+                try:
+                    wire.send_msg(conn, reply)
+                except (ConnectionError, OSError):
+                    return
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="kueue_tpu oracle service")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=7461)
+    parser.add_argument("--platform", default=None,
+                        help="force a JAX platform (e.g. cpu)")
+    args = parser.parse_args(argv)
+    if args.platform:
+        import os
+        os.environ["JAX_PLATFORMS"] = args.platform
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    jax.config.update("jax_enable_x64", True)
+    server = OracleServer(args.host, args.port)
+    print(f"oracle service listening on {server.address[0]}:"
+          f"{server.address[1]}", flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

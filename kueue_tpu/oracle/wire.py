@@ -1,0 +1,80 @@
+"""Wire format for the oracle serving boundary: length-prefixed frames
+carrying a JSON header plus raw tensor bytes.
+
+This is the process-boundary form of the tensor schema
+(tensor/schema.py — "this schema is the system's real API"): the control
+plane ships dense snapshot tensors to a standalone oracle process and
+receives verdict tensors back (SURVEY §7: "decision core as a JAX/TPU
+service", reference apply semantics scheduler.go:856-910). gRPC is not
+available in this environment, so framing is a 4-byte big-endian length
+followed by:
+
+    [4B header_len][header JSON][tensor bytes...]
+
+The header carries op name, static kwargs, and per-tensor
+(name, dtype, shape, byte offset/length) entries; tensor payloads are
+C-contiguous numpy buffers concatenated in header order.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any
+
+import numpy as np
+
+_LEN = struct.Struct(">I")
+
+
+def pack(op: str, tensors: dict[str, np.ndarray],
+         meta: dict[str, Any]) -> bytes:
+    entries = []
+    blobs = []
+    offset = 0
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(np.asarray(arr))
+        b = arr.tobytes()
+        entries.append({"name": name, "dtype": str(arr.dtype),
+                        "shape": list(arr.shape), "off": offset,
+                        "len": len(b)})
+        blobs.append(b)
+        offset += len(b)
+    header = json.dumps({"op": op, "meta": meta,
+                         "tensors": entries}).encode("utf-8")
+    body = _LEN.pack(len(header)) + header + b"".join(blobs)
+    return _LEN.pack(len(body)) + body
+
+
+def unpack(body: bytes):
+    (hlen,) = _LEN.unpack_from(body, 0)
+    header = json.loads(body[4:4 + hlen].decode("utf-8"))
+    base = 4 + hlen
+    tensors = {}
+    for e in header["tensors"]:
+        buf = body[base + e["off"]:base + e["off"] + e["len"]]
+        tensors[e["name"]] = np.frombuffer(
+            buf, dtype=np.dtype(e["dtype"])).reshape(e["shape"]).copy()
+    return header["op"], tensors, header["meta"]
+
+
+def send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(payload)
+
+
+def recv_msg(sock: socket.socket) -> bytes:
+    head = _recv_exact(sock, 4)
+    (n,) = _LEN.unpack(head)
+    return _recv_exact(sock, n)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)

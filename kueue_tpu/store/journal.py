@@ -1,0 +1,195 @@
+"""Durable state + restart: the standalone analog of "the Kubernetes API
+is the durable store" (SURVEY.md §5 checkpoint/resume).
+
+The reference persists every state transition in object status via SSA
+patches (pkg/workload/patching) and rebuilds its caches from informers
+on restart; nothing else is checkpointed. Here the same contract is an
+append-only JSONL journal of applied objects:
+
+  * every engine object creation and every workload status transition
+    appends an ``apply`` record (the SSA-patch analog — last write per
+    key wins);
+  * ``rebuild_engine`` cold-starts an engine from the journal: objects
+    are re-created in order, then each workload's last persisted state
+    is restored through Engine.restore_workload — admitted workloads
+    re-assume their cache usage, pending ones re-enter the queues with
+    their requeue backoff intact (the informer-rebuild path,
+    e.g. scheduler.go:554-557 in-flight recovery note);
+  * ``compact`` rewrites the log to one record per live key.
+
+Crash consistency: records are flushed per append (fsync optional); a
+torn final line is ignored on replay, mirroring at-least-once status
+patching.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, Optional
+
+from kueue_tpu.api.serde import from_jsonable, to_jsonable
+
+
+class Journal:
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self.fsync = fsync
+        self._repair_torn_tail()
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def _repair_torn_tail(self) -> None:
+        """Truncate a torn final line (crash mid-write) so post-restart
+        appends start on a clean line — otherwise the first new record
+        would concatenate onto the fragment and everything after it
+        would be unreadable on the next replay."""
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb+") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            if size == 0:
+                return
+            # Scan backwards in growing windows until the last newline is
+            # found (a torn record can exceed any fixed window).
+            window = 1 << 20
+            tail = b""
+            while True:
+                start = max(0, size - window)
+                fh.seek(start)
+                chunk = fh.read(size - start)
+                last_nl = chunk.rfind(b"\n")
+                if last_nl >= 0 or start == 0:
+                    tail = chunk[last_nl + 1:]
+                    break
+                window *= 4
+            if not tail:
+                return
+            try:
+                json.loads(tail.decode("utf-8"))
+                fh.seek(0, os.SEEK_END)
+                fh.write(b"\n")  # complete record missing its newline
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                fh.truncate(size - len(tail))
+
+    def apply(self, kind: str, obj, ts: float = 0.0) -> None:
+        rec = {"op": "apply", "kind": kind, "ts": ts,
+               "obj": to_jsonable(obj)}
+        self._write(rec)
+
+    def delete(self, kind: str, key: str, ts: float = 0.0) -> None:
+        self._write({"op": "delete", "kind": kind, "key": key, "ts": ts})
+
+    def _write(self, rec: dict) -> None:
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def replay(self) -> Iterator[dict]:
+        """Yield records in append order; a torn trailing line (crash
+        mid-write) is skipped."""
+        with open(self.path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    return
+
+    def compact(self) -> None:
+        """Rewrite the log keeping only the last record per (kind, key),
+        in first-seen order (creation order is preserved for replay)."""
+        last: dict[tuple, dict] = {}
+        order: list[tuple] = []
+        for rec in self.replay():
+            key = (rec["kind"], _key_of(rec))
+            if key not in last:
+                order.append(key)
+            last[key] = rec
+        self._fh.close()
+        tmp = self.path + ".compact"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            for key in order:
+                rec = last[key]
+                if rec["op"] != "delete":
+                    fh.write(json.dumps(rec) + "\n")
+        os.replace(tmp, self.path)
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+
+def _key_of(rec: dict) -> str:
+    if rec["op"] == "delete":
+        return rec["key"]
+    obj = rec["obj"]
+    ns = obj.get("namespace")
+    name = obj.get("name", "")
+    return f"{ns}/{name}" if ns is not None else name
+
+
+_CREATE = {
+    "cohort": "create_cohort",
+    "resource_flavor": "create_resource_flavor",
+    "cluster_queue": "create_cluster_queue",
+    "local_queue": "create_local_queue",
+    "topology": "create_topology",
+    "node": "create_node",
+}
+
+
+def rebuild_engine(path: str, engine=None, attach_oracle: bool = False,
+                   **engine_kwargs):
+    """Cold-start an engine from a journal: the restart path. Returns
+    the rebuilt engine (its caches and queues reconstructed, clock
+    restored to the last persisted timestamp)."""
+    from kueue_tpu.controllers.engine import Engine
+
+    eng = engine if engine is not None else Engine(**engine_kwargs)
+    journal = Journal(path)
+    records = list(journal.replay())
+    # Last op wins per (kind, key): a later delete tombstones earlier
+    # applies (a node that failed must not resurrect on restart).
+    live: dict[tuple, bool] = {}
+    for rec in records:
+        live[(rec["kind"], _key_of(rec))] = rec["op"] != "delete"
+    workloads: dict[str, dict] = {}
+    wl_order: list[str] = []
+    clock = 0.0
+    for rec in records:
+        clock = max(clock, rec.get("ts", 0.0))
+        kind = rec["kind"]
+        key = _key_of(rec)
+        if rec["op"] == "delete" or not live[(kind, key)]:
+            continue
+        if kind == "workload":
+            if key not in workloads:
+                wl_order.append(key)
+            workloads[key] = rec["obj"]
+            continue
+        if kind == "workload_priority_class":
+            eng.create_workload_priority_class(rec["obj"]["name"],
+                                               rec["obj"]["value"])
+            continue
+        method = _CREATE.get(kind)
+        if method is not None:
+            getattr(eng, method)(from_jsonable(rec["obj"]))
+    eng.clock = clock
+    for key in wl_order:
+        eng.restore_workload(from_jsonable(workloads[key]))
+    if attach_oracle:
+        eng.attach_oracle()
+    eng.attach_journal(journal, record_existing=False)
+    return eng
+
+
+def attach_new_journal(engine, path: str, fsync: bool = False) -> Journal:
+    """Start journaling a live engine, snapshotting its current state
+    first (so a journal can be introduced after boot)."""
+    journal = Journal(path, fsync=fsync)
+    engine.attach_journal(journal, record_existing=True)
+    return journal

@@ -123,6 +123,30 @@ class WorkloadTensors:
     hash_id: np.ndarray = None  # int32[W]
 
 
+def pow2_bucket(n: int, floor: int) -> int:
+    """Power-of-two bucket for a dynamic axis length: repeated launches
+    with drifting sizes reuse one compiled program per bucket."""
+    return max(floor, 1 << (max(n, 1) - 1).bit_length())
+
+
+def pad_axis0(arr: np.ndarray, target: int, fill) -> np.ndarray:
+    """Pad axis 0 to ``target`` rows with a sentinel fill. The sentinel
+    must match the kernel's masking semantics (e.g. cq=-1 rows never
+    classify, rank=BIG rows never win heads)."""
+    a = np.asarray(arr)
+    if a.shape[0] >= target:
+        return a
+    return np.concatenate(
+        [a, np.full((target - a.shape[0],) + a.shape[1:], fill, a.dtype)])
+
+
+# Workload-axis sentinel fills shared by every bucket-padding site:
+# rank/commit_rank BIG (never a head), cq 0 with pending=False.
+WL_PAD_FILLS = dict(rank=np.int64(1) << 40, commit_rank=np.int64(1) << 40,
+                    wl_cq=0, wl_req=0, wl_priority=0, wl_has_qr=False,
+                    wl_hash=0, wl_ts=0.0)
+
+
 def build_root_grouping(parent: np.ndarray, ancestors: np.ndarray,
                         num_cqs: int, max_depth: int):
     """Group the cohort forest by root subtree for the parallel commit
