@@ -38,6 +38,15 @@ def _auto_register() -> None:
     _REGISTRY["Node"] = Node
     _REGISTRY["TopologyAssignment"] = TopologyAssignment
     _REGISTRY["TopologyDomainAssignment"] = TopologyDomainAssignment
+    # Workload-reachable types living outside api.types: admission check
+    # states/updates (status.admission_check_*) and pod templates
+    # (PodSet.template) must round-trip through the journal too.
+    from kueue_tpu.controllers.admissionchecks import CheckState, PodSetUpdate
+    _REGISTRY["CheckState"] = CheckState
+    _REGISTRY["PodSetUpdate"] = PodSetUpdate
+    from kueue_tpu.utils.podtemplate import ContainerSpec, PodTemplate
+    _REGISTRY["ContainerSpec"] = ContainerSpec
+    _REGISTRY["PodTemplate"] = PodTemplate
 
 
 def register(cls: type) -> type:

@@ -303,7 +303,13 @@ class PodSetTopologyRequest:
 @dataclass
 class PodSet:
     """Reference: workload_types.go:556 (PodSet). ``requests`` are per-pod
-    milli-quantities; total request = requests * count."""
+    milli-quantities; total request = requests * count.
+
+    When ``template`` is set (a utils.podtemplate.PodTemplate carrying the
+    container-level resource stanzas), ``requests`` is derived from it by
+    the effective-requests pipeline (overhead + LimitRange defaults +
+    limits-as-missing-requests + the pod-requests aggregation;
+    pkg/workload/resources.go:141 AdjustResources) at submit time."""
 
     name: str
     count: int
@@ -312,6 +318,7 @@ class PodSet:
     topology_request: Optional[PodSetTopologyRequest] = None
     node_selector: dict[str, str] = field(default_factory=dict)
     tolerations: tuple[Toleration, ...] = ()
+    template: Optional[object] = None  # utils.podtemplate.PodTemplate
 
 
 class WorkloadConditionType(str, Enum):
@@ -359,6 +366,13 @@ class WorkloadStatus:
     requeue_count: int = 0
     requeue_at: Optional[float] = None
     admission_check_states: dict[str, str] = field(default_factory=dict)
+    # Additive per-PodSet modifications suggested by admission checks
+    # (workload_types.go:845 PodSetUpdate), merged into the job's pod
+    # sets at start; check name -> tuple of PodSetUpdate.
+    admission_check_updates: dict[str, tuple] = field(default_factory=dict)
+    # Backoff the next requeue should honor when a check flips to Retry
+    # (UpdateAdmissionCheckRequeueState, provisioning/controller.go:576).
+    check_retry_after_seconds: float = 0.0
     # TAS node replacement (workload_types.go:766): names of failed nodes
     # whose domains need re-placement (tas/node_controller.go).
     unhealthy_nodes: tuple[str, ...] = ()

@@ -244,6 +244,9 @@ class QueueManager:
         # AFS hook: lq key -> decayed usage (manager.go:68).
         self.lq_usage_fn = None
         self.second_pass = SecondPassQueue()
+        # workload_info.InfoOptions (resource transformations / excluded
+        # prefixes), set by the engine (workload.go:139 plumbing).
+        self.info_options = None
 
     def add_cluster_queue(self, cq: ClusterQueue) -> None:
         self.cluster_queues[cq.name] = PendingClusterQueue(cq, manager=self)
@@ -268,7 +271,8 @@ class QueueManager:
         cq_name = self.cluster_queue_for_workload(wl)
         if cq_name is None or cq_name not in self.cluster_queues:
             return None
-        info = WorkloadInfo.from_workload(wl, cq_name)
+        info = WorkloadInfo.from_workload(wl, cq_name,
+                                          options=self.info_options)
         self.cluster_queues[cq_name].push_or_update(info)
         return info
 

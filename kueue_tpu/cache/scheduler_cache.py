@@ -31,6 +31,8 @@ class Cache:
         self.nodes: dict[str, object] = {}  # tas.Node
         # key -> admitted/assumed WorkloadInfo
         self.workloads: dict[str, WorkloadInfo] = {}
+        # workload_info.InfoOptions, set by the engine.
+        self.info_options = None
 
     # -- object lifecycle --
 
@@ -70,7 +72,8 @@ class Cache:
         if wl.status.admission is None:
             return False
         info = WorkloadInfo.from_workload(wl,
-                                          wl.status.admission.cluster_queue)
+                                          wl.status.admission.cluster_queue,
+                                          options=self.info_options)
         if info.cluster_queue not in self.cluster_queues:
             return False
         self.workloads[wl.key] = info

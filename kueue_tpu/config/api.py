@@ -38,6 +38,25 @@ class AdmissionFairSharingConfig:
 
 
 @dataclass
+class ResourceTransformationSpec:
+    """configuration_types.go:560 (ResourceTransformation)."""
+
+    input: str = ""
+    strategy: str = "Retain"  # Retain | Replace
+    outputs: dict[str, float] = field(default_factory=dict)
+    multiply_by: str = ""
+
+
+@dataclass
+class ResourcesConfig:
+    """configuration_types.go:540 (Resources): resources excluded from
+    quota accounting and input->output transformations."""
+
+    exclude_resource_prefixes: tuple[str, ...] = ()
+    transformations: tuple[ResourceTransformationSpec, ...] = ()
+
+
+@dataclass
 class MultiKueueConfigSpec:
     gc_interval_seconds: int = 60
     origin: str = "multikueue"
@@ -60,9 +79,21 @@ class Configuration:
     multikueue: MultiKueueConfigSpec = field(
         default_factory=MultiKueueConfigSpec)
     feature_gates: dict[str, bool] = field(default_factory=dict)
+    resources: ResourcesConfig = field(default_factory=ResourcesConfig)
     # oracle: the batched TPU decision path configuration
     oracle_enabled: bool = True
     oracle_max_depth: int = 4
+
+    def info_options(self):
+        """Build workload_info.InfoOptions from the resources section."""
+        from kueue_tpu.workload_info import InfoOptions, ResourceTransformation
+
+        return InfoOptions.from_transform_list(
+            [ResourceTransformation(input=t.input, outputs=dict(t.outputs),
+                                    strategy=t.strategy,
+                                    multiply_by=t.multiply_by)
+             for t in self.resources.transformations],
+            excluded=self.resources.exclude_resource_prefixes)
 
     def validate(self) -> list[str]:
         """pkg/config/validation.go."""
@@ -75,6 +106,16 @@ class Configuration:
             if s not in ("LessThanOrEqualToFinalShare",
                          "LessThanInitialShare"):
                 errs.append(f"unknown preemption strategy {s}")
+        # pkg/config/validation.go:455 validateResourceTransformations.
+        seen_inputs = set()
+        for t in self.resources.transformations:
+            if not t.input:
+                errs.append("resource transformation needs an input")
+            if t.input in seen_inputs:
+                errs.append(f"duplicate transformation input {t.input}")
+            seen_inputs.add(t.input)
+            if t.strategy not in ("Retain", "Replace"):
+                errs.append(f"unknown transformation strategy {t.strategy}")
         if self.oracle_max_depth < 1:
             errs.append("oracleMaxDepth must be >= 1")
         return errs
@@ -124,6 +165,18 @@ def from_dict(raw: dict) -> Configuration:
         preemption_strategies=tuple(fs.get(
             "preemptionStrategies",
             FairSharingConfig().preemption_strategies)))
+    res = raw.get("resources") or {}
+    cfg.resources = ResourcesConfig(
+        exclude_resource_prefixes=tuple(
+            res.get("excludeResourcePrefixes", ())),
+        transformations=tuple(
+            ResourceTransformationSpec(
+                input=t.get("input", ""),
+                strategy=t.get("strategy", "Retain"),
+                outputs={k: float(v)
+                         for k, v in (t.get("outputs") or {}).items()},
+                multiply_by=t.get("multiplyBy", ""))
+            for t in res.get("transformations", ())))
     cfg.feature_gates = dict(raw.get("featureGates", {}))
     cfg.oracle_enabled = raw.get("oracle", {}).get("enable", True)
     cfg.oracle_max_depth = raw.get("oracle", {}).get("maxDepth", 4)

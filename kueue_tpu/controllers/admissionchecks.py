@@ -37,6 +37,59 @@ class AdmissionCheck:
     retry_delay_seconds: int = 60
 
 
+@dataclass(frozen=True)
+class PodSetUpdate:
+    """Additive per-PodSet modifications an admission check suggests
+    (workload_types.go:845 PodSetUpdate): merged into the job's pod sets
+    when it starts; conflicting keys across checks fail admission."""
+
+    name: str
+    labels: tuple = ()  # ((key, value), ...) — hashable
+    annotations: tuple = ()
+    node_selector: tuple = ()
+    tolerations: tuple = ()
+
+    @classmethod
+    def make(cls, name, labels=None, annotations=None, node_selector=None,
+             tolerations=()) -> "PodSetUpdate":
+        return cls(name=name,
+                   labels=tuple(sorted((labels or {}).items())),
+                   annotations=tuple(sorted((annotations or {}).items())),
+                   node_selector=tuple(sorted((node_selector or {}).items())),
+                   tolerations=tuple(tolerations))
+
+
+@dataclass
+class ProvisioningRequestRetryStrategy:
+    """provisioningrequestconfig_types.go:127: retry backoff is
+    min(base * 2^(attempt-1), max), capped at backoff_limit_count
+    attempts before the check rejects."""
+
+    backoff_limit_count: int = 3
+    backoff_base_seconds: int = 60
+    backoff_max_seconds: int = 1800
+
+    def delay(self, attempt: int) -> float:
+        return min(self.backoff_base_seconds * (2 ** max(attempt - 1, 0)),
+                   self.backoff_max_seconds)
+
+
+@dataclass
+class ProvisioningRequestConfig:
+    """provisioningrequestconfig_types.go:35: how the check controller
+    shapes ProvisioningRequests and what it injects back.
+    ``pod_set_update_node_selectors`` maps a node-selector key to the
+    ProvisioningClassDetails detail it reads the value from
+    (controller.go:652 podSetUpdates)."""
+
+    name: str = "default"
+    provisioning_class_name: str = "queued-provisioning.gke.io"
+    pod_set_update_node_selectors: dict[str, str] = field(
+        default_factory=dict)
+    retry_strategy: ProvisioningRequestRetryStrategy = field(
+        default_factory=ProvisioningRequestRetryStrategy)
+
+
 class AdmissionCheckManager:
     """Holds check definitions and per-workload states; drives the
     admit-when-all-ready rule for the engine."""
@@ -90,23 +143,54 @@ class ProvisioningRequest:
     provisioned: bool = False
     failed: bool = False
     attempts: int = 1
+    # What the autoscaler reports about the provisioned capacity
+    # (autoscaling ProvisioningRequest.Status.ProvisioningClassDetails),
+    # the source of injected node-selector values.
+    provisioning_class_details: dict[str, str] = field(default_factory=dict)
 
 
 class ProvisioningController:
     """admissionchecks/provisioning: creates a ProvisioningRequest per
     quota-reserved workload carrying this check, then mirrors the
-    request's outcome into the check state."""
+    request's outcome into the check state; on success it attaches
+    PodSetUpdates (provisioning annotations + node selectors resolved
+    from the request's ProvisioningClassDetails, controller.go:652)."""
 
-    def __init__(self, engine, check_name: str, max_retries: int = 3):
+    def __init__(self, engine, check_name: str, max_retries: int = None,
+                 config: ProvisioningRequestConfig = None):
         self.engine = engine
         self.check_name = check_name
-        self.max_retries = max_retries
+        self.config = config or ProvisioningRequestConfig()
+        if max_retries is not None:
+            self.config.retry_strategy.backoff_limit_count = max_retries
         self.requests: dict[str, ProvisioningRequest] = {}
+
+    def _pod_set_updates(self, wl: Workload,
+                         req: ProvisioningRequest) -> tuple:
+        """controller.go:652 podSetUpdates: every PodSet gets the
+        provisioning-request annotations; node selectors are looked up in
+        the request's ProvisioningClassDetails (missing details are
+        skipped, not errors)."""
+        annotations = {
+            "autoscaling.x-k8s.io/provisioning-request": req.name,
+            "autoscaling.x-k8s.io/provisioning-class":
+                self.config.provisioning_class_name,
+        }
+        selector = {}
+        for key, detail in self.config.pod_set_update_node_selectors.items():
+            value = req.provisioning_class_details.get(detail)
+            if value is not None:
+                selector[key] = value
+        return tuple(
+            PodSetUpdate.make(ps.name, annotations=annotations,
+                              node_selector=selector)
+            for ps in wl.pod_sets)
 
     def reconcile(self) -> None:
         """provisioning/controller.go:123 (Reconcile over workloads)."""
         acm = self.engine.admission_checks
-        for wl in self.engine.workloads.values():
+        retry = self.config.retry_strategy
+        for wl in list(self.engine.workloads.values()):
             if wl.is_finished or not wl.has_quota_reservation:
                 continue
             cq = (wl.status.admission.cluster_queue
@@ -123,22 +207,31 @@ class ProvisioningController:
                     check_name=self.check_name)
                 self.requests[wl.key] = req
             if req.provisioned:
+                wl.status.admission_check_updates[self.check_name] = \
+                    self._pod_set_updates(wl, req)
                 acm.set_state(wl.key, self.check_name, CheckState.READY)
             elif req.failed:
-                if req.attempts >= self.max_retries:
+                if req.attempts > retry.backoff_limit_count:
                     acm.set_state(wl.key, self.check_name,
                                   CheckState.REJECTED)
                 else:
+                    # UpdateAdmissionCheckRequeueState
+                    # (controller.go:576): exponential backoff before the
+                    # next attempt.
+                    wl.status.check_retry_after_seconds = retry.delay(
+                        req.attempts)
                     req.attempts += 1
                     req.failed = False
                     acm.set_state(wl.key, self.check_name, CheckState.RETRY)
 
     # -- the "cluster autoscaler" side, driven by tests/mimics --
 
-    def mark_provisioned(self, wl_key: str) -> None:
+    def mark_provisioned(self, wl_key: str, details=None) -> None:
         req = self.requests.get(wl_key)
         if req is not None:
             req.provisioned = True
+            if details:
+                req.provisioning_class_details.update(details)
         self.reconcile()
 
     def mark_failed(self, wl_key: str) -> None:

@@ -96,6 +96,22 @@ class Engine:
         # Durable store (store/journal.py) — the "K8s API as durable
         # store" analog; attach via attach_journal().
         self.journal = None
+        # Effective-requests pipeline inputs (pkg/workload/resources.go):
+        # namespaced LimitRanges, RuntimeClass overheads, namespace labels
+        # for CQ namespace-selector admissibility, and the Info options
+        # (excluded resource prefixes + transformations) from config.
+        self.limit_ranges: dict[str, object] = {}
+        self.runtime_class_overheads: dict[str, dict[str, int]] = {}
+        self.namespace_labels: dict[str, dict[str, str]] = {}
+        self.info_options = None
+
+    def set_info_options(self, options) -> None:
+        """Propagate workload_info.InfoOptions to every Info construction
+        site (queue manager + scheduler cache), the reference's
+        InfoOptions plumbing (workload.go:139)."""
+        self.info_options = options
+        self.queues.info_options = options
+        self.cache.info_options = options
 
     # -- durability (store/journal.py) --
 
@@ -142,7 +158,8 @@ class Engine:
                 # Pending node replacement: re-arm the second pass
                 # (mark_node_unhealthy had queued it pre-restart).
                 info = WorkloadInfo.from_workload(
-                    wl, wl.status.admission.cluster_queue)
+                    wl, wl.status.admission.cluster_queue,
+                    options=self.info_options)
                 self.queues.second_pass.prequeue(wl.key)
                 self.queues.second_pass.queue(info, now=self.clock)
         elif wl.active:
@@ -203,7 +220,8 @@ class Engine:
                 wl.status.unhealthy_nodes = \
                     wl.status.unhealthy_nodes + (name,)
                 info = WorkloadInfo.from_workload(
-                    wl, wl.status.admission.cluster_queue)
+                    wl, wl.status.admission.cluster_queue,
+                    options=self.info_options)
                 self.queues.second_pass.prequeue(wl.key)
                 self.queues.second_pass.queue(info, now=self.clock)
                 self._event("NodeUnhealthy", wl.key,
@@ -285,9 +303,45 @@ class Engine:
         self._journal_obj("workload_priority_class",
                           {"name": name, "value": value})
 
+    def create_limit_range(self, lr) -> None:
+        """Register a namespaced LimitRange (utils/limitrange.py)."""
+        self.limit_ranges[f"{lr.namespace}/{lr.name}"] = lr
+
+    def create_runtime_class(self, name: str,
+                             overhead: dict[str, int]) -> None:
+        """RuntimeClass pod overhead source (resources.go:59)."""
+        self.runtime_class_overheads[name] = dict(overhead)
+
+    def set_namespace_labels(self, namespace: str,
+                             labels: dict[str, str]) -> None:
+        self.namespace_labels[namespace] = dict(labels)
+
     def submit(self, wl: Workload) -> bool:
         if not wl.creation_time:
             wl.creation_time = self.clock
+        # Effective requests: overhead + LimitRange defaults +
+        # limits-as-missing-requests (resources.go:141 AdjustResources),
+        # then admissibility validation — inadmissible workloads are
+        # registered inactive with an explanatory event rather than
+        # queued (workload_controller.go admission checks).
+        from kueue_tpu import workload_info as wi
+
+        wi.adjust_resources(wl, list(self.limit_ranges.values()),
+                            self.runtime_class_overheads)
+        cq_name = self.queues.cluster_queue_for_workload(wl)
+        cq = self.cache.cluster_queues.get(cq_name) if cq_name else None
+        err = wi.validate_admissibility(
+            wl, list(self.limit_ranges.values()),
+            namespace_labels=self.namespace_labels.get(wl.namespace),
+            cq_namespace_selector=getattr(cq, "namespace_selector", None))
+        if err is not None:
+            # Deactivate so a journal restart can't resurrect it into the
+            # queues (restore_workload requeues active pending workloads).
+            wl.active = False
+            self.workloads[wl.key] = wl
+            self._event("Inadmissible", wl.key, detail=err)
+            self._journal_obj("workload", wl)
+            return False
         # Resolve priorityClassRef (pkg/util/priority).
         if (wl.priority_class_name
                 and wl.priority_class_name in self.workload_priority_classes):
@@ -522,7 +576,12 @@ class Engine:
             self.evict(wl, "AdmissionCheckRejected", requeue=False)
             return
         if any(states.get(c) == CheckState.RETRY for c in required):
-            self.evict(wl, "AdmissionCheckRetry")
+            # Honor the check's requeue backoff
+            # (UpdateAdmissionCheckRequeueState, provisioning
+            # controller.go:576): the next attempt waits out the delay.
+            backoff = wl.status.check_retry_after_seconds
+            wl.status.check_retry_after_seconds = 0.0
+            self.evict(wl, "AdmissionCheckRetry", backoff_seconds=backoff)
             for c in required:
                 if states.get(c) == CheckState.RETRY:
                     states[c] = CheckState.PENDING
@@ -542,6 +601,7 @@ class Engine:
                          reason=reason, now=self.clock)
         wl.status.admission = None
         wl.status.admission_check_states = {}
+        wl.status.admission_check_updates = {}
         self.cache.delete_workload(wl.key)
         self.registry.counter("evicted_workloads_total").inc(
             (cq_name, reason))

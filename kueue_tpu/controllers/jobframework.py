@@ -30,11 +30,31 @@ from kueue_tpu.api.types import (
 @dataclass
 class PodSetInfo:
     """Injected per-PodSet scheduling directives (podset.PodSetInfo):
-    node selectors from the assigned flavor + count from admission."""
+    node selectors from the assigned flavor + count from admission, plus
+    labels/annotations/tolerations merged from admission-check
+    PodSetUpdates (podset.FromUpdate + Merge, pkg/podset/podset.go)."""
 
     name: str
     count: int
     node_selector: dict[str, str] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    tolerations: tuple = ()
+
+    def merge_update(self, update) -> None:
+        """podset.Merge: additive only — an already-present key with a
+        different value is a conflict (the reference fails admission)."""
+        for attr, pairs in (("node_selector", update.node_selector),
+                            ("labels", update.labels),
+                            ("annotations", update.annotations)):
+            dst = getattr(self, attr)
+            for k, v in pairs:
+                if k in dst and dst[k] != v:
+                    raise ValueError(
+                        f"conflict for {attr} key {k} in pod set "
+                        f"{self.name}")
+                dst[k] = v
+        self.tolerations = self.tolerations + tuple(update.tolerations)
 
 
 @runtime_checkable
@@ -328,7 +348,10 @@ class JobReconciler:
 
     def _start_job(self, job: GenericJob, wl: Workload) -> None:
         """startJob -> RunWithPodSetsInfo (reconciler.go admitted path):
-        inject node selectors of the assigned flavors + admitted counts."""
+        inject node selectors of the assigned flavors + admitted counts,
+        then merge each admission check's PodSetUpdates
+        (reconciler.go:1606-1615). A conflicting update fails the start
+        and evicts the workload, as the reference's admission error does."""
         infos = []
         flavors = self.engine.cache.resource_flavors
         for psa in wl.status.admission.pod_set_assignments:
@@ -339,6 +362,19 @@ class JobReconciler:
                     selector.update(rf.node_labels)
             infos.append(PodSetInfo(name=psa.name, count=psa.count,
                                     node_selector=selector))
+        try:
+            for check_name, updates in sorted(
+                    wl.status.admission_check_updates.items()):
+                for update in updates:
+                    for info in infos:
+                        if info.name == update.name:
+                            info.merge_update(update)
+                            break
+        except ValueError as exc:
+            self.engine._event("PodSetUpdateConflict", wl.key,
+                               detail=str(exc))
+            self.engine.evict(wl, "PodSetUpdateConflict", requeue=False)
+            return
         job.run_with_pod_sets_info(infos)
 
     def _on_admit(self, wl: Workload, admission) -> None:

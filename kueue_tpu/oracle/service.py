@@ -172,8 +172,8 @@ class OracleServer:
                     body = wire.recv_msg(conn)
                 except (ConnectionError, OSError):
                     return
-                op, tensors, meta = wire.unpack(body)
                 try:
+                    op, tensors, meta = wire.unpack(body)
                     if op == "ping":
                         reply = wire.pack("pong", {}, {"n": 0})
                     elif op == "cycle_step":

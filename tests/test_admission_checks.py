@@ -103,14 +103,17 @@ def test_provisioning_controller_flow():
 
 
 def test_provisioning_failure_retries_then_rejects():
+    # backoff_limit_count=1: one retry allowed (attempt <= limit,
+    # provisioning/controller.go:568), the second failure rejects.
     eng, acm = make_stack()
-    prov = ProvisioningController(eng, "prov", max_retries=2)
+    prov = ProvisioningController(eng, "prov", max_retries=1)
     wl = submit(eng, "w")
     eng.schedule_once()
     prov.reconcile()
     prov.mark_failed(wl.key)
-    assert wl.is_evicted  # retry -> evicted + requeued
-    eng.schedule_once()  # re-reserves quota
+    assert wl.is_evicted  # retry -> evicted + requeued with backoff
+    eng.tick((wl.status.requeue_at or eng.clock) - eng.clock + 1)
+    eng.schedule_once()  # re-reserves quota after the backoff
     assert wl.has_quota_reservation
     prov.reconcile()
     prov.mark_failed(wl.key)
@@ -152,3 +155,92 @@ def test_maximum_execution_time():
     eng.tick(11.0)
     assert wl.is_evicted
     assert not wl.active
+
+
+def test_provisioning_pod_set_updates_flow_into_started_job():
+    """controller.go:652 podSetUpdates -> reconciler.go:1606: provisioned
+    node selectors and annotations reach the started job's pod sets."""
+    from kueue_tpu.controllers.admissionchecks import (
+        ProvisioningRequestConfig,
+    )
+    from kueue_tpu.controllers.jobframework import BatchJob, JobReconciler
+
+    eng, acm = make_stack()
+    prc = ProvisioningRequestConfig(
+        pod_set_update_node_selectors={
+            "cloud.example.com/node-group": "node-group-name"})
+    prov = ProvisioningController(eng, "prov", config=prc)
+    rec = JobReconciler(eng)
+    job = BatchJob(name="j", queue_name="lq", parallelism=2,
+                   requests={CPU: 500})
+    rec.create_job(job)
+    eng.schedule_once()
+    prov.reconcile()
+    wl_key = rec.job_to_workload[job.key]
+    prov.mark_provisioned(wl_key,
+                          details={"node-group-name": "tpu-pool-7"})
+    rec.reconcile_all()
+    assert not job.is_suspended()
+    info = job.injected_info[0]
+    assert info.node_selector["cloud.example.com/node-group"] == "tpu-pool-7"
+    assert info.annotations[
+        "autoscaling.x-k8s.io/provisioning-request"].startswith("prov-")
+
+
+def test_provisioning_retry_backoff_curve():
+    """Retry waits min(base * 2^(attempt-1), max) before the requeue
+    (provisioningrequestconfig_types.go:127)."""
+    from kueue_tpu.controllers.admissionchecks import (
+        ProvisioningRequestConfig,
+        ProvisioningRequestRetryStrategy,
+    )
+
+    eng, acm = make_stack()
+    prc = ProvisioningRequestConfig(
+        retry_strategy=ProvisioningRequestRetryStrategy(
+            backoff_limit_count=3, backoff_base_seconds=10,
+            backoff_max_seconds=25))
+    prov = ProvisioningController(eng, "prov", config=prc)
+    wl = submit(eng, "w")
+    eng.schedule_once()
+    prov.reconcile()
+
+    delays = []
+    for _ in range(3):
+        prov.mark_failed(wl.key)
+        delays.append(wl.status.requeue_at - eng.clock
+                      if wl.status.requeue_at else 0.0)
+        # Wait out the backoff, reschedule, reprovision.
+        eng.tick((wl.status.requeue_at or eng.clock) - eng.clock + 1)
+        eng.schedule_once()
+        prov.reconcile()
+    assert delays == [10.0, 20.0, 25.0]  # capped at max
+    # Fourth failure exhausts the limit: rejected + deactivated.
+    prov.mark_failed(wl.key)
+    assert wl.status.admission_check_states.get("prov") \
+        == CheckState.REJECTED or not wl.active
+
+
+def test_pod_set_update_conflict_fails_start():
+    """Two checks injecting the same node-selector key with different
+    values is a merge conflict: the job must not start."""
+    from kueue_tpu.controllers.admissionchecks import PodSetUpdate
+    from kueue_tpu.controllers.jobframework import BatchJob, JobReconciler
+
+    eng, acm = make_stack(checks=("a", "b"))
+    rec = JobReconciler(eng)
+    job = BatchJob(name="j", queue_name="lq", parallelism=1,
+                   requests={CPU: 100})
+    rec.create_job(job)
+    eng.schedule_once()
+    wl_key = rec.job_to_workload[job.key]
+    wl = eng.workloads[wl_key]
+    wl.status.admission_check_updates["a"] = (
+        PodSetUpdate.make("main", node_selector={"zone": "us-1"}),)
+    wl.status.admission_check_updates["b"] = (
+        PodSetUpdate.make("main", node_selector={"zone": "us-2"}),)
+    acm.set_state(wl_key, "a", CheckState.READY)
+    acm.set_state(wl_key, "b", CheckState.READY)
+    rec.reconcile_all()
+    assert job.is_suspended()
+    assert any(e.kind == "PodSetUpdateConflict" for e in eng.events)

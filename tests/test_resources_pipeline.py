@@ -1,0 +1,220 @@
+"""Effective-requests pipeline tests: pod-requests aggregation, LimitRange
+defaulting/validation, limits-as-missing-requests, pod overhead, resource
+transformations, excluded prefixes — mirroring the reference's
+pkg/workload/resources.go + pkg/util/limitrange semantics."""
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.utils.limitrange import (
+    LIMIT_TYPE_CONTAINER,
+    LIMIT_TYPE_POD,
+    LimitRange,
+    LimitRangeItem,
+    summarize,
+    validate_template,
+)
+from kueue_tpu.utils.podtemplate import (
+    ContainerSpec,
+    PodTemplate,
+    pod_requests,
+    use_limits_as_missing_requests,
+)
+from kueue_tpu.workload_info import (
+    InfoOptions,
+    ResourceTransformation,
+    WorkloadInfo,
+    adjust_resources,
+    apply_resource_transformations,
+    validate_admissibility,
+)
+
+
+def test_pod_requests_max_of_init_and_app_containers():
+    # Init containers run sequentially before the app containers: the pod
+    # request is max(sum(app), running-max over inits).
+    t = PodTemplate(
+        containers=[ContainerSpec("a", {"cpu": 300}),
+                    ContainerSpec("b", {"cpu": 200})],
+        init_containers=[ContainerSpec("init", {"cpu": 900})],
+    )
+    assert pod_requests(t) == {"cpu": 900}
+    t.init_containers[0].requests["cpu"] = 100
+    assert pod_requests(t) == {"cpu": 500}
+
+
+def test_pod_requests_sidecar_init_containers_add():
+    # restartPolicy=Always init containers (sidecars) run for the pod's
+    # lifetime: their requests add to the app containers'.
+    t = PodTemplate(
+        containers=[ContainerSpec("app", {"cpu": 400})],
+        init_containers=[
+            ContainerSpec("side", {"cpu": 100}, restart_always=True),
+            ContainerSpec("init", {"cpu": 450}),
+        ],
+    )
+    # init phase needs sidecar(100) + init(450) = 550 > app 400+100.
+    assert pod_requests(t) == {"cpu": 550}
+
+
+def test_pod_requests_overhead_and_pod_level_override():
+    t = PodTemplate(
+        containers=[ContainerSpec("app", {"cpu": 400, "mem": 100})],
+        overhead={"cpu": 50},
+        pod_requests={"cpu": 1000},
+    )
+    # Pod-level resources override the aggregation; overhead still adds.
+    assert pod_requests(t) == {"cpu": 1050, "mem": 100}
+
+
+def test_limits_as_missing_requests():
+    t = PodTemplate(containers=[
+        ContainerSpec("app", requests={"cpu": 100}, limits={"cpu": 200, "mem": 64})])
+    use_limits_as_missing_requests(t)
+    # cpu request kept, mem request promoted from limit.
+    assert t.containers[0].requests == {"cpu": 100, "mem": 64}
+
+
+def test_limitrange_summarize_keeps_tightest_bounds():
+    s = summarize([
+        LimitRange("a", limits=(LimitRangeItem(
+            LIMIT_TYPE_CONTAINER, max={"cpu": 800}, min={"cpu": 100},
+            default={"cpu": 500}, default_request={"cpu": 250}),)),
+        LimitRange("b", limits=(LimitRangeItem(
+            LIMIT_TYPE_CONTAINER, max={"cpu": 600}, min={"cpu": 200},
+            default={"cpu": 300}, default_request={"cpu": 150}),)),
+    ])
+    item = s[LIMIT_TYPE_CONTAINER]
+    assert item.max == {"cpu": 600}  # lowest max
+    assert item.min == {"cpu": 200}  # highest min
+    assert item.default == {"cpu": 500}  # first seen
+    assert item.default_request == {"cpu": 250}
+
+
+def test_limitrange_validation_bounds():
+    s = summarize([LimitRange("a", limits=(
+        LimitRangeItem(LIMIT_TYPE_CONTAINER, max={"cpu": 500},
+                       min={"cpu": 100}),
+        LimitRangeItem(LIMIT_TYPE_POD, max={"cpu": 800})))])
+    ok = PodTemplate(containers=[ContainerSpec("a", {"cpu": 300})])
+    assert validate_template(ok, s) == []
+    too_big = PodTemplate(containers=[ContainerSpec("a", {"cpu": 600})])
+    assert any("above" in e for e in validate_template(too_big, s))
+    too_small = PodTemplate(containers=[ContainerSpec("a", {"cpu": 50})])
+    assert any("below" in e for e in validate_template(too_small, s))
+    pod_over = PodTemplate(containers=[ContainerSpec("a", {"cpu": 450}),
+                                       ContainerSpec("b", {"cpu": 450})])
+    assert any("pod" in e for e in validate_template(pod_over, s))
+
+
+def test_resource_transformations_replace_and_retain():
+    transforms = {
+        "example.com/mig-1g": ResourceTransformation(
+            input="example.com/mig-1g",
+            outputs={"example.com/gpu-mem": 5.0},
+            strategy="Replace"),
+        "example.com/accel": ResourceTransformation(
+            input="example.com/accel", outputs={"example.com/units": 2.0},
+            strategy="Retain"),
+    }
+    out = apply_resource_transformations(
+        {"example.com/mig-1g": 4, "example.com/accel": 3, "cpu": 100},
+        transforms)
+    assert out == {"example.com/gpu-mem": 20, "example.com/accel": 3,
+                   "example.com/units": 6, "cpu": 100}
+
+
+def test_info_options_flow_into_usage():
+    wl = Workload(name="w", pod_sets=(PodSet(
+        "main", 2, {"cpu": 100, "internal.io/scratch": 7,
+                    "example.com/mig": 2}),))
+    opts = InfoOptions.from_transform_list(
+        [ResourceTransformation(input="example.com/mig",
+                                outputs={"gpu-mem": 3.0},
+                                strategy="Replace")],
+        excluded=("internal.io/",))
+    info = WorkloadInfo.from_workload(wl, "cq", options=opts)
+    reqs = info.total_requests[0].requests
+    assert reqs == {"cpu": 200, "gpu-mem": 12}
+
+
+def test_adjust_resources_full_pipeline():
+    # LimitRange default-request fills a missing cpu request, runtime
+    # class adds overhead, and PodSet.requests is recomputed.
+    wl = Workload(name="w", pod_sets=(PodSet(
+        "main", 1, template=PodTemplate(
+            containers=[ContainerSpec("app", limits={"cpu": 700})],
+            runtime_class_name="gvisor")),))
+    lr = LimitRange("defaults", limits=(LimitRangeItem(
+        LIMIT_TYPE_CONTAINER, default_request={"cpu": 200, "mem": 64}),))
+    adjust_resources(wl, [lr], {"gvisor": {"cpu": 30}})
+    # default_request wins over the limits-promotion (merged first).
+    assert wl.pod_sets[0].requests == {"cpu": 230, "mem": 64}
+
+
+def test_validate_admissibility_requests_over_limits():
+    wl = Workload(name="w", pod_sets=(PodSet(
+        "main", 1, template=PodTemplate(containers=[
+            ContainerSpec("app", requests={"cpu": 900},
+                          limits={"cpu": 500})])),))
+    err = validate_admissibility(wl)
+    assert err is not None and "validation failed" in err
+
+
+def test_engine_rejects_limitrange_violation_and_admits_adjusted():
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            ("cpu",),
+            (FlavorQuotas("default", {"cpu": ResourceQuota(1000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    eng.create_limit_range(LimitRange(
+        "bounds", namespace="default", limits=(LimitRangeItem(
+            LIMIT_TYPE_CONTAINER, max={"cpu": 500},
+            default_request={"cpu": 100}),)))
+
+    bad = Workload(name="bad", queue_name="lq", pod_sets=(PodSet(
+        "main", 1, template=PodTemplate(
+            containers=[ContainerSpec("a", {"cpu": 600})])),))
+    assert not eng.submit(bad)
+    assert any(e.kind == "Inadmissible" for e in eng.events)
+
+    good = Workload(name="good", queue_name="lq", pod_sets=(PodSet(
+        "main", 2, template=PodTemplate(
+            containers=[ContainerSpec("a", {}),
+                        ContainerSpec("b", {"cpu": 150})])),))
+    assert eng.submit(good)
+    # Defaulted: a gets 100 from the LimitRange, b keeps 150 -> 250/pod.
+    assert good.pod_sets[0].requests == {"cpu": 250}
+    eng.schedule_once()
+    assert good.is_admitted
+    usage = eng.cache.usage_for_cq("cq")
+    from kueue_tpu.api.types import FlavorResource
+    assert usage.get(FlavorResource("default", "cpu")) == 500
+
+
+def test_namespace_selector_mismatch():
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", namespace_selector={"team": "ml"},
+        resource_groups=(ResourceGroup(
+            ("cpu",),
+            (FlavorQuotas("default", {"cpu": ResourceQuota(1000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    wl = Workload(name="w", queue_name="lq",
+                  pod_sets=(PodSet("main", 1, {"cpu": 100}),))
+    assert not eng.submit(wl)
+    eng.set_namespace_labels("default", {"team": "ml"})
+    wl2 = Workload(name="w2", queue_name="lq",
+                   pod_sets=(PodSet("main", 1, {"cpu": 100}),))
+    assert eng.submit(wl2)
