@@ -329,6 +329,10 @@ class WorkloadConditionType(str, Enum):
     FINISHED = "Finished"
     PODS_READY = "PodsReady"
     REQUEUED = "Requeued"
+    # The workload needed to preempt but a closed preemption gate blocked
+    # it (workload_types.go:933) — the orchestrated-preemption signal
+    # MultiKueue/ConcurrentAdmission coordinators act on.
+    BLOCKED_ON_PREEMPTION_GATES = "BlockedOnPreemptionGates"
 
 
 @dataclass
@@ -379,6 +383,10 @@ class WorkloadStatus:
     # Pods no longer needed per pod set (workload_types.go:874
     # reclaimablePods): frees their quota while the workload runs.
     reclaimable_pods: dict[str, int] = field(default_factory=dict)
+    # Preemption gate positions (workload_types.go:909
+    # PreemptionGateState): gate name -> open-transition time. A gate
+    # named in spec but absent here is Closed.
+    open_preemption_gates: dict[str, float] = field(default_factory=dict)
 
 
 _uid_counter = itertools.count(1)
@@ -405,6 +413,9 @@ class Workload:
     # Elastic scale-up: key of the admitted slice this workload replaces
     # (pkg/workloadslicing annotation equivalent).
     replaced_workload_slice: Optional[str] = None
+    # Gates that must be Open before this workload may preempt others
+    # (workload_types.go:86 preemptionGates).
+    preemption_gates: tuple[str, ...] = ()
     uid: str = ""
     status: WorkloadStatus = field(default_factory=WorkloadStatus)
 
@@ -428,6 +439,21 @@ class Workload:
     def has_condition(self, ctype: str) -> bool:
         c = self.status.conditions.get(ctype)
         return c is not None and c.status
+
+    # -- preemption gates (workload.go:964-979) --
+
+    def has_closed_preemption_gate(self) -> bool:
+        return any(g not in self.status.open_preemption_gates
+                   for g in self.preemption_gates)
+
+    def open_preemption_gate(self, name: str, now: float = 0.0) -> None:
+        """workload.OpenPreemptionGate: flip the gate's position."""
+        self.status.open_preemption_gates[name] = now
+
+    def ensure_preemption_gate(self, name: str) -> None:
+        """workload.EnsurePreemptionGateOnSpec."""
+        if name not in self.preemption_gates:
+            self.preemption_gates = self.preemption_gates + (name,)
 
     def set_condition(self, ctype: str, status: bool, reason: str = "",
                       message: str = "", now: float = 0.0) -> None:

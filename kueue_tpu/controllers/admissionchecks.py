@@ -158,9 +158,14 @@ class ProvisioningController:
 
     def __init__(self, engine, check_name: str, max_retries: int = None,
                  config: ProvisioningRequestConfig = None):
+        import copy as _copy
+
         self.engine = engine
         self.check_name = check_name
-        self.config = config or ProvisioningRequestConfig()
+        # Deep-copy so a max_retries override can't mutate a config
+        # object shared with other controllers.
+        self.config = _copy.deepcopy(config) if config is not None \
+            else ProvisioningRequestConfig()
         if max_retries is not None:
             self.config.retry_strategy.backoff_limit_count = max_retries
         self.requests: dict[str, ProvisioningRequest] = {}
@@ -217,9 +222,11 @@ class ProvisioningController:
                 else:
                     # UpdateAdmissionCheckRequeueState
                     # (controller.go:576): exponential backoff before the
-                    # next attempt.
-                    wl.status.check_retry_after_seconds = retry.delay(
-                        req.attempts)
+                    # next attempt. Concurrent Retry verdicts from other
+                    # checks keep the longest backoff.
+                    wl.status.check_retry_after_seconds = max(
+                        wl.status.check_retry_after_seconds,
+                        retry.delay(req.attempts))
                     req.attempts += 1
                     req.failed = False
                     acm.set_state(wl.key, self.check_name, CheckState.RETRY)

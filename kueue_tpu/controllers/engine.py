@@ -65,7 +65,15 @@ class EngineMetrics:
 
 class Engine:
     def __init__(self, enable_fair_sharing: bool = False,
-                 cycle: Optional[SchedulerCycle] = None):
+                 cycle: Optional[SchedulerCycle] = None,
+                 config=None):
+        """``config`` is an optional config.api.Configuration: fair
+        sharing and the resources section (excluded prefixes +
+        transformations) are applied from it, the way the reference's
+        manager wires its loaded Configuration into the scheduler
+        (cmd/kueue main.go setup)."""
+        if config is not None and config.fair_sharing.enable:
+            enable_fair_sharing = True
         self.queues = QueueManager()
         self.cache = Cache()
         self.cycle = cycle or SchedulerCycle(
@@ -104,6 +112,8 @@ class Engine:
         self.runtime_class_overheads: dict[str, dict[str, int]] = {}
         self.namespace_labels: dict[str, dict[str, str]] = {}
         self.info_options = None
+        if config is not None:
+            self.set_info_options(config.info_options())
 
     def set_info_options(self, options) -> None:
         """Propagate workload_info.InfoOptions to every Info construction
@@ -339,8 +349,7 @@ class Engine:
             # queues (restore_workload requeues active pending workloads).
             wl.active = False
             self.workloads[wl.key] = wl
-            self._event("Inadmissible", wl.key, detail=err)
-            self._journal_obj("workload", wl)
+            self._event("Inadmissible", wl.key, detail=err)  # journals too
             return False
         # Resolve priorityClassRef (pkg/util/priority).
         if (wl.priority_class_name
@@ -523,6 +532,12 @@ class Engine:
         wl.status.admission = admission
         wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
                          reason="QuotaReserved", now=self.clock)
+        if wl.has_condition(
+                WorkloadConditionType.BLOCKED_ON_PREEMPTION_GATES):
+            # Reservation clears the blocked signal (workload.go:860).
+            wl.set_condition(
+                WorkloadConditionType.BLOCKED_ON_PREEMPTION_GATES, False,
+                reason="QuotaReserved", now=self.clock)
         entry.info.apply_admission(admission)
         self.cache.add_or_update_workload(wl)
         self._event("QuotaReserved", wl.key,
@@ -646,6 +661,14 @@ class Engine:
                                  EntryStatus.INADMISSIBLE)
                 and reason == RequeueReason.GENERIC):
             reason = RequeueReason.FAILED_AFTER_NOMINATION
+        if reason == RequeueReason.PREEMPTION_GATED:
+            # scheduler.go:1046: surface the orchestrated-preemption
+            # signal so a coordinator (MultiKueue) can open a gate.
+            wl.set_condition(
+                WorkloadConditionType.BLOCKED_ON_PREEMPTION_GATES, True,
+                reason="PreemptionGated",
+                message=entry.inadmissible_msg, now=self.clock)
+            # The Requeued _event below persists the condition.
         self.queues.requeue_workload(entry.info, reason)
         self._event("Requeued", wl.key,
                     cluster_queue=entry.info.cluster_queue,

@@ -323,7 +323,19 @@ class JobReconciler:
 
     def _ensure_one_workload(self, job: GenericJob) -> Optional[Workload]:
         """reconciler.go:399 (ensureOneWorkload): the Workload must match
-        the job's pod sets; replaced if the shape changed."""
+        the job's pod sets; replaced if the shape changed. A job carrying
+        a prebuilt-workload reference (reconciler.go:915, the
+        MultiKueue-remote path) adopts that Workload instead of creating
+        one."""
+        prebuilt = getattr(job, "prebuilt_workload_name", None)
+        if prebuilt:
+            key = f"{job.namespace}/{prebuilt}"
+            wl = self.engine.workloads.get(key)
+            if wl is None:
+                return None  # ErrPrebuiltWorkloadNotFound: wait
+            self.job_to_workload[job.key] = key
+            self.workload_to_job[key] = job.key
+            return wl
         wl_key = self.job_to_workload.get(job.key)
         pod_sets = job.pod_sets()
         if wl_key is not None:

@@ -37,6 +37,13 @@ class MultiKueueConfig:
     clusters: list[str] = field(default_factory=list)
 
 
+MULTIKUEUE_PREEMPTION_GATE = "kueue.x-k8s.io/multikueue-preemption"
+
+# workload.go:67: after opening one cluster's gate, wait this long before
+# opening another (one cluster preempts at a time).
+SINGLE_CLUSTER_PREEMPTION_TIMEOUT = 300.0
+
+
 @dataclass
 class _RemoteState:
     nominated: list[str] = field(default_factory=list)
@@ -56,7 +63,8 @@ class MultiKueueController:
     def __init__(self, manager_engine, check_name: str,
                  config: MultiKueueConfig,
                  dispatcher: str = Dispatcher.ALL_AT_ONCE,
-                 increment: int = 1, round_seconds: float = 300.0):
+                 increment: int = 1, round_seconds: float = 300.0,
+                 orchestrated_preemption: bool = False):
         self.engine = manager_engine
         self.check_name = check_name
         self.config = config
@@ -65,6 +73,32 @@ class MultiKueueController:
         self.round_seconds = round_seconds
         self.clusters: dict[str, object] = {}  # name -> worker Engine
         self.states: dict[str, _RemoteState] = {}
+        # MultiKueueOrchestratedPreemption: remote copies carry a closed
+        # preemption gate; the manager opens one cluster's gate at a time
+        # (workload.go:1186 workloadToOpenPreemptionGate).
+        self.orchestrated_preemption = orchestrated_preemption
+        # Job-object mirroring (jobframework MultiKueueAdapter): manager
+        # JobReconciler + per-cluster worker reconcilers + adapter table.
+        self.manager_jobs = None
+        self.worker_jobs: dict[str, object] = {}
+        self.adapters: dict[str, object] = {}
+        self.origin = "multikueue"
+
+    def attach_job_framework(self, manager_reconciler,
+                             worker_reconcilers: dict,
+                             adapters: Optional[dict] = None,
+                             origin: str = "multikueue") -> None:
+        """Enable per-framework job mirroring: for workloads owned by a
+        job, SyncJob creates the remote job object on the winning cluster
+        (bound to the mirrored Workload via prebuilt reference) and copies
+        remote job status back on every reconcile."""
+        from kueue_tpu.controllers.multikueue_adapters import DEFAULT_ADAPTERS
+
+        self.manager_jobs = manager_reconciler
+        self.worker_jobs = dict(worker_reconcilers)
+        self.adapters = adapters if adapters is not None \
+            else dict(DEFAULT_ADAPTERS)
+        self.origin = origin
 
     def connect_cluster(self, name: str, engine) -> None:
         self.clusters[name] = engine
@@ -102,6 +136,9 @@ class MultiKueueController:
                 self._nominate(wl, state)
                 self._sync_remotes(wl, state)
                 self._check_remote_admission(wl, state, acm)
+                if (state.cluster_name is None
+                        and self.orchestrated_preemption):
+                    self._maybe_open_preemption_gate(state)
             else:
                 self._sync_back(wl, state)
 
@@ -133,8 +170,51 @@ class MultiKueueController:
                 continue
             copy_wl = copy.deepcopy(wl)
             copy_wl.status = type(copy_wl.status)()
+            if self.orchestrated_preemption:
+                # cloneForCreate (workload.go:1254): remotes manage gates
+                # independently — drop the manager's, add the MK gate
+                # Closed so remotes can't preempt until ungated.
+                copy_wl.preemption_gates = ()
+                copy_wl.ensure_preemption_gate(MULTIKUEUE_PREEMPTION_GATE)
             if worker.submit(copy_wl):
                 state.created[cluster] = copy_wl.key
+
+    def _maybe_open_preemption_gate(self, state: _RemoteState) -> None:
+        """workload.go:1186 workloadToOpenPreemptionGate: among remotes
+        blocked on the gate, open the one whose blocked signal is oldest
+        — but only one cluster per SINGLE_CLUSTER_PREEMPTION_TIMEOUT."""
+        now = self.engine.clock
+        best: Optional[tuple[float, Workload]] = None
+        previous_open: Optional[float] = None
+        for cluster in state.nominated:
+            key = state.created.get(cluster)
+            worker = self.clusters.get(cluster)
+            if key is None or worker is None:
+                continue
+            remote = worker.workloads.get(key)
+            if remote is None:
+                continue
+            opened = remote.status.open_preemption_gates.get(
+                MULTIKUEUE_PREEMPTION_GATE)
+            if opened is not None:
+                if previous_open is None or opened > previous_open:
+                    previous_open = opened
+                continue
+            cond = remote.condition(
+                WorkloadConditionType.BLOCKED_ON_PREEMPTION_GATES)
+            if cond is None or not cond.status:
+                continue
+            if best is None or cond.last_transition_time < best[0]:
+                best = (cond.last_transition_time, remote)
+        if best is None:
+            return
+        if (previous_open is not None and now - previous_open
+                < SINGLE_CLUSTER_PREEMPTION_TIMEOUT):
+            return  # an earlier cluster's preemption attempt still runs
+        # Once the timeout lapses the next gate opens WITHOUT closing the
+        # previous one — the reference presumes the stale attempt stuck
+        # and lets both race (workload.go:1227-1242 never re-closes).
+        best[1].open_preemption_gate(MULTIKUEUE_PREEMPTION_GATE, now)
 
     def _check_remote_admission(self, wl: Workload, state: _RemoteState,
                                 acm) -> None:
@@ -147,8 +227,39 @@ class MultiKueueController:
             if remote is not None and remote.is_admitted:
                 state.cluster_name = cluster
                 self._remove_remotes(wl.key, except_cluster=cluster)
+                self._sync_remote_job(wl, state)
                 acm.set_state(wl.key, self.check_name, CheckState.READY)
                 return
+
+    def _adapter_and_job(self, wl: Workload):
+        """Resolve (local job, adapter, winning worker reconciler) for a
+        job-owned workload, or (None, None, None)."""
+        if self.manager_jobs is None:
+            return None, None, None
+        job_key = self.manager_jobs.workload_to_job.get(wl.key)
+        job = self.manager_jobs.jobs.get(job_key) if job_key else None
+        if job is None:
+            return None, None, None
+        from kueue_tpu.controllers.multikueue_adapters import adapter_for
+
+        adapter = adapter_for(job, self.adapters,
+                              self.manager_jobs.integrations)
+        return job, adapter, None
+
+    def _sync_remote_job(self, wl: Workload, state: _RemoteState) -> None:
+        """SyncJob on the winning cluster (workload.go:609): create the
+        remote job object bound to the mirrored Workload, or copy its
+        status back to the manager's job."""
+        job, adapter, _ = self._adapter_and_job(wl)
+        worker_rec = self.worker_jobs.get(state.cluster_name)
+        if job is None or adapter is None or worker_rec is None:
+            return
+        managed, _reason = adapter.is_job_managed_by_kueue(job)
+        if not managed:
+            return
+        remote_key = state.created.get(state.cluster_name)
+        remote_name = remote_key.split("/", 1)[1] if remote_key else wl.name
+        adapter.sync_job(job, worker_rec, remote_name, self.origin)
 
     def _sync_back(self, wl: Workload, state: _RemoteState) -> None:
         worker = self.clusters.get(state.cluster_name)
@@ -161,6 +272,9 @@ class MultiKueueController:
             del self.states[wl.key]
             self.engine.evict(wl, "MultiKueueRemoteLost")
             return
+        # Keep the remote job object in sync (create if the win happened
+        # before the job existed; copy status back otherwise).
+        self._sync_remote_job(wl, state)
         if remote.is_finished:
             cond = remote.condition(WorkloadConditionType.FINISHED)
             wl.set_condition(WorkloadConditionType.FINISHED, True,
@@ -182,6 +296,15 @@ class MultiKueueController:
                 if remote is not None:
                     worker.cache.delete_workload(key)
                     worker.queues.delete_workload(remote)
+            # Remove the mirrored job object too (DeleteRemoteObject).
+            worker_rec = self.worker_jobs.get(cluster)
+            if worker_rec is not None:
+                wl = self.engine.workloads.get(wl_key)
+                job, adapter, _ = (self._adapter_and_job(wl)
+                                   if wl is not None else (None, None, None))
+                if job is not None and adapter is not None \
+                        and job.key in worker_rec.jobs:
+                    adapter.delete_remote_object(worker_rec, job.key)
             del state.created[cluster]
 
     def _gc(self, wl: Workload) -> None:

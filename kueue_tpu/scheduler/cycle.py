@@ -66,6 +66,7 @@ class RequeueReason(str, Enum):
     GENERIC = "Generic"
     NO_FIT = "NoFit"
     PREEMPTION_NO_CANDIDATES = "PreemptionNoCandidates"
+    PREEMPTION_GATED = "PreemptionGated"
     FAILED_AFTER_NOMINATION = "FailedAfterNomination"
     NAMESPACE_MISMATCH = "NamespaceMismatch"
 
@@ -265,6 +266,14 @@ class SchedulerCycle:
             # scheduler.go:499 reserveCapacityForUnreclaimablePreempt.
             if not can_always_reclaim(cq):
                 cq.add_usage(self._quota_to_reserve(e, cq))
+            return
+
+        # Orchestrated preemption / concurrent admission: a closed gate
+        # blocks the preemptor (scheduler.go:422 markPreemptionGated).
+        if mode == Mode.PREEMPT and e.obj.has_closed_preemption_gate():
+            e.requeue_reason = RequeueReason.PREEMPTION_GATED
+            e.inadmissible_msg = (
+                "Workload requires preemption, but it's gated")
             return
 
         # One-admission-per-cohort overlap rule (scheduler.go:432).

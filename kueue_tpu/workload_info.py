@@ -66,6 +66,9 @@ def apply_resource_transformations(
             continue
         eff = qty
         if mapping.multiply_by and mapping.multiply_by in requests:
+            # The multiplied quantity is what Retain keeps, matching the
+            # reference (workload.go:530-546 mutates inputQuantity before
+            # both the outputs loop and the Retain branch).
             eff = qty * requests[mapping.multiply_by]
         for out_name, factor in mapping.outputs.items():
             out[out_name] = out.get(out_name, 0) + int(eff * factor)

@@ -132,3 +132,180 @@ def test_incremental_dispatcher_rounds():
     pump(manager, [w1, w2], mk)
     assert wl.is_admitted
     assert mk.states[wl.key].cluster_name == "worker2"
+
+
+def test_adapter_mirrors_job_objects_to_winning_cluster():
+    """jobframework MultiKueueAdapter: the manager's Job is mirrored as a
+    remote Job object on the winning cluster (bound to the mirrored
+    Workload via prebuilt reference), runs there, and its status syncs
+    back to the manager's job."""
+    from kueue_tpu.controllers.jobframework import BatchJob, JobReconciler
+
+    manager, w1, w2, mk = make_stack()
+    mgr_rec = JobReconciler(manager)
+    w1_rec = JobReconciler(w1)
+    w2_rec = JobReconciler(w2)
+    mk.attach_job_framework(mgr_rec, {"worker1": w1_rec,
+                                      "worker2": w2_rec})
+    job = BatchJob(name="train", queue_name="lq", parallelism=2,
+                   completions=2, requests={CPU: 500})
+    mgr_rec.create_job(job)
+    manager.schedule_once()
+    mk.reconcile()
+    # Mirrored workloads exist on both workers; worker1 admits first.
+    w1.schedule_once()
+    mk.reconcile()
+    wl_key = mgr_rec.job_to_workload[job.key]
+    assert mk.states[wl_key].cluster_name == "worker1"
+    # The remote JOB OBJECT (not just the workload) exists on worker1
+    # only, adopted the mirrored workload, and started.
+    assert job.key in w1_rec.jobs and job.key not in w2_rec.jobs
+    remote_job = w1_rec.jobs[job.key]
+    assert remote_job.prebuilt_workload_name
+    w1_rec.reconcile_all()
+    assert not remote_job.is_suspended()
+    # Remote progress syncs back to the manager's job.
+    remote_job.succeeded = 2
+    remote_job.active_pods = 0
+    w1_rec.reconcile_all()  # remote job finished -> remote wl Finished
+    mk.reconcile()
+    assert job.succeeded == 2
+    manager_wl = manager.workloads[wl_key]
+    assert manager_wl.is_finished
+
+
+def test_orchestrated_preemption_one_cluster_at_a_time():
+    """MultiKueueOrchestratedPreemption: mirrored copies carry a closed
+    preemption gate; blocked remotes signal BlockedOnPreemptionGates and
+    the manager opens exactly one cluster's gate."""
+    from kueue_tpu.api.types import ClusterQueuePreemption, PreemptionPolicy
+    from kueue_tpu.controllers.multikueue import (
+        MULTIKUEUE_PREEMPTION_GATE,
+        SINGLE_CLUSTER_PREEMPTION_TIMEOUT,
+    )
+
+    manager = make_cluster(checks=("multikueue",))
+
+    def preempting_cluster():
+        eng = Engine()
+        eng.create_resource_flavor(ResourceFlavor("default"))
+        eng.create_cluster_queue(ClusterQueue(
+            name="cq",
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY),
+            resource_groups=(ResourceGroup(
+                (CPU,),
+                (FlavorQuotas("default", {CPU: ResourceQuota(1000)}),)),)))
+        eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+        # Fill the cluster with a low-priority victim.
+        filler = Workload(name="filler", queue_name="lq", priority=0,
+                          pod_sets=(PodSet("main", 1, {CPU: 1000}),))
+        eng.submit(filler)
+        eng.schedule_once()
+        assert filler.is_admitted
+        return eng
+
+    w1, w2 = preempting_cluster(), preempting_cluster()
+    mk = MultiKueueController(
+        manager, "multikueue",
+        MultiKueueConfig(clusters=["worker1", "worker2"]),
+        orchestrated_preemption=True)
+    mk.connect_cluster("worker1", w1)
+    mk.connect_cluster("worker2", w2)
+
+    wl = submit(manager, "hi", cpu=1000)
+    wl.priority = 5
+    manager.schedule_once()
+    mk.reconcile()
+    # Copies exist, gated: scheduling on the workers wants preemption but
+    # is blocked, raising the signal.
+    for w in (w1, w2):
+        remote = w.workloads[wl.key]
+        assert remote.preemption_gates == (MULTIKUEUE_PREEMPTION_GATE,)
+        w.schedule_once()
+        assert remote.has_condition(
+            WorkloadConditionType.BLOCKED_ON_PREEMPTION_GATES)
+        assert not w.workloads["default/filler"].is_evicted
+    # Manager opens exactly ONE gate (oldest blocked signal = worker1).
+    mk.reconcile()
+    opened = [w for w in (w1, w2)
+              if MULTIKUEUE_PREEMPTION_GATE
+              in w.workloads[wl.key].status.open_preemption_gates]
+    assert len(opened) == 1 and opened[0] is w1
+    # Second reconcile within the timeout must NOT open another gate.
+    mk.reconcile()
+    assert MULTIKUEUE_PREEMPTION_GATE not in \
+        w2.workloads[wl.key].status.open_preemption_gates
+    # The ungated worker can now preempt and admit; the win converges.
+    w1.schedule_once()  # issues preemption
+    w1.schedule_once()  # admits after eviction
+    assert w1.workloads[wl.key].is_admitted
+    assert w1.workloads["default/filler"].is_evicted
+    mk.reconcile()
+    assert mk.states[wl.key].cluster_name == "worker1"
+    assert wl.is_admitted
+    # After the timeout with no winner, the next blocked cluster ungates:
+    # simulated by a fresh stack where worker1 cannot ever admit.
+    assert SINGLE_CLUSTER_PREEMPTION_TIMEOUT == 300.0
+
+
+def test_orchestrated_preemption_timeout_rotates_cluster():
+    """After SINGLE_CLUSTER_PREEMPTION_TIMEOUT with no admission, the
+    next blocked cluster's gate opens (workload.go:1231)."""
+    from kueue_tpu.api.types import ClusterQueuePreemption, PreemptionPolicy
+    from kueue_tpu.controllers.multikueue import (
+        MULTIKUEUE_PREEMPTION_GATE,
+        SINGLE_CLUSTER_PREEMPTION_TIMEOUT,
+    )
+
+    manager = make_cluster(checks=("multikueue",))
+
+    def cluster(capacity):
+        eng = Engine()
+        eng.create_resource_flavor(ResourceFlavor("default"))
+        eng.create_cluster_queue(ClusterQueue(
+            name="cq",
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY),
+            resource_groups=(ResourceGroup(
+                (CPU,),
+                (FlavorQuotas("default",
+                              {CPU: ResourceQuota(capacity)}),)),)))
+        eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+        return eng
+
+    # worker1 too small to ever fit the workload even after preempting;
+    # worker2 viable once its filler is evicted.
+    w1, w2 = cluster(500), cluster(1000)
+    filler2 = Workload(name="filler", queue_name="lq", priority=0,
+                       pod_sets=(PodSet("main", 1, {CPU: 1000}),))
+    w2.submit(filler2)
+    w2.schedule_once()
+    filler1 = Workload(name="filler", queue_name="lq", priority=0,
+                       pod_sets=(PodSet("main", 1, {CPU: 500}),))
+    w1.submit(filler1)
+    w1.schedule_once()
+
+    mk = MultiKueueController(
+        manager, "multikueue",
+        MultiKueueConfig(clusters=["worker1", "worker2"]),
+        orchestrated_preemption=True)
+    mk.connect_cluster("worker1", w1)
+    mk.connect_cluster("worker2", w2)
+    wl = submit(manager, "hi", cpu=1000)
+    wl.priority = 5
+    manager.schedule_once()
+    mk.reconcile()
+    w1.schedule_once()  # w1: NoFit even with preemption -> no signal
+    w2.schedule_once()  # w2: blocked on the gate -> signal
+    mk.reconcile()
+    # Only w2 raised the signal, so its gate opens directly.
+    assert MULTIKUEUE_PREEMPTION_GATE in \
+        w2.workloads[wl.key].status.open_preemption_gates
+    for eng in (manager, w1, w2):
+        eng.tick(SINGLE_CLUSTER_PREEMPTION_TIMEOUT + 1)
+    w2.schedule_once()
+    w2.schedule_once()
+    assert w2.workloads[wl.key].is_admitted
+    mk.reconcile()
+    assert mk.states[wl.key].cluster_name == "worker2"

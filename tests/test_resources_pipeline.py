@@ -218,3 +218,37 @@ def test_namespace_selector_mismatch():
     wl2 = Workload(name="w2", queue_name="lq",
                    pod_sets=(PodSet("main", 1, {"cpu": 100}),))
     assert eng.submit(wl2)
+
+
+def test_transformation_multiply_by_retains_scaled_input():
+    """Retain + multiplyBy keeps the MULTIPLIED input quantity, matching
+    workload.go:530-546 (inputQuantity is scaled before both the outputs
+    loop and the Retain branch)."""
+    out = apply_resource_transformations(
+        {"vendor/counter": 2, "gpu": 4},
+        {"vendor/counter": ResourceTransformation(
+            input="vendor/counter", multiply_by="gpu",
+            outputs={"mem": 1.0}, strategy="Retain")})
+    assert out == {"vendor/counter": 8, "mem": 8, "gpu": 4}
+
+
+def test_engine_config_wires_info_options():
+    from kueue_tpu.config.api import from_dict
+
+    cfg = from_dict({"resources": {
+        "excludeResourcePrefixes": ["scratch.io/"]}})
+    eng = Engine(config=cfg)
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            ("cpu",),
+            (FlavorQuotas("default", {"cpu": ResourceQuota(1000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    wl = Workload(name="w", queue_name="lq", pod_sets=(PodSet(
+        "main", 1, {"cpu": 100, "scratch.io/disk": 5}),))
+    eng.submit(wl)
+    eng.schedule_once()
+    assert wl.is_admitted
+    from kueue_tpu.api.types import FlavorResource
+    usage = eng.cache.usage_for_cq("cq")
+    assert FlavorResource("default", "scratch.io/disk") not in usage
