@@ -86,6 +86,14 @@ class Engine:
         self.metrics = EngineMetrics()
         from kueue_tpu.metrics.registry import MetricsRegistry
         self.registry = MetricsRegistry()
+        from kueue_tpu.cache.unadmitted import UnadmittedWorkloads
+        self.unadmitted = UnadmittedWorkloads(self.registry)
+        # First-eviction-per-workload tracking
+        # (evicted_workloads_once_total, metrics.go:666).
+        self._evicted_once: set[str] = set()
+        # Last cycle's phase durations (scheduler.go:291-358 logs these;
+        # the debugger/dashboard surface them here).
+        self.last_cycle_phases: dict[str, float] = {}
         self.workloads: dict[str, Workload] = {}
         # hook: called with (workload, admission) after each admission.
         self.on_admit: Optional[Callable] = None
@@ -363,20 +371,44 @@ class Engine:
             # restarted engine carries the same object.
             self._journal_obj("workload", wl)
             return False
+        self.registry.histogram("workload_creation_latency_seconds").observe(
+            max(0.0, self.clock - wl.creation_time))
+        self._track_unadmitted(wl, info.cluster_queue, "NoReservation")
         self._event("Submitted", wl.key,
                     cluster_queue=info.cluster_queue)
         return True
+
+    def _track_unadmitted(self, wl: Workload, cq_name: str,
+                          reason: str, cause: str = "") -> None:
+        """unadmitted_workloads.go:75 (update)."""
+        from kueue_tpu.cache.unadmitted import UnadmittedStatus
+
+        self.unadmitted.update(wl.key, UnadmittedStatus(
+            cluster_queue=cq_name, local_queue=wl.queue_name,
+            namespace=wl.namespace, reason=reason, cause=cause))
+
+    def _lq_key(self, wl: Workload) -> tuple:
+        return (f"{wl.namespace}/{wl.queue_name}",)
 
     def finish(self, key: str) -> None:
         wl = self.workloads.get(key)
         if wl is None:
             return
+        finished = wl.condition(WorkloadConditionType.FINISHED)
+        reason = (finished.reason if finished is not None and finished.reason
+                  else "Succeeded")
         wl.set_condition(WorkloadConditionType.FINISHED, True,
-                         reason="Succeeded", now=self.clock)
+                         reason=reason, now=self.clock)
         cq_name = (wl.status.admission.cluster_queue
                    if wl.status.admission else "")
         self.cache.delete_workload(key)
         self.queues.delete_workload(wl)
+        self.unadmitted.remove(key)
+        self._evicted_once.discard(wl.uid)  # bound the set to live objects
+        self.registry.counter("finished_workloads_total").inc(
+            (cq_name, reason))
+        self.registry.counter("local_queue_finished_workloads_total").inc(
+            self._lq_key(wl) + (reason,))
         self._event("Finished", key, cluster_queue=cq_name)
         self._requeue_cohort_inadmissible(cq_name)
 
@@ -476,9 +508,11 @@ class Engine:
         if count_cycle:
             self.metrics.admission_cycles += 1
         snapshot = self.cache.snapshot()
+        t_snap = _time.perf_counter()
         already = set(self.cache.workloads)
         result = self.cycle.schedule(heads, snapshot, now=self.clock,
                                      already_admitted=already)
+        t_decide = _time.perf_counter()
         for e in result.entries:
             self.metrics.admission_attempts_total += 1
             if e.status == EntryStatus.ASSUMED:
@@ -495,6 +529,20 @@ class Engine:
             m[cq_name] = m.get(cq_name, 0) + skips
             self.registry.counter("admission_cycle_preemption_skips").inc(
                 (cq_name,), skips)
+        # Per-phase durations (scheduler.go:291-358 logs snapshot/
+        # nominate/commit splits; the debugger shows where a slow cycle
+        # went). Gated on count_cycle: a hybrid cycle's host tail must
+        # not overwrite the bridge's encode/device/apply record.
+        if count_cycle:
+            t_apply = _time.perf_counter()
+            phases = {"snapshot": t_snap - t0,
+                      "decide": t_decide - t_snap,
+                      "apply": t_apply - t_decide}
+            self.last_cycle_phases = phases
+            for phase, dur in phases.items():
+                self.registry.histogram(
+                    "scheduler_phase_duration_seconds").observe(
+                    dur, (phase,))
         if count_cycle:
             outcome = "success" if result.assumed else "inadmissible"
             self.registry.report_admission_attempt(
@@ -505,6 +553,114 @@ class Engine:
             self.registry.gauge("admitted_active_workloads").set(
                 (name,), self.cache.admitted_count(name))
         return result
+
+    def sync_resource_metrics(self) -> None:
+        """Refresh the per-CQ / per-LQ / cohort resource and share gauges
+        from a fresh snapshot (the metrics.go:796-948 families; the
+        reference's cache controllers update these on reconcile)."""
+        from kueue_tpu.cache.snapshot import dominant_resource_share
+
+        snap = self.cache.snapshot()
+        g = self.registry.gauge
+        # These families are owned by this sync: clear so series for
+        # drained queues / finished workloads / deleted objects vanish
+        # rather than reporting the last non-zero value forever.
+        for fam in ("cluster_queue_info", "cluster_queue_resource_usage",
+                    "cluster_queue_resource_reservation",
+                    "cluster_queue_resource_pending",
+                    "cluster_queue_nominal_quota",
+                    "cluster_queue_borrowing_limit",
+                    "cluster_queue_lending_limit",
+                    "cluster_queue_weighted_share",
+                    "local_queue_resource_usage",
+                    "local_queue_resource_reservation",
+                    "reserving_active_workloads", "cohort_info",
+                    "cohort_subtree_quota",
+                    "cohort_subtree_resource_reservations",
+                    "cohort_subtree_admitted_active_workloads",
+                    "cohort_weighted_share"):
+            g(fam).clear()
+
+        for name, cqs in snap.cluster_queues.items():
+            g("cluster_queue_info").set((name, cqs.spec.cohort or ""), 1)
+            # Reservation = every quota-reserved workload's usage;
+            # usage = admitted-only (metrics.go:796,814).
+            admitted_usage: dict = {}
+            reserving = 0
+            admitted_n = 0
+            lq_reservation: dict = {}
+            lq_usage: dict = {}
+            for key, info in cqs.workloads.items():
+                wl = self.workloads.get(key)
+                lq = (f"{info.obj.namespace}/{info.obj.queue_name}")
+                is_admitted = wl is not None and wl.is_admitted
+                reserving += 1
+                admitted_n += 1 if is_admitted else 0
+                for fr, v in info.usage().items():
+                    lq_reservation[(lq, fr)] = \
+                        lq_reservation.get((lq, fr), 0) + v
+                    if is_admitted:
+                        admitted_usage[fr] = admitted_usage.get(fr, 0) + v
+                        lq_usage[(lq, fr)] = lq_usage.get((lq, fr), 0) + v
+            for fr, v in cqs.node.usage.items():
+                g("cluster_queue_resource_reservation").set(
+                    (name, fr.flavor, fr.resource), v)
+            for fr, v in admitted_usage.items():
+                g("cluster_queue_resource_usage").set(
+                    (name, fr.flavor, fr.resource), v)
+            for (lq, fr), v in lq_reservation.items():
+                g("local_queue_resource_reservation").set(
+                    (lq, fr.flavor, fr.resource), v)
+            for (lq, fr), v in lq_usage.items():
+                g("local_queue_resource_usage").set(
+                    (lq, fr.flavor, fr.resource), v)
+            g("reserving_active_workloads").set((name,), reserving)
+            for fr, q in cqs.node.quotas.items():
+                g("cluster_queue_nominal_quota").set(
+                    (name, fr.flavor, fr.resource), q.nominal)
+                if q.borrowing_limit is not None:
+                    g("cluster_queue_borrowing_limit").set(
+                        (name, fr.flavor, fr.resource), q.borrowing_limit)
+                if q.lending_limit is not None:
+                    g("cluster_queue_lending_limit").set(
+                        (name, fr.flavor, fr.resource), q.lending_limit)
+            # Pending per resource (metrics.go:805).
+            pcq = self.queues.cluster_queues.get(name)
+            if pcq is not None:
+                pending: dict = {}
+                for info in list(pcq.items.values()) \
+                        + list(pcq.inadmissible.values()):
+                    for psr in info.total_requests:
+                        for res, v in psr.requests.items():
+                            pending[res] = pending.get(res, 0) + v
+                for res, v in pending.items():
+                    g("cluster_queue_resource_pending").set(
+                        (name, res), v)
+            drs = dominant_resource_share(cqs, None)
+            share = (drs.precise_weighted_share()
+                     if cqs.fair_weight else drs.unweighted_ratio)
+            g("cluster_queue_weighted_share").set((name,), share)
+
+        for name, cohort in snap.cohorts.items():
+            g("cohort_info").set(
+                (name, cohort.parent.name if cohort.parent else ""), 1)
+            for fr, v in cohort.node.subtree_quota.items():
+                g("cohort_subtree_quota").set(
+                    (name, fr.flavor, fr.resource), v)
+            for fr, v in cohort.node.usage.items():
+                g("cohort_subtree_resource_reservations").set(
+                    (name, fr.flavor, fr.resource), v)
+            admitted = sum(
+                1 for cqs in cohort.subtree_cluster_queues()
+                for key in cqs.workloads
+                if (w := self.workloads.get(key)) is not None
+                and w.is_admitted)
+            g("cohort_subtree_admitted_active_workloads").set(
+                (name,), admitted)
+            drs = dominant_resource_share(cohort, None)
+            share = (drs.precise_weighted_share()
+                     if cohort.fair_weight else drs.unweighted_ratio)
+            g("cohort_weighted_share").set((name,), share)
 
     def run_until_quiescent(self, max_cycles: int = 10_000) -> int:
         """Drive cycles until no progress is possible (tests/bench)."""
@@ -547,6 +703,13 @@ class Engine:
             (cq_name,))
         self.registry.histogram("quota_reserved_wait_time_seconds").observe(
             max(0.0, self.clock - wl.creation_time), (cq_name,))
+        self.registry.counter(
+            "local_queue_quota_reserved_workloads_total").inc(
+            self._lq_key(wl))
+        self.registry.histogram(
+            "local_queue_quota_reserved_wait_time_seconds").observe(
+            max(0.0, self.clock - wl.creation_time), self._lq_key(wl))
+        self._track_unadmitted(wl, cq_name, "UnsatisfiedChecks")
         if self.admission_checks is not None:
             self.admission_checks.sync_states(wl,
                                               entry.info.cluster_queue)
@@ -570,6 +733,18 @@ class Engine:
         self.registry.counter("admitted_workloads_total").inc((cq_name,))
         self.registry.histogram("admission_wait_time_seconds").observe(
             max(0.0, self.clock - wl.creation_time), (cq_name,))
+        self.registry.counter("local_queue_admitted_workloads_total").inc(
+            self._lq_key(wl))
+        self.registry.histogram(
+            "local_queue_admission_wait_time_seconds").observe(
+            max(0.0, self.clock - wl.creation_time), self._lq_key(wl))
+        reserved = wl.condition(WorkloadConditionType.QUOTA_RESERVED)
+        if reserved is not None:
+            self.registry.histogram(
+                "admission_checks_wait_time_seconds").observe(
+                max(0.0, self.clock - reserved.last_transition_time),
+                (cq_name,))
+        self.unadmitted.remove(wl.key)
         self._event("Admitted", wl.key, cluster_queue=cq_name)
         if self.on_admit is not None:
             self.on_admit(wl, wl.status.admission)
@@ -608,6 +783,9 @@ class Engine:
         """Shared eviction path (pkg/workload/evict)."""
         cq_name = (wl.status.admission.cluster_queue
                    if wl.status.admission else "")
+        _adm = wl.condition(WorkloadConditionType.ADMITTED)
+        admitted_at = (_adm.last_transition_time
+                       if _adm is not None and _adm.status else None)
         wl.set_condition(WorkloadConditionType.EVICTED, True,
                          reason=reason, now=self.clock)
         wl.set_condition(WorkloadConditionType.ADMITTED, False,
@@ -620,15 +798,30 @@ class Engine:
         self.cache.delete_workload(wl.key)
         self.registry.counter("evicted_workloads_total").inc(
             (cq_name, reason))
+        self.registry.counter("local_queue_evicted_workloads_total").inc(
+            self._lq_key(wl) + (reason,))
+        if wl.uid not in self._evicted_once:
+            # Keyed by UID: a re-created workload under the same name is
+            # a new object with its own first eviction (metrics.go:666).
+            self._evicted_once.add(wl.uid)
+            self.registry.counter("evicted_workloads_once_total").inc(
+                (cq_name, reason))
+        if admitted_at is not None:
+            self.registry.histogram(
+                "workload_eviction_latency_seconds").observe(
+                max(0.0, self.clock - admitted_at), (cq_name, reason))
         self._event("Evicted", wl.key, cluster_queue=cq_name, detail=reason)
         if requeue and wl.active:
             wl.status.requeue_count += 1
             if backoff_seconds:
                 wl.status.requeue_at = self.clock + backoff_seconds
             self.queues.add_or_update_workload(wl)
+            self._track_unadmitted(wl, cq_name, "Evicted", cause=reason)
             # The requeue bookkeeping mutated status after the Evicted
             # event — persist the final state.
             self._journal_obj("workload", wl)
+        else:
+            self.unadmitted.remove(wl.key)
         self._requeue_cohort_inadmissible(cq_name)
 
     def _issue_preemptions(self, entry) -> None:
@@ -670,6 +863,7 @@ class Engine:
                 message=entry.inadmissible_msg, now=self.clock)
             # The Requeued _event below persists the condition.
         self.queues.requeue_workload(entry.info, reason)
+        self._track_unadmitted(wl, entry.info.cluster_queue, reason.value)
         self._event("Requeued", wl.key,
                     cluster_queue=entry.info.cluster_queue,
                     detail=f"{reason.value}: {entry.inadmissible_msg}")

@@ -178,6 +178,9 @@ class MultiKueueController:
                 copy_wl.ensure_preemption_gate(MULTIKUEUE_PREEMPTION_GATE)
             if worker.submit(copy_wl):
                 state.created[cluster] = copy_wl.key
+                self.engine.registry.counter(
+                    "workloads_dispatched_total").inc(
+                    (self.dispatcher, cluster))
 
     def _maybe_open_preemption_gate(self, state: _RemoteState) -> None:
         """workload.go:1186 workloadToOpenPreemptionGate: among remotes

@@ -42,6 +42,12 @@ class Gauge:
     def get(self, labels: tuple = ()) -> float:
         return self.values.get(labels, 0.0)
 
+    def clear(self) -> None:
+        """Drop every series (families fully re-populated each sync —
+        stale keys must disappear, the prometheus DeletePartialMatch
+        analog)."""
+        self.values.clear()
+
 
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60, 300,
                    1800)
@@ -93,23 +99,62 @@ class MetricsRegistry:
         h("admission_attempt_duration_seconds", "cycle latency by result")
         c("admission_cycle_preemption_skips",
           "preemptions skipped per cycle per CQ")
+        h("scheduler_phase_duration_seconds",
+          "per-cycle phase durations (snapshot|decide|apply|encode|device)")
         # workload lifecycle
         c("quota_reserved_workloads_total", "per CQ")
         h("quota_reserved_wait_time_seconds", "queued->reserved per CQ")
         c("admitted_workloads_total", "per CQ")
         h("admission_wait_time_seconds", "queued->admitted per CQ")
+        h("admission_checks_wait_time_seconds", "reserved->admitted per CQ")
         c("evicted_workloads_total", "per CQ x reason")
+        c("evicted_workloads_once_total",
+          "first eviction per workload, per CQ x reason")
         c("preempted_workloads_total", "per preempting CQ x reason")
+        c("finished_workloads_total", "per CQ x reason")
+        h("workload_eviction_latency_seconds",
+          "admitted->evicted per CQ x reason")
+        h("workload_creation_latency_seconds", "creation->queued")
+        c("replaced_workload_slices_total", "elastic slice swaps per CQ")
+        c("workloads_dispatched_total", "MultiKueue dispatches per mode")
         # queue state
         g("pending_workloads", "per CQ x status(active|inadmissible)")
+        g("reserving_active_workloads", "per CQ")
         g("admitted_active_workloads", "per CQ")
         g("cluster_queue_status", "per CQ x status")
+        g("unadmitted_workloads", "per CQ x reason x cause")
+        # LocalQueue mirrors (metrics.go local_queue_* families)
+        g("local_queue_pending_workloads", "per LQ x status")
+        c("local_queue_quota_reserved_workloads_total", "per LQ")
+        h("local_queue_quota_reserved_wait_time_seconds", "per LQ")
+        c("local_queue_admitted_workloads_total", "per LQ")
+        h("local_queue_admission_wait_time_seconds", "per LQ")
+        c("local_queue_evicted_workloads_total", "per LQ x reason")
+        c("local_queue_finished_workloads_total", "per LQ x reason")
+        g("local_queue_reserving_active_workloads", "per LQ")
+        g("local_queue_admitted_active_workloads", "per LQ")
+        g("local_queue_status", "per LQ x status")
+        g("local_queue_unadmitted_workloads", "per LQ x reason x cause")
+        g("local_queue_resource_usage", "per LQ x flavor x resource")
+        g("local_queue_resource_reservation", "per LQ x flavor x resource")
+        g("local_queue_admission_fair_sharing_usage", "decayed AFS usage")
         # resource state (per CQ x flavor x resource)
         g("cluster_queue_resource_usage", "")
+        g("cluster_queue_resource_reservation", "")
+        g("cluster_queue_resource_pending", "")
         g("cluster_queue_nominal_quota", "")
         g("cluster_queue_borrowing_limit", "")
         g("cluster_queue_lending_limit", "")
         g("cluster_queue_weighted_share", "fair sharing share per CQ")
+        # cohort hierarchy (metrics.go:892-940)
+        g("cohort_weighted_share", "per cohort")
+        g("cohort_subtree_quota", "per cohort x flavor x resource")
+        g("cohort_subtree_resource_reservations",
+          "per cohort x flavor x resource")
+        g("cohort_subtree_admitted_active_workloads", "per cohort")
+        g("cohort_info", "parent edge per cohort")
+        g("cluster_queue_info", "cohort membership per CQ")
+        g("build_info", "framework build identity")
         c("ready_wait_time_seconds_total", "admitted->ready")
 
     def _counter(self, name, help=""):

@@ -468,6 +468,9 @@ class OracleBridge:
                 return self._fallback("idle-inadmissible")
             return CycleResult()
 
+        import time as _time
+
+        _t0 = _time.perf_counter()
         snapshot = eng.cache.snapshot()
         solver = B.BatchedDrainSolver(snapshot, pending_infos,
                                       max_depth=self.max_depth)
@@ -513,6 +516,15 @@ class OracleBridge:
 
         demote(has_head & ~head_eligible, "head-ineligible")
         demote(has_head & ~flavor_safe, "flavor-unsafe")
+        # Closed preemption gates (orchestrated preemption /
+        # ConcurrentAdmission): the gate semantics — block preemption,
+        # raise BlockedOnPreemptionGates — live in the host path
+        # (cycle.py _process_entry), so gated heads go there.
+        gated = np.zeros(C, bool)
+        for ci in np.nonzero(has_head)[0]:
+            if pending_infos[head_wid[ci]].obj.has_closed_preemption_gate():
+                gated[ci] = True
+        demote(gated, "preemption-gated")
         cq_on_device = ~host_root[root_of_cq]
 
         # Multi-flavor groups on preemption-enabled CQs: the flavor
@@ -629,6 +641,7 @@ class OracleBridge:
                     slot_victim_vals=jnp.asarray(p_victims[1]),
                     slot_victim_ids=jnp.asarray(p_victims[2]),
                     claimed0=jnp.zeros(a_pad, bool))
+        _t_encode = _time.perf_counter()
         out = self.executor.cycle_step(
             dict(pending=pending, inadmissible=inadmissible, usage=usage,
                  **args, **pre_kwargs), statics)
@@ -669,6 +682,7 @@ class OracleBridge:
                 cq_on_device = ~host_root[root_of_cq]
 
         self.cycles_on_device += 1
+        _t_device = _time.perf_counter()
         apply_rows = device_w & cq_on_device[cq_safe_idx]
         result = self._apply(solver, pending_infos,
                              np.asarray(wl_admitted),
@@ -680,6 +694,15 @@ class OracleBridge:
                              slot_preempting=np.asarray(slot_preempting),
                              head_idx=np.asarray(head_idx),
                              preempt_targets=preempt_targets)
+        # North-star phase accounting: encode (snapshot + tensorize) /
+        # device (solve incl. transfer) / apply (decode + commit).
+        _t_apply = _time.perf_counter()
+        phases = {"encode": _t_encode - _t0, "device": _t_device - _t_encode,
+                  "apply": _t_apply - _t_device}
+        eng.last_cycle_phases = phases
+        for phase, dur in phases.items():
+            eng.registry.histogram(
+                "scheduler_phase_duration_seconds").observe(dur, (phase,))
 
         # --- host tail: sequential cycle over the host roots ---
         host_cqs = np.nonzero(has_head & ~cq_on_device)[0]

@@ -38,6 +38,15 @@ def make_handler(engine):
                 from kueue_tpu.visibility.dashboard import DASHBOARD_HTML
                 self._send(DASHBOARD_HTML, content_type="text/html")
             elif path == "/metrics":
+                # Refresh the resource/cohort gauge families so a scrape
+                # always sees current usage (the reference updates them
+                # on cache reconcile). The refresh races the scheduling
+                # thread's dict mutations; on a collision serve the
+                # previous aggregates rather than failing the scrape.
+                try:
+                    engine.sync_resource_metrics()
+                except RuntimeError:
+                    pass
                 self._send(engine.registry.render(),
                            content_type="text/plain")
             elif path == "/healthz":

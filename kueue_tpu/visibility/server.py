@@ -82,4 +82,9 @@ def dump_state(engine) -> dict:
             "usage": {f"{fr.flavor}/{fr.resource}": q
                       for fr, q in info.usage().items()},
         }
-    return {"queues": queues, "admitted": admitted}
+    return {"queues": queues, "admitted": admitted,
+            # Per-phase timings of the last cycle (scheduler.go:291-358):
+            # where a slow cycle went — encode vs device vs apply.
+            "lastCyclePhases": dict(engine.last_cycle_phases),
+            "unadmittedByReason": {
+                "/".join(k): v for k, v in engine.unadmitted.per_cq.items()}}

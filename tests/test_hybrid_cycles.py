@@ -238,3 +238,35 @@ def test_cross_cq_reclaim_on_device():
             == sorted(w.name for w in bat_wls if w.is_evicted))
     assert bat.oracle.cycles_on_device > 0
     assert bat.oracle.host_root_reasons.get("preemption-scope", 0) == 0
+
+
+def test_gated_head_demotes_to_host_and_blocks():
+    """A closed preemption gate must keep the device path from
+    preempting: the gated head demotes to the host cycle, which raises
+    BlockedOnPreemptionGates without evicting victims."""
+    from kueue_tpu.api.types import WorkloadConditionType
+
+    eng = make_engine(
+        oracle=True, n_cohorts=1, cqs_per_cohort=1, nominal=1000,
+        preemption_of=lambda i: ClusterQueuePreemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY))
+    filler = Workload(name="filler", queue_name="lq0",
+                      pod_sets=(PodSet("main", 1, {"cpu": 1000}),))
+    eng.submit(filler)
+    eng.schedule_once()
+    assert filler.is_admitted
+    eng.clock += 1
+    hi = Workload(name="hi", queue_name="lq0", priority=5,
+                  pod_sets=(PodSet("main", 1, {"cpu": 1000}),))
+    hi.ensure_preemption_gate("mk-gate")
+    eng.submit(hi)
+    eng.schedule_once()
+    assert not filler.is_evicted
+    assert hi.has_condition(
+        WorkloadConditionType.BLOCKED_ON_PREEMPTION_GATES)
+    assert eng.oracle.host_root_reasons.get("preemption-gated", 0) >= 1
+    # Opening the gate unblocks the preemption on later cycles.
+    hi.open_preemption_gate("mk-gate", eng.clock)
+    eng.queues.queue_inadmissible_workloads()
+    drain(eng)
+    assert filler.is_evicted and hi.is_admitted

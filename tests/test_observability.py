@@ -117,3 +117,115 @@ def test_feature_gates():
     features.reset()
     assert not features.enabled("ConcurrentAdmission")
     assert not features.enabled("SomeUnknownGate")
+
+
+def test_unadmitted_per_reason_bookkeeping():
+    """unadmitted_workloads.go: per-CQ per-reason gauges track pending
+    workloads through their lifecycle."""
+    eng = make_engine()
+    w_ok = submit(eng, "ok", 500)
+    w_big = submit(eng, "big", 5000)  # exceeds quota -> NoFit
+    assert eng.unadmitted.count_for_cq("cq", "NoReservation") == 2
+    eng.schedule_once()
+    eng.schedule_once()
+    # ok admitted (removed); big requeued inadmissible with NoFit.
+    assert eng.unadmitted.count_for_cq("cq", "NoReservation") == 0
+    assert eng.unadmitted.count_for_cq("cq", "NoFit") == 1
+    assert eng.registry.gauge("unadmitted_workloads").get(
+        ("cq", "NoFit", "")) == 1
+    eng.finish(w_big.key)
+    assert eng.unadmitted.count_for_cq("cq") == 0
+
+
+def test_lifecycle_metric_families_populated():
+    eng = make_engine()
+    wl = submit(eng, "w", 500)
+    eng.schedule_once()
+    assert wl.is_admitted
+    lq = ("default/lq",)
+    r = eng.registry
+    assert r.counter("local_queue_admitted_workloads_total").get(lq) == 1
+    assert r.counter("local_queue_quota_reserved_workloads_total").get(lq) == 1
+    eng.evict(wl, "Preempted")
+    assert r.counter("local_queue_evicted_workloads_total").get(
+        lq + ("Preempted",)) == 1
+    assert r.counter("evicted_workloads_once_total").get(
+        ("cq", "Preempted")) == 1
+    eng.evict(eng.workloads["default/w"], "Preempted")  # not admitted: no-op-ish
+    # once_total stays 1 even if evicted again later.
+    assert r.counter("evicted_workloads_once_total").get(
+        ("cq", "Preempted")) == 1
+    assert r.histogram("workload_eviction_latency_seconds").totals[
+        ("cq", "Preempted")] >= 1
+    eng.schedule_once()
+    eng.finish(wl.key)
+    assert r.counter("finished_workloads_total").get(("cq", "Succeeded")) == 1
+
+
+def test_phase_timing_recorded():
+    eng = make_engine()
+    submit(eng, "w", 500)
+    eng.schedule_once()
+    assert set(eng.last_cycle_phases) == {"snapshot", "decide", "apply"}
+    assert all(v >= 0 for v in eng.last_cycle_phases.values())
+    h = eng.registry.histogram("scheduler_phase_duration_seconds")
+    assert h.totals[("decide",)] == 1
+
+
+def test_resource_and_cohort_gauges():
+    from kueue_tpu.api.types import Cohort
+
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cohort(Cohort("root"))
+    eng.create_cohort(Cohort("child", parent="root"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", cohort="child",
+        resource_groups=(ResourceGroup(
+            ("cpu",),
+            (FlavorQuotas("default",
+                          {"cpu": ResourceQuota(1000,
+                                                borrowing_limit=200)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    submit(eng, "w", 600)
+    submit(eng, "pend", 600)
+    eng.schedule_once()
+    eng.sync_resource_metrics()
+    g = eng.registry.gauge
+    assert g("cluster_queue_resource_usage").get(
+        ("cq", "default", "cpu")) == 600
+    assert g("cluster_queue_resource_reservation").get(
+        ("cq", "default", "cpu")) == 600
+    assert g("cluster_queue_nominal_quota").get(
+        ("cq", "default", "cpu")) == 1000
+    assert g("cluster_queue_borrowing_limit").get(
+        ("cq", "default", "cpu")) == 200
+    assert g("cluster_queue_resource_pending").get(("cq", "cpu")) == 600
+    assert g("local_queue_resource_usage").get(
+        ("default/lq", "default", "cpu")) == 600
+    assert g("reserving_active_workloads").get(("cq",)) == 1
+    assert g("cohort_subtree_quota").get(("child", "default", "cpu")) == 1000
+    assert g("cohort_subtree_resource_reservations").get(
+        ("child", "default", "cpu")) == 600
+    assert g("cohort_subtree_admitted_active_workloads").get(("child",)) == 1
+    assert g("cohort_info").get(("child", "root")) == 1
+    assert g("cluster_queue_info").get(("cq", "child")) == 1
+    # Render covers the new families without error.
+    text = eng.registry.render()
+    assert "kueue_tpu_cohort_subtree_quota" in text
+
+
+def test_resource_gauges_clear_when_sources_vanish():
+    eng = make_engine()
+    wl = submit(eng, "w", 500)
+    eng.schedule_once()
+    eng.sync_resource_metrics()
+    g = eng.registry.gauge
+    assert g("cluster_queue_resource_usage").get(
+        ("cq", "default", CPU)) == 500
+    eng.finish(wl.key)
+    eng.sync_resource_metrics()
+    assert g("cluster_queue_resource_usage").get(
+        ("cq", "default", CPU)) == 0
+    assert g("local_queue_resource_usage").get(
+        ("default/lq", "default", CPU)) == 0
