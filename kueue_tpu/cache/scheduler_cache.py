@@ -33,6 +33,9 @@ class Cache:
         self.workloads: dict[str, WorkloadInfo] = {}
         # workload_info.InfoOptions, set by the engine.
         self.info_options = None
+        # Hook returning the set of defined AdmissionCheck names
+        # (installed by AdmissionCheckManager); None = no check registry.
+        self.admission_check_names = None
 
     # -- object lifecycle --
 
@@ -98,9 +101,45 @@ class Cache:
 
     # -- snapshot (cache.go Snapshot / snapshot.go:161) --
 
+    def cq_inactive_reasons(self, cq) -> list[tuple[str, str]]:
+        """clusterqueue.go:300 (inactiveReason): why this CQ can't admit.
+        The single source of truth shared by scheduling (CQs with any
+        reason are excluded from the snapshot) and the status controller
+        (the Active condition). ``admission_check_names`` is a hook set
+        by the AdmissionCheckManager."""
+        reasons: list[tuple[str, str]] = []
+        if cq.stop_policy != StopPolicy.NONE:
+            reasons.append(("Stopped", "is stopped"))
+        missing = [fq.name for rg in cq.resource_groups
+                   for fq in rg.flavors
+                   if fq.name not in self.resource_flavors]
+        if missing:
+            reasons.append((
+                "FlavorNotFound",
+                f"references missing ResourceFlavor(s): {missing}"))
+        for rg in cq.resource_groups:
+            for fq in rg.flavors:
+                rf = self.resource_flavors.get(fq.name)
+                topo = getattr(rf, "topology_name", None) if rf else None
+                if topo and topo not in self.topologies:
+                    reasons.append((
+                        "TopologyNotFound",
+                        f"there is no Topology {topo!r} for TAS flavor "
+                        f"{fq.name!r}"))
+        if self.admission_check_names is not None and cq.admission_checks:
+            known = self.admission_check_names()
+            missing_checks = [c for c in cq.admission_checks
+                              if c not in known]
+            if missing_checks:
+                reasons.append((
+                    "AdmissionCheckNotFound",
+                    f"references missing AdmissionCheck(s): "
+                    f"{missing_checks}"))
+        return reasons
+
     def inactive_cluster_queues(self) -> set[str]:
         return {name for name, cq in self.cluster_queues.items()
-                if cq.stop_policy != StopPolicy.NONE}
+                if self.cq_inactive_reasons(cq)}
 
     def snapshot(self) -> Snapshot:
         return build_snapshot(

@@ -80,6 +80,10 @@ class Configuration:
         default_factory=MultiKueueConfigSpec)
     feature_gates: dict[str, bool] = field(default_factory=dict)
     resources: ResourcesConfig = field(default_factory=ResourcesConfig)
+    # objectRetentionPolicies.workloads (configuration_types.go:648),
+    # durations in seconds; None = keep forever.
+    retention_after_finished_seconds: Optional[float] = None
+    retention_after_deactivated_seconds: Optional[float] = None
     # oracle: the batched TPU decision path configuration
     oracle_enabled: bool = True
     oracle_max_depth: int = 4
@@ -138,6 +142,22 @@ def load(path: str) -> Configuration:
     return cfg
 
 
+def _duration_seconds(value) -> Optional[float]:
+    """Accepts a number of seconds or a Go-style duration string
+    ("300s", "5m", "1h30m")."""
+    if value is None:
+        return None
+    if isinstance(value, (int, float)):
+        return float(value)
+    import re
+
+    total = 0.0
+    for qty, unit in re.findall(r"([\d.]+)(ms|h|m|s)", str(value)):
+        total += float(qty) * {"h": 3600, "m": 60, "s": 1,
+                               "ms": 0.001}[unit]
+    return total
+
+
 def from_dict(raw: dict) -> Configuration:
     cfg = Configuration()
     cfg.namespace = raw.get("namespace", cfg.namespace)
@@ -165,6 +185,12 @@ def from_dict(raw: dict) -> Configuration:
         preemption_strategies=tuple(fs.get(
             "preemptionStrategies",
             FairSharingConfig().preemption_strategies)))
+    ret = ((raw.get("objectRetentionPolicies") or {})
+           .get("workloads") or {})
+    cfg.retention_after_finished_seconds = _duration_seconds(
+        ret.get("afterFinished"))
+    cfg.retention_after_deactivated_seconds = _duration_seconds(
+        ret.get("afterDeactivatedByKueue"))
     res = raw.get("resources") or {}
     cfg.resources = ResourcesConfig(
         exclude_resource_prefixes=tuple(

@@ -98,12 +98,20 @@ class AdmissionCheckManager:
         self.engine = engine
         self.checks: dict[str, AdmissionCheck] = {}
         engine.admission_checks = self
+        # CQs referencing undefined checks are inactive
+        # (inactiveReason AdmissionCheckNotFound).
+        engine.cache.admission_check_names = lambda: set(self.checks)
+
+    def _requeue_after_registry_change(self) -> None:
+        self.engine.queues.queue_inadmissible_workloads()
 
     def create_admission_check(self, check: AdmissionCheck) -> None:
         self.checks[check.name] = check
+        self._requeue_after_registry_change()
 
     def delete_admission_check(self, name: str) -> None:
         self.checks.pop(name, None)
+        self._requeue_after_registry_change()
 
     def required_for(self, cq_name: str) -> tuple[str, ...]:
         cq = self.engine.cache.cluster_queues.get(cq_name)

@@ -105,6 +105,9 @@ class Engine:
         self.afs = None
         # OracleBridge (batched TPU fast path), via attach_oracle().
         self.oracle = None
+        # StatusController attaches itself here (CQ/LQ status + object
+        # retention, controllers/status.py).
+        self.status_controller = None
         # WorkloadPriorityClass registry (workloadpriorityclass_types.go).
         self.workload_priority_classes: dict[str, int] = {}
         # Second-pass retry bookkeeping (second_pass_queue.go backoff).
@@ -122,6 +125,17 @@ class Engine:
         self.info_options = None
         if config is not None:
             self.set_info_options(config.info_options())
+            if (config.retention_after_finished_seconds is not None
+                    or config.retention_after_deactivated_seconds
+                    is not None):
+                from kueue_tpu.controllers.status import (
+                    StatusController,
+                    WorkloadRetentionPolicy,
+                )
+                StatusController(self, retention=WorkloadRetentionPolicy(
+                    after_finished=config.retention_after_finished_seconds,
+                    after_deactivated_by_kueue=config
+                    .retention_after_deactivated_seconds))
 
     def set_info_options(self, options) -> None:
         """Propagate workload_info.InfoOptions to every Info construction
@@ -196,6 +210,9 @@ class Engine:
 
     def create_resource_flavor(self, rf: ResourceFlavor) -> None:
         self.cache.add_or_update_resource_flavor(rf)
+        # A CQ may have been inactive for referencing this flavor
+        # (inactiveReason FlavorNotFound): re-queue parked workloads.
+        self.queues.queue_inadmissible_workloads()
         self._journal_obj("resource_flavor", rf)
 
     def create_local_queue(self, lq: LocalQueue) -> None:
@@ -204,6 +221,7 @@ class Engine:
 
     def create_topology(self, topology) -> None:
         self.cache.add_or_update_topology(topology)
+        self.queues.queue_inadmissible_workloads()
         self._journal_obj("topology", topology)
 
     def create_node(self, node) -> None:
@@ -430,6 +448,8 @@ class Engine:
                 wl.active = False
                 self.evict(wl, "MaximumExecutionTimeExceeded",
                            requeue=False)
+        if self.status_controller is not None:
+            self.status_controller.sweep_retention()
 
     def attach_oracle(self, max_depth: int = 4,
                       remote_address: Optional[tuple] = None) -> None:
@@ -557,32 +577,23 @@ class Engine:
     def sync_resource_metrics(self) -> None:
         """Refresh the per-CQ / per-LQ / cohort resource and share gauges
         from a fresh snapshot (the metrics.go:796-948 families; the
-        reference's cache controllers update these on reconcile)."""
+        reference's cache controllers update these on reconcile). All
+        values are collected into fresh tables first and swapped into the
+        registry at the end: an exception mid-collection leaves the
+        previous aggregates intact, and stale series for deleted objects
+        vanish on swap."""
+        from collections import defaultdict
+
         from kueue_tpu.cache.snapshot import dominant_resource_share
 
         snap = self.cache.snapshot()
-        g = self.registry.gauge
-        # These families are owned by this sync: clear so series for
-        # drained queues / finished workloads / deleted objects vanish
-        # rather than reporting the last non-zero value forever.
-        for fam in ("cluster_queue_info", "cluster_queue_resource_usage",
-                    "cluster_queue_resource_reservation",
-                    "cluster_queue_resource_pending",
-                    "cluster_queue_nominal_quota",
-                    "cluster_queue_borrowing_limit",
-                    "cluster_queue_lending_limit",
-                    "cluster_queue_weighted_share",
-                    "local_queue_resource_usage",
-                    "local_queue_resource_reservation",
-                    "reserving_active_workloads", "cohort_info",
-                    "cohort_subtree_quota",
-                    "cohort_subtree_resource_reservations",
-                    "cohort_subtree_admitted_active_workloads",
-                    "cohort_weighted_share"):
-            g(fam).clear()
+        fams: dict[str, dict] = defaultdict(dict)
 
+        lq_pending: dict = {}
+        lq_reserving: dict = {}
+        lq_admitted: dict = {}
         for name, cqs in snap.cluster_queues.items():
-            g("cluster_queue_info").set((name, cqs.spec.cohort or ""), 1)
+            fams["cluster_queue_info"][(name, cqs.spec.cohort or "")] = 1
             # Reservation = every quota-reserved workload's usage;
             # usage = admitted-only (metrics.go:796,814).
             admitted_usage: dict = {}
@@ -592,10 +603,13 @@ class Engine:
             lq_usage: dict = {}
             for key, info in cqs.workloads.items():
                 wl = self.workloads.get(key)
-                lq = (f"{info.obj.namespace}/{info.obj.queue_name}")
+                lq = f"{info.obj.namespace}/{info.obj.queue_name}"
                 is_admitted = wl is not None and wl.is_admitted
                 reserving += 1
-                admitted_n += 1 if is_admitted else 0
+                lq_reserving[lq] = lq_reserving.get(lq, 0) + 1
+                if is_admitted:
+                    admitted_n += 1
+                    lq_admitted[lq] = lq_admitted.get(lq, 0) + 1
                 for fr, v in info.usage().items():
                     lq_reservation[(lq, fr)] = \
                         lq_reservation.get((lq, fr), 0) + v
@@ -603,64 +617,97 @@ class Engine:
                         admitted_usage[fr] = admitted_usage.get(fr, 0) + v
                         lq_usage[(lq, fr)] = lq_usage.get((lq, fr), 0) + v
             for fr, v in cqs.node.usage.items():
-                g("cluster_queue_resource_reservation").set(
-                    (name, fr.flavor, fr.resource), v)
+                fams["cluster_queue_resource_reservation"][
+                    (name, fr.flavor, fr.resource)] = v
             for fr, v in admitted_usage.items():
-                g("cluster_queue_resource_usage").set(
-                    (name, fr.flavor, fr.resource), v)
+                fams["cluster_queue_resource_usage"][
+                    (name, fr.flavor, fr.resource)] = v
             for (lq, fr), v in lq_reservation.items():
-                g("local_queue_resource_reservation").set(
-                    (lq, fr.flavor, fr.resource), v)
+                fams["local_queue_resource_reservation"][
+                    (lq, fr.flavor, fr.resource)] = v
             for (lq, fr), v in lq_usage.items():
-                g("local_queue_resource_usage").set(
-                    (lq, fr.flavor, fr.resource), v)
-            g("reserving_active_workloads").set((name,), reserving)
+                fams["local_queue_resource_usage"][
+                    (lq, fr.flavor, fr.resource)] = v
+            fams["reserving_active_workloads"][(name,)] = reserving
             for fr, q in cqs.node.quotas.items():
-                g("cluster_queue_nominal_quota").set(
-                    (name, fr.flavor, fr.resource), q.nominal)
+                fams["cluster_queue_nominal_quota"][
+                    (name, fr.flavor, fr.resource)] = q.nominal
                 if q.borrowing_limit is not None:
-                    g("cluster_queue_borrowing_limit").set(
-                        (name, fr.flavor, fr.resource), q.borrowing_limit)
+                    fams["cluster_queue_borrowing_limit"][
+                        (name, fr.flavor, fr.resource)] = q.borrowing_limit
                 if q.lending_limit is not None:
-                    g("cluster_queue_lending_limit").set(
-                        (name, fr.flavor, fr.resource), q.lending_limit)
-            # Pending per resource (metrics.go:805).
+                    fams["cluster_queue_lending_limit"][
+                        (name, fr.flavor, fr.resource)] = q.lending_limit
+            # Pending per resource + per LocalQueue (metrics.go:805,409).
             pcq = self.queues.cluster_queues.get(name)
             if pcq is not None:
                 pending: dict = {}
                 for info in list(pcq.items.values()) \
                         + list(pcq.inadmissible.values()):
+                    lq = f"{info.obj.namespace}/{info.obj.queue_name}"
+                    lq_pending[lq] = lq_pending.get(lq, 0) + 1
                     for psr in info.total_requests:
                         for res, v in psr.requests.items():
                             pending[res] = pending.get(res, 0) + v
                 for res, v in pending.items():
-                    g("cluster_queue_resource_pending").set(
-                        (name, res), v)
+                    fams["cluster_queue_resource_pending"][(name, res)] = v
             drs = dominant_resource_share(cqs, None)
             share = (drs.precise_weighted_share()
                      if cqs.fair_weight else drs.unweighted_ratio)
-            g("cluster_queue_weighted_share").set((name,), share)
+            fams["cluster_queue_weighted_share"][(name,)] = share
+
+        for lq, n in lq_pending.items():
+            fams["local_queue_pending_workloads"][(lq, "active")] = n
+        for lq, n in lq_reserving.items():
+            fams["local_queue_reserving_active_workloads"][(lq,)] = n
+        for lq, n in lq_admitted.items():
+            fams["local_queue_admitted_active_workloads"][(lq,)] = n
+        if self.afs is not None:
+            for lq, entry in self.afs.usage.items():
+                fams["local_queue_admission_fair_sharing_usage"][(lq,)] = \
+                    self.afs.current_usage(lq)
 
         for name, cohort in snap.cohorts.items():
-            g("cohort_info").set(
-                (name, cohort.parent.name if cohort.parent else ""), 1)
+            fams["cohort_info"][
+                (name, cohort.parent.name if cohort.parent else "")] = 1
             for fr, v in cohort.node.subtree_quota.items():
-                g("cohort_subtree_quota").set(
-                    (name, fr.flavor, fr.resource), v)
+                fams["cohort_subtree_quota"][
+                    (name, fr.flavor, fr.resource)] = v
             for fr, v in cohort.node.usage.items():
-                g("cohort_subtree_resource_reservations").set(
-                    (name, fr.flavor, fr.resource), v)
+                fams["cohort_subtree_resource_reservations"][
+                    (name, fr.flavor, fr.resource)] = v
             admitted = sum(
                 1 for cqs in cohort.subtree_cluster_queues()
                 for key in cqs.workloads
                 if (w := self.workloads.get(key)) is not None
                 and w.is_admitted)
-            g("cohort_subtree_admitted_active_workloads").set(
-                (name,), admitted)
+            fams["cohort_subtree_admitted_active_workloads"][
+                (name,)] = admitted
             drs = dominant_resource_share(cohort, None)
             share = (drs.precise_weighted_share()
                      if cohort.fair_weight else drs.unweighted_ratio)
-            g("cohort_weighted_share").set((name,), share)
+            fams["cohort_weighted_share"][(name,)] = share
+
+        # Atomic swap per family (empty tables drop stale series too).
+        for fam in ("cluster_queue_info", "cluster_queue_resource_usage",
+                    "cluster_queue_resource_reservation",
+                    "cluster_queue_resource_pending",
+                    "cluster_queue_nominal_quota",
+                    "cluster_queue_borrowing_limit",
+                    "cluster_queue_lending_limit",
+                    "cluster_queue_weighted_share",
+                    "local_queue_resource_usage",
+                    "local_queue_resource_reservation",
+                    "local_queue_pending_workloads",
+                    "local_queue_reserving_active_workloads",
+                    "local_queue_admitted_active_workloads",
+                    "local_queue_admission_fair_sharing_usage",
+                    "reserving_active_workloads", "cohort_info",
+                    "cohort_subtree_quota",
+                    "cohort_subtree_resource_reservations",
+                    "cohort_subtree_admitted_active_workloads",
+                    "cohort_weighted_share"):
+            self.registry.gauge(fam).values = fams.get(fam, {})
 
     def run_until_quiescent(self, max_cycles: int = 10_000) -> int:
         """Drive cycles until no progress is possible (tests/bench)."""

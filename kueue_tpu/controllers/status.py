@@ -1,0 +1,212 @@
+"""CQ/LQ status controllers and finished-object retention.
+
+Reference: pkg/controller/core/clusterqueue_controller.go:505
+(updateCqStatusIfChanged — flavorsReservation/flavorsUsage/pending/
+reserving/admitted counts + the Active condition whose reasons come from
+pkg/cache/scheduler/clusterqueue.go:300 inactiveReason),
+localqueue_controller.go (the LocalQueue mirror), and the
+objectRetentionPolicies sweep (workload_controller.go retention:
+finished / deactivated-by-kueue workloads deleted after a grace period).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.api.types import StopPolicy
+
+
+@dataclass
+class QueueStatus:
+    """The shared shape of ClusterQueueStatus / LocalQueueStatus
+    (clusterqueue_types.go:369-392, localqueue_types.go)."""
+
+    pending_workloads: int = 0
+    reserving_workloads: int = 0
+    admitted_workloads: int = 0
+    # flavor -> resource -> total quantity
+    flavors_reservation: dict[str, dict[str, int]] = field(
+        default_factory=dict)
+    flavors_usage: dict[str, dict[str, int]] = field(default_factory=dict)
+    active: bool = True
+    active_reason: str = "Ready"
+    active_message: str = "Can admit new workloads"
+    weighted_share: Optional[float] = None
+
+
+@dataclass
+class WorkloadRetentionPolicy:
+    """configuration_types.go:656 (WorkloadRetentionPolicy), seconds."""
+
+    after_finished: Optional[float] = None
+    after_deactivated_by_kueue: Optional[float] = None
+
+
+class StatusController:
+    """Computes and publishes CQ/LQ statuses; owns the retention sweep."""
+
+    def __init__(self, engine,
+                 retention: Optional[WorkloadRetentionPolicy] = None,
+                 attach: bool = True):
+        """``attach=False`` builds a read-only view (used by the HTTP
+        status endpoints) that must not install itself on the engine."""
+        self.engine = engine
+        self.retention = retention
+        self.cq_statuses: dict[str, QueueStatus] = {}
+        self.lq_statuses: dict[str, QueueStatus] = {}
+        if attach:
+            engine.status_controller = self
+
+    # -- activeness (clusterqueue.go:300 inactiveReason) --
+
+    def cq_active_condition(self, cq) -> tuple[bool, str, str]:
+        """Delegates to the cache's single source of inactive reasons so
+        the status surface can never disagree with what the scheduler
+        actually excludes."""
+        reasons = self.engine.cache.cq_inactive_reasons(cq)
+        if reasons:
+            return (False, reasons[0][0],
+                    "Can't admit new workloads: "
+                    + ", ".join(m for _, m in reasons))
+        return True, "Ready", "Can admit new workloads"
+
+    # -- status computation (clusterqueue_controller.go:505) --
+
+    def cq_status(self, name: str) -> Optional[QueueStatus]:
+        eng = self.engine
+        cq = eng.cache.cluster_queues.get(name)
+        if cq is None:
+            return None
+        st = QueueStatus()
+        pcq = eng.queues.cluster_queues.get(name)
+        if pcq is not None:
+            st.pending_workloads = len(pcq.items) + len(pcq.inadmissible)
+        for key, info in eng.cache.workloads.items():
+            if info.cluster_queue != name:
+                continue
+            wl = eng.workloads.get(key)
+            admitted = wl is not None and wl.is_admitted
+            st.reserving_workloads += 1
+            st.admitted_workloads += 1 if admitted else 0
+            for fr, v in info.usage().items():
+                st.flavors_reservation.setdefault(
+                    fr.flavor, {}).setdefault(fr.resource, 0)
+                st.flavors_reservation[fr.flavor][fr.resource] += v
+                if admitted:
+                    st.flavors_usage.setdefault(
+                        fr.flavor, {}).setdefault(fr.resource, 0)
+                    st.flavors_usage[fr.flavor][fr.resource] += v
+        st.active, st.active_reason, st.active_message = \
+            self.cq_active_condition(cq)
+        if cq.fair_sharing is not None:
+            from kueue_tpu.cache.snapshot import dominant_resource_share
+
+            snap = eng.cache.snapshot()
+            node = snap.cluster_queues.get(name)
+            if node is not None:
+                st.weighted_share = dominant_resource_share(
+                    node, None).unweighted_ratio
+        return st
+
+    def lq_status(self, key: str) -> Optional[QueueStatus]:
+        """localqueue_controller.go status: the LQ-scoped mirror."""
+        eng = self.engine
+        lq = eng.queues.local_queues.get(key)
+        if lq is None:
+            return None
+        st = QueueStatus()
+        cq = eng.cache.cluster_queues.get(lq.cluster_queue)
+        if cq is None:
+            st.active = False
+            st.active_reason = "ClusterQueueDoesNotExist"
+            st.active_message = "Can't submit new workloads to clusterQueue"
+        else:
+            ok, reason, _ = self.cq_active_condition(cq)
+            if not ok:
+                st.active = False
+                st.active_reason = "ClusterQueueIsInactive"
+                st.active_message = \
+                    "Can't submit new workloads to clusterQueue"
+            if lq.stop_policy != StopPolicy.NONE:
+                st.active = False
+                st.active_reason = "Stopped"
+                st.active_message = "LocalQueue is stopped"
+        pcq = eng.queues.cluster_queues.get(lq.cluster_queue)
+        if pcq is not None:
+            for info in list(pcq.items.values()) \
+                    + list(pcq.inadmissible.values()):
+                if f"{info.obj.namespace}/{info.obj.queue_name}" == key:
+                    st.pending_workloads += 1
+        for wkey, info in eng.cache.workloads.items():
+            wl = eng.workloads.get(wkey)
+            if wl is None or f"{wl.namespace}/{wl.queue_name}" != key:
+                continue
+            st.reserving_workloads += 1
+            st.admitted_workloads += 1 if wl.is_admitted else 0
+            for fr, v in info.usage().items():
+                st.flavors_reservation.setdefault(
+                    fr.flavor, {}).setdefault(fr.resource, 0)
+                st.flavors_reservation[fr.flavor][fr.resource] += v
+                if wl.is_admitted:
+                    st.flavors_usage.setdefault(
+                        fr.flavor, {}).setdefault(fr.resource, 0)
+                    st.flavors_usage[fr.flavor][fr.resource] += v
+        return st
+
+    def reconcile_all(self) -> None:
+        """Refresh every CQ/LQ status + the status gauges."""
+        g = self.engine.registry.gauge
+        g("cluster_queue_status").clear()
+        g("local_queue_status").clear()
+        self.cq_statuses = {
+            name: self.cq_status(name)
+            for name in self.engine.cache.cluster_queues}
+        for name, st in self.cq_statuses.items():
+            g("cluster_queue_status").set(
+                (name, "active" if st.active else "inactive"), 1)
+        self.lq_statuses = {
+            key: self.lq_status(key)
+            for key in self.engine.queues.local_queues}
+        for key, st in self.lq_statuses.items():
+            g("local_queue_status").set(
+                (key, "active" if st.active else "inactive"), 1)
+
+    # -- retention sweep (objectRetentionPolicies) --
+
+    def sweep_retention(self) -> list[str]:
+        """Delete finished workloads past afterFinished and
+        kueue-deactivated ones past afterDeactivatedByKueue
+        (workload_controller.go retention handling). Returns deleted
+        keys."""
+        if self.retention is None:
+            return []
+        eng = self.engine
+        deleted = []
+        for key, wl in list(eng.workloads.items()):
+            if wl.is_finished and self.retention.after_finished is not None:
+                fin = wl.condition("Finished")
+                if fin and eng.clock - fin.last_transition_time \
+                        >= self.retention.after_finished:
+                    deleted.append(key)
+                    continue
+            if (not wl.active and not wl.is_finished
+                    and self.retention.after_deactivated_by_kueue
+                    is not None):
+                ev = wl.condition("Evicted")
+                if ev and ev.reason in (
+                        "AdmissionCheckRejected", "DeactivatedDueToRequeuingLimitExceeded",
+                        "MaximumExecutionTimeExceeded") \
+                        and eng.clock - ev.last_transition_time \
+                        >= self.retention.after_deactivated_by_kueue:
+                    deleted.append(key)
+        for key in deleted:
+            wl = eng.workloads.pop(key)
+            eng.cache.delete_workload(key)
+            eng.queues.delete_workload(wl)
+            eng.unadmitted.remove(key)
+            eng._evicted_once.discard(wl.uid)
+            if eng.journal is not None:
+                eng.journal.delete("workload", key, ts=eng.clock)
+            eng._event("Deleted", key, detail="retention")
+        return deleted

@@ -156,6 +156,8 @@ class MetricsRegistry:
         g("cluster_queue_info", "cohort membership per CQ")
         g("build_info", "framework build identity")
         c("ready_wait_time_seconds_total", "admitted->ready")
+        self.gauge("build_info").set(
+            (("name", "kueue_tpu"), ("version", "0.2.0")), 1)
 
     def _counter(self, name, help=""):
         self._metrics[name] = Counter(name, help)

@@ -34,7 +34,7 @@ DASHBOARD_HTML = """<!DOCTYPE html>
 <h2>ClusterQueues</h2>
 <table id="cqs"><thead><tr>
   <th>Name</th><th>Cohort</th><th>Pending</th><th>Admitted</th>
-  <th>Usage</th></tr></thead><tbody></tbody></table>
+  <th>Usage</th><th>Active</th></tr></thead><tbody></tbody></table>
 <h2>Workloads</h2>
 <table id="wls"><thead><tr>
   <th>Key</th><th>Queue</th><th>Status</th><th>Priority</th>
@@ -70,9 +70,23 @@ async function refresh() {
             it.position_in_cluster_queue;
       } catch (e) {}
     }
-    fill("#cqs", cqs.map(c => [c.name, c.cohort || "-",
-      c.pending ?? "-", c.admitted ?? "-",
-      JSON.stringify(c.usage || {})]));
+    const statuses = {};
+    for (const cq of cqs) {
+      try {
+        statuses[cq.name] =
+          await getJSON("/clusterqueues/" + cq.name + "/status");
+      } catch (e) {}
+    }
+    fill("#cqs", cqs.map(c => {
+      const st = statuses[c.name] || {};
+      const act = st.active === false
+        ? {text: st.active_reason || "inactive", cls: "phase-Evicted"}
+        : "active";
+      return [c.name, c.cohort || "-",
+        st.pending_workloads ?? c.pending ?? "-",
+        st.admitted_workloads ?? c.admitted ?? "-",
+        JSON.stringify(st.flavors_usage || c.usage || {}), act];
+    }));
     fill("#wls", wls.map(w => {
       const key = (w.namespace || "default") + "/" + w.name;
       return [key, w.queue || w.local_queue || "-",

@@ -58,6 +58,22 @@ def make_handler(engine):
                 self._send(json.dumps(
                     Kueuectl(engine).list_cluster_queues()))
             elif (parts[:1] == ["clusterqueues"] and len(parts) == 3
+                    and parts[2] == "status"):
+                from kueue_tpu.controllers.status import StatusController
+                sc = engine.status_controller or StatusController(
+                    engine, attach=False)
+                st = sc.cq_status(parts[1])
+                self._send(json.dumps(
+                    vars(st) if st is not None else None))
+            elif (parts[:1] == ["localqueues"] and len(parts) == 4
+                    and parts[3] == "status"):
+                from kueue_tpu.controllers.status import StatusController
+                sc = engine.status_controller or StatusController(
+                    engine, attach=False)
+                st = sc.lq_status(f"{parts[1]}/{parts[2]}")
+                self._send(json.dumps(
+                    vars(st) if st is not None else None))
+            elif (parts[:1] == ["clusterqueues"] and len(parts) == 3
                     and parts[2] == "pendingworkloads"):
                 s = vis.pending_workloads_for_cq(parts[1])
                 self._send(json.dumps({

@@ -1,0 +1,132 @@
+"""CQ/LQ status controllers (clusterqueue_controller.go:505,
+localqueue_controller.go) and objectRetentionPolicies sweeps."""
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    StopPolicy,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.controllers.status import (
+    StatusController,
+    WorkloadRetentionPolicy,
+)
+
+CPU = "cpu"
+
+
+def make_engine():
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(1000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng
+
+
+def submit(eng, name, cpu, lq="lq"):
+    eng.clock += 0.1
+    wl = Workload(name=name, queue_name=lq,
+                  pod_sets=(PodSet("main", 1, {CPU: cpu}),))
+    eng.submit(wl)
+    return wl
+
+
+def test_cq_status_counts_and_usage():
+    eng = make_engine()
+    sc = StatusController(eng)
+    submit(eng, "a", 400)
+    submit(eng, "b", 400)
+    submit(eng, "c", 400)  # won't fit
+    eng.run_until_quiescent()
+    st = sc.cq_status("cq")
+    assert st.admitted_workloads == 2
+    assert st.reserving_workloads == 2
+    assert st.pending_workloads == 1
+    assert st.flavors_usage == {"default": {CPU: 800}}
+    assert st.flavors_reservation == {"default": {CPU: 800}}
+    assert st.active and st.active_reason == "Ready"
+    lst = sc.lq_status("default/lq")
+    assert lst.admitted_workloads == 2 and lst.pending_workloads == 1
+    assert lst.flavors_usage == {"default": {CPU: 800}}
+    sc.reconcile_all()
+    assert eng.registry.gauge("cluster_queue_status").get(
+        ("cq", "active")) == 1
+
+
+def test_cq_inactive_on_missing_flavor_blocks_admission():
+    """clusterqueue.go:300: a CQ referencing a missing ResourceFlavor is
+    inactive — FlavorNotFound condition AND no admission."""
+    eng = Engine()
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("ghost", {CPU: ResourceQuota(1000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    sc = StatusController(eng)
+    wl = submit(eng, "w", 100)
+    eng.schedule_once()
+    assert not wl.is_admitted
+    st = sc.cq_status("cq")
+    assert not st.active and st.active_reason == "FlavorNotFound"
+    lst = sc.lq_status("default/lq")
+    assert not lst.active and lst.active_reason == "ClusterQueueIsInactive"
+    # Creating the flavor re-activates, requeues, and admits.
+    eng.create_resource_flavor(ResourceFlavor("ghost"))
+    eng.schedule_once()
+    assert wl.is_admitted
+    assert sc.cq_status("cq").active
+
+
+def test_lq_stopped_condition():
+    eng = make_engine()
+    sc = StatusController(eng)
+    eng.queues.local_queues["default/lq"].stop_policy = StopPolicy.HOLD
+    st = sc.lq_status("default/lq")
+    assert not st.active and st.active_reason == "Stopped"
+
+
+def test_retention_sweep_deletes_finished_workloads():
+    eng = make_engine()
+    StatusController(eng, retention=WorkloadRetentionPolicy(
+        after_finished=60.0))
+    wl = submit(eng, "w", 400)
+    keep = submit(eng, "keep", 400)
+    eng.run_until_quiescent()
+    eng.finish(wl.key)
+    eng.tick(30.0)
+    assert wl.key in eng.workloads  # within retention
+    eng.tick(31.0)
+    assert wl.key not in eng.workloads  # swept
+    assert keep.key in eng.workloads  # running workloads untouched
+    assert any(e.kind == "Deleted" for e in eng.events)
+
+
+def test_retention_sweep_deactivated_by_kueue():
+    eng = make_engine()
+    StatusController(eng, retention=WorkloadRetentionPolicy(
+        after_deactivated_by_kueue=10.0))
+    wl = submit(eng, "w", 400)
+    wl.maximum_execution_time_seconds = 5
+    eng.run_until_quiescent()
+    eng.tick(6.0)  # exceeds max execution time -> deactivated eviction
+    assert not wl.active and not wl.is_finished
+    eng.tick(11.0)
+    assert wl.key not in eng.workloads
+
+
+def test_retention_config_parsing():
+    from kueue_tpu.config.api import from_dict
+
+    cfg = from_dict({"objectRetentionPolicies": {"workloads": {
+        "afterFinished": "1h30m", "afterDeactivatedByKueue": 120}}})
+    assert cfg.retention_after_finished_seconds == 5400.0
+    assert cfg.retention_after_deactivated_seconds == 120.0
