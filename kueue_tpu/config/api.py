@@ -151,11 +151,15 @@ def _duration_seconds(value) -> Optional[float]:
         return float(value)
     import re
 
-    total = 0.0
-    for qty, unit in re.findall(r"([\d.]+)(ms|h|m|s)", str(value)):
-        total += float(qty) * {"h": 3600, "m": 60, "s": 1,
-                               "ms": 0.001}[unit]
-    return total
+    text = str(value)
+    parts = re.findall(r"([\d.]+)(ms|h|m|s)", text)
+    # An unparseable duration must NOT silently become 0 (instant
+    # deletion); treat it as unset, the safe direction.
+    if not parts or "".join(q + u for q, u in parts) != text:
+        return None
+    return sum(float(qty) * {"h": 3600.0, "m": 60.0, "s": 1.0,
+                             "ms": 0.001}[unit]
+               for qty, unit in parts)
 
 
 def from_dict(raw: dict) -> Configuration:

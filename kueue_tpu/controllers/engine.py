@@ -642,13 +642,15 @@ class Engine:
             pcq = self.queues.cluster_queues.get(name)
             if pcq is not None:
                 pending: dict = {}
-                for info in list(pcq.items.values()) \
-                        + list(pcq.inadmissible.values()):
-                    lq = f"{info.obj.namespace}/{info.obj.queue_name}"
-                    lq_pending[lq] = lq_pending.get(lq, 0) + 1
-                    for psr in info.total_requests:
-                        for res, v in psr.requests.items():
-                            pending[res] = pending.get(res, 0) + v
+                for status, table in (("active", pcq.items),
+                                      ("inadmissible", pcq.inadmissible)):
+                    for info in list(table.values()):
+                        lq = f"{info.obj.namespace}/{info.obj.queue_name}"
+                        lq_pending[(lq, status)] = \
+                            lq_pending.get((lq, status), 0) + 1
+                        for psr in info.total_requests:
+                            for res, v in psr.requests.items():
+                                pending[res] = pending.get(res, 0) + v
                 for res, v in pending.items():
                     fams["cluster_queue_resource_pending"][(name, res)] = v
             drs = dominant_resource_share(cqs, None)
@@ -656,8 +658,8 @@ class Engine:
                      if cqs.fair_weight else drs.unweighted_ratio)
             fams["cluster_queue_weighted_share"][(name,)] = share
 
-        for lq, n in lq_pending.items():
-            fams["local_queue_pending_workloads"][(lq, "active")] = n
+        for (lq, status), n in lq_pending.items():
+            fams["local_queue_pending_workloads"][(lq, status)] = n
         for lq, n in lq_reserving.items():
             fams["local_queue_reserving_active_workloads"][(lq,)] = n
         for lq, n in lq_admitted.items():

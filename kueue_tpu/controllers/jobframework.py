@@ -240,10 +240,16 @@ class JobReconciler:
     """reconciler.go:286 (ReconcileGenericJob), driven by the engine."""
 
     def __init__(self, engine, integrations: IntegrationManager = None,
-                 manage_jobs_without_queue_name: bool = False):
+                 manage_jobs_without_queue_name: bool = False,
+                 webhooks=None):
+        """``webhooks``: an optional webhooks.jobwebhooks.JobWebhookRegistry
+        — when set, create_job/update_job run the per-framework
+        defaulting + validation layer first (the admission webhook in
+        front of the reconciler)."""
         self.engine = engine
         self.integrations = integrations or DEFAULT_INTEGRATIONS
         self.manage_all = manage_jobs_without_queue_name
+        self.webhooks = webhooks
         self.jobs: dict[str, GenericJob] = {}
         self.job_to_workload: dict[str, str] = {}
         self.workload_to_job: dict[str, str] = {}
@@ -261,9 +267,43 @@ class JobReconciler:
 
     # -- the job-side reconcile loop --
 
-    def create_job(self, job: GenericJob) -> None:
+    def create_job(self, job: GenericJob) -> list[str]:
+        """Returns webhook validation errors; on any, the job is
+        rejected (not registered), like an admission-webhook denial."""
+        if self.webhooks is not None:
+            errs = self.webhooks.admit_create(job)
+            if errs:
+                self.engine._event("JobRejected", job.key,
+                                   detail="; ".join(errs))
+                return errs
         self.jobs[job.key] = job
         self.reconcile(job)
+        return []
+
+    def update_job(self, job: GenericJob) -> list[str]:
+        """Webhook-validated replacement of a registered job object."""
+        old = self.jobs.get(job.key)
+        if old is None:
+            return self.create_job(job)
+        if self.webhooks is not None:
+            errs = self.webhooks.admit_update(old, job)
+            if errs:
+                self.engine._event("JobUpdateRejected", job.key,
+                                   detail="; ".join(errs))
+                return errs
+        self.jobs[job.key] = job
+        # A (suspended-only, webhook-enforced) queue move must follow
+        # through to the pending Workload (reconciler.go queue-name
+        # update handling), or the job and its workload diverge.
+        wl_key = self.job_to_workload.get(job.key)
+        if wl_key and old.queue_name != job.queue_name:
+            wl = self.engine.workloads.get(wl_key)
+            if wl is not None and not wl.is_finished:
+                self.engine.queues.delete_workload(wl)
+                wl.queue_name = job.queue_name
+                self.engine.queues.add_or_update_workload(wl)
+        self.reconcile(job)
+        return []
 
     def delete_job(self, job_key: str) -> None:
         job = self.jobs.pop(job_key, None)

@@ -73,7 +73,7 @@ class StatusController:
 
     # -- status computation (clusterqueue_controller.go:505) --
 
-    def cq_status(self, name: str) -> Optional[QueueStatus]:
+    def cq_status(self, name: str, snap=None) -> Optional[QueueStatus]:
         eng = self.engine
         cq = eng.cache.cluster_queues.get(name)
         if cq is None:
@@ -102,11 +102,17 @@ class StatusController:
         if cq.fair_sharing is not None:
             from kueue_tpu.cache.snapshot import dominant_resource_share
 
-            snap = eng.cache.snapshot()
+            if snap is None:
+                snap = eng.cache.snapshot()
             node = snap.cluster_queues.get(name)
             if node is not None:
-                st.weighted_share = dominant_resource_share(
-                    node, None).unweighted_ratio
+                drs = dominant_resource_share(node, None)
+                # Same formula as the cluster_queue_weighted_share gauge
+                # (engine.sync_resource_metrics) — the two surfaces must
+                # agree.
+                st.weighted_share = (drs.precise_weighted_share()
+                                     if node.fair_weight
+                                     else drs.unweighted_ratio)
         return st
 
     def lq_status(self, key: str) -> Optional[QueueStatus]:
@@ -159,8 +165,14 @@ class StatusController:
         g = self.engine.registry.gauge
         g("cluster_queue_status").clear()
         g("local_queue_status").clear()
+        # One snapshot shared across every CQ (snapshot construction is
+        # the expensive step; N CQs must not cost N snapshots).
+        snap = (self.engine.cache.snapshot()
+                if any(cq.fair_sharing is not None for cq in
+                       self.engine.cache.cluster_queues.values())
+                else None)
         self.cq_statuses = {
-            name: self.cq_status(name)
+            name: self.cq_status(name, snap=snap)
             for name in self.engine.cache.cluster_queues}
         for name, st in self.cq_statuses.items():
             g("cluster_queue_status").set(
@@ -194,8 +206,11 @@ class StatusController:
                     and self.retention.after_deactivated_by_kueue
                     is not None):
                 ev = wl.condition("Evicted")
+                # The kueue-initiated deactivation reasons (the analog of
+                # the reference's DeactivatedDueTo* family): each eviction
+                # site that also flips active=False.
                 if ev and ev.reason in (
-                        "AdmissionCheckRejected", "DeactivatedDueToRequeuingLimitExceeded",
+                        "AdmissionCheckRejected", "RequeuingLimitExceeded",
                         "MaximumExecutionTimeExceeded") \
                         and eng.clock - ev.last_transition_time \
                         >= self.retention.after_deactivated_by_kueue:

@@ -1,0 +1,194 @@
+"""Per-integration job webhooks: defaulting + validation.
+
+Reference: each job framework ships a ``<kind>_webhook.go``
+(pkg/controller/jobs/*/) layered over the shared helpers in
+pkg/controller/jobframework/{defaults,validation}.go. The behaviors
+mirrored here:
+
+Defaulting (defaults.go):
+  * default LocalQueue: a job with no queue name in a namespace that has
+    a LocalQueue literally named "default" joins it
+    (ApplyDefaultLocalQueue);
+  * suspend-on-create: any queue-managed job is created suspended so
+    kueue owns its start (ApplyDefaultForSuspend).
+
+Validation (validation.go):
+  * queue name must be a DNS-1123 label (ValidateQueueName);
+  * maximum execution time must be > 0 (validateCreateForMaxExecTime);
+  * queue name is immutable while the job is unsuspended
+    (validateUpdateForQueueName);
+  * prebuilt workload reference is immutable (validateUpdateForPrebuilt);
+  * priority is immutable while quota is held (suspended jobs may
+    change it — validateJobUpdateForWorkloadPriorityClassName);
+  * per-framework rules, e.g. batch/job partial admission:
+    0 < minParallelism < parallelism (job_webhook.go
+    validatePartialAdmissionCreate).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Callable
+
+_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+
+def _valid_queue_name(name: str) -> bool:
+    return bool(_DNS1123.match(name)) and len(name) <= 63
+
+
+# -- shared defaulting (jobframework/defaults.go) --
+
+
+def apply_default_local_queue(job, default_lq_exists: Callable[[str], bool],
+                              enabled: bool = True) -> None:
+    """ApplyDefaultLocalQueue: adopt the namespace's LocalQueue named
+    "default" when the job names none."""
+    if enabled and not job.queue_name \
+            and default_lq_exists(getattr(job, "namespace", "default")):
+        job.queue_name = "default"
+
+
+def apply_default_for_suspend(job, manage_jobs_without_queue_name: bool
+                              ) -> None:
+    """ApplyDefaultForSuspend: queue-managed jobs start suspended."""
+    managed = bool(job.queue_name) or manage_jobs_without_queue_name
+    if managed and not job.is_suspended():
+        job.suspend()
+
+
+# -- shared validation (jobframework/validation.go) --
+
+
+def validate_job_on_create(job) -> list[str]:
+    errs = []
+    if job.queue_name and not _valid_queue_name(job.queue_name):
+        errs.append(f"queue name {job.queue_name!r} is not a DNS-1123 "
+                    f"label")
+    max_exec = getattr(job, "maximum_execution_time_seconds", None)
+    if max_exec is not None and max_exec <= 0:
+        errs.append("maximum execution time should be greater than 0")
+    return errs
+
+
+def validate_job_on_update(old, new) -> list[str]:
+    errs = []
+    if old.queue_name != new.queue_name and not old.is_suspended():
+        errs.append("queue name is immutable while the job is "
+                    "unsuspended")
+    if getattr(old, "prebuilt_workload_name", None) != \
+            getattr(new, "prebuilt_workload_name", None):
+        errs.append("prebuilt workload is immutable")
+    if getattr(old, "priority", 0) != getattr(new, "priority", 0) \
+            and not old.is_suspended():
+        errs.append("priority is immutable while the job holds quota")
+    return errs
+
+
+# -- per-framework webhooks (pkg/controller/jobs/*/*_webhook.go) --
+
+
+@dataclass
+class JobWebhook:
+    """The generic webhook; framework-specific subclasses refine
+    extra_create_rules."""
+
+    kind: str = ""
+
+    def default(self, job, registry) -> None:
+        apply_default_local_queue(job, registry.default_lq_exists)
+        apply_default_for_suspend(job,
+                                  registry.manage_jobs_without_queue_name)
+
+    def validate_create(self, job) -> list[str]:
+        return validate_job_on_create(job) + self.extra_create_rules(job)
+
+    def validate_update(self, old, new) -> list[str]:
+        return validate_job_on_update(old, new)
+
+    def extra_create_rules(self, job) -> list[str]:
+        return []
+
+
+@dataclass
+class BatchJobWebhook(JobWebhook):
+    """jobs/job/job_webhook.go."""
+
+    kind: str = "batch/job"
+
+    def extra_create_rules(self, job) -> list[str]:
+        errs = []
+        # validatePartialAdmissionCreate: 0 < minParallelism < parallelism
+        min_p = getattr(job, "min_parallelism", None)
+        if min_p is not None:
+            if min_p <= 0:
+                errs.append("minimum parallelism must be positive")
+            elif min_p >= job.parallelism:
+                errs.append("minimum parallelism must be lower than "
+                            "parallelism")
+        # validateSyncCompletionCreate: completions must cover
+        # parallelism when partial admission syncs completions.
+        completions = getattr(job, "completions", None)
+        if min_p is not None and completions is not None \
+                and completions < job.parallelism:
+            errs.append("completions should be equal to parallelism when "
+                        "partial admission is used")
+        return errs
+
+
+@dataclass
+class JobSetWebhook(JobWebhook):
+    """jobs/jobset/jobset_webhook.go."""
+
+    kind: str = "jobset.x-k8s.io/jobset"
+
+    def extra_create_rules(self, job) -> list[str]:
+        if not getattr(job, "replicated_jobs", None):
+            return ["a JobSet needs at least one replicated job"]
+        names = [rj[0] for rj in job.replicated_jobs]
+        if len(set(names)) != len(names):
+            return ["replicated job names must be unique"]
+        return []
+
+
+class JobWebhookRegistry:
+    """Dispatches per-kind webhooks, the admission-webhook layer in front
+    of JobReconciler.create_job."""
+
+    def __init__(self, engine, integrations=None,
+                 manage_jobs_without_queue_name: bool = False,
+                 local_queue_defaulting: bool = True):
+        from kueue_tpu.controllers.jobframework import DEFAULT_INTEGRATIONS
+
+        self.engine = engine
+        self.integrations = integrations or DEFAULT_INTEGRATIONS
+        self.manage_jobs_without_queue_name = manage_jobs_without_queue_name
+        self.local_queue_defaulting = local_queue_defaulting
+        self.webhooks: dict[str, JobWebhook] = {
+            "batch/job": BatchJobWebhook(),
+            "jobset.x-k8s.io/jobset": JobSetWebhook(),
+        }
+        self._generic = JobWebhook()
+
+    def register(self, kind: str, webhook: JobWebhook) -> None:
+        self.webhooks[kind] = webhook
+
+    def default_lq_exists(self, namespace: str) -> bool:
+        if not self.local_queue_defaulting:
+            return False
+        return f"{namespace}/default" in self.engine.queues.local_queues
+
+    def webhook_for(self, job) -> JobWebhook:
+        kind = self.integrations.kind_of(job)
+        return self.webhooks.get(kind, self._generic)
+
+    def admit_create(self, job) -> list[str]:
+        """Default + ValidateCreate; returns validation errors (empty =
+        admitted)."""
+        hook = self.webhook_for(job)
+        hook.default(job, self)
+        return hook.validate_create(job)
+
+    def admit_update(self, old, new) -> list[str]:
+        return self.webhook_for(new).validate_update(old, new)

@@ -99,6 +99,18 @@ def validate_resource_flavor(rf: ResourceFlavor) -> list[str]:
     return errs
 
 
+def default_workload(wl: Workload) -> None:
+    """workload_webhook.go Default: drop minCounts when PartialAdmission
+    is gated off; name a sole anonymous PodSet "main"."""
+    from kueue_tpu.config import features
+
+    if not features.enabled("PartialAdmission"):
+        for ps in wl.pod_sets:
+            ps.min_count = None
+    if len(wl.pod_sets) == 1 and not wl.pod_sets[0].name:
+        wl.pod_sets[0].name = "main"
+
+
 def validate_workload(wl: Workload) -> list[str]:
     """workload_webhook.go: pod-set invariants."""
     errs = _name_errors(wl.name, "workload")

@@ -1,0 +1,156 @@
+"""Per-integration job webhook tests: defaulting (default LocalQueue,
+suspend-on-create) and validation (queue-name rules, immutability,
+partial-admission bounds) — jobframework/{defaults,validation}.go and
+the per-framework webhook files."""
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.controllers.jobframework import (
+    BatchJob,
+    JobReconciler,
+    JobSetJob,
+)
+from kueue_tpu.webhooks.jobwebhooks import JobWebhookRegistry
+
+CPU = "cpu"
+
+
+def make_stack(default_lq=False):
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(4000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    if default_lq:
+        eng.create_local_queue(LocalQueue("default", "default", "cq"))
+    rec = JobReconciler(eng, webhooks=JobWebhookRegistry(eng))
+    return eng, rec
+
+
+def test_default_local_queue_adoption():
+    eng, rec = make_stack(default_lq=True)
+    job = BatchJob(name="j", parallelism=1, requests={CPU: 100},
+                   suspended=False)
+    assert rec.create_job(job) == []
+    # Defaulted into the namespace's "default" LocalQueue + suspended.
+    assert job.queue_name == "default"
+    eng.schedule_once()
+    rec.reconcile_all()
+    assert not job.is_suspended()  # admitted and started by kueue
+
+
+def test_no_default_lq_no_adoption():
+    eng, rec = make_stack(default_lq=False)
+    job = BatchJob(name="j", parallelism=1, requests={CPU: 100})
+    rec.create_job(job)
+    assert job.queue_name == ""
+
+
+def test_suspend_on_create_for_queued_jobs():
+    eng, rec = make_stack()
+    job = BatchJob(name="j", queue_name="lq", parallelism=1,
+                   requests={CPU: 100}, suspended=False, active_pods=1)
+    rec.create_job(job)
+    assert job.is_suspended()  # webhook suspended it before admission
+
+
+def test_invalid_queue_name_rejected():
+    eng, rec = make_stack()
+    job = BatchJob(name="j", queue_name="Not_A_DNS_Label!",
+                   parallelism=1, requests={CPU: 100})
+    errs = rec.create_job(job)
+    assert errs and "DNS-1123" in errs[0]
+    assert job.key not in rec.jobs
+    assert any(e.kind == "JobRejected" for e in eng.events)
+
+
+def test_partial_admission_bounds():
+    eng, rec = make_stack()
+    bad = BatchJob(name="b", queue_name="lq", parallelism=4,
+                   min_parallelism=4, requests={CPU: 100})
+    assert any("lower than parallelism" in e
+               for e in rec.create_job(bad))
+    bad2 = BatchJob(name="b2", queue_name="lq", parallelism=4,
+                    min_parallelism=0, requests={CPU: 100})
+    assert any("positive" in e for e in rec.create_job(bad2))
+    ok = BatchJob(name="ok", queue_name="lq", parallelism=4,
+                  completions=4, min_parallelism=2, requests={CPU: 100})
+    assert rec.create_job(ok) == []
+
+
+def test_queue_name_immutable_while_unsuspended():
+    import copy
+
+    eng, rec = make_stack()
+    job = BatchJob(name="j", queue_name="lq", parallelism=1,
+                   requests={CPU: 100})
+    rec.create_job(job)
+    eng.schedule_once()
+    rec.reconcile_all()
+    assert not job.is_suspended()
+    moved = copy.deepcopy(job)
+    moved.queue_name = "lq2"
+    errs = rec.update_job(moved)
+    assert errs and "immutable" in errs[0]
+    assert rec.jobs[job.key].queue_name == "lq"
+    # Suspended jobs may move queues.
+    job.suspend()
+    moved2 = copy.deepcopy(job)
+    moved2.queue_name = "lq2"
+    assert rec.update_job(moved2) == []
+
+
+def test_jobset_webhook_rules():
+    eng, rec = make_stack()
+    empty = JobSetJob(name="js", queue_name="lq")
+    assert any("at least one" in e for e in rec.create_job(empty))
+    dup = JobSetJob(name="js2", queue_name="lq",
+                    replicated_jobs=[("a", 1, {CPU: 100}),
+                                     ("a", 2, {CPU: 100})])
+    assert any("unique" in e for e in rec.create_job(dup))
+
+
+def test_workload_defaulting_min_count_gated():
+    from kueue_tpu.api.types import PodSet, Workload
+    from kueue_tpu.config import features
+    from kueue_tpu.webhooks.validators import default_workload
+
+    wl = Workload(name="w", pod_sets=(PodSet("", 2, {CPU: 100},
+                                             min_count=1),))
+    features.set_feature("PartialAdmission", False)
+    try:
+        default_workload(wl)
+    finally:
+        features.reset()
+    assert wl.pod_sets[0].min_count is None
+    assert wl.pod_sets[0].name == "main"
+
+
+def test_suspended_queue_move_propagates_to_workload():
+    import copy
+
+    eng, rec = make_stack()
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq2", resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(4000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq2", "default", "cq2"))
+    job = BatchJob(name="j", queue_name="lq", parallelism=1,
+                   requests={CPU: 100})
+    rec.create_job(job)
+    moved = copy.deepcopy(job)
+    moved.queue_name = "lq2"
+    assert rec.update_job(moved) == []
+    wl = eng.workloads[rec.job_to_workload[job.key]]
+    assert wl.queue_name == "lq2"
+    eng.schedule_once()
+    assert wl.status.admission.cluster_queue == "cq2"
