@@ -416,6 +416,9 @@ class Workload:
     # Gates that must be Open before this workload may preempt others
     # (workload_types.go:86 preemptionGates).
     preemption_gates: tuple[str, ...] = ()
+    # Concurrent-admission variant pin: only this ResourceFlavor may be
+    # assigned (WorkloadAllowedResourceFlavorAnnotation).
+    allowed_resource_flavor: Optional[str] = None
     uid: str = ""
     status: WorkloadStatus = field(default_factory=WorkloadStatus)
 

@@ -39,6 +39,11 @@ def scheduling_hash(wl: Workload, cluster_queue: str) -> tuple:
     return (
         cluster_queue,
         wl.priority,
+        # A flavor-pinned variant schedules differently from its
+        # unpinned (or differently-pinned) siblings.
+        wl.allowed_resource_flavor,
+        # Closed preemption gates change schedulability too.
+        wl.has_closed_preemption_gate(),
         tuple(sorted(
             (ps.name, ps.count, tuple(sorted(ps.requests.items())),
              tuple(sorted(ps.node_selector.items())),

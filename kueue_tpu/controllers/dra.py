@@ -1,37 +1,97 @@
-"""DRA (Dynamic Resource Allocation) mapping.
+"""DRA (Dynamic Resource Allocation): claims, device classes, counters.
 
-Reference: pkg/dra — DeviceClass -> extended-resource mapping
-(extended_resource_cache.go:30, mapper.go) and per-workload ResourceClaim
-counting (claims.go). Workloads request devices via claims; the mapper
-translates them into the quota-space resource names the scheduler
-understands."""
+Reference: pkg/dra —
+  * ``ResourceMapper`` (mapper.go:36): DeviceClass -> logical extended
+    resource, populated from Configuration deviceClassMappings, with
+    optional counter definitions (per-device counter charges);
+  * claims (claims.go:58 countDevicesPerClass, :155
+    GetResourceRequestsForResourceClaimTemplates): a pod's claim
+    templates request N devices per class, optionally filtered by
+    selectors — the counts become quota-space requests;
+  * resource slices / pools (counters.go:224 poolInfo, :243
+    groupSlicesByPool): drivers publish device inventories in slices; a
+    pool is usable only when all its slices arrived;
+  * counter charges (counters.go:36 GetCounterResourcesForWorkload):
+    counter-based logical resources (e.g. gpu memory) are charged per
+    matched device from the pool's counter sets;
+  * workload integration (workload.go:625-645): claim-derived resources
+    replace the raw extended resources in each PodSet's effective
+    requests.
+
+The reference matches devices with CEL expressions; the rebuild uses
+plain attribute-equality selectors (CEL is a host-language detail, not
+framework behavior).
+"""
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Optional
 
 
 @dataclass
 class DeviceClass:
-    """A device class exposed as an extended resource."""
+    """A device class exposed as an extended resource, optionally
+    charging per-device counters (configapi DeviceClassMapping)."""
 
     name: str  # e.g. "tpu.google.com/v5e"
     extended_resource: str  # e.g. "tpu-v5e"
+    # counter name -> per-device charge (deviceClassCounterConfig).
+    counters: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class Device:
+    """One device in a ResourceSlice (resourcev1.Device)."""
+
+    name: str
+    attributes: dict[str, str] = field(default_factory=dict)
+    # counter set: counter name -> capacity this device consumes.
+    counters: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceSlice:
+    """A driver-published inventory shard (counters.go:243)."""
+
+    driver: str
+    pool: str
+    pool_slice_count: int  # total slices the pool publishes
+    devices: list[Device] = field(default_factory=list)
+
+
+@dataclass
+class DeviceRequest:
+    """One request inside a claim template (claims.go:47)."""
+
+    device_class: str
+    count: int = 1
+    # Attribute-equality selectors (the CEL analog).
+    selectors: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
 class ResourceClaim:
-    """A claim for N devices of a class (claims.go)."""
+    """A claim for devices (claims.go countDevicesPerClass input)."""
 
-    device_class: str
+    device_class: str = ""
     count: int = 1
+    requests: tuple[DeviceRequest, ...] = ()
+
+    def device_requests(self) -> list[DeviceRequest]:
+        if self.requests:
+            return list(self.requests)
+        return [DeviceRequest(self.device_class, self.count)]
 
 
 class DeviceClassMapper:
-    """extended_resource_cache.go + mapper.go."""
+    """mapper.go:36 (ResourceMapper) + the slice/pool inventory."""
 
     def __init__(self) -> None:
         self.classes: dict[str, DeviceClass] = {}
+        self.slices: list[ResourceSlice] = []
+
+    # -- registry (PopulateFromConfiguration) --
 
     def add_device_class(self, dc: DeviceClass) -> None:
         self.classes[dc.name] = dc
@@ -39,24 +99,106 @@ class DeviceClassMapper:
     def delete_device_class(self, name: str) -> None:
         self.classes.pop(name, None)
 
-    def resolve(self, claims: list[ResourceClaim]) -> dict[str, int]:
-        """Claims -> extended-resource requests; raises on unknown class."""
-        out: dict[str, int] = {}
-        for claim in claims:
-            dc = self.classes.get(claim.device_class)
-            if dc is None:
-                raise KeyError(
-                    f"unknown device class {claim.device_class}")
-            out[dc.extended_resource] = out.get(dc.extended_resource, 0) \
-                + claim.count
+    @classmethod
+    def from_mappings(cls, mappings: list[dict]) -> "DeviceClassMapper":
+        """mapper.go:65 PopulateFromConfiguration."""
+        m = cls()
+        for entry in mappings:
+            m.add_device_class(DeviceClass(
+                name=entry["name"],
+                extended_resource=entry.get("logicalResourceName",
+                                            entry["name"]),
+                counters={k: int(v) for k, v in
+                          (entry.get("counters") or {}).items()}))
+        return m
+
+    # -- inventory (groupSlicesByPool / poolInfo) --
+
+    def add_resource_slice(self, s: ResourceSlice) -> None:
+        self.slices.append(s)
+
+    def complete_pools(self, driver: Optional[str] = None
+                       ) -> dict[str, list[Device]]:
+        """counters.go:231 isComplete: a pool counts only when every
+        published slice has arrived."""
+        groups: dict[str, list[ResourceSlice]] = {}
+        for s in self.slices:
+            if driver is not None and s.driver != driver:
+                continue
+            groups.setdefault(f"{s.driver}/{s.pool}", []).append(s)
+        out: dict[str, list[Device]] = {}
+        for pool, slices in groups.items():
+            if len(slices) >= slices[0].pool_slice_count:
+                out[pool] = [d for s in slices for d in s.devices]
         return out
 
-    def apply_claims(self, pod_set, claims: list[ResourceClaim]):
-        """Merge claim-derived requests into a pod set's requests."""
+    # -- claim resolution --
+
+    def resolve(self, claims: list[ResourceClaim]) -> dict[str, int]:
+        """countDevicesPerClass -> extended-resource requests; raises on
+        unknown class."""
+        out: dict[str, int] = {}
+        for claim in claims:
+            for req in claim.device_requests():
+                dc = self.classes.get(req.device_class)
+                if dc is None:
+                    raise KeyError(
+                        f"unknown device class {req.device_class}")
+                out[dc.extended_resource] = out.get(
+                    dc.extended_resource, 0) + req.count
+        return out
+
+    def counter_resources(self, claims: list[ResourceClaim]
+                          ) -> dict[str, int]:
+        """counters.go:36 GetCounterResourcesForWorkload: charge
+        counter-based logical resources for the devices each request
+        would match, taken greedily from complete pools."""
+        pools = self.complete_pools()
+        matched: set[tuple[str, str]] = set()  # (pool, device name)
+        charges: dict[str, int] = {}
+        for claim in claims:
+            for req in claim.device_requests():
+                dc = self.classes.get(req.device_class)
+                if dc is None:
+                    raise KeyError(
+                        f"unknown device class {req.device_class}")
+                needed = req.count
+                for pool, devices in pools.items():
+                    for dev in devices:
+                        if needed == 0:
+                            break
+                        if (pool, dev.name) in matched:
+                            continue
+                        if any(dev.attributes.get(k) != v
+                               for k, v in req.selectors.items()):
+                            continue
+                        matched.add((pool, dev.name))
+                        needed -= 1
+                        for counter, per_dev in dc.counters.items():
+                            cap = dev.counters.get(counter, per_dev)
+                            charges[counter] = charges.get(counter, 0) \
+                                + cap
+                    if needed == 0:
+                        break
+                if needed > 0:
+                    raise LookupError(
+                        f"not enough devices for class "
+                        f"{req.device_class}: {needed} short")
+        return charges
+
+    def apply_claims(self, pod_set, claims: list[ResourceClaim],
+                     with_counters: bool = False):
+        """workload.go:625-645: merge claim-derived requests into a pod
+        set's requests, REPLACING any raw request for the mapped
+        extended resources (replacedExtendedResources)."""
         resolved = self.resolve(claims)
-        merged = dict(pod_set.requests)
+        merged = {r: q for r, q in pod_set.requests.items()
+                  if r not in resolved}
         for res, count in resolved.items():
             merged[res] = merged.get(res, 0) + count
+        if with_counters:
+            for counter, charge in self.counter_resources(claims).items():
+                merged[counter] = merged.get(counter, 0) + charge
         from dataclasses import replace as _replace
 
         return _replace(pod_set, requests=merged)

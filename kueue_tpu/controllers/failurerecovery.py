@@ -23,12 +23,23 @@ from kueue_tpu.tas.snapshot import HOSTNAME_LABEL
 
 @dataclass
 class FailureRecoveryPolicy:
-    """FailureRecoveryPolicy CRD equivalent."""
+    """FailureRecoveryPolicy CRD equivalent.
+
+    Actions:
+      * "Replace" — in-place TAS node replacement first
+        (tas_flavor_snapshot.go:747 via the engine's second pass);
+        pods on healthy nodes keep running. Falls back to the
+        second-pass retry/evict semantics when no replacement exists.
+      * "Requeue" — evict affected workloads immediately; the
+        scheduler's next pass finds a new placement (possibly another
+        flavor, or another cluster under MultiKueue).
+    ``max_failures`` bounds per-workload churn: a workload evicted for
+    node failures more than this many times is deactivated
+    (fail-fast, scheduler.go:804-817)."""
 
     name: str = "default"
-    # evict & requeue on the same queue (other flavors/clusters are
-    # naturally retried by the scheduler / MultiKueue).
-    action: str = "Requeue"
+    action: str = "Replace"
+    max_failures: int = 0  # 0 = unbounded
 
 
 class FailureRecoveryController:
@@ -36,6 +47,7 @@ class FailureRecoveryController:
         self.engine = engine
         self.policy = policy or FailureRecoveryPolicy()
         self.unhealthy_nodes: set[str] = set()
+        self.failure_counts: dict[str, int] = {}
 
     def node_failed(self, node_name: str) -> list[str]:
         """Node health event (tas/node_controller.go). Returns affected
@@ -45,13 +57,38 @@ class FailureRecoveryController:
         if node is not None:
             node.ready = False
         affected = self._workloads_on_node(node_name)
+        over_limit = []
         for key in affected:
+            self.failure_counts[key] = self.failure_counts.get(key, 0) + 1
+            if self.policy.max_failures \
+                    and self.failure_counts[key] > self.policy.max_failures:
+                over_limit.append(key)
+        if self.policy.action == "Replace":
+            # In-place replacement path: annotate unhealthyNodes + arm
+            # the second pass (engine.mark_node_unhealthy); keeps healthy
+            # pods running while only the failed domains re-place.
+            self.engine.mark_node_unhealthy(node_name, reason="NodeFailure")
+        else:
+            for key in affected:
+                if key in over_limit:
+                    continue  # deactivated below, under the right reason
+                wl = self.engine.workloads.get(key)
+                if wl is None or wl.is_finished:
+                    continue
+                wl.set_condition(WorkloadConditionType.EVICTED, False,
+                                 reason="", now=self.engine.clock)
+                self.engine.evict(wl, "NodeFailure")
+        # Fail-fast deactivation for churners (scheduler.go:804-817).
+        for key in over_limit:
             wl = self.engine.workloads.get(key)
             if wl is None or wl.is_finished:
                 continue
-            wl.set_condition(WorkloadConditionType.EVICTED, False,
-                             reason="", now=self.engine.clock)
-            self.engine.evict(wl, "NodeFailure")
+            wl.active = False
+            if wl.status.admission is not None or wl.has_quota_reservation:
+                self.engine.evict(wl, "NodeFailureLimitExceeded",
+                                  requeue=False)
+            else:
+                self.engine.queues.delete_workload(wl)
         self.engine.queues.queue_inadmissible_workloads()
         return affected
 

@@ -299,6 +299,11 @@ class JobReconciler:
         if wl_key and old.queue_name != job.queue_name:
             wl = self.engine.workloads.get(wl_key)
             if wl is not None and not wl.is_finished:
+                if wl.has_quota_reservation:
+                    # A reserved/admitted workload must release its old
+                    # CQ's quota before re-queueing elsewhere — pushing
+                    # it pending while still assumed would double-admit.
+                    self.engine.evict(wl, "QueueChanged", requeue=False)
                 self.engine.queues.delete_workload(wl)
                 wl.queue_name = job.queue_name
                 self.engine.queues.add_or_update_workload(wl)

@@ -406,6 +406,15 @@ class FlavorAssigner:
                 reasons.append(f"flavor {f_name} not found")
                 idx += 1
                 continue
+            # Concurrent-admission variants are pinned to one flavor
+            # (WorkloadAllowedResourceFlavorAnnotation,
+            # concurrentadmission/controller.go:371 generateVariant).
+            allowed = getattr(self.wl.obj, "allowed_resource_flavor", None)
+            if allowed and f_name != allowed:
+                reasons.append(
+                    f"flavor {f_name} excluded by allowed-flavor pin")
+                idx += 1
+                continue
             mismatch = None
             for i in ps_ids:
                 mismatch = flavor_matches_podset(flavor,

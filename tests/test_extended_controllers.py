@@ -94,7 +94,9 @@ def test_failure_recovery_evicts_workloads_on_failed_node():
         name="cq", resource_groups=(ResourceGroup(
             (CPU,), (FlavorQuotas("tas", {CPU: ResourceQuota(8000)}),)),)))
     eng.create_local_queue(LocalQueue("lq", "default", "cq"))
-    fr = FailureRecoveryController(eng)
+    from kueue_tpu.controllers.failurerecovery import FailureRecoveryPolicy
+    fr = FailureRecoveryController(
+        eng, FailureRecoveryPolicy(action="Requeue"))
     eng.clock += 0.1
     wl = Workload(name="gang", queue_name="lq", pod_sets=(PodSet(
         "main", 2, {CPU: 3000},
@@ -127,27 +129,319 @@ def test_dra_mapper():
         m.resolve([ResourceClaim("unknown", 1)])
 
 
-def test_concurrent_admission_variants():
-    eng = make_engine(nominal=1000, n_cqs=3)
+def make_two_flavor_engine(reserved=1000, spot=1000):
+    """One CQ with a preferred "reserved" flavor and a "spot" fallback."""
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("reserved"))
+    eng.create_resource_flavor(ResourceFlavor("spot"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("reserved", {CPU: ResourceQuota(reserved)}),
+             FlavorQuotas("spot", {CPU: ResourceQuota(spot)}),)),),))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng
+
+
+def test_concurrent_admission_variants_per_flavor():
+    """controller.go:356: variants are flavor-pinned clones; the less
+    preferred flavor admits while the preferred one is full."""
+    eng = make_two_flavor_engine()
     ca = ConcurrentAdmissionController(eng)
-    # cq0 is full; cq1 and cq2 are free.
     eng.clock += 0.1
-    filler = Workload(name="filler", queue_name="lq0",
-                      pod_sets=(PodSet("main", 1, {CPU: 1000}),))
+    filler = Workload(name="filler", queue_name="lq",
+                      pod_sets=(PodSet("main", 1, {CPU: 1000}),),
+                      allowed_resource_flavor="reserved")
     eng.submit(filler)
     eng.schedule_once()
     eng.clock += 0.1
     wl = Workload(name="flex", queue_name="",
                   pod_sets=(PodSet("main", 1, {CPU: 800}),))
-    variants = ca.submit_concurrent(wl, ["lq0", "lq1", "lq2"])
-    assert len(variants) == 3
-    eng.schedule_once()
+    variants = ca.submit_concurrent(wl, "lq")
+    assert [v.allowed_resource_flavor for v in variants] \
+        == ["reserved", "spot"]
+    for _ in range(4):
+        eng.schedule_once()
     ca.reconcile()
     winner = ca.winner_of(wl.key)
-    assert winner is not None and winner.queue_name == "lq1"
-    # losers withdrawn: the lq2 variant no longer holds quota or pends.
-    lq2_variant = eng.workloads["default/flex-lq2"]
-    assert not lq2_variant.active
-    assert eng.queues.pending_workloads("cq2") == 0
-    lq0_variant = eng.workloads["default/flex-lq0"]
-    assert not lq0_variant.active
+    assert winner is not None
+    assert winner.status.admission.pod_set_assignments[0].flavors[CPU] \
+        == "spot"
+
+
+def test_concurrent_admission_retain_first_admission():
+    from kueue_tpu.controllers.concurrentadmission import (
+        RETAIN_FIRST_ADMISSION,
+        ConcurrentAdmissionPolicy,
+    )
+
+    eng = make_two_flavor_engine()
+    ca = ConcurrentAdmissionController(eng)
+    filler = Workload(name="filler", queue_name="lq",
+                      pod_sets=(PodSet("main", 1, {CPU: 1000}),),
+                      allowed_resource_flavor="reserved")
+    eng.submit(filler)
+    eng.schedule_once()
+    wl = Workload(name="flex", queue_name="",
+                  pod_sets=(PodSet("main", 1, {CPU: 800}),))
+    ca.submit_concurrent(wl, "lq", ConcurrentAdmissionPolicy(
+        mode=RETAIN_FIRST_ADMISSION))
+    for _ in range(4):
+        eng.schedule_once()
+    ca.reconcile()
+    # spot admitted first and is retained; the reserved variant is
+    # deactivated even though reserved capacity frees up later.
+    reserved_variant = eng.workloads["default/flex-reserved"]
+    assert not reserved_variant.active
+    eng.finish(filler.key)
+    for _ in range(4):
+        eng.schedule_once()
+    assert not reserved_variant.is_admitted
+    assert eng.workloads["default/flex-spot"].is_admitted
+
+
+def test_concurrent_admission_migrates_to_preferred_flavor():
+    """TryPreferredFlavors (controller.go:519): a more-preferred variant
+    admitting later evicts the already-admitted less-preferred one."""
+    from kueue_tpu.controllers.concurrentadmission import (
+        TRY_PREFERRED_FLAVORS,
+        ConcurrentAdmissionPolicy,
+    )
+
+    eng = make_two_flavor_engine()
+    ca = ConcurrentAdmissionController(eng)
+    filler = Workload(name="filler", queue_name="lq",
+                      pod_sets=(PodSet("main", 1, {CPU: 1000}),),
+                      allowed_resource_flavor="reserved")
+    eng.submit(filler)
+    eng.schedule_once()
+    wl = Workload(name="flex", queue_name="",
+                  pod_sets=(PodSet("main", 1, {CPU: 800}),))
+    ca.submit_concurrent(wl, "lq", ConcurrentAdmissionPolicy(
+        mode=TRY_PREFERRED_FLAVORS))
+    for _ in range(4):
+        eng.schedule_once()
+    ca.reconcile()
+    spot_variant = eng.workloads["default/flex-spot"]
+    reserved_variant = eng.workloads["default/flex-reserved"]
+    assert spot_variant.is_admitted
+    assert reserved_variant.active  # still racing for the better flavor
+    # Reserved capacity frees: the preferred variant admits and the spot
+    # variant is migrated away (evicted + deactivated).
+    eng.finish(filler.key)
+    for _ in range(4):
+        eng.schedule_once()
+    ca.reconcile()
+    assert reserved_variant.is_admitted
+    assert not spot_variant.active and not spot_variant.is_admitted
+    assert ca.winner_of(wl.key) is reserved_variant
+    assert any(e.kind == "DeactivatedVariant"
+               and e.workload == spot_variant.key for e in eng.events)
+
+
+def test_concurrent_admission_gated_variants_rotate():
+    """Variants needing preemption are gated; exactly one is ungated at
+    a time (preemptionTimeout rotation, controller.go:68)."""
+    from kueue_tpu.api.types import ClusterQueuePreemption, PreemptionPolicy
+    from kueue_tpu.controllers.concurrentadmission import (
+        CONCURRENT_ADMISSION_GATE,
+    )
+
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("reserved"))
+    eng.create_resource_flavor(ResourceFlavor("spot"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq",
+        preemption=ClusterQueuePreemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY),
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("reserved", {CPU: ResourceQuota(1000)}),
+             FlavorQuotas("spot", {CPU: ResourceQuota(1000)}),)),),))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    ca = ConcurrentAdmissionController(eng)
+    for flavor in ("reserved", "spot"):
+        eng.clock += 0.1
+        low = Workload(name=f"low-{flavor}", queue_name="lq", priority=0,
+                       pod_sets=(PodSet("main", 1, {CPU: 1000}),),
+                       allowed_resource_flavor=flavor)
+        eng.submit(low)
+        eng.schedule_once()
+    eng.clock += 0.1
+    wl = Workload(name="hi", queue_name="", priority=9,
+                  pod_sets=(PodSet("main", 1, {CPU: 1000}),))
+    ca.submit_concurrent(wl, "lq")
+    eng.schedule_once()  # both variants blocked on their gates
+    ca.reconcile()  # ungates the preferred variant only
+    opened = [k for f, k in ca.groups[wl.key].variants.items()
+              if CONCURRENT_ADMISSION_GATE in eng.workloads[k]
+              .status.open_preemption_gates]
+    assert opened == ["default/hi-reserved"]
+    for _ in range(6):
+        eng.schedule_once()
+    ca.reconcile()
+    assert eng.workloads["default/hi-reserved"].is_admitted
+    assert eng.workloads["default/low-reserved"].is_evicted
+
+
+
+
+def test_dra_pools_and_counters():
+    """counters.go: counter-based logical resources charged per matched
+    device; incomplete pools are invisible."""
+    from kueue_tpu.controllers.dra import (
+        Device,
+        DeviceRequest,
+        ResourceSlice,
+    )
+
+    m = DeviceClassMapper()
+    m.add_device_class(DeviceClass(
+        "gpu.example.com/a100", "gpu-a100",
+        counters={"gpu-mem-gib": 40}))
+    # Pool of 2 slices; only one arrived -> invisible.
+    m.add_resource_slice(ResourceSlice(
+        driver="gpu.example.com", pool="p1", pool_slice_count=2,
+        devices=[Device("d0", {"zone": "a"}, {"gpu-mem-gib": 40})]))
+    assert m.complete_pools() == {}
+    m.add_resource_slice(ResourceSlice(
+        driver="gpu.example.com", pool="p1", pool_slice_count=2,
+        devices=[Device("d1", {"zone": "b"}, {"gpu-mem-gib": 80})]))
+    assert len(m.complete_pools()["gpu.example.com/p1"]) == 2
+
+    claims = [ResourceClaim(requests=(
+        DeviceRequest("gpu.example.com/a100", 2),))]
+    assert m.resolve(claims) == {"gpu-a100": 2}
+    # d0 charges 40 (own counter), d1 charges 80.
+    assert m.counter_resources(claims) == {"gpu-mem-gib": 120}
+    # Selector narrows matching; only one zone-a device exists.
+    selective = [ResourceClaim(requests=(
+        DeviceRequest("gpu.example.com/a100", 2,
+                      selectors={"zone": "a"}),))]
+    with pytest.raises(LookupError):
+        m.counter_resources(selective)
+
+
+def test_dra_apply_claims_replaces_extended_resources():
+    """workload.go:628-645: claim-derived quantities REPLACE raw requests
+    of the mapped extended resource."""
+    m = DeviceClassMapper()
+    m.add_device_class(DeviceClass("tpu.google.com/v5e", "tpu-v5e"))
+    ps = PodSet("main", 1, {CPU: 1000, "tpu-v5e": 99})
+    out = m.apply_claims(ps, [ResourceClaim("tpu.google.com/v5e", 4)])
+    assert out.requests == {CPU: 1000, "tpu-v5e": 4}  # 99 replaced
+
+
+def test_dra_from_config_mappings():
+    m = DeviceClassMapper.from_mappings([
+        {"name": "gpu.example.com/mig-1g",
+         "logicalResourceName": "gpu-mem",
+         "counters": {"mem-gib": 5}}])
+    assert m.resolve([ResourceClaim("gpu.example.com/mig-1g", 3)]) \
+        == {"gpu-mem": 3}
+
+
+def test_populator_creates_local_queues():
+    from kueue_tpu.controllers.populator import (
+        NAME_MODE_AS_CLUSTER_QUEUE,
+        PopulatorController,
+    )
+
+    eng = make_engine(n_cqs=1)
+    eng.cache.cluster_queues["cq0"].namespace_selector = {"team": "ml"}
+    eng.set_namespace_labels("ns-ml", {"team": "ml"})
+    eng.set_namespace_labels("ns-web", {"team": "web"})
+    pop = PopulatorController(eng, name_mode=NAME_MODE_AS_CLUSTER_QUEUE)
+    created = pop.reconcile()
+    assert created == ["ns-ml/cq0"]
+    assert "ns-ml/cq0" in eng.queues.local_queues
+    assert "ns-web/cq0" not in eng.queues.local_queues
+    assert pop.reconcile() == []  # idempotent
+
+
+def test_booster_time_sharing_negative_boost():
+    """kueue-priority-booster: long-admitted workloads get a negative
+    boost so equal-priority pending work can preempt them."""
+    from kueue_tpu.api.types import ClusterQueuePreemption, PreemptionPolicy
+    from kueue_tpu.controllers.booster import (
+        PriorityBooster,
+        TimeSharingPolicy,
+    )
+
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq",
+        preemption=ClusterQueuePreemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY),
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(1000)}),)),),))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    booster = PriorityBooster(eng, time_sharing=TimeSharingPolicy(
+        time_sharing_interval_seconds=100.0, negative_boost_value=-1))
+    first = Workload(name="first", queue_name="lq", priority=5,
+                     pod_sets=(PodSet("main", 1, {CPU: 1000}),))
+    eng.submit(first)
+    eng.schedule_once()
+    assert first.is_admitted
+    eng.tick(50.0)
+    booster.reconcile_time_sharing()
+    assert first.priority_boost == 0  # inside the sharing window
+    eng.clock += 0.1
+    second = Workload(name="second", queue_name="lq", priority=5,
+                      pod_sets=(PodSet("main", 1, {CPU: 1000}),))
+    eng.submit(second)
+    eng.schedule_once()
+    assert not second.is_admitted  # same priority: no preemption yet
+    eng.tick(60.0)  # past the interval
+    booster.reconcile_time_sharing()
+    assert first.priority_boost == -1
+    eng.queues.queue_inadmissible_workloads()
+    eng.schedule_once()
+    eng.schedule_once()
+    assert first.is_evicted and second.is_admitted
+    # Once no longer admitted, the demotion clears.
+    booster.reconcile_time_sharing()
+    assert first.priority_boost == 0
+
+
+def test_failure_recovery_replace_action_and_fail_fast():
+    from kueue_tpu.controllers.failurerecovery import (
+        FailureRecoveryController,
+        FailureRecoveryPolicy,
+    )
+
+    eng = Engine()
+    eng.create_topology(Topology("dc", (TopologyLevel("rack"),
+                                        TopologyLevel(HOSTNAME_LABEL))))
+    eng.create_resource_flavor(ResourceFlavor("tas", topology_name="dc"))
+    for h in range(3):
+        eng.create_node(Node(name=f"h{h}",
+                             labels={"rack": "r0", HOSTNAME_LABEL: f"h{h}"},
+                             capacity={CPU: 1000}))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            (CPU,), (FlavorQuotas("tas", {CPU: ResourceQuota(3000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    frc = FailureRecoveryController(eng, FailureRecoveryPolicy(
+        action="Replace", max_failures=2))
+    wl = Workload(name="gang", queue_name="lq", pod_sets=(PodSet(
+        "main", 2, {CPU: 1000},
+        topology_request=PodSetTopologyRequest(
+            mode=TopologyMode.REQUIRED, level="rack")),))
+    eng.submit(wl)
+    eng.schedule_once()
+    assert wl.is_admitted
+    placed = {d.values[-1]
+              for psa in wl.status.admission.pod_set_assignments
+              for d in psa.topology_assignment.domains}
+    # Fail one placed node: replacement happens in place, no eviction.
+    failed = sorted(placed)[0]
+    frc.node_failed(failed)
+    eng.schedule_once()
+    assert wl.is_admitted and not wl.is_evicted
+    new_placed = {d.values[-1]
+                  for psa in wl.status.admission.pod_set_assignments
+                  for d in psa.topology_assignment.domains}
+    assert failed not in new_placed
