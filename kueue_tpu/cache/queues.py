@@ -25,6 +25,7 @@ from kueue_tpu.api.types import (
     ClusterQueue,
     LocalQueue,
     QueueingStrategy,
+    StopPolicy,
     Workload,
 )
 from kueue_tpu.scheduler.cycle import RequeueReason
@@ -272,7 +273,12 @@ class QueueManager:
         return lq.cluster_queue or None
 
     def add_or_update_workload(self, wl: Workload) -> Optional[WorkloadInfo]:
-        """manager.go AddOrUpdateWorkload."""
+        """manager.go AddOrUpdateWorkload. A held LocalQueue keeps its
+        workloads out of the pending heap (manager.go LQ stopPolicy
+        gating); resume re-queues them."""
+        lq = self.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
+        if lq is not None and lq.stop_policy != StopPolicy.NONE:
+            return None
         cq_name = self.cluster_queue_for_workload(wl)
         if cq_name is None or cq_name not in self.cluster_queues:
             return None

@@ -35,6 +35,14 @@ class Kueuectl:
     def __init__(self, engine):
         self.engine = engine
 
+    def _journal_delete(self, kind: str, key: str) -> None:
+        if self.engine.journal is not None:
+            self.engine.journal.delete(kind, key, ts=self.engine.clock)
+
+    def _journal_apply(self, kind: str, obj) -> None:
+        if self.engine.journal is not None:
+            self.engine.journal.apply(kind, obj, ts=self.engine.clock)
+
     # -- create --
 
     def create_cluster_queue(self, name: str, cohort: Optional[str] = None,
@@ -170,6 +178,7 @@ class Kueuectl:
                     wl = self.engine.workloads.get(key)
                     if wl is not None:
                         self.engine.evict(wl, "ClusterQueueStopped")
+        self._journal_apply("cluster_queue", cq)
 
     def resume_cluster_queue(self, name: str) -> None:
         cq = self.engine.cache.cluster_queues.get(name)
@@ -177,26 +186,280 @@ class Kueuectl:
             raise KeyError(name)
         cq.stop_policy = StopPolicy.NONE
         self.engine.queues.queue_inadmissible_workloads({name})
+        self._journal_apply("cluster_queue", cq)
+
+    def stop_local_queue(self, key: str, drain: bool = False) -> None:
+        """kueuectl stop localqueue (stop/stop_localqueue.go). The held
+        stop policy keeps the LQ's workloads out of the pending heaps
+        (queues.add_or_update_workload gate)."""
+        lq = self.engine.queues.local_queues.get(key)
+        if lq is None:
+            raise KeyError(key)
+        lq.stop_policy = (StopPolicy.HOLD_AND_DRAIN if drain
+                          else StopPolicy.HOLD)
+        for wkey, wl in list(self.engine.workloads.items()):
+            if f"{wl.namespace}/{wl.queue_name}" != key or wl.is_finished:
+                continue
+            if drain and wl.has_quota_reservation:
+                self.engine.evict(wl, "LocalQueueStopped")
+            elif not wl.has_quota_reservation:
+                # Hold: pending workloads leave the queue until resume.
+                self.engine.queues.delete_workload(wl)
+        self._journal_apply("local_queue", lq)
+
+    def resume_local_queue(self, key: str) -> None:
+        lq = self.engine.queues.local_queues.get(key)
+        if lq is None:
+            raise KeyError(key)
+        lq.stop_policy = StopPolicy.NONE
+        # Re-queue the LQ's parked pending workloads (they were gated or
+        # removed while stopped).
+        for wl in self.engine.workloads.values():
+            if f"{wl.namespace}/{wl.queue_name}" == key and wl.active \
+                    and not wl.is_finished \
+                    and not wl.has_quota_reservation:
+                self.engine.queues.add_or_update_workload(wl)
+        self.engine.queues.queue_inadmissible_workloads()
+        self._journal_apply("local_queue", lq)
+
+    # -- pods (list/list_pods.go: pods of a queued job) --
+
+    def list_pods(self, workload_key: Optional[str] = None,
+                  namespace: Optional[str] = None) -> list[dict]:
+        """Pod-level rows derived from admissions: one row per admitted
+        pod (pod set x count) with its flavor-derived node selector."""
+        rows = []
+        flavors = self.engine.cache.resource_flavors
+        for key, wl in sorted(self.engine.workloads.items()):
+            if workload_key and key != workload_key:
+                continue
+            if namespace and wl.namespace != namespace:
+                continue
+            if wl.status.admission is None:
+                continue
+            for psa in wl.status.admission.pod_set_assignments:
+                selector = {}
+                for fname in psa.flavors.values():
+                    rf = flavors.get(fname)
+                    if rf is not None:
+                        selector.update(rf.node_labels)
+                for i in range(psa.count):
+                    rows.append({
+                        "name": f"{wl.name}-{psa.name}-{i}",
+                        "namespace": wl.namespace,
+                        "workload": key,
+                        "podSet": psa.name,
+                        "nodeSelector": selector,
+                        "phase": ("Running" if wl.is_admitted
+                                  else "Pending"),
+                    })
+        return rows
+
+    # -- describe (passthrough describe analog) --
+
+    def describe_workload(self, key: str) -> dict:
+        wl = self.engine.workloads.get(key)
+        if wl is None:
+            raise KeyError(key)
+        info = None
+        from kueue_tpu.workload_info import WorkloadInfo
+
+        info = WorkloadInfo.from_workload(
+            wl, wl.status.admission.cluster_queue
+            if wl.status.admission else "",
+            options=self.engine.info_options)
+        return {
+            "name": wl.name, "namespace": wl.namespace,
+            "queue": wl.queue_name, "priority": wl.effective_priority,
+            "active": wl.active,
+            "conditions": [
+                {"type": c.type, "status": c.status, "reason": c.reason,
+                 "message": c.message}
+                for c in wl.status.conditions.values()],
+            "admission": None if wl.status.admission is None else {
+                "clusterQueue": wl.status.admission.cluster_queue,
+                "podSetAssignments": [
+                    {"name": psa.name, "count": psa.count,
+                     "flavors": dict(psa.flavors)}
+                    for psa in wl.status.admission.pod_set_assignments],
+            },
+            "requeueCount": wl.status.requeue_count,
+            "usage": {f"{fr.flavor}/{fr.resource}": q
+                      for fr, q in info.usage().items()},
+            "admissionChecks": dict(wl.status.admission_check_states),
+        }
+
+    def describe_cluster_queue(self, name: str) -> dict:
+        from kueue_tpu.controllers.status import StatusController
+
+        cq = self.engine.cache.cluster_queues.get(name)
+        if cq is None:
+            raise KeyError(name)
+        sc = self.engine.status_controller or StatusController(
+            self.engine, attach=False)
+        st = sc.cq_status(name)
+        return {
+            "name": name, "cohort": cq.cohort or "",
+            "queueingStrategy": cq.queueing_strategy.value,
+            "flavors": [
+                {"name": fq.name,
+                 "quotas": {res: {"nominal": q.nominal,
+                                  "borrowingLimit": q.borrowing_limit,
+                                  "lendingLimit": q.lending_limit}
+                            for res, q in fq.resources.items()}}
+                for rg in cq.resource_groups for fq in rg.flavors],
+            "status": vars(st) if st is not None else None,
+        }
+
+    def describe_local_queue(self, key: str) -> dict:
+        from kueue_tpu.controllers.status import StatusController
+
+        lq = self.engine.queues.local_queues.get(key)
+        if lq is None:
+            raise KeyError(key)
+        sc = self.engine.status_controller or StatusController(
+            self.engine, attach=False)
+        st = sc.lq_status(key)
+        return {"name": lq.name, "namespace": lq.namespace,
+                "clusterQueue": lq.cluster_queue,
+                "status": vars(st) if st is not None else None}
+
+    # -- delete --
 
     def delete_workload(self, key: str) -> None:
         wl = self.engine.workloads.pop(key, None)
         if wl is not None:
             self.engine.cache.delete_workload(key)
             self.engine.queues.delete_workload(wl)
+            self._journal_delete("workload", key)
+
+    def delete_cluster_queue(self, name: str) -> None:
+        """delete/delete_clusterqueue.go: the queue (and its pending
+        heap) go away; workload objects stay registered, unqueued."""
+        self.engine.cache.cluster_queues.pop(name, None)
+        self.engine.queues.cluster_queues.pop(name, None)
+        self._journal_delete("cluster_queue", name)
+
+    def delete_local_queue(self, key: str) -> None:
+        self.engine.queues.delete_local_queue(key)
+        self._journal_delete("local_queue", key)
+
+    def delete_resource_flavor(self, name: str) -> None:
+        self.engine.cache.resource_flavors.pop(name, None)
+        self._journal_delete("resource_flavor", name)
+
+    # -- passthrough (app/passthrough: get on any kueue kind) --
+
+    def get(self, kind: str, name: Optional[str] = None,
+            namespace: Optional[str] = None):
+        table = {
+            "clusterqueues": self.list_cluster_queues,
+            "localqueues": lambda: self.list_local_queues(namespace),
+            "workloads": lambda: self.list_workloads(namespace),
+            "resourceflavors": self.list_resource_flavors,
+            "pods": lambda: self.list_pods(namespace=namespace),
+        }
+        if kind not in table:
+            raise KeyError(f"unknown kind {kind}")
+        rows = table[kind]()
+        if name is not None:
+            rows = [r for r in rows if r.get("name") == name]
+        return rows
 
     def version(self) -> str:
         return VERSION
+
+
+def _parse_quota_pairs(pairs: list[str]) -> dict:
+    """--nominal-quota flavor:resource=value [...]"""
+    out = {}
+    for pair in pairs or []:
+        key, val = pair.split("=", 1)
+        out[key] = int(val)
+    return out
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="kueuectl")
     sub = p.add_subparsers(dest="command")
     sub.add_parser("version")
+
     lst = sub.add_parser("list")
     lst.add_argument("kind", choices=["clusterqueues", "localqueues",
-                                      "workloads", "resourceflavors"])
+                                      "workloads", "resourceflavors",
+                                      "pods"])
     lst.add_argument("--namespace")
+    lst.add_argument("--for", dest="for_workload",
+                     help="workload key for pods listing")
+
+    get = sub.add_parser("get")  # passthrough
+    get.add_argument("kind")
+    get.add_argument("name", nargs="?")
+    get.add_argument("--namespace")
+
+    desc = sub.add_parser("describe")
+    desc.add_argument("kind", choices=["workload", "clusterqueue",
+                                       "localqueue"])
+    desc.add_argument("name")
+    desc.add_argument("--namespace", default="default")
+
+    create = sub.add_parser("create")
+    create.add_argument("kind", choices=["clusterqueue", "localqueue",
+                                         "resourceflavor"])
+    create.add_argument("name")
+    create.add_argument("--cohort")
+    create.add_argument("--clusterqueue")
+    create.add_argument("--namespace", default="default")
+    create.add_argument("--nominal-quota", nargs="*", default=[],
+                        help="flavor:resource=value pairs")
+    create.add_argument("--queueing-strategy", default="BestEffortFIFO")
+    create.add_argument("--node-label", nargs="*", default=[],
+                        help="key=value pairs")
+    create.add_argument("--dry-run", choices=["none", "client"],
+                        default="none")
+
+    for verb in ("stop", "resume"):
+        cmd = sub.add_parser(verb)
+        cmd.add_argument("kind", choices=["workload", "clusterqueue",
+                                          "localqueue"])
+        cmd.add_argument("name")
+        cmd.add_argument("--namespace", default="default")
+        if verb == "stop":
+            cmd.add_argument("--drain", action="store_true")
+
+    dele = sub.add_parser("delete")
+    dele.add_argument("kind", choices=["workload", "clusterqueue",
+                                       "localqueue", "resourceflavor"])
+    dele.add_argument("name")
+    dele.add_argument("--namespace", default="default")
+    dele.add_argument("--dry-run", choices=["none", "client"],
+                      default="none")
     return p
+
+
+def main(argv=None) -> None:
+    """Console entry point: operate on a journal-backed engine
+    (--journal points at the durable store; commands replay it, apply,
+    and mutations are journaled back)."""
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    journal = None
+    if "--journal" in argv:
+        i = argv.index("--journal")
+        if i + 1 >= len(argv):
+            raise SystemExit("--journal requires a path argument")
+        journal = argv[i + 1]
+        del argv[i:i + 2]
+    if journal:
+        from kueue_tpu.store.journal import rebuild_engine
+
+        engine = rebuild_engine(journal)
+    else:
+        from kueue_tpu.controllers.engine import Engine
+
+        engine = Engine()
+    print(run(engine, argv))
 
 
 def run(engine, argv: list[str]) -> str:
@@ -211,6 +474,65 @@ def run(engine, argv: list[str]) -> str:
             "localqueues": lambda: ctl.list_local_queues(args.namespace),
             "workloads": lambda: ctl.list_workloads(args.namespace),
             "resourceflavors": ctl.list_resource_flavors,
+            "pods": lambda: ctl.list_pods(args.for_workload,
+                                          args.namespace),
         }[args.kind]
         return json.dumps(fn(), indent=2)
+    if args.command == "get":
+        return json.dumps(ctl.get(args.kind, args.name, args.namespace),
+                          indent=2)
+    if args.command == "describe":
+        key = f"{args.namespace}/{args.name}"
+        fn = {
+            "workload": lambda: ctl.describe_workload(key),
+            "clusterqueue": lambda: ctl.describe_cluster_queue(args.name),
+            "localqueue": lambda: ctl.describe_local_queue(key),
+        }[args.kind]
+        return json.dumps(fn(), indent=2)
+    if args.command == "create":
+        if args.dry_run != "none":
+            return f"{args.kind}/{args.name} created (dry run)"
+        if args.kind == "clusterqueue":
+            ctl.create_cluster_queue(
+                args.name, cohort=args.cohort,
+                nominal_quota=_parse_quota_pairs(args.nominal_quota),
+                queueing_strategy=args.queueing_strategy)
+        elif args.kind == "localqueue":
+            if not args.clusterqueue:
+                raise SystemExit("--clusterqueue is required")
+            ctl.create_local_queue(args.name, args.clusterqueue,
+                                   namespace=args.namespace)
+        else:
+            labels = dict(pair.split("=", 1)
+                          for pair in args.node_label)
+            ctl.create_resource_flavor(args.name, node_labels=labels)
+        return f"{args.kind}/{args.name} created"
+    if args.command in ("stop", "resume"):
+        key = f"{args.namespace}/{args.name}"
+        table = {
+            ("stop", "workload"): lambda: ctl.stop_workload(key),
+            ("stop", "clusterqueue"): lambda: ctl.stop_cluster_queue(
+                args.name, drain=args.drain),
+            ("stop", "localqueue"): lambda: ctl.stop_local_queue(
+                key, drain=args.drain),
+            ("resume", "workload"): lambda: ctl.resume_workload(key),
+            ("resume", "clusterqueue"): lambda: ctl.resume_cluster_queue(
+                args.name),
+            ("resume", "localqueue"): lambda: ctl.resume_local_queue(key),
+        }
+        table[(args.command, args.kind)]()
+        return f"{args.kind}/{args.name} {args.command}ped" \
+            if args.command == "stop" else f"{args.kind}/{args.name} resumed"
+    if args.command == "delete":
+        if args.dry_run != "none":
+            return f"{args.kind}/{args.name} deleted (dry run)"
+        key = f"{args.namespace}/{args.name}"
+        {
+            "workload": lambda: ctl.delete_workload(key),
+            "clusterqueue": lambda: ctl.delete_cluster_queue(args.name),
+            "localqueue": lambda: ctl.delete_local_queue(key),
+            "resourceflavor": lambda: ctl.delete_resource_flavor(
+                args.name),
+        }[args.kind]()
+        return f"{args.kind}/{args.name} deleted"
     return ""

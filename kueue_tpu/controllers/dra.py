@@ -52,12 +52,15 @@ class Device:
 
 @dataclass
 class ResourceSlice:
-    """A driver-published inventory shard (counters.go:243)."""
+    """A driver-published inventory shard (counters.go:243).
+    ``name`` is the slice's object identity: re-publishing the same name
+    upserts rather than duplicates."""
 
     driver: str
     pool: str
     pool_slice_count: int  # total slices the pool publishes
     devices: list[Device] = field(default_factory=list)
+    name: str = ""
 
 
 @dataclass
@@ -89,7 +92,13 @@ class DeviceClassMapper:
 
     def __init__(self) -> None:
         self.classes: dict[str, DeviceClass] = {}
-        self.slices: list[ResourceSlice] = []
+        # (driver, pool, slice name) -> slice: controller upserts
+        # replace, never duplicate.
+        self._slices: dict[tuple, ResourceSlice] = {}
+
+    @property
+    def slices(self) -> list[ResourceSlice]:
+        return list(self._slices.values())
 
     # -- registry (PopulateFromConfiguration) --
 
@@ -115,7 +124,16 @@ class DeviceClassMapper:
     # -- inventory (groupSlicesByPool / poolInfo) --
 
     def add_resource_slice(self, s: ResourceSlice) -> None:
-        self.slices.append(s)
+        key = (s.driver, s.pool,
+               s.name or f"slice-{len(self._slices)}")
+        if not s.name:
+            # Anonymous slices get a distinct generated identity once.
+            s.name = key[2]
+        self._slices[(s.driver, s.pool, s.name)] = s
+
+    def delete_resource_slice(self, driver: str, pool: str,
+                              name: str) -> None:
+        self._slices.pop((driver, pool, name), None)
 
     def complete_pools(self, driver: Optional[str] = None
                        ) -> dict[str, list[Device]]:

@@ -192,8 +192,12 @@ class SchedulerCycle:
         # workload's assignment and it becomes a replacement target.
         slice_targets: list[Target] = []
         revert_slice = None
+        old_info = None
         old_key = wl.obj.replaced_workload_slice
         if old_key is not None:
+            # Captured BEFORE simulate_workload_removal drops it from the
+            # snapshot: the TAS pass needs the predecessor's topology
+            # assignment for delta-only elastic placement.
             old_info = cq.workloads.get(old_key)
             if old_info is not None:
                 slice_targets.append(
@@ -206,7 +210,7 @@ class SchedulerCycle:
                 wl, cq, snapshot.resource_flavors,
                 enable_fair_sharing=self.enable_fair_sharing, oracle=oracle)
             full = assigner.assign()
-            apply_tas_pass(full, wl, cq)
+            apply_tas_pass(full, wl, cq, previous_slice=old_info)
         finally:
             if revert_slice is not None:
                 revert_slice()
@@ -221,7 +225,8 @@ class SchedulerCycle:
                 and wl.obj.can_be_partially_admitted()):
             def try_counts(counts):
                 assignment = assigner.assign(counts)
-                apply_tas_pass(assignment, wl, cq)
+                apply_tas_pass(assignment, wl, cq,
+                               previous_slice=old_info)
                 m = assignment.representative_mode()
                 if m == Mode.FIT:
                     return (assignment, []), True

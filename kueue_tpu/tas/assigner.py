@@ -20,9 +20,22 @@ from kueue_tpu.workload_info import WorkloadInfo
 
 
 def workload_tas_requests(assignment: Assignment, wl: WorkloadInfo,
-                          cq_snapshot) -> dict[str, list]:
+                          cq_snapshot, previous_slice=None
+                          ) -> dict[str, list]:
     """Group the workload's TAS-needing pod sets by assigned TAS flavor
-    (flavorassigner.Assignment.WorkloadsTopologyRequests)."""
+    (flavorassigner.Assignment.WorkloadsTopologyRequests). An elastic
+    scale-up/-down slice carries its predecessor's per-pod-set topology
+    assignments (``previous_slice``, captured by the cycle before the
+    old slice is simulated out of the snapshot) so placement is
+    delta-only (tas_elastic_workloads.go:35)."""
+    prev_by_ps: dict[str, object] = {}
+    old_adm = (previous_slice.obj.status.admission
+               if previous_slice is not None
+               and previous_slice.obj.status.admission else None)
+    if old_adm is not None:
+        prev_by_ps = {psa.name: psa.topology_assignment
+                      for psa in old_adm.pod_set_assignments
+                      if psa.topology_assignment is not None}
     requests: dict[str, list] = {}
     for i, psa in enumerate(assignment.pod_sets):
         ps = wl.obj.pod_sets[i]
@@ -35,7 +48,9 @@ def workload_tas_requests(assignment: Assignment, wl: WorkloadInfo,
         psr = wl.total_requests[i]
         single = psr.single_pod_requests()
         requests.setdefault(flavor, []).append(
-            (psa, TASPodSetRequest(ps, single, psa.count)))
+            (psa, TASPodSetRequest(
+                ps, single, psa.count,
+                previous_assignment=prev_by_ps.get(ps.name))))
     return requests
 
 
@@ -71,9 +86,10 @@ def find_assignments(cq_snapshot, tas_requests: dict[str, list],
 
 
 def apply_tas_pass(assignment: Assignment, wl: WorkloadInfo,
-                   cq_snapshot) -> None:
+                   cq_snapshot, previous_slice=None) -> None:
     """The flavorassigner.go:783-821 TAS block."""
-    tas_requests = workload_tas_requests(assignment, wl, cq_snapshot)
+    tas_requests = workload_tas_requests(assignment, wl, cq_snapshot,
+                                         previous_slice=previous_slice)
     if not tas_requests:
         return
     if assignment.representative_mode() == Mode.FIT:

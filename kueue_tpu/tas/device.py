@@ -83,6 +83,10 @@ def try_find(snap, workers, leader=None, simulate_empty=False,
     NotImplemented when the world needs the sequential path."""
     if not snap.level_keys:
         return NotImplemented
+    if getattr(workers, "previous_assignment", None) is not None:
+        # Elastic delta placement is decomposed on the host
+        # (_handle_elastic_workload) before device dispatch.
+        return NotImplemented
     tr = workers.pod_set.topology_request or PodSetTopologyRequest()
     required = tr.mode == TopologyMode.REQUIRED
     unconstrained = tr.mode == TopologyMode.UNCONSTRAINED

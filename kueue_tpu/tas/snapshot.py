@@ -71,6 +71,37 @@ class TopologyAssignment:
     domains: tuple[TopologyDomainAssignment, ...]
 
 
+def merge_topology_assignments(a: TopologyAssignment,
+                               b: TopologyAssignment
+                               ) -> TopologyAssignment:
+    """mergeTopologyAssignments: sum counts per domain, sorted by
+    domain values (the canonical order)."""
+    counts: dict[tuple, int] = {}
+    for ta in (a, b):
+        for dom in ta.domains:
+            counts[tuple(dom.values)] = \
+                counts.get(tuple(dom.values), 0) + dom.count
+    return TopologyAssignment(
+        levels=a.levels,
+        domains=tuple(TopologyDomainAssignment(values, count)
+                      for values, count in sorted(counts.items())))
+
+
+def truncate_assignment(prev: TopologyAssignment,
+                        count: int) -> TopologyAssignment:
+    """utiltas.TruncateAssignment: keep the first ``count`` pods in
+    domain order (scale-down removes from the tail)."""
+    kept = []
+    remaining = count
+    for dom in prev.domains:
+        if remaining <= 0:
+            break
+        take = min(dom.count, remaining)
+        kept.append(TopologyDomainAssignment(dom.values, take))
+        remaining -= take
+    return TopologyAssignment(levels=prev.levels, domains=tuple(kept))
+
+
 class _Domain:
     __slots__ = ("id", "values", "parent", "children", "state",
                  "slice_state", "state_with_leader",
@@ -134,6 +165,10 @@ class TASPodSetRequest:
     pod_set: PodSet
     single_pod_requests: dict[str, int]
     count: int
+    # Elastic workload slices: the admitted predecessor's assignment —
+    # scale-up places only the delta, scale-down truncates
+    # (tas_elastic_workloads.go:35 handleElasticWorkload).
+    previous_assignment: Optional["TopologyAssignment"] = None
 
 
 @dataclass
@@ -294,6 +329,15 @@ class TASFlavorSnapshot:
                     _add_assumed(assumed, repl, tr)
                 continue
             leader, workers = _find_leader_and_workers(trs)
+            if workers.previous_assignment is not None:
+                applied, elastic, reason = self._handle_elastic_workload(
+                    workers, leader, assumed,
+                    simulate_empty=simulate_empty)
+                if applied:
+                    if reason:
+                        return results, reason
+                    results.update(elastic)
+                    continue
             assignments, reason = self.find_topology_assignments(
                 workers, leader, simulate_empty=simulate_empty,
                 assumed_usage=assumed)
@@ -305,6 +349,58 @@ class TASFlavorSnapshot:
                     results[tr.pod_set.name] = ta
                     _add_assumed(assumed, ta, tr)
         return results, ""
+
+    def _handle_elastic_workload(
+        self, workers: TASPodSetRequest,
+        leader: Optional[TASPodSetRequest],
+        assumed: dict, simulate_empty: bool = False,
+    ) -> tuple[bool, dict[str, "TopologyAssignment"], str]:
+        """tas_elastic_workloads.go:35 (handleElasticWorkload): keep the
+        previous slice's pods fixed — scale-up places only the delta and
+        merges, scale-down truncates, same-count reuses. Returns
+        (applied, results, failure_reason); applied=False falls back to
+        standard placement (stale previous assignment)."""
+        prev = workers.previous_assignment
+        stale, _domain = self.is_topology_assignment_stale(prev)
+        if stale:
+            return False, {}, ""
+        prev_count = sum(d.count for d in prev.domains)
+        results: dict[str, TopologyAssignment] = {}
+        if workers.count > prev_count:
+            # handleScaleUp (:67): previous pods consume capacity, only
+            # the delta is placed fresh, then merged.
+            from dataclasses import replace as _replace
+
+            delta = _replace(workers, count=workers.count - prev_count,
+                             previous_assignment=None)
+            _add_assumed(assumed, prev, workers)
+            assignments, reason = self.find_topology_assignments(
+                delta, leader, simulate_empty=simulate_empty,
+                assumed_usage=assumed)
+            if reason:
+                return True, {}, reason
+            merged = merge_topology_assignments(
+                assignments[workers.pod_set.name], prev)
+            results[workers.pod_set.name] = merged
+            _add_assumed(assumed, assignments[workers.pod_set.name],
+                         workers)
+            if leader is not None:
+                lta = assignments.get(leader.pod_set.name)
+                if lta is not None:
+                    results[leader.pod_set.name] = lta
+                    _add_assumed(assumed, lta, leader)
+            return True, results, ""
+        if workers.count < prev_count:
+            # handleScaleDown (:105): truncate, keep placement.
+            kept = truncate_assignment(prev, workers.count)
+        else:
+            kept = prev
+        results[workers.pod_set.name] = kept
+        _add_assumed(assumed, kept, workers)
+        if leader is not None and leader.previous_assignment is not None:
+            results[leader.pod_set.name] = leader.previous_assignment
+            _add_assumed(assumed, leader.previous_assignment, leader)
+        return True, results, ""
 
     def find_topology_assignment(
         self,

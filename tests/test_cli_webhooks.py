@@ -138,3 +138,92 @@ def test_cohort_cycle_detection():
     cycle = find_cohort_cycle(
         [Cohort("a", "b"), Cohort("b", "c"), Cohort("c", "a")])
     assert cycle is not None and set(cycle) == {"a", "b", "c"}
+
+
+def test_cli_create_describe_pods_and_delete():
+    """Expanded kueuectl surface: create via argv, describe, list pods,
+    passthrough get, stop/resume localqueue, delete with --dry-run."""
+    from kueue_tpu.cli.kueuectl import run
+
+    eng = Engine()
+    assert "created" in run(eng, ["create", "resourceflavor", "default",
+                                  "--node-label", "pool=tpu"])
+    assert "created" in run(
+        eng, ["create", "clusterqueue", "cq",
+              "--nominal-quota", "default:cpu=2000"])
+    assert "created" in run(eng, ["create", "localqueue", "lq",
+                                  "--clusterqueue", "cq"])
+    wl = Workload(name="w", queue_name="lq",
+                  pod_sets=(PodSet("main", 2, {CPU: 500}),))
+    eng.submit(wl)
+    eng.schedule_once()
+    assert wl.is_admitted
+
+    pods = json.loads(run(eng, ["list", "pods", "--for", "default/w"]))
+    assert len(pods) == 2 and pods[0]["nodeSelector"] == {"pool": "tpu"}
+
+    desc = json.loads(run(eng, ["describe", "workload", "w"]))
+    assert desc["admission"]["clusterQueue"] == "cq"
+    assert desc["usage"] == {"default/cpu": 1000}
+    cq_desc = json.loads(run(eng, ["describe", "clusterqueue", "cq"]))
+    assert cq_desc["flavors"][0]["quotas"]["cpu"]["nominal"] == 2000
+    assert cq_desc["status"]["admitted_workloads"] == 1
+    lq_desc = json.loads(run(eng, ["describe", "localqueue", "lq"]))
+    assert lq_desc["clusterQueue"] == "cq"
+
+    got = json.loads(run(eng, ["get", "workloads", "w"]))
+    assert len(got) == 1 and got[0]["status"] == "Admitted"
+
+    assert "stopped" in run(eng, ["stop", "localqueue", "lq", "--drain"])
+    assert wl.is_evicted
+    assert "resumed" in run(eng, ["resume", "localqueue", "lq"])
+
+    assert "dry run" in run(eng, ["delete", "workload", "w",
+                                  "--dry-run", "client"])
+    assert "default/w" in eng.workloads
+    assert "deleted" in run(eng, ["delete", "workload", "w"])
+    assert "default/w" not in eng.workloads
+    assert "deleted" in run(eng, ["delete", "clusterqueue", "cq"])
+    assert "cq" not in eng.cache.cluster_queues
+
+
+def test_stopped_local_queue_blocks_admission_until_resume():
+    """A held LocalQueue keeps its workloads out of the pending heaps
+    even across scheduling cycles; resume re-queues them."""
+    from kueue_tpu.cli.kueuectl import run
+
+    eng = Engine()
+    run(eng, ["create", "resourceflavor", "default"])
+    run(eng, ["create", "clusterqueue", "cq",
+              "--nominal-quota", "default:cpu=1000"])
+    run(eng, ["create", "localqueue", "lq", "--clusterqueue", "cq"])
+    wl = Workload(name="w", queue_name="lq",
+                  pod_sets=(PodSet("main", 1, {CPU: 500}),))
+    eng.submit(wl)
+    eng.schedule_once()
+    assert wl.is_admitted
+    run(eng, ["stop", "localqueue", "lq", "--drain"])
+    assert wl.is_evicted
+    for _ in range(3):
+        eng.schedule_once()
+    assert not wl.is_admitted  # stays out while stopped
+    run(eng, ["resume", "localqueue", "lq"])
+    eng.schedule_once()
+    assert wl.is_admitted
+
+
+def test_cli_journal_tombstones(tmp_path):
+    """kueuectl --journal deletions must tombstone, not resurrect."""
+    from kueue_tpu.cli.kueuectl import run
+    from kueue_tpu.store.journal import attach_new_journal, rebuild_engine
+
+    path = str(tmp_path / "j.jsonl")
+    eng = Engine()
+    attach_new_journal(eng, path)
+    run(eng, ["create", "resourceflavor", "default"])
+    run(eng, ["create", "clusterqueue", "cq",
+              "--nominal-quota", "default:cpu=1000"])
+    run(eng, ["delete", "clusterqueue", "cq"])
+    reb = rebuild_engine(path)
+    assert "cq" not in reb.cache.cluster_queues
+    assert "default" in reb.cache.resource_flavors

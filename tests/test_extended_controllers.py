@@ -445,3 +445,22 @@ def test_failure_recovery_replace_action_and_fail_fast():
                   for psa in wl.status.admission.pod_set_assignments
                   for d in psa.topology_assignment.domains}
     assert failed not in new_placed
+
+
+def test_dra_slice_republish_upserts():
+    from kueue_tpu.controllers.dra import Device, ResourceSlice
+
+    m = DeviceClassMapper()
+    m.add_device_class(DeviceClass("gpu.example.com/a", "gpu-a"))
+    m.add_resource_slice(ResourceSlice(
+        driver="d", pool="p", pool_slice_count=2, name="s0",
+        devices=[Device("d0")]))
+    # Re-publishing s0 must NOT complete a 2-slice pool.
+    m.add_resource_slice(ResourceSlice(
+        driver="d", pool="p", pool_slice_count=2, name="s0",
+        devices=[Device("d0"), Device("d0b")]))
+    assert m.complete_pools() == {}
+    m.add_resource_slice(ResourceSlice(
+        driver="d", pool="p", pool_slice_count=2, name="s1",
+        devices=[Device("d1")]))
+    assert len(m.complete_pools()["d/p"]) == 3

@@ -339,3 +339,129 @@ def test_ungater_bad_ranks_falls_back_to_greedy():
             PodStub("p2", labels={"rank": "2"})]
     out = assign_pods_to_domains(ASSIGNMENT, pods, pod_index_label="rank")
     assert len(out) == 3
+
+
+def elastic_snap():
+    return snap_with_nodes({
+        "b0-r0-h0": 2000, "b0-r0-h1": 2000,
+        "b0-r1-h0": 2000, "b0-r1-h1": 2000})
+
+
+def test_elastic_scale_up_keeps_previous_pods_fixed():
+    """tas_elastic_workloads.go:67 handleScaleUp: previous pods stay
+    where they are; only the delta is placed fresh and merged."""
+    snap = elastic_snap()
+    pod_set = ps("main", 2, cpu=1000, mode=TopologyMode.UNCONSTRAINED)
+    first, reason = snap.find_topology_assignment(
+        TASPodSetRequest(pod_set, {CPU: 1000}, 2))
+    assert reason == ""
+    prev_domains = {tuple(d.values): d.count for d in first.domains}
+
+    scaled = ps("main", 3, cpu=1000, mode=TopologyMode.UNCONSTRAINED)
+    results, reason = snap.find_topology_assignments_for_flavor(
+        [TASPodSetRequest(scaled, {CPU: 1000}, 3,
+                          previous_assignment=first)])
+    assert reason == ""
+    got = {tuple(d.values): d.count for d in results["main"].domains}
+    assert sum(got.values()) == 3
+    # Every previously placed pod is still placed where it was.
+    for values, count in prev_domains.items():
+        assert got.get(values, 0) >= count
+
+
+def test_elastic_scale_down_truncates():
+    snap = elastic_snap()
+    pod_set = ps("main", 4, cpu=1000, mode=TopologyMode.UNCONSTRAINED)
+    first, reason = snap.find_topology_assignment(
+        TASPodSetRequest(pod_set, {CPU: 1000}, 4))
+    assert reason == ""
+    small = ps("main", 1, cpu=1000, mode=TopologyMode.UNCONSTRAINED)
+    results, reason = snap.find_topology_assignments_for_flavor(
+        [TASPodSetRequest(small, {CPU: 1000}, 1,
+                          previous_assignment=first)])
+    assert reason == ""
+    got = results["main"]
+    assert sum(d.count for d in got.domains) == 1
+    originals = {tuple(d.values) for d in first.domains}
+    assert {tuple(d.values) for d in got.domains} <= originals
+
+
+def test_elastic_stale_previous_falls_back_to_fresh_placement():
+    from kueue_tpu.tas.snapshot import (
+        TopologyAssignment,
+        TopologyDomainAssignment,
+    )
+
+    snap = elastic_snap()
+    ghost = TopologyAssignment(
+        levels=tuple(snap.level_keys),
+        domains=(TopologyDomainAssignment(
+            ("ghost", "ghost-rack", "ghost-h"), 2),))
+    pod_set = ps("main", 2, cpu=500, mode=TopologyMode.UNCONSTRAINED)
+    results, reason = snap.find_topology_assignments_for_flavor(
+        [TASPodSetRequest(pod_set, {CPU: 500}, 2,
+                          previous_assignment=ghost)])
+    assert reason == ""
+    assert sum(d.count for d in results["main"].domains) == 2
+
+
+def test_elastic_slice_through_scheduler_keeps_placement():
+    """End-to-end: a scale-up slice replacing an admitted TAS workload
+    keeps the predecessor's pods in place (only the delta moves) —
+    the cycle passes the predecessor's assignment into the TAS pass."""
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Topology,
+        TopologyLevel,
+        Workload,
+    )
+    from kueue_tpu.controllers.engine import Engine
+
+    eng = Engine()
+    eng.create_topology(Topology("dc", (
+        TopologyLevel("block"), TopologyLevel("rack"),
+        TopologyLevel(HOSTNAME_LABEL))))
+    eng.create_resource_flavor(ResourceFlavor("tas", topology_name="dc"))
+    for h in range(4):
+        eng.create_node(Node(
+            name=f"h{h}",
+            labels={"block": "b0", "rack": f"b0r{h % 2}",
+                    HOSTNAME_LABEL: f"h{h}"},
+            capacity={CPU: 1000, "pods": 10}))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            (CPU,), (FlavorQuotas("tas", {CPU: ResourceQuota(4000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    first = Workload(name="v1", queue_name="lq", pod_sets=(
+        PodSet("main", 2, {CPU: 1000},
+               topology_request=PodSetTopologyRequest(
+                   mode=TopologyMode.UNCONSTRAINED)),))
+    eng.submit(first)
+    eng.schedule_once()
+    assert first.is_admitted
+    prev = {tuple(d.values): d.count
+            for d in first.status.admission.pod_set_assignments[0]
+            .topology_assignment.domains}
+
+    eng.clock += 1
+    scaled = Workload(name="v2", queue_name="lq",
+                      replaced_workload_slice=first.key,
+                      pod_sets=(PodSet(
+                          "main", 3, {CPU: 1000},
+                          topology_request=PodSetTopologyRequest(
+                              mode=TopologyMode.UNCONSTRAINED)),))
+    eng.submit(scaled)
+    eng.schedule_once()
+    assert scaled.is_admitted
+    assert eng.workloads[first.key].is_finished  # replaced slice retired
+    got = {tuple(d.values): d.count
+           for d in scaled.status.admission.pod_set_assignments[0]
+           .topology_assignment.domains}
+    assert sum(got.values()) == 3
+    for values, count in prev.items():
+        assert got.get(values, 0) >= count  # old pods stayed put
