@@ -349,16 +349,35 @@ def main() -> None:
     n_cohorts = int(os.environ.get(
         "KUEUE_TPU_BENCH_COHORTS", "20" if fast else "200"))
 
+    # The headline number must always print: optional scenarios run
+    # inside a wall-clock budget and are individually crash-isolated
+    # (a driver-side timeout must never eat the whole JSON line).
+    deadline = time.monotonic() + float(os.environ.get(
+        "KUEUE_TPU_BENCH_DEADLINE", "600"))
+
     scenarios = {}
     flat, scen, snap, infos = bench_throughput_flat(n_workloads, n_cohorts)
     scenarios["throughput_flat"] = flat
-    scenarios["cycle_latency"] = bench_cycle_latency(
-        snap, infos, n_cycles=3 if fast else 6)
-    scenarios["hier_fair"] = bench_hier_fair(500 if fast else 20_000)
-    scenarios["preempt_churn"] = bench_preempt_churn(
-        200 if fast else 4_000, n_cohorts=4 if fast else 20)
-    scenarios["tas"] = bench_tas(60 if fast else 800,
-                                 n_cqs=4 if fast else 8)
+
+    def run_scenario(name, fn, min_budget_s=45.0):
+        remaining = deadline - time.monotonic()
+        if remaining < min_budget_s:
+            scenarios[name] = {"skipped": "deadline",
+                               "remaining_s": round(remaining, 1)}
+            return
+        try:
+            scenarios[name] = fn()
+        except Exception as exc:  # noqa: BLE001 — isolate, keep the line
+            scenarios[name] = {"error": repr(exc)[:200]}
+
+    run_scenario("cycle_latency", lambda: bench_cycle_latency(
+        snap, infos, n_cycles=3 if fast else 6))
+    run_scenario("hier_fair",
+                 lambda: bench_hier_fair(500 if fast else 20_000))
+    run_scenario("preempt_churn", lambda: bench_preempt_churn(
+        200 if fast else 4_000, n_cohorts=4 if fast else 20))
+    run_scenario("tas", lambda: bench_tas(60 if fast else 800,
+                                          n_cqs=4 if fast else 8))
 
     print(json.dumps({
         "metric": (

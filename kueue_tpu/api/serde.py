@@ -75,10 +75,14 @@ def from_jsonable(data: Any) -> Any:
         _auto_register()
     if isinstance(data, dict):
         if "__t__" in data:
+            from kueue_tpu.api.conversion import convert_fields
+
             cls = _REGISTRY[data["__t__"]]
             kwargs = {k: from_jsonable(v) for k, v in data.items()
                       if k != "__t__"}
-            return cls(**kwargs)
+            # Versioned read: renamed fields map, unknown fields drop,
+            # missing fields default (api/conversion.py).
+            return cls(**convert_fields(cls, kwargs))
         if "__e__" in data:
             return _REGISTRY[data["__e__"]](data["v"])
         return {k: from_jsonable(v) for k, v in data.items()}

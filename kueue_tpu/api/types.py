@@ -178,6 +178,12 @@ class ClusterQueue:
     namespace_selector: Optional[dict[str, str]] = None  # None = match all
     stop_policy: StopPolicy = StopPolicy.NONE
     admission_checks: tuple[str, ...] = ()
+    # Per-flavor check scoping (clusterqueue_types.go:166
+    # admissionChecksStrategy): check name -> flavors it applies to
+    # (empty tuple = all flavors). Mutually exclusive with
+    # ``admission_checks`` in the reference; both supported here.
+    admission_checks_strategy: dict[str, tuple[str, ...]] = field(
+        default_factory=dict)
     # "UsageBasedAdmissionFairSharing" orders within the CQ by LocalQueue
     # usage (clusterqueue_types.go admissionScope).
     admission_scope: Optional[str] = None
@@ -387,6 +393,19 @@ class WorkloadStatus:
     # PreemptionGateState): gate name -> open-transition time. A gate
     # named in spec but absent here is Closed.
     open_preemption_gates: dict[str, float] = field(default_factory=dict)
+    # Eviction counts by reason (workload_types.go:728 schedulingStats).
+    eviction_counts: dict[str, int] = field(default_factory=dict)
+    # Execution time already consumed across past admissions
+    # (workload_types.go accumulatedPastExecutionTimeSeconds) — the
+    # maximum-execution-time budget spans evict/requeue cycles.
+    accumulated_past_execution_time_seconds: float = 0.0
+    # Effective per-PodSet total requests at consideration time
+    # (workload_types.go:886 PodSetRequest — post LimitRange/transforms).
+    resource_requests: dict[str, dict[str, int]] = field(
+        default_factory=dict)
+    # MultiKueue placement (workload_types.go status):
+    nominated_cluster_names: tuple[str, ...] = ()
+    cluster_name: Optional[str] = None
 
 
 _uid_counter = itertools.count(1)

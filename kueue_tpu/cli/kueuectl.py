@@ -261,7 +261,6 @@ class Kueuectl:
         wl = self.engine.workloads.get(key)
         if wl is None:
             raise KeyError(key)
-        info = None
         from kueue_tpu.workload_info import WorkloadInfo
 
         info = WorkloadInfo.from_workload(

@@ -113,14 +113,31 @@ class AdmissionCheckManager:
         self.checks.pop(name, None)
         self._requeue_after_registry_change()
 
-    def required_for(self, cq_name: str) -> tuple[str, ...]:
+    def required_for(self, cq_name: str,
+                     wl: Optional[Workload] = None) -> tuple[str, ...]:
+        """The CQ's checks plus the admissionChecksStrategy checks whose
+        flavor scope matches the workload's assigned flavors
+        (clusterqueue_types.go:166-189, workload.AdmissionChecksForWorkload)."""
         cq = self.engine.cache.cluster_queues.get(cq_name)
-        return cq.admission_checks if cq else ()
+        if cq is None:
+            return ()
+        out = list(cq.admission_checks)
+        strategy = getattr(cq, "admission_checks_strategy", None) or {}
+        if strategy:
+            assigned: set[str] = set()
+            if wl is not None and wl.status.admission is not None:
+                for psa in wl.status.admission.pod_set_assignments:
+                    assigned |= set(psa.flavors.values())
+            for check, flavors in strategy.items():
+                if not flavors or (assigned & set(flavors)):
+                    if check not in out:
+                        out.append(check)
+        return tuple(out)
 
     def sync_states(self, wl: Workload, cq_name: str) -> None:
         """reconcileSyncAdmissionChecks: seed Pending states for the CQ's
         checks (workload_controller.go:934)."""
-        for name in self.required_for(cq_name):
+        for name in self.required_for(cq_name, wl):
             wl.status.admission_check_states.setdefault(
                 name, CheckState.PENDING)
 
@@ -128,7 +145,7 @@ class AdmissionCheckManager:
         """workload.HasAllRequiredChecks (scheduler.go:914)."""
         return all(
             wl.status.admission_check_states.get(name) == CheckState.READY
-            for name in self.required_for(cq_name))
+            for name in self.required_for(cq_name, wl))
 
     def set_state(self, wl_key: str, check: str, state: CheckState) -> None:
         """A check controller reporting its verdict; triggers the workload
@@ -208,7 +225,7 @@ class ProvisioningController:
                 continue
             cq = (wl.status.admission.cluster_queue
                   if wl.status.admission else "")
-            if self.check_name not in acm.required_for(cq):
+            if self.check_name not in acm.required_for(cq, wl):
                 continue
             state = wl.status.admission_check_states.get(self.check_name)
             if state in (CheckState.READY, CheckState.REJECTED):

@@ -124,11 +124,12 @@ class DeviceClassMapper:
     # -- inventory (groupSlicesByPool / poolInfo) --
 
     def add_resource_slice(self, s: ResourceSlice) -> None:
-        key = (s.driver, s.pool,
-               s.name or f"slice-{len(self._slices)}")
         if not s.name:
-            # Anonymous slices get a distinct generated identity once.
-            s.name = key[2]
+            # Anonymous slices get a collision-free generated identity
+            # (a monotonic counter — dict length would reuse names
+            # after deletes and clobber live inventory).
+            self._anon_counter = getattr(self, "_anon_counter", 0) + 1
+            s.name = f"anon-slice-{self._anon_counter}"
         self._slices[(s.driver, s.pool, s.name)] = s
 
     def delete_resource_slice(self, driver: str, pool: str,

@@ -391,6 +391,10 @@ class Engine:
             return False
         self.registry.histogram("workload_creation_latency_seconds").observe(
             max(0.0, self.clock - wl.creation_time))
+        # status.resourceRequests: the effective (post-pipeline) totals
+        # at consideration time (workload_types.go:886 PodSetRequest).
+        wl.status.resource_requests = {
+            psr.name: dict(psr.requests) for psr in info.total_requests}
         self._track_unadmitted(wl, info.cluster_queue, "NoReservation")
         self._event("Submitted", wl.key,
                     cluster_queue=info.cluster_queue)
@@ -444,7 +448,11 @@ class Engine:
             if max_s is None:
                 continue
             adm = wl.condition(WorkloadConditionType.ADMITTED)
-            if adm and self.clock - adm.last_transition_time > max_s:
+            # The budget spans admissions: past execution time counts
+            # (workload_controller.go:838 + accumulatedPastExecutionTime).
+            spent = wl.status.accumulated_past_execution_time_seconds
+            if adm and spent + (self.clock - adm.last_transition_time) \
+                    > max_s:
                 wl.active = False
                 self.evict(wl, "MaximumExecutionTimeExceeded",
                            requeue=False)
@@ -806,7 +814,7 @@ class Engine:
         cq_name = wl.status.admission.cluster_queue
         from kueue_tpu.controllers.admissionchecks import CheckState
         states = wl.status.admission_check_states
-        required = (self.admission_checks.required_for(cq_name)
+        required = (self.admission_checks.required_for(cq_name, wl)
                     if self.admission_checks else ())
         if any(states.get(c) == CheckState.REJECTED for c in required):
             # Deactivate before evicting so the journaled eviction state
@@ -835,6 +843,13 @@ class Engine:
         _adm = wl.condition(WorkloadConditionType.ADMITTED)
         admitted_at = (_adm.last_transition_time
                        if _adm is not None and _adm.status else None)
+        # schedulingStats (workload_types.go:728) + the cross-admission
+        # execution-time budget (accumulatedPastExecutionTimeSeconds).
+        wl.status.eviction_counts[reason] = \
+            wl.status.eviction_counts.get(reason, 0) + 1
+        if admitted_at is not None:
+            wl.status.accumulated_past_execution_time_seconds += \
+                max(0.0, self.clock - admitted_at)
         wl.set_condition(WorkloadConditionType.EVICTED, True,
                          reason=reason, now=self.clock)
         wl.set_condition(WorkloadConditionType.ADMITTED, False,

@@ -103,6 +103,14 @@ class MultiKueueController:
     def connect_cluster(self, name: str, engine) -> None:
         self.clusters[name] = engine
 
+    @staticmethod
+    def _clear_placement_status(wl: Workload) -> None:
+        """Reset clusterName/nominatedClusterNames when a placement is
+        torn down — a later re-nomination must not coexist with a stale
+        placement (the workload_types.go:613 mutual-exclusion rule)."""
+        wl.status.cluster_name = None
+        wl.status.nominated_cluster_names = ()
+
     def disconnect_cluster(self, name: str) -> None:
         """Worker lost: evict manager workloads placed there."""
         self.clusters.pop(name, None)
@@ -111,6 +119,7 @@ class MultiKueueController:
                 wl = self.engine.workloads.get(wl_key)
                 del self.states[wl_key]
                 if wl is not None and not wl.is_finished:
+                    self._clear_placement_status(wl)
                     self.engine.evict(wl, "MultiKueueClusterLost")
             else:
                 state.created.pop(name, None)
@@ -127,9 +136,10 @@ class MultiKueueController:
                 if wl.key in self.states:
                     self._remove_remotes(wl.key, except_cluster=None)
                     del self.states[wl.key]
+                    self._clear_placement_status(wl)
                 continue
             cq = wl.status.admission.cluster_queue
-            if self.check_name not in acm.required_for(cq):
+            if self.check_name not in acm.required_for(cq, wl):
                 continue
             state = self.states.setdefault(wl.key, _RemoteState())
             if state.cluster_name is None:
@@ -148,6 +158,7 @@ class MultiKueueController:
         available = [c for c in self.config.clusters if c in self.clusters]
         if self.dispatcher == Dispatcher.ALL_AT_ONCE:
             state.nominated = available
+            wl.status.nominated_cluster_names = tuple(state.nominated)
             return
         # Incremental: +increment clusters every round_seconds
         # (incrementaldispatcher.go:50).
@@ -160,6 +171,7 @@ class MultiKueueController:
             n = len(state.nominated) + self.increment
             state.nominated = available[:n]
             state.last_round_time = self.engine.clock
+        wl.status.nominated_cluster_names = tuple(state.nominated)
 
     def _sync_remotes(self, wl: Workload, state: _RemoteState) -> None:
         for cluster in state.nominated:
@@ -229,6 +241,10 @@ class MultiKueueController:
             remote = worker.workloads.get(key)
             if remote is not None and remote.is_admitted:
                 state.cluster_name = cluster
+                # clusterName and nominatedClusterNames are mutually
+                # exclusive once placed (workload_types.go:613 CEL rule).
+                wl.status.cluster_name = cluster
+                wl.status.nominated_cluster_names = ()
                 self._remove_remotes(wl.key, except_cluster=cluster)
                 self._sync_remote_job(wl, state)
                 acm.set_state(wl.key, self.check_name, CheckState.READY)
@@ -273,6 +289,7 @@ class MultiKueueController:
         if remote is None:
             # Remote object lost: evict & retry.
             del self.states[wl.key]
+            self._clear_placement_status(wl)
             self.engine.evict(wl, "MultiKueueRemoteLost")
             return
         # Keep the remote job object in sync (create if the win happened

@@ -110,6 +110,20 @@ DEFAULT_ADAPTERS: dict[str, MultiKueueAdapter] = {
     "jobset.x-k8s.io/jobset": JobSetAdapter(),
 }
 
+# Every other integration's jobs share the _BaseJob status shape
+# (active/done/success), so the generic adapter with those fields covers
+# them — the analog of the reference's per-framework
+# <kind>_multikueue_adapter.go files, which differ only in the status
+# stanza they copy.
+for _kind in ("kubeflow.org/trainingjob", "kubeflow.org/trainjob",
+              "kubeflow.org/mpijob", "ray.io/raycluster", "ray.io/rayjob",
+              "ray.io/rayservice", "workload.codeflare.dev/appwrapper",
+              "leaderworkerset.x-k8s.io/leaderworkerset", "core/pod",
+              "core/podgroup", "apps/statefulset", "apps/deployment",
+              "sparkoperator.k8s.io/sparkapplication", "apps/serving"):
+    DEFAULT_ADAPTERS[_kind] = GenericJobAdapter(
+        kind=_kind, status_fields=("active",))
+
 
 def adapter_for(job, adapters: Optional[dict] = None,
                 integrations=None) -> Optional[MultiKueueAdapter]:

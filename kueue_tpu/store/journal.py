@@ -73,12 +73,17 @@ class Journal:
                 fh.truncate(size - len(tail))
 
     def apply(self, kind: str, obj, ts: float = 0.0) -> None:
+        from kueue_tpu.api.conversion import SCHEMA_VERSION
+
         rec = {"op": "apply", "kind": kind, "ts": ts,
-               "obj": to_jsonable(obj)}
+               "v": SCHEMA_VERSION, "obj": to_jsonable(obj)}
         self._write(rec)
 
     def delete(self, kind: str, key: str, ts: float = 0.0) -> None:
-        self._write({"op": "delete", "kind": kind, "key": key, "ts": ts})
+        from kueue_tpu.api.conversion import SCHEMA_VERSION
+
+        self._write({"op": "delete", "kind": kind, "key": key, "ts": ts,
+                     "v": SCHEMA_VERSION})
 
     def _write(self, rec: dict) -> None:
         self._fh.write(json.dumps(rec) + "\n")
@@ -98,7 +103,9 @@ class Journal:
                 if not line:
                     continue
                 try:
-                    yield json.loads(line)
+                    from kueue_tpu.api.conversion import upgrade_record
+
+                    yield upgrade_record(json.loads(line))
                 except json.JSONDecodeError:
                     return
 

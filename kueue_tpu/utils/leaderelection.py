@@ -34,10 +34,29 @@ class LeaseSpec:
 
 
 class LeaseFile:
-    """The durable lock object (atomic read-modify-write via rename)."""
+    """The durable lock object. Writes are atomic (tempfile + rename);
+    the read-modify-write of an acquire/renew is serialized by an fcntl
+    lock on a sidecar file (the CAS the reference gets from the API
+    server's resourceVersion) — without it two standbys could both read
+    an expired lease and both acquire."""
 
     def __init__(self, path: str):
         self.path = path
+        self._lock_path = path + ".lock"
+
+    def locked(self):
+        import fcntl
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _hold():
+            with open(self._lock_path, "a+") as lock_fh:
+                fcntl.flock(lock_fh.fileno(), fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(lock_fh.fileno(), fcntl.LOCK_UN)
+        return _hold()
 
     def read(self) -> Optional[LeaseSpec]:
         try:
@@ -71,32 +90,38 @@ class LeaderElector:
         self.on_stopped_leading = on_stopped_leading
 
     def tick(self, now: float) -> bool:
-        """One acquire-or-renew attempt; returns leadership."""
-        current = self.lease.read()
-        expired = (current is None or not current.holder
-                   or now - current.renew_time
-                   > current.lease_duration_seconds)
-        if current is not None and current.holder == self.identity:
-            # Renew (or re-acquire our own expired lease).
-            current.renew_time = now
-            self.lease.write(current)
-            self._set_leader(True)
-            return True
-        if expired:
-            self.lease.write(LeaseSpec(
-                holder=self.identity, acquire_time=now, renew_time=now,
-                lease_duration_seconds=self.lease_duration))
-            self._set_leader(True)
-            return True
+        """One acquire-or-renew attempt; returns leadership. The whole
+        read-check-write runs under the lease's file lock so only one
+        replica can win an expired lease."""
+        with self.lease.locked():
+            current = self.lease.read()
+            expired = (current is None or not current.holder
+                       or now - current.renew_time
+                       > current.lease_duration_seconds)
+            if current is not None and current.holder == self.identity:
+                # Renew (or re-acquire our own expired lease).
+                current.renew_time = now
+                self.lease.write(current)
+                self._set_leader(True)
+                return True
+            if expired:
+                self.lease.write(LeaseSpec(
+                    holder=self.identity, acquire_time=now,
+                    renew_time=now,
+                    lease_duration_seconds=self.lease_duration))
+                self._set_leader(True)
+                return True
         self._set_leader(False)
         return False
 
     def release(self) -> None:
         """Graceful handoff (ReleaseOnCancel)."""
-        current = self.lease.read()
-        if current is not None and current.holder == self.identity:
-            self.lease.write(LeaseSpec(
-                lease_duration_seconds=current.lease_duration_seconds))
+        with self.lease.locked():
+            current = self.lease.read()
+            if current is not None and current.holder == self.identity:
+                self.lease.write(LeaseSpec(
+                    lease_duration_seconds=current
+                    .lease_duration_seconds))
         self._set_leader(False)
 
     def _set_leader(self, leading: bool) -> None:

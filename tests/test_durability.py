@@ -396,3 +396,55 @@ def test_inadmissible_workload_not_resurrected_on_restart(tmp_path):
     reb = rebuild_engine(str(tmp_path / "j.jsonl"))
     reb.schedule_once()
     assert not reb.workloads["default/w"].is_admitted
+
+
+def test_versioned_read_tolerates_renames_and_unknown_fields():
+    """api/conversion.py: journals from other schema versions replay —
+    renamed fields map, unknown fields drop, missing fields default
+    (the apis/{v1beta1,v1beta2} conversion analog)."""
+    from kueue_tpu.api import conversion
+
+    data = to_jsonable(Workload(
+        name="w", pod_sets=(PodSet("main", 1, {"cpu": 100}),)))
+    # A newer writer added a field we do not know.
+    data["from_the_future"] = {"x": 1}
+    back = from_jsonable(data)
+    assert back.name == "w"
+    # A renamed field maps onto its new name.
+    conversion.register_rename("Workload", "legacy_queue", "queue_name")
+    try:
+        data2 = to_jsonable(Workload(name="w2"))
+        del data2["queue_name"]
+        data2["legacy_queue"] = "lq9"
+        assert from_jsonable(data2).queue_name == "lq9"
+        # A retired field drops.
+        conversion.register_rename("Workload", "dead_field", None)
+        data3 = to_jsonable(Workload(name="w3"))
+        data3["dead_field"] = True
+        assert from_jsonable(data3).name == "w3"
+    finally:
+        conversion.FIELD_RENAMES.pop("Workload", None)
+
+
+def test_journal_records_are_versioned_and_upgraded(tmp_path):
+    import json as _json
+
+    from kueue_tpu.api.conversion import SCHEMA_VERSION
+
+    eng = Engine()
+    build_world(eng)
+    attach_new_journal(eng, str(tmp_path / "j.jsonl"))
+    eng.submit(Workload(name="w", queue_name="lq0",
+                        pod_sets=(PodSet("main", 1, {"cpu": 100}),)))
+    with open(tmp_path / "j.jsonl") as f:
+        records = [_json.loads(line) for line in f if line.strip()]
+    assert all(r["v"] == SCHEMA_VERSION for r in records)
+    # An unversioned (round-1) journal replays through the upgrader.
+    legacy = tmp_path / "legacy.jsonl"
+    with open(legacy, "w") as f:
+        for r in records:
+            r = dict(r)
+            r.pop("v")
+            f.write(_json.dumps(r) + "\n")
+    reb = rebuild_engine(str(legacy))
+    assert "default/w" in reb.workloads

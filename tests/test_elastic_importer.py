@@ -97,3 +97,45 @@ def test_importer_rejects_unmapped_queue():
                     pod_sets=(PodSet("main", 1, {CPU: 100}),))]
     res = check(eng, bad, {CPU: "default"})
     assert not res.ok
+
+
+def test_importer_mapping_rules_and_pod_import():
+    """cmd/importer: mapping rules route pods to LocalQueues (first
+    match wins, skip rules, label indirection), then the import phase
+    admits them in place."""
+    from kueue_tpu.controllers.importer import (
+        MappingRule,
+        MappingRules,
+        PodToImport,
+        import_workloads,
+        pods_to_workloads,
+    )
+
+    eng = make_engine()
+    rules = MappingRules(rules=(
+        MappingRule(skip=True, match_labels={"kueue-ignore": "true"}),
+        MappingRule(to_local_queue="lq",
+                    priority_class_name="high",
+                    match_labels={"team": "ml"}),
+        MappingRule(to_local_queue="${queue-label}"),
+    ))
+    pods = [
+        PodToImport("p1", labels={"team": "ml"},
+                    priority_class_name="high", priority=5,
+                    requests={CPU: 500}),
+        PodToImport("p2", labels={"kueue-ignore": "true"},
+                    requests={CPU: 100}),
+        PodToImport("p3", labels={"queue-label": "lq"},
+                    requests={CPU: 300}),
+    ]
+    wls, skipped = pods_to_workloads(pods, rules)
+    assert [w.name for w in wls] == ["p1", "p3"]
+    assert skipped == ["default/p2"]
+    assert wls[0].queue_name == "lq" and wls[1].queue_name == "lq"
+
+    result = import_workloads(eng, wls, {CPU: "default"})
+    assert result.ok
+    assert eng.workloads["default/p1"].is_admitted
+    from kueue_tpu.api.types import FlavorResource
+    assert eng.cache.usage_for_cq("cq")[
+        FlavorResource("default", CPU)] == 800

@@ -127,3 +127,62 @@ def test_afs_orders_by_local_queue_usage():
     eng.schedule_once()
     assert b.is_admitted
     assert not a.is_admitted
+
+
+def test_accumulated_execution_time_budget_spans_admissions():
+    """workload_types.go accumulatedPastExecutionTimeSeconds: the max
+    execution budget counts time from PAST admissions too."""
+    eng = make_engine()
+    wl = submit(eng, "w", 400)
+    wl.maximum_execution_time_seconds = 100
+    eng.schedule_once()
+    assert wl.is_admitted
+    eng.tick(60.0)
+    eng.evict(wl, "Preempted")  # 60s consumed
+    assert wl.status.accumulated_past_execution_time_seconds == 60.0
+    eng.schedule_once()
+    assert wl.is_admitted
+    eng.tick(50.0)  # 60 + 50 > 100 -> budget exhausted
+    assert not wl.active
+    ev = wl.condition("Evicted")
+    assert ev.reason == "MaximumExecutionTimeExceeded"
+    assert wl.status.eviction_counts == {
+        "Preempted": 1, "MaximumExecutionTimeExceeded": 1}
+
+
+def test_admission_checks_strategy_scopes_by_flavor():
+    """clusterqueue_types.go:166 admissionChecksStrategy: a check bound
+    to specific flavors applies only when one of them is assigned."""
+    from kueue_tpu.api.types import FlavorQuotas, ResourceGroup
+    from kueue_tpu.controllers.admissionchecks import (
+        AdmissionCheck,
+        AdmissionCheckManager,
+        CheckState,
+    )
+
+    eng = Engine()
+    acm = AdmissionCheckManager(eng)
+    acm.create_admission_check(AdmissionCheck("spot-check"))
+    eng.create_resource_flavor(ResourceFlavor("reserved"))
+    eng.create_resource_flavor(ResourceFlavor("spot"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq",
+        admission_checks_strategy={"spot-check": ("spot",)},
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("reserved", {CPU: ResourceQuota(500)}),
+             FlavorQuotas("spot", {CPU: ResourceQuota(2000)}),)),),))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    # Fits in reserved: no check required, admits immediately.
+    w1 = submit(eng, "w1", 400, lq="lq")
+    eng.schedule_once()
+    assert w1.is_admitted
+    assert w1.status.admission_check_states == {}
+    # Forced onto spot: the scoped check gates admission.
+    w2 = submit(eng, "w2", 1000, lq="lq")
+    eng.schedule_once()
+    assert w2.has_quota_reservation and not w2.is_admitted
+    assert w2.status.admission.pod_set_assignments[0].flavors[CPU] \
+        == "spot"
+    acm.set_state(w2.key, "spot-check", CheckState.READY)
+    assert w2.is_admitted

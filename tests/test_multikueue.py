@@ -309,3 +309,49 @@ def test_orchestrated_preemption_timeout_rotates_cluster():
     assert w2.workloads[wl.key].is_admitted
     mk.reconcile()
     assert mk.states[wl.key].cluster_name == "worker2"
+
+
+def test_adapter_registry_covers_all_integrations():
+    from kueue_tpu.controllers.integrations import DEFAULT_INTEGRATIONS
+    from kueue_tpu.controllers.multikueue_adapters import DEFAULT_ADAPTERS
+
+    missing = [k for k in DEFAULT_INTEGRATIONS.kinds()
+               if k not in DEFAULT_ADAPTERS]
+    assert missing == [], missing
+
+
+def test_adapter_mirrors_mpi_job():
+    """A non-batch framework (MPIJob) mirrors through the generic
+    adapter: remote job object created, status synced back."""
+    from kueue_tpu.controllers.integrations import (
+        DEFAULT_INTEGRATIONS,
+        MPIJob,
+    )
+    from kueue_tpu.controllers.jobframework import JobReconciler
+
+    manager, w1, w2, mk = make_stack()
+    mgr_rec = JobReconciler(manager, integrations=DEFAULT_INTEGRATIONS)
+    w1_rec = JobReconciler(w1, integrations=DEFAULT_INTEGRATIONS)
+    w2_rec = JobReconciler(w2, integrations=DEFAULT_INTEGRATIONS)
+    mk.attach_job_framework(mgr_rec, {"worker1": w1_rec,
+                                      "worker2": w2_rec})
+    job = MPIJob(name="mpi", queue_name="lq",
+                 launcher_requests={CPU: 100},
+                 worker_replicas=2, worker_requests={CPU: 500})
+    mgr_rec.create_job(job)
+    manager.schedule_once()
+    mk.reconcile()
+    w1.schedule_once()
+    mk.reconcile()
+    wl_key = mgr_rec.job_to_workload[job.key]
+    assert mk.states[wl_key].cluster_name == "worker1"
+    assert manager.workloads[wl_key].status.cluster_name == "worker1"
+    assert job.key in w1_rec.jobs
+    w1_rec.reconcile_all()
+    remote = w1_rec.jobs[job.key]
+    assert not remote.is_suspended()
+    remote.done = True
+    remote.success = True
+    w1_rec.reconcile_all()
+    mk.reconcile()
+    assert manager.workloads[wl_key].is_finished
