@@ -36,6 +36,8 @@ class Cache:
         # Hook returning the set of defined AdmissionCheck names
         # (installed by AdmissionCheckManager); None = no check registry.
         self.admission_check_names = None
+        # Cached TAS forest prototypes (see tas_prototypes()).
+        self._tas_protos = None
 
     # -- object lifecycle --
 
@@ -51,23 +53,66 @@ class Cache:
     def delete_cohort(self, name: str) -> None:
         self.cohorts.pop(name, None)
 
+    def _invalidate_tas_prototypes(self) -> None:
+        self._tas_protos = None
+
     def add_or_update_resource_flavor(self, rf: ResourceFlavor) -> None:
         self.resource_flavors[rf.name] = rf
+        self._invalidate_tas_prototypes()
 
     def delete_resource_flavor(self, name: str) -> None:
         self.resource_flavors.pop(name, None)
+        self._invalidate_tas_prototypes()
 
     def add_or_update_topology(self, topology) -> None:
         self.topologies[topology.name] = topology
+        self._invalidate_tas_prototypes()
 
     def delete_topology(self, name: str) -> None:
         self.topologies.pop(name, None)
+        self._invalidate_tas_prototypes()
 
     def add_or_update_node(self, node) -> None:
         self.nodes[node.name] = node
+        self._invalidate_tas_prototypes()
 
     def delete_node(self, name: str) -> None:
         self.nodes.pop(name, None)
+        self._invalidate_tas_prototypes()
+
+    def set_node_ready(self, name: str, ready: bool) -> bool:
+        """In-place readiness flip WITH prototype invalidation — the
+        one sanctioned way to mutate a registered node (mutating the
+        object directly would leave stale TAS forests serving)."""
+        node = self.nodes.get(name)
+        if node is None:
+            return False
+        node.ready = ready
+        self._invalidate_tas_prototypes()
+        return True
+
+    def tas_prototypes(self):
+        """Cached per-flavor TAS forest prototypes (the tas_cache.go
+        node-forest cache): rebuilt only when nodes/topologies/flavors
+        change; snapshots fork them instead of re-adding every node."""
+        if self._tas_protos is None:
+            from kueue_tpu.tas.snapshot import TASFlavorSnapshot
+
+            protos = {}
+            for rf in self.resource_flavors.values():
+                topo = self.topologies.get(rf.topology_name) \
+                    if rf.topology_name else None
+                if topo is None:
+                    continue
+                snap = TASFlavorSnapshot(
+                    topo, flavor_tolerations=tuple(rf.tolerations))
+                for node in self.nodes.values():
+                    if all(node.labels.get(k) == v
+                           for k, v in rf.node_labels.items()):
+                        snap.add_node(node)
+                protos[rf.name] = snap
+            self._tas_protos = protos
+        return self._tas_protos
 
     # -- workloads (cache.go:766 AddOrUpdateWorkload / assume) --
 
@@ -151,4 +196,5 @@ class Cache:
             inactive_cluster_queues=self.inactive_cluster_queues(),
             topologies=list(self.topologies.values()),
             nodes=list(self.nodes.values()),
+            tas_prototypes=self.tas_prototypes(),
         )

@@ -411,6 +411,7 @@ def build_snapshot(
     inactive_cluster_queues: Optional[set[str]] = None,
     topologies: Optional[list] = None,
     nodes: Optional[list] = None,
+    tas_prototypes: Optional[dict] = None,
 ) -> Snapshot:
     """Assemble a Snapshot and run the tree-resource accumulation
     (resource_node.go:178 updateCohortTreeResources)."""
@@ -419,8 +420,13 @@ def build_snapshot(
     snap.inactive_cluster_queues = set(inactive_cluster_queues or ())
 
     # TAS flavor snapshots (tas_cache.go): one per flavor with a topology,
-    # fed by the nodes matching the flavor's nodeLabels.
-    if topologies:
+    # fed by the nodes matching the flavor's nodeLabels. With cached
+    # prototypes (Cache.tas_prototypes) the per-snapshot cost is a forest
+    # fork instead of O(nodes) re-parsing.
+    if tas_prototypes is not None:
+        for name, proto in tas_prototypes.items():
+            snap.tas_flavors[name] = proto.fork()
+    elif topologies:
         from kueue_tpu.tas.snapshot import TASFlavorSnapshot
         topo_by_name = {t.name: t for t in topologies}
         for rf in resource_flavors:

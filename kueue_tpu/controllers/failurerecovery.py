@@ -53,9 +53,7 @@ class FailureRecoveryController:
         """Node health event (tas/node_controller.go). Returns affected
         workload keys."""
         self.unhealthy_nodes.add(node_name)
-        node = self.engine.cache.nodes.get(node_name)
-        if node is not None:
-            node.ready = False
+        self.engine.cache.set_node_ready(node_name, False)
         affected = self._workloads_on_node(node_name)
         over_limit = []
         for key in affected:
@@ -94,9 +92,7 @@ class FailureRecoveryController:
 
     def node_recovered(self, node_name: str) -> None:
         self.unhealthy_nodes.discard(node_name)
-        node = self.engine.cache.nodes.get(node_name)
-        if node is not None:
-            node.ready = True
+        self.engine.cache.set_node_ready(node_name, True)
         self.engine.queues.queue_inadmissible_workloads()
 
     def _workloads_on_node(self, node_name: str) -> list[str]:

@@ -60,6 +60,14 @@ from kueue_tpu.scheduler.flavorassigner import (
 _HOST_BIG = np.int64(1) << 60
 
 
+def _flavor_unsafe(rf) -> bool:
+    """A flavor whose workloads must take the host path: taints need the
+    host toleration matching, a topology needs the TAS pass. The single
+    predicate behind both the pre-snapshot world check and the per-root
+    demotion."""
+    return rf is not None and bool(rf.node_taints or rf.topology_name)
+
+
 class OracleBridge:
     def __init__(self, engine, max_depth: int = 4, executor=None):
         self.engine = engine
@@ -88,6 +96,26 @@ class OracleBridge:
             # BlockAdmission (scheduler.go:535): the host path owns the
             # hold-everything requeue bookkeeping.
             return False
+        # When EVERY CQ with pending work is flavor-unsafe (TAS/taints),
+        # every root would demote and the snapshot+solver built here
+        # would be thrown away — skip straight to the sequential path.
+        # Computed from the cache (no snapshot needed).
+        any_safe = False
+        any_pending = False
+        for name, pcq in eng.queues.cluster_queues.items():
+            if not pcq.items:
+                continue
+            any_pending = True
+            cq = eng.cache.cluster_queues.get(name)
+            if cq is None:
+                continue
+            if not any(_flavor_unsafe(eng.cache.resource_flavors.get(
+                    fq.name))
+                    for rg in cq.resource_groups for fq in rg.flavors):
+                any_safe = True
+                break
+        if any_pending and not any_safe:
+            return False
         return True
 
     def _fallback(self, reason: str) -> None:
@@ -106,12 +134,9 @@ class OracleBridge:
         safe = np.ones(w.num_cqs, bool)
         for ci, name in enumerate(w.cq_names):
             spec = snapshot.cluster_queues[name].spec
-            for rg in spec.resource_groups:
-                for fq in rg.flavors:
-                    rf = eng.cache.resource_flavors.get(fq.name)
-                    if rf is not None and (rf.node_taints
-                                           or rf.topology_name):
-                        safe[ci] = False
+            safe[ci] = not any(
+                _flavor_unsafe(eng.cache.resource_flavors.get(fq.name))
+                for rg in spec.resource_groups for fq in rg.flavors)
         return safe
 
     def _cq_policy_cfg(self, snapshot, w):

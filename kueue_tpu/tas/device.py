@@ -38,6 +38,25 @@ def _structure(snap):
     version = getattr(snap, "_version", 0)
     if cached is not None and cached["version"] == version:
         return cached
+    # Forked snapshots (TASFlavorSnapshot.fork) share the prototype's
+    # structure: remap the domain-object lists onto the fork's clones
+    # and reuse every numpy array (same shapes, same slot order).
+    donor = getattr(snap, "_struct_donor", None)
+    donor_struct = None
+    if donor is not None:
+        # Build (or reuse) the struct ON THE PROTOTYPE so every future
+        # fork shares it — deriving it on the fork would discard it at
+        # cycle end and redo the encode + device transfers every cycle.
+        donor_struct = _structure(donor)
+    if donor_struct is not None and donor_struct["version"] == version:
+        level_domains = [[snap.domains[d.values] for d in doms]
+                         for doms in donor_struct["level_domains"]]
+        cached = dict(donor_struct,
+                      level_domains=level_domains,
+                      leaves=(level_domains[-1] if level_domains
+                              else []))
+        snap._device_struct = cached
+        return cached
     nl = len(snap.level_keys)
     level_domains = [
         sorted(snap.domains_per_level[lvl].values(),
@@ -132,24 +151,47 @@ def try_find(snap, workers, leader=None, simulate_empty=False,
     cols = axis + extras
     sp = max(4, -(-len(cols) // 4) * 4)  # pad to a multiple of 4
     cols = cols + [f"__pad{i}" for i in range(sp - len(cols))]
+    cols_key = tuple(cols)
 
     mp = struct["m"]
     leaves = struct["leaves"]
-    free = np.zeros((mp, sp), np.int64)
-    usage = np.zeros((mp, sp), np.int64)
-    assumed = np.zeros((mp, sp), np.int64)
     col_of = {res: i for i, res in enumerate(cols)}
-    for i, leaf in enumerate(leaves):
-        for res, cap in leaf.free_capacity.items():
-            free[i, col_of[res]] = cap
-        if not simulate_empty:
-            for res, used in leaf.tas_usage.items():
-                # Usage may name resources no node advertises anymore
-                # (recorded before a capacity change); they cannot affect
-                # any fit count, like the host's remaining-dict misses.
-                if res in col_of:
-                    usage[i, col_of[res]] = used
-            if assumed_usage:
+
+    # Free capacity is constant for the forest version: build the matrix
+    # once per (version, column set) and share it through the struct
+    # (which forks inherit from their prototype).
+    free_cache = struct.setdefault("free_cache", {})
+    free = free_cache.get(cols_key)
+    if free is None:
+        free = np.zeros((mp, sp), np.int64)
+        for i, leaf in enumerate(leaves):
+            for res, cap in leaf.free_capacity.items():
+                free[i, col_of[res]] = cap
+        free_cache[cols_key] = free
+
+    # TAS usage changes only on add_usage/remove_usage (counted by
+    # _usage_version): rebuild the usage matrix only then.
+    assumed = np.zeros((mp, sp), np.int64)
+    if simulate_empty:
+        usage = np.zeros((mp, sp), np.int64)
+    else:
+        uver = getattr(snap, "_usage_version", 0)
+        ucache = getattr(snap, "_usage_matrix_cache", None)
+        if ucache is not None and ucache[0] == (uver, cols_key):
+            usage = ucache[1]
+        else:
+            usage = np.zeros((mp, sp), np.int64)
+            for i, leaf in enumerate(leaves):
+                for res, used in leaf.tas_usage.items():
+                    # Usage may name resources no node advertises anymore
+                    # (recorded before a capacity change); they cannot
+                    # affect any fit count, like the host's
+                    # remaining-dict misses.
+                    if res in col_of:
+                        usage[i, col_of[res]] = used
+            snap._usage_matrix_cache = ((uver, cols_key), usage)
+        if assumed_usage:
+            for i, leaf in enumerate(leaves):
                 for res, used in assumed_usage.get(leaf.id, {}).items():
                     if res in col_of:
                         assumed[i, col_of[res]] = used
@@ -177,13 +219,27 @@ def try_find(snap, workers, leader=None, simulate_empty=False,
         TopologyDomainAssignment,
     )
 
+    # Device-resident constants: transfer the forest arrays (and the
+    # per-version free matrix) once, not per placement call.
+    jnp_cache = struct.setdefault("jnp_cache", {})
+    if "consts" not in jnp_cache:
+        jnp_cache["consts"] = (
+            jnp.asarray(struct["has_pods_cap"]),
+            jnp.asarray(struct["valid"]), jnp.asarray(struct["vrank"]),
+            jnp.asarray(struct["parent"]))
+    j_pods_cap, j_valid, j_vrank, j_parent = jnp_cache["consts"]
+    j_free = jnp_cache.get(("free", cols_key))
+    if j_free is None:
+        j_free = jnp.asarray(free)
+        jnp_cache[("free", cols_key)] = j_free
+
     status, fit_arg, cnt, lead = tops.tas_place(
-        jnp.asarray(free), jnp.asarray(usage), jnp.asarray(assumed),
+        j_free, jnp.asarray(usage), jnp.asarray(assumed),
         jnp.asarray(_req_vector(per_pod, cols)),
         jnp.asarray(_req_vector(leader_per_pod, cols)),
-        jnp.asarray(leaf_mask), jnp.asarray(struct["has_pods_cap"]),
-        jnp.asarray(struct["valid"]), jnp.asarray(struct["vrank"]),
-        jnp.asarray(struct["parent"]), np.int64(count),
+        jnp.asarray(leaf_mask), j_pods_cap,
+        j_valid, j_vrank,
+        j_parent, np.int64(count),
         np.int64(slice_size), num_levels=struct["nl"], max_domains=mp,
         pods_col=col_of["pods"], req_level=req_idx,
         slice_level=slice_idx, required=required,

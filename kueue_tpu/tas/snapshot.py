@@ -205,6 +205,61 @@ class TASFlavorSnapshot:
 
     # -- construction (tas_flavor.go / tas_nodes_cache.go) --
 
+    def fork(self) -> "TASFlavorSnapshot":
+        """Cheap per-cycle copy of a cached forest prototype: the domain
+        structure and free capacities are shared (immutable within a
+        snapshot's lifetime), while ``tas_usage`` and the phase states —
+        the only per-cycle mutables — are fresh. This is the analog of
+        the reference's cached TAS snapshot (tas_cache.go holds the node
+        forest; snapshots overlay usage), and it turns the
+        640-node-per-snapshot rebuild into an O(domains) pointer walk."""
+        new = TASFlavorSnapshot.__new__(TASFlavorSnapshot)
+        new.topology_name = self.topology_name
+        new.level_keys = self.level_keys
+        new.flavor_tolerations = self.flavor_tolerations
+        new.is_lowest_level_node = self.is_lowest_level_node
+        new._version = self._version
+        new.domains = {}
+        new.leaves = {}
+        new.roots = {}
+        new.domains_per_level = [{} for _ in self.level_keys]
+
+        # Iterative, level by level (parents first — _ensure_domain
+        # inserts children before parents, so plain insertion order
+        # won't do); direct slot assignment skips __init__ overhead.
+        domains = new.domains
+        mk = _Domain.__new__
+        for level_table in self.domains_per_level:
+            for values, d in level_table.items():
+                c = mk(_Domain)
+                c.id = d.id
+                c.values = values
+                c.state = 0
+                c.slice_state = 0
+                c.state_with_leader = 0
+                c.slice_state_with_leader = 0
+                c.leader_state = 0
+                c.free_capacity = d.free_capacity  # shared, read-only
+                c.tas_usage = {}
+                c.node_name = d.node_name
+                c.children = []
+                parent = d.parent
+                if parent is None:
+                    c.parent = None
+                else:
+                    c.parent = domains[parent.values]
+                    c.parent.children.append(c)
+                domains[values] = c
+                new.domains_per_level[len(values) - 1][values] = c
+                if not d.children:
+                    new.leaves[values] = c
+        for values in self.roots:
+            new.roots[values] = domains[values]
+        # The device encoding (tas/device.py _structure) can remap its
+        # cached arrays through the prototype instead of re-deriving.
+        new._struct_donor = self
+        return new
+
     def add_node(self, node: Node,
                  non_tas_usage: Optional[dict[str, int]] = None) -> None:
         if not node.ready:
@@ -258,6 +313,7 @@ class TASFlavorSnapshot:
         leaf = self.leaves.get(tuple(values))
         if leaf is None:
             return
+        self._usage_version = getattr(self, "_usage_version", 0) + 1
         for res, per_pod in requests.items():
             leaf.tas_usage[res] = leaf.tas_usage.get(res, 0) + per_pod * count
         leaf.tas_usage["pods"] = leaf.tas_usage.get("pods", 0)
@@ -267,6 +323,7 @@ class TASFlavorSnapshot:
         leaf = self.leaves.get(tuple(values))
         if leaf is None:
             return
+        self._usage_version = getattr(self, "_usage_version", 0) + 1
         for res, per_pod in requests.items():
             leaf.tas_usage[res] = leaf.tas_usage.get(res, 0) - per_pod * count
 
