@@ -171,6 +171,10 @@ class ClusterQueue:
     name: str
     resource_groups: tuple[ResourceGroup, ...] = ()
     cohort: Optional[str] = None
+    # Object metadata (all reference CRDs carry these; sources for
+    # custom metric labels, selectors, origin marks).
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
     queueing_strategy: QueueingStrategy = QueueingStrategy.BEST_EFFORT_FIFO
     preemption: ClusterQueuePreemption = field(default_factory=ClusterQueuePreemption)
     flavor_fungibility: FlavorFungibility = field(default_factory=FlavorFungibility)
@@ -231,6 +235,8 @@ class LocalQueue:
     namespace: str = "default"
     cluster_queue: str = ""
     stop_policy: StopPolicy = StopPolicy.NONE
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
 
     @property
     def key(self) -> str:
@@ -438,6 +444,8 @@ class Workload:
     # Concurrent-admission variant pin: only this ResourceFlavor may be
     # assigned (WorkloadAllowedResourceFlavorAnnotation).
     allowed_resource_flavor: Optional[str] = None
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
     uid: str = ""
     status: WorkloadStatus = field(default_factory=WorkloadStatus)
 

@@ -84,6 +84,9 @@ class Configuration:
     # durations in seconds; None = keep forever.
     retention_after_finished_seconds: Optional[float] = None
     retention_after_deactivated_seconds: Optional[float] = None
+    # metrics.customLabels (configuration_types.go:187): extra metric
+    # labels sourced from object metadata.
+    metrics_custom_labels: list = field(default_factory=list)
     # oracle: the batched TPU decision path configuration
     oracle_enabled: bool = True
     oracle_max_depth: int = 4
@@ -189,6 +192,14 @@ def from_dict(raw: dict) -> Configuration:
         preemption_strategies=tuple(fs.get(
             "preemptionStrategies",
             FairSharingConfig().preemption_strategies)))
+    from kueue_tpu.metrics.registry import CustomLabelEntry
+
+    cfg.metrics_custom_labels = [
+        CustomLabelEntry(
+            name=e.get("name", ""),
+            source_label_key=e.get("sourceLabelKey", ""),
+            source_annotation_key=e.get("sourceAnnotationKey", ""))
+        for e in (raw.get("metrics") or {}).get("customLabels", ())]
     ret = ((raw.get("objectRetentionPolicies") or {})
            .get("workloads") or {})
     cfg.retention_after_finished_seconds = _duration_seconds(

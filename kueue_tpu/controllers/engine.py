@@ -88,6 +88,12 @@ class Engine:
         self.registry = MetricsRegistry()
         from kueue_tpu.cache.unadmitted import UnadmittedWorkloads
         self.unadmitted = UnadmittedWorkloads(self.registry)
+        # Extra metric labels from CQ metadata (pkg/metrics/
+        # custom_labels.go), configured via metrics.customLabels.
+        from kueue_tpu.metrics.registry import CustomMetricLabels
+        self.custom_labels = CustomMetricLabels(
+            config.metrics_custom_labels
+            if config is not None else [])
         # First-eviction-per-workload tracking
         # (evicted_workloads_once_total, metrics.go:666).
         self._evicted_once: set[str] = set()
@@ -411,6 +417,10 @@ class Engine:
 
     def _lq_key(self, wl: Workload) -> tuple:
         return (f"{wl.namespace}/{wl.queue_name}",)
+
+    def _custom_cq_labels(self, cq_name: str) -> tuple:
+        return self.custom_labels.for_object(
+            self.cache.cluster_queues.get(cq_name))
 
     def finish(self, key: str) -> None:
         wl = self.workloads.get(key)
@@ -787,7 +797,8 @@ class Engine:
         wl.set_condition(WorkloadConditionType.ADMITTED, True,
                          reason="Admitted", now=self.clock)
         self.metrics.admissions_total += 1
-        self.registry.counter("admitted_workloads_total").inc((cq_name,))
+        self.registry.counter("admitted_workloads_total").inc(
+            (cq_name,) + self._custom_cq_labels(cq_name))
         self.registry.histogram("admission_wait_time_seconds").observe(
             max(0.0, self.clock - wl.creation_time), (cq_name,))
         self.registry.counter("local_queue_admitted_workloads_total").inc(
@@ -861,7 +872,7 @@ class Engine:
         wl.status.admission_check_updates = {}
         self.cache.delete_workload(wl.key)
         self.registry.counter("evicted_workloads_total").inc(
-            (cq_name, reason))
+            (cq_name, reason) + self._custom_cq_labels(cq_name))
         self.registry.counter("local_queue_evicted_workloads_total").inc(
             self._lq_key(wl) + (reason,))
         if wl.uid not in self._evicted_once:

@@ -88,6 +88,43 @@ class Histogram:
         return float("inf")
 
 
+@dataclass(frozen=True)
+class CustomLabelEntry:
+    """configuration_types.go (ControllerMetricsCustomLabel): one extra
+    Prometheus label sourced from object metadata."""
+
+    name: str
+    source_label_key: str = ""
+    source_annotation_key: str = ""
+
+
+class CustomMetricLabels:
+    """pkg/metrics/custom_labels.go: extract configured extra label
+    values from an object's labels/annotations; rendered as
+    ``custom_<name>="value"`` pairs appended to supported series."""
+
+    def __init__(self, entries: list[CustomLabelEntry]):
+        self.entries = list(entries)
+
+    def extract(self, labels: dict, annotations: dict) -> tuple:
+        """custom_labels.go:88 ExtractValues, as render-ready pairs."""
+        out = []
+        for e in self.entries:
+            if e.source_annotation_key:
+                val = (annotations or {}).get(e.source_annotation_key, "")
+            else:
+                val = (labels or {}).get(
+                    e.source_label_key or e.name, "")
+            out.append((f"custom_{e.name}", val))
+        return tuple(out)
+
+    def for_object(self, obj) -> tuple:
+        if not self.entries or obj is None:
+            return ()
+        return self.extract(getattr(obj, "labels", {}),
+                            getattr(obj, "annotations", {}))
+
+
 class MetricsRegistry:
     """The kueue metric families (metrics.go), standalone."""
 

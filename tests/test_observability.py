@@ -229,3 +229,33 @@ def test_resource_gauges_clear_when_sources_vanish():
         ("cq", "default", CPU)) == 0
     assert g("local_queue_resource_usage").get(
         ("default/lq", "default", CPU)) == 0
+
+
+def test_custom_metric_labels_from_cq_metadata():
+    """pkg/metrics/custom_labels.go: configured entries add
+    custom_<name> label pairs sourced from CQ labels/annotations."""
+    from kueue_tpu.config.api import from_dict
+
+    cfg = from_dict({"metrics": {"customLabels": [
+        {"name": "team"},
+        {"name": "tier", "sourceAnnotationKey": "example.com/tier"}]}})
+    eng = Engine(config=cfg)
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", labels={"team": "ml"},
+        annotations={"example.com/tier": "prod"},
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(1000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    wl = submit(eng, "w", 500)
+    eng.schedule_once()
+    assert wl.is_admitted
+    key = ("cq", ("custom_team", "ml"), ("custom_tier", "prod"))
+    assert eng.registry.counter("admitted_workloads_total").get(key) == 1
+    rendered = eng.registry.render()
+    assert 'custom_team="ml"' in rendered
+    eng.evict(wl, "Preempted")
+    assert eng.registry.counter("evicted_workloads_total").get(
+        ("cq", "Preempted", ("custom_team", "ml"),
+         ("custom_tier", "prod"))) == 1
