@@ -100,6 +100,16 @@ class PendingClusterQueue:
         self._hp.push(id_, sort_key[0], sort_key[1], sort_key[2],
                       sort_key[3])
 
+    def sort_key_of(self, key: str) -> Optional[tuple]:
+        """The stored heap sort key for a pending workload — the exact
+        ordering the next pop() honors (AFS usage is FROZEN at push
+        time, cluster_queue.go:208). The device bridge ranks with these
+        so device and host head order can never diverge."""
+        id_ = self._id_of.get(key)
+        if id_ is None:
+            return None
+        return self._entry_of[id_][1]
+
     def _heap_remove(self, key: str) -> None:
         id_ = self._id_of.pop(key, None)
         if id_ is not None:
@@ -255,6 +265,15 @@ class QueueManager:
         self.info_options = None
 
     def add_cluster_queue(self, cq: ClusterQueue) -> None:
+        existing = self.cluster_queues.get(cq.name)
+        if existing is not None:
+            # UpdateClusterQueue (manager.go:402): swap the spec in place
+            # — the pending heap and inadmissible map survive a spec
+            # update — then retry THIS queue's inadmissible workloads
+            # (manager.go:423 scopes the retry to the updated CQ).
+            existing.spec = cq
+            self.queue_inadmissible_workloads({cq.name})
+            return
         self.cluster_queues[cq.name] = PendingClusterQueue(cq, manager=self)
 
     def delete_cluster_queue(self, name: str) -> None:

@@ -38,6 +38,10 @@ class Cache:
         self.admission_check_names = None
         # Cached TAS forest prototypes (see tas_prototypes()).
         self._tas_protos = None
+        # Non-TAS pod usage (tas_non_tas_pod_cache.go): per-node totals
+        # subtracted from TAS leaf capacity at prototype build.
+        from kueue_tpu.tas.non_tas_usage import NonTASUsageCache
+        self.non_tas_usage = NonTASUsageCache()
 
     # -- object lifecycle --
 
@@ -109,7 +113,10 @@ class Cache:
                 for node in self.nodes.values():
                     if all(node.labels.get(k) == v
                            for k, v in rf.node_labels.items()):
-                        snap.add_node(node)
+                        snap.add_node(
+                            node,
+                            non_tas_usage=self.non_tas_usage.node_usage(
+                                node.name))
                 protos[rf.name] = snap
             self._tas_protos = protos
         return self._tas_protos

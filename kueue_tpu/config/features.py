@@ -27,6 +27,7 @@ _DEFAULTS: dict[str, bool] = {
     # subsystems
     "MultiKueue": True,
     "MultiKueueOrchestratedPreemption": False,
+    "MultiKueueManagerQuotaAutomation": False,
     "ElasticJobsViaWorkloadSlices": False,
     "ConcurrentAdmission": False,
     "WaitForPodsReady": False,

@@ -118,6 +118,16 @@ class Engine:
         self.workload_priority_classes: dict[str, int] = {}
         # Second-pass retry bookkeeping (second_pass_queue.go backoff).
         self._second_pass_attempts: dict[str, int] = {}
+        # In-flight preemption tracking (preemption/expectations,
+        # scheduler.go:151 WithPreemptionExpectations): never re-issue an
+        # eviction whose observation is still pending.
+        from kueue_tpu.utils.expectations import Store
+        self.preemption_expectations = Store("preemptions")
+        # Admission applies run through this wrapper (scheduler.go:870
+        # admissionRoutineWrapper; default = the synchronous test-mode
+        # wrapper since the in-memory engine has no apiserver latency).
+        from kueue_tpu.utils.routine import SyncWrapper
+        self.admission_routine = SyncWrapper()
         # Durable store (store/journal.py) — the "K8s API as durable
         # store" analog; attach via attach_journal().
         self.journal = None
@@ -235,6 +245,20 @@ class Engine:
         self.cache.add_or_update_node(node)
         self.queues.queue_inadmissible_workloads()
         self._journal_obj("node", node)
+
+    def observe_pod(self, pod) -> None:
+        """Non-TAS pod usage intake (tas/non_tas_usage_controller.go):
+        pods not managed by TAS consume node capacity that the TAS
+        placement must not double-book. Re-queues inadmissible TAS
+        workloads only when totals actually moved."""
+        from kueue_tpu.tas.non_tas_usage import NonTASUsageController
+        if NonTASUsageController(self.cache).pod_event(pod):
+            self.queues.queue_inadmissible_workloads()
+
+    def observe_pod_deleted(self, namespace: str, name: str) -> None:
+        from kueue_tpu.tas.non_tas_usage import NonTASUsageController
+        if NonTASUsageController(self.cache).pod_deleted(namespace, name):
+            self.queues.queue_inadmissible_workloads()
 
     def delete_node(self, name: str) -> None:
         self.cache.delete_node(name)
@@ -755,37 +779,57 @@ class Engine:
         wl.status.admission = admission
         wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
                          reason="QuotaReserved", now=self.clock)
-        if wl.has_condition(
-                WorkloadConditionType.BLOCKED_ON_PREEMPTION_GATES):
-            # Reservation clears the blocked signal (workload.go:860).
-            wl.set_condition(
-                WorkloadConditionType.BLOCKED_ON_PREEMPTION_GATES, False,
-                reason="QuotaReserved", now=self.clock)
+        # Reservation resets the active Evicted / Preempted / blocked-on-
+        # gates conditions (workload.go:852-862 resetActiveCondition) —
+        # without this a re-admitted former victim would still read as
+        # evicted and _issue_preemptions' "preemption ongoing" skip would
+        # never evict it again.
+        for ctype in (WorkloadConditionType.EVICTED,
+                      WorkloadConditionType.PREEMPTED,
+                      WorkloadConditionType.BLOCKED_ON_PREEMPTION_GATES):
+            if wl.has_condition(ctype):
+                wl.set_condition(ctype, False, reason="QuotaReserved",
+                                 now=self.clock)
         entry.info.apply_admission(admission)
         self.cache.add_or_update_workload(wl)
-        self._event("QuotaReserved", wl.key,
-                    cluster_queue=entry.info.cluster_queue)
-        cq_name = entry.info.cluster_queue
-        self.registry.counter("quota_reserved_workloads_total").inc(
-            (cq_name,))
-        self.registry.histogram("quota_reserved_wait_time_seconds").observe(
-            max(0.0, self.clock - wl.creation_time), (cq_name,))
-        self.registry.counter(
-            "local_queue_quota_reserved_workloads_total").inc(
-            self._lq_key(wl))
-        self.registry.histogram(
-            "local_queue_quota_reserved_wait_time_seconds").observe(
-            max(0.0, self.clock - wl.creation_time), self._lq_key(wl))
-        self._track_unadmitted(wl, cq_name, "UnsatisfiedChecks")
-        if self.admission_checks is not None:
-            self.admission_checks.sync_states(wl,
-                                              entry.info.cluster_queue)
-        self._sync_admitted(wl, entry.info.cluster_queue)
-        # Replace-old-slice after successful admission
-        # (scheduler.go:558 replaceOldWorkloadSlice).
-        for target in entry.preemption_targets:
-            if target.reason == "WorkloadSliceReplaced":
-                self.finish(target.workload.key)
+        # An assumed workload that was itself a pending preemption target
+        # satisfies its expectation (scheduler.go:882, kueue#11480).
+        self.preemption_expectations.observed_uid(wl.key, wl.uid)
+        # The status finalization below is the reference's PATCH to the
+        # apiserver (scheduler.go:870 admissionRoutineWrapper.Run). The
+        # wrapper here is the before/after instrumentation hook the
+        # reference's tests use (scheduler.go:220); it MUST execute the
+        # closure inline (SyncWrapper): the closure mutates engine state
+        # (conditions, unadmitted tracking, replaced-slice finish), and
+        # the engine is lock-free single-threaded by design. ThreadWrapper
+        # is for out-of-process appliers only (see utils/routine.py).
+        def _finalize() -> None:
+            self._event("QuotaReserved", wl.key,
+                        cluster_queue=entry.info.cluster_queue)
+            cq_name = entry.info.cluster_queue
+            self.registry.counter("quota_reserved_workloads_total").inc(
+                (cq_name,))
+            self.registry.histogram(
+                "quota_reserved_wait_time_seconds").observe(
+                max(0.0, self.clock - wl.creation_time), (cq_name,))
+            self.registry.counter(
+                "local_queue_quota_reserved_workloads_total").inc(
+                self._lq_key(wl))
+            self.registry.histogram(
+                "local_queue_quota_reserved_wait_time_seconds").observe(
+                max(0.0, self.clock - wl.creation_time), self._lq_key(wl))
+            self._track_unadmitted(wl, cq_name, "UnsatisfiedChecks")
+            if self.admission_checks is not None:
+                self.admission_checks.sync_states(wl,
+                                                  entry.info.cluster_queue)
+            self._sync_admitted(wl, entry.info.cluster_queue)
+            # Replace-old-slice after successful admission
+            # (scheduler.go:558 replaceOldWorkloadSlice).
+            for target in entry.preemption_targets:
+                if target.reason == "WorkloadSliceReplaced":
+                    self.finish(target.workload.key)
+
+        self.admission_routine.run(_finalize)
 
     def _sync_admitted(self, wl: Workload, cq_name: str) -> None:
         """workload.SyncAdmittedCondition."""
@@ -886,6 +930,10 @@ class Engine:
                 "workload_eviction_latency_seconds").observe(
                 max(0.0, self.clock - admitted_at), (cq_name, reason))
         self._event("Evicted", wl.key, cluster_queue=cq_name, detail=reason)
+        # The event handlers have now observed the eviction — release any
+        # in-flight preemption expectation (the workload_controller
+        # Update-event ObservedUID in the reference).
+        self.preemption_expectations.observed_uid(wl.key, wl.uid)
         if requeue and wl.active:
             wl.status.requeue_count += 1
             if backoff_seconds:
@@ -911,6 +959,20 @@ class Engine:
             twl = self.workloads.get(target.workload.key)
             if twl is None or twl.is_finished:
                 continue
+            if twl.has_condition(WorkloadConditionType.EVICTED):
+                # Preemption ongoing (preemption.go:209): the target is
+                # already evicted — observe and count it preempted.
+                self.preemption_expectations.observed_uid(twl.key, twl.uid)
+                continue
+            if not self.preemption_expectations.satisfied(twl.key):
+                # Already issued, waiting for observation
+                # (preemption.go:216). With the default synchronous
+                # engine the store drains inside evict() below, so this
+                # skip only engages when an async/remote applier (MK
+                # orchestrated preemption, remote oracle) issued the
+                # eviction and its observation is still in flight.
+                continue
+            self.preemption_expectations.expect_uids(twl.key, [twl.uid])
             twl.set_condition(WorkloadConditionType.PREEMPTED, True,
                               reason=target.reason, now=self.clock)
             self.evict(twl, "Preempted")

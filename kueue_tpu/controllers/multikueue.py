@@ -30,11 +30,21 @@ from kueue_tpu.api.types import Workload, WorkloadConditionType
 from kueue_tpu.controllers.admissionchecks import CheckState
 
 
+# multikueue_types.go:177 (MultiKueueConfigQuotaManagementMode).
+QUOTA_MANAGEMENT_MANUAL = "Manual"
+QUOTA_MANAGEMENT_AUTOMATED = "Automated"
+
+# The CQ condition type (multikueue/clusterqueue.go).
+QUOTA_AUTOMATION_CONDITION = "MultiKueueManagerQuotaAutomation"
+
+
 @dataclass
 class MultiKueueConfig:
-    """multikueue_types.go:124 (MultiKueueConfig): ordered cluster list."""
+    """multikueue_types.go:124 (MultiKueueConfig): ordered cluster list +
+    quotaManagement mode (:166)."""
 
     clusters: list[str] = field(default_factory=list)
+    quota_management: str = QUOTA_MANAGEMENT_MANUAL
 
 
 MULTIKUEUE_PREEMPTION_GATE = "kueue.x-k8s.io/multikueue-preemption"
@@ -83,6 +93,10 @@ class MultiKueueController:
         self.worker_jobs: dict[str, object] = {}
         self.adapters: dict[str, object] = {}
         self.origin = "multikueue"
+        # Manager-side quota automation (multikueue/clusterqueue.go
+        # cqReconciler): per-CQ MultiKueueManagerQuotaAutomation condition
+        # as (status, reason, message); absent = condition removed.
+        self.cq_conditions: dict[str, tuple[bool, str, str]] = {}
 
     def attach_job_framework(self, manager_reconciler,
                              worker_reconcilers: dict,
@@ -127,6 +141,7 @@ class MultiKueueController:
     # -- the reconcile pass (workload.go:185) --
 
     def reconcile(self) -> None:
+        self.reconcile_cluster_queues()
         acm = self.engine.admission_checks
         for wl in list(self.engine.workloads.values()):
             if wl.is_finished:
@@ -151,6 +166,114 @@ class MultiKueueController:
                     self._maybe_open_preemption_gate(state)
             else:
                 self._sync_back(wl, state)
+
+    # -- manager quota automation (multikueue/clusterqueue.go) --
+
+    def _cq_has_mk_check(self, cq) -> bool:
+        """getMultiKueueAdmissionCheck: the CQ references this controller's
+        check directly or through its admissionChecksStrategy."""
+        if self.check_name in (cq.admission_checks or ()):
+            return True
+        strategy = getattr(cq, "admission_checks_strategy", None) or {}
+        return self.check_name in strategy
+
+    def reconcile_cluster_queues(self) -> None:
+        """cqReconciler.Reconcile for every manager ClusterQueue: with
+        quotaManagement=Automated (and the MultiKueueManagerQuotaAutomation
+        gate), the single flavor's nominal quotas are overwritten with the
+        sum of the connected workers' quotas reachable through same-named
+        LocalQueues (aggregateWorkerQuotas)."""
+        from dataclasses import replace
+
+        from kueue_tpu.api.types import FlavorQuotas, ResourceQuota
+        from kueue_tpu.config import features
+
+        # Deleted CQs shed their condition (removeQuotaAutomationCondition
+        # fires on the delete event in the reference).
+        for stale in set(self.cq_conditions) \
+                - set(self.engine.cache.cluster_queues):
+            del self.cq_conditions[stale]
+        for name, cq in list(self.engine.cache.cluster_queues.items()):
+            if not self._cq_has_mk_check(cq):
+                self.cq_conditions.pop(name, None)
+                continue
+            if (self.config.quota_management != QUOTA_MANAGEMENT_AUTOMATED
+                    or not features.enabled(
+                        "MultiKueueManagerQuotaAutomation")):
+                self.cq_conditions[name] = (
+                    False, "NotRequested",
+                    "MultiKueue manager quota automation has not been "
+                    "requested.")
+                continue
+            if len(cq.resource_groups) != 1 \
+                    or len(cq.resource_groups[0].flavors) != 1:
+                self.cq_conditions[name] = (
+                    False, "UnsupportedConfiguration",
+                    "Quota automation requires that the manager-side "
+                    "ClusterQueue has exactly one ResourceFlavor")
+                continue
+            rg = cq.resource_groups[0]
+            aggregated = self._aggregate_worker_quotas(name)
+            missing = set(aggregated) - set(rg.covered_resources)
+            if missing:
+                self.cq_conditions[name] = (
+                    False, "UnsupportedConfiguration",
+                    "manager-side coveredResources is missing resources "
+                    f"configured on workers: {sorted(missing)}")
+                continue
+            flavor = rg.flavors[0]
+            # Only the nominal quota is automated; operator-set
+            # borrowing/lending limits survive. (Deliberate deviation:
+            # clusterqueue.go:136-142 rebuilds ResourceQuota{nominal}
+            # outright, which would silently reset borrowingLimit=None =
+            # unlimited — dangerous in a cohort.)
+            new_resources = {
+                res: (replace(flavor.resources[res],
+                              nominal=aggregated.get(res, 0))
+                      if res in flavor.resources
+                      else ResourceQuota(nominal=aggregated.get(res, 0)))
+                for res in rg.covered_resources}
+            if {r: q.nominal for r, q in flavor.resources.items()} != \
+                    {r: q.nominal for r, q in new_resources.items()}:
+                new_cq = replace(cq, resource_groups=(replace(
+                    rg, flavors=(FlavorQuotas(
+                        flavor.name, new_resources),)),))
+                # Propagates to cache + queues; the queue manager's
+                # update path keeps the pending heap and retries
+                # inadmissible workloads (manager.go:402
+                # UpdateClusterQueue), so a quota increase unparks
+                # waiting workloads.
+                self.engine.create_cluster_queue(new_cq)
+            self.cq_conditions[name] = (
+                True, "QuotaAutomated",
+                "ClusterQueue quota is automatically managed based on "
+                "MultiKueue workers.")
+
+    def _aggregate_worker_quotas(self, cq_name: str) -> dict[str, int]:
+        """aggregateWorkerQuotas (clusterqueue.go:176): manager LocalQueues
+        feeding this CQ name remote CQs through same-namespace/name worker
+        LocalQueues; sum those CQs' nominal quotas per resource."""
+        lq_keys = {lq.key for lq in
+                   self.engine.queues.local_queues.values()
+                   if lq.cluster_queue == cq_name}
+        total: dict[str, int] = {}
+        for cluster in self.config.clusters:
+            worker = self.clusters.get(cluster)
+            if worker is None:
+                continue  # not connected: skipped in aggregation
+            remote_cq_names = {
+                rlq.cluster_queue
+                for rlq in worker.queues.local_queues.values()
+                if rlq.key in lq_keys}
+            for rcq_name in remote_cq_names:
+                rcq = worker.cache.cluster_queues.get(rcq_name)
+                if rcq is None:
+                    continue
+                for rg in rcq.resource_groups:
+                    for fq in rg.flavors:
+                        for res, quota in fq.resources.items():
+                            total[res] = total.get(res, 0) + quota.nominal
+        return total
 
     # -- internals --
 

@@ -119,8 +119,13 @@ class CustomMetricLabels:
         return tuple(out)
 
     def for_object(self, obj) -> tuple:
-        if not self.entries or obj is None:
+        if not self.entries:
             return ()
+        if obj is None:
+            # A deleted/unknown object still gets the configured pairs
+            # (empty-valued): every series in a family must carry the
+            # same label set or the exposition is invalid.
+            return self.extract({}, {})
         return self.extract(getattr(obj, "labels", {}),
                             getattr(obj, "annotations", {}))
 

@@ -30,8 +30,12 @@ share quota, device-then-host commit order is cycle-equivalent to the
 reference's single interleaved cycle (scheduler.go:286).
 
 Remaining whole-cycle fallbacks (conservative, correctness-first):
-  * admission fair sharing (AFS heap ordering is host-side);
   * WaitForPodsReady admission blocking.
+
+Admission fair sharing runs on device: AFS-scoped CQs' head ordering
+(LocalQueue decayed usage first) is folded into the rank vector
+(_head_ranks), and entry penalties flow through the shared engine
+on_admit hook when device verdicts are applied.
 
 Fair sharing runs on device for arbitrary cohort forests: the
 hierarchical LCA tournament is ops/commit.commit_grouped_fair.
@@ -89,8 +93,6 @@ class OracleBridge:
 
     def world_is_fast_path_safe(self) -> bool:
         eng = self.engine
-        if getattr(eng, "afs", None) is not None:
-            return False
         if (eng.pods_ready is not None
                 and eng.pods_ready.admission_blocked()):
             # BlockAdmission (scheduler.go:535): the host path owns the
@@ -444,6 +446,35 @@ class OracleBridge:
             victim_vals[ci, j] = adm.usage[v]
             victim_ids[ci, j] = v
 
+    def _head_ranks(self, solver, pending_infos) -> np.ndarray:
+        """Within-CQ head ordering. Classical: priority desc, timestamp
+        asc (cluster_queue.go heap less). With admission fair sharing
+        active, rank by each workload's STORED heap key
+        (PendingClusterQueue.sort_key_of): AFS usage is frozen into the
+        key at push time (cluster_queue.go:208), so recomputing usage
+        live here would diverge from what the host heap pops — ranking
+        with the stored keys makes device and sequential head order
+        identical by construction. Ranks are only ever compared within
+        one CQ, so one global ordering over all keys is safe."""
+        afs = getattr(self.engine, "afs", None)
+        if afs is None:
+            return solver.head_ranks()
+        W = solver.wls.num_workloads
+        keys = []
+        for i, info in enumerate(pending_infos):
+            pcq = self.engine.queues.cluster_queues.get(
+                info.cluster_queue)
+            sk = pcq.sort_key_of(info.key) if pcq is not None else None
+            if sk is None:
+                sk = (0.0, -info.obj.effective_priority,
+                      info.obj.creation_time, _HOST_BIG)
+            keys.append((sk, i))
+        keys.sort()
+        rank = np.empty(W, np.int64)
+        for pos, (_, i) in enumerate(keys):
+            rank[i] = pos
+        return rank
+
     @staticmethod
     def _head_pri(wls, head_idx):
         h = np.maximum(head_idx, 0)
@@ -512,7 +543,7 @@ class OracleBridge:
               or i.obj.status.requeue_at <= now) for i in pending_infos),
             bool, count=W)
         active = ready & (wl.cq >= 0)
-        rank = solver.head_ranks()
+        rank = self._head_ranks(solver, pending_infos)
         cq_safe_idx = np.maximum(wl.cq, 0)
         eff = np.where(active, rank, _HOST_BIG)
         head_rank = np.full(C, _HOST_BIG, np.int64)

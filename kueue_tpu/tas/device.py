@@ -100,6 +100,15 @@ def try_find(snap, workers, leader=None, simulate_empty=False,
              assumed_usage=None, required_replacement_domain=()):
     """Device counterpart of find_topology_assignments. Returns
     NotImplemented when the world needs the sequential path."""
+    import jax
+
+    # ops/tas packs multi-field sort keys into int64 lanes; without x64
+    # they would silently truncate to int32 and mis-sort. Flip the
+    # process-global flag, same deliberate choice as
+    # engine.attach_oracle: the scheduler owns its process; embedders
+    # sharing it with float32 JAX code must enable x64 at startup.
+    if not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
     if not snap.level_keys:
         return NotImplemented
     if getattr(workers, "previous_assignment", None) is not None:

@@ -22,3 +22,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: the suite jits hundreds of device programs
+# whose shapes repeat across runs; caching them makes re-runs much faster.
+_cache_dir = os.environ.get(
+    "KUEUE_TPU_JAX_CACHE", os.path.expanduser("~/.cache/kueue_tpu_jax"))
+os.makedirs(_cache_dir, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -1,0 +1,196 @@
+"""In-flight preemption expectations (pkg/util/expectations +
+preemption.go:209-240) and the admission routine wrapper
+(pkg/util/routine, scheduler.go:870)."""
+
+import threading
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    ClusterQueuePreemption,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+    WorkloadConditionType,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.utils.expectations import Store
+from kueue_tpu.utils.routine import SyncWrapper, ThreadWrapper
+
+CPU = "cpu"
+
+
+class TestStore:
+    def test_expect_then_observe(self):
+        s = Store("t")
+        s.expect_uids("k", ["u1", "u2"])
+        assert not s.satisfied("k")
+        s.observed_uid("k", "u1")
+        assert not s.satisfied("k")
+        s.observed_uid("k", "u2")
+        assert s.satisfied("k")
+        assert len(s) == 0
+
+    def test_union_of_expectations(self):
+        s = Store("t")
+        s.expect_uids("k", ["u1"])
+        s.expect_uids("k", ["u2"])
+        s.observed_uid("k", "u2")
+        assert not s.satisfied("k")
+
+    def test_observe_unknown_key_noop(self):
+        s = Store("t")
+        s.observed_uid("k", "u1")
+        assert s.satisfied("k")
+
+
+class TestWrappers:
+    def test_sync_runs_inline_with_hooks(self):
+        order = []
+        w = SyncWrapper(before=lambda: order.append("before"),
+                        after=lambda: order.append("after"))
+        w.run(lambda: order.append("body"))
+        assert order == ["before", "body", "after"]
+
+    def test_thread_wrapper_runs_async(self):
+        done = threading.Event()
+        w = ThreadWrapper()
+        w.run(done.set)
+        assert done.wait(5.0)
+        w.join(5.0)
+
+    def test_thread_wrapper_before_inline(self):
+        """before() runs on the caller (routine/wrapper.go Run)."""
+        order = []
+        w = ThreadWrapper(before=lambda: order.append("before"))
+        w.run(lambda: None)
+        assert order == ["before"]
+        w.join(5.0)
+
+
+def make_engine():
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq",
+        preemption=ClusterQueuePreemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY),
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(4000)}),)),),
+    ))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng
+
+
+def wl(name, cpu, priority=0):
+    return Workload(name=name, queue_name="lq", priority=priority,
+                    pod_sets=(PodSet("main", 1, {CPU: cpu}),))
+
+
+class TestEngineExpectations:
+    def test_preemption_expectation_cycle(self):
+        """A preempted victim's expectation is observed by the eviction
+        event, so the store drains within the cycle (sync engine)."""
+        eng = make_engine()
+        eng.submit(wl("low", 4000, priority=0))
+        eng.schedule_once()
+        assert eng.workloads["default/low"].status.admission is not None
+        eng.submit(wl("high", 4000, priority=10))
+        eng.schedule_once()
+        low = eng.workloads["default/low"]
+        assert low.has_condition(WorkloadConditionType.PREEMPTED)
+        assert low.status.admission is None
+        # Synchronous apply = expectation already satisfied.
+        assert eng.preemption_expectations.satisfied("default/low")
+        assert len(eng.preemption_expectations) == 0
+
+    def test_unsatisfied_expectation_blocks_reissue(self):
+        """While an eviction is in flight (expectation pending), a new
+        cycle must not re-issue the preemption (preemption.go:216)."""
+        eng = make_engine()
+        eng.submit(wl("low", 4000, priority=0))
+        eng.schedule_once()
+        low = eng.workloads["default/low"]
+        # Simulate an in-flight eviction issued elsewhere.
+        eng.preemption_expectations.expect_uids(low.key, ["other-uid"])
+        evictions_before = low.status.eviction_counts.get("Preempted", 0)
+        eng.submit(wl("high", 4000, priority=10))
+        eng.schedule_once()
+        after = eng.workloads["default/low"].status.eviction_counts.get(
+            "Preempted", 0)
+        assert after == evictions_before  # not re-issued
+
+    def test_admission_satisfies_own_expectation(self):
+        """kueue#11480: admitting a workload clears a stale expectation
+        keyed on it."""
+        eng = make_engine()
+        w = wl("a", 1000)
+        eng.submit(w)
+        eng.preemption_expectations.expect_uids(
+            "default/a", [eng.workloads["default/a"].uid])
+        eng.schedule_once()
+        assert eng.workloads["default/a"].status.admission is not None
+        assert eng.preemption_expectations.satisfied("default/a")
+
+
+class TestEngineRoutineWrapper:
+    def test_admission_hooks_fire_around_finalization(self):
+        """The engine's admission wrapper is the before/after
+        instrumentation point (scheduler.go:220); the closure executes
+        inline because it mutates engine state."""
+        events = []
+        eng = make_engine()
+        eng.admission_routine = SyncWrapper(
+            before=lambda: events.append("before"),
+            after=lambda: events.append("after"))
+        eng.submit(wl("a", 1000))
+        eng.schedule_once()
+        a = eng.workloads["default/a"]
+        assert a.status.admission is not None
+        assert a.has_condition(WorkloadConditionType.ADMITTED)
+        assert events == ["before", "after"]
+
+    def test_thread_wrapper_prunes_finished_threads(self):
+        w = ThreadWrapper()
+        for _ in range(50):
+            w.run(lambda: None)
+        w.join(5.0)
+        w.run(lambda: None)
+        assert len(w._threads) <= 2
+
+
+class TestReAdmittedVictim:
+    def test_former_victim_can_be_preempted_again(self):
+        """Quota reservation resets Evicted/Preempted (workload.go:852):
+        a re-admitted former victim must be evictable by a later
+        preemptor — without the reset, the 'preemption ongoing' skip in
+        _issue_preemptions would livelock."""
+        eng = make_engine()
+        low = wl("low", 4000, priority=0)
+        eng.submit(low)
+        eng.schedule_once()
+        eng.clock += 1
+        hi1 = wl("hi1", 4000, priority=10)
+        eng.submit(hi1)
+        eng.schedule_once()  # preempts low
+        assert low.is_evicted and not low.is_admitted
+        eng.finish(hi1.key)
+        eng.clock += 1
+        eng.queues.queue_inadmissible_workloads()
+        eng.schedule_once()  # low re-admits
+        assert low.is_admitted
+        assert not low.has_condition(WorkloadConditionType.EVICTED)
+        assert not low.has_condition(WorkloadConditionType.PREEMPTED)
+        eng.clock += 1
+        hi2 = wl("hi2", 4000, priority=10)
+        eng.submit(hi2)
+        eng.schedule_once()
+        assert low.status.admission is None  # evicted again, no livelock
+        eng.clock += 1
+        eng.schedule_once()
+        assert hi2.is_admitted

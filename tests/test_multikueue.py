@@ -355,3 +355,143 @@ def test_adapter_mirrors_mpi_job():
     w1_rec.reconcile_all()
     mk.reconcile()
     assert manager.workloads[wl_key].is_finished
+
+
+# -- manager quota automation (multikueue/clusterqueue.go cqReconciler) --
+
+
+def quota_stack(mode="Automated", gate=True):
+    from kueue_tpu.config import features
+    features.set_feature("MultiKueueManagerQuotaAutomation", gate)
+    manager = make_cluster(nominal=1, checks=("multikueue",))
+    w1 = make_cluster(nominal=3000)
+    w2 = make_cluster(nominal=5000)
+    mk = MultiKueueController(
+        manager, "multikueue",
+        MultiKueueConfig(clusters=["worker1", "worker2"],
+                         quota_management=mode))
+    mk.connect_cluster("worker1", w1)
+    mk.connect_cluster("worker2", w2)
+    return manager, w1, w2, mk
+
+
+def _cq_nominal(eng):
+    cq = eng.cache.cluster_queues["cq"]
+    return cq.resource_groups[0].flavors[0].resources[CPU].nominal
+
+
+def test_quota_automation_aggregates_worker_quotas():
+    from kueue_tpu.config import features
+    manager, w1, w2, mk = quota_stack()
+    try:
+        mk.reconcile_cluster_queues()
+        assert _cq_nominal(manager) == 8000
+        ok, reason, _ = mk.cq_conditions["cq"]
+        assert ok and reason == "QuotaAutomated"
+    finally:
+        features.set_feature("MultiKueueManagerQuotaAutomation", False)
+
+
+def test_quota_automation_not_requested_when_manual():
+    from kueue_tpu.config import features
+    manager, _, _, mk = quota_stack(mode="Manual")
+    try:
+        mk.reconcile_cluster_queues()
+        assert _cq_nominal(manager) == 1  # untouched
+        ok, reason, _ = mk.cq_conditions["cq"]
+        assert not ok and reason == "NotRequested"
+    finally:
+        features.set_feature("MultiKueueManagerQuotaAutomation", False)
+
+
+def test_quota_automation_requires_single_flavor():
+    from kueue_tpu.config import features
+    manager, _, _, mk = quota_stack()
+    try:
+        manager.create_resource_flavor(ResourceFlavor("other"))
+        manager.create_cluster_queue(ClusterQueue(
+            name="cq", admission_checks=("multikueue",),
+            resource_groups=(ResourceGroup(
+                (CPU,),
+                (FlavorQuotas("default", {CPU: ResourceQuota(1)}),
+                 FlavorQuotas("other", {CPU: ResourceQuota(1)}))),),
+        ))
+        mk.reconcile_cluster_queues()
+        ok, reason, _ = mk.cq_conditions["cq"]
+        assert not ok and reason == "UnsupportedConfiguration"
+    finally:
+        features.set_feature("MultiKueueManagerQuotaAutomation", False)
+
+
+def test_quota_automation_missing_covered_resource():
+    from kueue_tpu.config import features
+    manager, w1, _, mk = quota_stack()
+    try:
+        # Worker 1's CQ also covers memory, which the manager CQ does not.
+        w1.create_cluster_queue(ClusterQueue(
+            name="cq",
+            resource_groups=(ResourceGroup(
+                (CPU, "memory"),
+                (FlavorQuotas("default", {
+                    CPU: ResourceQuota(3000),
+                    "memory": ResourceQuota(1 << 30)}),)),),
+        ))
+        mk.reconcile_cluster_queues()
+        ok, reason, msg = mk.cq_conditions["cq"]
+        assert not ok and reason == "UnsupportedConfiguration"
+        assert "memory" in msg
+    finally:
+        features.set_feature("MultiKueueManagerQuotaAutomation", False)
+
+
+def test_quota_automation_skips_disconnected_workers():
+    from kueue_tpu.config import features
+    manager, w1, w2, mk = quota_stack()
+    try:
+        mk.disconnect_cluster("worker2")
+        mk.reconcile_cluster_queues()
+        assert _cq_nominal(manager) == 3000
+    finally:
+        features.set_feature("MultiKueueManagerQuotaAutomation", False)
+
+
+def test_quota_automation_condition_removed_without_check():
+    from kueue_tpu.config import features
+    manager, _, _, mk = quota_stack()
+    try:
+        mk.reconcile_cluster_queues()
+        assert "cq" in mk.cq_conditions
+        manager.create_cluster_queue(ClusterQueue(
+            name="cq", admission_checks=(),
+            resource_groups=(ResourceGroup(
+                (CPU,),
+                (FlavorQuotas("default", {CPU: ResourceQuota(1)}),)),),
+        ))
+        mk.reconcile_cluster_queues()
+        assert "cq" not in mk.cq_conditions
+    finally:
+        features.set_feature("MultiKueueManagerQuotaAutomation", False)
+
+
+def test_quota_automation_preserves_pending_workloads():
+    """A CQ spec update from quota automation must keep the pending heap
+    (manager.go:402 UpdateClusterQueue) and unpark inadmissible
+    workloads once quota allows them."""
+    from kueue_tpu.config import features
+    manager, w1, w2, mk = quota_stack()
+    try:
+        # Needs 6000 > the manager's placeholder quota of 1: parks.
+        big = Workload(name="big", queue_name="lq",
+                       pod_sets=(PodSet("main", 1, {CPU: 6000}),))
+        manager.submit(big)
+        manager.schedule_once()
+        assert big.status.admission is None
+        mk.reconcile_cluster_queues()  # quota becomes 8000
+        assert _cq_nominal(manager) == 8000
+        pcq = manager.queues.cluster_queues["cq"]
+        assert "default/big" in pcq.items or \
+            "default/big" in pcq.inadmissible
+        manager.schedule_once()
+        assert big.status.admission is not None
+    finally:
+        features.set_feature("MultiKueueManagerQuotaAutomation", False)

@@ -1,0 +1,172 @@
+"""Non-TAS pod usage accounting (tas_non_tas_pod_cache.go +
+non_tas_usage_controller.go): cache bookkeeping, event filtering, and the
+end-to-end effect — non-TAS pods shrink TAS leaf capacity so placement
+avoids (or fails on) busy nodes."""
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PodSetTopologyRequest,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Topology,
+    TopologyLevel,
+    TopologyMode,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.tas.non_tas_usage import (
+    NonTASUsageCache,
+    PodUsage,
+    belongs_to_cache,
+)
+from kueue_tpu.tas.snapshot import HOSTNAME_LABEL, Node
+from kueue_tpu.tas.ungater import TOPOLOGY_GATE
+
+CPU = "cpu"
+
+
+def pod(name, node="n0", cpu=1000, **kw):
+    return PodUsage(namespace="default", name=name, node_name=node,
+                    requests={CPU: cpu}, **kw)
+
+
+class TestCache:
+    def test_add_and_aggregate(self):
+        c = NonTASUsageCache()
+        c.update(pod("a", cpu=500))
+        c.update(pod("b", cpu=700))
+        assert c.node_usage("n0") == {CPU: 1200, "pods": 2}
+
+    def test_update_replaces_entry(self):
+        """Node migration / resource resize: the old entry is removed."""
+        c = NonTASUsageCache()
+        c.update(pod("a", node="n0", cpu=500))
+        c.update(pod("a", node="n1", cpu=800))
+        assert c.node_usage("n0") == {}
+        assert c.node_usage("n1") == {CPU: 800, "pods": 1}
+
+    def test_terminated_pod_removed(self):
+        c = NonTASUsageCache()
+        c.update(pod("a"))
+        c.update(pod("a", terminated=True))
+        assert c.node_usage("n0") == {}
+        assert len(c) == 0
+
+    def test_delete_idempotent(self):
+        c = NonTASUsageCache()
+        c.update(pod("a"))
+        c.delete("default/a")
+        c.delete("default/a")
+        assert c.node_usage("n0") == {}
+
+    def test_empty_node_entry_cleaned(self):
+        c = NonTASUsageCache()
+        c.update(pod("a"))
+        c.delete("default/a")
+        assert "n0" not in c.nodes()
+
+
+class TestFiltering:
+    def test_tas_pod_excluded(self):
+        assert not belongs_to_cache(
+            pod("a", scheduling_gates=(TOPOLOGY_GATE,)))
+        assert not belongs_to_cache(
+            pod("a", labels={"kueue.x-k8s.io/tas": "true"}))
+
+    def test_unscheduled_excluded(self):
+        assert not belongs_to_cache(pod("a", node=""))
+
+    def test_terminated_excluded(self):
+        assert not belongs_to_cache(pod("a", terminated=True))
+
+    def test_plain_running_pod_included(self):
+        assert belongs_to_cache(pod("a"))
+
+
+def make_engine():
+    eng = Engine()
+    eng.create_topology(Topology("topo", (
+        TopologyLevel("rack"), TopologyLevel(HOSTNAME_LABEL))))
+    eng.create_resource_flavor(ResourceFlavor(
+        "tas-flavor", node_labels={"pool": "tas"}, topology_name="topo"))
+    for h in range(2):
+        name = f"h{h}"
+        eng.create_node(Node(
+            name=name,
+            labels={"pool": "tas", "rack": "r0", HOSTNAME_LABEL: name},
+            capacity={CPU: 4000, "pods": 10}))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("tas-flavor", {CPU: ResourceQuota(8000)}),)),),
+    ))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng
+
+
+def tas_wl(name, count, cpu):
+    return Workload(
+        name=name, queue_name="lq",
+        pod_sets=(PodSet(
+            "main", count, {CPU: cpu},
+            topology_request=PodSetTopologyRequest(
+                mode=TopologyMode.REQUIRED, level=HOSTNAME_LABEL)),))
+
+
+class TestEndToEnd:
+    def test_non_tas_pod_shrinks_placement_capacity(self):
+        """4 pods x 2000m need an empty 4000m host; a 1000m non-TAS pod
+        on each host makes the single-host requirement unsatisfiable."""
+        eng = make_engine()
+        eng.observe_pod(pod("sys-a", node="h0", cpu=1000))
+        eng.observe_pod(pod("sys-b", node="h1", cpu=1000))
+        eng.submit(tas_wl("wl", count=2, cpu=2000))
+        eng.schedule_once()
+        wl = eng.workloads["default/wl"]
+        assert wl.status.admission is None
+
+    def test_pod_deletion_frees_capacity(self):
+        eng = make_engine()
+        eng.observe_pod(pod("sys-a", node="h0", cpu=1000))
+        eng.observe_pod(pod("sys-b", node="h1", cpu=1000))
+        eng.submit(tas_wl("wl", count=2, cpu=2000))
+        eng.schedule_once()
+        assert eng.workloads["default/wl"].status.admission is None
+        eng.observe_pod_deleted("default", "sys-a")
+        eng.schedule_once()
+        wl = eng.workloads["default/wl"]
+        assert wl.status.admission is not None
+        ta = wl.status.admission.pod_set_assignments[0].topology_assignment
+        # Both pods land on the freed host h0.
+        assert [d.values[-1] for d in ta.domains] == ["h0"]
+
+    def test_tas_pod_does_not_double_count(self):
+        """A TAS-managed pod must not eat capacity twice (workload usage
+        already accounts it)."""
+        eng = make_engine()
+        eng.observe_pod(pod("tas-pod", node="h0", cpu=4000,
+                            scheduling_gates=(TOPOLOGY_GATE,)))
+        eng.submit(tas_wl("wl", count=2, cpu=2000))
+        eng.schedule_once()
+        assert eng.workloads["default/wl"].status.admission is not None
+
+
+class TestIdempotentResync:
+    def test_unchanged_pod_resync_keeps_version(self):
+        c = NonTASUsageCache()
+        c.update(pod("a", cpu=500))
+        v = c.version
+        c.update(pod("a", cpu=500))  # periodic resync, nothing moved
+        assert c.version == v
+
+    def test_resync_does_not_invalidate_prototypes(self):
+        eng = make_engine()
+        eng.observe_pod(pod("sys-a", node="h0", cpu=1000))
+        protos = eng.cache.tas_prototypes()
+        eng.observe_pod(pod("sys-a", node="h0", cpu=1000))
+        assert eng.cache.tas_prototypes() is protos
