@@ -1,0 +1,50 @@
+# Build/test entry points (the reference drives everything through
+# Makefile targets — Makefile-test.mk:108-143; this is the standalone
+# equivalent).
+
+PY ?= python
+PYTEST_FLAGS ?= -q
+
+.PHONY: all native test test-fast test-device bench multichip-dryrun clean
+
+all: native
+
+# Native runtime pieces (indexed pending-queue heap; ctypes-loaded).
+native:
+	$(MAKE) -C native
+
+test: native
+	$(PY) -m pytest tests/ $(PYTEST_FLAGS)
+
+# Skip the slow device-parity suites (CI smoke tier).
+test-fast: native
+	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
+	  --ignore=tests/test_multichip_parity.py \
+	  --ignore=tests/test_drain_parity.py \
+	  --ignore=tests/test_preempt_churn.py
+
+# Only the device kernels / parity suites (run after kernel changes).
+# Together with test-fast this covers the whole tests/ tree: everything
+# test-fast --ignores is enumerated here.
+test-device: native
+	$(PY) -m pytest tests/test_quota_parity.py tests/test_assign_parity.py \
+	  tests/test_commit_grouped.py tests/test_preempt_device.py \
+	  tests/test_classical_preempt_device.py tests/test_fair_device.py \
+	  tests/test_tas_device.py tests/test_drain_parity.py \
+	  tests/test_preempt_churn.py \
+	  tests/test_multichip_parity.py $(PYTEST_FLAGS)
+
+# The perf suite (BASELINE.json configs 2-5); FAST=1 for a smoke run.
+bench:
+	$(PY) bench.py
+
+bench-fast:
+	KUEUE_TPU_BENCH_FAST=1 $(PY) bench.py
+
+# Validate the multi-chip sharding compiles + executes on a virtual mesh.
+multichip-dryrun:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean:
+	$(MAKE) -C native clean

@@ -45,5 +45,23 @@ class RemoteClient:
         return self._get_json(
             f"/clusterqueues/{cluster_queue}/pendingworkloads")
 
+    def pending_workloads_many(self, cluster_queues: list[str]
+                               ) -> dict[str, dict]:
+        """Fan the per-CQ pending queries out over bounded workers
+        (pkg/util/parallelize Until — the reference uses the same
+        pattern for its API-call fan-outs). Raises the first error."""
+        from kueue_tpu.utils.parallelize import until
+
+        out: dict[str, dict] = {}
+
+        def piece(i: int) -> None:
+            cq = cluster_queues[i]
+            out[cq] = self.pending_workloads(cq)
+
+        err = until(len(cluster_queues), piece)
+        if err is not None:
+            raise err
+        return out
+
     def debug_dump(self) -> dict:
         return self._get_json("/debug/dump")

@@ -90,6 +90,9 @@ class Configuration:
     # oracle: the batched TPU decision path configuration
     oracle_enabled: bool = True
     oracle_max_depth: int = 4
+    # pprofBindAddress analog (configuration_types.go:140): a directory
+    # to drop JAX profiler traces into (xprof-viewable); None = off.
+    profile_dir: Optional[str] = None
 
     def info_options(self):
         """Build workload_info.InfoOptions from the resources section."""
@@ -221,4 +224,5 @@ def from_dict(raw: dict) -> Configuration:
     cfg.feature_gates = dict(raw.get("featureGates", {}))
     cfg.oracle_enabled = raw.get("oracle", {}).get("enable", True)
     cfg.oracle_max_depth = raw.get("oracle", {}).get("maxDepth", 4)
+    cfg.profile_dir = raw.get("profileDir")
     return cfg

@@ -19,6 +19,7 @@ Lifecycle semantics mirrored from the reference:
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -74,6 +75,7 @@ class Engine:
         (cmd/kueue main.go setup)."""
         if config is not None and config.fair_sharing.enable:
             enable_fair_sharing = True
+        self.config = config
         self.queues = QueueManager()
         self.cache = Cache()
         self.cycle = cycle or SchedulerCycle(
@@ -516,6 +518,28 @@ class Engine:
             executor = RemoteExecutor(*remote_address)
         self.oracle = OracleBridge(self, max_depth=max_depth,
                                    executor=executor)
+
+    @contextmanager
+    def profiled(self, trace_dir: Optional[str] = None):
+        """Context manager: capture a JAX profiler trace (xprof-viewable)
+        of everything inside — the reference's pprof server role
+        (configuration_types.go:140 PprofBindAddress; SURVEY §5 names
+        the JAX profiler as its analog). Directory precedence: explicit
+        arg > Configuration.profile_dir > KUEUE_TPU_PROFILE env."""
+        import os as _os
+
+        trace_dir = (trace_dir
+                     or (self.config.profile_dir if self.config else None)
+                     or _os.environ.get("KUEUE_TPU_PROFILE"))
+        if not trace_dir:
+            yield
+            return
+        import jax
+        jax.profiler.start_trace(trace_dir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
 
     def schedule_once(self) -> Optional[CycleResult]:
         """One schedule() cycle (scheduler.go:286)."""

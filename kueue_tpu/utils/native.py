@@ -27,7 +27,11 @@ from typing import Optional
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libkueue_native.so")
+# Installed deployments (pip wheel/container) ship the .so outside the
+# source tree and point at it with KUEUE_TPU_NATIVE_LIB.
+_SO_PATH = os.environ.get(
+    "KUEUE_TPU_NATIVE_LIB",
+    os.path.join(_NATIVE_DIR, "build", "libkueue_native.so"))
 
 _lib = None
 _lib_failed = False

@@ -259,3 +259,45 @@ def test_custom_metric_labels_from_cq_metadata():
     assert eng.registry.counter("evicted_workloads_total").get(
         ("cq", "Preempted", ("custom_team", "ml"),
          ("custom_tier", "prod"))) == 1
+
+
+def test_profiled_context_writes_trace(tmp_path):
+    """Engine.profiled captures a JAX profiler trace (the pprof-server
+    analog, configuration_types.go:140)."""
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Workload,
+    )
+    from kueue_tpu.controllers.engine import Engine
+
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("d"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            ("cpu",),
+            (FlavorQuotas("d", {"cpu": ResourceQuota(1000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    eng.submit(Workload(name="w", queue_name="lq",
+                        pod_sets=(PodSet("m", 1, {"cpu": 100}),)))
+    trace_dir = str(tmp_path / "traces")
+    with eng.profiled(trace_dir):
+        eng.schedule_once()
+    assert eng.workloads["default/w"].is_admitted
+    import os
+    found = [f for _, _, fs in os.walk(trace_dir) for f in fs]
+    assert found, "profiler wrote no trace files"
+
+
+def test_profiled_noop_without_dir(monkeypatch):
+    from kueue_tpu.controllers.engine import Engine
+
+    monkeypatch.delenv("KUEUE_TPU_PROFILE", raising=False)
+    eng = Engine()
+    with eng.profiled():
+        pass

@@ -1,0 +1,111 @@
+"""Bounded parallel fan-out (pkg/util/parallelize): worker cap, first-
+error capture, cancellation, and the remote-client fan-out consumer."""
+
+import threading
+import time
+
+from kueue_tpu.utils.parallelize import ErrorChannel, until
+
+
+class TestErrorChannel:
+    def test_keeps_first_error(self):
+        ch = ErrorChannel()
+        e1, e2 = ValueError("a"), ValueError("b")
+        ch.send_error(e1)
+        ch.send_error(e2)
+        assert ch.receive() is e1
+        assert ch.receive() is None  # drained
+
+    def test_none_is_ignored(self):
+        ch = ErrorChannel()
+        ch.send_error(None)
+        assert ch.receive() is None
+
+
+class TestUntil:
+    def test_runs_all_pieces(self):
+        seen = set()
+        lock = threading.Lock()
+
+        def piece(i):
+            with lock:
+                seen.add(i)
+        assert until(20, piece) is None
+        assert seen == set(range(20))
+
+    def test_worker_cap(self):
+        active = [0]
+        peak = [0]
+        lock = threading.Lock()
+
+        def piece(i):
+            with lock:
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            time.sleep(0.01)
+            with lock:
+                active[0] -= 1
+        until(40, piece, max_workers=4)
+        assert peak[0] <= 4
+
+    def test_first_error_returned(self):
+        def piece(i):
+            if i == 3:
+                raise RuntimeError("boom")
+        err = until(8, piece, max_workers=2)
+        assert isinstance(err, RuntimeError)
+
+    def test_cancel_stops_new_pieces(self):
+        cancel = threading.Event()
+        done = []
+        lock = threading.Lock()
+
+        def piece(i):
+            with lock:
+                done.append(i)
+            if len(done) >= 3:
+                cancel.set()
+        until(1000, piece, max_workers=1, cancel=cancel)
+        assert len(done) < 1000
+
+    def test_zero_pieces(self):
+        assert until(0, lambda i: None) is None
+
+
+def test_remote_client_fanout(tmp_path):
+    """pending_workloads_many against a live visibility HTTP server."""
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Workload,
+    )
+    from kueue_tpu.client.http_client import RemoteClient
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.visibility.http_server import ServingEndpoint
+
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("d"))
+    for i in range(3):
+        eng.create_cluster_queue(ClusterQueue(
+            name=f"cq{i}",
+            resource_groups=(ResourceGroup(
+                ("cpu",),
+                (FlavorQuotas("d", {"cpu": ResourceQuota(0)}),)),)))
+        eng.create_local_queue(LocalQueue(f"lq{i}", "default", f"cq{i}"))
+        eng.submit(Workload(name=f"w{i}", queue_name=f"lq{i}",
+                            pod_sets=(PodSet("m", 1, {"cpu": 100}),)))
+    srv = ServingEndpoint(eng)
+    srv.start()
+    try:
+        rc = RemoteClient(f"http://127.0.0.1:{srv.port}")
+        res = rc.pending_workloads_many([f"cq{i}" for i in range(3)])
+        assert set(res) == {"cq0", "cq1", "cq2"}
+        for i in range(3):
+            assert len(res[f"cq{i}"]["items"]) == 1
+    finally:
+        srv.stop()
