@@ -18,6 +18,7 @@ RUN pip install --no-cache-dir /wheels/*.whl
 COPY --from=build /src/native/build/libkueue_native.so \
     /usr/local/lib/kueue_tpu/libkueue_native.so
 ENV KUEUE_TPU_NATIVE_LIB=/usr/local/lib/kueue_tpu/libkueue_native.so
-# The oracle serving boundary (snapshot-in / verdicts-out).
-EXPOSE 9443
-ENTRYPOINT ["kueue-tpu-oracle"]
+# The oracle serving boundary (snapshot-in / verdicts-out). Bind all
+# interfaces so the published port actually reaches the service.
+EXPOSE 7461
+ENTRYPOINT ["kueue-tpu-oracle", "--host", "0.0.0.0", "--port", "7461"]

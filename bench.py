@@ -37,7 +37,11 @@ import subprocess
 import sys
 import time
 
-PROBE = "import jax; jax.devices(); print('ok')"
+# The probe must EXECUTE something: a sick device tunnel can still
+# enumerate devices and then hang on the first real computation.
+PROBE = ("import jax, jax.numpy as jnp;"
+         " jax.jit(lambda x: x + 1)(jnp.zeros(8)).block_until_ready();"
+         " print('ok')")
 REF_BASELINE_ADM_S = 43.0   # 15k workloads / ~351 s
 REF_TAS_ADM_S = 37.4        # 15k TAS workloads / ~401.5 s
 CYCLE_TARGET_S = 0.5

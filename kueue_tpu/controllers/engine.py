@@ -528,18 +528,13 @@ class Engine:
         arg > Configuration.profile_dir > KUEUE_TPU_PROFILE env."""
         import os as _os
 
+        from kueue_tpu.utils.structlog import device_trace
+
         trace_dir = (trace_dir
                      or (self.config.profile_dir if self.config else None)
                      or _os.environ.get("KUEUE_TPU_PROFILE"))
-        if not trace_dir:
+        with device_trace(trace_dir or None):
             yield
-            return
-        import jax
-        jax.profiler.start_trace(trace_dir)
-        try:
-            yield
-        finally:
-            jax.profiler.stop_trace()
 
     def schedule_once(self) -> Optional[CycleResult]:
         """One schedule() cycle (scheduler.go:286)."""

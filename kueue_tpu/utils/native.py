@@ -28,7 +28,10 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 # Installed deployments (pip wheel/container) ship the .so outside the
-# source tree and point at it with KUEUE_TPU_NATIVE_LIB.
+# source tree and point at it with KUEUE_TPU_NATIVE_LIB. The env var is
+# resolved ONCE, here; _SO_PATH_IS_ENV records how, so build decisions
+# and dlopen always agree even if os.environ changes later.
+_SO_PATH_IS_ENV = "KUEUE_TPU_NATIVE_LIB" in os.environ
 _SO_PATH = os.environ.get(
     "KUEUE_TPU_NATIVE_LIB",
     os.path.join(_NATIVE_DIR, "build", "libkueue_native.so"))
@@ -60,6 +63,10 @@ def ensure_built(block: bool = True) -> bool:
     global _build_thread
     if os.path.exists(_SO_PATH):
         return True
+    if _SO_PATH_IS_ENV:
+        # An explicit library path that doesn't exist: building the
+        # source tree would produce a .so we'd never load.
+        return False
     if _lib_failed or not os.path.exists(
             os.path.join(_NATIVE_DIR, "Makefile")):
         return False

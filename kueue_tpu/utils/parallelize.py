@@ -53,14 +53,22 @@ def until(pieces: int, do_work_piece: Callable[[int], None],
     if pieces <= 0:
         return None
     err_ch = ErrorChannel()
+    # First error stops handing out new pieces (ErrorChannel's
+    # SendErrorWithCancel semantics); started pieces run to completion.
+    stop = threading.Event()
+
+    def cancelled() -> bool:
+        return stop.is_set() or (cancel is not None and cancel.is_set())
+
     if pieces == 1 or max_workers <= 1:
         for i in range(pieces):
-            if cancel is not None and cancel.is_set():
+            if cancelled():
                 break
             try:
                 do_work_piece(i)
-            except BaseException as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
                 err_ch.send_error(e)
+                stop.set()
         return err_ch.receive()
 
     next_i = [0]
@@ -68,7 +76,7 @@ def until(pieces: int, do_work_piece: Callable[[int], None],
 
     def worker() -> None:
         while True:
-            if cancel is not None and cancel.is_set():
+            if cancelled():
                 return
             with lock:
                 i = next_i[0]
@@ -77,8 +85,9 @@ def until(pieces: int, do_work_piece: Callable[[int], None],
                 next_i[0] = i + 1
             try:
                 do_work_piece(i)
-            except BaseException as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
                 err_ch.send_error(e)
+                stop.set()
 
     threads = [threading.Thread(target=worker, daemon=True)
                for _ in range(min(pieces, max_workers))]
