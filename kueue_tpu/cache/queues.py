@@ -99,6 +99,8 @@ class PendingClusterQueue:
         self._entry_of[id_] = (info, sort_key)
         self._hp.push(id_, sort_key[0], sort_key[1], sort_key[2],
                       sort_key[3])
+        if self.manager is not None:
+            self.manager.rows.on_push(info, sort_key)
 
     def sort_key_of(self, key: str) -> Optional[tuple]:
         """The stored heap sort key for a pending workload — the exact
@@ -129,6 +131,19 @@ class PendingClusterQueue:
         self._heap_remove(key)
         if self.in_flight == key:
             self.in_flight = None
+        if self.manager is not None:
+            self.manager.rows.on_remove(key)
+
+    def park(self, key: str) -> None:
+        """Move an active pending workload to the inadmissible side map
+        (the oracle bridge's NoFit verdict application)."""
+        info = self.items.pop(key, None)
+        if info is None:
+            return
+        self._heap_remove(key)
+        self.inadmissible[key] = info
+        if self.manager is not None:
+            self.manager.rows.on_park(info)
 
     def requeue_if_not_present(self, info: WorkloadInfo,
                                reason: RequeueReason) -> bool:
@@ -149,6 +164,8 @@ class PendingClusterQueue:
             self.push_or_update(info)
         else:
             self.inadmissible[key] = info
+            if self.manager is not None:
+                self.manager.rows.on_park(info)
             self._park_same_hash(info)
         return True
 
@@ -162,6 +179,8 @@ class PendingClusterQueue:
                 del self.items[key]
                 self._heap_remove(key)
                 self.inadmissible[key] = other
+                if self.manager is not None:
+                    self.manager.rows.on_park(other)
 
     def queue_inadmissible(self) -> bool:
         """manager.go QueueInadmissibleWorkloads — move all inadmissible
@@ -194,6 +213,8 @@ class PendingClusterQueue:
                 continue
             del self.items[info.key]
             self.in_flight = info.key
+            if self.manager is not None:
+                self.manager.rows.on_pop(info.key)
             result = info
             break
         for info, sort_key in held:
@@ -255,11 +276,16 @@ class QueueManager:
     """pkg/cache/queue/manager.go:147 (Manager)."""
 
     def __init__(self) -> None:
+        from kueue_tpu.tensor.rowcache import WorkloadRowCache
+
         self.cluster_queues: dict[str, PendingClusterQueue] = {}
         self.local_queues: dict[str, LocalQueue] = {}
         # AFS hook: lq key -> decayed usage (manager.go:68).
         self.lq_usage_fn = None
         self.second_pass = SecondPassQueue()
+        # Incremental tensor rows over the pending world (the oracle
+        # bridge's per-cycle encoding, tensor/rowcache.py).
+        self.rows = WorkloadRowCache()
         # workload_info.InfoOptions (resource transformations / excluded
         # prefixes), set by the engine (workload.go:139 plumbing).
         self.info_options = None
@@ -277,7 +303,13 @@ class QueueManager:
         self.cluster_queues[cq.name] = PendingClusterQueue(cq, manager=self)
 
     def delete_cluster_queue(self, name: str) -> None:
-        self.cluster_queues.pop(name, None)
+        pcq = self.cluster_queues.pop(name, None)
+        if pcq is not None:
+            keys = set(pcq.items) | set(pcq.inadmissible)
+            if pcq.in_flight is not None:
+                keys.add(pcq.in_flight)
+            for key in keys:
+                self.rows.on_remove(key)
 
     def add_local_queue(self, lq: LocalQueue) -> None:
         self.local_queues[lq.key] = lq

@@ -811,6 +811,9 @@ class Engine:
                                  now=self.clock)
         entry.info.apply_admission(admission)
         self.cache.add_or_update_workload(wl)
+        # The workload left the pending world: free its tensor row (the
+        # pending heaps already dropped it at pop/delete time).
+        self.queues.rows.on_remove(wl.key)
         # An assumed workload that was itself a pending preemption target
         # satisfies its expectation (scheduler.go:882, kueue#11480).
         self.preemption_expectations.observed_uid(wl.key, wl.uid)

@@ -33,9 +33,17 @@ Remaining whole-cycle fallbacks (conservative, correctness-first):
   * WaitForPodsReady admission blocking.
 
 Admission fair sharing runs on device: AFS-scoped CQs' head ordering
-(LocalQueue decayed usage first) is folded into the rank vector
-(_head_ranks), and entry penalties flow through the shared engine
-on_admit hook when device verdicts are applied.
+(LocalQueue decayed usage first) is folded into the rank vector — the
+row cache stores each workload's heap sort key (AFS usage frozen at
+push time, cluster_queue.go:208) and ranks with those, so device and
+host head order are identical by construction — and entry penalties
+flow through the shared engine on_admit hook when device verdicts are
+applied.
+
+Per-cycle encoding is incremental (round 2): the queue manager's
+WorkloadRowCache (tensor/rowcache.py) keeps the pending set as live
+tensor rows updated on every queue transition; a cycle re-encodes only
+rows that changed, instead of the whole pending world.
 
 Fair sharing runs on device for arbitrary cohort forests: the
 hierarchical LCA tournament is ops/commit.commit_grouped_fair.
@@ -446,35 +454,6 @@ class OracleBridge:
             victim_vals[ci, j] = adm.usage[v]
             victim_ids[ci, j] = v
 
-    def _head_ranks(self, solver, pending_infos) -> np.ndarray:
-        """Within-CQ head ordering. Classical: priority desc, timestamp
-        asc (cluster_queue.go heap less). With admission fair sharing
-        active, rank by each workload's STORED heap key
-        (PendingClusterQueue.sort_key_of): AFS usage is frozen into the
-        key at push time (cluster_queue.go:208), so recomputing usage
-        live here would diverge from what the host heap pops — ranking
-        with the stored keys makes device and sequential head order
-        identical by construction. Ranks are only ever compared within
-        one CQ, so one global ordering over all keys is safe."""
-        afs = getattr(self.engine, "afs", None)
-        if afs is None:
-            return solver.head_ranks()
-        W = solver.wls.num_workloads
-        keys = []
-        for i, info in enumerate(pending_infos):
-            pcq = self.engine.queues.cluster_queues.get(
-                info.cluster_queue)
-            sk = pcq.sort_key_of(info.key) if pcq is not None else None
-            if sk is None:
-                sk = (0.0, -info.obj.effective_priority,
-                      info.obj.creation_time, _HOST_BIG)
-            keys.append((sk, i))
-        keys.sort()
-        rank = np.empty(W, np.int64)
-        for pos, (_, i) in enumerate(keys):
-            rank[i] = pos
-        return rank
-
     @staticmethod
     def _head_pri(wls, head_idx):
         h = np.maximum(head_idx, 0)
@@ -506,17 +485,12 @@ class OracleBridge:
         sequential fallback (nothing has been mutated in that case)."""
         import jax.numpy as jnp
 
-        from kueue_tpu.oracle import batched as B
-
         eng = self.engine
         if not self.world_is_fast_path_safe():
             return self._fallback("world")
 
-        # Gather all active pending workloads (without popping).
-        pending_infos = []
-        for pcq in eng.queues.cluster_queues.values():
-            pending_infos.extend(pcq.items.values())
-        if not pending_infos:
+        if not any(pcq.items for pcq in
+                   eng.queues.cluster_queues.values()):
             if any(pcq.inadmissible for pcq in
                    eng.queues.cluster_queues.values()):
                 # Only parked workloads remain; the sequential path owns
@@ -526,33 +500,57 @@ class OracleBridge:
 
         import time as _time
 
+        from kueue_tpu.tensor.schema import encode_snapshot
+
         _t0 = _time.perf_counter()
         snapshot = eng.cache.snapshot()
-        solver = B.BatchedDrainSolver(snapshot, pending_infos,
-                                      max_depth=self.max_depth)
-        wl = solver.wls
-        w = solver.world
+        now = eng.clock
+        # Incremental encoding: the queue manager's row cache carries the
+        # pending world as live tensors; a cycle pays only for rows that
+        # changed since the last one (tensor/rowcache.py).
+        rows = eng.queues.rows
+        rows.maybe_compact()
+        w = encode_snapshot(snapshot, max_depth=self.max_depth)
+        rows.refresh_held(now)
+        wl = rows.tensors(w)
+        pending_infos = rows.info_of
         W = wl.num_workloads
         C = w.num_cqs
         Rn = w.root_members.shape[0]
-        now = eng.clock
 
         # --- host-side head + root partitioning ---
-        ready = np.fromiter(
-            ((i.obj.status.requeue_at is None
-              or i.obj.status.requeue_at <= now) for i in pending_infos),
-            bool, count=W)
-        active = ready & (wl.cq >= 0)
-        rank = self._head_ranks(solver, pending_infos)
+        ready = rows.requeue_at <= now
+        active = rows.active & ready & (wl.cq >= 0)
+        rank = rows.head_ranks()
         cq_safe_idx = np.maximum(wl.cq, 0)
-        eff = np.where(active, rank, _HOST_BIG)
-        head_rank = np.full(C, _HOST_BIG, np.int64)
-        np.minimum.at(head_rank, cq_safe_idx,
-                      np.where(wl.cq >= 0, eff, _HOST_BIG))
-        has_head = head_rank < _HOST_BIG
-        is_head = active & (wl.cq >= 0) & (eff == head_rank[cq_safe_idx])
-        head_wid = np.full(C, -1, np.int64)
-        head_wid[wl.cq[is_head]] = np.nonzero(is_head)[0]
+
+        # Head selection with live hold-back checks: requeue-at can be
+        # mutated on status without a queue transition, and only heads
+        # gate on it (ClusterQueue.Pop skips held entries,
+        # cluster_queue.go:715). Re-read it for each candidate head; a
+        # held head yields to the next-ranked workload of its CQ.
+        for _hold_round in range(16):
+            eff = np.where(active, rank, _HOST_BIG)
+            head_rank = np.full(C, _HOST_BIG, np.int64)
+            np.minimum.at(head_rank, cq_safe_idx,
+                          np.where(wl.cq >= 0, eff, _HOST_BIG))
+            has_head = head_rank < _HOST_BIG
+            is_head = active & (wl.cq >= 0) \
+                & (eff == head_rank[cq_safe_idx])
+            head_wid = np.full(C, -1, np.int64)
+            head_wid[wl.cq[is_head]] = np.nonzero(is_head)[0]
+            held = []
+            for wid in head_wid[has_head]:
+                ra = pending_infos[wid].obj.status.requeue_at
+                rows.requeue_at[wid] = -np.inf if ra is None else ra
+                if ra is not None and ra > now:
+                    held.append(wid)
+            if not held:
+                break
+            active[held] = False
+        else:
+            # Pathological hold churn: give up on the fast path.
+            return self._fallback("held-head-churn")
 
         head_eligible = np.zeros(C, bool)
         head_eligible[has_head] = wl.eligible[head_wid[has_head]]
@@ -631,7 +629,7 @@ class OracleBridge:
         # --- device cycle ---
         args = dict(
             rank=jnp.asarray(rank),
-            commit_rank=jnp.asarray(solver.commit_ranks()),
+            commit_rank=jnp.asarray(rows.commit_ranks()),
             wl_cq=jnp.asarray(wl.cq), wl_req=jnp.asarray(wl.requests),
             wl_priority=jnp.asarray(wl.priority),
             wl_has_qr=jnp.asarray(wl.has_quota_reservation),
@@ -718,7 +716,7 @@ class OracleBridge:
                 if adm is None:
                     admitted, adm = self._encode_admitted(snapshot, w)
                 res = self._device_preemption(
-                    w, solver.wls, args, statics, pending,
+                    w, wl, args, statics, pending,
                     inadmissible, usage, in_scope, pcfg, adm, admitted,
                     np.asarray(flavor_of_res), np.asarray(head_idx), pre)
                 out, second_targets, overflow = res
@@ -740,7 +738,7 @@ class OracleBridge:
         self.cycles_on_device += 1
         _t_device = _time.perf_counter()
         apply_rows = device_w & cq_on_device[cq_safe_idx]
-        result = self._apply(solver, pending_infos,
+        result = self._apply(w, wl, pending_infos,
                              np.asarray(wl_admitted),
                              np.asarray(new_inadmissible),
                              np.asarray(slot_position),
@@ -882,7 +880,7 @@ class OracleBridge:
                  claimed0=np.zeros(A_pad, bool)), statics)
         return out, targets_by_slot, overflow
 
-    def _apply(self, solver, pending_infos, wl_admitted, parked,
+    def _apply(self, w, wls, pending_infos, wl_admitted, parked,
                slot_position, flavor_of_res, apply_rows=None,
                slot_mask=None, slot_preempting=None,
                head_idx=None, preempt_targets=None) -> CycleResult:
@@ -892,7 +890,6 @@ class OracleBridge:
         from kueue_tpu.scheduler.preemption import Target
 
         eng = self.engine
-        w, wls = solver.world, solver.wls
         result = CycleResult()
         W = len(pending_infos)
         if apply_rows is None:
@@ -902,17 +899,14 @@ class OracleBridge:
         if slot_preempting is None:
             slot_preempting = np.zeros(w.num_cqs, bool)
 
-        # Group verdict rows per slot.
+        # Group verdict rows per slot (vectorized: verdict rows are
+        # sparse relative to the row space).
         admit_of_slot: dict[int, int] = {}
         parked_of_slot: dict[int, list[int]] = {}
-        for i in range(W):
-            if not apply_rows[i]:
-                continue
-            ci = int(wls.cq[i])
-            if wl_admitted[i]:
-                admit_of_slot[ci] = i
-            elif parked[i]:
-                parked_of_slot.setdefault(ci, []).append(i)
+        for i in np.nonzero(wl_admitted[:W] & apply_rows)[0]:
+            admit_of_slot[int(wls.cq[i])] = int(i)
+        for i in np.nonzero(parked[:W] & apply_rows)[0]:
+            parked_of_slot.setdefault(int(wls.cq[i]), []).append(int(i))
 
         # Apply per slot in the host's nominate order (the queue manager's
         # ClusterQueue iteration order): the interleaving of parking and
@@ -954,8 +948,7 @@ class OracleBridge:
                 info = pending_infos[i]
                 pcq = eng.queues.cluster_queues.get(info.cluster_queue)
                 if pcq is not None:
-                    pcq.delete(info.key)
-                    pcq.inadmissible[info.key] = info
+                    pcq.park(info.key)
                 entry = Entry(info=info,
                               requeue_reason=RequeueReason.NO_FIT)
                 entry.inadmissible_msg = "NoFit (batched oracle)"

@@ -1,0 +1,355 @@
+"""Incremental workload row cache: the pending set as live tensors.
+
+The reference keeps its pending world incrementally correct (heaps and
+maps updated on every informer event, pkg/cache/queue/manager.go) and the
+scheduler snapshots it per cycle. Round 1 re-encoded every pending
+workload into dense arrays from scratch each serving cycle —
+O(W) Python per cycle, which at the 50k north-star scale costs more than
+the device solve itself. This module makes the tensor encoding itself
+incremental: queue transitions (push / park / pop / delete) update rows
+in O(1), and a cycle only pays for rows that changed since the last one.
+
+Layout: one row per known pending workload (active in the heap, parked
+inadmissible, or popped in-flight). Rows hold
+  * world-independent fields captured at push time: priority, queue-order
+    timestamp, the exact heap sort key (AFS usage frozen at push,
+    cluster_queue.go:208), requeue-at, quota-reservation flag;
+  * world-dependent fields (CQ index, request columns, fast-path
+    eligibility, scheduling-equivalence hash id) recomputed lazily for
+    dirty rows against the currently-bound world signature.
+
+Scheduling-equivalence hash ids are refcounted so the dense id space
+stays bounded by the row capacity (the cycle kernel scatters them into a
+rows+1 sized mask, oracle/batched.py).
+
+The cache is advisory: the engine bridge uses it when present, and the
+from-scratch encoder (tensor/schema.encode_workloads) remains both the
+fallback and the differential oracle (tests/test_rowcache.py).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Optional
+
+import numpy as np
+
+from kueue_tpu.workload_info import WorkloadInfo
+
+_INF_TS = np.inf
+
+
+class _HashRegistry:
+    """Dense, refcounted ids for scheduling-equivalence hash tuples.
+
+    Ids are recycled when their refcount drops to zero, so the id space
+    never outgrows the maximum number of concurrently-known rows."""
+
+    def __init__(self) -> None:
+        self._id_of: dict = {}
+        self._count: dict = {}
+        self._free: list[int] = []
+        self._next = 0
+
+    def acquire(self, h) -> int:
+        hid = self._id_of.get(h)
+        if hid is None:
+            hid = heapq.heappop(self._free) if self._free else self._next
+            if hid == self._next:
+                self._next += 1
+            self._id_of[h] = hid
+            self._count[hid] = 0
+        self._count[hid] += 1
+        return hid
+
+    def release(self, h) -> None:
+        hid = self._id_of.get(h)
+        if hid is None:
+            return
+        self._count[hid] -= 1
+        if self._count[hid] <= 0:
+            del self._count[hid]
+            del self._id_of[h]
+            heapq.heappush(self._free, hid)
+
+
+class WorkloadRowCache:
+    """Pending workloads as incrementally-maintained dense rows."""
+
+    MIN_CAPACITY = 64
+
+    def __init__(self) -> None:
+        self._cap = self.MIN_CAPACITY
+        self._row_of: dict[str, int] = {}
+        self._free: list[int] = list(range(self._cap - 1, -1, -1))
+        self.info_of: list[Optional[WorkloadInfo]] = [None] * self._cap
+        self._hash_tuple: list = [None] * self._cap
+        self._dirty: set[int] = set()
+        self._hashes = _HashRegistry()
+
+        # world-independent columns
+        self.priority = np.zeros(self._cap, np.int64)
+        self.timestamp = np.zeros(self._cap, np.float64)
+        self.has_qr = np.zeros(self._cap, bool)
+        self.requeue_at = np.full(self._cap, -_INF_TS, np.float64)
+        self.active = np.zeros(self._cap, bool)
+        # heap sort key (afs usage, -priority, ts, seq) frozen at push
+        self.key_afs = np.zeros(self._cap, np.float64)
+        self.key_negpri = np.zeros(self._cap, np.int64)
+        self.key_ts = np.zeros(self._cap, np.float64)
+        self.key_seq = np.full(self._cap, np.int64(1) << 60, np.int64)
+
+        # world-dependent columns (valid when row not dirty and the
+        # bound signature matches)
+        self._signature = None
+        self._num_resources = 1
+        self.cq = np.full(self._cap, -1, np.int32)
+        self.requests = np.zeros((self._cap, 1), np.int64)
+        self.eligible = np.zeros(self._cap, bool)
+        self.hash_id = np.zeros(self._cap, np.int32)
+
+    # -- queue transition hooks (O(1) amortized) --
+
+    def on_push(self, info: WorkloadInfo, sort_key: tuple) -> None:
+        """Workload entered (or re-entered) a pending heap."""
+        i = self._row_of.get(info.key)
+        if i is None:
+            i = self._alloc()
+            self._row_of[info.key] = i
+        self.info_of[i] = info
+        wl = info.obj
+        self.priority[i] = wl.effective_priority
+        self.timestamp[i] = wl.creation_time
+        self.has_qr[i] = wl.has_quota_reservation
+        ra = wl.status.requeue_at
+        self.requeue_at[i] = -_INF_TS if ra is None else ra
+        self.key_afs[i], negpri, kts, kseq = sort_key
+        self.key_negpri[i] = negpri
+        self.key_ts[i] = kts
+        self.key_seq[i] = kseq
+        self.active[i] = True
+        self._dirty.add(i)
+
+    def on_park(self, info: WorkloadInfo) -> None:
+        """Workload moved to the inadmissible side map (row kept: a
+        cluster event can re-activate it)."""
+        i = self._row_of.get(info.key)
+        if i is None:  # parked without ever being pushed
+            self.on_push(info, (0.0, -info.obj.effective_priority,
+                                info.obj.creation_time, np.int64(1) << 59))
+        i = self._row_of[info.key]
+        self.info_of[i] = info
+        self.active[i] = False
+
+    def on_pop(self, key: str) -> None:
+        """Workload popped (in flight with the sequential path)."""
+        i = self._row_of.get(key)
+        if i is not None:
+            self.active[i] = False
+
+    def on_remove(self, key: str) -> None:
+        """Workload left the pending world (admitted / deleted)."""
+        i = self._row_of.pop(key, None)
+        if i is None:
+            return
+        self.active[i] = False
+        self.info_of[i] = None
+        h = self._hash_tuple[i]
+        if h is not None:
+            self._hashes.release(h)
+            self._hash_tuple[i] = None
+        self.key_seq[i] = np.int64(1) << 60
+        self.requeue_at[i] = -_INF_TS
+        self._dirty.discard(i)
+        self._free.append(i)
+
+    # -- capacity management --
+
+    def _alloc(self) -> int:
+        if not self._free:
+            self._grow(self._cap * 2)
+        return self._free.pop()
+
+    def _grow(self, new_cap: int) -> None:
+        old = self._cap
+        self._cap = new_cap
+        for name in ("priority", "timestamp", "has_qr", "requeue_at",
+                     "active", "key_afs", "key_negpri", "key_ts",
+                     "key_seq", "cq", "eligible", "hash_id"):
+            arr = getattr(self, name)
+            fill = {"requeue_at": -_INF_TS, "cq": -1,
+                    "key_seq": np.int64(1) << 60}.get(name, 0)
+            grown = np.full(new_cap, fill, arr.dtype)
+            grown[:old] = arr
+            setattr(self, name, grown)
+        reqs = np.zeros((new_cap, self.requests.shape[1]), np.int64)
+        reqs[:old] = self.requests
+        self.requests = reqs
+        self.info_of.extend([None] * (new_cap - old))
+        self._hash_tuple.extend([None] * (new_cap - old))
+        self._free.extend(range(new_cap - 1, old - 1, -1))
+
+    def maybe_compact(self) -> None:
+        """Shrink after a drain: keep the dense-row invariant cheap. Runs
+        only between cycles (row indices change)."""
+        used = len(self._row_of)
+        if self._cap <= self.MIN_CAPACITY or used * 4 > self._cap:
+            return
+        keep = sorted(self._row_of.values())
+        new_cap = max(self.MIN_CAPACITY, 1 << (max(used * 2, 1) - 1)
+                      .bit_length())
+        remap = {old: new for new, old in enumerate(keep)}
+        for name in ("priority", "timestamp", "has_qr", "requeue_at",
+                     "active", "key_afs", "key_negpri", "key_ts",
+                     "key_seq", "cq", "eligible", "hash_id"):
+            arr = getattr(self, name)
+            fill = {"requeue_at": -_INF_TS, "cq": -1,
+                    "key_seq": np.int64(1) << 60}.get(name, 0)
+            grown = np.full(new_cap, fill, arr.dtype)
+            if keep:
+                grown[:used] = arr[keep]
+            setattr(self, name, grown)
+        reqs = np.zeros((new_cap, self.requests.shape[1]), np.int64)
+        if keep:
+            reqs[:used] = self.requests[keep]
+        self.requests = reqs
+        self.info_of = [self.info_of[i] for i in keep] + \
+            [None] * (new_cap - used)
+        self._hash_tuple = [self._hash_tuple[i] for i in keep] + \
+            [None] * (new_cap - used)
+        self._row_of = {k: remap[i] for k, i in self._row_of.items()}
+        self._dirty = {remap[i] for i in self._dirty if i in remap}
+        self._cap = new_cap
+        self._free = list(range(new_cap - 1, used - 1, -1))
+        # Re-index hash ids: id values are bounded by the peak row count
+        # between rebuilds, and the kernel scatters them into a
+        # rows+1-sized mask — after shrinking, rebuild the registry so
+        # ids fit the new capacity again.
+        self._hashes = _HashRegistry()
+        for i in range(used):
+            h = self._hash_tuple[i]
+            if h is not None:
+                self.hash_id[i] = self._hashes.acquire(h)
+
+    # -- per-cycle encoding --
+
+    @staticmethod
+    def world_signature(world) -> tuple:
+        """Everything the world-dependent row fields depend on: the CQ
+        index space, the resource column space, and per-CQ resource
+        coverage (drives implicit-pods and uncovered-resource
+        eligibility)."""
+        return (tuple(world.cq_names), tuple(world.resource_names),
+                world.group_of_res.tobytes())
+
+    def bind_world(self, world) -> None:
+        sig = self.world_signature(world)
+        if sig == self._signature:
+            return
+        self._signature = sig
+        S = max(world.num_resources, 1)
+        if S != self.requests.shape[1]:
+            self.requests = np.zeros((self._cap, S), np.int64)
+            self._num_resources = S
+        self._dirty.update(self._row_of.values())
+
+    def _encode_row(self, i: int, world, cq_idx: dict,
+                    s_idx: dict) -> None:
+        """World-dependent fields for one row — the single-row form of
+        tensor/schema.encode_workloads."""
+        from kueue_tpu.cache.queues import scheduling_hash
+
+        info = self.info_of[i]
+        wl = info.obj
+        old_h = self._hash_tuple[i]
+        h = scheduling_hash(wl, info.cluster_queue)
+        if h != old_h:
+            if old_h is not None:
+                self._hashes.release(old_h)
+            self.hash_id[i] = self._hashes.acquire(h)
+            self._hash_tuple[i] = h
+        ci = cq_idx.get(info.cluster_queue, -1)
+        self.cq[i] = ci
+        self.requests[i, :] = 0
+        eligible = True
+        if ci < 0 or len(info.total_requests) != 1:
+            eligible = False
+        else:
+            ps = wl.pod_sets[0]
+            if (ps.min_count is not None or ps.topology_request is not None
+                    or ps.node_selector or ps.tolerations):
+                eligible = False
+            else:
+                psr = info.total_requests[0]
+                reqs = dict(psr.requests)
+                si = s_idx.get("pods")
+                if si is not None and world.group_of_res[ci, si] >= 0:
+                    reqs["pods"] = psr.count
+                for res, q in reqs.items():
+                    si = s_idx.get(res)
+                    if si is None:
+                        if q > 0:
+                            eligible = False
+                        continue
+                    self.requests[i, si] = q
+        self.eligible[i] = eligible
+
+    def flush(self, world) -> None:
+        """Re-encode every dirty row against the bound world."""
+        self.bind_world(world)
+        if not self._dirty:
+            return
+        cq_idx = {n: i for i, n in enumerate(world.cq_names)}
+        s_idx = {n: i for i, n in enumerate(world.resource_names)}
+        for i in self._dirty:
+            if self.info_of[i] is not None:
+                self._encode_row(i, world, cq_idx, s_idx)
+        self._dirty.clear()
+
+    def refresh_held(self, now: float) -> None:
+        """Re-read requeue-at for rows currently held back: eviction
+        backoff is the one field controllers touch without a queue
+        transition."""
+        held = np.nonzero(self.requeue_at > now)[0]
+        for i in held:
+            info = self.info_of[i]
+            if info is None:
+                continue
+            ra = info.obj.status.requeue_at
+            self.requeue_at[i] = -_INF_TS if ra is None else ra
+
+    # -- views --
+
+    @property
+    def num_rows(self) -> int:
+        return self._cap
+
+    def tensors(self, world):
+        """A WorkloadTensors over the full row space (flush first)."""
+        from kueue_tpu.tensor.schema import WorkloadTensors
+
+        self.flush(world)
+        keys = [info.key if info is not None else "" for info in
+                self.info_of]
+        return WorkloadTensors(
+            num_workloads=self._cap, keys=keys, cq=self.cq,
+            priority=self.priority, timestamp=self.timestamp,
+            requests=self.requests, has_quota_reservation=self.has_qr,
+            eligible=self.eligible, hash_id=self.hash_id)
+
+    def head_ranks(self) -> np.ndarray:
+        """Global rank by the stored heap sort keys — by construction the
+        order the host heaps pop (AFS usage included)."""
+        order = np.lexsort((self.key_seq, self.key_ts, self.key_negpri,
+                            self.key_afs))
+        rank = np.empty(self._cap, np.int64)
+        rank[order] = np.arange(self._cap)
+        return rank
+
+    def commit_ranks(self) -> np.ndarray:
+        """FIFO commit tiebreak: queue-order timestamp, then push
+        sequence (scheduler.go:1001)."""
+        order = np.lexsort((self.key_seq, self.timestamp))
+        rank = np.empty(self._cap, np.int64)
+        rank[order] = np.arange(self._cap)
+        return rank

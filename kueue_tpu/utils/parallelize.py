@@ -85,7 +85,10 @@ def until(pieces: int, do_work_piece: Callable[[int], None],
                 next_i[0] = i + 1
             try:
                 do_work_piece(i)
-            except Exception as e:  # noqa: BLE001
+            except BaseException as e:  # noqa: BLE001 — SystemExit etc.
+                # raised in a worker thread would otherwise vanish
+                # (Python swallows them off the main thread) and the run
+                # would falsely report success.
                 err_ch.send_error(e)
                 stop.set()
 

@@ -1,0 +1,194 @@
+"""WorkloadRowCache: the incremental per-cycle encoding must match the
+from-scratch encoder (tensor/schema.encode_workloads) on every field the
+cycle kernel consumes, across arbitrary queue-transition histories."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.scheduler.cycle import RequeueReason
+from kueue_tpu.tensor.schema import encode_snapshot, encode_workloads
+
+
+def make_engine(n_cqs=4, nominal=4000):
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cohort(Cohort("co"))
+    for i in range(n_cqs):
+        eng.create_cluster_queue(ClusterQueue(
+            name=f"cq{i}", cohort="co",
+            resource_groups=(ResourceGroup(
+                ("cpu",), (FlavorQuotas(
+                    "default", {"cpu": ResourceQuota(nominal)}),)),)))
+        eng.create_local_queue(LocalQueue(f"lq{i}", "default", f"cq{i}"))
+    return eng
+
+
+def rows_vs_fresh(eng):
+    """Assert the row cache agrees with a fresh encode over the live
+    pending set (items + inadmissible), row for row."""
+    rows = eng.queues.rows
+    snap = eng.cache.snapshot()
+    world = encode_snapshot(snap, max_depth=4)
+    wls = rows.tensors(world)
+
+    fresh_infos = {}
+    for pcq in eng.queues.cluster_queues.values():
+        for info in pcq.items.values():
+            fresh_infos[info.key] = (info, True)
+        for info in pcq.inadmissible.values():
+            fresh_infos[info.key] = (info, False)
+
+    seen = set()
+    for i, info in enumerate(rows.info_of):
+        if info is None:
+            assert not rows.active[i]
+            continue
+        assert info.key in fresh_infos, f"stale row {info.key}"
+        live, is_active = fresh_infos[info.key]
+        assert rows.active[i] == is_active, info.key
+        seen.add(info.key)
+        ref = encode_workloads(world, [live])
+        assert wls.cq[i] == ref.cq[0]
+        assert wls.priority[i] == ref.priority[0]
+        assert wls.timestamp[i] == ref.timestamp[0]
+        assert wls.eligible[i] == ref.eligible[0]
+        np.testing.assert_array_equal(wls.requests[i], ref.requests[0])
+    assert seen == set(fresh_infos), "missing rows"
+    # hash-id space must fit the kernel's rows+1 scatter
+    assert rows.hash_id.max(initial=0) <= rows.num_rows
+
+
+def test_rowcache_tracks_submit_park_requeue_delete():
+    eng = make_engine()
+    rng = random.Random(3)
+    wls = []
+    for i in range(40):
+        eng.clock += 0.01
+        wl = Workload(name=f"w{i}", queue_name=f"lq{rng.randrange(4)}",
+                      priority=rng.choice([0, 5]),
+                      pod_sets=(PodSet("main", 1,
+                                       {"cpu": rng.choice([500, 1500])}),))
+        eng.submit(wl)
+        wls.append(wl)
+    rows_vs_fresh(eng)
+
+    # Park a few via the NoFit requeue path.
+    for name in ("cq0", "cq1"):
+        pcq = eng.queues.cluster_queues[name]
+        head = pcq.pop(eng.clock)
+        if head is not None:
+            pcq.requeue_if_not_present(head, RequeueReason.NO_FIT)
+    rows_vs_fresh(eng)
+
+    # Delete some, re-activate the parked ones.
+    for wl in wls[:10]:
+        eng.queues.delete_workload(wl)
+    eng.queues.queue_inadmissible_workloads()
+    rows_vs_fresh(eng)
+
+
+def test_rowcache_follows_scheduling_cycles():
+    eng = make_engine(n_cqs=3, nominal=3000)
+    eng.attach_oracle()
+    rng = random.Random(7)
+    for i in range(30):
+        eng.clock += 0.01
+        eng.submit(Workload(
+            name=f"w{i}", queue_name=f"lq{rng.randrange(3)}",
+            priority=rng.choice([0, 5]),
+            pod_sets=(PodSet("main", 1, {"cpu": 1000}),)))
+    for _ in range(50):
+        r = eng.schedule_once()
+        if r is None or not (r.stats.admitted or r.stats.preempting):
+            break
+    rows_vs_fresh(eng)
+    admitted = sum(1 for pcq in eng.queues.cluster_queues.values()
+                   for _ in pcq.items)
+    # 9 fit (3 CQs x 3000 / 1000); the rest pend
+    assert sum(eng.queues.rows.active) == admitted
+
+
+def test_rowcache_compaction_preserves_rows_and_hash_bounds():
+    eng = make_engine(n_cqs=2, nominal=10 ** 9)
+    eng.attach_oracle()
+    for i in range(600):
+        eng.clock += 0.001
+        eng.submit(Workload(name=f"w{i}", queue_name=f"lq{i % 2}",
+                            pod_sets=(PodSet("main", 1, {"cpu": 100}),)))
+    rows = eng.queues.rows
+    assert rows.num_rows >= 600
+    # Drain everything: all rows freed on admission.
+    for _ in range(500):
+        r = eng.schedule_once()
+        if r is None or not r.stats.admitted:
+            break
+    assert not any(pcq.items for pcq in
+                   eng.queues.cluster_queues.values())
+    # A couple of stragglers arrive; compaction shrinks the row space.
+    for i in range(5):
+        eng.clock += 0.001
+        eng.submit(Workload(name=f"tail{i}", queue_name="lq0",
+                            pod_sets=(PodSet("main", 1, {"cpu": 100}),)))
+    rows.maybe_compact()
+    assert rows.num_rows < 600
+    rows_vs_fresh(eng)
+    got = 0
+    for _ in range(10):  # one head per CQ per cycle; all 5 share a CQ
+        r = eng.schedule_once()
+        if r is None or not r.stats.admitted:
+            break
+        got += r.stats.admitted
+    assert got == 5
+
+
+def test_rowcache_afs_sort_keys_rank_like_heap():
+    """Head ranks must reproduce heap pop order, AFS usage included."""
+    eng = make_engine(n_cqs=1)
+    pcq = eng.queues.cluster_queues["cq0"]
+    for i, (pri, t) in enumerate([(0, 3.0), (5, 2.0), (5, 1.0), (1, 0.5)]):
+        eng.clock = t
+        eng.submit(Workload(name=f"w{i}", queue_name="lq0", priority=pri,
+                            pod_sets=(PodSet("main", 1, {"cpu": 100}),)))
+    rows = eng.queues.rows
+    rank = rows.head_ranks()
+    by_rank = sorted(
+        (i for i, info in enumerate(rows.info_of) if info is not None),
+        key=lambda i: rank[i])
+    names = [rows.info_of[i].obj.name for i in by_rank]
+    pops = []
+    while True:
+        head = pcq.pop(eng.clock)
+        if head is None:
+            break
+        pops.append(head.obj.name)
+    assert names == pops == ["w2", "w1", "w3", "w0"]
+
+
+def test_rowcache_requeue_at_held_heads():
+    eng = make_engine(n_cqs=1, nominal=1000)
+    eng.attach_oracle()
+    eng.clock = 1.0
+    w1 = Workload(name="held", queue_name="lq0",
+                  pod_sets=(PodSet("main", 1, {"cpu": 600}),))
+    eng.submit(w1)
+    w1.status.requeue_at = 50.0  # out-of-band hold (no queue transition)
+    eng.clock = 2.0
+    w2 = Workload(name="ready", queue_name="lq0",
+                  pod_sets=(PodSet("main", 1, {"cpu": 600}),))
+    eng.submit(w2)
+    eng.schedule_once()
+    assert w2.is_admitted and not w1.is_admitted
