@@ -31,6 +31,18 @@ class Cache:
         self.nodes: dict[str, object] = {}  # tas.Node
         # key -> admitted/assumed WorkloadInfo
         self.workloads: dict[str, WorkloadInfo] = {}
+        # Incremental admitted-side accounting (cache.go keeps usage live
+        # and Snapshot() clones it; round 1 recomputed it per cycle from
+        # every admitted workload — O(A) Python per snapshot). The exact
+        # quantities ADDED are remembered per workload so removal
+        # subtracts what was added even if the live object mutated
+        # (reclaimable pods shrink usage in place).
+        self.cq_usage: dict[str, dict] = {}  # cq -> FlavorResource -> int
+        self.cq_workloads: dict[str, dict[str, WorkloadInfo]] = {}
+        # flavor -> domain values tuple -> {resource: total}
+        self.tas_usage_agg: dict[str, dict[tuple, dict[str, int]]] = {}
+        self._wl_usage: dict[str, tuple] = {}  # key -> (cq, usage dict)
+        self._wl_tas: dict[str, list] = {}  # key -> tas_domains tuples
         # workload_info.InfoOptions, set by the engine.
         self.info_options = None
         # Hook returning the set of defined AdmissionCheck names
@@ -46,10 +58,20 @@ class Cache:
     # -- object lifecycle --
 
     def add_or_update_cluster_queue(self, cq: ClusterQueue) -> None:
+        is_new = cq.name not in self.cluster_queues
         self.cluster_queues[cq.name] = cq
+        if is_new:
+            # Workloads admitted while their CQ was absent were excluded
+            # from the aggregates (_account guards on CQ liveness).
+            self.rebuild_accounting()
 
     def delete_cluster_queue(self, name: str) -> None:
-        self.cluster_queues.pop(name, None)
+        if self.cluster_queues.pop(name, None) is not None:
+            # Drop the deleted CQ's contributions — TAS aggregates are
+            # flavor-keyed, so without this its still-registered
+            # workloads would keep occupying shared topology leaves that
+            # the from-scratch encoder (which filters by live CQs) frees.
+            self.rebuild_accounting()
 
     def add_or_update_cohort(self, cohort: Cohort) -> None:
         self.cohorts[cohort.name] = cohort
@@ -61,12 +83,17 @@ class Cache:
         self._tas_protos = None
 
     def add_or_update_resource_flavor(self, rf: ResourceFlavor) -> None:
+        was_tas = self._tas_flavor_names()
         self.resource_flavors[rf.name] = rf
         self._invalidate_tas_prototypes()
+        if was_tas != self._tas_flavor_names():
+            self.rebuild_accounting()
 
     def delete_resource_flavor(self, name: str) -> None:
-        self.resource_flavors.pop(name, None)
+        rf = self.resource_flavors.pop(name, None)
         self._invalidate_tas_prototypes()
+        if rf is not None and rf.topology_name:
+            self.rebuild_accounting()
 
     def add_or_update_topology(self, topology) -> None:
         self.topologies[topology.name] = topology
@@ -123,6 +150,73 @@ class Cache:
 
     # -- workloads (cache.go:766 AddOrUpdateWorkload / assume) --
 
+    def _tas_flavor_names(self) -> set:
+        return {rf.name for rf in self.resource_flavors.values()
+                if rf.topology_name}
+
+    def _account(self, key: str, info: WorkloadInfo) -> None:
+        if info.cluster_queue not in self.cluster_queues:
+            # Mirrors the from-scratch encoder's live-CQ filter; the
+            # CQ-(re)add path rebuilds accounting to pick these up.
+            return
+        usage = info.usage()
+        cq_usage = self.cq_usage.setdefault(info.cluster_queue, {})
+        for fr, v in usage.items():
+            cq_usage[fr] = cq_usage.get(fr, 0) + v
+        self.cq_workloads.setdefault(info.cluster_queue, {})[key] = info
+        tas = info.tas_domains(self._tas_flavor_names())
+        for flavor, values, single, count in tas:
+            by_values = self.tas_usage_agg.setdefault(flavor, {})
+            totals = by_values.setdefault(values, {})
+            for res, per_pod in single.items():
+                totals[res] = totals.get(res, 0) + per_pod * count
+            # Pod slots (tas_flavor_snapshot.go:321).
+            totals["pods"] = totals.get("pods", 0) + count
+        self._wl_usage[key] = (info.cluster_queue, usage)
+        self._wl_tas[key] = tas
+
+    def _unaccount(self, key: str) -> None:
+        entry = self._wl_usage.pop(key, None)
+        if entry is not None:
+            cq_name, usage = entry
+            cq_usage = self.cq_usage.get(cq_name, {})
+            for fr, v in usage.items():
+                left = cq_usage.get(fr, 0) - v
+                if left:
+                    cq_usage[fr] = left
+                else:
+                    cq_usage.pop(fr, None)
+            wls = self.cq_workloads.get(cq_name)
+            if wls is not None:
+                wls.pop(key, None)
+        for flavor, values, single, count in self._wl_tas.pop(key, ()):
+            totals = self.tas_usage_agg.get(flavor, {}).get(values)
+            if totals is None:
+                continue
+            for res, per_pod in single.items():
+                left = totals.get(res, 0) - per_pod * count
+                if left:
+                    totals[res] = left
+                else:
+                    totals.pop(res, None)
+            left = totals.get("pods", 0) - count
+            if left:
+                totals["pods"] = left
+            else:
+                totals.pop("pods", None)
+
+    def rebuild_accounting(self) -> None:
+        """Recompute the incremental aggregates from the workload
+        registry — the recovery path after flavor/topology registry
+        changes reclassify which flavors are TAS."""
+        self.cq_usage = {}
+        self.cq_workloads = {}
+        self.tas_usage_agg = {}
+        self._wl_usage = {}
+        self._wl_tas = {}
+        for key, info in self.workloads.items():
+            self._account(key, info)
+
     def add_or_update_workload(self, wl: Workload) -> bool:
         if wl.status.admission is None:
             return False
@@ -131,10 +225,13 @@ class Cache:
                                           options=self.info_options)
         if info.cluster_queue not in self.cluster_queues:
             return False
+        self._unaccount(wl.key)
         self.workloads[wl.key] = info
+        self._account(wl.key, info)
         return True
 
     def delete_workload(self, key: str) -> bool:
+        self._unaccount(key)
         return self.workloads.pop(key, None) is not None
 
     def is_assumed(self, key: str) -> bool:
@@ -143,13 +240,10 @@ class Cache:
     # -- status / metrics inputs --
 
     def usage_for_cq(self, name: str):
-        snap = self.snapshot()
-        cq = snap.cluster_queue(name)
-        return dict(cq.node.usage) if cq else {}
+        return dict(self.cq_usage.get(name, {}))
 
     def admitted_count(self, name: str) -> int:
-        return sum(1 for w in self.workloads.values()
-                   if w.cluster_queue == name)
+        return len(self.cq_workloads.get(name, {}))
 
     # -- snapshot (cache.go Snapshot / snapshot.go:161) --
 
@@ -198,10 +292,12 @@ class Cache:
             list(self.cluster_queues.values()),
             list(self.cohorts.values()),
             list(self.resource_flavors.values()),
-            [w for w in self.workloads.values()
-             if w.cluster_queue in self.cluster_queues],
+            None,
             inactive_cluster_queues=self.inactive_cluster_queues(),
             topologies=list(self.topologies.values()),
             nodes=list(self.nodes.values()),
             tas_prototypes=self.tas_prototypes(),
+            cq_usage=self.cq_usage,
+            cq_workloads=self.cq_workloads,
+            tas_usage_agg=self.tas_usage_agg,
         )

@@ -407,14 +407,25 @@ def build_snapshot(
     cluster_queues: list[ClusterQueue],
     cohorts: list[Cohort],
     resource_flavors: list[ResourceFlavor],
-    admitted_workloads: list[WorkloadInfo],
+    admitted_workloads: Optional[list[WorkloadInfo]],
     inactive_cluster_queues: Optional[set[str]] = None,
     topologies: Optional[list] = None,
     nodes: Optional[list] = None,
     tas_prototypes: Optional[dict] = None,
+    cq_usage: Optional[dict] = None,
+    cq_workloads: Optional[dict] = None,
+    tas_usage_agg: Optional[dict] = None,
 ) -> Snapshot:
     """Assemble a Snapshot and run the tree-resource accumulation
-    (resource_node.go:178 updateCohortTreeResources)."""
+    (resource_node.go:178 updateCohortTreeResources).
+
+    Two feeding modes: ``admitted_workloads`` replays every admitted
+    workload through add_workload (the from-scratch path used by tests
+    and the perf harness), while ``cq_usage``/``cq_workloads``/
+    ``tas_usage_agg`` install the live cache's incrementally-maintained
+    aggregates directly — O(ClusterQueues + distinct TAS domains)
+    instead of O(admitted workloads) per cycle (the reference's
+    Snapshot() clones its live usage the same way, snapshot.go:161)."""
     snap = Snapshot()
     snap.resource_flavors = {f.name: f for f in resource_flavors}
     snap.inactive_cluster_queues = set(inactive_cluster_queues or ())
@@ -470,6 +481,14 @@ def build_snapshot(
                 if fq.name in snap.tas_flavors:
                     cqs.tas_flavors[fq.name] = snap.tas_flavors[fq.name]
 
+    # Incremental mode: install the live cache's per-CQ usage BEFORE the
+    # bottom-up pass so cohort usage derives from it in the same sweep.
+    if cq_usage is not None:
+        for name, cqs in snap.cluster_queues.items():
+            usage = cq_usage.get(name)
+            if usage:
+                cqs.node.usage = dict(usage)
+
     # Bottom-up subtree quota accumulation from the roots.
     for cs in snap.cohorts.values():
         if cs.parent is None:
@@ -478,7 +497,20 @@ def build_snapshot(
         if cqs.parent is None:
             _update_cq_resource_node(cqs)
 
-    for info in admitted_workloads:
+    if cq_workloads is not None:
+        for name, cqs in snap.cluster_queues.items():
+            wls = cq_workloads.get(name)
+            if wls:
+                cqs.workloads = dict(wls)
+    if tas_usage_agg is not None:
+        for flavor, by_values in tas_usage_agg.items():
+            tas = snap.tas_flavors.get(flavor)
+            if tas is None:
+                continue
+            for values, totals in by_values.items():
+                if any(totals.values()):
+                    tas.install_usage(values, totals)
+    for info in admitted_workloads or ():
         snap.add_workload(info)
     return snap
 

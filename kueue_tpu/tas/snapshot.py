@@ -316,7 +316,10 @@ class TASFlavorSnapshot:
         self._usage_version = getattr(self, "_usage_version", 0) + 1
         for res, per_pod in requests.items():
             leaf.tas_usage[res] = leaf.tas_usage.get(res, 0) + per_pod * count
-        leaf.tas_usage["pods"] = leaf.tas_usage.get("pods", 0)
+        # Each placed pod occupies a pod slot regardless of its resource
+        # requests (tas_flavor_snapshot.go:321 updateTASUsage adds
+        # ResourcePods: count on top of the scaled requests).
+        leaf.tas_usage["pods"] = leaf.tas_usage.get("pods", 0) + count
 
     def remove_usage(self, values: tuple, requests: dict[str, int],
                      count: int) -> None:
@@ -326,6 +329,19 @@ class TASFlavorSnapshot:
         self._usage_version = getattr(self, "_usage_version", 0) + 1
         for res, per_pod in requests.items():
             leaf.tas_usage[res] = leaf.tas_usage.get(res, 0) - per_pod * count
+        leaf.tas_usage["pods"] = leaf.tas_usage.get("pods", 0) - count
+
+    def install_usage(self, values: tuple, usage: dict[str, int]) -> None:
+        """Add PRE-AGGREGATED usage (already scaled by pod counts, pods
+        slots included) to a leaf — the one-pass form the live cache's
+        incremental aggregates feed through build_snapshot."""
+        leaf = self.leaves.get(tuple(values))
+        if leaf is None:
+            return
+        self._usage_version = getattr(self, "_usage_version", 0) + 1
+        for res, v in usage.items():
+            leaf.tas_usage[res] = leaf.tas_usage.get(res, 0) + v
+        leaf.tas_usage.setdefault("pods", 0)
 
     def fits(self, domain_requests) -> bool:
         """clusterqueue_snapshot.go:137 TAS part: every requested domain has
@@ -486,16 +502,23 @@ class TASFlavorSnapshot:
         ({pod_set_name: assignment}, failure_reason).
 
         The device placement program (ops/tas.tas_place via
-        tas/device.py) is the serving path; this sequential
-        implementation below is the fallback and the differential-test
-        oracle (tests/test_tas_device.py)."""
+        tas/device.py) is the serving path for LARGE forests; this
+        sequential implementation below is the small-forest fast path,
+        the fallback, and the differential-test oracle
+        (tests/test_tas_device.py). Per-placement device dispatch costs
+        ~1-10ms regardless of problem size, so offload only wins once
+        the per-level domain count clears a threshold (measured: the
+        host path is ~2x faster at the reference's 640-node scale);
+        tas/device.py DEVICE_TAS_MIN_DOMAINS / KUEUE_TPU_DEVICE_TAS_MIN
+        set the crossover."""
         if features.enabled("DeviceTAS"):
             from kueue_tpu.tas import device
-            out = device.try_find(
-                self, workers, leader, simulate_empty, assumed_usage,
-                required_replacement_domain)
-            if out is not NotImplemented:
-                return out
+            if device.worth_offloading(self):
+                out = device.try_find(
+                    self, workers, leader, simulate_empty, assumed_usage,
+                    required_replacement_domain)
+                if out is not NotImplemented:
+                    return out
         return self.find_topology_assignments_host(
             workers, leader, simulate_empty, assumed_usage,
             required_replacement_domain)
@@ -804,7 +827,17 @@ class TASFlavorSnapshot:
                         state: _AssignState, simulate_empty: bool,
                         assumed_usage: dict,
                         required_replacement_domain: tuple = ()) -> None:
-        """fillInCounts :1750."""
+        """fillInCounts :1750. The no-leader case runs as numpy
+        reductions over the cached leaf matrices (tas/device.py
+        fill_in_counts_np — ~10x the per-leaf dict walk); leader
+        co-placement keeps the object walk (min-diff bubbling)."""
+        if leader_per_pod is None:
+            from kueue_tpu.tas import device
+            if device.fill_in_counts_np(
+                    self, pod_set, per_pod, state.slice_size,
+                    state.slice_level_idx, simulate_empty,
+                    assumed_usage or {}, required_replacement_domain):
+                return
         for d in self.domains.values():
             d.state = 0
             d.slice_state = 0

@@ -102,7 +102,6 @@ class WorkloadRowCache:
         # world-dependent columns (valid when row not dirty and the
         # bound signature matches)
         self._signature = None
-        self._num_resources = 1
         self.cq = np.full(self._cap, -1, np.int32)
         self.requests = np.zeros((self._cap, 1), np.int64)
         self.eligible = np.zeros(self._cap, bool)
@@ -250,7 +249,6 @@ class WorkloadRowCache:
         S = max(world.num_resources, 1)
         if S != self.requests.shape[1]:
             self.requests = np.zeros((self._cap, S), np.int64)
-            self._num_resources = S
         self._dirty.update(self._row_of.values())
 
     def _encode_row(self, i: int, world, cq_idx: dict,

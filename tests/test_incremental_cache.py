@@ -1,0 +1,165 @@
+"""Incremental admitted-side accounting: the live cache's aggregates
+(cq_usage / cq_workloads / tas_usage_agg) must produce snapshots
+identical to replaying every admitted workload through add_workload."""
+
+import random
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PodSetTopologyRequest,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Topology,
+    TopologyLevel,
+    TopologyMode,
+    Workload,
+)
+from kueue_tpu.cache.snapshot import build_snapshot
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.tas.snapshot import HOSTNAME_LABEL, Node
+
+
+def scratch_snapshot(cache):
+    """The round-1 from-scratch path, as the differential oracle."""
+    return build_snapshot(
+        list(cache.cluster_queues.values()),
+        list(cache.cohorts.values()),
+        list(cache.resource_flavors.values()),
+        [w for w in cache.workloads.values()
+         if w.cluster_queue in cache.cluster_queues],
+        inactive_cluster_queues=cache.inactive_cluster_queues(),
+        topologies=list(cache.topologies.values()),
+        nodes=list(cache.nodes.values()),
+        tas_prototypes=cache.tas_prototypes(),
+    )
+
+
+def assert_snapshots_match(cache):
+    inc = cache.snapshot()
+    ref = scratch_snapshot(cache)
+    assert set(inc.cluster_queues) == set(ref.cluster_queues)
+    for name, cqs in inc.cluster_queues.items():
+        refcq = ref.cluster_queues[name]
+        assert dict(cqs.node.usage) == dict(refcq.node.usage), name
+        assert set(cqs.workloads) == set(refcq.workloads), name
+    for name, cs in inc.cohorts.items():
+        assert dict(cs.node.usage) == dict(ref.cohorts[name].node.usage)
+        assert dict(cs.node.subtree_quota) == \
+            dict(ref.cohorts[name].node.subtree_quota)
+    for flavor, tas in inc.tas_flavors.items():
+        ref_tas = ref.tas_flavors[flavor]
+        for values, leaf in tas.leaves.items():
+            ref_usage = {r: v for r, v in
+                         ref_tas.leaves[values].tas_usage.items() if v}
+            got = {r: v for r, v in leaf.tas_usage.items() if v}
+            assert got == ref_usage, (flavor, values)
+
+
+def build_engine(with_tas=False):
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    if with_tas:
+        eng.create_topology(Topology("dc", (
+            TopologyLevel("rack"), TopologyLevel(HOSTNAME_LABEL))))
+        eng.create_resource_flavor(ResourceFlavor(name="tas",
+                                                 topology_name="dc"))
+        for r in range(2):
+            for h in range(4):
+                name = f"r{r}-h{h}"
+                eng.create_node(Node(
+                    name=name,
+                    labels={"rack": f"r{r}", HOSTNAME_LABEL: name},
+                    capacity={"cpu": 8000, "pods": 16}))
+    eng.create_cohort(Cohort("co"))
+    flavor = "tas" if with_tas else "default"
+    for i in range(3):
+        eng.create_cluster_queue(ClusterQueue(
+            name=f"cq{i}", cohort="co",
+            resource_groups=(ResourceGroup(
+                ("cpu",), (FlavorQuotas(
+                    flavor, {"cpu": ResourceQuota(16000)}),)),)))
+        eng.create_local_queue(LocalQueue(f"lq{i}", "default", f"cq{i}"))
+    return eng
+
+
+def test_incremental_matches_scratch_over_lifecycle():
+    eng = build_engine()
+    rng = random.Random(5)
+    wls = []
+    for i in range(30):
+        eng.clock += 0.01
+        wl = Workload(name=f"w{i}", queue_name=f"lq{rng.randrange(3)}",
+                      pod_sets=(PodSet("main", rng.choice([1, 2]),
+                                       {"cpu": 1000}),))
+        eng.submit(wl)
+        wls.append(wl)
+    for _ in range(40):
+        r = eng.schedule_once()
+        if r is None or not r.stats.admitted:
+            break
+    assert_snapshots_match(eng.cache)
+    # Finish some — removal must subtract exactly what was added.
+    for wl in wls[:10]:
+        if wl.is_admitted:
+            eng.finish(wl.key)
+    assert_snapshots_match(eng.cache)
+
+
+def test_incremental_matches_scratch_with_tas():
+    eng = build_engine(with_tas=True)
+    rng = random.Random(9)
+    for i in range(12):
+        eng.clock += 0.01
+        eng.submit(Workload(
+            name=f"t{i}", queue_name=f"lq{rng.randrange(3)}",
+            pod_sets=(PodSet(
+                "main", rng.choice([2, 4]), {"cpu": 1000},
+                topology_request=PodSetTopologyRequest(
+                    mode=TopologyMode.REQUIRED, level="rack")),)))
+    for _ in range(30):
+        r = eng.schedule_once()
+        if r is None or not r.stats.admitted:
+            break
+    assert any(eng.cache.tas_usage_agg.values())
+    assert_snapshots_match(eng.cache)
+
+
+def test_tas_usage_depletes_pod_slots():
+    """tas_flavor_snapshot.go:321: every placed pod occupies a pod slot
+    even when its resource requests alone would fit more pods."""
+    eng = build_engine(with_tas=True)
+    # 16-pod hosts; tiny cpu so pods is the binding constraint per host.
+    eng.submit(Workload(
+        name="big", queue_name="lq0",
+        pod_sets=(PodSet("main", 16, {"cpu": 1},
+                         topology_request=PodSetTopologyRequest(
+                             mode=TopologyMode.REQUIRED,
+                             level=HOSTNAME_LABEL)),)))
+    r = eng.schedule_once()
+    assert r.stats.admitted == 1
+    snap = eng.cache.snapshot()
+    tas = snap.tas_flavors["tas"]
+    used = [leaf for leaf in tas.leaves.values()
+            if leaf.tas_usage.get("pods")]
+    assert len(used) == 1 and used[0].tas_usage["pods"] == 16
+    # The host is pod-full: another 16-pod single-host gang must land on
+    # a DIFFERENT host (15 free slots nowhere near 16 on the used one).
+    eng.clock += 0.01
+    eng.submit(Workload(
+        name="second", queue_name="lq0",
+        pod_sets=(PodSet("main", 16, {"cpu": 1},
+                         topology_request=PodSetTopologyRequest(
+                             mode=TopologyMode.REQUIRED,
+                             level=HOSTNAME_LABEL)),)))
+    r2 = eng.schedule_once()
+    assert r2.stats.admitted == 1
+    snap2 = eng.cache.snapshot()
+    tas2 = snap2.tas_flavors["tas"]
+    full = [leaf.values for leaf in tas2.leaves.values()
+            if leaf.tas_usage.get("pods") == 16]
+    assert len(full) == 2, full

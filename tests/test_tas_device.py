@@ -180,6 +180,9 @@ def test_dispatch_serving_path_uses_device(monkeypatch):
         return orig(*a, **kw)
 
     monkeypatch.setattr(device, "try_find", spy)
+    # Small test forest: force the offload crossover down so the serving
+    # path actually dispatches to the device kernel.
+    monkeypatch.setenv("KUEUE_TPU_DEVICE_TAS_MIN", "0")
     got = snap.find_topology_assignments(workers)
     assert calls, "device path not taken"
     features.set_feature("DeviceTAS", False)
