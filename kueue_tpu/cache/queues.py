@@ -136,11 +136,14 @@ class PendingClusterQueue:
 
     def park(self, key: str) -> None:
         """Move an active pending workload to the inadmissible side map
-        (the oracle bridge's NoFit verdict application)."""
+        (the oracle bridge's NoFit verdict application). The heap entry
+        is left to lazy deletion — pop() discards entries whose key is
+        no longer live in ``items``, and a later re-activation's
+        push-or-update reuses the id — so bulk parking (whole
+        scheduling-equivalence classes at once) stays O(1) per row."""
         info = self.items.pop(key, None)
         if info is None:
             return
-        self._heap_remove(key)
         self.inadmissible[key] = info
         if self.manager is not None:
             self.manager.rows.on_park(info)
@@ -176,8 +179,8 @@ class PendingClusterQueue:
         h = scheduling_hash(info.obj, self.name)
         for key, other in list(self.items.items()):
             if scheduling_hash(other.obj, self.name) == h:
+                # Lazy heap deletion (see park()).
                 del self.items[key]
-                self._heap_remove(key)
                 self.inadmissible[key] = other
                 if self.manager is not None:
                     self.manager.rows.on_park(other)
@@ -333,15 +336,35 @@ class QueueManager:
         cq_name = self.cluster_queue_for_workload(wl)
         if cq_name is None or cq_name not in self.cluster_queues:
             return None
+        # One-ClusterQueue invariant: a LocalQueue retarget between
+        # pushes would otherwise leave the workload live in two pending
+        # heaps (and delete_workload's one-CQ fast path would miss one).
+        prev = self.rows.info_for(wl.key)
+        if prev is not None and prev.cluster_queue != cq_name:
+            old = self.cluster_queues.get(prev.cluster_queue)
+            if old is not None:
+                old.delete(wl.key)
         info = WorkloadInfo.from_workload(wl, cq_name,
                                           options=self.info_options)
         self.cluster_queues[cq_name].push_or_update(info)
         return info
 
     def delete_workload(self, wl: Workload) -> None:
-        for pcq in self.cluster_queues.values():
-            pcq.delete(wl.key)
-        self.second_pass.delete(wl.key)
+        """Drop a workload from the pending world. Fast path: its
+        LocalQueue mapping names the one ClusterQueue that can hold it;
+        the full sweep only runs when the mapping is stale (LQ retarget
+        between push and delete)."""
+        key = wl.key
+        cq_name = self.cluster_queue_for_workload(wl)
+        pcq = self.cluster_queues.get(cq_name) if cq_name else None
+        if pcq is not None and (key in pcq.items or key in pcq.inadmissible
+                                or pcq.in_flight == key):
+            pcq.delete(key)
+        else:
+            for pcq in self.cluster_queues.values():
+                pcq.delete(key)
+        self.rows.on_remove(key)
+        self.second_pass.delete(key)
 
     def requeue_workload(self, info: WorkloadInfo,
                          reason: RequeueReason) -> bool:

@@ -318,19 +318,26 @@ class WorkloadRowCache:
 
     # -- views --
 
+    def info_for(self, key: str) -> Optional[WorkloadInfo]:
+        """The WorkloadInfo currently holding this key's row (None when
+        the key has no row) — the queue manager uses it to keep the
+        one-ClusterQueue-per-pending-workload invariant."""
+        i = self._row_of.get(key)
+        return None if i is None else self.info_of[i]
+
     @property
     def num_rows(self) -> int:
         return self._cap
 
     def tensors(self, world):
-        """A WorkloadTensors over the full row space (flush first)."""
+        """A WorkloadTensors over the full row space (flush first).
+        ``keys`` stays empty — consumers hold ``info_of`` and a per-row
+        key list would cost O(rows) Python every cycle."""
         from kueue_tpu.tensor.schema import WorkloadTensors
 
         self.flush(world)
-        keys = [info.key if info is not None else "" for info in
-                self.info_of]
         return WorkloadTensors(
-            num_workloads=self._cap, keys=keys, cq=self.cq,
+            num_workloads=self._cap, keys=[], cq=self.cq,
             priority=self.priority, timestamp=self.timestamp,
             requests=self.requests, has_quota_reservation=self.has_qr,
             eligible=self.eligible, hash_id=self.hash_id)
