@@ -39,6 +39,9 @@ class Cache:
         # (reclaimable pods shrink usage in place).
         self.cq_usage: dict[str, dict] = {}  # cq -> FlavorResource -> int
         self.cq_workloads: dict[str, dict[str, WorkloadInfo]] = {}
+        # Bumped on every admitted-set change: consumers (the bridge's
+        # admitted-tensor cache) key their encodes on it.
+        self.admitted_version = 0
         # flavor -> domain values tuple -> {resource: total}
         self.tas_usage_agg: dict[str, dict[tuple, dict[str, int]]] = {}
         self._wl_usage: dict[str, tuple] = {}  # key -> (cq, usage dict)
@@ -214,6 +217,7 @@ class Cache:
         self.tas_usage_agg = {}
         self._wl_usage = {}
         self._wl_tas = {}
+        self.admitted_version += 1
         for key, info in self.workloads.items():
             self._account(key, info)
 
@@ -228,10 +232,12 @@ class Cache:
         self._unaccount(wl.key)
         self.workloads[wl.key] = info
         self._account(wl.key, info)
+        self.admitted_version += 1
         return True
 
     def delete_workload(self, key: str) -> bool:
         self._unaccount(key)
+        self.admitted_version += 1
         return self.workloads.pop(key, None) is not None
 
     def is_assumed(self, key: str) -> bool:

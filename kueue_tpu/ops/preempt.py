@@ -211,8 +211,7 @@ def within_cq_targets(
         slot_fr, slot_req, wcq_policy)
 
 
-@partial(jax.jit, static_argnames=("depth", "v_cap"))
-def classical_targets(
+def classical_targets_impl(
     slot_need,  # bool[C] head needs preemption on this slot
     slot_pri,  # int64[C] preemptor effective priority
     slot_ts,  # float64[C] preemptor creation time
@@ -546,8 +545,16 @@ def classical_targets(
         target_mask = jnp.zeros((A,), bool).at[
             jnp.where(taken, v_ids, A)].set(True, mode="drop")
         return (found, overflow, target_mask,
-                jnp.sum(taken.astype(jnp.int32)), variant, borrow_after)
+                jnp.sum(taken.astype(jnp.int32)), variant, borrow_after,
+                v_ids, taken)
 
     return jax.vmap(per_slot)(
         jnp.arange(C, dtype=jnp.int32), slot_need, slot_pri, slot_ts,
         slot_fr, slot_req)
+
+
+@partial(jax.jit, static_argnames=("depth", "v_cap"))
+def classical_targets(*args, depth: int, v_cap: int):
+    """Jitted standalone form (the oracle-service op): drops the packed
+    per-slot victim lists that only the fused cycle kernel consumes."""
+    return classical_targets_impl(*args, depth=depth, v_cap=v_cap)[:6]

@@ -93,9 +93,28 @@ def _cycle_core(
     slot_victim_vals=None,  # int64[C, V, R] victim usage rows
     slot_victim_ids=None,  # int32[C, V] admitted ids (overlap rule)
     claimed0=None,  # bool[A] initially-claimed victims
+    # --- fused classical preemption (round 2): when the admitted
+    # tensors + policy config are provided, preempt-flagged slots get
+    # their victim sets selected INSIDE this program
+    # (ops/preempt.classical_targets_impl against the cycle-start
+    # usage — identical semantics to the former second launch, minus
+    # two host round-trips per preempting cycle) ---
+    adm_cq=None,  # int32[A]
+    adm_pri=None,  # int64[A]
+    adm_ts=None,  # float64[A]
+    adm_qrt=None,  # float64[A]
+    adm_uid=None,  # int64[A]
+    adm_evicted=None,  # bool[A]
+    adm_usage=None,  # int64[A, R]
+    pc_wcq_policy=None,  # int32[C]
+    pc_reclaim_policy=None,  # int32[C]
+    pc_bwc_forbidden=None,  # bool[C]
+    pc_bwc_threshold=None,  # int64[C]
+    pc_cq_has_parent=None,  # bool[C]
+    root_of_cq=None,  # int32[C]
     *,
     depth: int, num_resources: int, num_cqs: int,
-    fair_mode: bool = False, num_flavors: int = 1,
+    fair_mode: bool = False, num_flavors: int = 1, v_cap: int = 32,
 ):
     W = pending.shape[0]
     C = num_cqs
@@ -163,6 +182,72 @@ def _cycle_core(
     # derived from CQ rows; the raw carry may predate aggregation).
     # Root-grouped: subtrees commit independently (ops/commit.py).
     full_usage = derived["usage"]
+
+    # --- fused classical preemption target selection ---
+    slot_overflow = jnp.zeros((C,), bool)
+    victim_mask = jnp.zeros((C, 0), bool)
+    victim_variant = jnp.zeros((C, 0), jnp.int32)
+    fused_preempt = jnp.zeros((C,), bool)
+    if adm_cq is not None and not fair_mode:
+        from kueue_tpu.ops import preempt as pops
+
+        h_pri = jnp.where(slot_valid, wl_priority[h_safe], 0)
+        h_ts = jnp.where(slot_valid, wl_ts[h_safe], 0.0)
+        (pfound, poverflow, victim_mask, _pn, victim_variant, pborrow,
+         pv_ids, ptaken) = pops.classical_targets_impl(
+            slot_oracle, h_pri, h_ts, usage_fr, h_req,
+            pc_wcq_policy, pc_reclaim_policy, pc_bwc_forbidden,
+            pc_bwc_threshold, pc_cq_has_parent,
+            adm_cq, adm_pri, adm_ts, adm_qrt, adm_uid, adm_evicted,
+            adm_usage, full_usage, derived["subtree_quota"], lend_limit,
+            borrow_limit, nominal, ancestors, height, local_chain,
+            root_nodes, root_of_cq, depth=depth, v_cap=v_cap)
+        pfound = pfound & slot_oracle
+        fused_preempt = pfound
+        slot_overflow = poverflow & slot_oracle
+        no_cand = slot_oracle & ~pfound & ~slot_overflow
+        kind = jnp.where(
+            pfound, cops.ENTRY_PREEMPT,
+            jnp.where(slot_overflow, cops.ENTRY_SKIP,
+                      jnp.where(no_cand,
+                                jnp.where(can_always_reclaim[h_cq],
+                                          cops.ENTRY_SKIP,
+                                          cops.ENTRY_RESERVE),
+                                kind)))
+        borrows = jnp.where(pfound, pborrow, borrows)
+        # Pack per-slot victims to v_cap columns for the commit kernel.
+        V = pv_ids.shape[1]
+        R = adm_usage.shape[1]
+        pv_safe = jnp.maximum(pv_ids, 0)
+        f_row = jnp.where(
+            ptaken & pfound[:, None],
+            local_chain[jnp.maximum(adm_cq[pv_safe], 0), 0], -1)
+        f_vals = jnp.where((ptaken & pfound[:, None])[:, :, None],
+                           adm_usage[pv_safe], 0)
+        f_ids = jnp.where(ptaken & pfound[:, None], pv_safe, -1)
+        if V < v_cap:
+            pad = v_cap - V
+            f_row = jnp.concatenate(
+                [f_row, jnp.full((C, pad), -1, f_row.dtype)], axis=1)
+            f_vals = jnp.concatenate(
+                [f_vals, jnp.zeros((C, pad, R), f_vals.dtype)], axis=1)
+            f_ids = jnp.concatenate(
+                [f_ids, jnp.full((C, pad), -1, f_ids.dtype)], axis=1)
+        if slot_victim_row is None:
+            slot_victim_row, slot_victim_vals, slot_victim_ids = \
+                f_row, f_vals, f_ids
+        else:
+            m = pfound[:, None]
+            slot_victim_row = jnp.where(m, f_row, slot_victim_row)
+            slot_victim_vals = jnp.where(m[:, :, None], f_vals,
+                                         slot_victim_vals)
+            slot_victim_ids = jnp.where(m, f_ids, slot_victim_ids)
+        if claimed0 is None:
+            claimed0 = jnp.zeros((adm_cq.shape[0],), bool)
+        # Every flagged slot is decided in-program; overflow slots are
+        # reported separately for host-root demotion.
+        needs_oracle = needs_oracle & jnp.zeros((C,), bool)
+        slot_oracle = slot_oracle & jnp.zeros((C,), bool)
     if fair_mode:
         # 4f/5f. Fair-sharing tournament ordering fused with the commit
         # (fair_sharing_iterator.go:47): per-root DRS recomputation after
@@ -206,7 +291,12 @@ def _cycle_core(
     # PREEMPT-overridden slots never park: with targets they are
     # PREEMPTING (plain requeue awaiting evictions); a failed commit fit
     # is a SKIPPED entry (plain requeue) in the reference.
-    preempt_override = overridden & (kind == cops.ENTRY_PREEMPT)
+    # PREEMPT verdicts — host-override or fused in-program selection —
+    # never park: with targets the entry is PREEMPTING (plain requeue
+    # awaiting evictions), and its scheduling-equivalence siblings must
+    # not be swept into the inadmissible map with it.
+    preempt_override = (overridden | fused_preempt) \
+        & (kind == cops.ENTRY_PREEMPT)
     parked_slot = slot_valid & ~slot_admitted & best_effort[h_cq] & (
         (pmode == aops.P_NO_FIT) | (pmode == aops.P_NO_CANDIDATES)) \
         & ~preempt_override
@@ -235,7 +325,8 @@ def _cycle_core(
     any_needs_oracle = jnp.any(slot_oracle)
     return (new_pending, new_inadmissible, usage_clean, wl_admitted,
             slot_admitted, slot_position, flavor_of_res, any_needs_oracle,
-            slot_oracle, slot_preempting, head_idx)
+            slot_oracle, slot_preempting, head_idx, slot_overflow,
+            victim_mask, victim_variant)
 
 
 cycle_step = partial(jax.jit,
@@ -298,8 +389,8 @@ def drain_loop(
          wl_flavor, oracle_flag) = state
         (pending, inadmissible, usage, wl_admitted, _slot_admitted,
          slot_position, flavor_of_res, any_oracle, _slot_oracle,
-         _slot_preempting, _head_idx) = step(
-            pending, inadmissible, usage)
+         _slot_preempting, _head_idx, _slot_overflow, _vmask,
+         _vvariant) = step(pending, inadmissible, usage)
         admit_cycle = jnp.where(wl_admitted, cycle, admit_cycle)
         admit_pos = jnp.where(wl_admitted, slot_position[wl_cq], admit_pos)
         wl_flavor = jnp.where(wl_admitted[:, None], flavor_of_res[wl_cq],

@@ -191,11 +191,48 @@ class OracleBridge:
                     cq_has_parent=cq_has_parent)
 
     def _encode_admitted(self, snapshot, w):
+        """Admitted tensors for the preemption kernels, cached by
+        (admitted-set version, world signature): steady-state cycles
+        with no admitted-set change skip the O(A) re-encode."""
+        from kueue_tpu.tensor.rowcache import WorkloadRowCache
         from kueue_tpu.tensor.schema import encode_admitted
 
+        key = (self.engine.cache.admitted_version,
+               WorkloadRowCache.world_signature(w))
+        cached = getattr(self, "_adm_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1], cached[2]
         admitted = [info for cqs in snapshot.cluster_queues.values()
                     for info in cqs.workloads.values()]
-        return admitted, encode_admitted(w, admitted, now=self.engine.clock)
+        adm = encode_admitted(w, admitted, now=self.engine.clock)
+        self._adm_cache = (key, admitted, adm)
+        return admitted, adm
+
+    def _adm_padded(self, adm) -> dict:
+        """Bucket-pad the admitted axis so churn cycles with a drifting
+        admitted count reuse one compiled program per bucket. Padded
+        rows have cq=-1 and zero usage, so they never classify as
+        candidates. Memoized per encoded-tensor object (the encode
+        itself is cached by admitted-set version)."""
+        from kueue_tpu.tensor.schema import pad_axis0, pow2_bucket
+
+        cached = getattr(self, "_adm_pad_cache", None)
+        if cached is not None and cached[0] is adm:
+            return cached[1]
+        A = adm.num_admitted
+        Ap = pow2_bucket(A, 8)
+        ap = dict(
+            adm_cq=pad_axis0(adm.cq, Ap, -1),
+            adm_pri=pad_axis0(adm.priority, Ap, 0),
+            adm_ts=pad_axis0(adm.timestamp, Ap, 0.0),
+            adm_qrt=pad_axis0(adm.qr_time, Ap, 0.0),
+            adm_uid=(np.concatenate(
+                [adm.uid_rank, np.arange(A, Ap, dtype=np.int64)])
+                if Ap != A else adm.uid_rank),
+            adm_ev=pad_axis0(adm.evicted, Ap, False),
+            adm_usage=pad_axis0(adm.usage, Ap, 0))
+        self._adm_pad_cache = (adm, ap)
+        return ap
 
     def _classical_call(self, w, adm, pcfg, usage, slot_need, slot_pri,
                         slot_ts, slot_fr, slot_req, v_cap=32,
@@ -209,23 +246,14 @@ class OracleBridge:
             return (np.zeros(C, bool), np.zeros(C, bool),
                     np.zeros((C, 0), bool), np.zeros((C, 0), np.int32),
                     np.zeros(C, np.int32))
-        # Bucket-pad the admitted axis so churn cycles with a drifting
-        # admitted count reuse one compiled program per bucket. Padded
-        # rows have cq=-1 and zero usage, so they never classify as
-        # candidates.
-        from kueue_tpu.tensor.schema import pad_axis0, pow2_bucket
-
-        A = adm.num_admitted
-        Ap = pow2_bucket(A, 8)
-        adm_cq = pad_axis0(adm.cq, Ap, -1)
-        adm_pri = pad_axis0(adm.priority, Ap, 0)
-        adm_ts = pad_axis0(adm.timestamp, Ap, 0.0)
-        adm_qrt = pad_axis0(adm.qr_time, Ap, 0.0)
-        adm_uid = np.concatenate(
-            [adm.uid_rank, np.arange(A, Ap, dtype=np.int64)]) \
-            if Ap != A else adm.uid_rank
-        adm_ev = pad_axis0(adm.evicted, Ap, False)
-        adm_usage = pad_axis0(adm.usage, Ap, 0)
+        ap = self._adm_padded(adm)
+        adm_cq = ap["adm_cq"]
+        adm_pri = ap["adm_pri"]
+        adm_ts = ap["adm_ts"]
+        adm_qrt = ap["adm_qrt"]
+        adm_uid = ap["adm_uid"]
+        adm_ev = ap["adm_ev"]
+        adm_usage = ap["adm_usage"]
         tensors = dict(
             slot_need=slot_need, slot_pri=slot_pri, slot_ts=slot_ts,
             slot_fr=slot_fr, slot_req=slot_req,
@@ -695,41 +723,70 @@ class OracleBridge:
                     slot_victim_vals=jnp.asarray(p_victims[1]),
                     slot_victim_ids=jnp.asarray(p_victims[2]),
                     claimed0=jnp.zeros(a_pad, bool))
+
+        # Fused classical preemption: with any preemption-enabled CQ in
+        # a classical world, ship the admitted tensors + policy config so
+        # preempt-flagged slots get their victim sets selected inside
+        # the cycle program — one launch instead of three.
+        fused = (not eng.cycle.enable_fair_sharing
+                 and bool(np.any(~w.no_preemption)))
+        if fused:
+            if pcfg is None:
+                pcfg = self._cq_policy_cfg(snapshot, w)
+            if adm is None:
+                admitted, adm = self._encode_admitted(snapshot, w)
+            ap = self._adm_padded(adm)
+            pre_kwargs.update(
+                adm_cq=ap["adm_cq"], adm_pri=ap["adm_pri"],
+                adm_ts=ap["adm_ts"], adm_qrt=ap["adm_qrt"],
+                adm_uid=ap["adm_uid"], adm_evicted=ap["adm_ev"],
+                adm_usage=ap["adm_usage"],
+                pc_wcq_policy=pcfg["wcq_policy"],
+                pc_reclaim_policy=pcfg["reclaim_policy"],
+                pc_bwc_forbidden=pcfg["bwc_forbidden"],
+                pc_bwc_threshold=pcfg["bwc_threshold"],
+                pc_cq_has_parent=pcfg["cq_has_parent"],
+                root_of_cq=jnp.asarray(w.root_of_cq))
         _t_encode = _time.perf_counter()
         out = self.executor.cycle_step(
             dict(pending=pending, inadmissible=inadmissible, usage=usage,
                  **args, **pre_kwargs), statics)
         (new_pending, new_inadmissible, usage2, wl_admitted, slot_admitted,
          slot_position, flavor_of_res, any_oracle, slot_oracle,
-         slot_preempting, head_idx) = out
+         slot_preempting, head_idx, slot_overflow, victim_mask,
+         victim_variant) = out
 
+        if fused:
+            overflow = np.asarray(slot_overflow) & cq_on_device
+            if overflow.any():
+                # More victims than v_cap: the host preemptor owns those
+                # roots this cycle.
+                demote(overflow, "preemption-overflow")
+                cq_on_device = ~host_root[root_of_cq]
+            # Host-side Target lists for the preempting slots, from the
+            # in-program victim selection.
+            sp = np.asarray(slot_preempting)
+            if sp.any():
+                vmask = np.asarray(victim_mask)
+                vvar = np.asarray(victim_variant)
+                variant_reason = self._variant_reason()
+                from kueue_tpu.scheduler.preemption import IN_CLUSTER_QUEUE
+                for ci in np.nonzero(sp & cq_on_device)[0]:
+                    if int(ci) in preempt_targets:
+                        continue  # sim-nomination slot (host-built)
+                    preempt_targets[int(ci)] = [
+                        (admitted[v],
+                         variant_reason.get(int(vvar[ci, v]),
+                                            IN_CLUSTER_QUEUE))
+                        for v in np.nonzero(vmask[ci])[0]]
         if bool(any_oracle):
             flagged = np.asarray(slot_oracle)
             if eng.cycle.enable_fair_sharing:
                 # Fair-sharing preemption strategies stay host-side.
                 demote(flagged, "preemption-scope")
                 cq_on_device = ~host_root[root_of_cq]
-            in_scope = flagged & cq_on_device
-            if in_scope.any():
-                if pcfg is None:
-                    pcfg = self._cq_policy_cfg(snapshot, w)
-                if adm is None:
-                    admitted, adm = self._encode_admitted(snapshot, w)
-                res = self._device_preemption(
-                    w, wl, args, statics, pending,
-                    inadmissible, usage, in_scope, pcfg, adm, admitted,
-                    np.asarray(flavor_of_res), np.asarray(head_idx), pre)
-                out, second_targets, overflow = res
-                preempt_targets.update(second_targets)
-                (new_pending, new_inadmissible, usage2, wl_admitted,
-                 slot_admitted, slot_position, flavor_of_res, any_oracle,
-                 slot_oracle, slot_preempting, head_idx) = out
-                if overflow.any():
-                    # More victims than v_max: the host preemptor owns
-                    # those roots this cycle.
-                    demote(overflow, "preemption-overflow")
-                    cq_on_device = ~host_root[root_of_cq]
-            # Defensive: any slot still flagged must be on a host root.
+            # Defensive: any slot still flagged must be on a host root
+            # (classical worlds decide preemption in-program).
             still = np.asarray(slot_oracle) & cq_on_device
             if still.any():
                 demote(still, "unexpected-oracle-flag")
@@ -784,101 +841,6 @@ class OracleBridge:
                     st.preemption_skips[k] = \
                         st.preemption_skips.get(k, 0) + v
         return result
-
-    def _device_preemption(self, w, wls, args, statics, pending,
-                           inadmissible, usage, in_scope, pcfg, adm,
-                           admitted, flavor_of_res, head_idx, pre,
-                           v_cap: int = 32):
-        """Run classical preemption target selection on device
-        (ops/preempt.classical_targets — within-CQ, cross-CQ reclaim,
-        borrowWithinCohort) for the in-scope flagged slots, merge with
-        any sim-nomination overrides (``pre``), and re-run the cycle with
-        kind overrides + victim sets. Returns (outputs, targets_by_slot,
-        overflow bool[C]); overflow slots' roots must be handed to the
-        host preemptor by the caller."""
-        from kueue_tpu.ops import commit as cops
-
-        variant_reason = self._variant_reason()
-        C = w.num_cqs
-        S = w.num_resources
-        R = max(w.num_flavors, 1) * max(S, 1)
-        flagged = np.nonzero(in_scope)[0]
-
-        slot_need = np.zeros(C, bool)
-        slot_pri = np.zeros(C, np.int64)
-        slot_ts = np.zeros(C, np.float64)
-        slot_fr = np.full((C, S), -1, np.int32)
-        slot_req = np.zeros((C, S), np.int64)
-        for ci in flagged:
-            wid = head_idx[ci]
-            slot_need[ci] = True
-            slot_pri[ci] = wls.priority[wid]
-            slot_ts[ci] = wls.timestamp[wid]
-            # flavor_of_res holds flavor ids; the kernel addresses the
-            # dense flavor-resource grid (fr = flavor * S + resource).
-            slot_fr[ci] = np.where(flavor_of_res[ci] >= 0,
-                                   flavor_of_res[ci] * S + np.arange(S),
-                                   -1)
-            slot_req[ci] = wls.requests[wid]
-
-        found, overflow, mask, variant, borrow_after = \
-            self._classical_call(w, adm, pcfg, usage, slot_need, slot_pri,
-                                 slot_ts, slot_fr, slot_req, v_cap=v_cap)
-        found &= in_scope
-        overflow &= in_scope
-
-        # Start from the sim-nomination overrides, fill in the flagged
-        # slots (disjoint: overridden slots never flag needs_oracle).
-        V = v_cap
-        if pre is not None:
-            p_override, p_borrows, p_flavor, p_victims, _pt = pre
-            override = p_override.copy()
-            borrows_override = p_borrows.copy()
-            flavor_override = p_flavor.copy()
-            if p_victims is not None:
-                victim_row = p_victims[0].copy()
-                victim_vals = p_victims[1].copy()
-                victim_ids = p_victims[2].copy()
-            else:
-                victim_row = np.full((C, V), -1, np.int32)
-                victim_vals = np.zeros((C, V, R), np.int64)
-                victim_ids = np.full((C, V), -1, np.int32)
-        else:
-            override = np.full(C, -1, np.int32)
-            borrows_override = np.full(C, -1, np.int32)
-            flavor_override = np.full((C, S), -1, np.int32)
-            victim_row = np.full((C, V), -1, np.int32)
-            victim_vals = np.zeros((C, V, R), np.int64)
-            victim_ids = np.full((C, V), -1, np.int32)
-        targets_by_slot: dict[int, list] = {}
-        for ci in flagged:
-            if overflow[ci]:
-                override[ci] = cops.ENTRY_SKIP  # root dropped by caller
-            elif found[ci]:
-                override[ci] = cops.ENTRY_PREEMPT
-                borrows_override[ci] = borrow_after[ci]
-                self._fill_victims(
-                    ci, np.nonzero(mask[ci])[0][:V], variant[ci],
-                    admitted, adm, w, victim_row, victim_vals, victim_ids,
-                    targets_by_slot, variant_reason)
-            else:
-                override[ci] = (cops.ENTRY_SKIP
-                                if w.can_always_reclaim[ci]
-                                else cops.ENTRY_RESERVE)
-
-        from kueue_tpu.tensor.schema import pow2_bucket
-        A_pad = pow2_bucket(adm.num_admitted, 8)
-        out = self.executor.cycle_step(
-            dict(pending=pending, inadmissible=inadmissible, usage=usage,
-                 **args,
-                 slot_kind_override=override,
-                 slot_borrows_override=borrows_override,
-                 slot_flavor_override=flavor_override,
-                 slot_victim_row=victim_row,
-                 slot_victim_vals=victim_vals,
-                 slot_victim_ids=victim_ids,
-                 claimed0=np.zeros(A_pad, bool)), statics)
-        return out, targets_by_slot, overflow
 
     def _apply(self, w, wls, pending_infos, wl_admitted, parked,
                slot_position, flavor_of_res, apply_rows=None,

@@ -183,3 +183,40 @@ def test_engine_device_preemption_under_mesh(mesh, monkeypatch):
                 for k, wl in sorted(eng.workloads.items())}
 
     assert state(seq) == state(bat)
+
+
+def test_sharded_single_cycle_parity(mesh):
+    """sharded_cycle_step (one cycle on the mesh) must match the
+    single-device cycle_step output for output."""
+    from kueue_tpu.oracle.batched import cycle_step
+    from kueue_tpu.parallel.sharding import sharded_cycle_step
+
+    scen = baseline_like(n_cohorts=4, cqs_per_cohort=4,
+                         n_workloads=64 * N_DEV, seed=5,
+                         sized_to_fit=False, nominal_per_cq=30_000)
+    snap = build_snapshot(scen.cluster_queues, scen.cohorts,
+                          scen.flavors, [])
+    solver = BatchedDrainSolver(snap, scen.pending_infos())
+    w = solver.world
+    prefix, tail = solver_mesh_args(solver, mesh)
+    step = sharded_cycle_step(mesh, depth=w.depth,
+                              num_resources=w.num_resources,
+                              num_cqs=w.num_cqs)
+    out_sharded = step(*prefix, *tail)
+    jax.block_until_ready(out_sharded)
+
+    args = solver._device_args()
+    import jax.numpy as jnp
+    pending = jnp.asarray(solver.wls.eligible & (solver.wls.cq >= 0))
+    inadmissible = jnp.zeros(solver.wls.num_workloads, bool)
+    usage = jnp.asarray(np.broadcast_to(
+        w.usage, (w.num_nodes, w.nominal.shape[1])).copy())
+    out_single = cycle_step(pending, inadmissible, usage, **args,
+                            depth=w.depth,
+                            num_resources=w.num_resources,
+                            num_cqs=w.num_cqs)
+    assert len(out_sharded) == len(out_single)
+    for i, (a, b) in enumerate(zip(out_sharded, out_single)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"output {i}")
+    assert int(np.asarray(out_single[3]).sum()) > 0
