@@ -237,8 +237,12 @@ class Cache:
 
     def delete_workload(self, key: str) -> bool:
         self._unaccount(key)
-        self.admitted_version += 1
-        return self.workloads.pop(key, None) is not None
+        removed = self.workloads.pop(key, None) is not None
+        if removed:
+            # Only an actual admitted-set change invalidates consumers'
+            # encodes (this is called for never-admitted keys too).
+            self.admitted_version += 1
+        return removed
 
     def is_assumed(self, key: str) -> bool:
         return key in self.workloads

@@ -197,8 +197,11 @@ class OracleBridge:
         from kueue_tpu.tensor.rowcache import WorkloadRowCache
         from kueue_tpu.tensor.schema import encode_admitted
 
+        # The admitted usage grid is laid out on flavor * S + resource
+        # columns, so the flavor index space is part of the key too.
         key = (self.engine.cache.admitted_version,
-               WorkloadRowCache.world_signature(w))
+               WorkloadRowCache.world_signature(w),
+               tuple(w.flavor_names))
         cached = getattr(self, "_adm_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1], cached[2]
@@ -219,6 +222,8 @@ class OracleBridge:
         cached = getattr(self, "_adm_pad_cache", None)
         if cached is not None and cached[0] is adm:
             return cached[1]
+        import jax.numpy as jnp
+
         A = adm.num_admitted
         Ap = pow2_bucket(A, 8)
         ap = dict(
@@ -231,6 +236,9 @@ class OracleBridge:
                 if Ap != A else adm.uid_rank),
             adm_ev=pad_axis0(adm.evicted, Ap, False),
             adm_usage=pad_axis0(adm.usage, Ap, 0))
+        # Device-resident: the encode is cached across cycles by
+        # admitted-set version, so transfer once, not per cycle.
+        ap = {k: jnp.asarray(v) for k, v in ap.items()}
         self._adm_pad_cache = (adm, ap)
         return ap
 

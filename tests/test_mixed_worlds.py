@@ -1,0 +1,182 @@
+"""Randomized MIXED-world differential suite: worlds that combine plain
+CQs, multi-flavor groups, TAS topologies, node selectors, multi-podset
+gangs, preemption policies, and priority churn in the same cohort forest
+must produce identical lifecycle outcomes on the hybrid device path and
+the sequential engine — with the device staying engaged (per-root
+partitioning, not whole-cycle fallback)."""
+
+import random
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.api.types import (  # noqa: E402
+    ClusterQueue,
+    ClusterQueuePreemption,
+    Cohort,
+    FairSharing,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PodSetTopologyRequest,
+    PreemptionPolicy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Topology,
+    TopologyLevel,
+    TopologyMode,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine  # noqa: E402
+from kueue_tpu.tas.snapshot import HOSTNAME_LABEL, Node  # noqa: E402
+
+
+def build_world(oracle: bool, seed: int, fair: bool = False):
+    """Roots of three characters in one engine: plain single-flavor,
+    multi-flavor (fungibility), and TAS-topology (host path)."""
+    rng = random.Random(seed)
+    eng = Engine(enable_fair_sharing=fair)
+    eng.create_resource_flavor(ResourceFlavor("on-demand"))
+    eng.create_resource_flavor(ResourceFlavor("spot"))
+    eng.create_topology(Topology("dc", (
+        TopologyLevel("rack"), TopologyLevel(HOSTNAME_LABEL))))
+    eng.create_resource_flavor(ResourceFlavor(name="tas",
+                                              topology_name="dc"))
+    for r in range(2):
+        for h in range(3):
+            name = f"r{r}-h{h}"
+            eng.create_node(Node(
+                name=name,
+                labels={"rack": f"r{r}", HOSTNAME_LABEL: name},
+                capacity={"cpu": 4000, "pods": 16}))
+
+    kinds = []
+    ci = 0
+    for root in range(3):
+        eng.create_cohort(Cohort(f"root{root}"))
+        kind = ("plain", "multiflavor", "tas")[root % 3]
+        for _ in range(rng.randrange(2, 4)):
+            name = f"cq{ci}"
+            nominal = rng.choice([2000, 3000])
+            if kind == "tas":
+                rgs = (ResourceGroup(("cpu",), (FlavorQuotas(
+                    "tas", {"cpu": ResourceQuota(nominal)}),)),)
+            elif kind == "multiflavor":
+                rgs = (ResourceGroup(("cpu",), (
+                    FlavorQuotas("on-demand",
+                                 {"cpu": ResourceQuota(nominal)}),
+                    FlavorQuotas("spot",
+                                 {"cpu": ResourceQuota(nominal)}),)),)
+            else:
+                rgs = (ResourceGroup(("cpu",), (FlavorQuotas(
+                    "on-demand", {"cpu": ResourceQuota(nominal)}),)),)
+            eng.create_cluster_queue(ClusterQueue(
+                name=name, cohort=f"root{root}",
+                fair_sharing=(FairSharing(weight=rng.choice([0.5, 1.0, 2.0]))
+                              if fair else None),
+                preemption=ClusterQueuePreemption(
+                    within_cluster_queue=rng.choice([
+                        PreemptionPolicy.NEVER,
+                        PreemptionPolicy.LOWER_PRIORITY]),
+                    reclaim_within_cohort=rng.choice([
+                        PreemptionPolicy.NEVER,
+                        PreemptionPolicy.LOWER_PRIORITY])),
+                resource_groups=rgs))
+            eng.create_local_queue(LocalQueue(f"lq{ci}", "default", name))
+            kinds.append(kind)
+            ci += 1
+    if oracle:
+        eng.attach_oracle()
+    return eng, kinds
+
+
+def submit_wave(eng, kinds, rng, wave, wls):
+    for _ in range(rng.randrange(5, 10)):
+        eng.clock += rng.random()
+        qi = rng.randrange(len(kinds))
+        kind = kinds[qi]
+        k = len(wls)
+        pri = rng.choice([0, 1, wave * 2])
+        if kind == "tas" and rng.random() < 0.8:
+            ps = PodSet("main", rng.choice([2, 4]), {"cpu": 500},
+                        topology_request=PodSetTopologyRequest(
+                            mode=rng.choice([TopologyMode.REQUIRED,
+                                             TopologyMode.PREFERRED]),
+                            level="rack"))
+        elif rng.random() < 0.15:
+            # multi-podset gang (host path head)
+            ps = None
+            wl = Workload(name=f"w{k}", queue_name=f"lq{qi}", priority=pri,
+                          pod_sets=(PodSet("driver", 1, {"cpu": 200}),
+                                    PodSet("exec", 2, {"cpu": 400})))
+        elif rng.random() < 0.15:
+            ps = PodSet("main", 1, {"cpu": rng.choice([400, 800])},
+                        node_selector={"disk": "ssd"})
+        else:
+            ps = PodSet("main", 1, {"cpu": rng.choice([400, 800, 1600])})
+        if ps is not None:
+            wl = Workload(name=f"w{k}", queue_name=f"lq{qi}",
+                          priority=pri, pod_sets=(ps,))
+        eng.submit(wl)
+        wls.append(wl)
+
+
+def drain(eng, max_cycles=250):
+    for _ in range(max_cycles):
+        r = eng.schedule_once()
+        if r is None or (not r.assumed and not any(
+                e.status.value == "preempting" for e in r.entries)):
+            break
+
+
+def outcome(w):
+    if w.is_finished:
+        return ("finished",)
+    if w.is_admitted:
+        return ("admitted", w.status.admission.cluster_queue)
+    return ("pending", w.status.requeue_count)
+
+
+def run_lifecycle(eng, kinds, seed):
+    rng = random.Random(seed * 31 + 7)
+    wls = []
+    for wave in range(3):
+        submit_wave(eng, kinds, rng, wave, wls)
+        drain(eng)
+        live = [w for w in wls if w.is_admitted and not w.is_finished]
+        for w in live[::4]:
+            eng.clock += 0.01
+            eng.finish(w.key)
+        drain(eng)
+    return wls
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_mixed_world_outcomes_match(seed):
+    seq, kinds = build_world(False, seed)
+    bat, _ = build_world(True, seed)
+    seq_wls = run_lifecycle(seq, kinds, seed)
+    bat_wls = run_lifecycle(bat, kinds, seed)
+    assert [outcome(w) for w in seq_wls] == [outcome(w) for w in bat_wls]
+    # The device path must stay engaged: per-root partitioning means the
+    # plain/multiflavor roots run on device even while TAS/multi-podset
+    # heads demote their own roots.
+    assert bat.oracle.cycles_on_device > 0
+    # Whole-cycle fallbacks may only come from idle bookkeeping or from
+    # moments when ONLY flavor-unsafe (TAS) work remains pending
+    # ("world") — never from the mixed world per se.
+    bad = {k: v for k, v in bat.oracle.fallback_reasons.items()
+           if k not in ("idle-inadmissible", "all-host", "world")}
+    assert not bad, bad
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_mixed_world_fair_outcomes_match(seed):
+    seq, kinds = build_world(False, seed, fair=True)
+    bat, _ = build_world(True, seed, fair=True)
+    seq_wls = run_lifecycle(seq, kinds, seed)
+    bat_wls = run_lifecycle(bat, kinds, seed)
+    assert [outcome(w) for w in seq_wls] == [outcome(w) for w in bat_wls]
+    assert bat.oracle.cycles_on_device > 0
