@@ -236,9 +236,14 @@ class OracleBridge:
                 if Ap != A else adm.uid_rank),
             adm_ev=pad_axis0(adm.evicted, Ap, False),
             adm_usage=pad_axis0(adm.usage, Ap, 0))
-        # Device-resident: the encode is cached across cycles by
-        # admitted-set version, so transfer once, not per cycle.
-        ap = {k: jnp.asarray(v) for k, v in ap.items()}
+        # Device-resident for in-process execution: the encode is cached
+        # across cycles by admitted-set version, so transfer once. A
+        # RemoteExecutor serializes host-side — keep numpy there or
+        # every cycle would pay a device->host readback instead.
+        from kueue_tpu.oracle.service import LocalExecutor
+
+        if isinstance(self.executor, LocalExecutor):
+            ap = {k: jnp.asarray(v) for k, v in ap.items()}
         self._adm_pad_cache = (adm, ap)
         return ap
 

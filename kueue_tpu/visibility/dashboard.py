@@ -35,10 +35,25 @@ DASHBOARD_HTML = """<!DOCTYPE html>
 <table id="cqs"><thead><tr>
   <th>Name</th><th>Cohort</th><th>Pending</th><th>Admitted</th>
   <th>Usage</th><th>Active</th></tr></thead><tbody></tbody></table>
+<h2>Capacity (usage vs nominal)</h2>
+<table id="cap"><thead><tr>
+  <th>ClusterQueue</th><th>Flavor</th><th>Resource</th>
+  <th>Usage</th><th>Nominal</th><th></th><th>Borrowed</th>
+  </tr></thead><tbody></tbody></table>
+<h2>Cohorts</h2>
+<table id="cohorts"><thead><tr>
+  <th>Name</th><th>Parent</th><th>Weight</th><th>Children</th>
+  <th>Subtree quota</th><th>Usage</th></tr></thead><tbody></tbody></table>
 <h2>Workloads</h2>
 <table id="wls"><thead><tr>
   <th>Key</th><th>Queue</th><th>Status</th><th>Priority</th>
   <th>Position</th></tr></thead><tbody></tbody></table>
+<h2>Evictions</h2>
+<table id="ev"><thead><tr>
+  <th>ClusterQueue</th><th>Reason</th><th>Count</th>
+  </tr></thead><tbody></tbody></table>
+<h2>Oracle (device fast path)</h2>
+<div id="oracle" style="font-size:.85rem"></div>
 <script>
 async function getJSON(p) { const r = await fetch(p); return r.json(); }
 function fill(id, rows) {
@@ -93,6 +108,32 @@ async function refresh() {
         {text: w.status || "-", cls: "phase-" + (w.status || "")},
         w.priority ?? 0, positions[key] ?? "-"];
     }));
+    const cap = await getJSON("/capacity");
+    fill("#cap", cap.map(r => {
+      const pct = r.nominal > 0
+        ? Math.min(100, Math.round(100 * r.usage / r.nominal)) : 0;
+      const bar = "\\u2588".repeat(Math.round(pct / 10)).padEnd(10,
+        "\\u2591");
+      return [r.clusterQueue, r.flavor, r.resource, r.usage, r.nominal,
+        {text: bar + " " + pct + "%",
+         cls: r.borrowed > 0 ? "phase-Pending" : "phase-Admitted"},
+        r.borrowed];
+    }));
+    const cohorts = await getJSON("/cohorts");
+    fill("#cohorts", cohorts.map(c => [
+      c.name, c.parent || "-", c.fairWeight,
+      [...c.childCohorts, ...c.childCQs].join(", "),
+      JSON.stringify(c.subtreeQuota), JSON.stringify(c.usage)]));
+    const ev = await getJSON("/evictions");
+    fill("#ev", ev.map(r => [r.clusterQueue, r.reason, r.count]));
+    const o = await getJSON("/oracle");
+    document.getElementById("oracle").textContent = o.attached
+      ? `device cycles: ${o.cyclesOnDevice} (hybrid ${o.cyclesHybrid}, ` +
+        `fallback ${o.cyclesFallback}) | fallback reasons: ` +
+        JSON.stringify(o.fallbackReasons) + " | host roots: " +
+        JSON.stringify(o.hostRootReasons) + " | last phases: " +
+        JSON.stringify(o.lastCyclePhases)
+      : "oracle not attached (sequential mode)";
     document.getElementById("updated").textContent =
       "updated " + new Date().toLocaleTimeString();
   } catch (e) {

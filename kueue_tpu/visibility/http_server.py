@@ -12,7 +12,14 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlparse
 
-from kueue_tpu.visibility.server import VisibilityServer, dump_state
+from kueue_tpu.visibility.server import (
+    VisibilityServer,
+    capacity_summary,
+    cohort_tree,
+    dump_state,
+    eviction_summary,
+    oracle_stats,
+)
 
 
 def make_handler(engine):
@@ -21,6 +28,20 @@ def make_handler(engine):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):  # quiet
             pass
+
+        _view_cache: dict = {}
+
+        def _send_view(self, name: str, fn) -> None:
+            """Serve a live-state view; these iterate mutable engine
+            dicts from an HTTP thread, so a collision with the
+            scheduling thread serves the previous rendering instead of
+            failing the request (the /metrics race discipline)."""
+            try:
+                body = json.dumps(fn(engine))
+                Handler._view_cache[name] = body
+            except RuntimeError:
+                body = Handler._view_cache.get(name, "[]")
+            self._send(body)
 
         def _send(self, body: str, content_type="application/json",
                   code=200):
@@ -53,6 +74,14 @@ def make_handler(engine):
                 self._send('{"status":"ok"}')
             elif path == "/debug/dump":
                 self._send(json.dumps(dump_state(engine), indent=2))
+            elif path == "/capacity":
+                self._send_view("capacity", capacity_summary)
+            elif path == "/cohorts":
+                self._send_view("cohorts", cohort_tree)
+            elif path == "/evictions":
+                self._send_view("evictions", eviction_summary)
+            elif path == "/oracle":
+                self._send_view("oracle", oracle_stats)
             elif parts[:1] == ["clusterqueues"] and len(parts) == 1:
                 from kueue_tpu.cli.kueuectl import Kueuectl
                 self._send(json.dumps(

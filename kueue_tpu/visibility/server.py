@@ -67,6 +67,85 @@ class VisibilityServer:
                 if it.local_queue == lq_name and it.namespace == namespace]
 
 
+def capacity_summary(engine) -> list:
+    """Per CQ × flavor × resource: usage vs nominal (the KueueViz
+    capacity view; clusterqueue_controller.go:556 usage reporting)."""
+    from kueue_tpu.api.types import FlavorResource
+
+    rows = []
+    for name, cq in list(engine.cache.cluster_queues.items()):
+        usage = engine.cache.usage_for_cq(name)
+        for rg in cq.resource_groups:
+            for fq in rg.flavors:
+                for res, quota in fq.resources.items():
+                    u = usage.get(FlavorResource(fq.name, res), 0)
+                    rows.append({
+                        "clusterQueue": name, "cohort": cq.cohort,
+                        "flavor": fq.name, "resource": res,
+                        "usage": u, "nominal": quota.nominal,
+                        "borrowed": max(0, u - quota.nominal)})
+    return rows
+
+
+def cohort_tree(engine) -> list:
+    """The cohort forest with aggregated subtree quota/usage (the
+    cohort gauges of pkg/cache/scheduler/cohort_metrics.go, as JSON).
+    Building a full scheduler snapshot per poll would be wasteful —
+    the result is memoized by the admitted-set version and the
+    CQ/cohort registries."""
+    key = (engine.cache.admitted_version,
+           tuple(sorted(engine.cache.cohorts)),
+           tuple(sorted(engine.cache.cluster_queues)))
+    cached = getattr(engine, "_cohort_tree_cache", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    snap = engine.cache.snapshot()
+    out = []
+    for name, cs in sorted(snap.cohorts.items()):
+        out.append({
+            "name": name,
+            "parent": cs.parent.name if cs.parent is not None else None,
+            "fairWeight": cs.fair_weight,
+            "childCohorts": sorted(c.name for c in cs.child_cohorts),
+            "childCQs": sorted(c.name for c in cs.child_cqs),
+            "subtreeQuota": {f"{fr.flavor}/{fr.resource}": v
+                             for fr, v in cs.node.subtree_quota.items()},
+            "usage": {f"{fr.flavor}/{fr.resource}": v
+                      for fr, v in cs.node.usage.items()},
+        })
+    engine._cohort_tree_cache = (key, out)
+    return out
+
+
+def eviction_summary(engine) -> list:
+    """Evictions by ClusterQueue × reason (evicted_workloads_total).
+    Counter keys carry optional custom-label suffixes from CQ metadata —
+    aggregate over them so one (cq, reason) pair is one row."""
+    ctr = engine.registry.counter("evicted_workloads_total")
+    agg: dict[tuple, float] = {}
+    for labels, v in list(ctr.values.items()):
+        key = (labels[0] if labels else "",
+               labels[1] if len(labels) > 1 else "")
+        agg[key] = agg.get(key, 0.0) + v
+    return [{"clusterQueue": cq, "reason": reason, "count": v}
+            for (cq, reason), v in sorted(agg.items())]
+
+
+def oracle_stats(engine) -> dict:
+    """Device fast-path diagnostics: cycle counts, fallback and
+    host-root demotion reasons, last-cycle phase split."""
+    b = engine.oracle
+    if b is None:
+        return {"attached": False}
+    return {"attached": True,
+            "cyclesOnDevice": b.cycles_on_device,
+            "cyclesFallback": b.cycles_fallback,
+            "cyclesHybrid": b.cycles_hybrid,
+            "fallbackReasons": dict(b.fallback_reasons),
+            "hostRootReasons": dict(b.host_root_reasons),
+            "lastCyclePhases": dict(engine.last_cycle_phases)}
+
+
 def dump_state(engine) -> dict:
     """pkg/debugger/debugger.go:42 — cache + queues dump for diagnostics."""
     queues = {}

@@ -64,6 +64,13 @@ def test_http_endpoints():
         assert [i["name"] for i in pend["items"]] == ["b"]
         dump = json.loads(get("/debug/dump"))
         assert "default/a" in dump["admitted"]
+        cap = json.loads(get("/capacity"))
+        row = next(r for r in cap if r["clusterQueue"] == "cq")
+        assert row["usage"] == 600 and row["nominal"] > 0
+        assert json.loads(get("/cohorts")) == []  # no cohorts here
+        assert json.loads(get("/evictions")) == []
+        assert json.loads(get("/oracle"))["attached"] is False
+        assert "Capacity" in get("/dashboard")
     finally:
         srv.stop()
 
