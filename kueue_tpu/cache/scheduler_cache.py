@@ -42,6 +42,8 @@ class Cache:
         # Bumped on every admitted-set change: consumers (the bridge's
         # admitted-tensor cache) key their encodes on it.
         self.admitted_version = 0
+        # Bumped on every CQ/cohort spec change (views memoize on it).
+        self.spec_version = 0
         # flavor -> domain values tuple -> {resource: total}
         self.tas_usage_agg: dict[str, dict[tuple, dict[str, int]]] = {}
         self._wl_usage: dict[str, tuple] = {}  # key -> (cq, usage dict)
@@ -63,12 +65,14 @@ class Cache:
     def add_or_update_cluster_queue(self, cq: ClusterQueue) -> None:
         is_new = cq.name not in self.cluster_queues
         self.cluster_queues[cq.name] = cq
+        self.spec_version += 1
         if is_new:
             # Workloads admitted while their CQ was absent were excluded
             # from the aggregates (_account guards on CQ liveness).
             self.rebuild_accounting()
 
     def delete_cluster_queue(self, name: str) -> None:
+        self.spec_version += 1
         if self.cluster_queues.pop(name, None) is not None:
             # Drop the deleted CQ's contributions — TAS aggregates are
             # flavor-keyed, so without this its still-registered
@@ -78,9 +82,11 @@ class Cache:
 
     def add_or_update_cohort(self, cohort: Cohort) -> None:
         self.cohorts[cohort.name] = cohort
+        self.spec_version += 1
 
     def delete_cohort(self, name: str) -> None:
         self.cohorts.pop(name, None)
+        self.spec_version += 1
 
     def _invalidate_tas_prototypes(self) -> None:
         self._tas_protos = None

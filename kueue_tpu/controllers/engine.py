@@ -1027,23 +1027,35 @@ class Engine:
                     cluster_queue=entry.info.cluster_queue,
                     detail=f"{reason.value}: {entry.inadmissible_msg}")
 
+    def _cohort_root_of(self, cohort_name: str) -> str:
+        """Root cohort of a (possibly implicit) cohort, from the live
+        registries — no snapshot needed."""
+        seen = set()
+        name = cohort_name
+        while name not in seen:
+            seen.add(name)
+            co = self.cache.cohorts.get(name)
+            if co is None or not co.parent:
+                return name
+            name = co.parent
+        return name  # defensive: cycle (webhooks reject these)
+
     def _requeue_cohort_inadmissible(self, cq_name: str) -> None:
         """Capacity freed: re-activate inadmissible workloads of the cohort
-        (manager.go QueueAssociatedInadmissibleWorkloadsAfter)."""
+        (manager.go QueueAssociatedInadmissibleWorkloadsAfter). Computed
+        from the live registries — building a full snapshot per eviction
+        was the preemption-churn hot spot."""
         cq = self.cache.cluster_queues.get(cq_name)
         if cq is None:
             return
         if cq.cohort is None:
             self.queues.queue_inadmissible_workloads({cq_name})
             return
-        # All CQs sharing the cohort forest root.
-        snap = self.cache.snapshot()
-        cqs = snap.cluster_queue(cq_name)
-        if cqs is None or not cqs.has_parent():
-            self.queues.queue_inadmissible_workloads({cq_name})
-            return
-        root = cqs.parent.root()
-        names = {c.name for c in root.subtree_cluster_queues()}
+        root = self._cohort_root_of(cq.cohort)
+        names = {name for name, c in self.cache.cluster_queues.items()
+                 if c.cohort is not None
+                 and self._cohort_root_of(c.cohort) == root}
+        names.add(cq_name)
         self.queues.queue_inadmissible_workloads(names)
 
     def _event(self, kind: str, workload: str, cluster_queue: str = "",

@@ -31,16 +31,17 @@ def make_handler(engine):
 
         _view_cache: dict = {}
 
-        def _send_view(self, name: str, fn) -> None:
+        def _send_view(self, name: str, fn, empty: str = "[]") -> None:
             """Serve a live-state view; these iterate mutable engine
             dicts from an HTTP thread, so a collision with the
             scheduling thread serves the previous rendering instead of
-            failing the request (the /metrics race discipline)."""
+            failing the request (the /metrics race discipline).
+            ``empty`` must match the view's JSON shape."""
             try:
                 body = json.dumps(fn(engine))
                 Handler._view_cache[name] = body
             except RuntimeError:
-                body = Handler._view_cache.get(name, "[]")
+                body = Handler._view_cache.get(name, empty)
             self._send(body)
 
         def _send(self, body: str, content_type="application/json",
@@ -81,7 +82,8 @@ def make_handler(engine):
             elif path == "/evictions":
                 self._send_view("evictions", eviction_summary)
             elif path == "/oracle":
-                self._send_view("oracle", oracle_stats)
+                self._send_view("oracle", oracle_stats,
+                                empty='{"attached": false}')
             elif parts[:1] == ["clusterqueues"] and len(parts) == 1:
                 from kueue_tpu.cli.kueuectl import Kueuectl
                 self._send(json.dumps(

@@ -28,6 +28,13 @@ class PendingWorkloadsSummary:
     items: list
 
 
+# View memo lives module-side (keyed weakly by engine) so the Engine
+# carries no visibility-owned attributes.
+import weakref  # noqa: E402
+
+_cohort_tree_memo: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
 class VisibilityServer:
     def __init__(self, engine):
         self.engine = engine
@@ -91,12 +98,9 @@ def cohort_tree(engine) -> list:
     """The cohort forest with aggregated subtree quota/usage (the
     cohort gauges of pkg/cache/scheduler/cohort_metrics.go, as JSON).
     Building a full scheduler snapshot per poll would be wasteful —
-    the result is memoized by the admitted-set version and the
-    CQ/cohort registries."""
-    key = (engine.cache.admitted_version,
-           tuple(sorted(engine.cache.cohorts)),
-           tuple(sorted(engine.cache.cluster_queues)))
-    cached = getattr(engine, "_cohort_tree_cache", None)
+    the result is memoized by the admitted-set and spec versions."""
+    key = (engine.cache.admitted_version, engine.cache.spec_version)
+    cached = _cohort_tree_memo.get(engine)
     if cached is not None and cached[0] == key:
         return cached[1]
     snap = engine.cache.snapshot()
@@ -113,7 +117,7 @@ def cohort_tree(engine) -> list:
             "usage": {f"{fr.flavor}/{fr.resource}": v
                       for fr, v in cs.node.usage.items()},
         })
-    engine._cohort_tree_cache = (key, out)
+    _cohort_tree_memo[engine] = (key, out)
     return out
 
 
