@@ -64,16 +64,19 @@ class Cache:
 
     def add_or_update_cluster_queue(self, cq: ClusterQueue) -> None:
         is_new = cq.name not in self.cluster_queues
+        if self.cluster_queues.get(cq.name) is not cq:
+            # Identity check keeps no-op resyncs of the same object from
+            # invalidating spec-keyed memos (world tensors, views).
+            self.spec_version += 1
         self.cluster_queues[cq.name] = cq
-        self.spec_version += 1
         if is_new:
             # Workloads admitted while their CQ was absent were excluded
             # from the aggregates (_account guards on CQ liveness).
             self.rebuild_accounting()
 
     def delete_cluster_queue(self, name: str) -> None:
-        self.spec_version += 1
         if self.cluster_queues.pop(name, None) is not None:
+            self.spec_version += 1
             # Drop the deleted CQ's contributions — TAS aggregates are
             # flavor-keyed, so without this its still-registered
             # workloads would keep occupying shared topology leaves that
@@ -81,12 +84,13 @@ class Cache:
             self.rebuild_accounting()
 
     def add_or_update_cohort(self, cohort: Cohort) -> None:
+        if self.cohorts.get(cohort.name) is not cohort:
+            self.spec_version += 1
         self.cohorts[cohort.name] = cohort
-        self.spec_version += 1
 
     def delete_cohort(self, name: str) -> None:
-        self.cohorts.pop(name, None)
-        self.spec_version += 1
+        if self.cohorts.pop(name, None) is not None:
+            self.spec_version += 1
 
     def _invalidate_tas_prototypes(self) -> None:
         self._tas_protos = None
@@ -94,22 +98,26 @@ class Cache:
     def add_or_update_resource_flavor(self, rf: ResourceFlavor) -> None:
         was_tas = self._tas_flavor_names()
         self.resource_flavors[rf.name] = rf
+        self.spec_version += 1
         self._invalidate_tas_prototypes()
         if was_tas != self._tas_flavor_names():
             self.rebuild_accounting()
 
     def delete_resource_flavor(self, name: str) -> None:
         rf = self.resource_flavors.pop(name, None)
+        self.spec_version += 1
         self._invalidate_tas_prototypes()
         if rf is not None and rf.topology_name:
             self.rebuild_accounting()
 
     def add_or_update_topology(self, topology) -> None:
         self.topologies[topology.name] = topology
+        self.spec_version += 1
         self._invalidate_tas_prototypes()
 
     def delete_topology(self, name: str) -> None:
         self.topologies.pop(name, None)
+        self.spec_version += 1
         self._invalidate_tas_prototypes()
 
     def add_or_update_node(self, node) -> None:
@@ -227,12 +235,23 @@ class Cache:
         for key, info in self.workloads.items():
             self._account(key, info)
 
-    def add_or_update_workload(self, wl: Workload) -> bool:
+    def add_or_update_workload(self, wl: Workload,
+                               info: Optional[WorkloadInfo] = None) -> bool:
+        """``info``: reuse an already-derived WorkloadInfo (the
+        scheduler's entry info, with the admission applied) — deriving
+        one from scratch runs the whole effective-requests pipeline and
+        was the dominant per-admission cost at scale."""
         if wl.status.admission is None:
             return False
-        info = WorkloadInfo.from_workload(wl,
-                                          wl.status.admission.cluster_queue,
-                                          options=self.info_options)
+        if (info is None or info.obj is not wl
+                or info.cluster_queue != wl.status.admission.cluster_queue
+                or wl.status.reclaimable_pods):
+            # Reclaimable pods interleave with admission count scaling in
+            # a path-dependent way — re-derive so every accounting path
+            # agrees with the canonical from-scratch pipeline.
+            info = WorkloadInfo.from_workload(
+                wl, wl.status.admission.cluster_queue,
+                options=self.info_options)
         if info.cluster_queue not in self.cluster_queues:
             return False
         self._unaccount(wl.key)

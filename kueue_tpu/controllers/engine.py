@@ -810,7 +810,7 @@ class Engine:
                 wl.set_condition(ctype, False, reason="QuotaReserved",
                                  now=self.clock)
         entry.info.apply_admission(admission)
-        self.cache.add_or_update_workload(wl)
+        self.cache.add_or_update_workload(wl, info=entry.info)
         # The workload left the pending world: free its tensor row (the
         # pending heaps already dropped it at pop/delete time).
         self.queues.rows.on_remove(wl.key)
@@ -1048,13 +1048,12 @@ class Engine:
         cq = self.cache.cluster_queues.get(cq_name)
         if cq is None:
             return
-        if cq.cohort is None:
+        if not cq.cohort:  # None or "" — no cohort membership
             self.queues.queue_inadmissible_workloads({cq_name})
             return
         root = self._cohort_root_of(cq.cohort)
         names = {name for name, c in self.cache.cluster_queues.items()
-                 if c.cohort is not None
-                 and self._cohort_root_of(c.cohort) == root}
+                 if c.cohort and self._cohort_root_of(c.cohort) == root}
         names.add(cq_name)
         self.queues.queue_inadmissible_workloads(names)
 
