@@ -98,20 +98,26 @@ def engine_state(eng):
     }
 
 
-def test_kill_restart_preserves_state(tmp_path):
-    rng = random.Random(4)
-    eng = Engine()
-    build_world(eng, preemption=True)
-    attach_new_journal(eng, str(tmp_path / "journal.jsonl"))
-    for i in range(12):
+def submit_random(eng, rng, n, schedule_every):
+    """Shared randomized submit/schedule cadence for the restart suites
+    (one definition so every restart world stays identical in shape)."""
+    for i in range(n):
         eng.clock += 0.5
         eng.submit(Workload(
             name=f"w{i}", queue_name=f"lq{rng.randrange(3)}",
             priority=rng.choice([0, 5]),
             pod_sets=(PodSet("main", 1,
                              {"cpu": rng.choice([800, 1500])}),)))
-        if i % 3 == 2:
+        if i % schedule_every == schedule_every - 1:
             eng.schedule_once()
+
+
+def test_kill_restart_preserves_state(tmp_path):
+    rng = random.Random(4)
+    eng = Engine()
+    build_world(eng, preemption=True)
+    attach_new_journal(eng, str(tmp_path / "journal.jsonl"))
+    submit_random(eng, rng, 12, schedule_every=3)
     # One more cycle that issues preemptions and leaves them in flight
     # (victims evicted + requeued, preemptors still pending).
     eng.schedule_once()
@@ -448,3 +454,36 @@ def test_journal_records_are_versioned_and_upgraded(tmp_path):
             f.write(_json.dumps(r) + "\n")
     reb = rebuild_engine(str(legacy))
     assert "default/w" in reb.workloads
+
+
+def test_restart_then_oracle_fast_path(tmp_path):
+    """Cold-start from the journal, then attach the batched oracle: the
+    rebuilt queue manager's row cache and admitted aggregates must feed
+    device cycles that match a never-killed engine running the same
+    continuation sequentially."""
+    rng = random.Random(9)
+    eng = Engine()
+    build_world(eng, preemption=True)
+    attach_new_journal(eng, str(tmp_path / "j.jsonl"))
+    submit_random(eng, rng, 14, schedule_every=4)
+
+    reb = rebuild_engine(str(tmp_path / "j.jsonl"))
+    assert engine_state(reb) == engine_state(eng)
+    # The rebuilt pending world must be fully represented in the row
+    # cache (journal replay flows through the same queue hooks).
+    rows = reb.queues.rows
+    pending_keys = {k for pcq in reb.queues.cluster_queues.values()
+                    for k in list(pcq.items) + list(pcq.inadmissible)}
+    row_keys = {info.key for info in rows.info_of if info is not None}
+    assert pending_keys == row_keys
+
+    reb.attach_oracle()
+    for e in (eng, reb):
+        for _ in range(40):
+            r = e.schedule_once()
+            if r is None or (not r.assumed and not any(
+                    en.status.value == "preempting" for en in r.entries)):
+                break
+            e.tick(0.0)
+    assert engine_state(reb) == engine_state(eng)
+    assert reb.oracle.cycles_on_device > 0
