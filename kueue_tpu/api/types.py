@@ -329,6 +329,12 @@ class PodSet:
     min_count: Optional[int] = None  # partial admission lower bound
     topology_request: Optional[PodSetTopologyRequest] = None
     node_selector: dict[str, str] = field(default_factory=dict)
+    # requiredDuringSchedulingIgnoredDuringExecution node-affinity terms:
+    # ORed terms, each a tuple of (key, operator, values) requirements
+    # (operator in In|NotIn|Exists|DoesNotExist). The flavor assigner
+    # evaluates these against a flavor's nodeLabels with non-flavor keys
+    # ignored (flavorassigner.go:1146 flavorSelector).
+    node_affinity: tuple[tuple[tuple[str, str, tuple[str, ...]], ...], ...] = ()
     tolerations: tuple[Toleration, ...] = ()
     template: Optional[object] = None  # utils.podtemplate.PodTemplate
 

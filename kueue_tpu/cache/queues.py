@@ -48,8 +48,10 @@ def scheduling_hash(wl: Workload, cluster_queue: str) -> tuple:
         tuple(sorted(
             (ps.name, ps.count, tuple(sorted(ps.requests.items())),
              tuple(sorted(ps.node_selector.items())),
+             ps.node_affinity,
              ps.min_count,
-             (ps.topology_request.mode.value,
+             (ps.topology_request.mode.value
+              if ps.topology_request.mode is not None else None,
               ps.topology_request.level,
               ps.topology_request.slice_level,
               ps.topology_request.slice_size)

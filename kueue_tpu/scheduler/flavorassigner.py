@@ -235,8 +235,12 @@ def flavor_matches_podset(flavor, pod_set) -> Optional[str]:
     """Taint/selector eligibility (flavorassigner.go:1076
     checkFlavorForPodSets). Returns a reason string if ineligible."""
     # TAS match (tas_flavorassigner.go checkPodSetAndFlavorMatchForTAS):
-    # a pod set with an explicit topology request needs a TAS flavor.
-    if (pod_set.topology_request is not None
+    # a pod set with an explicit topology PLACEMENT request needs a TAS
+    # flavor. A topology request carrying only a pod-set group name (the
+    # LeaderWorkerSet co-assignment contract) places no TAS demand
+    # (mode is None in that encoding).
+    tr = pod_set.topology_request
+    if (tr is not None and getattr(tr, "mode", None) is not None
             and flavor.topology_name is None):
         return (f"Flavor {flavor.name} does not support "
                 "TopologyAwareScheduling")
@@ -251,7 +255,44 @@ def flavor_matches_podset(flavor, pod_set) -> Optional[str]:
     for key, val in pod_set.node_selector.items():
         if key in flavor.node_labels and flavor.node_labels[key] != val:
             return f"flavor {flavor.name} doesn't match node affinity"
+    # requiredDuringScheduling affinity: ORed terms; within a term,
+    # expressions whose key is not one of the flavor's own labels are
+    # ignored (so a term of only foreign keys matches any flavor).
+    if pod_set.node_affinity:
+        if not any(_affinity_term_matches(term, flavor.node_labels)
+                   for term in pod_set.node_affinity):
+            return f"flavor {flavor.name} doesn't match node affinity"
     return None
+
+
+def _affinity_term_matches(term, labels: dict) -> bool:
+    for key, op, values in term:
+        if key not in labels:
+            continue  # foreign key: restricted selector ignores it
+        val = labels[key]
+        if op == "In":
+            if val not in values:
+                return False
+        elif op == "NotIn":
+            if val in values:
+                return False
+        elif op == "DoesNotExist":
+            return False
+        elif op == "Exists":
+            pass  # key present — satisfied
+        elif op in ("Gt", "Lt"):
+            # k8s numeric comparison: single integer value.
+            try:
+                lv, rv = int(val), int(values[0])
+            except (ValueError, IndexError):
+                return False
+            if op == "Gt" and not lv > rv:
+                return False
+            if op == "Lt" and not lv < rv:
+                return False
+        else:
+            return False  # unknown operator never matches
+    return True
 
 
 class FlavorAssigner:
@@ -330,11 +371,16 @@ class FlavorAssigner:
                     continue  # same resource group already assigned
                 flavors, reasons, ok = self._find_flavor_for_podsets(
                     ps_ids, group_requests, res, assignment.usage)
-                group_reasons.extend(reasons)
                 if not ok:
+                    # A failed search REPLACES the accumulated status —
+                    # only the failing resource's reasons survive
+                    # (flavorassigner.go:766-771: psAssignment.Flavors =
+                    # nil; psAssignment.Status = status; break).
                     group_flavors = {}
+                    group_reasons = reasons
                     group_failed = True
                     break
+                group_reasons.extend(reasons)
                 group_flavors.update(flavors)
 
             for i in ps_ids:
@@ -346,7 +392,14 @@ class FlavorAssigner:
                     if res in requests[i].requests}
                 psa.reasons = list(group_reasons)
                 self._append(assignment, requests[i], psa)
-                if group_failed or (requests[i].requests and not psa.flavors):
+                # Only POSITIVE requests demand a flavor: a podset whose
+                # requests are all explicit zeros of uncovered resources
+                # is status-clean Fit with no flavors
+                # (flavorassigner.go:340-343) and must not abort the
+                # remaining podsets.
+                if group_failed or (
+                        any(requests[i].requests.values())
+                        and not psa.flavors):
                     failed = True
             if failed:
                 return assignment

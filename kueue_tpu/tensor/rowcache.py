@@ -269,27 +269,21 @@ class WorkloadRowCache:
         ci = cq_idx.get(info.cluster_queue, -1)
         self.cq[i] = ci
         self.requests[i, :] = 0
-        eligible = True
-        if ci < 0 or len(info.total_requests) != 1:
-            eligible = False
-        else:
-            ps = wl.pod_sets[0]
-            if (ps.min_count is not None or ps.topology_request is not None
-                    or ps.node_selector or ps.tolerations):
-                eligible = False
-            else:
-                psr = info.total_requests[0]
-                reqs = dict(psr.requests)
-                si = s_idx.get("pods")
-                if si is not None and world.group_of_res[ci, si] >= 0:
-                    reqs["pods"] = psr.count
-                for res, q in reqs.items():
-                    si = s_idx.get(res)
-                    if si is None:
-                        if q > 0:
-                            eligible = False
-                        continue
-                    self.requests[i, si] = q
+        from kueue_tpu.tensor.schema import dense_path_eligible
+        eligible = ci >= 0 and dense_path_eligible(info)
+        if eligible:
+            psr = info.total_requests[0]
+            reqs = dict(psr.requests)
+            si = s_idx.get("pods")
+            if si is not None and world.group_of_res[ci, si] >= 0:
+                reqs["pods"] = psr.count
+            for res, q in reqs.items():
+                si = s_idx.get(res)
+                if si is None:
+                    if q > 0:
+                        eligible = False
+                    continue
+                self.requests[i, si] = q
         self.eligible[i] = eligible
 
     def flush(self, world) -> None:

@@ -427,6 +427,26 @@ def encode_admitted(world: WorldTensors, infos: list,
         evicted=evicted, usage=usage)
 
 
+def dense_path_eligible(info) -> bool:
+    """Whether a pending workload can be decided on the dense device
+    path. Shared by the batch encoder below and the incremental row
+    cache (tensor/rowcache.py) so the two can never desynchronize.
+
+    Ineligible: multi-podset, partial admission (min_count), topology
+    requests, node selectors/affinity, tolerations, and explicit
+    zero-quantity requests (Go assigns flavors/borrow levels to those;
+    the dense encoding cannot distinguish explicit-zero from absent)."""
+    if len(info.total_requests) != 1:
+        return False
+    ps = info.obj.pod_sets[0]
+    if (ps.min_count is not None or ps.topology_request is not None
+            or ps.node_selector or ps.node_affinity or ps.tolerations):
+        return False
+    if any(q == 0 for q in info.total_requests[0].requests.values()):
+        return False
+    return True
+
+
 def encode_workloads(world: WorldTensors,
                      infos: list[WorkloadInfo]) -> WorkloadTensors:
     """Encode pending workloads. Multi-podset workloads are marked
@@ -454,14 +474,7 @@ def encode_workloads(world: WorldTensors,
         priority[i] = info.obj.effective_priority
         timestamp[i] = info.obj.creation_time
         has_qr[i] = info.obj.has_quota_reservation
-        if cq[i] < 0 or len(info.total_requests) != 1:
-            eligible[i] = False
-            continue
-        ps = info.obj.pod_sets[0]
-        if (ps.min_count is not None or ps.topology_request is not None
-                or ps.node_selector or ps.tolerations):
-            # Partial admission, TAS, and node-affinity paths run on the
-            # sequential host path in round 1.
+        if cq[i] < 0 or not dense_path_eligible(info):
             eligible[i] = False
             continue
         psr = info.total_requests[0]

@@ -128,9 +128,11 @@ class WorkloadInfo:
     def from_workload(cls, wl: Workload, cluster_queue: str = "",
                       options: Optional[InfoOptions] = None) -> "WorkloadInfo":
         info = cls(obj=wl, cluster_queue=cluster_queue)
-        # Zero-quantity requests carry no scheduling information and are
-        # dropped (pod specs don't list zero resources; reference skips
-        # them in usage accounting, flavorassigner.go:229-234).
+        # Zero-quantity requests are KEPT: a zero request for a resource
+        # the ClusterQueue covers still receives a flavor assignment
+        # (flavorassigner_test.go "zero resource request defined in
+        # clusterQueue should get flavor assigned"); zero requests for
+        # uncovered resources are skipped at assignment time instead.
         # Effective requests: drop excluded prefixes, then resource
         # transformations (workload.go:623-626 totalRequestsFromPodSets).
         info.total_requests = []
@@ -144,8 +146,7 @@ class WorkloadInfo:
             info.total_requests.append(PodSetResources(
                 name=ps.name,
                 count=ps.count,
-                requests={r: q * ps.count for r, q in per_pod.items()
-                          if q != 0},
+                requests={r: q * ps.count for r, q in per_pod.items()},
             ))
         if wl.status.admission is not None:
             info.apply_admission(wl.status.admission)

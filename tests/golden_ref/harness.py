@@ -1,0 +1,237 @@
+"""Golden-case harness: run the sequential FlavorAssigner against worlds
+transliterated from the reference's table-driven tests and compare with
+the Go-authored expected outputs.
+
+Mirrors the driver at
+pkg/scheduler/flavorassigner/flavorassigner_test.go:3577-3662 (cache +
+snapshot construction, usage injection, test oracle) with a
+reason-normalizing comparer: the repo's reason strings carry the same
+(kind, resource, flavor, amount) facts as the Go ones but format
+quantities as raw integers, so both sides are mapped into canonical
+tuples before comparison.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.api.types import FlavorResource
+from kueue_tpu.cache.snapshot import build_snapshot
+from kueue_tpu.scheduler.flavorassigner import (
+    FlavorAssigner,
+    Mode,
+    PMode,
+)
+
+from .builders import MakeCohort, parse_quantity
+
+NO_FIT = Mode.NO_FIT
+PREEMPT = Mode.PREEMPT
+FIT = Mode.FIT
+
+# preemptioncommon.PreemptionPossibility values for the simulation stub.
+NO_CANDIDATES = PMode.NO_CANDIDATES
+PREEMPT_P = PMode.PREEMPT
+RECLAIM = PMode.RECLAIM
+
+
+@dataclass
+class TestOracle:
+    """flavorassigner_test.go:156 (testOracle): a canned per-FlavorResource
+    simulation result; default (Preempt, 0) like the Go stub."""
+
+    simulation_result: dict[tuple[str, str], tuple[PMode, int]] = field(
+        default_factory=dict)
+
+    def simulate_preemption(self, cq, wl, fr, quantity):
+        key = (fr.flavor, fr.resource)
+        if key in self.simulation_result:
+            return self.simulation_result[key]
+        return PMode.PREEMPT, 0
+
+
+@dataclass
+class WantFlavor:
+    """Expected per-resource FlavorAssignment (Name, Mode, TriedFlavorIdx)."""
+
+    name: str
+    mode: Mode
+    tried_idx: Optional[int] = None  # None = don't check
+
+
+@dataclass
+class WantPodSet:
+    name: str
+    flavors: dict[str, WantFlavor] = field(default_factory=dict)
+    count: Optional[int] = None
+    reasons: tuple[str, ...] = ()  # Go-authored Status reason strings
+
+
+@dataclass
+class WantAssignment:
+    podsets: list[WantPodSet] = field(default_factory=list)
+    usage: dict[tuple[str, str], int] = field(default_factory=dict)
+    borrowing: Optional[int] = None
+
+
+_RE_MAX = re.compile(
+    r"insufficient quota for (\S+) in flavor (\S+?),.* maximum capacity")
+_RE_NEED = re.compile(
+    r"insufficient unused quota for (\S+) in flavor (\S+), (\S+) more needed")
+_RE_PROVIDE = re.compile(r"[Ff]lavor (\S+) does not provide resource (\S+)")
+_RE_TAINT = re.compile(r"untolerated taint (\S+) in flavor (\S+)")
+_RE_AFFINITY = re.compile(r"flavor (\S+) doesn't match node affinity")
+_RE_TAS_UNSUPPORTED = re.compile(
+    r"Flavor (\S+) does not (?:support|contain) .*[Tt]opology")
+_RE_TAS_NOFIT = re.compile(
+    r"topology \S+ doesn't allow to fit (?:all|any) of \d+ pod\(s\)")
+_RE_UNAVAILABLE = re.compile(r"resource (\S+) unavailable in ClusterQueue")
+
+
+def normalize_reason(s: str, go_units: bool = False) -> tuple:
+    """Map a reason string to a canonical tuple. ``go_units=True`` for
+    Go-authored strings, whose quantities are humanized (cpu "1" means
+    1000 milli); the repo's own strings carry raw integers."""
+    m = _RE_NEED.search(s)
+    if m:
+        res, flavor, amount = m.groups()
+        try:
+            if go_units:
+                scale = 1000 if res == "cpu" else 1
+                qty = round(parse_quantity(amount) * scale)
+            else:
+                qty = int(amount)
+        except ValueError:
+            qty = -1
+        return ("need", flavor, res, qty)
+    m = _RE_MAX.search(s)
+    if m:
+        res, flavor = m.groups()
+        return ("max", flavor, res)
+    m = _RE_PROVIDE.search(s)
+    if m:
+        flavor, res = m.groups()
+        return ("max", flavor, res)  # repo reports these as max-capacity-0
+    m = _RE_TAINT.search(s)
+    if m:
+        return ("taint", m.group(2), m.group(1))
+    m = _RE_AFFINITY.search(s)
+    if m:
+        return ("affinity", m.group(1))
+    m = _RE_TAS_UNSUPPORTED.search(s)
+    if m:
+        return ("tas-unsupported", m.group(1))
+    if _RE_TAS_NOFIT.search(s):
+        return ("tas-nofit",)
+    m = _RE_UNAVAILABLE.search(s)
+    if m:
+        return ("unavailable", m.group(1))
+    return ("other", s)
+
+
+def run_assign_case(
+    *,
+    wl_podsets,
+    cluster_queue,
+    resource_flavors,
+    cluster_queue_usage: Optional[dict[tuple[str, str], int]] = None,
+    secondary_cluster_queue=None,
+    secondary_usage: Optional[dict[tuple[str, str], int]] = None,
+    enable_fair_sharing: bool = False,
+    simulation_result: Optional[dict[tuple[str, str],
+                                     tuple[PMode, int]]] = None,
+    reclaimable: Optional[dict[str, int]] = None,
+    topologies=None,
+    nodes=None,
+    counts: Optional[list[int]] = None,
+):
+    """Build the world exactly as the Go driver does and run Assign."""
+    from kueue_tpu.api.types import Workload
+    from kueue_tpu.workload_info import WorkloadInfo
+
+    wl = Workload(name="wl", pod_sets=tuple(wl_podsets))
+    if reclaimable:
+        wl.status.reclaimable_pods = dict(reclaimable)
+
+    cqs = [cluster_queue]
+    if secondary_cluster_queue is not None:
+        cqs.append(secondary_cluster_queue)
+    cohorts = []
+    if cluster_queue.cohort:
+        cohorts.append(MakeCohort(cluster_queue.cohort).Obj())
+    snap = build_snapshot(cqs, cohorts, list(resource_flavors.values()),
+                          [], topologies=topologies, nodes=nodes)
+    cq_snap = snap.cluster_queue(cluster_queue.name)
+    if cluster_queue_usage:
+        cq_snap.add_usage({FlavorResource(f, r): v
+                           for (f, r), v in cluster_queue_usage.items()})
+    if secondary_cluster_queue is not None and secondary_usage:
+        snap.cluster_queue(secondary_cluster_queue.name).add_usage(
+            {FlavorResource(f, r): v
+             for (f, r), v in secondary_usage.items()})
+
+    info = WorkloadInfo.from_workload(wl, cluster_queue.name)
+    assigner = FlavorAssigner(
+        info, cq_snap, snap.resource_flavors,
+        enable_fair_sharing=enable_fair_sharing,
+        oracle=TestOracle(simulation_result or {}))
+    return assigner.assign(counts=counts)
+
+
+def assert_assignment(assignment, want_mode: Mode,
+                      want: Optional[WantAssignment] = None,
+                      case: str = ""):
+    prefix = f"[{case}] " if case else ""
+    got_mode = assignment.representative_mode()
+    assert got_mode == want_mode, (
+        f"{prefix}representative mode: got {got_mode.name},"
+        f" want {want_mode.name}")
+    if want is None:
+        return
+
+    assert len(assignment.pod_sets) == len(want.podsets), (
+        f"{prefix}podset count: got"
+        f" {[ps.name for ps in assignment.pod_sets]},"
+        f" want {[ps.name for ps in want.podsets]}")
+    for got_ps, want_ps in zip(assignment.pod_sets, want.podsets):
+        assert got_ps.name == want_ps.name, (
+            f"{prefix}podset order: got {got_ps.name}, want {want_ps.name}")
+        if want_ps.count is not None:
+            assert got_ps.count == want_ps.count, (
+                f"{prefix}podset {got_ps.name} count: got {got_ps.count},"
+                f" want {want_ps.count}")
+        got_flavors = {res: (fa.name, fa.mode, fa.tried_flavor_idx)
+                       for res, fa in got_ps.flavors.items()}
+        want_names = {res: wf.name for res, wf in want_ps.flavors.items()}
+        got_names = {res: nm for res, (nm, _, _) in got_flavors.items()}
+        assert got_names == want_names, (
+            f"{prefix}podset {got_ps.name} flavors: got {got_names},"
+            f" want {want_names}")
+        for res, wf in want_ps.flavors.items():
+            nm, mode, idx = got_flavors[res]
+            assert mode == wf.mode, (
+                f"{prefix}podset {got_ps.name} res {res} mode:"
+                f" got {mode.name}, want {wf.mode.name}")
+            if wf.tried_idx is not None:
+                assert idx == wf.tried_idx, (
+                    f"{prefix}podset {got_ps.name} res {res} triedIdx:"
+                    f" got {idx}, want {wf.tried_idx}")
+        if want_ps.reasons:
+            got_r = sorted({normalize_reason(r) for r in got_ps.reasons})
+            want_r = sorted({normalize_reason(r, go_units=True)
+                             for r in want_ps.reasons})
+            assert got_r == want_r, (
+                f"{prefix}podset {got_ps.name} reasons:\n got "
+                f"{got_r}\n want {want_r}\n raw got: {got_ps.reasons}")
+
+    got_usage = {(fr.flavor, fr.resource): v
+                 for fr, v in assignment.usage.items() if v}
+    want_usage = {k: v for k, v in want.usage.items() if v}
+    assert got_usage == want_usage, (
+        f"{prefix}usage: got {got_usage}, want {want_usage}")
+    if want.borrowing is not None:
+        assert assignment.borrowing == want.borrowing, (
+            f"{prefix}borrowing: got {assignment.borrowing},"
+            f" want {want.borrowing}")

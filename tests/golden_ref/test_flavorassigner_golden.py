@@ -1,0 +1,923 @@
+"""Golden fixtures transliterated from the reference's
+pkg/scheduler/flavorassigner/flavorassigner_test.go (TestAssignFlavors).
+
+Each case preserves the Go table's name, inputs, and expected outputs
+(representative mode, per-resource flavor picks with modes and
+TriedFlavorIdx, counts, usage quantities, and Status reasons in
+normalized form). The Go test's FlavorAssignmentAttempts diagnostics are
+not asserted — the repo tracks equivalent facts through Status reasons.
+"""
+
+import pytest
+
+from kueue_tpu.api.types import (
+    BorrowWithinCohort,
+    BorrowWithinCohortPolicy,
+    FungibilityPolicy,
+    FungibilityPreference,
+    PreemptionPolicy,
+)
+
+from .builders import (
+    Gi,
+    MakeClusterQueue,
+    MakeFlavorQuotas,
+    MakePodSet,
+    MakeResourceFlavor,
+    Mi,
+)
+from .harness import (
+    FIT,
+    NO_FIT,
+    PREEMPT,
+    PMode,
+    WantAssignment,
+    WantFlavor,
+    WantPodSet,
+    assert_assignment,
+    run_assign_case,
+)
+
+DEFAULT = "main"
+
+# flavorassigner_test.go:176-205
+RESOURCE_FLAVORS = {
+    "default": MakeResourceFlavor("default").Obj(),
+    "one": MakeResourceFlavor("one").NodeLabel("type", "one").Obj(),
+    "two": MakeResourceFlavor("two").NodeLabel("type", "two").Obj(),
+    "b_one": MakeResourceFlavor("b_one").NodeLabel("b_type", "one").Obj(),
+    "b_two": MakeResourceFlavor("b_two").NodeLabel("b_type", "two").Obj(),
+    "tainted": MakeResourceFlavor("tainted")
+        .Taint(key="instance", value="spot", effect="NoSchedule").Obj(),
+    "taint_and_toleration": MakeResourceFlavor("taint_and_toleration")
+        .Taint(key="instance", value="spot", effect="NoSchedule")
+        .Toleration(key="instance", operator="Equal", value="spot",
+                    effect="NoSchedule").Obj(),
+    "label-x-a": MakeResourceFlavor("label-x-a").NodeLabel("x", "a").Obj(),
+    "label-xy-b": MakeResourceFlavor("label-xy-b")
+        .NodeLabel("x", "b").NodeLabel("y", "k").Obj(),
+    "tas-a": MakeResourceFlavor("tas-a").TopologyName("tas-topo-a").Obj(),
+    "tas-b": MakeResourceFlavor("tas-b").TopologyName("tas-topo-b").Obj(),
+}
+
+
+def wf(name, mode, idx=None):
+    return WantFlavor(name, mode, idx)
+
+
+CASES = {}
+
+
+def case(name, **kw):
+    CASES[name] = kw
+
+
+case(
+    "single flavor, fits",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "1")
+          .Request("memory", "1Mi").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("default").Resource("cpu", "1")
+        .Resource("memory", "2Mi").Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("default", FIT, -1),
+                                      "memory": wf("default", FIT, -1)},
+                            count=1)],
+        usage={("default", "cpu"): 1000, ("default", "memory"): Mi}),
+)
+
+case(
+    "single flavor, fits tainted flavor",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "1")
+          .Toleration(key="instance", operator="Equal", value="spot",
+                      effect="NoSchedule").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("tainted").Resource("cpu", "4").Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("tainted", FIT, -1)},
+                            count=1)],
+        usage={("tainted", "cpu"): 1000}),
+)
+
+case(
+    "single flavor, fits tainted flavor with toleration",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "1").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("taint_and_toleration").Resource("cpu", "4")
+        .Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(
+            DEFAULT, {"cpu": wf("taint_and_toleration", FIT, -1)},
+            count=1)],
+        usage={("taint_and_toleration", "cpu"): 1000}),
+)
+
+case(
+    "single flavor, used resources, doesn't fit",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "2").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("default").Resource("cpu", "4").Obj()).Obj(),
+    usage={("default", "cpu"): 3000},
+    want_mode=PREEMPT,
+    want=WantAssignment(
+        podsets=[WantPodSet(
+            DEFAULT, {"cpu": wf("default", PREEMPT, -1)}, count=1,
+            reasons=("insufficient unused quota for cpu in flavor default,"
+                     " 1 more needed",))],
+        usage={("default", "cpu"): 2000}),
+)
+
+case(
+    "multiple resource groups, fits",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "3")
+          .Request("memory", "10Mi").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "2").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "4").Obj())
+    .ResourceGroup(
+        MakeFlavorQuotas("b_one").Resource("memory", "1Gi").Obj(),
+        MakeFlavorQuotas("b_two").Resource("memory", "5Gi").Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("two", FIT, -1),
+                                      "memory": wf("b_one", FIT, 0)},
+                            count=1)],
+        usage={("two", "cpu"): 3000, ("b_one", "memory"): 10 * Mi}),
+)
+
+case(
+    "multiple flavors, leader worker set, leader and workers request the"
+    " same resources fits",
+    pods=[MakePodSet("worker", 4).Request("cpu", "2")
+          .PodSetGroup("group1").Obj(),
+          MakePodSet("leader", 1).Request("cpu", "1")
+          .PodSetGroup("group1").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "4").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "9").Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[
+            WantPodSet("worker", {"cpu": wf("two", FIT, -1)}, count=4),
+            WantPodSet("leader", {"cpu": wf("two", FIT, -1)}, count=1)],
+        usage={("two", "cpu"): 9000}),
+)
+
+case(
+    "multiple flavors, leader worker set, workers request GPU, leader"
+    " does not request GPU, fits",
+    pods=[MakePodSet("worker", 4).Request("cpu", "1")
+          .Request("memory", "1").Request("example.com/gpu", "1")
+          .PodSetGroup("group1").Obj(),
+          MakePodSet("leader", 1).Request("cpu", "1")
+          .Request("memory", "1").PodSetGroup("group1").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "10")
+        .Resource("memory", "10").Obj())
+    .ResourceGroup(
+        MakeFlavorQuotas("two").Resource("cpu", "5")
+        .Resource("memory", "5").Resource("example.com/gpu", "4")
+        .Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[
+            WantPodSet("worker", {"cpu": wf("two", FIT, -1),
+                                  "memory": wf("two", FIT, -1),
+                                  "example.com/gpu": wf("two", FIT, -1)},
+                       count=4),
+            WantPodSet("leader", {"cpu": wf("two", FIT, -1),
+                                  "memory": wf("two", FIT, -1)},
+                       count=1)],
+        usage={("two", "cpu"): 5000, ("two", "memory"): 5,
+               ("two", "example.com/gpu"): 4}),
+)
+
+case(
+    "multiple flavors, leader worker set, workers request GPU, leader"
+    " does not request GPU, does not fit, without group it would fit",
+    pods=[MakePodSet("worker", 4).Request("cpu", "1")
+          .Request("example.com/gpu", "1").PodSetGroup("group1").Obj(),
+          MakePodSet("leader", 1).Request("cpu", "1")
+          .PodSetGroup("group1").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "4")
+        .Resource("example.com/gpu", "4").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "5")
+        .Resource("example.com/gpu", "0").Obj()).Obj(),
+    want_mode=NO_FIT,
+    want=WantAssignment(
+        podsets=[
+            WantPodSet("worker", {}, count=4, reasons=(
+                "insufficient quota for cpu in flavor one, previously"
+                " considered podsets requests (0) + current podset request"
+                " (5) > maximum capacity (4)",
+                "insufficient quota for example.com/gpu in flavor two,"
+                " previously considered podsets requests (0) + current"
+                " podset request (4) > maximum capacity (0)")),
+            WantPodSet("leader", {}, count=1)],
+        usage={}),
+)
+
+case(
+    "multiple resource groups, one could fit with preemption, other"
+    " doesn't fit",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "3")
+          .Request("memory", "10Mi").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "3").Obj())
+    .ResourceGroup(MakeFlavorQuotas("b_one").Resource("memory", "1Mi")
+                   .Obj()).Obj(),
+    usage={("one", "cpu"): 1000},
+    want_mode=NO_FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {}, count=1, reasons=(
+            "insufficient quota for memory in flavor b_one, previously"
+            " considered podsets requests (0) + current podset request"
+            " (10Mi) > maximum capacity (1Mi)",))],
+        usage={}),
+)
+
+case(
+    "multiple resource groups with multiple resources, fits",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "3")
+          .Request("memory", "10Mi").Request("example.com/gpu", "3")
+          .Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "2")
+        .Resource("memory", "1Gi").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "4")
+        .Resource("memory", "15Mi").Obj())
+    .ResourceGroup(
+        MakeFlavorQuotas("b_one").Resource("example.com/gpu", "4").Obj(),
+        MakeFlavorQuotas("b_two").Resource("example.com/gpu", "2")
+        .Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {
+            "cpu": wf("two", FIT, -1), "memory": wf("two", FIT, -1),
+            "example.com/gpu": wf("b_one", FIT, 0)}, count=1)],
+        usage={("two", "cpu"): 3000, ("two", "memory"): 10 * Mi,
+               ("b_one", "example.com/gpu"): 3}),
+)
+
+case(
+    "multiple resource groups with multiple resources, fits with"
+    " different modes",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "3")
+          .Request("memory", "10Mi").Request("example.com/gpu", "3")
+          .Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "2")
+        .Resource("memory", "1Gi").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "4")
+        .Resource("memory", "15Mi").Obj())
+    .ResourceGroup(
+        MakeFlavorQuotas("b_one").Resource("example.com/gpu", "4").Obj())
+    .Cohort("test-cohort").Obj(),
+    usage={("two", "memory"): 10 * Mi},
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("b_one")
+                   .Resource("example.com/gpu", "0").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_usage={("b_one", "example.com/gpu"): 2},
+    simulation={("two", "memory"): (PMode.PREEMPT, 1)},
+    want_mode=PREEMPT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {
+            "cpu": wf("two", FIT, -1),
+            "memory": wf("two", PREEMPT, -1),
+            "example.com/gpu": wf("b_one", PREEMPT, -1)}, count=1,
+            reasons=(
+                "insufficient quota for cpu in flavor one, previously"
+                " considered podsets requests (0) + current podset"
+                " request (3) > maximum capacity (2)",
+                "insufficient unused quota for memory in flavor two,"
+                " 5Mi more needed",
+                "insufficient unused quota for example.com/gpu in flavor"
+                " b_one, 1 more needed"))],
+        borrowing=1,
+        usage={("two", "cpu"): 3000, ("two", "memory"): 10 * Mi,
+               ("b_one", "example.com/gpu"): 3}),
+)
+
+case(
+    "multiple resources in a group, doesn't fit",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "3")
+          .Request("memory", "10Mi").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "2")
+        .Resource("memory", "1Gi").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "4")
+        .Resource("memory", "5Mi").Obj()).Obj(),
+    want_mode=NO_FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {}, count=1, reasons=(
+            "insufficient quota for cpu in flavor one, previously"
+            " considered podsets requests (0) + current podset request"
+            " (3) > maximum capacity (2)",
+            "insufficient quota for memory in flavor two, previously"
+            " considered podsets requests (0) + current podset request"
+            " (10Mi) > maximum capacity (5Mi)"))],
+        usage={}),
+)
+
+case(
+    "multiple flavors, fits while skipping tainted flavor",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "3").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("tainted").Resource("cpu", "4").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "4").Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("two", FIT, -1)},
+                            count=1)],
+        usage={("two", "cpu"): 3000}),
+)
+
+case(
+    "multiple flavors, fits a node selector",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "1")
+          .NodeSelector("type", "two").NodeSelector("ignored1", "foo")
+          .RequiredDuringScheduling(
+              [("ignored2", "In", ["bar"])]).Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "4").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "4").Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("two", FIT, -1)},
+                            count=1)],
+        usage={("two", "cpu"): 1000}),
+)
+
+case(
+    "multiple flavors, fits with node affinity",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "1")
+          .Request("memory", "1Mi").NodeSelector("ignored1", "foo")
+          .RequiredDuringScheduling(
+              [("type", "In", ["two"])]).Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "4")
+        .Resource("memory", "1Gi").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "4")
+        .Resource("memory", "1Gi").Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("two", FIT, -1),
+                                      "memory": wf("two", FIT, -1)},
+                            count=1)],
+        usage={("two", "cpu"): 1000, ("two", "memory"): Mi}),
+)
+
+case(
+    "multiple flavors, node affinity fits any flavor",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "1")
+          .RequiredDuringScheduling(
+              [("ignored2", "In", ["bar"])],
+              [("cpuType", "In", ["two"])]).Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "4").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "4").Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("one", FIT, 0)},
+                            count=1)],
+        usage={("one", "cpu"): 1000}),
+)
+
+
+case(
+    "multiple flavors with different label keys, selector only uses"
+    " flavor's own keys",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "1")
+          .NodeSelector("x", "a").NodeSelector("y", "g").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("label-x-a").Resource("cpu", "4").Obj(),
+        MakeFlavorQuotas("label-xy-b").Resource("cpu", "4").Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("label-x-a", FIT, 0)},
+                            count=1)],
+        usage={("label-x-a", "cpu"): 1000}),
+)
+
+case(
+    "labelless flavor in group with labeled flavor, workload uses"
+    " labeled selector",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "1")
+          .NodeSelector("type", "two").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("default").Resource("cpu", "4").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "4").Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("default", FIT, 0)},
+                            count=1)],
+        usage={("default", "cpu"): 1000}),
+)
+
+case(
+    "multiple flavors, doesn't fit node affinity",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "1")
+          .RequiredDuringScheduling([("type", "In", ["three"])]).Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "4").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "4").Obj()).Obj(),
+    want_mode=NO_FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {}, count=1, reasons=(
+            "flavor one doesn't match node affinity",
+            "flavor two doesn't match node affinity"))],
+        usage={}),
+)
+
+case(
+    "multiple specs, fit different flavors",
+    pods=[MakePodSet("driver", 1).Request("cpu", "5").Obj(),
+          MakePodSet("worker", 1).Request("cpu", "3").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "4").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "10").Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[
+            WantPodSet("driver", {"cpu": wf("two", FIT, -1)}, count=1),
+            WantPodSet("worker", {"cpu": wf("one", FIT, 0)}, count=1)],
+        usage={("one", "cpu"): 3000, ("two", "cpu"): 5000}),
+)
+
+case(
+    "multiple specs, fits borrowing",
+    pods=[MakePodSet("driver", 1).Request("cpu", "4")
+          .Request("memory", "1Gi").Obj(),
+          MakePodSet("worker", 1).Request("cpu", "6")
+          .Request("memory", "4Gi").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("default")
+        .Resource("cpu", "2", borrowing="98")
+        .Resource("memory", "2Gi").Obj()).Cohort("test-cohort").Obj(),
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("default").Resource("cpu", "198")
+                   .Resource("memory", "198Gi").Obj())
+    .Cohort("test-cohort").Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[
+            WantPodSet("driver", {"cpu": wf("default", FIT, -1),
+                                  "memory": wf("default", FIT, -1)},
+                       count=1),
+            WantPodSet("worker", {"cpu": wf("default", FIT, -1),
+                                  "memory": wf("default", FIT, -1)},
+                       count=1)],
+        borrowing=1,
+        usage={("default", "cpu"): 10000, ("default", "memory"): 5 * Gi}),
+)
+
+case(
+    "not enough space to borrow",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "2").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "1").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one")
+                   .Resource("cpu", "10", lending="0").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_usage={("one", "cpu"): 9000},
+    want_mode=NO_FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {}, count=1, reasons=(
+            "insufficient quota for cpu in flavor one, previously"
+            " considered podsets requests (0) + current podset request"
+            " (2) > maximum capacity (1)",))],
+        usage={}),
+)
+
+case(
+    "past max, but can preempt in ClusterQueue",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "2").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "2", borrowing="8")
+        .Obj()).Cohort("test-cohort").Obj(),
+    usage={("one", "cpu"): 9000},
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "98").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_usage={("one", "cpu"): 9000},
+    simulation={("one", "cpu"): (PMode.PREEMPT, 1)},
+    want_mode=PREEMPT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("one", PREEMPT, -1)},
+                            count=1, reasons=(
+            "insufficient unused quota for cpu in flavor one,"
+            " 1 more needed",))],
+        borrowing=1,
+        usage={("one", "cpu"): 2000}),
+)
+
+case(
+    "past min, but can preempt in ClusterQueue",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "2").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "2").Obj()).Obj(),
+    usage={("one", "cpu"): 1000},
+    want_mode=PREEMPT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("one", PREEMPT, -1)},
+                            count=1, reasons=(
+            "insufficient unused quota for cpu in flavor one,"
+            " 1 more needed",))],
+        usage={("one", "cpu"): 2000}),
+)
+
+case(
+    "past min, but can preempt in cohort and ClusterQueue",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "2").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "3").Obj())
+    .Cohort("test-cohort").Obj(),
+    usage={("one", "cpu"): 2000},
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "7").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_usage={("one", "cpu"): 8000},
+    simulation={("one", "cpu"): (PMode.PREEMPT, 1)},
+    want_mode=PREEMPT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("one", PREEMPT, -1)},
+                            count=1, reasons=(
+            "insufficient unused quota for cpu in flavor one,"
+            " 2 more needed",))],
+        borrowing=1,
+        usage={("one", "cpu"): 2000}),
+)
+
+case(
+    "can only preempt flavors that match affinity",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "2")
+          .NodeSelector("type", "two").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "4").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "4").Obj()).Obj(),
+    usage={("one", "cpu"): 3000, ("two", "cpu"): 3000},
+    want_mode=PREEMPT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("two", PREEMPT, -1)},
+                            count=1, reasons=(
+            "flavor one doesn't match node affinity",
+            "insufficient unused quota for cpu in flavor two,"
+            " 1 more needed"))],
+        usage={("two", "cpu"): 2000}),
+)
+
+case(
+    "each podset requires preemption on a different flavor",
+    pods=[MakePodSet("launcher", 1).Request("cpu", "2").Obj(),
+          MakePodSet("workers", 10).Request("cpu", "1")
+          .Toleration(key="instance", operator="Equal", value="spot",
+                      effect="NoSchedule").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "4").Obj(),
+        MakeFlavorQuotas("tainted").Resource("cpu", "10").Obj()).Obj(),
+    usage={("one", "cpu"): 3000, ("tainted", "cpu"): 3000},
+    want_mode=PREEMPT,
+    want=WantAssignment(
+        podsets=[
+            WantPodSet("launcher", {"cpu": wf("one", PREEMPT, -1)},
+                       count=1, reasons=(
+                "insufficient unused quota for cpu in flavor one,"
+                " 1 more needed",
+                "untolerated taint instance in flavor tainted")),
+            WantPodSet("workers", {"cpu": wf("tainted", PREEMPT, -1)},
+                       count=10, reasons=(
+                "insufficient quota for cpu in flavor one, previously"
+                " considered podsets requests (2) + current podset"
+                " request (10) > maximum capacity (4)",
+                "insufficient unused quota for cpu in flavor tainted,"
+                " 3 more needed"))],
+        usage={("one", "cpu"): 2000, ("tainted", "cpu"): 10000}),
+)
+
+case(
+    "resource not listed in clusterQueue",
+    pods=[MakePodSet(DEFAULT, 1).Request("example.com/gpu", "2").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "4").Obj()).Obj(),
+    want_mode=NO_FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {}, count=1, reasons=(
+            "resource example.com/gpu unavailable in ClusterQueue",))],
+        usage={}),
+)
+
+case(
+    "zero resource request not in clusterQueue should succeed",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "1")
+          .Request("example.com/gpu", "0").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("default").Resource("cpu", "4").Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("default", FIT, -1)},
+                            count=1)],
+        usage={("default", "cpu"): 1000}),
+)
+
+case(
+    "zero resource request defined in clusterQueue should get flavor"
+    " assigned",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "1")
+          .Request("example.com/gpu", "0").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("default").Resource("cpu", "4")
+        .Resource("example.com/gpu", "4").Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {
+            "cpu": wf("default", FIT, -1),
+            "example.com/gpu": wf("default", FIT, -1)}, count=1)],
+        usage={("default", "cpu"): 1000}),
+)
+
+case(
+    "num pods fit",
+    pods=[MakePodSet(DEFAULT, 3).Request("cpu", "1").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("default").Resource("pods", "3")
+        .Resource("cpu", "10").Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("default", FIT, -1),
+                                      "pods": wf("default", FIT, -1)},
+                            count=3)],
+        usage={("default", "pods"): 3, ("default", "cpu"): 3000}),
+)
+
+case(
+    "num pods don't fit",
+    pods=[MakePodSet(DEFAULT, 3).Request("cpu", "1").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("default").Resource("pods", "2")
+        .Resource("cpu", "10").Obj()).Obj(),
+    want_mode=NO_FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {}, count=3, reasons=(
+            "insufficient quota for pods in flavor default, previously"
+            " considered podsets requests (0) + current podset request"
+            " (3) > maximum capacity (2)",))],
+        usage={}),
+)
+
+case(
+    "with reclaimable pods; reclaimablePods on",
+    pods=[MakePodSet(DEFAULT, 5).Request("cpu", "1").Obj()],
+    reclaimable={DEFAULT: 2},
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("default").Resource("pods", "3")
+        .Resource("cpu", "10").Obj()).Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("default", FIT, -1),
+                                      "pods": wf("default", FIT, -1)},
+                            count=3)],
+        usage={("default", "pods"): 3, ("default", "cpu"): 3000}),
+)
+
+case(
+    "preempt before try next flavor",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "9").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .FlavorFungibility(when_can_borrow=FungibilityPolicy.BORROW,
+                       when_can_preempt=FungibilityPolicy.PREEMPT)
+    .ResourceGroup(
+        MakeFlavorQuotas("one").Resource("pods", "10")
+        .Resource("cpu", "10").Obj(),
+        MakeFlavorQuotas("two").Resource("pods", "10")
+        .Resource("cpu", "10").Obj()).Obj(),
+    usage={("one", "cpu"): 2000},
+    want_mode=PREEMPT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("one", PREEMPT, 0),
+                                      "pods": wf("one", FIT, 0)},
+                            count=1, reasons=(
+            "insufficient unused quota for cpu in flavor one,"
+            " 1 more needed",))],
+        usage={("one", "cpu"): 9000, ("one", "pods"): 1}),
+)
+
+case(
+    "preempt try next flavor",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "9").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("pods", "10")
+        .Resource("cpu", "10").Obj(),
+        MakeFlavorQuotas("two").Resource("pods", "10")
+        .Resource("cpu", "10").Obj()).Obj(),
+    usage={("one", "cpu"): 2000},
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("two", FIT, -1),
+                                      "pods": wf("two", FIT, -1)},
+                            count=1)],
+        usage={("two", "cpu"): 9000, ("two", "pods"): 1}),
+)
+
+case(
+    "borrow try next flavor, found the first flavor",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "9").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .FlavorFungibility(when_can_borrow=FungibilityPolicy.TRY_NEXT_FLAVOR,
+                       when_can_preempt=FungibilityPolicy.TRY_NEXT_FLAVOR)
+    .ResourceGroup(
+        MakeFlavorQuotas("one").Resource("pods", "10")
+        .Resource("cpu", "10", borrowing="1").Obj(),
+        MakeFlavorQuotas("two").Resource("pods", "10")
+        .Resource("cpu", "1").Obj()).Cohort("test-cohort").Obj(),
+    usage={("one", "cpu"): 2000},
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "1").Obj())
+    .Cohort("test-cohort").Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("one", FIT, -1),
+                                      "pods": wf("one", FIT, -1)},
+                            count=1)],
+        borrowing=1,
+        usage={("one", "cpu"): 9000, ("one", "pods"): 1}),
+)
+
+case(
+    "borrow try next flavor, found the second flavor",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "9").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .FlavorFungibility(when_can_borrow=FungibilityPolicy.TRY_NEXT_FLAVOR,
+                       when_can_preempt=FungibilityPolicy.TRY_NEXT_FLAVOR)
+    .ResourceGroup(
+        MakeFlavorQuotas("one").Resource("pods", "10")
+        .Resource("cpu", "10", borrowing="1").Obj(),
+        MakeFlavorQuotas("two").Resource("pods", "10")
+        .Resource("cpu", "10").Obj()).Cohort("test-cohort").Obj(),
+    usage={("one", "cpu"): 2000},
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "1").Obj())
+    .Cohort("test-cohort").Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("two", FIT, -1),
+                                      "pods": wf("two", FIT, -1)},
+                            count=1)],
+        usage={("two", "cpu"): 9000, ("two", "pods"): 1}),
+)
+
+case(
+    "borrow before try next flavor",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "9").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("pods", "10")
+        .Resource("cpu", "10", borrowing="1").Obj(),
+        MakeFlavorQuotas("two").Resource("pods", "10")
+        .Resource("cpu", "10").Obj()).Cohort("test-cohort").Obj(),
+    usage={("one", "cpu"): 2000},
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "1").Obj())
+    .Cohort("test-cohort").Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("one", FIT, 0),
+                                      "pods": wf("one", FIT, 0)},
+                            count=1)],
+        borrowing=1,
+        usage={("one", "cpu"): 9000, ("one", "pods"): 1}),
+)
+
+case(
+    "when borrowing while preemption is needed for flavor one;"
+    " WhenCanBorrow=MayStopSearch",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "12").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .Preemption(reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY,
+                borrow_within_cohort=BorrowWithinCohort(
+                    policy=BorrowWithinCohortPolicy.LOWER_PRIORITY))
+    .FlavorFungibility(when_can_borrow=FungibilityPolicy.BORROW,
+                       when_can_preempt=FungibilityPolicy.PREEMPT)
+    .ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "0", borrowing="12")
+        .Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "12").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "12").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_usage={("one", "cpu"): 10000},
+    simulation={("one", "cpu"): (PMode.PREEMPT, 1)},
+    want_mode=PREEMPT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("one", PREEMPT, 0)},
+                            count=1, reasons=(
+            "insufficient unused quota for cpu in flavor one,"
+            " 10 more needed",))],
+        borrowing=1,
+        usage={("one", "cpu"): 12000}),
+)
+
+case(
+    "when borrowing while preemption is needed for flavor one, no"
+    " borrowingLimit; WhenCanBorrow=MayStopSearch",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "12").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .Preemption(reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY,
+                borrow_within_cohort=BorrowWithinCohort(
+                    policy=BorrowWithinCohortPolicy.LOWER_PRIORITY))
+    .FlavorFungibility(when_can_borrow=FungibilityPolicy.BORROW,
+                       when_can_preempt=FungibilityPolicy.PREEMPT)
+    .ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "0").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "12").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "12").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_usage={("one", "cpu"): 10000},
+    simulation={("one", "cpu"): (PMode.PREEMPT, 1)},
+    want_mode=PREEMPT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("one", PREEMPT, 0)},
+                            count=1, reasons=(
+            "insufficient unused quota for cpu in flavor one,"
+            " 10 more needed",))],
+        borrowing=1,
+        usage={("one", "cpu"): 12000}),
+)
+
+case(
+    "when borrowing while preemption is needed for flavor one;"
+    " WhenCanBorrow=TryNextFlavor",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "12").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .Preemption(reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY,
+                borrow_within_cohort=BorrowWithinCohort(
+                    policy=BorrowWithinCohortPolicy.LOWER_PRIORITY))
+    .FlavorFungibility(when_can_borrow=FungibilityPolicy.TRY_NEXT_FLAVOR,
+                       when_can_preempt=FungibilityPolicy.PREEMPT)
+    .ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "0", borrowing="12")
+        .Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "12").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "12").Obj())
+    .Cohort("test-cohort").Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("two", FIT, -1)},
+                            count=1)],
+        usage={("two", "cpu"): 12000}),
+)
+
+
+def test_all_zero_uncovered_podset_does_not_truncate_assignment():
+    """A podset whose requests are all explicit zeros of uncovered
+    resources is status-clean Fit with no flavors
+    (flavorassigner.go:340-343); later podsets must still be assigned
+    and charged."""
+    assignment = run_assign_case(
+        wl_podsets=[
+            MakePodSet("a", 1).Request("example.com/gpu", "0").Obj(),
+            MakePodSet("b", 1).Request("cpu", "1").Obj()],
+        cluster_queue=MakeClusterQueue("cq").ResourceGroup(
+            MakeFlavorQuotas("default").Resource("cpu", "4").Obj()).Obj(),
+        resource_flavors=RESOURCE_FLAVORS)
+    assert_assignment(assignment, FIT, WantAssignment(
+        podsets=[WantPodSet("a", {}, count=1),
+                 WantPodSet("b", {"cpu": wf("default", FIT, -1)},
+                            count=1)],
+        usage={("default", "cpu"): 1000}),
+        case="all-zero-uncovered podset")
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_assign_flavors_golden(name):
+    tc = CASES[name]
+    assignment = run_assign_case(
+        wl_podsets=tc["pods"],
+        cluster_queue=tc["cq"],
+        resource_flavors=RESOURCE_FLAVORS,
+        cluster_queue_usage=tc.get("usage"),
+        secondary_cluster_queue=tc.get("secondary_cq"),
+        secondary_usage=tc.get("secondary_usage"),
+        enable_fair_sharing=tc.get("fair", False),
+        simulation_result=tc.get("simulation"),
+        reclaimable=tc.get("reclaimable"),
+        topologies=tc.get("topologies"),
+        nodes=tc.get("nodes"),
+        counts=tc.get("counts"),
+    )
+    assert_assignment(assignment, tc["want_mode"], tc.get("want"),
+                      case=name)

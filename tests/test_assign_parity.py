@@ -81,8 +81,10 @@ def pending_workloads(rng, snap, n=40):
     out = []
     cq_names = list(snap.cluster_queues)
     for i in range(n):
-        reqs = {r: rng.choice([0, 100, 600, 1200, 3000, 9000])
-                for r in RESOURCES}
+        # 0 means "resource not requested" — absence, not an explicit
+        # zero request (explicit zeros are host-path-only; see schema.py).
+        reqs = {r: q for r in RESOURCES
+                if (q := rng.choice([0, 100, 600, 1200, 3000, 9000]))}
         w = Workload(name=f"p{i}", creation_time=100.0 + i,
                      pod_sets=(PodSet("main", 1, reqs),))
         out.append(WorkloadInfo.from_workload(w, rng.choice(cq_names)))
