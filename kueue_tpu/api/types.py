@@ -423,6 +423,10 @@ class WorkloadStatus:
 _uid_counter = itertools.count(1)
 
 
+# cmd/experimental/kueue-priority-booster; constants.go:87.
+PRIORITY_BOOST_ANNOTATION = "kueue.x-k8s.io/priority-boost"
+
+
 @dataclass
 class Workload:
     """Reference: apis/kueue/v1beta2/workload_types.go:1197.
@@ -465,6 +469,21 @@ class Workload:
 
     @property
     def effective_priority(self) -> int:
+        """priority.EffectivePriority: base + boost. The boost comes from
+        the priority-booster's annotation when present (invalid values
+        default to zero, pkg/util/priority); the in-process booster may
+        also set the field directly."""
+        ann = self.annotations.get(PRIORITY_BOOST_ANNOTATION)
+        if ann is not None:
+            try:
+                boost = int(ann)
+            except ValueError:
+                boost = 0
+            # The in-process booster writes BOTH the field and the
+            # annotation; an out-of-band annotation alone must not mask a
+            # later booster decision, so the stronger signal wins.
+            return self.priority + (self.priority_boost
+                                    if self.priority_boost != 0 else boost)
         return self.priority + self.priority_boost
 
     # -- condition helpers (pkg/workload helpers in the reference) --

@@ -54,7 +54,8 @@ def scheduling_hash(wl: Workload, cluster_queue: str) -> tuple:
               if ps.topology_request.mode is not None else None,
               ps.topology_request.level,
               ps.topology_request.slice_level,
-              ps.topology_request.slice_size)
+              ps.topology_request.slice_size,
+              ps.topology_request.pod_set_group_name)
              if ps.topology_request is not None else None,
              ps.tolerations)
             for ps in wl.pod_sets)),
@@ -89,7 +90,14 @@ class PendingClusterQueue:
             usage = self.manager.lq_usage_fn(
                 f"{wl.namespace}/{wl.queue_name}")
             info.local_queue_fs_usage = usage
-        return (usage, -wl.effective_priority, wl.creation_time, next(_seq))
+        # FIFO position honors the eviction-aware queue-order timestamp
+        # (workload.go:1087), not raw creation time.
+        from kueue_tpu.workload_info import queue_order_timestamp
+        ordering = getattr(self.manager, "workload_ordering", None) \
+            if self.manager is not None else None
+        from kueue_tpu.workload_info import DEFAULT_ORDERING
+        ts = queue_order_timestamp(wl, ordering or DEFAULT_ORDERING)
+        return (usage, -wl.effective_priority, ts, next(_seq))
 
     def _heap_push(self, info: WorkloadInfo,
                    sort_key: Optional[tuple] = None) -> None:
@@ -280,11 +288,15 @@ class SecondPassQueue:
 class QueueManager:
     """pkg/cache/queue/manager.go:147 (Manager)."""
 
-    def __init__(self) -> None:
+    def __init__(self, workload_ordering=None) -> None:
         from kueue_tpu.tensor.rowcache import WorkloadRowCache
 
         self.cluster_queues: dict[str, PendingClusterQueue] = {}
         self.local_queues: dict[str, LocalQueue] = {}
+        # Which timestamp drives FIFO for PodsReady-evicted workloads
+        # (workload.Ordering); shared with the scheduler cycle so heap
+        # pops and entry ordering agree.
+        self.workload_ordering = workload_ordering
         # AFS hook: lq key -> decayed usage (manager.go:68).
         self.lq_usage_fn = None
         self.second_pass = SecondPassQueue()

@@ -21,6 +21,9 @@ class WaitForPodsReady:
     requeuing_backoff_base_seconds: int = 60
     requeuing_backoff_limit_count: Optional[int] = None
     requeuing_backoff_max_seconds: int = 3600
+    # FIFO anchor for PodsReady-evicted workloads: "Eviction" (default)
+    # or "Creation" (configuration_types.go RequeuingStrategy.Timestamp).
+    requeuing_timestamp: str = "Eviction"
 
 
 @dataclass

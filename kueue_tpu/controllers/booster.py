@@ -17,6 +17,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
+from kueue_tpu.api.types import PRIORITY_BOOST_ANNOTATION
+
 
 @dataclass
 class BoostPolicy:
@@ -68,6 +70,7 @@ class PriorityBooster:
                             intervals * p.boost_per_interval)
                 if boost > wl.priority_boost:
                     wl.priority_boost = boost
+                    wl.annotations[PRIORITY_BOOST_ANNOTATION] = str(boost)
                     pcq.push_or_update(info)  # re-heapify
                     boosted += 1
         if self.time_sharing is not None:
@@ -105,6 +108,7 @@ class PriorityBooster:
                 # priority (clearBoostAnnotationIfPresent).
                 if wl.priority_boost < 0:
                     wl.priority_boost = 0
+                    wl.annotations.pop(PRIORITY_BOOST_ANNOTATION, None)
                     if wl.active and not wl.is_admitted \
                             and not wl.is_finished:
                         # Re-heapify: the pending heap key baked in the
@@ -119,6 +123,8 @@ class PriorityBooster:
                 continue
             if wl.priority_boost != ts.negative_boost_value:
                 wl.priority_boost = ts.negative_boost_value
+                wl.annotations[PRIORITY_BOOST_ANNOTATION] = \
+                    str(ts.negative_boost_value)
                 self.engine._event("PriorityBoostSet", wl.key,
                                    detail=str(ts.negative_boost_value))
                 changed += 1

@@ -76,10 +76,22 @@ class Engine:
         if config is not None and config.fair_sharing.enable:
             enable_fair_sharing = True
         self.config = config
-        self.queues = QueueManager()
+        # One workload.Ordering shared by the pending heaps and the cycle
+        # iterator so heap pops and entry ordering always agree
+        # (requeuingTimestamp in waitForPodsReady config).
+        workload_ordering = None
+        if config is not None:
+            ts = getattr(getattr(config, "wait_for_pods_ready", None),
+                         "requeuing_timestamp", None)
+            if ts:
+                from kueue_tpu.workload_info import Ordering
+                workload_ordering = Ordering(
+                    pods_ready_requeuing_timestamp=ts)
+        self.queues = QueueManager(workload_ordering=workload_ordering)
         self.cache = Cache()
         self.cycle = cycle or SchedulerCycle(
-            enable_fair_sharing=enable_fair_sharing)
+            enable_fair_sharing=enable_fair_sharing,
+            workload_ordering=workload_ordering)
         self.clock: float = 0.0
         self.events: list[EngineEvent] = []
         # Watch fan-out (client-go informer analog): called with each

@@ -121,9 +121,10 @@ class SchedulerCycle:
 
     def __init__(self, enable_fair_sharing: bool = False,
                  enable_partial_admission: bool = True,
-                 afs_enabled: bool = False):
+                 afs_enabled: bool = False, workload_ordering=None):
         self.enable_fair_sharing = enable_fair_sharing
         self.enable_partial_admission = enable_partial_admission
+        self.workload_ordering = workload_ordering
         self.preemptor = Preemptor(enable_fair_sharing=enable_fair_sharing,
                                    afs_enabled=afs_enabled)
 
@@ -208,7 +209,8 @@ class SchedulerCycle:
         try:
             assigner = FlavorAssigner(
                 wl, cq, snapshot.resource_flavors,
-                enable_fair_sharing=self.enable_fair_sharing, oracle=oracle)
+                enable_fair_sharing=self.enable_fair_sharing, oracle=oracle,
+                preempt_workload_slice=old_info)
             full = assigner.assign()
             apply_tas_pass(full, wl, cq, previous_slice=old_info)
         finally:
@@ -249,7 +251,8 @@ class SchedulerCycle:
                        snapshot: Snapshot) -> list[Entry]:
         if self.enable_fair_sharing:
             return list(_fair_sharing_order(entries))
-        return sorted(entries, key=_classical_key)
+        return sorted(entries, key=lambda e: _classical_key(
+            e, self.workload_ordering))
 
     # -- commit (scheduler.go:371 processEntry) --
 
@@ -362,13 +365,23 @@ class SchedulerCycle:
         return reserved
 
 
-def _classical_key(e: Entry):
-    """scheduler.go:971 (makeClassicalIterator sort)."""
+def _classical_key(e: Entry, ordering=None):
+    """scheduler.go:971 (makeClassicalIterator sort): quota-reserved
+    first, fewer borrows, priority (unless PrioritySortingWithinCohort is
+    off), then FIFO by the queue-order timestamp (eviction-aware)."""
+    from kueue_tpu.config import features
+    from kueue_tpu.workload_info import (
+        DEFAULT_ORDERING,
+        queue_order_timestamp,
+    )
+
+    prio = (-e.obj.effective_priority
+            if features.enabled("PrioritySortingWithinCohort") else 0)
     return (
         0 if e.obj.has_quota_reservation else 1,
         e.assignment.borrows(),
-        -e.obj.effective_priority,
-        e.obj.creation_time,
+        prio,
+        queue_order_timestamp(e.obj, ordering or DEFAULT_ORDERING),
     )
 
 

@@ -141,8 +141,13 @@ class PodSetAssignment:
     requests: dict[str, int] = field(default_factory=dict)
     count: int = 0
     topology_assignment: Optional[object] = None
+    # A hard error (e.g. multiple TAS flavors in one podset) forces
+    # NoFit even when per-resource modes are Fit (Status.err in Go).
+    error: Optional[str] = None
 
     def representative_mode(self) -> Mode:
+        if self.error is not None:
+            return Mode.NO_FIT
         # Status-clean means Fit even with no flavors (empty requests)
         # (flavorassigner.go:340-343).
         if not self.reasons:
@@ -305,12 +310,17 @@ class FlavorAssigner:
         resource_flavors: dict,
         enable_fair_sharing: bool = False,
         oracle: PreemptionOracle = NEVER_PREEMPT_ORACLE,
+        preempt_workload_slice: Optional[WorkloadInfo] = None,
     ):
         self.wl = wl
         self.cq = cq
         self.resource_flavors = resource_flavors
         self.enable_fair_sharing = enable_fair_sharing
         self.oracle = oracle
+        # Elastic scale-up: the admitted slice this workload replaces.
+        # The replacement must land on the original slice's flavors
+        # (flavorassigner.go preemptWorkloadSlice — pods keep running).
+        self.preempt_workload_slice = preempt_workload_slice
 
     def assign(self, counts: Optional[list[int]] = None) -> Assignment:
         # Drop stale resume state (flavorassigner.go:615,624).
@@ -391,6 +401,22 @@ class FlavorAssigner:
                     for res, fa in group_flavors.items()
                     if res in requests[i].requests}
                 psa.reasons = list(group_reasons)
+                # A podset with a topology placement request must land on
+                # ONE TAS flavor: resources split across different TAS
+                # flavors cannot be co-placed in a single topology
+                # (flavorassigner_test.go "multiple TAS flavors assigned
+                # to different resources in the same PodSet leads to
+                # NoFit"; MultipleTASFlavorsAssignedError).
+                tr = self.wl.obj.pod_sets[i].topology_request
+                if tr is not None and getattr(tr, "mode", None) is not None:
+                    tas_used = sorted({
+                        fa.name for fa in psa.flavors.values()
+                        if (fl := self.resource_flavors.get(fa.name))
+                        is not None and fl.topology_name})
+                    if len(tas_used) > 1:
+                        psa.error = ("multiple TAS flavors assigned: "
+                                     + ", ".join(tas_used))
+                        failed = True
                 self._append(assignment, requests[i], psa)
                 # Only POSITIVE requests demand a flavor: a podset whose
                 # requests are all explicit zeros of uncovered resources
@@ -417,6 +443,15 @@ class FlavorAssigner:
             flavor_idx[res] = fa.tried_flavor_idx
         assignment.last_tried_flavor_idx.append(flavor_idx)
         assignment._representative = None
+
+    def _slice_pinned_flavor(self, ps_id: int, res: str) -> Optional[str]:
+        """The original slice's flavor for (podset, resource), or None."""
+        slice_reqs = self.preempt_workload_slice.total_requests
+        name = self.wl.obj.pod_sets[ps_id].name
+        for psr in slice_reqs:
+            if psr.name == name:
+                return psr.flavors.get(res)
+        return None
 
     def _resume_idx(self, ps_id: int, res: str) -> int:
         """LastAssignment.NextFlavorToTryForPodSetResource
@@ -478,6 +513,22 @@ class FlavorAssigner:
                 reasons.append(mismatch)
                 idx += 1
                 continue
+            # Workload-slice pinning: the scale-up replacement must reuse
+            # the original slice's flavor for each resource — its pods
+            # keep running on those nodes (flavorassigner_test.go
+            # "workload slice preemption fits in the original workload
+            # resource flavor").
+            if self.preempt_workload_slice is not None:
+                pinned = next(
+                    (p for i in ps_ids
+                     if (p := self._slice_pinned_flavor(i, res_name))
+                     is not None), None)
+                if pinned is not None and pinned != f_name:
+                    reasons.append(
+                        f"could not assign {f_name} flavor since the"
+                        f" original workload is assigned: {pinned}")
+                    idx += 1
+                    continue
 
             assignments: dict[str, FlavorAssignment] = {}
             representative = BEST

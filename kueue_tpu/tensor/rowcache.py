@@ -117,8 +117,11 @@ class WorkloadRowCache:
             self._row_of[info.key] = i
         self.info_of[i] = info
         wl = info.obj
+        from kueue_tpu.workload_info import queue_order_timestamp
         self.priority[i] = wl.effective_priority
-        self.timestamp[i] = wl.creation_time
+        # FIFO timestamp is the eviction-aware queue-order timestamp so
+        # the device tiebreak can never diverge from the host heap.
+        self.timestamp[i] = queue_order_timestamp(wl)
         self.has_qr[i] = wl.has_quota_reservation
         ra = wl.status.requeue_at
         self.requeue_at[i] = -_INF_TS if ra is None else ra
@@ -134,8 +137,10 @@ class WorkloadRowCache:
         cluster event can re-activate it)."""
         i = self._row_of.get(info.key)
         if i is None:  # parked without ever being pushed
+            from kueue_tpu.workload_info import queue_order_timestamp
             self.on_push(info, (0.0, -info.obj.effective_priority,
-                                info.obj.creation_time, np.int64(1) << 59))
+                                queue_order_timestamp(info.obj),
+                                np.int64(1) << 59))
         i = self._row_of[info.key]
         self.info_of[i] = info
         self.active[i] = False

@@ -466,13 +466,16 @@ def encode_workloads(world: WorldTensors,
     hash_codes: dict = {}
     keys = []
     from kueue_tpu.cache.queues import scheduling_hash
+    from kueue_tpu.workload_info import queue_order_timestamp
     for i, info in enumerate(infos):
         keys.append(info.key)
         h = scheduling_hash(info.obj, info.cluster_queue)
         hash_id[i] = hash_codes.setdefault(h, len(hash_codes))
         cq[i] = cq_idx.get(info.cluster_queue, -1)
         priority[i] = info.obj.effective_priority
-        timestamp[i] = info.obj.creation_time
+        # Eviction-aware FIFO timestamp (workload.go:1087) — must match
+        # the host heap's ordering exactly.
+        timestamp[i] = queue_order_timestamp(info.obj)
         has_qr[i] = info.obj.has_quota_reservation
         if cq[i] < 0 or not dense_path_eligible(info):
             eligible[i] = False

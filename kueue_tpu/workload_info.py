@@ -111,6 +111,43 @@ class PodSetResources:
         return {r: q // self.count for r, q in self.requests.items()}
 
 
+@dataclass(frozen=True)
+class Ordering:
+    """pkg/workload/workload.go (Ordering): which timestamp drives FIFO
+    for workloads evicted by the WaitForPodsReady timeout —
+    config.EvictionTimestamp (default) or config.CreationTimestamp."""
+
+    pods_ready_requeuing_timestamp: str = "Eviction"
+
+
+DEFAULT_ORDERING = Ordering()
+_EPSILON = 1e-3  # the reference's time.Millisecond nudge
+
+
+def queue_order_timestamp(wl: Workload,
+                          ordering: Ordering = DEFAULT_ORDERING) -> float:
+    """workload.go:1087 (Ordering.GetQueueOrderTimestamp): FIFO uses the
+    eviction timestamp for PodsReady-timeout and admission-check
+    evictions, and — when priority sorting is disabled — nudges
+    InCohortReclaimWhileBorrowing preemptees just past their preemptor."""
+    from kueue_tpu.api.types import WorkloadConditionType as WCT
+    from kueue_tpu.config import features
+
+    evicted = wl.condition(WCT.EVICTED)
+    if evicted is not None and evicted.status:
+        if (ordering.pods_ready_requeuing_timestamp == "Eviction"
+                and evicted.reason == "PodsReadyTimeout"):
+            return evicted.last_transition_time
+        if evicted.reason == "AdmissionCheck":
+            return evicted.last_transition_time
+    if not features.enabled("PrioritySortingWithinCohort"):
+        preempted = wl.condition(WCT.PREEMPTED)
+        if (preempted is not None and preempted.status
+                and preempted.reason == "InCohortReclaimWhileBorrowing"):
+            return preempted.last_transition_time + _EPSILON
+    return wl.creation_time
+
+
 @dataclass
 class WorkloadInfo:
     """Reference: pkg/workload/workload.go:215 (Info)."""

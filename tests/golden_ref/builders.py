@@ -327,6 +327,12 @@ def MakeCohort(name: str):
     return CohortWrapper(name)
 
 
+def MakeTopology(name: str, *levels: str):
+    """utiltestingapi.MakeTopology(...).Levels(...) in one call."""
+    from kueue_tpu.api.types import Topology, TopologyLevel
+    return Topology(name, tuple(TopologyLevel(lv) for lv in levels))
+
+
 class WorkloadWrapper:
     """utiltestingapi.MakeWorkload — only what the golden tables use."""
 
@@ -341,6 +347,7 @@ class WorkloadWrapper:
         self._creation = 0.0
         self._admission: Optional[tuple[str, list[dict[str, str]],
                                         list[int]]] = None
+        self._admitted_at = 0.0
         self._reclaimable: dict[str, int] = {}
 
     def PodSets(self, *ps: PodSet) -> "WorkloadWrapper":
@@ -379,6 +386,12 @@ class WorkloadWrapper:
         self._admission = (cq, flavors or [], counts or [])
         return self
 
+    def ReserveQuotaAt(self, cq: str, at: float,
+                       flavors: Optional[list[dict[str, str]]] = None
+                       ) -> "WorkloadWrapper":
+        self._admitted_at = at
+        return self.ReserveQuota(cq, flavors)
+
     def Obj(self) -> Workload:
         WorkloadWrapper._counter += 1
         wl = Workload(
@@ -398,6 +411,7 @@ class WorkloadWrapper:
             cq = admission[0]
         info = WorkloadInfo.from_workload(wl, cq)
         if admission is not None:
+            from kueue_tpu.api.types import WorkloadConditionType as WCT
             _, flavors, counts = admission
             for i, psr in enumerate(info.total_requests):
                 fl = flavors[i] if i < len(flavors) else {}
@@ -405,6 +419,11 @@ class WorkloadWrapper:
                                for r in psr.requests}
                 if counts and i < len(counts):
                     psr.count = counts[i]
+            wl.set_condition(WCT.QUOTA_RESERVED, True,
+                             reason="QuotaReserved",
+                             now=self._admitted_at)
+            wl.set_condition(WCT.ADMITTED, True, reason="Admitted",
+                             now=self._admitted_at)
         return info
 
 

@@ -146,6 +146,7 @@ def run_assign_case(
     topologies=None,
     nodes=None,
     counts: Optional[list[int]] = None,
+    preempt_slice=None,  # list of (podset, requests, flavors-by-resource)
 ):
     """Build the world exactly as the Go driver does and run Assign."""
     from kueue_tpu.api.types import Workload
@@ -173,11 +174,75 @@ def run_assign_case(
              for (f, r), v in secondary_usage.items()})
 
     info = WorkloadInfo.from_workload(wl, cluster_queue.name)
+    slice_info = None
+    if preempt_slice is not None:
+        from kueue_tpu.workload_info import PodSetResources
+        slice_info = WorkloadInfo(
+            obj=Workload(name="orig-slice"),
+            cluster_queue=cluster_queue.name,
+            total_requests=[
+                PodSetResources(name=nm, count=1, requests=dict(reqs),
+                                flavors=dict(flavors))
+                for nm, reqs, flavors in preempt_slice])
     assigner = FlavorAssigner(
         info, cq_snap, snap.resource_flavors,
         enable_fair_sharing=enable_fair_sharing,
-        oracle=TestOracle(simulation_result or {}))
+        oracle=TestOracle(simulation_result or {}),
+        preempt_workload_slice=slice_info)
     return assigner.assign(counts=counts)
+
+
+def make_assignment(*podsets) -> "object":
+    """Build a flavorassigner.Assignment like the Go tables do
+    (preemption_test.go singlePodSetAssignment):
+    each podset = (name, {resource: (flavor, mode)}, usage-amounts[,
+    count])."""
+    from kueue_tpu.scheduler.flavorassigner import (
+        Assignment,
+        FlavorAssignment,
+        PodSetAssignment,
+    )
+
+    a = Assignment()
+    for ps in podsets:
+        name, flavors, requests = ps[0], ps[1], ps[2]
+        count = ps[3] if len(ps) > 3 else 1
+        psa = PodSetAssignment(
+            name=name,
+            flavors={res: FlavorAssignment(fl, mode)
+                     for res, (fl, mode) in flavors.items()},
+            requests=dict(requests), count=count)
+        a.pod_sets.append(psa)
+        for res, (fl, mode) in flavors.items():
+            fr = FlavorResource(fl, res)
+            a.usage[fr] = a.usage.get(fr, 0) + requests.get(res, 0)
+    return a
+
+
+def run_preemption_case(
+    *,
+    cluster_queues,
+    admitted,  # list of WorkloadInfo (already flavor-assigned)
+    incoming,  # WorkloadInfo with cluster_queue = targetCQ
+    assignment,
+    resource_flavors=None,
+    cohorts=(),
+    enable_fair_sharing: bool = False,
+    now: float = 0.0,
+):
+    """Mirror of preemption_test.go's driver: snapshot the admitted
+    world, run GetTargets, return sorted (victim-name, reason) pairs."""
+    from kueue_tpu.api.types import ResourceFlavor
+    from kueue_tpu.scheduler.preemption import Preemptor
+
+    flavors = resource_flavors or [ResourceFlavor("default"),
+                                   ResourceFlavor("alpha"),
+                                   ResourceFlavor("beta")]
+    snap = build_snapshot(list(cluster_queues), list(cohorts), flavors,
+                          list(admitted))
+    preemptor = Preemptor(enable_fair_sharing=enable_fair_sharing)
+    targets = preemptor.get_targets(incoming, assignment, snap, now=now)
+    return sorted((t.workload.obj.name, t.reason) for t in targets)
 
 
 def assert_assignment(assignment, want_mode: Mode,

@@ -19,6 +19,7 @@ from kueue_tpu.api.types import (
 )
 
 from .builders import (
+    MakeTopology,
     Gi,
     MakeClusterQueue,
     MakeFlavorQuotas,
@@ -882,6 +883,308 @@ case(
 )
 
 
+case(
+    "when borrowing while preemption is needed, but borrowingLimit"
+    " exceeds the quota available in the cohort",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "12").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .Preemption(reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY,
+                borrow_within_cohort=BorrowWithinCohort(
+                    policy=BorrowWithinCohortPolicy.LOWER_PRIORITY))
+    .ResourceGroup(MakeFlavorQuotas("one")
+                   .Resource("cpu", "0", borrowing="12").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "11").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_usage={("one", "cpu"): 10000},
+    want_mode=NO_FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {}, count=1, reasons=(
+            "insufficient quota for cpu in flavor one, previously"
+            " considered podsets requests (0) + current podset request"
+            " (12) > maximum capacity (11)",))],
+        usage={}),
+)
+
+case(
+    "lend try next flavor, found the second flavor",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "9").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .FlavorFungibility(when_can_borrow=FungibilityPolicy.TRY_NEXT_FLAVOR,
+                       when_can_preempt=FungibilityPolicy.TRY_NEXT_FLAVOR)
+    .ResourceGroup(
+        MakeFlavorQuotas("one").Resource("pods", "10")
+        .Resource("cpu", "10", lending="1").Obj(),
+        MakeFlavorQuotas("two").Resource("pods", "10")
+        .Resource("cpu", "10", lending="0").Obj())
+    .Cohort("test-cohort").Obj(),
+    usage={("one", "cpu"): 2000},
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "1").Obj())
+    .Cohort("test-cohort").Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("two", FIT, -1),
+                                      "pods": wf("two", FIT, -1)},
+                            count=1)],
+        usage={("two", "cpu"): 9000, ("two", "pods"): 1}),
+)
+
+case(
+    "lend try next flavor, found the first flavor",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "9").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .FlavorFungibility(when_can_borrow=FungibilityPolicy.TRY_NEXT_FLAVOR,
+                       when_can_preempt=FungibilityPolicy.TRY_NEXT_FLAVOR)
+    .ResourceGroup(
+        MakeFlavorQuotas("one").Resource("pods", "10")
+        .Resource("cpu", "10", lending="1").Obj(),
+        MakeFlavorQuotas("two").Resource("pods", "10")
+        .Resource("cpu", "1", lending="0").Obj())
+    .Cohort("test-cohort").Obj(),
+    usage={("one", "cpu"): 2000},
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "1").Obj())
+    .Cohort("test-cohort").Obj(),
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("one", FIT, -1),
+                                      "pods": wf("one", FIT, -1)},
+                            count=1)],
+        borrowing=1,
+        usage={("one", "cpu"): 9000, ("one", "pods"): 1}),
+)
+
+case(
+    "cannot preempt in cohort (oracle returns None) for the first"
+    " flavor, tries the second flavor (which fits)",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "2").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .FlavorFungibility(when_can_borrow=FungibilityPolicy.BORROW,
+                       when_can_preempt=FungibilityPolicy.PREEMPT)
+    .Preemption(reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY,
+                borrow_within_cohort=BorrowWithinCohort(
+                    policy=BorrowWithinCohortPolicy.LOWER_PRIORITY))
+    .ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "0", borrowing="2")
+        .Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "0", borrowing="2")
+        .Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "2").Obj(),
+                   MakeFlavorQuotas("two").Resource("cpu", "2").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_usage={("one", "cpu"): 2000},
+    simulation={("one", "cpu"): (PMode.NO_CANDIDATES, 0)},
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("two", FIT, -1)},
+                            count=1)],
+        borrowing=1,
+        usage={("two", "cpu"): 2000}),
+)
+
+case(
+    "quota exhausted, but can preempt in cohort and ClusterQueue",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "9").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("pods", "10")
+        .Resource("cpu", "10", lending="0").Obj())
+    .Cohort("test-cohort").Obj(),
+    usage={("one", "cpu"): 2000},
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("pods", "0")
+                   .Resource("cpu", "0").Obj())
+    .Cohort("test-cohort").Obj(),
+    simulation={("one", "cpu"): (PMode.PREEMPT, 1)},
+    want_mode=PREEMPT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("one", PREEMPT, -1),
+                                      "pods": wf("one", FIT, -1)},
+                            count=1, reasons=(
+            "insufficient unused quota for cpu in flavor one,"
+            " 1 more needed",))],
+        borrowing=1,
+        usage={("one", "cpu"): 9000, ("one", "pods"): 1}),
+)
+
+case(
+    "when borrowing while preemption is needed for flavor one, fair"
+    " sharing enabled, reclaimWithinCohort=Any",
+    fair=True,
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "12").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .Preemption(reclaim_within_cohort=PreemptionPolicy.ANY)
+    .FlavorFungibility(when_can_borrow=FungibilityPolicy.BORROW,
+                       when_can_preempt=FungibilityPolicy.PREEMPT)
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "0").Obj(),
+                   MakeFlavorQuotas("two").Resource("cpu", "12").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "12").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_usage={("one", "cpu"): 10000},
+    simulation={("one", "cpu"): (PMode.PREEMPT, 1)},
+    want_mode=PREEMPT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("one", PREEMPT, 0)},
+                            count=1, reasons=(
+            "insufficient unused quota for cpu in flavor one,"
+            " 10 more needed",))],
+        borrowing=1,
+        usage={("one", "cpu"): 12000}),
+)
+
+case(
+    "when borrowing while preemption is needed for flavor one, fair"
+    " sharing enabled, reclaimWithinCohort=Never",
+    fair=True,
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "12").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .Preemption(reclaim_within_cohort=PreemptionPolicy.NEVER)
+    .FlavorFungibility(when_can_borrow=FungibilityPolicy.BORROW,
+                       when_can_preempt=FungibilityPolicy.PREEMPT)
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "0").Obj(),
+                   MakeFlavorQuotas("two").Resource("cpu", "12").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_cq=MakeClusterQueue("test-secondary-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("one").Resource("cpu", "12").Obj())
+    .Cohort("test-cohort").Obj(),
+    secondary_usage={("one", "cpu"): 10000},
+    simulation={("one", "cpu"): (PMode.NO_CANDIDATES, 0)},
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("two", FIT, -1)},
+                            count=1)],
+        usage={("two", "cpu"): 12000}),
+)
+
+case(
+    "workload slice preemption fits in the original workload resource"
+    " flavor",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "3")
+          .Request("memory", "10Mi").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "3")
+        .Resource("memory", "1Gi").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "4")
+        .Resource("memory", "2Gi").Obj()).Obj(),
+    preempt_slice=[(DEFAULT, {"cpu": 2000, "memory": 10 * Mi},
+                    {"cpu": "two", "memory": "two"})],
+    want_mode=FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {"cpu": wf("two", FIT, -1),
+                                      "memory": wf("two", FIT, -1)},
+                            count=1)],
+        usage={("two", "cpu"): 3000, ("two", "memory"): 10 * Mi}),
+)
+
+case(
+    "workload slice preemption does not fit in the original workload"
+    " resource flavor",
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "3")
+          .Request("memory", "10Mi").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "500m")
+        .Resource("memory", "1Gi").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "4")
+        .Resource("memory", "2Gi").Obj()).Obj(),
+    preempt_slice=[(DEFAULT, {"cpu": 2000, "memory": 10 * Mi},
+                    {"cpu": "one", "memory": "one"})],
+    want_mode=NO_FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {}, count=1, reasons=(
+            "insufficient quota for cpu in flavor one, previously"
+            " considered podsets requests (0) + current podset request"
+            " (1) > maximum capacity (500m)",
+            "could not assign two flavor since the original workload"
+            " is assigned: one"))],
+        usage={}),
+)
+
+case(
+    "multiple TAS flavors assigned to different resources in the same"
+    " PodSet leads to NoFit",
+    topologies=[MakeTopology("tas-topo-a", "kubernetes.io/hostname"),
+                MakeTopology("tas-topo-b", "kubernetes.io/hostname")],
+    pods=[MakePodSet(DEFAULT, 1).Request("cpu", "1")
+          .Request("memory", "1Mi")
+          .RequiredTopologyRequest("kubernetes.io/hostname").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue")
+    .ResourceGroup(MakeFlavorQuotas("tas-a").Resource("cpu", "10")
+                   .Obj())
+    .ResourceGroup(MakeFlavorQuotas("tas-b").Resource("memory", "10Mi")
+                   .Obj()).Obj(),
+    want_mode=NO_FIT,
+    want=WantAssignment(
+        podsets=[WantPodSet(DEFAULT, {
+            "cpu": wf("tas-a", FIT, -1),
+            "memory": wf("tas-b", FIT, -1)}, count=1)],
+        usage={("tas-a", "cpu"): 1000, ("tas-b", "memory"): Mi}),
+)
+
+case(
+    "multi-podset, one fits and another fails, fitting podset attempts"
+    " skipped in resolveNoFitReason",
+    pods=[MakePodSet("fitting-podset", 1).Request("cpu", "1")
+          .NodeSelector("type", "one").Obj(),
+          MakePodSet("blocking-podset", 1).Request("cpu", "5").Obj()],
+    cq=MakeClusterQueue("test-clusterqueue").ResourceGroup(
+        MakeFlavorQuotas("one").Resource("cpu", "2").Obj(),
+        MakeFlavorQuotas("two").Resource("cpu", "2").Obj()).Obj(),
+    want_mode=NO_FIT,
+    want=WantAssignment(
+        podsets=[
+            WantPodSet("fitting-podset", {"cpu": wf("one", FIT, 0)},
+                       count=1),
+            WantPodSet("blocking-podset", {}, count=1, reasons=(
+                "insufficient quota for cpu in flavor one, previously"
+                " considered podsets requests (1) + current podset"
+                " request (5) > maximum capacity (2)",
+                "insufficient quota for cpu in flavor two, previously"
+                " considered podsets requests (0) + current podset"
+                " request (5) > maximum capacity (2)"))],
+        usage={("one", "cpu"): 1000}),
+)
+
+
+def test_workload_slice_pinning_via_engine_cycle():
+    """End-to-end: the scale-up slice reuses the original flavor through
+    the scheduler cycle path (scheduler.go:765 ReplacedWorkloadSlice)."""
+    from kueue_tpu.api.types import (LocalQueue, ResourceFlavor, Workload,
+                                     PodSet)
+    from kueue_tpu.controllers.engine import Engine
+
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("one"))
+    eng.create_resource_flavor(ResourceFlavor("two"))
+    eng.create_cluster_queue(
+        MakeClusterQueue("cq").ResourceGroup(
+            MakeFlavorQuotas("one").Resource("cpu", "2").Obj(),
+            MakeFlavorQuotas("two").Resource("cpu", "8").Obj()).Obj())
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    eng.submit(Workload(name="orig", queue_name="lq",
+                        pod_sets=(PodSet("main", 1, {"cpu": 3000}),)))
+    eng.schedule_once()
+    orig = eng.workloads["default/orig"]
+    assert orig.status.admission.pod_set_assignments[0].flavors["cpu"] \
+        == "two"
+    # Scale up: replacement slice requests 4 cpu; "one" has free quota
+    # but the slice is pinned to "two".
+    eng.submit(Workload(name="scaled", queue_name="lq",
+                        replaced_workload_slice="default/orig",
+                        pod_sets=(PodSet("main", 1, {"cpu": 4000}),)))
+    for _ in range(3):
+        if eng.schedule_once() is None:
+            break
+    scaled = eng.workloads["default/scaled"]
+    assert scaled.status.admission is not None
+    assert scaled.status.admission.pod_set_assignments[0] \
+        .flavors["cpu"] == "two"
+
+
 def test_all_zero_uncovered_podset_does_not_truncate_assignment():
     """A podset whose requests are all explicit zeros of uncovered
     resources is status-clean Fit with no flavors
@@ -918,6 +1221,7 @@ def test_assign_flavors_golden(name):
         topologies=tc.get("topologies"),
         nodes=tc.get("nodes"),
         counts=tc.get("counts"),
+        preempt_slice=tc.get("preempt_slice"),
     )
     assert_assignment(assignment, tc["want_mode"], tc.get("want"),
                       case=name)

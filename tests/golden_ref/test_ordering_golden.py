@@ -1,0 +1,243 @@
+"""Golden fixtures for ordering semantics, transliterated from:
+
+  * preemption_test.go TestCandidatesOrdering (candidate sort)
+  * scheduler_test.go TestEntryOrdering (classical entry iterator,
+    PrioritySortingWithinCohort gate, pods-ready requeuing timestamp)
+"""
+
+import pytest
+
+from kueue_tpu.api.types import (
+    PRIORITY_BOOST_ANNOTATION,
+    Condition,
+    WorkloadConditionType as WCT,
+)
+from kueue_tpu.config import features
+from kueue_tpu.scheduler.cycle import Entry, _classical_key
+from kueue_tpu.scheduler.flavorassigner import Assignment
+from kueue_tpu.scheduler.preemption import candidates_ordering_key
+from kueue_tpu.workload_info import Ordering, WorkloadInfo
+
+from .builders import MakeWorkload
+
+NOW = 1000.0
+
+
+@pytest.fixture(autouse=True)
+def _reset_features():
+    yield
+    features.reset()
+
+
+def wl(name, cq="preemptor", priority=0, at=NOW, boost_ann=None,
+       evicted_at=None, queue=None, lq_usage=None, admitted=True,
+       creation=None):
+    w = MakeWorkload(name).Priority(priority)
+    w.Request("cpu", "1")
+    if creation is not None:
+        w.Creation(creation)
+    if queue:
+        w.Queue(queue)
+    if admitted and evicted_at is None:
+        info = w.ReserveQuotaAt(cq, at).Info()
+    else:
+        info = w.Info(cq)
+    if boost_ann is not None:
+        info.obj.annotations[PRIORITY_BOOST_ANNOTATION] = boost_ann
+    if evicted_at is not None:
+        info.obj.set_condition(WCT.EVICTED, True, now=evicted_at)
+    if lq_usage is not None:
+        info.local_queue_fs_usage = lq_usage
+    return info
+
+
+def sort_candidates(infos, afs=False, cq="preemptor"):
+    return [i.obj.name for i in sorted(
+        infos, key=lambda c: candidates_ordering_key(c, cq, NOW, afs))]
+
+
+# -- TestCandidatesOrdering (preemption_test.go:4613) --
+
+def test_candidates_sorted_by_priority():
+    got = sort_candidates([wl("high", priority=10), wl("low", priority=-10)])
+    assert got == ["low", "high"]
+
+
+def test_candidates_sorted_by_effective_priority_with_boost():
+    got = sort_candidates([
+        wl("high-boost", priority=10, boost_ann="100"),
+        wl("low-boost", priority=10, boost_ann="5")])
+    assert got == ["low-boost", "high-boost"]
+
+
+def test_candidate_missing_priority_boost_defaults_to_zero():
+    got = sort_candidates([
+        wl("missing-boost", priority=10),
+        wl("has-boost", priority=10, boost_ann="5")])
+    assert got == ["missing-boost", "has-boost"]
+
+
+def test_candidate_invalid_priority_boost_defaults_to_zero():
+    got = sort_candidates([
+        wl("invalid-boost", priority=10, boost_ann="invalid"),
+        wl("valid-boost", priority=10, boost_ann="5")])
+    assert got == ["invalid-boost", "valid-boost"]
+
+
+def test_candidates_evicted_workload_first():
+    got = sort_candidates([
+        wl("other", priority=10),
+        wl("evicted", admitted=False, evicted_at=NOW)])
+    assert got == ["evicted", "other"]
+
+
+def test_candidates_workload_from_different_cq_first():
+    got = sort_candidates([
+        wl("preemptorCq", priority=10),
+        wl("other", cq="different", priority=10)])
+    assert got == ["other", "preemptorCq"]
+
+
+def test_candidates_old_workloads_last():
+    got = sort_candidates([
+        wl("older", at=NOW - 1),
+        wl("younger", at=NOW + 1),
+        wl("current", at=NOW)])
+    assert got == ["younger", "current", "older"]
+
+
+def test_candidates_higher_lq_usage_first():
+    got = sort_candidates([
+        wl("low_lq_usage", priority=1, queue="low_usage_lq",
+           lq_usage=0.1),
+        wl("mid_lq_usage", priority=10, queue="mid_usage_lq",
+           lq_usage=0.5)], afs=True)
+    assert got == ["mid_lq_usage", "low_lq_usage"]
+
+
+def test_candidates_different_cq_sorted_by_priority_and_timestamp():
+    got = sort_candidates([
+        wl("mid_lq_usage", priority=10, queue="mid_usage_lq",
+           lq_usage=0.5),
+        wl("high_lq_usage_different_cq", cq="different_cq", priority=1,
+           queue="high_usage_lq_different_cq", lq_usage=1.0)], afs=True)
+    assert got == ["high_lq_usage_different_cq", "mid_lq_usage"]
+
+
+# -- TestEntryOrdering (scheduler_test.go:6651) --
+
+def entry(name, creation, priority=0, borrowing=0, evicted_at=None,
+          evicted_reason="PodsReadyTimeout", preempted_at=None,
+          preempted_reason=None):
+    w = MakeWorkload(name).Priority(priority).Creation(creation)
+    w.Request("cpu", "1")
+    info = w.Info("cq")
+    if evicted_at is not None:
+        info.obj.status.conditions[WCT.EVICTED] = Condition(
+            type=WCT.EVICTED, status=True, reason=evicted_reason,
+            last_transition_time=evicted_at)
+    if preempted_at is not None:
+        info.obj.status.conditions[WCT.PREEMPTED] = Condition(
+            type=WCT.PREEMPTED, status=True, reason=preempted_reason,
+            last_transition_time=preempted_at)
+    a = Assignment()
+    a.borrowing = borrowing
+    return Entry(info=info, assignment=a)
+
+
+def entry_input():
+    return [
+        entry("old_borrowing", NOW, borrowing=1),
+        entry("old", NOW + 1),
+        entry("new", NOW + 3),
+        entry("high_pri_borrowing", NOW + 3, priority=1, borrowing=1),
+        entry("new_high_pri", NOW + 4, priority=1),
+        entry("new_borrowing", NOW + 3, borrowing=1),
+        entry("evicted_borrowing", NOW + 1, borrowing=1,
+              evicted_at=NOW + 2),
+        entry("recently_evicted", NOW, evicted_at=NOW + 2),
+        entry("high_pri_borrowing_more", NOW + 3, priority=1,
+              borrowing=2),
+    ]
+
+
+def preempted_input():
+    return [
+        entry("old-mid-recently-preempted-in-queue", NOW, priority=1,
+              preempted_at=NOW + 5, preempted_reason="InClusterQueue"),
+        entry("old-mid-recently-reclaimed-while-borrowing", NOW,
+              priority=1, preempted_at=NOW + 6,
+              preempted_reason="InCohortReclaimWhileBorrowing"),
+        entry("old-mid-more-recently-reclaimed-while-borrowing", NOW,
+              priority=1, preempted_at=NOW + 7,
+              preempted_reason="InCohortReclaimWhileBorrowing"),
+        entry("old-mid-not-preempted-yet", NOW + 1, priority=1),
+        entry("preemptor", NOW + 7, priority=2),
+    ]
+
+
+def sort_entries(entries, ordering=None):
+    return [e.obj.name for e in sorted(
+        entries, key=lambda e: _classical_key(e, ordering))]
+
+
+def test_entry_ordering_priority_enabled_eviction_timestamp():
+    features.set_feature("PrioritySortingWithinCohort", True)
+    got = sort_entries(entry_input(),
+                       Ordering(pods_ready_requeuing_timestamp="Eviction"))
+    assert got == [
+        "new_high_pri", "old", "recently_evicted", "new",
+        "high_pri_borrowing", "old_borrowing", "evicted_borrowing",
+        "new_borrowing", "high_pri_borrowing_more"]
+
+
+def test_entry_ordering_priority_enabled_creation_timestamp():
+    features.set_feature("PrioritySortingWithinCohort", True)
+    got = sort_entries(entry_input(),
+                       Ordering(pods_ready_requeuing_timestamp="Creation"))
+    assert got == [
+        "new_high_pri", "recently_evicted", "old", "new",
+        "high_pri_borrowing", "old_borrowing", "evicted_borrowing",
+        "new_borrowing", "high_pri_borrowing_more"]
+
+
+def test_entry_ordering_priority_disabled_eviction_timestamp():
+    features.set_feature("PrioritySortingWithinCohort", False)
+    got = sort_entries(entry_input(),
+                       Ordering(pods_ready_requeuing_timestamp="Eviction"))
+    assert got == [
+        "old", "recently_evicted", "new", "new_high_pri",
+        "old_borrowing", "evicted_borrowing", "high_pri_borrowing",
+        "new_borrowing", "high_pri_borrowing_more"]
+
+
+def test_entry_ordering_priority_disabled_creation_timestamp():
+    features.set_feature("PrioritySortingWithinCohort", False)
+    got = sort_entries(entry_input(),
+                       Ordering(pods_ready_requeuing_timestamp="Creation"))
+    assert got == [
+        "recently_evicted", "old", "new", "new_high_pri",
+        "old_borrowing", "evicted_borrowing", "high_pri_borrowing",
+        "new_borrowing", "high_pri_borrowing_more"]
+
+
+def test_entry_ordering_preempted_priority_disabled():
+    features.set_feature("PrioritySortingWithinCohort", False)
+    got = sort_entries(preempted_input())
+    assert got == [
+        "old-mid-recently-preempted-in-queue",
+        "old-mid-not-preempted-yet",
+        "old-mid-recently-reclaimed-while-borrowing",
+        "preemptor",
+        "old-mid-more-recently-reclaimed-while-borrowing"]
+
+
+def test_entry_ordering_preempted_priority_enabled():
+    features.set_feature("PrioritySortingWithinCohort", True)
+    got = sort_entries(preempted_input())
+    assert got == [
+        "preemptor",
+        "old-mid-recently-preempted-in-queue",
+        "old-mid-recently-reclaimed-while-borrowing",
+        "old-mid-more-recently-reclaimed-while-borrowing",
+        "old-mid-not-preempted-yet"]

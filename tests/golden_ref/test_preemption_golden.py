@@ -1,0 +1,622 @@
+"""Golden fixtures transliterated from the reference's
+pkg/scheduler/preemption/preemption_test.go (TestPreemption).
+
+Each case preserves the Go table's world (ClusterQueues, admitted
+workloads with their admissions, the incoming workload and its flavor
+assignment) and asserts the Go-authored expected outputs: WHICH
+workloads are preempted and with WHICH reason (InClusterQueue /
+InCohortReclamation / InCohortReclaimWhileBorrowing)."""
+
+import pytest
+
+from kueue_tpu.api.types import (
+    BorrowWithinCohort,
+    BorrowWithinCohortPolicy,
+    PreemptionPolicy,
+)
+from kueue_tpu.scheduler.flavorassigner import Mode
+
+from .builders import (
+    MakeClusterQueue,
+    MakeCohort,
+    MakeFlavorQuotas,
+    MakePodSet,
+    MakeWorkload,
+)
+from .harness import make_assignment, run_preemption_case
+
+NOW = 1000.0
+FIT = Mode.FIT
+PREEMPT = Mode.PREEMPT
+DEFAULT = "main"
+
+IN_CQ = "InClusterQueue"
+RECLAIM = "InCohortReclamation"
+RECLAIM_BORROW = "InCohortReclaimWhileBorrowing"
+
+
+def default_cluster_queues():
+    """preemption_test.go:72-280 (defaultClusterQueues)."""
+    return [
+        MakeClusterQueue("standalone")
+        .ResourceGroup(MakeFlavorQuotas("default").Resource("cpu", "6")
+                       .Obj())
+        .ResourceGroup(MakeFlavorQuotas("alpha")
+                       .Resource("memory", "3Gi").Obj(),
+                       MakeFlavorQuotas("beta")
+                       .Resource("memory", "3Gi").Obj())
+        .Preemption(within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY)
+        .Obj(),
+        MakeClusterQueue("c1").Cohort("cohort")
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "6", borrowing="6")
+                       .Resource("memory", "3Gi", borrowing="3Gi").Obj())
+        .Preemption(within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY)
+        .Obj(),
+        MakeClusterQueue("c2").Cohort("cohort")
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "6", borrowing="6")
+                       .Resource("memory", "3Gi", borrowing="3Gi").Obj())
+        .Preemption(within_cluster_queue=PreemptionPolicy.NEVER,
+                    reclaim_within_cohort=PreemptionPolicy.ANY)
+        .Obj(),
+        MakeClusterQueue("d1").Cohort("cohort-no-limits")
+        .ResourceGroup(MakeFlavorQuotas("default").Resource("cpu", "6")
+                       .Resource("memory", "3Gi").Obj())
+        .Preemption(within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY)
+        .Obj(),
+        MakeClusterQueue("d2").Cohort("cohort-no-limits")
+        .ResourceGroup(MakeFlavorQuotas("default").Resource("cpu", "6")
+                       .Resource("memory", "3Gi").Obj())
+        .Preemption(within_cluster_queue=PreemptionPolicy.NEVER,
+                    reclaim_within_cohort=PreemptionPolicy.ANY)
+        .Obj(),
+        MakeClusterQueue("l1").Cohort("legion")
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "6", borrowing="12")
+                       .Resource("memory", "3Gi", borrowing="6Gi").Obj())
+        .Preemption(within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY)
+        .Obj(),
+        MakeClusterQueue("preventStarvation")
+        .ResourceGroup(MakeFlavorQuotas("default").Resource("cpu", "6")
+                       .Obj())
+        .Preemption(within_cluster_queue=PreemptionPolicy.
+                    LOWER_OR_NEWER_EQUAL_PRIORITY)
+        .Obj(),
+        MakeClusterQueue("a_standard").Cohort("with_shared_cq")
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "1", borrowing="12").Obj())
+        .Preemption(within_cluster_queue=PreemptionPolicy.NEVER,
+                    reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY,
+                    borrow_within_cohort=BorrowWithinCohort(
+                        policy=BorrowWithinCohortPolicy.LOWER_PRIORITY,
+                        max_priority_threshold=0))
+        .Obj(),
+        MakeClusterQueue("b_standard").Cohort("with_shared_cq")
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "1", borrowing="12").Obj())
+        .Preemption(within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=PreemptionPolicy.ANY,
+                    borrow_within_cohort=BorrowWithinCohort(
+                        policy=BorrowWithinCohortPolicy.LOWER_PRIORITY,
+                        max_priority_threshold=0))
+        .Obj(),
+        MakeClusterQueue("a_best_effort").Cohort("with_shared_cq")
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "1", borrowing="12").Obj())
+        .Preemption(within_cluster_queue=PreemptionPolicy.NEVER,
+                    reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY,
+                    borrow_within_cohort=BorrowWithinCohort(
+                        policy=BorrowWithinCohortPolicy.LOWER_PRIORITY,
+                        max_priority_threshold=0))
+        .Obj(),
+        MakeClusterQueue("b_best_effort").Cohort("with_shared_cq")
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "0", borrowing="13").Obj())
+        .Preemption(within_cluster_queue=PreemptionPolicy.NEVER,
+                    reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY,
+                    borrow_within_cohort=BorrowWithinCohort(
+                        policy=BorrowWithinCohortPolicy.LOWER_PRIORITY,
+                        max_priority_threshold=0))
+        .Obj(),
+        MakeClusterQueue("shared").Cohort("with_shared_cq")
+        .ResourceGroup(MakeFlavorQuotas("default").Resource("cpu", "10")
+                       .Obj())
+        .Obj(),
+        MakeClusterQueue("lend1").Cohort("cohort-lend")
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "6", lending="4").Obj())
+        .Preemption(within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY)
+        .Obj(),
+        MakeClusterQueue("lend2").Cohort("cohort-lend")
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "6", lending="2").Obj())
+        .Preemption(within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY)
+        .Obj(),
+        MakeClusterQueue("a").Cohort("cohort-three")
+        .ResourceGroup(MakeFlavorQuotas("default").Resource("cpu", "2")
+                       .Resource("memory", "2").Obj())
+        .Preemption(within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=PreemptionPolicy.ANY)
+        .Obj(),
+        MakeClusterQueue("b").Cohort("cohort-three")
+        .ResourceGroup(MakeFlavorQuotas("default").Resource("cpu", "2")
+                       .Resource("memory", "2").Obj())
+        .Obj(),
+        MakeClusterQueue("c").Cohort("cohort-three")
+        .ResourceGroup(MakeFlavorQuotas("default").Resource("cpu", "2")
+                       .Resource("memory", "2").Obj())
+        .Obj(),
+    ]
+
+
+def adm(name, cq, requests, priority=0, flavors=None, creation=None,
+        at=NOW):
+    """An admitted workload: requests is {resource: qty-string};
+    flavors maps resource -> flavor (default 'default')."""
+    w = MakeWorkload(name).Priority(priority)
+    for res, qty in requests.items():
+        w.Request(res, qty)
+    if creation is not None:
+        w.Creation(creation)
+    return w.ReserveQuotaAt(cq, at, [flavors or {}]).Info()
+
+
+def incoming(requests, priority=0, target_cq="standalone",
+             creation=None):
+    w = MakeWorkload("in").Priority(priority)
+    for res, qty in requests.items():
+        w.Request(res, qty)
+    if creation is not None:
+        w.Creation(creation)
+    return w.Info(target_cq)
+
+
+def sps(flavors, requests=None):
+    """singlePodSetAssignment (preemption_test.go:4779)."""
+    return make_assignment((DEFAULT, flavors, requests or {}))
+
+
+CASES = {}
+
+
+def case(name, **kw):
+    CASES[name] = kw
+
+
+case(
+    "preempt lowest priority",
+    admitted=lambda: [
+        adm("low", "standalone", {"cpu": "2"}, priority=-1),
+        adm("mid", "standalone", {"cpu": "2"}),
+        adm("high", "standalone", {"cpu": "2"}, priority=1)],
+    incoming=lambda: incoming({"cpu": "2"}, priority=1),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("low", IN_CQ)],
+)
+
+case(
+    "preempt multiple",
+    admitted=lambda: [
+        adm("low", "standalone", {"cpu": "2"}, priority=-1),
+        adm("mid", "standalone", {"cpu": "2"}),
+        adm("high", "standalone", {"cpu": "2"}, priority=1)],
+    incoming=lambda: incoming({"cpu": "3"}, priority=1),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("low", IN_CQ), ("mid", IN_CQ)],
+)
+
+case(
+    "no preemption for low priority",
+    admitted=lambda: [
+        adm("low", "standalone", {"cpu": "3"}, priority=-1),
+        adm("mid", "standalone", {"cpu": "3"})],
+    incoming=lambda: incoming({"cpu": "1"}, priority=-1),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[],
+)
+
+case(
+    "not enough low priority workloads",
+    admitted=lambda: [
+        adm("low", "standalone", {"cpu": "3"}, priority=-1),
+        adm("mid", "standalone", {"cpu": "3"})],
+    incoming=lambda: incoming({"cpu": "4"}),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[],
+)
+
+case(
+    "some free quota, preempt low priority",
+    admitted=lambda: [
+        adm("low", "standalone", {"cpu": "1"}, priority=-1),
+        adm("mid", "standalone", {"cpu": "1"}),
+        adm("high", "standalone", {"cpu": "3"}, priority=1)],
+    incoming=lambda: incoming({"cpu": "2"}, priority=1),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("low", IN_CQ)],
+)
+
+case(
+    "minimal set excludes low priority",
+    admitted=lambda: [
+        adm("low", "standalone", {"cpu": "1"}, priority=-1),
+        adm("mid", "standalone", {"cpu": "2"}),
+        adm("high", "standalone", {"cpu": "3"}, priority=1)],
+    incoming=lambda: incoming({"cpu": "2"}, priority=1),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("mid", IN_CQ)],
+)
+
+case(
+    "only preempt workloads using the chosen flavor",
+    admitted=lambda: [
+        adm("low", "standalone", {"memory": "2Gi"}, priority=-1,
+            flavors={"memory": "alpha"}),
+        adm("mid", "standalone", {"memory": "1Gi"},
+            flavors={"memory": "beta"}),
+        adm("high", "standalone", {"memory": "1Gi"}, priority=1,
+            flavors={"memory": "beta"})],
+    incoming=lambda: incoming({"cpu": "1", "memory": "2Gi"}, priority=1),
+    assignment=sps({"cpu": ("default", FIT),
+                    "memory": ("beta", PREEMPT)}),
+    want=[("mid", IN_CQ)],
+)
+
+case(
+    "reclaim quota from borrower",
+    admitted=lambda: [
+        adm("c1-low", "c1", {"cpu": "3"}, priority=-1),
+        adm("c2-mid", "c2", {"cpu": "3"}),
+        adm("c2-high", "c2", {"cpu": "6"}, priority=1)],
+    incoming=lambda: incoming({"cpu": "3"}, priority=1, target_cq="c1"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("c2-mid", RECLAIM)],
+)
+
+case(
+    "reclaim quota if workload requests 0 resources for a resource at"
+    " nominal quota",
+    admitted=lambda: [
+        adm("c1-low", "c1", {"cpu": "3", "memory": "3Gi"}, priority=-1),
+        adm("c2-mid", "c2", {"cpu": "3"}),
+        adm("c2-high", "c2", {"cpu": "6"}, priority=1)],
+    incoming=lambda: incoming({"cpu": "3", "memory": "0"}, priority=1,
+                              target_cq="c1"),
+    assignment=sps({"cpu": ("default", PREEMPT),
+                    "memory": ("default", FIT)}),
+    want=[("c2-mid", RECLAIM)],
+)
+
+case(
+    "no workloads borrowing",
+    admitted=lambda: [
+        adm("c1-high", "c1", {"cpu": "4"}, priority=1),
+        adm("c2-low-1", "c2", {"cpu": "4"}, priority=-1)],
+    incoming=lambda: incoming({"cpu": "4"}, priority=1, target_cq="c1"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[],
+)
+
+case(
+    "not enough workloads borrowing",
+    admitted=lambda: [
+        adm("c1-high", "c1", {"cpu": "4"}, priority=1),
+        adm("c2-low-1", "c2", {"cpu": "4"}, priority=-1),
+        adm("c2-low-2", "c2", {"cpu": "4"}, priority=-1)],
+    incoming=lambda: incoming({"cpu": "4"}, priority=1, target_cq="c1"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[],
+)
+
+
+case(
+    "preempting locally and borrowing other resources in cohort,"
+    " without cohort candidates",
+    admitted=lambda: [
+        adm("c1-low", "c1", {"cpu": "4"}, priority=-1),
+        adm("c2-low-1", "c2", {"cpu": "4"}, priority=-1),
+        adm("c2-high-2", "c2", {"cpu": "4"}, priority=1)],
+    incoming=lambda: incoming({"cpu": "4", "memory": "5Gi"}, priority=1,
+                              target_cq="c1"),
+    assignment=sps({"cpu": ("default", PREEMPT),
+                    "memory": ("default", PREEMPT)}),
+    want=[("c1-low", IN_CQ)],
+)
+
+case(
+    "preempting locally and borrowing same resource in cohort",
+    admitted=lambda: [
+        adm("c1-med", "c1", {"cpu": "4"}),
+        adm("c1-low", "c1", {"cpu": "4"}, priority=-1),
+        adm("c2-low-1", "c2", {"cpu": "4"}, priority=-1)],
+    incoming=lambda: incoming({"cpu": "4"}, priority=1, target_cq="c1"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("c1-low", IN_CQ)],
+)
+
+case(
+    "preempting locally and borrowing same resource in cohort; no"
+    " borrowing limit in the cohort",
+    admitted=lambda: [
+        adm("d1-med", "d1", {"cpu": "4"}),
+        adm("d1-low", "d1", {"cpu": "4"}, priority=-1),
+        adm("d2-low-1", "d2", {"cpu": "4"}, priority=-1)],
+    incoming=lambda: incoming({"cpu": "4"}, priority=1, target_cq="d1"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("d1-low", IN_CQ)],
+)
+
+case(
+    "preempting locally and borrowing other resources in cohort, with"
+    " cohort candidates",
+    admitted=lambda: [
+        adm("c1-med", "c1", {"cpu": "4"}),
+        adm("c2-low-1", "c2", {"cpu": "5"}, priority=-1),
+        adm("c2-low-2", "c2", {"cpu": "1"}, priority=-1),
+        adm("c2-low-3", "c2", {"cpu": "1"}, priority=-1)],
+    incoming=lambda: incoming({"cpu": "2", "memory": "5Gi"}, priority=1,
+                              target_cq="c1"),
+    assignment=sps({"cpu": ("default", PREEMPT),
+                    "memory": ("default", PREEMPT)}),
+    want=[("c1-med", IN_CQ)],
+)
+
+case(
+    "preempting locally and not borrowing same resource in 1-queue"
+    " cohort",
+    admitted=lambda: [
+        adm("l1-med", "l1", {"cpu": "4"}),
+        adm("l1-low", "l1", {"cpu": "2"}, priority=-1)],
+    incoming=lambda: incoming({"cpu": "4"}, priority=1, target_cq="l1"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("l1-med", IN_CQ)],
+)
+
+case(
+    "do not reclaim borrowed quota from same priority for"
+    " withinCohort=ReclaimFromLowerPriority",
+    admitted=lambda: [
+        adm("c1", "c1", {"cpu": "2"}),
+        adm("c2-1", "c2", {"cpu": "4"}),
+        adm("c2-2", "c2", {"cpu": "4"})],
+    incoming=lambda: incoming({"cpu": "4"}, target_cq="c1"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[],
+)
+
+case(
+    "reclaim borrowed quota from same priority for"
+    " withinCohort=ReclaimFromAny",
+    admitted=lambda: [
+        adm("c1-1", "c1", {"cpu": "4"}),
+        adm("c1-2", "c1", {"cpu": "4"}, priority=1),
+        adm("c2", "c2", {"cpu": "2"})],
+    incoming=lambda: incoming({"cpu": "4"}, target_cq="c2"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("c1-1", RECLAIM)],
+)
+
+case(
+    "preempt from all ClusterQueues in cohort",
+    admitted=lambda: [
+        adm("c1-low", "c1", {"cpu": "3"}, priority=-1),
+        adm("c1-mid", "c1", {"cpu": "2"}),
+        adm("c2-low", "c2", {"cpu": "3"}, priority=-1),
+        adm("c2-mid", "c2", {"cpu": "4"})],
+    incoming=lambda: incoming({"cpu": "4"}, target_cq="c1"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("c1-low", IN_CQ), ("c2-low", RECLAIM)],
+)
+
+case(
+    "can't preempt workloads in ClusterQueue for"
+    " withinClusterQueue=Never",
+    admitted=lambda: [
+        adm("c2-low", "c2", {"cpu": "3"}, priority=-1)],
+    incoming=lambda: incoming({"cpu": "4"}, priority=1, target_cq="c2"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[],
+)
+
+case(
+    "each podset preempts a different flavor",
+    admitted=lambda: [
+        adm("low-alpha", "standalone", {"memory": "2Gi"}, priority=-1,
+            flavors={"memory": "alpha"}),
+        adm("low-beta", "standalone", {"memory": "2Gi"}, priority=-1,
+            flavors={"memory": "beta"})],
+    incoming=lambda: MakeWorkload("in").PodSets(
+        MakePodSet("launcher", 1).Request("memory", "2Gi").Obj(),
+        MakePodSet("workers", 2).Request("memory", "1Gi").Obj(),
+    ).Info("standalone"),
+    assignment=make_assignment(
+        ("launcher", {"memory": ("alpha", PREEMPT)}, {}, 1),
+        ("workers", {"memory": ("beta", PREEMPT)}, {}, 2)),
+    want=[("low-alpha", IN_CQ), ("low-beta", IN_CQ)],
+)
+
+case(
+    "preempt newer workloads with the same priority",
+    admitted=lambda: [
+        adm("wl1", "preventStarvation", {"cpu": "2"}, priority=2),
+        adm("wl2", "preventStarvation", {"cpu": "2"}, priority=1,
+            creation=NOW),
+        adm("wl3", "preventStarvation", {"cpu": "2"}, priority=1,
+            creation=NOW)],
+    incoming=lambda: incoming({"cpu": "2"}, priority=1,
+                              target_cq="preventStarvation",
+                              creation=NOW - 15),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("wl2", IN_CQ)],
+)
+
+case(
+    "use BorrowWithinCohort; allow preempting a lower-priority workload"
+    " from another ClusterQueue while borrowing",
+    admitted=lambda: [
+        adm("a_best_effort_low", "a_best_effort", {"cpu": "10"},
+            priority=-1),
+        adm("b_best_effort_low", "b_best_effort", {"cpu": "1"},
+            priority=-1)],
+    incoming=lambda: incoming({"cpu": "10"}, target_cq="a_standard"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("a_best_effort_low", RECLAIM_BORROW)],
+)
+
+case(
+    "use BorrowWithinCohort; don't allow preempting a lower-priority"
+    " workload with priority above MaxPriorityThreshold, if borrowing"
+    " is required even after the preemption",
+    admitted=lambda: [
+        adm("b_standard", "b_standard", {"cpu": "10"}, priority=1)],
+    incoming=lambda: incoming({"cpu": "10"}, priority=2,
+                              target_cq="a_standard"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[],
+)
+
+case(
+    "use BorrowWithinCohort; allow preempting a lower-priority workload"
+    " with priority above MaxPriorityThreshold, if borrowing is not"
+    " required after the preemption",
+    admitted=lambda: [
+        adm("b_standard", "b_standard", {"cpu": "13"}, priority=1)],
+    incoming=lambda: incoming({"cpu": "1"}, priority=2,
+                              target_cq="a_standard"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("b_standard", RECLAIM)],
+)
+
+case(
+    "use BorrowWithinCohort; don't allow for preemption of"
+    " lower-priority workload from the same ClusterQueue",
+    admitted=lambda: [
+        adm("a_standard", "a_standard", {"cpu": "13"}, priority=1)],
+    incoming=lambda: incoming({"cpu": "1"}, priority=2,
+                              target_cq="a_standard"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[],
+)
+
+case(
+    "use BorrowWithinCohort; only preempt from CQ if no workloads below"
+    " threshold and already above nominal",
+    admitted=lambda: [
+        adm("a_standard_1", "a_standard", {"cpu": "10"}, priority=1),
+        adm("a_standard_2", "a_standard", {"cpu": "1"}, priority=1),
+        adm("b_standard_1", "b_standard", {"cpu": "1"}, priority=1),
+        adm("b_standard_2", "b_standard", {"cpu": "1"}, priority=2)],
+    incoming=lambda: incoming({"cpu": "1"}, priority=3,
+                              target_cq="b_standard"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("b_standard_1", IN_CQ)],
+)
+
+case(
+    "use BorrowWithinCohort; preempt from CQ and from other CQs with"
+    " workloads below threshold",
+    admitted=lambda: [
+        adm("b_standard_high", "b_standard", {"cpu": "10"}, priority=2),
+        adm("b_standard_mid", "b_standard", {"cpu": "1"}, priority=1),
+        adm("a_best_effort_low", "a_best_effort", {"cpu": "1"},
+            priority=-1),
+        adm("a_best_effort_lower", "a_best_effort", {"cpu": "1"},
+            priority=-2)],
+    incoming=lambda: incoming({"cpu": "2"}, priority=2,
+                              target_cq="b_standard"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("a_best_effort_lower", RECLAIM_BORROW),
+          ("b_standard_mid", IN_CQ)],
+)
+
+case(
+    "reclaim quota from lender",
+    admitted=lambda: [
+        adm("lend1-low", "lend1", {"cpu": "3"}, priority=-1),
+        adm("lend2-mid", "lend2", {"cpu": "3"}),
+        adm("lend2-high", "lend2", {"cpu": "4"}, priority=1)],
+    incoming=lambda: incoming({"cpu": "3"}, priority=1,
+                              target_cq="lend1"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("lend2-mid", RECLAIM)],
+)
+
+case(
+    "preempt from all ClusterQueues in cohort-lend",
+    admitted=lambda: [
+        adm("lend1-low", "lend1", {"cpu": "3"}, priority=-1),
+        adm("lend1-mid", "lend1", {"cpu": "2"}),
+        adm("lend2-low", "lend2", {"cpu": "3"}, priority=-1),
+        adm("lend2-mid", "lend2", {"cpu": "4"})],
+    incoming=lambda: incoming({"cpu": "4"}, target_cq="lend1"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("lend1-low", IN_CQ), ("lend2-low", RECLAIM)],
+)
+
+case(
+    "cannot preempt from other ClusterQueues if exceeds requestable"
+    " quota including lending limit",
+    admitted=lambda: [
+        adm("lend2-low", "lend2", {"cpu": "10"}, priority=-1)],
+    incoming=lambda: incoming({"cpu": "9"}, target_cq="lend1"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[],
+)
+
+case(
+    "allow preemption from other cluster queues if target cq is not"
+    " exhausted for the requested resource",
+    admitted=lambda: [
+        adm("a1", "a", {"cpu": "1"}, priority=-1),
+        adm("b1", "b", {"cpu": "1"}),
+        adm("b2", "b", {"cpu": "1"}),
+        adm("b3", "b", {"cpu": "1"}),
+        adm("b4", "b", {"cpu": "1"}),
+        adm("b5", "b", {"cpu": "1"}, priority=-1)],
+    incoming=lambda: incoming({"cpu": "2"}, target_cq="a"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("a1", IN_CQ), ("b5", RECLAIM)],
+)
+
+case(
+    "long range preemption",
+    cluster_queues=[
+        MakeClusterQueue("cq-left").Cohort("cohort-left")
+        .Preemption(reclaim_within_cohort=PreemptionPolicy.ANY)
+        .ResourceGroup(MakeFlavorQuotas("default").Resource("cpu", "10")
+                       .Obj()).Obj(),
+        MakeClusterQueue("cq-right").Cohort("cohort-right")
+        .ResourceGroup(MakeFlavorQuotas("default").Resource("cpu", "0")
+                       .Obj()).Obj(),
+    ],
+    cohorts=[MakeCohort("cohort-left").Parent("root").Obj(),
+             MakeCohort("cohort-right").Parent("root").Obj()],
+    admitted=lambda: [
+        adm("to-be-preempted", "cq-right", {"cpu": "5"})],
+    incoming=lambda: incoming({"cpu": "8"}, target_cq="cq-left"),
+    assignment=sps({"cpu": ("default", PREEMPT)}),
+    want=[("to-be-preempted", RECLAIM)],
+)
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_preemption_golden(name):
+    tc = CASES[name]
+    inc = tc["incoming"]()
+    got = run_preemption_case(
+        cluster_queues=tc.get("cluster_queues") or default_cluster_queues(),
+        cohorts=tc.get("cohorts", ()),
+        admitted=tc["admitted"](),
+        incoming=inc,
+        assignment=tc["assignment"],
+        enable_fair_sharing=tc.get("fair", False),
+        now=NOW,
+    )
+    assert got == sorted(tc["want"]), (
+        f"[{name}] targets: got {got}, want {sorted(tc['want'])}")
