@@ -106,6 +106,10 @@ def bench_cycle_latency(scen, n_cycles=6):
         eng.submit(wl)
     eng.attach_oracle()
 
+    # The engine's own serving-daemon GC posture (part of the system
+    # under test; the oracle service main applies the same).
+    eng.apply_serving_gc_posture()
+
     times = []
     phases = []
     admitted_total = 0

@@ -45,6 +45,11 @@ def scheduling_hash(wl: Workload, cluster_queue: str) -> tuple:
         wl.allowed_resource_flavor,
         # Closed preemption gates change schedulability too.
         wl.has_closed_preemption_gate(),
+        # Reclaimable pods scale the effective counts/requests
+        # (workload_types.go:874): spec-equal workloads with different
+        # reclaim states have different admission verdicts and must not
+        # be treated as scheduling-equivalent.
+        tuple(sorted(wl.status.reclaimable_pods.items())),
         tuple(sorted(
             (ps.name, ps.count, tuple(sorted(ps.requests.items())),
              tuple(sorted(ps.node_selector.items())),
@@ -373,11 +378,11 @@ class QueueManager:
         pcq = self.cluster_queues.get(cq_name) if cq_name else None
         if pcq is not None and (key in pcq.items or key in pcq.inadmissible
                                 or pcq.in_flight == key):
-            pcq.delete(key)
+            pcq.delete(key)  # pcq.delete already releases the row
         else:
             for pcq in self.cluster_queues.values():
                 pcq.delete(key)
-        self.rows.on_remove(key)
+            self.rows.on_remove(key)
         self.second_pass.delete(key)
 
     def requeue_workload(self, info: WorkloadInfo,

@@ -55,6 +55,31 @@ class UnadmittedWorkloads:
         if prev is not None:
             self._adjust(prev, -1)
 
+    def remove_many(self, wl_keys) -> None:
+        """Bulk removal with one gauge write per touched series (the
+        serving cycle's whole admitted batch in one pass)."""
+        cq_delta: dict[tuple, int] = {}
+        lq_delta: dict[tuple, int] = {}
+        for key in wl_keys:
+            prev = self.statuses.pop(key, None)
+            if prev is None:
+                continue
+            ck, lk = prev.cq_key(), prev.lq_key()
+            cq_delta[ck] = cq_delta.get(ck, 0) - 1
+            lq_delta[lk] = lq_delta.get(lk, 0) - 1
+        for table, deltas, gauge in (
+                (self.per_cq, cq_delta, "unadmitted_workloads"),
+                (self.per_lq, lq_delta, "local_queue_unadmitted_workloads")):
+            for key, delta in deltas.items():
+                value = table.get(key, 0) + delta
+                if value <= 0:
+                    table.pop(key, None)
+                    value = 0
+                else:
+                    table[key] = value
+                if self.registry is not None:
+                    self.registry.gauge(gauge).set(key, value)
+
     def _adjust(self, status: UnadmittedStatus, delta: int) -> None:
         for table, key, gauge in (
                 (self.per_cq, status.cq_key(), "unadmitted_workloads"),

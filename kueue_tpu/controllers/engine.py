@@ -64,6 +64,43 @@ class EngineMetrics:
     cycle_durations: list[float] = field(default_factory=list)
 
 
+class _BulkAdmitCtx:
+    """Per-cycle accumulator for the batched serving path: shared
+    Condition instances plus deferred metric / unadmitted / journal
+    writes, flushed once by Engine.flush_bulk_admit."""
+
+    __slots__ = ("qr_cond", "adm_cond", "reset_conds", "counts", "waits",
+                 "removed_unadmitted", "journal_keys", "admissions")
+
+    def __init__(self, now: float):
+        from kueue_tpu.api.types import Condition, WorkloadConditionType
+
+        self.qr_cond = Condition(
+            type=WorkloadConditionType.QUOTA_RESERVED, status=True,
+            reason="QuotaReserved", last_transition_time=now)
+        self.adm_cond = Condition(
+            type=WorkloadConditionType.ADMITTED, status=True,
+            reason="Admitted", last_transition_time=now)
+        self.reset_conds = tuple(
+            (ct, Condition(type=ct, status=False, reason="QuotaReserved",
+                           last_transition_time=now))
+            for ct in (WorkloadConditionType.EVICTED,
+                       WorkloadConditionType.PREEMPTED,
+                       WorkloadConditionType.BLOCKED_ON_PREEMPTION_GATES))
+        self.counts: dict = {}
+        self.waits: dict = {}
+        self.removed_unadmitted: list = []
+        self.journal_keys: list = []
+        self.admissions: dict = {}  # (cq, assignment-id) -> Admission
+
+    def count(self, name: str, labels: tuple, n: int = 1) -> None:
+        key = (name, labels)
+        self.counts[key] = self.counts.get(key, 0) + n
+
+    def wait(self, name: str, labels: tuple, value: float) -> None:
+        self.waits.setdefault((name, labels), []).append(value)
+
+
 class Engine:
     def __init__(self, enable_fair_sharing: bool = False,
                  cycle: Optional[SchedulerCycle] = None,
@@ -89,6 +126,12 @@ class Engine:
                     pods_ready_requeuing_timestamp=ts)
         self.queues = QueueManager(workload_ordering=workload_ordering)
         self.cache = Cache()
+        # When a cycle is active, cohort-inadmissible requeues triggered
+        # by evictions are deferred to cycle end (one pass per distinct
+        # cohort root instead of one per victim) — matching the
+        # reference, where they ride watch events that land after
+        # schedule() returns.
+        self._deferred_cohort_requeue: Optional[set] = None
         self.cycle = cycle or SchedulerCycle(
             enable_fair_sharing=enable_fair_sharing,
             workload_ordering=workload_ordering)
@@ -606,17 +649,23 @@ class Engine:
         result = self.cycle.schedule(heads, snapshot, now=self.clock,
                                      already_admitted=already)
         t_decide = _time.perf_counter()
-        for e in result.entries:
-            self.metrics.admission_attempts_total += 1
-            if e.status == EntryStatus.ASSUMED:
-                self._admit(e)
-            elif e.status == EntryStatus.PREEMPTING:
-                self._issue_preemptions(e)
+        deferred: set = set()
+        self._deferred_cohort_requeue = deferred
+        try:
+            for e in result.entries:
+                self.metrics.admission_attempts_total += 1
+                if e.status == EntryStatus.ASSUMED:
+                    self._admit(e)
+                elif e.status == EntryStatus.PREEMPTING:
+                    self._issue_preemptions(e)
+                    self._requeue(e)
+                else:
+                    self._requeue(e)
+            for e in result.inadmissible:
                 self._requeue(e)
-            else:
-                self._requeue(e)
-        for e in result.inadmissible:
-            self._requeue(e)
+        finally:
+            self._deferred_cohort_requeue = None
+        self._requeue_cohorts_bulk(deferred)
         for cq_name, skips in result.stats.preemption_skips.items():
             m = self.metrics.admission_cycle_preemption_skips
             m[cq_name] = m.get(cq_name, 0) + skips
@@ -800,27 +849,85 @@ class Engine:
 
     # -- internals --
 
-    def _admit(self, entry) -> None:
+    def apply_serving_gc_posture(self) -> None:
+        """Serving-daemon GC posture: the admitted/pending world is
+        long-lived state; freeze it so generational collections stop
+        scanning millions of stable objects mid-cycle (the dominant
+        cycle-latency p95 outlier source). Call once after the initial
+        world is loaded; the bench harness applies it as part of the
+        system under test."""
+        import gc
+
+        gc.collect()
+        gc.freeze()
+
+    def begin_bulk_admit(self) -> "_BulkAdmitCtx":
+        """Open a bulk-admission context for one serving cycle: metric,
+        unadmitted-gauge, and journal writes are accumulated and applied
+        once in flush_bulk_admit. The reference pays this per entry at
+        scheduler.go:856-910; the batched serving path amortizes it."""
+        return _BulkAdmitCtx(self.clock)
+
+    def flush_bulk_admit(self, ctx: "_BulkAdmitCtx") -> None:
+        for (name, labels), n in ctx.counts.items():
+            self.registry.counter(name).inc(labels, n)
+        for (name, labels), values in ctx.waits.items():
+            self.registry.histogram(name).observe_many(values, labels)
+        if ctx.removed_unadmitted:
+            self.unadmitted.remove_many(ctx.removed_unadmitted)
+        if self.journal is not None:
+            for key in dict.fromkeys(ctx.journal_keys):
+                wl = self.workloads.get(key)
+                if wl is not None:
+                    self.journal.apply("workload", wl, ts=self.clock)
+
+    def _admit(self, entry, bulk: "Optional[_BulkAdmitCtx]" = None) -> None:
         """scheduler.go:856 (admit): reserve quota, assume in cache; the
         Admitted condition follows only when all AdmissionChecks are Ready
         (prepareWorkload :912)."""
         wl = entry.obj
-        admission = admission_from_assignment(entry.info.cluster_queue,
-                                              entry.assignment.pod_sets)
+        if bulk is not None:
+            # Admission objects are immutable; flyweight them per
+            # (CQ, assignment) — bridge assignments are themselves
+            # flyweights over scheduling-equivalence classes.
+            akey = (entry.info.cluster_queue, id(entry.assignment))
+            admission = bulk.admissions.get(akey)
+            if admission is None:
+                admission = admission_from_assignment(
+                    entry.info.cluster_queue, entry.assignment.pod_sets)
+                bulk.admissions[akey] = admission
+        else:
+            admission = admission_from_assignment(
+                entry.info.cluster_queue, entry.assignment.pod_sets)
         wl.status.admission = admission
-        wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
-                         reason="QuotaReserved", now=self.clock)
-        # Reservation resets the active Evicted / Preempted / blocked-on-
-        # gates conditions (workload.go:852-862 resetActiveCondition) —
-        # without this a re-admitted former victim would still read as
-        # evicted and _issue_preemptions' "preemption ongoing" skip would
-        # never evict it again.
-        for ctype in (WorkloadConditionType.EVICTED,
-                      WorkloadConditionType.PREEMPTED,
-                      WorkloadConditionType.BLOCKED_ON_PREEMPTION_GATES):
-            if wl.has_condition(ctype):
-                wl.set_condition(ctype, False, reason="QuotaReserved",
-                                 now=self.clock)
+        if bulk is not None:
+            # Shared per-cycle Condition instances: every workload in the
+            # batch transitions at the same clock with the same reason,
+            # so one immutable instance serves them all. A live True
+            # reservation (second-pass workloads) keeps its transition
+            # time, matching set_condition's semantics.
+            prev = wl.status.conditions.get(
+                WorkloadConditionType.QUOTA_RESERVED)
+            if prev is None or not prev.status:
+                wl.status.conditions[
+                    WorkloadConditionType.QUOTA_RESERVED] = bulk.qr_cond
+            for ctype, cond in bulk.reset_conds:
+                if wl.has_condition(ctype):
+                    wl.status.conditions[ctype] = cond
+        else:
+            wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, True,
+                             reason="QuotaReserved", now=self.clock)
+            # Reservation resets the active Evicted / Preempted / blocked-
+            # on-gates conditions (workload.go:852-862
+            # resetActiveCondition) — without this a re-admitted former
+            # victim would still read as evicted and _issue_preemptions'
+            # "preemption ongoing" skip would never evict it again.
+            for ctype in (WorkloadConditionType.EVICTED,
+                          WorkloadConditionType.PREEMPTED,
+                          WorkloadConditionType.BLOCKED_ON_PREEMPTION_GATES):
+                if wl.has_condition(ctype):
+                    wl.set_condition(ctype, False, reason="QuotaReserved",
+                                     now=self.clock)
         entry.info.apply_admission(admission)
         self.cache.add_or_update_workload(wl, info=entry.info)
         # The workload left the pending world: free its tensor row (the
@@ -838,25 +945,40 @@ class Engine:
         # the engine is lock-free single-threaded by design. ThreadWrapper
         # is for out-of-process appliers only (see utils/routine.py).
         def _finalize() -> None:
-            self._event("QuotaReserved", wl.key,
-                        cluster_queue=entry.info.cluster_queue)
             cq_name = entry.info.cluster_queue
-            self.registry.counter("quota_reserved_workloads_total").inc(
-                (cq_name,))
-            self.registry.histogram(
-                "quota_reserved_wait_time_seconds").observe(
-                max(0.0, self.clock - wl.creation_time), (cq_name,))
-            self.registry.counter(
-                "local_queue_quota_reserved_workloads_total").inc(
-                self._lq_key(wl))
-            self.registry.histogram(
-                "local_queue_quota_reserved_wait_time_seconds").observe(
-                max(0.0, self.clock - wl.creation_time), self._lq_key(wl))
-            self._track_unadmitted(wl, cq_name, "UnsatisfiedChecks")
+            wait = max(0.0, self.clock - wl.creation_time)
+            lq = self._lq_key(wl)
+            if bulk is not None:
+                self._event("QuotaReserved", wl.key, cluster_queue=cq_name,
+                            defer_journal=bulk)
+                bulk.count("quota_reserved_workloads_total", (cq_name,))
+                bulk.wait("quota_reserved_wait_time_seconds", (cq_name,),
+                          wait)
+                bulk.count("local_queue_quota_reserved_workloads_total",
+                           lq)
+                bulk.wait("local_queue_quota_reserved_wait_time_seconds",
+                          lq, wait)
+            else:
+                self._event("QuotaReserved", wl.key, cluster_queue=cq_name)
+                self.registry.counter(
+                    "quota_reserved_workloads_total").inc((cq_name,))
+                self.registry.histogram(
+                    "quota_reserved_wait_time_seconds").observe(
+                    wait, (cq_name,))
+                self.registry.counter(
+                    "local_queue_quota_reserved_workloads_total").inc(lq)
+                self.registry.histogram(
+                    "local_queue_quota_reserved_wait_time_seconds").observe(
+                    wait, lq)
             if self.admission_checks is not None:
+                # The UnsatisfiedChecks window only exists when admission
+                # checks can actually defer the Admitted condition; with
+                # none configured _sync_admitted resolves immediately and
+                # the transition would be a wasted gauge round trip.
+                self._track_unadmitted(wl, cq_name, "UnsatisfiedChecks")
                 self.admission_checks.sync_states(wl,
                                                   entry.info.cluster_queue)
-            self._sync_admitted(wl, entry.info.cluster_queue)
+            self._sync_admitted(wl, entry.info.cluster_queue, bulk=bulk)
             # Replace-old-slice after successful admission
             # (scheduler.go:558 replaceOldWorkloadSlice).
             for target in entry.preemption_targets:
@@ -865,33 +987,51 @@ class Engine:
 
         self.admission_routine.run(_finalize)
 
-    def _sync_admitted(self, wl: Workload, cq_name: str) -> None:
+    def _sync_admitted(self, wl: Workload, cq_name: str,
+                       bulk: "Optional[_BulkAdmitCtx]" = None) -> None:
         """workload.SyncAdmittedCondition."""
         if wl.is_admitted:
             return
         if (self.admission_checks is not None
                 and not self.admission_checks.all_ready(wl, cq_name)):
             return
-        wl.set_condition(WorkloadConditionType.ADMITTED, True,
-                         reason="Admitted", now=self.clock)
         self.metrics.admissions_total += 1
-        self.registry.counter("admitted_workloads_total").inc(
-            (cq_name,) + self._custom_cq_labels(cq_name))
-        self.registry.histogram("admission_wait_time_seconds").observe(
-            max(0.0, self.clock - wl.creation_time), (cq_name,))
-        self.registry.counter("local_queue_admitted_workloads_total").inc(
-            self._lq_key(wl))
-        self.registry.histogram(
-            "local_queue_admission_wait_time_seconds").observe(
-            max(0.0, self.clock - wl.creation_time), self._lq_key(wl))
+        wait = max(0.0, self.clock - wl.creation_time)
+        lq = self._lq_key(wl)
         reserved = wl.condition(WorkloadConditionType.QUOTA_RESERVED)
-        if reserved is not None:
+        if bulk is not None:
+            wl.status.conditions[WorkloadConditionType.ADMITTED] = \
+                bulk.adm_cond
+            bulk.count("admitted_workloads_total",
+                       (cq_name,) + self._custom_cq_labels(cq_name))
+            bulk.wait("admission_wait_time_seconds", (cq_name,), wait)
+            bulk.count("local_queue_admitted_workloads_total", lq)
+            bulk.wait("local_queue_admission_wait_time_seconds", lq, wait)
+            if reserved is not None:
+                bulk.wait(
+                    "admission_checks_wait_time_seconds", (cq_name,),
+                    max(0.0, self.clock - reserved.last_transition_time))
+            bulk.removed_unadmitted.append(wl.key)
+            self._event("Admitted", wl.key, cluster_queue=cq_name,
+                        defer_journal=bulk)
+        else:
+            wl.set_condition(WorkloadConditionType.ADMITTED, True,
+                             reason="Admitted", now=self.clock)
+            self.registry.counter("admitted_workloads_total").inc(
+                (cq_name,) + self._custom_cq_labels(cq_name))
+            self.registry.histogram("admission_wait_time_seconds").observe(
+                wait, (cq_name,))
+            self.registry.counter(
+                "local_queue_admitted_workloads_total").inc(lq)
             self.registry.histogram(
-                "admission_checks_wait_time_seconds").observe(
-                max(0.0, self.clock - reserved.last_transition_time),
-                (cq_name,))
-        self.unadmitted.remove(wl.key)
-        self._event("Admitted", wl.key, cluster_queue=cq_name)
+                "local_queue_admission_wait_time_seconds").observe(wait, lq)
+            if reserved is not None:
+                self.registry.histogram(
+                    "admission_checks_wait_time_seconds").observe(
+                    max(0.0, self.clock - reserved.last_transition_time),
+                    (cq_name,))
+            self.unadmitted.remove(wl.key)
+            self._event("Admitted", wl.key, cluster_queue=cq_name)
         if self.on_admit is not None:
             self.on_admit(wl, wl.status.admission)
 
@@ -925,8 +1065,12 @@ class Engine:
         self._sync_admitted(wl, cq_name)
 
     def evict(self, wl: Workload, reason: str, requeue: bool = True,
-              backoff_seconds: float = 0.0) -> None:
-        """Shared eviction path (pkg/workload/evict)."""
+              backoff_seconds: float = 0.0, bulk=None) -> None:
+        """Shared eviction path (pkg/workload/evict). ``bulk`` batches
+        the observability writes the way bulk admission does; the
+        cohort-inadmissible requeue is deferred per cycle when a cycle
+        is active (the reference's requeue rides watch events that land
+        after schedule() returns)."""
         cq_name = (wl.status.admission.cluster_queue
                    if wl.status.admission else "")
         _adm = wl.condition(WorkloadConditionType.ADMITTED)
@@ -949,21 +1093,38 @@ class Engine:
         wl.status.admission_check_states = {}
         wl.status.admission_check_updates = {}
         self.cache.delete_workload(wl.key)
-        self.registry.counter("evicted_workloads_total").inc(
-            (cq_name, reason) + self._custom_cq_labels(cq_name))
-        self.registry.counter("local_queue_evicted_workloads_total").inc(
-            self._lq_key(wl) + (reason,))
+        if bulk is not None:
+            bulk.count("evicted_workloads_total",
+                       (cq_name, reason) + self._custom_cq_labels(cq_name))
+            bulk.count("local_queue_evicted_workloads_total",
+                       self._lq_key(wl) + (reason,))
+        else:
+            self.registry.counter("evicted_workloads_total").inc(
+                (cq_name, reason) + self._custom_cq_labels(cq_name))
+            self.registry.counter(
+                "local_queue_evicted_workloads_total").inc(
+                self._lq_key(wl) + (reason,))
         if wl.uid not in self._evicted_once:
             # Keyed by UID: a re-created workload under the same name is
             # a new object with its own first eviction (metrics.go:666).
             self._evicted_once.add(wl.uid)
-            self.registry.counter("evicted_workloads_once_total").inc(
-                (cq_name, reason))
+            if bulk is not None:
+                bulk.count("evicted_workloads_once_total",
+                           (cq_name, reason))
+            else:
+                self.registry.counter("evicted_workloads_once_total").inc(
+                    (cq_name, reason))
         if admitted_at is not None:
-            self.registry.histogram(
-                "workload_eviction_latency_seconds").observe(
-                max(0.0, self.clock - admitted_at), (cq_name, reason))
-        self._event("Evicted", wl.key, cluster_queue=cq_name, detail=reason)
+            if bulk is not None:
+                bulk.wait("workload_eviction_latency_seconds",
+                          (cq_name, reason),
+                          max(0.0, self.clock - admitted_at))
+            else:
+                self.registry.histogram(
+                    "workload_eviction_latency_seconds").observe(
+                    max(0.0, self.clock - admitted_at), (cq_name, reason))
+        self._event("Evicted", wl.key, cluster_queue=cq_name, detail=reason,
+                    defer_journal=bulk)
         # The event handlers have now observed the eviction — release any
         # in-flight preemption expectation (the workload_controller
         # Update-event ObservedUID in the reference).
@@ -976,12 +1137,18 @@ class Engine:
             self._track_unadmitted(wl, cq_name, "Evicted", cause=reason)
             # The requeue bookkeeping mutated status after the Evicted
             # event — persist the final state.
-            self._journal_obj("workload", wl)
+            if bulk is not None:
+                bulk.journal_keys.append(wl.key)
+            else:
+                self._journal_obj("workload", wl)
         else:
             self.unadmitted.remove(wl.key)
-        self._requeue_cohort_inadmissible(cq_name)
+        if self._deferred_cohort_requeue is not None:
+            self._deferred_cohort_requeue.add(cq_name)
+        else:
+            self._requeue_cohort_inadmissible(cq_name)
 
-    def _issue_preemptions(self, entry) -> None:
+    def _issue_preemptions(self, entry, bulk=None) -> None:
         """preemption.go:194 (IssuePreemptions) + the workload controller's
         requeue-after-evict."""
         for target in entry.preemption_targets:
@@ -1009,11 +1176,11 @@ class Engine:
             self.preemption_expectations.expect_uids(twl.key, [twl.uid])
             twl.set_condition(WorkloadConditionType.PREEMPTED, True,
                               reason=target.reason, now=self.clock)
-            self.evict(twl, "Preempted")
+            self.evict(twl, "Preempted", bulk=bulk)
             self.metrics.preemptions_total += 1
             self._event("Preempted", twl.key,
                         cluster_queue=target.workload.cluster_queue,
-                        detail=target.reason)
+                        detail=target.reason, defer_journal=bulk)
 
     def _requeue(self, entry) -> None:
         """scheduler.go:1016 (requeueAndUpdate)."""
@@ -1052,6 +1219,27 @@ class Engine:
             name = co.parent
         return name  # defensive: cycle (webhooks reject these)
 
+    def _requeue_cohorts_bulk(self, cq_names: set) -> None:
+        """One inadmissible-requeue pass over the union of the evicting
+        CQs' cohort subtrees (deduped across a whole cycle's victims)."""
+        if not cq_names:
+            return
+        all_names: set = set()
+        for cq_name in cq_names:
+            cq = self.cache.cluster_queues.get(cq_name)
+            if cq is None:
+                continue
+            if not cq.cohort:
+                all_names.add(cq_name)
+                continue
+            root = self._cohort_root_of(cq.cohort)
+            all_names.update(
+                name for name, c in self.cache.cluster_queues.items()
+                if c.cohort and self._cohort_root_of(c.cohort) == root)
+            all_names.add(cq_name)
+        if all_names:
+            self.queues.queue_inadmissible_workloads(all_names)
+
     def _requeue_cohort_inadmissible(self, cq_name: str) -> None:
         """Capacity freed: re-activate inadmissible workloads of the cohort
         (manager.go QueueAssociatedInadmissibleWorkloadsAfter). Computed
@@ -1070,12 +1258,16 @@ class Engine:
         self.queues.queue_inadmissible_workloads(names)
 
     def _event(self, kind: str, workload: str, cluster_queue: str = "",
-               detail: str = "") -> None:
+               detail: str = "", defer_journal=None) -> None:
         ev = EngineEvent(self.clock, kind, workload, cluster_queue, detail)
         self.events.append(ev)
         # Every workload transition flows through here — persist the
-        # post-transition state (the SSA status-patch analog).
-        if self.journal is not None and workload in self.workloads:
+        # post-transition state (the SSA status-patch analog). Bulk
+        # cycles defer the write: one journal record per workload at
+        # flush time instead of one per condition transition.
+        if defer_journal is not None:
+            defer_journal.journal_keys.append(workload)
+        elif self.journal is not None and workload in self.workloads:
             self.journal.apply("workload", self.workloads[workload],
                                ts=self.clock)
         for fn in self.event_listeners:

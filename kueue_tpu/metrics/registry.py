@@ -72,6 +72,30 @@ class Histogram:
         self.sums[labels] += value
         self.totals[labels] += 1
 
+    def observe_many(self, values, labels: tuple = ()) -> None:
+        """Bulk observation: one vectorized bucket pass for a whole
+        serving cycle's samples (the per-entry loop the reference pays
+        at scheduler.go:856 is amortized here)."""
+        if len(values) < 64:
+            # numpy dispatch overhead dwarfs bisect below this size (the
+            # per-LocalQueue series typically get a handful of samples).
+            for v in values:
+                self.observe(v, labels)
+            return
+        import numpy as np
+
+        vals = np.asarray(values, dtype=np.float64)
+        if labels not in self.counts:
+            self.counts[labels] = [0] * (len(self.buckets) + 1)
+        idx = np.searchsorted(np.asarray(self.buckets), vals, side="left")
+        binned = np.bincount(idx, minlength=len(self.buckets) + 1)
+        row = self.counts[labels]
+        for i, c in enumerate(binned):
+            if c:
+                row[i] += int(c)
+        self.sums[labels] += float(vals.sum())
+        self.totals[labels] += int(vals.size)
+
     def quantile(self, q: float, labels: tuple = ()) -> float:
         """Approximate quantile from bucket counts (upper bound)."""
         counts = self.counts.get(labels)

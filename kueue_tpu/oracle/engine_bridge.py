@@ -169,6 +169,44 @@ class OracleBridge:
         w.usage = usage
         return w
 
+    def _device_world_args(self, w) -> dict:
+        """Device-resident copies of the world-STRUCTURE tensors, keyed
+        by the spec version that produced ``w``. Re-uploading ~25 static
+        arrays every cycle cost more host time than the device solve
+        itself (bench preempt_churn profile)."""
+        import jax.numpy as jnp
+
+        ver = self.engine.cache.spec_version
+        cached = getattr(self, "_dev_world_cache", None)
+        if cached is None or cached[0] != ver:
+            dev = dict(
+                nominal=jnp.asarray(w.nominal),
+                lend_limit=jnp.asarray(w.lend_limit),
+                borrow_limit=jnp.asarray(w.borrow_limit),
+                parent=jnp.asarray(w.parent),
+                ancestors=jnp.asarray(w.ancestors),
+                height=jnp.asarray(w.height),
+                group_of_res=jnp.asarray(w.group_of_res),
+                group_flavors=jnp.asarray(w.group_flavors),
+                no_preemption=jnp.asarray(w.no_preemption),
+                can_pwb=jnp.asarray(w.can_preempt_while_borrowing),
+                can_always_reclaim=jnp.asarray(w.can_always_reclaim),
+                best_effort=jnp.asarray(w.best_effort),
+                fung_borrow_try_next=jnp.asarray(w.fung_borrow_try_next),
+                fung_pref_preempt_first=jnp.asarray(
+                    w.fung_pref_preempt_first),
+                root_members=jnp.asarray(w.root_members),
+                root_nodes=jnp.asarray(w.root_nodes),
+                local_chain=jnp.asarray(w.local_chain),
+                fair_weight=jnp.asarray(w.fair_weight),
+                child_rank=jnp.asarray(w.child_rank),
+                local_depth=jnp.asarray(w.local_depth),
+                root_parent_local=jnp.asarray(w.root_parent_local),
+            )
+            cached = (ver, dev)
+            self._dev_world_cache = cached
+        return dict(cached[1])
+
     def _cq_flavor_safe(self, w) -> np.ndarray:
         """bool[C]: none of the CQ's flavors carries taints or a topology
         (those route through the host flavorassigner/TAS path)."""
@@ -184,12 +222,17 @@ class OracleBridge:
     def _cq_policy_cfg(self, w):
         """Per-CQ preemption-policy encoding for the device classical
         preemptor (ops/preempt.classical_targets), which covers the full
-        classical policy surface."""
+        classical policy surface. Memoized by spec version."""
         from kueue_tpu.api.types import (
             BorrowWithinCohortPolicy,
             PreemptionPolicy,
         )
         from kueue_tpu.ops import preempt as pops
+
+        cached = getattr(self, "_pcfg_cache", None)
+        ver = self.engine.cache.spec_version
+        if cached is not None and cached[0] == ver and cached[1] is w:
+            return cached[2]
 
         policy_code = {
             PreemptionPolicy.NEVER: pops.POLICY_NEVER,
@@ -217,10 +260,12 @@ class OracleBridge:
                 if thr is not None:
                     bwc_threshold[ci] = thr
             cq_has_parent[ci] = spec.cohort is not None
-        return dict(wcq_policy=wcq_policy, reclaim_policy=reclaim_policy,
-                    bwc_forbidden=bwc_forbidden,
-                    bwc_threshold=bwc_threshold,
-                    cq_has_parent=cq_has_parent)
+        cfg = dict(wcq_policy=wcq_policy, reclaim_policy=reclaim_policy,
+                   bwc_forbidden=bwc_forbidden,
+                   bwc_threshold=bwc_threshold,
+                   cq_has_parent=cq_has_parent)
+        self._pcfg_cache = (ver, w, cfg)
+        return cfg
 
     def _encode_admitted(self, w):
         """Admitted tensors for the preemption kernels, cached by
@@ -701,6 +746,9 @@ class OracleBridge:
             return self._fallback("all-host")
 
         # --- device cycle ---
+        # World-structure arrays are device-resident across cycles
+        # (re-uploaded only on spec changes); per-cycle uploads are just
+        # the row tensors + usage.
         args = dict(
             rank=jnp.asarray(rank),
             commit_rank=jnp.asarray(rows.commit_ranks()),
@@ -708,29 +756,9 @@ class OracleBridge:
             wl_priority=jnp.asarray(wl.priority),
             wl_has_qr=jnp.asarray(wl.has_quota_reservation),
             wl_hash=jnp.asarray(wl.hash_id),
-            nominal=jnp.asarray(w.nominal),
-            lend_limit=jnp.asarray(w.lend_limit),
-            borrow_limit=jnp.asarray(w.borrow_limit),
-            parent=jnp.asarray(w.parent),
-            ancestors=jnp.asarray(w.ancestors),
-            height=jnp.asarray(w.height),
-            group_of_res=jnp.asarray(w.group_of_res),
-            group_flavors=jnp.asarray(w.group_flavors),
-            no_preemption=jnp.asarray(w.no_preemption),
-            can_pwb=jnp.asarray(w.can_preempt_while_borrowing),
-            can_always_reclaim=jnp.asarray(w.can_always_reclaim),
-            best_effort=jnp.asarray(w.best_effort),
-            fung_borrow_try_next=jnp.asarray(w.fung_borrow_try_next),
-            fung_pref_preempt_first=jnp.asarray(w.fung_pref_preempt_first),
-            root_members=jnp.asarray(w.root_members),
-            root_nodes=jnp.asarray(w.root_nodes),
-            local_chain=jnp.asarray(w.local_chain),
             wl_ts=jnp.asarray(wl.timestamp),
-            fair_weight=jnp.asarray(w.fair_weight),
-            child_rank=jnp.asarray(w.child_rank),
-            local_depth=jnp.asarray(w.local_depth),
-            root_parent_local=jnp.asarray(w.root_parent_local),
         )
+        args.update(self._device_world_args(w))
         # Bucket-pad the workload axis so recurring cycles with varying
         # pending counts reuse one compiled program per bucket.
         from kueue_tpu.tensor.schema import (
@@ -917,14 +945,36 @@ class OracleBridge:
             parked_of_slot.setdefault(int(wls.cq[i]), []).append(int(i))
 
         # Apply per slot in the host's nominate order (the queue manager's
-        # ClusterQueue iteration order): the interleaving of parking and
-        # evictions matters, because an eviction re-activates the cohort's
-        # inadmissible workloads — a head parked BEFORE a later entry's
-        # eviction comes back, one parked after stays parked
-        # (engine._sequential_cycle processes entries the same way).
+        # ClusterQueue iteration order). Cohort-inadmissible requeues
+        # triggered by evictions are DEFERRED to one bulk pass after the
+        # loop — every NoFit-parked head in an evicting cohort
+        # re-activates at cycle end, exactly like the sequential path
+        # (engine._sequential_cycle defers identically; in the reference
+        # these requeues ride watch events that land after schedule()).
         cq_idx = {n: i for i, n in enumerate(w.cq_names)}
         nominate_order = [cq_idx[n] for n in eng.queues.cluster_queues
                           if n in cq_idx]
+        bulk = eng.begin_bulk_admit()
+        deferred: set = set()
+        eng._deferred_cohort_requeue = deferred
+        try:
+            self._apply_slots(nominate_order, slot_mask, admit_of_slot,
+                              parked_of_slot, pending_infos, w, wls,
+                              flavor_of_res, slot_position,
+                              slot_preempting, head_idx, preempt_targets,
+                              eng, bulk, result)
+        finally:
+            eng._deferred_cohort_requeue = None
+        eng._requeue_cohorts_bulk(deferred)
+        eng.flush_bulk_admit(bulk)
+        return result
+
+    def _apply_slots(self, nominate_order, slot_mask, admit_of_slot,
+                     parked_of_slot, pending_infos, w, wls, flavor_of_res,
+                     slot_position, slot_preempting, head_idx,
+                     preempt_targets, eng, bulk, result):
+        from kueue_tpu.scheduler.preemption import Target
+
         for ci in nominate_order:
             if not slot_mask[ci]:
                 continue
@@ -935,7 +985,7 @@ class OracleBridge:
                 entry.status = EntryStatus.ASSUMED
                 entry.commit_position = int(slot_position[ci])
                 eng.queues.delete_workload(info.obj)
-                eng._admit(entry)
+                eng._admit(entry, bulk=bulk)
                 result.entries.append(entry)
                 result.stats.admitted += 1
             if slot_preempting[ci]:
@@ -949,7 +999,7 @@ class OracleBridge:
                 entry.inadmissible_msg = (
                     f"Preempting {len(entry.preemption_targets)} "
                     "workload(s)")
-                eng._issue_preemptions(entry)
+                eng._issue_preemptions(entry, bulk=bulk)
                 result.entries.append(entry)
                 result.stats.preempting += 1
             head_row = int(head_idx[ci]) if head_idx is not None else -1
@@ -967,10 +1017,29 @@ class OracleBridge:
                                   requeue_reason=RequeueReason.NO_FIT)
                     entry.inadmissible_msg = "NoFit (batched oracle)"
                     result.entries.append(entry)
-        return result
 
     def _make_entry(self, info, w, wls, flavor_of_res, i) -> Entry:
-        ci = wls.cq[i]
+        """Entry for an admitted verdict row. Assignments are FLYWEIGHTS:
+        rows with equal scheduling-equivalence hash and equal slot flavor
+        picks produce identical Assignment structures, and the bulk-admit
+        path never mutates them — one immutable instance serves every
+        equivalent admission (the per-entry construction was the largest
+        single apply-phase cost at 1k admissions/cycle)."""
+        ci = int(wls.cq[i])
+        # Content-addressed key: the scheduling-equivalence hash TUPLE
+        # (dense hash ids are recycled and must not key a cache) plus the
+        # slot's flavor picks, guarded by the spec version that defines
+        # the flavor-id space.
+        ver = self.engine.cache.spec_version
+        cache = getattr(self, "_assignment_cache", None)
+        if cache is None or cache[0] != ver:
+            cache = (ver, {})
+            self._assignment_cache = cache
+        rows = self.engine.queues.rows
+        key = (rows._hash_tuple[i], flavor_of_res[ci].tobytes())
+        cached = cache[1].get(key)
+        if cached is not None:
+            return Entry(info=info, assignment=cached)
         psr = info.total_requests[0]
         flavors = {}
         usage: dict[FlavorResource, int] = {}
@@ -986,4 +1055,6 @@ class OracleBridge:
             name=psr.name, flavors=flavors,
             requests=dict(psr.requests), count=psr.count)
         assignment = Assignment(pod_sets=[psa], usage=usage)
+        if key[0] is not None:
+            cache[1][key] = assignment
         return Entry(info=info, assignment=assignment)
