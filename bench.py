@@ -83,7 +83,22 @@ def bench_throughput_flat(n_workloads, n_cohorts):
     }, scen, snap, infos
 
 
-def bench_cycle_latency(scen, n_cycles=6):
+def _device_share(eng) -> dict:
+    """Per-scenario device-share report (how much of the serving path
+    actually ran on device, and why roots/cycles fell back)."""
+    b = eng.oracle
+    if b is None:
+        return {}
+    return {
+        "device_cycles": b.cycles_on_device,
+        "fallback_cycles": b.cycles_fallback,
+        "hybrid_cycles": b.cycles_hybrid,
+        "fallback_reasons": dict(b.fallback_reasons),
+        "host_root_reasons": dict(b.host_root_reasons),
+    }
+
+
+def bench_cycle_latency(scen, n_cycles=6, fair=False):
     """The serving-path cycle at north-star scale, through the ENGINE:
     snapshot + incremental tensor encode + device solve + verdict
     apply, per schedule_once() call (the <500 ms target covers the
@@ -92,7 +107,7 @@ def bench_cycle_latency(scen, n_cycles=6):
     full-row encode and is untimed."""
     from kueue_tpu.controllers.engine import Engine
 
-    eng = Engine()
+    eng = Engine(enable_fair_sharing=fair)
     for rf in scen.flavors:
         eng.create_resource_flavor(rf)
     for co in scen.cohorts:
@@ -107,7 +122,10 @@ def bench_cycle_latency(scen, n_cycles=6):
     eng.attach_oracle()
 
     # The engine's own serving-daemon GC posture (part of the system
-    # under test; the oracle service main applies the same).
+    # under test). Unfrozen again after the timed loop: this process
+    # builds several scenario worlds, and a frozen discarded world is
+    # unreclaimable cyclic garbage.
+    import gc
     eng.apply_serving_gc_posture()
 
     times = []
@@ -125,6 +143,7 @@ def bench_cycle_latency(scen, n_cycles=6):
         admitted_total += r.stats.admitted
         if not r.stats.admitted:
             break
+    gc.unfreeze()
     if not times:
         return {"value": 0.0, "unit": "s/cycle (p95)", "vs_baseline": 0.0,
                 "detail": {"error": "no timed cycle admitted anything"}}
@@ -141,7 +160,8 @@ def bench_cycle_latency(scen, n_cycles=6):
                    "cycles_timed": len(times),
                    "admitted": admitted_total,
                    "mean_phases_s": mean_phase,
-                   "target_s": CYCLE_TARGET_S},
+                   "target_s": CYCLE_TARGET_S,
+                   **_device_share(eng)},
     }
 
 
@@ -169,6 +189,19 @@ def bench_hier_fair(n_workloads):
                    "cycles": stats["cycles"],
                    "elapsed_s": round(elapsed, 3)},
     }
+
+
+def bench_fair_cycle_latency(n_workloads=20_000, n_cycles=6):
+    """Fair-mode SERVING cycle at scale: the hierarchical DRS tournament
+    decides head order on device, through the engine, over the 3-level
+    hier_fair tree (>=500 CQs)."""
+    from kueue_tpu.bench.scenario import hierarchical_fair
+
+    scen = hierarchical_fair(n_workloads=n_workloads)
+    out = bench_cycle_latency(scen, n_cycles=n_cycles, fair=True)
+    out["detail"]["cqs"] = len(scen.cluster_queues)
+    out["detail"]["workloads"] = len(scen.workloads)
+    return out
 
 
 def _drain_engine(eng, max_cycles=5_000):
@@ -260,22 +293,143 @@ def bench_preempt_churn(n_pending, n_cohorts=20, cqs_per_cohort=5):
     elapsed = time.perf_counter() - t0
     decisions = admitted + preempting
     value = decisions / elapsed if elapsed > 0 else 0.0
-    b = eng.oracle
     return {
         "value": round(value, 1), "unit": "decisions/s",
         "vs_baseline": round(value / REF_BASELINE_ADM_S, 2),
         "detail": {"pending": n_pending, "cqs": n_cqs,
                    "admitted": admitted, "preemptions": preempting,
-                   "device_cycles": b.cycles_on_device,
-                   "fallback_cycles": b.cycles_fallback,
-                   "elapsed_s": round(elapsed, 3)},
+                   "elapsed_s": round(elapsed, 3),
+                   **_device_share(eng)},
+    }
+
+
+def bench_mixed(n_workloads=10_000, n_roots=30, cqs_per_root=4):
+    """Mixed-world serving drain (the test_mixed_worlds.py shapes at
+    bench scale): plain, multi-flavor, and TAS cohort roots in ONE
+    engine, with node-selector and multi-podset workloads sprinkled in.
+    Reports decisions/s plus the device-share counters — the honest
+    measure of how much of a REALISTIC world runs on device."""
+    import random
+
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        ClusterQueuePreemption,
+        Cohort,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        PodSetTopologyRequest,
+        PreemptionPolicy,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Topology,
+        TopologyLevel,
+        TopologyMode,
+        Workload,
+    )
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.tas.snapshot import HOSTNAME_LABEL, Node
+
+    n_cqs = n_roots * cqs_per_root
+
+    def build():
+        rng = random.Random(23)
+        eng = Engine()
+        eng.create_resource_flavor(ResourceFlavor("on-demand"))
+        eng.create_resource_flavor(ResourceFlavor("spot"))
+        eng.create_topology(Topology("dc", (
+            TopologyLevel("rack"), TopologyLevel(HOSTNAME_LABEL))))
+        eng.create_resource_flavor(ResourceFlavor(name="tas",
+                                                  topology_name="dc"))
+        for r in range(8):
+            for h in range(8):
+                name = f"r{r}-h{h}"
+                eng.create_node(Node(
+                    name=name,
+                    labels={"rack": f"r{r}", HOSTNAME_LABEL: name},
+                    capacity={"cpu": 16000, "pods": 64}))
+        kinds = []
+        ci = 0
+        per_cq = max(1, n_workloads // n_cqs)
+        nominal = per_cq * 700  # ~70% of demand fits
+        for root in range(n_roots):
+            eng.create_cohort(Cohort(f"root{root}"))
+            kind = ("plain", "plain", "multiflavor", "tas")[root % 4]
+            for _ in range(cqs_per_root):
+                name = f"cq{ci}"
+                if kind == "tas":
+                    rgs = (ResourceGroup(("cpu",), (FlavorQuotas(
+                        "tas", {"cpu": ResourceQuota(nominal)}),)),)
+                elif kind == "multiflavor":
+                    rgs = (ResourceGroup(("cpu",), (
+                        FlavorQuotas("on-demand",
+                                     {"cpu": ResourceQuota(nominal)}),
+                        FlavorQuotas("spot",
+                                     {"cpu": ResourceQuota(nominal)}),)),)
+                else:
+                    rgs = (ResourceGroup(("cpu",), (FlavorQuotas(
+                        "on-demand", {"cpu": ResourceQuota(nominal)}),)),)
+                eng.create_cluster_queue(ClusterQueue(
+                    name=name, cohort=f"root{root}",
+                    preemption=ClusterQueuePreemption(
+                        within_cluster_queue=(
+                            PreemptionPolicy.LOWER_PRIORITY if ci % 2
+                            else PreemptionPolicy.NEVER)),
+                    resource_groups=rgs))
+                eng.create_local_queue(LocalQueue(f"lq{ci}", "default",
+                                                  name))
+                kinds.append(kind)
+                ci += 1
+        for k in range(n_workloads):
+            eng.clock += 0.0001
+            qi = rng.randrange(n_cqs)
+            kind = kinds[qi]
+            pri = rng.choice([0, 0, 1, 5])
+            if kind == "tas":
+                ps = (PodSet("main", rng.choice([2, 4]), {"cpu": 500},
+                             topology_request=PodSetTopologyRequest(
+                                 mode=rng.choice([TopologyMode.REQUIRED,
+                                                  TopologyMode.PREFERRED]),
+                                 level="rack")),)
+            elif rng.random() < 0.05:
+                ps = (PodSet("driver", 1, {"cpu": 200}),
+                      PodSet("exec", 2, {"cpu": 400}))
+            elif rng.random() < 0.05:
+                ps = (PodSet("main", 1, {"cpu": rng.choice([400, 800])},
+                             node_selector={"disk": "ssd"}),)
+            else:
+                ps = (PodSet("main", 1,
+                             {"cpu": rng.choice([400, 800, 1600])}),)
+            eng.submit(Workload(name=f"w{k}", queue_name=f"lq{qi}",
+                                priority=pri, pod_sets=ps))
+        eng.attach_oracle()
+        return eng
+
+    _drain_engine(build())  # warm-up: compile all device programs
+    eng = build()
+    t0 = time.perf_counter()
+    admitted, preempting = _drain_engine(eng)
+    elapsed = time.perf_counter() - t0
+    decisions = admitted + preempting
+    value = decisions / elapsed if elapsed > 0 else 0.0
+    return {
+        "value": round(value, 1), "unit": "decisions/s",
+        "vs_baseline": round(value / REF_BASELINE_ADM_S, 2),
+        "detail": {"workloads": n_workloads, "cqs": n_cqs,
+                   "admitted": admitted, "preemptions": preempting,
+                   "elapsed_s": round(elapsed, 3),
+                   **_device_share(eng)},
     }
 
 
 def bench_tas(n_workloads, n_cqs=8):
     """BASELINE.json config 5 shape (640-node analog of
     configs/tas/generator.yaml): topology-constrained gang pod sets
-    placed by the device TAS kernel through the engine."""
+    placed through the engine. The detail reports WHICH TAS path placed
+    them (the host descent below tas/device.py's measured crossover,
+    the device kernel above it) plus a per-placement latency probe of
+    both paths at this forest size."""
     import random
 
     from kueue_tpu.api.types import (
@@ -343,13 +497,71 @@ def bench_tas(n_workloads, n_cqs=8):
     admitted, _ = _drain_engine(eng)
     elapsed = time.perf_counter() - t0
     value = admitted / elapsed if elapsed > 0 else 0.0
+
+    # Honest path label + measured crossover: which TAS implementation
+    # placed these pod sets, and what one placement costs on each at
+    # this forest size (tas/device.py DEVICE_TAS_MIN_DOMAINS).
+    from kueue_tpu.tas.device import (
+        DEVICE_TAS_MIN_DOMAINS,
+        worth_offloading,
+    )
+    snap = next(iter(eng.cache.tas_prototypes().values()), None)
+    path = "device" if (snap is not None and worth_offloading(snap)) \
+        else "host"
+    xover = _tas_crossover_measure(build)
     return {
         "value": round(value, 1), "unit": "admissions/s",
         "vs_baseline": round(value / REF_TAS_ADM_S, 2),
         "detail": {"workloads": n_workloads, "nodes": 640,
                    "admitted": admitted,
-                   "elapsed_s": round(elapsed, 3)},
+                   "elapsed_s": round(elapsed, 3),
+                   "tas_path": path,
+                   "device_crossover_domains": DEVICE_TAS_MIN_DOMAINS,
+                   **xover,
+                   **_device_share(eng)},
     }
+
+
+def _tas_crossover_measure(build, n_probe: int = 5) -> dict:
+    """Per-placement latency of the host descent vs the device kernel on
+    the SAME 640-leaf forest — the measurement behind the
+    DEVICE_TAS_MIN_DOMAINS crossover choice."""
+    import os
+
+    from kueue_tpu.api.types import PodSet, PodSetTopologyRequest, \
+        TopologyMode
+    from kueue_tpu.tas.snapshot import TASPodSetRequest
+
+    out = {}
+    try:
+        eng = build()
+        snap = next(iter(eng.cache.tas_prototypes().values()))
+        ps = PodSet("main", 4, {"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(
+                        mode=TopologyMode.REQUIRED, level="rack"))
+        req = TASPodSetRequest(pod_set=ps,
+                               single_pod_requests={"cpu": 1000}, count=4)
+        prior = os.environ.get("KUEUE_TPU_DEVICE_TAS_MIN")
+        for label, env in (("host_place_ms", "1000000"),
+                           ("device_place_ms", "0")):
+            os.environ["KUEUE_TPU_DEVICE_TAS_MIN"] = env
+            try:
+                fork = snap.fork()
+                fork.find_topology_assignments(req)  # warm/compile
+                t0 = time.perf_counter()
+                for _ in range(n_probe):
+                    fork = snap.fork()
+                    fork.find_topology_assignments(req)
+                out[label] = round(
+                    (time.perf_counter() - t0) / n_probe * 1000, 2)
+            finally:
+                if prior is None:
+                    os.environ.pop("KUEUE_TPU_DEVICE_TAS_MIN", None)
+                else:
+                    os.environ["KUEUE_TPU_DEVICE_TAS_MIN"] = prior
+    except Exception as exc:  # noqa: BLE001 — diagnostics only
+        out["crossover_probe_error"] = repr(exc)[:120]
+    return out
 
 
 def main() -> None:
@@ -403,11 +615,17 @@ def main() -> None:
             scenarios[name] = {"error": repr(exc)[:200]}
 
     run_scenario("cycle_latency", lambda: bench_cycle_latency(
-        scen, n_cycles=3 if fast else 6), min_budget_s=90.0)
+        scen, n_cycles=3 if fast else 8), min_budget_s=90.0)
     run_scenario("hier_fair",
                  lambda: bench_hier_fair(500 if fast else 20_000))
+    run_scenario("fair_cycle_latency", lambda: bench_fair_cycle_latency(
+        n_workloads=500 if fast else 20_000,
+        n_cycles=3 if fast else 6), min_budget_s=90.0)
     run_scenario("preempt_churn", lambda: bench_preempt_churn(
         200 if fast else 4_000, n_cohorts=4 if fast else 20))
+    run_scenario("mixed_world", lambda: bench_mixed(
+        n_workloads=500 if fast else 10_000,
+        n_roots=8 if fast else 30), min_budget_s=60.0)
     run_scenario("tas", lambda: bench_tas(60 if fast else 800,
                                           n_cqs=4 if fast else 8))
 
@@ -416,8 +634,9 @@ def main() -> None:
             f"batched admission throughput, {flat['detail']['workloads']}"
             f" workloads x {flat['detail']['cqs']} CQs,"
             f" {flat['detail']['cycles']} cycles ({dev.platform});"
-            " scenarios: cycle-latency p95, hierarchical fair sharing,"
-            " preemption churn, TAS 640 nodes"),
+            " scenarios: cycle-latency p95 (classical + fair-mode),"
+            " hierarchical fair sharing, preemption churn, mixed world"
+            " w/ device share, TAS 640 nodes"),
         "value": flat["value"],
         "unit": "admissions/s",
         "vs_baseline": flat["vs_baseline"],
