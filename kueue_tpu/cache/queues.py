@@ -190,7 +190,11 @@ class PendingClusterQueue:
     def _park_same_hash(self, info: WorkloadInfo) -> None:
         """Scheduling-equivalence hashing (cluster_queue.go:615
         handleInadmissibleHash): pending workloads identical in shape to a
-        NoFit head would get the same verdict — bulk-park them."""
+        NoFit head would get the same verdict — bulk-park them. Gated:
+        kube_features.go SchedulingEquivalenceHashing."""
+        from kueue_tpu.config import features
+        if not features.enabled("SchedulingEquivalenceHashing"):
+            return
         h = scheduling_hash(info.obj, self.name)
         for key, other in list(self.items.items()):
             if scheduling_hash(other.obj, self.name) == h:

@@ -77,8 +77,16 @@ class UnadmittedWorkloads:
                     value = 0
                 else:
                     table[key] = value
-                if self.registry is not None:
+                if self._gauges_on():
                     self.registry.gauge(gauge).set(key, value)
+
+    def _gauges_on(self) -> bool:
+        """kube_features.go UnadmittedWorkloadsObservability: the
+        per-reason gauge families are gated; the status bookkeeping
+        itself always runs (conditions/visibility depend on it)."""
+        from kueue_tpu.config import features
+        return (self.registry is not None
+                and features.enabled("UnadmittedWorkloadsObservability"))
 
     def _adjust(self, status: UnadmittedStatus, delta: int) -> None:
         for table, key, gauge in (
@@ -91,7 +99,7 @@ class UnadmittedWorkloads:
                 value = 0
             else:
                 table[key] = value
-            if self.registry is not None:
+            if self._gauges_on():
                 self.registry.gauge(gauge).set(key, value)
 
     def count_for_cq(self, cq: str, reason: str = None) -> int:

@@ -18,21 +18,45 @@ _DEFAULTS: dict[str, bool] = {
     "SchedulerTimestampPreemptionBuffer": False,
     "FairSharingPreemptWithinNominal": False,
     "FairSharingPrioritizeNonBorrowing": False,
+    # decision semantics (continued)
+    "ReclaimablePods": True,
+    "SchedulingEquivalenceHashing": True,
+    "HierarchicalCohorts": True,
+    "SparkApplicationIntegration": True,
     # TAS
     "TopologyAwareScheduling": True,
     "TASBalancedPlacement": False,
+    "TASFailedNodeReplacement": True,
     "TASReplaceNodeOnPodTermination": False,
+    "TASReplaceNodeOnNodeTaints": False,
+    "TASReplaceNodeNotReadyOverFixedTime": False,
     "TASFailedNodeReplacementFailFast": False,
     "TASRecomputeAssignmentWithinSchedulingCycle": False,
+    "TASMultiLayerTopology": True,
     # subsystems
     "MultiKueue": True,
     "MultiKueueOrchestratedPreemption": False,
     "MultiKueueManagerQuotaAutomation": False,
+    "MultiKueueIncrementalDispatcherConfig": True,
     "ElasticJobsViaWorkloadSlices": False,
+    "ElasticJobsViaWorkloadSlicesWithTAS": True,
     "ConcurrentAdmission": False,
     "WaitForPodsReady": False,
+    "DisableWaitForPodsReady": False,
     "ObjectRetentionPolicies": False,
     "PriorityBoost": False,
+    "FailureRecoveryPolicy": True,
+    "KueueDRAIntegration": True,
+    "KueueDRAIntegrationExtendedResource": True,
+    "LocalQueueDefaulting": True,
+    # defaulting / webhooks
+    "ManagedJobsNamespaceSelectorAlwaysRespected": True,
+    # observability
+    "UnadmittedWorkloadsObservability": True,
+    "LocalQueueMetrics": True,
+    "MetricsForCohorts": True,
+    "CustomMetricLabels": True,
+    "VisibilityOnDemand": True,
     # the TPU oracle fast path
     "BatchedOracle": True,
     # TAS placement solved by the device kernel (ops/tas.tas_place);

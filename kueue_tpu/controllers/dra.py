@@ -155,7 +155,20 @@ class DeviceClassMapper:
 
     def resolve(self, claims: list[ResourceClaim]) -> dict[str, int]:
         """countDevicesPerClass -> extended-resource requests; raises on
-        unknown class."""
+        unmapped classes. Gated: kube_features.go KueueDRAIntegration
+        (+ KueueDRAIntegrationExtendedResource for the mapping itself);
+        with the gate off, claims are rejected rather than silently
+        dropped (KueueDRARejectWorkloadsWhenDRADisabled semantics)."""
+        from kueue_tpu.config import features
+        if claims and not features.enabled("KueueDRAIntegration"):
+            raise KeyError(
+                "workload carries ResourceClaims but the"
+                " KueueDRAIntegration feature gate is disabled")
+        if claims and not features.enabled(
+                "KueueDRAIntegrationExtendedResource"):
+            raise KeyError(
+                "extended-resource mapping disabled"
+                " (KueueDRAIntegrationExtendedResource)")
         out: dict[str, int] = {}
         for claim in claims:
             for req in claim.device_requests():

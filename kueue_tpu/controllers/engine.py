@@ -327,7 +327,17 @@ class Engine:
         """tas/node_controller.go: a node failed — record it on every
         admitted TAS workload placed there (status.unhealthyNodes,
         workload_types.go:766) and arm the second-pass queue so the next
-        scheduling pass runs the replacement algorithm."""
+        scheduling pass runs the replacement algorithm.
+
+        kube_features.go TASFailedNodeReplacement (the parent gate of
+        the per-trigger TASReplaceNode* gates) disables only the
+        REPLACEMENT machinery — the node still stops receiving new
+        placements either way."""
+        from kueue_tpu.config import features
+        if not features.enabled("TASFailedNodeReplacement"):
+            self.cache.set_node_ready(name, False)
+            self._event("NodeUnhealthy", "", detail=name)
+            return
         self.cache.delete_node(name)
         if self.journal is not None:
             self.journal.delete("node", name, ts=self.clock)
@@ -464,7 +474,8 @@ class Engine:
             self.workloads[wl.key] = wl
             self._event("Inadmissible", wl.key, detail=err)  # journals too
             return False
-        # Resolve priorityClassRef (pkg/util/priority).
+        # Resolve priorityClassRef (pkg/util/priority). An explicitly
+        # named class always resolves — this is not gated.
         if (wl.priority_class_name
                 and wl.priority_class_name in self.workload_priority_classes):
             wl.priority = self.workload_priority_classes[
@@ -499,7 +510,17 @@ class Engine:
     def _lq_key(self, wl: Workload) -> tuple:
         return (f"{wl.namespace}/{wl.queue_name}",)
 
+    def _lq_metrics_on(self) -> bool:
+        # kube_features.go LocalQueueMetrics: every per-LocalQueue
+        # series family, event-time and sync-time alike.
+        from kueue_tpu.config import features
+        return features.enabled("LocalQueueMetrics")
+
     def _custom_cq_labels(self, cq_name: str) -> tuple:
+        # kube_features.go CustomMetricLabels.
+        from kueue_tpu.config import features
+        if not features.enabled("CustomMetricLabels"):
+            return ()
         return self.custom_labels.for_object(
             self.cache.cluster_queues.get(cq_name))
 
@@ -520,8 +541,10 @@ class Engine:
         self._evicted_once.discard(wl.uid)  # bound the set to live objects
         self.registry.counter("finished_workloads_total").inc(
             (cq_name, reason))
-        self.registry.counter("local_queue_finished_workloads_total").inc(
-            self._lq_key(wl) + (reason,))
+        if self._lq_metrics_on():
+            self.registry.counter(
+                "local_queue_finished_workloads_total").inc(
+                self._lq_key(wl) + (reason,))
         self._event("Finished", key, cluster_queue=cq_name)
         self._requeue_cohort_inadmissible(cq_name)
 
@@ -710,6 +733,10 @@ class Engine:
 
         snap = self.cache.snapshot()
         fams: dict[str, dict] = defaultdict(dict)
+        # kube_features.go LocalQueueMetrics: skip the per-LQ aggregation
+        # entirely when off (the family swap below still clears stale
+        # series).
+        lq_on = self._lq_metrics_on()
 
         lq_pending: dict = {}
         lq_reserving: dict = {}
@@ -725,19 +752,24 @@ class Engine:
             lq_usage: dict = {}
             for key, info in cqs.workloads.items():
                 wl = self.workloads.get(key)
-                lq = f"{info.obj.namespace}/{info.obj.queue_name}"
                 is_admitted = wl is not None and wl.is_admitted
                 reserving += 1
-                lq_reserving[lq] = lq_reserving.get(lq, 0) + 1
+                if lq_on:
+                    lq = f"{info.obj.namespace}/{info.obj.queue_name}"
+                    lq_reserving[lq] = lq_reserving.get(lq, 0) + 1
+                    if is_admitted:
+                        lq_admitted[lq] = lq_admitted.get(lq, 0) + 1
                 if is_admitted:
                     admitted_n += 1
-                    lq_admitted[lq] = lq_admitted.get(lq, 0) + 1
                 for fr, v in info.usage().items():
-                    lq_reservation[(lq, fr)] = \
-                        lq_reservation.get((lq, fr), 0) + v
+                    if lq_on:
+                        lq_reservation[(lq, fr)] = \
+                            lq_reservation.get((lq, fr), 0) + v
+                        if is_admitted:
+                            lq_usage[(lq, fr)] = \
+                                lq_usage.get((lq, fr), 0) + v
                     if is_admitted:
                         admitted_usage[fr] = admitted_usage.get(fr, 0) + v
-                        lq_usage[(lq, fr)] = lq_usage.get((lq, fr), 0) + v
             for fr, v in cqs.node.usage.items():
                 fams["cluster_queue_resource_reservation"][
                     (name, fr.flavor, fr.resource)] = v
@@ -767,9 +799,11 @@ class Engine:
                 for status, table in (("active", pcq.items),
                                       ("inadmissible", pcq.inadmissible)):
                     for info in list(table.values()):
-                        lq = f"{info.obj.namespace}/{info.obj.queue_name}"
-                        lq_pending[(lq, status)] = \
-                            lq_pending.get((lq, status), 0) + 1
+                        if lq_on:
+                            lq = (f"{info.obj.namespace}/"
+                                  f"{info.obj.queue_name}")
+                            lq_pending[(lq, status)] = \
+                                lq_pending.get((lq, status), 0) + 1
                         for psr in info.total_requests:
                             for res, v in psr.requests.items():
                                 pending[res] = pending.get(res, 0) + v
@@ -786,12 +820,16 @@ class Engine:
             fams["local_queue_reserving_active_workloads"][(lq,)] = n
         for lq, n in lq_admitted.items():
             fams["local_queue_admitted_active_workloads"][(lq,)] = n
-        if self.afs is not None:
+        if self.afs is not None and lq_on:
             for lq, entry in self.afs.usage.items():
                 fams["local_queue_admission_fair_sharing_usage"][(lq,)] = \
                     self.afs.current_usage(lq)
 
-        for name, cohort in snap.cohorts.items():
+        # kube_features.go MetricsForCohorts.
+        from kueue_tpu.config import features
+        cohort_items = (snap.cohorts.items()
+                        if features.enabled("MetricsForCohorts") else ())
+        for name, cohort in cohort_items:
             fams["cohort_info"][
                 (name, cohort.parent.name if cohort.parent else "")] = 1
             for fr, v in cohort.node.subtree_quota.items():
@@ -954,10 +992,12 @@ class Engine:
                 bulk.count("quota_reserved_workloads_total", (cq_name,))
                 bulk.wait("quota_reserved_wait_time_seconds", (cq_name,),
                           wait)
-                bulk.count("local_queue_quota_reserved_workloads_total",
-                           lq)
-                bulk.wait("local_queue_quota_reserved_wait_time_seconds",
-                          lq, wait)
+                if self._lq_metrics_on():
+                    bulk.count(
+                        "local_queue_quota_reserved_workloads_total", lq)
+                    bulk.wait(
+                        "local_queue_quota_reserved_wait_time_seconds",
+                        lq, wait)
             else:
                 self._event("QuotaReserved", wl.key, cluster_queue=cq_name)
                 self.registry.counter(
@@ -965,11 +1005,13 @@ class Engine:
                 self.registry.histogram(
                     "quota_reserved_wait_time_seconds").observe(
                     wait, (cq_name,))
-                self.registry.counter(
-                    "local_queue_quota_reserved_workloads_total").inc(lq)
-                self.registry.histogram(
-                    "local_queue_quota_reserved_wait_time_seconds").observe(
-                    wait, lq)
+                if self._lq_metrics_on():
+                    self.registry.counter(
+                        "local_queue_quota_reserved_workloads_total"
+                    ).inc(lq)
+                    self.registry.histogram(
+                        "local_queue_quota_reserved_wait_time_seconds"
+                    ).observe(wait, lq)
             if self.admission_checks is not None:
                 # The UnsatisfiedChecks window only exists when admission
                 # checks can actually defer the Admitted condition; with
@@ -1005,8 +1047,10 @@ class Engine:
             bulk.count("admitted_workloads_total",
                        (cq_name,) + self._custom_cq_labels(cq_name))
             bulk.wait("admission_wait_time_seconds", (cq_name,), wait)
-            bulk.count("local_queue_admitted_workloads_total", lq)
-            bulk.wait("local_queue_admission_wait_time_seconds", lq, wait)
+            if self._lq_metrics_on():
+                bulk.count("local_queue_admitted_workloads_total", lq)
+                bulk.wait("local_queue_admission_wait_time_seconds", lq,
+                          wait)
             if reserved is not None:
                 bulk.wait(
                     "admission_checks_wait_time_seconds", (cq_name,),
@@ -1021,10 +1065,12 @@ class Engine:
                 (cq_name,) + self._custom_cq_labels(cq_name))
             self.registry.histogram("admission_wait_time_seconds").observe(
                 wait, (cq_name,))
-            self.registry.counter(
-                "local_queue_admitted_workloads_total").inc(lq)
-            self.registry.histogram(
-                "local_queue_admission_wait_time_seconds").observe(wait, lq)
+            if self._lq_metrics_on():
+                self.registry.counter(
+                    "local_queue_admitted_workloads_total").inc(lq)
+                self.registry.histogram(
+                    "local_queue_admission_wait_time_seconds").observe(
+                    wait, lq)
             if reserved is not None:
                 self.registry.histogram(
                     "admission_checks_wait_time_seconds").observe(
@@ -1096,14 +1142,16 @@ class Engine:
         if bulk is not None:
             bulk.count("evicted_workloads_total",
                        (cq_name, reason) + self._custom_cq_labels(cq_name))
-            bulk.count("local_queue_evicted_workloads_total",
-                       self._lq_key(wl) + (reason,))
+            if self._lq_metrics_on():
+                bulk.count("local_queue_evicted_workloads_total",
+                           self._lq_key(wl) + (reason,))
         else:
             self.registry.counter("evicted_workloads_total").inc(
                 (cq_name, reason) + self._custom_cq_labels(cq_name))
-            self.registry.counter(
-                "local_queue_evicted_workloads_total").inc(
-                self._lq_key(wl) + (reason,))
+            if self._lq_metrics_on():
+                self.registry.counter(
+                    "local_queue_evicted_workloads_total").inc(
+                    self._lq_key(wl) + (reason,))
         if wl.uid not in self._evicted_once:
             # Keyed by UID: a re-created workload under the same name is
             # a new object with its own first eviction (metrics.go:666).

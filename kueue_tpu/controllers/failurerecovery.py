@@ -51,7 +51,10 @@ class FailureRecoveryController:
 
     def node_failed(self, node_name: str) -> list[str]:
         """Node health event (tas/node_controller.go). Returns affected
-        workload keys."""
+        workload keys. Gated: kube_features.go FailureRecoveryPolicy."""
+        from kueue_tpu.config import features
+        if not features.enabled("FailureRecoveryPolicy"):
+            return []
         self.unhealthy_nodes.add(node_name)
         self.engine.cache.set_node_ready(node_name, False)
         affected = self._workloads_on_node(node_name)

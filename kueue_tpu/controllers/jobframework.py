@@ -241,14 +241,17 @@ class JobReconciler:
 
     def __init__(self, engine, integrations: IntegrationManager = None,
                  manage_jobs_without_queue_name: bool = False,
-                 webhooks=None):
+                 webhooks=None, managed_namespace_selector=None):
         """``webhooks``: an optional webhooks.jobwebhooks.JobWebhookRegistry
         — when set, create_job/update_job run the per-framework
         defaulting + validation layer first (the admission webhook in
-        front of the reconciler)."""
+        front of the reconciler). ``managed_namespace_selector``: an
+        optional namespace -> bool predicate
+        (managedJobsNamespaceSelector, reconciler.go:323)."""
         self.engine = engine
         self.integrations = integrations or DEFAULT_INTEGRATIONS
         self.manage_all = manage_jobs_without_queue_name
+        self.managed_namespace_selector = managed_namespace_selector
         self.webhooks = webhooks
         self.jobs: dict[str, GenericJob] = {}
         self.job_to_workload: dict[str, str] = {}
@@ -270,6 +273,15 @@ class JobReconciler:
     def create_job(self, job: GenericJob) -> list[str]:
         """Returns webhook validation errors; on any, the job is
         rejected (not registered), like an admission-webhook denial."""
+        from kueue_tpu.config import features
+        if (type(job).__name__ == "SparkApplicationJob"
+                and not features.enabled("SparkApplicationIntegration")):
+            # kube_features.go SparkApplicationIntegration: the Spark
+            # adapter is gated off -> the job is not managed.
+            self.engine._event(
+                "JobRejected", job.key,
+                detail="SparkApplicationIntegration gate disabled")
+            return ["SparkApplicationIntegration feature gate disabled"]
         if self.webhooks is not None:
             errs = self.webhooks.admit_create(job)
             if errs:
@@ -323,6 +335,16 @@ class JobReconciler:
         """One ReconcileGenericJob pass."""
         if not job.queue_name and not self.manage_all:
             return  # queue-name management gating (reconciler.go:313-377)
+        if (self.managed_namespace_selector is not None
+                and not self.managed_namespace_selector(job.namespace)):
+            # With ManagedJobsNamespaceSelectorAlwaysRespected (default)
+            # the selector gates even jobs that name a queue; with the
+            # gate off, an explicit queue-name opts the job in anyway.
+            from kueue_tpu.config import features
+            if (features.enabled(
+                    "ManagedJobsNamespaceSelectorAlwaysRespected")
+                    or not job.queue_name):
+                return
         if getattr(job, "complete", None) is not None and not job.complete():
             return  # ComposableJob: wait for the whole group to exist
         wl = self._ensure_one_workload(job)

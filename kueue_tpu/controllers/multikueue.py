@@ -278,8 +278,14 @@ class MultiKueueController:
     # -- internals --
 
     def _nominate(self, wl: Workload, state: _RemoteState) -> None:
+        from kueue_tpu.config import features
+
         available = [c for c in self.config.clusters if c in self.clusters]
-        if self.dispatcher == Dispatcher.ALL_AT_ONCE:
+        # Incremental rounds are gated (kube_features.go
+        # MultiKueueIncrementalDispatcherConfig); off = AllAtOnce.
+        if (self.dispatcher == Dispatcher.ALL_AT_ONCE
+                or not features.enabled(
+                    "MultiKueueIncrementalDispatcherConfig")):
             state.nominated = available
             wl.status.nominated_cluster_names = tuple(state.nominated)
             return

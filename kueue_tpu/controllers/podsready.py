@@ -45,10 +45,17 @@ class PodsReadyManager:
                 return False
         return True
 
+    def _active(self) -> bool:
+        """kube_features.go DisableWaitForPodsReady: an emergency
+        off-switch over the config's enable flag."""
+        from kueue_tpu.config import features
+        return (self.config.enable
+                and not features.enabled("DisableWaitForPodsReady"))
+
     def admission_blocked(self) -> bool:
         """scheduler.go:535: with blockAdmission, one not-ready admitted
         workload blocks further admissions."""
-        return (self.config.enable and self.config.block_admission
+        return (self._active() and self.config.block_admission
                 and not self.all_admitted_ready())
 
     def backoff_seconds(self, requeue_count: int) -> float:
@@ -60,7 +67,7 @@ class PodsReadyManager:
 
     def reconcile(self) -> None:
         """The not-ready timeout pass (workload_controller.go:1161)."""
-        if not self.config.enable:
+        if not self._active():
             return
         now = self.engine.clock
         for key in list(self.engine.cache.workloads):

@@ -549,4 +549,9 @@ class BatchedDrainSolver:
             "needs_oracle": bool(oracle_flag),
             "admitted": len(decisions),
             "final_usage": np.asarray(usage),
+            # Per-workload decision vectors (dryrun/multichip parity
+            # asserts these element-wise, not just aggregates).
+            "admit_cycle": admit_cycle,
+            "admit_pos": admit_pos,
+            "wl_flavor": wl_flavor,
         }

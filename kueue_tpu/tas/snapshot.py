@@ -402,7 +402,12 @@ class TASFlavorSnapshot:
                     _add_assumed(assumed, repl, tr)
                 continue
             leader, workers = _find_leader_and_workers(trs)
-            if workers.previous_assignment is not None:
+            if (workers.previous_assignment is not None
+                    and features.enabled(
+                        "ElasticJobsViaWorkloadSlicesWithTAS")):
+                # Delta-only elastic placement is its own sub-gate
+                # (kube_features.go ElasticJobsViaWorkloadSlicesWithTAS);
+                # off = the replacement places from scratch.
                 applied, elastic, reason = self._handle_elastic_workload(
                     workers, leader, assumed,
                     simulate_empty=simulate_empty)
@@ -552,6 +557,12 @@ class TASFlavorSnapshot:
             requested_level_idx = 0
 
         slice_level_key = tr.slice_level or self.level_keys[-1]
+        if (tr.slice_level and tr.slice_level != self.level_keys[-1]
+                and not features.enabled("TASMultiLayerTopology")):
+            # Slices above the leaf level are the multi-layer form
+            # (kube_features.go TASMultiLayerTopology).
+            return None, ("multi-layer slice topologies require the"
+                          " TASMultiLayerTopology feature gate")
         if slice_level_key not in self.level_keys:
             return None, (
                 f"no requested topology level for slices: {slice_level_key}")

@@ -106,10 +106,17 @@ def make_handler(engine):
                     vars(st) if st is not None else None))
             elif (parts[:1] == ["clusterqueues"] and len(parts) == 3
                     and parts[2] == "pendingworkloads"):
-                s = vis.pending_workloads_for_cq(parts[1])
-                self._send(json.dumps({
-                    "clusterQueue": s.cluster_queue,
-                    "items": [vars(i) for i in s.items]}))
+                # kube_features.go VisibilityOnDemand gates the
+                # on-demand pending-positions computation.
+                from kueue_tpu.config import features
+                if not features.enabled("VisibilityOnDemand"):
+                    self._send('{"error":"VisibilityOnDemand disabled"}',
+                               code=403)
+                else:
+                    s = vis.pending_workloads_for_cq(parts[1])
+                    self._send(json.dumps({
+                        "clusterQueue": s.cluster_queue,
+                        "items": [vars(i) for i in s.items]}))
             elif parts[:1] == ["workloads"]:
                 from kueue_tpu.cli.kueuectl import Kueuectl
                 self._send(json.dumps(Kueuectl(engine).list_workloads()))

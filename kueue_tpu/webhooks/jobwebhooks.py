@@ -44,7 +44,11 @@ def _valid_queue_name(name: str) -> bool:
 def apply_default_local_queue(job, default_lq_exists: Callable[[str], bool],
                               enabled: bool = True) -> None:
     """ApplyDefaultLocalQueue: adopt the namespace's LocalQueue named
-    "default" when the job names none."""
+    "default" when the job names none. Gated: kube_features.go
+    LocalQueueDefaulting."""
+    from kueue_tpu.config import features
+    if not features.enabled("LocalQueueDefaulting"):
+        return
     if enabled and not job.queue_name \
             and default_lq_exists(getattr(job, "namespace", "default")):
         job.queue_name = "default"

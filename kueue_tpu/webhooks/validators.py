@@ -85,6 +85,10 @@ def validate_cluster_queue(cq: ClusterQueue) -> list[str]:
 def validate_cohort(cohort: Cohort) -> list[str]:
     errs = _name_errors(cohort.name, "cohort")
     if cohort.parent:
+        from kueue_tpu.config import features
+        if not features.enabled("HierarchicalCohorts"):
+            errs.append("cohort: parentName requires the"
+                        " HierarchicalCohorts feature gate")
         errs += _name_errors(cohort.parent, "cohort.parentName")
         if cohort.parent == cohort.name:
             errs.append("cohort: parentName must differ from name")

@@ -189,13 +189,16 @@ class WorkloadInfo:
             info.apply_admission(wl.status.admission)
         # Reclaimable pods free their share of the quota while the rest of
         # the workload keeps running (workload_types.go:874, applied after
-        # admission so the reduction survives count scaling).
-        for psr in info.total_requests:
-            reclaimed = wl.status.reclaimable_pods.get(psr.name, 0)
-            if reclaimed > 0:
-                scaled = psr.scaled_to(max(psr.count - reclaimed, 0))
-                psr.count = scaled.count
-                psr.requests = scaled.requests
+        # admission so the reduction survives count scaling). Gated:
+        # kube_features.go ReclaimablePods.
+        from kueue_tpu.config import features
+        if features.enabled("ReclaimablePods"):
+            for psr in info.total_requests:
+                reclaimed = wl.status.reclaimable_pods.get(psr.name, 0)
+                if reclaimed > 0:
+                    scaled = psr.scaled_to(max(psr.count - reclaimed, 0))
+                    psr.count = scaled.count
+                    psr.requests = scaled.requests
         return info
 
     @property

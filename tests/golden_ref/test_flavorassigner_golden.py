@@ -1185,6 +1185,31 @@ def test_workload_slice_pinning_via_engine_cycle():
         .flavors["cpu"] == "two"
 
 
+def test_reclaimable_pods_off_golden():
+    """flavorassigner_test.go 'with reclaimable pods; reclaimablePods
+    off': with the gate disabled the full count is assigned."""
+    from kueue_tpu.config import features
+
+    features.set_feature("ReclaimablePods", False)
+    try:
+        assignment = run_assign_case(
+            wl_podsets=[MakePodSet(DEFAULT, 5).Request("cpu", "1").Obj()],
+            reclaimable={DEFAULT: 2},
+            cluster_queue=MakeClusterQueue("test-clusterqueue")
+            .ResourceGroup(MakeFlavorQuotas("default")
+                           .Resource("pods", "5")
+                           .Resource("cpu", "10").Obj()).Obj(),
+            resource_flavors=RESOURCE_FLAVORS)
+        assert_assignment(assignment, FIT, WantAssignment(
+            podsets=[WantPodSet(DEFAULT, {
+                "cpu": wf("default", FIT, -1),
+                "pods": wf("default", FIT, -1)}, count=5)],
+            usage={("default", "pods"): 5, ("default", "cpu"): 5000}),
+            case="with reclaimable pods; reclaimablePods off")
+    finally:
+        features.reset()
+
+
 def test_all_zero_uncovered_podset_does_not_truncate_assignment():
     """A podset whose requests are all explicit zeros of uncovered
     resources is status-clean Fit with no flavors

@@ -1,0 +1,391 @@
+"""Per-gate behavior tests for the round-3 feature-gate additions: each
+gate verifiably changes its mechanism when flipped (kube_features.go
+analog registrations)."""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.api.types import (  # noqa: E402
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.config import features  # noqa: E402
+from kueue_tpu.controllers.engine import Engine  # noqa: E402
+from kueue_tpu.workload_info import WorkloadInfo  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    features.reset()
+
+
+def test_gate_count_at_least_45():
+    assert len(features.all_gates()) >= 45
+
+
+def simple_engine(nominal=4000):
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            ("cpu",), (FlavorQuotas("default",
+                                    {"cpu": ResourceQuota(nominal)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng
+
+
+def test_reclaimable_pods_gate():
+    wl = Workload(name="w", pod_sets=(PodSet("main", 4, {"cpu": 1000}),))
+    wl.status.reclaimable_pods = {"main": 2}
+    assert WorkloadInfo.from_workload(wl).total_requests[0].count == 2
+    features.set_feature("ReclaimablePods", False)
+    assert WorkloadInfo.from_workload(wl).total_requests[0].count == 4
+
+
+def test_scheduling_equivalence_hashing_gate():
+    def park_counts():
+        eng = simple_engine(nominal=1000)
+        for i in range(4):
+            eng.clock += 0.01
+            eng.submit(Workload(
+                name=f"w{i}", queue_name="lq",
+                pod_sets=(PodSet("main", 1, {"cpu": 3000}),)))
+        eng.schedule_once()
+        return len(eng.queues.cluster_queues["cq"].inadmissible)
+
+    assert park_counts() == 4  # head + 3 hash siblings bulk-parked
+    features.set_feature("SchedulingEquivalenceHashing", False)
+    assert park_counts() == 1  # only the NoFit head parks
+
+
+def test_unadmitted_observability_gate():
+    features.set_feature("UnadmittedWorkloadsObservability", False)
+    eng = simple_engine()
+    eng.submit(Workload(name="w", queue_name="lq",
+                        pod_sets=(PodSet("main", 1, {"cpu": 99000}),)))
+    eng.schedule_once()
+    assert eng.unadmitted.statuses  # bookkeeping still runs
+    assert not eng.registry.gauge("unadmitted_workloads").values
+
+
+def test_hierarchical_cohorts_gate():
+    from kueue_tpu.webhooks.validators import validate_cohort
+    child = Cohort("child", parent="root")
+    assert not validate_cohort(child)
+    features.set_feature("HierarchicalCohorts", False)
+    assert any("HierarchicalCohorts" in e for e in validate_cohort(child))
+
+
+def test_local_queue_defaulting_gate():
+    from kueue_tpu.webhooks.jobwebhooks import apply_default_local_queue
+
+    class J:
+        queue_name = ""
+        namespace = "default"
+
+    j = J()
+    apply_default_local_queue(j, lambda ns: True)
+    assert j.queue_name == "default"
+    features.set_feature("LocalQueueDefaulting", False)
+    j2 = J()
+    apply_default_local_queue(j2, lambda ns: True)
+    assert j2.queue_name == ""
+
+
+def test_disable_wait_for_pods_ready_gate():
+    from kueue_tpu.config.api import WaitForPodsReady
+    from kueue_tpu.controllers.podsready import PodsReadyManager
+
+    eng = simple_engine()
+    prm = PodsReadyManager(eng, WaitForPodsReady(enable=True,
+                                                 block_admission=True))
+    eng.pods_ready = prm
+    eng.submit(Workload(name="w", queue_name="lq",
+                        pod_sets=(PodSet("main", 1, {"cpu": 1000}),)))
+    eng.schedule_once()
+    # One admitted-but-not-ready workload blocks admission...
+    eng.submit(Workload(name="w2", queue_name="lq",
+                        pod_sets=(PodSet("main", 1, {"cpu": 1000}),)))
+    assert prm.admission_blocked()
+    # ...unless the emergency off-switch gate is on.
+    features.set_feature("DisableWaitForPodsReady", True)
+    assert not prm.admission_blocked()
+
+
+def test_dra_gates():
+    from kueue_tpu.controllers.dra import (
+        DeviceClass,
+        DeviceClassMapper,
+        DeviceRequest,
+        ResourceClaim,
+    )
+
+    mapper = DeviceClassMapper()
+    mapper.add_device_class(DeviceClass(
+        name="gpus", extended_resource="example.com/gpu"))
+    claims = [ResourceClaim(requests=(
+        DeviceRequest(device_class="gpus", count=2),))]
+    assert mapper.resolve(claims) == {"example.com/gpu": 2}
+    features.set_feature("KueueDRAIntegration", False)
+    with pytest.raises(KeyError, match="KueueDRAIntegration"):
+        mapper.resolve(claims)
+    features.reset()
+    features.set_feature("KueueDRAIntegrationExtendedResource", False)
+    with pytest.raises(KeyError, match="ExtendedResource"):
+        mapper.resolve(claims)
+
+
+def test_failure_recovery_policy_gate():
+    from kueue_tpu.controllers.failurerecovery import (
+        FailureRecoveryController,
+    )
+
+    features.set_feature("FailureRecoveryPolicy", False)
+    eng = simple_engine()
+    frc = FailureRecoveryController(eng)
+    assert frc.node_failed("node-1") == []
+    assert not frc.unhealthy_nodes
+
+
+def test_tas_failed_node_replacement_parent_gate():
+    features.set_feature("TASFailedNodeReplacement", False)
+    from kueue_tpu.tas.snapshot import HOSTNAME_LABEL, Node
+
+    eng = simple_engine()
+    eng.create_node(Node(name="n1", labels={HOSTNAME_LABEL: "n1"},
+                         capacity={"cpu": 1000}))
+    eng.mark_node_unhealthy("n1", reason="test")
+    assert "n1" in eng.cache.nodes  # node NOT dropped: replacement off
+
+
+def test_spark_application_integration_gate():
+    from kueue_tpu.controllers.integrations import SparkApplicationJob
+    from kueue_tpu.controllers.jobframework import JobReconciler
+
+    eng = simple_engine()
+    rec = JobReconciler(eng)
+    job = SparkApplicationJob(name="s", queue_name="lq",
+                              driver_requests={"cpu": 100},
+                              executor_instances=1,
+                              executor_requests={"cpu": 100})
+    assert rec.create_job(job) == []
+    features.set_feature("SparkApplicationIntegration", False)
+    job2 = SparkApplicationJob(name="s2", queue_name="lq",
+                               driver_requests={"cpu": 100},
+                               executor_instances=1,
+                               executor_requests={"cpu": 100})
+    errs = rec.create_job(job2)
+    assert errs and "SparkApplicationIntegration" in errs[0]
+
+
+def test_local_queue_metrics_gate():
+    features.set_feature("LocalQueueMetrics", False)
+    eng = simple_engine()
+    eng.submit(Workload(name="w", queue_name="lq",
+                        pod_sets=(PodSet("main", 1, {"cpu": 1000}),)))
+    eng.schedule_once()
+    eng.sync_resource_metrics()
+    assert not eng.registry.gauge(
+        "local_queue_admitted_active_workloads").values
+    assert eng.registry.gauge("admitted_active_workloads").values
+
+
+def test_metrics_for_cohorts_gate():
+    features.set_feature("MetricsForCohorts", False)
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cohort(Cohort("co"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", cohort="co", resource_groups=(ResourceGroup(
+            ("cpu",), (FlavorQuotas("default",
+                                    {"cpu": ResourceQuota(1000)}),)),)))
+    eng.sync_resource_metrics()
+    assert not eng.registry.gauge("cohort_info").values
+
+
+def test_custom_metric_labels_gate():
+    from kueue_tpu.metrics.registry import (
+        CustomLabelEntry,
+        CustomMetricLabels,
+    )
+
+    eng = simple_engine()
+    eng.custom_labels = CustomMetricLabels(
+        [CustomLabelEntry(name="team", source_label_key="team")])
+    eng.cache.cluster_queues["cq"].labels["team"] = "ml"
+    assert eng._custom_cq_labels("cq")
+    features.set_feature("CustomMetricLabels", False)
+    assert eng._custom_cq_labels("cq") == ()
+
+
+def test_incremental_dispatcher_gate(monkeypatch):
+    from kueue_tpu.controllers import multikueue as mk
+
+    class FakeState:
+        nominated = []
+        last_round_time = 0.0
+
+    class FakeCtl:
+        dispatcher = mk.Dispatcher.INCREMENTAL
+        increment = 1
+        round_seconds = 300.0
+
+        def __init__(self, eng, clusters):
+            self.engine = eng
+            self.config = type("C", (), {"clusters": clusters})()
+            self.clusters = {c: object() for c in clusters}
+
+        _nominate = mk.MultiKueueController._nominate
+
+    eng = simple_engine()
+    wl = Workload(name="w", pod_sets=(PodSet("main", 1, {"cpu": 1}),))
+    ctl = FakeCtl(eng, ["a", "b", "c"])
+    st = FakeState()
+    ctl._nominate(wl, st)
+    assert len(st.nominated) == 1  # incremental round 1
+    features.set_feature("MultiKueueIncrementalDispatcherConfig", False)
+    st2 = FakeState()
+    ctl._nominate(wl, st2)
+    assert len(st2.nominated) == 3  # degraded to AllAtOnce
+
+
+def test_managed_namespace_selector_gate():
+    from kueue_tpu.controllers.jobframework import BatchJob, JobReconciler
+
+    def build(gate_on):
+        features.reset()
+        features.set_feature(
+            "ManagedJobsNamespaceSelectorAlwaysRespected", gate_on)
+        eng = simple_engine()
+        rec = JobReconciler(
+            eng, managed_namespace_selector=lambda ns: ns == "managed")
+        job = BatchJob(name="j", queue_name="lq", requests={"cpu": 100})
+        rec.create_job(job)
+        return rec.job_to_workload.get(job.key)
+
+    # Gate on (default): the selector is respected even with a queue name.
+    assert build(True) is None
+    # Gate off: an explicit queue-name opts the job in anyway.
+    assert build(False) is not None
+
+
+def test_elastic_tas_sub_gate_and_multilayer_gate():
+    from kueue_tpu.api.types import (
+        PodSetTopologyRequest,
+        Topology,
+        TopologyLevel,
+        TopologyMode,
+    )
+    from kueue_tpu.tas.snapshot import (
+        HOSTNAME_LABEL,
+        Node,
+        TASFlavorSnapshot,
+        TASPodSetRequest,
+    )
+
+    topo = Topology("dc", (TopologyLevel("rack"),
+                           TopologyLevel(HOSTNAME_LABEL)))
+    snap = TASFlavorSnapshot(topo, "tas")
+    for h in range(2):
+        snap.add_node(Node(name=f"n{h}",
+                           labels={"rack": "r0", HOSTNAME_LABEL: f"n{h}"},
+                           capacity={"cpu": 4000, "pods": 8}))
+    # Multi-layer slices (slice level above the leaf) gated:
+    features.set_feature("TASMultiLayerTopology", False)
+    ps = PodSet("main", 2, {"cpu": 100},
+                topology_request=PodSetTopologyRequest(
+                    mode=TopologyMode.REQUIRED, level="rack",
+                    slice_level="rack", slice_size=1))
+    req = TASPodSetRequest(pod_set=ps, single_pod_requests={"cpu": 100},
+                           count=2)
+    got, reason = snap.find_topology_assignments_host(req)
+    assert "TASMultiLayerTopology" in reason
+
+
+def test_elastic_tas_sub_gate():
+    """ElasticJobsViaWorkloadSlicesWithTAS off: a replacement slice with
+    a previous assignment places from scratch (the delta-only handler
+    never engages)."""
+    from kueue_tpu.api.types import (
+        PodSetTopologyRequest,
+        Topology,
+        TopologyLevel,
+        TopologyMode,
+    )
+    from kueue_tpu.tas.snapshot import (
+        HOSTNAME_LABEL,
+        Node,
+        TASFlavorSnapshot,
+        TASPodSetRequest,
+        TopologyAssignment,
+        TopologyDomainAssignment,
+    )
+
+    def place(gate_on):
+        features.reset()
+        features.set_feature("ElasticJobsViaWorkloadSlicesWithTAS",
+                             gate_on)
+        topo = Topology("dc", (TopologyLevel(HOSTNAME_LABEL),))
+        snap = TASFlavorSnapshot(topo, "tas")
+        for h in range(2):
+            snap.add_node(Node(
+                name=f"n{h}", labels={HOSTNAME_LABEL: f"n{h}"},
+                capacity={"cpu": 4000, "pods": 8}))
+        calls = []
+        orig = snap._handle_elastic_workload
+
+        def spy(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+        snap._handle_elastic_workload = spy
+        ps = PodSet("main", 2, {"cpu": 100},
+                    topology_request=PodSetTopologyRequest(
+                        mode=TopologyMode.REQUIRED,
+                        level=HOSTNAME_LABEL))
+        prev = TopologyAssignment(
+            levels=(HOSTNAME_LABEL,),
+            domains=(TopologyDomainAssignment(values=("n0",), count=1),))
+        req = TASPodSetRequest(pod_set=ps,
+                               single_pod_requests={"cpu": 100},
+                               count=2, previous_assignment=prev)
+        results, reason = snap.find_topology_assignments_for_flavor([req])
+        return bool(calls), results, reason
+
+    used_elastic, results, reason = place(True)
+    assert used_elastic and results
+    used_elastic, results, reason = place(False)
+    assert not used_elastic and results  # fresh placement still works
+
+
+def test_visibility_on_demand_gate():
+    import json
+    import urllib.request
+
+    from kueue_tpu.visibility.http_server import ServingEndpoint
+
+    features.set_feature("VisibilityOnDemand", False)
+    eng = simple_engine()
+    ep = ServingEndpoint(eng)
+    ep.start()
+    try:
+        url = (f"http://{ep.httpd.server_address[0]}:"
+               f"{ep.httpd.server_address[1]}/clusterqueues/cq/"
+               "pendingworkloads")
+        try:
+            urllib.request.urlopen(url)
+            raise AssertionError("expected HTTP 403")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+            assert "VisibilityOnDemand" in json.loads(
+                e.read().decode())["error"]
+    finally:
+        ep.stop()
