@@ -336,6 +336,11 @@ class Engine:
         from kueue_tpu.config import features
         if not features.enabled("TASFailedNodeReplacement"):
             self.cache.set_node_ready(name, False)
+            # Persist the not-ready state: a restart must not resurrect
+            # the dead node as placeable.
+            node = self.cache.nodes.get(name)
+            if node is not None:
+                self._journal_obj("node", node)
             self._event("NodeUnhealthy", "", detail=name)
             return
         self.cache.delete_node(name)

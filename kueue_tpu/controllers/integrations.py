@@ -237,14 +237,65 @@ class LeaderWorkerSetJob(_BaseJob):
 
 
 @dataclass
+class LWSGroupJob(_BaseJob):
+    """ONE replica group of a LeaderWorkerSet as its own GenericJob —
+    the reference creates one Workload PER GROUP
+    (pkg/controller/jobs/leaderworkerset: workloads named
+    <lws>-<group-index>), so groups admit, evict, and recover
+    independently while leader+workers stay co-placed via the TAS
+    pod-set group."""
+
+    group_index: int = 0
+    size: int = 2
+    leader_requests: dict = field(default_factory=dict)
+    worker_requests: dict = field(default_factory=dict)
+    topology_request: Optional[PodSetTopologyRequest] = None
+
+    def pod_sets(self) -> list[PodSet]:
+        from dataclasses import replace as _replace
+        tr = self.topology_request or PodSetTopologyRequest(mode=None)
+        tr = _replace(tr, pod_set_group_name=f"group-{self.group_index}")
+        out = [PodSet(name="leader", count=1,
+                      requests=dict(self.leader_requests),
+                      topology_request=tr)]
+        if self.size > 1:
+            out.append(PodSet(name="workers", count=self.size - 1,
+                              requests=dict(self.worker_requests),
+                              topology_request=tr))
+        return out
+
+    def finished(self) -> tuple[bool, bool]:
+        return False, False  # serving semantics
+
+
+def lws_group_jobs(lws: "LeaderWorkerSetJob") -> list[LWSGroupJob]:
+    """Split a LeaderWorkerSet into its per-group jobs (the reference's
+    per-group Workload construction)."""
+    return [LWSGroupJob(
+        name=f"{lws.name}-{g}", namespace=lws.namespace,
+        queue_name=lws.queue_name, priority=lws.priority,
+        group_index=g, size=lws.size,
+        leader_requests=dict(lws.leader_requests),
+        worker_requests=dict(lws.worker_requests),
+        topology_request=lws.topology_request)
+        for g in range(lws.replicas)]
+
+
+@dataclass
 class PodJob(_BaseJob):
     """A plain pod (pkg/controller/jobs/pod): starts behind a scheduling
-    gate; admission ungates it."""
+    gate; admission ungates it. Carries the kueue finalizer the way real
+    group pods do (pod_controller.go:577 Finalize strips them)."""
 
     requests: dict = field(default_factory=dict)
     pod_group: Optional[str] = None
     group_total_count: int = 1
     gated: bool = True
+    failed: bool = False
+    # RetriableInGroupAnnotation (pod_controller.go:225): "false" means a
+    # single pod failure fails the whole group.
+    retriable: bool = True
+    finalizers: list = field(default_factory=list)
 
     def pod_sets(self) -> list[PodSet]:
         return [PodSet(name=self.pod_group or "main",
@@ -260,46 +311,171 @@ class PodJob(_BaseJob):
         self.gated = True
 
 
+POD_FINALIZER = "kueue.x-k8s.io/managed"
+
+
 class PodGroup:
     """Pod groups (pkg/controller/jobs/pod pod-group mode, ComposableJob):
     pods sharing a group name compose into ONE gang Workload with one pod
-    set per distinct shape; the Workload is created only when all
-    ``group_total_count`` pods exist."""
+    set per distinct shape (constructGroupPodSets). Reference edge
+    semantics carried over from pod_controller.go:
+
+      * gate-based assembly — the Workload exists only once all
+        ``total_count`` pods are created, unless ``fast_admission``
+        (GroupFastAdmissionAnnotation :717) builds it from the first pod
+        with the full count;
+      * replacement pods — a Failed pod makes the group report
+        WaitingForReplacementPods (:1394) while the Workload stays
+        admitted; a newly created pod replaces it and is ungated
+        immediately; an unretriable group (RetriableInGroup=false, :225)
+        fails the whole Workload instead;
+      * excess pods — pods beyond ``total_count`` are finalized and
+        removed, gated pods first, newest first (removeExcessPods :984);
+      * per-pod finalizers — every member carries the kueue finalizer
+        until the group finishes or is deleted (Finalize :577);
+      * reclaimable pods — Succeeded members release their quota share
+        (ReclaimablePods :1350) for non-serving groups.
+    """
 
     def __init__(self, name: str, namespace: str = "default",
-                 queue_name: str = "", total_count: int = 1):
+                 queue_name: str = "", total_count: int = 1,
+                 fast_admission: bool = False, serving: bool = False):
         self.name = name
         self.namespace = namespace
         self.queue_name = queue_name
         self.total_count = total_count
+        self.fast_admission = fast_admission
+        self.serving = serving
         self.pods: list[PodJob] = []
+        self.removed_excess: list[PodJob] = []
         self.suspended = True
         self.active = False
         self.injected_info = None
         self.priority = 0
+        # The gang's pod sets are FROZEN at Workload construction: pod
+        # failures awaiting replacement must not change the declared
+        # shapes/counts (the Workload keeps its pod sets; only
+        # reclaimablePods adjust, pod_controller.go:1308
+        # equivalentToWorkload ignores absent pods).
+        self._frozen_pod_sets: Optional[list[PodSet]] = None
+        self._shape_names: dict[tuple, str] = {}
 
     @property
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
 
+    # -- membership --
+
     def add_pod(self, pod: PodJob) -> None:
+        if POD_FINALIZER not in pod.finalizers:
+            pod.finalizers.append(POD_FINALIZER)
         self.pods.append(pod)
+        if self.active:
+            # Replacement for a failed member of a running group: ungate
+            # immediately (the group's admission already covers it;
+            # excess beyond total_count is trimmed by sync_excess).
+            pod.gated = False
+
+    def live_pods(self) -> list[PodJob]:
+        return [p for p in self.pods if not p.failed]
+
+    def absent_count(self) -> int:
+        """How many replacement pods the group is waiting for."""
+        return max(0, self.total_count - len(self.live_pods()))
+
+    def sync_excess(self) -> list[PodJob]:
+        """Drop pods beyond total_count: gated (never-started) pods
+        first, newest first; their finalizers are stripped
+        (removeExcessPods + finalizePods)."""
+        removed: list[PodJob] = []
+        live = self.live_pods()
+        excess = len(live) - self.total_count
+        if excess <= 0:
+            return removed
+        for pod in sorted(
+                live, key=lambda p: (not p.gated,
+                                     -self.pods.index(p)))[:excess]:
+            self.pods.remove(pod)
+            if POD_FINALIZER in pod.finalizers:
+                pod.finalizers.remove(POD_FINALIZER)
+            removed.append(pod)
+        self.removed_excess.extend(removed)
+        return removed
+
+    def is_unretriable(self) -> bool:
+        """pod_controller.go:231 isUnretriableGroup."""
+        return any(not p.retriable for p in self.pods)
+
+    def finalize(self) -> None:
+        """Strip the kueue finalizer from every member (Finalize :577).
+        The frozen gang shape unfreezes with it — a re-created group
+        re-declares its pod sets."""
+        for pod in self.pods:
+            if POD_FINALIZER in pod.finalizers:
+                pod.finalizers.remove(POD_FINALIZER)
+        self._frozen_pod_sets = None
+        self._shape_names = {}
+
+    # -- GenericJob contract --
 
     def complete(self) -> bool:
-        return len(self.pods) >= self.total_count
+        if self.fast_admission:
+            return bool(self.pods)
+        return len(self.live_pods()) >= self.total_count
 
     def pod_sets(self) -> list[PodSet]:
         # One pod set per distinct resource shape (pod/pod_controller.go
-        # constructGroupPodSets).
-        shapes: dict[tuple, list[PodJob]] = {}
-        for pod in self.pods:
+        # constructGroupPodSets), FROZEN once the Workload exists —
+        # a failed member awaiting replacement must not reshape the
+        # admitted gang. Under fast admission the absent pods are
+        # assumed to share the first pod's shape so the gang reserves
+        # its full quota up front.
+        if self._frozen_pod_sets is not None:
+            return self._frozen_pod_sets
+        shapes: dict[tuple, int] = {}
+        for pod in self.live_pods():
             shape = tuple(sorted(pod.requests.items()))
-            shapes.setdefault(shape, []).append(pod)
-        out = []
-        for i, (shape, pods) in enumerate(sorted(shapes.items())):
-            out.append(PodSet(name=f"shape-{i}", count=len(pods),
-                              requests=dict(shape)))
+            shapes[shape] = shapes.get(shape, 0) + 1
+        missing = self.total_count - sum(shapes.values())
+        if missing > 0 and shapes:
+            first = tuple(sorted(self.pods[0].requests.items()))
+            shapes[first] = shapes.get(first, 0) + missing
+        out = [PodSet(name=f"shape-{i}", count=n, requests=dict(shape))
+               for i, (shape, n) in enumerate(sorted(shapes.items()))]
+        if self.complete():
+            self._frozen_pod_sets = out
+            self._shape_names = {shape: f"shape-{i}" for i, (shape, _n)
+                                 in enumerate(sorted(shapes.items()))}
         return out
+
+    def reclaimable_pods(self) -> dict[str, int]:
+        """JobWithReclaimablePods: Succeeded members release their share
+        (serving groups never reclaim, :1342-1350)."""
+        if self.serving:
+            return {}
+        out: dict[str, int] = {}
+        for pod in self.live_pods():
+            if not (pod.done and pod.success):
+                continue
+            shape = tuple(sorted(pod.requests.items()))
+            # Keyed by the FROZEN shape->pod-set-name mapping so a
+            # reclaim never lands on the wrong pod set even when whole
+            # shapes have failed out of the live set.
+            name = self._shape_names.get(shape)
+            if name is None:
+                continue
+            out[name] = out.get(name, 0) + 1
+        return out
+
+    def custom_workload_conditions(self, now: float) -> list[tuple]:
+        """CustomWorkloadConditions (:1380): the
+        WaitingForReplacementPods signal, as (type, status, reason) the
+        reconciler applies to the group's Workload."""
+        absent = self.absent_count()
+        if absent > 0:
+            return [("WaitingForReplacementPods", True,
+                     "PodsFailed")]
+        return [("WaitingForReplacementPods", False, "PodsReady")]
 
     def is_suspended(self) -> bool:
         return self.suspended
@@ -315,7 +491,8 @@ class PodGroup:
         self.suspended = False
         self.active = True
         for pod in self.pods:
-            pod.gated = False
+            if not pod.failed:
+                pod.gated = False
 
     def restore_pod_sets_info(self, infos) -> None:
         self.injected_info = None
@@ -324,8 +501,15 @@ class PodGroup:
         return self.active
 
     def finished(self) -> tuple[bool, bool]:
-        if self.pods and all(p.done for p in self.pods):
-            return True, all(p.success for p in self.pods)
+        # An unretriable group fails outright on the first pod failure
+        # (:231); a retriable group keeps its admission and waits for
+        # replacements.
+        if self.is_unretriable() and any(p.failed for p in self.pods):
+            return True, False
+        live = self.live_pods()
+        if (len(live) >= self.total_count
+                and all(p.done for p in live)):
+            return True, all(p.success for p in live)
         return False, False
 
 

@@ -330,6 +330,8 @@ class JobReconciler:
             wl = self.engine.workloads.get(wl_key)
             if wl is not None and not wl.is_finished:
                 self.engine.finish(wl_key)
+        if job is not None and getattr(job, "finalize", None) is not None:
+            job.finalize()  # strip per-pod finalizers (:577)
 
     def reconcile(self, job: GenericJob) -> None:
         """One ReconcileGenericJob pass."""
@@ -345,11 +347,31 @@ class JobReconciler:
                     "ManagedJobsNamespaceSelectorAlwaysRespected")
                     or not job.queue_name):
                 return
-        if getattr(job, "complete", None) is not None and not job.complete():
-            return  # ComposableJob: wait for the whole group to exist
+        if (getattr(job, "complete", None) is not None
+                and not job.complete()
+                and self.job_to_workload.get(job.key) is None):
+            # ComposableJob: wait for the whole group to exist before
+            # CREATING the Workload; an existing group keeps reconciling
+            # through member failures (replacement-pod flow).
+            return
         wl = self._ensure_one_workload(job)
         if wl is None:
             return
+        # Pod-group housekeeping (pod_controller.go): trim excess
+        # members and surface the replacement-pods signal.
+        if getattr(job, "sync_excess", None) is not None:
+            for pod in job.sync_excess():
+                self.engine._event("ExcessPodRemoved", wl.key,
+                                   detail=pod.key)
+        if getattr(job, "custom_workload_conditions", None) is not None:
+            for ctype, status, reason in job.custom_workload_conditions(
+                    self.engine.clock):
+                prev = wl.condition(ctype)
+                if prev is None and not status:
+                    continue  # never set a fresh False condition
+                if prev is None or prev.status != status:
+                    wl.set_condition(ctype, status, reason=reason,
+                                     now=self.engine.clock)
         finished, success = job.finished()
         if finished and not wl.is_finished:
             # workloadfinish.Finish (reconciler.go:453-465).
@@ -358,6 +380,8 @@ class JobReconciler:
                 reason="Succeeded" if success else "Failed",
                 now=self.engine.clock)
             self.engine.finish(wl.key)
+            if getattr(job, "finalize", None) is not None:
+                job.finalize()  # strip per-pod finalizers (:577)
             return
         if wl.is_admitted and job.is_suspended():
             self._start_job(job, wl)

@@ -31,12 +31,84 @@ from typing import Iterator, Optional
 from kueue_tpu.api.serde import from_jsonable, to_jsonable
 
 
+class JournalConflict(Exception):
+    """Optimistic-concurrency failure: the object was modified by another
+    writer since the caller read it (the SSA patch-conflict analog,
+    pkg/workload/patching/patching.go:53-59 — the reference retries
+    after re-reading)."""
+
+    def __init__(self, kind: str, key: str, expected: int, found: int):
+        super().__init__(
+            f"conflict on {kind}/{key}: expected generation {expected},"
+            f" journal has {found}")
+        self.kind = kind
+        self.key = key
+        self.expected = expected
+        self.found = found
+
+
 class Journal:
+    """Append-only JSONL journal with per-key GENERATION stamps.
+
+    Multi-writer safety (a second replica, the out-of-process CLI): every
+    ``apply`` first refreshes from the shared file — appends made by
+    other writers since our last read are folded into the per-key
+    generation table — and then appends with generation last+1. A caller
+    that read an object at generation G can pass
+    ``expected_generation=G``; if another writer advanced the key past G
+    in the meantime the apply raises JournalConflict instead of silently
+    clobbering (exactly the SSA conflict-retry contract). Appends use
+    O_APPEND single-write records, so concurrent writers interleave at
+    record granularity."""
+
     def __init__(self, path: str, fsync: bool = False):
         self.path = path
         self.fsync = fsync
         self._repair_torn_tail()
         self._fh = open(path, "a", encoding="utf-8")
+        # Per-(kind, key) generation table + how far we've read the file.
+        self._generations: dict[tuple, int] = {}
+        self._read_offset = 0
+        self.refresh()
+
+    def refresh(self) -> int:
+        """Fold records appended by OTHER writers (or our own) since the
+        last read into the generation table. Returns the number of new
+        records seen."""
+        n = 0
+        try:
+            with open(self.path, "rb") as fh:
+                fh.seek(self._read_offset)
+                data = fh.read()
+        except FileNotFoundError:
+            return 0
+        if not data:
+            return 0
+        # Only complete lines advance the offset (another writer may be
+        # mid-append).
+        end = data.rfind(b"\n")
+        if end < 0:
+            return 0
+        for line in data[:end].split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            key = (rec.get("kind"), _key_of(rec))
+            self._generations[key] = int(rec.get("gen", 0)) or \
+                self._generations.get(key, 0) + 1
+            n += 1
+        self._read_offset += end + 1
+        return n
+
+    def generation_of(self, kind: str, key: str) -> int:
+        """The last persisted generation for a key (0 = never written).
+        Callers doing read-modify-write pass this back as
+        ``expected_generation``."""
+        self.refresh()
+        return self._generations.get((kind, key), 0)
 
     def _repair_torn_tail(self) -> None:
         """Truncate a torn final line (crash mid-write) so post-restart
@@ -72,24 +144,58 @@ class Journal:
             except (json.JSONDecodeError, UnicodeDecodeError):
                 fh.truncate(size - len(tail))
 
-    def apply(self, kind: str, obj, ts: float = 0.0) -> None:
+    def apply(self, kind: str, obj, ts: float = 0.0,
+              expected_generation: Optional[int] = None) -> int:
         from kueue_tpu.api.conversion import SCHEMA_VERSION
 
         rec = {"op": "apply", "kind": kind, "ts": ts,
                "v": SCHEMA_VERSION, "obj": to_jsonable(obj)}
-        self._write(rec)
+        return self._stamp_and_write(rec, kind, _key_of(rec),
+                                     expected_generation)
 
-    def delete(self, kind: str, key: str, ts: float = 0.0) -> None:
+    def delete(self, kind: str, key: str, ts: float = 0.0,
+               expected_generation: Optional[int] = None) -> int:
         from kueue_tpu.api.conversion import SCHEMA_VERSION
 
-        self._write({"op": "delete", "kind": kind, "key": key, "ts": ts,
-                     "v": SCHEMA_VERSION})
+        return self._stamp_and_write(
+            {"op": "delete", "kind": kind, "key": key, "ts": ts,
+             "v": SCHEMA_VERSION}, kind, key, expected_generation)
+
+    def _stamp_and_write(self, rec: dict, kind: str, key: str,
+                         expected_generation: Optional[int]) -> int:
+        import fcntl
+
+        # The refresh+check+append must be ATOMIC across processes, or
+        # two writers could both pass the generation check and clobber
+        # (the TOCTOU the SSA conflict contract forbids). flock makes
+        # the whole read-modify-append a critical section.
+        fcntl.flock(self._fh.fileno(), fcntl.LOCK_EX)
+        try:
+            self.refresh()
+            k = (kind, key)
+            current = self._generations.get(k, 0)
+            if (expected_generation is not None
+                    and current != expected_generation):
+                raise JournalConflict(kind, key, expected_generation,
+                                      current)
+            gen = current + 1
+            rec["gen"] = gen
+            self._write(rec)
+            self._generations[k] = gen
+            return gen
+        finally:
+            fcntl.flock(self._fh.fileno(), fcntl.LOCK_UN)
 
     def _write(self, rec: dict) -> None:
-        self._fh.write(json.dumps(rec) + "\n")
+        line = json.dumps(rec) + "\n"
+        self._fh.write(line)
         self._fh.flush()
         if self.fsync:
             os.fsync(self._fh.fileno())
+        # Our own append is already folded into the generation table —
+        # advance the read offset so the next refresh() doesn't re-read
+        # and re-parse it (one open+parse per record on the hot path).
+        self._read_offset += len(line.encode("utf-8"))
 
     def close(self) -> None:
         self._fh.close()
@@ -128,6 +234,12 @@ class Journal:
                     fh.write(json.dumps(rec) + "\n")
         os.replace(tmp, self.path)
         self._fh = open(self.path, "a", encoding="utf-8")
+        # Compaction rewrites the file: re-read the generation table from
+        # scratch (gens are preserved in the kept records). Compaction is
+        # a leader-only operation — concurrent writers must not compact.
+        self._generations.clear()
+        self._read_offset = 0
+        self.refresh()
 
 
 def _key_of(rec: dict) -> str:

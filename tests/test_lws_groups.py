@@ -1,0 +1,63 @@
+"""LeaderWorkerSet per-replica-group workloads: groups admit and recover
+INDEPENDENTLY (one Workload per group, the
+pkg/controller/jobs/leaderworkerset contract), with leader+workers
+co-assigned to one flavor via the pod-set group."""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.api.types import (  # noqa: E402
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+)
+from kueue_tpu.controllers.engine import Engine  # noqa: E402
+from kueue_tpu.controllers.integrations import (  # noqa: E402
+    LeaderWorkerSetJob,
+    lws_group_jobs,
+)
+from kueue_tpu.controllers.jobframework import JobReconciler  # noqa: E402
+
+
+def test_lws_groups_admit_independently():
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    # Capacity for exactly one 4-pod group (leader 1 + workers 3).
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            ("cpu",), (FlavorQuotas("default",
+                                    {"cpu": ResourceQuota(4000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    rec = JobReconciler(eng)
+
+    lws = LeaderWorkerSetJob(name="serve", queue_name="lq", replicas=2,
+                             size=4, leader_requests={"cpu": 1000},
+                             worker_requests={"cpu": 1000})
+    groups = lws_group_jobs(lws)
+    assert [g.name for g in groups] == ["serve-0", "serve-1"]
+    for g in groups:
+        rec.create_job(g)
+    for _ in range(3):
+        eng.schedule_once()
+        for g in groups:
+            rec.reconcile(g)
+
+    wl0 = eng.workloads[rec.job_to_workload[groups[0].key]]
+    wl1 = eng.workloads[rec.job_to_workload[groups[1].key]]
+    # One group admits, the other pends — independent lifecycles.
+    assert wl0.is_admitted and not wl1.is_admitted
+    assert groups[0].is_active() and not groups[1].is_active()
+    # Leader and workers of the admitted group share one flavor.
+    flavors = {psa.flavors["cpu"]
+               for psa in wl0.status.admission.pod_set_assignments}
+    assert flavors == {"default"}
+
+    # The admitted group finishing frees the second group to admit.
+    eng.finish(wl0.key)
+    eng.schedule_once()
+    rec.reconcile(groups[1])
+    assert eng.workloads[rec.job_to_workload[groups[1].key]].is_admitted

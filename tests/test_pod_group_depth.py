@@ -1,0 +1,198 @@
+"""Pod-group edge semantics (pkg/controller/jobs/pod/pod_controller.go):
+gate-based assembly, fast admission, replacement pods +
+WaitingForReplacementPods, unretriable groups, excess-pod trimming, and
+per-pod finalizers — through the jobframework reconciler and the real
+engine."""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.api.types import (  # noqa: E402
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+)
+from kueue_tpu.controllers.engine import Engine  # noqa: E402
+from kueue_tpu.controllers.integrations import (  # noqa: E402
+    POD_FINALIZER,
+    PodGroup,
+    PodJob,
+)
+from kueue_tpu.controllers.jobframework import JobReconciler  # noqa: E402
+
+
+def setup():
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            ("cpu",), (FlavorQuotas("default",
+                                    {"cpu": ResourceQuota(10000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    rec = JobReconciler(eng)
+    return eng, rec
+
+
+def pod(name, cpu=1000, **kw):
+    return PodJob(name=name, requests={"cpu": cpu}, **kw)
+
+
+def drive(eng, rec, group, cycles=3):
+    for _ in range(cycles):
+        eng.schedule_once()
+        rec.reconcile(group)
+
+
+def test_group_incomplete_waits_for_assembly():
+    eng, rec = setup()
+    group = PodGroup("g", queue_name="lq", total_count=3)
+    group.add_pod(pod("p0"))
+    rec.create_job(group)
+    drive(eng, rec, group)
+    assert rec.job_to_workload.get(group.key) is None  # not assembled
+
+    group.add_pod(pod("p1"))
+    group.add_pod(pod("p2"))
+    rec.reconcile(group)
+    drive(eng, rec, group)
+    wl = eng.workloads[rec.job_to_workload[group.key]]
+    assert wl.is_admitted
+    assert all(not p.gated for p in group.pods)  # gang ungated together
+    assert wl.status.admission.pod_set_assignments[0].count == 3
+
+
+def test_fast_admission_builds_from_first_pod():
+    eng, rec = setup()
+    group = PodGroup("g", queue_name="lq", total_count=4,
+                     fast_admission=True)
+    group.add_pod(pod("p0"))
+    rec.create_job(group)
+    drive(eng, rec, group)
+    wl = eng.workloads[rec.job_to_workload[group.key]]
+    assert wl.is_admitted
+    # Full gang quota reserved from the first pod's shape.
+    assert wl.status.admission.pod_set_assignments[0].count == 4
+
+
+def test_replacement_pod_flow():
+    eng, rec = setup()
+    group = PodGroup("g", queue_name="lq", total_count=2)
+    group.add_pod(pod("p0"))
+    group.add_pod(pod("p1"))
+    rec.create_job(group)
+    drive(eng, rec, group)
+    wl = eng.workloads[rec.job_to_workload[group.key]]
+    assert wl.is_admitted
+
+    # One pod fails: the workload stays admitted but reports
+    # WaitingForReplacementPods (pod_controller.go:1394).
+    group.pods[1].failed = True
+    rec.reconcile(group)
+    assert wl.is_admitted
+    assert wl.has_condition("WaitingForReplacementPods")
+
+    # The replacement arrives: ungated immediately, condition clears.
+    repl = pod("p1-replacement")
+    group.add_pod(repl)
+    assert not repl.gated
+    rec.reconcile(group)
+    assert not wl.condition("WaitingForReplacementPods").status
+
+
+def test_unretriable_group_fails_whole_workload():
+    eng, rec = setup()
+    group = PodGroup("g", queue_name="lq", total_count=2)
+    group.add_pod(pod("p0", retriable=False))
+    group.add_pod(pod("p1"))
+    rec.create_job(group)
+    drive(eng, rec, group)
+    wl = eng.workloads[rec.job_to_workload[group.key]]
+    assert wl.is_admitted
+
+    group.pods[0].failed = True
+    rec.reconcile(group)
+    assert wl.is_finished
+    assert wl.condition("Finished").reason == "Failed"
+    # Finalizers stripped on finish (Finalize :577).
+    assert all(POD_FINALIZER not in p.finalizers for p in group.pods)
+
+
+def test_excess_pods_trimmed_and_definalized():
+    eng, rec = setup()
+    group = PodGroup("g", queue_name="lq", total_count=2)
+    for i in range(2):
+        group.add_pod(pod(f"p{i}"))
+    rec.create_job(group)
+    drive(eng, rec, group)
+
+    extra = pod("p-extra")
+    group.add_pod(extra)
+    rec.reconcile(group)
+    assert extra in group.removed_excess
+    assert extra not in group.pods
+    assert POD_FINALIZER not in extra.finalizers
+    assert len(group.pods) == 2
+    assert any(e.kind == "ExcessPodRemoved" for e in eng.events)
+
+
+def test_finalizers_lifecycle_on_delete():
+    eng, rec = setup()
+    group = PodGroup("g", queue_name="lq", total_count=2)
+    for i in range(2):
+        group.add_pod(pod(f"p{i}"))
+    rec.create_job(group)
+    assert all(POD_FINALIZER in p.finalizers for p in group.pods)
+    rec.delete_job(group.key)
+    assert all(POD_FINALIZER not in p.finalizers for p in group.pods)
+
+
+def test_mixed_shape_failure_keeps_gang_admitted():
+    """A failed pod of shape B must NOT reshape the frozen gang (the
+    backfill would otherwise shift counts to shape A and the reconciler
+    would restart the whole workload)."""
+    eng, rec = setup()
+    group = PodGroup("g", queue_name="lq", total_count=4)
+    for i in range(2):
+        group.add_pod(pod(f"a{i}", cpu=1000))
+    for i in range(2):
+        group.add_pod(pod(f"b{i}", cpu=2000))
+    rec.create_job(group)
+    drive(eng, rec, group)
+    wl_key = rec.job_to_workload[group.key]
+    wl = eng.workloads[wl_key]
+    assert wl.is_admitted
+    frozen = [(ps.name, ps.count, dict(ps.requests))
+              for ps in group.pod_sets()]
+
+    group.pods[3].failed = True  # a shape-B member fails
+    rec.reconcile(group)
+    # Same workload, still admitted, same declared pod sets; only the
+    # replacement signal changes.
+    assert rec.job_to_workload[group.key] == wl_key
+    assert wl.is_admitted
+    assert [(ps.name, ps.count, dict(ps.requests))
+            for ps in group.pod_sets()] == frozen
+    assert wl.has_condition("WaitingForReplacementPods")
+
+
+def test_reclaimable_pods_release_quota():
+    eng, rec = setup()
+    group = PodGroup("g", queue_name="lq", total_count=2)
+    for i in range(2):
+        group.add_pod(pod(f"p{i}", cpu=4000))
+    rec.create_job(group)
+    drive(eng, rec, group)
+    wl = eng.workloads[rec.job_to_workload[group.key]]
+    assert wl.is_admitted
+
+    group.pods[0].done = True
+    group.pods[0].success = True
+    rec.reconcile(group)
+    assert wl.status.reclaimable_pods.get("shape-0") == 1
+    # Serving groups never reclaim (pod_controller.go:1342).
+    group.serving = True
+    assert group.reclaimable_pods() == {}
