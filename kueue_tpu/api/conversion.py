@@ -19,14 +19,31 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 # type name -> {old field name: new field name}; retired fields map to
-# None (dropped on read).
+# None (dropped on read). The v3 entries mirror the reference's actual
+# v1beta1 -> v1beta2 renames (zz_generated.conversion.go): cohort ->
+# cohortName, parent -> parentName, priorityClass -> priorityClassRef —
+# records written with either spelling read back into the same objects.
 FIELD_RENAMES: dict[str, dict[str, str | None]] = {
-    # v1 (round 1) -> v2 examples: none renamed yet; the table is the
-    # extension point the reference's conversion functions fill.
+    "ClusterQueue": {"cohort_name": "cohort"},
+    "Cohort": {"parent_name": "parent"},
+    "Workload": {"priority_class_ref": "priority_class_name"},
 }
+
+# enum type -> {alias value: canonical value}. The reference's v1beta2
+# renamed FlavorFungibility's stop values (Borrow / Preempt) to
+# MayStopSearch; every decision site only distinguishes TryNextFlavor
+# from "stop", so one canonical stop value is lossless.
+ENUM_VALUE_ALIASES: dict[str, dict[str, str]] = {
+    "FungibilityPolicy": {"MayStopSearch": "Borrow"},
+}
+
+
+def convert_enum_value(enum_name: str, value):
+    """Map a versioned enum spelling to its canonical value."""
+    return ENUM_VALUE_ALIASES.get(enum_name, {}).get(value, value)
 
 
 def register_rename(type_name: str, old: str, new: str | None) -> None:
@@ -82,3 +99,17 @@ def _upgrade_v1(record: dict) -> dict:
 
 
 register_upgrader(1, _upgrade_v1)
+
+
+def _upgrade_v2(record: dict) -> dict:
+    """v2 (round 2) -> v3: the reference's v1beta1 -> v1beta2 rename
+    wave. Structurally a no-op on write-side records — the renamed
+    fields and enum spellings are handled on READ by FIELD_RENAMES and
+    ENUM_VALUE_ALIASES, matching the reference's conversion-webhook
+    model where old objects convert as they are served."""
+    record = dict(record)
+    record["v"] = 3
+    return record
+
+
+register_upgrader(2, _upgrade_v2)

@@ -84,7 +84,10 @@ def from_jsonable(data: Any) -> Any:
             # missing fields default (api/conversion.py).
             return cls(**convert_fields(cls, kwargs))
         if "__e__" in data:
-            return _REGISTRY[data["__e__"]](data["v"])
+            from kueue_tpu.api.conversion import convert_enum_value
+
+            name = data["__e__"]
+            return _REGISTRY[name](convert_enum_value(name, data["v"]))
         return {k: from_jsonable(v) for k, v in data.items()}
     if isinstance(data, list):
         return tuple(from_jsonable(v) for v in data)

@@ -369,11 +369,14 @@ class PodGroup:
     def add_pod(self, pod: PodJob) -> None:
         if POD_FINALIZER not in pod.finalizers:
             pod.finalizers.append(POD_FINALIZER)
+        needed = self.active and self.absent_count() > 0
         self.pods.append(pod)
-        if self.active:
+        if needed:
             # Replacement for a failed member of a running group: ungate
-            # immediately (the group's admission already covers it;
-            # excess beyond total_count is trimmed by sync_excess).
+            # immediately (the group's admission already covers it). A
+            # pod added to a FULL group stays gated so it never runs
+            # outside the admitted quota and sync_excess trims it as a
+            # never-started pod (pod_controller.go removeExcessPods).
             pod.gated = False
 
     def live_pods(self) -> list[PodJob]:
@@ -437,12 +440,14 @@ class PodGroup:
             shape = tuple(sorted(pod.requests.items()))
             shapes[shape] = shapes.get(shape, 0) + 1
         missing = self.total_count - sum(shapes.values())
-        if missing > 0 and shapes:
+        if missing > 0 and self.pods:
+            # ANY member's shape anchors the backfill (the sole
+            # fast-admission pod may itself be Failed).
             first = tuple(sorted(self.pods[0].requests.items()))
             shapes[first] = shapes.get(first, 0) + missing
         out = [PodSet(name=f"shape-{i}", count=n, requests=dict(shape))
                for i, (shape, n) in enumerate(sorted(shapes.items()))]
-        if self.complete():
+        if self.complete() and out:
             self._frozen_pod_sets = out
             self._shape_names = {shape: f"shape-{i}" for i, (shape, _n)
                                  in enumerate(sorted(shapes.items()))}

@@ -64,8 +64,8 @@ class Journal:
     def __init__(self, path: str, fsync: bool = False):
         self.path = path
         self.fsync = fsync
-        self._repair_torn_tail()
         self._fh = open(path, "a", encoding="utf-8")
+        self._locked_repair()
         # Per-(kind, key) generation table + how far we've read the file.
         self._generations: dict[tuple, int] = {}
         self._read_offset = 0
@@ -78,6 +78,13 @@ class Journal:
         n = 0
         try:
             with open(self.path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                size = fh.tell()
+                if size < self._read_offset:
+                    # The file shrank under us (compaction by another
+                    # handle, or torn-tail repair): rescan from scratch.
+                    self._read_offset = 0
+                    self._generations.clear()
                 fh.seek(self._read_offset)
                 data = fh.read()
         except FileNotFoundError:
@@ -161,6 +168,31 @@ class Journal:
             {"op": "delete", "kind": kind, "key": key, "ts": ts,
              "v": SCHEMA_VERSION}, kind, key, expected_generation)
 
+    def _locked_repair(self) -> None:
+        """Torn-tail repair under the shared flock: a reader must not
+        truncate bytes a live writer just committed, and the repair must
+        re-stat the size INSIDE the critical section."""
+        import fcntl
+
+        fcntl.flock(self._fh.fileno(), fcntl.LOCK_EX)
+        try:
+            self._repair_torn_tail()
+        finally:
+            fcntl.flock(self._fh.fileno(), fcntl.LOCK_UN)
+
+    def _tail_is_clean(self) -> bool:
+        """True when the file is empty or ends with a newline."""
+        try:
+            with open(self.path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                size = fh.tell()
+                if size == 0:
+                    return True
+                fh.seek(size - 1)
+                return fh.read(1) == b"\n"
+        except FileNotFoundError:
+            return True
+
     def _stamp_and_write(self, rec: dict, kind: str, key: str,
                          expected_generation: Optional[int]) -> int:
         import fcntl
@@ -171,6 +203,11 @@ class Journal:
         # the whole read-modify-append a critical section.
         fcntl.flock(self._fh.fileno(), fcntl.LOCK_EX)
         try:
+            if not self._tail_is_clean():
+                # Another writer crashed mid-append: truncate its torn
+                # fragment (under the lock) or our record would
+                # concatenate onto it and poison every later replay.
+                self._repair_torn_tail()
             self.refresh()
             k = (kind, key)
             current = self._generations.get(k, 0)

@@ -22,12 +22,24 @@ from kueue_tpu.visibility.server import (
 )
 
 
-def make_handler(engine):
+def make_handler(engine, auth_token=None):
     vis = VisibilityServer(engine)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):  # quiet
             pass
+
+        def _authorized(self) -> bool:
+            """Bearer-token auth (the APF/RBAC stand-in for the
+            visibility server; /healthz stays open for probes)."""
+            if auth_token is None:
+                return True
+            if urlparse(self.path).path.rstrip("/") == "/healthz":
+                return True
+            import hmac
+
+            got = self.headers.get("Authorization", "")
+            return hmac.compare_digest(got, f"Bearer {auth_token}")
 
         _view_cache: dict = {}
 
@@ -54,6 +66,9 @@ def make_handler(engine):
             self.wfile.write(data)
 
         def do_GET(self):  # noqa: N802
+            if not self._authorized():
+                self._send('{"error":"unauthorized"}', code=401)
+                return
             path = urlparse(self.path).path.rstrip("/")
             parts = [p for p in path.split("/") if p]
             if path in ("", "/dashboard"):
@@ -127,9 +142,29 @@ def make_handler(engine):
 
 
 class ServingEndpoint:
-    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
-        self.httpd = ThreadingHTTPServer((host, port),
-                                         make_handler(engine))
+    """The debug/visibility HTTP endpoint. Hardening knobs (the
+    reference's pkg/util/cert + visibility APF analog):
+
+      * ``cert_dir`` — serve HTTPS with tls.crt/tls.key from the dir
+        (auto-generated self-signed via utils.cert when absent);
+      * ``auth_token`` — require ``Authorization: Bearer <token>`` on
+        every route except /healthz.
+    """
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
+                 cert_dir: str = None, auth_token: str = None):
+        self.httpd = ThreadingHTTPServer(
+            (host, port), make_handler(engine, auth_token=auth_token))
+        self.tls = cert_dir is not None
+        if cert_dir is not None:
+            import ssl
+
+            from kueue_tpu.utils.cert import ensure_cert_dir
+            crt, key = ensure_cert_dir(cert_dir, host)
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(crt, key)
+            self.httpd.socket = ctx.wrap_socket(self.httpd.socket,
+                                                server_side=True)
         self.thread = threading.Thread(target=self.httpd.serve_forever,
                                        daemon=True)
 
