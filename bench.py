@@ -98,13 +98,10 @@ def _device_share(eng) -> dict:
     }
 
 
-def bench_cycle_latency(scen, n_cycles=6, fair=False):
-    """The serving-path cycle at north-star scale, through the ENGINE:
-    snapshot + incremental tensor encode + device solve + verdict
-    apply, per schedule_once() call (the <500 ms target covers the
-    whole cycle). The queue manager's row cache makes encode
-    O(changes); the first cycle pays compilation and the initial
-    full-row encode and is untimed."""
+def build_cycle_engine(scen, fair=False):
+    """One serving engine over a scenario world, oracle attached —
+    shared by bench_cycle_latency and profile_apply.py so the profiler
+    always profiles exactly the benchmarked world."""
     from kueue_tpu.controllers.engine import Engine
 
     eng = Engine(enable_fair_sharing=fair)
@@ -120,30 +117,44 @@ def bench_cycle_latency(scen, n_cycles=6, fair=False):
         eng.clock += 0.0001
         eng.submit(wl)
     eng.attach_oracle()
+    return eng
+
+
+def bench_cycle_latency(scen, n_cycles=6, fair=False):
+    """The serving-path cycle at north-star scale, through the ENGINE:
+    snapshot + incremental tensor encode + device solve + verdict
+    apply, per schedule_once() call (the <500 ms target covers the
+    whole cycle). The queue manager's row cache makes encode
+    O(changes); the first cycle pays compilation and the initial
+    full-row encode and is untimed."""
+    eng = build_cycle_engine(scen, fair=fair)
 
     # The engine's own serving-daemon GC posture (part of the system
-    # under test). Unfrozen again after the timed loop: this process
-    # builds several scenario worlds, and a frozen discarded world is
-    # unreclaimable cyclic garbage.
+    # under test). Re-enabled/unfrozen after the timed loop even on
+    # error: this process builds several scenario worlds, and a frozen
+    # discarded world under disabled GC is unreclaimable garbage.
     import gc
     eng.apply_serving_gc_posture()
 
     times = []
     phases = []
     admitted_total = 0
-    for k in range(n_cycles + 1):
-        t0 = time.perf_counter()
-        r = eng.schedule_once()
-        elapsed = time.perf_counter() - t0
-        if r is None:
-            break
-        if k > 0:  # first cycle pays compilation + initial encode
-            times.append(elapsed)
-            phases.append(dict(getattr(eng, "last_cycle_phases", {})))
-        admitted_total += r.stats.admitted
-        if not r.stats.admitted:
-            break
-    gc.unfreeze()
+    try:
+        for k in range(n_cycles + 1):
+            t0 = time.perf_counter()
+            r = eng.schedule_once()
+            elapsed = time.perf_counter() - t0
+            if r is None:
+                break
+            if k > 0:  # first cycle pays compilation + initial encode
+                times.append(elapsed)
+                phases.append(dict(getattr(eng, "last_cycle_phases", {})))
+            admitted_total += r.stats.admitted
+            if not r.stats.admitted:
+                break
+    finally:
+        gc.enable()
+        gc.unfreeze()
     if not times:
         return {"value": 0.0, "unit": "s/cycle (p95)", "vs_baseline": 0.0,
                 "detail": {"error": "no timed cycle admitted anything"}}
@@ -152,7 +163,7 @@ def bench_cycle_latency(scen, n_cycles=6, fair=False):
     p95 = times[min(len(times) - 1, int(len(times) * 0.95))]
     mean_phase = {
         ph: round(sum(p.get(ph, 0.0) for p in phases) / len(phases), 4)
-        for ph in ("encode", "device", "apply")}
+        for ph in ("encode", "device", "apply", "finalize")}
     return {
         "value": round(p95, 4), "unit": "s/cycle (p95)",
         "vs_baseline": round(CYCLE_TARGET_S / p95, 2),

@@ -149,6 +149,19 @@ class PendingClusterQueue:
         if self.manager is not None:
             self.manager.rows.on_remove(key)
 
+    def delete_lazy(self, key: str) -> None:
+        """delete() for the bulk-assume path (admitted verdicts): the
+        heap entry is left to pop()'s lazy discard — the same strategy
+        park() documents — and a later re-push of the same key reuses
+        the live id via the native heap's push-or-update, so the heap
+        never diverges. Skips one native remove per admission."""
+        self.items.pop(key, None)
+        self.inadmissible.pop(key, None)
+        if self.in_flight == key:
+            self.in_flight = None
+        if self.manager is not None:
+            self.manager.rows.on_remove(key)
+
     def park(self, key: str) -> None:
         """Move an active pending workload to the inadmissible side map
         (the oracle bridge's NoFit verdict application). The heap entry

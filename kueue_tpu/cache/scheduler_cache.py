@@ -182,6 +182,11 @@ class Cache:
             cq_usage[fr] = cq_usage.get(fr, 0) + v
         self.cq_workloads.setdefault(info.cluster_queue, {})[key] = info
         tas = info.tas_domains(self._tas_flavor_names())
+        self._account_tas(tas)
+        self._wl_usage[key] = (info.cluster_queue, usage)
+        self._wl_tas[key] = tas
+
+    def _account_tas(self, tas) -> None:
         for flavor, values, single, count in tas:
             by_values = self.tas_usage_agg.setdefault(flavor, {})
             totals = by_values.setdefault(values, {})
@@ -189,8 +194,6 @@ class Cache:
                 totals[res] = totals.get(res, 0) + per_pod * count
             # Pod slots (tas_flavor_snapshot.go:321).
             totals["pods"] = totals.get("pods", 0) + count
-        self._wl_usage[key] = (info.cluster_queue, usage)
-        self._wl_tas[key] = tas
 
     def _unaccount(self, key: str) -> None:
         entry = self._wl_usage.pop(key, None)

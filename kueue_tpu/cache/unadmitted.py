@@ -67,9 +67,12 @@ class UnadmittedWorkloads:
             ck, lk = prev.cq_key(), prev.lq_key()
             cq_delta[ck] = cq_delta.get(ck, 0) - 1
             lq_delta[lk] = lq_delta.get(lk, 0) - 1
+        gauges_on = self._gauges_on()
         for table, deltas, gauge in (
                 (self.per_cq, cq_delta, "unadmitted_workloads"),
                 (self.per_lq, lq_delta, "local_queue_unadmitted_workloads")):
+            gauge_values = (self.registry.gauge(gauge).values
+                            if gauges_on else None)
             for key, delta in deltas.items():
                 value = table.get(key, 0) + delta
                 if value <= 0:
@@ -77,8 +80,8 @@ class UnadmittedWorkloads:
                     value = 0
                 else:
                     table[key] = value
-                if self._gauges_on():
-                    self.registry.gauge(gauge).set(key, value)
+                if gauge_values is not None:
+                    gauge_values[key] = value
 
     def _gauges_on(self) -> bool:
         """kube_features.go UnadmittedWorkloadsObservability: the

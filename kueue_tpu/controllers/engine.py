@@ -87,6 +87,10 @@ class _BulkAdmitCtx:
             for ct in (WorkloadConditionType.EVICTED,
                        WorkloadConditionType.PREEMPTED,
                        WorkloadConditionType.BLOCKED_ON_PREEMPTION_GATES))
+        # Per-family aggregation: {name: {labels: n}} / {name: {labels:
+        # [values]}} so the flush fetches each registry series ONCE and
+        # walks its label map directly (the (name, labels)-tupled layout
+        # paid a tuple construction + registry lookup per write).
         self.counts: dict = {}
         self.waits: dict = {}
         self.removed_unadmitted: list = []
@@ -94,11 +98,20 @@ class _BulkAdmitCtx:
         self.admissions: dict = {}  # (cq, assignment-id) -> Admission
 
     def count(self, name: str, labels: tuple, n: int = 1) -> None:
-        key = (name, labels)
-        self.counts[key] = self.counts.get(key, 0) + n
+        fam = self.counts.get(name)
+        if fam is None:
+            fam = self.counts[name] = {}
+        fam[labels] = fam.get(labels, 0) + n
 
     def wait(self, name: str, labels: tuple, value: float) -> None:
-        self.waits.setdefault((name, labels), []).append(value)
+        fam = self.waits.get(name)
+        if fam is None:
+            fam = self.waits[name] = {}
+        lst = fam.get(labels)
+        if lst is None:
+            fam[labels] = [value]
+        else:
+            lst.append(value)
 
 
 class Engine:
@@ -151,6 +164,8 @@ class Engine:
         self.custom_labels = CustomMetricLabels(
             config.metrics_custom_labels
             if config is not None else [])
+        self._cq_labels_cache = None  # (spec_version, {cq: labels})
+        self._serving_gc = False  # apply_serving_gc_posture() active
         # First-eviction-per-workload tracking
         # (evicted_workloads_once_total, metrics.go:666).
         self._evicted_once: set[str] = set()
@@ -522,12 +537,25 @@ class Engine:
         return features.enabled("LocalQueueMetrics")
 
     def _custom_cq_labels(self, cq_name: str) -> tuple:
-        # kube_features.go CustomMetricLabels.
+        # kube_features.go CustomMetricLabels. Memoized by (spec
+        # version, gate state) — label values derive from CQ object
+        # metadata, and a gate flip must invalidate.
         from kueue_tpu.config import features
-        if not features.enabled("CustomMetricLabels"):
-            return ()
-        return self.custom_labels.for_object(
-            self.cache.cluster_queues.get(cq_name))
+        on = features.enabled("CustomMetricLabels")
+        ver = (self.cache.spec_version, on)
+        cached = self._cq_labels_cache
+        if cached is None or cached[0] != ver:
+            cached = (ver, {})
+            self._cq_labels_cache = cached
+        labels = cached[1].get(cq_name)
+        if labels is None:
+            if not on:
+                labels = ()
+            else:
+                labels = self.custom_labels.for_object(
+                    self.cache.cluster_queues.get(cq_name))
+            cached[1][cq_name] = labels
+        return labels
 
     def finish(self, key: str) -> None:
         wl = self.workloads.get(key)
@@ -621,6 +649,20 @@ class Engine:
 
     def schedule_once(self) -> Optional[CycleResult]:
         """One schedule() cycle (scheduler.go:286)."""
+        if not self._serving_gc:
+            return self._schedule_once_impl()
+        try:
+            return self._schedule_once_impl()
+        finally:
+            # Serving GC posture: automatic collection is off; sweep the
+            # young generation and re-freeze survivors after EVERY cycle
+            # — device, hybrid, and sequential-fallback alike (see
+            # apply_serving_gc_posture).
+            import gc
+            gc.collect(0)
+            gc.freeze()
+
+    def _schedule_once_impl(self) -> Optional[CycleResult]:
         import time as _time
 
         self._process_second_pass()
@@ -898,11 +940,24 @@ class Engine:
         scanning millions of stable objects mid-cycle (the dominant
         cycle-latency p95 outlier source). Call once after the initial
         world is loaded; the bench harness applies it as part of the
-        system under test."""
+        system under test.
+
+        Automatic collection is then DISABLED and replaced by a small
+        young-generation sweep + re-freeze after every serving cycle
+        (schedule_once): each cycle's survivors (admitted infos,
+        conditions, events) are long-lived by construction, so they move
+        straight to the permanent generation and no full mark ever walks
+        the multi-million-object world mid-cycle. Dead non-cyclic
+        objects — the overwhelming majority here (dataclass trees with
+        no back-references) — are reclaimed by refcounting as usual.
+        This is the r03 p95 story: one gen-2 pause per ~7 cycles landed
+        inside the apply span and set the p95 (162 ms vs a 66 ms p50)."""
         import gc
 
         gc.collect()
         gc.freeze()
+        gc.disable()
+        self._serving_gc = True
 
     def begin_bulk_admit(self) -> "_BulkAdmitCtx":
         """Open a bulk-admission context for one serving cycle: metric,
@@ -912,10 +967,14 @@ class Engine:
         return _BulkAdmitCtx(self.clock)
 
     def flush_bulk_admit(self, ctx: "_BulkAdmitCtx") -> None:
-        for (name, labels), n in ctx.counts.items():
-            self.registry.counter(name).inc(labels, n)
-        for (name, labels), values in ctx.waits.items():
-            self.registry.histogram(name).observe_many(values, labels)
+        for name, fam in ctx.counts.items():
+            values = self.registry.counter(name).values
+            for labels, n in fam.items():
+                values[labels] += n
+        for name, fam in ctx.waits.items():
+            hist = self.registry.histogram(name)
+            for labels, values in fam.items():
+                hist.observe_many(values, labels)
         if ctx.removed_unadmitted:
             self.unadmitted.remove_many(ctx.removed_unadmitted)
         if self.journal is not None:
@@ -923,6 +982,303 @@ class Engine:
                 wl = self.workloads.get(key)
                 if wl is not None:
                     self.journal.apply("workload", wl, ts=self.clock)
+
+    def bulk_assume_batch(self, entries, bulk: "_BulkAdmitCtx") -> list:
+        """In-cycle half of a device cycle's admitted batch: remove the
+        workloads from the pending world and assume them in the cache —
+        the part the reference's cycle blocks on (scheduler.go:920
+        assumeWorkload). Status/metric/event finalization is the
+        reference's ASYNC status PATCH (scheduler.go:870
+        admissionRoutineWrapper.Run in a goroutine); its analog here is
+        bulk_finalize_batch, timed as its own phase.
+
+        Returns the (entry, admission) pairs for finalization. Entries
+        with reclaimable pods, preemption targets (slice replacement),
+        or configured admission checks take the exact per-entry _admit
+        path — only the hot plain-admission shape is flattened.
+        """
+        if not entries:
+            return []
+        cache = self.cache
+        queues = self.queues
+        second_pass = queues.second_pass
+        checks = self.admission_checks
+        expectations = self.preemption_expectations
+        tas_names = cache._tas_flavor_names()
+        workloads_reg = cache.workloads
+        wl_usage = cache._wl_usage
+        wl_tas = cache._wl_tas
+        live_cqs = cache.cluster_queues
+        # Persistent Admission flyweights: the stored assignment ref
+        # keeps its id() from being recycled, so identity keys are safe.
+        ver = cache.spec_version
+        fly = getattr(self, "_admission_fly", None)
+        if fly is None or fly[0] != ver:
+            fly = (ver, {})
+            self._admission_fly = fly
+        fly = fly[1]
+        if len(fly) > 65536:
+            # Non-flyweighted assignments (equivalence hashing off) would
+            # otherwise grow this without bound — cap and rebuild.
+            fly.clear()
+        pairs: list = []
+        slow: list = []
+        for entry in entries:
+            info = entry.info
+            wl = info.obj
+            if (wl.status.reclaimable_pods or entry.preemption_targets
+                    or checks is not None):
+                slow.append(entry)
+                continue
+            key = wl.key
+            cq_name = info.cluster_queue
+            assignment = entry.assignment
+            akey = (cq_name, id(assignment))
+            ent = fly.get(akey)
+            if ent is None or ent[0] is not assignment:
+                admission = admission_from_assignment(
+                    cq_name, assignment.pod_sets)
+                fly[akey] = (assignment, admission)
+            else:
+                admission = ent[1]
+            # status.admission is part of the ASSUME state (the
+            # reference sets quota reservation before assuming,
+            # scheduler.go:856-920): cache accounting below reads it
+            # (tas_domains), and a stale prior admission must never be
+            # accounted.
+            wl.status.admission = admission
+            # apply_admission, inlined for the fast shape (device
+            # verdicts never reduce pod counts).
+            trs = info.total_requests
+            psas = admission.pod_set_assignments
+            if len(trs) == len(psas):
+                for psr, psa in zip(trs, psas):
+                    psr.flavors = dict(psa.flavors)
+            else:
+                info.apply_admission(admission)
+            # Pending world exit (delete_workload, inlined: the
+            # bridge resolved the CQ already).
+            pcq = queues.cluster_queues.get(cq_name)
+            if pcq is not None and (
+                    key in pcq.items or key in pcq.inadmissible
+                    or pcq.in_flight == key):
+                pcq.delete_lazy(key)  # releases the tensor row too
+            else:
+                queues.delete_workload(wl)
+            second_pass.delete(key)
+            # Cache assume (add_or_update_workload inlined; usage
+            # dict is the assignment flyweight's — shared and never
+            # mutated by accounting).
+            if cq_name in live_cqs:
+                if key in wl_usage:
+                    cache._unaccount(key)
+                workloads_reg[key] = info
+                usage = assignment.usage
+                cqu = cache.cq_usage.get(cq_name)
+                if cqu is None:
+                    cqu = cache.cq_usage[cq_name] = {}
+                for fr, v in usage.items():
+                    cqu[fr] = cqu.get(fr, 0) + v
+                cqw = cache.cq_workloads.get(cq_name)
+                if cqw is None:
+                    cqw = cache.cq_workloads[cq_name] = {}
+                cqw[key] = info
+                wl_usage[key] = (cq_name, usage)
+                if tas_names:
+                    tas = info.tas_domains(tas_names)
+                    if tas:
+                        wl_tas[key] = tas
+                        cache._account_tas(tas)
+            expectations.observed_uid(key, wl.uid)
+            pairs.append((entry, admission))
+        if pairs:
+            cache.admitted_version += 1
+        # Rare shapes: the exact per-entry path (assume + finalize).
+        for entry in slow:
+            self.queues.delete_workload(entry.info.obj)
+            self._admit(entry, bulk=bulk)
+        return pairs
+
+    def bulk_finalize_batch(self, pairs, bulk: "_BulkAdmitCtx") -> None:
+        """Async-PATCH analog for a device cycle's admitted batch
+        (scheduler.go:870): status conditions, Admission on status,
+        events, metrics, unadmitted gauges, journal records. Runs
+        synchronously at cycle end (the engine is single-threaded by
+        design) but outside the apply span, exactly as the reference's
+        cycle does not block on its status PATCHes. The routine wrapper
+        brackets the batch once, not per entry."""
+        if not pairs:
+            return
+        now = self.clock
+        qr_cond = bulk.qr_cond
+        adm_cond = bulk.adm_cond
+        reset_conds = bulk.reset_conds
+        lq_on = self._lq_metrics_on()
+        events = self.events
+        listeners = self.event_listeners
+        on_admit = self.on_admit
+        journal_on = self.journal is not None
+        QR = WorkloadConditionType.QUOTA_RESERVED
+        ADM = WorkloadConditionType.ADMITTED
+        # (cq, lq) -> [count, [wait values], [nonzero checks waits]]
+        agg: dict[tuple, list] = {}
+        removed_unadmitted = bulk.removed_unadmitted
+        journal_keys = bulk.journal_keys
+
+        def _batch() -> None:
+            n_admitted = 0
+            for entry, admission in pairs:
+                info = entry.info
+                wl = info.obj
+                key = wl.key
+                cq_name = info.cluster_queue
+                conds = wl.status.conditions
+                prev = conds.get(QR)
+                if prev is None or not prev.status:
+                    conds[QR] = qr_cond
+                    checks_wait = 0.0
+                else:
+                    # A live reservation (second pass) keeps its
+                    # transition time; the admission-checks wait spans
+                    # from it (set_condition semantics).
+                    checks_wait = now - prev.last_transition_time
+                    if checks_wait < 0.0:
+                        checks_wait = 0.0
+                for ctype, cond in reset_conds:
+                    # Reset only currently-True conditions (_admit uses
+                    # has_condition): an already-False Evicted/Preempted
+                    # keeps its original transition time.
+                    pc = conds.get(ctype)
+                    if pc is not None and pc.status:
+                        conds[ctype] = cond
+                ev_qr = EngineEvent(now, "QuotaReserved", key, cq_name)
+                events.append(ev_qr)
+                if journal_on:
+                    journal_keys.append(key)
+                adm_cond_prev = conds.get(ADM)
+                if adm_cond_prev is not None and adm_cond_prev.status:
+                    # Already admitted (_sync_admitted's early return):
+                    # QuotaReserved bookkeeping only.
+                    bulk.count("quota_reserved_workloads_total",
+                               (cq_name,))
+                    bulk.wait("quota_reserved_wait_time_seconds",
+                              (cq_name,),
+                              max(0.0, now - wl.creation_time))
+                    if lq_on:
+                        lq_l = (f"{wl.namespace}/{wl.queue_name}",)
+                        bulk.count(
+                            "local_queue_quota_reserved_workloads_total",
+                            lq_l)
+                        bulk.wait(
+                            "local_queue_quota_reserved_wait_time_seconds",
+                            lq_l, max(0.0, now - wl.creation_time))
+                    if listeners:
+                        for fn in listeners:
+                            try:
+                                fn(ev_qr)
+                            except Exception as e:  # noqa: BLE001
+                                import warnings
+                                warnings.warn(
+                                    f"event listener {fn!r} raised: {e!r}")
+                    continue
+                conds[ADM] = adm_cond
+                n_admitted += 1
+                wait = now - wl.creation_time
+                if wait < 0.0:
+                    wait = 0.0
+                lq = f"{wl.namespace}/{wl.queue_name}"
+                a = agg.get((cq_name, lq))
+                if a is None:
+                    a = agg[(cq_name, lq)] = [1, [wait], []]
+                else:
+                    a[0] += 1
+                    a[1].append(wait)
+                if checks_wait > 0.0:
+                    a[2].append(checks_wait)
+                removed_unadmitted.append(key)
+                ev_adm = EngineEvent(now, "Admitted", key, cq_name)
+                events.append(ev_adm)
+                if listeners:
+                    for ev in (ev_qr, ev_adm):
+                        for fn in listeners:
+                            try:
+                                fn(ev)
+                            except Exception as e:  # noqa: BLE001
+                                import warnings
+                                warnings.warn(
+                                    f"event listener {fn!r} raised: {e!r}")
+                if on_admit is not None:
+                    on_admit(wl, admission)
+            self.metrics.admissions_total += n_admitted
+            self._flush_admission_metrics(agg, lq_on)
+
+        self.admission_routine.run(_batch)
+
+    def _flush_admission_metrics(self, agg: dict, lq_on: bool) -> None:
+        """Direct registry writes for a batch's admission metric series:
+        the families are fetched once and their label maps updated in
+        place (one layer, no per-write tuple/registry churn)."""
+        import bisect as _bisect
+
+        reg = self.registry
+        qr_total = reg.counter("quota_reserved_workloads_total").values
+        adm_total = reg.counter("admitted_workloads_total").values
+        hists = [
+            reg.histogram("quota_reserved_wait_time_seconds"),
+            reg.histogram("admission_wait_time_seconds"),
+        ]
+        checks_h = reg.histogram("admission_checks_wait_time_seconds")
+        if lq_on:
+            lq_qr_total = reg.counter(
+                "local_queue_quota_reserved_workloads_total").values
+            lq_adm_total = reg.counter(
+                "local_queue_admitted_workloads_total").values
+            lq_hists = [
+                reg.histogram("local_queue_quota_reserved_wait_time_seconds"),
+                reg.histogram("local_queue_admission_wait_time_seconds"),
+            ]
+        for (cq_name, lq), (n, waits, checks_waits) in agg.items():
+            cq_l = (cq_name,)
+            qr_total[cq_l] += n
+            adm_total[cq_l + self._custom_cq_labels(cq_name)] += n
+            for h in hists:
+                counts = h.counts.get(cq_l)
+                if counts is None:
+                    counts = h.counts[cq_l] = [0] * (len(h.buckets) + 1)
+                s = 0.0
+                for v in waits:
+                    counts[_bisect.bisect_left(h.buckets, v)] += 1
+                    s += v
+                h.sums[cq_l] += s
+                h.totals[cq_l] += n
+            # admission-checks wait: 0.0 for immediate admissions,
+            # the real reservation-to-now span for second-pass ones.
+            ccounts = checks_h.counts.get(cq_l)
+            if ccounts is None:
+                ccounts = checks_h.counts[cq_l] = \
+                    [0] * (len(checks_h.buckets) + 1)
+            ccounts[0] += n - len(checks_waits)
+            if checks_waits:
+                s = 0.0
+                for v in checks_waits:
+                    ccounts[_bisect.bisect_left(checks_h.buckets, v)] += 1
+                    s += v
+                checks_h.sums[cq_l] += s
+            checks_h.totals[cq_l] += n
+            if lq_on:
+                lq_l = (lq,)
+                lq_qr_total[lq_l] += n
+                lq_adm_total[lq_l] += n
+                for h in lq_hists:
+                    counts = h.counts.get(lq_l)
+                    if counts is None:
+                        counts = h.counts[lq_l] = [0] * (len(h.buckets) + 1)
+                    s = 0.0
+                    for v in waits:
+                        counts[_bisect.bisect_left(h.buckets, v)] += 1
+                        s += v
+                    h.sums[lq_l] += s
+                    h.totals[lq_l] += n
 
     def _admit(self, entry, bulk: "Optional[_BulkAdmitCtx]" = None) -> None:
         """scheduler.go:856 (admit): reserve quota, assume in cache; the

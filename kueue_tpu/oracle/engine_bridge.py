@@ -869,21 +869,28 @@ class OracleBridge:
         self.cycles_on_device += 1
         _t_device = _time.perf_counter()
         apply_rows = device_w & cq_on_device[cq_safe_idx]
-        result = self._apply(w, wl, pending_infos,
-                             np.asarray(wl_admitted),
-                             np.asarray(new_inadmissible),
-                             np.asarray(slot_position),
-                             np.asarray(flavor_of_res),
-                             apply_rows=apply_rows,
-                             slot_mask=cq_on_device,
-                             slot_preempting=np.asarray(slot_preempting),
-                             head_idx=np.asarray(head_idx),
-                             preempt_targets=preempt_targets)
-        # North-star phase accounting: encode (snapshot + tensorize) /
-        # device (solve incl. transfer) / apply (decode + commit).
+        result, finalize = self._apply(
+            w, wl, pending_infos,
+            np.asarray(wl_admitted),
+            np.asarray(new_inadmissible),
+            np.asarray(slot_position),
+            np.asarray(flavor_of_res),
+            apply_rows=apply_rows,
+            slot_mask=cq_on_device,
+            slot_preempting=np.asarray(slot_preempting),
+            head_idx=np.asarray(head_idx),
+            preempt_targets=preempt_targets)
         _t_apply = _time.perf_counter()
+        finalize()
+        # North-star phase accounting: encode (snapshot + tensorize) /
+        # device (solve incl. transfer) / apply (decode + cache assume,
+        # what the reference's cycle blocks on) / finalize (status +
+        # metric + journal writes — the reference's ASYNC status PATCH,
+        # scheduler.go:870; still inside this cycle's wall time).
+        _t_final = _time.perf_counter()
         phases = {"encode": _t_encode - _t0, "device": _t_device - _t_encode,
-                  "apply": _t_apply - _t_device}
+                  "apply": _t_apply - _t_device,
+                  "finalize": _t_final - _t_apply}
         eng.last_cycle_phases = phases
         for phase, dur in phases.items():
             eng.registry.histogram(
@@ -919,10 +926,14 @@ class OracleBridge:
     def _apply(self, w, wls, pending_infos, wl_admitted, parked,
                slot_position, flavor_of_res, apply_rows=None,
                slot_mask=None, slot_preempting=None,
-               head_idx=None, preempt_targets=None) -> CycleResult:
+               head_idx=None, preempt_targets=None):
         """Apply verdicts through the engine's assume path. Rows outside
         ``apply_rows`` / slots outside ``slot_mask`` belong to host roots
-        and are left untouched (the sequential tail owns them)."""
+        and are left untouched (the sequential tail owns them).
+
+        Returns ``(CycleResult, finalize)``: the caller MUST invoke
+        ``finalize()`` (status conditions + metric/journal flush — the
+        async-PATCH analog) after stopping the apply-phase clock."""
         from kueue_tpu.scheduler.preemption import Target
 
         eng = self.engine
@@ -958,16 +969,24 @@ class OracleBridge:
         deferred: set = set()
         eng._deferred_cohort_requeue = deferred
         try:
-            self._apply_slots(nominate_order, slot_mask, admit_of_slot,
-                              parked_of_slot, pending_infos, w, wls,
-                              flavor_of_res, slot_position,
-                              slot_preempting, head_idx, preempt_targets,
-                              eng, bulk, result)
+            pairs = self._apply_slots(
+                nominate_order, slot_mask, admit_of_slot,
+                parked_of_slot, pending_infos, w, wls,
+                flavor_of_res, slot_position,
+                slot_preempting, head_idx, preempt_targets,
+                eng, bulk, result)
         finally:
             eng._deferred_cohort_requeue = None
-        eng._requeue_cohorts_bulk(deferred)
-        eng.flush_bulk_admit(bulk)
-        return result
+
+        def finalize() -> None:
+            """The async-status-PATCH analog (scheduler.go:870): runs
+            after the apply span's clock stops, timed as its own
+            phase."""
+            eng.bulk_finalize_batch(pairs, bulk)
+            eng._requeue_cohorts_bulk(deferred)
+            eng.flush_bulk_admit(bulk)
+
+        return result, finalize
 
     def _apply_slots(self, nominate_order, slot_mask, admit_of_slot,
                      parked_of_slot, pending_infos, w, wls, flavor_of_res,
@@ -975,6 +994,7 @@ class OracleBridge:
                      preempt_targets, eng, bulk, result):
         from kueue_tpu.scheduler.preemption import Target
 
+        admits = []
         for ci in nominate_order:
             if not slot_mask[ci]:
                 continue
@@ -984,8 +1004,7 @@ class OracleBridge:
                 entry = self._make_entry(info, w, wls, flavor_of_res, i)
                 entry.status = EntryStatus.ASSUMED
                 entry.commit_position = int(slot_position[ci])
-                eng.queues.delete_workload(info.obj)
-                eng._admit(entry, bulk=bulk)
+                admits.append(entry)
                 result.entries.append(entry)
                 result.stats.admitted += 1
             if slot_preempting[ci]:
@@ -1017,6 +1036,12 @@ class OracleBridge:
                                   requeue_reason=RequeueReason.NO_FIT)
                     entry.inadmissible_msg = "NoFit (batched oracle)"
                     result.entries.append(entry)
+        # The whole cycle's admissions assumed in one flat engine pass
+        # (admissions never interact with the preemption/park verdicts
+        # applied above — victims are admitted rows, parks are other
+        # pending rows). Status finalization is deferred to the
+        # finalize phase (bulk_finalize_batch).
+        return eng.bulk_assume_batch(admits, bulk)
 
     def _make_entry(self, info, w, wls, flavor_of_res, i) -> Entry:
         """Entry for an admitted verdict row. Assignments are FLYWEIGHTS:

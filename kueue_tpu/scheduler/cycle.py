@@ -71,9 +71,11 @@ class RequeueReason(str, Enum):
     NAMESPACE_MISMATCH = "NamespaceMismatch"
 
 
-@dataclass
+@dataclass(slots=True)
 class Entry:
-    """scheduler.go:582 (entry)."""
+    """scheduler.go:582 (entry). __slots__ via the dataclass decorator:
+    a serving cycle constructs one per verdict, so instance-dict
+    allocation is measurable at 1k admissions/cycle."""
 
     info: WorkloadInfo
     assignment: Optional[Assignment] = None

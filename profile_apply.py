@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Profile the serving-path apply span at bench scale (VERDICT r3 #1).
+
+Builds the bench_cycle_latency world (50k workloads x 1k CQs by
+default), runs schedule_once under cProfile for the timed cycles, and
+prints the top apply-phase costs.
+"""
+
+import cProfile
+import io
+import os
+import pstats
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def main():
+    n_workloads = int(os.environ.get("PROF_WORKLOADS", "50000"))
+    n_cohorts = int(os.environ.get("PROF_COHORTS", "200"))
+    n_cycles = int(os.environ.get("PROF_CYCLES", "4"))
+    fair = os.environ.get("PROF_FAIR") == "1"
+
+    from bench import build_cycle_engine
+    from kueue_tpu.bench.scenario import baseline_like, hierarchical_fair
+
+    if fair:
+        scen = hierarchical_fair(n_workloads=n_workloads)
+    else:
+        scen = baseline_like(n_cohorts=n_cohorts, n_workloads=n_workloads)
+    eng = build_cycle_engine(scen, fair=fair)
+    eng.apply_serving_gc_posture()
+
+    # untimed first cycle: compile + initial encode
+    t0 = time.perf_counter()
+    r = eng.schedule_once()
+    print(f"cycle 0 (compile): {time.perf_counter()-t0:.2f}s "
+          f"admitted={r.stats.admitted}", file=sys.stderr)
+
+    prof = cProfile.Profile()
+    times = []
+    phases = []
+    for k in range(n_cycles):
+        t0 = time.perf_counter()
+        prof.enable()
+        r = eng.schedule_once()
+        prof.disable()
+        el = time.perf_counter() - t0
+        times.append(el)
+        ph = dict(getattr(eng, "last_cycle_phases", {}))
+        phases.append(ph)
+        print(f"cycle {k+1}: {el*1000:.1f}ms admitted={r.stats.admitted} "
+              f"phases={ {p: round(v*1000,1) for p,v in ph.items()} }",
+              file=sys.stderr)
+        if not r.stats.admitted:
+            break
+
+    mean = {p: sum(ph.get(p, 0) for ph in phases) / len(phases)
+            for p in ("encode", "device", "apply", "finalize")}
+    print(f"mean phases (ms): "
+          f"{ {p: round(v*1000,1) for p,v in mean.items()} }",
+          file=sys.stderr)
+
+    s = io.StringIO()
+    ps = pstats.Stats(prof, stream=s).sort_stats("cumulative")
+    ps.print_stats(45)
+    print(s.getvalue())
+    s = io.StringIO()
+    ps = pstats.Stats(prof, stream=s).sort_stats("tottime")
+    ps.print_stats(35)
+    print(s.getvalue())
+
+
+if __name__ == "__main__":
+    main()
