@@ -42,6 +42,14 @@ class Cache:
         # Bumped on every admitted-set change: consumers (the bridge's
         # admitted-tensor cache) key their encodes on it.
         self.admitted_version = 0
+        # Admitted-change log: keys whose admitted-side encoding may
+        # have changed (upsert/delete/evict-flag). Drained by the
+        # bridge's incremental AdmittedRows (tensor/rowcache.py). With
+        # no consumer attached the set is CAPPED: on overflow it is
+        # dropped and the epoch bumped, which tells a (future) consumer
+        # to full-resync instead of trusting the log.
+        self.admitted_dirty: set[str] = set()
+        self.admitted_dirty_epoch = 0
         # Bumped on every CQ/cohort spec change (views memoize on it).
         self.spec_version = 0
         # flavor -> domain values tuple -> {resource: total}
@@ -165,6 +173,14 @@ class Cache:
             self._tas_protos = protos
         return self._tas_protos
 
+    def mark_admitted_dirty(self, key: str) -> None:
+        if len(self.admitted_dirty) > 100_000:
+            # Nobody is draining the log (no oracle bridge attached):
+            # drop it and signal full-resync via the epoch.
+            self.admitted_dirty.clear()
+            self.admitted_dirty_epoch += 1
+        self.admitted_dirty.add(key)
+
     # -- workloads (cache.go:766 AddOrUpdateWorkload / assume) --
 
     def _tas_flavor_names(self) -> set:
@@ -235,6 +251,7 @@ class Cache:
         self._wl_usage = {}
         self._wl_tas = {}
         self.admitted_version += 1
+        self.admitted_dirty.update(self.workloads.keys())
         for key, info in self.workloads.items():
             self._account(key, info)
 
@@ -261,6 +278,7 @@ class Cache:
         self.workloads[wl.key] = info
         self._account(wl.key, info)
         self.admitted_version += 1
+        self.mark_admitted_dirty(wl.key)
         return True
 
     def delete_workload(self, key: str) -> bool:
@@ -270,6 +288,7 @@ class Cache:
             # Only an actual admitted-set change invalidates consumers'
             # encodes (this is called for never-admitted keys too).
             self.admitted_version += 1
+            self.mark_admitted_dirty(key)
         return removed
 
     def is_assumed(self, key: str) -> bool:

@@ -1084,6 +1084,7 @@ class Engine:
                     cqw = cache.cq_workloads[cq_name] = {}
                 cqw[key] = info
                 wl_usage[key] = (cq_name, usage)
+                cache.admitted_dirty.add(key)
                 if tas_names:
                     tas = info.tas_domains(tas_names)
                     if tas:

@@ -54,15 +54,22 @@ def _mode_key(pmode, borrow, pref_preempt_first):
 
 
 def _classify_flavor(c, req, fl, avail, potential, nominal, derived,
-                     ancestors, height, no_preemption, can_pwb, *, depth):
+                     ancestors, height, no_preemption, can_pwb, *, depth,
+                     acc=None):
     """fitsResourceQuota before the oracle consult
     (flavorassigner.go:1198): classify one flavor for every resource of
     one workload. Shared by the nomination kernel and the sim-grid so
-    the two folds can never diverge. Returns (pmode[S], borrow[S],
-    oracle[S] — gate open and the CQ can actually preempt)."""
+    the two folds can never diverge. ``acc`` (int64[R], optional) is the
+    within-workload usage already assigned to earlier pod sets — the
+    reference's assumedUsage: every check runs against
+    val = acc[fr] + req (flavorassigner.go:1213). Returns (pmode[S],
+    borrow[S], oracle[S] — gate open and the CQ can actually
+    preempt)."""
     S = req.shape[0]
     fl_safe = jnp.maximum(fl, 0)
     fr = fl_safe * S + jnp.arange(S)
+    if acc is not None:
+        req = req + jnp.where(req > 0, acc[fr], 0)
     a = avail[c, fr]
     p = potential[c, fr]
     nom = nominal[c, fr]
@@ -127,7 +134,7 @@ def flavor_grid(
 @partial(jax.jit, static_argnames=("depth", "num_resources"))
 def assign_flavors(
     wl_cq,  # int32[W]
-    wl_req,  # int64[W, S]
+    wl_req,  # int64[W, P, S] per-podset count-scaled requests
     derived,  # dict from quota.derive_world (usage-current)
     nominal,  # int64[N, R]
     ancestors,  # int32[N, D]
@@ -143,108 +150,141 @@ def assign_flavors(
     num_resources: int,
 ):
     """Returns per-workload:
-      flavor_of_res: int32[W, S] chosen flavor id per resource (-1 none)
-      pmode: int32[W] representative preemption-mode
-      borrows: int32[W] assignment borrowing level (max over resources)
+      flavor_of_res: int32[W, P, S] chosen flavor id per (podset,
+          resource) (-1 none)
+      pmode: int32[W] representative preemption-mode (worst over podsets)
+      borrows: int32[W] assignment borrowing level (max over podsets)
       needs_oracle: bool[W]
-      usage_fr: int32[W, S] flavor-resource index per resource (-1 none)
+      usage_fr: int32[W, P, S] flavor-resource index (-1 none)
+
+    Pod sets are scanned in order with within-workload usage
+    accumulation — the reference walks podsets sequentially
+    (flavorassigner.go:707 grouped loop) and every later podset's
+    fitsResourceQuota sees the earlier podsets' assigned usage as
+    assumedUsage (:1015, :1213). Zero-request (padding) podsets
+    classify as all-fitting and choose no flavors.
     """
     S = num_resources
+    R = nominal.shape[1]
     avail = jnp.maximum(0, derived["available"])  # CQ available clipped
     potential = derived["potential"]
 
     G, F = group_flavors.shape[1], group_flavors.shape[2]
 
-    def per_workload(c, req):
+    def per_workload(c, req_ps):
         g_of_res = group_of_res[c]  # [S]
-        active = req > 0  # [S]
 
-        def eval_flavor(fl):
-            """Classify flavor fl for every resource: (pmode[S], borrow[S],
-            needs_oracle[S])."""
-            return _classify_flavor(
-                c, req, fl, avail, potential, nominal, derived, ancestors,
-                height, no_preemption, can_pwb, depth=depth)
+        def podset_step(acc, req):
+            active = req > 0  # [S]
 
-        def eval_group(g):
-            in_group = (g_of_res == g) & active  # [S]
-            flavors = group_flavors[c, g]  # [F]
+            def eval_flavor(fl):
+                """Classify flavor fl for every resource: (pmode[S],
+                borrow[S], needs_oracle[S])."""
+                return _classify_flavor(
+                    c, req, fl, avail, potential, nominal, derived,
+                    ancestors, height, no_preemption, can_pwb,
+                    depth=depth, acc=acc)
 
-            def scan_step(carry, fl):
-                (best_key, best_fl, best_pmode_s, best_borrow_s,
-                 best_oracle, stopped) = carry
-                valid = fl >= 0
-                pmode_s, borrow_s, oracle_s = eval_flavor(
-                    jnp.maximum(fl, 0))
-                # Mask resources outside the group as perfectly-fitting.
-                pmode_s = jnp.where(in_group, pmode_s, P_FIT)
-                borrow_s = jnp.where(in_group, borrow_s, 0)
-                oracle_s = jnp.where(in_group, oracle_s, False)
-                # Representative = worst (min key) over group resources.
+            def eval_group(g):
+                in_group = (g_of_res == g) & active  # [S]
+                flavors = group_flavors[c, g]  # [F]
+
+                def scan_step(carry, fl):
+                    (best_key, best_fl, best_pmode_s, best_borrow_s,
+                     best_oracle, stopped) = carry
+                    valid = fl >= 0
+                    pmode_s, borrow_s, oracle_s = eval_flavor(
+                        jnp.maximum(fl, 0))
+                    # Mask resources outside the group as
+                    # perfectly-fitting.
+                    pmode_s = jnp.where(in_group, pmode_s, P_FIT)
+                    borrow_s = jnp.where(in_group, borrow_s, 0)
+                    oracle_s = jnp.where(in_group, oracle_s, False)
+                    # Representative = worst (min key) over group
+                    # resources.
+                    keys = _mode_key(pmode_s, borrow_s,
+                                     fung_pref_preempt_first[c])
+                    rep_key = jnp.min(jnp.where(in_group, keys,
+                                                keys.max()))
+                    rep_pmode = pmode_s[jnp.argmin(
+                        jnp.where(in_group, keys, keys.max()))]
+                    rep_borrow = jnp.max(jnp.where(in_group, borrow_s, 0))
+                    # shouldTryNextFlavor (kernel modes only).
+                    try_next = (rep_pmode <= P_NO_CANDIDATES) | (
+                        (rep_borrow > 0) & fung_borrow_try_next[c])
+                    consider = valid & ~stopped
+                    better = consider & (rep_key > best_key)
+                    stop_here = consider & ~try_next
+                    new = (
+                        jnp.where(better | stop_here, rep_key, best_key),
+                        jnp.where(better | stop_here, fl, best_fl),
+                        jnp.where(better | stop_here, pmode_s,
+                                  best_pmode_s),
+                        jnp.where(better | stop_here, borrow_s,
+                                  best_borrow_s),
+                        jnp.where(better | stop_here, jnp.any(oracle_s),
+                                  best_oracle),
+                        stopped | stop_here,
+                    )
+                    return new, None
+
+                init = (
+                    jnp.asarray(-(_BIG * _BIG) - 1),
+                    jnp.asarray(-1, jnp.int32),
+                    jnp.full((S,), P_NO_FIT, jnp.int32),
+                    jnp.zeros((S,), jnp.int32),
+                    jnp.asarray(False),
+                    jnp.asarray(False),
+                )
+                (key, fl, pmode_s, borrow_s, oracle, _), _ = jax.lax.scan(
+                    scan_step, init, flavors)
+                group_active = jnp.any(in_group)
+                # representative pmode of the chosen flavor over group
+                # resources
                 keys = _mode_key(pmode_s, borrow_s,
                                  fung_pref_preempt_first[c])
-                rep_key = jnp.min(jnp.where(in_group, keys, keys.max()))
-                rep_pmode = pmode_s[jnp.argmin(
-                    jnp.where(in_group, keys, keys.max()))]
-                rep_borrow = jnp.max(jnp.where(in_group, borrow_s, 0))
-                # shouldTryNextFlavor (kernel modes only).
-                try_next = (rep_pmode <= P_NO_CANDIDATES) | (
-                    (rep_borrow > 0) & fung_borrow_try_next[c])
-                consider = valid & ~stopped
-                better = consider & (rep_key > best_key)
-                stop_here = consider & ~try_next
-                new = (
-                    jnp.where(better | stop_here, rep_key, best_key),
-                    jnp.where(better | stop_here, fl, best_fl),
-                    jnp.where(better | stop_here, pmode_s, best_pmode_s),
-                    jnp.where(better | stop_here, borrow_s, best_borrow_s),
-                    jnp.where(better | stop_here, jnp.any(oracle_s),
-                              best_oracle),
-                    stopped | stop_here,
-                )
-                return new, None
+                rep_pmode = jnp.where(
+                    group_active,
+                    pmode_s[jnp.argmin(jnp.where(in_group, keys,
+                                                 keys.max()))],
+                    P_FIT)
+                rep_pmode = jnp.where(
+                    fl < 0, jnp.where(group_active, P_NO_FIT, P_FIT),
+                    rep_pmode)
+                group_borrow = jnp.where(
+                    group_active & (fl >= 0),
+                    jnp.max(jnp.where(in_group, borrow_s, 0)), 0)
+                return fl, rep_pmode, group_borrow, oracle & group_active
 
-            init = (
-                jnp.asarray(-(_BIG * _BIG) - 1),
-                jnp.asarray(-1, jnp.int32),
-                jnp.full((S,), P_NO_FIT, jnp.int32),
-                jnp.zeros((S,), jnp.int32),
-                jnp.asarray(False),
-                jnp.asarray(False),
-            )
-            (key, fl, pmode_s, borrow_s, oracle, _), _ = jax.lax.scan(
-                scan_step, init, flavors)
-            group_active = jnp.any(in_group)
-            # representative pmode of the chosen flavor over group resources
-            keys = _mode_key(pmode_s, borrow_s, fung_pref_preempt_first[c])
-            rep_pmode = jnp.where(
-                group_active,
-                pmode_s[jnp.argmin(jnp.where(in_group, keys, keys.max()))],
-                P_FIT)
-            rep_pmode = jnp.where(fl < 0, jnp.where(group_active, P_NO_FIT,
-                                                    P_FIT), rep_pmode)
-            group_borrow = jnp.where(group_active & (fl >= 0),
-                                     jnp.max(jnp.where(in_group, borrow_s,
-                                                       0)), 0)
-            return fl, rep_pmode, group_borrow, oracle & group_active
+            g_ids = jnp.arange(G)
+            g_fl, g_pmode, g_borrow, g_oracle = jax.vmap(eval_group)(g_ids)
 
-        g_ids = jnp.arange(G)
-        g_fl, g_pmode, g_borrow, g_oracle = jax.vmap(eval_group)(g_ids)
+            # Podset-level aggregation.
+            pmode = jnp.min(g_pmode)
+            borrows = jnp.max(g_borrow)
+            needs_oracle = jnp.any(g_oracle)
+            # Resources not covered by any group with a positive request
+            # make the whole assignment NoFit (flavorassigner.go:939-941).
+            uncovered = jnp.any(active & (g_of_res < 0))
+            pmode = jnp.where(uncovered, P_NO_FIT, pmode)
+            flavor_of_res = jnp.where(
+                active & (g_of_res >= 0),
+                g_fl[jnp.maximum(g_of_res, 0)], -1)
+            flavor_of_res = jnp.where(pmode == P_NO_FIT, -1,
+                                      flavor_of_res)
+            usage_fr = jnp.where(flavor_of_res >= 0,
+                                 flavor_of_res * S + jnp.arange(S), -1)
+            # Accumulate this podset's assigned usage for the next one
+            # (assignment.append, flavorassigner.go:765).
+            acc = acc.at[jnp.where(usage_fr >= 0, usage_fr, R)].add(
+                jnp.where(usage_fr >= 0, req, 0), mode="drop")
+            return acc, (flavor_of_res, pmode, borrows, needs_oracle,
+                         usage_fr)
 
-        # Workload-level aggregation.
-        pmode = jnp.min(g_pmode)
-        borrows = jnp.max(g_borrow)
-        needs_oracle = jnp.any(g_oracle)
-        # Resources not covered by any group with a positive request make
-        # the whole assignment NoFit (flavorassigner.go:939-941).
-        uncovered = jnp.any(active & (g_of_res < 0))
-        pmode = jnp.where(uncovered, P_NO_FIT, pmode)
-        flavor_of_res = jnp.where(
-            active & (g_of_res >= 0),
-            g_fl[jnp.maximum(g_of_res, 0)], -1)
-        flavor_of_res = jnp.where(pmode == P_NO_FIT, -1, flavor_of_res)
-        usage_fr = jnp.where(flavor_of_res >= 0,
-                             flavor_of_res * S + jnp.arange(S), -1)
-        return flavor_of_res, pmode, borrows, needs_oracle, usage_fr
+        acc0 = jnp.zeros((R,), wl_req.dtype)
+        _, (flavor_ps, pmode_ps, borrow_ps, oracle_ps, usage_fr_ps) = \
+            jax.lax.scan(podset_step, acc0, req_ps)
+        return (flavor_ps, jnp.min(pmode_ps), jnp.max(borrow_ps),
+                jnp.any(oracle_ps), usage_fr_ps)
 
     return jax.vmap(per_workload)(wl_cq, wl_req)

@@ -377,8 +377,11 @@ def commit_grouped_fair(
     N, R = usage0.shape
     Rn, M = root_members.shape
     K = root_nodes.shape[1]
-    S = entry_fr.shape[1]
     NF = num_flavors
+    # Resources per flavor for the flavor-summed reshapes below; the
+    # entry_fr/entry_req column count is independent (the cycle core
+    # passes a dense per-flavor-resource layout).
+    S = R // NF
     D = depth
     lq = local_quota(subtree_quota, lend_limit)
     entry_kind = jnp.where(entry_valid, entry_kind, ENTRY_SKIP)

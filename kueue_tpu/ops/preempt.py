@@ -236,6 +236,19 @@ def classical_targets_impl(
     local_chain,  # int32[C, D+1] positions into the CQ root's node row
     root_nodes,  # int32[Rn, K]
     root_of_cq,  # int32[C]
+    slot_cq=None,  # int32[C'] CQ id per row (default: row index == CQ).
+    #   Decouples rows from CQ ids so callers can batch arbitrary
+    #   (CQ, flavor-cell) simulation rows into ONE launch (the bridge's
+    #   sim-augmented nomination paid one launch per cell before).
+    adm_rank=None,  # int64[A] OPTIONAL precomputed rank of the slot-
+    #   independent ordering tail (priority asc, reservation recency
+    #   desc, uid asc — common/ordering.go:42). When provided, candidate
+    #   ordering is ONE composite-key argsort per slot instead of a
+    #   6-key lexsort (the dominant kernel cost at large admitted sets).
+    adm_by_root=None,  # int32[Rn, A_l] OPTIONAL admitted ids grouped by
+    #   cohort root (-1 pad): per-slot candidate work shrinks from O(A)
+    #   to O(max admitted per root). Victim ids in the outputs stay
+    #   GLOBAL.
     *,
     depth: int,
     v_cap: int,
@@ -269,7 +282,8 @@ def classical_targets_impl(
     """
     C, S = slot_req.shape
     A = adm_cq.shape[0]
-    V = min(v_cap, A)
+    A_l = A if adm_by_root is None else adm_by_root.shape[1]
+    V = min(v_cap, A_l)
     K = root_nodes.shape[1]
     lq_all = local_quota(subtree_quota, lend_limit)
 
@@ -281,6 +295,36 @@ def classical_targets_impl(
     def per_slot(c, need, p_pri, p_ts, frs, req):
         frs_safe = jnp.maximum(frs, 0)
         active = (frs >= 0) & (req > 0)
+
+        # Candidate scope: with adm_by_root, gather ONLY the slot's
+        # root's admitted rows (candidates never cross cohort roots —
+        # candidate_generator.go walks the preemptor's hierarchy) so all
+        # per-candidate work is O(max admitted per root), not O(A).
+        if adm_by_root is None:
+            l_ok = jnp.ones((A,), bool)
+            l_cq, l_pri, l_ts = adm_cq, adm_pri, adm_ts
+            l_qrt, l_uid, l_ev = adm_qrt, adm_uid, adm_evicted
+            l_usage = adm_usage
+            l_chain, l_loc = adm_chain, adm_loc
+            l_rank = adm_rank
+            g_rows = None
+        else:
+            g_rows = adm_by_root[root_of_cq[c]]  # [A_l] global ids
+            l_ok = g_rows >= 0
+            rsafe = jnp.maximum(g_rows, 0)
+            l_cq = jnp.where(l_ok, adm_cq[rsafe], -1)
+            l_pri = adm_pri[rsafe]
+            l_ts = adm_ts[rsafe]
+            l_qrt = adm_qrt[rsafe]
+            l_uid = adm_uid[rsafe]
+            l_ev = adm_evicted[rsafe] & l_ok
+            l_usage = jnp.where(l_ok[:, None], adm_usage[rsafe], 0)
+            l_chain = jnp.where(l_ok[:, None], adm_chain[rsafe], -1)
+            l_loc = jnp.where(l_ok[:, None], adm_loc[rsafe], -1)
+            # Pad rows sort last; ties among pads are irrelevant (they
+            # can never be candidates).
+            l_rank = (None if adm_rank is None
+                      else jnp.where(l_ok, adm_rank[rsafe], A))
 
         # Root-local state over the slot's root, columns = the slot's
         # chosen flavor-resources.
@@ -349,37 +393,39 @@ def classical_targets_impl(
         # --- candidate classification over all admitted workloads ---
         c_chain = jnp.concatenate(
             [jnp.asarray([c], jnp.int32), ancestors[c]])  # [D+1]
-        same_cq = adm_cq == c
-        same_root = root_of_cq[jnp.maximum(adm_cq, 0)] == root_of_cq[c]
+        same_cq = l_cq == c
+        same_root = (l_ok if g_rows is not None else
+                     root_of_cq[jnp.maximum(l_cq, 0)]
+                     == root_of_cq[c])
         # LCA level: lowest d >= 1 with c_chain[d] on the candidate's
         # chain. Loops over the (short) depth axes to keep peak memory at
         # O(A) per slot.
         NO_LCA = depth + 9
-        lca_level = jnp.full((A,), NO_LCA, jnp.int32)
+        lca_level = jnp.full((A_l,), NO_LCA, jnp.int32)
         for d in range(depth, 0, -1):
-            on_chain = jnp.zeros((A,), bool)
+            on_chain = jnp.zeros((A_l,), bool)
             for e in range(depth + 1):
-                on_chain = on_chain | (adm_chain[:, e] == c_chain[d])
+                on_chain = on_chain | (l_chain[:, e] == c_chain[d])
             on_chain = on_chain & (c_chain[d] >= 0)
             lca_level = jnp.where(on_chain, d, lca_level)
         has_lca = lca_level <= depth
         lca_node = c_chain[jnp.clip(lca_level, 0, depth)]  # [A]
         # Candidate-chain position of the LCA.
-        lca_pos = jnp.full((A,), NO_LCA, jnp.int32)
+        lca_pos = jnp.full((A_l,), NO_LCA, jnp.int32)
         for e in range(depth, -1, -1):
-            lca_pos = jnp.where(adm_chain[:, e] == lca_node, e, lca_pos)
+            lca_pos = jnp.where(l_chain[:, e] == lca_node, e, lca_pos)
 
         uses_any = jnp.any(
-            (adm_usage[:, frs_safe] > 0) & need_fr[None, :], axis=1)
+            (l_usage[:, frs_safe] > 0) & need_fr[None, :], axis=1)
         pol = jnp.where(same_cq, wcq_policy[c], reclaim_policy[c])
         pol_gate = jnp.where(
             same_cq, wcq_policy[c] != POLICY_NEVER,
             (reclaim_policy[c] != POLICY_NEVER) & cq_has_parent[c])
-        pol_ok = _policy_ok(pol, p_pri, p_ts, adm_pri, adm_ts)
+        pol_ok = _policy_ok(pol, p_pri, p_ts, l_pri, l_ts)
 
         adv_at_lca = adv_before[jnp.clip(lca_level, 0, depth)]
-        rwob = (bwc_forbidden[c] | (adm_pri >= p_pri)
-                | (adm_pri > bwc_threshold[c]))
+        rwob = (bwc_forbidden[c] | (l_pri >= p_pri)
+                | (l_pri > bwc_threshold[c]))
         variant = jnp.where(
             same_cq, V_WITHIN_CQ,
             jnp.where(adv_at_lca, V_HIERARCHICAL_RECLAIM,
@@ -392,12 +438,12 @@ def classical_targets_impl(
         # needed resource. Level-wise loop keeps peak memory at O(A * S).
         wn_rownominal = jnp.all(jnp.where(
             need_fr[None, :], sq_l >= usage_l0, True), axis=1)  # [K]
-        static_bad = jnp.zeros((A,), bool)
+        static_bad = jnp.zeros((A_l,), bool)
         for e in range(depth + 1):
-            rows = adm_loc[:, e]
-            below = (e < lca_pos) & (rows >= 0)
+            loc_e = l_loc[:, e]
+            below = (e < lca_pos) & (loc_e >= 0)
             static_bad = static_bad | (
-                below & wn_rownominal[jnp.maximum(rows, 0)])
+                below & wn_rownominal[jnp.maximum(loc_e, 0)])
         static_path_ok = ~static_bad
 
         is_cand = (any_need & uses_any & pol_gate & pol_ok
@@ -419,21 +465,31 @@ def classical_targets_impl(
         # Ordering: evicted first, bucket, priority asc, reservation
         # recency desc, uid asc; non-candidates last (lexsort: last key
         # is primary).
-        order = jnp.lexsort((
-            adm_uid,
-            -adm_qrt,
-            adm_pri,
-            bucket,
-            jnp.where(adm_evicted, 0, 1),
-            jnp.where(is_cand, 0, 1),
-        )).astype(jnp.int32)
+        if l_rank is None:
+            order = jnp.lexsort((
+                l_uid,
+                -l_qrt,
+                l_pri,
+                bucket,
+                jnp.where(l_ev, 0, 1),
+                jnp.where(is_cand, 0, 1),
+            )).astype(jnp.int32)
+        else:
+            # Composite key: only is_cand and bucket vary per slot; the
+            # rest is the precomputed rank. Rank uniqueness makes the
+            # order total — one argsort, no ties.
+            lvl = (jnp.where(is_cand, 0, 2)
+                   + jnp.where(l_ev, 0, 1)) * 4 + bucket
+            order = jnp.argsort(
+                lvl.astype(jnp.int64) * (A + 1) + l_rank
+            ).astype(jnp.int32)
         v_ids = order[:V]  # [V]
         v_cand = is_cand[v_ids]
         v_variant = variant[v_ids]
         v_same = same_cq[v_ids]
-        v_loc = adm_loc[v_ids]  # [V, D+1]
+        v_loc = l_loc[v_ids]  # [V, D+1]
         v_lca_pos = lca_pos[v_ids]
-        v_usage = adm_usage[v_ids][:, frs_safe]  # [V, S]
+        v_usage = l_usage[v_ids][:, frs_safe]  # [V, S]
         n_cand = jnp.sum(is_cand.astype(jnp.int32))
 
         def remove_chain(usage_l, loc, val):
@@ -542,19 +598,34 @@ def classical_targets_impl(
             f1, borrow_after_height(u1),
             jnp.where(use2, borrow_after_height(u2), 0)).astype(jnp.int32)
 
+        if g_rows is None:
+            g_v_ids = v_ids
+            variant_g = variant
+        else:
+            # Map local victim positions / variants back to GLOBAL ids.
+            g_v_ids = jnp.where(l_ok[v_ids], g_rows[v_ids], -1)
+            variant_g = jnp.zeros((A,), variant.dtype).at[
+                jnp.where(l_ok, jnp.maximum(g_rows, 0), A)].set(
+                jnp.where(l_ok, variant, 0), mode="drop")
         target_mask = jnp.zeros((A,), bool).at[
-            jnp.where(taken, v_ids, A)].set(True, mode="drop")
+            jnp.where(taken & (g_v_ids >= 0), g_v_ids, A)].set(
+            True, mode="drop")
         return (found, overflow, target_mask,
-                jnp.sum(taken.astype(jnp.int32)), variant, borrow_after,
-                v_ids, taken)
+                jnp.sum(taken.astype(jnp.int32)), variant_g, borrow_after,
+                g_v_ids, taken)
 
+    if slot_cq is None:
+        slot_cq = jnp.arange(C, dtype=jnp.int32)
     return jax.vmap(per_slot)(
-        jnp.arange(C, dtype=jnp.int32), slot_need, slot_pri, slot_ts,
-        slot_fr, slot_req)
+        slot_cq, slot_need, slot_pri, slot_ts, slot_fr, slot_req)
 
 
 @partial(jax.jit, static_argnames=("depth", "v_cap"))
-def classical_targets(*args, depth: int, v_cap: int):
+def classical_targets(*args, depth: int, v_cap: int, slot_cq=None,
+                      adm_rank=None, adm_by_root=None):
     """Jitted standalone form (the oracle-service op): drops the packed
     per-slot victim lists that only the fused cycle kernel consumes."""
-    return classical_targets_impl(*args, depth=depth, v_cap=v_cap)[:6]
+    return classical_targets_impl(*args, slot_cq=slot_cq,
+                                  adm_rank=adm_rank,
+                                  adm_by_root=adm_by_root, depth=depth,
+                                  v_cap=v_cap)[:6]

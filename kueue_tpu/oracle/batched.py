@@ -56,7 +56,10 @@ class DrainDecision:
     cluster_queue: str
     cycle: int
     position: int  # commit position within the cycle
-    flavors: dict  # resource -> flavor name
+    flavors: dict  # resource -> flavor name (first pod set)
+    # Per-podset flavor dicts (multi-podset workloads; [flavors] for
+    # single-podset ones).
+    podset_flavors: list = None
 
 
 def _cycle_core(
@@ -112,6 +115,16 @@ def _cycle_core(
     pc_bwc_threshold=None,  # int64[C]
     pc_cq_has_parent=None,  # bool[C]
     root_of_cq=None,  # int32[C]
+    adm_rank=None,  # int64[A] precomputed candidate-ordering rank
+    #   (ops/preempt.classical_targets_impl adm_rank)
+    adm_by_root=None,  # int32[Rn, A_l] admitted ids grouped by root
+    slot_maybe=None,  # bool[C] host precheck: this slot's head COULD
+    #   have preemption candidates (exact-conservative: False only when
+    #   provably none exist — candidate_generator.go's policy tests
+    #   evaluated against the admitted set). Slots masked off resolve to
+    #   the kernel's found=False outcome without running the preemptor;
+    #   a cycle with no maybe-slots skips target selection entirely
+    #   (lax.cond), which is most cycles in converged worlds.
     *,
     depth: int, num_resources: int, num_cqs: int,
     fair_mode: bool = False, num_flavors: int = 1, v_cap: int = 32,
@@ -142,9 +155,12 @@ def _cycle_core(
     slot_valid = head_idx >= 0
     h_safe = jnp.maximum(head_idx, 0)
     h_cq = jnp.where(slot_valid, wl_cq[h_safe], 0).astype(jnp.int32)
-    h_req = jnp.where(slot_valid[:, None], wl_req[h_safe], 0)
+    # [C, P, S]: per-podset head requests.
+    h_req = jnp.where(slot_valid[:, None, None], wl_req[h_safe], 0)
+    P = h_req.shape[1]
 
-    # 3. Nominate all heads at once.
+    # 3. Nominate all heads at once (per-podset flavor choices with
+    # within-workload usage accumulation, flavorassigner.go:707).
     flavor_of_res, pmode, borrows, needs_oracle, usage_fr = \
         aops.assign_flavors(
             h_cq, h_req, derived, nominal, ancestors, height, group_of_res,
@@ -154,12 +170,34 @@ def _cycle_core(
         borrows = jnp.where(slot_borrows_override >= 0,
                             slot_borrows_override, borrows)
     if slot_flavor_override is not None:
+        # Sim-nomination overrides are single-podset by construction
+        # (the bridge demotes multi-podset sim heads): apply at podset 0
+        # and clear the rest.
         has_fo = jnp.any(slot_flavor_override >= 0, axis=1)
-        flavor_of_res = jnp.where(has_fo[:, None], slot_flavor_override,
-                                  flavor_of_res)
+        fo0 = jnp.where(has_fo[:, None], slot_flavor_override,
+                        flavor_of_res[:, 0])
+        flavor_of_res = flavor_of_res.at[:, 0].set(fo0)
+        if P > 1:
+            tail_clear = has_fo[:, None, None] \
+                & (jnp.arange(P)[None, :, None] > 0)
+            flavor_of_res = jnp.where(tail_clear, -1, flavor_of_res)
         usage_fr = jnp.where(
             flavor_of_res >= 0,
-            flavor_of_res * S + jnp.arange(S)[None, :], -1)
+            flavor_of_res * S + jnp.arange(S)[None, None, :], -1)
+
+    # Dense per-flavor-resource entry form for the commit/preemption
+    # kernels: requests aggregated over podsets per fr column, so
+    # columns are UNIQUE by construction (two podsets sharing a flavor
+    # must be fit-checked against their combined usage; per-column
+    # checks would double-book headroom).
+    R = nominal.shape[1]
+    flat_fr = usage_fr.reshape(C, -1)
+    flat_req = h_req.reshape(C, -1)
+    req_fr = jnp.zeros((C, R), h_req.dtype).at[
+        jnp.arange(C)[:, None], jnp.where(flat_fr >= 0, flat_fr, 0)
+    ].add(jnp.where(flat_fr >= 0, flat_req, 0))
+    entry_fr_d = jnp.where(req_fr > 0,
+                           jnp.arange(R, dtype=jnp.int32)[None, :], -1)
 
     # 5. Commit. Entry kinds: FIT commits; preempt-mode-no-candidates
     # reserves capacity unless the CQ can always reclaim
@@ -193,18 +231,44 @@ def _cycle_core(
 
         h_pri = jnp.where(slot_valid, wl_priority[h_safe], 0)
         h_ts = jnp.where(slot_valid, wl_ts[h_safe], 0.0)
+        oracle_eff = (slot_oracle if slot_maybe is None
+                      else slot_oracle & slot_maybe)
+        A_ = adm_cq.shape[0]
+        A_l_ = adm_by_root.shape[1] if adm_by_root is not None else A_
+        V_ = min(v_cap, A_l_)  # must match the kernel's victim width
+
+        def _run_targets(_):
+            out = pops.classical_targets_impl(
+                oracle_eff, h_pri, h_ts, entry_fr_d, req_fr,
+                pc_wcq_policy, pc_reclaim_policy, pc_bwc_forbidden,
+                pc_bwc_threshold, pc_cq_has_parent,
+                adm_cq, adm_pri, adm_ts, adm_qrt, adm_uid, adm_evicted,
+                adm_usage, full_usage, derived["subtree_quota"],
+                lend_limit, borrow_limit, nominal, ancestors, height,
+                local_chain, root_nodes, root_of_cq,
+                adm_rank=adm_rank, adm_by_root=adm_by_root,
+                depth=depth, v_cap=v_cap)
+            # Canonical dtypes: both cond branches must match exactly.
+            return (out[0], out[1], out[2], out[3].astype(jnp.int32),
+                    out[4].astype(jnp.int32), out[5].astype(jnp.int32),
+                    out[6].astype(jnp.int32), out[7])
+
+        def _skip_targets(_):
+            return (jnp.zeros((C,), bool), jnp.zeros((C,), bool),
+                    jnp.zeros((C, A_), bool), jnp.zeros((C,), jnp.int32),
+                    jnp.zeros((C, A_), jnp.int32),
+                    jnp.zeros((C,), jnp.int32),
+                    jnp.zeros((C, V_), jnp.int32),
+                    jnp.zeros((C, V_), bool))
+
         (pfound, poverflow, victim_mask, _pn, victim_variant, pborrow,
-         pv_ids, ptaken) = pops.classical_targets_impl(
-            slot_oracle, h_pri, h_ts, usage_fr, h_req,
-            pc_wcq_policy, pc_reclaim_policy, pc_bwc_forbidden,
-            pc_bwc_threshold, pc_cq_has_parent,
-            adm_cq, adm_pri, adm_ts, adm_qrt, adm_uid, adm_evicted,
-            adm_usage, full_usage, derived["subtree_quota"], lend_limit,
-            borrow_limit, nominal, ancestors, height, local_chain,
-            root_nodes, root_of_cq, depth=depth, v_cap=v_cap)
-        pfound = pfound & slot_oracle
+         pv_ids, ptaken) = jax.lax.cond(
+            jnp.any(oracle_eff), _run_targets, _skip_targets, None)
+        pfound = pfound & oracle_eff
         fused_preempt = pfound
-        slot_overflow = poverflow & slot_oracle
+        slot_overflow = poverflow & oracle_eff
+        # Precheck-masked slots land here too: no candidates == the
+        # kernel's found=False outcome.
         no_cand = slot_oracle & ~pfound & ~slot_overflow
         kind = jnp.where(
             pfound, cops.ENTRY_PREEMPT,
@@ -253,7 +317,7 @@ def _cycle_core(
         # (fair_sharing_iterator.go:47): per-root DRS recomputation after
         # every winner, on device.
         slot_admitted, slot_round, _ = cops.commit_grouped_fair(
-            slot_valid, usage_fr, h_req, kind, borrows,
+            slot_valid, entry_fr_d, req_fr, kind, borrows,
             jnp.where(slot_valid, wl_priority[h_safe], 0),
             jnp.where(slot_valid, wl_ts[h_safe], 0.0),
             full_usage, derived["subtree_quota"], lend_limit, borrow_limit,
@@ -273,7 +337,7 @@ def _cycle_core(
             jnp.where(slot_valid, commit_rank[h_safe], (1 << 24) - 1))
         order = jnp.argsort(key).astype(jnp.int32)
         slot_committed, _ = cops.commit_grouped(
-            key, slot_valid, usage_fr, h_req, kind, borrows, full_usage,
+            key, slot_valid, entry_fr_d, req_fr, kind, borrows, full_usage,
             derived["subtree_quota"], lend_limit, borrow_limit, nominal,
             ancestors, root_members, root_nodes, local_chain,
             root_parent_local, slot_victim_row, slot_victim_vals,
@@ -317,7 +381,7 @@ def _cycle_core(
     committed_kind = jnp.where(slot_admitted, cops.ENTRY_FORCE,
                                cops.ENTRY_SKIP)
     _, usage_clean = cops.commit_grouped(
-        key, slot_valid, usage_fr, h_req, committed_kind, borrows,
+        key, slot_valid, entry_fr_d, req_fr, committed_kind, borrows,
         full_usage, derived["subtree_quota"], lend_limit, borrow_limit,
         nominal, ancestors, root_members, root_nodes, local_chain,
         depth=depth)
@@ -357,7 +421,7 @@ def drain_loop(
     more than the cycle itself. Returns:
       admit_cycle int32[W]  (-1 = not admitted)
       admit_pos   int32[W]  commit position within its cycle
-      wl_flavor   int32[W, S] chosen flavor per resource (-1 none)
+      wl_flavor   int32[W, P, S] chosen flavor per (podset, resource)
       usage       final usage tensor
       cycles      int32 number of cycles executed (incl. the empty one)
       oracle_flag bool  any workload flagged for the host preemptor
@@ -393,15 +457,16 @@ def drain_loop(
          _vvariant) = step(pending, inadmissible, usage)
         admit_cycle = jnp.where(wl_admitted, cycle, admit_cycle)
         admit_pos = jnp.where(wl_admitted, slot_position[wl_cq], admit_pos)
-        wl_flavor = jnp.where(wl_admitted[:, None], flavor_of_res[wl_cq],
-                              wl_flavor)
+        wl_flavor = jnp.where(wl_admitted[:, None, None],
+                              flavor_of_res[wl_cq], wl_flavor)
         progress = jnp.any(wl_admitted)
         return (pending, inadmissible, usage, cycle + 1, progress,
                 admit_cycle, admit_pos, wl_flavor, oracle_flag | any_oracle)
 
+    P = wl_req.shape[1]
     init = (pending, inadmissible, usage, jnp.int32(0), jnp.asarray(True),
             jnp.full((W,), -1, jnp.int32), jnp.zeros((W,), jnp.int32),
-            jnp.full((W, S), -1, jnp.int32), jnp.asarray(False))
+            jnp.full((W, P, S), -1, jnp.int32), jnp.asarray(False))
     (pending, inadmissible, usage, cycles, _, admit_cycle, admit_pos,
      wl_flavor, oracle_flag) = jax.lax.while_loop(cond, body, init)
     return admit_cycle, admit_pos, wl_flavor, usage, cycles, oracle_flag
@@ -534,16 +599,22 @@ class BatchedDrainSolver:
                                          admit_cycle[admitted_ids]))]
         for wid in order:
             ci = self.wls.cq[wid]
-            flavors = {}
-            for s_i, res in enumerate(w.resource_names):
-                fl = wl_flavor[wid, s_i]
-                if fl >= 0 and self.wls.requests[wid, s_i] > 0:
-                    flavors[res] = w.flavor_names[fl]
+            podset_flavors = []
+            # Real pod sets only (the tensor axis is pow2-padded).
+            n_real = len(self.infos[wid].total_requests)
+            for p in range(min(n_real, self.wls.requests.shape[1])):
+                flavors = {}
+                for s_i, res in enumerate(w.resource_names):
+                    fl = wl_flavor[wid, p, s_i]
+                    if fl >= 0 and self.wls.requests[wid, p, s_i] > 0:
+                        flavors[res] = w.flavor_names[fl]
+                podset_flavors.append(flavors)
             decisions.append(DrainDecision(
                 key=self.wls.keys[wid],
                 cluster_queue=w.cq_names[ci],
                 cycle=int(admit_cycle[wid]), position=int(admit_pos[wid]),
-                flavors=flavors))
+                flavors=podset_flavors[0],
+                podset_flavors=podset_flavors))
         return decisions, {
             "cycles": int(cycles),
             "needs_oracle": bool(oracle_flag),

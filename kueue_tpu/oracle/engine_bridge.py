@@ -268,29 +268,23 @@ class OracleBridge:
         return cfg
 
     def _encode_admitted(self, w):
-        """Admitted tensors for the preemption kernels, cached by
-        (admitted-set version, world signature): steady-state cycles
-        with no admitted-set change skip the O(A) re-encode."""
-        from kueue_tpu.tensor.rowcache import WorkloadRowCache
-        from kueue_tpu.tensor.schema import encode_admitted
+        """Admitted tensors for the preemption kernels: an incremental
+        row set (tensor/rowcache.AdmittedRows) updated from the cache's
+        admitted-change log — churn cycles touch a handful of rows, not
+        O(A). Rows are holes-allowed; `info_of` maps kernel victim ids
+        back to WorkloadInfos."""
+        from kueue_tpu.tensor.rowcache import AdmittedRows, \
+            WorkloadRowCache
 
-        # The admitted usage grid is laid out on flavor * S + resource
-        # columns, so the flavor index space is part of the key too.
-        key = (self.engine.cache.admitted_version,
-               WorkloadRowCache.world_signature(w),
-               tuple(w.flavor_names))
-        cached = getattr(self, "_adm_cache", None)
-        if cached is not None and cached[0] == key:
-            return cached[1], cached[2]
-        admitted = [info
-                    for name in self.engine.cache.cluster_queues
-                    for info in self.engine.cache.cq_workloads.get(
-                        name, {}).values()]
-        adm = encode_admitted(w, admitted, now=self.engine.clock)
-        self._adm_cache = (key, admitted, adm)
-        return admitted, adm
+        sig = (WorkloadRowCache.world_signature(w), tuple(w.flavor_names))
+        ar = getattr(self, "_adm_rows", None)
+        if ar is None or ar.signature != sig:
+            ar = AdmittedRows(w)
+            self._adm_rows = ar
+        adm = ar.sync(self.engine.cache, now=self.engine.clock)
+        return ar.info_of, adm
 
-    def _adm_padded(self, adm) -> dict:
+    def _adm_padded(self, adm, w) -> dict:
         """Bucket-pad the admitted axis so churn cycles with a drifting
         admitted count reuse one compiled program per bucket. Padded
         rows have cq=-1 and zero usage, so they never classify as
@@ -305,6 +299,27 @@ class OracleBridge:
 
         A = adm.num_admitted
         Ap = pow2_bucket(A, 8)
+        # Root-grouped admitted ids (preempt kernels scan candidates per
+        # root, O(max per root) instead of O(A)).
+        Rn = w.root_members.shape[0]
+        root_of = np.where(adm.cq >= 0, w.root_of_cq[np.maximum(
+            adm.cq, 0)], Rn) if A else np.zeros(0, np.int64)
+        counts = np.bincount(root_of, minlength=Rn + 1)[:Rn]
+        A_l = pow2_bucket(int(counts.max()) if counts.size else 1, 8)
+        adm_by_root = np.full((max(Rn, 1), A_l), -1, np.int32)
+        if A:
+            order = np.argsort(root_of, kind="stable")
+            sr = root_of[order]
+            pos = np.arange(A) - np.searchsorted(sr, sr)
+            valid = sr < Rn
+            adm_by_root[sr[valid], pos[valid]] = order[valid]
+        # Precomputed candidate-ordering rank (priority asc, reservation
+        # recency desc, uid asc — common/ordering.go:42): lets the
+        # preempt kernels order candidates with ONE composite argsort
+        # per slot instead of a 6-key lexsort.
+        rank = np.empty(A, np.int64)
+        rank[np.lexsort((adm.uid_rank, -adm.qr_time, adm.priority))] = \
+            np.arange(A)
         ap = dict(
             adm_cq=pad_axis0(adm.cq, Ap, -1),
             adm_pri=pad_axis0(adm.priority, Ap, 0),
@@ -314,6 +329,10 @@ class OracleBridge:
                 [adm.uid_rank, np.arange(A, Ap, dtype=np.int64)])
                 if Ap != A else adm.uid_rank),
             adm_ev=pad_axis0(adm.evicted, Ap, False),
+            adm_rank=(np.concatenate(
+                [rank, np.arange(A, Ap, dtype=np.int64)])
+                if Ap != A else rank),
+            adm_by_root=adm_by_root,
             adm_usage=pad_axis0(adm.usage, Ap, 0))
         # Device-resident for in-process execution: the encode is cached
         # across cycles by admitted-set version, so transfer once. A
@@ -326,19 +345,53 @@ class OracleBridge:
         self._adm_pad_cache = (adm, ap)
         return ap
 
+    def _slot_maybe(self, w, pcfg, adm, head_pri) -> np.ndarray:
+        """bool[C]: this slot's head COULD have preemption candidates —
+        exact-conservative host precheck against the admitted set
+        (candidate_generator.go's policy tests): False only when
+        provably no admitted workload can classify as a candidate.
+        Cross-CQ reclaim is never prechecked (conservatively maybe);
+        within-CQ policies are checked against per-CQ admitted priority
+        minima. Most converged-world cycles have zero maybe-slots, which
+        lets the kernels skip preemption target selection entirely."""
+        from kueue_tpu.ops import preempt as pops
+
+        C = w.num_cqs
+        maybe = ((pcfg["reclaim_policy"] != pops.POLICY_NEVER)
+                 & pcfg["cq_has_parent"])
+        wcq = pcfg["wcq_policy"]
+        A = adm.num_admitted
+        if A:
+            valid = adm.cq >= 0
+            cq_safe = np.where(valid, adm.cq, 0)
+            minpri = np.full(C, np.iinfo(np.int64).max, np.int64)
+            np.minimum.at(minpri, cq_safe,
+                          np.where(valid, adm.priority,
+                                   np.iinfo(np.int64).max))
+            count = np.bincount(cq_safe, weights=valid, minlength=C)
+            within = np.where(
+                wcq == pops.POLICY_ANY, count > 0,
+                np.where(wcq == pops.POLICY_LOWER, minpri < head_pri,
+                         np.where(wcq == pops.POLICY_LOWER_OR_NEWER_EQ,
+                                  minpri <= head_pri, False)))
+            maybe = maybe | within
+        return maybe
+
     def _classical_call(self, w, adm, pcfg, usage, slot_need, slot_pri,
                         slot_ts, slot_fr, slot_req, v_cap=32,
-                        derived=None):
+                        derived=None, slot_cq=None):
         """One batched classical_targets launch via the executor;
         returns numpy (found, overflow, mask, variant, borrow_after).
         Pass ``derived`` when the caller already ran quota.derive_world
-        for this usage (in-process execution reuses it)."""
-        C = w.num_cqs
-        if adm.num_admitted == 0:
+        for this usage (in-process execution reuses it). ``slot_cq``
+        decouples rows from CQ ids (batched sim cells)."""
+        C = slot_need.shape[0]
+        live = adm.live if adm.live is not None else adm.num_admitted
+        if live == 0:
             return (np.zeros(C, bool), np.zeros(C, bool),
                     np.zeros((C, 0), bool), np.zeros((C, 0), np.int32),
                     np.zeros(C, np.int32))
-        ap = self._adm_padded(adm)
+        ap = self._adm_padded(adm, w)
         adm_cq = ap["adm_cq"]
         adm_pri = ap["adm_pri"]
         adm_ts = ap["adm_ts"]
@@ -356,11 +409,15 @@ class OracleBridge:
             cq_has_parent=pcfg["cq_has_parent"],
             adm_cq=adm_cq, adm_pri=adm_pri, adm_ts=adm_ts,
             adm_qrt=adm_qrt, adm_uid=adm_uid, adm_ev=adm_ev,
+            adm_rank=ap["adm_rank"],
+            adm_by_root=ap["adm_by_root"],
             adm_usage=adm_usage, usage=usage, nominal=w.nominal,
             lend_limit=w.lend_limit, borrow_limit=w.borrow_limit,
             parent=w.parent, ancestors=w.ancestors, height=w.height,
             local_chain=w.local_chain, root_nodes=w.root_nodes,
             root_of_cq=w.root_of_cq)
+        if slot_cq is not None:
+            tensors["slot_cq"] = slot_cq
         out = self.executor.classical_targets(
             tensors, {"depth": w.depth, "v_cap": v_cap}, derived=derived)
         found, overflow, mask, _n, variant, borrow_after = out
@@ -408,7 +465,10 @@ class OracleBridge:
         h_cq = np.zeros(C, np.int32)
         h_req = np.zeros((C, S), np.int64)
         h_cq[slots] = slots  # head CQ == slot for valid heads
-        h_req[slots] = wls.requests[h]
+        # Sim heads are single-podset by construction (try_cycle demotes
+        # multi-podset heads on sim-needing CQs): podset 0 carries the
+        # whole request.
+        h_req[slots] = wls.requests[h, 0]
 
         derived = qops.derive_world(
             jnp.asarray(w.nominal), jnp.asarray(w.lend_limit),
@@ -426,24 +486,61 @@ class OracleBridge:
         g_sim = np.array(g_sim)  # writable copy
         g_sim[~sim_slots] = False
 
-        # Batched per-cell sims: one classical_targets launch per
-        # (group, flavor, resource) cell any slot needs.
-        sim_out: dict[tuple, tuple] = {}
-        for g, f, s in zip(*np.nonzero(np.any(g_sim, axis=0))):
-            cell_need = g_sim[:, g, f, s]
-            fl = w.group_flavors[:, g, f]
-            slot_fr = np.full((C, S), -1, np.int32)
-            slot_fr[cell_need, s] = fl[cell_need] * S + s
-            slot_req = np.zeros((C, S), np.int64)
-            slot_req[cell_need, s] = h_req[cell_need, s]
-            found, overflow, mask, variant, borrow_after = \
+        # Batched per-cell sims: ALL (slot, group, flavor, resource)
+        # cells in ONE classical_targets launch — each cell is its own
+        # row with slot_cq pointing at the head's CQ (the per-cell
+        # launches dominated mixed-world cycle time). Cells whose slot
+        # provably has no candidates (_slot_maybe) skip the kernel and
+        # resolve to found=False, matching SimulatePreemption's no-
+        # candidates outcome.
+        from kueue_tpu.tensor.schema import pow2_bucket
+
+        head_pri = self._head_pri(wls, head_idx)
+        head_ts = self._head_ts(wls, head_idx)
+        maybe = self._slot_maybe(w, pcfg, adm, head_pri)
+        cells = list(zip(*np.nonzero(np.any(g_sim, axis=0))))
+        # cell -> (found bool[C], victims dict ci -> np.int idx array,
+        # borrow int32[C])
+        sim_out: dict[tuple, tuple] = {
+            cell: (np.zeros(C, bool), {}, np.zeros(C, np.int32))
+            for cell in cells}
+        row_ci: list[int] = []
+        row_cell: list[tuple] = []
+        for cell in cells:
+            g, f, s = cell
+            for ci in np.nonzero(g_sim[:, g, f, s] & maybe)[0]:
+                row_ci.append(int(ci))
+                row_cell.append(cell)
+        adm_live = adm.live if adm.live is not None else adm.num_admitted
+        if row_ci and adm_live:
+            n_rows = len(row_ci)
+            Rw = pow2_bucket(n_rows, 8)
+            r_cq = np.zeros(Rw, np.int32)
+            r_need = np.zeros(Rw, bool)
+            r_pri = np.zeros(Rw, np.int64)
+            r_ts = np.zeros(Rw, np.float64)
+            r_fr = np.full((Rw, S), -1, np.int32)
+            r_req = np.zeros((Rw, S), np.int64)
+            for r, (ci, (g, f, s)) in enumerate(zip(row_ci, row_cell)):
+                r_cq[r] = ci
+                r_need[r] = True
+                r_pri[r] = head_pri[ci]
+                r_ts[r] = head_ts[ci]
+                r_fr[r, s] = w.group_flavors[ci, g, f] * S + s
+                r_req[r, s] = h_req[ci, s]
+            found_r, overflow_r, mask_r, _variant_r, borrow_r = \
                 self._classical_call(
-                    w, adm, pcfg, usage, cell_need,
-                    np.where(sim_slots, self._head_pri(wls, head_idx), 0),
-                    np.where(sim_slots, self._head_ts(wls, head_idx), 0.0),
-                    slot_fr, slot_req, v_cap=v_cap, derived=derived)
-            demote_cq |= overflow & cell_need
-            sim_out[(g, f, s)] = (found, mask, borrow_after)
+                    w, adm, pcfg, usage, r_need, r_pri, r_ts,
+                    r_fr, r_req, v_cap=v_cap, derived=derived,
+                    slot_cq=r_cq)
+            for r, (ci, cell) in enumerate(zip(row_ci, row_cell)):
+                f_arr, victims, b_arr = sim_out[cell]
+                if overflow_r[r]:
+                    demote_cq[ci] = True
+                if found_r[r]:
+                    f_arr[ci] = True
+                    victims[ci] = np.nonzero(mask_r[r])[0]
+                    b_arr[ci] = borrow_r[r]
 
         # Host-side fungibility fold (findFlavorForPodSets semantics)
         # on the device-computed granular modes.
@@ -474,9 +571,10 @@ class OracleBridge:
                         pm = int(g_pmode[ci, g, f, s])
                         br = int(g_borrow[ci, g, f, s])
                         if g_sim[ci, g, f, s]:
-                            found, mask, borrow_after = sim_out[(g, f, s)]
+                            found, victims, borrow_after = \
+                                sim_out[(g, f, s)]
                             if found[ci]:
-                                vs = np.nonzero(mask[ci])[0]
+                                vs = victims[ci]
                                 same = any(adm.cq[v] == ci for v in vs)
                                 pm = int(PMode.PREEMPT if same
                                          else PMode.RECLAIM)
@@ -519,19 +617,29 @@ class OracleBridge:
         if pre_slots.size:
             need = np.zeros(C, bool)
             need[pre_slots] = True
-            slot_fr = np.where(
-                flavor_override >= 0,
-                flavor_override.astype(np.int64) * S
-                + np.arange(S)[None, :], -1).astype(np.int32)
-            slot_fr[~need] = -1
-            slot_req = np.where(need[:, None], h_req, 0)
-            found, overflow, mask, variant, borrow_after = \
-                self._classical_call(
-                    w, adm, pcfg, usage, need,
-                    np.where(sim_slots, self._head_pri(wls, head_idx), 0),
-                    np.where(sim_slots, self._head_ts(wls, head_idx), 0.0),
-                    slot_fr, slot_req, v_cap=v_cap, derived=derived)
-            demote_cq |= overflow & need
+            # Precheck-masked slots resolve to the kernel's found=False
+            # outcome without running it; skip the launch entirely when
+            # no slot could have candidates.
+            kernel_need = need & maybe
+            if kernel_need.any():
+                slot_fr = np.where(
+                    flavor_override >= 0,
+                    flavor_override.astype(np.int64) * S
+                    + np.arange(S)[None, :], -1).astype(np.int32)
+                slot_fr[~kernel_need] = -1
+                slot_req = np.where(kernel_need[:, None], h_req, 0)
+                found, overflow, mask, variant, borrow_after = \
+                    self._classical_call(
+                        w, adm, pcfg, usage, kernel_need,
+                        np.where(sim_slots, head_pri, 0),
+                        np.where(sim_slots, head_ts, 0.0),
+                        slot_fr, slot_req, v_cap=v_cap, derived=derived)
+                demote_cq |= overflow & kernel_need
+            else:
+                found = np.zeros(C, bool)
+                mask = np.zeros((C, 0), bool)
+                variant = np.zeros((C, 0), np.int32)
+                borrow_after = np.zeros(C, np.int32)
             V = v_cap
             R = max(w.num_flavors, 1) * max(S, 1)
             victim_row = np.full((C, V), -1, np.int32)
@@ -710,6 +818,17 @@ class OracleBridge:
             mf = np.zeros(C, bool)
         sim_cq = (mf & ~w.no_preemption & has_head & head_eligible
                   & flavor_safe & cq_on_device)
+        if sim_cq.any():
+            # The sim grid (flavor_grid + per-cell preemption sims) is
+            # single-podset; multi-podset heads needing it go host.
+            multi_ps = np.zeros(C, bool)
+            for ci in np.nonzero(sim_cq)[0]:
+                if len(pending_infos[head_wid[ci]].total_requests) > 1:
+                    multi_ps[ci] = True
+            if multi_ps.any():
+                demote(multi_ps, "sim-multi-podset")
+                cq_on_device = ~host_root[root_of_cq]
+                sim_cq = sim_cq & cq_on_device
         pre = None
         pcfg = adm = admitted = None
         if sim_cq.any():
@@ -809,7 +928,7 @@ class OracleBridge:
                 pcfg = self._cq_policy_cfg(w)
             if adm is None:
                 admitted, adm = self._encode_admitted(w)
-            ap = self._adm_padded(adm)
+            ap = self._adm_padded(adm, w)
             pre_kwargs.update(
                 adm_cq=ap["adm_cq"], adm_pri=ap["adm_pri"],
                 adm_ts=ap["adm_ts"], adm_qrt=ap["adm_qrt"],
@@ -820,7 +939,11 @@ class OracleBridge:
                 pc_bwc_forbidden=pcfg["bwc_forbidden"],
                 pc_bwc_threshold=pcfg["bwc_threshold"],
                 pc_cq_has_parent=pcfg["cq_has_parent"],
-                root_of_cq=jnp.asarray(w.root_of_cq))
+                root_of_cq=jnp.asarray(w.root_of_cq),
+                adm_rank=ap["adm_rank"],
+                adm_by_root=ap["adm_by_root"],
+                slot_maybe=jnp.asarray(self._slot_maybe(
+                    w, pcfg, adm, self._head_pri(wl, head_wid))))
         _t_encode = _time.perf_counter()
         out = self.executor.cycle_step(
             dict(pending=pending, inadmissible=inadmissible, usage=usage,
@@ -1049,7 +1172,10 @@ class OracleBridge:
         picks produce identical Assignment structures, and the bulk-admit
         path never mutates them — one immutable instance serves every
         equivalent admission (the per-entry construction was the largest
-        single apply-phase cost at 1k admissions/cycle)."""
+        single apply-phase cost at 1k admissions/cycle).
+
+        ``flavor_of_res[ci]`` is [P, S]: one PodSetAssignment per real
+        pod set (flavorassigner.go:707 builds one per podset)."""
         ci = int(wls.cq[i])
         # Content-addressed key: the scheduling-equivalence hash TUPLE
         # (dense hash ids are recycled and must not key a cache) plus the
@@ -1065,21 +1191,22 @@ class OracleBridge:
         cached = cache[1].get(key)
         if cached is not None:
             return Entry(info=info, assignment=cached)
-        psr = info.total_requests[0]
-        flavors = {}
+        pod_sets = []
         usage: dict[FlavorResource, int] = {}
-        for s_i, res in enumerate(w.resource_names):
-            fl = flavor_of_res[ci, s_i]
-            if fl < 0 or wls.requests[i, s_i] <= 0:
-                continue
-            name = w.flavor_names[fl]
-            flavors[res] = FlavorAssignment(name=name, mode=Mode.FIT)
-            fr = FlavorResource(name, res)
-            usage[fr] = usage.get(fr, 0) + int(wls.requests[i, s_i])
-        psa = PodSetAssignment(
-            name=psr.name, flavors=flavors,
-            requests=dict(psr.requests), count=psr.count)
-        assignment = Assignment(pod_sets=[psa], usage=usage)
+        for p, psr in enumerate(info.total_requests):
+            flavors = {}
+            for s_i, res in enumerate(w.resource_names):
+                fl = flavor_of_res[ci, p, s_i]
+                if fl < 0 or wls.requests[i, p, s_i] <= 0:
+                    continue
+                name = w.flavor_names[fl]
+                flavors[res] = FlavorAssignment(name=name, mode=Mode.FIT)
+                fr = FlavorResource(name, res)
+                usage[fr] = usage.get(fr, 0) + int(wls.requests[i, p, s_i])
+            pod_sets.append(PodSetAssignment(
+                name=psr.name, flavors=flavors,
+                requests=dict(psr.requests), count=psr.count))
+        assignment = Assignment(pod_sets=pod_sets, usage=usage)
         if key[0] is not None:
             cache[1][key] = assignment
         return Entry(info=info, assignment=assignment)

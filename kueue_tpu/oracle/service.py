@@ -76,8 +76,10 @@ def _run_classical_targets(tensors: dict, statics: dict, derived=None):
         t["adm_uid"], t["adm_ev"], t["adm_usage"], derived["usage"],
         derived["subtree_quota"], t["lend_limit"], t["borrow_limit"],
         t["nominal"], t["ancestors"], t["height"], t["local_chain"],
-        t["root_nodes"], t["root_of_cq"], depth=statics["depth"],
-        v_cap=statics["v_cap"])
+        t["root_nodes"], t["root_of_cq"],
+        slot_cq=t.get("slot_cq"), adm_rank=t.get("adm_rank"),
+        adm_by_root=t.get("adm_by_root"),
+        depth=statics["depth"], v_cap=statics["v_cap"])
     return [np.asarray(o) for o in out]
 
 

@@ -43,6 +43,7 @@ def _shardings(mesh: Mesh):
     return dict(
         wl=NamedSharding(mesh, P(WL_AXIS)),
         wl2=NamedSharding(mesh, P(WL_AXIS, None)),
+        wl3=NamedSharding(mesh, P(WL_AXIS, None, None)),
         r=NamedSharding(mesh, P()),
         r2=NamedSharding(mesh, P(None, None)),
         r3=NamedSharding(mesh, P(None, None, None)),
@@ -55,7 +56,7 @@ def _shardings(mesh: Mesh):
 # parent, ancestors, height, group_of_res, group_flavors, no_preemption,
 # can_pwb, can_always_reclaim, best_effort, fung_borrow_try_next,
 # fung_pref_preempt_first, root_members, root_nodes, local_chain
-_PREFIX = ("wl", "wl", "r2", "wl", "wl", "wl", "wl2", "wl", "wl", "wl",
+_PREFIX = ("wl", "wl", "r2", "wl", "wl", "wl", "wl3", "wl", "wl", "wl",
            "r2", "r2", "r2", "r", "r2", "r", "r2", "r3", "r", "r", "r",
            "r", "r", "r", "r2", "r2", "r2")
 # wl_ts, fair_weight, child_rank, local_depth, root_parent_local
@@ -75,7 +76,7 @@ def sharded_cycle_step(mesh: Mesh, depth: int, num_resources: int,
     # preemption tensors are not provided, as here).
     out_shardings = (
         sh["wl"], sh["wl"], sh["r2"], sh["wl"], sh["r"], sh["r"],
-        sh["r2"], sh["r"], sh["r"], sh["r"], sh["r"], sh["r"],
+        sh["r3"], sh["r"], sh["r"], sh["r"], sh["r"], sh["r"],
         sh["r2"], sh["r2"])
 
     def fn(pending, inadmissible, usage, rank, commit_rank, wl_cq,
@@ -114,7 +115,7 @@ def sharded_drain_loop(mesh: Mesh, depth: int, num_resources: int,
     sh = _shardings(mesh)
     names = list(_PREFIX) + ["r"] + list(_TAIL)
     in_shardings = tuple(sh[n] for n in names)
-    out_shardings = (sh["wl"], sh["wl"], sh["wl2"], sh["r2"], sh["r"],
+    out_shardings = (sh["wl"], sh["wl"], sh["wl3"], sh["r2"], sh["r"],
                      sh["r"])
 
     def fn(pending, inadmissible, usage, rank, commit_rank, wl_cq,

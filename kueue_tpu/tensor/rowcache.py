@@ -103,7 +103,9 @@ class WorkloadRowCache:
         # bound signature matches)
         self._signature = None
         self.cq = np.full(self._cap, -1, np.int32)
-        self.requests = np.zeros((self._cap, 1), np.int64)
+        # [cap, P, S]: podset axis grows on demand (pow2, capped by
+        # schema.MAX_FAST_PODSETS; larger workloads are ineligible).
+        self.requests = np.zeros((self._cap, 1, 1), np.int64)
         self.eligible = np.zeros(self._cap, bool)
         self.hash_id = np.zeros(self._cap, np.int32)
 
@@ -112,11 +114,26 @@ class WorkloadRowCache:
     def on_push(self, info: WorkloadInfo, sort_key: tuple) -> None:
         """Workload entered (or re-entered) a pending heap."""
         i = self._row_of.get(info.key)
+        wl = info.obj
         if i is None:
             i = self._alloc()
             self._row_of[info.key] = i
+            fresh = True
+        else:
+            # Re-push of the SAME info (requeue after eviction / NoFit):
+            # the world-dependent fields are functions of the info's
+            # immutable pod-set shape plus the mutable hash prefix
+            # checked here (scheduling_hash elements 1-4) — when neither
+            # changed, skip the dirty re-encode. Churn worlds requeue
+            # thousands of rows per cycle.
+            h = self._hash_tuple[i]
+            fresh = (self.info_of[i] is not info or h is None
+                     or h[1] != wl.priority
+                     or h[2] != wl.allowed_resource_flavor
+                     or h[3] != wl.has_closed_preemption_gate()
+                     or h[4] != tuple(sorted(
+                         wl.status.reclaimable_pods.items())))
         self.info_of[i] = info
-        wl = info.obj
         from kueue_tpu.workload_info import queue_order_timestamp
         self.priority[i] = wl.effective_priority
         # FIFO timestamp is the eviction-aware queue-order timestamp so
@@ -130,7 +147,8 @@ class WorkloadRowCache:
         self.key_ts[i] = kts
         self.key_seq[i] = kseq
         self.active[i] = True
-        self._dirty.add(i)
+        if fresh:
+            self._dirty.add(i)
 
     def on_park(self, info: WorkloadInfo) -> None:
         """Workload moved to the inadmissible side map (row kept: a
@@ -186,7 +204,7 @@ class WorkloadRowCache:
             grown = np.full(new_cap, fill, arr.dtype)
             grown[:old] = arr
             setattr(self, name, grown)
-        reqs = np.zeros((new_cap, self.requests.shape[1]), np.int64)
+        reqs = np.zeros((new_cap,) + self.requests.shape[1:], np.int64)
         reqs[:old] = self.requests
         self.requests = reqs
         self.info_of.extend([None] * (new_cap - old))
@@ -213,7 +231,7 @@ class WorkloadRowCache:
             if keep:
                 grown[:used] = arr[keep]
             setattr(self, name, grown)
-        reqs = np.zeros((new_cap, self.requests.shape[1]), np.int64)
+        reqs = np.zeros((new_cap,) + self.requests.shape[1:], np.int64)
         if keep:
             reqs[:used] = self.requests[keep]
         self.requests = reqs
@@ -252,8 +270,9 @@ class WorkloadRowCache:
             return
         self._signature = sig
         S = max(world.num_resources, 1)
-        if S != self.requests.shape[1]:
-            self.requests = np.zeros((self._cap, S), np.int64)
+        if S != self.requests.shape[2]:
+            self.requests = np.zeros(
+                (self._cap, self.requests.shape[1], S), np.int64)
         self._dirty.update(self._row_of.values())
 
     def _encode_row(self, i: int, world, cq_idx: dict,
@@ -273,22 +292,26 @@ class WorkloadRowCache:
             self._hash_tuple[i] = h
         ci = cq_idx.get(info.cluster_queue, -1)
         self.cq[i] = ci
-        self.requests[i, :] = 0
-        from kueue_tpu.tensor.schema import dense_path_eligible
+        self.requests[i] = 0
+        from kueue_tpu.tensor.schema import (
+            dense_path_eligible,
+            pow2_bucket,
+        )
         eligible = ci >= 0 and dense_path_eligible(info)
         if eligible:
-            psr = info.total_requests[0]
-            reqs = dict(psr.requests)
-            si = s_idx.get("pods")
-            if si is not None and world.group_of_res[ci, si] >= 0:
-                reqs["pods"] = psr.count
-            for res, q in reqs.items():
-                si = s_idx.get(res)
-                if si is None:
-                    if q > 0:
-                        eligible = False
-                    continue
-                self.requests[i, si] = q
+            n_ps = len(info.total_requests)
+            if n_ps > self.requests.shape[1]:
+                # Grow the podset axis (pow2-bucketed so recurring worlds
+                # reuse one compiled program per bucket).
+                newP = pow2_bucket(n_ps, 1)
+                reqs = np.zeros((self._cap, newP,
+                                 self.requests.shape[2]), np.int64)
+                reqs[:, :self.requests.shape[1]] = self.requests
+                self.requests = reqs
+            from kueue_tpu.tensor.schema import encode_podset_requests
+            if not encode_podset_requests(info, ci, world, s_idx,
+                                          self.requests[i]):
+                eligible = False
         self.eligible[i] = eligible
 
     def flush(self, world) -> None:
@@ -339,7 +362,8 @@ class WorkloadRowCache:
             num_workloads=self._cap, keys=[], cq=self.cq,
             priority=self.priority, timestamp=self.timestamp,
             requests=self.requests, has_quota_reservation=self.has_qr,
-            eligible=self.eligible, hash_id=self.hash_id)
+            eligible=self.eligible, hash_id=self.hash_id,
+            num_podsets=self.requests.shape[1])
 
     def head_ranks(self) -> np.ndarray:
         """Global rank by the stored heap sort keys — by construction the
@@ -357,3 +381,137 @@ class WorkloadRowCache:
         rank = np.empty(self._cap, np.int64)
         rank[order] = np.arange(self._cap)
         return rank
+
+
+class AdmittedRows:
+    """Incremental admitted-side tensors for the device preemption
+    kernels: the AdmittedTensors encode (tensor/schema.encode_admitted)
+    maintained as live rows updated from the scheduler cache's
+    admitted-change log (Cache.admitted_dirty) instead of re-encoded
+    O(A) every cycle — churn worlds change a handful of admitted rows
+    per cycle while A is thousands.
+
+    Holes (freed rows) keep cq=-1 / zero usage, so they can never
+    classify as preemption candidates; `info_of` is aligned with rows
+    for victim-id mapping. The uid rank (CandidatesOrdering tiebreak,
+    common/ordering.go:42) is recomputed vectorized over a fixed-width
+    string array whenever any row changed."""
+
+    MIN_CAPACITY = 64
+    _HOLE_UID = "￿"  # sorts above every real uid
+
+    def __init__(self, world) -> None:
+        self.signature = (WorkloadRowCache.world_signature(world),
+                          tuple(world.flavor_names))
+        self._cq_idx = {n: i for i, n in enumerate(world.cq_names)}
+        self._fl_idx = {n: i for i, n in enumerate(world.flavor_names)}
+        self._s_idx = {n: i for i, n in enumerate(world.resource_names)}
+        self._S = world.num_resources
+        self._R = max(world.num_flavors * world.num_resources, 1)
+        self._cap = self.MIN_CAPACITY
+        self._row_of: dict[str, int] = {}
+        self._free = list(range(self._cap - 1, -1, -1))
+        self.info_of: list = [None] * self._cap
+        self.cq = np.full(self._cap, -1, np.int32)
+        self.priority = np.zeros(self._cap, np.int64)
+        self.timestamp = np.zeros(self._cap, np.float64)
+        self.qr_time = np.zeros(self._cap, np.float64)
+        self.evicted = np.zeros(self._cap, bool)
+        self.usage = np.zeros((self._cap, self._R), np.int64)
+        self._uids = np.full(self._cap, self._HOLE_UID, dtype="U96")
+        self._built = False
+        self._epoch = 0
+        self._tensors = None
+
+    def _grow(self, new_cap: int) -> None:
+        old = self._cap
+        self._cap = new_cap
+        for name, fill in (("cq", -1), ("priority", 0), ("timestamp", 0),
+                           ("qr_time", 0), ("evicted", False)):
+            arr = getattr(self, name)
+            grown = np.full(new_cap, fill, arr.dtype)
+            grown[:old] = arr
+            setattr(self, name, grown)
+        usage = np.zeros((new_cap, self._R), np.int64)
+        usage[:old] = self.usage
+        self.usage = usage
+        uids = np.full(new_cap, self._HOLE_UID, dtype="U96")
+        uids[:old] = self._uids
+        self._uids = uids
+        self.info_of.extend([None] * (new_cap - old))
+        self._free.extend(range(new_cap - 1, old - 1, -1))
+
+    def _encode(self, i: int, info, now: float) -> None:
+        wl = info.obj
+        self.info_of[i] = info
+        self.cq[i] = self._cq_idx.get(info.cluster_queue, -1)
+        self.priority[i] = wl.effective_priority
+        self.timestamp[i] = wl.creation_time
+        self.qr_time[i] = wl.quota_reservation_time(now)
+        self.evicted[i] = wl.is_evicted
+        self._uids[i] = wl.uid
+        row = self.usage[i]
+        row[:] = 0
+        S = self._S
+        for fr, v in info.usage().items():
+            fi = self._fl_idx.get(fr.flavor)
+            si = self._s_idx.get(fr.resource)
+            if fi is not None and si is not None:
+                row[fi * S + si] = v
+
+    def sync(self, cache, now: float):
+        """Apply the cache's admitted-change log; returns the (possibly
+        unchanged — identity matters, downstream pads are memoized on
+        it) AdmittedTensors view."""
+        from kueue_tpu.tensor.schema import AdmittedTensors
+
+        epoch = getattr(cache, "admitted_dirty_epoch", 0)
+        if not self._built or epoch != self._epoch:
+            # First build, or the cache capped/dropped its change log:
+            # full resync (stale rows freed below via the key union).
+            dirty = set(cache.workloads.keys())
+            dirty.update(self._row_of.keys())
+            dirty.update(cache.admitted_dirty)
+            self._built = True
+            self._epoch = epoch
+        elif cache.admitted_dirty:
+            dirty = set(cache.admitted_dirty)
+        else:
+            dirty = None
+        cache.admitted_dirty.clear()
+        if dirty is None and self._tensors is not None:
+            return self._tensors
+        if dirty:
+            for key in dirty:
+                info = cache.workloads.get(key)
+                i = self._row_of.get(key)
+                if info is None:
+                    if i is not None:
+                        del self._row_of[key]
+                        self.info_of[i] = None
+                        self.cq[i] = -1
+                        self.usage[i] = 0
+                        self.evicted[i] = False
+                        self._uids[i] = self._HOLE_UID
+                        self._free.append(i)
+                    continue
+                if i is None:
+                    if not self._free:
+                        self._grow(self._cap * 2)
+                    i = self._free.pop()
+                    self._row_of[key] = i
+                self._encode(i, info, now)
+        uid_rank = np.empty(self._cap, np.int64)
+        uid_rank[np.argsort(self._uids, kind="stable")] = \
+            np.arange(self._cap)
+        self._tensors = AdmittedTensors(
+            num_admitted=self._cap, keys=[], cq=self.cq,
+            priority=self.priority, timestamp=self.timestamp,
+            qr_time=self.qr_time, uid_rank=uid_rank,
+            evicted=self.evicted, usage=self.usage,
+            live=len(self._row_of))
+        return self._tensors
+
+    @property
+    def live(self) -> int:
+        return len(self._row_of)

@@ -108,19 +108,28 @@ class WorldTensors:
 
 @dataclass
 class WorkloadTensors:
-    """Pending workloads (single-podset fast path)."""
+    """Pending workloads on the fast path. The pod-set axis is padded to
+    ``num_podsets`` (P, a power of two ≤ MAX_FAST_PODSETS); padding rows
+    carry zero requests and never affect nomination or commit."""
 
     num_workloads: int
     keys: list  # host-side workload keys, aligned with rows
     cq: np.ndarray  # int32[W] CQ index
     priority: np.ndarray  # int64[W] effective priority
     timestamp: np.ndarray  # float64[W] queue-order timestamp
-    requests: np.ndarray  # int64[W, S] count-scaled totals
+    requests: np.ndarray  # int64[W, P, S] count-scaled totals per podset
     has_quota_reservation: np.ndarray  # bool[W]
     eligible: np.ndarray  # bool[W] — encodable on the fast path
     # Scheduling-equivalence hash id (workload.go:236 SchedulingHash),
     # dense-coded: equal ids => identical admission verdicts.
     hash_id: np.ndarray = None  # int32[W]
+    num_podsets: int = 1  # P
+
+
+# Pod-set cap for the dense path: the kernel scans podsets sequentially
+# (flavorassigner.go:707 walks podsets in order), so the pad is a compile
+# -time constant; workloads beyond it take the host path.
+MAX_FAST_PODSETS = 8
 
 
 def pow2_bucket(n: int, floor: int) -> int:
@@ -379,7 +388,8 @@ def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
 class AdmittedTensors:
     """Admitted workloads (preemption candidate pool)."""
 
-    num_admitted: int
+    num_admitted: int  # ROW-SPACE size (== array length; the
+    #   incremental AdmittedRows keeps holes, so this can exceed `live`)
     keys: list  # host-side workload keys, aligned with rows
     cq: np.ndarray  # int32[A]
     priority: np.ndarray  # int64[A]
@@ -388,6 +398,7 @@ class AdmittedTensors:
     uid_rank: np.ndarray  # int64[A] rank of uid (CandidatesOrdering tiebreak)
     evicted: np.ndarray  # bool[A]
     usage: np.ndarray  # int64[A, R] on the flavor-resource grid
+    live: int = None  # live admitted count (None = num_admitted)
 
 
 def encode_admitted(world: WorldTensors, infos: list,
@@ -427,30 +438,61 @@ def encode_admitted(world: WorldTensors, infos: list,
         evicted=evicted, usage=usage)
 
 
+def encode_podset_requests(info, ci: int, world, s_idx: dict,
+                           out) -> bool:
+    """Fill one workload's [P, S] request rows (implicit pods resource
+    when the CQ covers it). Returns False when a positive request names
+    a resource outside the world's column space (host-path-only).
+    Shared by the batch encoder and the incremental row cache so the
+    two can never desynchronize."""
+    pods_si = s_idx.get("pods")
+    covers_pods = (pods_si is not None
+                   and world.group_of_res[ci, pods_si] >= 0)
+    ok = True
+    for p, psr in enumerate(info.total_requests):
+        reqs = dict(psr.requests)
+        if covers_pods:
+            reqs["pods"] = psr.count
+        for res, q in reqs.items():
+            si = s_idx.get(res)
+            if si is None:
+                if q > 0:
+                    ok = False
+                continue
+            out[p, si] = q
+    return ok
+
+
 def dense_path_eligible(info) -> bool:
     """Whether a pending workload can be decided on the dense device
     path. Shared by the batch encoder below and the incremental row
     cache (tensor/rowcache.py) so the two can never desynchronize.
 
-    Ineligible: multi-podset, partial admission (min_count), topology
-    requests, node selectors/affinity, tolerations, and explicit
-    zero-quantity requests (Go assigns flavors/borrow levels to those;
-    the dense encoding cannot distinguish explicit-zero from absent)."""
-    if len(info.total_requests) != 1:
+    The kernel handles up to MAX_FAST_PODSETS pod sets per workload
+    (flavorassigner.go:707/932 walks podsets in order; the kernel scans
+    the padded podset axis with within-workload usage accumulation).
+    Ineligible: more podsets than the cap, partial admission
+    (min_count), topology requests, node selectors/affinity,
+    tolerations, and explicit zero-quantity requests (Go assigns
+    flavors/borrow levels to those; the dense encoding cannot
+    distinguish explicit-zero from absent)."""
+    if len(info.total_requests) > MAX_FAST_PODSETS:
         return False
-    ps = info.obj.pod_sets[0]
-    if (ps.min_count is not None or ps.topology_request is not None
-            or ps.node_selector or ps.node_affinity or ps.tolerations):
-        return False
-    if any(q == 0 for q in info.total_requests[0].requests.values()):
-        return False
+    for p, psr in enumerate(info.total_requests):
+        ps = info.obj.pod_sets[p]
+        if (ps.min_count is not None or ps.topology_request is not None
+                or ps.node_selector or ps.node_affinity or ps.tolerations):
+            return False
+        if any(q == 0 for q in psr.requests.values()):
+            return False
     return True
 
 
 def encode_workloads(world: WorldTensors,
                      infos: list[WorkloadInfo]) -> WorkloadTensors:
-    """Encode pending workloads. Multi-podset workloads are marked
-    ineligible for the fast path (host fallback handles them)."""
+    """Encode pending workloads. Workloads beyond the fast-path shape
+    (dense_path_eligible) are marked ineligible; the host fallback
+    handles them."""
     W = len(infos)
     S = world.num_resources
     cq_idx = {n: i for i, n in enumerate(world.cq_names)}
@@ -459,7 +501,6 @@ def encode_workloads(world: WorldTensors,
     cq = np.full(W, -1, np.int32)
     priority = np.zeros(W, np.int64)
     timestamp = np.zeros(W, np.float64)
-    requests = np.zeros((W, S), np.int64)
     has_qr = np.zeros(W, bool)
     eligible = np.ones(W, bool)
     hash_id = np.zeros(W, np.int32)
@@ -467,6 +508,15 @@ def encode_workloads(world: WorldTensors,
     keys = []
     from kueue_tpu.cache.queues import scheduling_hash
     from kueue_tpu.workload_info import queue_order_timestamp
+
+    P = 1
+    for info in infos:
+        n = len(info.total_requests)
+        if 1 < n and dense_path_eligible(info):
+            P = max(P, n)
+    P = pow2_bucket(P, 1)
+    requests = np.zeros((W, P, S), np.int64)
+
     for i, info in enumerate(infos):
         keys.append(info.key)
         h = scheduling_hash(info.obj, info.cluster_queue)
@@ -480,18 +530,11 @@ def encode_workloads(world: WorldTensors,
         if cq[i] < 0 or not dense_path_eligible(info):
             eligible[i] = False
             continue
-        psr = info.total_requests[0]
-        # Implicit pods resource when the CQ covers it.
-        reqs = dict(psr.requests)
-        if "pods" in s_idx and world.group_of_res[cq[i], s_idx["pods"]] >= 0:
-            reqs["pods"] = psr.count
-        for res, q in reqs.items():
-            if res not in s_idx:
-                if q > 0:
-                    eligible[i] = False
-                continue
-            requests[i, s_idx[res]] = q
+        if not encode_podset_requests(info, int(cq[i]), world, s_idx,
+                                      requests[i]):
+            eligible[i] = False
     return WorkloadTensors(
         num_workloads=W, keys=keys, cq=cq, priority=priority,
         timestamp=timestamp, requests=requests,
-        has_quota_reservation=has_qr, eligible=eligible, hash_id=hash_id)
+        has_quota_reservation=has_qr, eligible=eligible, hash_id=hash_id,
+        num_podsets=P)

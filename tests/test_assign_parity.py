@@ -77,16 +77,22 @@ def random_world(rng, n_cohorts=3, n_cqs=6, admitted=8):
     return build_snapshot(cqs, cohorts, flavors, infos)
 
 
-def pending_workloads(rng, snap, n=40):
+def pending_workloads(rng, snap, n=40, multi_podset=False):
     out = []
     cq_names = list(snap.cluster_queues)
     for i in range(n):
-        # 0 means "resource not requested" — absence, not an explicit
-        # zero request (explicit zeros are host-path-only; see schema.py).
-        reqs = {r: q for r in RESOURCES
-                if (q := rng.choice([0, 100, 600, 1200, 3000, 9000]))}
+        n_ps = rng.choice([1, 1, 2, 3]) if multi_podset else 1
+        pod_sets = []
+        for p in range(n_ps):
+            # 0 means "resource not requested" — absence, not an explicit
+            # zero request (explicit zeros are host-path-only; schema.py).
+            reqs = {r: q for r in RESOURCES
+                    if (q := rng.choice([0, 100, 600, 1200, 3000, 9000]))}
+            if not reqs:
+                reqs = {"cpu": 100}
+            pod_sets.append(PodSet(f"ps{p}", 1, reqs))
         w = Workload(name=f"p{i}", creation_time=100.0 + i,
-                     pod_sets=(PodSet("main", 1, reqs),))
+                     pod_sets=tuple(pod_sets))
         out.append(WorkloadInfo.from_workload(w, rng.choice(cq_names)))
     return out
 
@@ -132,8 +138,56 @@ def test_batched_assignment_matches_sequential(seed):
                        for r, fa in seq.pod_sets[0].flavors.items()}
         for s_i, res in enumerate(world.resource_names):
             want = seq_flavors.get(res)
-            got = (world.flavor_names[flavor_of_res[i, s_i]]
-                   if flavor_of_res[i, s_i] >= 0 else None)
+            got = (world.flavor_names[flavor_of_res[i, 0, s_i]]
+                   if flavor_of_res[i, 0, s_i] >= 0 else None)
             if info.total_requests[0].requests.get(res, 0) == 0:
                 continue
             assert got == want, (ctx, res, got, want)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_multi_podset_assignment_matches_sequential(seed):
+    """Per-podset flavor choices with within-workload usage accumulation
+    (flavorassigner.go:707 + :1015 assumedUsage) vs the sequential
+    assigner on random no-preemption worlds."""
+    rng = random.Random(1000 + seed)
+    snap = random_world(rng)
+    pend = pending_workloads(rng, snap, multi_podset=True)
+
+    world = encode_snapshot(snap)
+    wls = encode_workloads(world, pend)
+    assert wls.requests.shape[1] > 1  # multi-podset rows present
+    derived = qops.derive_world(
+        world.nominal, world.lend_limit, world.borrow_limit, world.usage,
+        world.parent, depth=world.depth)
+    flavor_of_res, pmode, borrows, needs_oracle, _usage_fr = jax.tree.map(
+        np.asarray,
+        aops.assign_flavors(
+            wls.cq, wls.requests, derived, world.nominal, world.ancestors,
+            world.height, world.group_of_res, world.group_flavors,
+            world.no_preemption, world.can_preempt_while_borrowing,
+            world.fung_borrow_try_next, world.fung_pref_preempt_first,
+            depth=world.depth, num_resources=world.num_resources))
+
+    for i, info in enumerate(pend):
+        assert wls.eligible[i]
+        assert not needs_oracle[i]
+        cqs = snap.cluster_queue(info.cluster_queue)
+        seq = FlavorAssigner(info, cqs, snap.resource_flavors).assign()
+        seq_mode = seq.representative_mode()
+        got_mode = PMODE_TO_MODE[pmode[i]]
+        ctx = (seed, i, info.cluster_queue, len(info.total_requests))
+        assert got_mode == seq_mode, (ctx, got_mode, seq_mode)
+        if seq_mode == Mode.NO_FIT:
+            continue
+        assert borrows[i] == seq.borrowing, (ctx, borrows[i], seq.borrowing)
+        for p, psr in enumerate(info.total_requests):
+            seq_flavors = {r: fa.name
+                           for r, fa in seq.pod_sets[p].flavors.items()}
+            for s_i, res in enumerate(world.resource_names):
+                if psr.requests.get(res, 0) == 0:
+                    continue
+                want = seq_flavors.get(res)
+                got = (world.flavor_names[flavor_of_res[i, p, s_i]]
+                       if flavor_of_res[i, p, s_i] >= 0 else None)
+                assert got == want, (ctx, p, res, got, want)

@@ -382,3 +382,53 @@ def test_afs_stale_heap_keys_device_parity():
     bat_eng, bat_order = build(True)
     assert bat_order == seq_order
     assert bat_eng.oracle.cycles_on_device > 0
+
+
+def test_multi_podset_heads_stay_on_device():
+    """Multi-podset workloads are fast-path eligible (round 4): the
+    device kernel scans pod sets with within-workload usage accumulation
+    (flavorassigner.go:707,:1015), so a multi-podset head must neither
+    demote its root nor diverge from the sequential engine."""
+    seq = make_engine(oracle=False)
+    bat = make_engine(oracle=True)
+
+    def populate(eng):
+        rng = random.Random(17)
+        wls = []
+        for i in range(60):
+            eng.clock += 0.1
+            kind = rng.random()
+            if kind < 0.3:
+                pod_sets = (PodSet("driver", 1, {"cpu": 100}),
+                            PodSet("workers", 2, {"cpu": 300}))
+            elif kind < 0.45:
+                pod_sets = (PodSet("a", 1, {"cpu": 200}),
+                            PodSet("b", 1, {"cpu": 500}),
+                            PodSet("c", 3, {"cpu": 100}))
+            else:
+                pod_sets = (PodSet("main", 1,
+                                   {"cpu": rng.choice([200, 700, 1500])}),)
+            wl = Workload(
+                name=f"w{i}", queue_name=f"lq{rng.randrange(6)}",
+                priority=rng.choice([0, 0, 10]),
+                pod_sets=pod_sets)
+            eng.submit(wl)
+            wls.append(wl)
+        return wls
+
+    seq_wls = populate(seq)
+    bat_wls = populate(bat)
+    drain(seq)
+    drain(bat)
+    assert outcomes(seq_wls) == outcomes(bat_wls)
+    assert bat.oracle.cycles_on_device > 0
+    # No demotions: every multi-podset head was decided on device.
+    assert bat.oracle.host_root_reasons.get("head-ineligible", 0) == 0
+    assert bat.oracle.cycles_hybrid == 0
+    # Multi-podset admissions carry one PodSetAssignment per pod set.
+    multi = [w for w in bat_wls
+             if len(w.pod_sets) > 1 and w.is_admitted]
+    assert multi, "expected admitted multi-podset workloads"
+    for w in multi:
+        assert len(w.status.admission.pod_set_assignments) == \
+            len(w.pod_sets)

@@ -74,9 +74,9 @@ def test_classical_drain_parity_10k(mesh):
         key = solver.wls.keys[row]
         cyc, pos, flavors = key_to_cycle_pos[key]
         assert (int(ac[row]), int(ap[row])) == (cyc, pos)
-        got = {w.resource_names[s]: w.flavor_names[fl[row, s]]
+        got = {w.resource_names[s]: w.flavor_names[fl[row, 0, s]]
                for s in range(w.num_resources)
-               if fl[row, s] >= 0 and solver.wls.requests[row, s] > 0}
+               if fl[row, 0, s] >= 0 and solver.wls.requests[row, 0, s] > 0}
         assert got == flavors
 
 
