@@ -48,13 +48,22 @@ REF_TAS_ADM_S = 37.4        # 15k TAS workloads / ~401.5 s
 CYCLE_TARGET_S = 0.5
 
 
-def tpu_available(timeout_s: int = 90) -> bool:
-    try:
-        r = subprocess.run([sys.executable, "-c", PROBE],
-                           capture_output=True, timeout=timeout_s)
-        return b"ok" in r.stdout
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+def tpu_available(timeout_s: int = 90, attempts: int = 3,
+                  backoff_s: float = 20.0) -> bool:
+    """Bounded multi-retry probe: a transient tunnel hiccup recovers,
+    a sick tunnel (enumerates devices but hangs on compute) fails all
+    attempts and the bench provably runs on CPU."""
+    for k in range(attempts):
+        try:
+            r = subprocess.run([sys.executable, "-c", PROBE],
+                               capture_output=True, timeout=timeout_s)
+            if b"ok" in r.stdout:
+                return True
+        except (subprocess.TimeoutExpired, OSError):
+            pass
+        if k + 1 < attempts:
+            time.sleep(backoff_s)
+    return False
 
 
 def bench_throughput_flat(n_workloads, n_cohorts):
@@ -640,6 +649,19 @@ def main() -> None:
     run_scenario("tas", lambda: bench_tas(60 if fast else 800,
                                           n_cqs=4 if fast else 8))
 
+    # Compact per-scenario path labels for the trailer: the platform
+    # must be provable from the END of the line (the driver's capture
+    # keeps the tail; r03's platform sat only at the head and was
+    # truncated away).
+    paths = {}
+    for name, sc in scenarios.items():
+        d = sc.get("detail", {}) if isinstance(sc, dict) else {}
+        if "device_cycles" in d:
+            paths[name] = (f"dev{d['device_cycles']}"
+                           f"/fb{d.get('fallback_cycles', 0)}"
+                           f"/hy{d.get('hybrid_cycles', 0)}")
+        elif "tas_path" in d:
+            paths[name] = d["tas_path"]
     print(json.dumps({
         "metric": (
             f"batched admission throughput, {flat['detail']['workloads']}"
@@ -652,6 +674,15 @@ def main() -> None:
         "unit": "admissions/s",
         "vs_baseline": flat["vs_baseline"],
         "scenarios": scenarios,
+        # KEEP LAST: tail-proof platform stamp.
+        "platform_trailer": {
+            "platform": dev.platform,
+            "device": str(dev),
+            "probe": ("forced" if os.environ.get(
+                "KUEUE_TPU_BENCH_PLATFORM") else
+                ("tpu-ok" if platform != "cpu" else "tpu-probe-failed")),
+            "paths": paths,
+        },
     }))
 
 

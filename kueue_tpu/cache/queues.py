@@ -186,12 +186,17 @@ class PendingClusterQueue:
             self.in_flight = None
         if key in self.items or key in self.inadmissible:
             return False
-        immediate = reason not in (RequeueReason.NO_FIT,
-                                   RequeueReason.PREEMPTION_NO_CANDIDATES)
-        if (immediate
-                or self.spec.queueing_strategy
-                == QueueingStrategy.STRICT_FIFO):
-            # StrictFIFO blocks the queue on its head rather than parking it.
+        if self.spec.queueing_strategy == QueueingStrategy.STRICT_FIFO:
+            # StrictFIFO blocks the queue on its head rather than
+            # parking it — except namespace mismatch, which only a
+            # namespace/CQ change can cure (cluster_queue.go:919).
+            immediate = reason != RequeueReason.NAMESPACE_MISMATCH
+        else:
+            immediate = reason not in (
+                RequeueReason.NO_FIT,
+                RequeueReason.PREEMPTION_NO_CANDIDATES,
+                RequeueReason.NAMESPACE_MISMATCH)
+        if immediate:
             self.push_or_update(info)
         else:
             self.inadmissible[key] = info

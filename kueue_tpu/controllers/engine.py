@@ -148,6 +148,9 @@ class Engine:
         self.cycle = cycle or SchedulerCycle(
             enable_fair_sharing=enable_fair_sharing,
             workload_ordering=workload_ordering)
+        # Bound lazily: namespace_labels is initialized further down.
+        self.cycle.namespace_labels_of = \
+            lambda ns: self.namespace_labels.get(ns)
         self.clock: float = 0.0
         self.events: list[EngineEvent] = []
         # Watch fan-out (client-go informer analog): called with each
@@ -467,7 +470,15 @@ class Engine:
 
     def set_namespace_labels(self, namespace: str,
                              labels: dict[str, str]) -> None:
+        """Namespace (re)labeled: workloads parked for a selector
+        mismatch can only be cured by this event, so requeue the
+        inadmissible sets of every selector-bearing CQ (the reference
+        requeues on Namespace update events)."""
         self.namespace_labels[namespace] = dict(labels)
+        sel_cqs = {n for n, cq in self.cache.cluster_queues.items()
+                   if cq.namespace_selector is not None}
+        if sel_cqs:
+            self.queues.queue_inadmissible_workloads(sel_cqs)
 
     def submit(self, wl: Workload) -> bool:
         if not wl.creation_time:
@@ -481,12 +492,13 @@ class Engine:
 
         wi.adjust_resources(wl, list(self.limit_ranges.values()),
                             self.runtime_class_overheads)
-        cq_name = self.queues.cluster_queue_for_workload(wl)
-        cq = self.cache.cluster_queues.get(cq_name) if cq_name else None
+        # Template/LimitRange admissibility only: the namespace-selector
+        # check runs at NOMINATION time (scheduler.go:636), so a
+        # mismatched workload still queues and parks inadmissible under
+        # its CQ (RequeueReasonNamespaceMismatch).
         err = wi.validate_admissibility(
             wl, list(self.limit_ranges.values()),
-            namespace_labels=self.namespace_labels.get(wl.namespace),
-            cq_namespace_selector=getattr(cq, "namespace_selector", None))
+            namespace_labels=self.namespace_labels.get(wl.namespace))
         if err is not None:
             # Deactivate so a journal restart can't resurrect it into the
             # queues (restore_workload requeues active pending workloads).
@@ -1084,7 +1096,7 @@ class Engine:
                     cqw = cache.cq_workloads[cq_name] = {}
                 cqw[key] = info
                 wl_usage[key] = (cq_name, usage)
-                cache.admitted_dirty.add(key)
+                cache.mark_admitted_dirty(key)
                 if tas_names:
                     tas = info.tas_domains(tas_names)
                     if tas:

@@ -207,6 +207,22 @@ class OracleBridge:
             self._dev_world_cache = cached
         return dict(cached[1])
 
+    def _cq_has_selector(self, w):
+        """bool[C] mask of CQs with a namespace selector, or None when
+        no CQ has one (the common case — skips per-head checks).
+        Memoized by spec version."""
+        cached = getattr(self, "_sel_cache", None)
+        ver = self.engine.cache.spec_version
+        if cached is None or cached[0] != ver:
+            mask = np.zeros(w.num_cqs, bool)
+            for ci, name in enumerate(w.cq_names):
+                if self.engine.cache.cluster_queues[name] \
+                        .namespace_selector is not None:
+                    mask[ci] = True
+            cached = (ver, mask if mask.any() else None)
+            self._sel_cache = cached
+        return cached[1]
+
     def _cq_flavor_safe(self, w) -> np.ndarray:
         """bool[C]: none of the CQ's flavors carries taints or a topology
         (those route through the host flavorassigner/TAS path)."""
@@ -353,8 +369,15 @@ class OracleBridge:
         Cross-CQ reclaim is never prechecked (conservatively maybe);
         within-CQ policies are checked against per-CQ admitted priority
         minima. Most converged-world cycles have zero maybe-slots, which
-        lets the kernels skip preemption target selection entirely."""
+        lets the kernels skip preemption target selection entirely.
+        Memoized per (adm, pcfg, heads) — the sim-nomination and fused
+        setup both need it within one cycle."""
         from kueue_tpu.ops import preempt as pops
+
+        memo = getattr(self, "_maybe_memo", None)
+        if (memo is not None and memo[0] is adm and memo[1] is pcfg
+                and np.array_equal(memo[2], head_pri)):
+            return memo[3]
 
         C = w.num_cqs
         maybe = ((pcfg["reclaim_policy"] != pops.POLICY_NEVER)
@@ -375,6 +398,7 @@ class OracleBridge:
                          np.where(wcq == pops.POLICY_LOWER_OR_NEWER_EQ,
                                   minpri <= head_pri, False)))
             maybe = maybe | within
+        self._maybe_memo = (adm, pcfg, np.array(head_pri), maybe)
         return maybe
 
     def _classical_call(self, w, adm, pcfg, usage, slot_need, slot_pri,
@@ -783,6 +807,22 @@ class OracleBridge:
         head_eligible[has_head] = wl.eligible[head_wid[has_head]]
         flavor_safe = self._cq_flavor_safe(w)
 
+        # Namespace-selector CQs: a mismatched head parks as
+        # inadmissible at nomination (scheduler.go:636); the host path
+        # owns that bookkeeping, so those heads' roots demote. Checked
+        # only for the (rare) CQs that carry a selector.
+        ns_mismatch = np.zeros(C, bool)
+        sel_cqs = self._cq_has_selector(w)
+        if sel_cqs is not None:
+            from kueue_tpu.workload_info import namespace_selector_mismatch
+            for ci in np.nonzero(has_head & sel_cqs)[0]:
+                if namespace_selector_mismatch(
+                        eng.cache.cluster_queues[w.cq_names[ci]]
+                        .namespace_selector,
+                        eng.namespace_labels.get(
+                            pending_infos[head_wid[ci]].obj.namespace)):
+                    ns_mismatch[ci] = True
+
         root_of_cq = w.root_of_cq
         host_root = np.zeros(Rn, bool)
 
@@ -797,6 +837,7 @@ class OracleBridge:
 
         demote(has_head & ~head_eligible, "head-ineligible")
         demote(has_head & ~flavor_safe, "flavor-unsafe")
+        demote(ns_mismatch, "namespace-mismatch")
         # Closed preemption gates (orchestrated preemption /
         # ConcurrentAdmission): the gate semantics — block preemption,
         # raise BlockedOnPreemptionGates — live in the host path
@@ -963,12 +1004,18 @@ class OracleBridge:
             # Host-side Target lists for the preempting slots, from the
             # in-program victim selection.
             sp = np.asarray(slot_preempting)
-            if sp.any():
-                vmask = np.asarray(victim_mask)
+            vmask = np.asarray(victim_mask)
+            # Slots with a selected victim set: committed ones become
+            # PREEMPTING entries; uncommitted ones (capacity claimed by
+            # an earlier entry) are the reference's skipped preemptions
+            # and are counted by _apply.
+            found_any = (vmask.any(axis=1) if vmask.size
+                         else np.zeros(C, bool))
+            if (sp | found_any).any():
                 vvar = np.asarray(victim_variant)
                 variant_reason = self._variant_reason()
                 from kueue_tpu.scheduler.preemption import IN_CLUSTER_QUEUE
-                for ci in np.nonzero(sp & cq_on_device)[0]:
+                for ci in np.nonzero((sp | found_any) & cq_on_device)[0]:
                     if int(ci) in preempt_targets:
                         continue  # sim-nomination slot (host-built)
                     preempt_targets[int(ci)] = [
@@ -1159,6 +1206,23 @@ class OracleBridge:
                                   requeue_reason=RequeueReason.NO_FIT)
                     entry.inadmissible_msg = "NoFit (batched oracle)"
                     result.entries.append(entry)
+        # Preempt-mode slots whose victim set was selected but whose
+        # commit lost (capacity claimed by an earlier entry this cycle)
+        # are the reference's skipped preemptions
+        # (admission_cycle_preemption_skips, scheduler.go:432 overlap /
+        # failed re-fit): count them like _sequential_cycle does.
+        if preempt_targets:
+            for ci, targets in preempt_targets.items():
+                if targets and not slot_preempting[ci]:
+                    name = w.cq_names[ci]
+                    result.stats.preemption_skips[name] = \
+                        result.stats.preemption_skips.get(name, 0) + 1
+            for cq_name, skips in result.stats.preemption_skips.items():
+                m = eng.metrics.admission_cycle_preemption_skips
+                m[cq_name] = m.get(cq_name, 0) + skips
+                eng.registry.counter(
+                    "admission_cycle_preemption_skips").inc(
+                    (cq_name,), skips)
         # The whole cycle's admissions assumed in one flat engine pass
         # (admissions never interact with the preemption/park verdicts
         # applied above — victims are admitted rows, parks are other

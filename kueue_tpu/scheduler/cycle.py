@@ -129,6 +129,10 @@ class SchedulerCycle:
         self.workload_ordering = workload_ordering
         self.preemptor = Preemptor(enable_fair_sharing=enable_fair_sharing,
                                    afs_enabled=afs_enabled)
+        # namespace -> labels provider for the nomination-time namespace
+        # selector check (scheduler.go:636 ValidateAdmissibility); the
+        # engine wires its namespace registry in. None = skip the check.
+        self.namespace_labels_of = None
 
     def schedule(self, heads: list[WorkloadInfo], snapshot: Snapshot,
                  now: float = 0.0,
@@ -175,12 +179,29 @@ class SchedulerCycle:
                     f"ClusterQueue {w.cluster_queue} not found")
                 e.status = EntryStatus.INADMISSIBLE
                 result.inadmissible.append(e)
+            elif self._namespace_mismatch(w, e.cq_snapshot):
+                # scheduler.go:636: admissibility is validated at
+                # nomination; a selector mismatch requeues the workload
+                # as inadmissible (RequeueReasonNamespaceMismatch).
+                e.inadmissible_msg = ("workload namespace doesn't match "
+                                      "ClusterQueue selector")
+                e.requeue_reason = RequeueReason.NAMESPACE_MISMATCH
+                e.status = EntryStatus.INADMISSIBLE
+                result.inadmissible.append(e)
             else:
                 assignment, targets = self._get_assignments(w, snapshot, now)
                 e.assignment = assignment
                 e.preemption_targets = targets
                 entries.append(e)
         return entries
+
+    def _namespace_mismatch(self, w: WorkloadInfo, cq_snapshot) -> bool:
+        from kueue_tpu.workload_info import namespace_selector_mismatch
+        if self.namespace_labels_of is None:
+            return False
+        return namespace_selector_mismatch(
+            getattr(cq_snapshot.spec, "namespace_selector", None),
+            self.namespace_labels_of(w.obj.namespace))
 
     def _get_assignments(self, wl: WorkloadInfo, snapshot: Snapshot,
                          now: float) -> tuple[Assignment, list[Target]]:

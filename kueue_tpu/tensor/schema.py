@@ -328,7 +328,14 @@ def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
                 if res in s_idx:
                     group_of_res[ci, s_idx[res]] = gi
             for fi, fq in enumerate(rg.flavors):
-                group_flavors[ci, gi, fi] = fl_idx[fq.name]
+                # Quotas naming an unregistered ResourceFlavor are
+                # unusable slots ("flavor not found" errors to NoFit in
+                # flavorassigner.go): leave -1 so the kernel's flavor
+                # scan can never choose them. Their fr columns still
+                # exist (usage bookkeeping), but no nomination path
+                # reaches them.
+                if fq.name in snap.resource_flavors:
+                    group_flavors[ci, gi, fi] = fl_idx[fq.name]
         from kueue_tpu.api.types import QueueingStrategy
         best_effort[ci] = (spec.queueing_strategy
                            == QueueingStrategy.BEST_EFFORT_FIFO)

@@ -301,21 +301,25 @@ def adjust_resources(wl: Workload, limit_ranges=None,
         ps.requests = pt.pod_requests(template)
 
 
+def namespace_selector_mismatch(selector, labels) -> bool:
+    """The CQ namespace-selector match predicate, shared by the
+    nomination check (scheduler/cycle.py) and the device bridge's
+    per-head demotion so the two can never diverge."""
+    if selector is None:
+        return False
+    labels = labels or {}
+    return any(labels.get(k) != v for k, v in selector.items())
+
+
 def validate_admissibility(wl: Workload, limit_ranges=None,
-                           namespace_labels=None,
-                           cq_namespace_selector=None) -> Optional[str]:
-    """pkg/workload/resources.go:233 ValidateAdmissibility: namespace
-    selector match, requests<=limits, LimitRange bounds. Returns the
-    first failure message, or None when admissible."""
+                           namespace_labels=None) -> Optional[str]:
+    """pkg/workload/resources.go:233 ValidateAdmissibility:
+    requests<=limits, LimitRange bounds. Returns the first failure
+    message, or None when admissible. The namespace-selector check runs
+    at nomination time (namespace_selector_mismatch)."""
     from kueue_tpu.utils import limitrange as lr
     from kueue_tpu.utils import podtemplate as pt
 
-    if cq_namespace_selector is not None:
-        labels = (namespace_labels or {})
-        for k, v in cq_namespace_selector.items():
-            if labels.get(k) != v:
-                return ("workload namespace doesn't match ClusterQueue "
-                        "selector")
     summary = None
     if limit_ranges:
         in_ns = [r for r in limit_ranges if r.namespace == wl.namespace]

@@ -270,6 +270,11 @@ class ClusterQueueWrapper:
         self._strategy = s
         return self
 
+    def NamespaceSelector(self, **labels) -> "ClusterQueueWrapper":
+        """Go MatchExpressions In [v] collapse to {key: v} equality."""
+        self._ns_selector = dict(labels)
+        return self
+
     def FairWeight(self, w: float) -> "ClusterQueueWrapper":
         self._fair_weight = w
         return self
@@ -280,6 +285,8 @@ class ClusterQueueWrapper:
             kw["flavor_fungibility"] = self._fungibility
         if self._fair_weight is not None:
             kw["fair_sharing"] = FairSharing(weight=self._fair_weight)
+        if getattr(self, "_ns_selector", None) is not None:
+            kw["namespace_selector"] = self._ns_selector
         return ClusterQueue(
             name=self._name, cohort=self._cohort,
             resource_groups=tuple(self._groups),

@@ -1,0 +1,192 @@
+"""Whole-cycle golden harness: run ONE scheduling cycle over worlds
+transliterated from the reference's TestSchedule tables
+(pkg/scheduler/scheduler_test.go:349) and compare the Go-authored
+post-cycle expectations.
+
+Driver mirror: the Go test seeds cache+queues (pre-admitted workloads
+via ReserveQuota, pending ones via queues), runs scheduler.schedule(ctx)
+once, then asserts wantAssignments (every admission in the cache),
+wantLeft (keys still queued per CQ) and wantInadmissibleLeft.
+
+One deliberate translation: the reference issues evictions as ASYNC api
+PATCHes, so its post-cycle cache still shows preemption victims as
+assigned; this engine applies evictions synchronously inside the cycle.
+Ported cases therefore list victims under ``want_preempted`` and expect
+them requeued (in ``want_left``) rather than still-assigned — the same
+decisions, observed after the eviction lands instead of before.
+
+Every case also runs through the DEVICE path (engine + oracle bridge)
+and must produce identical observables — the device differential gate
+the round-3 verdict asked for.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kueue_tpu.api.types import (
+    Admission,
+    LocalQueue,
+    PodSetAssignmentStatus,
+)
+from kueue_tpu.controllers.engine import Engine
+
+from .builders import WorkloadWrapper
+
+
+def MakeLocalQueue(name: str, namespace: str = "default"):
+    return _LQWrapper(name, namespace)
+
+
+class _LQWrapper:
+    """utiltestingapi.MakeLocalQueue."""
+
+    def __init__(self, name: str, namespace: str):
+        self._name = name
+        self._namespace = namespace
+        self._cq = ""
+
+    def ClusterQueue(self, cq: str) -> "_LQWrapper":
+        self._cq = cq
+        return self
+
+    def Obj(self) -> LocalQueue:
+        return LocalQueue(name=self._name, namespace=self._namespace,
+                          cluster_queue=self._cq)
+
+
+def seed_admitted(eng: Engine, ww: WorkloadWrapper) -> None:
+    """Inject a pre-admitted workload (the Go tables' ReserveQuota /
+    Admitted seeds) straight into the engine's registries, like the Go
+    driver seeds its cache."""
+    info = ww.Info()
+    wl = info.obj
+    wl.status.admission = Admission(
+        cluster_queue=info.cluster_queue,
+        pod_set_assignments=tuple(
+            PodSetAssignmentStatus(
+                name=psr.name, flavors=dict(psr.flavors),
+                resource_usage=dict(psr.requests), count=psr.count)
+            for psr in info.total_requests))
+    eng.workloads[wl.key] = wl
+    eng.cache.add_or_update_workload(wl, info=info)
+
+
+def build_engine(*, resource_flavors, cluster_queues, local_queues,
+                 cohorts=(), workloads=(), namespaces=None,
+                 enable_fair_sharing=False, partial_admission=True,
+                 oracle=False) -> Engine:
+    eng = Engine(enable_fair_sharing=enable_fair_sharing)
+    eng.cycle.enable_partial_admission = partial_admission
+    if namespaces:
+        eng.namespace_labels.update(namespaces)
+    for rf in resource_flavors:
+        eng.create_resource_flavor(rf)
+    # The Go tables reference cohorts implicitly from CQ specs; create
+    # the missing ones (bare cohorts with no quota of their own).
+    from kueue_tpu.api.types import Cohort
+    declared = {co.name for co in cohorts}
+    for co in cohorts:
+        eng.create_cohort(co)
+    for cq in cluster_queues:
+        if cq.cohort and cq.cohort not in declared:
+            declared.add(cq.cohort)
+            eng.create_cohort(Cohort(cq.cohort))
+    for cq in cluster_queues:
+        eng.create_cluster_queue(cq)
+    for lq in local_queues:
+        eng.create_local_queue(lq)
+    for ww in workloads:
+        if ww._admission is not None:
+            seed_admitted(eng, ww)
+        else:
+            wl = ww.Obj()
+            eng.clock = max(eng.clock, wl.creation_time)
+            eng.submit(wl)
+    if oracle:
+        eng.attach_oracle()
+    return eng
+
+
+def observe(eng: Engine, result) -> dict:
+    """Post-cycle observables, the shape the wants compare against."""
+    assignments = {}
+    for key, info in eng.cache.workloads.items():
+        adm = info.obj.status.admission
+        assignments[key] = (
+            adm.cluster_queue,
+            tuple((psa.name, tuple(sorted(psa.flavors.items())),
+                   psa.count)
+                  for psa in adm.pod_set_assignments))
+    left: dict[str, list] = {}
+    inadmissible: dict[str, list] = {}
+    for name, pcq in eng.queues.cluster_queues.items():
+        if pcq.items:
+            left[name] = sorted(pcq.items)
+        if pcq.inadmissible:
+            inadmissible[name] = sorted(pcq.inadmissible)
+    preempted = sorted(
+        k for k, wl in eng.workloads.items()
+        if wl.has_condition("Evicted") and not wl.is_admitted)
+    skips = dict(eng.metrics.admission_cycle_preemption_skips)
+    return {"assignments": assignments, "left": left,
+            "inadmissible": inadmissible, "preempted": preempted,
+            "preemption_skips": {k: v for k, v in skips.items() if v}}
+
+
+def want_admission(cq: str, *podsets) -> tuple:
+    """Expected admission: podsets = (name, {res: flavor}[, count])."""
+    out = []
+    for ps in podsets:
+        name, flavors = ps[0], ps[1]
+        count = ps[2] if len(ps) > 2 else 1
+        out.append((name, tuple(sorted(flavors.items())), count))
+    return (cq, tuple(out))
+
+
+def run_schedule_case(*, case: str, want_assignments: dict,
+                      want_left: Optional[dict] = None,
+                      want_inadmissible: Optional[dict] = None,
+                      want_preempted=(),
+                      want_preemption_skips: Optional[dict] = None,
+                      n_cycles: int = 1,
+                      **world) -> None:
+    """Run the case through the sequential engine, assert the Go-authored
+    wants, then through the device path and assert identical
+    observables."""
+    outs = {}
+    for mode in ("host", "device"):
+        eng = build_engine(oracle=(mode == "device"), **world)
+        result = None
+        for _ in range(n_cycles):
+            result = eng.schedule_once()
+            if result is None:
+                break
+        outs[mode] = observe(eng, result)
+
+    got = outs["host"]
+    prefix = f"[{case}] "
+    assert got["assignments"] == dict(want_assignments), (
+        f"{prefix}assignments:\n got {got['assignments']}\n"
+        f" want {dict(want_assignments)}")
+    if want_left is not None:
+        got_left = {cq: keys for cq, keys in got["left"].items()}
+        assert got_left == {cq: sorted(v) for cq, v in want_left.items()}, (
+            f"{prefix}left: got {got_left}, want {want_left}")
+    if want_inadmissible is not None:
+        assert got["inadmissible"] == {
+            cq: sorted(v) for cq, v in want_inadmissible.items()}, (
+            f"{prefix}inadmissible: got {got['inadmissible']},"
+            f" want {want_inadmissible}")
+    assert got["preempted"] == sorted(want_preempted), (
+        f"{prefix}preempted: got {got['preempted']},"
+        f" want {sorted(want_preempted)}")
+    if want_preemption_skips is not None:
+        assert got["preemption_skips"] == want_preemption_skips, (
+            f"{prefix}skips: got {got['preemption_skips']},"
+            f" want {want_preemption_skips}")
+
+    # Device differential gate: identical observables on the same world.
+    assert outs["device"] == got, (
+        f"{prefix}device/host divergence:\n device {outs['device']}\n"
+        f" host   {got}")

@@ -1,0 +1,1176 @@
+"""Whole-cycle golden fixtures transliterated from the reference's
+TestSchedule table (pkg/scheduler/scheduler_test.go:349): full cycles —
+nomination + ordering + commit + requeue — over the suite's fixture
+world, with the Go-authored post-cycle expectations. Every case also
+runs through the device path and must match (schedule_harness).
+
+Suite fixtures mirror scheduler_test.go:354-466; namespaces
+scheduler_test.go:188-193. Cases carry the Go case name verbatim.
+
+Translation notes (schedule_harness docstring): evictions are
+synchronous here, so preemption victims appear requeued instead of
+still-assigned; admission-check states that the Go cases attach inertly
+(CheckStateReady) are dropped when they do not change the decision.
+"""
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kueue_tpu.api.types import (  # noqa: E402
+    FungibilityPolicy,
+    PreemptionPolicy,
+    QueueingStrategy,
+)
+
+from .builders import (  # noqa: E402
+    MakeClusterQueue,
+    MakeCohort,
+    MakeFlavorQuotas,
+    MakePodSet,
+    MakeResourceFlavor,
+    MakeWorkload,
+)
+from .schedule_harness import (  # noqa: E402
+    MakeLocalQueue,
+    run_schedule_case,
+    want_admission,
+)
+
+S_FIFO = QueueingStrategy.STRICT_FIFO
+
+NAMESPACES = {
+    "eng-alpha": {"dep": "eng"},
+    "eng-beta": {"dep": "eng"},
+    "eng-gamma": {"dep": "eng"},
+    "sales": {"dep": "sales"},
+    "lend": {"dep": "lend"},
+}
+
+
+def suite_flavors():
+    return [
+        MakeResourceFlavor("default").Obj(),
+        MakeResourceFlavor("on-demand").Obj(),
+        MakeResourceFlavor("spot").Obj(),
+        MakeResourceFlavor("model-a").Obj(),
+        MakeResourceFlavor("spot-tainted").Taint(
+            key="key", value="val", effect="NoSchedule").Obj(),
+        MakeResourceFlavor("spot-tainted-2").Taint(
+            key="key", value="val2", effect="NoSchedule").Obj(),
+    ]
+
+
+def suite_cluster_queues():
+    return [
+        MakeClusterQueue("sales")
+        .NamespaceSelector(dep="sales")
+        .QueueingStrategy(S_FIFO)
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "50", "0").Obj())
+        .Obj(),
+        MakeClusterQueue("eng-alpha")
+        .Cohort("eng")
+        .NamespaceSelector(dep="eng")
+        .QueueingStrategy(S_FIFO)
+        .ResourceGroup(
+            MakeFlavorQuotas("on-demand").Resource("cpu", "50", "50").Obj(),
+            MakeFlavorQuotas("spot").Resource("cpu", "100", "0").Obj())
+        .Obj(),
+        MakeClusterQueue("eng-beta")
+        .Cohort("eng")
+        .NamespaceSelector(dep="eng")
+        .QueueingStrategy(S_FIFO)
+        .Preemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+            reclaim_within_cohort=PreemptionPolicy.ANY)
+        .ResourceGroup(
+            MakeFlavorQuotas("on-demand").Resource("cpu", "50", "10").Obj(),
+            MakeFlavorQuotas("spot").Resource("cpu", "0", "100").Obj())
+        .ResourceGroup(
+            MakeFlavorQuotas("model-a")
+            .Resource("example.com/gpu", "20", "0").Obj())
+        .Obj(),
+        MakeClusterQueue("flavor-nonexistent-cq")
+        .QueueingStrategy(S_FIFO)
+        .ResourceGroup(MakeFlavorQuotas("nonexistent-flavor")
+                       .Resource("cpu", "50").Obj())
+        .Obj(),
+        MakeClusterQueue("lend-a")
+        .Cohort("lend")
+        .NamespaceSelector(dep="lend")
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "3", None, "2").Obj())
+        .Obj(),
+        MakeClusterQueue("lend-b")
+        .Cohort("lend")
+        .NamespaceSelector(dep="lend")
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "2", None, "2").Obj())
+        .Obj(),
+    ]
+
+
+def suite_local_queues():
+    return [
+        MakeLocalQueue("main", "sales").ClusterQueue("sales").Obj(),
+        MakeLocalQueue("blocked", "sales").ClusterQueue("eng-alpha").Obj(),
+        MakeLocalQueue("main", "eng-alpha").ClusterQueue("eng-alpha").Obj(),
+        MakeLocalQueue("main", "eng-beta").ClusterQueue("eng-beta").Obj(),
+        MakeLocalQueue("flavor-nonexistent-queue", "sales")
+        .ClusterQueue("flavor-nonexistent-cq").Obj(),
+        MakeLocalQueue("cq-nonexistent-queue", "sales")
+        .ClusterQueue("nonexistent-cq").Obj(),
+        MakeLocalQueue("lend-a-queue", "lend").ClusterQueue("lend-a").Obj(),
+        MakeLocalQueue("lend-b-queue", "lend").ClusterQueue("lend-b").Obj(),
+    ]
+
+
+def run_case(case, *, extra_cqs=(), extra_lqs=(), cohorts=(), workloads,
+             **wants):
+    run_schedule_case(
+        case=case,
+        resource_flavors=suite_flavors(),
+        cluster_queues=suite_cluster_queues() + list(extra_cqs),
+        local_queues=suite_local_queues() + list(extra_lqs),
+        cohorts=cohorts,
+        namespaces=NAMESPACES,
+        workloads=workloads,
+        **wants)
+
+
+class TestScheduleGolden:
+    # scheduler_test.go:468
+    def test_second_flavor_when_first_has_no_preemption_candidates(self):
+        run_case(
+            "use second flavor when the first has no preemption candidates;"
+            " WhenCanPreempt: MayStopSearch",
+            extra_cqs=[
+                MakeClusterQueue("other-alpha")
+                .Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY)
+                .FlavorFungibility(
+                    when_can_preempt=FungibilityPolicy.PREEMPT)
+                .ResourceGroup(
+                    MakeFlavorQuotas("on-demand")
+                    .Resource("cpu", "50", "50").Obj(),
+                    MakeFlavorQuotas("spot")
+                    .Resource("cpu", "100", "0").Obj())
+                .Obj()],
+            extra_lqs=[MakeLocalQueue("other", "eng-alpha")
+                       .ClusterQueue("other-alpha").Obj()],
+            workloads=[
+                MakeWorkload("admitted", "eng-alpha").Queue("other")
+                .Request("cpu", "50")
+                .ReserveQuota("other-alpha", [{"cpu": "on-demand"}]),
+                MakeWorkload("new", "eng-alpha").Queue("other")
+                .Request("cpu", "20"),
+            ],
+            want_assignments={
+                "eng-alpha/admitted": want_admission(
+                    "other-alpha", ("main", {"cpu": "on-demand"})),
+                "eng-alpha/new": want_admission(
+                    "other-alpha", ("main", {"cpu": "spot"})),
+            },
+            want_left={})
+
+    # scheduler_test.go:557 (the inert CheckStateReady is dropped)
+    def test_workload_fits_in_single_cluster_queue(self):
+        run_case(
+            "workload fits in single clusterQueue, with check state ready",
+            workloads=[
+                MakeWorkload("foo", "sales").Queue("main")
+                .PodSets(MakePodSet("one", 10).Request("cpu", "1").Obj()),
+            ],
+            want_assignments={
+                "sales/foo": want_admission(
+                    "sales", ("one", {"cpu": "default"}, 10)),
+            },
+            want_left={})
+
+    # scheduler_test.go:626
+    def test_skip_workload_with_missing_cluster_queue(self):
+        run_case(
+            "skip workload with missing or deleted ClusterQueue (NoFit)",
+            workloads=[
+                MakeWorkload("missing-cq-workload", "sales")
+                .Queue("non-existent-queue")
+                .PodSets(MakePodSet("set", 1).Request("cpu", "1").Obj()),
+            ],
+            want_assignments={},
+            want_left={})
+
+    # scheduler_test.go:651
+    def test_flavors_mixed_misconfiguration_and_insufficient_quota(self):
+        run_case(
+            "flavors with mixed misconfiguration and insufficient quota",
+            extra_cqs=[
+                MakeClusterQueue("custom-cq").QueueingStrategy(S_FIFO)
+                .ResourceGroup(
+                    MakeFlavorQuotas("spot-tainted")
+                    .Resource("cpu", "20", "20").Obj(),
+                    MakeFlavorQuotas("on-demand")
+                    .Resource("cpu", "15", "15").Obj())
+                .Obj()],
+            extra_lqs=[MakeLocalQueue("custom-q", "sales")
+                       .ClusterQueue("custom-cq").Obj()],
+            workloads=[
+                MakeWorkload("existing-on-demand-job", "sales")
+                .Queue("custom-q").Request("cpu", "10")
+                .ReserveQuota("custom-cq", [{"cpu": "on-demand"}]),
+                MakeWorkload("new-job", "sales").Queue("custom-q")
+                .Request("cpu", "10"),
+            ],
+            want_assignments={
+                "sales/existing-on-demand-job": want_admission(
+                    "custom-cq", ("main", {"cpu": "on-demand"})),
+            },
+            want_left={"custom-cq": ["sales/new-job"]})
+
+    # scheduler_test.go:732
+    def test_flavors_mixed_taint_mismatch_and_exceeding_limits(self):
+        run_case(
+            "flavors with mixed taint mismatch and exceeding limits",
+            extra_cqs=[
+                MakeClusterQueue("custom-cq2").QueueingStrategy(S_FIFO)
+                .ResourceGroup(
+                    MakeFlavorQuotas("spot-tainted")
+                    .Resource("cpu", "20", "20").Obj(),
+                    MakeFlavorQuotas("on-demand")
+                    .Resource("cpu", "5", "5").Obj())
+                .Obj()],
+            extra_lqs=[MakeLocalQueue("custom-q2", "sales")
+                       .ClusterQueue("custom-cq2").Obj()],
+            workloads=[
+                MakeWorkload("new-job2", "sales").Queue("custom-q2")
+                .Request("cpu", "10"),
+            ],
+            want_assignments={},
+            want_left={"custom-cq2": ["sales/new-job2"]})
+
+    # scheduler_test.go:782
+    def test_flavors_structurally_incompatible(self):
+        run_case(
+            "flavors are structurally incompatible",
+            extra_cqs=[
+                MakeClusterQueue("custom-cq3").QueueingStrategy(S_FIFO)
+                .ResourceGroup(
+                    MakeFlavorQuotas("spot-tainted")
+                    .Resource("cpu", "20", "20").Obj(),
+                    MakeFlavorQuotas("spot-tainted-2")
+                    .Resource("cpu", "5", "5").Obj())
+                .Obj()],
+            extra_lqs=[MakeLocalQueue("custom-q3", "sales")
+                       .ClusterQueue("custom-cq3").Obj()],
+            workloads=[
+                MakeWorkload("new-job3", "sales").Queue("custom-q3")
+                .Request("cpu", "1"),
+            ],
+            want_assignments={},
+            want_left={"custom-cq3": ["sales/new-job3"]})
+
+    # scheduler_test.go:918
+    def test_single_cluster_queue_full(self):
+        run_case(
+            "single clusterQueue full",
+            workloads=[
+                MakeWorkload("new", "sales").Queue("main")
+                .PodSets(MakePodSet("one", 11).Request("cpu", "1").Obj()),
+                MakeWorkload("assigned", "sales")
+                .PodSets(MakePodSet("one", 40).Request("cpu", "1").Obj())
+                .ReserveQuota("sales", [{"cpu": "default"}]),
+            ],
+            want_assignments={
+                "sales/assigned": want_admission(
+                    "sales", ("one", {"cpu": "default"}, 40)),
+            },
+            want_left={"sales": ["sales/new"]})
+
+    # scheduler_test.go:997
+    def test_failed_to_match_cluster_queue_selector(self):
+        run_case(
+            "failed to match clusterQueue selector",
+            workloads=[
+                MakeWorkload("new", "sales").Queue("blocked")
+                .PodSets(MakePodSet("one", 1).Request("cpu", "1").Obj()),
+            ],
+            want_assignments={},
+            want_left={},
+            want_inadmissible={"eng-alpha": ["sales/new"]})
+
+    # scheduler_test.go:1039
+    def test_admit_in_different_cohorts(self):
+        run_case(
+            "admit in different cohorts",
+            workloads=[
+                MakeWorkload("new", "sales").Queue("main")
+                .PodSets(MakePodSet("one", 1).Request("cpu", "1").Obj()),
+                MakeWorkload("new", "eng-alpha").Queue("main")
+                .PodSets(MakePodSet("one", 51).Request("cpu", "1").Obj()),
+            ],
+            want_assignments={
+                "sales/new": want_admission(
+                    "sales", ("one", {"cpu": "default"}, 1)),
+                "eng-alpha/new": want_admission(
+                    "eng-alpha", ("one", {"cpu": "on-demand"}, 51)),
+            },
+            want_left={})
+
+    # scheduler_test.go:1133
+    def test_admit_in_same_cohort_no_borrowing(self):
+        run_case(
+            "admit in same cohort with no borrowing",
+            workloads=[
+                MakeWorkload("new", "eng-alpha").Queue("main")
+                .PodSets(MakePodSet("one", 40).Request("cpu", "1").Obj()),
+                MakeWorkload("new", "eng-beta").Queue("main")
+                .PodSets(MakePodSet("one", 40).Request("cpu", "1").Obj()),
+            ],
+            want_assignments={
+                "eng-alpha/new": want_admission(
+                    "eng-alpha", ("one", {"cpu": "on-demand"}, 40)),
+                "eng-beta/new": want_admission(
+                    "eng-beta", ("one", {"cpu": "on-demand"}, 40)),
+            },
+            want_left={})
+
+    # scheduler_test.go:1228
+    def test_assign_multiple_resources_and_flavors(self):
+        run_case(
+            "assign multiple resources and flavors",
+            workloads=[
+                MakeWorkload("new", "eng-beta").Queue("main")
+                .PodSets(
+                    MakePodSet("one", 10).Request("cpu", "6")
+                    .Request("example.com/gpu", "1").Obj(),
+                    MakePodSet("two", 40).Request("cpu", "1").Obj()),
+            ],
+            want_assignments={
+                "eng-beta/new": want_admission(
+                    "eng-beta",
+                    ("one", {"cpu": "on-demand",
+                             "example.com/gpu": "model-a"}, 10),
+                    ("two", {"cpu": "spot"}, 40)),
+            },
+            want_left={})
+
+    # scheduler_test.go:1304
+    def test_cannot_borrow_if_cohort_would_overadmit(self):
+        run_case(
+            "cannot borrow if cohort was assigned and would result in"
+            " overadmission",
+            workloads=[
+                MakeWorkload("new", "eng-alpha").Queue("main")
+                .PodSets(MakePodSet("one", 45).Request("cpu", "1").Obj()),
+                MakeWorkload("new", "eng-beta").Queue("main")
+                .PodSets(MakePodSet("one", 56).Request("cpu", "1").Obj()),
+            ],
+            want_assignments={
+                "eng-alpha/new": want_admission(
+                    "eng-alpha", ("one", {"cpu": "on-demand"}, 45)),
+            },
+            want_left={"eng-beta": ["eng-beta/new"]})
+
+    # scheduler_test.go:1392
+    def test_can_borrow_if_cohort_will_not_overadmit(self):
+        run_case(
+            "can borrow if cohort was assigned and will not result in"
+            " overadmission",
+            workloads=[
+                MakeWorkload("new", "eng-alpha").Queue("main")
+                .PodSets(MakePodSet("one", 45).Request("cpu", "1").Obj()),
+                MakeWorkload("new", "eng-beta").Queue("main")
+                .PodSets(MakePodSet("one", 55).Request("cpu", "1").Obj()),
+            ],
+            want_assignments={
+                "eng-alpha/new": want_admission(
+                    "eng-alpha", ("one", {"cpu": "on-demand"}, 45)),
+                "eng-beta/new": want_admission(
+                    "eng-beta", ("one", {"cpu": "on-demand"}, 55)),
+            },
+            want_left={})
+
+    # scheduler_test.go:1486
+    def test_can_borrow_if_needs_reclaim_in_different_flavor(self):
+        run_case(
+            "can borrow if needs reclaim from cohort in different flavor",
+            workloads=[
+                MakeWorkload("can-reclaim", "eng-alpha").Queue("main")
+                .Request("cpu", "100"),
+                MakeWorkload("needs-to-borrow", "eng-beta").Queue("main")
+                .Request("cpu", "1"),
+                MakeWorkload("user-on-demand", "eng-beta")
+                .Request("cpu", "50")
+                .ReserveQuota("eng-beta", [{"cpu": "on-demand"}]),
+                MakeWorkload("user-spot", "eng-beta")
+                .Request("cpu", "1")
+                .ReserveQuota("eng-beta", [{"cpu": "spot"}]),
+            ],
+            want_assignments={
+                "eng-beta/user-spot": want_admission(
+                    "eng-beta", ("main", {"cpu": "spot"})),
+                "eng-beta/user-on-demand": want_admission(
+                    "eng-beta", ("main", {"cpu": "on-demand"})),
+                "eng-beta/needs-to-borrow": want_admission(
+                    "eng-beta", ("main", {"cpu": "on-demand"})),
+            },
+            want_left={"eng-alpha": ["eng-alpha/can-reclaim"]})
+
+    # scheduler_test.go:1602
+    def test_workload_exceeds_lending_limit_when_borrow_in_cohort(self):
+        run_case(
+            "workload exceeds lending limit when borrow in cohort",
+            workloads=[
+                MakeWorkload("a", "lend").Request("cpu", "2")
+                .ReserveQuota("lend-b", [{"cpu": "default"}]),
+                MakeWorkload("b", "lend").Queue("lend-b-queue")
+                .Request("cpu", "3"),
+            ],
+            want_assignments={
+                "lend/a": want_admission(
+                    "lend-b", ("main", {"cpu": "default"})),
+            },
+            want_inadmissible={"lend-b": ["lend/b"]})
+
+    # scheduler_test.go:1680
+    def test_hierarchical_cohort_respects_lending_limit(self):
+        run_case(
+            "hierarchical cohort respects lending limit when borrowing",
+            cohorts=[MakeCohort("root").Obj(),
+                     MakeCohort("child").Parent("root").Obj()],
+            extra_cqs=[
+                MakeClusterQueue("cq-lender").Cohort("child")
+                .NamespaceSelector(dep="eng")
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("cpu", "10", None, "3").Obj())
+                .Obj(),
+                MakeClusterQueue("cq-borrower").Cohort("child")
+                .NamespaceSelector(dep="eng")
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("cpu", "5", "10").Obj())
+                .Obj()],
+            extra_lqs=[
+                MakeLocalQueue("lq-lender", "eng-alpha")
+                .ClusterQueue("cq-lender").Obj(),
+                MakeLocalQueue("lq-borrower", "eng-alpha")
+                .ClusterQueue("cq-borrower").Obj()],
+            workloads=[
+                MakeWorkload("wl-existing", "eng-alpha")
+                .PodSets(MakePodSet("main", 1).Request("cpu", "5").Obj())
+                .ReserveQuota("cq-borrower", [{"cpu": "on-demand"}]),
+                MakeWorkload("wl-pending", "eng-alpha")
+                .Queue("lq-borrower")
+                .PodSets(MakePodSet("main", 1).Request("cpu", "4").Obj()),
+            ],
+            want_assignments={
+                "eng-alpha/wl-existing": want_admission(
+                    "cq-borrower", ("main", {"cpu": "on-demand"})),
+            },
+            want_inadmissible={"cq-borrower": ["eng-alpha/wl-pending"]})
+
+    # scheduler_test.go:1805
+    def test_hierarchical_cohort_allows_borrowing_up_to_lending_limit(self):
+        run_case(
+            "hierarchical cohort allows borrowing up to lending limit",
+            cohorts=[MakeCohort("root").Obj(),
+                     MakeCohort("child").Parent("root").Obj()],
+            extra_cqs=[
+                MakeClusterQueue("cq-lender").Cohort("child")
+                .NamespaceSelector(dep="eng")
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("cpu", "10", None, "5").Obj())
+                .Obj(),
+                MakeClusterQueue("cq-borrower").Cohort("child")
+                .NamespaceSelector(dep="eng")
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("cpu", "5", "10").Obj())
+                .Obj()],
+            extra_lqs=[
+                MakeLocalQueue("lq-lender", "eng-alpha")
+                .ClusterQueue("cq-lender").Obj(),
+                MakeLocalQueue("lq-borrower", "eng-alpha")
+                .ClusterQueue("cq-borrower").Obj()],
+            workloads=[
+                MakeWorkload("wl-existing", "eng-alpha")
+                .PodSets(MakePodSet("main", 1).Request("cpu", "5").Obj())
+                .ReserveQuota("cq-borrower", [{"cpu": "on-demand"}]),
+                MakeWorkload("wl-borrowing", "eng-alpha")
+                .Queue("lq-borrower")
+                .PodSets(MakePodSet("main", 1).Request("cpu", "5").Obj()),
+            ],
+            want_assignments={
+                "eng-alpha/wl-existing": want_admission(
+                    "cq-borrower", ("main", {"cpu": "on-demand"})),
+                "eng-alpha/wl-borrowing": want_admission(
+                    "cq-borrower", ("main", {"cpu": "on-demand"})),
+            },
+            want_left={})
+
+    # scheduler_test.go:1917 — evictions are synchronous here, so the
+    # two victims (Go: Preempted events for eng-alpha/borrower via
+    # cohort reclamation and eng-beta/low-2 via in-CQ prioritization)
+    # leave the cache instead of lingering until watch events.
+    def test_preempt_workloads_in_cluster_queue_and_cohort(self):
+        run_case(
+            "preempt workloads in ClusterQueue and cohort",
+            workloads=[
+                MakeWorkload("preemptor", "eng-beta").Queue("main")
+                .Request("cpu", "20"),
+                MakeWorkload("use-all-spot", "eng-alpha")
+                .Request("cpu", "100")
+                .ReserveQuota("eng-alpha", [{"cpu": "spot"}]),
+                MakeWorkload("low-1", "eng-beta").Priority(-1)
+                .Request("cpu", "30")
+                .ReserveQuota("eng-beta", [{"cpu": "on-demand"}]),
+                MakeWorkload("low-2", "eng-beta").Priority(-2)
+                .Request("cpu", "10")
+                .ReserveQuota("eng-beta", [{"cpu": "on-demand"}]),
+                MakeWorkload("borrower", "eng-alpha")
+                .Request("cpu", "60")
+                .ReserveQuota("eng-alpha", [{"cpu": "on-demand"}]),
+            ],
+            want_assignments={
+                "eng-alpha/use-all-spot": want_admission(
+                    "eng-alpha", ("main", {"cpu": "spot"})),
+                "eng-beta/low-1": want_admission(
+                    "eng-beta", ("main", {"cpu": "on-demand"})),
+            },
+            want_preempted=["eng-alpha/borrower", "eng-beta/low-2"],
+            want_left={"eng-beta": ["eng-beta/preemptor"]})
+
+    # scheduler_test.go:2080 — the in-cycle eviction re-activates the
+    # cohort's parked workloads at cycle end (the reference's requeue
+    # rides post-cycle watch events), so eng-alpha/pending lands back in
+    # the active queue instead of wantInadmissibleLeft.
+    def test_multiple_cqs_need_preemption(self):
+        run_case(
+            "multiple CQs need preemption",
+            extra_cqs=[
+                MakeClusterQueue("other-alpha").Cohort("other")
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("cpu", "50", "50").Obj())
+                .Obj(),
+                MakeClusterQueue("other-beta").Cohort("other")
+                .Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=PreemptionPolicy.ANY)
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("cpu", "50", "10").Obj())
+                .Obj()],
+            extra_lqs=[
+                MakeLocalQueue("other", "eng-alpha")
+                .ClusterQueue("other-alpha").Obj(),
+                MakeLocalQueue("other", "eng-beta")
+                .ClusterQueue("other-beta").Obj()],
+            workloads=[
+                MakeWorkload("preemptor", "eng-beta").Priority(-1)
+                .Queue("other").Request("cpu", "1"),
+                MakeWorkload("pending", "eng-alpha").Priority(1)
+                .Queue("other").Request("cpu", "1"),
+                MakeWorkload("use-all", "eng-alpha")
+                .Request("cpu", "100")
+                .ReserveQuota("other-alpha", [{"cpu": "on-demand"}]),
+            ],
+            want_assignments={},
+            want_preempted=["eng-alpha/use-all"],
+            want_left={"other-beta": ["eng-beta/preemptor"],
+                       "other-alpha": ["eng-alpha/pending"]},
+            want_inadmissible={})
+
+    # scheduler_test.go:2220
+    def test_cannot_borrow_resource_not_listed_in_cluster_queue(self):
+        run_case(
+            "cannot borrow resource not listed in clusterQueue",
+            workloads=[
+                MakeWorkload("new", "eng-alpha").Queue("main")
+                .Request("example.com/gpu", "1"),
+            ],
+            want_assignments={},
+            want_left={"eng-alpha": ["eng-alpha/new"]})
+
+    # scheduler_test.go:2257
+    def test_not_enough_to_borrow_fallback_to_next_flavor(self):
+        run_case(
+            "not enough resources to borrow, fallback to next flavor;"
+            " WhenCanPreempt: TryNextFlavor",
+            workloads=[
+                MakeWorkload("new", "eng-alpha").Queue("main")
+                .PodSets(MakePodSet("one", 60).Request("cpu", "1").Obj()),
+                MakeWorkload("existing", "eng-beta")
+                .PodSets(MakePodSet("one", 45).Request("cpu", "1").Obj())
+                .ReserveQuota("eng-beta", [{"cpu": "on-demand"}]),
+            ],
+            want_assignments={
+                "eng-alpha/new": want_admission(
+                    "eng-alpha", ("one", {"cpu": "spot"}, 60)),
+                "eng-beta/existing": want_admission(
+                    "eng-beta", ("one", {"cpu": "on-demand"}, 45)),
+            },
+            want_left={})
+
+    # scheduler_test.go:2331
+    def test_workload_should_not_fit_in_nonexistent_cluster_queue(self):
+        run_case(
+            "workload should not fit in nonexistent clusterQueue",
+            workloads=[
+                MakeWorkload("foo", "sales").Queue("cq-nonexistent-queue")
+                .Request("cpu", "1"),
+            ],
+            want_assignments={},
+            want_left={})
+
+    # scheduler_test.go:2345
+    def test_workload_should_not_fit_in_cq_with_nonexistent_flavor(self):
+        run_case(
+            "workload should not fit in clusterQueue with nonexistent"
+            " flavor",
+            workloads=[
+                MakeWorkload("foo", "sales")
+                .Queue("flavor-nonexistent-queue").Request("cpu", "1"),
+            ],
+            want_assignments={},
+            want_left={"flavor-nonexistent-cq": ["sales/foo"]})
+
+    # scheduler_test.go:2362 — the FIFO order (creation timestamps) puts
+    # eng-beta/new first; gamma's head would overcommit the cohort and
+    # parks (BestEffortFIFO).
+    def test_no_overadmission_while_borrowing(self):
+        run_case(
+            "no overadmission while borrowing",
+            extra_cqs=[
+                MakeClusterQueue("eng-gamma").Cohort("eng")
+                .Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=PreemptionPolicy.ANY)
+                .ResourceGroup(
+                    MakeFlavorQuotas("on-demand")
+                    .Resource("cpu", "50", "10").Obj(),
+                    MakeFlavorQuotas("spot")
+                    .Resource("cpu", "0", "100").Obj())
+                .Obj()],
+            extra_lqs=[MakeLocalQueue("main", "eng-gamma")
+                       .ClusterQueue("eng-gamma").Obj()],
+            workloads=[
+                MakeWorkload("new", "eng-beta").Queue("main").Creation(1.0)
+                .PodSets(MakePodSet("one", 50).Request("cpu", "1").Obj()),
+                MakeWorkload("new-alpha", "eng-alpha").Queue("main")
+                .Creation(2.0)
+                .PodSets(MakePodSet("one", 1).Request("cpu", "1").Obj()),
+                MakeWorkload("new-gamma", "eng-gamma").Queue("main")
+                .Creation(3.0)
+                .PodSets(MakePodSet("one", 50).Request("cpu", "1").Obj()),
+                MakeWorkload("existing", "eng-gamma")
+                .PodSets(
+                    MakePodSet("borrow-on-demand", 51)
+                    .Request("cpu", "1").Obj(),
+                    MakePodSet("use-all-spot", 100)
+                    .Request("cpu", "1").Obj())
+                .ReserveQuota("eng-gamma", [{"cpu": "on-demand"},
+                                            {"cpu": "spot"}]),
+            ],
+            want_assignments={
+                "eng-gamma/existing": want_admission(
+                    "eng-gamma",
+                    ("borrow-on-demand", {"cpu": "on-demand"}, 51),
+                    ("use-all-spot", {"cpu": "spot"}, 100)),
+                "eng-beta/new": want_admission(
+                    "eng-beta", ("one", {"cpu": "on-demand"}, 50)),
+                "eng-alpha/new-alpha": want_admission(
+                    "eng-alpha", ("one", {"cpu": "on-demand"}, 1)),
+            },
+            want_inadmissible={"eng-gamma": ["eng-gamma/new-gamma"]},
+            want_preemption_skips={})
+
+    # scheduler_test.go:2559
+    def test_partial_admission_single_variable_pod_set(self):
+        run_case(
+            "partial admission single variable pod set",
+            workloads=[
+                MakeWorkload("new", "sales").Queue("main")
+                .PodSets(MakePodSet("one", 50).SetMinimumCount(20)
+                         .Request("cpu", "2").Obj()),
+            ],
+            want_assignments={
+                "sales/new": want_admission(
+                    "sales", ("one", {"cpu": "default"}, 25)),
+            },
+            want_left={})
+
+    # scheduler_test.go:2614 — the Go case keeps the victim assigned
+    # (async eviction); here it leaves the cache and the preemptor waits.
+    def test_partial_admission_preempt_first(self):
+        run_case(
+            "partial admission single variable pod set, preempt first",
+            workloads=[
+                MakeWorkload("new", "eng-beta").Queue("main").Priority(4)
+                .PodSets(MakePodSet("one", 20).SetMinimumCount(10)
+                         .Request("example.com/gpu", "1").Obj()),
+                MakeWorkload("old", "eng-beta").Priority(-4)
+                .PodSets(MakePodSet("one", 10)
+                         .Request("example.com/gpu", "1").Obj())
+                .ReserveQuota("eng-beta",
+                              [{"example.com/gpu": "model-a"}]),
+            ],
+            want_assignments={},
+            want_preempted=["eng-beta/old"],
+            want_left={"eng-beta": ["eng-beta/new"]})
+
+    # scheduler_test.go:2703
+    def test_partial_admission_preempt_with_partial_admission(self):
+        run_case(
+            "partial admission single variable pod set, preempt with"
+            " partial admission",
+            workloads=[
+                MakeWorkload("new", "eng-beta").Queue("main").Priority(4)
+                .PodSets(MakePodSet("one", 30).SetMinimumCount(10)
+                         .Request("example.com/gpu", "1").Obj()),
+                MakeWorkload("old", "eng-beta").Priority(-4)
+                .PodSets(MakePodSet("one", 10)
+                         .Request("example.com/gpu", "1").Obj())
+                .ReserveQuota("eng-beta",
+                              [{"example.com/gpu": "model-a"}]),
+            ],
+            want_assignments={},
+            want_preempted=["eng-beta/old"],
+            want_left={"eng-beta": ["eng-beta/new"]})
+
+    # scheduler_test.go:2792
+    def test_partial_admission_multiple_variable_pod_sets(self):
+        run_case(
+            "partial admission multiple variable pod sets",
+            workloads=[
+                MakeWorkload("new", "sales").Queue("main")
+                .PodSets(
+                    MakePodSet("one", 20).Request("cpu", "1").Obj(),
+                    MakePodSet("two", 30).SetMinimumCount(10)
+                    .Request("cpu", "1").Obj(),
+                    MakePodSet("three", 15).SetMinimumCount(5)
+                    .Request("cpu", "1").Obj()),
+            ],
+            want_assignments={
+                "sales/new": want_admission(
+                    "sales",
+                    ("one", {"cpu": "default"}, 20),
+                    ("two", {"cpu": "default"}, 20),
+                    ("three", {"cpu": "default"}, 10)),
+            },
+            want_left={})
+
+    # scheduler_test.go:2881
+    def test_partial_admission_disabled_multiple_variable_pod_sets(self):
+        run_case(
+            "partial admission disabled, multiple variable pod sets",
+            partial_admission=False,
+            workloads=[
+                MakeWorkload("new", "sales").Queue("main")
+                .PodSets(
+                    MakePodSet("one", 20).Request("cpu", "1").Obj(),
+                    MakePodSet("two", 30).SetMinimumCount(10)
+                    .Request("cpu", "1").Obj(),
+                    MakePodSet("three", 15).SetMinimumCount(5)
+                    .Request("cpu", "1").Obj()),
+            ],
+            want_assignments={},
+            want_left={"sales": ["sales/new"]})
+
+    # scheduler_test.go:2957
+    def test_two_workloads_borrow_different_resources_same_cycle(self):
+        pre = dict(within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                   reclaim_within_cohort=PreemptionPolicy.ANY)
+
+        def rg():
+            return MakeFlavorQuotas("default") \
+                .Resource("r1", "10", "10").Resource("r2", "10", "10").Obj()
+
+        run_case(
+            "two workloads can borrow different resources from the same"
+            " flavor in the same cycle",
+            extra_cqs=[
+                MakeClusterQueue("cq1").Cohort("co").Preemption(**pre)
+                .ResourceGroup(rg()).Obj(),
+                MakeClusterQueue("cq2").Cohort("co").Preemption(**pre)
+                .ResourceGroup(rg()).Obj(),
+                MakeClusterQueue("cq3").Cohort("co").Preemption(**pre)
+                .ResourceGroup(rg()).Obj()],
+            extra_lqs=[
+                MakeLocalQueue("lq1", "sales").ClusterQueue("cq1").Obj(),
+                MakeLocalQueue("lq2", "sales").ClusterQueue("cq2").Obj(),
+                MakeLocalQueue("lq3", "sales").ClusterQueue("cq3").Obj()],
+            workloads=[
+                MakeWorkload("wl1", "sales").Queue("lq1").Priority(-1)
+                .PodSets(MakePodSet("main", 1).Request("r1", "16").Obj()),
+                MakeWorkload("wl2", "sales").Queue("lq2").Priority(-2)
+                .PodSets(MakePodSet("main", 1).Request("r2", "16").Obj()),
+            ],
+            want_assignments={
+                "sales/wl1": want_admission(
+                    "cq1", ("main", {"r1": "default"})),
+                "sales/wl2": want_admission(
+                    "cq2", ("main", {"r2": "default"})),
+            },
+            want_left={})
+
+    # scheduler_test.go:3053
+    def test_two_workloads_borrow_same_resource_fits_cohort(self):
+        pre = dict(within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                   reclaim_within_cohort=PreemptionPolicy.ANY)
+
+        def rg():
+            return MakeFlavorQuotas("default") \
+                .Resource("r1", "10", "10").Resource("r2", "10", "10").Obj()
+
+        run_case(
+            "two workloads can borrow the same resources from the same"
+            " flavor in the same cycle if fits in the cohort quota",
+            extra_cqs=[
+                MakeClusterQueue("cq1").Cohort("co").Preemption(**pre)
+                .ResourceGroup(rg()).Obj(),
+                MakeClusterQueue("cq2").Cohort("co").Preemption(**pre)
+                .ResourceGroup(rg()).Obj(),
+                MakeClusterQueue("cq3").Cohort("co").Preemption(**pre)
+                .ResourceGroup(rg()).Obj()],
+            extra_lqs=[
+                MakeLocalQueue("lq1", "sales").ClusterQueue("cq1").Obj(),
+                MakeLocalQueue("lq2", "sales").ClusterQueue("cq2").Obj(),
+                MakeLocalQueue("lq3", "sales").ClusterQueue("cq3").Obj()],
+            workloads=[
+                MakeWorkload("wl1", "sales").Queue("lq1").Priority(-1)
+                .PodSets(MakePodSet("main", 1).Request("r1", "16").Obj()),
+                MakeWorkload("wl2", "sales").Queue("lq2").Priority(-2)
+                .PodSets(MakePodSet("main", 1).Request("r1", "14").Obj()),
+            ],
+            want_assignments={
+                "sales/wl1": want_admission(
+                    "cq1", ("main", {"r1": "default"})),
+                "sales/wl2": want_admission(
+                    "cq2", ("main", {"r1": "default"})),
+            },
+            want_left={})
+
+    # scheduler_test.go:3149
+    def test_only_one_workload_can_borrow_when_cohort_cannot_fit(self):
+        pre = dict(within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                   reclaim_within_cohort=PreemptionPolicy.ANY)
+
+        def rg():
+            return MakeFlavorQuotas("default") \
+                .Resource("r1", "10", "10").Resource("r2", "10", "10").Obj()
+
+        run_case(
+            "only one workload can borrow one resources from the same"
+            " flavor in the same cycle if cohort quota cannot fit",
+            extra_cqs=[
+                MakeClusterQueue("cq1").Cohort("co").Preemption(**pre)
+                .ResourceGroup(rg()).Obj(),
+                MakeClusterQueue("cq2").Cohort("co").Preemption(**pre)
+                .ResourceGroup(rg()).Obj(),
+                MakeClusterQueue("cq3").Cohort("co").Preemption(**pre)
+                .ResourceGroup(rg()).Obj()],
+            extra_lqs=[
+                MakeLocalQueue("lq1", "sales").ClusterQueue("cq1").Obj(),
+                MakeLocalQueue("lq2", "sales").ClusterQueue("cq2").Obj(),
+                MakeLocalQueue("lq3", "sales").ClusterQueue("cq3").Obj()],
+            workloads=[
+                MakeWorkload("wl1", "sales").Queue("lq1").Priority(-1)
+                .PodSets(MakePodSet("main", 1).Request("r1", "16").Obj()),
+                MakeWorkload("wl2", "sales").Queue("lq2").Priority(-2)
+                .PodSets(MakePodSet("main", 1).Request("r1", "16").Obj()),
+            ],
+            want_assignments={
+                "sales/wl1": want_admission(
+                    "cq1", ("main", {"r1": "default"})),
+            },
+            want_left={"cq2": ["sales/wl2"]})
+
+    # scheduler_test.go:3239
+    def test_preemption_waiting_does_not_block_borrower_in_other_cq(self):
+        from kueue_tpu.api.types import (
+            BorrowWithinCohort,
+            BorrowWithinCohortPolicy,
+        )
+        bwc = BorrowWithinCohort(
+            policy=BorrowWithinCohortPolicy.LOWER_PRIORITY)
+        run_case(
+            "preemption while borrowing, workload waiting for preemption"
+            " should not block a borrowing workload in another CQ",
+            extra_cqs=[
+                MakeClusterQueue("cq_shared")
+                .Cohort("preemption-while-borrowing")
+                .ResourceGroup(MakeFlavorQuotas("default")
+                               .Resource("cpu", "4", "0").Obj()).Obj(),
+                MakeClusterQueue("cq_a")
+                .Cohort("preemption-while-borrowing")
+                .Preemption(
+                    reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY,
+                    borrow_within_cohort=bwc)
+                .ResourceGroup(MakeFlavorQuotas("default")
+                               .Resource("cpu", "0", "3").Obj()).Obj(),
+                MakeClusterQueue("cq_b")
+                .Cohort("preemption-while-borrowing")
+                .Preemption(
+                    reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY,
+                    borrow_within_cohort=bwc)
+                .ResourceGroup(MakeFlavorQuotas("default")
+                               .Resource("cpu", "0").Obj()).Obj()],
+            extra_lqs=[
+                MakeLocalQueue("lq_a", "eng-alpha")
+                .ClusterQueue("cq_a").Obj(),
+                MakeLocalQueue("lq_b", "eng-beta")
+                .ClusterQueue("cq_b").Obj()],
+            workloads=[
+                MakeWorkload("a", "eng-alpha").Queue("lq_a").Creation(1.0)
+                .PodSets(MakePodSet("main", 1).Request("cpu", "3").Obj()),
+                MakeWorkload("b", "eng-beta").Queue("lq_b").Creation(2.0)
+                .PodSets(MakePodSet("main", 1).Request("cpu", "1").Obj()),
+                MakeWorkload("admitted_a", "eng-alpha").Queue("lq_a")
+                .PodSets(MakePodSet("main", 1).Request("cpu", "2").Obj())
+                .ReserveQuota("cq_a", [{"cpu": "default"}]),
+            ],
+            want_assignments={
+                "eng-alpha/admitted_a": want_admission(
+                    "cq_a", ("main", {"cpu": "default"})),
+                "eng-beta/b": want_admission(
+                    "cq_b", ("main", {"cpu": "default"})),
+            },
+            want_inadmissible={"cq_a": ["eng-alpha/a"]})
+
+    # scheduler_test.go:3405 — victims a1+a2 (lowest priority, minimal
+    # set); they requeue synchronously here and land back in the queue.
+    def test_minimal_preemptions_when_target_queue_exhausted(self):
+        def cq(name, **pre):
+            w = MakeClusterQueue(name).Cohort("other")
+            if pre:
+                w = w.Preemption(**pre)
+            return w.ResourceGroup(
+                MakeFlavorQuotas("on-demand").Resource("cpu", "2").Obj()
+            ).Obj()
+
+        run_case(
+            "minimal preemptions when target queue is exhausted",
+            extra_cqs=[
+                cq("other-alpha",
+                   within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                   reclaim_within_cohort=PreemptionPolicy.ANY),
+                cq("other-beta"), cq("other-gamma")],
+            extra_lqs=[
+                MakeLocalQueue("other", "eng-alpha")
+                .ClusterQueue("other-alpha").Obj(),
+                MakeLocalQueue("other", "eng-beta")
+                .ClusterQueue("other-beta").Obj(),
+                MakeLocalQueue("other", "eng-gamma")
+                .ClusterQueue("other-gamma").Obj()],
+            workloads=[
+                MakeWorkload("a1", "eng-alpha").Priority(-2).Queue("other")
+                .Request("cpu", "1")
+                .ReserveQuota("other-alpha", [{"cpu": "on-demand"}]),
+                MakeWorkload("a2", "eng-alpha").Priority(-2).Queue("other")
+                .Request("cpu", "1")
+                .ReserveQuota("other-alpha", [{"cpu": "on-demand"}]),
+                MakeWorkload("a3", "eng-alpha").Priority(-1).Queue("other")
+                .Request("cpu", "1")
+                .ReserveQuota("other-alpha", [{"cpu": "on-demand"}]),
+                MakeWorkload("b1", "eng-beta").Priority(0).Queue("other")
+                .Request("cpu", "1")
+                .ReserveQuota("other-beta", [{"cpu": "on-demand"}]),
+                MakeWorkload("b2", "eng-beta").Priority(0).Queue("other")
+                .Request("cpu", "1")
+                .ReserveQuota("other-beta", [{"cpu": "on-demand"}]),
+                MakeWorkload("b3", "eng-beta").Priority(0).Queue("other")
+                .Request("cpu", "1")
+                .ReserveQuota("other-beta", [{"cpu": "on-demand"}]),
+                MakeWorkload("incoming", "eng-alpha").Priority(0)
+                .Queue("other").Request("cpu", "2"),
+            ],
+            want_assignments={
+                "eng-alpha/a3": want_admission(
+                    "other-alpha", ("main", {"cpu": "on-demand"})),
+                "eng-beta/b1": want_admission(
+                    "other-beta", ("main", {"cpu": "on-demand"})),
+                "eng-beta/b2": want_admission(
+                    "other-beta", ("main", {"cpu": "on-demand"})),
+                "eng-beta/b3": want_admission(
+                    "other-beta", ("main", {"cpu": "on-demand"})),
+            },
+            want_preempted=["eng-alpha/a1", "eng-alpha/a2"],
+            want_left={"other-alpha": ["eng-alpha/a1", "eng-alpha/a2",
+                                       "eng-alpha/incoming"]})
+
+    # scheduler_test.go:3662
+    def test_preemption_eligibility_requires_fit_within_nominal(self):
+        def cq(name, **pre):
+            w = MakeClusterQueue(name).Cohort("other")
+            if pre:
+                w = w.Preemption(**pre)
+            return w.ResourceGroup(
+                MakeFlavorQuotas("on-demand").Resource("cpu", "2").Obj()
+            ).Obj()
+
+        run_case(
+            "A workload is only eligible to do preemptions if it fits"
+            " fully within nominal quota",
+            extra_cqs=[
+                cq("other-alpha",
+                   within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                   reclaim_within_cohort=PreemptionPolicy.ANY),
+                cq("other-beta")],
+            extra_lqs=[
+                MakeLocalQueue("other", "eng-alpha")
+                .ClusterQueue("other-alpha").Obj(),
+                MakeLocalQueue("other", "eng-beta")
+                .ClusterQueue("other-beta").Obj()],
+            workloads=[
+                MakeWorkload("a1", "eng-alpha").Priority(-1).Queue("other")
+                .Request("cpu", "1")
+                .ReserveQuota("other-alpha", [{"cpu": "on-demand"}]),
+                MakeWorkload("b1", "eng-beta").Priority(-1).Queue("other")
+                .Request("cpu", "1")
+                .ReserveQuota("other-beta", [{"cpu": "on-demand"}]),
+                MakeWorkload("incoming", "eng-alpha").Priority(1)
+                .Queue("other").Request("cpu", "3"),
+            ],
+            want_assignments={
+                "eng-alpha/a1": want_admission(
+                    "other-alpha", ("main", {"cpu": "on-demand"})),
+                "eng-beta/b1": want_admission(
+                    "other-beta", ("main", {"cpu": "on-demand"})),
+            },
+            want_inadmissible={"other-alpha": ["eng-alpha/incoming"]})
+
+    # scheduler_test.go:3777
+    def test_multiple_preemptions_without_borrowing(self):
+        def cq(name):
+            return MakeClusterQueue(name).Cohort("other").Preemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+            ).ResourceGroup(
+                MakeFlavorQuotas("default").Resource("cpu", "2").Obj()
+            ).Obj()
+
+        run_case(
+            "multiple preemptions without borrowing",
+            extra_cqs=[cq("other-alpha"), cq("other-beta")],
+            extra_lqs=[
+                MakeLocalQueue("other", "eng-alpha")
+                .ClusterQueue("other-alpha").Obj(),
+                MakeLocalQueue("other", "eng-beta")
+                .ClusterQueue("other-beta").Obj()],
+            workloads=[
+                MakeWorkload("a1", "eng-alpha").Priority(0).Queue("other")
+                .Request("cpu", "2")
+                .ReserveQuota("other-alpha", [{"cpu": "default"}]),
+                MakeWorkload("b1", "eng-beta").Priority(0).Queue("other")
+                .Request("cpu", "2")
+                .ReserveQuota("other-beta", [{"cpu": "default"}]),
+                MakeWorkload("preemptor", "eng-alpha").Priority(100)
+                .Queue("other").Request("cpu", "2"),
+                MakeWorkload("preemptor", "eng-beta").Priority(100)
+                .Queue("other").Request("cpu", "2"),
+            ],
+            want_assignments={},
+            want_preempted=["eng-alpha/a1", "eng-beta/b1"],
+            want_left={"other-alpha": ["eng-alpha/a1",
+                                       "eng-alpha/preemptor"],
+                       "other-beta": ["eng-beta/b1",
+                                      "eng-beta/preemptor"]},
+            want_preemption_skips={})
+
+    # scheduler_test.go:3970
+    def test_multiple_preemptions_after_earlier_workload_fits(self):
+        run_case(
+            "multiple preemptions preemption possible after earlier"
+            " workload fits",
+            extra_cqs=[
+                MakeClusterQueue("other-alpha").Cohort("other")
+                .Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY)
+                .ResourceGroup(MakeFlavorQuotas("default")
+                               .Resource("cpu", "1").Obj()).Obj(),
+                MakeClusterQueue("other-beta").Cohort("other")
+                .Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY)
+                .ResourceGroup(MakeFlavorQuotas("default")
+                               .Resource("cpu", "2").Obj()).Obj()],
+            extra_lqs=[
+                MakeLocalQueue("other", "eng-alpha")
+                .ClusterQueue("other-alpha").Obj(),
+                MakeLocalQueue("other", "eng-beta")
+                .ClusterQueue("other-beta").Obj()],
+            workloads=[
+                MakeWorkload("b1", "eng-beta").Priority(0).Queue("other")
+                .Request("cpu", "2")
+                .ReserveQuota("other-beta", [{"cpu": "default"}]),
+                MakeWorkload("fit", "eng-alpha").Priority(100)
+                .Queue("other").Request("cpu", "1"),
+                MakeWorkload("preemptor", "eng-beta").Priority(99)
+                .Queue("other").Request("cpu", "2"),
+            ],
+            want_assignments={
+                "eng-alpha/fit": want_admission(
+                    "other-alpha", ("main", {"cpu": "default"})),
+            },
+            want_preempted=["eng-beta/b1"],
+            want_left={"other-beta": ["eng-beta/b1",
+                                      "eng-beta/preemptor"]})
+
+    # scheduler_test.go:4127 — other-beta's pretender is SKIPPED (the
+    # shared bank capacity is claimed by other-alpha's preemptor):
+    # admission_cycle_preemption_skips{other-beta} = 1.
+    def test_multiple_preemptions_skip_on_shared_limited_resource(self):
+        from kueue_tpu.api.types import (
+            BorrowWithinCohort,
+            BorrowWithinCohortPolicy,
+        )
+
+        def cq(name):
+            return MakeClusterQueue(name).Cohort("other").Preemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                borrow_within_cohort=BorrowWithinCohort(
+                    policy=BorrowWithinCohortPolicy.LOWER_PRIORITY)
+            ).ResourceGroup(
+                MakeFlavorQuotas("default").Resource("cpu", "2").Obj()
+            ).Obj()
+
+        run_case(
+            "multiple preemptions skip preemption when shared limited"
+            " resource",
+            extra_cqs=[
+                cq("other-alpha"), cq("other-beta"),
+                MakeClusterQueue("resource-bank").Cohort("other")
+                .ResourceGroup(MakeFlavorQuotas("default")
+                               .Resource("cpu", "1").Obj()).Obj()],
+            extra_lqs=[
+                MakeLocalQueue("other", "eng-alpha")
+                .ClusterQueue("other-alpha").Obj(),
+                MakeLocalQueue("other", "eng-beta")
+                .ClusterQueue("other-beta").Obj()],
+            workloads=[
+                MakeWorkload("a1", "eng-alpha").Priority(0).Queue("other")
+                .Request("cpu", "2")
+                .ReserveQuota("other-alpha", [{"cpu": "default"}]),
+                MakeWorkload("b1", "eng-beta").Priority(0).Queue("other")
+                .Request("cpu", "2")
+                .ReserveQuota("other-beta", [{"cpu": "default"}]),
+                MakeWorkload("preemptor", "eng-alpha").Priority(100)
+                .Queue("other").Request("cpu", "3"),
+                MakeWorkload("pretending-preemptor", "eng-beta")
+                .Priority(99).Queue("other").Request("cpu", "3"),
+            ],
+            want_assignments={
+                "eng-beta/b1": want_admission(
+                    "other-beta", ("main", {"cpu": "default"})),
+            },
+            want_preempted=["eng-alpha/a1"],
+            want_left={"other-alpha": ["eng-alpha/a1",
+                                       "eng-alpha/preemptor"],
+                       "other-beta": ["eng-beta/pretending-preemptor"]},
+            want_preemption_skips={"other-beta": 1})
+
+    # scheduler_test.go:4319
+    def test_not_enough_resources(self):
+        run_case(
+            "not enough resources",
+            workloads=[
+                MakeWorkload("new", "sales").Queue("main")
+                .Request("cpu", "100"),
+            ],
+            want_assignments={},
+            want_left={"sales": ["sales/new"]})

@@ -382,8 +382,9 @@ def test_serde_roundtrip_check_states_and_templates():
 
 
 def test_inadmissible_workload_not_resurrected_on_restart(tmp_path):
-    """A submit-time-inadmissible workload must stay out of the queues
-    across a journal rebuild (it is journaled deactivated)."""
+    """A namespace-selector-mismatched workload parks inadmissible at
+    NOMINATION (scheduler.go:636) and must stay parked — not admitted —
+    across a journal rebuild."""
     from kueue_tpu.store.journal import Journal
 
     eng = Engine()
@@ -397,7 +398,9 @@ def test_inadmissible_workload_not_resurrected_on_restart(tmp_path):
     eng.attach_journal(Journal(str(tmp_path / "j.jsonl")))
     wl = Workload(name="w", queue_name="lq",
                   pod_sets=(PodSet("main", 1, {"cpu": 100}),))
-    assert not eng.submit(wl)
+    assert eng.submit(wl)  # queued; validated during nomination
+    eng.schedule_once()
+    assert "default/w" in eng.queues.cluster_queues["cq"].inadmissible
 
     reb = rebuild_engine(str(tmp_path / "j.jsonl"))
     reb.schedule_once()

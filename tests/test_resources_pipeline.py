@@ -203,6 +203,10 @@ def test_engine_rejects_limitrange_violation_and_admits_adjusted():
 
 
 def test_namespace_selector_mismatch():
+    """Namespace-selector validation runs at NOMINATION (scheduler.go:636),
+    not submit: a mismatched workload queues, parks inadmissible under
+    its CQ (RequeueReasonNamespaceMismatch), and becomes admittable once
+    the namespace labels change and a cluster event requeues it."""
     eng = Engine()
     eng.create_resource_flavor(ResourceFlavor("default"))
     eng.create_cluster_queue(ClusterQueue(
@@ -213,11 +217,15 @@ def test_namespace_selector_mismatch():
     eng.create_local_queue(LocalQueue("lq", "default", "cq"))
     wl = Workload(name="w", queue_name="lq",
                   pod_sets=(PodSet("main", 1, {"cpu": 100}),))
-    assert not eng.submit(wl)
+    assert eng.submit(wl)  # queued; validated during nomination
+    eng.schedule_once()
+    assert not wl.is_admitted
+    pcq = eng.queues.cluster_queues["cq"]
+    assert "default/w" in pcq.inadmissible
     eng.set_namespace_labels("default", {"team": "ml"})
-    wl2 = Workload(name="w2", queue_name="lq",
-                   pod_sets=(PodSet("main", 1, {"cpu": 100}),))
-    assert eng.submit(wl2)
+    eng.queues.queue_inadmissible_workloads({"cq"})
+    eng.schedule_once()
+    assert wl.is_admitted
 
 
 def test_transformation_multiply_by_retains_scaled_input():
