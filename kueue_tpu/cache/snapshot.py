@@ -190,6 +190,14 @@ class DRS:
     def is_borrowing(self) -> bool:
         return self.borrowing
 
+    def is_borrowing_on(self, requested_frs) -> bool:
+        """fair_sharing.go:76 (IsBorrowingOn): borrowing on any
+        FlavorResource positively present in ``requested_frs``."""
+        if not requested_frs:
+            return False
+        return any(requested_frs.get(fr, 0) > 0
+                   for fr in self.borrowed_frs)
+
     def _zero_weight_borrows(self) -> bool:
         return self.fair_weight == 0 and not self.is_zero()
 

@@ -453,11 +453,13 @@ class AdmittedRows:
         row = self.usage[i]
         row[:] = 0
         S = self._S
+        from kueue_tpu.api.types import INF
         for fr, v in info.usage().items():
             fi = self._fl_idx.get(fr.flavor)
             si = self._s_idx.get(fr.resource)
             if fi is not None and si is not None:
-                row[fi * S + si] = v
+                # INF saturation (see schema.encode_podset_requests).
+                row[fi * S + si] = v if v < INF else INF
 
     def sync(self, cache, now: float):
         """Apply the cache's admitted-change log; returns the (possibly

@@ -436,7 +436,10 @@ def encode_admitted(world: WorldTensors, infos: list,
         evicted[i] = info.obj.is_evicted
         for fr, v in info.usage().items():
             if fr.flavor in fl_idx and fr.resource in s_idx:
-                usage[i, fl_idx[fr.flavor] * S + s_idx[fr.resource]] = v
+                # INF saturation, like encode_podset_requests: unbounded
+                # host ints would overflow the int64 grid.
+                usage[i, fl_idx[fr.flavor] * S + s_idx[fr.resource]] = \
+                    v if v < INF else INF
     uid_rank = np.empty(A, np.int64)
     uid_rank[np.argsort(np.asarray(uids, dtype=object))] = np.arange(A)
     return AdmittedTensors(
@@ -466,7 +469,11 @@ def encode_podset_requests(info, ci: int, world, s_idx: dict,
                 if q > 0:
                     ok = False
                 continue
-            out[p, si] = q
+            # Saturate at the INF sentinel: unbounded host-side ints
+            # would wrap in the kernels' int64 arithmetic (the
+            # reference's MaxInt64 overflow guards), flipping an
+            # impossible request into a negative fitting one.
+            out[p, si] = q if q < INF else INF
     return ok
 
 
@@ -480,10 +487,14 @@ def dense_path_eligible(info) -> bool:
     the padded podset axis with within-workload usage accumulation).
     Ineligible: more podsets than the cap, partial admission
     (min_count), topology requests, node selectors/affinity,
-    tolerations, and explicit zero-quantity requests (Go assigns
+    tolerations, explicit zero-quantity requests (Go assigns
     flavors/borrow levels to those; the dense encoding cannot
-    distinguish explicit-zero from absent)."""
+    distinguish explicit-zero from absent), and elastic workload-slice
+    replacements (the host path owns ReplacedWorkloadSlice's freed-usage
+    fit and old-slice finish, scheduler.go:765)."""
     if len(info.total_requests) > MAX_FAST_PODSETS:
+        return False
+    if info.obj.replaced_workload_slice is not None:
         return False
     for p, psr in enumerate(info.total_requests):
         ps = info.obj.pod_sets[p]

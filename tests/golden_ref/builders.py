@@ -43,8 +43,10 @@ Mi = 1024 * Ki
 Gi = 1024 * Mi
 
 _SUFFIX = {
-    "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12,
+    "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15,
+    "E": 10**18,
     "Ki": Ki, "Mi": Mi, "Gi": Gi, "Ti": 1024 * Gi,
+    "Pi": 1024 ** 5, "Ei": 1024 ** 6,
 }
 
 
@@ -53,7 +55,7 @@ def parse_quantity(s: str | int | float) -> float:
     if isinstance(s, (int, float)):
         return float(s)
     s = s.strip()
-    m = re.fullmatch(r"(-?\d+(?:\.\d+)?)(m|[kMGT]i?)?", s)
+    m = re.fullmatch(r"(-?\d+(?:\.\d+)?)(m|[kMGTPE]i?)?", s)
     if not m:
         raise ValueError(f"unparseable quantity {s!r}")
     val = float(m.group(1))
@@ -356,6 +358,9 @@ class WorkloadWrapper:
                                         list[int]]] = None
         self._admitted_at = 0.0
         self._reclaimable: dict[str, int] = {}
+        self._gates: tuple = ()
+        self._replaced_slice: Optional[str] = None
+        self._simple_flavor: Optional[str] = None
 
     def PodSets(self, *ps: PodSet) -> "WorkloadWrapper":
         self._podsets.extend(ps)
@@ -384,6 +389,15 @@ class WorkloadWrapper:
         self._reclaimable.update(counts)
         return self
 
+    def PreemptionGates(self, *names: str) -> "WorkloadWrapper":
+        self._gates = tuple(names)
+        return self
+
+    def WorkloadSliceReplacementFor(self, key: str) -> "WorkloadWrapper":
+        """workloadslicing.WorkloadSliceReplacementFor annotation."""
+        self._replaced_slice = key
+        return self
+
     def ReserveQuota(self, cq: str,
                      flavors: Optional[list[dict[str, str]]] = None,
                      counts: Optional[list[int]] = None
@@ -399,12 +413,22 @@ class WorkloadWrapper:
         self._admitted_at = at
         return self.ReserveQuota(cq, flavors)
 
+    def SimpleReserveQuota(self, cq: str, flavor: str,
+                           at: float = 0.0) -> "WorkloadWrapper":
+        """utiltestingapi SimpleReserveQuota: every resource on one
+        flavor."""
+        self._admitted_at = at
+        self._simple_flavor = flavor
+        return self.ReserveQuota(cq)
+
     def Obj(self) -> Workload:
         WorkloadWrapper._counter += 1
         wl = Workload(
             name=self._name, namespace=self._namespace,
             queue_name=self._queue, pod_sets=tuple(self._podsets),
             priority=self._priority,
+            preemption_gates=self._gates,
+            replaced_workload_slice=self._replaced_slice,
             creation_time=self._creation or float(WorkloadWrapper._counter))
         if self._reclaimable:
             wl.status.reclaimable_pods = dict(self._reclaimable)
@@ -420,9 +444,10 @@ class WorkloadWrapper:
         if admission is not None:
             from kueue_tpu.api.types import WorkloadConditionType as WCT
             _, flavors, counts = admission
+            default_fl = self._simple_flavor or "default"
             for i, psr in enumerate(info.total_requests):
                 fl = flavors[i] if i < len(flavors) else {}
-                psr.flavors = {r: fl.get(r, "default")
+                psr.flavors = {r: fl.get(r, default_fl)
                                for r in psr.requests}
                 if counts and i < len(counts):
                     psr.count = counts[i]

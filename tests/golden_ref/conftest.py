@@ -1,0 +1,16 @@
+"""Golden-tier conftest: every case world compiles its own device
+programs (distinct world shapes), which adds hundreds of live XLA:CPU
+executables to the process; past ~600 the backend segfaults during a
+later compile. Dropping the jit caches after each golden module bounds
+the live-executable count — later suites recompile their own programs
+(fast, and served from the persistent compile cache)."""
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_after_module():
+    yield
+    import jax
+
+    jax.clear_caches()

@@ -190,3 +190,28 @@ def run_schedule_case(*, case: str, want_assignments: dict,
     assert outs["device"] == got, (
         f"{prefix}device/host divergence:\n device {outs['device']}\n"
         f" host   {got}")
+
+
+def run_two_cycle_case(*, case: str, delete_between=(),
+                       want_assignments: dict, **world) -> None:
+    """TestLastSchedulingContext driver (scheduler_test.go:6929): one
+    schedule cycle, delete the named workloads, a second cycle, then
+    assert the cache's admissions — the flavor-retry state
+    (LastAssignment / FlavorFungibility) must carry across the cycles.
+    Runs on both the sequential engine and the device path, which must
+    produce identical full observables (the differential gate)."""
+    outs = {}
+    for mode in ("host", "device"):
+        eng = build_engine(oracle=(mode == "device"), **world)
+        eng.schedule_once()
+        for key in delete_between:
+            eng.finish(key)
+        eng.schedule_once()
+        outs[mode] = observe(eng, None)
+        got = outs[mode]["assignments"]
+        assert got == dict(want_assignments), (
+            f"[{case}] ({mode}) assignments:\n got {got}\n"
+            f" want {dict(want_assignments)}")
+    assert outs["device"] == outs["host"], (
+        f"[{case}] device/host divergence:\n device {outs['device']}\n"
+        f" host   {outs['host']}")

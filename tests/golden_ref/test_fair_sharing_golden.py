@@ -1,0 +1,698 @@
+"""Golden fixtures transliterated from the reference's DRS tables:
+pkg/cache/scheduler/fair_sharing_test.go (TestDominantResourceShare, 16
+cases + TestIsBorrowingOn, 5 cases). The driver mirrors the Go one —
+build cache+snapshot, inject usage at "cq", compute
+dominantResourceShare per node with the candidate workload's
+FlavorResourceQuantities — and compares the Go-authored
+(name, node-type, rounded weighted share, dominant resource, borrowing)
+tuples."""
+
+import math
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kueue_tpu.api.types import FlavorResource  # noqa: E402
+from kueue_tpu.cache.snapshot import build_snapshot  # noqa: E402
+
+from .builders import (  # noqa: E402
+    MakeClusterQueue,
+    MakeCohort,
+    MakeFlavorQuotas,
+    MakeResourceFlavor,
+)
+
+MAXINT = 2**63 - 1
+CQ, COHORT = "cq-node", "cohort-node"
+
+
+def fr(flavor, resource):
+    return FlavorResource(flavor, resource)
+
+
+def rounded(drs):
+    """fair_sharing.go:124 (roundedWeightedShare)."""
+    if drs._zero_weight_borrows():
+        return MAXINT
+    return int(math.ceil(drs.precise_weighted_share()))
+
+
+def run_drs_case(case, *, usage, cluster_queue, lending_cluster_queue=None,
+                 cohorts=(), flv_res_q=None, want):
+    flavors = [MakeResourceFlavor("default").Obj(),
+               MakeResourceFlavor("on-demand").Obj(),
+               MakeResourceFlavor("spot").Obj()]
+    cqs = [cluster_queue]
+    if lending_cluster_queue is not None:
+        cqs.append(lending_cluster_queue)
+    declared = {c.name for c in cohorts}
+    cohort_objs = list(cohorts)
+    for cq in cqs:
+        if cq.cohort and cq.cohort not in declared:
+            declared.add(cq.cohort)
+            cohort_objs.append(MakeCohort(cq.cohort).Obj())
+    snap = build_snapshot(cqs, cohort_objs, flavors, [])
+    snap.cluster_queue("cq").add_usage(dict(usage))
+    got = set()
+    for name, node in snap.cluster_queues.items():
+        drs = node.dominant_resource_share(flv_res_q)
+        got.add((name, CQ, rounded(drs), drs.dominant_resource,
+                 drs.is_borrowing()))
+    for name, node in snap.cohorts.items():
+        drs = node.dominant_resource_share(flv_res_q)
+        got.add((name, COHORT, rounded(drs), drs.dominant_resource,
+                 drs.is_borrowing()))
+    assert got == set(want), (
+        f"[{case}]\n got  {sorted(got)}\n want {sorted(set(want))}")
+
+
+def std_pair(cq_quota, lending_quota, cq_weight=1.0, lending_weight=1.0):
+    """The repeated two-CQ cohort world of the Go table."""
+    cqw = MakeClusterQueue("cq").Cohort("test-cohort") \
+        .FairWeight(cq_weight).ResourceGroup(cq_quota).Obj()
+    lw = MakeClusterQueue("lending-cq").Cohort("test-cohort") \
+        .FairWeight(lending_weight).ResourceGroup(lending_quota).Obj()
+    return cqw, lw
+
+
+class TestDominantResourceShare:
+    # fair_sharing_test.go:61
+    def test_no_cohort(self):
+        run_drs_case(
+            "no cohort",
+            usage={fr("default", "cpu"): 1_000,
+                   fr("default", "example.com/gpu"): 2},
+            cluster_queue=MakeClusterQueue("cq").ResourceGroup(
+                MakeFlavorQuotas("default")
+                .Resource("cpu", "2000")
+                .Resource("example.com/gpu", "5").Obj()).Obj(),
+            want=[("cq", CQ, 0, "", False)])
+
+    # fair_sharing_test.go:83
+    def test_usage_below_nominal(self):
+        cq, lend = std_pair(
+            MakeFlavorQuotas("default").Resource("cpu", "2")
+            .Resource("example.com/gpu", "5").Obj(),
+            MakeFlavorQuotas("default").Resource("cpu", "8")
+            .Resource("example.com/gpu", "5").Obj())
+        run_drs_case(
+            "usage below nominal",
+            usage={fr("default", "cpu"): 1_000,
+                   fr("default", "example.com/gpu"): 2},
+            cluster_queue=cq, lending_cluster_queue=lend,
+            want=[("cq", CQ, 0, "", False),
+                  ("lending-cq", CQ, 0, "", False),
+                  ("test-cohort", COHORT, 0, "", False)])
+
+    # fair_sharing_test.go:130
+    def test_usage_above_nominal(self):
+        cq, lend = std_pair(
+            MakeFlavorQuotas("default").Resource("cpu", "2")
+            .Resource("example.com/gpu", "5").Obj(),
+            MakeFlavorQuotas("default").Resource("cpu", "8")
+            .Resource("example.com/gpu", "5").Obj())
+        run_drs_case(
+            "usage above nominal",
+            usage={fr("default", "cpu"): 3_000,
+                   fr("default", "example.com/gpu"): 7},
+            cluster_queue=cq, lending_cluster_queue=lend,
+            want=[("cq", CQ, 200, "example.com/gpu", True),
+                  ("lending-cq", CQ, 0, "", False),
+                  ("test-cohort", COHORT, 0, "", False)])
+
+    # fair_sharing_test.go:177
+    def test_usage_slightly_above_nominal_large_quotas(self):
+        cq, lend = std_pair(
+            MakeFlavorQuotas("default")
+            .Resource("example.com/gpu", "500").Obj(),
+            MakeFlavorQuotas("default")
+            .Resource("example.com/gpu", "1000").Obj(),
+            cq_weight=1.0, lending_weight=300.0)
+        run_drs_case(
+            "usage slightly above nominal in a cohort with large quotas",
+            usage={fr("default", "example.com/gpu"): 501},
+            cluster_queue=cq, lending_cluster_queue=lend,
+            want=[("cq", CQ, 1, "example.com/gpu", True),
+                  ("lending-cq", CQ, 0, "", False),
+                  ("test-cohort", COHORT, 0, "", False)])
+
+    # fair_sharing_test.go:221
+    def test_usage_way_above_nominal_large_quotas_and_weights(self):
+        cq, lend = std_pair(
+            MakeFlavorQuotas("default")
+            .Resource("example.com/gpu", "500").Obj(),
+            MakeFlavorQuotas("default")
+            .Resource("example.com/gpu", "1000").Obj(),
+            cq_weight=300.0, lending_weight=300.0)
+        run_drs_case(
+            "usage way above nominal in a cohort with large quotas and"
+            " weights",
+            usage={fr("default", "example.com/gpu"): 800},
+            cluster_queue=cq, lending_cluster_queue=lend,
+            want=[("cq", CQ, 1, "example.com/gpu", True),
+                  ("lending-cq", CQ, 0, "", False),
+                  ("test-cohort", COHORT, 0, "", False)])
+
+    # fair_sharing_test.go:265
+    def test_one_resource_above_nominal(self):
+        cq, lend = std_pair(
+            MakeFlavorQuotas("default").Resource("cpu", "2")
+            .Resource("example.com/gpu", "5").Obj(),
+            MakeFlavorQuotas("default").Resource("cpu", "8")
+            .Resource("example.com/gpu", "5").Obj())
+        run_drs_case(
+            "one resource above nominal",
+            usage={fr("default", "cpu"): 3_000,
+                   fr("default", "example.com/gpu"): 3},
+            cluster_queue=cq, lending_cluster_queue=lend,
+            want=[("cq", CQ, 100, "cpu", True),
+                  ("lending-cq", CQ, 0, "", False),
+                  ("test-cohort", COHORT, 0, "", False)])
+
+    # fair_sharing_test.go:312
+    def test_usage_with_workload_above_nominal(self):
+        cq, lend = std_pair(
+            MakeFlavorQuotas("default").Resource("cpu", "2")
+            .Resource("example.com/gpu", "5").Obj(),
+            MakeFlavorQuotas("default").Resource("cpu", "8")
+            .Resource("example.com/gpu", "5").Obj())
+        run_drs_case(
+            "usage with workload above nominal",
+            usage={fr("default", "cpu"): 1_000,
+                   fr("default", "example.com/gpu"): 2},
+            cluster_queue=cq, lending_cluster_queue=lend,
+            flv_res_q={fr("default", "cpu"): 4_000,
+                       fr("default", "example.com/gpu"): 4},
+            want=[("cq", CQ, 300, "cpu", True),
+                  ("lending-cq", CQ, 0, "", False),
+                  ("test-cohort", COHORT, 0, "", False)])
+
+    # fair_sharing_test.go:363
+    def test_resource_with_zero_lendable(self):
+        cq, lend = std_pair(
+            MakeFlavorQuotas("default").Resource("cpu", "2")
+            .Resource("example.com/gpu", "2", None, "0").Obj(),
+            MakeFlavorQuotas("default").Resource("cpu", "8")
+            .Resource("example.com/gpu", "64", None, "0").Obj())
+        run_drs_case(
+            "A resource with zero lendable",
+            usage={fr("default", "cpu"): 1_000,
+                   fr("default", "example.com/gpu"): 1},
+            cluster_queue=cq, lending_cluster_queue=lend,
+            flv_res_q={fr("default", "cpu"): 4_000,
+                       fr("default", "example.com/gpu"): 4},
+            want=[("cq", CQ, 300, "cpu", True),
+                  ("lending-cq", CQ, 0, "", False),
+                  ("test-cohort", COHORT, 0, "", False)])
+
+    # fair_sharing_test.go:414
+    def test_multiple_flavors(self):
+        cq = MakeClusterQueue("cq").Cohort("test-cohort").FairWeight(1.0) \
+            .ResourceGroup(
+                MakeFlavorQuotas("on-demand").Resource("cpu", "20").Obj(),
+                MakeFlavorQuotas("spot").Resource("cpu", "80").Obj()).Obj()
+        lend = MakeClusterQueue("lending-cq").Cohort("test-cohort") \
+            .FairWeight(1.0).ResourceGroup(
+                MakeFlavorQuotas("default").Resource("cpu", "100").Obj()
+            ).Obj()
+        run_drs_case(
+            "multiple flavors",
+            usage={fr("on-demand", "cpu"): 15_000,
+                   fr("spot", "cpu"): 5_000},
+            cluster_queue=cq, lending_cluster_queue=lend,
+            flv_res_q={fr("on-demand", "cpu"): 10_000},
+            want=[("cq", CQ, 25, "cpu", True),
+                  ("lending-cq", CQ, 0, "", False),
+                  ("test-cohort", COHORT, 0, "", False)])
+
+    # fair_sharing_test.go:465
+    def test_above_nominal_with_integer_weight(self):
+        cq, lend = std_pair(
+            MakeFlavorQuotas("default")
+            .Resource("example.com/gpu", "5").Obj(),
+            MakeFlavorQuotas("default")
+            .Resource("example.com/gpu", "5").Obj(),
+            cq_weight=2.0)
+        run_drs_case(
+            "above nominal with integer weight",
+            usage={fr("default", "example.com/gpu"): 7},
+            cluster_queue=cq, lending_cluster_queue=lend,
+            want=[("cq", CQ, 100, "example.com/gpu", True),
+                  ("lending-cq", CQ, 0, "", False),
+                  ("test-cohort", COHORT, 0, "", False)])
+
+    # fair_sharing_test.go:509
+    def test_above_nominal_with_decimal_weight(self):
+        cq, lend = std_pair(
+            MakeFlavorQuotas("default")
+            .Resource("example.com/gpu", "5").Obj(),
+            MakeFlavorQuotas("default")
+            .Resource("example.com/gpu", "5").Obj(),
+            cq_weight=0.5)
+        run_drs_case(
+            "above nominal with decimal weight",
+            usage={fr("default", "example.com/gpu"): 7},
+            cluster_queue=cq, lending_cluster_queue=lend,
+            want=[("cq", CQ, 400, "example.com/gpu", True),
+                  ("lending-cq", CQ, 0, "", False),
+                  ("test-cohort", COHORT, 0, "", False)])
+
+    # fair_sharing_test.go:553
+    def test_above_nominal_with_zero_weight(self):
+        cq, lend = std_pair(
+            MakeFlavorQuotas("default")
+            .Resource("example.com/gpu", "5").Obj(),
+            MakeFlavorQuotas("default")
+            .Resource("example.com/gpu", "10").Obj(),
+            cq_weight=0.0)
+        run_drs_case(
+            "above nominal with zero weight",
+            usage={fr("default", "example.com/gpu"): 7},
+            cluster_queue=cq, lending_cluster_queue=lend,
+            want=[("cq", CQ, MAXINT, "example.com/gpu", True),
+                  ("lending-cq", CQ, 0, "", False),
+                  ("test-cohort", COHORT, 0, "", False)])
+
+    # fair_sharing_test.go:597
+    def test_cohort_has_resource_share(self):
+        run_drs_case(
+            "cohort has resource share",
+            usage={fr("default", "example.com/gpu"): 10},
+            cluster_queue=MakeClusterQueue("cq").Cohort("child-cohort")
+            .FairWeight(1.0).ResourceGroup(
+                MakeFlavorQuotas("default")
+                .Resource("example.com/gpu", "5").Obj()).Obj(),
+            cohorts=[
+                MakeCohort("child-cohort").FairWeight(2.0)
+                .Parent("root").Obj(),
+                MakeCohort("root").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("example.com/gpu", "45").Obj()).Obj()],
+            want=[("cq", CQ, 100, "example.com/gpu", True),
+                  ("child-cohort", COHORT, 50, "example.com/gpu", True),
+                  ("root", COHORT, 0, "", False)])
+
+    # fair_sharing_test.go:641
+    def test_resource_share_only_at_root_cohort(self):
+        run_drs_case(
+            "resource share defined for resources only available at the"
+            " root cohort",
+            usage={fr("default", "example.com/gpu"): 10},
+            cluster_queue=MakeClusterQueue("cq").Cohort("child-cohort")
+            .FairWeight(1.0).ResourceGroup(
+                MakeFlavorQuotas("default")
+                .Resource("example.com/gpu", "0").Obj()).Obj(),
+            cohorts=[
+                MakeCohort("child-cohort").FairWeight(2.0)
+                .Parent("root").Obj(),
+                MakeCohort("root").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("example.com/gpu", "50").Obj()).Obj()],
+            want=[("cq", CQ, 200, "example.com/gpu", True),
+                  ("child-cohort", COHORT, 100, "example.com/gpu", True),
+                  ("root", COHORT, 0, "", False)])
+
+    # fair_sharing_test.go:685
+    def test_resource_share_affected_by_borrowing_limit(self):
+        run_drs_case(
+            "resource share affected by borrowing limit",
+            usage={fr("default", "example.com/gpu"): 10},
+            cluster_queue=MakeClusterQueue("cq").Cohort("child-cohort")
+            .ResourceGroup(
+                MakeFlavorQuotas("default")
+                .Resource("example.com/gpu", "0").Obj()).Obj(),
+            cohorts=[
+                MakeCohort("child-cohort").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("example.com/gpu", "0", "10").Obj())
+                .Parent("root").Obj(),
+                MakeCohort("root").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("example.com/gpu", "50").Obj()).Obj()],
+            want=[("cq", CQ, 1000, "example.com/gpu", True),
+                  ("child-cohort", COHORT, 200, "example.com/gpu", True),
+                  ("root", COHORT, 0, "", False)])
+
+    # fair_sharing_test.go:741
+    def test_borrowing_against_unlimited_lendable_capacity(self):
+        cq, lend = std_pair(
+            MakeFlavorQuotas("default").Resource("cpu", "0").Obj(),
+            MakeFlavorQuotas("default").Resource("cpu", "1E").Obj())
+        run_drs_case(
+            "borrowing against unlimited lendable capacity"
+            " (exabyte-scale quota)",
+            usage={fr("default", "cpu"): 1_000},
+            cluster_queue=cq, lending_cluster_queue=lend,
+            want=[("cq", CQ, 1, "cpu", True),
+                  ("lending-cq", CQ, 0, "", False),
+                  ("test-cohort", COHORT, 0, "", False)])
+
+
+class TestIsBorrowingOn:
+    # fair_sharing_test.go:888 (TestIsBorrowingOn) — the fixed two-CQ
+    # world: cq cpu=2 gpu=5, lending-cq cpu=8 gpu=5.
+    def _drs(self, usage):
+        flavors = [MakeResourceFlavor("default").Obj()]
+        cq = MakeClusterQueue("cq").Cohort("cohort").FairWeight(1.0) \
+            .ResourceGroup(MakeFlavorQuotas("default")
+                           .Resource("cpu", "2")
+                           .Resource("example.com/gpu", "5").Obj()).Obj()
+        lend = MakeClusterQueue("lending-cq").Cohort("cohort") \
+            .ResourceGroup(MakeFlavorQuotas("default")
+                           .Resource("cpu", "8")
+                           .Resource("example.com/gpu", "5").Obj()).Obj()
+        snap = build_snapshot([cq, lend], [MakeCohort("cohort").Obj()],
+                              flavors, [])
+        snap.cluster_queue("cq").add_usage(dict(usage))
+        return snap.cluster_queue("cq").dominant_resource_share(None)
+
+    def test_borrows_on_requested_flavor(self):
+        drs = self._drs({fr("default", "cpu"): 3_000})
+        assert drs.is_borrowing()
+        assert drs.is_borrowing_on({fr("default", "cpu"): 1_000})
+
+    def test_borrows_on_unrequested_flavor_only(self):
+        drs = self._drs({fr("default", "cpu"): 1_000,
+                         fr("default", "example.com/gpu"): 7})
+        assert drs.is_borrowing()
+        assert not drs.is_borrowing_on({fr("default", "cpu"): 1_000})
+
+    def test_borrows_on_both_requests_one(self):
+        drs = self._drs({fr("default", "cpu"): 3_000,
+                         fr("default", "example.com/gpu"): 7})
+        assert drs.is_borrowing()
+        assert drs.is_borrowing_on({fr("default", "example.com/gpu"): 1})
+
+    def test_no_borrowing(self):
+        drs = self._drs({fr("default", "cpu"): 1_000,
+                         fr("default", "example.com/gpu"): 2})
+        assert not drs.is_borrowing()
+        assert not drs.is_borrowing_on({fr("default", "cpu"): 1_000})
+
+    def test_nil_requested_frs(self):
+        drs = self._drs({fr("default", "cpu"): 3_000})
+        assert drs.is_borrowing()
+        assert not drs.is_borrowing_on(None)
+
+
+class TestMakeClusterQueueOrdering:
+    """preemption/fairsharing/ordering_test.go
+    (TestMakeClusterQueueOrdering, 6 cases) against the repo's
+    _TargetCQOrdering (scheduler/preemption.py)."""
+
+    def run_ordering_case(self, case, *, cluster_queues, cohorts=(),
+                          admitted, preemptor_cq, candidate_cqs,
+                          actions=(), want_order):
+        from kueue_tpu.scheduler.preemption import _TargetCQOrdering
+
+        flavors = [MakeResourceFlavor("default").Obj()]
+        declared = {c.name for c in cohorts}
+        cohort_objs = list(cohorts)
+        for cq in cluster_queues:
+            if cq.cohort and cq.cohort not in declared:
+                declared.add(cq.cohort)
+                cohort_objs.append(MakeCohort(cq.cohort).Obj())
+        infos = [ww.Info() for ww in admitted]
+        snap = build_snapshot(cluster_queues, cohort_objs, flavors, infos)
+        cand_set = set(candidate_cqs)
+        candidates = [i for i in infos if i.cluster_queue in cand_set]
+        ordering = _TargetCQOrdering(
+            snap.cluster_queue(preemptor_cq), candidates, now=0.0)
+        got = []
+        action_idx = 0
+        for target in ordering.iterate():
+            got.append(target.target_cq.name)
+            if action_idx < len(actions) and actions[action_idx] == "drop":
+                ordering.drop_queue(target)
+            else:
+                target.pop()
+            action_idx += 1
+            assert len(got) <= 50, f"[{case}] infinite loop"
+        assert got == list(want_order), (
+            f"[{case}] got {got}, want {list(want_order)}")
+
+    # ordering_test.go "no cohort: preemptor CQ yielded for in-CQ
+    # preemption; repro for nil pointer panic issue"
+    def test_no_cohort_preemptor_yielded(self):
+        from .builders import MakeWorkload
+        self.run_ordering_case(
+            "no cohort: preemptor CQ yielded for in-CQ preemption",
+            cluster_queues=[
+                MakeClusterQueue("preemptor").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("cpu", "4").Obj()).Obj()],
+            admitted=[
+                MakeWorkload("wl1", "ns").Request("cpu", "1")
+                .SimpleReserveQuota("preemptor", "default")],
+            preemptor_cq="preemptor",
+            candidate_cqs=["preemptor"],
+            want_order=["preemptor"])
+
+    # ordering_test.go "non-borrowing CQ is pruned even with candidates"
+    def test_non_borrowing_cq_pruned(self):
+        from .builders import MakeWorkload
+        self.run_ordering_case(
+            "non-borrowing CQ is pruned even with candidates",
+            cluster_queues=[
+                MakeClusterQueue("preemptor").Cohort("all").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("cpu", "4").Obj()).Obj(),
+                MakeClusterQueue("target").Cohort("all").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("cpu", "5").Obj()).Obj()],
+            admitted=[
+                MakeWorkload("t1", "ns").Request("cpu", "2")
+                .SimpleReserveQuota("target", "default")],
+            preemptor_cq="preemptor",
+            candidate_cqs=["target"],
+            want_order=[])
+
+    # ordering_test.go "higher DRS CQ returned before lower DRS CQ"
+    def test_higher_drs_first(self):
+        from .builders import MakeWorkload
+        self.run_ordering_case(
+            "higher DRS CQ returned before lower DRS CQ",
+            cluster_queues=[
+                MakeClusterQueue("preemptor").Cohort("all").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("cpu", "4").Obj()).Obj(),
+                MakeClusterQueue("high").Cohort("all").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("cpu", "2").Obj()).Obj(),
+                MakeClusterQueue("low").Cohort("all").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("cpu", "2").Obj()).Obj()],
+            admitted=[
+                MakeWorkload("h1", "ns").Request("cpu", "5")
+                .SimpleReserveQuota("high", "default"),
+                MakeWorkload("l1", "ns").Request("cpu", "3")
+                .SimpleReserveQuota("low", "default")],
+            preemptor_cq="preemptor",
+            candidate_cqs=["high", "low"],
+            want_order=["high", "low"])
+
+    # ordering_test.go "CQ with highest DRS returned again while it
+    # still has candidates"
+    def test_highest_drs_returned_again(self):
+        from .builders import MakeWorkload
+        self.run_ordering_case(
+            "CQ with highest DRS returned again while it still has"
+            " candidates",
+            cluster_queues=[
+                MakeClusterQueue("preemptor").Cohort("all").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("cpu", "4").Obj()).Obj(),
+                MakeClusterQueue("high").Cohort("all").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("cpu", "2").Obj()).Obj(),
+                MakeClusterQueue("low").Cohort("all").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("cpu", "2").Obj()).Obj()],
+            admitted=[
+                MakeWorkload("h1", "ns").Request("cpu", "3")
+                .SimpleReserveQuota("high", "default"),
+                MakeWorkload("h2", "ns").Request("cpu", "2")
+                .SimpleReserveQuota("high", "default"),
+                MakeWorkload("l1", "ns").Request("cpu", "3")
+                .SimpleReserveQuota("low", "default")],
+            preemptor_cq="preemptor",
+            candidate_cqs=["high", "low"],
+            want_order=["high", "high", "low"])
+
+    # ordering_test.go "drop queue prevents CQ from being returned again"
+    def test_drop_queue(self):
+        from .builders import MakeWorkload
+        self.run_ordering_case(
+            "drop queue prevents CQ from being returned again",
+            cluster_queues=[
+                MakeClusterQueue("preemptor").Cohort("all").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("cpu", "4").Obj()).Obj(),
+                MakeClusterQueue("high").Cohort("all").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("cpu", "2").Obj()).Obj(),
+                MakeClusterQueue("low").Cohort("all").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("cpu", "2").Obj()).Obj()],
+            admitted=[
+                MakeWorkload("h1", "ns").Request("cpu", "3")
+                .SimpleReserveQuota("high", "default"),
+                MakeWorkload("h2", "ns").Request("cpu", "2")
+                .SimpleReserveQuota("high", "default"),
+                MakeWorkload("l1", "ns").Request("cpu", "3")
+                .SimpleReserveQuota("low", "default")],
+            preemptor_cq="preemptor",
+            candidate_cqs=["high", "low"],
+            actions=["drop", "pop"],
+            want_order=["high", "low"])
+
+    # ordering_test.go "hierarchical cohorts: higher-DRS subtree visited
+    # first"
+    def test_hierarchical_higher_drs_subtree_first(self):
+        from .builders import MakeWorkload
+        self.run_ordering_case(
+            "hierarchical cohorts: higher-DRS subtree visited first",
+            cluster_queues=[
+                MakeClusterQueue("preemptor-cq").Cohort("root")
+                .ResourceGroup(MakeFlavorQuotas("default")
+                               .Resource("cpu", "4").Obj()).Obj(),
+                MakeClusterQueue("left-cq").Cohort("left-cohort")
+                .ResourceGroup(MakeFlavorQuotas("default")
+                               .Resource("cpu", "2").Obj()).Obj(),
+                MakeClusterQueue("right-cq").Cohort("right-cohort")
+                .ResourceGroup(MakeFlavorQuotas("default")
+                               .Resource("cpu", "2").Obj()).Obj()],
+            cohorts=[
+                MakeCohort("root").Obj(),
+                MakeCohort("left-cohort").Parent("root").Obj(),
+                MakeCohort("right-cohort").Parent("root").Obj()],
+            admitted=[
+                MakeWorkload("lc1", "ns").Request("cpu", "5")
+                .SimpleReserveQuota("left-cq", "default"),
+                MakeWorkload("rc1", "ns").Request("cpu", "3")
+                .SimpleReserveQuota("right-cq", "default")],
+            preemptor_cq="preemptor-cq",
+            candidate_cqs=["left-cq", "right-cq"],
+            want_order=["left-cq", "right-cq"])
+
+
+class TestResourcesToReserve:
+    """scheduler_test.go:8241 (TestResourcesToReserve, 6 cases): the
+    reserve-capacity quantities for preempt-mode entries
+    (scheduler.go:708 quotaResourcesToReserve) against the repo's
+    SchedulerCycle._quota_to_reserve."""
+
+    def run_reserve_case(self, case, *, mode, borrowing, assignment_usage,
+                         cq_usage, want):
+        from kueue_tpu.scheduler.cycle import Entry, SchedulerCycle
+        from kueue_tpu.scheduler.flavorassigner import Assignment
+        from kueue_tpu.workload_info import WorkloadInfo
+        from kueue_tpu.api.types import Workload
+
+        flavors = [MakeResourceFlavor(n).Obj()
+                   for n in ("on-demand", "spot", "model-a", "model-b")]
+        cq = MakeClusterQueue("cq").Cohort("eng").ResourceGroup(
+            MakeFlavorQuotas("on-demand").Resource("memory", "100").Obj(),
+            MakeFlavorQuotas("spot").Resource("memory", "0", "100").Obj(),
+        ).ResourceGroup(
+            MakeFlavorQuotas("model-a").Resource("gpu", "10", "0").Obj(),
+            MakeFlavorQuotas("model-b").Resource("gpu", "10", "5").Obj(),
+        ).Obj()
+        snap = build_snapshot([cq], [MakeCohort("eng").Obj()], flavors, [])
+        cq_snap = snap.cluster_queue("cq")
+        cq_snap.add_usage(dict(cq_usage))
+        a = Assignment(usage=dict(assignment_usage))
+        a.borrowing = borrowing
+        e = Entry(info=WorkloadInfo.from_workload(Workload(name="wl"),
+                                                  "cq"),
+                  assignment=a)
+        if mode == "fit":
+            # resourcesToReserve's Fit branch reserves the full usage.
+            got = dict(a.usage)
+        else:
+            got = SchedulerCycle._quota_to_reserve(e, cq_snap)
+        got = {k: v for k, v in got.items()}
+        assert got == dict(want), f"[{case}] got {got}, want {dict(want)}"
+
+    def test_reserved_less_than_usage_preempt(self):
+        self.run_reserve_case(
+            "Reserved memory and gpu less than assignment usage,"
+            " assignment preempts",
+            mode="preempt", borrowing=0,
+            assignment_usage={fr("on-demand", "memory"): 50,
+                              fr("model-a", "gpu"): 6},
+            cq_usage={fr("on-demand", "memory"): 60,
+                      fr("spot", "memory"): 50,
+                      fr("model-a", "gpu"): 6,
+                      fr("model-b", "gpu"): 2},
+            want={fr("on-demand", "memory"): 40,
+                  fr("model-a", "gpu"): 4})
+
+    def test_reserved_equal_usage_preempt(self):
+        self.run_reserve_case(
+            "Reserved memory equal assignment usage, assignment preempts",
+            mode="preempt", borrowing=0,
+            assignment_usage={fr("on-demand", "memory"): 30,
+                              fr("model-a", "gpu"): 2},
+            cq_usage={fr("on-demand", "memory"): 60,
+                      fr("spot", "memory"): 50,
+                      fr("model-a", "gpu"): 2,
+                      fr("model-b", "gpu"): 2},
+            want={fr("on-demand", "memory"): 30,
+                  fr("model-a", "gpu"): 2})
+
+    def test_reserved_equal_usage_fit(self):
+        self.run_reserve_case(
+            "Reserved memory equal assignment usage, assignment fits",
+            mode="fit", borrowing=0,
+            assignment_usage={fr("on-demand", "memory"): 50,
+                              fr("model-a", "gpu"): 2},
+            cq_usage={fr("on-demand", "memory"): 60,
+                      fr("spot", "memory"): 50,
+                      fr("model-a", "gpu"): 2,
+                      fr("model-b", "gpu"): 2},
+            want={fr("on-demand", "memory"): 50,
+                  fr("model-a", "gpu"): 2})
+
+    def test_reserved_zero_when_borrowing_preempt_without_borrow(self):
+        self.run_reserve_case(
+            "Reserved memory is 0, CQ is borrowing, assignment preempts"
+            " without borrowing",
+            mode="preempt", borrowing=0,
+            assignment_usage={fr("spot", "memory"): 50,
+                              fr("model-b", "gpu"): 2},
+            cq_usage={fr("on-demand", "memory"): 60,
+                      fr("spot", "memory"): 60,
+                      fr("model-a", "gpu"): 2,
+                      fr("model-b", "gpu"): 10},
+            want={fr("spot", "memory"): 0,
+                  fr("model-b", "gpu"): 0})
+
+    def test_reserved_cut_by_nominal_plus_borrowing(self):
+        self.run_reserve_case(
+            "Reserved memory cut by nominal+borrowing quota, assignment"
+            " preempts and borrows",
+            mode="preempt", borrowing=1,
+            assignment_usage={fr("spot", "memory"): 50,
+                              fr("model-b", "gpu"): 2},
+            cq_usage={fr("on-demand", "memory"): 60,
+                      fr("spot", "memory"): 60,
+                      fr("model-a", "gpu"): 2,
+                      fr("model-b", "gpu"): 10},
+            want={fr("spot", "memory"): 40,
+                  fr("model-b", "gpu"): 2})
+
+    def test_reserved_equal_usage_nil_borrowing_limit(self):
+        self.run_reserve_case(
+            "Reserved memory equal assignment usage, CQ borrowing limit"
+            " is nil",
+            mode="preempt", borrowing=1,
+            assignment_usage={fr("on-demand", "memory"): 50,
+                              fr("model-b", "gpu"): 2},
+            cq_usage={fr("on-demand", "memory"): 60,
+                      fr("spot", "memory"): 60,
+                      fr("model-a", "gpu"): 2,
+                      fr("model-b", "gpu"): 10},
+            want={fr("on-demand", "memory"): 50,
+                  fr("model-b", "gpu"): 2})

@@ -1,0 +1,276 @@
+"""Golden fixtures from TestLastSchedulingContext
+(pkg/scheduler/scheduler_test.go:6929, 6 cases): flavor-retry state
+across two scheduling cycles — the LastAssignment memory
+(flavorassigner NextFlavorToTryForPodSetResource) and the
+FlavorFungibility policies must make the SECOND cycle land on the
+Go-authored flavors after workload deletions free capacity.
+
+Driver translation: deletions use engine.finish() (frees quota like the
+Go cache DeleteWorkload); evictions are synchronous, so first-cycle
+preemption victims are gone before the delete step."""
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kueue_tpu.api.types import (  # noqa: E402
+    FungibilityPolicy,
+    PreemptionPolicy,
+    QueueingStrategy,
+)
+
+from .builders import (  # noqa: E402
+    MakeClusterQueue,
+    MakeFlavorQuotas,
+    MakeResourceFlavor,
+    MakeWorkload,
+)
+from .schedule_harness import (  # noqa: E402
+    MakeLocalQueue,
+    run_two_cycle_case,
+    want_admission,
+)
+
+S_FIFO = QueueingStrategy.STRICT_FIFO
+
+
+def cohort_cq(name, *, preempt_policy=FungibilityPolicy.PREEMPT,
+              borrow_policy=FungibilityPolicy.PREEMPT):
+    """scheduler_test.go:6938 clusterQueueCohort members (MayStopSearch
+    maps to FungibilityPolicy.PREEMPT, its former name)."""
+    return MakeClusterQueue(name).Cohort("cohort") \
+        .QueueingStrategy(S_FIFO) \
+        .Preemption(within_cluster_queue=PreemptionPolicy.NEVER,
+                    reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY) \
+        .FlavorFungibility(when_can_borrow=borrow_policy,
+                           when_can_preempt=preempt_policy) \
+        .ResourceGroup(
+            MakeFlavorQuotas("on-demand").Resource("cpu", "50", "50").Obj(),
+            MakeFlavorQuotas("spot").Resource("cpu", "100", "0").Obj()) \
+        .Obj()
+
+
+def cohort_cqs():
+    return [
+        cohort_cq("eng-cohort-alpha"),
+        cohort_cq("eng-cohort-beta"),
+        cohort_cq("eng-cohort-theta",
+                  preempt_policy=FungibilityPolicy.TRY_NEXT_FLAVOR,
+                  borrow_policy=FungibilityPolicy.TRY_NEXT_FLAVOR),
+    ]
+
+
+def suite_lqs():
+    return [
+        MakeLocalQueue("main", "default").ClusterQueue("eng-alpha").Obj(),
+        MakeLocalQueue("main-alpha", "default")
+        .ClusterQueue("eng-cohort-alpha").Obj(),
+        MakeLocalQueue("main-beta", "default")
+        .ClusterQueue("eng-cohort-beta").Obj(),
+        MakeLocalQueue("main-theta", "default")
+        .ClusterQueue("eng-cohort-theta").Obj(),
+    ]
+
+
+FLAVORS = [MakeResourceFlavor("on-demand").Obj(),
+           MakeResourceFlavor("spot").Obj()]
+
+
+class TestLastSchedulingContext:
+    # scheduler_test.go "scheduling on the first flavor is unblocked
+    # after some workloads were deleted"
+    def test_first_flavor_unblocked_after_deletion(self):
+        run_two_cycle_case(
+            case="scheduling on the first flavor is unblocked after some"
+                 " workloads were deleted",
+            resource_flavors=FLAVORS,
+            cluster_queues=[
+                MakeClusterQueue("eng-alpha")
+                .Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY)
+                .FlavorFungibility(
+                    when_can_preempt=FungibilityPolicy.PREEMPT)
+                .ResourceGroup(
+                    MakeFlavorQuotas("on-demand")
+                    .Resource("cpu", "50", "50").Obj(),
+                    MakeFlavorQuotas("spot")
+                    .Resource("cpu", "10", "0").Obj())
+                .Obj()],
+            local_queues=suite_lqs(),
+            workloads=[
+                MakeWorkload("low-1", "default").Queue("main")
+                .Request("cpu", "50")
+                .ReserveQuota("eng-alpha", [{"cpu": "on-demand"}]),
+                MakeWorkload("preemptor", "default").Queue("main")
+                .Request("cpu", "20"),
+            ],
+            delete_between=["default/low-1"],
+            want_assignments={
+                "default/preemptor": want_admission(
+                    "eng-alpha", ("main", {"cpu": "on-demand"})),
+            })
+
+    # scheduler_test.go "borrow before next flavor"
+    def test_borrow_before_next_flavor(self):
+        run_two_cycle_case(
+            case="borrow before next flavor",
+            resource_flavors=FLAVORS,
+            cluster_queues=cohort_cqs(),
+            local_queues=suite_lqs(),
+            workloads=[
+                MakeWorkload("placeholder", "default")
+                .Request("cpu", "50")
+                .ReserveQuota("eng-cohort-alpha", [{"cpu": "on-demand"}]),
+                MakeWorkload("borrower", "default").Queue("main-alpha")
+                .Request("cpu", "20"),
+                MakeWorkload("workload1", "default").Queue("main-beta")
+                .Request("cpu", "20"),
+            ],
+            want_assignments={
+                "default/placeholder": want_admission(
+                    "eng-cohort-alpha", ("main", {"cpu": "on-demand"})),
+                "default/workload1": want_admission(
+                    "eng-cohort-beta", ("main", {"cpu": "on-demand"})),
+                "default/borrower": want_admission(
+                    "eng-cohort-alpha", ("main", {"cpu": "on-demand"})),
+            })
+
+    # scheduler_test.go "borrow after all flavors"
+    def test_borrow_after_all_flavors(self):
+        run_two_cycle_case(
+            case="borrow after all flavors",
+            resource_flavors=FLAVORS,
+            cluster_queues=cohort_cqs(),
+            local_queues=suite_lqs(),
+            workloads=[
+                MakeWorkload("placeholder", "default")
+                .Request("cpu", "50")
+                .ReserveQuota("eng-cohort-alpha", [{"cpu": "on-demand"}]),
+                MakeWorkload("placeholder1", "default")
+                .Request("cpu", "50")
+                .ReserveQuota("eng-cohort-theta", [{"cpu": "on-demand"}]),
+                MakeWorkload("workload", "default").Queue("main-theta")
+                .Request("cpu", "20"),
+            ],
+            want_assignments={
+                "default/placeholder": want_admission(
+                    "eng-cohort-alpha", ("main", {"cpu": "on-demand"})),
+                "default/placeholder1": want_admission(
+                    "eng-cohort-theta", ("main", {"cpu": "on-demand"})),
+                "default/workload": want_admission(
+                    "eng-cohort-theta", ("main", {"cpu": "spot"})),
+            })
+
+    # scheduler_test.go "when the next flavor is full, but can borrow on
+    # first"
+    def test_next_flavor_full_can_borrow_on_first(self):
+        run_two_cycle_case(
+            case="when the next flavor is full, but can borrow on first",
+            resource_flavors=FLAVORS,
+            cluster_queues=cohort_cqs(),
+            local_queues=suite_lqs(),
+            workloads=[
+                MakeWorkload("placeholder", "default")
+                .Request("cpu", "40")
+                .ReserveQuota("eng-cohort-alpha", [{"cpu": "on-demand"}]),
+                MakeWorkload("placeholder1", "default")
+                .Request("cpu", "40")
+                .ReserveQuota("eng-cohort-theta", [{"cpu": "on-demand"}]),
+                MakeWorkload("placeholder2", "default")
+                .Request("cpu", "100")
+                .ReserveQuota("eng-cohort-theta", [{"cpu": "spot"}]),
+                MakeWorkload("workload", "default").Queue("main-theta")
+                .Request("cpu", "20"),
+            ],
+            want_assignments={
+                "default/placeholder": want_admission(
+                    "eng-cohort-alpha", ("main", {"cpu": "on-demand"})),
+                "default/placeholder1": want_admission(
+                    "eng-cohort-theta", ("main", {"cpu": "on-demand"})),
+                "default/placeholder2": want_admission(
+                    "eng-cohort-theta", ("main", {"cpu": "spot"})),
+                "default/workload": want_admission(
+                    "eng-cohort-theta", ("main", {"cpu": "on-demand"})),
+            })
+
+    # scheduler_test.go "when the next flavor is full, but can preempt
+    # on first"
+    def test_next_flavor_full_can_preempt_on_first(self):
+        run_two_cycle_case(
+            case="when the next flavor is full, but can preempt on first",
+            resource_flavors=FLAVORS,
+            cluster_queues=cohort_cqs(),
+            local_queues=suite_lqs(),
+            workloads=[
+                MakeWorkload("placeholder-alpha", "default").Priority(-1)
+                .Request("cpu", "150")
+                .ReserveQuota("eng-cohort-alpha", [{"cpu": "on-demand"}]),
+                MakeWorkload("placeholder-theta-spot", "default")
+                .Request("cpu", "100")
+                .ReserveQuota("eng-cohort-theta", [{"cpu": "spot"}]),
+                MakeWorkload("new", "default").Queue("main-theta")
+                .Request("cpu", "20"),
+            ],
+            delete_between=["default/placeholder-alpha"],
+            want_assignments={
+                "default/placeholder-theta-spot": want_admission(
+                    "eng-cohort-theta", ("main", {"cpu": "spot"})),
+                "default/new": want_admission(
+                    "eng-cohort-theta", ("main", {"cpu": "on-demand"})),
+            })
+
+    # scheduler_test.go "TryNextFlavor, but second flavor is full and
+    # can preempt on first"
+    def test_try_next_flavor_second_full_preempt_on_first(self):
+        def cq(name, od_nominal, od_borrow):
+            return MakeClusterQueue(name).Cohort("cohort").Preemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                reclaim_within_cohort=PreemptionPolicy.ANY
+            ).FlavorFungibility(
+                when_can_borrow=FungibilityPolicy.TRY_NEXT_FLAVOR,
+                when_can_preempt=FungibilityPolicy.TRY_NEXT_FLAVOR
+            ).ResourceGroup(
+                MakeFlavorQuotas("on-demand")
+                .Resource("cpu", od_nominal, od_borrow).Obj(),
+                MakeFlavorQuotas("spot")
+                .Resource("cpu", "30", "30").Obj()
+            ).Obj()
+
+        run_two_cycle_case(
+            case="TryNextFlavor, but second flavor is full and can"
+                 " preempt on first",
+            resource_flavors=FLAVORS,
+            cluster_queues=[
+                cq("eng-cohort-alpha", "0", "60"),
+                cq("eng-cohort-beta", "30", "30"),
+                MakeClusterQueue("eng-cohort-shared").Cohort("cohort")
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("cpu", "30").Obj()).Obj()],
+            local_queues=suite_lqs(),
+            workloads=[
+                # alpha2 reserved more recently (Go: now vs now-1s) —
+                # candidate recency-desc ordering picks it as victim.
+                MakeWorkload("alpha1", "default").Request("cpu", "22")
+                .SimpleReserveQuota("eng-cohort-alpha", "on-demand",
+                                    at=0.0),
+                MakeWorkload("alpha2", "default").Request("cpu", "22")
+                .SimpleReserveQuota("eng-cohort-alpha", "on-demand",
+                                    at=1.0),
+                MakeWorkload("alpha3", "default").Request("cpu", "22")
+                .SimpleReserveQuota("eng-cohort-alpha", "spot"),
+                MakeWorkload("beta1", "default").Request("cpu", "22")
+                .SimpleReserveQuota("eng-cohort-beta", "spot"),
+                MakeWorkload("new", "default").Queue("main-beta")
+                .Request("cpu", "22"),
+            ],
+            delete_between=["default/alpha2"],
+            want_assignments={
+                "default/alpha1": want_admission(
+                    "eng-cohort-alpha", ("main", {"cpu": "on-demand"})),
+                "default/alpha3": want_admission(
+                    "eng-cohort-alpha", ("main", {"cpu": "spot"})),
+                "default/beta1": want_admission(
+                    "eng-cohort-beta", ("main", {"cpu": "spot"})),
+                "default/new": want_admission(
+                    "eng-cohort-beta", ("main", {"cpu": "on-demand"})),
+            })

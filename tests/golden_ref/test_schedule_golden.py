@@ -1174,3 +1174,643 @@ class TestScheduleGolden:
             ],
             want_assignments={},
             want_left={"sales": ["sales/new"]})
+
+    # scheduler_test.go:4473 — the reclaimed borrower (b1) is evicted
+    # synchronously here and requeues.
+    def test_prefer_reclamation_over_cq_priority_preemption(self):
+        def cq(name, od, spot):
+            return MakeClusterQueue(name).Cohort("other").Preemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY
+            ).ResourceGroup(
+                MakeFlavorQuotas("on-demand").Resource("gpu", od).Obj(),
+                MakeFlavorQuotas("spot").Resource("gpu", spot).Obj()
+            ).Obj()
+
+        run_case(
+            "prefer reclamation over cq priority based preemption",
+            extra_cqs=[cq("other-alpha", "10", "10"),
+                       cq("other-beta", "0", "0")],
+            extra_lqs=[
+                MakeLocalQueue("other", "eng-alpha")
+                .ClusterQueue("other-alpha").Obj(),
+                MakeLocalQueue("other", "eng-beta")
+                .ClusterQueue("other-beta").Obj()],
+            workloads=[
+                MakeWorkload("a1", "eng-alpha").Priority(50).Queue("other")
+                .Request("gpu", "5")
+                .SimpleReserveQuota("other-alpha", "on-demand"),
+                MakeWorkload("b1", "eng-beta").Priority(50).Queue("other")
+                .Request("gpu", "5")
+                .SimpleReserveQuota("other-beta", "spot"),
+                MakeWorkload("preemptor", "eng-alpha").Priority(100)
+                .Queue("other").Request("gpu", "6"),
+            ],
+            want_assignments={
+                "eng-alpha/a1": want_admission(
+                    "other-alpha", ("main", {"gpu": "on-demand"})),
+            },
+            want_preempted=["eng-beta/b1"],
+            want_left={"other-alpha": ["eng-alpha/preemptor"],
+                       "other-beta": ["eng-beta/b1"]})
+
+    # scheduler_test.go:4599
+    def test_prefer_first_flavor_when_second_needs_reclaim_and_cq(self):
+        run_case(
+            "prefer first preemption flavor when second flavor requires"
+            " both reclaim and cq priority preemption",
+            extra_cqs=[
+                MakeClusterQueue("other-alpha").Cohort("other").Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY)
+                .ResourceGroup(
+                    MakeFlavorQuotas("on-demand")
+                    .Resource("gpu", "10").Obj(),
+                    MakeFlavorQuotas("spot").Resource("gpu", "10").Obj())
+                .Obj(),
+                MakeClusterQueue("other-beta").Cohort("other")
+                .ResourceGroup(
+                    MakeFlavorQuotas("on-demand").Resource("gpu", "0").Obj(),
+                    MakeFlavorQuotas("spot").Resource("gpu", "0").Obj())
+                .Obj()],
+            extra_lqs=[
+                MakeLocalQueue("other", "eng-alpha")
+                .ClusterQueue("other-alpha").Obj(),
+                MakeLocalQueue("other", "eng-beta")
+                .ClusterQueue("other-beta").Obj()],
+            workloads=[
+                MakeWorkload("a1", "eng-alpha").Priority(50).Queue("other")
+                .Request("gpu", "5")
+                .SimpleReserveQuota("other-alpha", "on-demand"),
+                MakeWorkload("a2", "eng-alpha").Priority(50).Queue("other")
+                .Request("gpu", "5")
+                .SimpleReserveQuota("other-alpha", "spot"),
+                MakeWorkload("b1", "eng-beta").Priority(50).Queue("other")
+                .Request("gpu", "5")
+                .SimpleReserveQuota("other-beta", "spot"),
+                MakeWorkload("preemptor", "eng-alpha").Priority(100)
+                .Queue("other").Request("gpu", "6"),
+            ],
+            want_assignments={
+                "eng-alpha/a2": want_admission(
+                    "other-alpha", ("main", {"gpu": "spot"})),
+                "eng-beta/b1": want_admission(
+                    "other-beta", ("main", {"gpu": "spot"})),
+            },
+            want_preempted=["eng-alpha/a1"],
+            want_left={"other-alpha": ["eng-alpha/a1",
+                                       "eng-alpha/preemptor"]})
+
+    # scheduler_test.go:4737
+    def test_prefer_first_flavor_when_second_also_needs_cq_preempt(self):
+        run_case(
+            "prefer first preemption flavor when second flavor also"
+            " requires cq preemption",
+            extra_cqs=[
+                MakeClusterQueue("other-alpha").Cohort("other").Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY)
+                .ResourceGroup(
+                    MakeFlavorQuotas("on-demand")
+                    .Resource("gpu", "10").Obj(),
+                    MakeFlavorQuotas("spot").Resource("gpu", "10").Obj())
+                .Obj(),
+                MakeClusterQueue("other-beta").Cohort("other")
+                .ResourceGroup(
+                    MakeFlavorQuotas("on-demand").Resource("gpu", "0").Obj(),
+                    MakeFlavorQuotas("spot").Resource("gpu", "0").Obj())
+                .Obj()],
+            extra_lqs=[
+                MakeLocalQueue("other", "eng-alpha")
+                .ClusterQueue("other-alpha").Obj(),
+                MakeLocalQueue("other", "eng-beta")
+                .ClusterQueue("other-beta").Obj()],
+            workloads=[
+                MakeWorkload("a1", "eng-alpha").Priority(50).Queue("other")
+                .Request("gpu", "6")
+                .SimpleReserveQuota("other-alpha", "on-demand"),
+                MakeWorkload("a2", "eng-alpha").Priority(50).Queue("other")
+                .Request("gpu", "5")
+                .SimpleReserveQuota("other-alpha", "spot"),
+                MakeWorkload("b1", "eng-beta").Priority(9001)
+                .Queue("other").Request("gpu", "5")
+                .SimpleReserveQuota("other-beta", "spot"),
+                MakeWorkload("preemptor", "eng-alpha").Priority(100)
+                .Queue("other").Request("gpu", "5"),
+            ],
+            want_assignments={
+                "eng-alpha/a2": want_admission(
+                    "other-alpha", ("main", {"gpu": "spot"})),
+                "eng-beta/b1": want_admission(
+                    "other-beta", ("main", {"gpu": "spot"})),
+            },
+            want_preempted=["eng-alpha/a1"],
+            want_left={"other-alpha": ["eng-alpha/a1",
+                                       "eng-alpha/preemptor"]})
+
+    # scheduler_test.go:4878 — WL2's reclamation evicts the lowest-
+    # priority borrower in CQ3; the eviction re-activates WL1 (same
+    # cohort) from the inadmissible map at cycle end.
+    def test_reclaiming_workload_prioritized_over_full_cq(self):
+        run_case(
+            "workload requiring reclaimation prioritized over wl in"
+            " another full cq",
+            extra_cqs=[
+                MakeClusterQueue("CQ1").Cohort("other")
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("gpu", "10").Obj()).Obj(),
+                MakeClusterQueue("CQ2").Cohort("other")
+                .Preemption(reclaim_within_cohort=PreemptionPolicy.ANY)
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("gpu", "10").Obj()).Obj(),
+                MakeClusterQueue("CQ3").Cohort("other")
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("gpu", "0").Obj()).Obj()],
+            extra_lqs=[
+                MakeLocalQueue("lq", "eng-alpha").ClusterQueue("CQ1").Obj(),
+                MakeLocalQueue("lq", "eng-beta").ClusterQueue("CQ2").Obj(),
+                MakeLocalQueue("lq", "eng-gamma").ClusterQueue("CQ3").Obj()],
+            workloads=[
+                MakeWorkload("Admitted-Workload-1", "eng-alpha")
+                .Queue("lq").Request("gpu", "5")
+                .SimpleReserveQuota("CQ1", "on-demand"),
+                MakeWorkload("WL1", "eng-alpha").Creation(10.0)
+                .Queue("lq").Request("gpu", "10"),
+                MakeWorkload("WL2", "eng-beta").Creation(11.0)
+                .Queue("lq").Request("gpu", "10"),
+                MakeWorkload("Admitted-Workload-2", "eng-gamma")
+                .Queue("lq").Priority(0).Request("gpu", "5")
+                .SimpleReserveQuota("CQ3", "on-demand"),
+                MakeWorkload("Admitted-Workload-3", "eng-gamma")
+                .Queue("lq").Priority(1).Request("gpu", "5")
+                .SimpleReserveQuota("CQ3", "on-demand"),
+            ],
+            want_assignments={
+                "eng-alpha/Admitted-Workload-1": want_admission(
+                    "CQ1", ("main", {"gpu": "on-demand"})),
+                "eng-gamma/Admitted-Workload-3": want_admission(
+                    "CQ3", ("main", {"gpu": "on-demand"})),
+            },
+            want_preempted=["eng-gamma/Admitted-Workload-2"],
+            want_left={"CQ1": ["eng-alpha/WL1"],
+                       "CQ2": ["eng-beta/WL2"],
+                       "CQ3": ["eng-gamma/Admitted-Workload-2"]},
+            want_inadmissible={})
+
+    # scheduler_test.go:5082
+    def test_capacity_not_blocked_when_lender_can_reclaim_any(self):
+        run_case(
+            "capacity not blocked when lending clusterqueue can reclaim"
+            " (ReclaimWithinCohort=Any)",
+            extra_cqs=[
+                MakeClusterQueue("ClusterQueueA").Cohort("root")
+                .Preemption(reclaim_within_cohort=PreemptionPolicy.ANY)
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("gpu", "2").Obj()).Obj(),
+                MakeClusterQueue("ClusterQueueB").Cohort("root")
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("gpu", "0").Obj()).Obj()],
+            extra_lqs=[
+                MakeLocalQueue("lq", "eng-alpha")
+                .ClusterQueue("ClusterQueueA").Obj(),
+                MakeLocalQueue("lq", "eng-beta")
+                .ClusterQueue("ClusterQueueB").Obj()],
+            workloads=[
+                MakeWorkload("a1-admitted", "eng-alpha").Queue("lq")
+                .Request("gpu", "1")
+                .SimpleReserveQuota("ClusterQueueA", "on-demand"),
+                MakeWorkload("a2-pending", "eng-alpha").Queue("lq")
+                .Request("gpu", "2"),
+                MakeWorkload("b1-pending", "eng-beta").Queue("lq")
+                .Request("gpu", "1"),
+            ],
+            want_assignments={
+                "eng-alpha/a1-admitted": want_admission(
+                    "ClusterQueueA", ("main", {"gpu": "on-demand"})),
+                "eng-beta/b1-pending": want_admission(
+                    "ClusterQueueB", ("main", {"gpu": "on-demand"})),
+            },
+            want_left={},
+            want_inadmissible={"ClusterQueueA": ["eng-alpha/a2-pending"]})
+
+    # scheduler_test.go:5200
+    def test_capacity_blocked_when_lender_reclaim_lower_priority(self):
+        run_case(
+            "capacity blocked when lending clusterqueue not guaranteed to"
+            " reclaim (ReclaimWithinCohort=LowerPriority)",
+            extra_cqs=[
+                MakeClusterQueue("ClusterQueueA").Cohort("root")
+                .Preemption(
+                    reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY)
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("gpu", "2").Obj()).Obj(),
+                MakeClusterQueue("ClusterQueueB").Cohort("root")
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("gpu", "0").Obj()).Obj()],
+            extra_lqs=[
+                MakeLocalQueue("lq", "eng-alpha")
+                .ClusterQueue("ClusterQueueA").Obj(),
+                MakeLocalQueue("lq", "eng-beta")
+                .ClusterQueue("ClusterQueueB").Obj()],
+            workloads=[
+                MakeWorkload("a1-admitted", "eng-alpha").Queue("lq")
+                .Request("gpu", "1")
+                .SimpleReserveQuota("ClusterQueueA", "on-demand"),
+                MakeWorkload("a2-pending", "eng-alpha").Queue("lq")
+                .Request("gpu", "2"),
+                MakeWorkload("b1-pending", "eng-beta").Queue("lq")
+                .Request("gpu", "1"),
+            ],
+            want_assignments={
+                "eng-alpha/a1-admitted": want_admission(
+                    "ClusterQueueA", ("main", {"gpu": "on-demand"})),
+            },
+            want_left={"ClusterQueueB": ["eng-beta/b1-pending"]},
+            want_inadmissible={"ClusterQueueA": ["eng-alpha/a2-pending"]})
+
+    # scheduler_test.go:5311
+    def test_capacity_blocked_when_lender_reclaim_never(self):
+        run_case(
+            "capacity blocked when lending clusterqueue not guaranteed to"
+            " reclaim (ReclaimWithinCohort=Never)",
+            extra_cqs=[
+                MakeClusterQueue("ClusterQueueA").Cohort("root")
+                .Preemption(reclaim_within_cohort=PreemptionPolicy.NEVER)
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("gpu", "2").Obj()).Obj(),
+                MakeClusterQueue("ClusterQueueB").Cohort("root")
+                .ResourceGroup(MakeFlavorQuotas("on-demand")
+                               .Resource("gpu", "0").Obj()).Obj()],
+            extra_lqs=[
+                MakeLocalQueue("lq", "eng-alpha")
+                .ClusterQueue("ClusterQueueA").Obj(),
+                MakeLocalQueue("lq", "eng-beta")
+                .ClusterQueue("ClusterQueueB").Obj()],
+            workloads=[
+                MakeWorkload("a1-admitted", "eng-alpha").Queue("lq")
+                .Request("gpu", "1")
+                .SimpleReserveQuota("ClusterQueueA", "on-demand"),
+                MakeWorkload("a2-pending", "eng-alpha").Queue("lq")
+                .Request("gpu", "2"),
+                MakeWorkload("b1-pending", "eng-beta").Queue("lq")
+                .Request("gpu", "1"),
+            ],
+            want_assignments={
+                "eng-alpha/a1-admitted": want_admission(
+                    "ClusterQueueA", ("main", {"gpu": "on-demand"})),
+            },
+            want_left={"ClusterQueueB": ["eng-beta/b1-pending"]},
+            want_inadmissible={"ClusterQueueA": ["eng-alpha/a2-pending"]})
+
+    # scheduler_test.go:5429
+    def test_hierarchical_cohort_borrowing_less_scheduled_first(self):
+        run_case(
+            "in a hierarchical cohort, workload borrowing less is"
+            " scheduled first",
+            cohorts=[
+                MakeCohort("root").Obj(),
+                MakeCohort("guaranteed")
+                .ResourceGroup(MakeFlavorQuotas("default")
+                               .Resource("cpu", "4").Obj())
+                .Parent("root").Obj()],
+            extra_cqs=[
+                MakeClusterQueue("guaranteed").Cohort("guaranteed")
+                .ResourceGroup(MakeFlavorQuotas("default")
+                               .Resource("cpu", "0").Obj()).Obj(),
+                MakeClusterQueue("best-effort").Cohort("root")
+                .ResourceGroup(MakeFlavorQuotas("default")
+                               .Resource("cpu", "0").Obj()).Obj()],
+            extra_lqs=[
+                MakeLocalQueue("lq-guaranteed", "eng-alpha")
+                .ClusterQueue("guaranteed").Obj(),
+                MakeLocalQueue("lq-best-effort", "eng-alpha")
+                .ClusterQueue("best-effort").Obj()],
+            workloads=[
+                MakeWorkload("guaranteed", "eng-alpha")
+                .Queue("lq-guaranteed").Priority(0)
+                .PodSets(MakePodSet("one", 1).Request("cpu", "4").Obj()),
+                MakeWorkload("best-effort", "eng-alpha")
+                .Queue("lq-best-effort").Priority(3)
+                .PodSets(MakePodSet("one", 1).Request("cpu", "4").Obj()),
+            ],
+            want_assignments={
+                "eng-alpha/guaranteed": want_admission(
+                    "guaranteed", ("one", {"cpu": "default"})),
+            },
+            want_left={"best-effort": ["eng-alpha/best-effort"]})
+
+    # scheduler_test.go:5547
+    def test_dont_assign_flavor_without_preemption_candidates(self):
+        from kueue_tpu.api.types import (
+            BorrowWithinCohort,
+            BorrowWithinCohortPolicy,
+        )
+        run_case(
+            "don't assign flavor if there are no candidates for"
+            " preemption",
+            extra_cqs=[
+                MakeClusterQueue("cq1").Cohort("cohort").Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=PreemptionPolicy.ANY,
+                    borrow_within_cohort=BorrowWithinCohort(
+                        policy=BorrowWithinCohortPolicy.LOWER_PRIORITY))
+                .FlavorFungibility(
+                    when_can_borrow=FungibilityPolicy.BORROW,
+                    when_can_preempt=FungibilityPolicy.PREEMPT)
+                .ResourceGroup(
+                    MakeFlavorQuotas("on-demand")
+                    .Resource("cpu", "0", "1").Obj(),
+                    MakeFlavorQuotas("spot")
+                    .Resource("cpu", "0", "1").Obj())
+                .Obj(),
+                MakeClusterQueue("cq2").Cohort("cohort")
+                .ResourceGroup(
+                    MakeFlavorQuotas("on-demand").Resource("cpu", "1").Obj(),
+                    MakeFlavorQuotas("spot").Resource("cpu", "1").Obj())
+                .Obj()],
+            extra_lqs=[
+                MakeLocalQueue("lq1", "eng-alpha").ClusterQueue("cq1").Obj(),
+                MakeLocalQueue("lq2", "eng-alpha").ClusterQueue("cq2").Obj()],
+            workloads=[
+                MakeWorkload("admitted", "eng-alpha").Queue("lq2")
+                .Request("cpu", "1").Priority(0)
+                .SimpleReserveQuota("cq2", "on-demand"),
+                MakeWorkload("new", "eng-alpha").Queue("lq1")
+                .Request("cpu", "1").Priority(100),
+            ],
+            want_assignments={
+                "eng-alpha/admitted": want_admission(
+                    "cq2", ("main", {"cpu": "on-demand"})),
+                "eng-alpha/new": want_admission(
+                    "cq1", ("main", {"cpu": "spot"})),
+            },
+            want_left={})
+
+    # scheduler_test.go:5839
+    def test_admit_second_flavor_when_first_needs_preempt_try_next(self):
+        run_case(
+            "admit to second flavor when first needs preemption;"
+            " WhenCanPreempt: TryNextFlavor",
+            extra_cqs=[
+                MakeClusterQueue("preempt-attempts-cq").Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY)
+                .FlavorFungibility(
+                    when_can_preempt=FungibilityPolicy.TRY_NEXT_FLAVOR)
+                .ResourceGroup(
+                    MakeFlavorQuotas("on-demand").Resource("cpu", "1").Obj(),
+                    MakeFlavorQuotas("spot").Resource("cpu", "1").Obj())
+                .Obj()],
+            extra_lqs=[MakeLocalQueue("preempt-attempts-lq", "eng-alpha")
+                       .ClusterQueue("preempt-attempts-cq").Obj()],
+            workloads=[
+                MakeWorkload("blocker", "eng-alpha")
+                .Queue("preempt-attempts-lq").Request("cpu", "1")
+                .Priority(50)
+                .ReserveQuota("preempt-attempts-cq",
+                              [{"cpu": "on-demand"}]),
+                MakeWorkload("test-wl", "eng-alpha")
+                .Queue("preempt-attempts-lq").Request("cpu", "1")
+                .Priority(100),
+            ],
+            want_assignments={
+                "eng-alpha/blocker": want_admission(
+                    "preempt-attempts-cq", ("main", {"cpu": "on-demand"})),
+                "eng-alpha/test-wl": want_admission(
+                    "preempt-attempts-cq", ("main", {"cpu": "spot"})),
+            },
+            want_left={})
+
+    # scheduler_test.go:5937
+    def test_admit_workload_with_zero_quantity_request(self):
+        run_case(
+            "admit workload with zero-quantity request for resource not"
+            " in ClusterQueue",
+            workloads=[
+                MakeWorkload("zero-resource-wl", "sales").Queue("main")
+                .Request("cpu", "1").Request("example.com/gpu", "0"),
+            ],
+            want_assignments={
+                "sales/zero-resource-wl": want_admission(
+                    "sales", ("main", {"cpu": "default"})),
+            },
+            want_left={})
+
+    # scheduler_test.go:5988
+    def test_preempt_with_zero_quantity_request(self):
+        run_case(
+            "preempt when workload requests zero of a resource not"
+            " defined in ClusterQueue",
+            extra_cqs=[
+                MakeClusterQueue("preempt-zero-gpu-cq").Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY)
+                .ResourceGroup(MakeFlavorQuotas("default")
+                               .Resource("cpu", "4").Obj()).Obj()],
+            extra_lqs=[MakeLocalQueue("preempt-zero-gpu-lq", "sales")
+                       .ClusterQueue("preempt-zero-gpu-cq").Obj()],
+            workloads=[
+                MakeWorkload("preemptor", "sales")
+                .Queue("preempt-zero-gpu-lq").Request("cpu", "2")
+                .Request("example.com/gpu", "0"),
+                MakeWorkload("low-priority", "sales").Priority(-1)
+                .Queue("preempt-zero-gpu-lq").Request("cpu", "4")
+                .ReserveQuota("preempt-zero-gpu-cq", [{"cpu": "default"}]),
+            ],
+            want_assignments={},
+            want_preempted=["sales/low-priority"],
+            want_left={"preempt-zero-gpu-cq": ["sales/low-priority",
+                                               "sales/preemptor"]})
+
+    # scheduler_test.go:6097
+    def test_preemption_over_borrowing_preference(self):
+        from kueue_tpu.api.types import FungibilityPreference
+        run_case(
+            "PreemptionOverBorrowing preference: preempt in first flavor"
+            " instead of borrowing in second",
+            extra_cqs=[
+                MakeClusterQueue("pob-cq").Cohort("pob-cohort").Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY)
+                .FlavorFungibility(
+                    when_can_borrow=FungibilityPolicy.TRY_NEXT_FLAVOR,
+                    when_can_preempt=FungibilityPolicy.TRY_NEXT_FLAVOR,
+                    preference=(FungibilityPreference
+                                .PREEMPTION_OVER_BORROWING))
+                .ResourceGroup(
+                    MakeFlavorQuotas("on-demand")
+                    .Resource("cpu", "5", "0").Obj(),
+                    MakeFlavorQuotas("spot")
+                    .Resource("cpu", "0", "5").Obj())
+                .Obj(),
+                MakeClusterQueue("pob-lender").Cohort("pob-cohort")
+                .ResourceGroup(
+                    MakeFlavorQuotas("on-demand").Resource("cpu", "0").Obj(),
+                    MakeFlavorQuotas("spot").Resource("cpu", "5").Obj())
+                .Obj()],
+            extra_lqs=[MakeLocalQueue("pob-queue", "default")
+                       .ClusterQueue("pob-cq").Obj()],
+            workloads=[
+                MakeWorkload("low-pob", "default").Queue("pob-queue")
+                .Priority(-1).Request("cpu", "5")
+                .ReserveQuota("pob-cq", [{"cpu": "on-demand"}]),
+                MakeWorkload("high-pob", "default").Queue("pob-queue")
+                .Priority(0).Request("cpu", "5"),
+            ],
+            want_assignments={},
+            want_preempted=["default/low-pob"],
+            want_left={"pob-cq": ["default/high-pob", "default/low-pob"]})
+
+    # scheduler_test.go:6220
+    def test_borrowing_over_preemption_preference(self):
+        from kueue_tpu.api.types import FungibilityPreference
+        run_case(
+            "BorrowingOverPreemption preference: borrow in second flavor"
+            " instead of preempting in first",
+            extra_cqs=[
+                MakeClusterQueue("bop-cq").Cohort("bop-cohort").Preemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY)
+                .FlavorFungibility(
+                    when_can_borrow=FungibilityPolicy.TRY_NEXT_FLAVOR,
+                    when_can_preempt=FungibilityPolicy.TRY_NEXT_FLAVOR,
+                    preference=(FungibilityPreference
+                                .BORROWING_OVER_PREEMPTION))
+                .ResourceGroup(
+                    MakeFlavorQuotas("on-demand")
+                    .Resource("cpu", "5", "0").Obj(),
+                    MakeFlavorQuotas("spot")
+                    .Resource("cpu", "0", "5").Obj())
+                .Obj(),
+                MakeClusterQueue("bop-lender").Cohort("bop-cohort")
+                .ResourceGroup(
+                    MakeFlavorQuotas("on-demand").Resource("cpu", "0").Obj(),
+                    MakeFlavorQuotas("spot").Resource("cpu", "5").Obj())
+                .Obj()],
+            extra_lqs=[MakeLocalQueue("bop-queue", "default")
+                       .ClusterQueue("bop-cq").Obj()],
+            workloads=[
+                MakeWorkload("low-bop", "default").Queue("bop-queue")
+                .Priority(-1).Request("cpu", "5")
+                .ReserveQuota("bop-cq", [{"cpu": "on-demand"}]),
+                MakeWorkload("high-bop", "default").Queue("bop-queue")
+                .Priority(0).Request("cpu", "5"),
+            ],
+            want_assignments={
+                "default/low-bop": want_admission(
+                    "bop-cq", ("main", {"cpu": "on-demand"})),
+                "default/high-bop": want_admission(
+                    "bop-cq", ("main", {"cpu": "spot"})),
+            },
+            want_left={})
+
+    # scheduler_test.go:6324
+    def test_preemption_gate_blocks_preemptions(self):
+        run_case(
+            "block preemptions and signal `BlockedOnPreemptionGates` when"
+            " a preemption gate is present",
+            workloads=[
+                MakeWorkload("preemptor", "eng-beta").Queue("main")
+                .Request("example.com/gpu", "20").PreemptionGates("gate"),
+                MakeWorkload("low-priority", "eng-beta").Priority(-1)
+                .Request("example.com/gpu", "20")
+                .ReserveQuota("eng-beta", [{"example.com/gpu": "model-a"}]),
+            ],
+            want_assignments={
+                "eng-beta/low-priority": want_admission(
+                    "eng-beta", ("main", {"example.com/gpu": "model-a"})),
+            },
+            want_left={"eng-beta": ["eng-beta/preemptor"]})
+
+    # scheduler_test.go:6405
+    def test_preemption_gate_not_signaled_when_fits(self):
+        run_case(
+            "do not signal `BlockedOnPreemptionGates` when a preemption"
+            " gate is present, but the workload fits without preemption",
+            workloads=[
+                MakeWorkload("preemptor", "eng-beta").Queue("main")
+                .Request("example.com/gpu", "20").PreemptionGates("gate"),
+            ],
+            want_assignments={
+                "eng-beta/preemptor": want_admission(
+                    "eng-beta", ("main", {"example.com/gpu": "model-a"})),
+            },
+            want_left={})
+
+    # scheduler_test.go:6455
+    def test_preemption_gate_not_signaled_without_candidates(self):
+        run_case(
+            "do not signal `BlockedOnPreemptionGates` when a preemption"
+            " gate is present, but the workload had nothing to preempt",
+            workloads=[
+                MakeWorkload("preemptor", "eng-beta").Queue("main")
+                .Request("example.com/gpu", "20").PreemptionGates("gate"),
+                MakeWorkload("high-priority", "eng-beta").Priority(1)
+                .Request("example.com/gpu", "20")
+                .ReserveQuota("eng-beta", [{"example.com/gpu": "model-a"}]),
+            ],
+            want_assignments={
+                "eng-beta/high-priority": want_admission(
+                    "eng-beta", ("main", {"example.com/gpu": "model-a"})),
+            },
+            want_left={"eng-beta": ["eng-beta/preemptor"]})
+
+    # scheduler_test.go:6529 — the reference guards int64 overflow in
+    # the podset-request sum; quantities here are unbounded ints, so the
+    # same world simply exceeds capacity (the identical verdict:
+    # inadmissible, ExceedsMaxQuota).
+    def test_overflow_sum_of_podset_requests(self):
+        run_case(
+            "prevent integer overflow when sum of requests over podsets"
+            " exceeds MaxInt64",
+            extra_cqs=[
+                MakeClusterQueue("overflow-cq").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("cpu", "10000").Obj()).Obj()],
+            extra_lqs=[MakeLocalQueue("overflow-queue", "default")
+                       .ClusterQueue("overflow-cq").Obj()],
+            workloads=[
+                MakeWorkload("vuln-wl", "default").Queue("overflow-queue")
+                .PodSets(
+                    MakePodSet("ps1", 1)
+                    .Request("cpu", "1000000m").Obj(),
+                    MakePodSet("ps2", 1)
+                    .Request("cpu", "9223372036854775000m").Obj()),
+            ],
+            want_assignments={},
+            want_inadmissible={"overflow-cq": ["default/vuln-wl"]})
+
+    # scheduler_test.go:6589
+    def test_overflow_resource_value_to_milli(self):
+        run_case(
+            "prevent integer overflow in ResourceValue conversion to"
+            " MilliValue",
+            extra_cqs=[
+                MakeClusterQueue("overflow-cq").ResourceGroup(
+                    MakeFlavorQuotas("default")
+                    .Resource("cpu", "10").Obj()).Obj()],
+            extra_lqs=[MakeLocalQueue("overflow-queue", "default")
+                       .ClusterQueue("overflow-cq").Obj()],
+            workloads=[
+                MakeWorkload("vuln-wl", "default").Queue("overflow-queue")
+                .PodSets(MakePodSet("ps1", 1)
+                         .Request("cpu", "9223372036854776").Obj()),
+            ],
+            want_assignments={},
+            want_inadmissible={"overflow-cq": ["default/vuln-wl"]})
+
+    # scheduler_test.go:5651 — the replaced slice is finished
+    # synchronously on the replacement's admission here (the reference
+    # defers it to a status-apply), so only foo-2 remains assigned.
+    def test_workload_slice_fits_in_single_cluster_queue(self):
+        run_case(
+            "workload-slice fits in single clusterQueue",
+            workloads=[
+                MakeWorkload("foo-1", "sales").Queue("main")
+                .PodSets(MakePodSet("one", 10).Request("cpu", "1").Obj())
+                .ReserveQuota("sales", [{"cpu": "default"}]),
+                MakeWorkload("foo-2", "sales").Queue("main")
+                .WorkloadSliceReplacementFor("sales/foo-1")
+                .PodSets(MakePodSet("one", 15).Request("cpu", "1").Obj()),
+            ],
+            want_assignments={
+                "sales/foo-2": want_admission(
+                    "sales", ("one", {"cpu": "default"}, 15)),
+            },
+            want_left={})
