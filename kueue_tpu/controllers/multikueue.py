@@ -82,6 +82,10 @@ class MultiKueueController:
         self.increment = increment
         self.round_seconds = round_seconds
         self.clusters: dict[str, object] = {}  # name -> worker Engine
+        # RemoteClient-managed clusters (multikueue_cluster.py):
+        # connect/reconnect/hot-reload lifecycles live here; plain
+        # connect_cluster() workers bypass it.
+        self.remote_clients: dict[str, object] = {}
         self.states: dict[str, _RemoteState] = {}
         # MultiKueueOrchestratedPreemption: remote copies carry a closed
         # preemption gate; the manager opens one cluster's gate at a time
@@ -117,6 +121,47 @@ class MultiKueueController:
     def connect_cluster(self, name: str, engine) -> None:
         self.clusters[name] = engine
 
+    def add_remote_cluster(self, name: str, kubeconfig_path: str,
+                           connect, retry_increment: float = 1.0) -> None:
+        """Register a worker reached through a kubeconfig-file-backed
+        RemoteClient (multikueuecluster.go): reconcile_clusters() drives
+        connect / exponential reconnect / kubeconfig hot-reload."""
+        from kueue_tpu.controllers.multikueue_cluster import RemoteClient
+
+        self.remote_clients[name] = RemoteClient(
+            name, kubeconfig_path, connect,
+            clock=lambda: self.engine.clock,
+            retry_increment=retry_increment)
+
+    def cluster_connection_lost(self, name: str, reason: str) -> None:
+        """Watch-ended / transport-failure event for a managed cluster:
+        tear down placements there (the workers-lost eviction,
+        multikueuecluster.go) and schedule a backed-off reconnect."""
+        rc = self.remote_clients.get(name)
+        if rc is not None:
+            rc.mark_lost(reason)
+        self.disconnect_cluster(name)
+
+    def reconcile_clusters(self) -> None:
+        """Drive every RemoteClient's lifecycle; newly (re)connected
+        workers plug back into the dispatch set."""
+        for name, rc in self.remote_clients.items():
+            event = rc.tick()
+            if event in ("reconfigured", "disconnected"):
+                # The old client (and its credentials) is gone:
+                # placements made through it tear down like a
+                # disconnect — stale state.created entries must not
+                # block re-dispatch to the rebuilt cluster.
+                self.disconnect_cluster(name)
+            if event in ("connected", "reconfigured"):
+                self.connect_cluster(name, rc.worker)
+
+    def cluster_active(self, name: str):
+        """The MultiKueueCluster Active condition for a managed
+        cluster (None when the cluster is not RemoteClient-managed)."""
+        rc = self.remote_clients.get(name)
+        return None if rc is None else rc.active
+
     @staticmethod
     def _clear_placement_status(wl: Workload) -> None:
         """Reset clusterName/nominatedClusterNames when a placement is
@@ -141,6 +186,7 @@ class MultiKueueController:
     # -- the reconcile pass (workload.go:185) --
 
     def reconcile(self) -> None:
+        self.reconcile_clusters()
         self.reconcile_cluster_queues()
         acm = self.engine.admission_checks
         for wl in list(self.engine.workloads.values()):
@@ -303,14 +349,39 @@ class MultiKueueController:
         wl.status.nominated_cluster_names = tuple(state.nominated)
 
     def _sync_remotes(self, wl: Workload, state: _RemoteState) -> None:
+        from kueue_tpu.controllers.multikueue_cluster import ORIGIN_LABEL
+
         for cluster in state.nominated:
             if cluster in state.created:
                 continue
             worker = self.clusters.get(cluster)
             if worker is None:
                 continue
+            existing = worker.workloads.get(wl.key)
+            if (existing is not None
+                    and existing.labels.get(ORIGIN_LABEL) == self.origin):
+                # Reconnect after a connection loss: the remote copy is
+                # ADOPTED, not recreated — the reference's wlReconciler
+                # only creates missing remote objects (workload.go:609).
+                state.created[cluster] = existing.key
+                if existing.is_finished:
+                    # It finished during the outage: propagate the
+                    # result instead of running the job a second time.
+                    state.cluster_name = cluster
+                    cond = existing.condition(
+                        WorkloadConditionType.FINISHED)
+                    wl.set_condition(
+                        WorkloadConditionType.FINISHED, True,
+                        reason=cond.reason if cond else "Finished",
+                        now=self.engine.clock)
+                    self.engine.finish(wl.key)
+                    return
+                continue
             copy_wl = copy.deepcopy(wl)
             copy_wl.status = type(copy_wl.status)()
+            # Origin mark (kueue.MultiKueueOriginLabel): run_gc only
+            # collects this manager's own orphans.
+            copy_wl.labels[ORIGIN_LABEL] = self.origin
             if self.orchestrated_preemption:
                 # cloneForCreate (workload.go:1254): remotes manage gates
                 # independently — drop the manager's, add the MK gate
@@ -431,6 +502,37 @@ class MultiKueueController:
                              now=self.engine.clock)
             self.engine.finish(wl.key)
 
+    def _delete_remote(self, cluster: str, key: str) -> None:
+        """Delete one remote workload copy and its mirrored job object
+        (wlGroup.RemoveRemoteObjects / DeleteRemoteObject). Shared by
+        the per-workload teardown and the orphan GC."""
+        worker = self.clusters.get(cluster)
+        if worker is not None:
+            remote = worker.workloads.pop(key, None)
+            if remote is not None:
+                worker.cache.delete_workload(key)
+                worker.queues.delete_workload(remote)
+        worker_rec = self.worker_jobs.get(cluster)
+        if worker_rec is not None:
+            wl = self.engine.workloads.get(key)
+            job, adapter, _ = (self._adapter_and_job(wl)
+                               if wl is not None else (None, None, None))
+            if job is None and adapter is None:
+                # Manager workload gone (orphan GC): resolve the remote
+                # job through the worker's own registry.
+                job_key = getattr(worker_rec, "workload_to_job",
+                                  {}).get(key)
+                if job_key is not None and job_key in worker_rec.jobs:
+                    from kueue_tpu.controllers.multikueue_adapters import (
+                        adapter_for,
+                    )
+                    job = worker_rec.jobs[job_key]
+                    adapter = adapter_for(job, self.adapters,
+                                          worker_rec.integrations)
+            if job is not None and adapter is not None \
+                    and job.key in worker_rec.jobs:
+                adapter.delete_remote_object(worker_rec, job.key)
+
     def _remove_remotes(self, wl_key: str,
                         except_cluster: Optional[str]) -> None:
         state = self.states.get(wl_key)
@@ -439,21 +541,7 @@ class MultiKueueController:
         for cluster, key in list(state.created.items()):
             if cluster == except_cluster:
                 continue
-            worker = self.clusters.get(cluster)
-            if worker is not None:
-                remote = worker.workloads.pop(key, None)
-                if remote is not None:
-                    worker.cache.delete_workload(key)
-                    worker.queues.delete_workload(remote)
-            # Remove the mirrored job object too (DeleteRemoteObject).
-            worker_rec = self.worker_jobs.get(cluster)
-            if worker_rec is not None:
-                wl = self.engine.workloads.get(wl_key)
-                job, adapter, _ = (self._adapter_and_job(wl)
-                                   if wl is not None else (None, None, None))
-                if job is not None and adapter is not None \
-                        and job.key in worker_rec.jobs:
-                    adapter.delete_remote_object(worker_rec, job.key)
+            self._delete_remote(cluster, key)
             del state.created[cluster]
 
     def _gc(self, wl: Workload) -> None:
@@ -461,3 +549,24 @@ class MultiKueueController:
         if wl.key in self.states:
             self._remove_remotes(wl.key, except_cluster=None)
             del self.states[wl.key]
+
+    def run_gc(self) -> None:
+        """multikueuecluster.go:608 (runGC): on every connected worker,
+        remote workloads carrying THIS manager's origin label whose
+        local counterpart is gone (deleted manager workload, or a
+        manager that crashed between remote-create and journaling) are
+        deleted, along with their mirrored job objects."""
+        from kueue_tpu.controllers.multikueue_cluster import ORIGIN_LABEL
+
+        for cluster, worker in self.clusters.items():
+            for key, remote in list(worker.workloads.items()):
+                if remote.labels.get(ORIGIN_LABEL) != self.origin:
+                    continue
+                local = self.engine.workloads.get(key)
+                if local is not None and not local.is_finished:
+                    continue
+                self._delete_remote(cluster, key)
+                state = self.states.get(key)
+                if state is not None and \
+                        state.created.get(cluster) == key:
+                    del state.created[cluster]

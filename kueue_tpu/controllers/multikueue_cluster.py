@@ -1,0 +1,125 @@
+"""MultiKueue cluster connectivity: remote clients with exponential
+reconnect, kubeconfig hot-reload, and origin-labeled orphan GC.
+
+Reference: pkg/controller/admissionchecks/multikueue/
+multikueuecluster.go — the per-cluster client lifecycle (retryAfter
+backoff :96-103, failedConnAttempts reset/bump :282-290, the Active
+condition on the MultiKueueCluster object, runGC :608) — and
+fswatch.go, which watches kubeconfig files so credential rotations
+rebuild the client without a manager restart. The fsnotify watcher maps
+to an mtime poll here (tick() is driven from the controller's reconcile
+loop the way the watcher's events drive the reference's reconciler).
+
+The transport is abstracted as ``connect(config) -> worker``: a
+callable that builds a live worker handle from the kubeconfig's parsed
+contents and raises on failure (bad endpoint, bad credential). Tests
+and deployments provide it; the controller only manages the lifecycle.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+# multikueuecluster.go:96 retryAfter: 0, then 2^(min(n, max)-1) * inc.
+RETRY_MAX_STEPS = 7
+DEFAULT_RETRY_INCREMENT = 1.0
+
+# kueue.MultiKueueOriginLabel: marks remote objects created by this
+# manager so runGC only collects its own orphans.
+ORIGIN_LABEL = "kueue.x-k8s.io/multikueue-origin"
+
+
+def retry_after(failed_attempts: int,
+                increment: float = DEFAULT_RETRY_INCREMENT) -> float:
+    """multikueuecluster.go:98 (retryAfter)."""
+    if failed_attempts == 0:
+        return 0.0
+    return float(1 << (min(failed_attempts, RETRY_MAX_STEPS) - 1)) \
+        * increment
+
+
+@dataclass
+class ClusterActive:
+    """The MultiKueueCluster Active condition surface."""
+
+    status: bool = False
+    reason: str = "Pending"
+    message: str = ""
+
+
+class RemoteClient:
+    """One worker cluster's client lifecycle (remoteClient in
+    multikueuecluster.go): connect from a kubeconfig file, reconnect
+    with exponential backoff after failures, rebuild when the file
+    changes."""
+
+    def __init__(self, name: str, kubeconfig_path: str,
+                 connect: Callable[[dict], object],
+                 clock: Callable[[], float],
+                 retry_increment: float = DEFAULT_RETRY_INCREMENT):
+        self.name = name
+        self.kubeconfig_path = kubeconfig_path
+        self.connect = connect
+        self.clock = clock
+        self.retry_increment = retry_increment
+        self.worker: Optional[object] = None
+        self.failed_attempts = 0
+        self.next_attempt_at = 0.0
+        self.active = ClusterActive()
+        self._mtime: Optional[int] = None
+
+    def _stat_mtime(self) -> Optional[int]:
+        try:
+            return os.stat(self.kubeconfig_path).st_mtime_ns
+        except OSError:
+            return None
+
+    def mark_lost(self, reason: str) -> None:
+        """Watch-ended / transport-failure event (the reference's
+        queueWatchEndedEvent): drop the client and schedule a
+        backed-off reconnect (failedConnAttempts++, :289)."""
+        self.worker = None
+        self.failed_attempts += 1
+        self.next_attempt_at = self.clock() + retry_after(
+            self.failed_attempts, self.retry_increment)
+        self.active = ClusterActive(False, "ClientConnectionLost", reason)
+
+    def tick(self) -> str:
+        """One lifecycle step. Returns the transition that happened:
+        "" (none), "connected" (a fresh client is live),
+        "reconfigured" (kubeconfig changed AND the rebuilt client
+        connected in the same step — the old client must be torn down
+        before the new one serves), or "disconnected" (kubeconfig
+        changed and the rebuild failed — the old client is dead and
+        must be torn down NOW; reconnects continue under backoff)."""
+        now = self.clock()
+        mtime = self._stat_mtime()
+        reconfigured = False
+        if self.worker is not None and mtime != self._mtime:
+            # fswatch.go: the kubeconfig changed — rebuild immediately
+            # (credential rotation must not wait out a backoff).
+            self.worker = None
+            self.next_attempt_at = now
+            self.active = ClusterActive(False, "KubeconfigChanged", "")
+            reconfigured = True
+        if self.worker is None and now >= self.next_attempt_at:
+            try:
+                with open(self.kubeconfig_path, encoding="utf-8") as f:
+                    config = json.load(f)
+                self.worker = self.connect(config)
+                self._mtime = mtime
+                self.failed_attempts = 0
+                self.active = ClusterActive(True, "Active", "Connected")
+                return "reconfigured" if reconfigured else "connected"
+            except Exception as e:  # noqa: BLE001 — any connect failure
+                self.failed_attempts += 1
+                self.next_attempt_at = now + retry_after(
+                    self.failed_attempts, self.retry_increment)
+                self.active = ClusterActive(
+                    False, "ClientConnectionFailed", str(e)[:200])
+                if reconfigured:
+                    return "disconnected"
+        return ""

@@ -1,0 +1,253 @@
+"""MultiKueue operational depth: worker kill/restore mid-dispatch with
+exponential reconnect (multikueuecluster.go retryAfter), kubeconfig
+hot-reload without a manager restart (fswatch.go analog), and
+origin-labeled orphan GC (runGC :608)."""
+
+import json
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.admissionchecks import (
+    AdmissionCheck,
+    AdmissionCheckManager,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.controllers.multikueue import (
+    MultiKueueConfig,
+    MultiKueueController,
+)
+from kueue_tpu.controllers.multikueue_cluster import (
+    ORIGIN_LABEL,
+    retry_after,
+)
+
+
+def make_cluster(nominal=4000, checks=()):
+    eng = Engine()
+    if checks:
+        acm = AdmissionCheckManager(eng)
+        for c in checks:
+            acm.create_admission_check(AdmissionCheck(c))
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", admission_checks=tuple(checks),
+        resource_groups=(ResourceGroup(
+            ("cpu",),
+            (FlavorQuotas("default", {"cpu": ResourceQuota(nominal)}),)),),
+    ))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng
+
+
+def write_kubeconfig(path, endpoint, credential="good"):
+    path.write_text(json.dumps(
+        {"endpoint": endpoint, "credential": credential}))
+
+
+class Fabric:
+    """The test transport: endpoint -> worker engine, with per-endpoint
+    reachability and a credential check — connect() raises exactly like
+    a kubeconfig client build against a dead/misconfigured cluster."""
+
+    def __init__(self):
+        self.endpoints: dict[str, Engine] = {}
+        self.down: set = set()
+        self.connects: list[str] = []
+
+    def connect(self, config: dict):
+        ep = config["endpoint"]
+        self.connects.append(ep)
+        if ep in self.down or ep not in self.endpoints:
+            raise ConnectionError(f"endpoint {ep} unreachable")
+        if config.get("credential") != "good":
+            raise PermissionError("bad credential")
+        return self.endpoints[ep]
+
+
+def make_stack(tmp_path, fabric, clusters=("worker1",)):
+    manager = make_cluster(checks=("multikueue",))
+    mk = MultiKueueController(
+        manager, "multikueue", MultiKueueConfig(clusters=list(clusters)))
+    for name in clusters:
+        fabric.endpoints[name] = make_cluster()
+        path = tmp_path / f"{name}.kubeconfig"
+        write_kubeconfig(path, name)
+        mk.add_remote_cluster(name, str(path), fabric.connect,
+                              retry_increment=1.0)
+    return manager, mk
+
+
+def submit(eng, name, cpu=1000):
+    eng.clock += 0.001
+    wl = Workload(name=name, queue_name="lq",
+                  pod_sets=(PodSet("main", 1, {"cpu": cpu}),))
+    eng.submit(wl)
+    return wl
+
+
+def pump(manager, mk, cycles=2):
+    for _ in range(cycles):
+        manager.schedule_once()
+        mk.reconcile()
+        for worker in mk.clusters.values():
+            worker.schedule_once()
+        mk.reconcile()
+
+
+def test_retry_after_matches_reference_curve():
+    # multikueuecluster.go:98 — 0, inc, 2*inc, 4*inc, ... capped at
+    # 2^(maxSteps-1).
+    assert retry_after(0) == 0.0
+    assert [retry_after(n) for n in range(1, 9)] == [
+        1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 64.0]
+
+
+def test_kill_and_restore_worker_mid_dispatch(tmp_path):
+    fabric = Fabric()
+    manager, mk = make_stack(tmp_path, fabric)
+    wl = submit(manager, "job")
+    pump(manager, mk)
+    assert wl.is_admitted
+    assert wl.status.cluster_name == "worker1"
+
+    # KILL: the transport reports the watch ended; placements evict,
+    # the manager workload requeues, the cluster goes inactive.
+    fabric.down.add("worker1")
+    mk.cluster_connection_lost("worker1", "watch closed")
+    assert not wl.is_admitted
+    assert wl.status.cluster_name is None
+    assert not mk.cluster_active("worker1").status
+    assert "worker1" not in mk.clusters
+
+    # Reconnect attempts back off exponentially against a dead worker.
+    before = len(fabric.connects)
+    rc = mk.remote_clients["worker1"]
+    for _ in range(6):
+        manager.clock += 0.5
+        mk.reconcile()
+    attempts_while_down = len(fabric.connects) - before
+    assert 1 <= attempts_while_down <= 2  # backed off, not hammering
+    assert rc.failed_attempts >= 2
+
+    # RESTORE: once the endpoint is back and the backoff lapses, the
+    # client reconnects and the workload re-dispatches and re-admits.
+    fabric.down.discard("worker1")
+    manager.clock = max(manager.clock, rc.next_attempt_at) + 0.001
+    pump(manager, mk, cycles=3)
+    assert mk.cluster_active("worker1").status
+    assert wl.is_admitted
+    assert wl.status.cluster_name == "worker1"
+
+
+def test_kubeconfig_hot_reload_swaps_credentials_without_restart(
+        tmp_path):
+    fabric = Fabric()
+    manager, mk = make_stack(tmp_path, fabric)
+    path = tmp_path / "worker1.kubeconfig"
+
+    # Break the credential on disk: the next lifecycle tick rebuilds the
+    # client, fails auth, and the cluster goes inactive.
+    write_kubeconfig(path, "worker1", credential="rotated-out")
+    manager.clock += 1.0
+    mk.reconcile()
+    active = mk.cluster_active("worker1")
+    assert not active.status
+    assert "bad credential" in active.message
+
+    # Fix the credential — same controller instance, no restart: the
+    # mtime change triggers an immediate rebuild with the new contents.
+    rc = mk.remote_clients["worker1"]
+    manager.clock = max(manager.clock, rc.next_attempt_at) + 1.0
+    write_kubeconfig(path, "worker1", credential="good")
+    mk.reconcile()
+    assert mk.cluster_active("worker1").status
+    wl = submit(manager, "job")
+    pump(manager, mk)
+    assert wl.is_admitted
+
+
+def test_orphan_gc_collects_remote_objects(tmp_path):
+    fabric = Fabric()
+    manager, mk = make_stack(tmp_path, fabric)
+    wl = submit(manager, "job")
+    manager.schedule_once()
+    mk.reconcile()  # remotes created, not yet admitted anywhere
+    worker = mk.clusters["worker1"]
+    assert "default/job" in worker.workloads
+    assert worker.workloads["default/job"].labels[ORIGIN_LABEL] == \
+        mk.origin
+
+    # The manager loses the workload without a clean remote teardown
+    # (crash between delete and remote cleanup): the remote copy is now
+    # an orphan and the next GC run collects it.
+    del manager.workloads[wl.key]
+    mk.run_gc()
+    assert "default/job" not in worker.workloads
+    assert "default/job" not in worker.cache.workloads
+
+    # Foreign-origin remote objects are never touched.
+    foreign = Workload(name="foreign", queue_name="lq",
+                       pod_sets=(PodSet("main", 1, {"cpu": 100}),))
+    foreign.labels[ORIGIN_LABEL] = "another-manager"
+    worker.submit(foreign)
+    mk.run_gc()
+    assert "default/foreign" in worker.workloads
+
+
+def test_remote_finish_during_outage_propagates_not_reruns(tmp_path):
+    fabric = Fabric()
+    manager, mk = make_stack(tmp_path, fabric)
+    wl = submit(manager, "job")
+    pump(manager, mk)
+    assert wl.is_admitted
+    worker = fabric.endpoints["worker1"]
+
+    # Connection lost; the remote copy keeps running and FINISHES
+    # during the outage.
+    fabric.down.add("worker1")
+    mk.cluster_connection_lost("worker1", "watch closed")
+    worker.finish("default/job")
+
+    # Reconnect: the manager must adopt the finished result, not
+    # resubmit the job for a second execution.
+    fabric.down.discard("worker1")
+    rc = mk.remote_clients["worker1"]
+    manager.clock = max(manager.clock, rc.next_attempt_at) + 0.001
+    pump(manager, mk, cycles=3)
+    assert wl.is_finished
+    # Not re-executed: the remote copy is either still the finished one
+    # or already GC'd with the finished manager workload — never a
+    # fresh pending copy.
+    remote = worker.workloads.get("default/job")
+    assert remote is None or remote.is_finished
+    assert "default/job" not in worker.queues.rows._row_of
+
+
+def test_kubeconfig_endpoint_swap_moves_placements(tmp_path):
+    fabric = Fabric()
+    manager, mk = make_stack(tmp_path, fabric)
+    wl = submit(manager, "job")
+    pump(manager, mk)
+    assert wl.status.cluster_name == "worker1"
+
+    # Rotate the kubeconfig to a DIFFERENT endpoint: the old client is
+    # gone, its placements tear down, and dispatch resumes against the
+    # new cluster (no manager restart, no stale state.created entry).
+    fabric.endpoints["worker1b"] = make_cluster()
+    write_kubeconfig(tmp_path / "worker1.kubeconfig", "worker1b")
+    manager.clock += 1.0
+    pump(manager, mk, cycles=3)
+    assert wl.is_admitted
+    assert wl.status.cluster_name == "worker1"
+    assert "default/job" in fabric.endpoints["worker1b"].workloads
+    # The old endpoint's copy is an orphan now; GC collects it.
+    mk.run_gc()  # worker1 old engine is not connected — unreachable
+    assert fabric.connects[-1] == "worker1b"

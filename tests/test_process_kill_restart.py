@@ -1,0 +1,191 @@
+"""True process-kill restart: a CHILD OS process runs the preemption
+churn world with a journal attached and is SIGKILLed mid-churn (no
+cleanup, possibly mid-write — the journal reader must tolerate a torn
+tail). The parent rebuilds an engine from the crashed journal, checks
+internal consistency, drains to convergence, and the final world must
+match an unkilled control run of the identical deterministic scenario —
+the decision-parity restart story the reference gets from rebuilding
+its caches off the apiserver (SURVEY §5 checkpoint/resume)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.api.types import FlavorResource  # noqa: E402
+from kueue_tpu.store.journal import rebuild_engine  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The child's scenario — importable by both processes so the control
+# run is bit-identical. Submissions interleave with cycles so a kill
+# lands mid-churn; a marker line is printed (flushed) after every cycle
+# for the parent to pace the kill.
+_CHILD = r"""
+import sys
+sys.path.insert(0, {repo!r})
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from tests.test_process_kill_restart import build_world, run_churn
+
+path = sys.argv[1]
+eng = build_world(path)
+for k in run_churn(eng):
+    print(f"cycle {k}", flush=True)
+print("done", flush=True)
+"""
+
+
+def build_world(journal_path=None):
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        ClusterQueuePreemption,
+        Cohort,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        PreemptionPolicy,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Workload,
+    )
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.store.journal import attach_new_journal
+
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    for c in range(3):
+        eng.create_cohort(Cohort(f"co{c}"))
+    for i in range(9):
+        eng.create_cluster_queue(ClusterQueue(
+            name=f"cq{i}", cohort=f"co{i % 3}",
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY),
+            resource_groups=(ResourceGroup(
+                ("cpu",), (FlavorQuotas("default",
+                                        {"cpu": ResourceQuota(4000)}),)),)))
+        eng.create_local_queue(LocalQueue(f"lq{i}", "default", f"cq{i}"))
+    if journal_path:
+        attach_new_journal(eng, journal_path, fsync=False)
+    # Deterministic fill (no RNG: the control must match exactly).
+    for i in range(27):
+        eng.clock += 0.01
+        eng.submit(Workload(
+            name=f"low{i}", queue_name=f"lq{i % 9}", priority=0,
+            pod_sets=(PodSet("main", 1, {"cpu": 1000}),)))
+    return eng
+
+
+def run_churn(eng):
+    """Interleave high-priority submissions with cycles: every yield is
+    a kill window with preemptions in flight."""
+    from kueue_tpu.api.types import PodSet, Workload
+
+    for k in range(24):
+        if k < 18:
+            eng.clock += 0.01
+            eng.submit(Workload(
+                name=f"high{k}", queue_name=f"lq{k % 9}", priority=10,
+                pod_sets=(PodSet("main", 1, {"cpu": 2000}),)))
+        r = eng.schedule_once()
+        if r is not None and r.stats.preempting:
+            eng.tick(0.0)
+        yield k
+
+
+def drain(eng, cycles=80):
+    for _ in range(cycles):
+        r = eng.schedule_once()
+        if r is None:
+            break
+        if r.stats.preempting:
+            eng.tick(0.0)
+        elif not r.stats.admitted:
+            break
+
+
+def fingerprint(eng):
+    out = {}
+    for key, wl in eng.workloads.items():
+        out[key] = (wl.is_admitted, wl.is_finished,
+                    None if wl.status.admission is None
+                    else (wl.status.admission.cluster_queue, tuple(
+                        (psa.name, tuple(sorted(psa.flavors.items())),
+                         psa.count)
+                        for psa in wl.status.admission.pod_set_assignments)))
+    usage = {name: {(fr.flavor, fr.resource): v for fr, v in u.items()
+                    if v}
+             for name, u in eng.cache.cq_usage.items() if u}
+    return out, {k: v for k, v in usage.items() if v}
+
+
+def test_sigkill_mid_churn_then_restart_matches_control(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    child = subprocess.Popen(
+        [sys.executable, "-c", _CHILD.replace("{repo!r}", repr(REPO)),
+         path],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    # Let it get mid-churn (a few preemption cycles in), then SIGKILL —
+    # no atexit, no flush beyond what already hit the file.
+    seen = 0
+    deadline = time.monotonic() + 120
+    while seen < 6:
+        line = child.stdout.readline()
+        assert line, f"child exited early: {child.stderr.read()[-800:]}"
+        if line.startswith("cycle"):
+            seen += 1
+        assert time.monotonic() < deadline
+    os.kill(child.pid, signal.SIGKILL)
+    child.wait(timeout=30)
+    assert child.returncode == -signal.SIGKILL
+
+    # The journal survived the kill (torn tail tolerated) and rebuilds
+    # a CONSISTENT engine.
+    rebuilt = rebuild_engine(path)
+    wl_state, usage = fingerprint(rebuilt)
+    assert wl_state, "journal rebuilt an empty world"
+    # Accounting invariant: cache usage equals the sum of admitted
+    # workloads' assigned quantities.
+    expect_usage: dict = {}
+    for key, info in rebuilt.cache.workloads.items():
+        cqu = expect_usage.setdefault(info.cluster_queue, {})
+        for fr, v in info.usage().items():
+            k = (fr.flavor, fr.resource)
+            cqu[k] = cqu.get(k, 0) + v
+    got_usage = {name: {(fr.flavor, fr.resource): v
+                        for fr, v in u.items() if v}
+                 for name, u in rebuilt.cache.cq_usage.items() if u}
+    assert got_usage == {n: u for n, u in expect_usage.items() if u}
+
+    # Continue: submit whatever the child never got to, then drain.
+    submitted = {k for k in rebuilt.workloads}
+    from kueue_tpu.api.types import PodSet, Workload
+    for k in range(18):
+        name = f"default/high{k}"
+        if name not in submitted:
+            rebuilt.clock += 0.01
+            rebuilt.submit(Workload(
+                name=f"high{k}", queue_name=f"lq{k % 9}", priority=10,
+                pod_sets=(PodSet("main", 1, {"cpu": 2000}),)))
+    drain(rebuilt)
+
+    # Unkilled control: the identical deterministic scenario end-to-end.
+    control = build_world(None)
+    for _ in run_churn(control):
+        pass
+    drain(control)
+
+    assert fingerprint(rebuilt) == fingerprint(control), (
+        "restart-from-journal diverged from the unkilled control")
