@@ -542,6 +542,231 @@ def bench_tas(n_workloads, n_cqs=8):
     }
 
 
+def bench_tas_large(n_workloads=120, blocks=8, racks=16, hosts=40,
+                    n_cqs=8):
+    """Pod-slice-scale TAS (round-3 verdict #6b): a topology with
+    blocks*racks*hosts >= 4096 leaf domains — the regime where the
+    device placement kernel (ops/tas.tas_place) engages
+    (tas_path="device") and beats the host descent. The detail carries
+    the same per-placement probe as the 640-node scenario so the
+    device-vs-host comparison is measured on THIS forest, not
+    asserted."""
+    import random
+
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        PodSetTopologyRequest,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Topology,
+        TopologyLevel,
+        TopologyMode,
+        Workload,
+    )
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.tas.snapshot import HOSTNAME_LABEL, Node
+
+    n_leaves = blocks * racks * hosts
+
+    def build():
+        rng = random.Random(13)
+        eng = Engine()
+        eng.create_topology(Topology("dc", (
+            TopologyLevel("block"), TopologyLevel("rack"),
+            TopologyLevel(HOSTNAME_LABEL))))
+        eng.create_resource_flavor(ResourceFlavor(name="tas",
+                                                  topology_name="dc"))
+        for b in range(blocks):
+            for r in range(racks):
+                for h in range(hosts):
+                    name = f"b{b}-r{r}-h{h}"
+                    eng.create_node(Node(
+                        name=name,
+                        labels={"block": f"b{b}", "rack": f"b{b}-r{r}",
+                                HOSTNAME_LABEL: name},
+                        capacity={"cpu": 8000, "pods": 32}))
+        total = n_leaves * 8000
+        for i in range(n_cqs):
+            eng.create_cluster_queue(ClusterQueue(
+                name=f"cq-{i}", resource_groups=(ResourceGroup(
+                    ("cpu",), (FlavorQuotas("tas",
+                                            {"cpu": ResourceQuota(
+                                                total // n_cqs)}),)),)))
+            eng.create_local_queue(LocalQueue(f"lq-{i}", "default",
+                                              f"cq-{i}"))
+        eng.attach_oracle()
+        for i in range(n_workloads):
+            eng.clock += 0.001
+            mode = rng.choice([TopologyMode.REQUIRED,
+                               TopologyMode.PREFERRED,
+                               TopologyMode.UNCONSTRAINED])
+            level = None if mode == TopologyMode.UNCONSTRAINED else \
+                rng.choice(["block", "rack"])
+            eng.submit(Workload(
+                name=f"tas-{i}", queue_name=f"lq-{rng.randrange(n_cqs)}",
+                pod_sets=(PodSet(
+                    "main", rng.choice([4, 8, 16]), {"cpu": 1000},
+                    topology_request=PodSetTopologyRequest(
+                        mode=mode, level=level)),)))
+        return eng
+
+    _drain_engine(build())  # warm-up: compile the placement programs
+    eng = build()
+    t0 = time.perf_counter()
+    admitted, _ = _drain_engine(eng)
+    elapsed = time.perf_counter() - t0
+    value = admitted / elapsed if elapsed > 0 else 0.0
+
+    from kueue_tpu.tas.device import (
+        DEVICE_TAS_MIN_DOMAINS,
+        worth_offloading,
+    )
+    snap = next(iter(eng.cache.tas_prototypes().values()), None)
+    path = "device" if (snap is not None and worth_offloading(snap)) \
+        else "host"
+    xover = _tas_crossover_measure(build)
+    return {
+        "value": round(value, 1), "unit": "admissions/s",
+        "vs_baseline": round(value / REF_TAS_ADM_S, 2),
+        "detail": {"workloads": n_workloads, "nodes": n_leaves,
+                   "admitted": admitted,
+                   "elapsed_s": round(elapsed, 3),
+                   "tas_path": path,
+                   "device_crossover_domains": DEVICE_TAS_MIN_DOMAINS,
+                   **xover,
+                   **_device_share(eng)},
+    }
+
+
+def bench_tas_churn(n_cqs=32, blocks=8, racks=16, hosts=40,
+                    n_wl=320, churn_cycles=20):
+    """The device-TAS winning regime (round-3 verdict #6): a pod-slice
+    scale forest under steady churn. Finishes free capacity each tick
+    and requeue the cohort's parked workloads; most re-tried heads still
+    can't fit, and the batched feasibility kernel
+    (ops/tas.tas_feasibility, wired at scheduler/cycle.py _nominate)
+    decides ALL of them in one launch where the host pays a full
+    placement descent per head. Both paths run on the SAME world and
+    must produce identical admission traces; value is the device-path
+    decision rate and vs_baseline is the speedup over the host path."""
+    import random
+
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        PodSetTopologyRequest,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Topology,
+        TopologyLevel,
+        TopologyMode,
+        Workload,
+    )
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.tas.snapshot import HOSTNAME_LABEL, Node
+
+    def build():
+        rng = random.Random(11)
+        eng = Engine()
+        eng.create_topology(Topology("dc", (
+            TopologyLevel("block"), TopologyLevel("rack"),
+            TopologyLevel(HOSTNAME_LABEL))))
+        eng.create_resource_flavor(ResourceFlavor(name="tas",
+                                                  topology_name="dc"))
+        for b in range(blocks):
+            for r in range(racks):
+                for h in range(hosts):
+                    name = f"b{b}-r{r}-h{h}"
+                    eng.create_node(Node(
+                        name=name,
+                        labels={"block": f"b{b}", "rack": f"b{b}-r{r}",
+                                HOSTNAME_LABEL: name},
+                        capacity={"cpu": 8000, "pods": 8}))
+        total = blocks * racks * hosts * 8000
+        for i in range(n_cqs):
+            eng.create_cluster_queue(ClusterQueue(
+                name=f"cq-{i}", cohort="shared",
+                resource_groups=(ResourceGroup(
+                    ("cpu",), (FlavorQuotas("tas", {"cpu": ResourceQuota(
+                        total // n_cqs)}),)),)))
+            eng.create_local_queue(LocalQueue(f"lq-{i}", "default",
+                                              f"cq-{i}"))
+        eng.attach_oracle()
+        rack_pods = hosts * 8
+        for i in range(n_wl):
+            eng.clock += 0.001
+            level = rng.choice(["rack", "block"])
+            cnt = rng.choice([rack_pods - 64, rack_pods,
+                              rack_pods + 192])
+            eng.submit(Workload(
+                name=f"t-{i}", queue_name=f"lq-{rng.randrange(n_cqs)}",
+                pod_sets=(PodSet(
+                    "main", cnt, {"cpu": 100},
+                    topology_request=PodSetTopologyRequest(
+                        mode=TopologyMode.REQUIRED, level=level)),)))
+        return eng
+
+    def churn(eng):
+        for _ in range(80):
+            if eng.schedule_once() is None:
+                break
+        heads_total = 0
+        trace = []
+        t0 = time.perf_counter()
+        for _ in range(churn_cycles):
+            adm = sorted(k for k, w in eng.workloads.items()
+                         if w.is_admitted and not w.is_finished)
+            for k in adm[:2]:
+                eng.finish(k)
+            # heads() pops; count nominations non-destructively as
+            # CQs-with-pending (one head per CQ, manager.go:872).
+            heads_total += sum(
+                1 for cq in eng.queues.cluster_queues
+                if eng.queues.pending_workloads(cq) > 0)
+            eng.schedule_once()
+            trace.append(tuple(sorted(
+                k for k, w in eng.workloads.items()
+                if w.is_admitted and not w.is_finished)))
+        return time.perf_counter() - t0, heads_total, trace
+
+    prior = os.environ.get("KUEUE_TPU_TAS_FEAS")
+    out = {}
+    try:
+        for label, env in (("device", "1"), ("host", "0")):
+            os.environ["KUEUE_TPU_TAS_FEAS"] = env
+            eng = build()
+            if label == "device":
+                churn(build())  # warm the feasibility compile
+            out[label] = churn(eng)
+    finally:
+        if prior is None:
+            os.environ.pop("KUEUE_TPU_TAS_FEAS", None)
+        else:
+            os.environ["KUEUE_TPU_TAS_FEAS"] = prior
+    d_el, d_heads, d_trace = out["device"]
+    h_el, h_heads, h_trace = out["host"]
+    value = d_heads / d_el if d_el > 0 else 0.0
+    host_rate = h_heads / h_el if h_el > 0 else 0.0
+    return {
+        "value": round(value, 1), "unit": "head decisions/s",
+        "vs_baseline": round(value / host_rate, 2) if host_rate else 0.0,
+        "detail": {"nodes": blocks * racks * hosts, "cqs": n_cqs,
+                   "workloads": n_wl, "churn_cycles": churn_cycles,
+                   "device_cycle_ms": round(d_el / churn_cycles * 1e3, 1),
+                   "host_cycle_ms": round(h_el / churn_cycles * 1e3, 1),
+                   "heads_per_cycle": round(d_heads / churn_cycles, 1),
+                   "traces_equal": d_trace == h_trace,
+                   "tas_path": "feasibility-batch"},
+    }
+
+
 def _tas_crossover_measure(build, n_probe: int = 5) -> dict:
     """Per-placement latency of the host descent vs the device kernel on
     the SAME 640-leaf forest — the measurement behind the
@@ -648,6 +873,15 @@ def main() -> None:
         n_roots=8 if fast else 30), min_budget_s=60.0)
     run_scenario("tas", lambda: bench_tas(60 if fast else 800,
                                           n_cqs=4 if fast else 8))
+    run_scenario("tas_large", lambda: bench_tas_large(
+        n_workloads=30 if fast else 120,
+        blocks=4 if fast else 8, racks=8 if fast else 16,
+        hosts=32 if fast else 40), min_budget_s=60.0)
+    run_scenario("tas_churn", lambda: bench_tas_churn(
+        n_cqs=8 if fast else 32, blocks=4 if fast else 8,
+        racks=8 if fast else 16, hosts=32 if fast else 40,
+        n_wl=80 if fast else 320,
+        churn_cycles=6 if fast else 20), min_budget_s=60.0)
 
     # Compact per-scenario path labels for the trailer: the platform
     # must be provable from the END of the line (the driver's capture
@@ -669,7 +903,7 @@ def main() -> None:
             f" {flat['detail']['cycles']} cycles ({dev.platform});"
             " scenarios: cycle-latency p95 (classical + fair-mode),"
             " hierarchical fair sharing, preemption churn, mixed world"
-            " w/ device share, TAS 640 nodes"),
+            " w/ device share, TAS 640 nodes + pod-slice churn"),
         "value": flat["value"],
         "unit": "admissions/s",
         "vs_baseline": flat["vs_baseline"],

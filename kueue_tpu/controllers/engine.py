@@ -569,6 +569,56 @@ class Engine:
             cached[1][cq_name] = labels
         return labels
 
+    def hold_workload(self, key: str, message: str = "") -> None:
+        """statefulset_reconciler.go:295 (releaseScaleDownReservation):
+        release the quota reservation with QuotaReserved=False reason
+        OnHold and do NOT requeue — the workload stays parked out of
+        every queue until clear_hold() (a scale-to-zero serving job
+        keeps its Workload without consuming quota)."""
+        wl = self.workloads.get(key)
+        if wl is None or wl.is_finished or self.is_on_hold(wl):
+            return
+        cq = (wl.status.admission.cluster_queue
+              if wl.status.admission is not None else "")
+        if wl.status.admission is not None:
+            self.cache.delete_workload(key)
+        wl.status.admission = None
+        if wl.is_admitted:
+            wl.set_condition(WorkloadConditionType.ADMITTED, False,
+                             reason="OnHold", now=self.clock)
+        wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, False,
+                         reason="OnHold", now=self.clock)
+        self.queues.delete_workload(wl)
+        self.unadmitted.remove(key)
+        self._event("OnHold", key, cluster_queue=cq, detail=message)
+        self._journal_obj("workload", wl)
+        if cq:
+            # Freed quota wakes the cohort's parked peers.
+            self._requeue_cohort_inadmissible(cq)
+
+    @staticmethod
+    def is_on_hold(wl: Workload) -> bool:
+        """workload.IsOnHold: QuotaReserved is False with reason
+        OnHold."""
+        cond = wl.condition(WorkloadConditionType.QUOTA_RESERVED)
+        return (cond is not None and not cond.status
+                and cond.reason == "OnHold")
+
+    def clear_hold(self, key: str) -> None:
+        """statefulset_reconciler.go:274 (clearOnHold): the workload
+        becomes admissible again and requeues."""
+        wl = self.workloads.get(key)
+        if wl is None or not self.is_on_hold(wl):
+            return
+        wl.set_condition(WorkloadConditionType.QUOTA_RESERVED, False,
+                         reason="Pending", now=self.clock)
+        info = self.queues.add_or_update_workload(wl)
+        if info is not None:
+            self._track_unadmitted(wl, info.cluster_queue,
+                                   "NoReservation")
+        self._event("HoldCleared", key)
+        self._journal_obj("workload", wl)
+
     def finish(self, key: str) -> None:
         wl = self.workloads.get(key)
         if wl is None:

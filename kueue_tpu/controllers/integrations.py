@@ -90,10 +90,17 @@ class TrainingJob(_BaseJob):
 
 @dataclass
 class RayClusterJob(_BaseJob):
-    """Ray cluster: head + worker groups (pkg/controller/jobs/raycluster)."""
+    """Ray cluster: head + worker groups (pkg/controller/jobs/raycluster,
+    common.go head/worker pod sets). The in-tree autoscaler
+    (enableInTreeAutoscaling) is only admissible for elastic jobs under
+    ElasticJobsViaWorkloadSlices (raycluster_webhook.go:141) — the
+    autoscaler's replica changes then flow through workload slices;
+    scale_group() is the RayCluster workerGroup replicas update."""
 
     head_requests: dict = field(default_factory=dict)
     worker_groups: list = field(default_factory=list)  # (name, n, requests)
+    enable_in_tree_autoscaling: bool = False
+    elastic: bool = False
 
     def pod_sets(self) -> list[PodSet]:
         out = [PodSet(name="head", count=1,
@@ -102,6 +109,11 @@ class RayClusterJob(_BaseJob):
             out.append(PodSet(name=gname, count=replicas,
                               requests=dict(requests)))
         return out
+
+    def scale_group(self, group: str, replicas: int) -> None:
+        self.worker_groups = [
+            (g, replicas if g == group else n, req)
+            for g, n, req in self.worker_groups]
 
 
 @dataclass
@@ -520,15 +532,33 @@ class PodGroup:
 
 @dataclass
 class StatefulSetJob(_BaseJob):
-    """StatefulSet (pkg/controller/jobs/statefulset): serving pods behind
-    gates; scale-ups flow through workload slices."""
+    """StatefulSet (pkg/controller/jobs/statefulset): a serving job with
+    a prebuilt-workload lifecycle. Scale semantics
+    (statefulset_reconciler.go:187):
+      * scale to ZERO releases the reservation with reason OnHold and
+        parks the Workload (:295 releaseScaleDownReservation) — pods
+        gone, quota freed, Workload kept;
+      * scale back up clears the hold (:274 clearOnHold) and requeues;
+      * replica changes on a RUNNING set flow through elastic workload
+        slices when the job is elastic (ElasticJobsViaWorkloadSlices),
+        otherwise re-create the Workload (stop-and-requeue).
+    """
 
     replicas: int = 1
     requests: dict = field(default_factory=dict)
+    # statefulset jobs are scale-to-zero serving objects.
+    hold_at_zero: bool = True
+    # ElasticJobsViaWorkloadSlices opt-in (the elastic-job annotation).
+    elastic: bool = False
 
     def pod_sets(self) -> list[PodSet]:
         return [PodSet(name="pods", count=self.replicas,
                        requests=dict(self.requests))]
+
+    def scale(self, replicas: int) -> None:
+        """The Scale-subresource update; the reconciler turns it into
+        hold/clear-hold or a slice/recreate on the next pass."""
+        self.replicas = replicas
 
     def finished(self) -> tuple[bool, bool]:
         return False, False
@@ -553,12 +583,18 @@ class DeploymentJob(_BaseJob):
 
 @dataclass
 class SparkApplicationJob(_BaseJob):
-    """SparkApplication (pkg/controller/jobs/sparkapplication): driver +
-    executors."""
+    """SparkApplication (pkg/controller/jobs/sparkapplication): one
+    driver pod set + one executor pod set sized by spec.executor
+    .instances (sparkapplication_podset.go). dynamicAllocation is only
+    admissible for elastic jobs under ElasticJobsViaWorkloadSlices
+    (sparkapplication_webhook.go:125) — the operator's executor-count
+    changes then flow through workload slices via scale_executors()."""
 
     driver_requests: dict = field(default_factory=dict)
     executor_instances: int = 1
     executor_requests: dict = field(default_factory=dict)
+    dynamic_allocation: bool = False
+    elastic: bool = False
 
     def pod_sets(self) -> list[PodSet]:
         return [
@@ -567,6 +603,9 @@ class SparkApplicationJob(_BaseJob):
             PodSet(name="executor", count=self.executor_instances,
                    requests=dict(self.executor_requests)),
         ]
+
+    def scale_executors(self, instances: int) -> None:
+        self.executor_instances = instances
 
 
 @dataclass

@@ -354,6 +354,23 @@ class JobReconciler:
             # CREATING the Workload; an existing group keeps reconciling
             # through member failures (replacement-pod flow).
             return
+        if getattr(job, "hold_at_zero", False):
+            # Serving jobs (StatefulSet): scale-to-zero releases the
+            # reservation with reason OnHold instead of finishing or
+            # requeueing (statefulset_reconciler.go:223-264); scaling
+            # back up clears the hold below.
+            total = sum(ps.count for ps in job.pod_sets())
+            wl_key = self.job_to_workload.get(job.key)
+            if total == 0:
+                if wl_key is not None:
+                    self.engine.hold_workload(
+                        wl_key, "scaled to zero; workload on hold")
+                return
+            if wl_key is not None:
+                wl_held = self.engine.workloads.get(wl_key)
+                if wl_held is not None and \
+                        self.engine.is_on_hold(wl_held):
+                    self.engine.clear_hold(wl_key)
         wl = self._ensure_one_workload(job)
         if wl is None:
             return
@@ -386,9 +403,18 @@ class JobReconciler:
         if wl.is_admitted and job.is_suspended():
             self._start_job(job, wl)
         elif not wl.is_admitted and not job.is_suspended():
-            # stopJob on eviction (reconciler.go:379-394).
-            job.suspend()
-            job.restore_pod_sets_info([])
+            old_slice = wl.replaced_workload_slice
+            old_wl = (self.engine.workloads.get(old_slice)
+                      if old_slice is not None else None)
+            if old_wl is not None and old_wl.is_admitted:
+                # Elastic slice replacement pending: the OLD slice still
+                # holds the quota and the pods keep running
+                # (workloadslicing.go — scale never stops the job).
+                pass
+            else:
+                # stopJob on eviction (reconciler.go:379-394).
+                job.suspend()
+                job.restore_pod_sets_info([])
         self._sync_reclaimable(job, wl)
 
     def _sync_reclaimable(self, job: GenericJob, wl: Workload) -> None:
@@ -429,19 +455,43 @@ class JobReconciler:
             return wl
         wl_key = self.job_to_workload.get(job.key)
         pod_sets = job.pod_sets()
+        replaced_slice = None
         if wl_key is not None:
             wl = self.engine.workloads.get(wl_key)
             if wl is not None and _pod_sets_match(wl, pod_sets):
                 return wl
             if wl is not None:
-                self.engine.finish(wl_key)
-                self.workload_to_job.pop(wl_key, None)
+                from kueue_tpu.config import features
+                if (getattr(job, "elastic", False)
+                        and features.enabled(
+                            "ElasticJobsViaWorkloadSlices")
+                        and wl.is_admitted and not wl.is_finished):
+                    # Elastic scale of a RUNNING job: the replacement
+                    # workload SLICE preempt-replaces the old one
+                    # without stopping its pods (workloadslicing.go:46;
+                    # the scheduler finishes the old slice when the
+                    # replacement admits, scheduler.go:558).
+                    replaced_slice = wl_key
+                else:
+                    # A re-scale before a pending slice admitted must
+                    # keep pointing at the still-admitted predecessor:
+                    # dropping the chain would leak its quota forever
+                    # and suspend the running pods.
+                    old_key = wl.replaced_workload_slice
+                    if old_key is not None:
+                        old = self.engine.workloads.get(old_key)
+                        if old is not None and old.is_admitted \
+                                and not old.is_finished:
+                            replaced_slice = old_key
+                    self.engine.finish(wl_key)
+                    self.workload_to_job.pop(wl_key, None)
         wl = Workload(
             name=f"{job.name}-wl-{next(_wl_suffix)}",
             namespace=job.namespace,
             queue_name=job.queue_name,
             priority=getattr(job, "priority", 0),
             pod_sets=tuple(pod_sets),
+            replaced_workload_slice=replaced_slice,
         )
         if not self.engine.submit(wl):
             return None

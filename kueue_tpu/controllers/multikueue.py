@@ -188,6 +188,10 @@ class MultiKueueController:
     def reconcile(self) -> None:
         self.reconcile_clusters()
         self.reconcile_cluster_queues()
+        # The reference runs runGC on a timer per connected cluster
+        # (multikueuecluster.go:608); the engine's tick IS the timer
+        # here, so every reconcile sweeps origin-labeled orphans.
+        self.run_gc()
         acm = self.engine.admission_checks
         for wl in list(self.engine.workloads.values()):
             if wl.is_finished:

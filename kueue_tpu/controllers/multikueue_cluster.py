@@ -98,13 +98,18 @@ class RemoteClient:
         now = self.clock()
         mtime = self._stat_mtime()
         reconfigured = False
-        if self.worker is not None and mtime != self._mtime:
+        if mtime != self._mtime:
             # fswatch.go: the kubeconfig changed — rebuild immediately
-            # (credential rotation must not wait out a backoff).
-            self.worker = None
+            # (credential rotation must not wait out a backoff). While
+            # DISCONNECTED the same rule cancels any accumulated
+            # reconnect backoff: the operator just rotated the
+            # credentials the backoff was waiting on.
+            if self.worker is not None:
+                self.worker = None
+                self.active = ClusterActive(False, "KubeconfigChanged", "")
+                reconfigured = True
             self.next_attempt_at = now
-            self.active = ClusterActive(False, "KubeconfigChanged", "")
-            reconfigured = True
+            self._mtime = mtime
         if self.worker is None and now >= self.next_attempt_at:
             try:
                 with open(self.kubeconfig_path, encoding="utf-8") as f:

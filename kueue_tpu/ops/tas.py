@@ -346,8 +346,13 @@ def tas_place(free, usage, assumed, per_pod, leader_per_pod, leaf_mask,
         return st[lvl], sst[lvl], swl[lvl], sstl[lvl], ls[lvl]
 
     # --- per-level leader-order ranks and top-fit flags ---
+    # Required/unconstrained place at exactly req_level, so the
+    # selection sorts for the levels above are statically dead — each
+    # skipped level saves a multi-key lax.sort of the whole forest.
+    sel_levels = ((req_level,) if (required or unconstrained)
+                  else range(req_level + 1))
     lperm, lrank, topfit, topslice = {}, {}, {}, {}
-    for lvl in range(req_level + 1):
+    for lvl in sel_levels:
         stl_, sst_, swl_, sstl_, ls_ = level_arrays(lvl)
         perm, rank = _rank_of(
             _leader_keys(swl_, sstl_, ls_, vrank[lvl], valid[lvl],
@@ -361,7 +366,7 @@ def tas_place(free, usage, assumed, per_pod, leader_per_pod, leaf_mask,
     # findLevelWithFitDomains recursion: deepest level whose best domain
     # fits; preferred climbs toward the root, required stays put.
     if required or unconstrained:
-        fit_level = jnp.int64(req_level)
+        fit_level = req_level  # static: descent above it never runs
     else:
         fit_level = jnp.int64(0)
         for lvl in range(req_level + 1):
@@ -517,19 +522,22 @@ def tas_place(free, usage, assumed, per_pod, leader_per_pod, leaf_mask,
     lead = jnp.zeros(M, jnp.int64)
     status = jnp.int64(OK)
     fit_arg = jnp.int64(0)
-    cand = range(req_level + 1) if not (required or unconstrained) \
-        else (req_level,)
+    static_fit = required or unconstrained
+    cand = range(req_level + 1) if not static_fit else (req_level,)
     sels = {lvl: selection_at(lvl) for lvl in cand}
     for lvl in range(NL):
         if lvl in sels:
-            here = fit_level == lvl
+            here = jnp.bool_(True) if static_fit else fit_level == lvl
             s_cnt, s_lead, s_st, s_fa = sels[lvl]
             cnt = jnp.where(here, s_cnt, cnt)
             lead = jnp.where(here, s_lead, lead)
             status = jnp.where(here, s_st, status)
             fit_arg = jnp.where(here, s_fa, fit_arg)
         if lvl < NL - 1:
-            act = (fit_level <= lvl) & (status == OK)
+            if static_fit and lvl < req_level:
+                continue  # statically above the placement level
+            act = ((status == OK) if static_fit
+                   else (fit_level <= lvl) & (status == OK))
             if lvl + 1 <= slice_level:
                 n_cnt, n_lead, d_st, d_fa = pooled_step(lvl, cnt, lead)
             else:
@@ -577,3 +585,116 @@ def encode_tas_snapshot(tas_snap, resources: list[str]):
         "tas_usage": usage,
         "level_domains": level_domains,
     }
+
+# ---------------------------------------------------------------------------
+# Batched feasibility: exact fit/no-fit (and the notFitMessage argument)
+# for leaderless, ungrouped, unfiltered single-pod-set requests, B at a
+# time against one forest. Phase 1 is the only ingredient — segment
+# reductions, no sorts — so one launch replaces B host descents when the
+# cycle only needs to LEARN THE FAILURE (findLevelWithFitDomains :1377
+# failure branches; success still runs the real placement).
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("num_levels", "max_domains",
+                                   "pods_col"))
+def tas_feasibility(free, usage, per_pod, count, slice_size, slice_level,
+                    req_level, mode, valid, parent, has_pods_cap, *,
+                    num_levels, max_domains, pods_col):
+    """Exact batched fit verdicts.
+
+    free/usage: int64[M, S] — the kernel evaluates both the live world
+    (free - usage) and the simulate-empty world (free); per_pod:
+    int64[B, S];
+    count/slice_size/slice_level/req_level/mode: int64[B]
+    (mode 0=required, 1=preferred, 2=unconstrained); valid: bool[NL, M];
+    parent: int64[NL, M]; has_pods_cap: bool[M].
+
+    Returns (fit bool[2, B], fit_arg int64[2, B]): fit mirrors
+    find_topology_assignments success for each usage variant; fit_arg is
+    the notFitMessage argument the sequential path would report on
+    failure (top slice-state for required, consumed slice total for
+    preferred/unconstrained)."""
+    NL, M = num_levels, max_domains
+    B = per_pod.shape[0]
+    S = per_pod.shape[1]
+    rem = jnp.maximum(jnp.stack([free - usage, free]), 0)  # [2, M, S]
+
+    # count_in (:1864) batched: min over applicable resources of
+    # rem // req, per (variant, request, leaf).
+    cnt = jnp.full((2, B, M), _IBIG)
+    any_app = jnp.zeros((B, M), bool)
+    for s in range(S):
+        req_s = per_pod[:, s]                       # [B]
+        app = req_s > 0                             # [B]
+        if s == pods_col:
+            app_m = app[:, None] & has_pods_cap[None, :]       # [B, M]
+        else:
+            app_m = jnp.broadcast_to(app[:, None], (B, M))
+        div = rem[:, :, s][:, None, :] // jnp.maximum(req_s, 1)[None, :,
+                                                                None]
+        cnt = jnp.where(app_m[None], jnp.minimum(cnt, div), cnt)
+        any_app = any_app | app_m
+    # A leaf with zero applicable constraints fits zero pods.
+    st = jnp.where(valid[NL - 1][None, None, :] & any_app[None], cnt, 0)
+
+    ss = jnp.maximum(slice_size, 1)
+    sc = count // ss                                # [B]
+    sst = jnp.where((slice_level == NL - 1)[None, :, None],
+                    st // ss[None, :, None], 0)
+
+    max_sst = []
+    sum_sst = []
+
+    def level_stats(lvl, st_l, sst_l):
+        v = valid[lvl][None, None, :]
+        mx = jnp.max(jnp.where(v, sst_l, 0), axis=2)
+        sm = jnp.sum(jnp.where(v, sst_l, 0), axis=2)
+        return mx, sm
+
+    mx, sm = level_stats(NL - 1, st, sst)
+    max_sst.append(mx)
+    sum_sst.append(sm)
+    for lvl in range(NL - 2, -1, -1):
+        cv = valid[lvl + 1]
+        seg = jnp.where(cv, parent[lvl + 1], M)     # [M]
+        st_t = jnp.moveaxis(jnp.where(cv[None, None], st, 0), 2, 0)
+        sst_t = jnp.moveaxis(jnp.where(cv[None, None], sst, 0), 2, 0)
+        sum_st = jnp.moveaxis(jax.ops.segment_sum(
+            st_t, seg, num_segments=M + 1)[:M], 0, 2)
+        sum_ss = jnp.moveaxis(jax.ops.segment_sum(
+            sst_t, seg, num_segments=M + 1)[:M], 0, 2)
+        v = valid[lvl][None, None, :]
+        st = jnp.where(v, sum_st, 0)
+        sst = jnp.where(v, jnp.where(
+            (slice_level == lvl)[None, :, None],
+            st // ss[None, :, None], sum_ss), 0)
+        mx, sm = level_stats(lvl, st, sst)
+        max_sst.append(mx)
+        sum_sst.append(sm)
+    max_sst = jnp.stack(max_sst[::-1], axis=2)      # [2, B, NL]
+    sum_sst = jnp.stack(sum_sst[::-1], axis=2)
+
+    rl = jnp.clip(req_level, 0, NL - 1)
+    at_req_max = jnp.take_along_axis(
+        max_sst, jnp.broadcast_to(rl[None, :, None], (2, B, 1)),
+        axis=2)[:, :, 0]
+    at_req_sum = jnp.take_along_axis(
+        sum_sst, jnp.broadcast_to(rl[None, :, None], (2, B, 1)),
+        axis=2)[:, :, 0]
+    lvl_idx = jnp.arange(NL, dtype=jnp.int64)
+    topfit_any = jnp.any(
+        (lvl_idx[None, None, :] <= rl[None, :, None])
+        & (max_sst >= sc[None, :, None]), axis=2)
+    sum0 = sum_sst[:, :, 0]
+
+    scb = sc[None, :]
+    fit_required = at_req_max >= scb
+    fit_uncon = at_req_sum >= scb
+    fit_pref = topfit_any | (sum0 >= scb)
+    m = mode[None, :]
+    fit = jnp.where(m == 0, fit_required,
+                    jnp.where(m == 2, fit_uncon, fit_pref))
+    fit_arg = jnp.where(m == 0, at_req_max,
+                        jnp.where(m == 2, at_req_sum, sum0))
+    return fit, fit_arg

@@ -163,6 +163,13 @@ class SchedulerCycle:
     def _nominate(self, heads: list[WorkloadInfo], snapshot: Snapshot,
                   result: CycleResult, already_admitted: set[str],
                   now: float) -> list[Entry]:
+        from kueue_tpu.tas import feasibility
+
+        # One batched launch per TAS forest decides fit/no-fit for every
+        # qualifying head before the per-entry walk; apply_tas_pass
+        # consults the verdicts and skips the sequential descent for
+        # provably-unplaceable entries.
+        feasibility.precompute(heads, snapshot)
         entries: list[Entry] = []
         for w in heads:
             e = Entry(info=w)

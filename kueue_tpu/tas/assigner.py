@@ -85,6 +85,34 @@ def find_assignments(cq_snapshot, tas_requests: dict[str, list],
     return results, None
 
 
+def _precomputed_failure(tas_requests: dict[str, list], cq_snapshot,
+                         simulate_empty: bool):
+    """A batched-feasibility rejection for the request the sequential
+    path would fail on FIRST (the first order group of the first sorted
+    flavor), or None to run the real placement. Exact: the verdict
+    carries the notFitMessage argument the host descent would report."""
+    from kueue_tpu.tas import feasibility
+
+    flavor = sorted(tas_requests)[0]
+    pairs = tas_requests[flavor]
+    psa, request = pairs[0]
+    tr = request.pod_set.topology_request
+    if tr is not None and tr.pod_set_group_name:
+        return None  # the first group may pair a leader
+    snap = cq_snapshot.tas_flavors[flavor]
+    vd = feasibility.lookup(snap, request)
+    if vd is None:
+        return None
+    sc = request.count // (tr.slice_size if tr and tr.slice_size else 1)
+    if simulate_empty:
+        if vd.fit_empty:
+            return None
+        return psa.name, snap._not_fit_message(vd.arg_empty, sc)
+    if vd.fit_used or not feasibility.used_valid(snap):
+        return None
+    return psa.name, snap._not_fit_message(vd.arg_used, sc)
+
+
 def apply_tas_pass(assignment: Assignment, wl: WorkloadInfo,
                    cq_snapshot, previous_slice=None) -> None:
     """The flavorassigner.go:783-821 TAS block."""
@@ -93,7 +121,11 @@ def apply_tas_pass(assignment: Assignment, wl: WorkloadInfo,
     if not tas_requests:
         return
     if assignment.representative_mode() == Mode.FIT:
-        results, failure = find_assignments(cq_snapshot, tas_requests)
+        failure = _precomputed_failure(tas_requests, cq_snapshot,
+                                       simulate_empty=False)
+        results = None
+        if failure is None:
+            results, failure = find_assignments(cq_snapshot, tas_requests)
         if failure is not None:
             ps_name, reason = failure
             for psa in assignment.pod_sets:
@@ -105,8 +137,14 @@ def apply_tas_pass(assignment: Assignment, wl: WorkloadInfo,
                 if psa.name in results:
                     psa.topology_assignment = results[psa.name]
     if assignment.representative_mode() == Mode.PREEMPT:
-        results, failure = find_assignments(cq_snapshot, tas_requests,
-                                            simulate_empty=True)
+        failure = _precomputed_failure(tas_requests, cq_snapshot,
+                                       simulate_empty=True)
+        if failure is not None:
+            ps_name, _ = failure
+            assignment.update_mode(ps_name, Mode.NO_FIT)
+            return
+        results, failure = find_assignments(
+            cq_snapshot, tas_requests, simulate_empty=True)
         if failure is not None:
             ps_name, _ = failure
             assignment.update_mode(ps_name, Mode.NO_FIT)

@@ -79,6 +79,8 @@ def _structure(snap):
                       level_domains=level_domains,
                       leaves=(level_domains[-1] if level_domains
                               else []))
+        # slot_of_leaf_values / slot_of_leaf_id stay valid: forks keep
+        # ids, values and slot order.
         snap._device_struct = cached
         return cached
     nl = len(snap.level_keys)
@@ -107,6 +109,10 @@ def _structure(snap):
         has_pods_cap[i] = "pods" in leaf.free_capacity
     cached = dict(version=version, nl=nl, m=mp,
                   level_domains=level_domains, leaves=leaves,
+                  slot_of_leaf_values={d.values: i
+                                       for i, d in enumerate(leaves)},
+                  slot_of_leaf_id={d.id: i
+                                   for i, d in enumerate(leaves)},
                   res_axis=res_axis, valid=valid, vrank=vrank,
                   parent=parent, has_pods_cap=has_pods_cap,
                   # Present from birth so fork copies SHARE them — a
@@ -157,7 +163,16 @@ def _usage_matrix(snap, struct, cols: list[str]) -> np.ndarray:
         return ucache[1]
     col_of = {res: i for i, res in enumerate(cols)}
     usage = np.zeros((struct["m"], len(cols)), np.int64)
-    for i, leaf in enumerate(struct["leaves"]):
+    used_leaves = getattr(snap, "_used_leaves", None)
+    if used_leaves is None:
+        leaf_iter = enumerate(struct["leaves"])
+    else:
+        # Only leaves that ever carried usage — O(used), not O(forest).
+        slot_of = struct["slot_of_leaf_values"]
+        leaves = struct["leaves"]
+        leaf_iter = ((slot_of[v], leaves[slot_of[v]])
+                     for v in used_leaves if v in slot_of)
+    for i, leaf in leaf_iter:
         for res, used in leaf.tas_usage.items():
             if res in col_of:
                 usage[i, col_of[res]] = used
@@ -349,8 +364,12 @@ def try_find(snap, workers, leader=None, simulate_empty=False,
     else:
         usage = _usage_matrix(snap, struct, cols)
         if assumed_usage:
-            for i, leaf in enumerate(leaves):
-                for res, used in assumed_usage.get(leaf.id, {}).items():
+            slot_of_id = struct["slot_of_leaf_id"]
+            for leaf_id, res_used in assumed_usage.items():
+                i = slot_of_id.get(leaf_id)
+                if i is None:
+                    continue
+                for res, used in res_used.items():
                     if res in col_of:
                         assumed[i, col_of[res]] = used
 
@@ -358,16 +377,20 @@ def try_find(snap, workers, leader=None, simulate_empty=False,
     # :1864 early returns).
     leaf_mask = struct["valid"][struct["nl"] - 1].copy()
     rrd = tuple(required_replacement_domain or ())
-    for i, leaf in enumerate(leaves):
-        if rrd and leaf.values[:len(rrd)] != rrd:
-            leaf_mask[i] = False
-            continue
-        if snap.is_lowest_level_node:
-            for key, val in workers.pod_set.node_selector.items():
-                if key in snap.level_keys and \
-                        leaf.values[snap.level_keys.index(key)] != val:
-                    leaf_mask[i] = False
-                    break
+    needs_selector = (snap.is_lowest_level_node
+                      and any(k in snap.level_keys
+                              for k in workers.pod_set.node_selector))
+    if rrd or needs_selector:
+        for i, leaf in enumerate(leaves):
+            if rrd and leaf.values[:len(rrd)] != rrd:
+                leaf_mask[i] = False
+                continue
+            if needs_selector:
+                for key, val in workers.pod_set.node_selector.items():
+                    if key in snap.level_keys and \
+                            leaf.values[snap.level_keys.index(key)] != val:
+                        leaf_mask[i] = False
+                        break
 
     import jax.numpy as jnp
 
@@ -390,18 +413,52 @@ def try_find(snap, workers, leader=None, simulate_empty=False,
     if j_free is None:
         j_free = jnp.asarray(free)
         jnp_cache[("free", cols_key)] = j_free
+    # Usage / assumed / mask are device-resident between calls: the
+    # usage matrix only changes when TAS usage mutates (keyed on the
+    # same version as _usage_matrix, held on the snap so forks don't
+    # alias), the all-zero assumed matrix is shared per shape, and the
+    # default leaf mask is the forest's own validity row.
+    def _cached_zeros(shape):
+        z = jnp_cache.get(("zeros", shape))
+        if z is None:
+            z = jnp_cache[("zeros", shape)] = jnp.zeros(shape, jnp.int64)
+        return z
+
+    if simulate_empty or not np.any(usage):
+        j_usage = _cached_zeros(usage.shape)
+    else:
+        ukey = (getattr(snap, "_usage_version", 0), cols_key)
+        cached_u = getattr(snap, "_j_usage_cache", None)
+        if cached_u is not None and cached_u[0] == ukey:
+            j_usage = cached_u[1]
+        else:
+            j_usage = jnp.asarray(usage)
+            snap._j_usage_cache = (ukey, j_usage)
+    if np.any(assumed):
+        j_assumed = jnp.asarray(assumed)
+    else:
+        j_assumed = _cached_zeros(assumed.shape)
+    if rrd or needs_selector:
+        j_mask = jnp.asarray(leaf_mask)
+    else:
+        j_mask = jnp_cache.get("default_mask")
+        if j_mask is None:
+            j_mask = jnp_cache["default_mask"] = jnp.asarray(leaf_mask)
 
     status, fit_arg, cnt, lead = tops.tas_place(
-        j_free, jnp.asarray(usage), jnp.asarray(assumed),
+        j_free, j_usage, j_assumed,
         jnp.asarray(_req_vector(per_pod, cols)),
         jnp.asarray(_req_vector(leader_per_pod, cols)),
-        jnp.asarray(leaf_mask), j_pods_cap,
+        j_mask, j_pods_cap,
         j_valid, j_vrank,
         j_parent, np.int64(count),
         np.int64(slice_size), num_levels=struct["nl"], max_domains=mp,
         pods_col=col_of["pods"], req_level=req_idx,
         slice_level=slice_idx, required=required,
         unconstrained=unconstrained, has_leader=has_leader)
+    # One blocking transfer for all outputs, not one sync per field.
+    status, fit_arg, cnt, lead = jax.device_get(
+        (status, fit_arg, cnt, lead))
     status = int(status)
     if status == tops.ERR_NOT_FIT:
         return None, snap._not_fit_message(int(fit_arg),
@@ -409,19 +466,17 @@ def try_find(snap, workers, leader=None, simulate_empty=False,
     if status == tops.ERR_UNDERFLOW:
         return None, "internal: assignment accounting underflow"
 
-    cnt = np.asarray(cnt)
-    lead = np.asarray(lead)
     assignments = {}
     if has_leader:
         leader_domains = sorted(
             (TopologyDomainAssignment(leaves[i].values, int(lead[i]))
-             for i in range(len(leaves)) if lead[i] > 0),
+             for i in np.nonzero(lead > 0)[0]),
             key=lambda a: a.values)
         assignments[leader.pod_set.name] = TopologyAssignment(
             tuple(snap.level_keys), tuple(leader_domains))
     domains = sorted(
         (TopologyDomainAssignment(leaves[i].values, int(cnt[i]))
-         for i in range(len(leaves)) if cnt[i] > 0),
+         for i in np.nonzero(cnt > 0)[0]),
         key=lambda a: a.values)
     assignments[workers.pod_set.name] = TopologyAssignment(
         tuple(snap.level_keys), tuple(domains))

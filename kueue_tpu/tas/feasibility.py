@@ -1,0 +1,266 @@
+"""Batched TAS feasibility pre-pass.
+
+One device launch per flavor forest decides fit/no-fit — with the exact
+notFitMessage argument — for every qualifying pending pod set in the
+cycle, before nomination walks entries one by one. The scheduler's
+oversubscribed steady state re-tries the same unplaceable workloads each
+cycle (scheduler.go:614 nominate -> flavorassigner TAS block); the host
+pays a full phase-1 + descent per entry for each of those failures,
+while the batch pays one sort-free kernel (ops/tas.tas_feasibility) for
+all of them.
+
+Exactness: a qualifying request's selection outcome is fully determined
+by phase-1 counts (findLevelWithFitDomains :1377 — required: top-domain
+slice state at the requested level; preferred: any level's top fit, else
+the level-0 greedy sum; unconstrained: the requested level's sum), and
+the leaderless descent below a successful selection cannot fail (each
+parent's state is the sum of its children's). So the verdict may REJECT
+without running placement; successes still run the real placement for
+the actual assignment. Requests with leaders, pod-set groups, elastic
+previous slices, node-selector leaf filtering, replacement domains, or
+the balanced-placement gate fall back to the sequential path
+unconditionally.
+
+The live-usage verdict additionally requires that no TAS usage was
+removed from the forest since the batch ran (within a cycle usage only
+grows as entries are assumed — except around elastic slice simulation,
+which disqualifies itself); the simulate-empty verdict is valid for the
+whole cycle.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from kueue_tpu.api.types import PodSetTopologyRequest, TopologyMode
+from kueue_tpu.config import features
+
+_MODE_NUM = {TopologyMode.REQUIRED: 0, TopologyMode.PREFERRED: 1,
+             TopologyMode.UNCONSTRAINED: 2}
+
+
+def enabled() -> bool:
+    return os.environ.get("KUEUE_TPU_TAS_FEAS", "1") != "0"
+
+
+@dataclass(frozen=True)
+class Verdict:
+    fit_used: bool
+    arg_used: int
+    fit_empty: bool
+    arg_empty: int
+
+
+def request_signature(pod_set, single_pod_requests, count):
+    tr = pod_set.topology_request or PodSetTopologyRequest()
+    return (tr.mode, tr.level, tr.slice_level, tr.slice_size or 1,
+            int(count), tuple(sorted(single_pod_requests.items())))
+
+
+def _qualify(snap, pod_set, count):
+    """Returns (slice_level_idx, req_level_idx, mode_num, slice_size) or
+    None when the request needs the sequential path. Mirrors the early
+    returns of find_topology_assignments (snapshot.py:543) so a
+    qualifying request reaches phase 2 with the default leaf mask."""
+    if not snap.level_keys:
+        return None
+    tr = pod_set.topology_request or PodSetTopologyRequest()
+    mode = _MODE_NUM.get(tr.mode)
+    if mode is None:
+        return None
+    if (features.enabled("TASBalancedPlacement") and mode == 1):
+        return None
+    if tr.pod_set_group_name:
+        return None
+    slice_size = tr.slice_size or 1
+    if slice_size <= 0 or count % slice_size != 0:
+        return None
+    if tr.level is not None:
+        if tr.level not in snap.level_keys:
+            return None
+        req_idx = snap.level_keys.index(tr.level)
+    else:
+        req_idx = 0
+    slice_level_key = tr.slice_level or snap.level_keys[-1]
+    if (tr.slice_level and tr.slice_level != snap.level_keys[-1]
+            and not features.enabled("TASMultiLayerTopology")):
+        return None
+    if slice_level_key not in snap.level_keys:
+        return None
+    slice_idx = snap.level_keys.index(slice_level_key)
+    if req_idx > slice_idx:
+        return None
+    # Leaf filtering (node selectors at the lowest level) changes the
+    # counts; those requests take the sequential path.
+    if snap.is_lowest_level_node and any(
+            k in snap.level_keys for k in pod_set.node_selector):
+        return None
+    return slice_idx, req_idx, mode, slice_size
+
+
+def collect_requests(wl, cq_snapshot):
+    """(snap, sig, pod_set, single, count, params) tuples for every
+    (TAS flavor x pod set) pair of a pending head that the batch can
+    decide. The assigned flavor isn't known before flavor assignment,
+    so every candidate TAS flavor of the CQ is covered."""
+    if wl.obj.replaced_workload_slice is not None:
+        return []
+    if getattr(wl.obj.status, "unhealthy_nodes", ()):
+        return []
+    out = []
+    for snap in set(cq_snapshot.tas_flavors.values()):
+        for i, ps in enumerate(wl.obj.pod_sets):
+            params = _qualify(snap, ps, ps.count)
+            if params is None:
+                continue
+            single = wl.total_requests[i].single_pod_requests()
+            sig = request_signature(ps, single, ps.count)
+            out.append((snap, sig, ps, single, ps.count, params))
+    return out
+
+
+def precompute(heads, snapshot) -> None:
+    """Run one feasibility launch per flavor forest for the cycle's
+    pending heads and park the verdicts on each snap
+    (``_feas`` / ``_feas_removals``). Small batches aren't worth a
+    dispatch: below ``KUEUE_TPU_TAS_FEAS_MIN`` (default 4) qualifying
+    head requests the snap keeps no verdicts and every entry takes the
+    sequential path. The threshold counts request INSTANCES, not
+    distinct signatures — a churn steady state retries many homogeneous
+    heads, and the savings scale with the retries."""
+    if not enabled():
+        return
+    min_batch = int(os.environ.get("KUEUE_TPU_TAS_FEAS_MIN", "4"))
+    by_snap: dict[int, tuple[object, dict, list[int]]] = {}
+    for w in heads:
+        cqs = snapshot.cluster_queue(w.cluster_queue)
+        if cqs is None or not cqs.tas_flavors:
+            continue
+        for snap, sig, ps, single, count, params in \
+                collect_requests(w, cqs):
+            _, reqs, n = by_snap.setdefault(id(snap), (snap, {}, [0]))
+            reqs.setdefault(sig, (single, count, params))
+            n[0] += 1
+    for snap, reqs, n in by_snap.values():
+        snap._feas = None
+        if n[0] >= min_batch:
+            try:
+                snap._feas = _launch(snap, reqs)
+                snap._feas_removals = getattr(snap, "_usage_removals", 0)
+            except Exception:  # noqa: BLE001 — pre-pass is optional
+                snap._feas = None
+
+
+def _launch(snap, reqs: dict) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from kueue_tpu.ops import tas as tops
+    from kueue_tpu.tas.device import (
+        _cols_for,
+        _free_matrix,
+        _structure,
+        _usage_matrix,
+    )
+
+    if not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
+
+    struct = _structure(snap)
+    sigs = list(reqs)
+    all_per_pod = []
+    for sig in sigs:
+        single, _count, _params = reqs[sig]
+        pp = dict(single)
+        pp["pods"] = pp.get("pods", 0) + 1
+        all_per_pod.append(pp)
+    union: dict[str, int] = {}
+    for pp in all_per_pod:
+        union.update(pp)
+    cols = _cols_for(struct, union, {})
+    col_of = {res: i for i, res in enumerate(cols)}
+
+    free = _free_matrix(struct, cols)
+    usage = _usage_matrix(snap, struct, cols)
+
+    B = len(sigs)
+    Bp = 1 << (B - 1).bit_length()  # pow2 pad bounds recompiles
+    S = len(cols)
+    per_pod = np.zeros((Bp, S), np.int64)
+    count = np.ones(Bp, np.int64)
+    slice_size = np.ones(Bp, np.int64)
+    slice_level = np.zeros(Bp, np.int64)
+    req_level = np.zeros(Bp, np.int64)
+    mode = np.zeros(Bp, np.int64)
+    for b, sig in enumerate(sigs):
+        single, cnt_b, (slice_idx, req_idx, mode_n, ss) = reqs[sig]
+        for res, v in all_per_pod[b].items():
+            if res in col_of:
+                per_pod[b, col_of[res]] = min(v, 1 << 60)
+        count[b] = cnt_b
+        slice_size[b] = ss
+        slice_level[b] = slice_idx
+        req_level[b] = req_idx
+        mode[b] = mode_n
+    # Padding rows: count 1, zero requests -> fit trivially, harmless.
+
+    jnp_cache = struct.setdefault("jnp_cache", {})
+    if "consts" not in jnp_cache:
+        jnp_cache["consts"] = (
+            jnp.asarray(struct["has_pods_cap"]),
+            jnp.asarray(struct["valid"]), jnp.asarray(struct["vrank"]),
+            jnp.asarray(struct["parent"]))
+    j_pods_cap, j_valid, _j_vrank, j_parent = jnp_cache["consts"]
+    cols_key = tuple(cols)
+    j_free = jnp_cache.get(("free", cols_key))
+    if j_free is None:
+        j_free = jnp.asarray(free)
+        jnp_cache[("free", cols_key)] = j_free
+    if not np.any(usage):
+        j_usage = jnp_cache.get(("zeros", usage.shape))
+        if j_usage is None:
+            j_usage = jnp_cache[("zeros", usage.shape)] = jnp.zeros(
+                usage.shape, jnp.int64)
+    else:
+        ukey = (getattr(snap, "_usage_version", 0), cols_key)
+        cached_u = getattr(snap, "_j_usage_cache", None)
+        if cached_u is not None and cached_u[0] == ukey:
+            j_usage = cached_u[1]
+        else:
+            j_usage = jnp.asarray(usage)
+            snap._j_usage_cache = (ukey, j_usage)
+
+    fit, arg = jax.device_get(tops.tas_feasibility(
+        j_free, j_usage, jnp.asarray(per_pod),
+        jnp.asarray(count), jnp.asarray(slice_size),
+        jnp.asarray(slice_level), jnp.asarray(req_level),
+        jnp.asarray(mode), j_valid, j_parent, j_pods_cap,
+        num_levels=struct["nl"], max_domains=struct["m"],
+        pods_col=col_of["pods"]))
+    return {sig: Verdict(bool(fit[0, b]), int(arg[0, b]),
+                         bool(fit[1, b]), int(arg[1, b]))
+            for b, sig in enumerate(sigs)}
+
+
+def lookup(tas_snap, request):
+    """The verdict for a nominate-time request, or None. Callers use
+    ``fit_used`` only when ``used_valid(tas_snap)`` still holds."""
+    verdicts = getattr(tas_snap, "_feas", None)
+    if not verdicts:
+        return None
+    if request.previous_assignment is not None:
+        return None
+    sig = request_signature(request.pod_set,
+                            request.single_pod_requests, request.count)
+    return verdicts.get(sig)
+
+
+def used_valid(tas_snap) -> bool:
+    """Live-usage verdicts assume usage only grew since the batch ran;
+    any removal (elastic slice simulation, second-pass replacement)
+    invalidates them for the rest of the cycle."""
+    return getattr(tas_snap, "_usage_removals", 0) == \
+        getattr(tas_snap, "_feas_removals", 0)

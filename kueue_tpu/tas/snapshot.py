@@ -308,12 +308,22 @@ class TASFlavorSnapshot:
 
     # -- usage accounting (updateTASUsage) --
 
+    def _touch_used(self, leaf) -> None:
+        """Track leaves carrying TAS usage so dense encoders iterate
+        the used subset, not the whole (possibly pod-slice-scale)
+        forest."""
+        used = getattr(self, "_used_leaves", None)
+        if used is None:
+            used = self._used_leaves = set()
+        used.add(leaf.values)
+
     def add_usage(self, values: tuple, requests: dict[str, int],
                   count: int) -> None:
         leaf = self.leaves.get(tuple(values))
         if leaf is None:
             return
         self._usage_version = getattr(self, "_usage_version", 0) + 1
+        self._touch_used(leaf)
         for res, per_pod in requests.items():
             leaf.tas_usage[res] = leaf.tas_usage.get(res, 0) + per_pod * count
         # Each placed pod occupies a pod slot regardless of its resource
@@ -327,6 +337,10 @@ class TASFlavorSnapshot:
         if leaf is None:
             return
         self._usage_version = getattr(self, "_usage_version", 0) + 1
+        # Removals can make stale "doesn't fit" conclusions wrong; the
+        # feasibility pre-pass keys its live-usage verdicts on this.
+        self._usage_removals = getattr(self, "_usage_removals", 0) + 1
+        self._touch_used(leaf)
         for res, per_pod in requests.items():
             leaf.tas_usage[res] = leaf.tas_usage.get(res, 0) - per_pod * count
         leaf.tas_usage["pods"] = leaf.tas_usage.get("pods", 0) - count
@@ -339,6 +353,7 @@ class TASFlavorSnapshot:
         if leaf is None:
             return
         self._usage_version = getattr(self, "_usage_version", 0) + 1
+        self._touch_used(leaf)
         for res, v in usage.items():
             leaf.tas_usage[res] = leaf.tas_usage.get(res, 0) + v
         leaf.tas_usage.setdefault("pods", 0)

@@ -156,6 +156,79 @@ class JobSetWebhook(JobWebhook):
         return []
 
 
+def _elastic_job_allowed(job) -> bool:
+    """The shared elastic gate: a kueue-managed job may use an external
+    autoscaling mechanism only when ElasticJobsViaWorkloadSlices is on
+    AND the job is elastic (raycluster_webhook.go:148,
+    sparkapplication_webhook.go:129)."""
+    from kueue_tpu.config import features
+    return (features.enabled("ElasticJobsViaWorkloadSlices")
+            and getattr(job, "elastic", False))
+
+
+@dataclass
+class RayClusterWebhook(JobWebhook):
+    """jobs/raycluster/raycluster_webhook.go."""
+
+    kind: str = "ray.io/raycluster"
+
+    def extra_create_rules(self, job) -> list[str]:
+        errs = []
+        if getattr(job, "enable_in_tree_autoscaling", False) \
+                and not _elastic_job_allowed(job):
+            errs.append(
+                "a kueue managed job can use autoscaling only when the "
+                "ElasticJobsViaWorkloadSlices feature gate is on and "
+                "the job is an elastic job")
+        names = [g[0] for g in getattr(job, "worker_groups", ())]
+        if len(set(names)) != len(names):
+            errs.append("worker group names must be unique")
+        return errs
+
+
+@dataclass
+class SparkApplicationWebhook(JobWebhook):
+    """jobs/sparkapplication/sparkapplication_webhook.go."""
+
+    kind: str = "sparkoperator.k8s.io/sparkapplication"
+
+    def extra_create_rules(self, job) -> list[str]:
+        errs = []
+        if getattr(job, "dynamic_allocation", False) \
+                and not _elastic_job_allowed(job):
+            errs.append(
+                "a kueue managed job can use dynamicAllocation only "
+                "when the ElasticJobsViaWorkloadSlices feature gate is "
+                "on and the job is an elastic job")
+        if getattr(job, "executor_instances", 1) < 0:
+            errs.append("executor instances must be non-negative")
+        return errs
+
+
+@dataclass
+class StatefulSetWebhook(JobWebhook):
+    """jobs/statefulset/statefulset_webhook.go."""
+
+    kind: str = "apps/statefulset"
+
+    def extra_create_rules(self, job) -> list[str]:
+        if getattr(job, "replicas", 1) < 0:
+            return ["replicas must be non-negative"]
+        return []
+
+    def validate_update(self, old, new) -> list[str]:
+        errs = super().validate_update(old, new)
+        # Scale is the ONLY mutable shape field while running; request
+        # shape changes need a fresh object (the sts webhook rejects
+        # pod-template mutation of a managed set).
+        if (getattr(old, "requests", None) != getattr(new, "requests",
+                                                      None)
+                and not old.is_suspended()):
+            errs.append("pod template resources are immutable while the "
+                        "StatefulSet is managed and running")
+        return errs
+
+
 class JobWebhookRegistry:
     """Dispatches per-kind webhooks, the admission-webhook layer in front
     of JobReconciler.create_job."""
@@ -172,6 +245,10 @@ class JobWebhookRegistry:
         self.webhooks: dict[str, JobWebhook] = {
             "batch/job": BatchJobWebhook(),
             "jobset.x-k8s.io/jobset": JobSetWebhook(),
+            "ray.io/raycluster": RayClusterWebhook(),
+            "sparkoperator.k8s.io/sparkapplication":
+                SparkApplicationWebhook(),
+            "apps/statefulset": StatefulSetWebhook(),
         }
         self._generic = JobWebhook()
 

@@ -1,0 +1,272 @@
+"""Integration depth for StatefulSet, SparkApplication and RayCluster:
+scale-up/down, replacement via elastic workload slices, and
+validation-webhook parity (statefulset_reconciler.go,
+sparkapplication_webhook.go, raycluster_webhook.go)."""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.api.types import (  # noqa: E402
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+)
+from kueue_tpu.config import features  # noqa: E402
+from kueue_tpu.controllers.engine import Engine  # noqa: E402
+from kueue_tpu.controllers.integrations import (  # noqa: E402
+    RayClusterJob,
+    SparkApplicationJob,
+    StatefulSetJob,
+)
+from kueue_tpu.controllers.jobframework import JobReconciler  # noqa: E402
+from kueue_tpu.webhooks.jobwebhooks import (  # noqa: E402
+    JobWebhookRegistry,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_features():
+    yield
+    features.reset()
+
+
+def make_engine(nominal=16000):
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            ("cpu",),
+            (FlavorQuotas("default", {"cpu": ResourceQuota(nominal)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng
+
+
+def pump(eng, rec, n=3):
+    for _ in range(n):
+        rec.reconcile_all()
+        eng.schedule_once()
+        rec.reconcile_all()
+
+
+class TestStatefulSetDepth:
+    def test_scale_to_zero_holds_and_scale_up_resumes(self):
+        eng = make_engine()
+        rec = JobReconciler(eng)
+        sts = StatefulSetJob(name="web", queue_name="lq", replicas=3,
+                             requests={"cpu": 1000})
+        rec.create_job(sts)
+        pump(eng, rec)
+        wl_key = rec.job_to_workload[sts.key]
+        wl = eng.workloads[wl_key]
+        assert wl.is_admitted
+        assert not sts.is_suspended()
+
+        # Scale to ZERO: reservation released with reason OnHold, the
+        # Workload is kept but queued nowhere, quota is freed.
+        sts.scale(0)
+        rec.reconcile_all()
+        assert eng.is_on_hold(wl)
+        assert not wl.is_admitted
+        assert wl_key not in eng.cache.workloads
+        assert eng.cache.usage_for_cq("cq") in ({}, None) or not any(
+            eng.cache.usage_for_cq("cq").values())
+        # Not requeued: no scheduling cycle brings it back.
+        assert eng.schedule_once() is None
+        assert eng.is_on_hold(wl)
+
+        # Scale back UP: hold cleared, requeued, admitted at the new
+        # shape.
+        sts.scale(5)
+        pump(eng, rec)
+        new_key = rec.job_to_workload[sts.key]
+        new_wl = eng.workloads[new_key]
+        assert new_wl.is_admitted
+        assert new_wl.pod_sets[0].count == 5
+
+    def test_elastic_scale_up_uses_workload_slice(self):
+        features.set_feature("ElasticJobsViaWorkloadSlices", True)
+        eng = make_engine()
+        rec = JobReconciler(eng)
+        sts = StatefulSetJob(name="web", queue_name="lq", replicas=2,
+                             requests={"cpu": 1000}, elastic=True)
+        rec.create_job(sts)
+        pump(eng, rec)
+        old_key = rec.job_to_workload[sts.key]
+        assert eng.workloads[old_key].is_admitted
+
+        # Elastic scale-up: a replacement SLICE preempt-replaces the old
+        # workload; the old slice finishes only when the new one admits
+        # (the pods never stop).
+        sts.scale(4)
+        rec.reconcile_all()
+        new_key = rec.job_to_workload[sts.key]
+        assert new_key != old_key
+        new_wl = eng.workloads[new_key]
+        assert new_wl.replaced_workload_slice == old_key
+        assert not sts.is_suspended()  # pods kept running throughout
+        pump(eng, rec)
+        assert new_wl.is_admitted
+        assert eng.workloads[old_key].is_finished
+        assert new_wl.pod_sets[0].count == 4
+
+    def test_rescale_before_slice_admits_keeps_chain(self):
+        """Scale 2->4->3 with the 4-slice never admitted: the 3-replica
+        replacement must still chain to the ORIGINAL admitted workload
+        (not drop it), so its quota is released on admission and the
+        pods never stop."""
+        features.set_feature("ElasticJobsViaWorkloadSlices", True)
+        eng = make_engine()
+        rec = JobReconciler(eng)
+        sts = StatefulSetJob(name="web", queue_name="lq", replicas=2,
+                             requests={"cpu": 1000}, elastic=True)
+        rec.create_job(sts)
+        pump(eng, rec)
+        orig_key = rec.job_to_workload[sts.key]
+        assert eng.workloads[orig_key].is_admitted
+
+        sts.scale(4)
+        rec.reconcile_all()  # slice B created, NOT yet admitted
+        b_key = rec.job_to_workload[sts.key]
+        assert not eng.workloads[b_key].is_admitted
+        sts.scale(3)
+        rec.reconcile_all()  # B replaced by C before ever admitting
+        c_key = rec.job_to_workload[sts.key]
+        assert c_key not in (orig_key, b_key)
+        assert eng.workloads[c_key].replaced_workload_slice == orig_key
+        assert not sts.is_suspended()  # original pods keep running
+        pump(eng, rec)
+        assert eng.workloads[c_key].is_admitted
+        assert eng.workloads[orig_key].is_finished
+        assert eng.workloads[b_key].is_finished
+        # No quota leak: only the 3-replica slice holds usage.
+        usage = eng.cache.usage_for_cq("cq") or {}
+        assert sum(usage.values()) == 3000
+
+    def test_non_elastic_scale_recreates_and_requeues(self):
+        eng = make_engine()
+        rec = JobReconciler(eng)
+        sts = StatefulSetJob(name="web", queue_name="lq", replicas=2,
+                             requests={"cpu": 1000})
+        rec.create_job(sts)
+        pump(eng, rec)
+        old_key = rec.job_to_workload[sts.key]
+        sts.scale(4)
+        pump(eng, rec)
+        new_key = rec.job_to_workload[sts.key]
+        assert new_key != old_key
+        assert eng.workloads[old_key].is_finished
+        assert eng.workloads[new_key].is_admitted
+        assert eng.workloads[new_key].replaced_workload_slice is None
+
+    def test_webhook_validation(self):
+        reg = JobWebhookRegistry(make_engine())
+        bad = StatefulSetJob(name="s", queue_name="lq", replicas=-1)
+        assert any("replicas" in e for e in reg.admit_create(bad))
+        old = StatefulSetJob(name="s", queue_name="lq", replicas=2,
+                             requests={"cpu": 100})
+        old.suspended = False
+        new = StatefulSetJob(name="s", queue_name="lq", replicas=2,
+                             requests={"cpu": 900})
+        new.suspended = False
+        assert any("immutable" in e for e in reg.admit_update(old, new))
+        # Scale alone is fine.
+        new2 = StatefulSetJob(name="s", queue_name="lq", replicas=7,
+                              requests={"cpu": 100})
+        new2.suspended = False
+        assert reg.admit_update(old, new2) == []
+
+
+class TestRayClusterDepth:
+    def test_autoscaling_requires_elastic_gate(self):
+        reg = JobWebhookRegistry(make_engine())
+        rc = RayClusterJob(name="rc", queue_name="lq",
+                           head_requests={"cpu": 1000},
+                           worker_groups=[("small", 2, {"cpu": 1000})],
+                           enable_in_tree_autoscaling=True)
+        errs = reg.admit_create(rc)
+        assert any("autoscaling" in e for e in errs)
+        # Gate on + elastic: allowed.
+        features.set_feature("ElasticJobsViaWorkloadSlices", True)
+        rc.elastic = True
+        assert reg.admit_create(rc) == []
+        # Duplicate worker group names rejected.
+        dup = RayClusterJob(name="rc2", queue_name="lq",
+                            worker_groups=[("g", 1, {"cpu": 1}),
+                                           ("g", 2, {"cpu": 1})])
+        assert any("unique" in e for e in reg.admit_create(dup))
+
+    def test_autoscaler_worker_scale_flows_through_slice(self):
+        features.set_feature("ElasticJobsViaWorkloadSlices", True)
+        eng = make_engine()
+        rec = JobReconciler(eng)
+        rc = RayClusterJob(name="rc", queue_name="lq",
+                           head_requests={"cpu": 1000},
+                           worker_groups=[("small", 2, {"cpu": 1000})],
+                           enable_in_tree_autoscaling=True, elastic=True)
+        rec.create_job(rc)
+        pump(eng, rec)
+        old_key = rec.job_to_workload[rc.key]
+        assert eng.workloads[old_key].is_admitted
+
+        rc.scale_group("small", 5)  # the autoscaler added workers
+        rec.reconcile_all()
+        new_key = rec.job_to_workload[rc.key]
+        assert eng.workloads[new_key].replaced_workload_slice == old_key
+        pump(eng, rec)
+        new_wl = eng.workloads[new_key]
+        assert new_wl.is_admitted
+        assert eng.workloads[old_key].is_finished
+        by_name = {ps.name: ps.count for ps in new_wl.pod_sets}
+        assert by_name == {"head": 1, "small": 5}
+
+
+class TestSparkApplicationDepth:
+    def test_dynamic_allocation_requires_elastic_gate(self):
+        reg = JobWebhookRegistry(make_engine())
+        spark = SparkApplicationJob(
+            name="sp", queue_name="lq",
+            driver_requests={"cpu": 1000},
+            executor_instances=3, executor_requests={"cpu": 2000},
+            dynamic_allocation=True)
+        errs = reg.admit_create(spark)
+        assert any("dynamicAllocation" in e for e in errs)
+        features.set_feature("ElasticJobsViaWorkloadSlices", True)
+        spark.elastic = True
+        assert reg.admit_create(spark) == []
+        bad = SparkApplicationJob(name="sp2", queue_name="lq",
+                                  executor_instances=-1)
+        assert any("non-negative" in e for e in reg.admit_create(bad))
+
+    def test_driver_executor_roles_admit_and_scale(self):
+        features.set_feature("ElasticJobsViaWorkloadSlices", True)
+        eng = make_engine()
+        rec = JobReconciler(eng)
+        spark = SparkApplicationJob(
+            name="sp", queue_name="lq",
+            driver_requests={"cpu": 1000},
+            executor_instances=3, executor_requests={"cpu": 2000},
+            dynamic_allocation=True, elastic=True)
+        rec.create_job(spark)
+        pump(eng, rec)
+        old_key = rec.job_to_workload[spark.key]
+        wl = eng.workloads[old_key]
+        assert wl.is_admitted
+        by_name = {psa.name: psa.count
+                   for psa in wl.status.admission.pod_set_assignments}
+        assert by_name == {"driver": 1, "executor": 3}
+
+        # dynamicAllocation shrinks the executor fleet: slice replace.
+        spark.scale_executors(1)
+        pump(eng, rec)
+        new_key = rec.job_to_workload[spark.key]
+        assert new_key != old_key
+        new_wl = eng.workloads[new_key]
+        assert new_wl.is_admitted
+        assert eng.workloads[old_key].is_finished
+        by_name = {psa.name: psa.count
+                   for psa in new_wl.status.admission.pod_set_assignments}
+        assert by_name == {"driver": 1, "executor": 1}

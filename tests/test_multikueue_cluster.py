@@ -174,6 +174,34 @@ def test_kubeconfig_hot_reload_swaps_credentials_without_restart(
     assert wl.is_admitted
 
 
+def test_kubeconfig_rotation_while_disconnected_cancels_backoff(
+        tmp_path):
+    """fswatch.go: credential rotation must not wait out a backoff —
+    also when the rotation happens while the cluster is DOWN and the
+    backoff has grown long."""
+    fabric = Fabric()
+    manager, mk = make_stack(tmp_path, fabric)
+    path = tmp_path / "worker1.kubeconfig"
+
+    fabric.down.add("worker1")
+    mk.cluster_connection_lost("worker1", "watch closed")
+    rc = mk.remote_clients["worker1"]
+    for _ in range(8):
+        manager.clock += 2.0
+        mk.reconcile()
+    assert rc.failed_attempts >= 4
+    assert rc.next_attempt_at > manager.clock + 4.0  # deep backoff
+
+    # The operator fixes the endpoint AND rotates the kubeconfig: the
+    # very next tick must reconnect, not wait out next_attempt_at.
+    fabric.down.discard("worker1")
+    manager.clock += 0.5
+    write_kubeconfig(path, "worker1", credential="good")
+    mk.reconcile()
+    assert mk.cluster_active("worker1").status
+    assert rc.failed_attempts == 0
+
+
 def test_orphan_gc_collects_remote_objects(tmp_path):
     fabric = Fabric()
     manager, mk = make_stack(tmp_path, fabric)

@@ -1,0 +1,292 @@
+"""Batched TAS feasibility pre-pass (tas/feasibility.py +
+ops/tas.tas_feasibility): the verdicts must agree EXACTLY with the
+sequential placement's success/failure and notFitMessage, and wiring the
+pre-pass into the cycle must not change any scheduling observable."""
+
+import random
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.api.types import (  # noqa: E402
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PodSetTopologyRequest,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Topology,
+    TopologyLevel,
+    TopologyMode,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine  # noqa: E402
+from kueue_tpu.tas import feasibility  # noqa: E402
+from kueue_tpu.tas.snapshot import (  # noqa: E402
+    HOSTNAME_LABEL,
+    Node,
+    TASFlavorSnapshot,
+    TASPodSetRequest,
+)
+
+
+def make_snapshot(blocks=2, racks=3, hosts=4, cpu=4000, pods=8,
+                  ragged=False):
+    snap = TASFlavorSnapshot(Topology("dc", (
+        TopologyLevel("block"), TopologyLevel("rack"),
+        TopologyLevel(HOSTNAME_LABEL))))
+    for b in range(blocks):
+        for r in range(racks):
+            if ragged and (b + r) % 3 == 0:
+                continue
+            for h in range(hosts):
+                name = f"b{b}-r{r}-h{h}"
+                snap.add_node(Node(
+                    name=name,
+                    labels={"block": f"b{b}", "rack": f"b{b}-r{r}",
+                            HOSTNAME_LABEL: name},
+                    capacity={"cpu": cpu, "pods": pods}))
+    return snap
+
+
+def request_of(count, mode, level, cpu=1000, slice_size=None):
+    ps = PodSet("main", count, {"cpu": cpu},
+                topology_request=PodSetTopologyRequest(
+                    mode=mode, level=level, slice_size=slice_size))
+    return TASPodSetRequest(pod_set=ps,
+                            single_pod_requests={"cpu": cpu}, count=count)
+
+
+def batch_verdicts(snap, requests):
+    reqs = {}
+    for tr in requests:
+        params = feasibility._qualify(snap, tr.pod_set, tr.count)
+        assert params is not None
+        sig = feasibility.request_signature(
+            tr.pod_set, tr.single_pod_requests, tr.count)
+        reqs[sig] = (tr.single_pod_requests, tr.count, params)
+    return feasibility._launch(snap, reqs)
+
+
+class TestKernelExactness:
+    """Verdict == sequential outcome, message argument included, across
+    randomized worlds, modes and usage states."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_randomized_parity(self, seed):
+        rng = random.Random(seed)
+        snap = make_snapshot(blocks=2, racks=3, hosts=4,
+                             ragged=bool(seed % 2))
+        # Pre-existing usage on a few leaves.
+        for leaf in list(snap.leaves.values())[::3]:
+            snap.add_usage(leaf.values, {"cpu": 1000},
+                           rng.randrange(0, 5))
+        modes = [(TopologyMode.REQUIRED, "rack"),
+                 (TopologyMode.REQUIRED, "block"),
+                 (TopologyMode.PREFERRED, "rack"),
+                 (TopologyMode.PREFERRED, "block"),
+                 (TopologyMode.UNCONSTRAINED, None)]
+        requests = []
+        for _ in range(24):
+            mode, level = rng.choice(modes)
+            count = rng.choice([1, 2, 3, 8, 16, 17, 32, 64, 97, 200])
+            cpu = rng.choice([500, 1000, 4000])
+            requests.append(request_of(count, mode, level, cpu=cpu))
+        verdicts = batch_verdicts(snap, requests)
+        assert len(verdicts) == len({
+            feasibility.request_signature(t.pod_set,
+                                          t.single_pod_requests, t.count)
+            for t in requests})
+        for tr in requests:
+            sig = feasibility.request_signature(
+                tr.pod_set, tr.single_pod_requests, tr.count)
+            vd = verdicts[sig]
+            for empty, fit, arg in ((False, vd.fit_used, vd.arg_used),
+                                    (True, vd.fit_empty, vd.arg_empty)):
+                # On the prototype, not a fork: fork() starts usage-empty
+                # by design (the cache reinstalls usage per cycle).
+                got, reason = snap.find_topology_assignments(
+                    tr, None, simulate_empty=empty)
+                assert (got is not None) == fit, (sig, empty, reason)
+                if not fit:
+                    assert reason == snap._not_fit_message(
+                        arg, tr.count), (sig, empty)
+
+    def test_slices_and_messages(self):
+        snap = make_snapshot(blocks=1, racks=2, hosts=3, pods=4)
+        # slice_size 2 at the default (hostname) slice level.
+        tr = request_of(24, TopologyMode.REQUIRED, "rack", slice_size=2)
+        vd = batch_verdicts(snap, [tr])[feasibility.request_signature(
+            tr.pod_set, tr.single_pod_requests, tr.count)]
+        got, reason = snap.find_topology_assignments(tr, None)
+        assert got is None and not vd.fit_used
+        # fit_arg counts SLICES, same as the sequential message.
+        assert reason == snap._not_fit_message(vd.arg_used, 12)
+
+    def test_usage_variant_diverges_from_empty(self):
+        snap = make_snapshot(blocks=1, racks=1, hosts=4, pods=8)
+        for leaf in snap.leaves.values():
+            snap.add_usage(leaf.values, {}, 6)  # 2 pod slots left each
+        tr = request_of(16, TopologyMode.REQUIRED, "rack")
+        vd = batch_verdicts(snap, [tr])[feasibility.request_signature(
+            tr.pod_set, tr.single_pod_requests, tr.count)]
+        assert not vd.fit_used      # 8 slots free in the rack
+        assert vd.fit_empty         # 32 slots empty
+
+
+class TestQualification:
+    def test_disqualifiers(self):
+        snap = make_snapshot()
+        ok = PodSet("m", 4, {"cpu": 100},
+                    topology_request=PodSetTopologyRequest(
+                        mode=TopologyMode.REQUIRED, level="rack"))
+        assert feasibility._qualify(snap, ok, 4) is not None
+        grouped = PodSet("m", 4, {"cpu": 100},
+                         topology_request=PodSetTopologyRequest(
+                             mode=TopologyMode.REQUIRED, level="rack",
+                             pod_set_group_name="g"))
+        assert feasibility._qualify(snap, grouped, 4) is None
+        bad_level = PodSet("m", 4, {"cpu": 100},
+                           topology_request=PodSetTopologyRequest(
+                               mode=TopologyMode.REQUIRED, level="zone"))
+        assert feasibility._qualify(snap, bad_level, 4) is None
+        indivisible = PodSet("m", 5, {"cpu": 100},
+                             topology_request=PodSetTopologyRequest(
+                                 mode=TopologyMode.REQUIRED, level="rack",
+                                 slice_size=2))
+        assert feasibility._qualify(snap, indivisible, 5) is None
+
+    def test_node_selector_disqualifies_on_node_level(self):
+        snap = make_snapshot()
+        assert snap.is_lowest_level_node
+        ps = PodSet("m", 4, {"cpu": 100},
+                    node_selector={HOSTNAME_LABEL: "b0-r0-h0"},
+                    topology_request=PodSetTopologyRequest(
+                        mode=TopologyMode.REQUIRED, level="rack"))
+        assert feasibility._qualify(snap, ps, 4) is None
+
+    def test_removals_invalidate_live_verdicts(self):
+        snap = make_snapshot()
+        snap._feas_removals = getattr(snap, "_usage_removals", 0)
+        assert feasibility.used_valid(snap)
+        leaf = next(iter(snap.leaves.values()))
+        snap.add_usage(leaf.values, {"cpu": 100}, 1)
+        assert feasibility.used_valid(snap)   # additions are fine
+        snap.remove_usage(leaf.values, {"cpu": 100}, 1)
+        assert not feasibility.used_valid(snap)
+
+
+def build_engine(n_cqs=4, blocks=2, racks=4, hosts=5, n_wl=60, seed=3,
+                 cohort="shared"):
+    rng = random.Random(seed)
+    eng = Engine()
+    eng.create_topology(Topology("dc", (
+        TopologyLevel("block"), TopologyLevel("rack"),
+        TopologyLevel(HOSTNAME_LABEL))))
+    eng.create_resource_flavor(ResourceFlavor(name="tas",
+                                              topology_name="dc"))
+    for b in range(blocks):
+        for r in range(racks):
+            for h in range(hosts):
+                name = f"b{b}-r{r}-h{h}"
+                eng.create_node(Node(
+                    name=name,
+                    labels={"block": f"b{b}", "rack": f"b{b}-r{r}",
+                            HOSTNAME_LABEL: name},
+                    capacity={"cpu": 8000, "pods": 8}))
+    total = blocks * racks * hosts * 8000
+    for i in range(n_cqs):
+        eng.create_cluster_queue(ClusterQueue(
+            name=f"cq-{i}", cohort=cohort,
+            resource_groups=(ResourceGroup(
+                ("cpu",), (FlavorQuotas("tas", {"cpu": ResourceQuota(
+                    total // n_cqs)}),)),)))
+        eng.create_local_queue(LocalQueue(f"lq-{i}", "default", f"cq-{i}"))
+    eng.attach_oracle()
+    hostpods = hosts * 8
+    for i in range(n_wl):
+        eng.clock += 0.001
+        mode = rng.choice([TopologyMode.REQUIRED, TopologyMode.PREFERRED,
+                           TopologyMode.UNCONSTRAINED])
+        level = None if mode == TopologyMode.UNCONSTRAINED else \
+            rng.choice(["rack", "block"])
+        cnt = rng.choice([hostpods // 2, hostpods, 2 * hostpods,
+                          3 * hostpods])
+        eng.submit(Workload(
+            name=f"t-{i}", queue_name=f"lq-{rng.randrange(n_cqs)}",
+            pod_sets=(PodSet(
+                "main", cnt, {"cpu": 100},
+                topology_request=PodSetTopologyRequest(
+                    mode=mode, level=level)),)))
+    return eng
+
+
+def run_world(monkeypatch, feas_on, cycles=40, churn=10):
+    monkeypatch.setenv("KUEUE_TPU_TAS_FEAS", "1" if feas_on else "0")
+    eng = build_engine()
+    for _ in range(cycles):
+        if eng.schedule_once() is None:
+            break
+    for _ in range(churn):
+        adm = sorted(k for k, w in eng.workloads.items()
+                     if w.is_admitted and not w.is_finished)
+        for k in adm[:2]:
+            eng.finish(k)
+        eng.schedule_once()
+    state = {}
+    for k, w in eng.workloads.items():
+        conds = {str(t): (c.status, c.reason, c.message)
+                 for t, c in (getattr(w.status, "conditions", {}) or
+                              {}).items()}
+        psa = None
+        if w.status.admission is not None:
+            psa = tuple(
+                (p.name, p.count,
+                 tuple(sorted((d.values, d.count) for d in
+                              p.topology_assignment.domains))
+                 if p.topology_assignment else None)
+                for p in w.status.admission.pod_set_assignments)
+        state[k] = (w.is_admitted, w.is_finished, psa, conds)
+    return state
+
+
+class TestCycleParity:
+    def test_feasibility_changes_no_observable(self, monkeypatch):
+        off = run_world(monkeypatch, feas_on=False)
+        on = run_world(monkeypatch, feas_on=True)
+        assert off.keys() == on.keys()
+        for k in off:
+            assert off[k] == on[k], k
+
+    def test_verdicts_actually_reject(self, monkeypatch):
+        """The pre-pass must short-circuit at least one placement in the
+        churn regime — guards against the wiring silently dying."""
+        monkeypatch.setenv("KUEUE_TPU_TAS_FEAS", "1")
+        monkeypatch.setenv("KUEUE_TPU_TAS_FEAS_MIN", "2")
+        import kueue_tpu.tas.assigner as asg
+        rejected = []
+        orig = asg._precomputed_failure
+
+        def spy(*a, **k):
+            r = orig(*a, **k)
+            if r is not None:
+                rejected.append(r)
+            return r
+
+        monkeypatch.setattr(asg, "_precomputed_failure", spy)
+        eng = build_engine()
+        for _ in range(40):
+            if eng.schedule_once() is None:
+                break
+        for _ in range(6):
+            adm = sorted(k for k, w in eng.workloads.items()
+                         if w.is_admitted and not w.is_finished)
+            for k in adm[:2]:
+                eng.finish(k)
+            eng.schedule_once()
+        assert rejected
+        name, reason = rejected[0]
+        assert "topology" in reason
