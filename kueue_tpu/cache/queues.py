@@ -224,10 +224,28 @@ class PendingClusterQueue:
 
     def queue_inadmissible(self) -> bool:
         """manager.go QueueInadmissibleWorkloads — move all inadmissible
-        workloads back into the heap (on relevant cluster events)."""
+        workloads back into the heap (on relevant cluster events).
+
+        Fast path: park() leaves the heap node to lazy deletion, so an
+        unchanged workload un-parks as a pure map move plus a row-cache
+        re-activation (dirty-skipped when the shape is unchanged) — no
+        key recompute, no native push. Requires the
+        SAME info object still backing the live node (a re-submission
+        would strand the new object) and a non-AFS queue (AFS keys
+        freeze LocalQueue usage at push time, so a re-push must
+        re-read it)."""
         moved = bool(self.inadmissible)
+        afs = self.spec.admission_scope == "UsageBasedAdmissionFairSharing"
         for info in self.inadmissible.values():
-            self.items[info.key] = info
+            key = info.key
+            self.items[key] = info
+            id_ = self._id_of.get(key)
+            if not afs and id_ is not None:
+                entry = self._entry_of.get(id_)
+                if entry is not None and entry[0] is info:
+                    if self.manager is not None:
+                        self.manager.rows.on_push(info, entry[1])
+                    continue
             self._heap_push(info)
         self.inadmissible.clear()
         return moved

@@ -18,9 +18,14 @@ Reference: pkg/dra —
     replace the raw extended resources in each PodSet's effective
     requests.
 
-The reference matches devices with CEL expressions; the rebuild uses
-plain attribute-equality selectors (CEL is a host-language detail, not
-framework behavior).
+Device selectors come in two forms, as in the reference: plain
+attribute-equality maps, and CEL expressions (claims.go:235
+validateCELSelectors / :411 validateCELSelectorsAgainstDevices)
+evaluated per device with ``device.driver`` / ``device.attributes`` /
+``device.capacity`` in scope (utils/cel.py implements the expression
+subset; compile errors reject the claim before quota admission, and an
+insufficient match count is surfaced exactly like the reference's
+"insufficient matching devices" error).
 """
 
 from __future__ import annotations
@@ -48,6 +53,50 @@ class Device:
     attributes: dict[str, str] = field(default_factory=dict)
     # counter set: counter name -> capacity this device consumes.
     counters: dict[str, int] = field(default_factory=dict)
+    driver: str = ""  # stamped from the slice for the CEL env
+
+    def cel_env(self) -> dict:
+        return {"device": {"driver": self.driver,
+                           "attributes": dict(self.attributes),
+                           "capacity": dict(self.counters)}}
+
+
+def validate_cel_selectors(requests) -> list[str]:
+    """claims.go:235 validateCELSelectors: compile every expression up
+    front; syntax errors reject the claim before quota admission."""
+    from kueue_tpu.utils import cel
+
+    errs = []
+    for i, req in enumerate(requests):
+        for j, expr in enumerate(getattr(req, "cel_selectors", ()) or ()):
+            try:
+                cel.compile_cel(expr)
+            except cel.CelCompileError as e:
+                errs.append(f"devices.requests[{i}].selectors[{j}]: "
+                            f"CEL compilation failed: {e}")
+    return errs
+
+
+def _device_matches(dev: Device, req: DeviceRequest) -> bool:
+    """All attribute-equality AND all CEL selectors must hold; a CEL
+    runtime error (missing key, type mismatch) means no-match for that
+    device, the upstream evaluator's per-device error behavior."""
+    if any(dev.attributes.get(k) != v for k, v in req.selectors.items()):
+        return False
+    if req.cel_selectors:
+        from kueue_tpu.utils import cel
+
+        env = dev.cel_env()
+        for expr in req.cel_selectors:
+            try:
+                if not cel.evaluate_predicate(expr, env):
+                    return False
+            except cel.CelEvalError:
+                # Upstream evaluates per device and an evaluation error
+                # (missing attribute, bad regex, non-bool result) means
+                # this device doesn't match.
+                return False
+    return True
 
 
 @dataclass
@@ -69,8 +118,11 @@ class DeviceRequest:
 
     device_class: str
     count: int = 1
-    # Attribute-equality selectors (the CEL analog).
+    # Attribute-equality selectors (the fast path).
     selectors: dict[str, str] = field(default_factory=dict)
+    # CEL selector expressions, ALL of which must match a device
+    # (resourcev1.DeviceSelector.CEL; claims.go:45 celDeviceRequest).
+    cel_selectors: tuple[str, ...] = ()
 
 
 @dataclass
@@ -124,6 +176,9 @@ class DeviceClassMapper:
     # -- inventory (groupSlicesByPool / poolInfo) --
 
     def add_resource_slice(self, s: ResourceSlice) -> None:
+        for d in s.devices:
+            if not d.driver:
+                d.driver = s.driver
         if not s.name:
             # Anonymous slices get a collision-free generated identity
             # (a monotonic counter — dict length would reuse names
@@ -201,8 +256,7 @@ class DeviceClassMapper:
                             break
                         if (pool, dev.name) in matched:
                             continue
-                        if any(dev.attributes.get(k) != v
-                               for k, v in req.selectors.items()):
+                        if not _device_matches(dev, req):
                             continue
                         matched.add((pool, dev.name))
                         needed -= 1
@@ -217,6 +271,51 @@ class DeviceClassMapper:
                         f"not enough devices for class "
                         f"{req.device_class}: {needed} short")
         return charges
+
+    def validate_against_devices(self, claims: list[ResourceClaim]
+                                 ) -> list[str]:
+        """claims.go:411 validateCELSelectorsAgainstDevices: compile the
+        selectors, count matching devices across complete pools, and
+        report shortages so quota is never held by workloads whose pods
+        can never be scheduled."""
+        errs = []
+        for claim in claims:
+            errs.extend(validate_cel_selectors(claim.device_requests()))
+        if errs:
+            return errs
+        pools = self.complete_pools()
+        matched: set[tuple[str, str]] = set()
+        for claim in claims:
+            for i, req in enumerate(claim.device_requests()):
+                # Selector-less requests still CONSUME devices from the
+                # pools (counter_resources allocates greedily in claim
+                # order), so they participate in the matched-set
+                # accounting — skipping them would validate claims that
+                # allocation must reject.
+                dc = self.classes.get(req.device_class)
+                if dc is None:
+                    errs.append(f"unknown device class "
+                                f"{req.device_class}")
+                    continue
+                count = 0
+                for pool, devices in pools.items():
+                    for dev in devices:
+                        if (pool, dev.name) in matched:
+                            continue
+                        if _device_matches(dev, req):
+                            matched.add((pool, dev.name))
+                            count += 1
+                            if count >= req.count:
+                                break
+                    if count >= req.count:
+                        break
+                if count < req.count:
+                    errs.append(
+                        f"insufficient matching devices for selector in "
+                        f"DeviceClass {req.device_class}: {count} "
+                        f"device(s) match in the cluster but "
+                        f"{req.count} requested")
+        return errs
 
     def apply_claims(self, pod_set, claims: list[ResourceClaim],
                      with_counters: bool = False):

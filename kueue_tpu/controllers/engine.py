@@ -650,8 +650,13 @@ class Engine:
         execution time enforcement (workload_controller.go:838
         reconcileMaxExecutionTime)."""
         self.clock += dt
-        for wl in list(self.workloads.values()):
-            if not wl.is_admitted or wl.is_finished:
+        # Only admitted workloads can exceed an execution budget, and
+        # the admitted world is exactly the cache's workload set — at
+        # churn scale iterating every known workload per tick dominated
+        # the tick itself.
+        for info in list(self.cache.workloads.values()):
+            wl = self.workloads.get(info.key)
+            if wl is None or not wl.is_admitted or wl.is_finished:
                 continue
             max_s = wl.maximum_execution_time_seconds
             if max_s is None:

@@ -492,6 +492,17 @@ def dense_path_eligible(info) -> bool:
     distinguish explicit-zero from absent), and elastic workload-slice
     replacements (the host path owns ReplacedWorkloadSlice's freed-usage
     fit and old-slice finish, scheduler.go:765)."""
+    cached = getattr(info, "_dense_elig", None)
+    if cached is not None:
+        return cached
+    info._dense_elig = out = _dense_path_eligible(info)
+    return out
+
+
+def _dense_path_eligible(info) -> bool:
+    # Pure in the info's immutable shape (pod sets, derived requests,
+    # slice replacement), so dense_path_eligible memoizes per info —
+    # churn worlds re-encode the same rows thousands of times.
     if len(info.total_requests) > MAX_FAST_PODSETS:
         return False
     if info.obj.replaced_workload_slice is not None:

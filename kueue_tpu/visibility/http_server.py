@@ -22,12 +22,22 @@ from kueue_tpu.visibility.server import (
 )
 
 
-def make_handler(engine, auth_token=None):
+def make_handler(engine, auth_token=None, apf=None):
     vis = VisibilityServer(engine)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):  # quiet
             pass
+
+        def _flow_user(self) -> str:
+            """The APF flow identity: the bearer token's fingerprint
+            (ByUser distinguisher), or the anonymous group."""
+            got = self.headers.get("Authorization", "")
+            if got.startswith("Bearer "):
+                import hashlib
+
+                return hashlib.sha256(got.encode()).hexdigest()[:12]
+            return "system:anonymous"
 
         def _authorized(self) -> bool:
             """Bearer-token auth (the APF/RBAC stand-in for the
@@ -66,9 +76,37 @@ def make_handler(engine, auth_token=None):
             self.wfile.write(data)
 
         def do_GET(self):  # noqa: N802
+            # Authentication BEFORE flow classification (the apiserver
+            # runs authn ahead of APF): an invalid bearer token gets a
+            # cheap 401 and never mints a flow, so junk tokens cannot
+            # fan out across the shuffle-shard queues.
             if not self._authorized():
                 self._send('{"error":"unauthorized"}', code=401)
                 return
+            if apf is not None:
+                from kueue_tpu.visibility.flowcontrol import RejectedError
+                try:
+                    ticket = apf.admit(self._flow_user(),
+                                       urlparse(self.path).path)
+                except RejectedError as e:
+                    # The apiserver's overload answer: 429 + Retry-After.
+                    data = json.dumps({"error": "too many requests",
+                                       "reason": str(e)}).encode()
+                    self.send_response(429)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After", "1")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                try:
+                    self._serve_get()
+                finally:
+                    apf.release(ticket)
+            else:
+                self._serve_get()
+
+        def _serve_get(self):
             path = urlparse(self.path).path.rstrip("/")
             parts = [p for p in path.split("/") if p]
             if path in ("", "/dashboard"):
@@ -88,6 +126,10 @@ def make_handler(engine, auth_token=None):
                            content_type="text/plain")
             elif path == "/healthz":
                 self._send('{"status":"ok"}')
+            elif path == "/debug/flowcontrol":
+                self._send(json.dumps(
+                    apf.stats() if apf is not None
+                    else {"enabled": False}))
             elif path == "/debug/dump":
                 self._send(json.dumps(dump_state(engine), indent=2))
             elif path == "/capacity":
@@ -148,13 +190,25 @@ class ServingEndpoint:
       * ``cert_dir`` — serve HTTPS with tls.crt/tls.key from the dir
         (auto-generated self-signed via utils.cert when absent);
       * ``auth_token`` — require ``Authorization: Bearer <token>`` on
-        every route except /healthz.
+        every route except /healthz;
+      * ``flow_control`` — APF request classification in front of every
+        route (visibility/flowcontrol.py, the config/visibility-apf
+        analog): per-user flows, seat limits, shuffle-shard queuing,
+        429 shedding. True (default) uses the shipped schema/level
+        pair; pass an APFDispatcher for custom config; False disables.
     """
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
-                 cert_dir: str = None, auth_token: str = None):
+                 cert_dir: str = None, auth_token: str = None,
+                 flow_control=True):
+        from kueue_tpu.visibility.flowcontrol import APFDispatcher
+        self.apf = None
+        if flow_control:
+            self.apf = (flow_control if isinstance(
+                flow_control, APFDispatcher) else APFDispatcher())
         self.httpd = ThreadingHTTPServer(
-            (host, port), make_handler(engine, auth_token=auth_token))
+            (host, port), make_handler(engine, auth_token=auth_token,
+                                       apf=self.apf))
         self.tls = cert_dir is not None
         if cert_dir is not None:
             import ssl

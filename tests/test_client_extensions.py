@@ -1,0 +1,424 @@
+"""Listers, apply-configurations, DRA CEL matching, visibility APF —
+the round-4 verdict's "smaller gaps" tier (client-go listers/
+applyconfigurations, pkg/dra CEL selectors, config/visibility-apf)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.api.types import (  # noqa: E402
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.client.applyconfigurations import (  # noqa: E402
+    ApplyConflict,
+    ApplyEngine,
+    ClusterQueueApply,
+    WorkloadApply,
+)
+from kueue_tpu.client.listers import (  # noqa: E402
+    LabelSelector,
+    Listers,
+    Requirement,
+)
+from kueue_tpu.controllers.dra import (  # noqa: E402
+    Device,
+    DeviceClass,
+    DeviceClassMapper,
+    DeviceRequest,
+    ResourceClaim,
+    ResourceSlice,
+    validate_cel_selectors,
+)
+from kueue_tpu.controllers.engine import Engine  # noqa: E402
+from kueue_tpu.utils import cel  # noqa: E402
+from kueue_tpu.visibility.flowcontrol import (  # noqa: E402
+    APFDispatcher,
+    FlowSchema,
+    PriorityLevelConfiguration,
+    RejectedError,
+)
+from kueue_tpu.visibility.http_server import ServingEndpoint  # noqa: E402
+
+
+def make_engine():
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    for i, cohort in (("a", "left"), ("b", "left"), ("c", "right")):
+        eng.create_cluster_queue(ClusterQueue(
+            name=f"cq-{i}", cohort=cohort,
+            resource_groups=(ResourceGroup(
+                ("cpu",), (FlavorQuotas("default", {"cpu": ResourceQuota(
+                    8000)}),)),)))
+        eng.create_local_queue(LocalQueue(f"lq-{i}", "default", f"cq-{i}"))
+    return eng
+
+
+class TestCel:
+    def test_expressions(self):
+        env = {"device": {"driver": "tpu.example.com",
+                          "attributes": {"family": "v5e", "cores": 8},
+                          "capacity": {"memory": 16}}}
+        cases = [
+            ('device.driver == "tpu.example.com"', True),
+            ('device.attributes["family"] == "v5e" && '
+             'device.attributes["cores"] >= 8', True),
+            ('device.attributes["cores"] > 8', False),
+            ('device.driver.startsWith("tpu.")', True),
+            ('device.driver.matches("^tpu\\\\.")', True),
+            ('"family" in device.attributes', True),
+            ('"missing" in device.attributes', False),
+            ('device.capacity["memory"] - 8 >= 8', True),
+            ('device.attributes["family"] in ["v5e", "v5p"]', True),
+            ('!(device.attributes["cores"] < 4)', True),
+            ('device.driver.size() > 5', True),
+        ]
+        for expr, want in cases:
+            assert cel.evaluate(expr, env) is want, expr
+
+    def test_compile_errors(self):
+        for bad in ("device.attributes[", "a &&", "1 ===2", "foo(",
+                    'device.driver.nosuch("x")'):
+            with pytest.raises(cel.CelCompileError):
+                cel.compile_cel(bad)
+
+    def test_eval_errors(self):
+        env = {"device": {"driver": "d", "attributes": {},
+                          "capacity": {}}}
+        with pytest.raises(cel.CelEvalError):
+            cel.evaluate('device.attributes["missing"] == 1', env)
+        with pytest.raises(cel.CelEvalError):
+            cel.evaluate('device.driver + 1 == 2', env)
+        # Every runtime failure mode surfaces as CelEvalError — bad
+        # regexes and type confusion must not leak host exceptions.
+        with pytest.raises(cel.CelEvalError):
+            cel.evaluate('device.driver.matches("[")', env)
+        with pytest.raises(cel.CelEvalError):
+            cel.evaluate('1 in device.driver', env)
+        # Selector predicates must be boolean-typed.
+        env2 = {"device": {"driver": "d", "attributes": {"tier": "gold"},
+                           "capacity": {}}}
+        with pytest.raises(cel.CelEvalError):
+            cel.evaluate_predicate('device.attributes["tier"]', env2)
+
+
+class TestDraCel:
+    def make_mapper(self):
+        m = DeviceClassMapper()
+        m.add_device_class(DeviceClass(
+            "tpu.example.com/v5e", "tpu-v5e", counters={"mem": 16}))
+        m.add_resource_slice(ResourceSlice(
+            driver="tpu.example.com", pool="p0", pool_slice_count=1,
+            devices=[
+                Device("d0", {"family": "v5e", "zone": "a"}),
+                Device("d1", {"family": "v5e", "zone": "b"}),
+                Device("d2", {"family": "v5p", "zone": "a"}),
+            ]))
+        return m
+
+    def test_cel_selector_matching_counts(self):
+        m = self.make_mapper()
+        claim = ResourceClaim(requests=(DeviceRequest(
+            "tpu.example.com/v5e", count=2,
+            cel_selectors=('device.attributes["family"] == "v5e"',)),))
+        assert m.validate_against_devices([claim]) == []
+        short = ResourceClaim(requests=(DeviceRequest(
+            "tpu.example.com/v5e", count=3,
+            cel_selectors=('device.attributes["family"] == "v5e"',)),))
+        errs = m.validate_against_devices([short])
+        assert len(errs) == 1
+        assert "2 device(s) match in the cluster but 3 requested" in \
+            errs[0]
+
+    def test_compile_error_rejects_before_admission(self):
+        errs = validate_cel_selectors([DeviceRequest(
+            "c", cel_selectors=("device.attributes[",))])
+        assert errs and "CEL compilation failed" in errs[0]
+
+    def test_eval_error_means_no_match(self):
+        m = self.make_mapper()
+        claim = ResourceClaim(requests=(DeviceRequest(
+            "tpu.example.com/v5e", count=1,
+            cel_selectors=('device.attributes["nope"] == "x"',)),))
+        errs = m.validate_against_devices([claim])
+        assert errs and "0 device(s) match" in errs[0]
+
+    def test_bad_regex_and_nonbool_mean_no_match(self):
+        m = self.make_mapper()
+        for expr in ('device.attributes["family"].matches("[")',
+                     'device.attributes["family"]'):
+            claim = ResourceClaim(requests=(DeviceRequest(
+                "tpu.example.com/v5e", count=1,
+                cel_selectors=(expr,)),))
+            errs = m.validate_against_devices([claim])
+            assert errs and "0 device(s) match" in errs[0], expr
+
+    def test_selectorless_requests_consume_in_validation(self):
+        """A selector-less request eats devices allocation-order before
+        a selective one; validation must account for that."""
+        m = self.make_mapper()
+        greedy = ResourceClaim(requests=(DeviceRequest(
+            "tpu.example.com/v5e", count=2),))
+        picky = ResourceClaim(requests=(DeviceRequest(
+            "tpu.example.com/v5e", count=2,
+            cel_selectors=('device.attributes["family"] == "v5e"',)),))
+        errs = m.validate_against_devices([greedy, picky])
+        assert errs and "but 2 requested" in errs[0]
+
+    def test_counter_charges_through_cel(self):
+        m = self.make_mapper()
+        claim = ResourceClaim(requests=(DeviceRequest(
+            "tpu.example.com/v5e", count=1,
+            cel_selectors=('device.driver == "tpu.example.com" && '
+                           'device.attributes["zone"] == "b"',)),))
+        assert m.counter_resources([claim]) == {"mem": 16}
+
+
+class TestListers:
+    def test_workload_indices_and_selectors(self):
+        eng = make_engine()
+        for i, (lq, labels) in enumerate((
+                ("lq-a", {"team": "ml"}), ("lq-a", {"team": "web"}),
+                ("lq-b", {"team": "ml"}), ("lq-c", {}))):
+            eng.submit(Workload(name=f"w{i}", queue_name=lq,
+                                labels=labels,
+                                pod_sets=(PodSet("m", 1,
+                                                 {"cpu": 100}),)))
+        for _ in range(4):
+            eng.schedule_once()
+        ls = Listers(eng)
+        assert {w.name for w in ls.workloads.by_cluster_queue("cq-a")} \
+            == {"w0", "w1"}
+        assert {w.name for w in ls.workloads.by_local_queue(
+            "default", "lq-b")} == {"w2"}
+        sel = LabelSelector.of({"team": "ml"})
+        assert {w.name for w in ls.workloads.list(sel)} == {"w0", "w2"}
+        expr = LabelSelector.of(match_expressions=(
+            Requirement("team", "NotIn", ("web",)),
+            Requirement("team", "Exists")))
+        assert {w.name for w in ls.workloads.list(expr)} == {"w0", "w2"}
+        assert {w.name for w in ls.workloads.by_phase("Admitted")} == \
+            {"w0", "w1", "w2", "w3"}
+        ns = ls.workloads.namespaced("default")
+        assert ns.get("w0") is not None
+        assert ls.cluster_queues.by_cohort("left")[0].name in (
+            "cq-a", "cq-b")
+        assert {q.name for q in ls.local_queues.by_cluster_queue(
+            "cq-c")} == {"lq-c"}
+
+
+class TestApplyConfigurations:
+    def test_field_ownership_and_conflict(self):
+        eng = make_engine()
+        eng.submit(Workload(name="w", queue_name="lq-a",
+                            pod_sets=(PodSet("m", 1, {"cpu": 100}),)))
+        ae = ApplyEngine(eng)
+        ae.apply_workload(WorkloadApply("default", "w")
+                          .with_priority(5).with_label("team", "ml"),
+                          field_manager="alpha")
+        wl = eng.workloads["default/w"]
+        assert wl.priority == 5 and wl.labels["team"] == "ml"
+        assert ae.field_owners("workload", "default/w")["priority"] == \
+            "alpha"
+        # A second manager changing an owned field conflicts...
+        with pytest.raises(ApplyConflict) as exc:
+            ae.apply_workload(WorkloadApply("default", "w")
+                              .with_priority(9), field_manager="beta")
+        assert "conflict with 'alpha'" in str(exc.value)
+        # ...unless forced, which transfers ownership.
+        ae.apply_workload(WorkloadApply("default", "w").with_priority(9),
+                          field_manager="beta", force=True)
+        assert eng.workloads["default/w"].priority == 9
+        assert ae.field_owners("workload", "default/w")["priority"] == \
+            "beta"
+        # Same value from another manager is not a conflict (SSA rule).
+        ae.apply_workload(WorkloadApply("default", "w").with_priority(9),
+                          field_manager="gamma")
+
+    def test_queue_move_requeues_pending(self):
+        eng = make_engine()
+        eng.submit(Workload(name="w", queue_name="lq-a",
+                            pod_sets=(PodSet("m", 1, {"cpu": 100}),)))
+        ae = ApplyEngine(eng)
+        ae.apply_workload(WorkloadApply("default", "w")
+                          .with_queue_name("lq-b"), field_manager="m")
+        eng.schedule_once()
+        wl = eng.workloads["default/w"]
+        assert wl.is_admitted
+        assert wl.status.admission.cluster_queue == "cq-b"
+
+    def test_priority_apply_rekeys_pending_entry(self):
+        """with_priority on a pending workload must re-key its heap
+        entry — the boosted workload wins the next head pop."""
+        eng = make_engine()
+        # Fill cq-a so both stay pending and contend for the next pop.
+        eng.submit(Workload(name="big", queue_name="lq-a",
+                            pod_sets=(PodSet("m", 1, {"cpu": 8000}),)))
+        eng.schedule_once()
+        eng.submit(Workload(name="w1", queue_name="lq-a",
+                            pod_sets=(PodSet("m", 1, {"cpu": 100}),)))
+        eng.clock += 1.0
+        eng.submit(Workload(name="w2", queue_name="lq-a",
+                            pod_sets=(PodSet("m", 1, {"cpu": 100}),)))
+        ApplyEngine(eng).apply_workload(
+            WorkloadApply("default", "w2").with_priority(50),
+            field_manager="m")
+        head = eng.queues.heads()[0]
+        assert head.obj.name == "w2"
+
+    def test_cluster_queue_apply_upserts_spec(self):
+        eng = make_engine()
+        ae = ApplyEngine(eng)
+        ae.apply_cluster_queue(ClusterQueueApply("cq-a")
+                               .with_cohort("moved"),
+                               field_manager="m")
+        assert eng.cache.cluster_queues["cq-a"].cohort == "moved"
+
+
+class TestAPF:
+    def small(self):
+        schemas = [
+            FlowSchema(name="probes", priority_level="exempt",
+                       matching_precedence=100, distinguisher="",
+                       path_prefixes=("/healthz",)),
+            FlowSchema(name="vis", priority_level="vis",
+                       matching_precedence=9000),
+        ]
+        levels = {
+            "exempt": PriorityLevelConfiguration("exempt", exempt=True),
+            "vis": PriorityLevelConfiguration(
+                "vis", nominal_concurrency=2, queues=4, hand_size=2,
+                queue_length_limit=1),
+        }
+        return APFDispatcher(schemas, levels)
+
+    def test_classify_precedence_and_exempt(self):
+        apf = self.small()
+        schema, flow = apf.classify("u", "/healthz")
+        assert schema.name == "probes"
+        schema, flow = apf.classify("u", "/capacity")
+        assert schema.name == "vis" and flow == "vis/u"
+        t = apf.admit("u", "/healthz")
+        apf.release(t)  # exempt: no seat accounting
+        assert apf.stats()["levels"]["exempt"]["executing"] == 0
+
+    def test_seats_queue_and_shed(self):
+        apf = self.small()
+        t1 = apf.admit("a", "/x")
+        t2 = apf.admit("b", "/x")
+        # Seats full; a third non-blocking probe must shed once its
+        # queue (limit 1) is full.
+        blocked = []
+
+        def waiter():
+            try:
+                t = apf.admit("c", "/x", timeout=5.0)
+                blocked.append(t)
+            except RejectedError:
+                blocked.append(None)
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        import time
+        for _ in range(100):
+            if apf.stats()["levels"]["vis"]["queued"] == 1:
+                break
+            time.sleep(0.01)
+        # The same flow's next request finds its queue full -> 429.
+        with pytest.raises(RejectedError):
+            apf.admit("c", "/x", timeout=0.05)
+        apf.release(t1)
+        th.join(timeout=5)
+        assert blocked and blocked[0] is not None
+        apf.release(blocked[0])
+        apf.release(t2)
+        s = apf.stats()
+        assert s["rejected_total"] >= 1
+        assert s["levels"]["vis"]["executing"] == 0
+
+    def test_queued_waiters_drain_before_newcomers(self):
+        """A freed seat must go to an already-queued request, not to a
+        fresh arrival racing the release."""
+        apf = self.small()
+        t1 = apf.admit("a", "/x")
+        t2 = apf.admit("b", "/x")
+        got = []
+
+        def waiter():
+            got.append(apf.admit("c", "/x", timeout=5.0))
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        import time
+        for _ in range(200):
+            if apf.stats()["levels"]["vis"]["queued"] == 1:
+                break
+            time.sleep(0.005)
+        apf.release(t1)
+        # A newcomer right after the release queues behind the waiter
+        # instead of stealing the seat.
+        with pytest.raises(RejectedError):
+            apf.admit("d", "/x", timeout=0.05)
+        th.join(timeout=5)
+        assert got
+        apf.release(got[0])
+        apf.release(t2)
+
+    def test_invalid_tokens_cannot_mint_flows(self):
+        """Authn runs before APF: junk bearer tokens get 401 without
+        touching the dispatcher (no per-token flows)."""
+        eng = make_engine()
+        apf = APFDispatcher()
+        ep = ServingEndpoint(eng, auth_token="s3cret", flow_control=apf)
+        ep.start()
+        try:
+            url = f"http://127.0.0.1:{ep.port}"
+            for i in range(4):
+                req = urllib.request.Request(
+                    f"{url}/capacity",
+                    headers={"Authorization": f"Bearer junk{i}"})
+                try:
+                    urllib.request.urlopen(req)
+                    raise AssertionError("expected 401")
+                except urllib.error.HTTPError as e:
+                    assert e.code == 401
+            assert apf.queued_total == 0 and apf.rejected_total == 0
+            req = urllib.request.Request(
+                f"{url}/capacity",
+                headers={"Authorization": "Bearer s3cret"})
+            with urllib.request.urlopen(req) as r:
+                assert r.status == 200
+        finally:
+            ep.stop()
+
+    def test_http_endpoint_serves_and_sheds(self):
+        eng = make_engine()
+        apf = APFDispatcher(*([
+            FlowSchema(name="vis", priority_level="vis",
+                       matching_precedence=9000)],
+            {"vis": PriorityLevelConfiguration(
+                "vis", nominal_concurrency=1, queues=2, hand_size=1,
+                queue_length_limit=1)}))
+        ep = ServingEndpoint(eng, flow_control=apf)
+        ep.start()
+        try:
+            url = f"http://127.0.0.1:{ep.port}"
+            with urllib.request.urlopen(f"{url}/capacity") as r:
+                assert r.status == 200
+            with urllib.request.urlopen(f"{url}/debug/flowcontrol") as r:
+                st = json.loads(r.read())
+            # The stats request itself holds the level's only seat.
+            assert st["levels"]["vis"]["executing"] == 1
+        finally:
+            ep.stop()
