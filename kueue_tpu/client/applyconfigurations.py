@@ -159,12 +159,26 @@ class ApplyEngine:
             # entry through the manager (queue_controller's
             # UpdateWorkload path) so the heap key and tensor row are
             # recomputed; mutating in place would leave the workload
-            # competing at its old key.
+            # competing at its old key. A move to a missing/held queue
+            # must not strand the workload: validate the target BEFORE
+            # removing from the current heap.
+            new_q = declared.get("queue_name", wl.queue_name)
+            target = self._engine.queues.local_queues.get(
+                f"{wl.namespace}/{new_q}")
+            if target is None:
+                raise KeyError(
+                    f"LocalQueue {wl.namespace}/{new_q} not found")
             self._engine.queues.delete_workload(wl)
         for path, value in declared.items():
             self._set_path(wl, path, value)
         if rekey and not wl.is_admitted:
-            self._engine.queues.add_or_update_workload(wl)
+            if self._engine.queues.add_or_update_workload(wl) is None:
+                # Gated out (held queue / inactive): surface it — the
+                # submit path would have evented; silence strands.
+                self._engine._event(
+                    "WorkloadHeld", wl.key,
+                    detail=f"queue {wl.queue_name} is not accepting "
+                           f"workloads")
         return wl
 
     def apply_cluster_queue(self, cfg: ClusterQueueApply,
@@ -191,8 +205,26 @@ class ApplyEngine:
         self._check_and_own(
             f"localqueue/{cfg.key}", declared, field_manager, force,
             lambda p: self._get_path(lq, p))
+        new_policy = declared.pop("stop_policy", None)
         for path, value in declared.items():
             self._set_path(lq, path, value)
+        if new_policy is not None and new_policy != lq.stop_policy:
+            # Stop-policy transitions go through the kueuectl machinery
+            # (stop/stop_localqueue.go): Hold retracts the LQ's pending
+            # workloads, HoldAndDrain also evicts reserved ones, None
+            # re-queues — a bare field write would only gate future
+            # submissions.
+            from kueue_tpu.api.types import StopPolicy
+            from kueue_tpu.cli.kueuectl import Kueuectl
+
+            ctl = Kueuectl(self._engine)
+            if new_policy in (StopPolicy.HOLD, "Hold"):
+                ctl.stop_local_queue(cfg.key, drain=False)
+            elif new_policy in (StopPolicy.HOLD_AND_DRAIN,
+                                "HoldAndDrain"):
+                ctl.stop_local_queue(cfg.key, drain=True)
+            else:
+                ctl.resume_local_queue(cfg.key)
         return lq
 
     def field_owners(self, kind: str, key: str) -> dict[str, str]:

@@ -38,6 +38,10 @@ _DEFAULTS: dict[str, bool] = {
     "MultiKueueOrchestratedPreemption": False,
     "MultiKueueManagerQuotaAutomation": False,
     "MultiKueueIncrementalDispatcherConfig": True,
+    # kube_features.go:253 MultiKueueClusterProfile (alpha, default off):
+    # MultiKueueCluster may name a ClusterProfile instead of a
+    # kubeconfig as its connection source.
+    "MultiKueueClusterProfile": False,
     "ElasticJobsViaWorkloadSlices": False,
     "ElasticJobsViaWorkloadSlicesWithTAS": True,
     "ConcurrentAdmission": False,

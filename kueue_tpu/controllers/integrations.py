@@ -124,6 +124,9 @@ class MPIJob(_BaseJob):
     worker_replicas: int = 1
     worker_requests: dict = field(default_factory=dict)
     run_launcher_as_worker: bool = False
+    # slotsPerWorker scales each worker's share of the MPI world; the
+    # webhook rejects non-positive values (mpijob_webhook.go).
+    slots_per_worker: int = 1
     topology_request: Optional[PodSetTopologyRequest] = None
 
     def pod_sets(self) -> list[PodSet]:
@@ -568,14 +571,21 @@ class StatefulSetJob(_BaseJob):
 class DeploymentJob(_BaseJob):
     """Deployment (pkg/controller/jobs/deployment): each replica is
     admitted independently in the reference; modeled as one pod set with
-    per-replica pods."""
+    per-replica pods. Serving semantics like StatefulSet: scale-to-zero
+    releases the reservation with an engine hold
+    (deployment_reconciler.go scale handling), scale while running
+    replaces the workload (elastic: via a workload slice)."""
 
     replicas: int = 1
     requests: dict = field(default_factory=dict)
+    hold_at_zero: bool = True
 
     def pod_sets(self) -> list[PodSet]:
         return [PodSet(name="pods", count=self.replicas,
                        requests=dict(self.requests))]
+
+    def scale(self, replicas: int) -> None:
+        self.replicas = replicas
 
     def finished(self) -> tuple[bool, bool]:
         return False, False

@@ -86,6 +86,12 @@ class MultiKueueController:
         # connect/reconnect/hot-reload lifecycles live here; plain
         # connect_cluster() workers bypass it.
         self.remote_clients: dict[str, object] = {}
+        # ClusterProfile objects (cluster-inventory-api) for
+        # profile-sourced RemoteClients (MultiKueueClusterProfile gate).
+        from kueue_tpu.controllers.multikueue_cluster import (
+            ClusterProfileRegistry,
+        )
+        self.cluster_profiles = ClusterProfileRegistry()
         self.states: dict[str, _RemoteState] = {}
         # MultiKueueOrchestratedPreemption: remote copies carry a closed
         # preemption gate; the manager opens one cluster's gate at a time
@@ -121,17 +127,23 @@ class MultiKueueController:
     def connect_cluster(self, name: str, engine) -> None:
         self.clusters[name] = engine
 
-    def add_remote_cluster(self, name: str, kubeconfig_path: str,
-                           connect, retry_increment: float = 1.0) -> None:
-        """Register a worker reached through a kubeconfig-file-backed
-        RemoteClient (multikueuecluster.go): reconcile_clusters() drives
-        connect / exponential reconnect / kubeconfig hot-reload."""
+    def add_remote_cluster(self, name: str, kubeconfig_path: str = None,
+                           connect=None, retry_increment: float = 1.0,
+                           cluster_profile: str = None) -> None:
+        """Register a worker reached through a RemoteClient
+        (multikueuecluster.go): reconcile_clusters() drives connect /
+        exponential reconnect / source hot-reload. ClusterSource is
+        exactly one of ``kubeconfig_path`` (file-backed, fswatch) or
+        ``cluster_profile`` (a name in ``self.cluster_profiles``, gated
+        by MultiKueueClusterProfile)."""
         from kueue_tpu.controllers.multikueue_cluster import RemoteClient
 
         self.remote_clients[name] = RemoteClient(
             name, kubeconfig_path, connect,
             clock=lambda: self.engine.clock,
-            retry_increment=retry_increment)
+            retry_increment=retry_increment,
+            cluster_profile=cluster_profile,
+            profiles=self.cluster_profiles)
 
     def cluster_connection_lost(self, name: str, reason: str) -> None:
         """Watch-ended / transport-failure event for a managed cluster:

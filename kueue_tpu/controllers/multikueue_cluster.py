@@ -32,6 +32,11 @@ DEFAULT_RETRY_INCREMENT = 1.0
 ORIGIN_LABEL = "kueue.x-k8s.io/multikueue-origin"
 
 
+class _GateDisabled(RuntimeError):
+    """ClusterProfile source used while MultiKueueClusterProfile is
+    off."""
+
+
 def retry_after(failed_attempts: int,
                 increment: float = DEFAULT_RETRY_INCREMENT) -> float:
     """multikueuecluster.go:98 (retryAfter)."""
@@ -50,18 +55,73 @@ class ClusterActive:
     message: str = ""
 
 
+@dataclass
+class ClusterProfile:
+    """cluster-inventory-api ClusterProfile, reduced to what the access
+    provider consumes (multikueuecluster.go:716
+    clusterProfileAccessProvider.BuildConfigFromCP)."""
+
+    name: str
+    config: dict = None
+    generation: int = 0
+
+
+class ClusterProfileRegistry:
+    """The ClusterProfile object store + access provider: resolves a
+    profile reference into a connection config. Registering a profile
+    bumps its generation, the analog of the watch event that re-triggers
+    the cluster reconciler (multikueuecluster.go:836)."""
+
+    def __init__(self):
+        self._profiles: dict[str, ClusterProfile] = {}
+        self._gen = 0  # registry-wide, survives delete: a
+        # delete + re-register rotation between ticks must still
+        # present a NEW generation to the change detector.
+
+    def register(self, profile: ClusterProfile) -> None:
+        self._gen += 1
+        profile.generation = self._gen
+        self._profiles[profile.name] = profile
+
+    def delete(self, name: str) -> None:
+        self._profiles.pop(name, None)
+
+    def get(self, name: str) -> Optional[ClusterProfile]:
+        return self._profiles.get(name)
+
+    def build_config(self, name: str) -> dict:
+        """BuildConfigFromCP: raises on a missing profile (reconcile
+        re-triggers when the ClusterProfile is created,
+        multikueuecluster.go:836)."""
+        profile = self._profiles.get(name)
+        if profile is None or profile.config is None:
+            raise KeyError(f"ClusterProfile {name!r} not found")
+        return profile.config
+
+
 class RemoteClient:
     """One worker cluster's client lifecycle (remoteClient in
-    multikueuecluster.go): connect from a kubeconfig file, reconnect
-    with exponential backoff after failures, rebuild when the file
-    changes."""
+    multikueuecluster.go): connect from a kubeconfig file OR a
+    ClusterProfile reference (ClusterSource is exactly one of the two,
+    multikueue_types.go ClusterSource), reconnect with exponential
+    backoff after failures, rebuild when the source changes. The
+    ClusterProfile source is gated by MultiKueueClusterProfile
+    (multikueuecluster.go:859: gate off => Active=False with reason
+    MultiKueueClusterProfileFeatureDisabled)."""
 
-    def __init__(self, name: str, kubeconfig_path: str,
-                 connect: Callable[[dict], object],
-                 clock: Callable[[], float],
-                 retry_increment: float = DEFAULT_RETRY_INCREMENT):
+    def __init__(self, name: str, kubeconfig_path: str = None,
+                 connect: Callable[[dict], object] = None,
+                 clock: Callable[[], float] = None,
+                 retry_increment: float = DEFAULT_RETRY_INCREMENT,
+                 cluster_profile: str = None,
+                 profiles: Optional[ClusterProfileRegistry] = None):
+        if (kubeconfig_path is None) == (cluster_profile is None):
+            raise ValueError("exactly one of kubeconfig_path and "
+                             "cluster_profile must be set")
         self.name = name
         self.kubeconfig_path = kubeconfig_path
+        self.cluster_profile = cluster_profile
+        self.profiles = profiles
         self.connect = connect
         self.clock = clock
         self.retry_increment = retry_increment
@@ -71,11 +131,34 @@ class RemoteClient:
         self.active = ClusterActive()
         self._mtime: Optional[int] = None
 
-    def _stat_mtime(self) -> Optional[int]:
+    def _stat_mtime(self):
+        if self.kubeconfig_path is None:
+            # ClusterProfile source: the profile's generation is the
+            # change signal (a re-registered profile bumps it, the
+            # watch-event analog); the gate state participates so a
+            # flip re-triggers connection handling immediately.
+            from kueue_tpu.config import features
+            if not features.enabled("MultiKueueClusterProfile"):
+                return "gate-disabled"
+            profile = (self.profiles.get(self.cluster_profile)
+                       if self.profiles is not None else None)
+            return None if profile is None else profile.generation
         try:
             return os.stat(self.kubeconfig_path).st_mtime_ns
         except OSError:
             return None
+
+    def _load_config(self) -> dict:
+        if self.kubeconfig_path is not None:
+            with open(self.kubeconfig_path, encoding="utf-8") as f:
+                return json.load(f)
+        from kueue_tpu.config import features
+        if not features.enabled("MultiKueueClusterProfile"):
+            raise _GateDisabled(
+                "MultiKueueClusterProfile feature gate is disabled")
+        if self.profiles is None:
+            raise KeyError("no ClusterProfile registry attached")
+        return self.profiles.build_config(self.cluster_profile)
 
     def mark_lost(self, reason: str) -> None:
         """Watch-ended / transport-failure event (the reference's
@@ -112,13 +195,20 @@ class RemoteClient:
             self._mtime = mtime
         if self.worker is None and now >= self.next_attempt_at:
             try:
-                with open(self.kubeconfig_path, encoding="utf-8") as f:
-                    config = json.load(f)
+                config = self._load_config()
                 self.worker = self.connect(config)
                 self._mtime = mtime
                 self.failed_attempts = 0
                 self.active = ClusterActive(True, "Active", "Connected")
                 return "reconfigured" if reconfigured else "connected"
+            except _GateDisabled as e:
+                # multikueuecluster.go:859: no backoff churn — the gate
+                # flip itself re-triggers via the source version.
+                self.active = ClusterActive(
+                    False, "MultiKueueClusterProfileFeatureDisabled",
+                    str(e))
+                if reconfigured:
+                    return "disconnected"
             except Exception as e:  # noqa: BLE001 — any connect failure
                 self.failed_attempts += 1
                 self.next_attempt_at = now + retry_after(

@@ -229,6 +229,47 @@ class StatefulSetWebhook(JobWebhook):
         return errs
 
 
+@dataclass
+class DeploymentWebhook(JobWebhook):
+    """jobs/deployment/deployment_webhook.go: replicas bounds + pod
+    template immutability while managed (scale alone is allowed, same
+    rule as the StatefulSet webhook)."""
+
+    kind: str = "apps/deployment"
+
+    def extra_create_rules(self, job) -> list[str]:
+        if getattr(job, "replicas", 1) < 0:
+            return ["replicas must be non-negative"]
+        return []
+
+    def validate_update(self, old, new) -> list[str]:
+        errs = super().validate_update(old, new)
+        if (getattr(old, "requests", None) != getattr(new, "requests",
+                                                      None)
+                and not old.is_suspended()):
+            errs.append("pod template resources are immutable while the "
+                        "Deployment is managed and running")
+        return errs
+
+
+@dataclass
+class MPIJobWebhook(JobWebhook):
+    """jobs/mpijob/mpijob_webhook.go."""
+
+    kind: str = "kubeflow.org/mpijob"
+
+    def extra_create_rules(self, job) -> list[str]:
+        errs = []
+        if getattr(job, "worker_replicas", 1) < 0:
+            errs.append("worker replicas must be non-negative")
+        if getattr(job, "slots_per_worker", 1) <= 0:
+            errs.append("slotsPerWorker must be positive")
+        if getattr(job, "run_launcher_as_worker", False) \
+                and getattr(job, "worker_replicas", 1) == 0:
+            errs.append("runLauncherAsWorker needs at least one worker")
+        return errs
+
+
 class JobWebhookRegistry:
     """Dispatches per-kind webhooks, the admission-webhook layer in front
     of JobReconciler.create_job."""
@@ -249,6 +290,8 @@ class JobWebhookRegistry:
             "sparkoperator.k8s.io/sparkapplication":
                 SparkApplicationWebhook(),
             "apps/statefulset": StatefulSetWebhook(),
+            "apps/deployment": DeploymentWebhook(),
+            "kubeflow.org/mpijob": MPIJobWebhook(),
         }
         self._generic = JobWebhook()
 

@@ -277,6 +277,37 @@ class TestApplyConfigurations:
         head = eng.queues.heads()[0]
         assert head.obj.name == "w2"
 
+    def test_queue_move_to_missing_queue_rejected_upfront(self):
+        eng = make_engine()
+        eng.submit(Workload(name="w", queue_name="lq-a",
+                            pod_sets=(PodSet("m", 1, {"cpu": 100}),)))
+        ae = ApplyEngine(eng)
+        with pytest.raises(KeyError):
+            ae.apply_workload(WorkloadApply("default", "w")
+                              .with_queue_name("nope"),
+                              field_manager="m")
+        # Not stranded: still pending in its original queue.
+        eng.schedule_once()
+        assert eng.workloads["default/w"].is_admitted
+
+    def test_stop_policy_apply_retracts_pending(self):
+        from kueue_tpu.api.types import StopPolicy
+        from kueue_tpu.client.applyconfigurations import LocalQueueApply
+        eng = make_engine()
+        eng.submit(Workload(name="w", queue_name="lq-a",
+                            pod_sets=(PodSet("m", 1, {"cpu": 100}),)))
+        ae = ApplyEngine(eng)
+        ae.apply_local_queue(LocalQueueApply("default", "lq-a")
+                             .with_stop_policy(StopPolicy.HOLD),
+                             field_manager="m")
+        eng.schedule_once()
+        assert not eng.workloads["default/w"].is_admitted
+        ae.apply_local_queue(LocalQueueApply("default", "lq-a")
+                             .with_stop_policy(StopPolicy.NONE),
+                             field_manager="m")
+        eng.schedule_once()
+        assert eng.workloads["default/w"].is_admitted
+
     def test_cluster_queue_apply_upserts_spec(self):
         eng = make_engine()
         ae = ApplyEngine(eng)

@@ -180,6 +180,80 @@ class TestStatefulSetDepth:
         assert reg.admit_update(old, new2) == []
 
 
+class TestDeploymentDepth:
+    def test_scale_to_zero_holds_and_webhook_parity(self):
+        from kueue_tpu.controllers.integrations import DeploymentJob
+        eng = make_engine()
+        rec = JobReconciler(eng)
+        dep = DeploymentJob(name="srv", queue_name="lq", replicas=2,
+                            requests={"cpu": 1000})
+        rec.create_job(dep)
+        pump(eng, rec)
+        wl_key = rec.job_to_workload[dep.key]
+        assert eng.workloads[wl_key].is_admitted
+        dep.scale(0)
+        rec.reconcile_all()
+        assert eng.is_on_hold(eng.workloads[wl_key])
+        assert not eng.workloads[wl_key].is_admitted
+        dep.scale(3)
+        pump(eng, rec)
+        new = eng.workloads[rec.job_to_workload[dep.key]]
+        assert new.is_admitted and new.pod_sets[0].count == 3
+
+        reg = JobWebhookRegistry(make_engine())
+        bad = DeploymentJob(name="d", queue_name="lq", replicas=-1)
+        assert any("replicas" in e for e in reg.admit_create(bad))
+        old = DeploymentJob(name="d", queue_name="lq", replicas=2,
+                            requests={"cpu": 100})
+        old.suspended = False
+        new2 = DeploymentJob(name="d", queue_name="lq", replicas=2,
+                             requests={"cpu": 500})
+        new2.suspended = False
+        assert any("immutable" in e for e in reg.admit_update(old, new2))
+        scaled = DeploymentJob(name="d", queue_name="lq", replicas=9,
+                               requests={"cpu": 100})
+        scaled.suspended = False
+        assert reg.admit_update(old, scaled) == []
+
+
+class TestMPIJobDepth:
+    def test_webhook_rules(self):
+        from kueue_tpu.controllers.integrations import MPIJob
+        reg = JobWebhookRegistry(make_engine())
+        bad_slots = MPIJob(name="m", queue_name="lq", slots_per_worker=0,
+                           worker_requests={"cpu": 100})
+        assert any("slotsPerWorker" in e
+                   for e in reg.admit_create(bad_slots))
+        bad_replicas = MPIJob(name="m", queue_name="lq",
+                              worker_replicas=-1)
+        assert any("non-negative" in e
+                   for e in reg.admit_create(bad_replicas))
+        bad_launcher = MPIJob(name="m", queue_name="lq",
+                              run_launcher_as_worker=True,
+                              worker_replicas=0)
+        assert any("runLauncherAsWorker" in e
+                   for e in reg.admit_create(bad_launcher))
+        ok = MPIJob(name="m", queue_name="lq",
+                    launcher_requests={"cpu": 100},
+                    worker_replicas=2, worker_requests={"cpu": 500})
+        assert reg.admit_create(ok) == []
+
+    def test_launcher_and_workers_admit(self):
+        from kueue_tpu.controllers.integrations import MPIJob
+        eng = make_engine()
+        rec = JobReconciler(eng)
+        mpi = MPIJob(name="m", queue_name="lq",
+                     launcher_requests={"cpu": 100},
+                     worker_replicas=4, worker_requests={"cpu": 1000})
+        rec.create_job(mpi)
+        pump(eng, rec)
+        wl = eng.workloads[rec.job_to_workload[mpi.key]]
+        assert wl.is_admitted
+        by_name = {psa.name: psa.count
+                   for psa in wl.status.admission.pod_set_assignments}
+        assert by_name == {"launcher": 1, "worker": 4}
+
+
 class TestRayClusterDepth:
     def test_autoscaling_requires_elastic_gate(self):
         reg = JobWebhookRegistry(make_engine())
