@@ -544,13 +544,12 @@ def bench_tas(n_workloads, n_cqs=8):
 
 def bench_tas_large(n_workloads=120, blocks=8, racks=16, hosts=40,
                     n_cqs=8):
-    """Pod-slice-scale TAS (round-3 verdict #6b): a topology with
-    blocks*racks*hosts >= 4096 leaf domains — the regime where the
-    device placement kernel (ops/tas.tas_place) engages
-    (tas_path="device") and beats the host descent. The detail carries
-    the same per-placement probe as the 640-node scenario so the
-    device-vs-host comparison is measured on THIS forest, not
-    asserted."""
+    """Pod-slice-scale TAS: a topology with blocks*racks*hosts >= 4096
+    leaf domains. The detail carries the same per-placement probe as
+    the 640-node scenario (host descent vs one ops/tas.tas_place launch
+    on THIS forest) — measured, the per-placement launch never wins, so
+    the drain runs the host path and the device TAS regime is the
+    batched feasibility scenario (tas_churn)."""
     import random
 
     from kueue_tpu.api.types import (
@@ -635,6 +634,11 @@ def bench_tas_large(n_workloads=120, blocks=8, racks=16, hosts=40,
         "detail": {"workloads": n_workloads, "nodes": n_leaves,
                    "admitted": admitted,
                    "elapsed_s": round(elapsed, 3),
+                   # vs_baseline divides by the reference rate measured
+                   # on ITS 640-node config; this world is 8x larger
+                   # per placement (the 640-node "tas" scenario is the
+                   # apples-to-apples comparison).
+                   "baseline_nodes": 640,
                    "tas_path": path,
                    "device_crossover_domains": DEVICE_TAS_MIN_DOMAINS,
                    **xover,

@@ -148,26 +148,29 @@ class ApplyEngine:
         if wl is None:
             raise KeyError(f"workload {cfg.key} not found")
         declared = cfg.declared()
-        self._check_and_own(
-            f"workload/{cfg.key}", declared, field_manager, force,
-            lambda p: self._get_path(wl, p))
         rekey = any(path in ("queue_name", "priority")
                     and self._get_path(wl, path) != value
                     for path, value in declared.items())
+        # Validate BEFORE ownership is recorded: a failed apply must not
+        # grant the manager the field (SSA records managedFields only on
+        # success). The target-queue check applies only to actual queue
+        # MOVES — a priority-only rekey must keep working even when the
+        # workload's current LocalQueue has been deleted.
+        if rekey and not wl.is_admitted and "queue_name" in declared:
+            new_q = declared["queue_name"]
+            if self._engine.queues.local_queues.get(
+                    f"{wl.namespace}/{new_q}") is None:
+                raise KeyError(
+                    f"LocalQueue {wl.namespace}/{new_q} not found")
+        self._check_and_own(
+            f"workload/{cfg.key}", declared, field_manager, force,
+            lambda p: self._get_path(wl, p))
         if rekey and not wl.is_admitted:
             # Queue moves AND priority changes re-route the pending
             # entry through the manager (queue_controller's
             # UpdateWorkload path) so the heap key and tensor row are
             # recomputed; mutating in place would leave the workload
-            # competing at its old key. A move to a missing/held queue
-            # must not strand the workload: validate the target BEFORE
-            # removing from the current heap.
-            new_q = declared.get("queue_name", wl.queue_name)
-            target = self._engine.queues.local_queues.get(
-                f"{wl.namespace}/{new_q}")
-            if target is None:
-                raise KeyError(
-                    f"LocalQueue {wl.namespace}/{new_q} not found")
+            # competing at its old key.
             self._engine.queues.delete_workload(wl)
         for path, value in declared.items():
             self._set_path(wl, path, value)
@@ -213,18 +216,22 @@ class ApplyEngine:
             # (stop/stop_localqueue.go): Hold retracts the LQ's pending
             # workloads, HoldAndDrain also evicts reserved ones, None
             # re-queues — a bare field write would only gate future
-            # submissions.
+            # submissions. Unknown values are rejected like the CRD
+            # enum would, NOT treated as a resume.
             from kueue_tpu.api.types import StopPolicy
             from kueue_tpu.cli.kueuectl import Kueuectl
 
             ctl = Kueuectl(self._engine)
-            if new_policy in (StopPolicy.HOLD, "Hold"):
+            if new_policy == StopPolicy.HOLD:
                 ctl.stop_local_queue(cfg.key, drain=False)
-            elif new_policy in (StopPolicy.HOLD_AND_DRAIN,
-                                "HoldAndDrain"):
+            elif new_policy == StopPolicy.HOLD_AND_DRAIN:
                 ctl.stop_local_queue(cfg.key, drain=True)
-            else:
+            elif new_policy == StopPolicy.NONE:
                 ctl.resume_local_queue(cfg.key)
+            else:
+                raise ValueError(
+                    f"invalid stopPolicy {new_policy!r}: must be one "
+                    f"of None, Hold, HoldAndDrain")
         return lq
 
     def field_owners(self, kind: str, key: str) -> dict[str, str]:

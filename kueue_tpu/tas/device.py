@@ -32,16 +32,24 @@ _VRANK_PAD = 1 << 40
 
 # Crossover for offload: per-placement device dispatch costs ~1-10ms
 # whatever the problem size, while the host descent scales with the
-# domain count — measured on the 640-node reference topology the host
-# path is ~2x faster. Offload only when the leaf level is big enough
-# for the batched kernel to amortize the launch.
-DEVICE_TAS_MIN_DOMAINS = 4096
+# domain count. Measured per-placement (bench.py tas/tas_large probes,
+# both the CPU backend and the round-3 TPU capture), the numpy host
+# phase-1 + descent beats a per-call device launch at every forest size
+# tried (640: host 1.4ms vs device 2.9ms on TPU; 5120: host ~2ms vs
+# device ~8ms on CPU) — the launch+readback overhead never amortizes
+# for a SINGLE placement. The device TAS win is the BATCHED feasibility
+# kernel (tas/feasibility.py: one launch deciding every pending head),
+# so per-placement offload is default-off; KUEUE_TPU_DEVICE_TAS_MIN
+# re-enables it (0 = always, used by the differential suites and for
+# forests beyond anything measured).
+DEVICE_TAS_MIN_DOMAINS = 1 << 30
 
 
 def worth_offloading(snap) -> bool:
-    """True when the forest is large enough that the device placement
-    beats per-call dispatch overhead (KUEUE_TPU_DEVICE_TAS_MIN
-    overrides; 0 = always offload, for the differential suites)."""
+    """True when per-placement device offload is enabled for this forest
+    size (KUEUE_TPU_DEVICE_TAS_MIN overrides; 0 = always offload, for
+    the differential suites; default threshold is effectively off — see
+    DEVICE_TAS_MIN_DOMAINS)."""
     import os
 
     try:

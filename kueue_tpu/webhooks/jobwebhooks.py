@@ -206,10 +206,13 @@ class SparkApplicationWebhook(JobWebhook):
 
 
 @dataclass
-class StatefulSetWebhook(JobWebhook):
-    """jobs/statefulset/statefulset_webhook.go."""
+class ServingScaleWebhook(JobWebhook):
+    """Shared rules for serving-scale kinds (StatefulSet/Deployment):
+    replicas bounds on create; scale is the ONLY mutable shape field
+    while running — the per-kind webhooks reject pod-template mutation
+    of a managed set (statefulset_webhook.go, deployment_webhook.go)."""
 
-    kind: str = "apps/statefulset"
+    display: str = "workload"
 
     def extra_create_rules(self, job) -> list[str]:
         if getattr(job, "replicas", 1) < 0:
@@ -218,38 +221,28 @@ class StatefulSetWebhook(JobWebhook):
 
     def validate_update(self, old, new) -> list[str]:
         errs = super().validate_update(old, new)
-        # Scale is the ONLY mutable shape field while running; request
-        # shape changes need a fresh object (the sts webhook rejects
-        # pod-template mutation of a managed set).
         if (getattr(old, "requests", None) != getattr(new, "requests",
                                                       None)
                 and not old.is_suspended()):
-            errs.append("pod template resources are immutable while the "
-                        "StatefulSet is managed and running")
+            errs.append(f"pod template resources are immutable while "
+                        f"the {self.display} is managed and running")
         return errs
 
 
 @dataclass
-class DeploymentWebhook(JobWebhook):
-    """jobs/deployment/deployment_webhook.go: replicas bounds + pod
-    template immutability while managed (scale alone is allowed, same
-    rule as the StatefulSet webhook)."""
+class StatefulSetWebhook(ServingScaleWebhook):
+    """jobs/statefulset/statefulset_webhook.go."""
+
+    kind: str = "apps/statefulset"
+    display: str = "StatefulSet"
+
+
+@dataclass
+class DeploymentWebhook(ServingScaleWebhook):
+    """jobs/deployment/deployment_webhook.go."""
 
     kind: str = "apps/deployment"
-
-    def extra_create_rules(self, job) -> list[str]:
-        if getattr(job, "replicas", 1) < 0:
-            return ["replicas must be non-negative"]
-        return []
-
-    def validate_update(self, old, new) -> list[str]:
-        errs = super().validate_update(old, new)
-        if (getattr(old, "requests", None) != getattr(new, "requests",
-                                                      None)
-                and not old.is_suspended()):
-            errs.append("pod template resources are immutable while the "
-                        "Deployment is managed and running")
-        return errs
+    display: str = "Deployment"
 
 
 @dataclass

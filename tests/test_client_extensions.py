@@ -290,6 +290,48 @@ class TestApplyConfigurations:
         eng.schedule_once()
         assert eng.workloads["default/w"].is_admitted
 
+    def test_failed_apply_grants_no_ownership(self):
+        eng = make_engine()
+        eng.submit(Workload(name="w", queue_name="lq-a",
+                            pod_sets=(PodSet("m", 1, {"cpu": 100}),)))
+        ae = ApplyEngine(eng)
+        with pytest.raises(KeyError):
+            ae.apply_workload(WorkloadApply("default", "w")
+                              .with_queue_name("nope"),
+                              field_manager="alpha")
+        assert "queue_name" not in ae.field_owners("workload",
+                                                   "default/w")
+        # Another manager's valid move is NOT a conflict.
+        ae.apply_workload(WorkloadApply("default", "w")
+                          .with_queue_name("lq-b"), field_manager="beta")
+
+    def test_priority_apply_survives_deleted_queue(self):
+        eng = make_engine()
+        eng.submit(Workload(name="w", queue_name="lq-a",
+                            pod_sets=(PodSet("m", 1, {"cpu": 100}),)))
+        eng.queues.delete_local_queue("default/lq-a")
+        ApplyEngine(eng).apply_workload(
+            WorkloadApply("default", "w").with_priority(7),
+            field_manager="m")
+        assert eng.workloads["default/w"].priority == 7
+
+    def test_invalid_stop_policy_rejected_not_resumed(self):
+        from kueue_tpu.api.types import StopPolicy
+        from kueue_tpu.client.applyconfigurations import LocalQueueApply
+        eng = make_engine()
+        eng.submit(Workload(name="w", queue_name="lq-a",
+                            pod_sets=(PodSet("m", 1, {"cpu": 100}),)))
+        ae = ApplyEngine(eng)
+        ae.apply_local_queue(LocalQueueApply("default", "lq-a")
+                             .with_stop_policy(StopPolicy.HOLD),
+                             field_manager="m")
+        with pytest.raises(ValueError):
+            ae.apply_local_queue(LocalQueueApply("default", "lq-a")
+                                 .with_stop_policy("Drain"),
+                                 field_manager="m", force=True)
+        eng.schedule_once()  # still held
+        assert not eng.workloads["default/w"].is_admitted
+
     def test_stop_policy_apply_retracts_pending(self):
         from kueue_tpu.api.types import StopPolicy
         from kueue_tpu.client.applyconfigurations import LocalQueueApply
