@@ -813,6 +813,24 @@ def _tas_crossover_measure(build, n_probe: int = 5) -> dict:
     return out
 
 
+def _machine_cache_dir() -> str:
+    import hashlib
+    import platform as _platform
+
+    fp = _platform.machine()
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    fp += hashlib.sha256(
+                        line.encode()).hexdigest()[:10]
+                    break
+    except OSError:
+        pass
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".jax_cache", fp)
+
+
 def main() -> None:
     platform = os.environ.get("KUEUE_TPU_BENCH_PLATFORM")
     if platform is None:
@@ -826,11 +844,14 @@ def main() -> None:
     jax.config.update("jax_enable_x64", True)
     try:
         # Persistent compile cache: repeated bench runs (and rounds)
-        # skip XLA compilation entirely.
+        # skip XLA compilation entirely. The directory is fingerprinted
+        # per host CPU: XLA:CPU AOT entries embed the COMPILING
+        # machine's feature set, and loading them on a host with
+        # different features can SIGILL the whole process (observed
+        # across this repo's build/bench machines) — a poisoned shared
+        # cache must never be able to kill a bench run.
         jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         ".jax_cache"))
+            "jax_compilation_cache_dir", _machine_cache_dir())
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:
         pass

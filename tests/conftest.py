@@ -25,8 +25,29 @@ jax.config.update("jax_enable_x64", True)
 
 # Persistent compilation cache: the suite jits hundreds of device programs
 # whose shapes repeat across runs; caching them makes re-runs much faster.
+def _machine_fingerprint() -> str:
+    # XLA:CPU AOT cache entries embed the compiling host's CPU feature
+    # set; loading them on a different host can SIGILL. Key the cache
+    # per machine so shared checkouts can't poison each other.
+    import hashlib
+    import platform as _platform
+
+    fp = _platform.machine()
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    fp += hashlib.sha256(line.encode()).hexdigest()[:10]
+                    break
+    except OSError:
+        pass
+    return fp
+
+
 _cache_dir = os.environ.get(
-    "KUEUE_TPU_JAX_CACHE", os.path.expanduser("~/.cache/kueue_tpu_jax"))
+    "KUEUE_TPU_JAX_CACHE",
+    os.path.join(os.path.expanduser("~/.cache/kueue_tpu_jax"),
+                 _machine_fingerprint()))
 os.makedirs(_cache_dir, exist_ok=True)
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
