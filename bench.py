@@ -887,7 +887,9 @@ def main() -> None:
     run_scenario("cycle_latency", lambda: bench_cycle_latency(
         scen, n_cycles=3 if fast else 8), min_budget_s=90.0)
     run_scenario("hier_fair",
-                 lambda: bench_hier_fair(500 if fast else 20_000))
+                 # 40k keeps the measured span >=0.5s of real work at
+                 # the current admission rate (round-3 verdict weak #6).
+                 lambda: bench_hier_fair(500 if fast else 40_000))
     run_scenario("fair_cycle_latency", lambda: bench_fair_cycle_latency(
         n_workloads=500 if fast else 20_000,
         n_cycles=3 if fast else 6), min_budget_s=90.0)
