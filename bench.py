@@ -795,11 +795,14 @@ def _tas_crossover_measure(build, n_probe: int = 5) -> dict:
                            ("device_place_ms", "0")):
             os.environ["KUEUE_TPU_DEVICE_TAS_MIN"] = env
             try:
+                # One fork outside the timed loop (the serving path no
+                # longer forks per placement); clear the result memo per
+                # iteration so every probe runs the real placement.
                 fork = snap.fork()
                 fork.find_topology_assignments(req)  # warm/compile
                 t0 = time.perf_counter()
                 for _ in range(n_probe):
-                    fork = snap.fork()
+                    fork._place_memo = None
                     fork.find_topology_assignments(req)
                 out[label] = round(
                     (time.perf_counter() - t0) / n_probe * 1000, 2)

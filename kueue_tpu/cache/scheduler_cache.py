@@ -170,6 +170,15 @@ class Cache:
                             non_tas_usage=self.non_tas_usage.node_usage(
                                 node.name))
                 protos[rf.name] = snap
+            # Prototypes carry the LIVE admitted usage from birth;
+            # _account_tas/_unaccount write commits through from here on
+            # (snapshots share the forest under an undo scope instead of
+            # forking it — tas/snapshot.py begin_cycle).
+            for name, proto in protos.items():
+                for values, totals in self.tas_usage_agg.get(name,
+                                                             {}).items():
+                    if any(totals.values()):
+                        proto.install_usage(values, totals)
             self._tas_protos = protos
         return self._tas_protos
 
@@ -203,6 +212,7 @@ class Cache:
         self._wl_tas[key] = tas
 
     def _account_tas(self, tas) -> None:
+        protos = self._tas_protos
         for flavor, values, single, count in tas:
             by_values = self.tas_usage_agg.setdefault(flavor, {})
             totals = by_values.setdefault(values, {})
@@ -210,6 +220,13 @@ class Cache:
                 totals[res] = totals.get(res, 0) + per_pod * count
             # Pod slots (tas_flavor_snapshot.go:321).
             totals["pods"] = totals.get("pods", 0) + count
+            if protos is not None:
+                proto = protos.get(flavor)
+                if proto is not None:
+                    deltas = {res: per_pod * count
+                              for res, per_pod in single.items()}
+                    deltas["pods"] = deltas.get("pods", 0) + count
+                    proto.commit_usage(values, deltas)
 
     def _unaccount(self, key: str) -> None:
         entry = self._wl_usage.pop(key, None)
@@ -225,6 +242,7 @@ class Cache:
             wls = self.cq_workloads.get(cq_name)
             if wls is not None:
                 wls.pop(key, None)
+        protos = self._tas_protos
         for flavor, values, single, count in self._wl_tas.pop(key, ()):
             totals = self.tas_usage_agg.get(flavor, {}).get(values)
             if totals is None:
@@ -240,11 +258,22 @@ class Cache:
                 totals["pods"] = left
             else:
                 totals.pop("pods", None)
+            if protos is not None:
+                proto = protos.get(flavor)
+                if proto is not None:
+                    deltas = {res: -per_pod * count
+                              for res, per_pod in single.items()}
+                    deltas["pods"] = deltas.get("pods", 0) - count
+                    proto.commit_usage(values, deltas)
 
     def rebuild_accounting(self) -> None:
         """Recompute the incremental aggregates from the workload
         registry — the recovery path after flavor/topology registry
         changes reclassify which flavors are TAS."""
+        # Live prototypes carry the old aggregates — drop them so the
+        # rebuild's _account write-throughs can't double-install (the
+        # next tas_prototypes() call re-installs the fresh aggregates).
+        self._invalidate_tas_prototypes()
         self.cq_usage = {}
         self.cq_workloads = {}
         self.tas_usage_agg = {}

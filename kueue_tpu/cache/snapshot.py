@@ -381,6 +381,20 @@ class Snapshot:
     def cluster_queue(self, name: str) -> Optional[ClusterQueueSnapshot]:
         return self.cluster_queues.get(name)
 
+    def close(self) -> None:
+        """End the TAS undo scopes opened by build_snapshot over live
+        prototypes, reverting in-cycle usage mutations. Idempotent;
+        no-op for from-scratch TAS forests (their scopes were never
+        opened, and their mutations die with this object)."""
+        seen = set()
+        for tas in self.tas_flavors.values():
+            if id(tas) in seen:
+                continue
+            seen.add(id(tas))
+            end = getattr(tas, "end_cycle", None)
+            if end is not None:
+                end()
+
     # -- workload add/remove (snapshot.go AddWorkload/RemoveWorkload) --
 
     def add_workload(self, info: WorkloadInfo) -> None:
@@ -439,12 +453,15 @@ def build_snapshot(
     snap.inactive_cluster_queues = set(inactive_cluster_queues or ())
 
     # TAS flavor snapshots (tas_cache.go): one per flavor with a topology,
-    # fed by the nodes matching the flavor's nodeLabels. With cached
-    # prototypes (Cache.tas_prototypes) the per-snapshot cost is a forest
-    # fork instead of O(nodes) re-parsing.
+    # fed by the nodes matching the flavor's nodeLabels. Cached
+    # prototypes (Cache.tas_prototypes) carry the LIVE admitted usage
+    # and are shared zero-copy: the snapshot opens an undo scope on each
+    # (begin_cycle) so in-cycle mutations revert at Snapshot.close() —
+    # O(touched leaves) instead of the O(forest) fork of round 4.
     if tas_prototypes is not None:
         for name, proto in tas_prototypes.items():
-            snap.tas_flavors[name] = proto.fork()
+            proto.begin_cycle()
+            snap.tas_flavors[name] = proto
     elif topologies:
         from kueue_tpu.tas.snapshot import TASFlavorSnapshot
         topo_by_name = {t.name: t for t in topologies}
@@ -510,7 +527,10 @@ def build_snapshot(
             wls = cq_workloads.get(name)
             if wls:
                 cqs.workloads = dict(wls)
-    if tas_usage_agg is not None:
+    # Live prototypes already carry the admitted usage (installed at
+    # prototype build + written through on every cache commit); the
+    # install loop only feeds from-scratch forests.
+    if tas_usage_agg is not None and tas_prototypes is None:
         for flavor, by_values in tas_usage_agg.items():
             tas = snap.tas_flavors.get(flavor)
             if tas is None:

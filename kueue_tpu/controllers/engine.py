@@ -416,16 +416,20 @@ class Engine:
                     psa.count))
             reason = ""
             patches: dict[str, object] = {}
-            for flavor in sorted(by_flavor):
-                # One grouped call per flavor: the replacement path threads
-                # a shared assumed-usage dict across the workload's pod
-                # sets so two replacements can't double-book one free slot.
-                results, reason = snapshot.tas_flavors[flavor] \
-                    .find_topology_assignments_for_flavor(
-                        by_flavor[flavor], workload=wl)
-                if reason:
-                    break
-                patches.update(results)
+            try:
+                for flavor in sorted(by_flavor):
+                    # One grouped call per flavor: the replacement path
+                    # threads a shared assumed-usage dict across the
+                    # workload's pod sets so two replacements can't
+                    # double-book one free slot.
+                    results, reason = snapshot.tas_flavors[flavor] \
+                        .find_topology_assignments_for_flavor(
+                            by_flavor[flavor], workload=wl)
+                    if reason:
+                        break
+                    patches.update(results)
+            finally:
+                snapshot.close()
             if reason:
                 if features.enabled("TASFailedNodeReplacementFailFast"):
                     # Clear before evicting so the journaled eviction
@@ -783,8 +787,14 @@ class Engine:
         snapshot = self.cache.snapshot()
         t_snap = _time.perf_counter()
         already = set(self.cache.workloads)
-        result = self.cycle.schedule(heads, snapshot, now=self.clock,
-                                     already_admitted=already)
+        try:
+            result = self.cycle.schedule(heads, snapshot, now=self.clock,
+                                         already_admitted=already)
+        finally:
+            # Revert the cycle's in-place TAS mutations on the shared
+            # live forests BEFORE the apply loop commits the assumed
+            # entries through the cache (tas/snapshot.py begin_cycle).
+            snapshot.close()
         t_decide = _time.perf_counter()
         deferred: set = set()
         self._deferred_cohort_requeue = deferred

@@ -8,13 +8,17 @@ flavor as Fit / NoCandidates / NoFit with a borrowing level, and fold with
 the FlavorFungibility preference lattice (flavorassigner.go:483
 isPreferred, :1127 shouldTryNextFlavor).
 
-Fast-path scope (round 1): single-podset workloads, no taint/affinity
-filtering (worlds using those route through the host path), preemption
-candidate search not simulated on device — workloads whose CQ has a
-non-Never preemption policy and that need preemption are flagged
-``needs_oracle`` and fall back to the sequential preemptor. For CQs with
-all-Never policies the kernel computes the exact NoCandidates outcome the
-sequential path produces (preemption_oracle.go:58).
+Scope: multi-podset workloads are first-class — requests are
+``int64[W, P, S]`` and the flavor scan accumulates assumed usage across
+a workload's pod sets exactly like the sequential walk
+(flavorassigner.go:1015,1213; see ``wl_req`` below). Still host-routed:
+taint/affinity filtering (worlds using those demote the root), and the
+preemption candidate SEARCH — workloads whose CQ has a non-Never
+preemption policy and that need preemption are flagged ``needs_oracle``
+for the device preemptor (ops/preempt.py) or the sequential fallback.
+For CQs with all-Never policies the kernel computes the exact
+NoCandidates outcome the sequential path produces
+(preemption_oracle.go:58).
 
 Mode encoding matches scheduler/flavorassigner.PMode:
   0=NO_FIT, 1=NO_CANDIDATES, 2/3=preempt/reclaim (host only), 4=FIT.

@@ -125,15 +125,26 @@ def collect_requests(wl, cq_snapshot):
 def precompute(heads, snapshot) -> None:
     """Run one feasibility launch per flavor forest for the cycle's
     pending heads and park the verdicts on each snap
-    (``_feas`` / ``_feas_removals``). Small batches aren't worth a
-    dispatch: below ``KUEUE_TPU_TAS_FEAS_MIN`` (default 4) qualifying
-    head requests the snap keeps no verdicts and every entry takes the
-    sequential path. The threshold counts request INSTANCES, not
-    distinct signatures — a churn steady state retries many homogeneous
-    heads, and the savings scale with the retries."""
+    (``_feas`` / ``_feas_removals``). Two gates keep the dispatch from
+    costing more than it saves: below ``KUEUE_TPU_TAS_FEAS_MIN``
+    (default 12) qualifying head requests the batch can't amortize the
+    launch, and below ``KUEUE_TPU_TAS_FEAS_MIN_LEAVES`` (default 2048)
+    leaves the numpy host phase-1 per head is cheaper than the launch.
+    Cost model (measured on the bench worlds, CPU backend): one launch
+    at 5,120 leaves costs ~11 ms (kernel + transfers + marshalling)
+    and saves ~1.3 ms per head it short-circuits, so it needs roughly
+    ten rejected heads to break even — a churn steady state (30
+    homogeneous retried heads/cycle) clears that 3x; a draining world
+    (8 CQ heads, most of which fit and run the real placement anyway)
+    never does, and at 640 leaves the host descent is so cheap the
+    launch can never win (the round-4 640-node regression). The
+    instance threshold counts request INSTANCES, not distinct
+    signatures, because the savings scale with the retries."""
     if not enabled():
         return
-    min_batch = int(os.environ.get("KUEUE_TPU_TAS_FEAS_MIN", "4"))
+    min_batch = int(os.environ.get("KUEUE_TPU_TAS_FEAS_MIN", "12"))
+    min_leaves = int(os.environ.get("KUEUE_TPU_TAS_FEAS_MIN_LEAVES",
+                                    "2048"))
     by_snap: dict[int, tuple[object, dict, list[int]]] = {}
     for w in heads:
         cqs = snapshot.cluster_queue(w.cluster_queue)
@@ -141,6 +152,8 @@ def precompute(heads, snapshot) -> None:
             continue
         for snap, sig, ps, single, count, params in \
                 collect_requests(w, cqs):
+            if len(snap.leaves) < min_leaves:
+                continue
             _, reqs, n = by_snap.setdefault(id(snap), (snap, {}, [0]))
             reqs.setdefault(sig, (single, count, params))
             n[0] += 1

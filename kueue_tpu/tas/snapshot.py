@@ -203,16 +203,94 @@ class TASFlavorSnapshot:
         # change shape.
         self._version = 0
 
+    # -- per-cycle undo scope (the zero-copy snapshot share) --
+    #
+    # Round 4 forked the whole forest per scheduling cycle (fork(), ~6 ms
+    # at 640 leaves / ~16 ms at 5,120) and re-installed every live usage
+    # aggregate on the copy. Round 5 replaces that with the reference's
+    # own revert-closure pattern (snapshot.go:77 SimulateWorkloadRemoval):
+    # the live prototype carries the admitted usage, a cycle opens an
+    # undo scope, every in-cycle mutation logs its DELTA, and closing the
+    # scope reverts in O(touched leaves). Cache write-through commits
+    # (admissions applied after the cycle) bypass the log via
+    # commit_usage().
+
+    def begin_cycle(self) -> None:
+        """Open an undo scope. A dangling scope (a reader snapshot that
+        never closed) is force-closed first — its log is empty, so the
+        force-close is free and self-healing."""
+        if getattr(self, "_txn", None) is not None:
+            self.end_cycle()
+        self._txn = []
+        self._txn_dirty = False
+        self._txn_base_version = getattr(self, "_usage_version", 0)
+        self._txn_base_removals = getattr(self, "_usage_removals", 0)
+
+    def end_cycle(self) -> None:
+        """Revert the scope's delta log (reverse order) and restore the
+        usage-version bookkeeping so pre-cycle encodes stay valid. If a
+        commit interleaved (``_txn_dirty``), versions move forward
+        instead and the matrix caches are dropped — going backward would
+        alias a stale cache entry onto restored-but-different state."""
+        txn = getattr(self, "_txn", None)
+        if txn is None:
+            return
+        for leaf, deltas in reversed(txn):
+            usage = leaf.tas_usage
+            for res, d in deltas.items():
+                left = usage.get(res, 0) - d
+                if left:
+                    usage[res] = left
+                else:
+                    usage.pop(res, None)
+        if self._txn_dirty:
+            self._usage_version = getattr(self, "_usage_version", 0) + 1
+            self._usage_removals = getattr(self, "_usage_removals", 0) + 1
+            self._usage_matrix_cache = None
+            self._j_usage_cache = None
+        elif txn:
+            base = self._txn_base_version
+            mc = getattr(self, "_usage_matrix_cache", None)
+            if mc is not None and mc[0][0] != base:
+                self._usage_matrix_cache = None
+            jc = getattr(self, "_j_usage_cache", None)
+            if jc is not None and jc[0][0] != base:
+                self._j_usage_cache = None
+            self._usage_version = base
+            self._usage_removals = self._txn_base_removals
+        self._txn = None
+        self._feas = None
+        self._place_memo = None
+
+    def commit_usage(self, values: tuple, deltas: dict[str, int]) -> None:
+        """Write-through from the live cache's admitted-side accounting
+        (scheduler_cache._account_tas): NOT delta-logged, so the change
+        survives end_cycle(). ``deltas`` are pre-aggregated (pod slots
+        included), negative for removals."""
+        leaf = self.leaves.get(tuple(values))
+        if leaf is None:
+            return
+        self._usage_version = getattr(self, "_usage_version", 0) + 1
+        if any(v < 0 for v in deltas.values()):
+            self._usage_removals = getattr(self, "_usage_removals", 0) + 1
+        if getattr(self, "_txn", None) is not None:
+            self._txn_dirty = True
+        self._touch_used(leaf)
+        usage = leaf.tas_usage
+        for res, d in deltas.items():
+            left = usage.get(res, 0) + d
+            if left:
+                usage[res] = left
+            else:
+                usage.pop(res, None)
+
     # -- construction (tas_flavor.go / tas_nodes_cache.go) --
 
     def fork(self) -> "TASFlavorSnapshot":
-        """Cheap per-cycle copy of a cached forest prototype: the domain
-        structure and free capacities are shared (immutable within a
-        snapshot's lifetime), while ``tas_usage`` and the phase states —
-        the only per-cycle mutables — are fresh. This is the analog of
-        the reference's cached TAS snapshot (tas_cache.go holds the node
-        forest; snapshots overlay usage), and it turns the
-        640-node-per-snapshot rebuild into an O(domains) pointer walk."""
+        """Full per-call copy of the forest (structure shared, usage
+        copied): used by what-if probes that outlive a cycle scope
+        (bench crossover measurement, tests). The serving path no longer
+        forks per cycle — see begin_cycle()."""
         new = TASFlavorSnapshot.__new__(TASFlavorSnapshot)
         new.topology_name = self.topology_name
         new.level_keys = self.level_keys
@@ -240,7 +318,7 @@ class TASFlavorSnapshot:
                 c.slice_state_with_leader = 0
                 c.leader_state = 0
                 c.free_capacity = d.free_capacity  # shared, read-only
-                c.tas_usage = {}
+                c.tas_usage = dict(d.tas_usage) if d.tas_usage else {}
                 c.node_name = d.node_name
                 c.children = []
                 parent = d.parent
@@ -255,6 +333,10 @@ class TASFlavorSnapshot:
                     new.leaves[values] = c
         for values in self.roots:
             new.roots[values] = domains[values]
+        used = getattr(self, "_used_leaves", None)
+        if used:
+            new._used_leaves = set(used)
+        new._usage_version = getattr(self, "_usage_version", 0)
         # The device encoding (tas/device.py _structure) can remap its
         # cached arrays through the prototype instead of re-deriving.
         new._struct_donor = self
@@ -317,33 +399,41 @@ class TASFlavorSnapshot:
             used = self._used_leaves = set()
         used.add(leaf.values)
 
+    def _apply_deltas(self, leaf, deltas: dict[str, int]) -> None:
+        """Apply a usage delta to one leaf, logging it for revert when a
+        cycle's undo scope is open (begin_cycle)."""
+        self._usage_version = getattr(self, "_usage_version", 0) + 1
+        self._touch_used(leaf)
+        txn = getattr(self, "_txn", None)
+        if txn is not None:
+            txn.append((leaf, deltas))
+        usage = leaf.tas_usage
+        for res, d in deltas.items():
+            usage[res] = usage.get(res, 0) + d
+
     def add_usage(self, values: tuple, requests: dict[str, int],
                   count: int) -> None:
         leaf = self.leaves.get(tuple(values))
         if leaf is None:
             return
-        self._usage_version = getattr(self, "_usage_version", 0) + 1
-        self._touch_used(leaf)
-        for res, per_pod in requests.items():
-            leaf.tas_usage[res] = leaf.tas_usage.get(res, 0) + per_pod * count
+        deltas = {res: per_pod * count for res, per_pod in requests.items()}
         # Each placed pod occupies a pod slot regardless of its resource
         # requests (tas_flavor_snapshot.go:321 updateTASUsage adds
         # ResourcePods: count on top of the scaled requests).
-        leaf.tas_usage["pods"] = leaf.tas_usage.get("pods", 0) + count
+        deltas["pods"] = deltas.get("pods", 0) + count
+        self._apply_deltas(leaf, deltas)
 
     def remove_usage(self, values: tuple, requests: dict[str, int],
                      count: int) -> None:
         leaf = self.leaves.get(tuple(values))
         if leaf is None:
             return
-        self._usage_version = getattr(self, "_usage_version", 0) + 1
         # Removals can make stale "doesn't fit" conclusions wrong; the
         # feasibility pre-pass keys its live-usage verdicts on this.
         self._usage_removals = getattr(self, "_usage_removals", 0) + 1
-        self._touch_used(leaf)
-        for res, per_pod in requests.items():
-            leaf.tas_usage[res] = leaf.tas_usage.get(res, 0) - per_pod * count
-        leaf.tas_usage["pods"] = leaf.tas_usage.get("pods", 0) - count
+        deltas = {res: -per_pod * count for res, per_pod in requests.items()}
+        deltas["pods"] = deltas.get("pods", 0) - count
+        self._apply_deltas(leaf, deltas)
 
     def install_usage(self, values: tuple, usage: dict[str, int]) -> None:
         """Add PRE-AGGREGATED usage (already scaled by pod counts, pods
@@ -352,11 +442,7 @@ class TASFlavorSnapshot:
         leaf = self.leaves.get(tuple(values))
         if leaf is None:
             return
-        self._usage_version = getattr(self, "_usage_version", 0) + 1
-        self._touch_used(leaf)
-        for res, v in usage.items():
-            leaf.tas_usage[res] = leaf.tas_usage.get(res, 0) + v
-        leaf.tas_usage.setdefault("pods", 0)
+        self._apply_deltas(leaf, dict(usage))
 
     def fits(self, domain_requests) -> bool:
         """clusterqueue_snapshot.go:137 TAS part: every requested domain has
@@ -531,17 +617,48 @@ class TASFlavorSnapshot:
         host path is ~2x faster at the reference's 640-node scale);
         tas/device.py DEVICE_TAS_MIN_DOMAINS / KUEUE_TPU_DEVICE_TAS_MIN
         set the crossover."""
+        # Within-usage-version memo: an oversubscribed cycle retries
+        # many heads with identical (signature, selector) requests — the
+        # placement outcome is a pure function of (request, usage state),
+        # so repeats are dict hits instead of phase-1 + descent reruns.
+        # Only leaderless, ungrouped, unaccumulated calls qualify (the
+        # assumed-usage dict threads state between a workload's pod
+        # sets). Keyed on _usage_version: any usage mutation invalidates.
+        memo_key = None
+        if (leader is None and not assumed_usage
+                and not required_replacement_domain
+                and workers.previous_assignment is None):
+            from kueue_tpu.tas.feasibility import request_signature
+            ver = getattr(self, "_usage_version", 0)
+            memo = getattr(self, "_place_memo", None)
+            if memo is None or memo[0] != ver or len(memo[1]) > 4096:
+                memo = (ver, {})
+                self._place_memo = memo
+            memo_key = (
+                request_signature(workers.pod_set,
+                                  workers.single_pod_requests,
+                                  workers.count),
+                workers.pod_set.name, bool(simulate_empty),
+                tuple(sorted(workers.pod_set.node_selector.items())))
+            hit = memo[1].get(memo_key)
+            if hit is not None:
+                return hit
+        out = None
         if features.enabled("DeviceTAS"):
             from kueue_tpu.tas import device
             if device.worth_offloading(self):
                 out = device.try_find(
                     self, workers, leader, simulate_empty, assumed_usage,
                     required_replacement_domain)
-                if out is not NotImplemented:
-                    return out
-        return self.find_topology_assignments_host(
-            workers, leader, simulate_empty, assumed_usage,
-            required_replacement_domain)
+                if out is NotImplemented:
+                    out = None
+        if out is None:
+            out = self.find_topology_assignments_host(
+                workers, leader, simulate_empty, assumed_usage,
+                required_replacement_domain)
+        if memo_key is not None:
+            memo[1][memo_key] = out
+        return out
 
     def find_topology_assignments_host(
         self,

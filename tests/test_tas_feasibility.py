@@ -226,6 +226,11 @@ def build_engine(n_cqs=4, blocks=2, racks=4, hosts=5, n_wl=60, seed=3,
 
 def run_world(monkeypatch, feas_on, cycles=40, churn=10):
     monkeypatch.setenv("KUEUE_TPU_TAS_FEAS", "1" if feas_on else "0")
+    # The serving defaults only dispatch at pod-slice forest scale with
+    # enough heads to amortize (KUEUE_TPU_TAS_FEAS_MIN_LEAVES / _MIN);
+    # this 40-leaf, ~10-head world opts in so the pre-pass actually runs.
+    monkeypatch.setenv("KUEUE_TPU_TAS_FEAS_MIN_LEAVES", "0")
+    monkeypatch.setenv("KUEUE_TPU_TAS_FEAS_MIN", "2")
     eng = build_engine()
     for _ in range(cycles):
         if eng.schedule_once() is None:
@@ -266,6 +271,7 @@ class TestCycleParity:
         churn regime — guards against the wiring silently dying."""
         monkeypatch.setenv("KUEUE_TPU_TAS_FEAS", "1")
         monkeypatch.setenv("KUEUE_TPU_TAS_FEAS_MIN", "2")
+        monkeypatch.setenv("KUEUE_TPU_TAS_FEAS_MIN_LEAVES", "0")
         import kueue_tpu.tas.assigner as asg
         rejected = []
         orig = asg._precomputed_failure
