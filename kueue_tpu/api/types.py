@@ -302,12 +302,22 @@ class TopologyMode(str, Enum):
 
 @dataclass(frozen=True)
 class PodSetTopologyRequest:
-    """Reference: workload_types.go:165 (PodSetTopologyRequest)."""
+    """Reference: workload_types.go:165 (PodSetTopologyRequest).
 
-    mode: TopologyMode = TopologyMode.UNCONSTRAINED
+    ``mode=None`` encodes an empty request (no required/preferred/
+    unconstrained field set in the Go API); the dataclass default is the
+    implied-unconstrained form job adapters produce. ``slice_constraints``
+    is the multi-layer list (workload_types.go:248
+    PodsetSliceRequiredTopologyConstraints, max 3 layers, outermost
+    first); ``slice_level``/``slice_size`` remain the single-layer
+    legacy fields (util/tas/tas.go:116 normalizes both forms)."""
+
+    mode: Optional[TopologyMode] = TopologyMode.UNCONSTRAINED
     level: Optional[str] = None  # node label of required/preferred level
     slice_level: Optional[str] = None
     slice_size: Optional[int] = None
+    # ((topology_level_label, size), ...) — outermost layer first.
+    slice_constraints: tuple = ()
     pod_set_group_name: Optional[str] = None
     pod_index_label: Optional[str] = None  # rank label for the ungater
 
@@ -456,6 +466,9 @@ class Workload:
     allowed_resource_flavor: Optional[str] = None
     labels: dict[str, str] = field(default_factory=dict)
     annotations: dict[str, str] = field(default_factory=dict)
+    # ((api_version, kind, name, uid), ...) — metav1.OwnerReference
+    # essentials; drives workload.OwnedBySinglePod (workload.go:1309).
+    owner_references: tuple = ()
     uid: str = ""
     status: WorkloadStatus = field(default_factory=WorkloadStatus)
 

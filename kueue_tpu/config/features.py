@@ -33,6 +33,11 @@ _DEFAULTS: dict[str, bool] = {
     "TASFailedNodeReplacementFailFast": False,
     "TASRecomputeAssignmentWithinSchedulingCycle": False,
     "TASMultiLayerTopology": True,
+    # kube_features.go:541 (beta since 0.15, default on): unconstrained
+    # placements use the LeastFreeCapacity ordering; off = BestFit
+    # everywhere (the KEP#2724 profile matrix).
+    "TASProfileMixed": True,
+    "SkipReassignmentForPodOwnedWorkloads": True,
     # subsystems
     "MultiKueue": True,
     "MultiKueueOrchestratedPreemption": False,

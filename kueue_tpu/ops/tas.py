@@ -599,16 +599,18 @@ def encode_tas_snapshot(tas_snap, resources: list[str]):
 @partial(jax.jit, static_argnames=("num_levels", "max_domains",
                                    "pods_col"))
 def tas_feasibility(free, usage, per_pod, count, slice_size, slice_level,
-                    req_level, mode, valid, parent, has_pods_cap, *,
-                    num_levels, max_domains, pods_col):
+                    req_level, mode, leaf_mask, valid, parent,
+                    has_pods_cap, *, num_levels, max_domains, pods_col):
     """Exact batched fit verdicts.
 
     free/usage: int64[M, S] — the kernel evaluates both the live world
     (free - usage) and the simulate-empty world (free); per_pod:
     int64[B, S];
     count/slice_size/slice_level/req_level/mode: int64[B]
-    (mode 0=required, 1=preferred, 2=unconstrained); valid: bool[NL, M];
-    parent: int64[NL, M]; has_pods_cap: bool[M].
+    (mode 0=required, 1=preferred, 2=unconstrained);
+    leaf_mask: bool[B, M] — per-request matchNode leaf eligibility
+    (selectors/taints/affinity, snapshot._match_excluded);
+    valid: bool[NL, M]; parent: int64[NL, M]; has_pods_cap: bool[M].
 
     Returns (fit bool[2, B], fit_arg int64[2, B]): fit mirrors
     find_topology_assignments success for each usage variant; fit_arg is
@@ -635,8 +637,10 @@ def tas_feasibility(free, usage, per_pod, count, slice_size, slice_level,
                                                                 None]
         cnt = jnp.where(app_m[None], jnp.minimum(cnt, div), cnt)
         any_app = any_app | app_m
-    # A leaf with zero applicable constraints fits zero pods.
-    st = jnp.where(valid[NL - 1][None, None, :] & any_app[None], cnt, 0)
+    # A leaf with zero applicable constraints fits zero pods; matchNode
+    # exclusions zero the leaf for that request only.
+    st = jnp.where(valid[NL - 1][None, None, :] & any_app[None]
+                   & leaf_mask[None], cnt, 0)
 
     ss = jnp.maximum(slice_size, 1)
     sc = count // ss                                # [B]

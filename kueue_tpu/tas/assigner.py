@@ -103,14 +103,29 @@ def _precomputed_failure(tas_requests: dict[str, list], cq_snapshot,
     vd = feasibility.lookup(snap, request)
     if vd is None:
         return None
-    sc = request.count // (tr.slice_size if tr and tr.slice_size else 1)
+    from kueue_tpu.tas.snapshot import slice_topology_constraints
+    constraints = slice_topology_constraints(tr)
+    slice_size = constraints[0][1] if constraints else 1
+    if slice_size <= 0:
+        return None
+    sc = request.count // slice_size
+
+    def message(arg):
+        # Identical string to the host walk: stats built lazily from the
+        # same (request, forest) inputs (snapshot._exclusion_stats).
+        per_pod = dict(request.single_pod_requests)
+        per_pod["pods"] = per_pod.get("pods", 0) + 1
+        stats = snap._exclusion_stats(request.pod_set, per_pod,
+                                      simulate_empty, {}, ())
+        return snap._not_fit_message(arg, sc, slice_size, stats)
+
     if simulate_empty:
         if vd.fit_empty:
             return None
-        return psa.name, snap._not_fit_message(vd.arg_empty, sc)
+        return psa.name, message(vd.arg_empty)
     if vd.fit_used or not feasibility.used_valid(snap):
         return None
-    return psa.name, snap._not_fit_message(vd.arg_used, sc)
+    return psa.name, message(vd.arg_used)
 
 
 def apply_tas_pass(assignment: Assignment, wl: WorkloadInfo,

@@ -191,7 +191,9 @@ def _usage_matrix(snap, struct, cols: list[str]) -> np.ndarray:
 def fill_in_counts_np(snap, pod_set, per_pod: dict, slice_size: int,
                       slice_level_idx: int, simulate_empty: bool,
                       assumed_usage: dict,
-                      required_replacement_domain: tuple) -> bool:
+                      required_replacement_domain: tuple,
+                      excluded: dict = None,
+                      slice_size_at_level: dict = None) -> bool:
     """Vectorized phase-1 (fillInCounts, tas_flavor_snapshot.go:1750)
     for the NO-LEADER case: compute per-domain fit counts as numpy
     reductions over the cached leaf matrices and write them back into
@@ -200,8 +202,11 @@ def fill_in_counts_np(snap, pod_set, per_pod: dict, slice_size: int,
     placement costs more than the whole computation, but the dense
     encoding still beats the per-leaf dict walk by ~10x. Returns False
     when the world is unsupported (leaders are bubbled with min-diff
-    tracking on the Python path)."""
+    tracking on the Python path; multi-layer inner slice rounding
+    stays on the host bubble)."""
     if not snap.level_keys:
+        return False
+    if slice_size_at_level:
         return False
     struct = _structure(snap)
     nl = struct["nl"]
@@ -249,18 +254,15 @@ def fill_in_counts_np(snap, pod_set, per_pod: dict, slice_size: int,
     counts = np.where(applied, counts, 0)
     counts[~struct["valid"][nl - 1]] = 0
 
-    # Selector / replacement-domain leaf filtering.
+    # matchNode exclusions (taints / selectors / affinity, precomputed
+    # by snapshot._match_excluded) + replacement-domain filtering.
     rrd = tuple(required_replacement_domain or ())
-    selector = (pod_set.node_selector
-                if snap.is_lowest_level_node else {})
-    sel_levels = [(snap.level_keys.index(k), v)
-                  for k, v in (selector or {}).items()
-                  if k in snap.level_keys]
-    if rrd or sel_levels:
+    if rrd or excluded:
+        excluded = excluded or {}
         for i, leaf in enumerate(leaves):
             if rrd and leaf.values[:len(rrd)] != rrd:
                 counts[i] = 0
-            elif any(leaf.values[idx] != val for idx, val in sel_levels):
+            elif leaf.values in excluded:
                 counts[i] = 0
 
     # Bottom-up aggregation (fillInCountsHelper :1906, no-leader form:
@@ -314,37 +316,41 @@ def try_find(snap, workers, leader=None, simulate_empty=False,
         # Elastic delta placement is decomposed on the host
         # (_handle_elastic_workload) before device dispatch.
         return NotImplemented
-    tr = workers.pod_set.topology_request or PodSetTopologyRequest()
-    required = tr.mode == TopologyMode.REQUIRED
-    unconstrained = tr.mode == TopologyMode.UNCONSTRAINED
+    if leader is not None:
+        # Leader co-placement: host walk only. The round-5 parity rework
+        # aligned the host descent with the reference's exact consume
+        # semantics (leaderless domains contribute stateWithLeader ==
+        # state, leader lands at the first capable domain in plain
+        # sortedDomains order — tas_flavor_snapshot.go:1897,1518); the
+        # kernel's leader-first formulation predates that and leader
+        # groups never reach the serving device path anyway (the
+        # feasibility batch skips groups, per-placement offload is
+        # default-off).
+        return NotImplemented
+    count = workers.count
+    state, reason = snap.resolve_request(workers, leader is not None)
+    if state is None:
+        return None, reason
+    required = state.required
+    unconstrained = state.unconstrained
     if (features.enabled("TASBalancedPlacement") and not required
             and not unconstrained):
         return NotImplemented
-
-    count = workers.count
-    slice_size = tr.slice_size or 1
-    if count % slice_size != 0:
-        return None, (
-            f"pod count {count} not divisible by slice size {slice_size}")
-    if tr.level is not None:
-        if tr.level not in snap.level_keys:
-            return None, f"no requested topology level: {tr.level}"
-        req_idx = snap.level_keys.index(tr.level)
-    else:
-        req_idx = 0
-    slice_level_key = tr.slice_level or snap.level_keys[-1]
-    if slice_level_key not in snap.level_keys:
-        return None, (
-            f"no requested topology level for slices: {slice_level_key}")
-    slice_idx = snap.level_keys.index(slice_level_key)
-    if req_idx > slice_idx:
-        return None, (
-            f"podset slice topology {slice_level_key} is above the "
-            f"podset topology {tr.level}")
+    if state.slice_size_at_level:
+        # Multi-layer inner slice rounding: host path only.
+        return NotImplemented
+    if state.least_free != state.unconstrained:
+        # TASProfileMixed off: the kernel's unconstrained branches encode
+        # the LeastFreeCapacity profile; BestFit-unconstrained stays host.
+        return NotImplemented
+    slice_size = state.slice_size
+    req_idx = state.requested_level_idx
+    slice_idx = state.slice_level_idx
 
     struct = _structure(snap)
     if not struct["level_domains"][req_idx]:
-        return None, "no topology domains at level"
+        return None, ("no topology domains at level: "
+                      f"{snap.level_keys[req_idx]}")
 
     per_pod = dict(workers.single_pod_requests)
     per_pod["pods"] = per_pod.get("pods", 0) + 1
@@ -381,24 +387,18 @@ def try_find(snap, workers, leader=None, simulate_empty=False,
                     if res in col_of:
                         assumed[i, col_of[res]] = used
 
-    # Selector / replacement-domain leaf filtering (fillLeafCounts
-    # :1864 early returns).
+    # matchNode exclusions (taints / full-label selectors / affinity —
+    # snapshot._match_excluded) + replacement-domain leaf filtering.
     leaf_mask = struct["valid"][struct["nl"] - 1].copy()
     rrd = tuple(required_replacement_domain or ())
-    needs_selector = (snap.is_lowest_level_node
-                      and any(k in snap.level_keys
-                              for k in workers.pod_set.node_selector))
-    if rrd or needs_selector:
+    excluded = snap._match_excluded(workers.pod_set)
+    needs_selector = bool(excluded)
+    if rrd or excluded:
         for i, leaf in enumerate(leaves):
             if rrd and leaf.values[:len(rrd)] != rrd:
                 leaf_mask[i] = False
-                continue
-            if needs_selector:
-                for key, val in workers.pod_set.node_selector.items():
-                    if key in snap.level_keys and \
-                            leaf.values[snap.level_keys.index(key)] != val:
-                        leaf_mask[i] = False
-                        break
+            elif leaf.values in excluded:
+                leaf_mask[i] = False
 
     import jax.numpy as jnp
 
@@ -469,8 +469,14 @@ def try_find(snap, workers, leader=None, simulate_empty=False,
         (status, fit_arg, cnt, lead))
     status = int(status)
     if status == tops.ERR_NOT_FIT:
+        # Identical failure string to the host walk: the exclusion-stats
+        # tail is a pure function of (request, forest), built lazily.
+        stats = snap._exclusion_stats(
+            workers.pod_set, per_pod, simulate_empty, assumed_usage or {},
+            required_replacement_domain)
         return None, snap._not_fit_message(int(fit_arg),
-                                           count // slice_size)
+                                           count // slice_size,
+                                           slice_size, stats)
     if status == tops.ERR_UNDERFLOW:
         return None, "internal: assignment accounting underflow"
 

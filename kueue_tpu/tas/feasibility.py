@@ -55,50 +55,45 @@ class Verdict:
 
 
 def request_signature(pod_set, single_pod_requests, count):
-    tr = pod_set.topology_request or PodSetTopologyRequest()
-    return (tr.mode, tr.level, tr.slice_level, tr.slice_size or 1,
-            int(count), tuple(sorted(single_pod_requests.items())))
+    from kueue_tpu.tas.snapshot import slice_topology_constraints
+    tr = pod_set.topology_request
+    mode = tr.mode if tr is not None else None
+    return (mode, tr.level if tr else None,
+            slice_topology_constraints(tr), int(count),
+            tuple(sorted(single_pod_requests.items())),
+            tuple(sorted((pod_set.node_selector or {}).items())),
+            tuple(pod_set.tolerations or ()),
+            tuple(tuple(term) for term in (pod_set.node_affinity or ())))
 
 
-def _qualify(snap, pod_set, count):
-    """Returns (slice_level_idx, req_level_idx, mode_num, slice_size) or
-    None when the request needs the sequential path. Mirrors the early
-    returns of find_topology_assignments (snapshot.py:543) so a
-    qualifying request reaches phase 2 with the default leaf mask."""
+def _qualify(snap, pod_set, single, count):
+    """Returns (slice_level_idx, req_level_idx, mode_num, slice_size,
+    excluded_leaf_values) or None when the request needs the sequential
+    path. Anchored on snapshot.resolve_request so the batch can never
+    disagree with the host walk on what a request means; leaf-level
+    matchNode filtering (selectors, taints, affinity) feeds the kernel
+    as a per-request mask instead of disqualifying the request."""
     if not snap.level_keys:
         return None
-    tr = pod_set.topology_request or PodSetTopologyRequest()
-    mode = _MODE_NUM.get(tr.mode)
+    from kueue_tpu.tas.snapshot import TASPodSetRequest
+    tr = pod_set.topology_request
+    mode = _MODE_NUM.get(tr.mode) if tr is not None else 2
     if mode is None:
         return None
     if (features.enabled("TASBalancedPlacement") and mode == 1):
         return None
-    if tr.pod_set_group_name:
+    if tr is not None and tr.pod_set_group_name:
         return None
-    slice_size = tr.slice_size or 1
-    if slice_size <= 0 or count % slice_size != 0:
+    state, reason = snap.resolve_request(
+        TASPodSetRequest(pod_set, single, count), has_leader=False)
+    if state is None:
         return None
-    if tr.level is not None:
-        if tr.level not in snap.level_keys:
-            return None
-        req_idx = snap.level_keys.index(tr.level)
-    else:
-        req_idx = 0
-    slice_level_key = tr.slice_level or snap.level_keys[-1]
-    if (tr.slice_level and tr.slice_level != snap.level_keys[-1]
-            and not features.enabled("TASMultiLayerTopology")):
-        return None
-    if slice_level_key not in snap.level_keys:
-        return None
-    slice_idx = snap.level_keys.index(slice_level_key)
-    if req_idx > slice_idx:
-        return None
-    # Leaf filtering (node selectors at the lowest level) changes the
-    # counts; those requests take the sequential path.
-    if snap.is_lowest_level_node and any(
-            k in snap.level_keys for k in pod_set.node_selector):
-        return None
-    return slice_idx, req_idx, mode, slice_size
+    if state.slice_size_at_level:
+        return None  # multi-layer rounding: host path only
+    excluded = snap._match_excluded(pod_set)
+    return (state.slice_level_idx, state.requested_level_idx,
+            2 if state.unconstrained else mode, state.slice_size,
+            frozenset(excluded))
 
 
 def collect_requests(wl, cq_snapshot):
@@ -113,10 +108,10 @@ def collect_requests(wl, cq_snapshot):
     out = []
     for snap in set(cq_snapshot.tas_flavors.values()):
         for i, ps in enumerate(wl.obj.pod_sets):
-            params = _qualify(snap, ps, ps.count)
+            single = wl.total_requests[i].single_pod_requests()
+            params = _qualify(snap, ps, single, ps.count)
             if params is None:
                 continue
-            single = wl.total_requests[i].single_pod_requests()
             sig = request_signature(ps, single, ps.count)
             out.append((snap, sig, ps, single, ps.count, params))
     return out
@@ -202,14 +197,19 @@ def _launch(snap, reqs: dict) -> dict:
     B = len(sigs)
     Bp = 1 << (B - 1).bit_length()  # pow2 pad bounds recompiles
     S = len(cols)
+    M = struct["m"]
+    leaves_list = struct["leaves"]
     per_pod = np.zeros((Bp, S), np.int64)
     count = np.ones(Bp, np.int64)
     slice_size = np.ones(Bp, np.int64)
     slice_level = np.zeros(Bp, np.int64)
     req_level = np.zeros(Bp, np.int64)
     mode = np.zeros(Bp, np.int64)
+    leaf_mask = np.ones((Bp, M), bool)
+    any_excluded = False
     for b, sig in enumerate(sigs):
-        single, cnt_b, (slice_idx, req_idx, mode_n, ss) = reqs[sig]
+        single, cnt_b, (slice_idx, req_idx, mode_n, ss, excluded) = \
+            reqs[sig]
         for res, v in all_per_pod[b].items():
             if res in col_of:
                 per_pod[b, col_of[res]] = min(v, 1 << 60)
@@ -218,6 +218,11 @@ def _launch(snap, reqs: dict) -> dict:
         slice_level[b] = slice_idx
         req_level[b] = req_idx
         mode[b] = mode_n
+        if excluded:
+            any_excluded = True
+            for i, leaf in enumerate(leaves_list):
+                if leaf.values in excluded:
+                    leaf_mask[b, i] = False
     # Padding rows: count 1, zero requests -> fit trivially, harmless.
 
     jnp_cache = struct.setdefault("jnp_cache", {})
@@ -246,11 +251,18 @@ def _launch(snap, reqs: dict) -> dict:
             j_usage = jnp.asarray(usage)
             snap._j_usage_cache = (ukey, j_usage)
 
+    if any_excluded:
+        j_leaf_mask = jnp.asarray(leaf_mask)
+    else:
+        j_leaf_mask = jnp_cache.get(("ones_mask", leaf_mask.shape))
+        if j_leaf_mask is None:
+            j_leaf_mask = jnp_cache[("ones_mask", leaf_mask.shape)] = \
+                jnp.ones(leaf_mask.shape, bool)
     fit, arg = jax.device_get(tops.tas_feasibility(
         j_free, j_usage, jnp.asarray(per_pod),
         jnp.asarray(count), jnp.asarray(slice_size),
         jnp.asarray(slice_level), jnp.asarray(req_level),
-        jnp.asarray(mode), j_valid, j_parent, j_pods_cap,
+        jnp.asarray(mode), j_leaf_mask, j_valid, j_parent, j_pods_cap,
         num_levels=struct["nl"], max_domains=struct["m"],
         pods_col=col_of["pods"]))
     return {sig: Verdict(bool(fit[0, b]), int(arg[0, b]),

@@ -57,6 +57,9 @@ class Node:
     capacity: dict[str, int] = field(default_factory=dict)
     taints: tuple[Taint, ...] = ()
     ready: bool = True
+    # corev1.NodeSpec.Unschedulable (kubectl cordon): excluded from the
+    # forest like not-ready nodes (tas_nodes_cache.go node filtering).
+    unschedulable: bool = False
 
 
 @dataclass
@@ -106,7 +109,8 @@ class _Domain:
     __slots__ = ("id", "values", "parent", "children", "state",
                  "slice_state", "state_with_leader",
                  "slice_state_with_leader", "leader_state",
-                 "free_capacity", "tas_usage", "node_name")
+                 "free_capacity", "tas_usage", "node_name",
+                 "node_labels", "node_taints")
 
     def __init__(self, domain_id, values):
         self.id = domain_id
@@ -121,6 +125,10 @@ class _Domain:
         self.free_capacity: dict[str, int] = {}
         self.tas_usage: dict[str, int] = {}
         self.node_name: Optional[str] = None
+        # Leaf-only node metadata for matchNode (tas_flavor_snapshot.go
+        # :1830 — taints, full label set for selectors/affinity).
+        self.node_labels: dict[str, str] = {}
+        self.node_taints: tuple = ()
 
     def clear_state(self):
         """tas_balanced_placement.go clearState."""
@@ -160,6 +168,102 @@ def clone_domains(domains: list[_Domain]) -> list[_Domain]:
     return [clone(d, None) for d in domains]
 
 
+def slice_topology_constraints(tr) -> tuple:
+    """util/tas/tas.go:116 (PodSetSliceRequiredTopologyConstraints):
+    normalize the multi-layer list and the legacy single-layer fields to
+    ((level_label_or_None, size), ...), outermost first. A ``None``
+    level means the topology's lowest level (our historical API allowed
+    ``slice_size`` alone; the resolver substitutes the leaf level)."""
+    if tr is None:
+        return ()
+    extra = tuple(getattr(tr, "slice_constraints", ()) or ())
+    if extra:
+        return tuple((str(t), int(s)) for t, s in extra)
+    if tr.slice_level is None and not tr.slice_size:
+        return ()
+    return ((tr.slice_level, int(tr.slice_size or 0)),)
+
+
+def _taint_to_string(t) -> str:
+    """corev1.Taint.ToString (k8s.io/api/core/v1/taint.go:28)."""
+    if not t.effect:
+        return t.key if not t.value else f"{t.key}={t.value}:"
+    if not t.value:
+        return f"{t.key}:{t.effect}"
+    return f"{t.key}={t.value}:{t.effect}"
+
+
+def _node_affinity_term_matches(term, labels: dict) -> bool:
+    """One requiredDuringScheduling nodeSelectorTerm against a node's
+    FULL label set (component-helpers nodeaffinity.NodeSelector.Match —
+    unlike the flavor-restricted matcher in scheduler/flavorassigner.py,
+    absent keys fail In/Exists here). ``term`` is ((key, op, values),...);
+    all expressions must match."""
+    for key, op, values in term:
+        val = labels.get(key)
+        if op == "In":
+            if val is None or val not in values:
+                return False
+        elif op == "NotIn":
+            if val is not None and val in values:
+                return False
+        elif op == "Exists":
+            if val is None:
+                return False
+        elif op == "DoesNotExist":
+            if val is not None:
+                return False
+        elif op in ("Gt", "Lt"):
+            try:
+                n = int(val)
+                bound = int(values[0])
+            except (TypeError, ValueError, IndexError):
+                return False
+            if op == "Gt" and not n > bound:
+                return False
+            if op == "Lt" and not n < bound:
+                return False
+        else:
+            return False
+    return True
+
+
+class ExclusionStats:
+    """tas_flavor_snapshot.go:496 (ExclusionStats): why nodes were
+    excluded during placement, rendered into the notFitMessage tail."""
+
+    __slots__ = ("taints", "node_selector", "affinity", "topology_domain",
+                 "resources", "total_nodes")
+
+    def __init__(self):
+        self.taints: dict[str, int] = {}
+        self.node_selector = 0
+        self.affinity = 0
+        self.topology_domain = 0
+        self.resources: dict[str, int] = {}
+        self.total_nodes = 0
+
+    def has_exclusions(self) -> bool:
+        return (self.node_selector > 0 or self.affinity > 0
+                or self.topology_domain > 0 or bool(self.taints)
+                or bool(self.resources))
+
+    def format_reasons(self) -> str:
+        """formatReasons :551 — entries string-sorted after rendering."""
+        reasons = []
+        if self.node_selector > 0:
+            reasons.append(f"nodeSelector: {self.node_selector}")
+        if self.affinity > 0:
+            reasons.append(f"affinity: {self.affinity}")
+        if self.topology_domain > 0:
+            reasons.append(f"topologyDomain: {self.topology_domain}")
+        for taint in sorted(self.taints):
+            reasons.append(f'taint "{taint}": {self.taints[taint]}')
+        for res in sorted(self.resources):
+            reasons.append(f'resource "{res}": {self.resources[res]}')
+        return ", ".join(sorted(reasons))
+
+
 @dataclass
 class TASPodSetRequest:
     pod_set: PodSet
@@ -181,6 +285,22 @@ class _AssignState:
     required: bool
     unconstrained: bool
     leader_count: int = 0
+    # unconstrained under the TASProfileMixed gate → LeastFreeCapacity
+    # ordering (tas_flavor_snapshot.go:1498 useLeastFreeCapacityAlgorithm)
+    least_free: bool = False
+    # level idx -> inner slice size (buildSliceSizeAtLevel :1174)
+    slice_size_at_level: dict = field(default_factory=dict)
+    # the normalized constraint list when multi-layer is active (drives
+    # multiLayerNotFitMessage :2004)
+    multi_layer: tuple = ()
+    # lazy ExclusionStats builder, memoized per call
+    stats_fn: Optional[object] = None
+    _stats_memo: Optional[object] = None
+
+    def stats(self):
+        if self._stats_memo is None and self.stats_fn is not None:
+            self._stats_memo = self.stats_fn()
+        return self._stats_memo
 
 
 class TASFlavorSnapshot:
@@ -320,6 +440,8 @@ class TASFlavorSnapshot:
                 c.free_capacity = d.free_capacity  # shared, read-only
                 c.tas_usage = dict(d.tas_usage) if d.tas_usage else {}
                 c.node_name = d.node_name
+                c.node_labels = d.node_labels  # shared, read-only
+                c.node_taints = d.node_taints
                 c.children = []
                 parent = d.parent
                 if parent is None:
@@ -337,6 +459,7 @@ class TASFlavorSnapshot:
         if used:
             new._used_leaves = set(used)
         new._usage_version = getattr(self, "_usage_version", 0)
+        new._any_taints = getattr(self, "_any_taints", False)
         # The device encoding (tas/device.py _structure) can remap its
         # cached arrays through the prototype instead of re-deriving.
         new._struct_donor = self
@@ -344,7 +467,7 @@ class TASFlavorSnapshot:
 
     def add_node(self, node: Node,
                  non_tas_usage: Optional[dict[str, int]] = None) -> None:
-        if not node.ready:
+        if not node.ready or node.unschedulable:
             return
         self._version += 1
         values = tuple(node.labels.get(k, "") for k in self.level_keys)
@@ -352,6 +475,12 @@ class TASFlavorSnapshot:
             return  # node not labeled for this topology
         leaf = self._ensure_domain(values)
         leaf.node_name = node.name
+        leaf.node_labels = dict(node.labels)
+        sched_taints = tuple(t for t in node.taints
+                             if t.effect in ("NoSchedule", "NoExecute"))
+        leaf.node_taints = leaf.node_taints + sched_taints
+        if sched_taints:
+            self._any_taints = True
         for res, cap in node.capacity.items():
             used = (non_tas_usage or {}).get(res, 0)
             leaf.free_capacity[res] = leaf.free_capacity.get(res, 0) \
@@ -493,6 +622,16 @@ class TASFlavorSnapshot:
                     existing = _existing_assignment(workload,
                                                    tr.pod_set.name)
                     if existing is None:
+                        continue
+                    if features.enabled(
+                            "SkipReassignmentForPodOwnedWorkloads") \
+                            and owned_by_single_pod(workload):
+                        # The pod cannot relocate and the Workload cannot
+                        # outlive it; keep the existing assignment so
+                        # admit clears UnhealthyNodes without diverging
+                        # from the node the pod actually runs on
+                        # (tas_flavor_snapshot.go:679).
+                        results[tr.pod_set.name] = existing
                         continue
                     new_assignment, repl, reason = \
                         self.find_replacement_assignment(
@@ -660,6 +799,240 @@ class TASFlavorSnapshot:
             memo[1][memo_key] = out
         return out
 
+    def resolve_request(self, workers: TASPodSetRequest,
+                        has_leader: bool) -> tuple:
+        """Shared request resolution (findTopologyAssignment :978-1032):
+        slice size, requested/slice level indices, mode flags, the
+        multi-layer slice-size map. Returns (state, reason) — state is an
+        _AssignState on success. Used by the host path, the device
+        adapter, and the feasibility batch so the three can never
+        disagree on what a request means."""
+        tr = workers.pod_set.topology_request
+        count = workers.count
+
+        constraints = slice_topology_constraints(tr)
+        if len(constraints) > 1 and not features.enabled(
+                "TASMultiLayerTopology"):
+            # Gate off: additional layers ignored (the annotation parser
+            # only emits the list under the gate, jobframework/tas.go:91).
+            constraints = constraints[:1]
+        # getSliceSizeWithSinglePodAsDefault :1310.
+        if constraints:
+            slice_size = constraints[0][1]
+            if slice_size <= 0:
+                return None, ("slice topology requested, but slice size "
+                              "not provided")
+        else:
+            slice_size = 1
+        if count % slice_size != 0:
+            return None, (
+                f"pod count {count} not divisible by slice size {slice_size}")
+
+        implied = tr is None
+        mode = tr.mode if tr is not None else None
+        required = mode == TopologyMode.REQUIRED
+        preferred = mode == TopologyMode.PREFERRED
+        slice_only = (not required and not preferred and bool(constraints))
+        unconstrained = (mode == TopologyMode.UNCONSTRAINED or implied
+                         or slice_only)
+
+        # levelKey :1273 + levelKeyWithImpliedFallback :1263: required/
+        # preferred name a level; slice-only anchors at the HIGHEST
+        # level; unconstrained (incl. implied) at the LOWEST.
+        if required or preferred:
+            if tr.level is None or tr.level not in self.level_keys:
+                return None, f"no requested topology level: {tr.level}"
+            requested_level_idx = self.level_keys.index(tr.level)
+        elif slice_only:
+            requested_level_idx = 0
+        elif unconstrained:
+            requested_level_idx = len(self.level_keys) - 1
+        else:
+            return None, "topology level not specified"
+
+        # sliceLevelKeyWithDefault :1248 — the OUTERMOST constraint's
+        # level, defaulting to the lowest level.
+        slice_level_key = (constraints[0][0] if constraints
+                           and constraints[0][0] is not None
+                           else self.level_keys[-1])
+        if slice_level_key not in self.level_keys:
+            return None, (
+                f"no requested topology level for slices: {slice_level_key}")
+        slice_level_idx = self.level_keys.index(slice_level_key)
+        if requested_level_idx > slice_level_idx:
+            named = tr.level if (tr is not None and tr.level) else \
+                self.level_keys[requested_level_idx]
+            return None, (
+                f"podset slice topology {slice_level_key} is above the "
+                f"podset topology {named}")
+
+        # buildSliceSizeAtLevel :1174 — inner layers.
+        slice_size_at_level: dict[int, int] = {}
+        prev_size, prev_idx = slice_size, slice_level_idx
+        for layer_key, layer_size in constraints[1:]:
+            if layer_key not in self.level_keys:
+                return None, ("no requested topology level for additional "
+                              f"slice layer: {layer_key}")
+            inner_idx = self.level_keys.index(layer_key)
+            if inner_idx <= prev_idx:
+                return None, (
+                    f"additional slice layer topology {layer_key} must be "
+                    f"at a lower level than {self.level_keys[prev_idx]}")
+            if prev_size % layer_size != 0:
+                return None, (
+                    f"additional slice layer size {layer_size} must evenly "
+                    f"divide parent layer size {prev_size}")
+            for lvl in range(prev_idx + 1, inner_idx + 1):
+                slice_size_at_level[lvl] = layer_size
+            prev_size, prev_idx = layer_size, inner_idx
+
+        state = _AssignState(
+            count=count, slice_size=slice_size,
+            requested_level_idx=requested_level_idx,
+            slice_level_idx=slice_level_idx, required=required,
+            unconstrained=unconstrained,
+            leader_count=1 if has_leader else 0,
+            least_free=(unconstrained
+                        and features.enabled("TASProfileMixed")),
+            slice_size_at_level=slice_size_at_level,
+            multi_layer=constraints if slice_size_at_level else ())
+        return state, ""
+
+    def has_level(self, tr) -> bool:
+        """HasLevel :1221 — whether the request names topology levels
+        this snapshot resolves (the main level via levelKey :1273, the
+        slice level, and every multi-layer layer). Used by the delayed
+        topology-request gating (scheduler.go second pass)."""
+        if tr is None:
+            return False
+        constraints = slice_topology_constraints(tr)
+        mode = tr.mode
+        if mode in (TopologyMode.REQUIRED, TopologyMode.PREFERRED):
+            main = tr.level
+        elif constraints:
+            main = self.level_keys[0] if self.level_keys else None
+        elif mode == TopologyMode.UNCONSTRAINED:
+            main = self.level_keys[-1] if self.level_keys else None
+        else:
+            main = None
+        if main is None or main not in self.level_keys:
+            return False
+        leaf_key = self.level_keys[-1] if self.level_keys else None
+        slice_key = (constraints[0][0] or leaf_key) if constraints \
+            else leaf_key
+        if slice_key not in self.level_keys:
+            return False
+        return all((layer_key or leaf_key) in self.level_keys
+                   for layer_key, _size in constraints)
+
+    def _match_excluded(self, pod_set) -> dict:
+        """matchNode (:1830) over every leaf: {leaf values: reason}
+        where reason is ("taint", taint_string) | ("selector",) |
+        ("affinity",). Only hostname-lowest topologies match nodes; the
+        taint check folds in the flavor's tolerations. Memoized per
+        (structure version, selector, tolerations, affinity) — the
+        matchingLeavesCache / TASCacheNodeMatchResults analog."""
+        if not self.is_lowest_level_node:
+            return {}
+        selector = pod_set.node_selector or {}
+        tolerations = tuple(pod_set.tolerations) + tuple(
+            self.flavor_tolerations)
+        affinity = tuple(tuple(term) for term in
+                         (pod_set.node_affinity or ()))
+        if not selector and not affinity \
+                and not getattr(self, "_any_taints", False):
+            return {}
+        key = (tuple(sorted(selector.items())), tolerations, affinity)
+        cache = getattr(self, "_match_cache", None)
+        if cache is None or cache[0] != self._version:
+            cache = (self._version, {})
+            self._match_cache = cache
+        hit = cache[1].get(key)
+        if hit is not None:
+            return hit
+        excluded: dict[tuple, tuple] = {}
+        for values, leaf in self.leaves.items():
+            reason = None
+            for taint in leaf.node_taints:
+                if not any(t.tolerates(taint) for t in tolerations):
+                    reason = ("taint", _taint_to_string(taint))
+                    break
+            if reason is None and selector:
+                labels = leaf.node_labels
+                if any(labels.get(k) != v for k, v in selector.items()):
+                    reason = ("selector",)
+            if reason is None and affinity:
+                labels = leaf.node_labels
+                if not any(_node_affinity_term_matches(term, labels)
+                           for term in affinity):
+                    reason = ("affinity",)
+            if reason is not None:
+                excluded[values] = reason
+        if len(cache[1]) > 256:
+            cache[1].clear()
+        cache[1][key] = excluded
+        return excluded
+
+    def _count_in_with_limiting(self, per_pod: dict[str, int],
+                                remaining: dict[str, int]) -> tuple:
+        """resources.Requests.CountInWithLimitingResource
+        (pkg/resources/requests.go:208): (pods that fit, the limiting
+        resource) — min count, lexicographically-smallest name on ties.
+        A leaf without explicit "pods" capacity is unlimited on pods
+        (our standalone node model; K8s nodes always report pods)."""
+        best = None
+        limiting = ""
+        for res in sorted(per_pod):
+            need = per_pod[res]
+            if need == 0:
+                continue
+            if res == "pods" and res not in remaining:
+                continue
+            cnt = max(0, remaining.get(res, 0)) // need
+            if best is None or cnt < best or (cnt == best
+                                              and res < limiting):
+                best = cnt
+                limiting = res
+        return (best if best is not None else 0), limiting
+
+    def _exclusion_stats(self, pod_set, per_pod: dict[str, int],
+                         simulate_empty: bool, assumed_usage: dict,
+                         required_replacement_domain: tuple
+                         ) -> ExclusionStats:
+        """Build the failure-path ExclusionStats lazily — a pure function
+        of (request, forest state), so EVERY decision path (host walk,
+        numpy phase-1, device kernel, feasibility batch) renders the
+        identical message by calling this at failure time instead of
+        collecting counters inline."""
+        stats = ExclusionStats()
+        stats.total_nodes = len(self.leaves)
+        excluded = self._match_excluded(pod_set)
+        for reason in excluded.values():
+            if reason[0] == "taint":
+                stats.taints[reason[1]] = stats.taints.get(reason[1], 0) + 1
+            elif reason[0] == "selector":
+                stats.node_selector += 1
+            else:
+                stats.affinity += 1
+        rrd = tuple(required_replacement_domain or ())
+        for values, leaf in self.leaves.items():
+            if values in excluded:
+                continue
+            if rrd and values[:len(rrd)] != rrd:
+                stats.topology_domain += 1
+                continue
+            remaining = dict(leaf.free_capacity)
+            if not simulate_empty:
+                for res, used in leaf.tas_usage.items():
+                    remaining[res] = remaining.get(res, 0) - used
+                for res, used in assumed_usage.get(leaf.id, {}).items():
+                    remaining[res] = remaining.get(res, 0) - used
+            cnt, limiting = self._count_in_with_limiting(per_pod, remaining)
+            if cnt == 0 and limiting:
+                stats.resources[limiting] = \
+                    stats.resources.get(limiting, 0) + 1
+        return stats
+
     def find_topology_assignments_host(
         self,
         workers: TASPodSetRequest,
@@ -669,40 +1042,10 @@ class TASFlavorSnapshot:
         required_replacement_domain: tuple = (),
     ) -> tuple[Optional[dict[str, TopologyAssignment]], str]:
         """The sequential oracle path of find_topology_assignments."""
-        tr = workers.pod_set.topology_request or PodSetTopologyRequest()
+        state, reason = self.resolve_request(workers, leader is not None)
+        if reason:
+            return None, reason
         count = workers.count
-        required = tr.mode == TopologyMode.REQUIRED
-        unconstrained = tr.mode == TopologyMode.UNCONSTRAINED
-
-        slice_size = tr.slice_size or 1
-        if count % slice_size != 0:
-            return None, (
-                f"pod count {count} not divisible by slice size {slice_size}")
-
-        # Resolve requested level (unconstrained defaults to the root
-        # level; required/preferred name a level).
-        if tr.level is not None:
-            if tr.level not in self.level_keys:
-                return None, f"no requested topology level: {tr.level}"
-            requested_level_idx = self.level_keys.index(tr.level)
-        else:
-            requested_level_idx = 0
-
-        slice_level_key = tr.slice_level or self.level_keys[-1]
-        if (tr.slice_level and tr.slice_level != self.level_keys[-1]
-                and not features.enabled("TASMultiLayerTopology")):
-            # Slices above the leaf level are the multi-layer form
-            # (kube_features.go TASMultiLayerTopology).
-            return None, ("multi-layer slice topologies require the"
-                          " TASMultiLayerTopology feature gate")
-        if slice_level_key not in self.level_keys:
-            return None, (
-                f"no requested topology level for slices: {slice_level_key}")
-        slice_level_idx = self.level_keys.index(slice_level_key)
-        if requested_level_idx > slice_level_idx:
-            return None, (
-                f"podset slice topology {slice_level_key} is above the "
-                f"podset topology {tr.level}")
 
         per_pod = dict(workers.single_pod_requests)
         per_pod["pods"] = per_pod.get("pods", 0) + 1
@@ -711,18 +1054,19 @@ class TASFlavorSnapshot:
             leader_per_pod = dict(leader.single_pod_requests)
             leader_per_pod["pods"] = leader_per_pod.get("pods", 0) + 1
 
-        state = _AssignState(
-            count=count, slice_size=slice_size,
-            requested_level_idx=requested_level_idx,
-            slice_level_idx=slice_level_idx, required=required,
-            unconstrained=unconstrained,
-            leader_count=1 if leader is not None else 0)
+        assumed = assumed_usage or {}
+        state.stats_fn = lambda: self._exclusion_stats(
+            workers.pod_set, per_pod, simulate_empty, assumed,
+            required_replacement_domain)
 
         # Phase 1: per-domain fit counts.
         self._fill_in_counts(workers.pod_set, per_pod, leader_per_pod,
-                             state, simulate_empty, assumed_usage or {},
+                             state, simulate_empty, assumed,
                              required_replacement_domain)
 
+        slice_size = state.slice_size
+        slice_level_idx = state.slice_level_idx
+        unconstrained = state.unconstrained
         slice_count = count // slice_size
 
         # Phase 2a: balanced placement (preferred mode only), else find
@@ -731,7 +1075,7 @@ class TASFlavorSnapshot:
         fit_level_idx = 0
         used_balanced = False
         if (features.enabled("TASBalancedPlacement")
-                and not required and not unconstrained):
+                and not state.required and not unconstrained):
             from kueue_tpu.tas import balanced
             cand, threshold = balanced.find_best_domains(self, state)
             if threshold > 0:
@@ -740,42 +1084,43 @@ class TASFlavorSnapshot:
                 used_balanced = not reason
         if not used_balanced:
             fit_level_idx, fit_domains, reason = self._find_level_with_fit(
-                requested_level_idx, slice_count, state)
+                state.requested_level_idx, slice_count, state)
             if reason:
                 return None, reason
 
-        # Phase 2b: minimize the chosen domains, then descend.
+        # Phase 2b: minimize the chosen domains, then descend
+        # (tas_flavor_snapshot.go:1085-1130). The descent always orders
+        # children with sortedDomains — leader consumption happens inside
+        # the consume walk, not via the with-leader sort (that one is
+        # selection-level only, :1387).
         fit_domains = self._update_counts_to_minimum(
             fit_domains, count, state.leader_count, slice_size,
-            unconstrained, use_slices=True)
+            state.least_free, use_slices=True)
         if fit_domains is None:
             return None, "internal: assignment accounting underflow"
         level = fit_level_idx
         while level < min(len(self.level_keys) - 1, slice_level_idx) \
                 and not used_balanced:
-            # Leader still to place: order children so leader-capable
-            # domains come first (sortedDomainsWithLeader), otherwise the
-            # leader branch of the consume loop skips worker-only domains.
             children = [c for d in fit_domains for c in d.children]
-            lower = (self._sorted_with_leader(children, unconstrained)
-                     if state.leader_count > 0
-                     else self._sorted(children, unconstrained))
+            lower = self._sorted(children, state.least_free)
             fit_domains = self._update_counts_to_minimum(
-                lower, count, state.leader_count, slice_size, unconstrained,
-                use_slices=True)
+                lower, count, state.leader_count, slice_size,
+                state.least_free, use_slices=True)
             if fit_domains is None:
-                return None, self._not_fit_message(0, slice_count)
+                return None, "internal: assignment accounting underflow"
             level += 1
         while level < len(self.level_keys) - 1:
             # At/below the slice level (or after balanced placement), pods
             # are distributed per parent domain
-            # (tas_flavor_snapshot.go:1095-1130).
-            slice_on_level = slice_size if level < slice_level_idx else 1
+            # (tas_flavor_snapshot.go:1095-1130); inner multi-layer
+            # constraints re-anchor the slice size per level.
+            if level >= slice_level_idx:
+                slice_on_level = state.slice_size_at_level.get(level + 1, 1)
+            else:
+                slice_on_level = slice_size
             new_fit = []
             for d in fit_domains:
-                lower = (self._sorted_with_leader(d.children, unconstrained)
-                         if d.leader_state > 0
-                         else self._sorted(d.children, unconstrained))
+                lower = self._sorted(d.children, state.least_free)
                 if slice_on_level > 1:
                     for c in lower:
                         c.slice_state = c.state // slice_on_level
@@ -783,7 +1128,7 @@ class TASFlavorSnapshot:
                             c.state_with_leader // slice_on_level
                 out = self._update_counts_to_minimum(
                     lower, d.state, d.leader_state, slice_on_level,
-                    unconstrained, use_slices=slice_on_level > 1)
+                    state.least_free, use_slices=slice_on_level > 1)
                 if out is None:
                     return None, "internal: assignment accounting underflow"
                 new_fit.extend(out)
@@ -922,8 +1267,11 @@ class TASFlavorSnapshot:
                    leader_per_pod: Optional[dict[str, int]],
                    leaf: _Domain, simulate_empty: bool,
                    assumed_usage: dict,
-                   required_replacement_domain: tuple) -> None:
-        """fillLeafCounts :1864 — pods that fit, plus leader variants."""
+                   required_replacement_domain: tuple,
+                   excluded: dict) -> None:
+        """fillLeafCounts :1864 — pods that fit, plus leader variants.
+        ``excluded`` is the matchNode verdict map (_match_excluded):
+        taints / full-label selectors / required node affinity."""
         leaf.state = 0
         leaf.leader_state = 0
         leaf.state_with_leader = 0
@@ -931,12 +1279,8 @@ class TASFlavorSnapshot:
                 leaf.values[:len(required_replacement_domain)] != \
                 required_replacement_domain:
             return
-        if self.is_lowest_level_node:
-            for key, val in pod_set.node_selector.items():
-                if key in self.level_keys:
-                    idx = self.level_keys.index(key)
-                    if leaf.values[idx] != val:
-                        return
+        if leaf.values in excluded:
+            return
 
         remaining = dict(leaf.free_capacity)
         if not simulate_empty:
@@ -960,10 +1304,11 @@ class TASFlavorSnapshot:
             leaf.leader_state = 1
             for res, need in leader_per_pod.items():
                 remaining[res] = remaining.get(res, 0) - need
-            leaf.state_with_leader = count_in(per_pod)
-        else:
-            leaf.state_with_leader = leaf.state if leader_per_pod is None \
-                else 0
+        # stateWithLeader is CountIn(remaining) UNCONDITIONALLY
+        # (fillLeafCounts :1897): when the leader doesn't fit here it
+        # equals state — the descent consume walk takes full worker
+        # capacity from leaderless domains instead of wasting them.
+        leaf.state_with_leader = count_in(per_pod)
 
     def _fill_in_counts(self, pod_set: PodSet, per_pod: dict[str, int],
                         leader_per_pod: Optional[dict[str, int]],
@@ -974,12 +1319,14 @@ class TASFlavorSnapshot:
         reductions over the cached leaf matrices (tas/device.py
         fill_in_counts_np — ~10x the per-leaf dict walk); leader
         co-placement keeps the object walk (min-diff bubbling)."""
+        excluded = self._match_excluded(pod_set)
         if leader_per_pod is None:
             from kueue_tpu.tas import device
             if device.fill_in_counts_np(
                     self, pod_set, per_pod, state.slice_size,
                     state.slice_level_idx, simulate_empty,
-                    assumed_usage or {}, required_replacement_domain):
+                    assumed_usage or {}, required_replacement_domain,
+                    excluded, state.slice_size_at_level):
                 return
         for d in self.domains.values():
             d.state = 0
@@ -990,16 +1337,21 @@ class TASFlavorSnapshot:
         for leaf in self.leaves.values():
             self._leaf_fits(pod_set, per_pod, leader_per_pod, leaf,
                             simulate_empty, assumed_usage,
-                            required_replacement_domain)
+                            required_replacement_domain, excluded)
         for root in self.roots.values():
             self.bubble_up(root, state.slice_size, state.slice_level_idx,
-                           0, leader_required=state.leader_count > 0)
+                           0, leader_required=state.leader_count > 0,
+                           slice_size_at_level=state.slice_size_at_level)
 
     def bubble_up(self, domain: _Domain, slice_size: int,
                   slice_level_idx: int, level: int,
-                  leader_required: bool) -> None:
+                  leader_required: bool,
+                  slice_size_at_level: Optional[dict] = None) -> None:
         """fillInCountsHelper :1906 — roll child capacities up one subtree.
-        Also used by balanced-placement pruning to re-aggregate clones."""
+        Also used by balanced-placement pruning to re-aggregate clones.
+        With multi-layer constraints, children at a constrained level
+        contribute pods rounded down to multiples of the inner slice
+        size (:1925-1930)."""
         if not domain.children:
             if level == slice_level_idx:
                 domain.slice_state = domain.state // slice_size
@@ -1012,15 +1364,22 @@ class TASFlavorSnapshot:
         min_state_diff = _INF
         min_slice_diff = _INF
         leader_state = 0
+        inner = (slice_size_at_level or {}).get(level + 1)
         for child in domain.children:
             self.bubble_up(child, slice_size, slice_level_idx, level + 1,
-                           leader_required)
-            children_capacity += child.state
+                           leader_required,
+                           slice_size_at_level=slice_size_at_level)
+            child_state = child.state
+            child_swl = child.state_with_leader
+            if inner:
+                child_state = (child_state // inner) * inner
+                child_swl = (child_swl // inner) * inner
+            children_capacity += child_state
             slice_capacity += child.slice_state
             if not leader_required or child.leader_state > 0:
                 has_leader_contributor = True
                 min_state_diff = min(min_state_diff,
-                                     child.state - child.state_with_leader)
+                                     child_state - child_swl)
                 min_slice_diff = min(
                     min_slice_diff,
                     child.slice_state - child.slice_state_with_leader)
@@ -1039,19 +1398,20 @@ class TASFlavorSnapshot:
         domain.slice_state = slice_capacity
         domain.slice_state_with_leader = slice_with_leader
 
-    def _sorted(self, domains: list, unconstrained: bool) -> list:
-        """sortedDomains :1722 — BestFit order (sliceState desc, state asc,
-        values asc), or LeastFreeCapacity ascending for unconstrained."""
-        if unconstrained:
+    def _sorted(self, domains: list, least_free: bool) -> list:
+        """sortedDomains :1721 — BestFit order (sliceState desc, state asc,
+        values asc), or LeastFreeCapacity ascending under the
+        TASProfileMixed unconstrained profile."""
+        if least_free:
             return sorted(domains,
                           key=lambda d: (d.slice_state, d.state, d.values))
         return sorted(domains,
                       key=lambda d: (-d.slice_state, d.state, d.values))
 
     def _sorted_with_leader(self, domains: list,
-                            unconstrained: bool) -> list:
+                            least_free: bool) -> list:
         """sortedDomainsWithLeader :1683 — leader capacity first."""
-        if unconstrained:
+        if least_free:
             return sorted(domains, key=lambda d: (
                 -d.leader_state, d.slice_state_with_leader,
                 d.state_with_leader, d.values))
@@ -1065,167 +1425,277 @@ class TASFlavorSnapshot:
         domains = list(self.domains_per_level[level_idx].values()) \
             if self.level_keys else []
         if not domains:
-            return 0, [], "no topology domains at level"
+            level_name = (self.level_keys[level_idx]
+                          if self.level_keys else "")
+            return 0, [], f"no topology domains at level: {level_name}"
         sorted_domains = self._sorted_with_leader(domains,
-                                                 state.unconstrained)
+                                                 state.least_free)
         top = sorted_domains[0]
-        if not state.unconstrained \
+        if not state.least_free \
                 and top.slice_state_with_leader >= slice_count \
                 and top.leader_state >= state.leader_count:
-            best = _best_fit_for_slices(sorted_domains, slice_count,
-                                        state.leader_count)
-            return level_idx, [best], ""
-        if state.unconstrained:
+            # optimize the potentially last domain
+            top = _best_fit_for_slices(sorted_domains, slice_count,
+                                       state.leader_count)
+        if state.least_free:
             # LeastFreeCapacity: the fullest single domain that fits.
+            # Deliberate deviation: when a leader must co-place, the
+            # single-domain scan also requires leader capacity — the
+            # reference checks only sliceState (:1402) and then emits an
+            # empty assignment when the chosen domain can't host the
+            # leader; requiring it here lets such groups fall through to
+            # the multi-domain greedy and place correctly.
             for d in sorted_domains:
-                if d.slice_state >= slice_count:
+                if d.slice_state >= slice_count and (
+                        state.leader_count == 0
+                        or (d.slice_state_with_leader >= slice_count
+                            and d.leader_state >= state.leader_count)):
                     return level_idx, [d], ""
         if top.slice_state_with_leader < slice_count or \
                 top.leader_state < state.leader_count:
             if state.required:
-                return 0, [], self._not_fit_message(
-                    top.slice_state, slice_count)
+                return 0, [], self._not_fit(state, top.slice_state,
+                                            slice_count, level_idx)
             if level_idx > 0 and not state.unconstrained:
                 return self._find_level_with_fit(level_idx - 1, slice_count,
                                                  state)
-        # Multi-domain greedy at the top (or unconstrained anywhere):
-        # leaders first (:1430-1469), then remaining workers.
-        results = []
-        remaining = slice_count
-        remaining_leaders = state.leader_count
-        idx = 0
-        while remaining_leaders > 0 and idx < len(sorted_domains) \
-                and sorted_domains[idx].leader_state > 0:
-            d = sorted_domains[idx]
-            if not state.unconstrained and \
-                    d.slice_state_with_leader >= remaining:
-                d = _best_fit_for_slices(sorted_domains[idx:], remaining,
-                                         remaining_leaders)
-            results.append(d)
-            remaining_leaders -= d.leader_state
-            remaining -= d.slice_state_with_leader
-            idx += 1
-        if remaining_leaders > 0:
-            return 0, [], self._not_fit_message(
-                state.leader_count - remaining_leaders, slice_count)
-        rest = self._sorted(sorted_domains[idx:], state.unconstrained)
-        for i, d in enumerate(rest):
-            if remaining <= 0:
-                break
-            if d.slice_state <= 0:
-                continue
-            if not state.unconstrained and d.slice_state >= remaining:
-                d = _best_fit_for_slices(rest[i:], remaining, 0)
-            results.append(d)
-            remaining -= d.slice_state
-        if remaining > 0:
-            return 0, [], self._not_fit_message(slice_count - remaining,
-                                                slice_count)
-        return level_idx, results, ""
+            # Multi-domain greedy (:1430-1469): leaders first, then the
+            # remaining domains re-sorted by worker capacity.
+            results = []
+            remaining = slice_count
+            remaining_leaders = state.leader_count
+            idx = 0
+            while remaining_leaders > 0 and idx < len(sorted_domains) \
+                    and sorted_domains[idx].leader_state > 0:
+                d = sorted_domains[idx]
+                if not state.least_free and \
+                        d.slice_state_with_leader >= remaining:
+                    d = _best_fit_for_slices(sorted_domains[idx:], remaining,
+                                             remaining_leaders)
+                results.append(d)
+                remaining_leaders -= d.leader_state
+                remaining -= d.slice_state_with_leader
+                idx += 1
+            if remaining_leaders > 0:
+                return 0, [], self._not_fit(
+                    state, state.leader_count - remaining_leaders,
+                    slice_count, level_idx)
+            rest = self._sorted(sorted_domains[idx:], state.least_free)
+            for i, d in enumerate(rest):
+                if remaining <= 0:
+                    break
+                if not state.least_free and d.slice_state >= remaining:
+                    d = _best_fit_for_slices(rest[i:], remaining, 0)
+                results.append(d)
+                remaining -= d.slice_state
+            if remaining > 0:
+                return 0, [], self._not_fit(
+                    state, slice_count - remaining, slice_count, level_idx)
+            return level_idx, results, ""
+        return level_idx, [top], ""
+
+    def _consume_with_leaders(self, d, remaining_domains: list,
+                              rem: list, least_free: bool,
+                              use_slices: bool, slice_size: int):
+        """consumeWithLeadersGeneric :1518 — one domain's take while
+        leaders remain. ``rem`` is [remaining_primary, remaining_leaders]
+        (mutated). Returns (domain, completed)."""
+        def with_leader(dom):
+            return dom.slice_state_with_leader if use_slices \
+                else dom.state_with_leader
+
+        if not least_free and with_leader(d) >= rem[0] \
+                and d.leader_state >= rem[1]:
+            # optimize the last domain
+            d = (_best_fit_for_slices if use_slices
+                 else _best_fit_for_pods)(remaining_domains, rem[0], rem[1])
+        wl = with_leader(d)
+        if wl >= rem[0] and d.leader_state >= rem[1]:
+            if use_slices:
+                d.slice_state = rem[0]
+            d.leader_state = rem[1]
+            d.state = rem[0] * slice_size
+            return d, True
+        if use_slices:
+            # Clamp to remaining before consuming; state from slice count.
+            if d.slice_state_with_leader > rem[0]:
+                d.slice_state_with_leader = rem[0]
+            if d.leader_state > rem[1]:
+                d.leader_state = rem[1]
+            d.state = d.slice_state_with_leader * slice_size
+            rem[1] -= d.leader_state
+            rem[0] -= d.slice_state_with_leader
+            return d, False
+        # Pods: clamp the take to the remainder BEFORE consuming.
+        # Deliberate deviation: the reference's partial pods-with-leader
+        # branch subtracts first and never clamps domain.state
+        # (consumeWithLeadersGeneric :1565-1575), which over-counts the
+        # emitted assignment past the requested count and can zero a
+        # placed leader when the take exceeds the remainder; we apply
+        # the completed-branch semantics so assignments never exceed
+        # the request.
+        take = min(d.state_with_leader, rem[0])
+        lead_take = min(d.leader_state, rem[1])
+        d.state = take
+        d.state_with_leader = take
+        d.leader_state = lead_take
+        rem[0] -= take
+        rem[1] -= lead_take
+        return d, False
 
     def _update_counts_to_minimum(self, sorted_domains: list, count: int,
                                   leader_count: int, slice_size: int,
-                                  unconstrained: bool,
+                                  least_free: bool,
                                   use_slices: bool) -> Optional[list]:
-        """updateCountsToMinimumGeneric :1575 + consumeWithLeadersGeneric
-        :1510: distribute ``count`` pods (and the leader) over a minimal
-        prefix of the sorted domains, clamping each domain's state to its
-        assigned amount."""
+        """updateCountsToMinimumGeneric :1575: distribute ``count`` pods
+        (and the leaders) over a minimal prefix of the sorted domains,
+        clamping each domain's state to its assigned amount."""
         results = []
-        remaining = count // slice_size if use_slices else count
-        remaining_leaders = leader_count
+        rem = [count // slice_size if use_slices else count, leader_count]
 
-        def primary(d):
-            return d.slice_state if use_slices else d.state
-
-        def primary_with_leader(d):
-            return d.slice_state_with_leader if use_slices \
-                else d.state_with_leader
-
-        for i, d in enumerate(sorted_domains):
-            if remaining <= 0 and remaining_leaders <= 0:
-                break
-            if remaining_leaders > 0:
-                if not unconstrained \
-                        and primary_with_leader(d) >= remaining \
-                        and d.leader_state >= remaining_leaders:
-                    d = (_best_fit_for_slices if use_slices
-                         else _best_fit_for_pods)(
-                        sorted_domains[i:], remaining, remaining_leaders)
-                take = primary_with_leader(d)
-                if take >= remaining and d.leader_state >= remaining_leaders:
-                    d.leader_state = remaining_leaders
-                    d.state = remaining * slice_size if use_slices \
-                        else remaining
-                    if use_slices:
-                        d.slice_state = remaining
-                    results.append(d)
+        for i, dom in enumerate(sorted_domains):
+            if rem[1] > 0:
+                d, completed = self._consume_with_leaders(
+                    dom, sorted_domains[i:], rem, least_free,
+                    use_slices, slice_size if use_slices else 1)
+                results.append(d)
+                if completed:
                     return results
-                take = min(take, remaining)
-                d.leader_state = min(d.leader_state, remaining_leaders)
-                d.state = take * slice_size if use_slices else take
-                if use_slices:
-                    d.slice_state = take
-                remaining_leaders -= d.leader_state
-                remaining -= take
-                results.append(d)
                 continue
-            d.leader_state = 0
-            if not unconstrained and primary(d) >= remaining:
-                d = (_best_fit_for_slices if use_slices
-                     else _best_fit_for_pods)(sorted_domains[i:],
-                                              remaining, 0)
-                d.leader_state = 0
-            take = primary(d)
-            if take >= remaining:
-                d.state = remaining * slice_size if use_slices else remaining
-                if use_slices:
-                    d.slice_state = remaining
-                results.append(d)
+            # No leaders remaining: tail without leaders.
+            if use_slices:
+                if not least_free and dom.slice_state >= rem[0]:
+                    dom = _best_fit_for_slices(sorted_domains[i:], rem[0], 0)
+                dom.leader_state = 0
+                if dom.slice_state >= rem[0]:
+                    dom.state = rem[0] * slice_size
+                    dom.slice_state = rem[0]
+                    results.append(dom)
+                    return results
+                dom.state = dom.slice_state * slice_size
+                rem[0] -= dom.slice_state
+                results.append(dom)
+                continue
+            if not least_free and dom.state >= rem[0]:
+                dom = _best_fit_for_pods(sorted_domains[i:], rem[0], 0)
+            dom.leader_state = 0
+            if dom.state >= rem[0]:
+                dom.state = rem[0]
+                results.append(dom)
                 return results
-            d.state = take * slice_size if use_slices else take
-            remaining -= take
-            results.append(d)
-        if remaining > 0 or remaining_leaders > 0:
+            rem[0] -= dom.state
+            results.append(dom)
+        if rem[0] > 0 or rem[1] > 0:
             return None  # accounting violated upstream
         return results
 
-    def _not_fit_message(self, fit: int, want: int) -> str:
-        """notFitMessage."""
-        if want == 1:
-            return "topology %r doesn't allow to fit any pod" % \
-                self.topology_name
-        return (f"topology {self.topology_name!r} allows to fit only "
-                f"{fit} out of {want} slice(s)/pod(s)")
+    def _not_fit(self, state: _AssignState, fit: int, want: int,
+                 level_idx: int) -> str:
+        """notFitReason closure of findLevelWithFitDomains :1394."""
+        if state.multi_layer:
+            return self._multi_layer_not_fit_message(
+                level_idx, state.count, state.multi_layer, state.stats())
+        return self._not_fit_message(fit, want, state.slice_size,
+                                     state.stats())
+
+    def _not_fit_message(self, fit: int, want: int, slice_size: int = 1,
+                         stats: Optional[ExclusionStats] = None) -> str:
+        """notFitMessage :1971 — quantities in slice units when slices
+        are requested, with the exclusion-stats tail."""
+        unit = "pod" if slice_size == 1 else "slice"
+        if fit == 0:
+            msg = (f'topology "{self.topology_name}" doesn\'t allow to fit '
+                   f'any of {want} {unit}(s)')
+        else:
+            msg = (f'topology "{self.topology_name}" allows to fit only '
+                   f'{fit} out of {want} {unit}(s)')
+        if stats is not None and stats.has_exclusions():
+            msg += (f". Total nodes: {stats.total_nodes}; "
+                    f"excluded: {stats.format_reasons()}")
+        return msg
+
+    def _multi_layer_not_fit_message(self, level_idx: int, count: int,
+                                     constraints: tuple,
+                                     stats: Optional[ExclusionStats]
+                                     ) -> str:
+        """multiLayerNotFitMessage :2004: per-layer best-case fit counts
+        from the best domain at the required level."""
+        msg = f'topology "{self.topology_name}" doesn\'t allow to fit'
+        best = None
+        for d in self.domains_per_level[level_idx].values():
+            if best is None or d.slice_state > best.slice_state or (
+                    d.slice_state == best.slice_state and d.id < best.id):
+                best = d
+        if best is None:
+            return msg
+        for layer_key, layer_size in constraints:
+            if layer_key not in self.level_keys:
+                continue
+            target_idx = self.level_keys.index(layer_key)
+            needed = count // layer_size
+            fit = _count_slices_in_subtree(best, level_idx, target_idx,
+                                           layer_size)
+            msg += f"; {fit}/{needed} slice(s) fit on level {layer_key}"
+        if stats is not None and stats.has_exclusions():
+            msg += (f". Total nodes: {stats.total_nodes}; "
+                    f"excluded: {stats.format_reasons()}")
+        return msg
+
+
+def _count_slices_in_subtree(d, current_level: int, target_level: int,
+                             slice_size: int) -> int:
+    """countSlicesInSubtree :1993."""
+    if current_level == target_level:
+        return d.state // slice_size
+    return sum(_count_slices_in_subtree(c, current_level + 1, target_level,
+                                        slice_size) for c in d.children)
+
+
+def _best_fit_by(sorted_domains: list, needed: int, cap):
+    """findBestFitDomainBy :1355: the FIRST domain with the lowest
+    capacity >= needed; the first (most-capacity) domain if none fit."""
+    best = sorted_domains[0]
+    best_cap = cap(best)
+    for d in sorted_domains:
+        c = cap(d)
+        if c >= needed and c < best_cap:
+            best = d
+            best_cap = c
+    return best
 
 
 def _best_fit_for_slices(sorted_domains: list, slice_count: int,
                          leader_count: int):
-    """findBestFitDomainForSlices: among fitting domains, the one with the
-    least leftover slice capacity (first in sorted order on ties)."""
-    def cap(d):
-        return d.slice_state_with_leader if leader_count > 0 \
-            else d.slice_state
-
-    best = None
-    for d in sorted_domains:
-        if cap(d) >= slice_count and d.leader_state >= leader_count and (
-                best is None or cap(d) < cap(best)):
-            best = d
-    return best if best is not None else sorted_domains[0]
+    """findBestFitDomainForSlices :1342."""
+    if leader_count > 0:
+        return _best_fit_by(sorted_domains, slice_count,
+                            lambda d: d.slice_state_with_leader)
+    return _best_fit_by(sorted_domains, slice_count,
+                        lambda d: d.slice_state)
 
 
 def _best_fit_for_pods(sorted_domains: list, count: int, leader_count: int):
-    """findBestFitDomain — pod-count flavor of the above."""
-    def cap(d):
-        return d.state_with_leader if leader_count > 0 else d.state
+    """findBestFitDomain :1326 — pod-count flavor of the above."""
+    if leader_count > 0:
+        return _best_fit_by(sorted_domains, count,
+                            lambda d: d.state_with_leader)
+    return _best_fit_by(sorted_domains, count, lambda d: d.state)
 
-    best = None
-    for d in sorted_domains:
-        if cap(d) >= count and d.leader_state >= leader_count and (
-                best is None or cap(d) < cap(best)):
-            best = d
-    return best if best is not None else sorted_domains[0]
+
+IS_GROUP_WORKLOAD_ANNOTATION = "kueue.x-k8s.io/is-group-workload"
+
+
+def owned_by_single_pod(workload) -> bool:
+    """workload.OwnedBySinglePod (pkg/workload/workload.go:1309): one
+    core/v1 Pod owner and not a pod-group workload."""
+    refs = tuple(getattr(workload, "owner_references", ()) or ())
+    if workload is None or len(refs) != 1:
+        return False
+    anns = getattr(workload, "annotations", {}) or {}
+    if anns.get(IS_GROUP_WORKLOAD_ANNOTATION) == "true":
+        return False
+    api_version, kind = refs[0][0], refs[0][1]
+    return kind == "Pod" and api_version == "v1"
 
 
 def _find_leader_and_workers(trs: list[TASPodSetRequest]):

@@ -1,0 +1,1689 @@
+"""Go-authored TAS goldens: placement tables transliterated from the
+reference's own test suites, run against the host walk (and the device
+paths where the request qualifies).
+
+Sources (case names preserved verbatim):
+  * pkg/cache/scheduler/tas_cache_test.go (TestFindTopologyAssignments,
+    the 8.3k-line placement table — slices, leaders, groups, elastic,
+    replacement, multi-layer, exclusion stats)
+  * pkg/cache/scheduler/tas_flavor_snapshot_test.go (merge / truncate /
+    sorted-domain / HasLevel / assumed-usage helper tables)
+
+Conventions: quantities are the reference's raw Requests units (cpu in
+milli — resource.MustParse("1") == 1000; memory in bytes; pods in
+counts). Go compresses assignment Levels to [hostname] when the lowest
+topology level is the hostname label (buildAssignment
+tas_flavor_snapshot.go:1660); our assignments always carry full level
+paths in the same (full-path lexicographic) order, so the comparator
+maps ours down before diffing.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kueue_tpu.api.types import (
+    Admission,
+    PodSet,
+    PodSetAssignmentStatus,
+    PodSetTopologyRequest,
+    Taint,
+    Toleration,
+    Topology,
+    TopologyLevel,
+    TopologyMode,
+    Workload,
+    WorkloadStatus,
+)
+from kueue_tpu.config import features
+from kueue_tpu.tas.snapshot import (
+    HOSTNAME_LABEL,
+    Node,
+    TASFlavorSnapshot,
+    TASPodSetRequest,
+    TopologyAssignment,
+    TopologyDomainAssignment,
+    merge_topology_assignments,
+    truncate_assignment,
+)
+
+HOST = HOSTNAME_LABEL
+BLOCK = "cloud.com/topology-block"
+RACK = "cloud.com/topology-rack"
+SUBBLOCK = "cloud.com/topology-subblock"
+DC = "cloud.com/datacenter"
+AIZONE = "cloud.com/aizone"
+
+ONE_LEVEL = [HOST]
+TWO_LEVELS = [BLOCK, RACK]
+THREE_LEVELS = [BLOCK, RACK, HOST]
+
+GI = 1024 * 1024 * 1024
+
+
+def N(name, labels, cpu=None, mem=None, pods=None, ready=True,
+      taints=(), unschedulable=False, extra=None):
+    cap = {}
+    if cpu is not None:
+        cap["cpu"] = cpu
+    if mem is not None:
+        cap["memory"] = mem
+    if pods is not None:
+        cap["pods"] = pods
+    if extra:
+        cap.update(extra)
+    return Node(name=name, labels=dict(labels), capacity=cap,
+                taints=tuple(taints), ready=ready,
+                unschedulable=unschedulable)
+
+
+def _h3(name, block, rack, host, **kw):
+    return N(name, {BLOCK: block, RACK: rack, HOST: host}, **kw)
+
+
+# tas_cache_test.go:75 (defaultNodes) — note b2-r2-x2 carries rack r1.
+def default_nodes():
+    return [
+        _h3("b1-r1-x3", "b1", "r1", "x3", cpu=1000, mem=GI, pods=10),
+        _h3("b1-r2-x5", "b1", "r2", "x5", cpu=1000, mem=GI, pods=10),
+        _h3("b1-r2-x1", "b1", "r2", "x1", cpu=1000, mem=GI, pods=10),
+        _h3("b1-r2-x6", "b1", "r2", "x6", cpu=1000, mem=GI, pods=10),
+        _h3("b2-r2-x2", "b2", "r1", "x2", cpu=1000, mem=GI, pods=10),
+        _h3("b2-r2-x4", "b2", "r2", "x4", cpu=2000, mem=4 * GI, pods=40),
+    ]
+
+
+# tas_cache_test.go:149 (scatteredNodes).
+def scattered_nodes():
+    return [
+        _h3("b1-r1-x3", "b1", "r1", "x3", cpu=4000, mem=GI, pods=10),
+        _h3("b1-r1-x5", "b1", "r1", "x5", cpu=1000, mem=GI, pods=10),
+        _h3("b1-r1-x1", "b1", "r1", "x1", cpu=1000, mem=GI, pods=10),
+        _h3("b2-r1-x6", "b2", "r1", "x6", cpu=2000, mem=GI, pods=10),
+        _h3("b2-r1-x2", "b2", "r1", "x2", cpu=1000, mem=GI, pods=10),
+    ]
+
+
+# tas_cache_test.go:212 (multipodNodeset).
+def multipod_nodes():
+    return [
+        _h3("b1-r1-x3", "b1", "r1", "x3", cpu=10000, mem=GI, pods=10),
+        _h3("b1-r2-x5", "b1", "r2", "x5", cpu=10000, mem=GI, pods=10),
+        _h3("b1-r2-x1", "b1", "r2", "x1", cpu=10000, mem=GI, pods=10),
+        _h3("b1-r2-x6", "b1", "r2", "x6", cpu=10000, mem=GI, pods=10),
+        _h3("b2-r1-x2", "b2", "r1", "x2", cpu=10000, mem=GI, pods=10),
+        _h3("b2-r2-x4", "b2", "r2", "x4", cpu=20000, mem=4 * GI, pods=40),
+    ]
+
+
+# tas_cache_test.go:298 (binaryTreesNodes).
+def binary_tree_nodes():
+    out = []
+    for block, rack, host in (("b1", "r1", "x3"), ("b1", "r1", "x5"),
+                              ("b1", "r2", "x1"), ("b1", "r2", "x6"),
+                              ("b2", "r1", "x2"), ("b2", "r1", "x4"),
+                              ("b2", "r2", "x7"), ("b2", "r2", "x8")):
+        out.append(_h3(f"{block}-{rack}-{host}", block, rack, host,
+                       cpu=1000, mem=GI, pods=10))
+    return out
+
+
+def _pod(name, node="", cpu=None, terminated=False):
+    """testingpod.MakePod analog feeding the non-TAS usage cache."""
+    from kueue_tpu.tas.non_tas_usage import PodUsage
+    reqs = {"cpu": cpu} if cpu is not None else {}
+    return PodUsage(namespace="test-ns", name=name, node_name=node,
+                    requests=reqs, terminated=terminated)
+
+
+def TR(mode=None, level=None, slice_level=None, slice_size=None,
+       group=None, constraints=()):
+    if mode is None and level is None and slice_level is None \
+            and slice_size is None and not constraints and group is None:
+        return None
+    return PodSetTopologyRequest(
+        mode=mode if mode is not None else TopologyMode.UNCONSTRAINED,
+        level=level, slice_level=slice_level, slice_size=slice_size,
+        slice_constraints=tuple(constraints), pod_set_group_name=group)
+
+
+def PS(name="main", count=1, requests=None, tr=None, selector=None,
+       tolerations=(), affinity=(), previous=None):
+    """One PodSetTestCase input (tas_cache_test.go:47)."""
+    return dict(name=name, count=count, requests=dict(requests or {}),
+                tr=tr, selector=dict(selector or {}),
+                tolerations=tuple(tolerations), affinity=tuple(affinity),
+                previous=previous)
+
+
+def A(levels, *domains):
+    """wantAssignment: (levels, ((values..., count), ...)) — values in
+    the reference's emitted (possibly hostname-compressed) form, count
+    last."""
+    return (list(levels), [(list(d[:-1]), d[-1]) for d in domains])
+
+
+def ta(levels, *domains):
+    """Build a concrete TopologyAssignment (for previous/existing)."""
+    return TopologyAssignment(
+        tuple(levels),
+        tuple(TopologyDomainAssignment(tuple(v), c) for v, c in domains))
+
+
+def make_workload(pod_set_assignments, unhealthy=(), owners=(),
+                  annotations=None):
+    wl = Workload(name="wl", namespace="ns",
+                  owner_references=tuple(owners),
+                  annotations=dict(annotations or {}))
+    wl.status = WorkloadStatus()
+    wl.status.admission = Admission(
+        cluster_queue="cq",
+        pod_set_assignments=tuple(pod_set_assignments))
+    wl.status.unhealthy_nodes = tuple(unhealthy)
+    return wl
+
+
+@pytest.fixture(autouse=True)
+def _reset_features():
+    features.reset()
+    yield
+    features.reset()
+
+
+def run_case(tc):
+    """The TestFindTopologyAssignments runner (tas_cache_test.go:7070+):
+    build the snapshot from nodes (filtered by the flavor's nodeLabels),
+    run FindTopologyAssignmentsForFlavor over every pod set, compare
+    per-pod-set assignment/reason."""
+    for gate, val in (tc.get("gates") or {}).items():
+        features.set_feature(gate, val)
+    levels = tc["levels"]
+    topo = Topology("default", tuple(TopologyLevel(k) for k in levels))
+    snap = TASFlavorSnapshot(
+        topo, flavor_tolerations=tuple(tc.get("flavor_tolerations", ())))
+    non_tas = None
+    if tc.get("pods"):
+        from kueue_tpu.tas.non_tas_usage import NonTASUsageCache
+        non_tas = NonTASUsageCache()
+        for pod in tc["pods"]:
+            non_tas.update(pod)
+    node_labels = tc.get("node_labels") or {}
+    for node in tc["nodes"]:
+        if all(node.labels.get(k) == v for k, v in node_labels.items()):
+            snap.add_node(node, non_tas_usage=(
+                non_tas.node_usage(node.name) if non_tas else None))
+    for values, usage in (tc.get("prior_usage") or {}).items():
+        snap.install_usage(tuple(values), dict(usage))
+
+    requests = []
+    for ps in tc["pod_sets"]:
+        pod_set = PodSet(ps["name"], ps["count"], dict(ps["requests"]),
+                         topology_request=ps["tr"],
+                         node_selector=ps["selector"],
+                         tolerations=ps["tolerations"],
+                         node_affinity=ps["affinity"])
+        requests.append(TASPodSetRequest(
+            pod_set, dict(ps["requests"]), ps["count"],
+            previous_assignment=ps["previous"]))
+
+    results, reason = snap.find_topology_assignments_for_flavor(
+        requests, workload=tc.get("workload"))
+
+    for ps in tc["pod_sets"]:
+        want_reason = ps.get("want_reason", "")
+        got = results.get(ps["name"])
+        if want_reason:
+            assert got is None, (ps["name"], got)
+            assert reason == want_reason, (
+                f"\n got: {reason}\nwant: {want_reason}")
+            continue
+        want = ps.get("want")
+        if want is None:
+            continue
+        assert got is not None, (ps["name"], reason)
+        want_levels, want_domains = want
+        got_domains = [(list(d.values), d.count) for d in got.domains]
+        if want_levels == ONE_LEVEL and len(levels) > 1 \
+                and levels[-1] == HOST:
+            # buildAssignment hostname compression (:1664-1667): the
+            # full-path lex order is preserved, values keep the tail.
+            got_domains = [(v[-1:], c) for v, c in got_domains]
+        assert got_domains == want_domains, (
+            f"{ps['name']}\n got: {got_domains}\nwant: {want_domains}")
+
+
+def run(name):
+    run_case(CASES[name])
+
+
+# ---------------------------------------------------------------------------
+# TestFindTopologyAssignments (tas_cache_test.go:61) — transliterated
+# cases, names preserved.
+# ---------------------------------------------------------------------------
+
+CPU = "cpu"
+
+CASES = {
+    "node replaced for single-Pod-owned workload; gate off": dict(
+        gates={"SkipReassignmentForPodOwnedWorkloads": False},
+        nodes=[N("x1", {HOST: "x1"}, cpu=1000, pods=10, ready=False),
+               N("x2", {HOST: "x2"}, cpu=1000, pods=10)],
+        levels=ONE_LEVEL,
+        workload=make_workload(
+            [PodSetAssignmentStatus(
+                "main", count=1,
+                topology_assignment=ta(ONE_LEVEL, (["x1"], 1)))],
+            unhealthy=["x1"],
+            owners=[("v1", "Pod", "owner-0", "uid-0")]),
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, HOST),
+                     ) | dict(want=A(ONE_LEVEL, ("x2", 1)))],
+    ),
+    "node replacement skipped for single-Pod-owned workload; gate on": dict(
+        gates={"SkipReassignmentForPodOwnedWorkloads": True},
+        nodes=[N("x1", {HOST: "x1"}, cpu=1000, pods=10, ready=False),
+               N("x2", {HOST: "x2"}, cpu=1000, pods=10)],
+        levels=ONE_LEVEL,
+        workload=make_workload(
+            [PodSetAssignmentStatus(
+                "main", count=1,
+                topology_assignment=ta(ONE_LEVEL, (["x1"], 1)))],
+            unhealthy=["x1"],
+            owners=[("v1", "Pod", "owner-0", "uid-0")]),
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, HOST),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 1)))],
+    ),
+    "node replaced for Job-owned workload; gate on": dict(
+        gates={"SkipReassignmentForPodOwnedWorkloads": True},
+        nodes=[N("x1", {HOST: "x1"}, cpu=1000, pods=10, ready=False),
+               N("x2", {HOST: "x2"}, cpu=1000, pods=10)],
+        levels=ONE_LEVEL,
+        workload=make_workload(
+            [PodSetAssignmentStatus(
+                "main", count=1,
+                topology_assignment=ta(ONE_LEVEL, (["x1"], 1)))],
+            unhealthy=["x1"],
+            owners=[("batch/v1", "Job", "owner-0", "uid-0")]),
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, HOST),
+                     ) | dict(want=A(ONE_LEVEL, ("x2", 1)))],
+    ),
+    "node replaced for pod-group workload with two Pod owners; gate on":
+    dict(
+        gates={"SkipReassignmentForPodOwnedWorkloads": True},
+        nodes=[N("x1", {HOST: "x1"}, cpu=1000, pods=10, ready=False),
+               N("x2", {HOST: "x2"}, cpu=1000, pods=10)],
+        levels=ONE_LEVEL,
+        workload=make_workload(
+            [PodSetAssignmentStatus(
+                "main", count=1,
+                topology_assignment=ta(ONE_LEVEL, (["x1"], 1)))],
+            unhealthy=["x1"],
+            owners=[("v1", "Pod", "owner-0", "uid-0"),
+                    ("v1", "Pod", "owner-1", "uid-1")]),
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, HOST),
+                     ) | dict(want=A(ONE_LEVEL, ("x2", 1)))],
+    ),
+    "node replaced for size-1 pod-group workload (is-group-workload "
+    "annotation); gate on": dict(
+        gates={"SkipReassignmentForPodOwnedWorkloads": True},
+        nodes=[N("x1", {HOST: "x1"}, cpu=1000, pods=10, ready=False),
+               N("x2", {HOST: "x2"}, cpu=1000, pods=10)],
+        levels=ONE_LEVEL,
+        workload=make_workload(
+            [PodSetAssignmentStatus(
+                "main", count=1,
+                topology_assignment=ta(ONE_LEVEL, (["x1"], 1)))],
+            unhealthy=["x1"],
+            owners=[("v1", "Pod", "owner-0", "uid-0")],
+            annotations={"kueue.x-k8s.io/is-group-workload": "true"}),
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, HOST),
+                     ) | dict(want=A(ONE_LEVEL, ("x2", 1)))],
+    ),
+    "minimize the number of used racks before optimizing the number of "
+    "nodes; BestFit": dict(
+        nodes=[_h3("b1-r1-x3", "b1", "r1", "x3", cpu=2000, pods=10),
+               _h3("b1-r2-x5", "b1", "r2", "x5", cpu=2000, pods=20),
+               _h3("b1-r3-x1", "b1", "r3", "x1", cpu=1000, pods=10),
+               _h3("b1-r3-x6", "b1", "r3", "x6", cpu=1000, pods=10),
+               _h3("b1-r3-x2", "b1", "r3", "x2", cpu=1000, pods=10),
+               _h3("b1-r3-x4", "b1", "r3", "x4", cpu=1000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 4, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 1), ("x2", 1),
+                                     ("x4", 1), ("x6", 1)))],
+    ),
+    "choose the node that can accommodate all Pods": dict(
+        nodes=[_h3("b1-r1-x3", "b1", "r1", "x3", cpu=2000, pods=10),
+               _h3("b1-r1-x5", "b1", "r1", "x5", cpu=1000, pods=10),
+               _h3("b1-r1-x1", "b1", "r1", "x1", cpu=1000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 2, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK),
+                     ) | dict(want=A(ONE_LEVEL, ("x3", 2)))],
+    ),
+    "no annotation; implied default to unconstrained; 6 pods fit into "
+    "hosts scattered across the whole datacenter even they could fit "
+    "into single rack; BestFit": dict(
+        nodes=scattered_nodes(), levels=THREE_LEVELS,
+        pod_sets=[PS("main", 6, {CPU: 1000}, None,
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 1), ("x3", 1),
+                                     ("x5", 1), ("x2", 1), ("x6", 2)))],
+    ),
+    "unconstrained; 6 pods fit into hosts scattered across the whole "
+    "datacenter even they could fit into single rack; BestFit": dict(
+        nodes=scattered_nodes(), levels=THREE_LEVELS,
+        pod_sets=[PS("main", 6, {CPU: 1000},
+                     TR(TopologyMode.UNCONSTRAINED),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 1), ("x3", 1),
+                                     ("x5", 1), ("x2", 1), ("x6", 2)))],
+    ),
+    "unconstrained; a single pod fits into each host; BestFit": dict(
+        nodes=default_nodes(), levels=THREE_LEVELS,
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.UNCONSTRAINED),
+                     ) | dict(want=A(ONE_LEVEL, ("x3", 1)))],
+    ),
+    "unconstrained; a single pod fits into each host; LeastFreeCapacity; "
+    "TASProfileMixed": dict(
+        gates={"TASProfileMixed": True},
+        nodes=default_nodes(), levels=THREE_LEVELS,
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.UNCONSTRAINED),
+                     ) | dict(want=A(ONE_LEVEL, ("x3", 1)))],
+    ),
+    "block required; 4 pods fit into one host each; BestFit": dict(
+        nodes=binary_tree_nodes(), levels=THREE_LEVELS,
+        pod_sets=[PS("main", 4, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK),
+                     ) | dict(want=A(ONE_LEVEL, ("x3", 1), ("x5", 1),
+                                     ("x1", 1), ("x6", 1)))],
+    ),
+    "host required; single Pod fits in the host; BestFit": dict(
+        nodes=default_nodes(), levels=THREE_LEVELS,
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, HOST),
+                     ) | dict(want=A(ONE_LEVEL, ("x3", 1)))],
+    ),
+    "rack required; single Pod fits in a rack; BestFit": dict(
+        nodes=default_nodes(), levels=TWO_LEVELS,
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, RACK),
+                     ) | dict(want=A(TWO_LEVELS, ("b1", "r1", 1)))],
+    ),
+    "rack required; multiple Pods fit in a rack; BestFit": dict(
+        nodes=default_nodes(), levels=TWO_LEVELS,
+        pod_sets=[PS("main", 3, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, RACK),
+                     ) | dict(want=A(TWO_LEVELS, ("b1", "r2", 3)))],
+    ),
+    "block preferred; Pods fit in 2 blocks; BestFit": dict(
+        nodes=[N("b1", {BLOCK: "b1"}, cpu=2000, pods=20),
+               N("b2", {BLOCK: "b2"}, cpu=1000, pods=10),
+               N("b3", {BLOCK: "b3"}, cpu=4000, pods=40)],
+        levels=[BLOCK],
+        pod_sets=[PS("main", 5, {CPU: 1000},
+                     TR(TopologyMode.PREFERRED, BLOCK),
+                     ) | dict(want=A([BLOCK], ("b2", 1), ("b3", 4)))],
+    ),
+    "rack required; multiple Pods fit in some racks; BestFit": dict(
+        nodes=default_nodes(), levels=TWO_LEVELS,
+        pod_sets=[PS("main", 2, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, RACK),
+                     ) | dict(want=A(TWO_LEVELS, ("b2", "r2", 2)))],
+    ),
+    "rack required; too many pods to fit in any rack; BestFit": dict(
+        nodes=default_nodes(), levels=TWO_LEVELS,
+        pod_sets=[PS("main", 4, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, RACK)) | dict(
+            want_reason='topology "default" allows to fit only 3 out of '
+                        '4 pod(s)')],
+    ),
+    "block required; single Pod fits in a block and a single rack; "
+    "BestFit": dict(
+        nodes=default_nodes(), levels=TWO_LEVELS,
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK),
+                     ) | dict(want=A(TWO_LEVELS, ("b2", "r1", 1)))],
+    ),
+    "block required; single Pod fits in a block spread across two racks; "
+    "BestFit": dict(
+        nodes=default_nodes(), levels=TWO_LEVELS,
+        pod_sets=[PS("main", 4, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK),
+                     ) | dict(want=A(TWO_LEVELS, ("b1", "r1", 1),
+                                     ("b1", "r2", 3)))],
+    ),
+    "block required; Pods fit in a block spread across two racks; "
+    "BestFit": dict(
+        nodes=default_nodes(), levels=TWO_LEVELS,
+        pod_sets=[PS("main", 4, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK),
+                     ) | dict(want=A(TWO_LEVELS, ("b1", "r1", 1),
+                                     ("b1", "r2", 3)))],
+    ),
+    "block required; single Pod which cannot be split; BestFit": dict(
+        nodes=default_nodes(), levels=TWO_LEVELS,
+        pod_sets=[PS("main", 1, {CPU: 4000},
+                     TR(TopologyMode.REQUIRED, BLOCK)) | dict(
+            want_reason='topology "default" doesn\'t allow to fit any of '
+                        '1 pod(s). Total nodes: 4; excluded: '
+                        'resource "cpu": 4')],
+    ),
+    "block required; too many Pods to fit requested; BestFit": dict(
+        nodes=default_nodes(), levels=TWO_LEVELS,
+        pod_sets=[PS("main", 5, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK)) | dict(
+            want_reason='topology "default" allows to fit only 4 out of '
+                        '5 pod(s)')],
+    ),
+    "rack required; single Pod requiring memory; BestFit": dict(
+        nodes=default_nodes(), levels=TWO_LEVELS,
+        pod_sets=[PS("main", 4, {"memory": 1024},
+                     TR(TopologyMode.REQUIRED, RACK),
+                     ) | dict(want=A(TWO_LEVELS, ("b1", "r1", 4)))],
+    ),
+    "rack preferred; but only block can accommodate the workload; "
+    "BestFit": dict(
+        nodes=default_nodes(), levels=TWO_LEVELS,
+        pod_sets=[PS("main", 4, {CPU: 1000},
+                     TR(TopologyMode.PREFERRED, RACK),
+                     ) | dict(want=A(TWO_LEVELS, ("b1", "r1", 1),
+                                     ("b1", "r2", 3)))],
+    ),
+    "rack preferred; but only multiple blocks can accommodate the "
+    "workload; BestFit": dict(
+        nodes=default_nodes(), levels=TWO_LEVELS,
+        pod_sets=[PS("main", 6, {CPU: 1000},
+                     TR(TopologyMode.PREFERRED, RACK),
+                     ) | dict(want=A(TWO_LEVELS, ("b1", "r1", 1),
+                                     ("b1", "r2", 3), ("b2", "r2", 2)))],
+    ),
+    "block preferred; but only multiple blocks can accommodate the "
+    "workload; BestFit": dict(
+        nodes=default_nodes(), levels=TWO_LEVELS,
+        pod_sets=[PS("main", 6, {CPU: 1000},
+                     TR(TopologyMode.PREFERRED, BLOCK),
+                     ) | dict(want=A(TWO_LEVELS, ("b1", "r1", 1),
+                                     ("b1", "r2", 3), ("b2", "r2", 2)))],
+    ),
+    "block preferred; but the workload cannot be accommodate in entire "
+    "topology; BestFit": dict(
+        nodes=default_nodes(), levels=TWO_LEVELS,
+        pod_sets=[PS("main", 10, {CPU: 1000},
+                     TR(TopologyMode.PREFERRED, BLOCK)) | dict(
+            want_reason='topology "default" allows to fit only 7 out of '
+                        '10 pod(s)')],
+    ),
+    "detailed failure message with exclusion stats": dict(
+        nodes=[N("x1", {HOST: "x1"}, cpu=1000, pods=10,
+                 taints=[Taint("key", "value", "NoSchedule")]),
+               N("x2", {HOST: "x2", "zone": "zone-b"}, cpu=1000, pods=10),
+               N("x3", {HOST: "x3", "zone": "zone-b"}, cpu=2000, pods=10),
+               N("x4", {HOST: "x4", "zone": "zone-a"}, cpu=100, pods=10)],
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, HOST),
+                     selector={"zone": "zone-a"}) | dict(
+            want_reason='topology "default" doesn\'t allow to fit any of '
+                        '1 pod(s). Total nodes: 4; excluded: '
+                        'nodeSelector: 2, resource "cpu": 1, '
+                        'taint "key=value:NoSchedule": 1')],
+    ),
+    "resource exclusion picks most restrictive resource": dict(
+        nodes=[N("dual-shortage", {HOST: "dual-shortage"}, cpu=500,
+                 pods=10, extra={"example.com/gpu": 0})],
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 1, {CPU: 1000, "example.com/gpu": 1},
+                     TR(TopologyMode.REQUIRED, HOST)) | dict(
+            want_reason='topology "default" doesn\'t allow to fit any of '
+                        '1 pod(s). Total nodes: 1; excluded: '
+                        'resource "cpu": 1')],
+    ),
+    "allow to schedule on node with tolerated taint; BestFit": dict(
+        nodes=[N("b1-r1-x3", {"zone": "zone-a", HOST: "x3"}, cpu=1000,
+                 mem=GI, pods=10,
+                 taints=[Taint("example.com/gpu", "present",
+                               "NoSchedule")])],
+        levels=ONE_LEVEL,
+        node_labels={"zone": "zone-a"},
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, HOST),
+                     tolerations=[Toleration("example.com/gpu", "Equal",
+                                             "present")],
+                     ) | dict(want=A(ONE_LEVEL, ("x3", 1)))],
+    ),
+    "skip node which has untolerated taint; BestFit": dict(
+        nodes=[N("b1-r1-x3", {"zone": "zone-a", HOST: "x3"}, cpu=1000,
+                 mem=GI, pods=10,
+                 taints=[Taint("example.com/gpu", "present",
+                               "NoSchedule")])],
+        levels=ONE_LEVEL,
+        node_labels={"zone": "zone-a"},
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, HOST)) | dict(
+            want_reason='topology "default" doesn\'t allow to fit any of '
+                        '1 pod(s). Total nodes: 1; excluded: '
+                        'taint "example.com/gpu=present:NoSchedule": 1')],
+    ),
+    "no assignment as node is not ready; BestFit": dict(
+        nodes=[N("b1-r1-x3", {"zone": "zone-a", HOST: "x3"}, cpu=1000,
+                 mem=GI, pods=10, ready=False)],
+        levels=ONE_LEVEL,
+        node_labels={"zone": "zone-a"},
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, HOST)) | dict(
+            want_reason="no topology domains at level: "
+                        "kubernetes.io/hostname")],
+    ),
+    "no assignment as node is unschedulable; BestFit": dict(
+        nodes=[N("b1-r1-x3", {"zone": "zone-a", HOST: "x3"}, cpu=1000,
+                 mem=GI, pods=10, unschedulable=True)],
+        levels=ONE_LEVEL,
+        node_labels={"zone": "zone-a"},
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, HOST)) | dict(
+            want_reason="no topology domains at level: "
+                        "kubernetes.io/hostname")],
+    ),
+    "only nodes with matching labels are considered; no matching node; "
+    "BestFit": dict(
+        nodes=[N("b1-r1-x3", {"zone": "zone-a", HOST: "x3"}, cpu=1000,
+                 mem=GI, pods=10)],
+        levels=ONE_LEVEL,
+        node_labels={"zone": "zone-b"},
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, HOST)) | dict(
+            want_reason="no topology domains at level: "
+                        "kubernetes.io/hostname")],
+    ),
+    "only nodes with matching labels are considered; matching node is "
+    "found; BestFit": dict(
+        nodes=[N("b1-r1-x3", {"zone": "zone-a", HOST: "x3"}, cpu=1000,
+                 mem=GI, pods=10)],
+        levels=ONE_LEVEL,
+        node_labels={"zone": "zone-a"},
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, HOST),
+                     ) | dict(want=A(ONE_LEVEL, ("x3", 1)))],
+    ),
+    "only nodes with matching levels are considered; no host label on "
+    "node; BestFit": dict(
+        nodes=[N("b1-r1-x3", {BLOCK: "b1", RACK: "r1"}, cpu=1000,
+                 mem=GI, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, RACK)) | dict(
+            want_reason="no topology domains at level: "
+                        "cloud.com/topology-rack")],
+    ),
+    "don't consider unscheduled Pods when computing capacity; BestFit":
+    dict(
+        nodes=[N("x3", {HOST: "x3"}, cpu=1000, mem=GI, pods=10)],
+        pods=[_pod("test-unscheduled", node="", cpu=600)],
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 1, {CPU: 600},
+                     TR(TopologyMode.REQUIRED, HOST),
+                     ) | dict(want=A(ONE_LEVEL, ("x3", 1)))],
+    ),
+    "don't consider terminal pods when computing the capacity; BestFit":
+    dict(
+        nodes=[N("x3", {HOST: "x3"}, cpu=1000, mem=GI, pods=10)],
+        pods=[_pod("test-failed", node="x3", cpu=600, terminated=True),
+              _pod("test-succeeded", node="x3", cpu=600,
+                   terminated=True)],
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 1, {CPU: 600},
+                     TR(TopologyMode.REQUIRED, HOST),
+                     ) | dict(want=A(ONE_LEVEL, ("x3", 1)))],
+    ),
+    "include usage from pending scheduled non-TAS pods, blocked "
+    "assignment; BestFit": dict(
+        nodes=[N("x3", {HOST: "x3"}, cpu=1000, mem=GI, pods=10)],
+        pods=[_pod("test-pending", node="x3", cpu=600)],
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 1, {CPU: 600},
+                     TR(TopologyMode.REQUIRED, HOST)) | dict(
+            want_reason='topology "default" doesn\'t allow to fit any of '
+                        '1 pod(s). Total nodes: 1; excluded: '
+                        'resource "cpu": 1')],
+    ),
+    "include usage from running non-TAS pods, blocked assignment; "
+    "BestFit": dict(
+        nodes=[N("x3", {HOST: "x3"}, cpu=1000, mem=GI, pods=10)],
+        pods=[_pod("test-running", node="x3", cpu=600)],
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 1, {CPU: 600},
+                     TR(TopologyMode.REQUIRED, HOST)) | dict(
+            want_reason='topology "default" doesn\'t allow to fit any of '
+                        '1 pod(s). Total nodes: 1; excluded: '
+                        'resource "cpu": 1')],
+    ),
+    "include usage from non-TAS pods; pod usage": dict(
+        nodes=[N("x3", {HOST: "x3"}, pods=10)],
+        pods=[_pod("running1", node="x3"), _pod("running2", node="x3")],
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 9, {CPU: 0},
+                     TR(TopologyMode.REQUIRED, HOST)) | dict(
+            want_reason='topology "default" allows to fit only 8 out of '
+                        '9 pod(s)')],
+    ),
+    "include usage from running non-TAS pods, found free capacity on "
+    "another node; BestFit": dict(
+        nodes=[N("x3", {HOST: "x3"}, cpu=1000, mem=GI, pods=10),
+               N("x5", {HOST: "x5"}, cpu=1000, mem=GI, pods=10)],
+        pods=[_pod("test-pod", node="x3", cpu=600)],
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 1, {CPU: 600},
+                     TR(TopologyMode.REQUIRED, HOST),
+                     ) | dict(want=A(ONE_LEVEL, ("x5", 1)))],
+    ),
+    "no assignment as node does not have enough allocatable pods "
+    "(.status.allocatable['pods']); BestFit": dict(
+        nodes=[N("b1-r1-x3", {"zone": "zone-a", HOST: "x3"}, cpu=1000,
+                 pods=1)],
+        pods=[_pod("test-running", node="b1-r1-x3", cpu=300)],
+        node_labels={"zone": "zone-a"},
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 1, {CPU: 300},
+                     TR(TopologyMode.REQUIRED, HOST)) | dict(
+            want_reason='topology "default" doesn\'t allow to fit any of '
+                        '1 pod(s). Total nodes: 1; excluded: '
+                        'resource "pods": 1')],
+    ),
+    "multiple PodSets account assumed pod usage against allocatable "
+    "pods; BestFit": dict(
+        nodes=[N("x1", {HOST: "x1"}, cpu=2000, pods=1)],
+        levels=ONE_LEVEL,
+        pod_sets=[
+            PS("one", 1, {CPU: 1000}, TR(TopologyMode.REQUIRED, HOST),
+               ) | dict(want=A(ONE_LEVEL, ("x1", 1))),
+            PS("two", 1, {CPU: 1000}, TR(TopologyMode.REQUIRED, HOST),
+               ) | dict(
+                want_reason='topology "default" doesn\'t allow to fit '
+                            'any of 1 pod(s). Total nodes: 1; excluded: '
+                            'resource "pods": 1'),
+        ],
+    ),
+    "skip node which doesn't match node selector, missing label; "
+    "BestFit": dict(
+        nodes=[N("x3", {"zone": "zone-a", HOST: "x3"}, cpu=1000, mem=GI,
+                 pods=10)],
+        node_labels={"zone": "zone-a"},
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 1, {CPU: 300},
+                     TR(TopologyMode.REQUIRED, HOST),
+                     selector={"custom-label-1": "custom-value-1"}) | dict(
+            want_reason='topology "default" doesn\'t allow to fit any of '
+                        '1 pod(s). Total nodes: 1; excluded: '
+                        'nodeSelector: 1')],
+    ),
+    "skip node which doesn't match node selector, label exists, value "
+    "doesn't match; BestFit": dict(
+        nodes=[N("x3", {"zone": "zone-a", HOST: "x3",
+                        "custom-label-1": "value-1"}, cpu=1000, mem=GI,
+                 pods=10)],
+        node_labels={"zone": "zone-a"},
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 1, {CPU: 300},
+                     TR(TopologyMode.REQUIRED, HOST),
+                     selector={"custom-label-1": "value-2"}) | dict(
+            want_reason='topology "default" doesn\'t allow to fit any of '
+                        '1 pod(s). Total nodes: 1; excluded: '
+                        'nodeSelector: 1')],
+    ),
+    "allow to schedule on node which matches node; BestFit": dict(
+        nodes=[N("b1-r1-x3", {"zone": "zone-a", HOST: "x3",
+                              "custom-label-1": "value-1"}, cpu=1000,
+                 mem=GI, pods=10),
+               N("b1-r1-x5", {"zone": "zone-a", HOST: "x5",
+                              "custom-label-1": "value-2"}, cpu=1000,
+                 mem=GI, pods=10)],
+        node_labels={"zone": "zone-a"},
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, HOST),
+                     selector={"custom-label-1": "value-2"},
+                     ) | dict(want=A(ONE_LEVEL, ("x5", 1)))],
+    ),
+    "block required for podset; host required for slices; BestFit": dict(
+        nodes=[_h3("b1-r1-x3", "b1", "r1", "x3", cpu=3000, pods=10),
+               _h3("b1-r1-x5", "b1", "r1", "x5", cpu=3000, pods=10),
+               _h3("b1-r1-x1", "b1", "r1", "x1", cpu=3000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 6, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK, slice_level=HOST,
+                        slice_size=2),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 2), ("x3", 2),
+                                     ("x5", 2)))],
+    ),
+    "block required for podset; host required for slices; prioritize "
+    "more free slice capacity first and then tight fit; BestFit": dict(
+        nodes=[_h3("b1-r1-x3", "b1", "r1", "x3", cpu=6000, pods=10),
+               _h3("b1-r1-x5", "b1", "r1", "x5", cpu=5000, pods=10),
+               _h3("b1-r1-x1", "b1", "r1", "x1", cpu=4000, pods=10),
+               _h3("b1-r1-x6", "b1", "r1", "x6", cpu=2000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 12, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK, slice_level=HOST,
+                        slice_size=2),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 4), ("x3", 6),
+                                     ("x6", 2)))],
+    ),
+    "block required for podset; host required for slices; select "
+    "domains with tight fit; BestFit": dict(
+        nodes=[_h3("b1-r1-x3", "b1", "r1", "x3", cpu=3000, pods=10),
+               _h3("b1-r1-x5", "b1", "r1", "x5", cpu=2000, pods=10),
+               _h3("b1-r1-x1", "b1", "r1", "x1", cpu=2000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 4, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK, slice_level=HOST,
+                        slice_size=2),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 2), ("x5", 2)))],
+    ),
+    "block required for podset; rack required for slices; BestFit": dict(
+        nodes=[_h3("b1-r1-x3", "b1", "r1", "x3", cpu=1000, pods=10),
+               _h3("b1-r1-x5", "b1", "r1", "x5", cpu=1000, pods=10),
+               _h3("b1-r2-x1", "b1", "r2", "x1", cpu=1000, pods=10),
+               _h3("b1-r2-x6", "b1", "r2", "x6", cpu=1000, pods=10),
+               _h3("b1-r2-x2", "b1", "r2", "x2", cpu=1000, pods=10),
+               _h3("b2-r1-x4", "b2", "r1", "x4", cpu=1000, pods=10),
+               _h3("b2-r1-x7", "b2", "r1", "x7", cpu=1000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 4, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK, slice_level=RACK,
+                        slice_size=2),
+                     ) | dict(want=A(ONE_LEVEL, ("x3", 1), ("x5", 1),
+                                     ("x1", 1), ("x2", 1)))],
+    ),
+    "block preferred for podset; rack required for slices; BestFit":
+    dict(
+        nodes=default_nodes(), levels=THREE_LEVELS,
+        pod_sets=[PS("main", 4, {CPU: 1000},
+                     TR(TopologyMode.PREFERRED, BLOCK, slice_level=RACK,
+                        slice_size=2),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 1), ("x5", 1),
+                                     ("x4", 2)))],
+    ),
+    "block required for podset; host required for slices; optimize last "
+    "domain; BestFit": dict(
+        nodes=[_h3("b1-r1-x3", "b1", "r1", "x3", cpu=4000, pods=10),
+               _h3("b1-r1-x5", "b1", "r1", "x5", cpu=3000, pods=10),
+               _h3("b1-r1-x1", "b1", "r1", "x1", cpu=2000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 6, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK, slice_level=HOST,
+                        slice_size=2),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 2), ("x3", 4)))],
+    ),
+    "block preferred for podset; host required for slices; 2 blocks "
+    "with unbalanced subdomains; BestFit": dict(
+        nodes=[_h3("b1-r1-x3", "b1", "r1", "x3", cpu=3000, pods=10),
+               _h3("b1-r1-x5", "b1", "r1", "x5", cpu=3000, pods=10),
+               _h3("b1-r1-x1", "b1", "r1", "x1", cpu=3000, pods=10),
+               _h3("b2-r1-x6", "b2", "r1", "x6", cpu=6000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 12, {CPU: 1000},
+                     TR(TopologyMode.PREFERRED, BLOCK, slice_level=HOST,
+                        slice_size=3),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 3), ("x3", 3),
+                                     ("x6", 6)))],
+    ),
+    "block required for podset; rack required for slices; podset fits "
+    "in a block, but slices do not fit in racks": dict(
+        nodes=[_h3("b1-r1-x3", "b1", "r1", "x3", cpu=2000, pods=10),
+               _h3("b1-r2-x5", "b1", "r2", "x5", cpu=2000, pods=10),
+               _h3("b1-r3-x1", "b1", "r3", "x1", cpu=2000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 6, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK, slice_level=RACK,
+                        slice_size=3)) | dict(
+            want_reason='topology "default" doesn\'t allow to fit any of '
+                        '2 slice(s)')],
+    ),
+    "block required for podset; rack required for slices; only 1 out of "
+    "2 slices fit the topology": dict(
+        nodes=[_h3("b1-r1-x3", "b1", "r1", "x3", cpu=3000, pods=10),
+               _h3("b1-r2-x5", "b1", "r2", "x5", cpu=1000, pods=10),
+               _h3("b1-r3-x1", "b1", "r3", "x1", cpu=1000, pods=10),
+               _h3("b1-r4-x6", "b1", "r4", "x6", cpu=1000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 6, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK, slice_level=RACK,
+                        slice_size=3)) | dict(
+            want_reason='topology "default" allows to fit only 1 out of '
+                        '2 slice(s)')],
+    ),
+    "block required for podset; rack required for slices; podset fits "
+    "in both blocks, but slices fit in only one block": dict(
+        nodes=[_h3("b1-r1-x3", "b1", "r1", "x3", cpu=2000, pods=10),
+               _h3("b1-r2-x5", "b1", "r2", "x5", cpu=2000, pods=10),
+               _h3("b1-r3-x1", "b1", "r3", "x1", cpu=2000, pods=10),
+               _h3("b2-r4-x6", "b2", "r4", "x6", cpu=3000, pods=10),
+               _h3("b2-r5-x2", "b2", "r5", "x2", cpu=3000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 6, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK, slice_level=RACK,
+                        slice_size=3),
+                     ) | dict(want=A(ONE_LEVEL, ("x6", 3), ("x2", 3)))],
+    ),
+    "slice required topology level cannot be above the main required "
+    "topology level": dict(
+        nodes=default_nodes(), levels=THREE_LEVELS,
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, HOST, slice_level=BLOCK,
+                        slice_size=1)) | dict(
+            want_reason="podset slice topology cloud.com/topology-block "
+                        "is above the podset topology "
+                        "kubernetes.io/hostname")],
+    ),
+    "slice size is required when slice topology is requested": dict(
+        nodes=default_nodes(), levels=THREE_LEVELS,
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK,
+                        slice_level=HOST)) | dict(
+            want_reason="slice topology requested, but slice size not "
+                        "provided")],
+    ),
+    "cannot request not existing slice topology": dict(
+        nodes=default_nodes(), levels=THREE_LEVELS,
+        pod_sets=[PS("main", 1, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK,
+                        slice_level="not-existing-topology-level",
+                        slice_size=1)) | dict(
+            want_reason="no requested topology level for slices: "
+                        "not-existing-topology-level")],
+    ),
+    "no topology for podset; host required for slices; BestFit": dict(
+        nodes=[_h3("b1-r1-x3", "b1", "r1", "x3", cpu=3000, pods=10),
+               _h3("b1-r1-x5", "b1", "r1", "x5", cpu=3000, pods=10),
+               _h3("b1-r1-x1", "b1", "r1", "x1", cpu=3000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 6, {CPU: 1000},
+                     TR(slice_level=HOST, slice_size=2),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 2), ("x3", 2),
+                                     ("x5", 2)))],
+    ),
+    "no topology for podset; host required for slices; multiple blocks; "
+    "BestFit": dict(
+        nodes=scattered_nodes(), levels=THREE_LEVELS,
+        pod_sets=[PS("main", 6, {CPU: 1000},
+                     TR(slice_level=HOST, slice_size=2),
+                     ) | dict(want=A(ONE_LEVEL, ("x3", 4), ("x6", 2)))],
+    ),
+    "no topology for podset; rack required for slices; multiple blocks; "
+    "BestFit": dict(
+        nodes=default_nodes(), levels=THREE_LEVELS,
+        pod_sets=[PS("main", 4, {CPU: 1000},
+                     TR(slice_level=RACK, slice_size=2),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 1), ("x5", 1),
+                                     ("x4", 2)))],
+    ),
+    "find topology assignment for two podsets with overlapping domain":
+    dict(
+        nodes=[N("b1", {BLOCK: "b1"}, cpu=2000, pods=10),
+               N("b2", {BLOCK: "b2"}, cpu=2000, pods=10),
+               N("b3", {BLOCK: "b3"}, cpu=2000, pods=10)],
+        levels=[BLOCK],
+        pod_sets=[
+            PS("podset1", 3, {CPU: 1000},
+               TR(TopologyMode.PREFERRED, BLOCK),
+               ) | dict(want=A([BLOCK], ("b1", 2), ("b2", 1))),
+            PS("podset2", 3, {CPU: 1000},
+               TR(TopologyMode.PREFERRED, BLOCK),
+               ) | dict(want=A([BLOCK], ("b2", 1), ("b3", 2))),
+        ],
+    ),
+    "find topology assignment for two podsets with the same group": dict(
+        nodes=[N("b1", {BLOCK: "b1"}, cpu=2000, mem=2 * GI, pods=10,
+                 extra={"example.com/gpu": 2}),
+               N("b2", {BLOCK: "b2"}, cpu=5000, pods=10,
+                 extra={"example.com/gpu": 4}),
+               N("b3", {BLOCK: "b3"}, cpu=2000, pods=10,
+                 extra={"example.com/gpu": 2})],
+        levels=[BLOCK],
+        pod_sets=[
+            PS("leader", 1, {CPU: 1000},
+               TR(TopologyMode.REQUIRED, BLOCK, group="sameGroup"),
+               ) | dict(want=A([BLOCK], ("b2", 1))),
+            PS("workers", 4, {CPU: 1000, "example.com/gpu": 1},
+               TR(TopologyMode.REQUIRED, BLOCK, group="sameGroup"),
+               ) | dict(want=A([BLOCK], ("b2", 4))),
+        ],
+    ),
+    "find topology assignment for two podsets with the same group with "
+    "domains that can tightly fit leader and workers": dict(
+        nodes=[N("b1", {BLOCK: "b1"}, cpu=2000, pods=10,
+                 extra={"example.com/gpu": 2}),
+               N("b2", {BLOCK: "b2"}, cpu=8000, pods=10,
+                 extra={"example.com/gpu": 8}),
+               N("b3", {BLOCK: "b3"}, cpu=2000, pods=10,
+                 extra={"example.com/gpu": 2})],
+        levels=[BLOCK],
+        pod_sets=[
+            PS("leader", 1, {CPU: 1000},
+               TR(TopologyMode.REQUIRED, BLOCK, group="sameGroup"),
+               ) | dict(want=A([BLOCK], ("b2", 1))),
+            PS("workers", 4, {CPU: 1000, "example.com/gpu": 2},
+               TR(TopologyMode.REQUIRED, BLOCK, group="sameGroup"),
+               ) | dict(want=A([BLOCK], ("b2", 4))),
+        ],
+    ),
+    "find topology assignment for grouped podsets skips domain where "
+    "only workers fit without leader": dict(
+        nodes=[_h3("small-used", "b1", "small", "small-used", cpu=2800,
+                   pods=10),
+               _h3("small-free", "b1", "small", "small-free", cpu=2800,
+                   pods=10),
+               _h3("large-free", "b1", "large", "large-free", cpu=6000,
+                   pods=10)],
+        pods=[_pod("filler", node="small-used", cpu=2500)],
+        levels=THREE_LEVELS,
+        pod_sets=[
+            PS("leader", 1, {CPU: 2500},
+               TR(TopologyMode.REQUIRED, RACK, group="sameGroup"),
+               ) | dict(want=A(ONE_LEVEL, ("large-free", 1))),
+            PS("workers", 1, {CPU: 2500},
+               TR(TopologyMode.REQUIRED, RACK, group="sameGroup"),
+               ) | dict(want=A(ONE_LEVEL, ("large-free", 1))),
+        ],
+    ),
+    "find topology assignment for grouped podsets skips domain where "
+    "mixed-size workers only fit without leader": dict(
+        nodes=[_h3("small-used", "b1", "small", "small-used", cpu=2800,
+                   pods=10),
+               _h3("small-free", "b1", "small", "small-free", cpu=2800,
+                   pods=10),
+               _h3("large-free", "b1", "large", "large-free", cpu=6000,
+                   pods=10)],
+        pods=[_pod("filler", node="small-used", cpu=2500)],
+        levels=THREE_LEVELS,
+        pod_sets=[
+            PS("leader", 1, {CPU: 2500},
+               TR(TopologyMode.REQUIRED, RACK, group="sameGroup"),
+               ) | dict(want=A(ONE_LEVEL, ("large-free", 1))),
+            PS("workers", 2, {CPU: 500},
+               TR(TopologyMode.REQUIRED, RACK, group="sameGroup"),
+               ) | dict(want=A(ONE_LEVEL, ("large-free", 2))),
+        ],
+    ),
+    "find topology assignment for grouped podsets keeps tight domain "
+    "when leader and workers fit together": dict(
+        nodes=[_h3("small-used", "b1", "small", "small-used", cpu=2800,
+                   pods=10),
+               _h3("small-free", "b1", "small", "small-free", cpu=2800,
+                   pods=10),
+               _h3("large-free", "b1", "large", "large-free", cpu=6000,
+                   pods=10)],
+        pods=[_pod("filler", node="small-used", cpu=2500)],
+        levels=THREE_LEVELS,
+        pod_sets=[
+            PS("leader", 1, {CPU: 1000},
+               TR(TopologyMode.REQUIRED, RACK, group="sameGroup"),
+               ) | dict(want=A(ONE_LEVEL, ("small-free", 1))),
+            PS("workers", 1, {CPU: 1000},
+               TR(TopologyMode.REQUIRED, RACK, group="sameGroup"),
+               ) | dict(want=A(ONE_LEVEL, ("small-free", 1))),
+        ],
+    ),
+    "find topology assignment for two podsets with the same group - "
+    "no fit": dict(
+        nodes=[N("b1", {BLOCK: "b1"}, cpu=1000, pods=10,
+                 extra={"example.com/gpu": 0}),
+               N("b2", {BLOCK: "b2"}, cpu=4000, pods=10,
+                 extra={"example.com/gpu": 4})],
+        levels=[BLOCK],
+        pod_sets=[
+            PS("leader", 1, {CPU: 1000},
+               TR(TopologyMode.REQUIRED, BLOCK, group="sameGroup"),
+               ) | dict(
+                want_reason='topology "default" allows to fit only 4 out '
+                            'of 4 pod(s). Total nodes: 2; excluded: '
+                            'resource "example.com/gpu": 1'),
+            PS("workers", 4, {CPU: 1000, "example.com/gpu": 1},
+               TR(TopologyMode.REQUIRED, BLOCK, group="sameGroup"),
+               ) | dict(
+                want_reason='topology "default" allows to fit only 4 out '
+                            'of 4 pod(s). Total nodes: 2; excluded: '
+                            'resource "example.com/gpu": 1'),
+        ],
+    ),
+    "find topology assignment for two podsets with the same group - "
+    "optimizes domain for both leader and workers": dict(
+        nodes=[N("b1", {BLOCK: "b1"}, cpu=11000, pods=10,
+                 extra={"example.com/gpu": 8}),
+               N("b2", {BLOCK: "b2"}, cpu=4000, pods=10,
+                 extra={"example.com/gpu": 4})],
+        levels=[BLOCK],
+        pod_sets=[
+            PS("leader", 1, {CPU: 1000},
+               TR(TopologyMode.REQUIRED, BLOCK, group="sameGroup"),
+               ) | dict(want=A([BLOCK], ("b1", 1))),
+            PS("workers", 4, {CPU: 1000, "example.com/gpu": 1},
+               TR(TopologyMode.REQUIRED, BLOCK, group="sameGroup"),
+               ) | dict(want=A([BLOCK], ("b1", 4))),
+        ],
+    ),
+    "BestFit: podset group workers spread across hosts": dict(
+        nodes=[_h3("b1-r1-x1", "b1", "r1", "x1", cpu=20000, pods=10,
+                   extra={"example.com/gpu": 4}),
+               _h3("b1-r1-x2", "b1", "r1", "x2", cpu=20000, pods=10,
+                   extra={"example.com/gpu": 2}),
+               _h3("b1-r1-x3", "b1", "r1", "x3", cpu=20000, pods=10,
+                   extra={"example.com/gpu": 2}),
+               _h3("b1-r1-x4", "b1", "r1", "x4", cpu=20000, pods=10,
+                   extra={"example.com/gpu": 2})],
+        levels=THREE_LEVELS,
+        pod_sets=[
+            PS("leader", 1, {CPU: 1000},
+               TR(TopologyMode.PREFERRED, BLOCK, group="sameGroup"),
+               ) | dict(want=A(ONE_LEVEL, ("x1", 1))),
+            PS("workers", 6, {CPU: 1000, "example.com/gpu": 1},
+               TR(TopologyMode.PREFERRED, BLOCK, slice_level=HOST,
+                  slice_size=2, group="sameGroup"),
+               ) | dict(want=A(ONE_LEVEL, ("x1", 4), ("x2", 2))),
+        ],
+    ),
+    "find topology assignment for two podsets with the same group - "
+    "leader does not fit anywhere": dict(
+        nodes=[N("b1", {BLOCK: "b1"}, cpu=4000, pods=10,
+                 extra={"example.com/gpu": 4}),
+               N("b2", {BLOCK: "b2"}, cpu=4000, pods=10,
+                 extra={"example.com/gpu": 4})],
+        levels=[BLOCK],
+        pod_sets=[
+            PS("leader", 1, {CPU: 10000},
+               TR(TopologyMode.REQUIRED, BLOCK, group="sameGroup"),
+               ) | dict(
+                want_reason='topology "default" allows to fit only 4 out '
+                            'of 4 pod(s)'),
+            PS("workers", 4, {CPU: 1000, "example.com/gpu": 1},
+               TR(TopologyMode.REQUIRED, BLOCK, group="sameGroup"),
+               ) | dict(
+                want_reason='topology "default" allows to fit only 4 out '
+                            'of 4 pod(s)'),
+        ],
+    ),
+    "find topology assignment for two podsets with the same group - "
+    "multiple hosts": dict(
+        nodes=[_h3("b1-r1-x3", "b1", "r1", "x3", cpu=2000, pods=10,
+                   extra={"example.com/gpu": 1}),
+               _h3("b1-r1-x5", "b1", "r1", "x5", cpu=2000, pods=10,
+                   extra={"example.com/gpu": 1}),
+               _h3("b1-r1-x1", "b1", "r1", "x1", cpu=2000, pods=10,
+                   extra={"example.com/gpu": 1}),
+               _h3("b2-r4-x6", "b2", "r4", "x6", cpu=1000, pods=10,
+                   extra={"example.com/gpu": 1}),
+               _h3("b2-r5-x2", "b2", "r5", "x2", cpu=2000, pods=10,
+                   extra={"example.com/gpu": 1}),
+               _h3("b2-r6-x4", "b2", "r6", "x4", cpu=2000, pods=10,
+                   extra={"example.com/gpu": 1})],
+        levels=THREE_LEVELS,
+        pod_sets=[
+            PS("leader", 1, {CPU: 2000},
+               TR(TopologyMode.REQUIRED, BLOCK, group="sameGroup"),
+               ) | dict(want=A(ONE_LEVEL, ("x1", 1))),
+            PS("workers", 2, {CPU: 1000, "example.com/gpu": 1},
+               TR(TopologyMode.REQUIRED, BLOCK, group="sameGroup"),
+               ) | dict(want=A(ONE_LEVEL, ("x3", 1), ("x5", 1))),
+        ],
+    ),
+    "find topology assignment for two podsets with the same group "
+    "requesting same resources and nodes in the same rack": dict(
+        nodes=[_h3("b1-r1-x3", "b1", "r1", "x3", cpu=1000, pods=10,
+                   extra={"example.com/gpu": 1}),
+               _h3("b1-r1-x5", "b1", "r1", "x5", cpu=1000, pods=10,
+                   extra={"example.com/gpu": 1}),
+               _h3("b1-r2-x1", "b1", "r2", "x1", cpu=1000, pods=10,
+                   extra={"example.com/gpu": 1}),
+               _h3("b1-r2-x6", "b1", "r2", "x6", cpu=1000, pods=10,
+                   extra={"example.com/gpu": 1}),
+               _h3("b2-r3-x2", "b2", "r3", "x2", cpu=1000, pods=10,
+                   extra={"example.com/gpu": 1}),
+               _h3("b2-r3-x4", "b2", "r3", "x4", cpu=1000, pods=10,
+                   extra={"example.com/gpu": 1}),
+               _h3("b2-r4-x7", "b2", "r4", "x7", cpu=1000, pods=10,
+                   extra={"example.com/gpu": 1}),
+               _h3("b2-r4-x8", "b2", "r4", "x8", cpu=1000, pods=10,
+                   extra={"example.com/gpu": 1})],
+        levels=THREE_LEVELS,
+        pod_sets=[
+            PS("leader", 1, {CPU: 1000, "example.com/gpu": 1},
+               TR(TopologyMode.REQUIRED, BLOCK, group="sameGroup"),
+               ) | dict(want=A(ONE_LEVEL, ("x3", 1))),
+            PS("workers", 2, {CPU: 1000, "example.com/gpu": 1},
+               TR(TopologyMode.REQUIRED, BLOCK, group="sameGroup"),
+               ) | dict(want=A(ONE_LEVEL, ("x5", 1), ("x1", 1))),
+        ],
+    ),
+    "multiple podsets: rack required for both, different resource "
+    "requests; BestFit": dict(
+        nodes=multipod_nodes(), levels=TWO_LEVELS,
+        pod_sets=[
+            PS("podset1", 2, {CPU: 1000},
+               TR(TopologyMode.REQUIRED, RACK),
+               ) | dict(want=A(TWO_LEVELS, ("b1", "r1", 2))),
+            PS("podset2", 1, {"memory": 1024},
+               TR(TopologyMode.REQUIRED, RACK),
+               ) | dict(want=A(TWO_LEVELS, ("b1", "r1", 1))),
+        ],
+    ),
+    "multiple podsets: block required for one, unconstrained for "
+    "another; TASProfileMixed": dict(
+        gates={"TASProfileMixed": True},
+        nodes=multipod_nodes(), levels=THREE_LEVELS,
+        pod_sets=[
+            PS("podset1", 8, {CPU: 1000},
+               TR(TopologyMode.REQUIRED, BLOCK),
+               ) | dict(want=A(ONE_LEVEL, ("x2", 8))),
+            PS("podset2", 2, {CPU: 1000},
+               TR(TopologyMode.UNCONSTRAINED),
+               ) | dict(want=A(ONE_LEVEL, ("x2", 2))),
+        ],
+    ),
+    "elastic workload scale up: delta-only placement preserves previous "
+    "assignment": dict(
+        gates={"ElasticJobsViaWorkloadSlices": True,
+               "ElasticJobsViaWorkloadSlicesWithTAS": True},
+        nodes=[N("x1", {HOST: "x1"}, cpu=2000, pods=10),
+               N("x2", {HOST: "x2"}, cpu=2000, pods=10),
+               N("x3", {HOST: "x3"}, cpu=2000, pods=10)],
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 4, {CPU: 1000},
+                     TR(TopologyMode.UNCONSTRAINED),
+                     previous=ta(ONE_LEVEL, (["x1"], 2)),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 2), ("x2", 2)))],
+    ),
+    "elastic workload scale up: spread across multiple nodes preserved":
+    dict(
+        gates={"ElasticJobsViaWorkloadSlices": True,
+               "ElasticJobsViaWorkloadSlicesWithTAS": True},
+        nodes=[N("x1", {HOST: "x1"}, cpu=2000, pods=10),
+               N("x2", {HOST: "x2"}, cpu=2000, pods=10),
+               N("x3", {HOST: "x3"}, cpu=2000, pods=10)],
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 4, {CPU: 1000},
+                     TR(TopologyMode.UNCONSTRAINED),
+                     previous=ta(ONE_LEVEL, (["x1"], 1), (["x2"], 1)),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 1), ("x2", 1),
+                                     ("x3", 2)))],
+    ),
+    "elastic workload scale down: truncates assignment": dict(
+        gates={"ElasticJobsViaWorkloadSlices": True,
+               "ElasticJobsViaWorkloadSlicesWithTAS": True},
+        nodes=[N("x1", {HOST: "x1"}, cpu=4000, pods=10),
+               N("x2", {HOST: "x2"}, cpu=4000, pods=10)],
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 3, {CPU: 1000},
+                     TR(TopologyMode.UNCONSTRAINED),
+                     previous=ta(ONE_LEVEL, (["x1"], 3), (["x2"], 2)),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 3)))],
+    ),
+    "elastic workload same count: reuses previous assignment exactly":
+    dict(
+        gates={"ElasticJobsViaWorkloadSlices": True,
+               "ElasticJobsViaWorkloadSlicesWithTAS": True},
+        nodes=[N("x1", {HOST: "x1"}, cpu=4000, pods=10),
+               N("x2", {HOST: "x2"}, cpu=4000, pods=10)],
+        levels=ONE_LEVEL,
+        pod_sets=[PS("main", 3, {CPU: 1000},
+                     TR(TopologyMode.UNCONSTRAINED),
+                     previous=ta(ONE_LEVEL, (["x1"], 2), (["x2"], 1)),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 2), ("x2", 1)))],
+    ),
+    "elastic workload scale down with leader: truncates workers, reuses "
+    "leader": dict(
+        gates={"ElasticJobsViaWorkloadSlices": True,
+               "ElasticJobsViaWorkloadSlicesWithTAS": True},
+        nodes=[N("x1", {HOST: "x1"}, cpu=4000, pods=10),
+               N("x2", {HOST: "x2"}, cpu=2000, pods=10)],
+        levels=ONE_LEVEL,
+        pod_sets=[
+            PS("leader", 1, {CPU: 1000},
+               TR(TopologyMode.UNCONSTRAINED, group="elastic-group"),
+               previous=ta(ONE_LEVEL, (["x2"], 1)),
+               ) | dict(want=A(ONE_LEVEL, ("x2", 1))),
+            PS("workers", 3, {CPU: 1000},
+               TR(TopologyMode.UNCONSTRAINED, group="elastic-group"),
+               previous=ta(ONE_LEVEL, (["x1"], 3), (["x2"], 2)),
+               ) | dict(want=A(ONE_LEVEL, ("x1", 3))),
+        ],
+    ),
+    "elastic workload same count with leader: reuses both assignments "
+    "exactly": dict(
+        gates={"ElasticJobsViaWorkloadSlices": True,
+               "ElasticJobsViaWorkloadSlicesWithTAS": True},
+        nodes=[N("x1", {HOST: "x1"}, cpu=4000, pods=10),
+               N("x2", {HOST: "x2"}, cpu=4000, pods=10)],
+        levels=ONE_LEVEL,
+        pod_sets=[
+            PS("leader", 1, {CPU: 1000},
+               TR(TopologyMode.UNCONSTRAINED, group="elastic-group"),
+               previous=ta(ONE_LEVEL, (["x2"], 1)),
+               ) | dict(want=A(ONE_LEVEL, ("x2", 1))),
+            PS("workers", 3, {CPU: 1000},
+               TR(TopologyMode.UNCONSTRAINED, group="elastic-group"),
+               previous=ta(ONE_LEVEL, (["x1"], 2), (["x2"], 1)),
+               ) | dict(want=A(ONE_LEVEL, ("x1", 2), ("x2", 1))),
+        ],
+    ),
+    "multi-layer topology: block required; rack slices of 4; host "
+    "slices of 2; TASMultiLayerTopology": dict(
+        gates={"TASMultiLayerTopology": True},
+        nodes=[_h3("b1-r1-x1", "b1", "r1", "x1", cpu=1000, pods=10),
+               _h3("b1-r1-x2", "b1", "r1", "x2", cpu=4000, pods=10),
+               _h3("b1-r2-x3", "b1", "r2", "x3", cpu=3000, pods=10),
+               _h3("b1-r2-x4", "b1", "r2", "x4", cpu=4000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 8, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK,
+                        constraints=((RACK, 4), (HOST, 2))),
+                     ) | dict(want=A(ONE_LEVEL, ("x2", 4), ("x3", 2),
+                                     ("x4", 2)))],
+    ),
+    "multi-layer topology: no feature gate; additional layers ignored":
+    dict(
+        gates={"TASMultiLayerTopology": False},
+        nodes=[_h3("b1-r1-x1", "b1", "r1", "x1", cpu=1000, pods=10),
+               _h3("b1-r1-x2", "b1", "r1", "x2", cpu=4000, pods=10),
+               _h3("b1-r2-x3", "b1", "r2", "x3", cpu=3000, pods=10),
+               _h3("b1-r2-x4", "b1", "r2", "x4", cpu=4000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 8, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK, slice_level=RACK,
+                        slice_size=4),
+                     ) | dict(want=A(ONE_LEVEL, ("x1", 1), ("x2", 3),
+                                     ("x3", 3), ("x4", 1)))],
+    ),
+    "multi-layer topology: mimic a real-world GB200 cluster, with NVL36 "
+    "arch (2GPUs/node); dc required; aizone slices of 48; rack slices "
+    "of 16; TASMultiLayerTopology": dict(
+        gates={"TASMultiLayerTopology": True},
+        nodes=[N(f"{blk}-{rk}-{az}-n{i}",
+                 {DC: "dc0", AIZONE: az, BLOCK: blk, RACK: rk},
+                 pods=110, extra={"nvidia.com/gpu": 2})
+               for az, blk, rk in (("aizone0", "block0", "r0"),
+                                   ("aizone0", "block0", "r1"),
+                                   ("aizone0", "block1", "r2"),
+                                   ("aizone0", "block1", "r3"),
+                                   ("aizone1", "block2", "r4"),
+                                   ("aizone1", "block2", "r5"),
+                                   ("aizone1", "block3", "r6"),
+                                   ("aizone1", "block3", "r7"))
+               for i in range(18)],
+        levels=[DC, AIZONE, BLOCK, RACK],
+        pod_sets=[PS("main", 96, {"nvidia.com/gpu": 2},
+                     TR(TopologyMode.REQUIRED, DC,
+                        constraints=((AIZONE, 48), (RACK, 16))),
+                     ) | dict(want=(
+                [DC, AIZONE, BLOCK, RACK],
+                [(["dc0", "aizone0", "block0", "r0"], 16),
+                 (["dc0", "aizone0", "block0", "r1"], 16),
+                 (["dc0", "aizone0", "block1", "r2"], 16),
+                 (["dc0", "aizone1", "block2", "r4"], 16),
+                 (["dc0", "aizone1", "block2", "r5"], 16),
+                 (["dc0", "aizone1", "block3", "r6"], 16)]))],
+    ),
+    "multi-layer topology: host slice rounding makes rack slice "
+    "impossible": dict(
+        gates={"TASMultiLayerTopology": True},
+        nodes=[_h3("b1-r1-x1", "b1", "r1", "x1", cpu=3000, pods=10),
+               _h3("b1-r1-x2", "b1", "r1", "x2", cpu=3000, pods=10),
+               _h3("b1-r1-x3", "b1", "r1", "x3", cpu=0, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 6, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK,
+                        constraints=((RACK, 6), (HOST, 2)))) | dict(
+            want_reason='topology "default" doesn\'t allow to fit; 0/1 '
+                        'slice(s) fit on level cloud.com/topology-rack; '
+                        '2/3 slice(s) fit on level kubernetes.io/'
+                        'hostname. Total nodes: 3; excluded: '
+                        'resource "cpu": 1')],
+    ),
+    "multi-layer topology: small host kills rack slices despite enough "
+    "total capacity": dict(
+        gates={"TASMultiLayerTopology": True},
+        nodes=[_h3("b1-r1-x1", "b1", "r1", "x1", cpu=7000, pods=10),
+               _h3("b1-r1-x2", "b1", "r1", "x2", cpu=4000, pods=10),
+               _h3("b1-r2-x3", "b1", "r2", "x3", cpu=7000, pods=10),
+               _h3("b1-r2-x4", "b1", "r2", "x4", cpu=3000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 16, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK,
+                        constraints=((RACK, 8), (HOST, 4)))) | dict(
+            want_reason='topology "default" doesn\'t allow to fit; 1/2 '
+                        'slice(s) fit on level cloud.com/topology-rack; '
+                        '3/4 slice(s) fit on level '
+                        'kubernetes.io/hostname')],
+    ),
+    "multi-layer topology: enough hostname slices but not enough rack "
+    "slices": dict(
+        gates={"TASMultiLayerTopology": True},
+        nodes=[_h3("b1-r1-x1", "b1", "r1", "x1", cpu=4000, pods=10),
+               _h3("b1-r2-x2", "b1", "r2", "x2", cpu=4000, pods=10),
+               _h3("b1-r3-x3", "b1", "r3", "x3", cpu=4000, pods=10)],
+        levels=THREE_LEVELS,
+        pod_sets=[PS("main", 12, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, BLOCK,
+                        constraints=((RACK, 6), (HOST, 2)))) | dict(
+            want_reason='topology "default" doesn\'t allow to fit; 0/2 '
+                        'slice(s) fit on level cloud.com/topology-rack; '
+                        '6/6 slice(s) fit on level '
+                        'kubernetes.io/hostname')],
+    ),
+    "multi-layer topology: 3-layer negative case with small hosts "
+    "cascading up": dict(
+        gates={"TASMultiLayerTopology": True},
+        nodes=[N(f"dc1-{blk}-{rk}-{h}",
+                 {DC: "dc1", BLOCK: blk, RACK: rk, HOST: h},
+                 cpu=cpu, pods=10)
+               for blk, rk, h, cpu in (
+                   ("b1", "r1", "x1", 4000), ("b1", "r1", "x2", 4000),
+                   ("b1", "r2", "x3", 4000), ("b1", "r2", "x4", 4000),
+                   ("b2", "r3", "x5", 4000), ("b2", "r3", "x6", 4000),
+                   ("b2", "r4", "x7", 1000), ("b2", "r4", "x8", 1000))],
+        levels=[DC, BLOCK, RACK, HOST],
+        pod_sets=[PS("main", 24, {CPU: 1000},
+                     TR(TopologyMode.REQUIRED, DC,
+                        constraints=((BLOCK, 12), (RACK, 6),
+                                     (HOST, 3)))) | dict(
+            want_reason='topology "default" doesn\'t allow to fit; 1/2 '
+                        'slice(s) fit on level cloud.com/topology-block; '
+                        '3/4 slice(s) fit on level '
+                        'cloud.com/topology-rack; 6/8 slice(s) fit on '
+                        'level kubernetes.io/hostname')],
+    ),
+    "temporary state cleanup prevents leakage across PodSets": dict(
+        nodes=[N("n1", {HOST: "x1"}, cpu=4000, mem=4 * GI, pods=10),
+               N("n2", {HOST: "x2"}, cpu=4000, mem=4 * GI, pods=10)],
+        levels=ONE_LEVEL,
+        pod_sets=[
+            PS("ps1", 1, {CPU: 1000, "memory": 1000}, None,
+               ) | dict(want=A(ONE_LEVEL, ("x1", 1))),
+            PS("ps2", 1, {CPU: 1000, "memory": 1000}, None,
+               selector={"never": "match"}) | dict(
+                want_reason='topology "default" doesn\'t allow to fit '
+                            'any of 1 pod(s). Total nodes: 2; excluded: '
+                            'nodeSelector: 2'),
+        ],
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_find_topology_assignments_golden(name):
+    run_case(CASES[name])
+
+
+def _device_qualifies(tc):
+    """Single leaderless/ungrouped pod set, no selector/tolerations/
+    affinity/taints/previous/workload/multi-layer — the per-placement
+    device kernel's supported surface."""
+    if len(tc["pod_sets"]) != 1 or tc.get("workload") is not None:
+        return False
+    ps = tc["pod_sets"][0]
+    tr = ps["tr"]
+    if ps["selector"] or ps["tolerations"] or ps["affinity"] \
+            or ps["previous"] is not None:
+        return False
+    if tr is not None and (tr.pod_set_group_name
+                           or len(tr.slice_constraints) > 1):
+        return False
+    if any(n.taints for n in tc["nodes"]):
+        return False
+    if (tc.get("gates") or {}).get("TASBalancedPlacement"):
+        return False
+    return True
+
+
+@pytest.mark.parametrize("name", sorted(
+    n for n in CASES if _device_qualifies(CASES[n])))
+def test_device_differential_on_golden(name, monkeypatch):
+    """Each qualifying Go case also runs through the per-placement
+    device kernel (ops/tas.tas_place, forced via
+    KUEUE_TPU_DEVICE_TAS_MIN=0) and must match the host walk bit-for-bit
+    — the Go table pins the host, the differential pins the kernel."""
+    monkeypatch.setenv("KUEUE_TPU_DEVICE_TAS_MIN", "0")
+    tc = CASES[name]
+    for gate, val in (tc.get("gates") or {}).items():
+        features.set_feature(gate, val)
+    levels = tc["levels"]
+    topo = Topology("default", tuple(TopologyLevel(k) for k in levels))
+    snap = TASFlavorSnapshot(topo)
+    node_labels = tc.get("node_labels") or {}
+    for node in tc["nodes"]:
+        if all(node.labels.get(k) == v for k, v in node_labels.items()):
+            snap.add_node(node)
+    for values, usage in (tc.get("prior_usage") or {}).items():
+        snap.install_usage(tuple(values), dict(usage))
+    ps = tc["pod_sets"][0]
+    pod_set = PodSet(ps["name"], ps["count"], dict(ps["requests"]),
+                     topology_request=ps["tr"])
+    req = TASPodSetRequest(pod_set, dict(ps["requests"]), ps["count"])
+    from kueue_tpu.tas import device
+    got = device.try_find(snap, req)
+    want = snap.find_topology_assignments_host(req)
+    if got is NotImplemented:
+        return  # host-only shape (e.g. balanced gate)
+    assert got == want, f"device={got}\nhost={want}"
+
+
+# ---------------------------------------------------------------------------
+# tas_flavor_snapshot_test.go helper tables.
+# ---------------------------------------------------------------------------
+
+
+def _two_level_snap():
+    """TestMergeTopologyAssignments world (:74): 4 nodes over 2 levels."""
+    topo = Topology("dummy", (TopologyLevel("level-1"),
+                              TopologyLevel("level-2")))
+    snap = TASFlavorSnapshot(topo)
+    for l1, l2, name in (("a", "b", "x"), ("a", "c", "y"),
+                         ("d", "e", "z"), ("d", "f", "w")):
+        snap.add_node(Node(name=name,
+                           labels={"level-1": l1, "level-2": l2}))
+    return snap
+
+
+MERGE_CASES = {
+    # TestMergeTopologyAssignments (tas_flavor_snapshot_test.go:74)
+    "topologies with different domains, all a before b": (
+        [(("a", "b"), 1), (("a", "c"), 1)],
+        [(("d", "e"), 1), (("d", "f"), 1)],
+        [(("a", "b"), 1), (("a", "c"), 1), (("d", "e"), 1),
+         (("d", "f"), 1)]),
+    "topologies with different domains, all b before a": (
+        [(("d", "e"), 1), (("d", "f"), 1)],
+        [(("a", "b"), 1), (("a", "c"), 1)],
+        [(("a", "b"), 1), (("a", "c"), 1), (("d", "e"), 1),
+         (("d", "f"), 1)]),
+    "topologies with different domains, mixed order": (
+        [(("a", "c"), 1), (("d", "e"), 1)],
+        [(("a", "b"), 1), (("d", "f"), 1)],
+        [(("a", "b"), 1), (("a", "c"), 1), (("d", "e"), 1),
+         (("d", "f"), 1)]),
+    "topologies with different and the same domains, mixed order": (
+        [(("a", "c"), 1), (("d", "e"), 1)],
+        [(("a", "b"), 1), (("d", "e"), 1)],
+        [(("a", "b"), 1), (("a", "c"), 1), (("d", "e"), 2)]),
+    "topology a with empty domains": (
+        [],
+        [(("a", "b"), 1), (("d", "e"), 1)],
+        [(("a", "b"), 1), (("d", "e"), 1)]),
+    "topology b with empty domain": (
+        [(("a", "c"), 1), (("d", "e"), 1)],
+        [],
+        [(("a", "c"), 1), (("d", "e"), 1)]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(MERGE_CASES))
+def test_merge_topology_assignments_golden(name):
+    a_doms, b_doms, want = MERGE_CASES[name]
+    levels = ("level-1", "level-2")
+    a = ta(levels, *((list(v), c) for v, c in a_doms))
+    b = ta(levels, *((list(v), c) for v, c in b_doms))
+    got = merge_topology_assignments(a, b)
+    assert [(tuple(d.values), d.count) for d in got.domains] == want
+
+
+TRUNCATE_CASES = {
+    # TestTruncateAssignment (tas_flavor_snapshot_test.go:831)
+    "truncate to zero": ([(("node-a",), 2)], 0, []),
+    "no truncation needed": (
+        [(("node-a",), 2), (("node-b",), 1)], 3,
+        [(("node-a",), 2), (("node-b",), 1)]),
+    "truncate to single domain": (
+        [(("node-a",), 3), (("node-b",), 2)], 3, [(("node-a",), 3)]),
+    "truncation preserves assignment order not lex order": (
+        [(("node-z",), 3), (("node-a",), 2)], 3, [(("node-z",), 3)]),
+    "partial domain truncation": (
+        [(("node-a",), 3), (("node-b",), 3)], 4,
+        [(("node-a",), 3), (("node-b",), 1)]),
+    "truncate within first domain": (
+        [(("node-a",), 5), (("node-b",), 3)], 2, [(("node-a",), 2)]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(TRUNCATE_CASES))
+def test_truncate_assignment_golden(name):
+    doms, new_count, want = TRUNCATE_CASES[name]
+    prev = ta(("hostname",), *((list(v), c) for v, c in doms))
+    got = truncate_assignment(prev, new_count)
+    assert [(tuple(d.values), d.count) for d in got.domains] == want
+
+
+def _dom(id_, slice_state=0, state=0, swl=0, sswl=0, leader=0,
+         values=()):
+    from kueue_tpu.tas.snapshot import _Domain
+    d = _Domain(id_, tuple(values))
+    d.slice_state = slice_state
+    d.state = state
+    d.state_with_leader = swl
+    d.slice_state_with_leader = sswl
+    d.leader_state = leader
+    return d
+
+
+SORTED_CASES = {
+    # TestSortedDomains (tas_flavor_snapshot_test.go:554) — the two
+    # affinityScore cases need TASRespectNodeAffinityPreferred (not
+    # implemented; scored ordering is an explicit non-goal this round).
+    "BestFit: sliceState descending": (
+        [("a", 3, 1), ("b", 1, 1), ("c", 2, 1)], False, ["a", "c", "b"]),
+    "LeastFreeCapacity: sliceState ascending": (
+        [("a", 3, 1), ("b", 1, 1), ("c", 2, 1)], True, ["b", "c", "a"]),
+    "BestFit: state ascending as tiebreaker": (
+        [("large", 5, 100), ("small", 5, 10), ("medium", 5, 50)], False,
+        ["small", "medium", "large"]),
+    "LeastFreeCapacity: state ascending as tiebreaker": (
+        [("large", 5, 100), ("small", 5, 10), ("medium", 5, 50)], True,
+        ["small", "medium", "large"]),
+    "levelValues ascending as final tiebreaker": (
+        [("c", 5, 10), ("a", 5, 10), ("b", 5, 10)], False,
+        ["a", "b", "c"]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(SORTED_CASES))
+def test_sorted_domains_golden(name):
+    rows, least_free, want = SORTED_CASES[name]
+    snap = TASFlavorSnapshot(Topology("test", (TopologyLevel("block"),)))
+    domains = [_dom(i, slice_state=s, state=st, values=(i,))
+               for i, s, st in rows]
+    got = [d.id for d in snap._sorted(domains, least_free)]
+    assert got == want
+
+
+SORTED_LEADER_CASES = {
+    # TestSortedDomainsWithLeader (tas_flavor_snapshot_test.go:438)
+    "leaderState descending: domains that can host leader come first": (
+        [("no-leader", 0, 10, 10, "a"), ("has-leader", 1, 1, 1, "b")],
+        False, ["has-leader", "no-leader"]),
+    "BestFit: sliceStateWithLeader descending": (
+        [("a", 1, 3, 1, "a"), ("b", 1, 1, 1, "b"), ("c", 1, 2, 1, "c")],
+        False, ["a", "c", "b"]),
+    "LeastFreeCapacity: sliceStateWithLeader ascending": (
+        [("a", 1, 3, 1, "a"), ("b", 1, 1, 1, "b"), ("c", 1, 2, 1, "c")],
+        True, ["b", "c", "a"]),
+    "BestFit: stateWithLeader ascending as tiebreaker": (
+        [("large", 1, 5, 100, "a"), ("small", 1, 5, 10, "b"),
+         ("medium", 1, 5, 50, "c")], False,
+        ["small", "medium", "large"]),
+    "LeastFreeCapacity: stateWithLeader ascending as tiebreaker": (
+        [("large", 1, 5, 100, "a"), ("small", 1, 5, 10, "b"),
+         ("medium", 1, 5, 50, "c")], True,
+        ["small", "medium", "large"]),
+    "levelValues ascending as final tiebreaker": (
+        [("c", 1, 5, 10, "c"), ("a", 1, 5, 10, "a"),
+         ("b", 1, 5, 10, "b")], False, ["a", "b", "c"]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(SORTED_LEADER_CASES))
+def test_sorted_domains_with_leader_golden(name):
+    rows, least_free, want = SORTED_LEADER_CASES[name]
+    snap = TASFlavorSnapshot(Topology("test", (TopologyLevel("block"),)))
+    domains = [_dom(i, leader=ls, sswl=sswl, swl=swl, values=(v,))
+               for i, ls, sswl, swl, v in rows]
+    got = [d.id for d in snap._sorted_with_leader(domains, least_free)]
+    assert got == want
+
+
+HAS_LEVEL_CASES = {
+    # TestHasLevel (tas_flavor_snapshot_test.go:363)
+    "topology request nil": (None, False),
+    "topology request empty": (PodSetTopologyRequest(mode=None), False),
+    "required": (TR(TopologyMode.REQUIRED, "level-1"), True),
+    "required - invalid level": (
+        TR(TopologyMode.REQUIRED, "invalid-level"), False),
+    "preferred": (TR(TopologyMode.PREFERRED, "level-1"), True),
+    "preferred - invalid level": (
+        TR(TopologyMode.PREFERRED, "invalid-level"), False),
+    "unconstrained": (TR(TopologyMode.UNCONSTRAINED), True),
+    "slice-only": (PodSetTopologyRequest(mode=None, slice_level="level-1",
+                                         slice_size=1), True),
+    "slice-only - invalid level": (
+        PodSetTopologyRequest(mode=None, slice_level="invalid-level",
+                              slice_size=1), False),
+}
+
+
+@pytest.mark.parametrize("name", sorted(HAS_LEVEL_CASES))
+def test_has_level_golden(name):
+    tr, want = HAS_LEVEL_CASES[name]
+    snap = TASFlavorSnapshot(Topology("dummy", (
+        TopologyLevel("level-1"), TopologyLevel("level-2"))))
+    assert snap.has_level(tr) is want
+
+
+ASSUMED_CASES = {
+    # TestAddAssumedUsage (tas_flavor_snapshot_test.go:757)
+    "includes pod count for existing and new domains": (
+        {("node-a",): {"cpu": 1000, "pods": 1}},
+        [(("node-a",), 1), (("node-b",), 2)],
+        {"cpu": 500, "memory": 2048},
+        {("node-a",): {"cpu": 1500, "memory": 2048, "pods": 2},
+         ("node-b",): {"cpu": 1000, "memory": 4096, "pods": 2}}),
+    "includes pod count starting from empty assumed usage": (
+        {},
+        [(("node-a",), 3)],
+        {"cpu": 250, "memory": 512},
+        {("node-a",): {"cpu": 750, "memory": 1536, "pods": 3}}),
+}
+
+
+@pytest.mark.parametrize("name", sorted(ASSUMED_CASES))
+def test_add_assumed_usage_golden(name):
+    from kueue_tpu.tas.snapshot import _add_assumed
+    assumed, doms, single, want = ASSUMED_CASES[name]
+    assumed = {k: dict(v) for k, v in assumed.items()}
+    assignment = ta(("hostname",), *((list(v), c) for v, c in doms))
+    req = TASPodSetRequest(PodSet("main", 1, dict(single)),
+                           dict(single), 1)
+    _add_assumed(assumed, assignment, req)
+    assert assumed == want

@@ -299,16 +299,22 @@ def test_elastic_tas_sub_gate_and_multilayer_gate():
         snap.add_node(Node(name=f"n{h}",
                            labels={"rack": "r0", HOSTNAME_LABEL: f"n{h}"},
                            capacity={"cpu": 4000, "pods": 8}))
-    # Multi-layer slices (slice level above the leaf) gated:
+    # The gate only controls ADDITIONAL slice layers (the reference
+    # parses the multi-layer constraint list only when the gate is on,
+    # jobframework/tas.go:91; a single non-leaf slice level is always
+    # allowed). Gate off: the inner (hostname, 1) layer is ignored and
+    # the request behaves as single-layer rack slicing.
     features.set_feature("TASMultiLayerTopology", False)
     ps = PodSet("main", 2, {"cpu": 100},
                 topology_request=PodSetTopologyRequest(
                     mode=TopologyMode.REQUIRED, level="rack",
-                    slice_level="rack", slice_size=1))
+                    slice_constraints=(("rack", 2),
+                                       (HOSTNAME_LABEL, 1))))
     req = TASPodSetRequest(pod_set=ps, single_pod_requests={"cpu": 100},
                            count=2)
     got, reason = snap.find_topology_assignments_host(req)
-    assert "TASMultiLayerTopology" in reason
+    assert reason == ""
+    assert sum(d.count for d in got["main"].domains) == 2
 
 
 def test_elastic_tas_sub_gate():
