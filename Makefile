@@ -46,5 +46,14 @@ multichip-dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	  $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+# Regenerate the README/ARCHITECTURE perf blocks from the latest
+# BENCH_r*.json; -check greppably fails when docs drift from the
+# shipped artifact.
+docs-perf:
+	$(PY) tools/docs_perf.py
+
+docs-perf-check:
+	$(PY) tools/docs_perf.py --check
+
 clean:
 	$(MAKE) -C native clean

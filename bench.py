@@ -48,22 +48,36 @@ REF_TAS_ADM_S = 37.4        # 15k TAS workloads / ~401.5 s
 CYCLE_TARGET_S = 0.5
 
 
+PROBE_LOG: list = []
+
+
 def tpu_available(timeout_s: int = 90, attempts: int = 3,
                   backoff_s: float = 20.0) -> bool:
     """Bounded multi-retry probe: a transient tunnel hiccup recovers,
     a sick tunnel (enumerates devices but hangs on compute) fails all
-    attempts and the bench provably runs on CPU."""
+    attempts and the bench provably runs on CPU. Every attempt is
+    appended to PROBE_LOG as (unix_ts, elapsed_s, outcome) so the
+    platform trailer can prove how often and when the tunnel was
+    tried."""
+    ok = False
     for k in range(attempts):
+        t0 = time.time()
+        outcome = "timeout"
         try:
             r = subprocess.run([sys.executable, "-c", PROBE],
                                capture_output=True, timeout=timeout_s)
-            if b"ok" in r.stdout:
-                return True
-        except (subprocess.TimeoutExpired, OSError):
-            pass
+            outcome = "ok" if b"ok" in r.stdout else "error"
+        except subprocess.TimeoutExpired:
+            outcome = "timeout"
+        except OSError as exc:
+            outcome = f"oserror:{exc.errno}"
+        PROBE_LOG.append((round(t0), round(time.time() - t0, 1), outcome))
+        if outcome == "ok":
+            ok = True
+            break
         if k + 1 < attempts:
             time.sleep(backoff_s)
-    return False
+    return ok
 
 
 def bench_throughput_flat(n_workloads, n_cohorts):
@@ -313,12 +327,28 @@ def bench_preempt_churn(n_pending, n_cohorts=20, cqs_per_cohort=5):
     elapsed = time.perf_counter() - t0
     decisions = admitted + preempting
     value = decisions / elapsed if elapsed > 0 else 0.0
+    # The structural-floor profile (round-4 verdict ask #3): per-phase
+    # mean of the device cycles plus the semantic bound on decisions
+    # per cycle — the one-admission-per-cohort-overlap rule
+    # (scheduler.go:432) serializes a cohort's overlapping preemptions
+    # across eviction rounds, so throughput = decisions/cycle x
+    # cycles/s, both bounded. See ARCHITECTURE.md "Preemption churn
+    # floor".
+    phases = {}
+    h = eng.registry.histogram("scheduler_phase_duration_seconds")
+    for (phase,), total in h.sums.items():
+        n = h.totals[(phase,)]
+        if n:
+            phases[phase] = round(total / n * 1000, 2)
+    cycles = max(1, eng.oracle.cycles_on_device if eng.oracle else 1)
     return {
         "value": round(value, 1), "unit": "decisions/s",
         "vs_baseline": round(value / REF_BASELINE_ADM_S, 2),
         "detail": {"pending": n_pending, "cqs": n_cqs,
                    "admitted": admitted, "preemptions": preempting,
                    "elapsed_s": round(elapsed, 3),
+                   "decisions_per_cycle": round(decisions / cycles, 1),
+                   "phase_ms_mean": phases,
                    **_device_share(eng)},
     }
 
@@ -913,19 +943,58 @@ def main() -> None:
         n_wl=80 if fast else 320,
         churn_cycles=6 if fast else 20), min_budget_s=60.0)
 
+    # Late-round TPU re-probe (round-4 verdict ask #6): when the early
+    # probe failed, try once more AFTER the CPU run — a tunnel that
+    # recovered mid-round still yields a TPU-stamped serving number.
+    # The re-run happens in a SUBPROCESS (this process is pinned to
+    # cpu) covering just the two headline serving scenarios.
+    tpu_recheck = None
+    if platform == "cpu" and not os.environ.get("KUEUE_TPU_BENCH_PLATFORM"):
+        if tpu_available(timeout_s=60, attempts=1):
+            env = dict(os.environ,
+                       KUEUE_TPU_BENCH_PLATFORM="default",
+                       KUEUE_TPU_BENCH_FAST="1",
+                       KUEUE_TPU_BENCH_RECHECK="1",
+                       KUEUE_TPU_BENCH_DEADLINE="240")
+            try:
+                r = subprocess.run(
+                    [sys.executable, __file__], capture_output=True,
+                    timeout=420, env=env)
+                sub = json.loads(r.stdout.decode().strip().splitlines()[-1])
+                tpu_recheck = {
+                    "platform": sub["platform_trailer"]["platform"],
+                    "values": sub["platform_trailer"].get("values", {}),
+                }
+            except Exception as exc:  # noqa: BLE001 — diagnostics only
+                tpu_recheck = {"error": repr(exc)[:120]}
+
     # Compact per-scenario path labels for the trailer: the platform
     # must be provable from the END of the line (the driver's capture
     # keeps the tail; r03's platform sat only at the head and was
     # truncated away).
     paths = {}
+    values = {}
     for name, sc in scenarios.items():
-        d = sc.get("detail", {}) if isinstance(sc, dict) else {}
+        if not isinstance(sc, dict):
+            continue
+        d = sc.get("detail", {})
         if "device_cycles" in d:
             paths[name] = (f"dev{d['device_cycles']}"
                            f"/fb{d.get('fallback_cycles', 0)}"
                            f"/hy{d.get('hybrid_cycles', 0)}")
         elif "tas_path" in d:
             paths[name] = d["tas_path"]
+        # Truncation-proof headline recap (round-4 verdict ask #7): the
+        # driver keeps ~2,000 tail chars; every scenario's
+        # value/unit/vs_baseline must be recoverable from the trailer
+        # alone.
+        if "value" in sc:
+            values[name] = (f"{sc['value']} {sc['unit']}"
+                            f" (vs {sc.get('vs_baseline')})")
+        elif "skipped" in sc:
+            values[name] = f"skipped:{sc['skipped']}"
+        elif "error" in sc:
+            values[name] = "error"
     print(json.dumps({
         "metric": (
             f"batched admission throughput, {flat['detail']['workloads']}"
@@ -938,14 +1007,17 @@ def main() -> None:
         "unit": "admissions/s",
         "vs_baseline": flat["vs_baseline"],
         "scenarios": scenarios,
-        # KEEP LAST: tail-proof platform stamp.
+        # KEEP LAST: tail-proof platform stamp + headline recap.
         "platform_trailer": {
             "platform": dev.platform,
             "device": str(dev),
             "probe": ("forced" if os.environ.get(
                 "KUEUE_TPU_BENCH_PLATFORM") else
                 ("tpu-ok" if platform != "cpu" else "tpu-probe-failed")),
+            "probe_attempts": PROBE_LOG,
+            "tpu_recheck": tpu_recheck,
             "paths": paths,
+            "values": values,
         },
     }))
 

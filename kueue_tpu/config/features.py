@@ -38,6 +38,10 @@ _DEFAULTS: dict[str, bool] = {
     # everywhere (the KEP#2724 profile matrix).
     "TASProfileMixed": True,
     "SkipReassignmentForPodOwnedWorkloads": True,
+    # kube_features.go:688 (beta since 0.19, default on): external
+    # admission gates via the admission-gated-by annotation; the
+    # per-integration webhooks validate the annotation's format.
+    "AdmissionGatedBy": True,
     # subsystems
     "MultiKueue": True,
     "MultiKueueOrchestratedPreemption": False,

@@ -42,6 +42,9 @@ class _BaseJob:
     done: bool = False
     success: bool = False
     injected_info: Optional[list[PodSetInfo]] = None
+    # Object annotations seen by the admission webhooks (the elastic
+    # workload-slice opt-in, admission-gated-by, ...).
+    annotations: dict = field(default_factory=dict)
 
     @property
     def key(self) -> str:
@@ -98,9 +101,11 @@ class RayClusterJob(_BaseJob):
     scale_group() is the RayCluster workerGroup replicas update."""
 
     head_requests: dict = field(default_factory=dict)
-    worker_groups: list = field(default_factory=list)  # (name, n, requests)
+    # (name, n, requests[, pod_template_annotations]) per worker group.
+    worker_groups: list = field(default_factory=list)
     enable_in_tree_autoscaling: bool = False
     elastic: bool = False
+    head_annotations: dict = field(default_factory=dict)
 
     def pod_sets(self) -> list[PodSet]:
         out = [PodSet(name="head", count=1,
@@ -230,6 +235,8 @@ class LeaderWorkerSetJob(_BaseJob):
     leader_requests: dict = field(default_factory=dict)
     worker_requests: dict = field(default_factory=dict)
     topology_request: Optional[PodSetTopologyRequest] = None
+    leader_annotations: dict = field(default_factory=dict)
+    worker_annotations: dict = field(default_factory=dict)
 
     def pod_sets(self) -> list[PodSet]:
         from dataclasses import replace as _replace
@@ -265,6 +272,8 @@ class LWSGroupJob(_BaseJob):
     leader_requests: dict = field(default_factory=dict)
     worker_requests: dict = field(default_factory=dict)
     topology_request: Optional[PodSetTopologyRequest] = None
+    leader_annotations: dict = field(default_factory=dict)
+    worker_annotations: dict = field(default_factory=dict)
 
     def pod_sets(self) -> list[PodSet]:
         from dataclasses import replace as _replace
@@ -553,6 +562,9 @@ class StatefulSetJob(_BaseJob):
     hold_at_zero: bool = True
     # ElasticJobsViaWorkloadSlices opt-in (the elastic-job annotation).
     elastic: bool = False
+    # status.readyReplicas: the webhook freezes queue/priority labels
+    # once any replica is ready (statefulset_webhook.go).
+    ready_replicas: int = 0
 
     def pod_sets(self) -> list[PodSet]:
         return [PodSet(name="pods", count=self.replicas,
@@ -605,6 +617,8 @@ class SparkApplicationJob(_BaseJob):
     executor_requests: dict = field(default_factory=dict)
     dynamic_allocation: bool = False
     elastic: bool = False
+    driver_annotations: dict = field(default_factory=dict)
+    executor_annotations: dict = field(default_factory=dict)
 
     def pod_sets(self) -> list[PodSet]:
         return [
@@ -625,6 +639,7 @@ class ServingJob(_BaseJob):
 
     replicas: int = 1
     requests: dict = field(default_factory=dict)
+    ready_replicas: int = 0
 
     def pod_sets(self) -> list[PodSet]:
         return [PodSet(name="pods", count=self.replicas,

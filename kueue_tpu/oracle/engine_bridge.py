@@ -276,10 +276,18 @@ class OracleBridge:
                 if thr is not None:
                     bwc_threshold[ci] = thr
             cq_has_parent[ci] = spec.cohort is not None
+        import jax.numpy as jnp
+
         cfg = dict(wcq_policy=wcq_policy, reclaim_policy=reclaim_policy,
                    bwc_forbidden=bwc_forbidden,
                    bwc_threshold=bwc_threshold,
                    cq_has_parent=cq_has_parent)
+        # Device-resident copies, uploaded once per spec change: the
+        # fused-preemption cycle ships these every cycle, and per-cycle
+        # host->device transfers of spec-static arrays were a measurable
+        # slice of the preemption-churn cycle floor.
+        cfg["j"] = {k: jnp.asarray(v) for k, v in cfg.items()}
+        cfg["j"]["root_of_cq"] = jnp.asarray(w.root_of_cq)
         self._pcfg_cache = (ver, w, cfg)
         return cfg
 
@@ -975,12 +983,12 @@ class OracleBridge:
                 adm_ts=ap["adm_ts"], adm_qrt=ap["adm_qrt"],
                 adm_uid=ap["adm_uid"], adm_evicted=ap["adm_ev"],
                 adm_usage=ap["adm_usage"],
-                pc_wcq_policy=pcfg["wcq_policy"],
-                pc_reclaim_policy=pcfg["reclaim_policy"],
-                pc_bwc_forbidden=pcfg["bwc_forbidden"],
-                pc_bwc_threshold=pcfg["bwc_threshold"],
-                pc_cq_has_parent=pcfg["cq_has_parent"],
-                root_of_cq=jnp.asarray(w.root_of_cq),
+                pc_wcq_policy=pcfg["j"]["wcq_policy"],
+                pc_reclaim_policy=pcfg["j"]["reclaim_policy"],
+                pc_bwc_forbidden=pcfg["j"]["bwc_forbidden"],
+                pc_bwc_threshold=pcfg["j"]["bwc_threshold"],
+                pc_cq_has_parent=pcfg["j"]["cq_has_parent"],
+                root_of_cq=pcfg["j"]["root_of_cq"],
                 adm_rank=ap["adm_rank"],
                 adm_by_root=ap["adm_by_root"],
                 slot_maybe=jnp.asarray(self._slot_maybe(

@@ -1,0 +1,89 @@
+"""The deployable control-plane process: journal-backed engine + the
+HTTP serving endpoint (metrics/visibility/dashboard/debugger) + the
+scheduling loop, with the oracle in-process or as a remote sidecar.
+
+Reference: cmd/kueue/main.go:126 (the manager main — config load,
+controllers, visibility server, scheduler loop). This is the standalone
+analog wired for the deploy story in deploy/ (docker-compose and k8s
+manifests run this as the `engine` container with the oracle service as
+a sidecar).
+
+Environment:
+  KUEUE_TPU_JOURNAL        journal path (durable store; default
+                           ./kueue-journal.jsonl)
+  KUEUE_TPU_ORACLE         "local" (default), "off", or "host:port" of
+                           a kueue-tpu-oracle service
+  KUEUE_TPU_HTTP_ADDR      bind address for the serving endpoint
+                           (default 0.0.0.0:8080)
+  KUEUE_TPU_AUTH_TOKEN     optional bearer token for the endpoint
+  KUEUE_TPU_TICK_SECONDS   idle scheduling tick (default 0.25)
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="kueue_tpu control plane (engine + serving endpoint)")
+    parser.add_argument("--journal",
+                        default=os.environ.get("KUEUE_TPU_JOURNAL",
+                                               "kueue-journal.jsonl"))
+    parser.add_argument("--oracle",
+                        default=os.environ.get("KUEUE_TPU_ORACLE", "local"))
+    parser.add_argument("--http",
+                        default=os.environ.get("KUEUE_TPU_HTTP_ADDR",
+                                               "0.0.0.0:8080"))
+    parser.add_argument("--tick", type=float,
+                        default=float(os.environ.get(
+                            "KUEUE_TPU_TICK_SECONDS", "0.25")))
+    args = parser.parse_args(argv)
+
+    from kueue_tpu.store.journal import rebuild_engine
+    from kueue_tpu.visibility.http_server import ServingEndpoint
+
+    # rebuild_engine re-attaches the journal for continued writes.
+    eng = rebuild_engine(args.journal)
+    if args.oracle == "local":
+        eng.attach_oracle()
+    elif args.oracle != "off":
+        host, _, port = args.oracle.rpartition(":")
+        eng.attach_oracle(remote_address=(host or "127.0.0.1", int(port)))
+
+    host, _, port = args.http.rpartition(":")
+    endpoint = ServingEndpoint(
+        eng, host=host or "0.0.0.0", port=int(port),
+        auth_token=os.environ.get("KUEUE_TPU_AUTH_TOKEN"))
+    endpoint.start()
+    print(f"kueue-tpu engine serving on {host or '0.0.0.0'}:"
+          f"{endpoint.port} (journal={args.journal}, "
+          f"oracle={args.oracle})", flush=True)
+
+    stop = {"flag": False}
+
+    def _stop(*_a):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+
+    # The wait.UntilWithBackoff loop (scheduler.go:207): schedule while
+    # fruitful, idle-tick otherwise; engine time advances with the wall
+    # clock so backoffs and timeouts fire.
+    while not stop["flag"]:
+        t0 = time.monotonic()
+        result = eng.schedule_once()
+        eng.tick(time.monotonic() - t0 + args.tick
+                 if result is None else time.monotonic() - t0)
+        if result is None:
+            time.sleep(args.tick)
+    endpoint.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1484,6 +1484,13 @@ class TASFlavorSnapshot:
             for i, d in enumerate(rest):
                 if remaining <= 0:
                     break
+                if d.slice_state <= 0:
+                    # Zero-capacity domains contribute nothing and are
+                    # filtered at assignment build; under LeastFreeCapacity
+                    # ordering they sort FIRST, and the reference appends
+                    # them all (thousands of zero-take domains threaded
+                    # through the descent on a full cluster) — skip.
+                    continue
                 if not state.least_free and d.slice_state >= remaining:
                     d = _best_fit_for_slices(rest[i:], remaining, 0)
                 results.append(d)
@@ -1494,97 +1501,92 @@ class TASFlavorSnapshot:
             return level_idx, results, ""
         return level_idx, [top], ""
 
-    def _consume_with_leaders(self, d, remaining_domains: list,
-                              rem: list, least_free: bool,
-                              use_slices: bool, slice_size: int):
-        """consumeWithLeadersGeneric :1518 — one domain's take while
-        leaders remain. ``rem`` is [remaining_primary, remaining_leaders]
-        (mutated). Returns (domain, completed)."""
-        def with_leader(dom):
-            return dom.slice_state_with_leader if use_slices \
-                else dom.state_with_leader
-
-        if not least_free and with_leader(d) >= rem[0] \
-                and d.leader_state >= rem[1]:
-            # optimize the last domain
-            d = (_best_fit_for_slices if use_slices
-                 else _best_fit_for_pods)(remaining_domains, rem[0], rem[1])
-        wl = with_leader(d)
-        if wl >= rem[0] and d.leader_state >= rem[1]:
-            if use_slices:
-                d.slice_state = rem[0]
-            d.leader_state = rem[1]
-            d.state = rem[0] * slice_size
-            return d, True
-        if use_slices:
-            # Clamp to remaining before consuming; state from slice count.
-            if d.slice_state_with_leader > rem[0]:
-                d.slice_state_with_leader = rem[0]
-            if d.leader_state > rem[1]:
-                d.leader_state = rem[1]
-            d.state = d.slice_state_with_leader * slice_size
-            rem[1] -= d.leader_state
-            rem[0] -= d.slice_state_with_leader
-            return d, False
-        # Pods: clamp the take to the remainder BEFORE consuming.
-        # Deliberate deviation: the reference's partial pods-with-leader
-        # branch subtracts first and never clamps domain.state
-        # (consumeWithLeadersGeneric :1565-1575), which over-counts the
-        # emitted assignment past the requested count and can zero a
-        # placed leader when the take exceeds the remainder; we apply
-        # the completed-branch semantics so assignments never exceed
-        # the request.
-        take = min(d.state_with_leader, rem[0])
-        lead_take = min(d.leader_state, rem[1])
-        d.state = take
-        d.state_with_leader = take
-        d.leader_state = lead_take
-        rem[0] -= take
-        rem[1] -= lead_take
-        return d, False
-
     def _update_counts_to_minimum(self, sorted_domains: list, count: int,
                                   leader_count: int, slice_size: int,
                                   least_free: bool,
                                   use_slices: bool) -> Optional[list]:
-        """updateCountsToMinimumGeneric :1575: distribute ``count`` pods
-        (and the leaders) over a minimal prefix of the sorted domains,
-        clamping each domain's state to its assigned amount."""
+        """updateCountsToMinimumGeneric :1575 + consumeWithLeadersGeneric
+        :1518: distribute ``count`` pods (and the leader) over a minimal
+        prefix of the sorted domains, clamping each domain's state to
+        its assigned amount.
+
+        Deliberate deviation in the multi-domain leader walk: the
+        reference consumes the leader at the FIRST capable domain in
+        worker-sort order, while the phase-1 bubbling that admitted this
+        placement promised the MIN-DIFF placement (fillInCountsHelper
+        :1930 takes min(state - stateWithLeader) over capable children)
+        — so the reference's own walk can fall short of its selection
+        and abort with errCodeAssumptionsViolated on feasible worlds.
+        Here the leader (when no single domain completes the whole
+        request) lands at the capable domain minimizing lost worker
+        capacity, honoring the selection's arithmetic; the
+        single-domain tight-fit completion path is unchanged."""
         results = []
         rem = [count // slice_size if use_slices else count, leader_count]
 
+        def primary(d):
+            return d.slice_state if use_slices else d.state
+
+        def primary_wl(d):
+            return d.slice_state_with_leader if use_slices \
+                else d.state_with_leader
+
+        def commit(d, take, leaders):
+            d.leader_state = leaders
+            if use_slices:
+                d.slice_state = take
+                d.state = take * slice_size
+            else:
+                d.state = take
+            rem[0] -= take
+            rem[1] -= leaders
+
         for i, dom in enumerate(sorted_domains):
+            if rem[0] <= 0 and rem[1] <= 0:
+                break
             if rem[1] > 0:
-                d, completed = self._consume_with_leaders(
-                    dom, sorted_domains[i:], rem, least_free,
-                    use_slices, slice_size if use_slices else 1)
-                results.append(d)
-                if completed:
+                # Single-domain completion (with the leader-filtered
+                # best-fit swap): the whole remainder + leader in one
+                # tight domain.
+                d = dom
+                if not least_free and primary_wl(dom) >= rem[0] \
+                        and dom.leader_state >= rem[1]:
+                    d = (_best_fit_for_slices if use_slices
+                         else _best_fit_for_pods)(
+                        sorted_domains[i:], rem[0], rem[1])
+                if primary_wl(d) >= rem[0] and d.leader_state >= rem[1]:
+                    commit(d, rem[0] + 0, rem[1])
+                    results.append(d)
                     return results
+                # No completion here: the leader goes to the min-diff
+                # capable domain among the remainder; everything else
+                # contributes full worker capacity.
+                capable = [d2 for d2 in sorted_domains[i:]
+                           if d2.leader_state >= rem[1]]
+                if not capable:
+                    return None
+                min_dom = min(capable,
+                              key=lambda d2: primary(d2) - primary_wl(d2))
+                if dom is min_dom:
+                    commit(dom, min(primary_wl(dom), rem[0]), rem[1])
+                else:
+                    commit(dom, min(primary(dom), rem[0]), 0)
+                if dom.state > 0 or dom.leader_state > 0:
+                    results.append(dom)
                 continue
             # No leaders remaining: tail without leaders.
-            if use_slices:
-                if not least_free and dom.slice_state >= rem[0]:
-                    dom = _best_fit_for_slices(sorted_domains[i:], rem[0], 0)
-                dom.leader_state = 0
-                if dom.slice_state >= rem[0]:
-                    dom.state = rem[0] * slice_size
-                    dom.slice_state = rem[0]
-                    results.append(dom)
-                    return results
-                dom.state = dom.slice_state * slice_size
-                rem[0] -= dom.slice_state
-                results.append(dom)
-                continue
-            if not least_free and dom.state >= rem[0]:
-                dom = _best_fit_for_pods(sorted_domains[i:], rem[0], 0)
+            if not least_free and primary(dom) >= rem[0]:
+                dom = (_best_fit_for_slices if use_slices
+                       else _best_fit_for_pods)(sorted_domains[i:],
+                                                rem[0], 0)
             dom.leader_state = 0
-            if dom.state >= rem[0]:
-                dom.state = rem[0]
+            if primary(dom) >= rem[0]:
+                commit(dom, rem[0] + 0, 0)
                 results.append(dom)
                 return results
-            rem[0] -= dom.state
-            results.append(dom)
+            commit(dom, primary(dom), 0)
+            if dom.state > 0:
+                results.append(dom)
         if rem[0] > 0 or rem[1] > 0:
             return None  # accounting violated upstream
         return results
@@ -1651,14 +1653,15 @@ def _count_slices_in_subtree(d, current_level: int, target_level: int,
                                         slice_size) for c in d.children)
 
 
-def _best_fit_by(sorted_domains: list, needed: int, cap):
+def _best_fit_by(sorted_domains: list, needed: int, cap, ok=None):
     """findBestFitDomainBy :1355: the FIRST domain with the lowest
-    capacity >= needed; the first (most-capacity) domain if none fit."""
+    capacity >= needed; the first (most-capacity) domain if none fit.
+    ``ok`` is an extra candidacy filter (see the deviation below)."""
     best = sorted_domains[0]
     best_cap = cap(best)
     for d in sorted_domains:
         c = cap(d)
-        if c >= needed and c < best_cap:
+        if c >= needed and c < best_cap and (ok is None or ok(d)):
             best = d
             best_cap = c
     return best
@@ -1666,19 +1669,29 @@ def _best_fit_by(sorted_domains: list, needed: int, cap):
 
 def _best_fit_for_slices(sorted_domains: list, slice_count: int,
                          leader_count: int):
-    """findBestFitDomainForSlices :1342."""
+    """findBestFitDomainForSlices :1342. Deliberate deviation: when a
+    leader must co-place, only leader-capable domains are best-fit
+    candidates — the reference filters on sliceStateWithLeader alone,
+    and (since stateWithLeader == state for leaderless domains,
+    fillLeafCounts :1897) can swap in a smaller domain that cannot host
+    the leader and then fail a placement that fits (review repro:
+    2 hosts, the leader-infeasible one barely covers the workers)."""
     if leader_count > 0:
-        return _best_fit_by(sorted_domains, slice_count,
-                            lambda d: d.slice_state_with_leader)
+        return _best_fit_by(
+            sorted_domains, slice_count,
+            lambda d: d.slice_state_with_leader,
+            ok=lambda d: d.leader_state >= leader_count)
     return _best_fit_by(sorted_domains, slice_count,
                         lambda d: d.slice_state)
 
 
 def _best_fit_for_pods(sorted_domains: list, count: int, leader_count: int):
-    """findBestFitDomain :1326 — pod-count flavor of the above."""
+    """findBestFitDomain :1326 — pod-count flavor of the above, same
+    leader-capability deviation."""
     if leader_count > 0:
         return _best_fit_by(sorted_domains, count,
-                            lambda d: d.state_with_leader)
+                            lambda d: d.state_with_leader,
+                            ok=lambda d: d.leader_state >= leader_count)
     return _best_fit_by(sorted_domains, count, lambda d: d.state)
 
 
@@ -1688,8 +1701,10 @@ IS_GROUP_WORKLOAD_ANNOTATION = "kueue.x-k8s.io/is-group-workload"
 def owned_by_single_pod(workload) -> bool:
     """workload.OwnedBySinglePod (pkg/workload/workload.go:1309): one
     core/v1 Pod owner and not a pod-group workload."""
+    if workload is None:
+        return False
     refs = tuple(getattr(workload, "owner_references", ()) or ())
-    if workload is None or len(refs) != 1:
+    if len(refs) != 1:
         return False
     anns = getattr(workload, "annotations", {}) or {}
     if anns.get(IS_GROUP_WORKLOAD_ANNOTATION) == "true":

@@ -54,6 +54,10 @@ DASHBOARD_HTML = """<!DOCTYPE html>
   </tr></thead><tbody></tbody></table>
 <h2>Oracle (device fast path)</h2>
 <div id="oracle" style="font-size:.85rem"></div>
+<h2>Live events <span id="live-state" style="font-size:.75rem;color:#888"></span></h2>
+<table id="live"><thead><tr>
+  <th>Time</th><th>Kind</th><th>Workload</th><th>ClusterQueue</th>
+  <th>Detail</th></tr></thead><tbody></tbody></table>
 <script>
 async function getJSON(p) { const r = await fetch(p); return r.json(); }
 function fill(id, rows) {
@@ -142,6 +146,33 @@ async function refresh() {
 }
 refresh();
 setInterval(refresh, 2000);
+// Live push (no polling): the /events SSE stream carries every
+// queue/admission transition from the engine's event fan-out — the
+// KueueViz WebSocket analog.
+(function () {
+  const state = document.getElementById("live-state");
+  const tb = document.querySelector("#live tbody");
+  const es = new EventSource("/events");
+  es.onopen = () => { state.textContent = "(streaming)"; };
+  es.onerror = () => { state.textContent = "(reconnecting...)"; };
+  es.onmessage = () => {};
+  for (const kind of ["Admitted", "QuotaReserved", "Preempted",
+                      "Requeued", "Finished", "Submitted", "Evicted",
+                      "NodeReplaced", "NodeUnhealthy"]) {
+    es.addEventListener(kind, (e) => {
+      const ev = JSON.parse(e.data);
+      const tr = document.createElement("tr");
+      for (const c of [ev.time.toFixed(3), ev.kind, ev.workload,
+                       ev.clusterQueue, ev.detail]) {
+        const td = document.createElement("td");
+        td.textContent = c;
+        tr.appendChild(td);
+      }
+      tb.prepend(tr);
+      while (tb.children.length > 50) tb.removeChild(tb.lastChild);
+    });
+  }
+})();
 </script>
 </body>
 </html>

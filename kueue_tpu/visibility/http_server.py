@@ -83,6 +83,13 @@ def make_handler(engine, auth_token=None, apf=None):
             if not self._authorized():
                 self._send('{"error":"unauthorized"}', code=401)
                 return
+            if urlparse(self.path).path.rstrip("/") == "/events":
+                # The SSE stream is long-lived: holding an APF seat for
+                # its lifetime would permanently occupy a shuffle-shard
+                # slot (the apiserver exempts WATCH from APF seats the
+                # same way after the initial admit).
+                self._serve_events()
+                return
             if apf is not None:
                 from kueue_tpu.visibility.flowcontrol import RejectedError
                 try:
@@ -105,6 +112,60 @@ def make_handler(engine, auth_token=None, apf=None):
                     apf.release(ticket)
             else:
                 self._serve_get()
+
+        def _serve_events(self):
+            """Server-sent-events push of queue/admission transitions —
+            the live-update surface of the reference's KueueViz
+            WebSocket backend (cmd/kueueviz/backend streams watch
+            events; here the engine's EngineEvent fan-out
+            (controllers/engine.py event_listeners, the informer
+            analog) feeds each connected browser/curl session without
+            polling. Long-lived response: one handler thread per
+            subscriber (ThreadingHTTPServer), keep-alive comments every
+            15 s, bounded per-client queue (a slow consumer drops
+            events rather than backing up the scheduling thread)."""
+            import queue as _queue
+
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "keep-alive")
+            self.end_headers()
+            q: _queue.Queue = _queue.Queue(maxsize=1024)
+
+            def listener(ev):
+                try:
+                    q.put_nowait(ev)
+                except _queue.Full:
+                    pass
+
+            engine.event_listeners.append(listener)
+            try:
+                self.wfile.write(b": connected\n\n")
+                self.wfile.flush()
+                while True:
+                    try:
+                        ev = q.get(timeout=15.0)
+                    except _queue.Empty:
+                        self.wfile.write(b": keep-alive\n\n")
+                        self.wfile.flush()
+                        continue
+                    payload = json.dumps({
+                        "time": ev.time, "kind": ev.kind,
+                        "workload": ev.workload,
+                        "clusterQueue": ev.cluster_queue,
+                        "detail": ev.detail})
+                    self.wfile.write(
+                        f"event: {ev.kind}\ndata: {payload}\n\n"
+                        .encode())
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass  # client went away
+            finally:
+                try:
+                    engine.event_listeners.remove(listener)
+                except ValueError:
+                    pass
 
         def _serve_get(self):
             path = urlparse(self.path).path.rstrip("/")

@@ -1,0 +1,285 @@
+"""Go-authored per-integration webhook validation goldens.
+
+Transliterated from the reference's webhook test tables (names
+preserved):
+  * pkg/controller/jobs/statefulset/statefulset_webhook_test.go
+    (TestValidateCreate :185, TestValidateUpdate :383)
+  * pkg/controller/jobs/sparkapplication/sparkapplication_webhook_test.go
+    (TestValidateCreate :40)
+  * pkg/controller/jobs/raycluster/raycluster_webhook_test.go
+    (TestValidateCreate :128)
+
+Errors are matched by their distinctive reference fragments (field
+path + message core), not byte-for-byte field.Error formatting.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kueue_tpu.config import features
+from kueue_tpu.controllers.integrations import (
+    LeaderWorkerSetJob,
+    RayClusterJob,
+    SparkApplicationJob,
+    StatefulSetJob,
+)
+from kueue_tpu.webhooks.jobwebhooks import (
+    LeaderWorkerSetWebhook,
+    RayClusterWebhook,
+    SparkApplicationWebhook,
+    StatefulSetWebhook,
+)
+
+GATE_ANN = "kueue.x-k8s.io/admission-gated-by"
+ELASTIC_ANN = "kueue.x-k8s.io/elastic-job"
+REQ_TOPO = "kueue.x-k8s.io/podset-required-topology"
+PREF_TOPO = "kueue.x-k8s.io/podset-preferred-topology"
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    features.reset()
+    yield
+    features.reset()
+
+
+def sts(queue="", annotations=None, ready=0, priority=0):
+    return StatefulSetJob(name="test-sts", queue_name=queue,
+                          annotations=dict(annotations or {}),
+                          ready_replicas=ready, priority=priority)
+
+
+def expect(errs, *fragments):
+    if not fragments:
+        assert errs == [], errs
+        return
+    for frag in fragments:
+        assert any(frag in e for e in errs), (frag, errs)
+
+
+# --- statefulset_webhook_test.go TestValidateCreate :185 ---
+
+STS_CREATE = {
+    "without queue": (sts(), None, ()),
+    "valid queue name": (sts("test-queue"), None, ()),
+    "invalid queue name": (
+        sts("test/queue"), None,
+        ("metadata.labels[kueue.x-k8s.io/queue-name]",)),
+    "AdmissionGatedBy annotation - single gate": (
+        sts("queue", {GATE_ANN: "example.com/controller"}),
+        {"AdmissionGatedBy": True}, ()),
+    "AdmissionGatedBy annotation - trailing space": (
+        sts("queue", {GATE_ANN: "example.com/gate "}),
+        {"AdmissionGatedBy": True}, ()),
+    "AdmissionGatedBy annotation - space before comma": (
+        sts("queue", {GATE_ANN: "example.com/gate ,example.com/gate2"}),
+        {"AdmissionGatedBy": True}, ()),
+    "AdmissionGatedBy annotation - space after comma": (
+        sts("queue", {GATE_ANN: "example.com/gate, example.com/gate2"}),
+        {"AdmissionGatedBy": True}, ()),
+    "AdmissionGatedBy annotation - leading space": (
+        sts("queue", {GATE_ANN: " example.com/gate"}),
+        {"AdmissionGatedBy": True}, ()),
+    "AdmissionGatedBy annotation - multiple gates": (
+        sts("queue", {GATE_ANN: "example.com/a,not.example.com/b"}),
+        {"AdmissionGatedBy": True}, ()),
+    "invalid AdmissionGatedBy annotation - not in subdomain/path format":
+    (
+        sts("queue", {GATE_ANN: "this is an invalid value"}),
+        {"AdmissionGatedBy": True},
+        ('must be a domain-prefixed path (such as "acme.io/foo")',)),
+    "invalid AdmissionGatedBy annotation - duplicate gates": (
+        sts("queue",
+            {GATE_ANN: "duplicates.are/invalid,duplicates.are/invalid"}),
+        {"AdmissionGatedBy": True},
+        ("duplicate gate name: duplicates.are/invalid",)),
+    "invalid AdmissionGatedBy annotation - gate name too long": (
+        sts("queue", {GATE_ANN: "cannot.be.too.long/"
+                      + "but-this-is-too-long" * 20}),
+        {"AdmissionGatedBy": True}, ("Too long",)),
+    "invalid AdmissionGatedBy annotation - space in path component": (
+        sts("queue", {GATE_ANN: "example.com/gate name"}),
+        {"AdmissionGatedBy": True},
+        ("name part must consist of alphanumeric characters",)),
+    "invalid AdmissionGatedBy annotation - space in domain component": (
+        sts("queue", {GATE_ANN: "example .com/gate"}),
+        {"AdmissionGatedBy": True},
+        ("a lowercase RFC 1123 subdomain must consist of",)),
+    "invalid AdmissionGatedBy annotation - multiple gates with one "
+    "containing space": (
+        sts("queue", {GATE_ANN: "valid.com/gate,invalid gate.com/"
+                      "controller"}),
+        {"AdmissionGatedBy": True},
+        ("a lowercase RFC 1123 subdomain must consist of",)),
+    "AdmissionGatedBy annotation with feature gate disabled - valid "
+    "value": (
+        sts("queue", {GATE_ANN: "example.com/gate"}),
+        {"AdmissionGatedBy": False}, ()),
+    "AdmissionGatedBy annotation with feature gate disabled - invalid "
+    "value": (
+        sts("queue", {GATE_ANN: "this is an invalid value"}),
+        {"AdmissionGatedBy": False}, ()),
+    "AdmissionGatedBy annotation with feature gate enabled - empty "
+    "string": (
+        sts("queue", {GATE_ANN: ""}),
+        {"AdmissionGatedBy": True}, ()),
+    "elastic job annotation is rejected": (
+        sts("queue", {ELASTIC_ANN: "true"}),
+        {"ElasticJobsViaWorkloadSlices": True},
+        (f"metadata.annotations[{ELASTIC_ANN}]",
+         "elastic job is not supported for")),
+}
+
+
+@pytest.mark.parametrize("name", sorted(STS_CREATE))
+def test_statefulset_validate_create_golden(name):
+    job, gates, fragments = STS_CREATE[name]
+    for gate, val in (gates or {}).items():
+        features.set_feature(gate, val)
+    expect(StatefulSetWebhook().validate_create(job), *fragments)
+
+
+# --- statefulset_webhook_test.go TestValidateUpdate :383 ---
+
+STS_UPDATE = {
+    "no changes": (sts("queue1"), sts("queue1"), ()),
+    "change in queue label": (
+        sts("test-queue"), sts("test-queue-new"), ()),
+    "change in queue label (ReadyReplicas > 0)": (
+        sts("test-queue", ready=1), sts("test-queue-new", ready=1),
+        ("metadata.labels[kueue.x-k8s.io/queue-name]",)),
+    "set queue label": (sts(), sts("test-queue"), ()),
+    "set queue label (ReadyReplicas > 0)": (
+        sts(ready=1), sts("test-queue", ready=1),
+        ("metadata.labels[kueue.x-k8s.io/queue-name]",)),
+    "delete queue name": (
+        sts("test-queue"), sts(),
+        ("metadata.labels[kueue.x-k8s.io/queue-name]",)),
+    "change in priority class label when suspended": (
+        sts("queue1", priority=1), sts("queue1", priority=2), ()),
+    "set in priority class label when replicas ready": (
+        sts("queue1", ready=1, priority=1),
+        sts("queue1", ready=1, priority=2),
+        ("metadata.labels[kueue.x-k8s.io/priority-class]",)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(STS_UPDATE))
+def test_statefulset_validate_update_golden(name):
+    old, new, fragments = STS_UPDATE[name]
+    expect(StatefulSetWebhook().validate_update(old, new), *fragments)
+
+
+# --- sparkapplication_webhook_test.go TestValidateCreate :40 ---
+
+def spark(queue="local-queue", dynamic=False, annotations=None,
+          driver_ann=None, executor_ann=None):
+    return SparkApplicationJob(
+        name="test-sparkapp", queue_name=queue,
+        dynamic_allocation=dynamic,
+        annotations=dict(annotations or {}),
+        driver_annotations=dict(driver_ann or {}),
+        executor_annotations=dict(executor_ann or {}))
+
+
+SPARK_CREATE = {
+    "base": (spark(), None, ()),
+    "dynamicAllocation without elastic job feature": (
+        spark(dynamic=True), None,
+        ("spec.dynamicAllocation.enabled",
+         "a kueue managed job can use dynamicAllocation only when the "
+         "ElasticJobsViaWorkloadSlices feature gate is on and the job "
+         "is an elastic job")),
+    "dynamicAllocation with elastic job feature": (
+        spark(dynamic=True, annotations={ELASTIC_ANN: "true"}),
+        {"ElasticJobsViaWorkloadSlices": True},
+        ('elastic job is not supported for '
+         '\'sparkoperator.k8s.io/v1beta2, Kind=SparkApplication\'',)),
+    "base with TAS": (
+        spark(executor_ann={REQ_TOPO: "cloud.com/block"}),
+        {"TopologyAwareScheduling": True}, ()),
+    "invalid TAS configuration": (
+        spark(executor_ann={REQ_TOPO: "cloud.com/block",
+                            PREF_TOPO: "cloud.com/block"}),
+        {"TopologyAwareScheduling": True},
+        ("must not contain more than one topology annotation",)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(SPARK_CREATE))
+def test_sparkapplication_validate_create_golden(name):
+    job, gates, fragments = SPARK_CREATE[name]
+    for gate, val in (gates or {}).items():
+        features.set_feature(gate, val)
+    expect(SparkApplicationWebhook().validate_create(job), *fragments)
+
+
+# --- raycluster_webhook_test.go TestValidateCreate :128 ---
+
+def ray(queue="queue", autoscaling=False, groups=(), head_ann=None):
+    return RayClusterJob(name="job", queue_name=queue,
+                         enable_in_tree_autoscaling=autoscaling,
+                         worker_groups=list(groups),
+                         head_annotations=dict(head_ann or {}))
+
+
+RAY_CREATE = {
+    "invalid unmanaged": (ray(queue=""), None, ()),
+    "invalid managed - has auto scaler": (
+        ray(autoscaling=True), None,
+        ("spec.enableInTreeAutoscaling",
+         "a kueue managed job can use autoscaling only when the "
+         "ElasticJobsViaWorkloadSlices feature gate is on and the job "
+         "is an elastic job")),
+    "invalid managed - too many worker groups": (
+        ray(groups=[(f"wg{i}", 1, {"cpu": 100}) for i in range(18)]),
+        None,
+        ("spec.workerGroupSpecs: Too many: 19: must have at most 18 "
+         "items",)),
+    "worker group uses head name": (
+        ray(groups=[("head", 1, {"cpu": 100})]), None,
+        ('spec.workerGroupSpecs[0].groupName',
+         '"head" is reserved for the head group')),
+    "valid topology request": (
+        ray(head_ann={REQ_TOPO: "cloud.com/block"},
+            groups=[("wg1", 1, {"cpu": 100},
+                     {REQ_TOPO: "cloud.com/block"}),
+                    ("wg2", 1, {"cpu": 100},
+                     {PREF_TOPO: "cloud.com/block"}),
+                    ("wg3", 1, {"cpu": 100})]),
+        {"TopologyAwareScheduling": True}, ()),
+    "invalid topology request": (
+        ray(head_ann={REQ_TOPO: "cloud.com/block",
+                      PREF_TOPO: "cloud.com/block"},
+            groups=[("wg1", 1, {"cpu": 100},
+                     {REQ_TOPO: "cloud.com/block",
+                      PREF_TOPO: "cloud.com/block"})]),
+        {"TopologyAwareScheduling": True},
+        ("must not contain more than one topology annotation",)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(RAY_CREATE))
+def test_raycluster_validate_create_golden(name):
+    job, gates, fragments = RAY_CREATE[name]
+    for gate, val in (gates or {}).items():
+        features.set_feature(gate, val)
+    expect(RayClusterWebhook().validate_create(job), *fragments)
+
+
+# --- leaderworkerset: group shape + topology exclusivity ---
+
+def test_lws_invalid_size_and_topology():
+    bad = LeaderWorkerSetJob(name="lws", queue_name="q", size=0,
+                             worker_annotations={
+                                 REQ_TOPO: "b", PREF_TOPO: "b"})
+    errs = LeaderWorkerSetWebhook().validate_create(bad)
+    expect(errs, "spec.leaderWorkerTemplate.size",
+           "must not contain more than one topology annotation")
+
+
+def test_lws_valid():
+    ok = LeaderWorkerSetJob(name="lws", queue_name="q", size=2,
+                            leader_annotations={REQ_TOPO: "b"})
+    assert LeaderWorkerSetWebhook().validate_create(ok) == []

@@ -1,0 +1,69 @@
+"""The deployable control-plane process (kueue_tpu/serve.py, wired by
+deploy/docker-compose.yaml and deploy/k8s.yaml): boots from a journal,
+serves /healthz + visibility, schedules, and shuts down cleanly on
+SIGTERM."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+
+def test_serve_boots_schedules_and_stops(tmp_path):
+    journal = tmp_path / "journal.jsonl"
+    # Seed the journal with a world + one pending workload via an
+    # engine (the kueuectl/importer path in production).
+    from kueue_tpu.api.types import (
+        ClusterQueue, FlavorQuotas, LocalQueue, PodSet, ResourceFlavor,
+        ResourceGroup, ResourceQuota, Workload,
+    )
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.store.journal import Journal
+
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            ("cpu",), (FlavorQuotas("default",
+                                    {"cpu": ResourceQuota(4000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    eng.attach_journal(Journal(str(journal)))
+    eng.submit(Workload(name="w0", queue_name="lq",
+                        pod_sets=(PodSet("main", 1, {"cpu": 1000}),)))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=os.getcwd())
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kueue_tpu.serve", "--journal",
+         str(journal), "--oracle", "off", "--http", "127.0.0.1:0",
+         "--tick", "0.05"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "serving on" in line, line
+        port = int(line.split("serving on ")[1].split(" ")[0]
+                   .rsplit(":", 1)[1])
+        deadline = time.time() + 30
+        admitted = False
+        while time.time() < deadline and not admitted:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+                assert r.status == 200
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/dump",
+                    timeout=5) as r:
+                state = json.loads(r.read())
+            admitted = any(w.get("admitted") for w in
+                           state.get("workloads", [])) or \
+                "default/w0" in str(state)
+            time.sleep(0.2)
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=20) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
