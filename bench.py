@@ -60,12 +60,18 @@ def tpu_available(timeout_s: int = 90, attempts: int = 3,
     platform trailer can prove how often and when the tunnel was
     tried."""
     ok = False
+    # The probe must see the REAL default platform stack (axon,cpu):
+    # once main() pins this process to cpu via JAX_PLATFORMS, an
+    # inheriting subprocess would "succeed" on the CPU backend and a
+    # late re-probe could never detect a recovered tunnel.
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     for k in range(attempts):
         t0 = time.time()
         outcome = "timeout"
         try:
             r = subprocess.run([sys.executable, "-c", PROBE],
-                               capture_output=True, timeout=timeout_s)
+                               capture_output=True, timeout=timeout_s,
+                               env=env)
             outcome = "ok" if b"ok" in r.stdout else "error"
         except subprocess.TimeoutExpired:
             outcome = "timeout"
@@ -906,7 +912,16 @@ def main() -> None:
     flat, scen, snap, infos = bench_throughput_flat(n_workloads, n_cohorts)
     scenarios["throughput_flat"] = flat
 
+    # Re-probe mode (the late-round TPU recheck subprocess): cover only
+    # the two headline serving scenarios so a recovered tunnel yields a
+    # TPU-stamped number inside the remaining budget.
+    recheck_only = os.environ.get("KUEUE_TPU_BENCH_RECHECK") == "1"
+    RECHECK_SCENARIOS = ("cycle_latency", "tas_churn")
+
     def run_scenario(name, fn, min_budget_s=45.0):
+        if recheck_only and name not in RECHECK_SCENARIOS:
+            scenarios[name] = {"skipped": "recheck-mode"}
+            return
         remaining = deadline - time.monotonic()
         if remaining < min_budget_s:
             scenarios[name] = {"skipped": "deadline",
@@ -956,6 +971,8 @@ def main() -> None:
                        KUEUE_TPU_BENCH_FAST="1",
                        KUEUE_TPU_BENCH_RECHECK="1",
                        KUEUE_TPU_BENCH_DEADLINE="240")
+            # The child must not inherit this process's cpu pin.
+            env.pop("JAX_PLATFORMS", None)
             try:
                 r = subprocess.run(
                     [sys.executable, __file__], capture_output=True,

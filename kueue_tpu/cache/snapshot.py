@@ -415,13 +415,42 @@ class Snapshot:
 
     def simulate_workload_removal(
             self, infos: list[WorkloadInfo]) -> Callable[[], None]:
-        """snapshot.go:77 (SimulateWorkloadRemoval)."""
+        """snapshot.go:77 (SimulateWorkloadRemoval). The revert restores
+        each touched TAS forest's usage-version bookkeeping: a
+        preemption candidate search runs hundreds of simulate/revert
+        pairs per nomination, and letting each bump the version forever
+        would invalidate every version-keyed memo (placement results,
+        exclusion stats, device usage matrices) for state that is
+        bit-identical after the revert. Reverts nest LIFO (the
+        preemptor's search discipline), so the snapshots compose."""
+        tas_vers = {id(t): (t, getattr(t, "_usage_version", 0),
+                            getattr(t, "_usage_removals", 0))
+                    for t in self.tas_flavors.values()}
         for info in infos:
             self.remove_workload(info)
 
         def revert() -> None:
             for info in infos:
                 self.add_workload(info)
+            for tas, ver, rem in tas_vers.values():
+                # Cache/memo entries keyed at interim versions would
+                # collide with future bumps after the restore and serve
+                # results computed against the simulated (reverted)
+                # state — purge any not keyed at the restored version.
+                mc = getattr(tas, "_usage_matrix_cache", None)
+                if mc is not None and mc[0][0] != ver:
+                    tas._usage_matrix_cache = None
+                jc = getattr(tas, "_j_usage_cache", None)
+                if jc is not None and jc[0][0] != ver:
+                    tas._j_usage_cache = None
+                pm = getattr(tas, "_place_memo", None)
+                if pm is not None and pm[0] != ver:
+                    tas._place_memo = None
+                sm = getattr(tas, "_stats_memo", None)
+                if sm is not None and sm[0][1] != ver:
+                    tas._stats_memo = None
+                tas._usage_version = ver
+                tas._usage_removals = rem
         return revert
 
 

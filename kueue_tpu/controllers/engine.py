@@ -1104,7 +1104,8 @@ class Engine:
             info = entry.info
             wl = info.obj
             if (wl.status.reclaimable_pods or entry.preemption_targets
-                    or checks is not None):
+                    or checks is not None
+                    or wl.status.admission_check_states):
                 slow.append(entry)
                 continue
             key = wl.key
@@ -1193,7 +1194,9 @@ class Engine:
         reset_conds = bulk.reset_conds
         lq_on = self._lq_metrics_on()
         events = self.events
-        listeners = self.event_listeners
+        # Snapshot: SSE handler threads append/remove listeners while
+        # cycles iterate (client-go informers snapshot the same way).
+        listeners = tuple(self.event_listeners)
         on_admit = self.on_admit
         journal_on = self.journal is not None
         QR = WorkloadConditionType.QUOTA_RESERVED
@@ -1472,6 +1475,14 @@ class Engine:
                        bulk: "Optional[_BulkAdmitCtx]" = None) -> None:
         """workload.SyncAdmittedCondition."""
         if wl.is_admitted:
+            return
+        # EVERY check state present in status must be Ready — including
+        # states injected by external controllers for checks the CQ
+        # doesn't configure (workload/admissionchecks.go:130
+        # HasAllChecksReady iterates status, not the CQ's list).
+        from kueue_tpu.controllers.admissionchecks import CheckState
+        if any(s != CheckState.READY
+               for s in wl.status.admission_check_states.values()):
             return
         if (self.admission_checks is not None
                 and not self.admission_checks.all_ready(wl, cq_name)):
@@ -1757,7 +1768,7 @@ class Engine:
         elif self.journal is not None and workload in self.workloads:
             self.journal.apply("workload", self.workloads[workload],
                                ts=self.clock)
-        for fn in self.event_listeners:
+        for fn in tuple(self.event_listeners):
             # Handler errors must not unwind the scheduling cycle
             # (client-go informers isolate handler panics the same way).
             try:

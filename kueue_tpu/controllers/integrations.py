@@ -110,15 +110,15 @@ class RayClusterJob(_BaseJob):
     def pod_sets(self) -> list[PodSet]:
         out = [PodSet(name="head", count=1,
                       requests=dict(self.head_requests))]
-        for gname, replicas, requests in self.worker_groups:
+        for gname, replicas, requests, *_ann in self.worker_groups:
             out.append(PodSet(name=gname, count=replicas,
                               requests=dict(requests)))
         return out
 
     def scale_group(self, group: str, replicas: int) -> None:
         self.worker_groups = [
-            (g, replicas if g == group else n, req)
-            for g, n, req in self.worker_groups]
+            (g[0], replicas if g[0] == group else g[1], *g[2:])
+            for g in self.worker_groups]
 
 
 @dataclass
@@ -183,7 +183,7 @@ class RayJob(_BaseJob):
                               requests=dict(self.submitter_requests)))
         out.append(PodSet(name="head", count=1,
                           requests=dict(self.head_requests)))
-        for gname, replicas, requests in self.worker_groups:
+        for gname, replicas, requests, *_ann in self.worker_groups:
             out.append(PodSet(name=gname, count=replicas,
                               requests=dict(requests)))
         return out
@@ -201,7 +201,7 @@ class RayServiceJob(_BaseJob):
     def pod_sets(self) -> list[PodSet]:
         out = [PodSet(name="head", count=1,
                       requests=dict(self.head_requests))]
-        for gname, replicas, requests in self.worker_groups:
+        for gname, replicas, requests, *_ann in self.worker_groups:
             out.append(PodSet(name=gname, count=replicas,
                               requests=dict(requests)))
         return out

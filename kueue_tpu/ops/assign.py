@@ -149,6 +149,12 @@ def assign_flavors(
     can_pwb,  # bool[C]
     fung_borrow_try_next,  # bool[C]
     fung_pref_preempt_first,  # bool[C]
+    flavor_ok=None,  # bool[W, NF] per-workload flavor eligibility
+    #   (taints/selectors/affinity vs the flavor's nodeLabels —
+    #   flavorassigner.flavor_matches_podset evaluated on host at row
+    #   encode; None = all flavors eligible). A masked flavor is
+    #   skipped exactly like the reference's checkFlavorForPodSets
+    #   taint/affinity rejection: try the next flavor in order.
     *,
     depth: int,
     num_resources: int,
@@ -175,7 +181,7 @@ def assign_flavors(
 
     G, F = group_flavors.shape[1], group_flavors.shape[2]
 
-    def per_workload(c, req_ps):
+    def per_workload(c, req_ps, ok):
         g_of_res = group_of_res[c]  # [S]
 
         def podset_step(acc, req):
@@ -197,6 +203,8 @@ def assign_flavors(
                     (best_key, best_fl, best_pmode_s, best_borrow_s,
                      best_oracle, stopped) = carry
                     valid = fl >= 0
+                    if ok is not None:
+                        valid = valid & ok[jnp.maximum(fl, 0)]
                     pmode_s, borrow_s, oracle_s = eval_flavor(
                         jnp.maximum(fl, 0))
                     # Mask resources outside the group as
@@ -291,4 +299,7 @@ def assign_flavors(
         return (flavor_ps, jnp.min(pmode_ps), jnp.max(borrow_ps),
                 jnp.any(oracle_ps), usage_fr_ps)
 
-    return jax.vmap(per_workload)(wl_cq, wl_req)
+    if flavor_ok is None:
+        return jax.vmap(lambda c, r: per_workload(c, r, None))(
+            wl_cq, wl_req)
+    return jax.vmap(per_workload)(wl_cq, wl_req, flavor_ok)

@@ -118,6 +118,9 @@ def _cycle_core(
     adm_rank=None,  # int64[A] precomputed candidate-ordering rank
     #   (ops/preempt.classical_targets_impl adm_rank)
     adm_by_root=None,  # int32[Rn, A_l] admitted ids grouped by root
+    wl_flavor_ok=None,  # bool[W, NF] per-workload flavor eligibility
+    #   masks (taints/selectors/affinity — ops/assign.assign_flavors
+    #   flavor_ok); None = every flavor eligible for every row
     slot_maybe=None,  # bool[C] host precheck: this slot's head COULD
     #   have preemption candidates (exact-conservative: False only when
     #   provably none exist — candidate_generator.go's policy tests
@@ -161,11 +164,15 @@ def _cycle_core(
 
     # 3. Nominate all heads at once (per-podset flavor choices with
     # within-workload usage accumulation, flavorassigner.go:707).
+    h_ok = None
+    if wl_flavor_ok is not None:
+        h_ok = jnp.where(slot_valid[:, None], wl_flavor_ok[h_safe], True)
     flavor_of_res, pmode, borrows, needs_oracle, usage_fr = \
         aops.assign_flavors(
             h_cq, h_req, derived, nominal, ancestors, height, group_of_res,
             group_flavors, no_preemption, can_pwb, fung_borrow_try_next,
-            fung_pref_preempt_first, depth=depth, num_resources=S)
+            fung_pref_preempt_first, flavor_ok=h_ok,
+            depth=depth, num_resources=S)
     if slot_borrows_override is not None:
         borrows = jnp.where(slot_borrows_override >= 0,
                             slot_borrows_override, borrows)

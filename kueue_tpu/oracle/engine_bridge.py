@@ -868,8 +868,27 @@ class OracleBridge:
         sim_cq = (mf & ~w.no_preemption & has_head & head_eligible
                   & flavor_safe & cq_on_device)
         if sim_cq.any():
-            # The sim grid (flavor_grid + per-cell preemption sims) is
-            # single-podset; multi-podset heads needing it go host.
+            # The sim grid (flavor_grid + per-cell preemption sims)
+            # doesn't thread per-workload flavor masks; node-filtered
+            # heads that need simulation go host.
+            if wl.flavor_ok is not None:
+                masked = np.zeros(C, bool)
+                for ci in np.nonzero(sim_cq)[0]:
+                    # Only flavors the CQ's resource groups actually
+                    # reference matter; a mask hole on an unrelated
+                    # flavor must not demote the root.
+                    fls = w.group_flavors[ci]
+                    fls = fls[fls >= 0]
+                    if fls.size and not wl.flavor_ok[
+                            head_wid[ci]][fls].all():
+                        masked[ci] = True
+                if masked.any():
+                    demote(masked, "sim-flavor-mask")
+                    cq_on_device = ~host_root[root_of_cq]
+                    sim_cq = sim_cq & cq_on_device
+        if sim_cq.any():
+            # The sim grid is single-podset; multi-podset heads needing
+            # it go host.
             multi_ps = np.zeros(C, bool)
             for ci in np.nonzero(sim_cq)[0]:
                 if len(pending_infos[head_wid[ci]].total_requests) > 1:
@@ -926,6 +945,11 @@ class OracleBridge:
             wl_hash=jnp.asarray(wl.hash_id),
             wl_ts=jnp.asarray(wl.timestamp),
         )
+        if wl.flavor_ok is not None:
+            # Per-workload flavor eligibility (taints/selectors/affinity)
+            # — lets node-filtered rows ride the dense path instead of
+            # demoting their root (round-4 verdict ask #4).
+            args["wl_flavor_ok"] = jnp.asarray(wl.flavor_ok)
         args.update(self._device_world_args(w))
         # Bucket-pad the workload axis so recurring cycles with varying
         # pending counts reuse one compiled program per bucket.
@@ -939,7 +963,8 @@ class OracleBridge:
         device_w_padded = device_w
         if Wp != W:
             for key, fill in WL_PAD_FILLS.items():
-                args[key] = jnp.asarray(pad_axis0(args[key], Wp, fill))
+                if key in args:  # optional tensors (wl_flavor_ok)
+                    args[key] = jnp.asarray(pad_axis0(args[key], Wp, fill))
             device_w_padded = pad_axis0(device_w, Wp, False)
         pending = jnp.asarray(device_w_padded)
         inadmissible = jnp.zeros(Wp, bool)

@@ -381,6 +381,7 @@ class TASFlavorSnapshot:
         self._txn = None
         self._feas = None
         self._place_memo = None
+        self._stats_memo = None
 
     def commit_usage(self, values: tuple, deltas: dict[str, int]) -> None:
         """Write-through from the live cache's admitted-side accounting
@@ -1003,7 +1004,33 @@ class TASFlavorSnapshot:
         of (request, forest state), so EVERY decision path (host walk,
         numpy phase-1, device kernel, feasibility batch) renders the
         identical message by calling this at failure time instead of
-        collecting counters inline."""
+        collecting counters inline.
+
+        The walk is O(leaves); a churn cycle renders failure messages
+        for MANY homogeneous rejected heads (30 heads x 5,120 leaves
+        regressed the device churn path 50x before the memo), so
+        results are memoized per (request fingerprint, usage/structure
+        version) for the common unaccumulated call shape."""
+        key = None
+        memo = None
+        if not assumed_usage and not required_replacement_domain:
+            # ONE version key for both usage variants: simulate-empty
+            # stats don't depend on usage, but alternating live/empty
+            # renders with differing memo versions would thrash the
+            # single-slot memo (they interleave per head).
+            ver = (self._version, getattr(self, "_usage_version", 0))
+            memo = getattr(self, "_stats_memo", None)
+            if memo is None or memo[0] != ver or len(memo[1]) > 1024:
+                memo = (ver, {})
+                self._stats_memo = memo
+            key = (tuple(sorted(per_pod.items())),
+                   tuple(sorted(pod_set.node_selector.items())),
+                   tuple(pod_set.tolerations),
+                   tuple(tuple(t) for t in (pod_set.node_affinity or ())),
+                   bool(simulate_empty))
+            hit = memo[1].get(key)
+            if hit is not None:
+                return hit
         stats = ExclusionStats()
         stats.total_nodes = len(self.leaves)
         excluded = self._match_excluded(pod_set)
@@ -1015,23 +1042,95 @@ class TASFlavorSnapshot:
             else:
                 stats.affinity += 1
         rrd = tuple(required_replacement_domain or ())
-        for values, leaf in self.leaves.items():
-            if values in excluded:
-                continue
-            if rrd and values[:len(rrd)] != rrd:
-                stats.topology_domain += 1
-                continue
-            remaining = dict(leaf.free_capacity)
-            if not simulate_empty:
-                for res, used in leaf.tas_usage.items():
-                    remaining[res] = remaining.get(res, 0) - used
-                for res, used in assumed_usage.get(leaf.id, {}).items():
-                    remaining[res] = remaining.get(res, 0) - used
-            cnt, limiting = self._count_in_with_limiting(per_pod, remaining)
-            if cnt == 0 and limiting:
-                stats.resources[limiting] = \
-                    stats.resources.get(limiting, 0) + 1
+        res_order = [(res, need) for res, need in
+                     sorted(per_pod.items()) if need > 0]
+        if (len(self.leaves) >= 256 and not assumed_usage
+                and self._np_resource_exclusions(
+                    res_order, simulate_empty, excluded, rrd, stats)):
+            pass  # vectorized path filled the resource counts
+        else:
+            for values, leaf in self.leaves.items():
+                if values in excluded:
+                    continue
+                if rrd and values[:len(rrd)] != rrd:
+                    stats.topology_domain += 1
+                    continue
+                free = leaf.free_capacity
+                usage = leaf.tas_usage if not simulate_empty else None
+                assumed = assumed_usage.get(leaf.id) if not simulate_empty \
+                    else None
+                best = None
+                limiting = ""
+                for res, need in res_order:
+                    if res == "pods" and res not in free:
+                        continue
+                    rem = free.get(res, 0)
+                    if usage:
+                        rem -= usage.get(res, 0)
+                    if assumed:
+                        rem -= assumed.get(res, 0)
+                    cnt = max(0, rem) // need
+                    if best is None or cnt < best:
+                        best = cnt
+                        limiting = res
+                    if best == 0:
+                        break  # sorted order: first zero IS the winner
+                if best == 0 and limiting:
+                    stats.resources[limiting] = \
+                        stats.resources.get(limiting, 0) + 1
+        if key is not None:
+            memo[1][key] = stats
         return stats
+
+    def _np_resource_exclusions(self, res_order, simulate_empty: bool,
+                                excluded: dict, rrd: tuple,
+                                stats: ExclusionStats) -> bool:
+        """Vectorized resource-exclusion counting over the cached leaf
+        matrices (device._free_matrix/_usage_matrix) — the per-leaf dict
+        walk was the pod-slice-scale message-render bottleneck. Fills
+        ``stats.resources``/``topology_domain``; returns False when the
+        dense path can't serve (unknown columns)."""
+        import numpy as np
+
+        from kueue_tpu.tas import device
+
+        struct = device._structure(self)
+        cols = device._cols_for(struct, dict(res_order), {})
+        col_of = {res: i for i, res in enumerate(cols)}
+        if any(res not in col_of for res, _ in res_order):
+            return False
+        free = device._free_matrix(struct, cols)
+        if simulate_empty:
+            remaining = free
+        else:
+            remaining = free - device._usage_matrix(self, struct, cols)
+        leaves = struct["leaves"]
+        m = len(leaves)
+        alive = struct["valid"][struct["nl"] - 1][:].copy()
+        alive[m:] = False
+        if excluded or rrd:
+            for i, leaf in enumerate(leaves):
+                if leaf.values in excluded:
+                    alive[i] = False
+                elif rrd and leaf.values[:len(rrd)] != rrd:
+                    alive[i] = False
+                    stats.topology_domain += 1
+        # First zero-count resource in sorted order per leaf (the
+        # CountInWithLimitingResource min+lexicographic tie rule: zero
+        # is the global minimum, first-in-sorted-order wins ties).
+        undecided = alive.copy()
+        pods_cap = struct["has_pods_cap"]
+        for res, need in res_order:
+            ci = col_of[res]
+            zero = remaining[:len(undecided), ci] < need
+            if res == "pods":
+                zero = zero & pods_cap[:len(undecided)]
+            hit = undecided & zero
+            n = int(hit.sum())
+            if n:
+                stats.resources[res] = stats.resources.get(res, 0) + n
+                undecided = undecided & ~hit
+        return True
 
     def find_topology_assignments_host(
         self,

@@ -100,6 +100,34 @@ class WorldTensors:
     #   first-candidate-wins tiebreak, fair_sharing_iterator.go:125)
     local_depth: np.ndarray = None  # int32[Rn, K] chain distance from the
     #   root row (root = 0, -1 pad) for the hierarchical fair tournament
+    # Host-only: ResourceFlavor objects aligned with flavor_names (the
+    # row encoders evaluate taint/selector/affinity flavor eligibility
+    # against nodeLabels/taints/tolerations); referenced-but-undefined
+    # flavors carry None.
+    flavor_objects: list = None
+
+    def flavor_spec_token(self) -> tuple:
+        """Identity of the flavor axis AND each flavor's node-matching
+        spec: the per-workload flavor masks are only reusable while
+        this is unchanged. Cached on the instance — WorldTensors are
+        rebuilt on spec changes, and the row cache consults the token
+        on EVERY row encode (hot in churn worlds)."""
+        cached = getattr(self, "_flavor_token", None)
+        if cached is not None:
+            return cached
+        out = []
+        for name, rf in zip(self.flavor_names, self.flavor_objects
+                            or [None] * len(self.flavor_names)):
+            if rf is None:
+                out.append((name,))
+            else:
+                out.append((name,
+                            tuple(sorted(rf.node_labels.items())),
+                            tuple(rf.node_taints),
+                            tuple(rf.tolerations),
+                            rf.topology_name))
+        self._flavor_token = tuple(out)
+        return self._flavor_token
 
     def fr_index(self, flavor: str, resource: str) -> int:
         return (self.flavor_names.index(flavor) * self.num_resources
@@ -124,6 +152,9 @@ class WorkloadTensors:
     # dense-coded: equal ids => identical admission verdicts.
     hash_id: np.ndarray = None  # int32[W]
     num_podsets: int = 1  # P
+    # bool[W, NF] per-flavor eligibility (taints/selectors/affinity —
+    # flavor_eligibility_mask); None = every flavor eligible everywhere.
+    flavor_ok: np.ndarray = None
 
 
 # Pod-set cap for the dense path: the kernel scans podsets sequentially
@@ -153,7 +184,7 @@ def pad_axis0(arr: np.ndarray, target: int, fill) -> np.ndarray:
 # rank/commit_rank BIG (never a head), cq 0 with pending=False.
 WL_PAD_FILLS = dict(rank=np.int64(1) << 40, commit_rank=np.int64(1) << 40,
                     wl_cq=0, wl_req=0, wl_priority=0, wl_has_qr=False,
-                    wl_hash=0, wl_ts=0.0)
+                    wl_hash=0, wl_ts=0.0, wl_flavor_ok=True)
 
 
 def build_root_grouping(parent: np.ndarray, ancestors: np.ndarray,
@@ -388,6 +419,8 @@ def encode_snapshot(snap: Snapshot, max_depth: int = 8) -> WorldTensors:
         local_chain=local_chain, root_parent_local=root_parent_local,
         root_of_cq=root_of_cq, child_rank=child_rank,
         local_depth=local_depth,
+        flavor_objects=[snap.resource_flavors.get(n)
+                        for n in flavor_names],
     )
 
 
@@ -503,18 +536,83 @@ def _dense_path_eligible(info) -> bool:
     # Pure in the info's immutable shape (pod sets, derived requests,
     # slice replacement), so dense_path_eligible memoizes per info —
     # churn worlds re-encode the same rows thousands of times.
+    if not _dense_shape_eligible(info):
+        return False
+    for ps in info.obj.pod_sets:
+        if ps.node_selector or ps.node_affinity or ps.tolerations:
+            return False
+    return True
+
+
+def _dense_shape_eligible(info) -> bool:
+    """The SHAPE part of fast-path eligibility (podset cap, partial
+    admission, topology, zero-quantity, slice replacement). Node
+    filters (selectors/affinity/tolerations) are NOT a shape problem —
+    the serving row cache encodes them as per-flavor eligibility masks
+    (flavor_eligibility_mask) the cycle kernel consumes; the whole-drain
+    paths, which don't thread masks, keep the strict predicate above.
+    Memoized per info like dense_path_eligible (churn worlds re-encode
+    the same rows thousands of times)."""
+    cached = getattr(info, "_dense_shape_elig", None)
+    if cached is not None:
+        return cached
+    info._dense_shape_elig = out = _dense_shape_eligible_impl(info)
+    return out
+
+
+def _dense_shape_eligible_impl(info) -> bool:
     if len(info.total_requests) > MAX_FAST_PODSETS:
         return False
     if info.obj.replaced_workload_slice is not None:
         return False
     for p, psr in enumerate(info.total_requests):
         ps = info.obj.pod_sets[p]
-        if (ps.min_count is not None or ps.topology_request is not None
-                or ps.node_selector or ps.node_affinity or ps.tolerations):
+        if ps.min_count is not None or ps.topology_request is not None:
             return False
         if any(q == 0 for q in psr.requests.values()):
             return False
     return True
+
+
+def flavor_eligibility_mask(info, world):
+    """bool[num_flavors] — which of the world's flavors this workload's
+    pod sets can match (flavorassigner.flavor_matches_podset: taints vs
+    tolerations, selectors/affinity vs the flavor's nodeLabels). Returns
+    None when the pod sets DISAGREE (the [W, F] encoding has no podset
+    axis; those rows stay host-path) or when a referenced flavor has no
+    registered object. Memoized per info against the world's
+    flavor-spec token."""
+    import numpy as np
+
+    token = world.flavor_spec_token()
+    cached = getattr(info, "_flavor_mask", None)
+    if cached is not None and cached[0] == token:
+        return cached[1]
+    from kueue_tpu.scheduler.flavorassigner import flavor_matches_podset
+
+    NF = max(world.num_flavors, 1)
+    filtered = [ps for ps in info.obj.pod_sets
+                if ps.node_selector or ps.node_affinity or ps.tolerations]
+    if not filtered:
+        mask = np.ones(NF, bool)
+        info._flavor_mask = (token, mask)
+        return mask
+    mask = None
+    for ps in info.obj.pod_sets:
+        row = np.zeros(NF, bool)
+        for i, rf in enumerate(world.flavor_objects or ()):
+            if rf is None:
+                # Referenced-but-undefined flavor: the sequential path
+                # can't match it either; leave ineligible.
+                continue
+            row[i] = flavor_matches_podset(rf, ps) is None
+        if mask is None:
+            mask = row
+        elif not np.array_equal(mask, row):
+            info._flavor_mask = (token, None)
+            return None
+    info._flavor_mask = (token, mask)
+    return mask
 
 
 def encode_workloads(world: WorldTensors,

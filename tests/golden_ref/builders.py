@@ -82,6 +82,7 @@ class PodSetWrapper:
         self._name = name
         self._count = count
         self._requests: dict[str, int] = {}
+        self._limits: dict[str, int] = {}
         self._min_count: Optional[int] = None
         self._node_selector: dict[str, str] = {}
         self._tolerations: list[Toleration] = []
@@ -91,6 +92,14 @@ class PodSetWrapper:
 
     def Request(self, resource: str, qty) -> "PodSetWrapper":
         self._requests[resource] = res_value(resource, qty)
+        return self
+
+    def Limit(self, resource: str, qty) -> "PodSetWrapper":
+        """Container-level limit: forces the pod set onto the template
+        pipeline (utils/podtemplate) so requests-vs-limits and
+        LimitRange validation run (workload_info.validate_admissibility
+        — the TestSchedule limitRange/limits cases)."""
+        self._limits[resource] = res_value(resource, qty)
         return self
 
     def Toleration(self, key="", operator="Equal", value="",
@@ -143,12 +152,22 @@ class PodSetWrapper:
                 slice_level=topo.slice_level, slice_size=topo.slice_size,
                 pod_set_group_name=self._group,
                 pod_index_label=topo.pod_index_label)
+        template = None
+        if self._limits:
+            from kueue_tpu.utils.podtemplate import (
+                ContainerSpec,
+                PodTemplate,
+            )
+            template = PodTemplate(containers=[ContainerSpec(
+                name="c", requests=dict(self._requests),
+                limits=dict(self._limits))])
         return PodSet(
             name=self._name, count=self._count, requests=self._requests,
             min_count=self._min_count, topology_request=topo,
             node_selector=self._node_selector,
             node_affinity=self._affinity,
-            tolerations=tuple(self._tolerations))
+            tolerations=tuple(self._tolerations),
+            template=template)
 
 
 def MakePodSet(name: str = DEFAULT_PODSET_NAME, count: int = 1):
@@ -361,6 +380,7 @@ class WorkloadWrapper:
         self._gates: tuple = ()
         self._replaced_slice: Optional[str] = None
         self._simple_flavor: Optional[str] = None
+        self._check_states: dict = {}
 
     def PodSets(self, *ps: PodSet) -> "WorkloadWrapper":
         self._podsets.extend(ps)
@@ -398,6 +418,13 @@ class WorkloadWrapper:
         self._replaced_slice = key
         return self
 
+    def AdmissionCheckState(self, name: str,
+                            state: str) -> "WorkloadWrapper":
+        """utiltestingapi AdmissionCheck(kueue.AdmissionCheckState{...}):
+        a check state already present in the workload's status."""
+        self._check_states[name] = state
+        return self
+
     def ReserveQuota(self, cq: str,
                      flavors: Optional[list[dict[str, str]]] = None,
                      counts: Optional[list[int]] = None
@@ -432,6 +459,8 @@ class WorkloadWrapper:
             creation_time=self._creation or float(WorkloadWrapper._counter))
         if self._reclaimable:
             wl.status.reclaimable_pods = dict(self._reclaimable)
+        if self._check_states:
+            wl.status.admission_check_states = dict(self._check_states)
         return wl
 
     def Info(self, cluster_queue: str = "") -> WorkloadInfo:

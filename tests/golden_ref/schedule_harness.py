@@ -75,11 +75,13 @@ def seed_admitted(eng: Engine, ww: WorkloadWrapper) -> None:
 def build_engine(*, resource_flavors, cluster_queues, local_queues,
                  cohorts=(), workloads=(), namespaces=None,
                  enable_fair_sharing=False, partial_admission=True,
-                 oracle=False) -> Engine:
+                 limit_ranges=(), oracle=False) -> Engine:
     eng = Engine(enable_fair_sharing=enable_fair_sharing)
     eng.cycle.enable_partial_admission = partial_admission
     if namespaces:
         eng.namespace_labels.update(namespaces)
+    for lr in limit_ranges:
+        eng.create_limit_range(lr)
     for rf in resource_flavors:
         eng.create_resource_flavor(rf)
     # The Go tables reference cohorts implicitly from CQ specs; create

@@ -283,3 +283,20 @@ def test_lws_valid():
     ok = LeaderWorkerSetJob(name="lws", queue_name="q", size=2,
                             leader_annotations={REQ_TOPO: "b"})
     assert LeaderWorkerSetWebhook().validate_create(ok) == []
+
+
+def test_ray_worker_group_annotation_tuples_reconcile():
+    """Review regression: 4-tuple worker groups (with pod-template
+    annotations, the shape the webhook validates) must flow through
+    pod_sets()/scale_group() without unpack errors."""
+    job = RayClusterJob(name="rc", queue_name="q",
+                        head_requests={"cpu": 100},
+                        worker_groups=[("wg1", 2, {"cpu": 100},
+                                        {REQ_TOPO: "b"}),
+                                       ("wg2", 1, {"cpu": 200})])
+    ps = job.pod_sets()
+    assert [p.name for p in ps] == ["head", "wg1", "wg2"]
+    job.scale_group("wg1", 5)
+    assert job.worker_groups[0][1] == 5
+    assert job.worker_groups[0][3] == {REQ_TOPO: "b"}
+    assert job.pod_sets()[1].count == 5

@@ -1,0 +1,189 @@
+"""Go-authored snapshot bookkeeping goldens.
+
+Transliterated from pkg/cache/scheduler/snapshot_test.go
+(TestSnapshotAddRemoveWorkload :897,
+TestSnapshotAddRemoveWorkloadWithLendingLimit :1214): the
+remove/add what-if bookkeeping the preemptor's simulations ride
+(snapshot.go AddWorkload/RemoveWorkload; cohort usage bubbles only the
+share above localQuota — resource_node.go:217 accumulateFromChild).
+Quantities in milli (Go "6" cpu == 6000; 1Gi memory == GiB bytes).
+"""
+
+from __future__ import annotations
+
+from kueue_tpu.api.types import FlavorResource
+from kueue_tpu.cache.snapshot import build_snapshot
+
+from .builders import (
+    MakeClusterQueue,
+    MakeFlavorQuotas,
+    MakeResourceFlavor,
+    MakeWorkload,
+)
+
+GI = 1024 * 1024 * 1024
+
+
+def FR(flavor, resource):
+    return FlavorResource(flavor, resource)
+
+
+def _world():
+    """snapshot_test.go:899-963."""
+    flavors = [MakeResourceFlavor("default").Obj(),
+               MakeResourceFlavor("alpha").Obj(),
+               MakeResourceFlavor("beta").Obj()]
+    cqs = [
+        MakeClusterQueue("c1").Cohort("cohort")
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "6").Obj())
+        .ResourceGroup(MakeFlavorQuotas("alpha")
+                       .Resource("memory", GI * 6).Obj(),
+                       MakeFlavorQuotas("beta")
+                       .Resource("memory", GI * 6).Obj())
+        .Obj(),
+        MakeClusterQueue("c2").Cohort("cohort")
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "6").Obj())
+        .Obj(),
+    ]
+    infos = {}
+    for name, cq, res, flavor, qty in (
+            ("c1-cpu", "c1", "cpu", "default", "1"),
+            ("c1-memory-alpha", "c1", "memory", "alpha", GI),
+            ("c1-memory-beta", "c1", "memory", "beta", GI),
+            ("c2-cpu-1", "c2", "cpu", "default", "1"),
+            ("c2-cpu-2", "c2", "cpu", "default", "1")):
+        ww = MakeWorkload(name, "default").Request(res, qty) \
+            .ReserveQuota(cq, [{res: flavor}])
+        infos[f"default/{name}"] = ww.Info()
+    return flavors, cqs, infos
+
+
+def _snap(flavors, cqs, infos):
+    return build_snapshot(cqs, [], flavors, list(infos.values()))
+
+
+def usages(snap):
+    out = {}
+    for name, cqs_ in snap.cluster_queues.items():
+        out[name] = {(fr.flavor, fr.resource): v
+                     for fr, v in cqs_.node.usage.items() if v}
+    for name, cs in snap.cohorts.items():
+        out[f"cohort:{name}"] = {(fr.flavor, fr.resource): v
+                                 for fr, v in cs.node.usage.items() if v}
+    return out
+
+
+class TestSnapshotAddRemoveWorkload:
+    # snapshot_test.go:993 "no-op remove add"
+    def test_noop_remove_add(self):
+        flavors, cqs, infos = _world()
+        snap = _snap(flavors, cqs, infos)
+        before = usages(snap)
+        revert = snap.simulate_workload_removal(
+            [infos["default/c1-cpu"], infos["default/c2-cpu-1"]])
+        revert()
+        assert usages(snap) == before
+        assert set(snap.cluster_queue("c1").workloads) == {
+            "default/c1-cpu", "default/c1-memory-alpha",
+            "default/c1-memory-beta"}
+
+    # snapshot_test.go:998 "remove all"
+    def test_remove_all(self):
+        flavors, cqs, infos = _world()
+        snap = _snap(flavors, cqs, infos)
+        for info in infos.values():
+            snap.remove_workload(info)
+        assert usages(snap) == {"c1": {}, "c2": {}, "cohort:cohort": {}}
+        assert snap.cluster_queue("c1").workloads == {}
+        assert snap.cluster_queue("c2").workloads == {}
+
+    # snapshot_test.go:1058 "remove c1-cpu": cohort usage drops to
+    # 2 cpu (c2's two) + both memories.
+    def test_remove_c1_cpu(self):
+        flavors, cqs, infos = _world()
+        snap = _snap(flavors, cqs, infos)
+        snap.remove_workload(infos["default/c1-cpu"])
+        got = usages(snap)
+        assert got["c1"] == {("alpha", "memory"): GI,
+                             ("beta", "memory"): GI}
+        assert got["c2"] == {("default", "cpu"): 2000}
+        assert got["cohort:cohort"] == {
+            ("default", "cpu"): 2000,
+            ("alpha", "memory"): GI, ("beta", "memory"): GI}
+
+    # snapshot_test.go:1124 "remove c1-memory-alpha": only the alpha
+    # flavor's usage drops; beta keeps its GiB.
+    def test_remove_c1_memory_alpha(self):
+        flavors, cqs, infos = _world()
+        snap = _snap(flavors, cqs, infos)
+        snap.remove_workload(infos["default/c1-memory-alpha"])
+        got = usages(snap)
+        assert got["c1"] == {("default", "cpu"): 1000,
+                             ("beta", "memory"): GI}
+        assert got["cohort:cohort"] == {
+            ("default", "cpu"): 3000, ("beta", "memory"): GI}
+
+
+def _lending_world():
+    """snapshot_test.go:1216-1276: nominal 10 with lending limits 4/6 —
+    localQuota (the guaranteed, never-lent share) is nominal - lending
+    (resource_node.go:30 localQuota), and cohort usage counts only the
+    share ABOVE it."""
+    flavors = [MakeResourceFlavor("default").Obj()]
+    cqs = [
+        MakeClusterQueue("lend-a").Cohort("lend")
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "10", None, "4").Obj()).Obj(),
+        MakeClusterQueue("lend-b").Cohort("lend")
+        .ResourceGroup(MakeFlavorQuotas("default")
+                       .Resource("cpu", "10", None, "6").Obj()).Obj(),
+    ]
+    infos = {}
+    for name, cq, qty in (("lend-a-1", "lend-a", "1"),
+                          ("lend-a-2", "lend-a", "9"),
+                          ("lend-a-3", "lend-a", "6"),
+                          ("lend-b-1", "lend-b", "4")):
+        ww = MakeWorkload(name, "default").Request("cpu", qty) \
+            .ReserveQuota(cq, [{"cpu": "default"}])
+        infos[f"default/{name}"] = ww.Info()
+    return flavors, cqs, infos
+
+
+class TestSnapshotAddRemoveWorkloadWithLendingLimit:
+    # snapshot_test.go "remove workload, above GuaranteedQuota":
+    # lend-a drops to 7 used; guaranteed (localQuota) is 10-4=6, so the
+    # cohort sees only the 1 above it plus nothing from lend-b (4 < 4
+    # guaranteed... lend-b localQuota = 10-6 = 4, usage 4 -> 0 above).
+    def test_remove_above_guaranteed(self):
+        flavors, cqs, infos = _lending_world()
+        snap = _snap(flavors, cqs, infos)
+        snap.remove_workload(infos["default/lend-a-2"])
+        snap.remove_workload(infos["default/lend-a-3"])
+        snap.add_workload(infos["default/lend-a-3"])
+        got = usages(snap)
+        assert got["lend-a"] == {("default", "cpu"): 7000}
+        assert got["lend-b"] == {("default", "cpu"): 4000}
+        assert got["cohort:lend"] == {("default", "cpu"): 1000}
+
+    # snapshot_test.go "remove wokload, using same quota as
+    # GuaranteedQuota": lend-a keeps 6 (== its guaranteed share) so the
+    # cohort-level usage from lend-a is zero.
+    def test_remove_to_guaranteed(self):
+        flavors, cqs, infos = _lending_world()
+        snap = _snap(flavors, cqs, infos)
+        snap.remove_workload(infos["default/lend-a-1"])
+        snap.remove_workload(infos["default/lend-a-2"])
+        got = usages(snap)
+        assert got["lend-a"] == {("default", "cpu"): 6000}
+        assert got["cohort:lend"] == {}
+
+    def test_noop_remove_add_with_lending(self):
+        flavors, cqs, infos = _lending_world()
+        snap = _snap(flavors, cqs, infos)
+        before = usages(snap)
+        revert = snap.simulate_workload_removal(list(infos.values()))
+        assert usages(snap)["cohort:lend"] == {}
+        revert()
+        assert usages(snap) == before
