@@ -5,7 +5,8 @@
 PY ?= python
 PYTEST_FLAGS ?= -q
 
-.PHONY: all native test test-fast test-device bench multichip-dryrun clean
+.PHONY: all native test test-fast test-device bench multichip-dryrun \
+  replay-smoke clean
 
 all: native
 
@@ -40,6 +41,11 @@ bench:
 
 bench-fast:
 	KUEUE_TPU_BENCH_FAST=1 $(PY) bench.py
+
+# Flight-recorder determinism smoke: record a 50-workload scenario,
+# replay it twice, diff the decision-stream checksums (replay/).
+replay-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/replay_smoke.py
 
 # Validate the multi-chip sharding compiles + executes on a virtual mesh.
 multichip-dryrun:

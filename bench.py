@@ -852,6 +852,34 @@ def _tas_crossover_measure(build, n_probe: int = 5) -> dict:
     return out
 
 
+def bench_replay(trace_path, mode="host"):
+    """A flight-recorder trace AS a bench scenario: re-execute it through
+    the real engine (replay/replayer.py) and report cycle throughput plus
+    the per-phase attribution table — recorded vs replayed — that pins
+    where a serving cycle's time actually goes. vs_baseline is the
+    determinism verdict (1.0 = byte-identical decision stream)."""
+    from kueue_tpu.replay.replayer import replay_trace
+
+    t0 = time.perf_counter()
+    report = replay_trace(trace_path, mode=mode)
+    elapsed = time.perf_counter() - t0
+    cycles = report.cycles + report.idle_cycles
+    value = cycles / elapsed if elapsed > 0 else 0.0
+    return {
+        "value": round(value, 1), "unit": "cycles/s",
+        "vs_baseline": 1.0 if report.ok else 0.0,
+        "detail": {"trace": trace_path, "mode": mode,
+                   "cycles": report.cycles,
+                   "idle_cycles": report.idle_cycles,
+                   "inputs": report.inputs, "admitted": report.admitted,
+                   "byte_identical": report.ok,
+                   "elapsed_s": round(elapsed, 3),
+                   "digest": report.replayed_digest,
+                   "attribution_replayed": report.attribution("replayed"),
+                   "attribution_recorded": report.attribution("recorded")},
+    }
+
+
 def _machine_cache_dir() -> str:
     import hashlib
     import platform as _platform
@@ -895,6 +923,42 @@ def main() -> None:
     except Exception:
         pass
     dev = jax.devices()[0]
+
+    # Replay mode (bench.py --replay TRACE[,TRACE...] or
+    # KUEUE_TPU_BENCH_REPLAY): recorded traces are the scenarios —
+    # deterministic, reproducible serving-path workloads with phase
+    # attribution. Prints the same ONE-JSON-line contract and exits.
+    replay_arg = os.environ.get("KUEUE_TPU_BENCH_REPLAY")
+    if "--replay" in sys.argv:
+        i = sys.argv.index("--replay")
+        if i + 1 >= len(sys.argv):
+            raise SystemExit("--replay requires a trace path")
+        replay_arg = sys.argv[i + 1]
+    if replay_arg:
+        mode = os.environ.get("KUEUE_TPU_BENCH_REPLAY_MODE", "host")
+        scenarios = {}
+        for path in filter(None, replay_arg.split(",")):
+            try:
+                scenarios[os.path.basename(path)] = bench_replay(
+                    path, mode=mode)
+            except Exception as exc:  # noqa: BLE001 — isolate, keep line
+                scenarios[os.path.basename(path)] = {
+                    "error": repr(exc)[:200]}
+        first = next((s for s in scenarios.values() if "value" in s),
+                     {"value": 0.0, "unit": "cycles/s",
+                      "vs_baseline": 0.0})
+        print(json.dumps({
+            "metric": (f"trace replay, {len(scenarios)} trace(s), "
+                       f"mode={mode} ({dev.platform}); vs_baseline is "
+                       "the determinism verdict (1.0 = byte-identical)"),
+            "value": first["value"],
+            "unit": first["unit"],
+            "vs_baseline": first["vs_baseline"],
+            "scenarios": scenarios,
+            "platform_trailer": {"platform": dev.platform,
+                                 "device": str(dev)},
+        }))
+        return
 
     fast = os.environ.get("KUEUE_TPU_BENCH_FAST") == "1"
     n_workloads = int(os.environ.get(

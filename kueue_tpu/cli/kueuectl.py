@@ -433,6 +433,31 @@ def build_parser() -> argparse.ArgumentParser:
     dele.add_argument("--namespace", default="default")
     dele.add_argument("--dry-run", choices=["none", "client"],
                       default="none")
+
+    rec = sub.add_parser(
+        "record",
+        help="flight-record the engine: bootstrap the current "
+             "(journal-rebuilt) world into a trace, then run scheduling "
+             "cycles until quiescent (or --cycles)")
+    rec.add_argument("out", help="trace path to write")
+    rec.add_argument("--cycles", type=int, default=0,
+                     help="cycle budget (0 = run until quiescent)")
+    rec.add_argument("--label", default="")
+
+    rep = sub.add_parser(
+        "replay",
+        help="deterministically re-execute a flight-recorder trace and "
+             "verify the decision stream (exit non-zero on divergence)")
+    rep.add_argument("trace")
+    rep.add_argument("--mode", choices=["host", "device", "both"],
+                     default="host",
+                     help="host = sequential core; device = oracle "
+                          "attached; both = differential host-vs-device")
+    rep.add_argument("--faults",
+                     help="fault spec armed on the replay engine, e.g. "
+                          "oracle-crash@cycle:2 (see replay/faults.py)")
+    rep.add_argument("--stop-after", type=int, dest="stop_after",
+                     help="replay only the first N cycles")
     return p
 
 
@@ -522,6 +547,31 @@ def run(engine, argv: list[str]) -> str:
         table[(args.command, args.kind)]()
         return f"{args.kind}/{args.name} {args.command}ped" \
             if args.command == "stop" else f"{args.kind}/{args.name} resumed"
+    if args.command == "record":
+        from kueue_tpu.replay.recorder import FlightRecorder
+        recorder = FlightRecorder(engine, args.out, bootstrap=True,
+                                  label=args.label)
+        ran = 0
+        try:
+            while True:
+                result = engine.schedule_once()
+                ran += 1
+                if args.cycles and ran >= args.cycles:
+                    break
+                if not args.cycles and result is None:
+                    break
+        finally:
+            recorder.close()
+        return (f"recorded {ran} cycle(s) -> {args.out} "
+                f"(digest {recorder.digest})")
+    if args.command == "replay":
+        from kueue_tpu.replay.replayer import replay_trace
+        report = replay_trace(args.trace, mode=args.mode,
+                              faults=args.faults,
+                              stop_after_cycles=args.stop_after)
+        if not report.ok:
+            raise SystemExit(report.render())
+        return report.render()
     if args.command == "delete":
         if args.dry_run != "none":
             return f"{args.kind}/{args.name} deleted (dry run)"
@@ -535,3 +585,7 @@ def run(engine, argv: list[str]) -> str:
         }[args.kind]()
         return f"{args.kind}/{args.name} deleted"
     return ""
+
+
+if __name__ == "__main__":
+    main()

@@ -175,6 +175,16 @@ class Engine:
         # Last cycle's phase durations (scheduler.go:291-358 logs these;
         # the debugger/dashboard surface them here).
         self.last_cycle_phases: dict[str, float] = {}
+        # Which path decided the last cycle: "sequential", "device", or
+        # "hybrid" (device roots + host tail).
+        self.last_cycle_mode: str = ""
+        # Flight-recorder / fault-injection capture points (replay/):
+        # pre_cycle_hooks fire before each schedule_once() attempt with
+        # (seq, engine); cycle_listeners after, with (seq, result) —
+        # result is None for an idle cycle.
+        self.cycle_seq: int = 0
+        self.pre_cycle_hooks: list[Callable] = []
+        self.cycle_listeners: list[Callable] = []
         self.workloads: dict[str, Workload] = {}
         # hook: called with (workload, admission) after each admission.
         self.on_admit: Optional[Callable] = None
@@ -719,19 +729,41 @@ class Engine:
             yield
 
     def schedule_once(self) -> Optional[CycleResult]:
-        """One schedule() cycle (scheduler.go:286)."""
+        """One schedule() cycle (scheduler.go:286), bracketed by the
+        replay capture points: pre_cycle_hooks before (fault injection
+        lands here), then the cycle, then the journal's crash-safe
+        cycle-boundary sync, then cycle_listeners (the flight recorder's
+        decision-stream capture)."""
+        seq = self.cycle_seq
+        for fn in tuple(self.pre_cycle_hooks):
+            fn(seq, self)
         if not self._serving_gc:
-            return self._schedule_once_impl()
-        try:
-            return self._schedule_once_impl()
-        finally:
-            # Serving GC posture: automatic collection is off; sweep the
-            # young generation and re-freeze survivors after EVERY cycle
-            # — device, hybrid, and sequential-fallback alike (see
-            # apply_serving_gc_posture).
-            import gc
-            gc.collect(0)
-            gc.freeze()
+            result = self._schedule_once_impl()
+        else:
+            try:
+                result = self._schedule_once_impl()
+            finally:
+                # Serving GC posture: automatic collection is off; sweep
+                # the young generation and re-freeze survivors after
+                # EVERY cycle — device, hybrid, and sequential-fallback
+                # alike (see apply_serving_gc_posture).
+                import gc
+                gc.collect(0)
+                gc.freeze()
+        self.cycle_seq = seq + 1
+        if result is not None and self.journal is not None:
+            # Crash-safe cycle boundary: every record this cycle wrote
+            # (admissions, evictions, requeues) reaches the platter
+            # before the decisions take further effect — a SIGKILL
+            # between cycles can never lose an applied admission.
+            self.journal.sync()
+        for fn in tuple(self.cycle_listeners):
+            try:
+                fn(seq, result)
+            except Exception as e:  # noqa: BLE001 — observers must not
+                import warnings      # unwind the scheduling loop
+                warnings.warn(f"cycle listener {fn!r} raised: {e!r}")
+        return result
 
     def _schedule_once_impl(self) -> Optional[CycleResult]:
         import time as _time
@@ -784,6 +816,7 @@ class Engine:
         t0 = _time.perf_counter()
         if count_cycle:
             self.metrics.admission_cycles += 1
+            self.last_cycle_mode = "sequential"
         snapshot = self.cache.snapshot()
         t_snap = _time.perf_counter()
         already = set(self.cache.workloads)

@@ -98,6 +98,10 @@ class OracleBridge:
         self.fallback_reasons: dict[str, int] = {}
         # Why individual roots were handed to the host path.
         self.host_root_reasons: dict[str, int] = {}
+        # CRC-32 of the last device cycle's raw verdict tensors
+        # (replay/trace.py records it per cycle for kernel-vs-apply
+        # divergence attribution).
+        self.last_verdict_digest: Optional[int] = None
 
     def world_is_fast_path_safe(self) -> bool:
         eng = self.engine
@@ -1070,6 +1074,16 @@ class OracleBridge:
                 cq_on_device = ~host_root[root_of_cq]
 
         self.cycles_on_device += 1
+        # Replay capture point: a cheap fingerprint of the raw device
+        # verdicts (before host decode/apply), recorded into traces so a
+        # decision-stream divergence can be attributed to the kernel
+        # (digest differs) vs the apply path (digest equal).
+        import zlib as _zlib
+        _vd = 0
+        for _arr in (wl_admitted, slot_admitted, slot_position,
+                     slot_preempting, victim_mask):
+            _vd = _zlib.crc32(np.ascontiguousarray(_arr).tobytes(), _vd)
+        self.last_verdict_digest = _vd
         _t_device = _time.perf_counter()
         apply_rows = device_w & cq_on_device[cq_safe_idx]
         result, finalize = self._apply(
@@ -1100,9 +1114,11 @@ class OracleBridge:
                 "scheduler_phase_duration_seconds").observe(dur, (phase,))
 
         # --- host tail: sequential cycle over the host roots ---
+        eng.last_cycle_mode = "device"
         host_cqs = np.nonzero(has_head & ~cq_on_device)[0]
         if host_cqs.size:
             self.cycles_hybrid += 1
+            eng.last_cycle_mode = "hybrid"
             heads = []
             for ci in host_cqs:
                 pcq = eng.queues.cluster_queues.get(w.cq_names[ci])

@@ -29,6 +29,7 @@ same jit cache when local).
 
 from __future__ import annotations
 
+import os
 import socket
 import threading
 from typing import Optional
@@ -156,9 +157,32 @@ class RemoteExecutor:
 
 
 class OracleServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    """``fault_after`` (the replay/faults.py crash matrix, --fault
+    crash-after:N): hard-exit the process immediately after the Nth
+    compute reply is sent — the sidecar-crash-mid-serving scenario.
+    The engine side must surface RemoteOracleError, fall back to the
+    sequential path for the cycle, and reconnect once the sidecar is
+    restarted (crash recovery with zero lost/duplicate admissions)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 fault_after: Optional[int] = None):
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
+        self.fault_after = fault_after
+        self._served = 0
+        self._served_lock = threading.Lock()
+
+    def _count_and_maybe_crash(self) -> None:
+        if self.fault_after is None:
+            return
+        with self._served_lock:
+            self._served += 1
+            crash = self._served >= self.fault_after
+        if crash:
+            import os
+            # os._exit, not sys.exit: a real sidecar crash runs no
+            # finalizers and leaves peers mid-read on the socket.
+            os._exit(17)
 
     def serve_forever(self) -> None:
         while True:
@@ -199,6 +223,8 @@ class OracleServer:
                     wire.send_msg(conn, reply)
                 except (ConnectionError, OSError):
                     return
+                if op in ("cycle_step", "classical_targets"):
+                    self._count_and_maybe_crash()
 
 
 def main(argv=None) -> None:
@@ -209,15 +235,24 @@ def main(argv=None) -> None:
     parser.add_argument("--port", type=int, default=7461)
     parser.add_argument("--platform", default=None,
                         help="force a JAX platform (e.g. cpu)")
+    parser.add_argument(
+        "--fault", default=os.environ.get("KUEUE_TPU_ORACLE_FAULT", ""),
+        help="fault injection, e.g. crash-after:3 (exit hard after the "
+             "3rd compute reply; replay/faults.py crash matrix)")
     args = parser.parse_args(argv)
+    fault_after = None
+    if args.fault:
+        kind, _, n = args.fault.partition(":")
+        if kind != "crash-after" or not n.isdigit():
+            raise SystemExit(f"unknown --fault {args.fault!r}")
+        fault_after = int(n)
     if args.platform:
-        import os
         os.environ["JAX_PLATFORMS"] = args.platform
     import jax
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     jax.config.update("jax_enable_x64", True)
-    server = OracleServer(args.host, args.port)
+    server = OracleServer(args.host, args.port, fault_after=fault_after)
     print(f"oracle service listening on {server.address[0]}:"
           f"{server.address[1]}", flush=True)
     server.serve_forever()

@@ -1,0 +1,45 @@
+"""Flight recorder: deterministic record/replay + fault injection for
+the admission pipeline.
+
+The reference's correctness story rests on "the Go semantics as the
+oracle" — but golden worlds are hand-built. This subsystem turns any
+live serving run into a regression test:
+
+  * ``trace``     — the versioned, checksummed trace framing (one JSON
+                    frame per line, CRC-chained so truncation or
+                    tampering anywhere invalidates the tail);
+  * ``recorder``  — FlightRecorder captures an engine's inputs (object
+                    creations, submissions, clock ticks) and each
+                    cycle's decision stream + phase timings;
+  * ``replayer``  — re-executes a trace through the real engine (host
+                    path, device path, or differential both) and
+                    asserts the decision stream is byte-identical,
+                    with per-cycle phase attribution;
+  * ``faults``    — injects SIGKILL-mid-cycle, torn-journal-tail,
+                    oracle-crash and delayed-verdict faults under
+                    replay or live smoke (serve.py --fault).
+"""
+
+from kueue_tpu.replay.faults import FaultPlan, arm_faults
+from kueue_tpu.replay.recorder import FlightRecorder
+from kueue_tpu.replay.replayer import ReplayReport, replay_trace
+from kueue_tpu.replay.trace import (
+    TraceCorruption,
+    TraceReader,
+    TraceWriter,
+    canonical_decisions,
+    decision_digest,
+)
+
+__all__ = [
+    "FaultPlan",
+    "FlightRecorder",
+    "ReplayReport",
+    "TraceCorruption",
+    "TraceReader",
+    "TraceWriter",
+    "arm_faults",
+    "canonical_decisions",
+    "decision_digest",
+    "replay_trace",
+]

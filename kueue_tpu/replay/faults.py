@@ -1,0 +1,190 @@
+"""Fault injection for the admission pipeline, under replay or live
+smoke (serve.py --fault / KUEUE_TPU_FAULT).
+
+Spec grammar (comma-separated faults):
+
+  sigkill@cycle:N          SIGKILL this process as cycle N begins
+  sigkill@admission:N      SIGKILL mid-apply, at the Nth admission —
+                           the journal's torn-tail + crash-recovery
+                           path under a real half-applied cycle
+  torn-tail@cycle:N        append a partial (newline-less, invalid)
+                           record to the journal, fsync it, SIGKILL —
+                           the exact artifact of a crash mid-append
+  oracle-crash@cycle:N     the oracle executor raises transport errors
+                           for the whole of cycle N (sidecar crash);
+                           the bridge must fall back sequentially and
+                           re-attach on the next cycle
+  delay-verdict@cycle:N:MS the oracle's verdicts arrive MS late on
+                           cycle N (slow sidecar) — decisions must be
+                           unaffected, only phase timings move
+
+The recovery contract these faults exist to prove: reboot via
+store.journal.rebuild_engine and drain, and the admitted set equals an
+uninterrupted run's — zero lost, zero duplicate admissions.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Fault:
+    kind: str        # sigkill | torn-tail | oracle-crash | delay-verdict
+    at: str          # cycle | admission
+    n: int           # trigger point (cycle seq or admission ordinal)
+    arg: float = 0.0  # delay-verdict: milliseconds
+
+
+@dataclass
+class FaultPlan:
+    faults: list = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        plan = cls()
+        for part in filter(None, (s.strip() for s in spec.split(","))):
+            try:
+                kind, rest = part.split("@", 1)
+                bits = rest.split(":")
+                at, n = bits[0], int(bits[1])
+                arg = float(bits[2]) if len(bits) > 2 else 0.0
+            except (ValueError, IndexError):
+                raise ValueError(
+                    f"bad fault spec {part!r} "
+                    "(want kind@cycle:N or kind@admission:N)") from None
+            if kind not in ("sigkill", "torn-tail", "oracle-crash",
+                            "delay-verdict"):
+                raise ValueError(f"unknown fault kind {kind!r}")
+            if at not in ("cycle", "admission"):
+                raise ValueError(f"unknown fault point {at!r}")
+            if at == "admission" and kind != "sigkill":
+                raise ValueError(
+                    f"{kind} only triggers at cycle boundaries")
+            plan.faults.append(Fault(kind, at, n, arg))
+        return plan
+
+
+def _die() -> None:
+    # SIGKILL, not sys.exit: no atexit, no finally blocks, no flush —
+    # the same crash the fault matrix is meant to prove recovery from.
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _tear_journal_tail(journal) -> None:
+    """Plant the artifact of a crash mid-append: a flushed, newline-less
+    JSON fragment at the end of the journal file."""
+    with open(journal.path, "ab") as fh:
+        fh.write(b'{"op":"apply","kind":"workload","ts":9')
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+class _ExecutorFaultProxy:
+    """Wraps the oracle bridge's executor: raises transport errors while
+    ``crashed`` is set, sleeps ``delay_ms`` before returning otherwise."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.crashed = False
+        self.delay_ms = 0.0
+        self.injected_errors = 0
+        self.delayed_calls = 0
+
+    def _gate(self):
+        from kueue_tpu.oracle.service import RemoteOracleError
+        if self.crashed:
+            self.injected_errors += 1
+            raise RemoteOracleError("injected oracle crash")
+        if self.delay_ms > 0:
+            import time
+            time.sleep(self.delay_ms / 1e3)
+            self.delayed_calls += 1
+
+    def cycle_step(self, tensors, statics):
+        self._gate()
+        return self.inner.cycle_step(tensors, statics)
+
+    def classical_targets(self, tensors, statics, derived=None):
+        self._gate()
+        return self.inner.classical_targets(tensors, statics,
+                                            derived=derived)
+
+    def close(self) -> None:
+        if hasattr(self.inner, "close"):
+            self.inner.close()
+
+
+class FaultInjector:
+    """Armed on an engine: hooks the cycle boundary (pre_cycle_hooks)
+    and the admission apply path (_admit)."""
+
+    def __init__(self, engine, plan: FaultPlan):
+        self.engine = engine
+        self.plan = plan
+        self.admissions = 0
+        self.fired: list[str] = []
+        self.proxy = None
+        self._kill_at_admission = min(
+            (f.n for f in plan.faults
+             if f.kind == "sigkill" and f.at == "admission"),
+            default=None)
+        engine.pre_cycle_hooks.append(self._pre_cycle)
+        engine.cycle_listeners.append(self._post_cycle)
+        if self._kill_at_admission is not None:
+            orig = engine._admit
+
+            def admit_and_maybe_die(entry, bulk=None):
+                orig(entry, bulk=bulk)
+                self.admissions += 1
+                if self.admissions == self._kill_at_admission:
+                    _die()
+            engine._admit = admit_and_maybe_die
+        if any(f.kind in ("oracle-crash", "delay-verdict")
+               for f in plan.faults):
+            self._ensure_proxy()
+
+    def _ensure_proxy(self):
+        bridge = self.engine.oracle
+        if bridge is None:
+            raise RuntimeError(
+                "oracle faults need an attached oracle "
+                "(engine.attach_oracle() first)")
+        if not isinstance(bridge.executor, _ExecutorFaultProxy):
+            bridge.executor = _ExecutorFaultProxy(bridge.executor)
+        self.proxy = bridge.executor
+
+    def _pre_cycle(self, seq: int, engine) -> None:
+        for f in self.plan.faults:
+            if f.at != "cycle" or f.n != seq:
+                continue
+            if f.kind == "sigkill":
+                self.fired.append(f"sigkill@cycle:{seq}")
+                _die()
+            elif f.kind == "torn-tail":
+                if engine.journal is None:
+                    raise RuntimeError("torn-tail fault needs a journal")
+                _tear_journal_tail(engine.journal)
+                self.fired.append(f"torn-tail@cycle:{seq}")
+                _die()
+            elif f.kind == "oracle-crash":
+                self.proxy.crashed = True
+                self.fired.append(f"oracle-crash@cycle:{seq}")
+            elif f.kind == "delay-verdict":
+                self.proxy.delay_ms = f.arg
+                self.fired.append(f"delay-verdict@cycle:{seq}")
+
+    def _post_cycle(self, seq: int, result) -> None:
+        # Transient faults clear at the cycle's end: the sidecar
+        # "restarts" and the next cycle reconnects.
+        if self.proxy is not None:
+            self.proxy.crashed = False
+            self.proxy.delay_ms = 0.0
+
+
+def arm_faults(engine, plan) -> FaultInjector:
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan)
+    return FaultInjector(engine, plan)

@@ -17,6 +17,13 @@ Environment:
                            (default 0.0.0.0:8080)
   KUEUE_TPU_AUTH_TOKEN     optional bearer token for the endpoint
   KUEUE_TPU_TICK_SECONDS   idle scheduling tick (default 0.25)
+  KUEUE_TPU_RECORD         flight-recorder trace path (--record): every
+                           input and every cycle's decision stream is
+                           captured for deterministic replay
+                           (kueuectl replay <trace>)
+  KUEUE_TPU_FAULT          fault-injection spec (--fault), e.g.
+                           "sigkill@admission:40" — the live-smoke side
+                           of the replay/faults.py crash matrix
 """
 
 from __future__ import annotations
@@ -42,6 +49,10 @@ def main(argv=None) -> None:
     parser.add_argument("--tick", type=float,
                         default=float(os.environ.get(
                             "KUEUE_TPU_TICK_SECONDS", "0.25")))
+    parser.add_argument("--record",
+                        default=os.environ.get("KUEUE_TPU_RECORD"))
+    parser.add_argument("--fault",
+                        default=os.environ.get("KUEUE_TPU_FAULT"))
     args = parser.parse_args(argv)
 
     from kueue_tpu.store.journal import rebuild_engine
@@ -54,6 +65,18 @@ def main(argv=None) -> None:
     elif args.oracle != "off":
         host, _, port = args.oracle.rpartition(":")
         eng.attach_oracle(remote_address=(host or "127.0.0.1", int(port)))
+
+    recorder = None
+    if args.record:
+        # Flight recorder: bootstrap frames replay the journal-rebuilt
+        # world, then every input and cycle is captured — the trace is
+        # a self-contained regression test (kueuectl replay <trace>).
+        from kueue_tpu.replay.recorder import FlightRecorder
+        recorder = FlightRecorder(eng, args.record, bootstrap=True,
+                                  label=f"serve:{args.journal}")
+    if args.fault:
+        from kueue_tpu.replay.faults import arm_faults
+        arm_faults(eng, args.fault)
 
     host, _, port = args.http.rpartition(":")
     endpoint = ServingEndpoint(
@@ -82,6 +105,8 @@ def main(argv=None) -> None:
                  if result is None else time.monotonic() - t0)
         if result is None:
             time.sleep(args.tick)
+    if recorder is not None:
+        recorder.close()
     endpoint.stop()
 
 

@@ -17,9 +17,13 @@ append-only JSONL journal of applied objects:
     e.g. scheduler.go:554-557 in-flight recovery note);
   * ``compact`` rewrites the log to one record per live key.
 
-Crash consistency: records are flushed per append (fsync optional); a
-torn final line is ignored on replay, mirroring at-least-once status
-patching.
+Crash consistency: records are flushed per append (fsync optional), and
+the engine calls ``sync()`` (flush+fsync) on every non-idle cycle
+boundary so an applied admission can never be lost to a crash between
+cycles; a truncated or corrupt final line is trimmed on reattach and
+ignored on replay, mirroring at-least-once status patching, while
+corruption anywhere else raises (silent record loss is worse than a
+failed restart).
 """
 
 from __future__ import annotations
@@ -29,6 +33,11 @@ import os
 from typing import Iterator, Optional
 
 from kueue_tpu.api.serde import from_jsonable, to_jsonable
+
+
+class JournalCorruption(Exception):
+    """A record that is neither the torn final line nor parseable:
+    replaying past it would silently drop every later record."""
 
 
 class JournalConflict(Exception):
@@ -65,6 +74,11 @@ class Journal:
         self.path = path
         self.fsync = fsync
         self._fh = open(path, "a", encoding="utf-8")
+        # Appends since the last sync(): the engine calls sync() on
+        # cycle boundaries (write+flush+fsync), so a crash between
+        # cycles never loses an applied admission and per-append fsync
+        # stays optional for the hot path.
+        self._dirty = False
         self._locked_repair()
         # Per-(kind, key) generation table + how far we've read the file.
         self._generations: dict[tuple, int] = {}
@@ -118,10 +132,14 @@ class Journal:
         return self._generations.get((kind, key), 0)
 
     def _repair_torn_tail(self) -> None:
-        """Truncate a torn final line (crash mid-write) so post-restart
-        appends start on a clean line — otherwise the first new record
-        would concatenate onto the fragment and everything after it
-        would be unreadable on the next replay."""
+        """Trim a truncated or corrupt final line (crash mid-write) so
+        post-restart appends start on a clean line — otherwise the first
+        new record would concatenate onto the fragment and everything
+        after it would be unreadable on the next replay. Covers both
+        crash artifacts: a newline-less fragment AND a newline-terminated
+        final line that doesn't parse (a torn write that happened to end
+        on the terminator byte). Repair never removes more than the
+        single damaged record."""
         if not os.path.exists(self.path):
             return
         with open(self.path, "rb+") as fh:
@@ -133,16 +151,33 @@ class Journal:
             # found (a torn record can exceed any fixed window).
             window = 1 << 20
             tail = b""
+            last_nl = -1
             while True:
                 start = max(0, size - window)
                 fh.seek(start)
                 chunk = fh.read(size - start)
                 last_nl = chunk.rfind(b"\n")
                 if last_nl >= 0 or start == 0:
-                    tail = chunk[last_nl + 1:]
+                    if last_nl >= 0:
+                        last_nl += start  # absolute offset
+                    tail = chunk[chunk.rfind(b"\n") + 1:]
                     break
                 window *= 4
             if not tail:
+                # File ends on a newline: the last COMPLETE line can
+                # still be a torn write (crash after the terminator of
+                # a partial buffer). Validate it; trim if corrupt.
+                if last_nl < 0:
+                    return
+                prev_nl = self._find_prev_newline(fh, last_nl)
+                fh.seek(prev_nl + 1)
+                line = fh.read(last_nl - prev_nl - 1)
+                if not line.strip():
+                    return
+                try:
+                    json.loads(line.decode("utf-8"))
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    fh.truncate(prev_nl + 1)
                 return
             try:
                 json.loads(tail.decode("utf-8"))
@@ -150,6 +185,22 @@ class Journal:
                 fh.write(b"\n")  # complete record missing its newline
             except (json.JSONDecodeError, UnicodeDecodeError):
                 fh.truncate(size - len(tail))
+
+    @staticmethod
+    def _find_prev_newline(fh, before: int) -> int:
+        """Absolute offset of the last newline strictly before
+        ``before`` (-1 when the line is the file's first)."""
+        window = 1 << 20
+        while True:
+            start = max(0, before - window)
+            fh.seek(start)
+            chunk = fh.read(before - start)
+            nl = chunk.rfind(b"\n")
+            if nl >= 0:
+                return start + nl
+            if start == 0:
+                return -1
+            window *= 4
 
     def apply(self, kind: str, obj, ts: float = 0.0,
               expected_generation: Optional[int] = None) -> int:
@@ -229,28 +280,52 @@ class Journal:
         self._fh.flush()
         if self.fsync:
             os.fsync(self._fh.fileno())
+        else:
+            self._dirty = True
         # Our own append is already folded into the generation table —
         # advance the read offset so the next refresh() doesn't re-read
         # and re-parse it (one open+parse per record on the hot path).
         self._read_offset += len(line.encode("utf-8"))
 
+    def sync(self) -> None:
+        """Crash-safe cycle boundary (Engine.schedule_once calls this
+        after every non-idle cycle): flush+fsync all appends since the
+        last sync. No-op when nothing is pending, so idle serving loops
+        don't touch the disk."""
+        if not self._dirty:
+            return
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._dirty = False
+
     def close(self) -> None:
+        if not self._fh.closed:
+            self.sync()
         self._fh.close()
 
     def replay(self) -> Iterator[dict]:
-        """Yield records in append order; a torn trailing line (crash
-        mid-write) is skipped."""
-        with open(self.path, encoding="utf-8") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    from kueue_tpu.api.conversion import upgrade_record
+        """Yield records in append order. A truncated/corrupt FINAL
+        line (crash mid-write) is tolerated and skipped — the same
+        record __init__'s locked repair would trim; corruption anywhere
+        else means records would be silently lost, so it raises
+        JournalCorruption instead of dropping the tail."""
+        from kueue_tpu.api.conversion import upgrade_record
 
-                    yield upgrade_record(json.loads(line))
-                except json.JSONDecodeError:
-                    return
+        with open(self.path, encoding="utf-8") as fh:
+            lines = fh.read().split("\n")
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                if any(rest.strip() for rest in lines[i + 1:]):
+                    raise JournalCorruption(
+                        f"{self.path}:{i + 1}: unparseable record "
+                        "with records after it") from None
+                return  # torn tail
+            yield upgrade_record(rec)
 
     def compact(self) -> None:
         """Rewrite the log keeping only the last record per (kind, key),

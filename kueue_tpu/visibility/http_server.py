@@ -22,7 +22,8 @@ from kueue_tpu.visibility.server import (
 )
 
 
-def make_handler(engine, auth_token=None, apf=None):
+def make_handler(engine, auth_token=None, apf=None,
+                 heartbeat_seconds: float = 15.0):
     vis = VisibilityServer(engine)
 
     class Handler(BaseHTTPRequestHandler):
@@ -121,9 +122,12 @@ def make_handler(engine, auth_token=None, apf=None):
             (controllers/engine.py event_listeners, the informer
             analog) feeds each connected browser/curl session without
             polling. Long-lived response: one handler thread per
-            subscriber (ThreadingHTTPServer), keep-alive comments every
-            15 s, bounded per-client queue (a slow consumer drops
-            events rather than backing up the scheduling thread)."""
+            subscriber (ThreadingHTTPServer), heartbeat comments every
+            ``heartbeat_seconds`` (~15 s; SSE comment lines, invisible
+            to EventSource consumers) so idle connections aren't
+            silently dropped by proxies/LB idle timeouts, bounded
+            per-client queue (a slow consumer drops events rather than
+            backing up the scheduling thread)."""
             import queue as _queue
 
             self.send_response(200)
@@ -145,7 +149,7 @@ def make_handler(engine, auth_token=None, apf=None):
                 self.wfile.flush()
                 while True:
                     try:
-                        ev = q.get(timeout=15.0)
+                        ev = q.get(timeout=heartbeat_seconds)
                     except _queue.Empty:
                         self.wfile.write(b": keep-alive\n\n")
                         self.wfile.flush()
@@ -257,19 +261,23 @@ class ServingEndpoint:
         analog): per-user flows, seat limits, shuffle-shard queuing,
         429 shedding. True (default) uses the shipped schema/level
         pair; pass an APFDispatcher for custom config; False disables.
+      * ``heartbeat_seconds`` — /events SSE keep-alive comment interval
+        (default ~15 s; keeps idle EventSource connections alive
+        through proxy idle timeouts).
     """
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  cert_dir: str = None, auth_token: str = None,
-                 flow_control=True):
+                 flow_control=True, heartbeat_seconds: float = 15.0):
         from kueue_tpu.visibility.flowcontrol import APFDispatcher
         self.apf = None
         if flow_control:
             self.apf = (flow_control if isinstance(
                 flow_control, APFDispatcher) else APFDispatcher())
         self.httpd = ThreadingHTTPServer(
-            (host, port), make_handler(engine, auth_token=auth_token,
-                                       apf=self.apf))
+            (host, port), make_handler(
+                engine, auth_token=auth_token, apf=self.apf,
+                heartbeat_seconds=heartbeat_seconds))
         self.tls = cert_dir is not None
         if cert_dir is not None:
             import ssl

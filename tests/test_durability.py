@@ -341,6 +341,73 @@ def test_torn_tail_line_ignored(tmp_path):
     assert reb.workloads["default/w"].is_admitted
 
 
+def test_corrupt_final_line_with_newline_trimmed(tmp_path):
+    """A torn write that happens to end on the newline byte leaves a
+    complete-but-unparseable final line; reattach must trim exactly that
+    one record (not just newline-less fragments)."""
+    path = str(tmp_path / "j.jsonl")
+    eng = Engine()
+    build_world(eng)
+    attach_new_journal(eng, path)
+    eng.submit(Workload(name="w", queue_name="lq0",
+                        pod_sets=(PodSet("main", 1, {"cpu": 500}),)))
+    eng.schedule_once()
+    with open(path) as fh:
+        n_lines = len(fh.readlines())
+    with open(path, "a") as fh:
+        fh.write('{"op": "apply", "kind": "workload", "obj": {"trunc\n')
+    reb = rebuild_engine(path)
+    assert reb.workloads["default/w"].is_admitted
+    with open(path) as fh:
+        lines = fh.readlines()
+    assert len(lines) == n_lines, "repair did not trim the corrupt line"
+    assert all(line.endswith("\n") for line in lines)
+
+
+def test_corruption_mid_file_raises(tmp_path):
+    """A corrupt record FOLLOWED by valid records is not a crash
+    artifact — replaying past it would silently drop state, so replay
+    must refuse (JournalCorruption), not trim."""
+    from kueue_tpu.store.journal import JournalCorruption
+
+    path = str(tmp_path / "j.jsonl")
+    eng = Engine()
+    build_world(eng)
+    attach_new_journal(eng, path)
+    eng.submit(Workload(name="w", queue_name="lq0",
+                        pod_sets=(PodSet("main", 1, {"cpu": 500}),)))
+    eng.schedule_once()
+    with open(path) as fh:
+        lines = fh.readlines()
+    lines[len(lines) // 2] = '{"op": "apply", "kind": "wor\n'
+    with open(path, "w") as fh:
+        fh.writelines(lines)
+    with pytest.raises(JournalCorruption):
+        list(Journal(path).replay())
+    with pytest.raises(JournalCorruption):
+        rebuild_engine(path)
+
+
+def test_sync_on_cycle_boundary(tmp_path):
+    """Engine.schedule_once calls journal.sync() after every non-idle
+    cycle: appends since the last sync are flushed+fsynced, and an idle
+    loop never touches the disk (the dirty flag gates the no-op)."""
+    path = str(tmp_path / "j.jsonl")
+    eng = Engine()
+    build_world(eng)
+    journal = attach_new_journal(eng, path)  # fsync=False per append
+    journal.sync()
+    eng.submit(Workload(name="w", queue_name="lq0",
+                        pod_sets=(PodSet("main", 1, {"cpu": 500}),)))
+    assert journal._dirty, "append did not mark the journal dirty"
+    r = eng.schedule_once()
+    assert r is not None
+    assert not journal._dirty, "cycle boundary did not sync"
+    # Idle cycles: no appends, sync stays a no-op.
+    eng.schedule_once()
+    assert not journal._dirty
+
+
 def test_compact_preserves_rebuild(tmp_path):
     eng = Engine()
     build_world(eng)

@@ -376,6 +376,41 @@ class TestEventStream:
         assert got["admitted"]["workload"] == "default/w"
         assert got["admitted"]["clusterQueue"] == "cq"
 
+    def test_sse_heartbeat_comments_on_idle_stream(self):
+        """An idle /events connection still carries traffic: SSE comment
+        heartbeats every heartbeat_seconds (invisible to EventSource,
+        but enough to keep proxy/LB idle timeouts from dropping the
+        stream)."""
+        import http.client
+        import threading
+
+        from kueue_tpu.visibility.http_server import ServingEndpoint
+
+        eng, _, _ = self._world()
+        ep = ServingEndpoint(eng, port=0, heartbeat_seconds=0.1)
+        ep.start()
+        beats: list = []
+        done = threading.Event()
+
+        def subscribe():
+            conn = http.client.HTTPConnection("127.0.0.1", ep.port,
+                                              timeout=30)
+            conn.request("GET", "/events")
+            resp = conn.getresponse()
+            while len(beats) < 3:
+                line = resp.fp.readline().decode()
+                if line.startswith(": keep-alive"):
+                    beats.append(line)
+            done.set()
+
+        t = threading.Thread(target=subscribe, daemon=True)
+        t.start()
+        # The engine is completely idle: the heartbeat comments are the
+        # ONLY traffic on the stream.
+        assert done.wait(10), "heartbeat comments did not arrive"
+        ep.stop()
+        assert len(beats) >= 3
+
     def test_dashboard_page_wires_event_source(self):
         from kueue_tpu.visibility.dashboard import DASHBOARD_HTML
 

@@ -1,0 +1,336 @@
+"""Flight recorder + deterministic replayer (kueue_tpu/replay/): the
+determinism contract — record a scenario, replay through a fresh engine,
+byte-identical decision streams — plus trace integrity (CRC chain,
+tamper detection, torn-tail tolerance) and the differential
+host-vs-device replay mode."""
+
+import json
+import os
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.api.types import (  # noqa: E402
+    ClusterQueue,
+    ClusterQueuePreemption,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine  # noqa: E402
+from kueue_tpu.replay.recorder import FlightRecorder  # noqa: E402
+from kueue_tpu.replay.replayer import replay_trace  # noqa: E402
+from kueue_tpu.replay.trace import (  # noqa: E402
+    TraceCorruption,
+    TraceReader,
+)
+
+
+def _world(eng):
+    """Preemption-capable world: 2 cohorts x 2 CQs, lower-priority
+    reclaim — cycles produce admitted, preempting, AND pending
+    decisions."""
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    for c in range(2):
+        eng.create_cohort(Cohort(f"co{c}"))
+    for i in range(4):
+        eng.create_cluster_queue(ClusterQueue(
+            name=f"cq{i}", cohort=f"co{i % 2}",
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY),
+            resource_groups=(ResourceGroup(
+                ("cpu",), (FlavorQuotas(
+                    "default", {"cpu": ResourceQuota(4000)}),)),)))
+        eng.create_local_queue(LocalQueue(f"lq{i}", "default", f"cq{i}"))
+
+
+def _churn(eng):
+    """Deterministic churn: fill low-priority, drain, high-priority wave
+    forcing preemptions, finish a few, drain again — with out-of-band
+    clock jumps (the ``eng.clock +=`` idiom the recorder must capture
+    per frame)."""
+    for i in range(12):
+        eng.clock += 0.01
+        eng.submit(Workload(
+            name=f"low{i}", queue_name=f"lq{i % 4}", priority=0,
+            pod_sets=(PodSet("main", 1, {"cpu": 1000}),)))
+    for _ in range(20):
+        r = eng.schedule_once()
+        if r is None:
+            break
+        if r.stats.preempting:
+            eng.tick(0.0)
+    for i in range(6):
+        eng.clock += 0.01
+        eng.submit(Workload(
+            name=f"high{i}", queue_name=f"lq{i % 4}", priority=10,
+            pod_sets=(PodSet("main", 1, {"cpu": 2000}),)))
+    for _ in range(30):
+        r = eng.schedule_once()
+        if r is None:
+            break
+        if r.stats.preempting:
+            eng.tick(0.0)
+    done = sorted(k for k, w in eng.workloads.items()
+                  if w.is_admitted and not w.is_finished)
+    for key in done[:3]:
+        eng.clock += 0.01
+        eng.finish(key)
+    for _ in range(20):
+        if eng.schedule_once() is None:
+            break
+
+
+def _record(path, device=False):
+    eng = Engine()
+    rec = FlightRecorder(eng, str(path), label="test")
+    _world(eng)
+    if device:
+        eng.attach_oracle()
+    _churn(eng)
+    rec.close()
+    return eng, rec.digest
+
+
+def test_record_replay_byte_identical(tmp_path):
+    path = tmp_path / "t.jsonl"
+    eng, digest = _record(path)
+    report = replay_trace(str(path))
+    assert report.ok, report.render()
+    assert report.replayed_digest == digest
+    assert report.cycles > 0
+    assert report.admitted > 0
+    assert report.inputs > 0
+    assert not report.truncated
+
+
+def test_replay_twice_identical_digests(tmp_path):
+    path = tmp_path / "t.jsonl"
+    _record(path)
+    r1 = replay_trace(str(path))
+    r2 = replay_trace(str(path))
+    assert r1.ok and r2.ok
+    assert r1.replayed_digest == r2.replayed_digest
+    assert r1.cycles == r2.cycles
+
+
+def test_replayed_world_matches_recording_engine(tmp_path):
+    """Beyond the per-cycle decision stream: the replayed engine's final
+    admitted SET equals the recording engine's."""
+    path = tmp_path / "t.jsonl"
+    eng, _ = _record(path)
+    replayed = Engine()
+    from kueue_tpu.replay.recorder import apply_input
+    for frame in TraceReader(str(path)):
+        if frame["f"] == "input":
+            apply_input(replayed, frame)
+        elif frame["f"] == "idle":
+            for _ in range(frame["n"]):
+                replayed.schedule_once()
+        elif frame["f"] == "cycle":
+            replayed.clock = frame["clock"]
+            replayed.schedule_once()
+
+    def admitted(e):
+        return sorted(k for k, w in e.workloads.items()
+                      if w.is_admitted and not w.is_finished)
+    assert admitted(replayed) == admitted(eng)
+
+
+def test_phase_timings_captured(tmp_path):
+    path = tmp_path / "t.jsonl"
+    _record(path)
+    report = replay_trace(str(path))
+    # Sequential path phases (engine.last_cycle_phases).
+    assert set(report.phases_recorded) >= {"snapshot", "decide", "apply"}
+    attr = report.attribution("replayed")
+    assert attr and abs(sum(a["share"] for a in attr.values()) - 1.0) < 0.01
+
+
+def test_tamper_raises_trace_corruption(tmp_path):
+    path = tmp_path / "t.jsonl"
+    _record(path)
+    lines = path.read_text().splitlines()
+    mid = len(lines) // 2
+    # Flip a decision inside a mid-file frame, keeping valid JSON.
+    lines[mid] = lines[mid].replace('"clock"', '"clocj"', 1) \
+        if '"clock"' in lines[mid] else lines[mid].replace("1", "2", 1)
+    path.write_text("\n".join(lines) + "\n")
+    with pytest.raises(TraceCorruption):
+        replay_trace(str(path))
+
+
+def test_dropped_frame_raises_trace_corruption(tmp_path):
+    path = tmp_path / "t.jsonl"
+    _record(path)
+    lines = path.read_text().splitlines()
+    del lines[len(lines) // 2]  # drop one frame: the chain must notice
+    path.write_text("\n".join(lines) + "\n")
+    with pytest.raises(TraceCorruption):
+        replay_trace(str(path))
+
+
+def test_torn_tail_tolerated(tmp_path):
+    """A crash mid-write leaves a half-frame at EOF: the reader reports
+    truncation and replays the intact prefix."""
+    path = tmp_path / "t.jsonl"
+    _record(path)
+    data = path.read_text()
+    lines = data.splitlines(keepends=True)
+    # Drop the end frame entirely and tear the last cycle frame in half.
+    torn = "".join(lines[:-2]) + lines[-2][:len(lines[-2]) // 2]
+    path.write_text(torn)
+    report = replay_trace(str(path))
+    assert report.truncated
+    assert report.cycles > 0
+    assert not [m for m in report.mismatches], report.render()
+
+
+def test_evict_recorded_by_key(tmp_path):
+    """evict() takes a live engine-owned Workload: the trace must carry
+    its key (not a serialized copy) and replay must resolve it against
+    the replay engine's own object."""
+    path = tmp_path / "t.jsonl"
+    eng = Engine()
+    rec = FlightRecorder(eng, str(path))
+    _world(eng)
+    eng.clock += 0.01
+    eng.submit(Workload(name="w", queue_name="lq0",
+                        pod_sets=(PodSet("main", 1, {"cpu": 1000}),)))
+    eng.schedule_once()
+    wl = eng.workloads["default/w"]
+    assert wl.is_admitted
+    eng.clock += 0.01
+    eng.evict(wl, "Preempted")
+    eng.schedule_once()
+    rec.close()
+    frames = [f for f in TraceReader(str(path))
+              if f["f"] == "input" and f["method"] == "evict"]
+    assert frames and frames[0]["args"][0] == "default/w"
+    report = replay_trace(str(path))
+    assert report.ok, report.render()
+
+
+def test_recorder_close_detaches(tmp_path):
+    path = tmp_path / "t.jsonl"
+    eng = Engine()
+    rec = FlightRecorder(eng, str(path))
+    _world(eng)
+    rec.close()
+    frames_before = len(list(TraceReader(str(path))))
+    # Post-close inputs must NOT extend the trace, and the instance
+    # attributes must be gone (class methods restored).
+    eng.clock += 1.0
+    eng.submit(Workload(name="late", queue_name="lq0",
+                        pod_sets=(PodSet("main", 1, {"cpu": 100}),)))
+    eng.schedule_once()
+    assert "submit" not in eng.__dict__
+    assert len(list(TraceReader(str(path)))) == frames_before
+
+
+def test_internal_calls_not_double_recorded(tmp_path):
+    """Preemption applies evictions INSIDE a recorded cycle; those must
+    not appear as input frames (replaying them twice would diverge)."""
+    path = tmp_path / "t.jsonl"
+    _record(path)
+    evicts = [f for f in TraceReader(str(path))
+              if f["f"] == "input" and f["method"] == "evict"]
+    assert evicts == []  # _churn never calls evict directly
+
+
+def test_idle_cycles_coalesced(tmp_path):
+    path = tmp_path / "t.jsonl"
+    eng = Engine()
+    rec = FlightRecorder(eng, str(path))
+    _world(eng)
+    for _ in range(5):
+        eng.schedule_once()  # empty world: all idle
+    eng.clock += 0.01
+    eng.submit(Workload(name="w", queue_name="lq0",
+                        pod_sets=(PodSet("main", 1, {"cpu": 100}),)))
+    eng.schedule_once()
+    rec.close()
+    idles = [f for f in TraceReader(str(path)) if f["f"] == "idle"]
+    assert len(idles) == 1 and idles[0]["n"] == 5
+    report = replay_trace(str(path))
+    assert report.ok and report.idle_cycles == 5
+
+
+def test_bootstrap_from_populated_world(tmp_path):
+    """bootstrap=True snapshots a live (e.g. journal-rebuilt) world into
+    the trace head: the trace alone reconstructs mid-life state."""
+    path = tmp_path / "t.jsonl"
+    eng = Engine()
+    _world(eng)
+    for i in range(4):
+        eng.clock += 0.01
+        eng.submit(Workload(
+            name=f"pre{i}", queue_name=f"lq{i % 4}",
+            pod_sets=(PodSet("main", 1, {"cpu": 1000}),)))
+    eng.schedule_once()  # some already admitted before recording starts
+    rec = FlightRecorder(eng, str(path), bootstrap=True)
+    eng.clock += 0.01
+    eng.submit(Workload(name="post", queue_name="lq0",
+                        pod_sets=(PodSet("main", 1, {"cpu": 1000}),)))
+    for _ in range(10):
+        if eng.schedule_once() is None:
+            break
+    rec.close()
+    report = replay_trace(str(path))
+    assert report.ok, report.render()
+    # The bootstrap emitted restore_workload frames for the pre-state.
+    restores = [f for f in TraceReader(str(path))
+                if f["f"] == "input" and f["method"] == "restore_workload"]
+    assert len(restores) == 4
+
+
+def test_trace_frames_are_canonical_json(tmp_path):
+    path = tmp_path / "t.jsonl"
+    _record(path)
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            frame = json.loads(line)
+            assert "crc" in frame and "f" in frame
+
+
+def test_replay_rejects_unknown_mode(tmp_path):
+    path = tmp_path / "t.jsonl"
+    _record(path)
+    with pytest.raises(ValueError):
+        replay_trace(str(path), mode="quantum")
+
+
+class TestDifferentialReplay:
+    """mode='both': host and device engines consume the trace side by
+    side; every cycle must match the recording AND each other — the
+    golden-suite host/device decision-parity contract, asserted over a
+    whole recorded scenario instead of single synthetic cycles."""
+
+    def test_host_vs_device_differential(self, tmp_path):
+        path = tmp_path / "t.jsonl"
+        _record(path)  # recorded on the host path
+        report = replay_trace(str(path), mode="both")
+        assert report.ok, report.render()
+        assert not [m for m in report.mismatches
+                    if m.kind == "host-vs-device"], report.render()
+
+    def test_device_replay_of_device_recording(self, tmp_path):
+        """Record THROUGH the oracle (device/hybrid cycles, verdict
+        digests in the trace), replay on the host path: the semantic
+        decision stream is path-invariant."""
+        path = tmp_path / "t.jsonl"
+        _record(path, device=True)
+        modes = {f.get("mode") for f in TraceReader(str(path))
+                 if f["f"] == "cycle"}
+        assert modes & {"device", "hybrid"}, (
+            f"recording never took the device path: {modes}")
+        report = replay_trace(str(path), mode="host")
+        assert report.ok, report.render()

@@ -1,0 +1,260 @@
+"""Fault-injection matrix (kueue_tpu/replay/faults.py): spec parsing,
+in-process oracle faults (sidecar crash → sequential fallback →
+reconnect; delayed verdicts → decisions unaffected), and the real
+crash-recovery contract — a CHILD process SIGKILLed mid-admission (or
+after planting a torn journal tail) by the fault layer, rebuilt from its
+journal, must converge to the exact admitted set of an uninterrupted
+control run: zero lost, zero duplicate admissions."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.replay.faults import (  # noqa: E402
+    FaultPlan,
+    _ExecutorFaultProxy,
+    arm_faults,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The child runs the SAME deterministic churn scenario as the process-
+# kill restart suite, but the killing is done by the armed fault layer —
+# mid-admission-apply or after tearing the journal tail — instead of a
+# parent-paced signal.
+_CHILD = r"""
+import sys
+sys.path.insert(0, {repo!r})
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from tests.test_process_kill_restart import build_world, run_churn
+from kueue_tpu.replay.faults import arm_faults
+
+path, spec = sys.argv[1], sys.argv[2]
+eng = build_world(path)
+injector = arm_faults(eng, spec)
+for k in run_churn(eng):
+    print(f"cycle {k}", flush=True)
+print("done", flush=True)
+"""
+
+
+class TestFaultPlanParse:
+    def test_all_kinds(self):
+        plan = FaultPlan.parse(
+            "sigkill@cycle:3, sigkill@admission:40,"
+            "torn-tail@cycle:2,oracle-crash@cycle:1,"
+            "delay-verdict@cycle:5:250")
+        kinds = [(f.kind, f.at, f.n) for f in plan.faults]
+        assert kinds == [("sigkill", "cycle", 3),
+                         ("sigkill", "admission", 40),
+                         ("torn-tail", "cycle", 2),
+                         ("oracle-crash", "cycle", 1),
+                         ("delay-verdict", "cycle", 5)]
+        assert plan.faults[-1].arg == 250.0
+
+    def test_empty_spec_is_empty_plan(self):
+        assert FaultPlan.parse("").faults == []
+
+    @pytest.mark.parametrize("spec", [
+        "sigkill",                   # no @
+        "sigkill@cycle",             # no :N
+        "sigkill@cycle:x",           # non-integer
+        "meteor@cycle:1",            # unknown kind
+        "sigkill@verdict:1",         # unknown point
+        "torn-tail@admission:1",     # only sigkill triggers mid-apply
+    ])
+    def test_rejects_bad_specs(self, spec):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(spec)
+
+
+def _device_world():
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        Cohort,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Workload,
+    )
+    from kueue_tpu.controllers.engine import Engine
+
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cohort(Cohort("co"))
+    for i in range(3):
+        eng.create_cluster_queue(ClusterQueue(
+            name=f"cq{i}", cohort="co",
+            resource_groups=(ResourceGroup(
+                ("cpu",), (FlavorQuotas(
+                    "default", {"cpu": ResourceQuota(4000)}),)),)))
+        eng.create_local_queue(LocalQueue(f"lq{i}", "default", f"cq{i}"))
+    for i in range(9):
+        eng.clock += 0.01
+        eng.submit(Workload(
+            name=f"w{i}", queue_name=f"lq{i % 3}",
+            pod_sets=(PodSet("main", 1, {"cpu": 1000}),)))
+    eng.attach_oracle()
+    return eng
+
+
+def _admitted(eng):
+    return sorted(k for k, w in eng.workloads.items()
+                  if w.is_admitted and not w.is_finished)
+
+
+class TestOracleFaults:
+    def test_oracle_crash_falls_back_then_recovers(self):
+        """oracle-crash@cycle:N: the executor raises transport errors
+        for cycle N; the engine must run that cycle sequentially (the
+        BestEffortFIFO fallback contract) and be back on device the
+        next cycle — with the SAME admitted set as a fault-free run."""
+        control = _device_world()
+        while control.schedule_once() is not None:
+            pass
+
+        eng = _device_world()
+        injector = arm_faults(eng, "oracle-crash@cycle:0")
+        eng.schedule_once()  # faulted cycle: sequential fallback
+        assert injector.proxy.injected_errors >= 1
+        assert eng.oracle.fallback_reasons.get("remote-error", 0) >= 1
+        assert eng.last_cycle_mode == "sequential"
+        device_before = eng.oracle.cycles_on_device
+        while eng.schedule_once() is not None:  # sidecar "restarted"
+            pass
+        assert eng.oracle.cycles_on_device > device_before, \
+            "bridge never reconnected after the injected crash"
+        assert _admitted(eng) == _admitted(control)
+        assert injector.fired == ["oracle-crash@cycle:0"]
+
+    def test_delayed_verdict_leaves_decisions_unchanged(self):
+        """delay-verdict@cycle:N:MS: verdicts arrive late; only the
+        phase timings move, never the decision stream."""
+        control = _device_world()
+        while control.schedule_once() is not None:
+            pass
+
+        eng = _device_world()
+        injector = arm_faults(eng, "delay-verdict@cycle:0:80")
+        t0 = time.perf_counter()
+        eng.schedule_once()
+        delayed_elapsed = time.perf_counter() - t0
+        assert injector.proxy.delayed_calls >= 1
+        assert delayed_elapsed >= 0.08
+        while eng.schedule_once() is not None:
+            pass
+        assert injector.proxy.delay_ms == 0.0  # cleared post-cycle
+        assert _admitted(eng) == _admitted(control)
+
+    def test_executor_proxy_passthrough_when_armed_clean(self):
+        """An armed-but-untriggered plan is a no-op: the proxy wraps the
+        executor but injects nothing until its cycle comes up."""
+        eng = _device_world()
+        injector = arm_faults(eng, "oracle-crash@cycle:9999")
+        assert isinstance(eng.oracle.executor, _ExecutorFaultProxy)
+        while eng.schedule_once() is not None:
+            pass
+        assert injector.proxy.injected_errors == 0
+        assert eng.oracle.fallback_reasons.get("remote-error", 0) == 0
+
+    def test_oracle_fault_requires_attached_oracle(self):
+        from kueue_tpu.controllers.engine import Engine
+        with pytest.raises(RuntimeError):
+            arm_faults(Engine(), "oracle-crash@cycle:1")
+
+
+def _spawn_child(journal_path, spec):
+    return subprocess.Popen(
+        [sys.executable, "-c", _CHILD.replace("{repo!r}", repr(REPO)),
+         journal_path, spec],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def _control_fingerprint():
+    from tests.test_process_kill_restart import (
+        build_world,
+        drain,
+        fingerprint,
+        run_churn,
+    )
+    control = build_world(None)
+    for _ in run_churn(control):
+        pass
+    drain(control)
+    return fingerprint(control)
+
+
+def _recover_and_fingerprint(journal_path):
+    from kueue_tpu.api.types import PodSet, Workload
+    from kueue_tpu.store.journal import rebuild_engine
+    from tests.test_process_kill_restart import drain, fingerprint
+
+    rebuilt = rebuild_engine(journal_path)
+    assert rebuilt.workloads, "journal rebuilt an empty world"
+    # Re-drive the inputs the child never got to submit, then converge.
+    for k in range(18):
+        name = f"default/high{k}"
+        if name not in rebuilt.workloads:
+            rebuilt.clock += 0.01
+            rebuilt.submit(Workload(
+                name=f"high{k}", queue_name=f"lq{k % 9}", priority=10,
+                pod_sets=(PodSet("main", 1, {"cpu": 2000}),)))
+    drain(rebuilt)
+    return fingerprint(rebuilt)
+
+
+@pytest.mark.slow
+def test_sigkill_mid_admission_recovers_to_control(tmp_path):
+    """The fault layer SIGKILLs the child in the middle of a cycle's
+    admission apply loop (sigkill@admission:N — after the Nth admission
+    commits, before the cycle completes). Reboot from the journal and
+    drain: the admitted set must equal the uninterrupted control's —
+    zero lost, zero duplicate admissions."""
+    path = str(tmp_path / "j.jsonl")
+    child = _spawn_child(path, "sigkill@admission:12")
+    deadline = time.monotonic() + 180
+    while child.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.2)
+    assert child.poll() is not None, "child never died; fault unarmed?"
+    out = child.stdout.read()
+    assert child.returncode == -signal.SIGKILL, (
+        f"exit={child.returncode} out={out[-400:]} "
+        f"err={child.stderr.read()[-800:]}")
+    assert "done" not in out, "child finished churn — kill never fired"
+    assert _recover_and_fingerprint(path) == _control_fingerprint(), (
+        "post-crash recovery diverged from the uninterrupted control")
+
+
+@pytest.mark.slow
+def test_torn_tail_fault_recovers_to_control(tmp_path):
+    """torn-tail@cycle:N plants a flushed newline-less fragment at the
+    journal tail and SIGKILLs — the exact artifact of a crash mid-
+    append. The rebuild must trim it and converge to the control."""
+    path = str(tmp_path / "j.jsonl")
+    child = _spawn_child(path, "torn-tail@cycle:4")
+    deadline = time.monotonic() + 180
+    while child.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.2)
+    assert child.poll() is not None and \
+        child.returncode == -signal.SIGKILL
+    # The fragment is really there: the raw file must NOT end clean.
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    assert not raw.endswith(b"\n"), "fault did not tear the tail"
+    assert _recover_and_fingerprint(path) == _control_fingerprint(), (
+        "torn-tail recovery diverged from the uninterrupted control")

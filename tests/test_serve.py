@@ -67,3 +67,68 @@ def test_serve_boots_schedules_and_stops(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_serve_record_flag_produces_replayable_trace(tmp_path):
+    """serve.py --record: the serving loop flight-records bootstrap +
+    every input/cycle; after a clean SIGTERM the sealed trace replays
+    byte-identically and carries the admission."""
+    journal = tmp_path / "journal.jsonl"
+    trace = tmp_path / "flight.trace.jsonl"
+    from kueue_tpu.api.types import (
+        ClusterQueue, FlavorQuotas, LocalQueue, PodSet, ResourceFlavor,
+        ResourceGroup, ResourceQuota, Workload,
+    )
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.store.journal import Journal
+
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=(ResourceGroup(
+            ("cpu",), (FlavorQuotas("default",
+                                    {"cpu": ResourceQuota(4000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    eng.attach_journal(Journal(str(journal)))
+    eng.submit(Workload(name="w0", queue_name="lq",
+                        pod_sets=(PodSet("main", 1, {"cpu": 1000}),)))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=os.getcwd())
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kueue_tpu.serve", "--journal",
+         str(journal), "--oracle", "off", "--http", "127.0.0.1:0",
+         "--tick", "0.05", "--record", str(trace)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "serving on" in line, line
+        port = int(line.split("serving on ")[1].split(" ")[0]
+                   .rsplit(":", 1)[1])
+        deadline = time.time() + 30
+        admitted = False
+        while time.time() < deadline and not admitted:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/dump",
+                    timeout=5) as r:
+                state = json.loads(r.read())
+            admitted = "default/w0" in str(state)
+            time.sleep(0.2)
+        assert admitted
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=20) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    from kueue_tpu.replay.replayer import replay_trace
+    from kueue_tpu.replay.trace import TraceReader
+
+    report = replay_trace(str(trace))
+    assert report.ok, report.render()
+    assert not report.truncated, "clean shutdown must seal the trace"
+    assert report.admitted >= 1
+    # The bootstrap replayed the journal-seeded world into the trace.
+    methods = {f["method"] for f in TraceReader(str(trace))
+               if f["f"] == "input"}
+    assert "create_cluster_queue" in methods

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""replay-smoke: the end-to-end determinism check behind
+``make replay-smoke``.
+
+Records a 50-workload admission scenario (world build, submissions,
+drain, finish churn, re-drain) through the flight recorder, then replays
+the trace TWICE through fresh engines and diffs the decision-stream
+checksums: recorded vs replay #1 vs replay #2 must be byte-identical
+(the replay/trace.py determinism contract). Exits non-zero with the
+first divergence otherwise.
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def record(path: str) -> str:
+    from kueue_tpu.bench.scenario import baseline_like
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.replay.recorder import FlightRecorder
+
+    eng = Engine()
+    rec = FlightRecorder(eng, path, label="replay-smoke")
+    # Undersized quota (sized_to_fit=False): the drain leaves a pending
+    # tail, so the trace carries admitted AND pending decisions, and the
+    # finish churn below actually changes what fits.
+    scen = baseline_like(n_cohorts=2, cqs_per_cohort=2, n_workloads=50,
+                         nominal_per_cq=20_000, sized_to_fit=False)
+    for rf in scen.flavors:
+        eng.create_resource_flavor(rf)
+    for co in scen.cohorts:
+        eng.create_cohort(co)
+    for cq in scen.cluster_queues:
+        eng.create_cluster_queue(cq)
+    for lq in scen.local_queues:
+        eng.create_local_queue(lq)
+    for wl in scen.workloads:
+        eng.clock += 0.001
+        eng.submit(wl)
+
+    def drain():
+        for _ in range(200):
+            if eng.schedule_once() is None:
+                break
+
+    drain()
+    # Finish churn: free capacity, requeue pressure, drain again — the
+    # second wave's decisions depend on the first's exact outcome.
+    done = sorted(k for k, w in eng.workloads.items()
+                  if w.is_admitted and not w.is_finished)
+    for key in done[:10]:
+        eng.clock += 0.001
+        eng.finish(key)
+    drain()
+    rec.close()
+    return rec.digest
+
+
+def main() -> int:
+    trace = os.path.join(tempfile.mkdtemp(prefix="replay-smoke-"),
+                         "smoke.trace.jsonl")
+    recorded = record(trace)
+    print(f"recorded  {trace} (digest {recorded})")
+
+    from kueue_tpu.replay.replayer import replay_trace
+
+    digests = []
+    for attempt in (1, 2):
+        report = replay_trace(trace, mode="host")
+        print(f"replay #{attempt}: cycles={report.cycles} "
+              f"idle={report.idle_cycles} inputs={report.inputs} "
+              f"admitted={report.admitted} "
+              f"digest={report.replayed_digest} "
+              f"{'OK' if report.ok else 'DIVERGED'}")
+        if not report.ok:
+            print(report.render(), file=sys.stderr)
+            return 1
+        digests.append(report.replayed_digest)
+    if len(set(digests)) != 1 or digests[0] != recorded:
+        print(f"checksum diff: recorded={recorded} replays={digests}",
+              file=sys.stderr)
+        return 1
+    print(f"replay-smoke OK: 2 replays byte-identical to the recording "
+          f"({recorded})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
