@@ -6,7 +6,7 @@ PY ?= python
 PYTEST_FLAGS ?= -q
 
 .PHONY: all native test test-fast test-device bench multichip-dryrun \
-  replay-smoke clean
+  replay-smoke obs-smoke clean
 
 all: native
 
@@ -46,6 +46,12 @@ bench-fast:
 # replay it twice, diff the decision-stream checksums (replay/).
 replay-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/replay_smoke.py
+
+# Observability smoke: tracer + serving endpoint, 50-workload admit,
+# /metrics scrape validated by tools/promcheck, Perfetto export
+# validated by tools/trace_schema, /debug/trace + explain (obs/).
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/obs_smoke.py
 
 # Validate the multi-chip sharding compiles + executes on a virtual mesh.
 multichip-dryrun:

@@ -852,6 +852,79 @@ def _tas_crossover_measure(build, n_probe: int = 5) -> dict:
     return out
 
 
+def bench_trace_overhead(n_workloads, n_cohorts=4, repeats=3):
+    """Admission tracing must be observationally near-free: the same
+    sequential drain with and without the CycleTracer attached
+    (obs/tracer.py), best-of-N per arm. Budget: <=5% wall-clock
+    overhead — vs_baseline 1.0 means within budget, <1.0 scales by the
+    overrun. Both arms chain their per-cycle decision digests through a
+    listener (costed symmetrically), so the line also proves the
+    tracer's digest-neutrality contract on this exact run."""
+    from kueue_tpu.bench.scenario import baseline_like
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.replay.trace import canonical_decisions, decision_digest
+
+    budget_pct = 5.0
+    scen = baseline_like(n_cohorts=n_cohorts, n_workloads=n_workloads)
+
+    def drive(traced):
+        eng = Engine()
+        state = {"digest": 0, "cycles": 0}
+
+        def listener(seq, result):
+            if result is not None:
+                state["digest"] = decision_digest(
+                    canonical_decisions(result), state["digest"])
+                state["cycles"] += 1
+        eng.cycle_listeners.append(listener)
+        if traced:
+            eng.attach_tracer(retain=64)
+        for rf in scen.flavors:
+            eng.create_resource_flavor(rf)
+        for co in scen.cohorts:
+            eng.create_cohort(co)
+        for cq in scen.cluster_queues:
+            eng.create_cluster_queue(cq)
+        for lq in scen.local_queues:
+            eng.create_local_queue(lq)
+        for wl in scen.workloads:
+            eng.clock += 0.0001
+            eng.submit(wl)
+        t0 = time.perf_counter()
+        while eng.schedule_once() is not None:
+            pass
+        elapsed = time.perf_counter() - t0
+        admitted = sum(1 for w in eng.workloads.values()
+                       if w.is_admitted)
+        return elapsed, f"{state['digest']:08x}", state["cycles"], admitted
+
+    best = {False: float("inf"), True: float("inf")}
+    digests = {}
+    cycles = admitted = 0
+    for _ in range(repeats):
+        for traced in (False, True):
+            elapsed, digest, cycles, admitted = drive(traced)
+            best[traced] = min(best[traced], elapsed)
+            digests[traced] = digest
+    overhead = ((best[True] - best[False]) / best[False] * 100
+                if best[False] > 0 else 0.0)
+    within = overhead <= budget_pct
+    return {
+        "value": round(overhead, 2), "unit": "% overhead",
+        "vs_baseline": (1.0 if within
+                        else round(budget_pct / max(overhead, 1e-9), 2)),
+        "detail": {"budget_pct": budget_pct, "within_budget": within,
+                   "untraced_s": round(best[False], 4),
+                   "traced_s": round(best[True], 4),
+                   "repeats": repeats, "cycles": cycles,
+                   "admitted": admitted, "workloads": n_workloads,
+                   "digest_untraced": digests[False],
+                   "digest_traced": digests[True],
+                   "digests_identical":
+                       digests[False] == digests[True]},
+    }
+
+
 def bench_replay(trace_path, mode="host"):
     """A flight-recorder trace AS a bench scenario: re-execute it through
     the real engine (replay/replayer.py) and report cycle throughput plus
@@ -1021,6 +1094,9 @@ def main() -> None:
         racks=8 if fast else 16, hosts=32 if fast else 40,
         n_wl=80 if fast else 320,
         churn_cycles=6 if fast else 20), min_budget_s=60.0)
+    run_scenario("trace_overhead", lambda: bench_trace_overhead(
+        500 if fast else 5_000, n_cohorts=2 if fast else 4,
+        repeats=2 if fast else 3), min_budget_s=60.0)
 
     # Late-round TPU re-probe (round-4 verdict ask #6): when the early
     # probe failed, try once more AFTER the CPU run — a tunnel that

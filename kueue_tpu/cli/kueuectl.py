@@ -365,6 +365,12 @@ class Kueuectl:
             rows = [r for r in rows if r.get("name") == name]
         return rows
 
+    # -- explain (obs/: why is my workload pending?) --
+
+    def explain(self, key: str, probe: bool = True) -> dict:
+        from kueue_tpu.obs import explain_workload
+        return explain_workload(self.engine, key, probe=probe)
+
     def version(self) -> str:
         return VERSION
 
@@ -458,6 +464,33 @@ def build_parser() -> argparse.ArgumentParser:
                           "oracle-crash@cycle:2 (see replay/faults.py)")
     rep.add_argument("--stop-after", type=int, dest="stop_after",
                      help="replay only the first N cycles")
+
+    exp = sub.add_parser(
+        "explain",
+        help="why is my workload pending: last traced decision "
+             "(per-flavor rejection reasons, preemption rationale, "
+             "correlation id) plus a live what-if probe")
+    exp.add_argument("name")
+    exp.add_argument("--namespace", default="default")
+    exp.add_argument("--json", action="store_true", dest="as_json",
+                     help="emit the structured report instead of text")
+    exp.add_argument("--no-probe", action="store_true",
+                     help="skip the live one-shot nomination probe")
+
+    tr = sub.add_parser(
+        "trace", help="span-tree operations (obs/)")
+    trs = tr.add_subparsers(dest="trace_command")
+    texp = trs.add_parser(
+        "export",
+        help="export span trees as Chrome/Perfetto trace-event JSON "
+             "(open in ui.perfetto.dev or chrome://tracing)")
+    texp.add_argument("--out", required=True, help="output JSON path")
+    texp.add_argument("--input",
+                      help="flight-recorder trace (.jsonl) to convert "
+                           "offline; default: the live engine's "
+                           "retained spans")
+    texp.add_argument("--last", type=int, default=0,
+                      help="export only the newest N cycle span trees")
     return p
 
 
@@ -572,6 +605,32 @@ def run(engine, argv: list[str]) -> str:
         if not report.ok:
             raise SystemExit(report.render())
         return report.render()
+    if args.command == "explain":
+        from kueue_tpu.obs import explain_workload, render_explain
+        report = explain_workload(engine, f"{args.namespace}/{args.name}",
+                                  probe=not args.no_probe)
+        if args.as_json:
+            return json.dumps(report, indent=2, default=str)
+        return render_explain(report)
+    if args.command == "trace":
+        if args.trace_command != "export":
+            raise SystemExit("usage: kueuectl trace export --out FILE")
+        from kueue_tpu.obs import spans_from_flight_trace, write_perfetto
+        if args.input:
+            roots = spans_from_flight_trace(args.input)
+        else:
+            tracer = getattr(engine, "tracer", None)
+            if tracer is None:
+                raise SystemExit(
+                    "no tracer attached to this engine and no --input "
+                    "flight trace given (serve with --trace, or pass "
+                    "--input RECORDING.jsonl)")
+            roots = list(tracer.spans)
+        if args.last:
+            roots = roots[-args.last:]
+        n = write_perfetto(roots, args.out)
+        return (f"exported {n} trace event(s) from {len(roots)} "
+                f"cycle span tree(s) -> {args.out}")
     if args.command == "delete":
         if args.dry_run != "none":
             return f"{args.kind}/{args.name} deleted (dry run)"

@@ -185,6 +185,9 @@ class Engine:
         self.cycle_seq: int = 0
         self.pre_cycle_hooks: list[Callable] = []
         self.cycle_listeners: list[Callable] = []
+        # Admission tracer (obs.CycleTracer attaches itself here); the
+        # flight recorder and explain path read it via this slot.
+        self.tracer = None
         self.workloads: dict[str, Workload] = {}
         # hook: called with (workload, admission) after each admission.
         self.on_admit: Optional[Callable] = None
@@ -686,6 +689,14 @@ class Engine:
                            requeue=False)
         if self.status_controller is not None:
             self.status_controller.sweep_retention()
+
+    def attach_tracer(self, retain: int = 64, **kwargs):
+        """Enable admission tracing: per-cycle span trees with decision
+        rationale (obs.CycleTracer), retained in a bounded ring and
+        served at /debug/trace, ``kueuectl explain`` and
+        ``kueuectl trace export``."""
+        from kueue_tpu.obs import attach_tracer
+        return attach_tracer(self, retain=retain, **kwargs)
 
     def attach_oracle(self, max_depth: int = 4,
                       remote_address: Optional[tuple] = None) -> None:

@@ -14,6 +14,7 @@ from __future__ import annotations
 import bisect
 from collections import defaultdict
 from dataclasses import dataclass, field
+from typing import Optional
 
 
 @dataclass
@@ -97,19 +98,39 @@ class Histogram:
         self.totals[labels] += int(vals.size)
 
     def quantile(self, q: float, labels: tuple = ()) -> float:
-        """Approximate quantile from bucket counts (upper bound)."""
+        """Approximate quantile from bucket counts (upper bound).
+
+        The zero-total case is guarded explicitly: with total == 0 the
+        target q*total is 0 and ``acc >= target`` holds at the very
+        first bucket, returning buckets[0] instead of the 0.0 an empty
+        series must report (a race-visible state — a scraper can land
+        between a concurrent observe creating counts[labels] and the
+        total increment)."""
         counts = self.counts.get(labels)
         if not counts:
             return 0.0
-        total = self.totals[labels]
+        total = self.totals.get(labels, 0)
+        if total <= 0:
+            return 0.0
         target = q * total
         acc = 0
         for i, c in enumerate(counts):
             acc += c
-            if acc >= target:
+            if acc and acc >= target:
                 return (self.buckets[i] if i < len(self.buckets)
                         else float("inf"))
         return float("inf")
+
+    def reset(self, labels: Optional[tuple] = None) -> None:
+        """Drop one series, or every series (test isolation)."""
+        if labels is None:
+            self.counts.clear()
+            self.sums.clear()
+            self.totals.clear()
+        else:
+            self.counts.pop(labels, None)
+            self.sums.pop(labels, None)
+            self.totals.pop(labels, None)
 
 
 @dataclass(frozen=True)
@@ -222,6 +243,11 @@ class MetricsRegistry:
         g("cluster_queue_info", "cohort membership per CQ")
         g("build_info", "framework build identity")
         c("ready_wait_time_seconds_total", "admitted->ready")
+        # span-derived exemplar families (obs.tracer): traced cycles
+        # per decision path and workload decision spans per outcome
+        c("trace_cycles_total", "traced scheduling cycles per mode")
+        c("trace_workload_decisions_total",
+          "traced workload decision spans per outcome")
         self.gauge("build_info").set(
             (("name", "kueue_tpu"), ("version", "0.2.0")), 1)
 
@@ -281,6 +307,12 @@ class MetricsRegistry:
                         lines.append(
                             f"{prefix}{name}_bucket"
                             f"{_fmt(labels + (('le', b),))} {acc}")
+                    # The mandatory +Inf bucket (== _count): scrapers
+                    # reject a histogram without it.
+                    lines.append(
+                        f"{prefix}{name}_bucket"
+                        f"{_fmt(labels + (('le', '+Inf'),))} "
+                        f"{acc + counts[len(metric.buckets)]}")
                     lines.append(
                         f"{prefix}{name}_sum{_fmt(labels)} "
                         f"{metric.sums[labels]}")
@@ -290,13 +322,23 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+def _esc(value) -> str:
+    """Escape a label value per the Prometheus text exposition format:
+    backslash, double-quote and newline must appear as \\\\, \\" and
+    \\n inside the quoted value (exposition_formats.md) — unescaped
+    they truncate the value or split the sample line, producing
+    exposition text scrapers reject."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt(labels: tuple) -> str:
     if not labels:
         return ""
     parts = []
     for i, item in enumerate(labels):
         if isinstance(item, tuple) and len(item) == 2:
-            parts.append(f'{item[0]}="{item[1]}"')
+            parts.append(f'{item[0]}="{_esc(item[1])}"')
         else:
-            parts.append(f'label_{i}="{item}"')
+            parts.append(f'label_{i}="{_esc(item)}"')
     return "{" + ",".join(parts) + "}"

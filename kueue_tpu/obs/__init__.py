@@ -1,0 +1,41 @@
+"""Admission tracing and decision explainability.
+
+The substrate every perf/debug story builds on: per-cycle span trees
+with structured decision rationale (obs.tracer), cheap rationale hooks
+for the decision path (obs.hooks), Chrome/Perfetto export
+(obs.perfetto), ``kueuectl explain`` (obs.explain), and device-path
+named scopes that line host spans up with XLA profiles (obs.device).
+"""
+
+from kueue_tpu.obs import hooks
+from kueue_tpu.obs.explain import explain_workload, render_explain
+from kueue_tpu.obs.perfetto import (
+    spans_from_flight_trace,
+    to_perfetto,
+    write_perfetto,
+)
+from kueue_tpu.obs.span import Span, correlation_id
+from kueue_tpu.obs.tracer import CycleTracer
+
+
+def attach_tracer(engine, retain: int = 64, **kwargs) -> CycleTracer:
+    """Attach a CycleTracer to a live engine (idempotent: an existing
+    tracer is returned rather than doubled)."""
+    existing = getattr(engine, "tracer", None)
+    if existing is not None:
+        return existing
+    return CycleTracer(engine, retain=retain, **kwargs)
+
+
+__all__ = [
+    "CycleTracer",
+    "Span",
+    "attach_tracer",
+    "correlation_id",
+    "explain_workload",
+    "hooks",
+    "render_explain",
+    "spans_from_flight_trace",
+    "to_perfetto",
+    "write_perfetto",
+]

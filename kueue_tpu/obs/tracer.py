@@ -1,0 +1,207 @@
+"""CycleTracer: per-cycle span trees from the engine's capture points.
+
+Attachment is purely observational — a pre-cycle hook captures the wall
+start and arms the rationale buffer (obs.hooks), and a cycle listener
+reconstructs the span tree from artifacts the cycle already produced:
+the CycleResult entries (assignment, per-flavor rejection reasons,
+preemption targets, statuses), Engine.last_cycle_phases and
+last_cycle_mode, and the drained rationale events. Nothing here feeds
+back into a decision, which is what keeps a traced run's decision
+digest byte-identical to an untraced run (asserted by
+tests/test_obs_trace.py and the bench trace-overhead scenario).
+
+Both decision paths land here unchanged: the sequential core and the
+oracle bridge (device/hybrid) both deliver CycleResult entries through
+Engine.cycle_listeners, so workload spans carry the same attributes
+regardless of which path decided them.
+
+Retention is a bounded ring (``retain`` cycles) — the /debug/trace and
+``kueuectl explain`` working set, not an archive; export what you want
+to keep (``kueuectl trace export``).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Optional
+
+from kueue_tpu.obs import hooks
+from kueue_tpu.obs.span import Span, correlation_id
+
+_STATUS_TO_DECISION = {
+    "assumed": "admitted",
+    "preempting": "preempting",
+    "skipped": "skipped",
+    "inadmissible": "inadmissible",
+    "nominated": "nominated",
+    "": "not-nominated",
+}
+
+
+class CycleTracer:
+    def __init__(self, engine, retain: int = 64,
+                 journal_correlation: bool = True,
+                 emit_events: bool = True):
+        self.engine = engine
+        self.retain = retain
+        self.journal_correlation = journal_correlation
+        self.emit_events = emit_events
+        self.spans: deque[Span] = deque(maxlen=retain)
+        self.cycles_traced = 0
+        self.last_cid: Optional[str] = None
+        self._epoch = time.perf_counter()
+        self._t0: Optional[float] = None
+        self._pre = self._pre_cycle
+        self._post = self._on_cycle
+        engine.pre_cycle_hooks.append(self._pre)
+        engine.cycle_listeners.append(self._post)
+        engine.tracer = self
+
+    # -- capture points --
+
+    def _pre_cycle(self, seq, eng) -> None:
+        # Runs un-isolated in schedule_once (fault injectors share this
+        # hook list and raise on purpose) — keep it infallible.
+        self._t0 = time.perf_counter()
+        hooks.CURRENT = hooks.RationaleBuffer()
+
+    def _on_cycle(self, seq, result) -> None:
+        buf, hooks.CURRENT = hooks.CURRENT, None
+        end = time.perf_counter()
+        t0 = self._t0 if self._t0 is not None else end
+        self._t0 = None
+        if result is None:
+            return  # idle: no decisions, no span tree
+        root = self._build(seq, result, buf, t0, end)
+        self.spans.append(root)
+        self.cycles_traced += 1
+        self.last_cid = root.attrs["cid"]
+        self._report(root)
+
+    # -- span-tree construction --
+
+    def _build(self, seq, result, buf, t0: float, end: float) -> Span:
+        from kueue_tpu.replay.trace import canonical_decisions
+
+        eng = self.engine
+        decisions = canonical_decisions(result)
+        cid = correlation_id(seq, decisions)
+        mode = eng.last_cycle_mode or "sequential"
+        ts = (t0 - self._epoch) * 1e6
+        root = Span(f"cycle/{seq}", "cycle", ts, (end - t0) * 1e6, {
+            "seq": seq, "cid": cid, "mode": mode, "clock": eng.clock,
+            "admitted": result.stats.admitted,
+            "preempting": result.stats.preempting,
+            "skipped": result.stats.skipped,
+            "inadmissible": result.stats.inadmissible,
+        })
+        # Phases laid end-to-end from the cycle start, in the order the
+        # decision path recorded them (snapshot/decide/apply on the host
+        # path; encode/device/apply/finalize on the device path).
+        cursor = ts
+        decide_ts = ts
+        for phase, secs in eng.last_cycle_phases.items():
+            dur = secs * 1e6
+            root.child(f"phase/{phase}", "phase", cursor, dur,
+                       seconds=round(secs, 6))
+            if phase in ("decide", "device"):
+                decide_ts = cursor
+            cursor += dur
+        rationale = buf.by_workload() if buf is not None else {}
+        for e in list(result.entries) + list(result.inadmissible):
+            root.children.append(
+                self._workload_span(e, rationale, decide_ts))
+        return root
+
+    def _workload_span(self, e, rationale: dict, ts: float) -> Span:
+        key = e.info.key
+        attrs = {
+            "decision": _STATUS_TO_DECISION.get(e.status.value,
+                                                e.status.value),
+            "cluster_queue": e.info.cluster_queue,
+        }
+        a = e.assignment
+        if a is not None:
+            flavors = {ps.name: {res: fa.name
+                                 for res, fa in ps.flavors.items()}
+                       for ps in a.pod_sets if ps.flavors}
+            reasons = {ps.name: list(ps.reasons)
+                       for ps in a.pod_sets if ps.reasons}
+            if flavors:
+                attrs["flavors"] = flavors
+            if reasons:
+                attrs["reasons"] = reasons
+            attrs["borrowing"] = a.borrowing
+        if e.preemption_targets:
+            attrs["preemption_chosen"] = sorted(
+                [t.workload.key, t.reason] for t in e.preemption_targets)
+        if e.inadmissible_msg:
+            attrs["message"] = e.inadmissible_msg
+        if e.status.value not in ("assumed", ""):
+            attrs["requeue_reason"] = e.requeue_reason.value
+        if e.commit_position >= 0:
+            attrs["commit_position"] = e.commit_position
+        for kind, ev in rationale.get(key, ()):
+            attrs.setdefault("rationale", []).append(
+                {"kind": kind, **ev})
+        return Span(f"workload/{key}", "workload", ts, 0.0, attrs)
+
+    # -- side channels: metrics, journal correlation, SSE summary --
+
+    def _report(self, root: Span) -> None:
+        eng = self.engine
+        attrs = root.attrs
+        try:
+            reg = eng.registry
+            reg.counter("trace_cycles_total").inc((attrs["mode"],))
+            dec = reg.counter("trace_workload_decisions_total")
+            for s in root.children:
+                if s.kind == "workload":
+                    dec.inc((s.attrs["decision"],))
+        except KeyError:
+            pass  # registry predates the trace families
+        if self.journal_correlation and eng.journal is not None:
+            # The cross-artifact join record: the same cid the flight
+            # recorder stamps on its cycle frame. rebuild_engine skips
+            # unknown kinds, so old engines replay journals with these
+            # records untouched.
+            eng.journal.apply("cycle_trace", {
+                "name": attrs["cid"], "seq": attrs["seq"],
+                "mode": attrs["mode"], "admitted": attrs["admitted"],
+                "preempting": attrs["preempting"]}, ts=eng.clock)
+        if self.emit_events:
+            eng._event(
+                "cycle_trace", "", "",
+                detail=(f"cid={attrs['cid']} mode={attrs['mode']} "
+                        f"admitted={attrs['admitted']} "
+                        f"preempting={attrs['preempting']} "
+                        f"inadmissible={attrs['inadmissible']} "
+                        f"dur_ms={root.dur / 1e3:.3f}"))
+
+    # -- query surface --
+
+    def trees(self) -> list[dict]:
+        """Retained span trees, oldest first (the /debug/trace body)."""
+        return [s.to_dict() for s in self.spans]
+
+    def find_workload(self, key: str):
+        """Newest retained (cycle-span, workload-span) pair for ``key``,
+        or (None, None)."""
+        name = f"workload/{key}"
+        for root in reversed(self.spans):
+            for s in root.children:
+                if s.name == name:
+                    return root, s
+        return None, None
+
+    def detach(self) -> None:
+        for lst, fn in ((self.engine.pre_cycle_hooks, self._pre),
+                        (self.engine.cycle_listeners, self._post)):
+            try:
+                lst.remove(fn)
+            except ValueError:
+                pass
+        if getattr(self.engine, "tracer", None) is self:
+            self.engine.tracer = None
+        hooks.CURRENT = None

@@ -764,7 +764,17 @@ class OracleBridge:
 
         import time as _time
 
+        from kueue_tpu.obs.device import PhaseAnnotator
+
         _t0 = _time.perf_counter()
+        # Named profiler scopes mirroring the phase marks below: a JAX
+        # profiler capture shows kueue_tpu.oracle.{encode,device,apply,
+        # finalize} lined up with the host span tree (no-op unless a
+        # cycle tracer is active). Sequential phase()/close() calls
+        # because this function times phases with perf_counter marks,
+        # not nested blocks; every early return below must close().
+        _ann = PhaseAnnotator()
+        _ann.phase("encode")
         now = eng.clock
         # Incremental encoding: the queue manager's row cache carries the
         # pending world as live tensors; a cycle pays only for rows that
@@ -813,6 +823,7 @@ class OracleBridge:
             active[held] = False
         else:
             # Pathological hold churn: give up on the fast path.
+            _ann.close()
             return self._fallback("held-head-churn")
 
         head_eligible = np.zeros(C, bool)
@@ -934,6 +945,7 @@ class OracleBridge:
         device_w = active & wl.eligible & (wl.cq >= 0) \
             & cq_on_device[cq_safe_idx]
         if not device_w.any():
+            _ann.close()
             return self._fallback("all-host")
 
         # --- device cycle ---
@@ -1023,6 +1035,7 @@ class OracleBridge:
                 slot_maybe=jnp.asarray(self._slot_maybe(
                     w, pcfg, adm, self._head_pri(wl, head_wid))))
         _t_encode = _time.perf_counter()
+        _ann.phase("device")
         out = self.executor.cycle_step(
             dict(pending=pending, inadmissible=inadmissible, usage=usage,
                  **args, **pre_kwargs), statics)
@@ -1085,6 +1098,7 @@ class OracleBridge:
             _vd = _zlib.crc32(np.ascontiguousarray(_arr).tobytes(), _vd)
         self.last_verdict_digest = _vd
         _t_device = _time.perf_counter()
+        _ann.phase("apply")
         apply_rows = device_w & cq_on_device[cq_safe_idx]
         result, finalize = self._apply(
             w, wl, pending_infos,
@@ -1098,6 +1112,7 @@ class OracleBridge:
             head_idx=np.asarray(head_idx),
             preempt_targets=preempt_targets)
         _t_apply = _time.perf_counter()
+        _ann.phase("finalize")
         finalize()
         # North-star phase accounting: encode (snapshot + tensorize) /
         # device (solve incl. transfer) / apply (decode + cache assume,
@@ -1105,6 +1120,7 @@ class OracleBridge:
         # metric + journal writes — the reference's ASYNC status PATCH,
         # scheduler.go:870; still inside this cycle's wall time).
         _t_final = _time.perf_counter()
+        _ann.close()
         phases = {"encode": _t_encode - _t0, "device": _t_device - _t_encode,
                   "apply": _t_apply - _t_device,
                   "finalize": _t_final - _t_apply}

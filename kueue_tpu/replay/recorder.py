@@ -164,10 +164,17 @@ class FlightRecorder:
         verdict = None
         if eng.oracle is not None:
             verdict = getattr(eng.oracle, "last_verdict_digest", None)
+        decisions = canonical_decisions(result)
+        # The cid is a pure function of (seq, decisions) — computed here
+        # independently of any attached tracer, so the frame joins
+        # against journal cycle_trace records and retained span trees
+        # whether or not tracing was on during the recording.
+        from kueue_tpu.obs.span import correlation_id
         self.writer.cycle(
             seq, eng.clock, eng.last_cycle_mode or "sequential",
-            canonical_decisions(result), dict(eng.last_cycle_phases),
-            verdict_digest=verdict)
+            decisions, dict(eng.last_cycle_phases),
+            verdict_digest=verdict,
+            cid=correlation_id(seq, decisions))
 
     def _flush_idle(self) -> None:
         if self._idle:

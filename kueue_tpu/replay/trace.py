@@ -140,7 +140,8 @@ class TraceWriter:
             self._write({"f": "idle", "n": n, "clock": clock})
 
     def cycle(self, seq: int, clock: float, mode: str, decisions: list,
-              phases: dict, verdict_digest: Optional[int] = None) -> None:
+              phases: dict, verdict_digest: Optional[int] = None,
+              cid: Optional[str] = None) -> None:
         self._digest = decision_digest(decisions, self._digest)
         frame = {"f": "cycle", "seq": seq, "clock": clock, "mode": mode,
                  "decisions": decisions,
@@ -148,6 +149,12 @@ class TraceWriter:
                  "phases": {k: round(v, 6) for k, v in phases.items()}}
         if verdict_digest is not None:
             frame["verdict"] = f"{verdict_digest:08x}"
+        if cid is not None:
+            # Correlation id joining this frame to the journal's
+            # cycle_trace record and the tracer's span tree. Carried
+            # OUTSIDE the decision digest (which hashes decisions only):
+            # traced and untraced recordings stay digest-identical.
+            frame["cid"] = cid
         self._write(frame, sync=True)
         self.cycles += 1
 

@@ -298,19 +298,43 @@ class Preemptor:
         self.fs_strategies = fs_strategies or [
             "LessThanOrEqualToFinalShare", "LessThanInitialShare"]
         self.afs_enabled = afs_enabled
+        # Rationale capture (obs.hooks): candidate keys examined during
+        # an explicit get_targets call. None = not collecting — the
+        # flavorassigner's Oracle simulations go through _get_targets
+        # directly and stay silent, so the emitted event reflects the
+        # REAL target search, not per-flavor what-ifs.
+        self._considered: Optional[list[str]] = None
 
     def get_targets(self, wl: WorkloadInfo, assignment: Assignment,
                     snapshot: Snapshot, now: float = 0.0) -> list[Target]:
         """preemption.go:129 (GetTargets)."""
+        from kueue_tpu.obs import hooks as _obs
+
         cq = snapshot.cluster_queue(wl.cluster_queue)
-        return self._get_targets(PreemptionCtx(
-            preemptor=wl,
-            preemptor_cq=cq,
-            snapshot=snapshot,
-            workload_usage=assignment.total_requests_for(wl),
-            frs_need_preemption=flavor_resources_need_preemption(assignment),
-            now=now,
-        ))
+        tracing = _obs.CURRENT is not None
+        if tracing:
+            self._considered = []
+        try:
+            targets = self._get_targets(PreemptionCtx(
+                preemptor=wl,
+                preemptor_cq=cq,
+                snapshot=snapshot,
+                workload_usage=assignment.total_requests_for(wl),
+                frs_need_preemption=flavor_resources_need_preemption(
+                    assignment),
+                now=now,
+            ))
+        finally:
+            considered, self._considered = self._considered, None
+        if tracing:
+            _obs.emit(
+                "preemption", wl.key,
+                strategy=("fair" if self.enable_fair_sharing
+                          else "classical"),
+                considered=sorted(set(considered or ())),
+                chosen=sorted([t.workload.key, t.reason]
+                              for t in targets))
+        return targets
 
     def _get_targets(self, ctx: PreemptionCtx) -> list[Target]:
         if self.enable_fair_sharing:
@@ -341,6 +365,8 @@ class Preemptor:
                 cand, reason = gen.next(allow_borrowing)
                 if cand is None:
                     break
+                if self._considered is not None:
+                    self._considered.append(cand.key)
                 ctx.snapshot.remove_workload(cand)
                 targets.append(Target(cand, reason))
                 if _workload_fits(ctx, allow_borrowing):
@@ -355,6 +381,8 @@ class Preemptor:
     def _fair_preemptions(self, ctx: PreemptionCtx) -> list[Target]:
         """preemption.go:491 (fairPreemptions)."""
         candidates = self._find_candidates(ctx)
+        if self._considered is not None:
+            self._considered.extend(w.key for w in candidates)
         if not candidates:
             return []
         candidates.sort(key=lambda c: candidates_ordering_key(

@@ -24,6 +24,11 @@ Environment:
   KUEUE_TPU_FAULT          fault-injection spec (--fault), e.g.
                            "sigkill@admission:40" — the live-smoke side
                            of the replay/faults.py crash matrix
+  KUEUE_TPU_TRACE          admission tracing (--trace): attach the
+                           obs.CycleTracer — span trees at /debug/trace,
+                           cycle summaries on /events, kueuectl explain
+                           / trace export. Value is the span retention
+                           ring size ("on"/"1"/empty mean the default)
 """
 
 from __future__ import annotations
@@ -53,6 +58,8 @@ def main(argv=None) -> None:
                         default=os.environ.get("KUEUE_TPU_RECORD"))
     parser.add_argument("--fault",
                         default=os.environ.get("KUEUE_TPU_FAULT"))
+    parser.add_argument("--trace", nargs="?", const="on",
+                        default=os.environ.get("KUEUE_TPU_TRACE"))
     args = parser.parse_args(argv)
 
     from kueue_tpu.store.journal import rebuild_engine
@@ -77,6 +84,13 @@ def main(argv=None) -> None:
     if args.fault:
         from kueue_tpu.replay.faults import arm_faults
         arm_faults(eng, args.fault)
+    if args.trace:
+        # Admission tracing: passive span trees over every cycle
+        # (obs.CycleTracer). The flag value doubles as the retention
+        # ring size; "on"/"true"/"1" keep the default.
+        retain = (int(args.trace) if args.trace.isdigit()
+                  and int(args.trace) > 1 else 64)
+        eng.attach_tracer(retain=retain)
 
     host, _, port = args.http.rpartition(":")
     endpoint = ServingEndpoint(

@@ -19,6 +19,7 @@ from kueue_tpu.visibility.server import (
     dump_state,
     eviction_summary,
     oracle_stats,
+    trace_summary,
 )
 
 
@@ -197,6 +198,11 @@ def make_handler(engine, auth_token=None, apf=None,
                     else {"enabled": False}))
             elif path == "/debug/dump":
                 self._send(json.dumps(dump_state(engine), indent=2))
+            elif path == "/debug/trace":
+                # Last-N retained span trees (obs.CycleTracer ring);
+                # same race discipline as the other live views.
+                self._send_view("trace", trace_summary,
+                                empty='{"enabled": false, "cycles": []}')
             elif path == "/capacity":
                 self._send_view("capacity", capacity_summary)
             elif path == "/cohorts":

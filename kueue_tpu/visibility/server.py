@@ -171,3 +171,17 @@ def dump_state(engine) -> dict:
             "lastCyclePhases": dict(engine.last_cycle_phases),
             "unadmittedByReason": {
                 "/".join(k): v for k, v in engine.unadmitted.per_cq.items()}}
+
+
+def trace_summary(engine) -> dict:
+    """The /debug/trace body: retained per-cycle span trees from the
+    attached CycleTracer (obs/), oldest first, plus enough envelope for
+    a client to know what it is looking at."""
+    tracer = getattr(engine, "tracer", None)
+    if tracer is None:
+        return {"enabled": False, "cycles": []}
+    return {"enabled": True,
+            "retain": tracer.retain,
+            "cyclesTraced": tracer.cycles_traced,
+            "lastCid": tracer.last_cid,
+            "cycles": tracer.trees()}

@@ -1,0 +1,152 @@
+"""/metrics end-to-end: scrape the serving endpoint and validate every
+line with the tools/promcheck text-format parser (HELP/TYPE pairing,
+label-value escaping, histogram bucket invariants), plus the registry
+fixes promcheck exists to guard (label escaping, quantile zero-total,
+reset)."""
+
+import os
+import sys
+import urllib.request
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.metrics.registry import MetricsRegistry, _esc, _fmt
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+from promcheck import _parse_sample, check_exposition  # noqa: E402
+
+CPU = "cpu"
+
+
+def make_engine():
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(1000)}),)),),
+    ))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng
+
+
+def submit(eng, name, cpu):
+    eng.clock += 0.5
+    eng.submit(Workload(name=name, queue_name="lq",
+                        pod_sets=(PodSet("main", 1, {CPU: cpu}),)))
+
+
+class TestEndToEndScrape:
+    def test_scrape_validates_and_carries_families(self):
+        from kueue_tpu.visibility.http_server import ServingEndpoint
+
+        eng = make_engine()
+        eng.attach_tracer()
+        submit(eng, "a", 600)
+        submit(eng, "b", 600)
+        for _ in range(5):
+            if eng.schedule_once() is None:
+                break
+        ep = ServingEndpoint(eng, port=0)
+        ep.start()
+        try:
+            url = f"http://127.0.0.1:{ep.port}/metrics"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                assert r.headers.get("Content-Type", "").startswith(
+                    "text/plain")
+                text = r.read().decode()
+        finally:
+            ep.stop()
+        assert check_exposition(text) == []
+        for family in ("kueue_tpu_admitted_workloads_total",
+                       "kueue_tpu_admission_attempt_duration_seconds",
+                       "kueue_tpu_pending_workloads",
+                       "kueue_tpu_trace_cycles_total"):
+            assert f"# TYPE {family} " in text
+        assert 'kueue_tpu_trace_cycles_total{label_0="sequential"}' \
+            in text
+
+
+class TestLabelEscaping:
+    def test_render_escapes_hostile_label_values(self):
+        reg = MetricsRegistry()
+        hostile = 'cq"quoted\\back\nslashed'
+        reg.counter("admitted_workloads_total").inc((hostile,))
+        text = reg.render()
+        assert check_exposition(text) == []
+        line = next(ln for ln in text.split("\n")
+                    if ln.startswith("kueue_tpu_admitted_workloads_total{"))
+        assert '\\"quoted' in line and "\\\\back" in line \
+            and "\\nslashed" in line
+        # Round-trip: the parser recovers the original value.
+        errors: list = []
+        name, labels, value = _parse_sample(line, 1, errors)
+        assert errors == []
+        assert dict(labels)["label_0"] == hostile
+        assert value == 1.0
+
+    def test_esc_and_fmt_units(self):
+        assert _esc('a"b') == 'a\\"b'
+        assert _esc("a\\b") == "a\\\\b"
+        assert _esc("a\nb") == "a\\nb"
+        assert _fmt((("cq", 'x"y'),)) == '{cq="x\\"y"}'
+
+    def test_named_pair_labels_escaped_too(self):
+        reg = MetricsRegistry()
+        reg.gauge("cluster_queue_info").set((("cohort", 'co"ho\nrt'),), 1)
+        assert check_exposition(reg.render()) == []
+
+
+class TestHistogram:
+    def test_quantile_zero_total_returns_zero(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("admission_attempt_duration_seconds")
+        assert h.quantile(0.5, ("success",)) == 0.0
+        # The race-visible shape: counts row exists, totals not yet
+        # incremented — still 0.0, not buckets[0].
+        h.counts[("success",)] = [0] * (len(h.buckets) + 1)
+        assert h.quantile(0.5, ("success",)) == 0.0
+
+    def test_quantile_after_observations(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("admission_attempt_duration_seconds")
+        for v in (0.002, 0.002, 0.002, 0.4):
+            h.observe(v, ("success",))
+        assert h.quantile(0.5, ("success",)) == 0.005  # upper bound
+        assert h.quantile(1.0, ("success",)) == 0.5
+
+    def test_reset_one_series_and_all(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("admission_attempt_duration_seconds")
+        h.observe(0.1, ("success",))
+        h.observe(0.1, ("error",))
+        h.reset(("success",))
+        assert h.totals.get(("success",), 0) == 0
+        assert h.totals[("error",)] == 1
+        h.reset()
+        assert not h.counts and not h.sums and not h.totals
+        assert h.quantile(0.5, ("error",)) == 0.0
+
+    def test_inf_bucket_rendered_and_equals_count(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("admission_attempt_duration_seconds")
+        for v in (0.002, 5000.0):  # one beyond the last finite bucket
+            h.observe(v, ("success",))
+        text = reg.render()
+        assert check_exposition(text) == []
+        inf_line = next(
+            ln for ln in text.split("\n")
+            if ln.startswith(
+                "kueue_tpu_admission_attempt_duration_seconds_bucket")
+            and 'le="+Inf"' in ln)
+        assert inf_line.endswith(" 2")

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""obs-smoke: the end-to-end observability check behind
+``make obs-smoke``.
+
+Runs the full observability surface in one process: an engine with the
+CycleTracer attached and the serving endpoint up, a 50-workload
+admission scenario driven to quiescence, then
+
+  * scrapes /metrics over HTTP and validates every line with
+    tools/promcheck (HELP/TYPE pairing, label escaping, histogram
+    bucket invariants);
+  * exports the retained span trees as Perfetto trace-event JSON and
+    validates the file with tools/trace_schema;
+  * fetches /debug/trace and checks the span-tree view is live;
+  * runs ``explain`` against a still-pending workload and checks the
+    report carries per-flavor rejection reasons.
+
+Exits non-zero on the first failure.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from promcheck import check_exposition  # noqa: E402
+from trace_schema import check_trace_events  # noqa: E402
+
+
+def fail(msg: str) -> int:
+    print(f"obs-smoke FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    from kueue_tpu.bench.scenario import baseline_like
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.obs import write_perfetto
+    from kueue_tpu.visibility.http_server import ServingEndpoint
+
+    eng = Engine()
+    tracer = eng.attach_tracer(retain=256)
+    endpoint = ServingEndpoint(eng, port=0)
+    endpoint.start()
+    base = f"http://127.0.0.1:{endpoint.port}"
+
+    try:
+        # Undersized quota (sized_to_fit=False) so the drain leaves a
+        # pending tail — explain below needs a genuinely pending
+        # workload with rejection reasons.
+        scen = baseline_like(n_cohorts=2, cqs_per_cohort=2,
+                             n_workloads=50, nominal_per_cq=20_000,
+                             sized_to_fit=False)
+        for rf in scen.flavors:
+            eng.create_resource_flavor(rf)
+        for co in scen.cohorts:
+            eng.create_cohort(co)
+        for cq in scen.cluster_queues:
+            eng.create_cluster_queue(cq)
+        for lq in scen.local_queues:
+            eng.create_local_queue(lq)
+        for wl in scen.workloads:
+            eng.clock += 0.001
+            eng.submit(wl)
+        for _ in range(200):
+            if eng.schedule_once() is None:
+                break
+
+        admitted = sum(1 for w in eng.workloads.values()
+                       if w.is_admitted)
+        pending = sorted(k for k, w in eng.workloads.items()
+                         if not w.is_admitted and not w.is_finished)
+        print(f"scenario: {admitted} admitted, {len(pending)} pending, "
+              f"{len(tracer.spans)} cycle span trees retained")
+        if admitted == 0:
+            return fail("nothing admitted")
+        if not tracer.spans:
+            return fail("tracer retained no span trees")
+
+        # 1. /metrics end-to-end through promcheck.
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        errors = check_exposition(text)
+        if errors:
+            for e in errors[:20]:
+                print(f"  {e}", file=sys.stderr)
+            return fail(f"/metrics failed promcheck "
+                        f"({len(errors)} error(s))")
+        lines = sum(1 for ln in text.split("\n")
+                    if ln.strip() and not ln.startswith("#"))
+        print(f"/metrics OK ({lines} samples, promcheck clean)")
+
+        # 2. Perfetto export validates.
+        out = os.path.join(tempfile.mkdtemp(prefix="obs-smoke-"),
+                           "trace.json")
+        n = write_perfetto(list(tracer.spans), out)
+        with open(out, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        errors = check_trace_events(doc)
+        if errors:
+            for e in errors[:20]:
+                print(f"  {e}", file=sys.stderr)
+            return fail(f"perfetto export failed trace_schema "
+                        f"({len(errors)} error(s))")
+        print(f"perfetto export OK ({n} events -> {out})")
+
+        # 3. /debug/trace is live.
+        with urllib.request.urlopen(f"{base}/debug/trace",
+                                    timeout=10) as r:
+            view = json.load(r)
+        if not view.get("enabled") or not view.get("cycles"):
+            return fail(f"/debug/trace not live: {str(view)[:120]}")
+        print(f"/debug/trace OK ({view['cyclesTraced']} cycles traced, "
+              f"last cid {view['lastCid']})")
+
+        # 4. explain on a pending workload reports rejection reasons.
+        if pending:
+            from kueue_tpu.obs import explain_workload, render_explain
+            report = explain_workload(eng, pending[0])
+            rendered = render_explain(report)
+            probe = report.get("probe") or {}
+            if not probe.get("reasons") and not probe.get("message"):
+                print(rendered, file=sys.stderr)
+                return fail(f"explain({pending[0]}) carries no "
+                            "rejection reasons")
+            print(f"explain OK ({pending[0]}: verdict "
+                  f"{probe.get('verdict')!r}, "
+                  f"{sum(len(v) for v in probe.get('reasons', {}).values())}"
+                  " rejection reason(s))")
+    finally:
+        endpoint.stop()
+
+    print("obs-smoke OK: metrics scrape, perfetto export, /debug/trace "
+          "and explain all validate")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

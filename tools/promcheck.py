@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""promcheck: Prometheus text-exposition-format validator.
+
+A strict line-level parser for the format /metrics serves
+(metrics/registry.py render()), used by the obs smoke and the endpoint
+tests to prove every emitted line is actually scrapeable:
+
+  * every sample line parses (metric name, label pairs, float value);
+  * label values use only the legal escapes (``\\\\``, ``\\"``,
+    ``\\n``) — the registry._fmt escaping bug class;
+  * every sample belongs to a family declared with BOTH ``# HELP`` and
+    ``# TYPE`` (histogram samples match their family via the
+    ``_bucket``/``_sum``/``_count`` suffixes);
+  * histogram series are well-formed: ``le`` parses as a float,
+    cumulative bucket counts are monotone non-decreasing in ``le``
+    order, the mandatory ``le="+Inf"`` bucket exists and equals
+    ``_count``.
+
+Library surface: ``check_exposition(text) -> list[str]`` (empty list ==
+valid). CLI: reads a file (or stdin with ``-``), prints errors, exits
+non-zero on any.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+_NAME = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+_ESCAPES = {"\\", '"', "n"}
+
+
+def _parse_labels(line: str, i: int, lineno: int,
+                  errors: list) -> tuple[list, int]:
+    """Parse ``{name="value",...}`` starting at the ``{``; returns
+    (pairs, index-after-``}``). Appends to ``errors`` and bails to end
+    of line on malformed input."""
+    pairs: list = []
+    i += 1
+    while i < len(line):
+        if line[i] == "}":
+            return pairs, i + 1
+        m = _NAME.match(line, i)
+        if m is None:
+            errors.append(f"line {lineno}: bad label name at col {i}")
+            return pairs, len(line)
+        label = m.group(0)
+        i = m.end()
+        if i >= len(line) or line[i] != "=":
+            errors.append(f"line {lineno}: expected '=' after label "
+                          f"{label!r}")
+            return pairs, len(line)
+        i += 1
+        if i >= len(line) or line[i] != '"':
+            errors.append(f"line {lineno}: label {label!r} value must "
+                          "be double-quoted")
+            return pairs, len(line)
+        i += 1
+        val = []
+        closed = False
+        while i < len(line):
+            c = line[i]
+            if c == "\\":
+                if i + 1 >= len(line) or line[i + 1] not in _ESCAPES:
+                    errors.append(
+                        f"line {lineno}: label {label!r} has illegal "
+                        f"escape \\{line[i + 1:i + 2]}")
+                    return pairs, len(line)
+                val.append({"n": "\n"}.get(line[i + 1], line[i + 1]))
+                i += 2
+                continue
+            if c == '"':
+                closed = True
+                i += 1
+                break
+            if c == "\n":
+                break
+            val.append(c)
+            i += 1
+        if not closed:
+            errors.append(f"line {lineno}: unterminated value for "
+                          f"label {label!r} (raw newline or EOL — "
+                          "needs \\n escaping)")
+            return pairs, len(line)
+        pairs.append((label, "".join(val)))
+        if i < len(line) and line[i] == ",":
+            i += 1
+    errors.append(f"line {lineno}: unterminated label set (missing '}}')")
+    return pairs, len(line)
+
+
+def _parse_sample(line: str, lineno: int, errors: list):
+    """Returns (name, labels tuple, value) or None on parse failure."""
+    m = _NAME.match(line)
+    if m is None:
+        errors.append(f"line {lineno}: bad metric name: {line[:40]!r}")
+        return None
+    name = m.group(0)
+    i = m.end()
+    labels: list = []
+    if i < len(line) and line[i] == "{":
+        labels, i = _parse_labels(line, i, lineno, errors)
+    rest = line[i:].strip()
+    if not rest:
+        errors.append(f"line {lineno}: missing value for {name}")
+        return None
+    # value [timestamp] — we only require the value to parse
+    try:
+        value = float(rest.split()[0])
+    except ValueError:
+        errors.append(f"line {lineno}: unparseable value "
+                      f"{rest.split()[0]!r} for {name}")
+        return None
+    return name, tuple(labels), value
+
+
+def _family_of(name: str, types: dict) -> str | None:
+    """The declared family a sample belongs to, honoring histogram /
+    summary suffix conventions."""
+    if name in types:
+        return name
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if types.get(base) in ("histogram", "summary"):
+                if suffix == "_bucket" and types[base] == "summary":
+                    break
+                return base
+    return None
+
+
+def check_exposition(text: str) -> list:
+    """Validate a /metrics payload; returns a list of error strings
+    (empty == valid)."""
+    errors: list = []
+    helps: dict = {}
+    types: dict = {}
+    samples: list = []
+    for lineno, line in enumerate(text.split("\n"), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line[len("# HELP "):].split(" ", 1)
+            if not parts or not _NAME.fullmatch(parts[0]):
+                errors.append(f"line {lineno}: malformed HELP")
+                continue
+            if parts[0] in helps:
+                errors.append(f"line {lineno}: duplicate HELP for "
+                              f"{parts[0]}")
+            helps[parts[0]] = parts[1] if len(parts) > 1 else ""
+            continue
+        if line.startswith("# TYPE "):
+            parts = line[len("# TYPE "):].split()
+            if len(parts) != 2 or not _NAME.fullmatch(parts[0]):
+                errors.append(f"line {lineno}: malformed TYPE")
+                continue
+            if parts[1] not in _TYPES:
+                errors.append(f"line {lineno}: unknown type "
+                              f"{parts[1]!r} for {parts[0]}")
+            if parts[0] not in helps:
+                errors.append(f"line {lineno}: TYPE {parts[0]} "
+                              "without preceding HELP")
+            if parts[0] in types:
+                errors.append(f"line {lineno}: duplicate TYPE for "
+                              f"{parts[0]}")
+            types[parts[0]] = parts[1]
+            continue
+        if line.startswith("#"):
+            continue  # free-form comment
+        parsed = _parse_sample(line, lineno, errors)
+        if parsed is not None:
+            samples.append((lineno,) + parsed)
+
+    seen: set = set()
+    for lineno, name, labels, value in samples:
+        fam = _family_of(name, types)
+        if fam is None:
+            errors.append(f"line {lineno}: sample {name} has no "
+                          "# TYPE declaration")
+        key = (name, labels)
+        if key in seen:
+            errors.append(f"line {lineno}: duplicate sample "
+                          f"{name}{dict(labels)}")
+        seen.add(key)
+
+    _check_histograms(samples, types, errors)
+    return errors
+
+
+def _check_histograms(samples: list, types: dict, errors: list) -> None:
+    series: dict = {}  # (family, non-le labels) -> {"buckets":[], ...}
+    for lineno, name, labels, value in samples:
+        fam = _family_of(name, types)
+        if fam is None or types.get(fam) != "histogram":
+            continue
+        if name.endswith("_bucket"):
+            le = dict(labels).get("le")
+            rest = tuple(p for p in labels if p[0] != "le")
+            s = series.setdefault((fam, rest), {"buckets": [],
+                                                "count": None})
+            if le is None:
+                errors.append(f"line {lineno}: {name} bucket without "
+                              "le label")
+                continue
+            try:
+                bound = float("inf") if le == "+Inf" else float(le)
+            except ValueError:
+                errors.append(f"line {lineno}: {name} unparseable "
+                              f"le={le!r}")
+                continue
+            s["buckets"].append((bound, value, lineno))
+        elif name.endswith("_count"):
+            series.setdefault((fam, tuple(labels)),
+                              {"buckets": [], "count": None})[
+                                  "count"] = value
+    for (fam, labels), s in series.items():
+        buckets = sorted(s["buckets"])
+        prev = -1.0
+        for bound, value, lineno in buckets:
+            if value < prev:
+                errors.append(
+                    f"line {lineno}: {fam}_bucket{dict(labels)} not "
+                    f"cumulative: le={bound} count {value} < {prev}")
+            prev = value
+        if not buckets or buckets[-1][0] != float("inf"):
+            errors.append(f"{fam}{dict(labels)}: missing mandatory "
+                          'le="+Inf" bucket')
+        elif s["count"] is not None and buckets[-1][1] != s["count"]:
+            errors.append(
+                f"{fam}{dict(labels)}: +Inf bucket {buckets[-1][1]} "
+                f"!= _count {s['count']}")
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print("usage: promcheck.py <metrics.txt | ->", file=sys.stderr)
+        return 2
+    text = (sys.stdin.read() if argv[1] == "-"
+            else open(argv[1], encoding="utf-8").read())
+    errors = check_exposition(text)
+    for e in errors:
+        print(e, file=sys.stderr)
+    if errors:
+        print(f"promcheck: {len(errors)} error(s)", file=sys.stderr)
+        return 1
+    print("promcheck OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""trace_schema: Chrome/Perfetto trace-event JSON checker.
+
+Validates the subset of the Trace Event Format that
+``kueuectl trace export`` (obs/perfetto.py) emits, strictly enough that
+a file passing here loads in ui.perfetto.dev / chrome://tracing:
+
+  * top level is ``{"traceEvents": [...]}`` (the JSON Object Format);
+  * every event is an object with a string ``name`` and a known phase
+    (``X`` complete, ``i`` instant, ``M`` metadata);
+  * ``pid``/``tid`` are integers;
+  * timed events carry numeric ``ts`` >= 0 (microseconds), ``X`` events
+    a numeric ``dur`` >= 0, ``i`` events a valid scope ``s`` when
+    present;
+  * ``args`` when present is an object.
+
+Library surface: ``check_trace_events(obj) -> list[str]`` (empty ==
+valid). CLI: reads a JSON file, prints errors, exits non-zero on any.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+_PHASES = {"X", "i", "M"}
+_SCOPES = {"g", "p", "t"}
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def check_trace_events(obj) -> list:
+    """Validate a parsed trace-event JSON document; returns a list of
+    error strings (empty == valid)."""
+    errors: list = []
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        return ['top level must be an object with a "traceEvents" key']
+    events = obj["traceEvents"]
+    if not isinstance(events, list):
+        return ['"traceEvents" must be a list']
+    for i, ev in enumerate(events):
+        at = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{at}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _PHASES:
+            errors.append(f"{at}: unknown phase {ph!r} "
+                          f"(expected one of {sorted(_PHASES)})")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            errors.append(f"{at}: missing/empty string name")
+        for field in ("pid", "tid"):
+            if field in ev and (not isinstance(ev[field], int)
+                                or isinstance(ev[field], bool)):
+                errors.append(f"{at}: {field} must be an integer, "
+                              f"got {ev[field]!r}")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            errors.append(f"{at}: args must be an object")
+        if ph == "M":
+            continue  # metadata events carry no timestamps
+        if not _num(ev.get("ts")) or ev["ts"] < 0:
+            errors.append(f"{at}: {ph!r} event needs numeric ts >= 0, "
+                          f"got {ev.get('ts')!r}")
+        if ph == "X" and (not _num(ev.get("dur")) or ev["dur"] < 0):
+            errors.append(f"{at}: complete event needs numeric "
+                          f"dur >= 0, got {ev.get('dur')!r}")
+        if ph == "i" and "s" in ev and ev["s"] not in _SCOPES:
+            errors.append(f"{at}: instant scope {ev['s']!r} not in "
+                          f"{sorted(_SCOPES)}")
+    return errors
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print("usage: trace_schema.py <trace.json | ->",
+              file=sys.stderr)
+        return 2
+    text = (sys.stdin.read() if argv[1] == "-"
+            else open(argv[1], encoding="utf-8").read())
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError as e:
+        print(f"trace_schema: not JSON: {e}", file=sys.stderr)
+        return 1
+    errors = check_trace_events(obj)
+    for e in errors:
+        print(e, file=sys.stderr)
+    if errors:
+        print(f"trace_schema: {len(errors)} error(s)", file=sys.stderr)
+        return 1
+    n = len(obj["traceEvents"])
+    print(f"trace_schema OK ({n} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
