@@ -6,7 +6,7 @@ PY ?= python
 PYTEST_FLAGS ?= -q
 
 .PHONY: all native test test-fast test-device bench multichip-dryrun \
-  replay-smoke obs-smoke clean
+  replay-smoke obs-smoke lint clean
 
 all: native
 
@@ -42,15 +42,23 @@ bench:
 bench-fast:
 	KUEUE_TPU_BENCH_FAST=1 $(PY) bench.py
 
+# Static analysis: the graftlint AST rules (D1/J1/U1/O1/R1) over the
+# package plus the in-process emitter/validator self-check (V1/V2).
+# One entry point, one exit code, one JSON report (--json FILE).
+lint:
+	JAX_PLATFORMS=cpu $(PY) -m tools.graftlint kueue_tpu/ --self-check
+
 # Flight-recorder determinism smoke: record a 50-workload scenario,
 # replay it twice, diff the decision-stream checksums (replay/).
-replay-smoke:
+# lint runs first: replaying a tree that violates D1 proves nothing.
+replay-smoke: lint
 	JAX_PLATFORMS=cpu $(PY) tools/replay_smoke.py
 
 # Observability smoke: tracer + serving endpoint, 50-workload admit,
 # /metrics scrape validated by tools/promcheck, Perfetto export
 # validated by tools/trace_schema, /debug/trace + explain (obs/).
-obs-smoke:
+# lint runs first: O1 violations invalidate digest-neutrality claims.
+obs-smoke: lint
 	JAX_PLATFORMS=cpu $(PY) tools/obs_smoke.py
 
 # Validate the multi-chip sharding compiles + executes on a virtual mesh.

@@ -477,6 +477,15 @@ def build_parser() -> argparse.ArgumentParser:
     exp.add_argument("--no-probe", action="store_true",
                      help="skip the live one-shot nomination probe")
 
+    # Parsed in main() before engine construction; registered here so
+    # `kueuectl --help` lists it.
+    lint = sub.add_parser(
+        "lint",
+        help="run the graftlint static analyzer (tools/graftlint) over "
+             "the package; extra args pass through (--explain RULE, "
+             "--json FILE, paths)")
+    lint.add_argument("lint_args", nargs=argparse.REMAINDER)
+
     tr = sub.add_parser(
         "trace", help="span-tree operations (obs/)")
     trs = tr.add_subparsers(dest="trace_command")
@@ -501,6 +510,24 @@ def main(argv=None) -> None:
     import sys
 
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "lint":
+        # Passthrough to the static analyzer — no engine/journal needed.
+        # graftlint ships in the repo's tools/ tree, not the installed
+        # package, so degrade gracefully outside a checkout.
+        try:
+            from tools.graftlint.cli import main as lint_main
+            from tools.graftlint.config import Config
+        except ImportError:
+            raise SystemExit(
+                "kueuectl lint requires the repository checkout "
+                "(tools/graftlint is not part of the installed package)")
+        import os
+
+        rest = argv[1:]
+        if not any(not a.startswith("-") for a in rest):
+            rest = [os.path.join(Config().root, "kueue_tpu"),
+                    "--self-check"] + rest
+        raise SystemExit(lint_main(rest))
     journal = None
     if "--journal" in argv:
         i = argv.index("--journal")

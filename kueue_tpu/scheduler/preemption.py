@@ -661,7 +661,7 @@ def _restore(snapshot: Snapshot, targets: list[Target]) -> None:
 
 def _queue_under_nominal(ctx: PreemptionCtx) -> bool:
     """preemption.go:654 (queueUnderNominalInResourcesNeedingPreemption)."""
-    for fr in ctx.frs_need_preemption:
+    for fr in sorted(ctx.frs_need_preemption):
         if (ctx.preemptor_cq.quota_for(fr).nominal
                 <= ctx.preemptor_cq.node.usage.get(fr, 0)):
             return False

@@ -372,6 +372,14 @@ _CREATE = {
     "node": "create_node",
 }
 
+# Kinds that are journaled for offline analysis but carry no engine
+# state: rebuild_engine skips them BY DESIGN, not by omission. The
+# tracer's per-cycle correlation record lands here — replaying it would
+# double-apply nothing (it is pure rationale), and dropping it loses no
+# admission. Every other emitted kind must have a _CREATE entry or an
+# explicit special case above; graftlint rule R1 enforces the union.
+EPHEMERAL_KINDS = frozenset({"cycle_trace"})
+
 
 def rebuild_engine(path: str, engine=None, attach_oracle: bool = False,
                    **engine_kwargs):

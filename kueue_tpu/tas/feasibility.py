@@ -106,7 +106,11 @@ def collect_requests(wl, cq_snapshot):
     if getattr(wl.obj.status, "unhealthy_nodes", ()):
         return []
     out = []
-    for snap in set(cq_snapshot.tas_flavors.values()):
+    # Identity dedup in tas_flavors insertion order: several flavor
+    # names can share a forest, and set() iteration order would vary
+    # run-to-run (D1 — launch order feeds the decision digest).
+    for snap in {id(s): s for s in
+                 cq_snapshot.tas_flavors.values()}.values():
         for i, ps in enumerate(wl.obj.pod_sets):
             single = wl.total_requests[i].single_pod_requests()
             params = _qualify(snap, ps, single, ps.count)

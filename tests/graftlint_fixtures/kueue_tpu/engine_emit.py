@@ -1,0 +1,8 @@
+"""Fixture emit sites: three handled journal kinds, one unhandled."""
+
+
+def persist(journal, obj):
+    journal.apply("node", obj)
+    journal.apply("workload", obj)
+    journal.apply("pod_group", obj)
+    journal.delete("cluster_queue", "default/main")

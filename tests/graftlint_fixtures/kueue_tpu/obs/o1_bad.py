@@ -1,0 +1,13 @@
+"""Planted O1 violations: obs code driving/mutating the engine."""
+
+
+class Probe:
+    def __init__(self, engine):
+        self.engine = engine
+        engine.tracer = self
+
+    def on_cycle(self, eng, snap, result):
+        eng.schedule_once()
+        snap.add_usage({}, {}, 1)
+        eng.journal.apply("cycle_trace", {"seq": result.seq})
+        eng.paused = True

@@ -1,0 +1,17 @@
+"""Write-only observability: lifecycle attach/detach plus append-only
+rationale buffers."""
+
+
+class Tracer:
+    def __init__(self, engine):
+        self.engine = engine
+        self.buf = []
+        engine.tracer = self
+
+    def detach(self):
+        self.engine.tracer = None
+
+    def on_cycle(self, seq, result):
+        self.buf.append((seq, result.admitted))
+        rationale = [r.reason for r in result.rejections]
+        self.buf.extend(rationale)

@@ -1,0 +1,34 @@
+"""Planted J1 violations inside jit roots. Test data, never run."""
+from functools import partial
+
+import jax
+import jax.experimental.pallas as pl
+
+_CACHE = {}
+_COUNT = 0
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def step(usage, quota, depth):
+    print(usage)
+    if usage > 0:
+        usage = usage + 1
+    _CACHE["last"] = usage
+    while quota > 0:
+        quota = quota - 1
+    return usage
+
+
+@jax.jit
+def bump(x):
+    global _COUNT
+    return x
+
+
+def _kernel(x_ref, o_ref):
+    print("traced")
+    o_ref[...] = x_ref[...]
+
+
+def launch(x):
+    return pl.pallas_call(_kernel, out_shape=x)(x)

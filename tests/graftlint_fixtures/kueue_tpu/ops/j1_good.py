@@ -1,0 +1,28 @@
+"""Jit-pure idioms: static branching, range loops, data-dependent
+selection via jnp.where. Test data, never run."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("depth", "wide"))
+def step(usage, quota, depth, wide):
+    if depth > 2:
+        usage = usage + 1
+    if wide and usage.shape[0] > 4:
+        quota = quota + 1
+    if quota is None:
+        return usage
+    for lvl in range(depth):
+        usage = jnp.where(usage > quota, usage - lvl, quota)
+    picks = {lvl: lvl * 2 for lvl in range(depth)}
+    for lvl in range(depth):
+        if lvl in picks:
+            usage = usage + picks[lvl]
+    return usage
+
+
+def helper(x):
+    print(x)
+    return x

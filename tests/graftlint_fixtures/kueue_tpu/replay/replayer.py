@@ -1,0 +1,10 @@
+"""Fixture frame dispatcher: handles header/cycle/end only."""
+
+
+def dispatch(frame):
+    f = frame["f"]
+    if f == "header":
+        return "header"
+    if f in ("cycle", "end"):
+        return "timed"
+    raise ValueError(f"unknown frame {f!r}")

@@ -1,0 +1,13 @@
+"""Fixture trace writer: two dispatched frame kinds, one rogue."""
+
+
+def write_header(fh):
+    fh.write({"f": "header", "v": 1})
+
+
+def write_cycle(fh, seq):
+    fh.write({"f": "cycle", "seq": seq})
+
+
+def write_rogue(fh):
+    fh.write({"f": "rogue"})

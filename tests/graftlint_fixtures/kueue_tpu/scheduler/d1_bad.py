@@ -1,0 +1,21 @@
+"""Planted D1 violations (decision-core zone). Test data, never run."""
+import time
+import random as rnd
+from os import urandom as entropy
+
+
+def pick_heads(queues: set, pending):
+    for q in queues:
+        pending.append(q)
+    deadline = time.time() + 5
+    jitter = rnd.random()
+    seed = entropy(8)
+    return deadline, jitter, seed
+
+
+def order_candidates(cands, by_name):
+    cands.sort(key=lambda c: (c.prio, id(c)))
+    out = []
+    for name in by_name.keys():
+        out.append(name)
+    return out

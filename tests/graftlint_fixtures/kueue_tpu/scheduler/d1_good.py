@@ -1,0 +1,21 @@
+"""The sanctioned deterministic idioms. Test data, never run."""
+
+
+def pick_heads(queues: set, pending, clock):
+    for q in sorted(queues):
+        pending.append(q)
+    busy = any(q.active for q in queues)
+    deadline = clock + 5
+    return busy, deadline
+
+
+def dedup_flavors(flavors):
+    out = []
+    for snap in {id(s): s for s in flavors.values()}.values():
+        out.append(snap)
+    return out
+
+
+def order_candidates(cands, by_name):
+    cands.sort(key=lambda c: (c.prio, c.name))
+    return [by_name[k] for k in sorted(by_name)]

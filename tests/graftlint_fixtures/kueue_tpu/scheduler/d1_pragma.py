@@ -1,0 +1,11 @@
+"""Pragma behavior: justified suppression vs empty-reason error."""
+import time
+
+
+def timed_ok():
+    # graftlint: allow[D1] smoke-only phase timing, digest-neutral
+    return time.time()
+
+
+def timed_bad():
+    return time.time()  # graftlint: allow[D1]

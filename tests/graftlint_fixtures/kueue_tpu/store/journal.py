@@ -1,0 +1,20 @@
+"""Fixture journal handler file: _CREATE dispatch, a special case, and
+an ephemeral declaration — the three ways a kind counts as handled."""
+
+_CREATE = {
+    "node": "create_node",
+    "cluster_queue": "create_cluster_queue",
+}
+
+EPHEMERAL_KINDS = frozenset({"cycle_trace"})
+
+
+def rebuild(records, eng):
+    for rec in records:
+        kind = rec["kind"]
+        if kind == "workload":
+            eng.restore(rec["obj"])
+            continue
+        method = _CREATE.get(kind)
+        if method is not None:
+            getattr(eng, method)(rec["obj"])

@@ -1,0 +1,8 @@
+"""Planted U1 violations: guarded-state writes outside custodians."""
+
+
+def place(leaf, res, n):
+    leaf.tas_usage[res] = n
+    u = leaf.tas_usage
+    u.update({res: n})
+    leaf.free_capacity = {}

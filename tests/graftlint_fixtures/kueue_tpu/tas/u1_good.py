@@ -1,0 +1,24 @@
+"""Sanctioned undo-log usage: custodians write, others route/read."""
+
+
+def commit_usage(leaf, res, n):
+    leaf.tas_usage[res] = n
+    leaf.free_capacity = {}
+
+
+def _apply_deltas(leaf, deltas):
+    for res in sorted(deltas):
+        leaf.tas_usage[res] = deltas[res]
+
+
+def clone_domains(domains):
+    def clone(d):
+        c = object()
+        c.tas_usage = dict(d.tas_usage)
+        return c
+    return [clone(d) for d in domains]
+
+
+def place(self, leaf, res, n):
+    self._apply_deltas(leaf, {res: n})
+    return leaf.tas_usage.get(res)

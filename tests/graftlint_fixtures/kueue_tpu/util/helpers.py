@@ -1,0 +1,8 @@
+"""Zone gating: identical set iteration OUTSIDE a D1 zone is clean."""
+import time
+
+
+def wall_deadline(queues: set):
+    for q in queues:
+        q.touch()
+    return time.time() + 5

@@ -1,0 +1,17 @@
+"""graftlint — AST-based invariant analyzer for the kueue_tpu tree.
+
+Rule classes (see ``python -m tools.graftlint --explain RULE``):
+
+  D1  no nondeterminism in decision-core zones
+  J1  jit-purity of device-compiled functions
+  U1  undo-log discipline for snapshot/TAS state
+  O1  observability is write-only
+  R1  journal/trace record kinds are replay-exhaustive
+  V1  prometheus exposition validity (wrapped tools/promcheck.py)
+  V2  trace-event JSON validity (wrapped tools/trace_schema.py)
+"""
+
+from tools.graftlint.core import Finding, Module, Rule, RunResult, run
+from tools.graftlint.config import Config
+
+__all__ = ["Finding", "Module", "Rule", "RunResult", "run", "Config"]

@@ -1,0 +1,99 @@
+"""graftlint baseline: grandfathered findings, each with a mandatory
+justification.
+
+The baseline is the escape hatch for findings that are *judged
+acceptable* rather than fixed — perf_counter phase timing that is
+digest-neutral by construction, the tracer's append-only journal
+correlation record, and so on. Every entry must say WHY, and entries
+match by (rule, file, symbol) — not line numbers — so edits elsewhere
+in a file neither invalidate nor widen the grandfathering. An entry
+that stops matching anything is reported as stale so the baseline only
+ever shrinks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from tools.graftlint.core import Finding, RunResult
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "baseline.json")
+
+
+class BaselineError(Exception):
+    pass
+
+
+def load(path: str) -> list[dict]:
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or "entries" not in doc:
+        raise BaselineError(f"{path}: expected {{'entries': [...]}}")
+    entries = doc["entries"]
+    for i, e in enumerate(entries):
+        for field in ("rule", "file", "symbol", "justification"):
+            if field not in e:
+                raise BaselineError(
+                    f"{path}: entries[{i}] missing {field!r}")
+        if not str(e["justification"]).strip():
+            raise BaselineError(
+                f"{path}: entries[{i}] ({e['rule']} {e['file']} "
+                f"{e['symbol']}): empty justification — every "
+                "baselined finding must be justified")
+    return entries
+
+
+def apply(result: RunResult, path: Optional[str]) -> dict:
+    """Filter baselined findings out of ``result`` in place. Returns
+    the JSON-report info block: matched / stale entries."""
+    if path is None or not os.path.exists(path):
+        return {"path": path, "entries": 0, "matched": 0, "stale": []}
+    entries = load(path)
+    index: dict[tuple, dict] = {}
+    for e in entries:
+        index[(e["rule"], e["file"], e["symbol"])] = e
+    matched: set = set()
+    kept: list[Finding] = []
+    for f in result.findings:
+        e = index.get(f.key())
+        if e is not None:
+            matched.add(f.key())
+            result.suppressed.append(
+                (f, f"baseline: {e['justification']}"))
+        else:
+            kept.append(f)
+    result.findings = kept
+    stale = [e for k, e in index.items() if k not in matched]
+    for e in stale:
+        result.errors.append(
+            f"baseline entry is stale (no longer matches anything): "
+            f"{e['rule']} {e['file']} [{e['symbol']}] — delete it")
+    return {"path": path, "entries": len(entries),
+            "matched": len(matched),
+            "stale": [[e["rule"], e["file"], e["symbol"]]
+                      for e in stale]}
+
+
+def write(findings: list[Finding], path: str) -> None:
+    """--write-baseline: emit the current finding set as a baseline
+    skeleton. Justifications are intentionally TODO so a human must
+    fill each in — an unjustified entry fails load()."""
+    entries = []
+    seen: set = set()
+    for f in findings:
+        if f.key() in seen:
+            continue
+        seen.add(f.key())
+        entries.append({
+            "rule": f.rule, "file": f.file, "symbol": f.symbol,
+            "message": f.message,
+            "justification": "TODO: justify or fix",
+        })
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": BASELINE_VERSION, "entries": entries},
+                  fh, indent=2)
+        fh.write("\n")

@@ -1,0 +1,157 @@
+"""graftlint command line.
+
+    python -m tools.graftlint kueue_tpu/            # AST rules
+    python -m tools.graftlint --self-check          # emitter/validator
+    python -m tools.graftlint --metrics exp.txt     # validate artifact
+    python -m tools.graftlint --explain D1          # rule rationale
+    python -m tools.graftlint kueue_tpu/ --json report.json
+
+Exit codes: 0 clean, 1 findings or errors, 2 usage / internal failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.graftlint import baseline as baseline_mod
+from tools.graftlint.config import Config
+from tools.graftlint.core import Rule, RunResult, run
+from tools.graftlint.report import render_json, render_text, write_json
+from tools.graftlint.rules_determinism import DeterminismRule
+from tools.graftlint.rules_jit import JitPurityRule
+from tools.graftlint.rules_journal import KindExhaustivenessRule
+from tools.graftlint.rules_obs import ObsWriteOnlyRule
+from tools.graftlint.rules_undo import UndoLogRule
+
+
+def build_rules(config: Config) -> list[Rule]:
+    return [
+        DeterminismRule(),
+        JitPurityRule(),
+        UndoLogRule(config.u1_custodians),
+        ObsWriteOnlyRule(),
+        KindExhaustivenessRule(config.journal_handler_files,
+                               config.trace_handler_files),
+    ]
+
+
+def _explain(rule_name: str, rules: list[Rule]) -> int:
+    for r in rules:
+        if r.name == rule_name:
+            print(f"{r.name}: {r.title}\n")
+            print(r.rationale)
+            if r.example:
+                print(f"\nExample:\n{r.example}")
+            return 0
+    known = ", ".join(r.name for r in rules)
+    print(f"unknown rule {rule_name!r} (known: {known})",
+          file=sys.stderr)
+    return 2
+
+
+def _list_rules(rules: list[Rule]) -> int:
+    for r in rules:
+        scope = "cross-file" if r.cross_file else "per-module"
+        print(f"{r.name}  {r.title}  ({scope})")
+    print("V1  prometheus exposition validity  (validator)")
+    print("V2  trace-event JSON validity  (validator)")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST-based invariant analyzer for kueue_tpu "
+                    "(determinism, jit-purity, undo-log discipline, "
+                    "obs write-only, record-kind exhaustiveness).")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to analyze")
+    p.add_argument("--json", metavar="FILE", dest="json_out",
+                   help="write the JSON report to FILE ('-' = stdout)")
+    p.add_argument("--baseline", default=baseline_mod.DEFAULT_BASELINE,
+                   help="baseline file of grandfathered findings "
+                        "(default: tools/graftlint/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline entirely")
+    p.add_argument("--write-baseline", metavar="FILE", nargs="?",
+                   const=baseline_mod.DEFAULT_BASELINE, default=None,
+                   help="write current findings as a baseline skeleton "
+                        "(justifications left TODO) and exit 0")
+    p.add_argument("--explain", metavar="RULE",
+                   help="print a rule's rationale and an example "
+                        "violation, then exit")
+    p.add_argument("--list-rules", action="store_true",
+                   help="list all rules and exit")
+    p.add_argument("--metrics", metavar="FILE", action="append",
+                   default=[],
+                   help="validate a prometheus exposition file (V1)")
+    p.add_argument("--trace-json", metavar="FILE", action="append",
+                   default=[],
+                   help="validate a trace-event JSON file (V2)")
+    p.add_argument("--self-check", action="store_true",
+                   help="build metrics/trace artifacts in-process from "
+                        "the live emitters and validate them")
+    p.add_argument("--verbose", action="store_true",
+                   help="also print suppressed findings")
+    p.add_argument("--root", default="",
+                   help="repo root for relative paths (default: "
+                        "auto-detected)")
+    args = p.parse_args(argv)
+
+    config = Config(root=args.root) if args.root else Config()
+    rules = build_rules(config)
+
+    if args.explain:
+        return _explain(args.explain, rules)
+    if args.list_rules:
+        return _list_rules(rules)
+    if not args.paths and not (args.metrics or args.trace_json
+                               or args.self_check):
+        p.print_usage(sys.stderr)
+        print("graftlint: nothing to do — give paths and/or "
+              "--metrics/--trace-json/--self-check", file=sys.stderr)
+        return 2
+
+    if args.paths:
+        result = run(args.paths, config, rules)
+    else:
+        result = RunResult()
+
+    # Validator passes share the runner's reporting contract.
+    from tools.graftlint import validators
+    for m in args.metrics:
+        result.findings.extend(validators.check_metrics_file(m))
+    for t in args.trace_json:
+        result.findings.extend(validators.check_trace_file(t))
+    if args.self_check:
+        result.findings.extend(validators.self_check())
+
+    if args.write_baseline:
+        baseline_mod.write(result.findings, args.write_baseline)
+        print(f"wrote {len(result.findings)} finding(s) to "
+              f"{args.write_baseline} — fill in every justification",
+              file=sys.stderr)
+        return 0
+
+    baseline_path = None if args.no_baseline else args.baseline
+    try:
+        baseline_info = baseline_mod.apply(result, baseline_path)
+    except baseline_mod.BaselineError as e:
+        print(f"graftlint: bad baseline: {e}", file=sys.stderr)
+        return 2
+
+    if args.json_out:
+        doc = render_json(result, baseline_info)
+        if args.json_out == "-":
+            write_json(doc, sys.stdout)
+        else:
+            with open(args.json_out, "w", encoding="utf-8") as fh:
+                write_json(doc, fh)
+    if args.json_out != "-":
+        render_text(result, sys.stdout, verbose=args.verbose)
+    return 1 if (result.findings or result.errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,148 @@
+"""graftlint zone configuration: which invariant classes guard which
+packages, and the structural allowlists the rules consult.
+
+The zone map is the analyzer's contract with the architecture
+(ARCHITECTURE.md "Static analysis"): decision-core packages carry the
+bit-determinism invariant the flight recorder replays against (D1);
+device programs carry jit-purity (J1); TAS/cache state carries undo-log
+discipline (U1); the observability package is write-only (O1); journal
+and trace record kinds must be replay-exhaustive (R1, cross-file).
+
+Tests construct their own Config over fixture trees — nothing below is
+process-global.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+# Longest-prefix wins; a file matching no prefix gets only the global
+# rules (J1 applies wherever jit-wrapped code appears; R1 is a
+# whole-tree pass anchored on the emitter/handler files).
+DEFAULT_ZONES: tuple = (
+    ("kueue_tpu/scheduler/", frozenset({"D1", "J1"})),
+    ("kueue_tpu/tas/", frozenset({"D1", "U1", "J1"})),
+    ("kueue_tpu/ops/", frozenset({"D1", "J1"})),
+    ("kueue_tpu/oracle/", frozenset({"D1", "J1"})),
+    ("kueue_tpu/cache/snapshot.py", frozenset({"D1", "U1", "J1"})),
+    ("kueue_tpu/cache/", frozenset({"U1", "J1"})),
+    ("kueue_tpu/parallel/", frozenset({"D1", "J1"})),
+    ("kueue_tpu/obs/", frozenset({"O1", "J1"})),
+)
+
+GLOBAL_RULES = frozenset({"J1"})
+
+# -- D1: nondeterminism sources banned in decision-core zones --
+
+# Dotted-prefix match after import-alias resolution: "random" bans
+# random.random, random.choice, ...; exact names ban single functions.
+D1_BANNED_CALLS: tuple = (
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today",
+    "os.urandom", "os.getrandom",
+    "uuid.uuid1", "uuid.uuid4",
+    "random", "secrets",
+)
+
+# Set-typed attribute names (collected from AnnAssign annotations like
+# ``frs_need_preemption: set[FlavorResource]``) propagate set-ness to
+# ``anything.<attr>`` iteration sites across functions.
+
+# -- U1: undo-log discipline --
+
+# Attributes holding revertable scheduling state. A store/mutation on
+# ``<expr>.<attr>`` (or an alias bound from it) is only legal inside a
+# custodian function below.
+U1_GUARDED_ATTRS = frozenset({"tas_usage", "free_capacity", "usage"})
+
+# The functions that ARE the undo log / construction path. Everything
+# else must route mutations through them (_apply_deltas logs the delta;
+# commit_usage is the sanctioned write-through; build_snapshot and the
+# aggregate updaters run before any scope opens).
+U1_CUSTODIANS = frozenset({
+    # tas/snapshot.py
+    "_apply_deltas", "commit_usage", "begin_cycle", "end_cycle",
+    "fork", "clone_domains", "add_node", "remove_node", "__init__",
+    # cache/snapshot.py
+    "add_usage_fr", "remove_usage_fr", "build_snapshot",
+    "_update_cq_resource_node", "_update_cohort_resource_node",
+    "_accumulate_from_child", "close",
+})
+
+MUTATOR_METHODS = frozenset({
+    "pop", "update", "clear", "setdefault", "add", "discard", "remove",
+    "append", "extend", "popitem",
+})
+
+# -- O1: observability write-only discipline --
+
+# Engine/snapshot mutators obs code must never call: calling one from a
+# hook would make a traced run diverge from an untraced run.
+O1_MUTATOR_CALLS = frozenset({
+    "schedule_once", "submit", "finish", "evict", "requeue",
+    "add_usage", "remove_usage", "install_usage", "commit_usage",
+    "add_workload", "remove_workload", "begin_cycle",
+    "add_node", "remove_node", "preempt",
+})
+
+# Receiver names treated as "the engine" for attribute-store checks.
+O1_ENGINE_NAMES = frozenset({"engine", "eng"})
+
+# Lifecycle functions allowed to attach/detach themselves on the engine.
+O1_ATTACH_OK = frozenset({"__init__", "detach", "attach", "close"})
+
+# -- J1: jit purity --
+
+J1_BANNED_CALLS: tuple = (
+    "print", "open", "input", "breakpoint",
+    "os", "sys", "io", "pathlib", "logging", "time", "random",
+    "kueue_tpu.metrics",
+)
+
+# Call receivers that look like the metrics registry.
+J1_REGISTRY_NAMES = frozenset({"registry", "METRICS", "metrics"})
+
+# Attribute accesses on traced values that yield static (trace-time)
+# information — branching on these is legal.
+J1_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size",
+                             "sharding", "aval"})
+J1_STATIC_CALLS = frozenset({"len", "isinstance", "getattr", "hasattr",
+                             "type", "callable"})
+
+# -- R1: journal / trace kind exhaustiveness --
+
+# Where record kinds are *handled*. Emit sites are discovered
+# tree-wide; a kind emitted anywhere must appear in a handler (or
+# declared-ephemeral) position in one of these files.
+R1_JOURNAL_HANDLER_FILES = ("kueue_tpu/store/journal.py",)
+R1_TRACE_HANDLER_FILES = ("kueue_tpu/replay/replayer.py",
+                          "kueue_tpu/replay/trace.py",
+                          "kueue_tpu/replay/recorder.py")
+
+
+@dataclass
+class Config:
+    root: str = ""
+    zones: tuple = DEFAULT_ZONES
+    global_rules: frozenset = GLOBAL_RULES
+    journal_handler_files: tuple = R1_JOURNAL_HANDLER_FILES
+    trace_handler_files: tuple = R1_TRACE_HANDLER_FILES
+    u1_custodians: frozenset = U1_CUSTODIANS
+    extra: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.root:
+            # tools/graftlint/config.py -> repo root
+            self.root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+
+    def rules_for(self, relpath: str) -> frozenset:
+        best: frozenset = frozenset()
+        best_len = -1
+        for prefix, rules in self.zones:
+            if relpath.startswith(prefix) and len(prefix) > best_len:
+                best, best_len = rules, len(prefix)
+        return best | self.global_rules

@@ -1,0 +1,250 @@
+"""graftlint core: module loading, zones, findings, the rule runner.
+
+The analyzer is a set of per-rule AST visitors (stdlib ``ast`` only)
+driven over a tree of parsed modules. Each module is mapped to a set of
+*invariant classes* by the zone config (config.py) — e.g. everything
+under ``kueue_tpu/scheduler/`` is a decision-core zone and gets the D1
+determinism rule; ``kueue_tpu/obs/`` gets the O1 write-only rule.
+Cross-file rules (R1 kind exhaustiveness) receive the whole module set.
+
+Suppression is explicit and justified, never silent:
+
+  * an inline pragma ``# graftlint: allow[D1] <reason>`` on the flagged
+    line (or the line directly above) suppresses one finding — an empty
+    reason is itself an error;
+  * the checked-in baseline (baseline.py) grandfathers findings by
+    (rule, file, symbol) with a mandatory justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+_PRAGMA = re.compile(
+    r"#\s*graftlint:\s*allow\[(?P<rules>[A-Z0-9, ]+)\]\s*(?P<reason>.*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str      # "D1" | "J1" | "U1" | "O1" | "R1" | "V1" | "V2"
+    file: str      # repo-relative path (or validator input label)
+    line: int
+    col: int
+    symbol: str    # enclosing function qualname ("" at module level)
+    message: str
+
+    def key(self) -> tuple:
+        """Baseline identity: line numbers excluded so unrelated edits
+        above a grandfathered finding don't un-baseline it."""
+        return (self.rule, self.file, self.symbol)
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.file}:{self.line}:{self.col}: " \
+               f"{self.rule}{sym}: {self.message}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "col": self.col, "symbol": self.symbol,
+                "message": self.message}
+
+
+@dataclass
+class Module:
+    """A parsed source file plus the zone verdict for it."""
+
+    path: str              # absolute
+    relpath: str           # repo-relative, "/"-separated
+    tree: ast.Module
+    lines: list[str]
+    rules: frozenset      # invariant classes active for this file
+
+    def pragma_for(self, line: int) -> Optional[tuple[set, str]]:
+        """The allow-pragma covering ``line``: on the line itself or the
+        line directly above. Returns (rules, reason) or None."""
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _PRAGMA.search(self.lines[ln - 1])
+                if m:
+                    rules = {r.strip() for r in
+                             m.group("rules").split(",") if r.strip()}
+                    return rules, m.group("reason").strip()
+        return None
+
+
+class Rule:
+    """Base class: one invariant class, one visitor pass per module."""
+
+    name = ""
+    title = ""
+    rationale = ""     # --explain body
+    example = ""       # --explain example violation
+    cross_file = False
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        return ()
+
+    def check_tree(self, modules: list[Module]) -> Iterable[Finding]:
+        """Cross-file rules override this instead."""
+        return ()
+
+
+# -- dotted-name resolution helpers shared by the rules --
+
+def import_aliases(tree: ast.Module) -> dict[str, str]:
+    """Local name -> dotted origin, from the module's imports.
+    ``import time`` -> {"time": "time"}; ``from os import urandom as u``
+    -> {"u": "os.urandom"}; ``import numpy as np`` -> {"np": "numpy"}."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def dotted(expr: ast.AST, aliases: dict[str, str]) -> str:
+    """Best-effort dotted path of a Name/Attribute chain, resolved
+    through the module's import aliases. Unresolvable -> ""."""
+    parts: list[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return ""
+    head = aliases.get(node.id, node.id)
+    parts.append(head)
+    return ".".join(reversed(parts))
+
+
+def qualname_index(tree: ast.Module) -> dict[ast.AST, str]:
+    """Map every function/class def node to its dotted qualname."""
+    out: dict[ast.AST, str] = {}
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qn = f"{prefix}.{child.name}" if prefix else child.name
+                out[child] = qn
+                visit(child, qn)
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def enclosing_function(tree: ast.Module,
+                       target: ast.AST) -> str:
+    """Qualname of the innermost function containing ``target``."""
+    qns = qualname_index(tree)
+    best = ""
+    best_span = None
+    tl = getattr(target, "lineno", 0)
+    for node, qn in qns.items():
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        end = getattr(node, "end_lineno", node.lineno)
+        if node.lineno <= tl <= end:
+            span = end - node.lineno
+            if best_span is None or span < best_span:
+                best, best_span = qn, span
+    return best
+
+
+# -- the runner --
+
+@dataclass
+class RunResult:
+    findings: list[Finding] = field(default_factory=list)
+    suppressed: list[tuple[Finding, str]] = field(default_factory=list)
+    errors: list[str] = field(default_factory=list)   # pragma misuse etc.
+    files: int = 0
+
+
+def _iter_py_files(path: str) -> Iterable[str]:
+    if os.path.isfile(path):
+        yield path
+        return
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs
+                         if d not in ("__pycache__", ".git"))
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def load_modules(paths: list[str], config) -> tuple[list[Module],
+                                                    list[str]]:
+    """Parse every .py under ``paths``; returns (modules, errors).
+    Syntax errors are reported, not fatal — a lint run must see the
+    whole tree even when one file is mid-edit."""
+    modules: list[Module] = []
+    errors: list[str] = []
+    root = config.root
+    seen: set[str] = set()
+    for p in paths:
+        for fp in _iter_py_files(os.path.abspath(p)):
+            if fp in seen:
+                continue
+            seen.add(fp)
+            rel = os.path.relpath(fp, root).replace(os.sep, "/")
+            try:
+                with open(fp, encoding="utf-8") as fh:
+                    src = fh.read()
+                tree = ast.parse(src, filename=rel)
+            except SyntaxError as e:
+                errors.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
+                continue
+            except OSError as e:
+                errors.append(f"{rel}: unreadable: {e}")
+                continue
+            modules.append(Module(
+                path=fp, relpath=rel, tree=tree,
+                lines=src.split("\n"),
+                rules=config.rules_for(rel)))
+    return modules, errors
+
+
+def run(paths: list[str], config, rules: list[Rule]) -> RunResult:
+    modules, errors = load_modules(paths, config)
+    result = RunResult(errors=errors, files=len(modules))
+    raw: list[tuple[Finding, Module]] = []
+    by_rel = {m.relpath: m for m in modules}
+    for rule in rules:
+        if rule.cross_file:
+            for f in rule.check_tree(modules):
+                raw.append((f, by_rel.get(f.file)))
+        else:
+            for mod in modules:
+                if rule.name not in mod.rules:
+                    continue
+                for f in rule.check_module(mod):
+                    raw.append((f, mod))
+    for f, mod in sorted(raw, key=lambda fm: (fm[0].file, fm[0].line,
+                                              fm[0].col, fm[0].rule)):
+        pragma = mod.pragma_for(f.line) if mod is not None else None
+        if pragma is not None and f.rule in pragma[0]:
+            if not pragma[1]:
+                result.errors.append(
+                    f"{f.file}:{f.line}: allow[{f.rule}] pragma without "
+                    "a justification (a reason is mandatory)")
+                result.findings.append(f)
+            else:
+                result.suppressed.append((f, pragma[1]))
+            continue
+        result.findings.append(f)
+    return result

@@ -1,0 +1,59 @@
+"""graftlint reporting: one text format, one JSON document, one exit
+code — shared by the AST rules and the wrapped validators (promcheck,
+trace_schema), so ``make lint`` has a single output contract.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, Optional, TextIO
+
+from tools.graftlint.core import Finding, RunResult
+
+JSON_VERSION = 1
+
+
+def render_text(result: RunResult, out: TextIO,
+                verbose: bool = False) -> None:
+    for f in result.findings:
+        print(f.render(), file=out)
+    for e in result.errors:
+        print(f"error: {e}", file=out)
+    if verbose:
+        for f, reason in result.suppressed:
+            print(f"suppressed: {f.render()}  [pragma: {reason}]",
+                  file=out)
+    n, s = len(result.findings), len(result.suppressed)
+    status = "FAIL" if (result.findings or result.errors) else "OK"
+    print(f"graftlint {status}: {n} finding(s), {s} suppressed, "
+          f"{len(result.errors)} error(s), {result.files} file(s)",
+          file=out)
+
+
+def render_json(result: RunResult,
+                baseline_info: Optional[dict] = None) -> dict:
+    doc = {
+        "version": JSON_VERSION,
+        "files": result.files,
+        "findings": [f.to_json() for f in result.findings],
+        "suppressed": [{**f.to_json(), "reason": r}
+                       for f, r in result.suppressed],
+        "errors": list(result.errors),
+        "summary": _summary(result.findings),
+        "ok": not result.findings and not result.errors,
+    }
+    if baseline_info:
+        doc["baseline"] = baseline_info
+    return doc
+
+
+def _summary(findings: Iterable[Finding]) -> dict:
+    out: dict = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return dict(sorted(out.items()))
+
+
+def write_json(doc: dict, out: TextIO) -> None:
+    json.dump(doc, out, indent=2, sort_keys=False)
+    out.write("\n")

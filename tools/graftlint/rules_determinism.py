@@ -1,0 +1,259 @@
+"""D1: no nondeterminism in decision-core zones.
+
+The flight recorder's contract (replay/trace.py) is that replaying a
+trace through a fresh engine yields a byte-identical decision stream.
+That only holds if the decision core is a pure function of (inputs,
+engine clock): wall-clock reads, entropy sources, unordered-collection
+iteration, and memory-address-derived ordering all silently break it.
+
+Checks:
+  * calls to banned nondeterminism sources (time.time / random.* /
+    os.urandom / uuid4 / ..., resolved through import aliases);
+  * ``for``-loop and comprehension iteration over set-valued
+    expressions, unless consumed by an order-insensitive reducer
+    (any/all/sum/min/max/len/sorted/frozenset/set);
+  * iteration over ``<dict>.keys()`` (insertion order is deterministic
+    per-process but keyed on build order — decision zones must sort);
+  * ``id(...)`` inside ``sorted(key=...)`` / ``.sort(key=...)`` keys
+    (CPython address order varies run to run).
+
+Set-ness inference is intentionally shallow and name-based: literal
+``{a, b}`` / ``set(...)`` / set comprehensions, local names assigned
+from those, names/params/attributes annotated ``set[...]`` or
+``frozenset[...]`` anywhere in the module (dataclass fields included).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.config import D1_BANNED_CALLS
+from tools.graftlint.core import (
+    Finding,
+    Module,
+    Rule,
+    dotted,
+    import_aliases,
+)
+
+_ORDER_INSENSITIVE = {"any", "all", "sum", "min", "max", "len",
+                      "sorted", "frozenset", "set"}
+
+
+def _is_set_annotation(ann: ast.AST) -> bool:
+    base = ann
+    if isinstance(base, ast.Subscript):
+        base = base.value
+    if isinstance(base, ast.Name):
+        return base.id in ("set", "frozenset", "Set", "FrozenSet",
+                           "AbstractSet", "MutableSet")
+    if isinstance(base, ast.Attribute):
+        return base.attr in ("Set", "FrozenSet", "AbstractSet",
+                             "MutableSet")
+    if isinstance(base, ast.Constant) and isinstance(base.value, str):
+        return base.value.lstrip().startswith(("set[", "set ",
+                                               "frozenset["))
+    return False
+
+
+class DeterminismRule(Rule):
+    name = "D1"
+    title = "no nondeterminism in decision-core zones"
+    rationale = (
+        "Decision-core packages (scheduler/, tas/, ops/, oracle/, "
+        "cache/snapshot) must be bit-deterministic: the flight recorder "
+        "(PR 2) replays recorded traces through a fresh engine and "
+        "asserts a byte-identical decision stream, and the host/device "
+        "differential tests assume both paths see identical inputs in "
+        "identical order. A wall-clock read, an entropy source, a bare "
+        "iteration over a set (hash/address order), or id() in a sort "
+        "key makes two replays of the same trace diverge without any "
+        "test failing at the site of the bug.")
+    example = (
+        "    # BAD: set iteration order varies run to run\n"
+        "    for snap in set(cq.tas_flavors.values()):\n"
+        "        place(snap)\n"
+        "    # GOOD: deterministic identity-dedup, insertion order\n"
+        "    for snap in {id(s): s for s in "
+        "cq.tas_flavors.values()}.values():\n"
+        "        place(snap)\n"
+        "    # BAD: wall clock on a decision path\n"
+        "    deadline = time.time() + 5\n"
+        "    # BAD: address-derived ordering\n"
+        "    cands.sort(key=lambda c: (c.prio, id(c)))")
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        aliases = import_aliases(mod.tree)
+        set_attrs = self._annotated_set_names(mod.tree)
+        findings: list[Finding] = []
+        self._walk_scope(mod, mod.tree, "", aliases, set_attrs,
+                         findings)
+        return findings
+
+    # -- set-ness inference --
+
+    @staticmethod
+    def _annotated_set_names(tree: ast.Module) -> set:
+        """Names/attribute-names annotated as sets anywhere in the
+        module (function params, AnnAssign locals, dataclass fields)."""
+        out: set = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.AnnAssign) \
+                    and _is_set_annotation(node.annotation):
+                t = node.target
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    out.add(t.attr)
+            elif isinstance(node, ast.arg) and node.annotation \
+                    is not None and _is_set_annotation(node.annotation):
+                out.add(node.arg)
+        return out
+
+    @staticmethod
+    def _is_set_expr(expr: ast.AST, local_sets: set,
+                     set_attrs: set) -> bool:
+        if isinstance(expr, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) \
+                and expr.func.id in ("set", "frozenset"):
+            return True
+        if isinstance(expr, ast.Name):
+            return expr.id in local_sets or expr.id in set_attrs
+        if isinstance(expr, ast.Attribute):
+            return expr.attr in set_attrs
+        if isinstance(expr, ast.BinOp) and isinstance(
+                expr.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+            # set algebra keeps set-ness (a | b, a - b, ...)
+            return DeterminismRule._is_set_expr(
+                expr.left, local_sets, set_attrs) \
+                or DeterminismRule._is_set_expr(
+                    expr.right, local_sets, set_attrs)
+        return False
+
+    # -- the walk --
+
+    def _walk_scope(self, mod, scope, qual: str, aliases: dict,
+                    set_attrs: set, findings: list) -> None:
+        """Walk one function (or module) body; recurse into nested
+        defs with their own local-set tables."""
+        local_sets: set = set()
+        body = scope.body if hasattr(scope, "body") else []
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                q = f"{qual}.{node.name}" if qual else node.name
+                self._walk_scope(mod, node, q, aliases, set_attrs,
+                                 findings)
+                return
+            if isinstance(node, ast.ClassDef):
+                q = f"{qual}.{node.name}" if qual else node.name
+                for child in node.body:
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        self._walk_scope(
+                            mod, child, f"{q}.{child.name}", aliases,
+                            set_attrs, findings)
+                    else:
+                        visit(child)
+                return
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                if self._is_set_expr(node.value, local_sets, set_attrs):
+                    local_sets.add(node.targets[0].id)
+                else:
+                    local_sets.discard(node.targets[0].id)
+            if isinstance(node, ast.Call):
+                self._check_call(mod, node, qual, aliases, findings)
+            if isinstance(node, ast.For):
+                self._check_iter(mod, node.iter, qual, local_sets,
+                                 set_attrs, findings)
+            if isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                                 ast.DictComp, ast.SetComp)):
+                if not self._comp_is_reduced(mod, node):
+                    for gen in node.generators:
+                        self._check_iter(mod, gen.iter, qual,
+                                         local_sets, set_attrs,
+                                         findings)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in body:
+            visit(stmt)
+
+    def _comp_is_reduced(self, mod: Module, comp: ast.AST) -> bool:
+        """True when the comprehension/genexp is the direct argument of
+        an order-insensitive reducer (any/sum/sorted/...) — its
+        iteration order cannot reach a decision."""
+        parents = getattr(mod, "_d1_parents", None)
+        if parents is None:
+            parents = {}
+            for n in ast.walk(mod.tree):
+                for c in ast.iter_child_nodes(n):
+                    parents[c] = n
+            mod._d1_parents = parents  # type: ignore[attr-defined]
+        p = parents.get(comp)
+        return (isinstance(p, ast.Call)
+                and isinstance(p.func, ast.Name)
+                and p.func.id in _ORDER_INSENSITIVE
+                and comp in p.args)
+
+    def _check_iter(self, mod: Module, it: ast.AST, qual: str,
+                    local_sets: set, set_attrs: set,
+                    findings: list) -> None:
+        if self._is_set_expr(it, local_sets, set_attrs):
+            what = "set-valued expression"
+            if isinstance(it, (ast.Name, ast.Attribute)):
+                nm = it.id if isinstance(it, ast.Name) else it.attr
+                what = f"set {nm!r}"
+            findings.append(Finding(
+                self.name, mod.relpath, it.lineno, it.col_offset, qual,
+                f"iteration over {what}: set order is "
+                "hash/address-dependent and varies between runs — "
+                "iterate sorted(...) or an insertion-ordered dedup"))
+        elif isinstance(it, ast.Call) \
+                and isinstance(it.func, ast.Attribute) \
+                and it.func.attr == "keys" and not it.args:
+            findings.append(Finding(
+                self.name, mod.relpath, it.lineno, it.col_offset, qual,
+                "iteration over .keys(): key order is build-order-"
+                "dependent — decision zones iterate sorted(d) "
+                "(or drop .keys() after proving insertion order is "
+                "canonical)"))
+
+    def _check_call(self, mod: Module, call: ast.Call, qual: str,
+                    aliases: dict, findings: list) -> None:
+        path = dotted(call.func, aliases)
+        if path:
+            for banned in D1_BANNED_CALLS:
+                if path == banned or path.startswith(banned + "."):
+                    findings.append(Finding(
+                        self.name, mod.relpath, call.lineno,
+                        call.col_offset, qual,
+                        f"call to {path}(): nondeterminism source in a "
+                        "decision-core zone (breaks flight-recorder "
+                        "replay) — thread the engine clock / a seeded "
+                        "generator through instead"))
+                    break
+        # id() inside sort keys
+        is_sort = (isinstance(call.func, ast.Name)
+                   and call.func.id == "sorted") or \
+                  (isinstance(call.func, ast.Attribute)
+                   and call.func.attr == "sort")
+        if is_sort:
+            for kw in call.keywords:
+                if kw.arg != "key":
+                    continue
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Call) \
+                            and isinstance(sub.func, ast.Name) \
+                            and sub.func.id == "id":
+                        findings.append(Finding(
+                            self.name, mod.relpath, sub.lineno,
+                            sub.col_offset, qual,
+                            "id() in a sort key: CPython object "
+                            "addresses vary run to run, so ties order "
+                            "nondeterministically — sort on a stable "
+                            "field (key, name, seq)"))

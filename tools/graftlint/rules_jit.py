@@ -1,0 +1,385 @@
+"""J1: jit-purity — functions handed to jax.jit / pjit / pallas_call
+must be pure traces.
+
+A jit-wrapped function executes its Python body ONCE per abstract
+signature; anything impure in it (I/O, closure mutation, metrics
+increments) runs at trace time — usually never again — and anything
+that branches a Python ``if`` on a *traced* value raises a
+ConcretizationTypeError at best or silently bakes one branch into the
+compiled program at worst (the batched-oracle path would then disagree
+with the host oracle on exactly the inputs that took the other branch).
+
+Detection:
+  * jit roots: ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)``
+    decorators, module-level names bound to ``partial(jax.jit, ...)``
+    used as decorators, and kernels passed (positionally or by name) to
+    ``pl.pallas_call``;
+  * static args: parsed out of ``static_argnames=(...)`` /
+    ``static_argnums=(...)`` literals — branching on those is legal;
+  * inside a jit root: banned impure calls (print/open/os.*/time.* /
+    metrics registry), ``global``/``nonlocal``, stores to names not
+    local to the function (closure/module mutation), and ``if``/
+    ``while`` tests reaching a traced parameter (shallow taint through
+    local assignments; ``x.shape``/``x.ndim``/``x.dtype``/``len(x)``
+    and ``is None`` tests are static and exempt).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from tools.graftlint.config import (
+    J1_BANNED_CALLS,
+    J1_REGISTRY_NAMES,
+    J1_STATIC_ATTRS,
+    J1_STATIC_CALLS,
+)
+from tools.graftlint.core import (
+    Finding,
+    Module,
+    Rule,
+    dotted,
+    import_aliases,
+)
+
+
+def _contains_jit(expr: ast.AST, aliases: dict) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            path = dotted(node, aliases)
+            tail = path.rsplit(".", 1)[-1] if path else ""
+            if tail in ("jit", "pjit"):
+                return True
+    return False
+
+
+def _literal_strs(expr: ast.AST) -> list:
+    out = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.append(node.value)
+    return out
+
+
+def _static_names_from_call(call: ast.Call,
+                            fn: ast.FunctionDef) -> set:
+    """static_argnames / static_argnums keywords of a jit/partial call,
+    resolved to parameter names of ``fn``."""
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    out: set = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            out.update(_literal_strs(kw.value))
+        elif kw.arg == "static_argnums":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, int):
+                    if 0 <= node.value < len(params):
+                        out.add(params[node.value])
+    return out
+
+
+class JitPurityRule(Rule):
+    name = "J1"
+    title = "jit-purity for device programs"
+    rationale = (
+        "Functions wrapped by jax.jit / pjit or passed to "
+        "pl.pallas_call trace once per abstract signature: side effects "
+        "(I/O, metrics increments, closure writes) execute at trace "
+        "time only, and a Python `if` on a traced value either raises "
+        "under jit or freezes one branch into the compiled program — "
+        "the device oracle would then silently diverge from the host "
+        "oracle on inputs taking the other branch. Purity here is what "
+        "makes the compiled decision core a function, which is what "
+        "the batched-oracle design (PAPER.md) verifies against.")
+    example = (
+        "    @partial(jax.jit, static_argnames=(\"depth\",))\n"
+        "    def step(usage, quota, depth):\n"
+        "        print(usage)              # BAD: trace-time I/O\n"
+        "        if usage.sum() > 0:       # BAD: branch on traced "
+        "value\n"
+        "            _CACHE[depth] = usage # BAD: closure mutation\n"
+        "        for _ in range(depth):    # fine: depth is static\n"
+        "            ...\n"
+        "        return jnp.where(usage > quota, 0, 1)  # GOOD")
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        aliases = import_aliases(mod.tree)
+        findings: list[Finding] = []
+        jit_aliases = self._module_jit_aliases(mod.tree, aliases)
+        roots = self._find_roots(mod.tree, aliases, jit_aliases)
+        for fn, static, qual, how in roots:
+            self._check_body(mod, fn, static, qual, how, aliases,
+                             findings)
+        return findings
+
+    # -- root discovery --
+
+    @staticmethod
+    def _module_jit_aliases(tree: ast.Module, aliases: dict) -> set:
+        """Module-level names bound to partial(jax.jit, ...)-style
+        expressions (e.g. ``cycle_step = partial(jax.jit, ...)``)."""
+        out: set = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call) \
+                    and _contains_jit(node.value, aliases):
+                out.add(node.targets[0].id)
+        return out
+
+    def _find_roots(self, tree: ast.Module, aliases: dict,
+                    jit_aliases: set) -> list:
+        """(fn, static_params, qualname, how) for every jit root."""
+        roots: list = []
+        fns_by_name: dict[str, ast.FunctionDef] = {}
+
+        def collect(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.FunctionDef):
+                    qn = f"{prefix}.{child.name}" if prefix \
+                        else child.name
+                    fns_by_name.setdefault(child.name, child)
+                    dec_info = self._jit_decorator(child, aliases,
+                                                   jit_aliases)
+                    if dec_info is not None:
+                        roots.append((child, dec_info, qn, "decorator"))
+                    collect(child, qn)
+                elif isinstance(child, ast.ClassDef):
+                    collect(child,
+                            f"{prefix}.{child.name}" if prefix
+                            else child.name)
+                else:
+                    collect(child, prefix)
+
+        collect(tree, "")
+
+        # pallas_call kernels: pl.pallas_call(kernel, ...) — resolve a
+        # Name first-arg to a module function.
+        seen = {id(fn) for fn, _s, _q, _h in roots}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            path = dotted(node.func, aliases)
+            if not path.endswith("pallas_call"):
+                continue
+            cand: Optional[str] = None
+            if node.args and isinstance(node.args[0], ast.Name):
+                cand = node.args[0].id
+            fn = fns_by_name.get(cand or "")
+            if fn is not None and id(fn) not in seen:
+                seen.add(id(fn))
+                roots.append((fn, set(), fn.name, "pallas_call"))
+        return [(fn, st, qn, how) for fn, st, qn, how in roots]
+
+    @staticmethod
+    def _jit_decorator(fn: ast.FunctionDef, aliases: dict,
+                       jit_aliases: set) -> Optional[set]:
+        """The static-param set if ``fn`` is jit-decorated, else None."""
+        for dec in fn.decorator_list:
+            if isinstance(dec, (ast.Name, ast.Attribute)):
+                path = dotted(dec, aliases)
+                tail = path.rsplit(".", 1)[-1]
+                if tail in ("jit", "pjit"):
+                    return set()
+                if isinstance(dec, ast.Name) and dec.id in jit_aliases:
+                    return set()  # conservatively: no static info
+            elif isinstance(dec, ast.Call):
+                if _contains_jit(dec, aliases):
+                    return _static_names_from_call(dec, fn)
+                if isinstance(dec.func, ast.Name) \
+                        and dec.func.id in jit_aliases:
+                    return _static_names_from_call(dec, fn)
+        return None
+
+    # -- body checks --
+
+    @staticmethod
+    def _target_names(t: ast.AST) -> Iterable[tuple]:
+        """(name, is_binding) for names STORED by an assignment target.
+        For ``lperm[lvl] = v`` the stored name is ``lperm`` — the index
+        ``lvl`` is a read and must not pick up the value's taint — and
+        a subscript/attribute store is a mutation, not a local binding
+        (``_CACHE[k] = v`` must still read as a non-local store)."""
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                yield from JitPurityRule._target_names(el)
+        elif isinstance(t, ast.Starred):
+            yield from JitPurityRule._target_names(t.value)
+        elif isinstance(t, ast.Name):
+            yield t.id, True
+        else:
+            base = t
+            while isinstance(base, (ast.Subscript, ast.Attribute)):
+                base = base.value
+            if isinstance(base, ast.Name):
+                yield base.id, False
+
+    def _check_body(self, mod: Module, fn: ast.FunctionDef, static: set,
+                    qual: str, how: str, aliases: dict,
+                    findings: list) -> None:
+        params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)}
+        if fn.args.vararg:
+            params.add(fn.args.vararg.arg)
+        tainted = set(params) - set(static)
+        local: set = set(params)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                value = node.value
+                is_tainted = value is not None and \
+                    self._expr_tainted(value, tainted)
+                for t in targets:
+                    for nm, binds in self._target_names(t):
+                        if binds:
+                            local.add(nm)
+                        if is_tainted:
+                            tainted.add(nm)
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                tgt = node.target
+                it = node.iter
+                # range()/enumerate() loop vars are Python ints even
+                # when the bound expression is data-derived — a traced
+                # bound raises at the range() call, not in the body.
+                static_iter = isinstance(it, ast.Call) \
+                    and isinstance(it.func, ast.Name) \
+                    and it.func.id in ("range", "enumerate")
+                is_tainted = (not static_iter
+                              and self._expr_tainted(it, tainted))
+                for nm, binds in self._target_names(tgt):
+                    if binds:
+                        local.add(nm)
+                    if is_tainted:
+                        tainted.add(nm)
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        for sub in ast.walk(item.optional_vars):
+                            if isinstance(sub, ast.Name):
+                                local.add(sub.id)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                local.add(node.name)
+
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                findings.append(Finding(
+                    self.name, mod.relpath, node.lineno,
+                    node.col_offset, qual,
+                    f"{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                    " inside a jit-wrapped function: closure mutation "
+                    "runs at trace time only"))
+            elif isinstance(node, ast.Call):
+                self._check_call(mod, node, qual, aliases, findings)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    base = t
+                    while isinstance(base, (ast.Subscript,
+                                            ast.Attribute)):
+                        base = base.value
+                    if isinstance(base, ast.Name) \
+                            and base.id not in local \
+                            and base is not t:
+                        findings.append(Finding(
+                            self.name, mod.relpath, t.lineno,
+                            t.col_offset, qual,
+                            f"store into non-local {base.id!r} from a "
+                            "jit-wrapped function: the write happens "
+                            "once at trace time, not per call"))
+            elif isinstance(node, (ast.If, ast.While)):
+                hit = self._branch_taint(node.test, tainted)
+                if hit:
+                    findings.append(Finding(
+                        self.name, mod.relpath, node.test.lineno,
+                        node.test.col_offset, qual,
+                        f"Python {'if' if isinstance(node, ast.If) else 'while'}"
+                        f" on traced value {hit!r}: under jit this "
+                        "raises or freezes one branch at trace time — "
+                        "use jnp.where / lax.cond / lax.while_loop, or "
+                        "mark the argument static"))
+
+    def _check_call(self, mod: Module, call: ast.Call, qual: str,
+                    aliases: dict, findings: list) -> None:
+        path = dotted(call.func, aliases)
+        if path:
+            head = path.split(".", 1)[0]
+            for banned in J1_BANNED_CALLS:
+                if path == banned or path.startswith(banned + ".") \
+                        or head == banned:
+                    findings.append(Finding(
+                        self.name, mod.relpath, call.lineno,
+                        call.col_offset, qual,
+                        f"impure call {path}() inside a jit-wrapped "
+                        "function: executes at trace time only (and "
+                        "never on the device)"))
+                    return
+        if isinstance(call.func, ast.Attribute):
+            base = call.func.value
+            if isinstance(base, ast.Name) \
+                    and base.id in J1_REGISTRY_NAMES:
+                findings.append(Finding(
+                    self.name, mod.relpath, call.lineno,
+                    call.col_offset, qual,
+                    f"metrics-registry call {base.id}."
+                    f"{call.func.attr}() inside a jit-wrapped "
+                    "function: increments fire at trace time, not per "
+                    "execution — record metrics outside the program"))
+
+    @staticmethod
+    def _expr_tainted(expr: ast.AST, tainted: set) -> bool:
+        return JitPurityRule._first_taint(expr, tainted) is not None
+
+    @staticmethod
+    def _first_taint(expr: ast.AST, tainted: set):
+        """First tainted Name reached WITHOUT passing through a
+        static-information accessor (.shape/.dtype/len()/is None)."""
+
+        def walk(node: ast.AST):
+            if isinstance(node, ast.Attribute):
+                if node.attr in J1_STATIC_ATTRS:
+                    return None
+                return walk(node.value)
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id in J1_STATIC_CALLS:
+                    return None
+                for a in list(node.args) + [kw.value for kw in
+                                            node.keywords]:
+                    hit = walk(a)
+                    if hit:
+                        return hit
+                return walk(node.func) if not isinstance(
+                    node.func, ast.Name) else None
+            if isinstance(node, ast.Compare):
+                # `x is None` / `x is not None` resolve at trace time.
+                if all(isinstance(op, (ast.Is, ast.IsNot))
+                       for op in node.ops):
+                    return None
+                hit = walk(node.left)
+                if hit:
+                    return hit
+                for c in node.comparators:
+                    hit = walk(c)
+                    if hit:
+                        return hit
+                return None
+            if isinstance(node, ast.Name):
+                return node.id if node.id in tainted else None
+            for child in ast.iter_child_nodes(node):
+                hit = walk(child)
+                if hit:
+                    return hit
+            return None
+
+        return walk(expr)
+
+    @staticmethod
+    def _branch_taint(test: ast.AST, tainted: set):
+        return JitPurityRule._first_taint(test, tainted)

@@ -1,0 +1,189 @@
+"""R1: journal/trace record-kind exhaustiveness.
+
+Two record streams survive a process: the durable journal
+(store/journal.py — ``journal.apply("<kind>", obj)``) and the flight-
+recorder trace (replay/trace.py — ``{"f": "<kind>", ...}`` frames).
+Both are replayed by OTHER code: rebuild_engine dispatches journal
+kinds through ``_CREATE`` + explicit special cases, and the replayer/
+reader dispatch trace frame kinds. A kind emitted without a registered
+handler is silently dropped on rebuild/replay — admissions that
+"existed" before the crash simply never happen after it, with no error
+anywhere.
+
+This is a cross-file rule:
+  * emit sites: every ``<x>.apply("<literal>", ...)`` /
+    ``<x>.delete("<literal>", ...)`` call tree-wide where the receiver
+    mentions ``journal``, and every dict literal containing an
+    ``"f": "<literal>"`` entry inside the trace-writer files;
+  * handlers: keys of the ``_CREATE`` dict, string literals compared
+    against ``kind`` / ``rec["kind"]`` / ``frame["f"]`` (== and ``in``
+    memberships) in the handler files, and kinds declared in an
+    ``EPHEMERAL_KINDS`` set (emitted-by-design with no rebuild effect —
+    the declaration is the justification).
+
+Every emitted kind missing from handlers ∪ ephemeral is reported at
+its emit site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.core import (
+    Finding,
+    Module,
+    Rule,
+    enclosing_function,
+)
+
+
+def _mentions_journal(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and "journal" in node.attr:
+            return True
+        if isinstance(node, ast.Name) and "journal" in node.id:
+            return True
+    return False
+
+
+def _handled_strings(tree: ast.Module, key_names: tuple) -> set:
+    """String literals a handler file dispatches on: compare/membership
+    against ``kind``-ish names or ``rec["kind"]`` / ``frame["f"]``
+    subscripts, plus ``_CREATE``/``EPHEMERAL_KINDS`` literal keys."""
+
+    def is_kind_expr(e: ast.AST) -> bool:
+        if isinstance(e, ast.Name) and e.id in key_names:
+            return True
+        if isinstance(e, ast.Subscript):
+            s = e.slice
+            return isinstance(s, ast.Constant) and s.value in key_names
+        if isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute) \
+                and e.func.attr == "get" and e.args:
+            a0 = e.args[0]
+            return isinstance(a0, ast.Constant) and a0.value in key_names
+        return False
+
+    out: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            if any(is_kind_expr(s) for s in sides):
+                for s in sides:
+                    if isinstance(s, ast.Constant) \
+                            and isinstance(s.value, str):
+                        out.add(s.value)
+                    elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                        for el in s.elts:
+                            if isinstance(el, ast.Constant) \
+                                    and isinstance(el.value, str):
+                                out.add(el.value)
+        elif isinstance(node, ast.Assign) \
+                and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id in ("_CREATE", "CREATE",
+                                           "EPHEMERAL_KINDS",
+                                           "_EPHEMERAL"):
+            v = node.value
+            if isinstance(v, ast.Dict):
+                for k in v.keys:
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str):
+                        out.add(k.value)
+            elif isinstance(v, (ast.Set, ast.Tuple, ast.List)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, str):
+                        out.add(el.value)
+            elif isinstance(v, ast.Call):   # frozenset({...})
+                for sub in ast.walk(v):
+                    if isinstance(sub, ast.Constant) \
+                            and isinstance(sub.value, str):
+                        out.add(sub.value)
+    return out
+
+
+class KindExhaustivenessRule(Rule):
+    name = "R1"
+    title = "journal/trace kinds must have replay handlers"
+    cross_file = True
+    rationale = (
+        "The journal and the flight-recorder trace are both replayed "
+        "by code far from the emit site: rebuild_engine dispatches "
+        "journal kinds (store/journal.py _CREATE + special cases) and "
+        "the replayer dispatches trace frame kinds. An emitted kind "
+        "with no handler is silently dropped on rebuild — state that "
+        "existed before a crash never comes back, and nothing errors. "
+        "Kinds that are emitted-by-design with no rebuild effect must "
+        "say so by joining EPHEMERAL_KINDS in store/journal.py; the "
+        "declaration is the reviewable justification.")
+    example = (
+        "    # engine.py — emits a new kind\n"
+        "    self.journal.apply(\"pod_group\", obj)   # BAD until...\n"
+        "    # store/journal.py — ...a handler (or ephemeral "
+        "declaration) exists\n"
+        "    _CREATE = {..., \"pod_group\": \"create_pod_group\"}\n"
+        "    EPHEMERAL_KINDS = frozenset({\"cycle_trace\"})")
+
+    def __init__(self, journal_handler_files: tuple,
+                 trace_handler_files: tuple):
+        self.journal_handler_files = journal_handler_files
+        self.trace_handler_files = trace_handler_files
+
+    def check_tree(self, modules: list[Module]) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        by_rel = {m.relpath: m for m in modules}
+
+        journal_handled: set = set()
+        for rel in self.journal_handler_files:
+            m = by_rel.get(rel)
+            if m is not None:
+                journal_handled |= _handled_strings(m.tree, ("kind",))
+        trace_handled: set = set()
+        for rel in self.trace_handler_files:
+            m = by_rel.get(rel)
+            if m is not None:
+                trace_handled |= _handled_strings(m.tree, ("f", "kind"))
+
+        have_journal_handlers = any(r in by_rel for r in
+                                    self.journal_handler_files)
+        have_trace_handlers = any(r in by_rel for r in
+                                  self.trace_handler_files)
+
+        for mod in modules:
+            for node in ast.walk(mod.tree):
+                if have_journal_handlers and isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("apply", "delete") \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str) \
+                        and _mentions_journal(node.func.value):
+                    kind = node.args[0].value
+                    if kind not in journal_handled:
+                        findings.append(Finding(
+                            self.name, mod.relpath, node.lineno,
+                            node.col_offset,
+                            enclosing_function(mod.tree, node),
+                            f"journal kind {kind!r} is emitted but has "
+                            "no rebuild handler in "
+                            f"{self.journal_handler_files[0]} — add a "
+                            "_CREATE entry / special case, or declare "
+                            "it in EPHEMERAL_KINDS with a comment"))
+                elif have_trace_handlers and isinstance(node, ast.Dict) \
+                        and mod.relpath in self.trace_handler_files:
+                    for k, v in zip(node.keys, node.values):
+                        if isinstance(k, ast.Constant) \
+                                and k.value == "f" \
+                                and isinstance(v, ast.Constant) \
+                                and isinstance(v.value, str):
+                            if v.value not in trace_handled:
+                                findings.append(Finding(
+                                    self.name, mod.relpath,
+                                    node.lineno, node.col_offset,
+                                    enclosing_function(mod.tree, node),
+                                    f"trace frame kind {v.value!r} is "
+                                    "written but never dispatched by "
+                                    "the replayer/reader — replays "
+                                    "would silently skip it"))
+        return findings

@@ -1,0 +1,103 @@
+"""O1: observability is write-only.
+
+The tracing contract (obs/hooks.py) is that a traced run's decision
+digest is byte-identical to an untraced run: obs code may observe cycle
+artifacts and append rationale, but must never mutate engine/snapshot
+state or write the durable store. A single mutator call from a hook
+turns the tracer into a scheduling participant — the digest-neutrality
+tests would catch the divergence but point nowhere near the cause.
+
+Checks (zone ``kueue_tpu/obs/``):
+  * calls to engine/snapshot mutators (schedule_once, submit,
+    add_usage, begin_cycle, ... — config.O1_MUTATOR_CALLS);
+  * attribute stores on engine receivers (``engine.x = ...`` /
+    ``eng.x = ...`` / ``self.engine.x = ...``) outside attachment
+    lifecycle functions (__init__/attach/detach/close);
+  * durable-store writes (``*.journal.apply`` / ``*.journal.delete``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.config import (
+    O1_ATTACH_OK,
+    O1_ENGINE_NAMES,
+    O1_MUTATOR_CALLS,
+)
+from tools.graftlint.core import Finding, Module, Rule, enclosing_function
+
+
+def _engine_receiver(expr: ast.AST) -> bool:
+    """True for ``engine`` / ``eng`` / ``self.engine`` receivers."""
+    if isinstance(expr, ast.Name) and expr.id in O1_ENGINE_NAMES:
+        return True
+    return (isinstance(expr, ast.Attribute)
+            and expr.attr in O1_ENGINE_NAMES)
+
+
+class ObsWriteOnlyRule(Rule):
+    name = "O1"
+    title = "observability hooks are write-only"
+    rationale = (
+        "obs/ attaches to the engine purely observationally: rationale "
+        "buffers are append-only, span trees are built from artifacts "
+        "the cycle already produced, and nothing may feed back into a "
+        "decision. This is what keeps a traced run decision-digest-"
+        "identical to an untraced run (tests/test_obs_trace.py, the "
+        "trace_overhead bench budget). A mutator call or an engine-"
+        "attribute write from obs code breaks that contract in ways "
+        "the digest tests detect but cannot localize.")
+    example = (
+        "    def _on_cycle(self, seq, result):\n"
+        "        self.engine.schedule_once()      # BAD: drives the "
+        "engine\n"
+        "        snap.add_usage(vals, reqs, n)    # BAD: mutates state\n"
+        "        eng.journal.apply(\"x\", obj)      # BAD: durable "
+        "write\n"
+        "        hooks.emit(\"tas\", key, after=v)  # GOOD: append-only")
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                recv = node.func.value
+                if attr in O1_MUTATOR_CALLS:
+                    qual = enclosing_function(mod.tree, node)
+                    findings.append(Finding(
+                        self.name, mod.relpath, node.lineno,
+                        node.col_offset, qual,
+                        f"engine/snapshot mutator .{attr}() called "
+                        "from the obs zone — observability must stay "
+                        "write-only (digest neutrality)"))
+                elif attr in ("apply", "delete") \
+                        and isinstance(recv, ast.Attribute) \
+                        and recv.attr == "journal":
+                    qual = enclosing_function(mod.tree, node)
+                    findings.append(Finding(
+                        self.name, mod.relpath, node.lineno,
+                        node.col_offset, qual,
+                        f"durable-store write journal.{attr}() from "
+                        "the obs zone — obs may only append to "
+                        "rationale/trace buffers"))
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and _engine_receiver(t.value):
+                        qual = enclosing_function(mod.tree, t)
+                        leaf = qual.rsplit(".", 1)[-1] if qual else ""
+                        if leaf in O1_ATTACH_OK or any(
+                                leaf.startswith(p)
+                                for p in ("attach", "detach")):
+                            continue
+                        findings.append(Finding(
+                            self.name, mod.relpath, t.lineno,
+                            t.col_offset, qual,
+                            f"attribute store engine.{t.attr} = ... "
+                            "outside the "
+                            "attach/detach lifecycle — obs code must "
+                            "not reconfigure the engine mid-flight"))
+        return findings

@@ -1,0 +1,138 @@
+"""U1: undo-log discipline for snapshot/TAS state.
+
+The per-cycle undo scope (tas/snapshot.py begin_cycle/end_cycle,
+cache/snapshot.py Snapshot.close) reverts every in-cycle mutation by
+replaying a delta log in reverse. That only works if every mutation of
+the guarded state actually LANDS in the log: a direct
+``leaf.tas_usage[res] = v`` from anywhere but the custodian functions
+survives end_cycle() and corrupts the live prototype for every later
+cycle — the exact bug class the zero-copy snapshot share (PR 1) makes
+possible.
+
+Check: in U1 zones, any store to (or mutating method call on) a guarded
+attribute — ``tas_usage`` / ``free_capacity`` / ``usage`` — or a local
+alias bound from one, outside the custodian allowlist
+(config.U1_CUSTODIANS), is a finding. Reads are always fine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.config import (
+    MUTATOR_METHODS,
+    U1_GUARDED_ATTRS,
+)
+from tools.graftlint.core import Finding, Module, Rule
+
+
+class UndoLogRule(Rule):
+    name = "U1"
+    title = "undo-log discipline for snapshot/TAS state"
+    rationale = (
+        "Scheduling cycles mutate the LIVE snapshot prototypes inside "
+        "an undo scope (tas/snapshot.py begin_cycle, cache/snapshot.py "
+        "build_snapshot) and revert by replaying the delta log in "
+        "reverse. A write to guarded state (tas_usage, free_capacity, "
+        "node usage) that bypasses the logging custodians "
+        "(_apply_deltas, commit_usage, add_usage_fr, ...) survives the "
+        "revert, silently corrupting capacity accounting for every "
+        "subsequent cycle. No test catches this at the write site — "
+        "the damage surfaces cycles later as phantom usage.")
+    example = (
+        "    def place(self, leaf, res, n):\n"
+        "        leaf.tas_usage[res] = n        # BAD: bypasses the "
+        "delta log\n"
+        "        u = leaf.tas_usage\n"
+        "        u.update(more)                 # BAD: alias mutation\n"
+        "        self._apply_deltas(leaf, {res: n})  # GOOD: logged, "
+        "revertable")
+
+    def __init__(self, custodians: frozenset):
+        self.custodians = custodians
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        self._scan(mod, mod.tree, "", None, findings, False)
+        return findings
+
+    def _scan(self, mod: Module, scope: ast.AST, qual: str,
+              fname, findings: list, inherited: bool = False) -> None:
+        """Walk one scope; ``fname`` is the bare name of the enclosing
+        function (None at module level). ``inherited`` carries custodian
+        status into nested helpers — a closure defined inside
+        clone_domains IS part of the custodian."""
+        aliases: set = set()   # locals bound from <expr>.<guarded>
+
+        def guarded_base(expr: ast.AST):
+            """The guarded attribute name if ``expr`` denotes guarded
+            state (attribute access or alias), else None."""
+            if isinstance(expr, ast.Attribute) \
+                    and expr.attr in U1_GUARDED_ATTRS:
+                return expr.attr
+            if isinstance(expr, ast.Name) and expr.id in aliases:
+                return expr.id
+            return None
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                q = f"{qual}.{node.name}" if qual else node.name
+                self._scan(mod, node, q, node.name, findings,
+                           inherited or fname in self.custodians)
+                return
+            if isinstance(node, ast.ClassDef):
+                q = f"{qual}.{node.name}" if qual else node.name
+                for child in node.body:
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        self._scan(mod, child, f"{q}.{child.name}",
+                                   child.name, findings, False)
+                    else:
+                        visit(child)
+                return
+            exempt = inherited or fname in self.custodians
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Attribute) \
+                    and node.value.attr in U1_GUARDED_ATTRS:
+                aliases.add(node.targets[0].id)
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    attr = None
+                    if isinstance(t, ast.Subscript):
+                        attr = guarded_base(t.value)
+                    elif isinstance(t, ast.Attribute) \
+                            and t.attr in U1_GUARDED_ATTRS:
+                        attr = t.attr
+                    if attr is not None and not exempt:
+                        findings.append(Finding(
+                            self.name, mod.relpath, t.lineno,
+                            t.col_offset, qual,
+                            f"direct write to guarded state "
+                            f"{attr!r} outside the undo-log "
+                            "custodians — route through "
+                            "_apply_deltas/commit_usage (or register "
+                            "the function as a custodian with a "
+                            "justification)"))
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in MUTATOR_METHODS:
+                attr = guarded_base(node.func.value)
+                if attr is not None and not exempt:
+                    findings.append(Finding(
+                        self.name, mod.relpath, node.lineno,
+                        node.col_offset, qual,
+                        f"mutating call .{node.func.attr}() on guarded "
+                        f"state {attr!r} outside the undo-log "
+                        "custodians — route through "
+                        "_apply_deltas/commit_usage"))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        body = scope.body if hasattr(scope, "body") else []
+        for stmt in body:
+            visit(stmt)

@@ -1,0 +1,138 @@
+"""Validator adapters: the ad-hoc checkers (tools/promcheck.py,
+tools/trace_schema.py) surfaced through the graftlint runner/reporter,
+so ``make lint`` is one entry point with one exit code and one JSON
+report.
+
+Two modes:
+  * file mode (``--metrics FILE`` / ``--trace-json FILE``): validate an
+    artifact on disk, one V1/V2 finding per validator error;
+  * self-check mode (``--self-check``): build the artifacts in-process
+    — render a default metrics registry exposition and export a
+    synthetic span tree through the real Perfetto writer — then
+    validate them. This proves the *emitters* and the *validators*
+    agree without needing a serving process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from typing import Iterable
+
+from tools.graftlint.core import Finding
+
+# promcheck/trace_schema live beside the package; they self-import via
+# script-style sys.path, so reach them the same way.
+_TOOLS_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+
+def _prom_findings(text: str, label: str) -> Iterable[Finding]:
+    from promcheck import check_exposition
+
+    for err in check_exposition(text):
+        line = 0
+        if err.startswith("line "):
+            try:
+                line = int(err.split(":", 1)[0].split()[1])
+            except (ValueError, IndexError):
+                line = 0
+        yield Finding("V1", label, line, 0, "",
+                      f"prometheus exposition: {err}")
+
+
+def _trace_findings(obj, label: str) -> Iterable[Finding]:
+    from trace_schema import check_trace_events
+
+    for err in check_trace_events(obj):
+        yield Finding("V2", label, 0, 0, "",
+                      f"trace-event JSON: {err}")
+
+
+def check_metrics_file(path: str) -> list[Finding]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError as e:
+        return [Finding("V1", path, 0, 0, "", f"unreadable: {e}")]
+    return list(_prom_findings(text, path))
+
+
+def check_trace_file(path: str) -> list[Finding]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            obj = json.load(fh)
+    except OSError as e:
+        return [Finding("V2", path, 0, 0, "", f"unreadable: {e}")]
+    except json.JSONDecodeError as e:
+        return [Finding("V2", path, 0, 0, "", f"not JSON: {e}")]
+    return list(_trace_findings(obj, path))
+
+
+def self_check() -> list[Finding]:
+    """Validate the live emitters against the validators without a
+    serving process: a default-family registry exposition (label
+    escaping / histogram invariants included) and a real Perfetto
+    export of a synthetic span tree."""
+    findings: list[Finding] = []
+    try:
+        from kueue_tpu.metrics.registry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        # Exercise the escaping path the validator exists to police.
+        reg.counter("admission_attempts_total").inc(
+            ((("result", 'esc"aped\\name\nnewline'),),))
+        reg.histogram(
+            "admission_attempt_duration_seconds").observe(0.01, ())
+        findings.extend(_prom_findings(reg.render(),
+                                       "<self-check:/metrics>"))
+    except Exception as e:  # noqa: BLE001 — report, don't crash lint
+        findings.append(Finding(
+            "V1", "<self-check:/metrics>", 0, 0, "",
+            f"registry self-check failed to run: {e!r}"))
+    try:
+        from kueue_tpu.obs.perfetto import to_perfetto
+        from kueue_tpu.obs.span import Span
+
+        root = Span("cycle/0", "cycle", 0.0, 120.0,
+                    {"seq": 0, "cid": "selfcheck", "mode": "sequential",
+                     "clock": 0.0, "admitted": 1, "preempting": 0,
+                     "skipped": 0, "inadmissible": 0})
+        root.child("phase/decide", "phase", 0.0, 100.0, seconds=1e-4)
+        doc = to_perfetto([root])
+        findings.extend(_trace_findings(doc, "<self-check:trace>"))
+    except Exception as e:  # noqa: BLE001
+        findings.append(Finding(
+            "V2", "<self-check:trace>", 0, 0, "",
+            f"perfetto self-check failed to run: {e!r}"))
+    return findings
+
+
+def validator_main(check_fn, argv, label: str) -> int:
+    """Shared CLI shim for promcheck/trace_schema: route their errors
+    through the graftlint reporter so both scripts and ``make lint``
+    print the same format and exit codes."""
+    from tools.graftlint.core import RunResult
+    from tools.graftlint.report import render_text
+
+    if len(argv) != 2:
+        print(f"usage: {label} <file | ->", file=sys.stderr)
+        return 2
+    if argv[1] == "-":
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=label, delete=False,
+                encoding="utf-8") as tmp:
+            tmp.write(sys.stdin.read())
+            path = tmp.name
+        try:
+            findings = check_fn(path)
+        finally:
+            os.unlink(path)
+    else:
+        findings = check_fn(argv[1])
+    result = RunResult(findings=findings, files=1)
+    render_text(result, sys.stderr if findings else sys.stdout)
+    return 1 if findings else 0

@@ -233,19 +233,16 @@ def _check_histograms(samples: list, types: dict, errors: list) -> None:
 
 
 def main(argv) -> int:
-    if len(argv) != 2:
-        print("usage: promcheck.py <metrics.txt | ->", file=sys.stderr)
-        return 2
-    text = (sys.stdin.read() if argv[1] == "-"
-            else open(argv[1], encoding="utf-8").read())
-    errors = check_exposition(text)
-    for e in errors:
-        print(e, file=sys.stderr)
-    if errors:
-        print(f"promcheck: {len(errors)} error(s)", file=sys.stderr)
-        return 1
-    print("promcheck OK")
-    return 0
+    # CLI routes through the graftlint reporter so promcheck,
+    # trace_schema and `make lint` share one output format and exit-code
+    # contract (the library surface above is unchanged).
+    import os
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from tools.graftlint.validators import check_metrics_file, \
+        validator_main
+    return validator_main(check_metrics_file, argv, "promcheck")
 
 
 if __name__ == "__main__":

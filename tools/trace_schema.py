@@ -20,7 +20,6 @@ valid). CLI: reads a JSON file, prints errors, exits non-zero on any.
 
 from __future__ import annotations
 
-import json
 import sys
 
 _PHASES = {"X", "i", "M"}
@@ -74,26 +73,16 @@ def check_trace_events(obj) -> list:
 
 
 def main(argv) -> int:
-    if len(argv) != 2:
-        print("usage: trace_schema.py <trace.json | ->",
-              file=sys.stderr)
-        return 2
-    text = (sys.stdin.read() if argv[1] == "-"
-            else open(argv[1], encoding="utf-8").read())
-    try:
-        obj = json.loads(text)
-    except json.JSONDecodeError as e:
-        print(f"trace_schema: not JSON: {e}", file=sys.stderr)
-        return 1
-    errors = check_trace_events(obj)
-    for e in errors:
-        print(e, file=sys.stderr)
-    if errors:
-        print(f"trace_schema: {len(errors)} error(s)", file=sys.stderr)
-        return 1
-    n = len(obj["traceEvents"])
-    print(f"trace_schema OK ({n} events)")
-    return 0
+    # CLI routes through the graftlint reporter so promcheck,
+    # trace_schema and `make lint` share one output format and exit-code
+    # contract (the library surface above is unchanged).
+    import os
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from tools.graftlint.validators import check_trace_file, \
+        validator_main
+    return validator_main(check_trace_file, argv, "trace_schema")
 
 
 if __name__ == "__main__":
