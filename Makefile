@@ -6,7 +6,7 @@ PY ?= python
 PYTEST_FLAGS ?= -q
 
 .PHONY: all native test test-fast test-device bench multichip-dryrun \
-  replay-smoke obs-smoke lint clean
+  replay-smoke obs-smoke tas-smoke lint clean
 
 all: native
 
@@ -53,6 +53,16 @@ lint:
 # lint runs first: replaying a tree that violates D1 proves nothing.
 replay-smoke: lint
 	JAX_PLATFORMS=cpu $(PY) tools/replay_smoke.py
+
+# Batched-TAS smoke: drain one TAS world with the batched planner on
+# and off (subprocess per arm), assert the batched arm ran device
+# cycles AND that admissions + topology assignments are byte-identical
+# across the toggle, then run the TAS equivalence suite. lint first:
+# the planner lives in a D1 determinism zone.
+tas-smoke: lint
+	JAX_PLATFORMS=cpu $(PY) tools/tas_smoke.py
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tas_batched.py \
+	  tests/test_tas_device.py $(PYTEST_FLAGS)
 
 # Observability smoke: tracer + serving endpoint, 50-workload admit,
 # /metrics scrape validated by tools/promcheck, Perfetto export

@@ -118,13 +118,22 @@ def _device_share(eng) -> dict:
     b = eng.oracle
     if b is None:
         return {}
-    return {
+    out = {
         "device_cycles": b.cycles_on_device,
         "fallback_cycles": b.cycles_fallback,
         "hybrid_cycles": b.cycles_hybrid,
         "fallback_reasons": dict(b.fallback_reasons),
         "host_root_reasons": dict(b.host_root_reasons),
     }
+    stats = getattr(b, "tas_stats", None)
+    if stats and stats.get("plan_cycles"):
+        out["tas_stats"] = {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in stats.items()}
+        out["batched_heads_per_launch"] = {
+            str(k): v
+            for k, v in sorted(b.tas_heads_per_launch.items())}
+    return out
 
 
 def build_cycle_engine(scen, fair=False):
@@ -554,13 +563,11 @@ def bench_tas(n_workloads, n_cqs=8):
     elapsed = time.perf_counter() - t0
     value = admitted / elapsed if elapsed > 0 else 0.0
 
-    # Honest path label + measured crossover: which TAS implementation
-    # placed these pod sets, and what one placement costs on each at
-    # this forest size (tas/device.py DEVICE_TAS_MIN_DOMAINS).
-    from kueue_tpu.tas.device import (
-        DEVICE_TAS_MIN_DOMAINS,
-        worth_offloading,
-    )
+    # Honest path label + measured crossover: which per-placement TAS
+    # implementation a lone descent would use, and what one placement
+    # costs on each at this forest size (persisted by the probe into
+    # tas/calibration.py, consulted by tas/device.worth_offloading).
+    from kueue_tpu.tas.device import worth_offloading
     snap = next(iter(eng.cache.tas_prototypes().values()), None)
     path = "device" if (snap is not None and worth_offloading(snap)) \
         else "host"
@@ -572,7 +579,6 @@ def bench_tas(n_workloads, n_cqs=8):
                    "admitted": admitted,
                    "elapsed_s": round(elapsed, 3),
                    "tas_path": path,
-                   "device_crossover_domains": DEVICE_TAS_MIN_DOMAINS,
                    **xover,
                    **_device_share(eng)},
     }
@@ -656,10 +662,7 @@ def bench_tas_large(n_workloads=120, blocks=8, racks=16, hosts=40,
     elapsed = time.perf_counter() - t0
     value = admitted / elapsed if elapsed > 0 else 0.0
 
-    from kueue_tpu.tas.device import (
-        DEVICE_TAS_MIN_DOMAINS,
-        worth_offloading,
-    )
+    from kueue_tpu.tas.device import worth_offloading
     snap = next(iter(eng.cache.tas_prototypes().values()), None)
     path = "device" if (snap is not None and worth_offloading(snap)) \
         else "host"
@@ -676,7 +679,6 @@ def bench_tas_large(n_workloads=120, blocks=8, racks=16, hosts=40,
                    # apples-to-apples comparison).
                    "baseline_nodes": 640,
                    "tas_path": path,
-                   "device_crossover_domains": DEVICE_TAS_MIN_DOMAINS,
                    **xover,
                    **_device_share(eng)},
     }
@@ -809,12 +811,15 @@ def bench_tas_churn(n_cqs=32, blocks=8, racks=16, hosts=40,
 
 def _tas_crossover_measure(build, n_probe: int = 5) -> dict:
     """Per-placement latency of the host descent vs the device kernel on
-    the SAME 640-leaf forest — the measurement behind the
-    DEVICE_TAS_MIN_DOMAINS crossover choice."""
+    the SAME forest — the measurement behind the host/device crossover.
+    The probe persists its result via tas/calibration.py so subsequent
+    runs (and the serving path's worth_offloading) pick the winner for
+    this (backend, forest shape) without re-measuring."""
     import os
 
     from kueue_tpu.api.types import PodSet, PodSetTopologyRequest, \
         TopologyMode
+    from kueue_tpu.tas import calibration
     from kueue_tpu.tas.snapshot import TASPodSetRequest
 
     out = {}
@@ -847,6 +852,15 @@ def _tas_crossover_measure(build, n_probe: int = 5) -> dict:
                     os.environ.pop("KUEUE_TPU_DEVICE_TAS_MIN", None)
                 else:
                     os.environ["KUEUE_TPU_DEVICE_TAS_MIN"] = prior
+        if "host_place_ms" in out and "device_place_ms" in out:
+            import jax
+            nl = len(snap.level_keys)
+            leaves = len(snap.domains_per_level[nl - 1])
+            path = calibration.save(
+                jax.default_backend(), nl, leaves,
+                out["host_place_ms"], out["device_place_ms"])
+            calibration.invalidate_cache()
+            out["crossover_record"] = path or "unwritable"
     except Exception as exc:  # noqa: BLE001 — diagnostics only
         out["crossover_probe_error"] = repr(exc)[:120]
     return out

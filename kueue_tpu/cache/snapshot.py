@@ -438,14 +438,20 @@ class Snapshot:
                 # results computed against the simulated (reverted)
                 # state — purge any not keyed at the restored version.
                 mc = getattr(tas, "_usage_matrix_cache", None)
-                if mc is not None and mc[0][0] != ver:
-                    tas._usage_matrix_cache = None
+                if mc:
+                    for k in [k for k in mc if k[0] != ver]:
+                        mc.pop(k)
                 jc = getattr(tas, "_j_usage_cache", None)
                 if jc is not None and jc[0][0] != ver:
                     tas._j_usage_cache = None
                 pm = getattr(tas, "_place_memo", None)
                 if pm is not None and pm[0] != ver:
                     tas._place_memo = None
+                # The phase-1 memo (tas._p1) needs no purge here: usage
+                # writes during the simulation AND its revert both land
+                # the touched leaves in its stale set, and the next use
+                # recomputes exactly those — version restoration cannot
+                # alias it onto different state.
                 sm = getattr(tas, "_stats_memo", None)
                 if sm is not None and sm[0][1] != ver:
                     tas._stats_memo = None

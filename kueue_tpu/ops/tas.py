@@ -702,3 +702,46 @@ def tas_feasibility(free, usage, per_pod, count, slice_size, slice_level,
     fit_arg = jnp.where(m == 0, at_req_max,
                         jnp.where(m == 2, at_req_sum, sum0))
     return fit, fit_arg
+
+
+# ---------------------------------------------------------------------------
+# Batched placement: one launch runs tas_place for every TAS head of a
+# hybrid cycle that resolved to the same selection statics (requested /
+# slice level, required / unconstrained flags), vmapped over the
+# per-head request vectors against one shared forest. All heads place
+# against the cycle-start usage — exactly the semantics of the
+# sequential nominate loop, whose assignments are also computed against
+# the cycle snapshot before any entry commits — so the batch needs no
+# inter-head state threading; commit-order conflicts are handled by the
+# caller's overlay re-check, like _process_entry's fits() re-check.
+# Leaderless, ungrouped requests only (the demotion matrix sends the
+# rest to the host walk).
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=(
+    "num_levels", "max_domains", "pods_col", "req_level", "slice_level",
+    "required", "unconstrained"))
+def tas_place_batch(free, usage, per_pod, leaf_mask, count, slice_size,
+                    has_pods_cap, valid, vrank, parent, *, num_levels,
+                    max_domains, pods_col, req_level, slice_level,
+                    required, unconstrained):
+    """vmap of tas_place over B leaderless head requests.
+
+    free/usage: int64[M, S] shared; per_pod: int64[B, S];
+    leaf_mask: bool[B, M]; count/slice_size: int64[B]; the rest as in
+    tas_place. Returns (status int64[B], fit_arg int64[B],
+    cnt int64[B, M], lead int64[B, M])."""
+    zero_assumed = jnp.zeros_like(usage)
+    zero_leader = jnp.zeros(per_pod.shape[1], jnp.int64)
+
+    def one(pp, lm, c, ss):
+        return tas_place(
+            free, usage, zero_assumed, pp, zero_leader, lm,
+            has_pods_cap, valid, vrank, parent, c, ss,
+            num_levels=num_levels, max_domains=max_domains,
+            pods_col=pods_col, req_level=req_level,
+            slice_level=slice_level, required=required,
+            unconstrained=unconstrained, has_leader=False)
+
+    return jax.vmap(one)(per_pod, leaf_mask, count, slice_size)

@@ -72,12 +72,25 @@ from kueue_tpu.scheduler.flavorassigner import (
 _HOST_BIG = np.int64(1) << 60
 
 
+def _flavor_taint_unsafe(rf) -> bool:
+    """A flavor whose workloads must take the host path regardless of
+    the batched TAS planner: taints need the host toleration
+    matching."""
+    return rf is not None and bool(rf.node_taints)
+
+
 def _flavor_unsafe(rf) -> bool:
-    """A flavor whose workloads must take the host path: taints need the
-    host toleration matching, a topology needs the TAS pass. The single
-    predicate behind both the pre-snapshot world check and the per-root
-    demotion."""
+    """The legacy (batched TAS off) host-path predicate: taints AND
+    topologies demote. With the planner on, a topology alone no longer
+    demotes — tas/batched.plan_cycle nominates placements for TAS
+    heads inside the hybrid cycle and demotes per-head only when a
+    request needs an unsupported TAS feature."""
     return rf is not None and bool(rf.node_taints or rf.topology_name)
+
+
+def _flavor_predicate():
+    from kueue_tpu.tas import batched as _tb
+    return _flavor_taint_unsafe if _tb.enabled() else _flavor_unsafe
 
 
 class OracleBridge:
@@ -102,6 +115,13 @@ class OracleBridge:
         # (replay/trace.py records it per cycle for kernel-vs-apply
         # divergence attribution).
         self.last_verdict_digest: Optional[int] = None
+        # Batched-TAS planner accounting (bench tas/tas_large detail):
+        # per-phase totals and the heads-per-launch histogram.
+        self.tas_stats: dict[str, float] = {
+            "plan_cycles": 0, "heads_planned": 0, "placed_device": 0,
+            "placed_host": 0, "memo_hits": 0, "commit_drops": 0,
+            "encode_s": 0.0, "place_s": 0.0, "decode_s": 0.0}
+        self.tas_heads_per_launch: dict[int, int] = {}
 
     def world_is_fast_path_safe(self) -> bool:
         eng = self.engine
@@ -110,10 +130,12 @@ class OracleBridge:
             # BlockAdmission (scheduler.go:535): the host path owns the
             # hold-everything requeue bookkeeping.
             return False
-        # When EVERY CQ with pending work is flavor-unsafe (TAS/taints),
-        # every root would demote and the snapshot+solver built here
-        # would be thrown away — skip straight to the sequential path.
-        # Computed from the cache (no snapshot needed).
+        # When EVERY CQ with pending work is flavor-unsafe (taints, or
+        # TAS with the batched planner off), every root would demote
+        # and the snapshot+solver built here would be thrown away —
+        # skip straight to the sequential path. Computed from the
+        # cache (no snapshot needed).
+        unsafe = _flavor_predicate()
         any_safe = False
         any_pending = False
         for name, pcq in eng.queues.cluster_queues.items():
@@ -123,9 +145,9 @@ class OracleBridge:
             cq = eng.cache.cluster_queues.get(name)
             if cq is None:
                 continue
-            if not any(_flavor_unsafe(eng.cache.resource_flavors.get(
-                    fq.name))
-                    for rg in cq.resource_groups for fq in rg.flavors):
+            if not any(unsafe(eng.cache.resource_flavors.get(fq.name))
+                       for rg in cq.resource_groups
+                       for fq in rg.flavors):
                 any_safe = True
                 break
         if any_pending and not any_safe:
@@ -228,16 +250,37 @@ class OracleBridge:
         return cached[1]
 
     def _cq_flavor_safe(self, w) -> np.ndarray:
-        """bool[C]: none of the CQ's flavors carries taints or a topology
-        (those route through the host flavorassigner/TAS path)."""
+        """bool[C]: none of the CQ's flavors demotes to the host
+        flavorassigner path. With the batched TAS planner on, only
+        taints demote here; topology-carrying CQs stay and get their
+        placements from tas/batched.plan_cycle (which applies its own
+        per-head demotion matrix)."""
         eng = self.engine
+        unsafe = _flavor_predicate()
         safe = np.ones(w.num_cqs, bool)
         for ci, name in enumerate(w.cq_names):
             spec = eng.cache.cluster_queues[name]
             safe[ci] = not any(
-                _flavor_unsafe(eng.cache.resource_flavors.get(fq.name))
+                unsafe(eng.cache.resource_flavors.get(fq.name))
                 for rg in spec.resource_groups for fq in rg.flavors)
         return safe
+
+    def _cq_tas_mask(self, w):
+        """bool[C] mask of CQs referencing at least one TAS flavor, or
+        None when the world has none (the common case — skips the
+        whole TAS planning block). Memoized by spec version."""
+        cached = getattr(self, "_tas_mask_cache", None)
+        ver = self.engine.cache.spec_version
+        if cached is None or cached[0] != ver:
+            from kueue_tpu.tas import batched as _tb
+            info = _tb.cq_tas_info(self.engine.cache)
+            mask = np.zeros(w.num_cqs, bool)
+            for ci, name in enumerate(w.cq_names):
+                if name in info:
+                    mask[ci] = True
+            cached = (ver, mask if mask.any() else None)
+            self._tas_mask_cache = cached
+        return cached[1]
 
     def _cq_policy_cfg(self, w):
         """Per-CQ preemption-policy encoding for the device classical
@@ -870,6 +913,70 @@ class OracleBridge:
             if pending_infos[head_wid[ci]].obj.has_closed_preemption_gate():
                 gated[ci] = True
         demote(gated, "preemption-gated")
+
+        # --- batched TAS planning (tas/batched.py) ---
+        # Nominate a topology assignment for every device-eligible TAS
+        # head BEFORE the quota kernel launches; heads needing an
+        # unsupported TAS feature (or whose placement fails) demote
+        # only their root. With the planner off (KUEUE_TPU_TAS_BATCH=0)
+        # _cq_flavor_safe already demoted every TAS CQ above.
+        from kueue_tpu.tas import batched as _tb
+        tas_plan = None
+        tas_cq = None
+        _t_tas0 = _time.perf_counter()
+        if _tb.enabled():
+            tas_cq = self._cq_tas_mask(w)
+            # The serving rows keep topology heads device-eligible on
+            # the planner's behalf (schema.serving_shape_eligible); a
+            # topology head on a CQ with no TAS flavor can't be placed
+            # by anyone — the host path owns its inadmissible verdict.
+            topo = np.zeros(C, bool)
+            for ci in np.nonzero(has_head)[0]:
+                inf = pending_infos[head_wid[ci]]
+                h = getattr(inf, "_has_topo_req", None)
+                if h is None:
+                    h = any(ps.topology_request is not None
+                            for ps in inf.obj.pod_sets)
+                    inf._has_topo_req = h
+                topo[ci] = h
+            demote(topo if tas_cq is None else (topo & ~tas_cq),
+                   "tas-flavor-mismatch")
+        if tas_cq is not None:
+            # Preemption-enabled TAS CQs: the host owns the
+            # PREEMPT -> simulate-empty ladder AND the sim-grid never
+            # sees TAS flavors (pre-demoting keeps sim_cq clean).
+            demote(has_head & tas_cq & ~w.no_preemption,
+                   "tas-preemption")
+            need = has_head & tas_cq & ~host_root[root_of_cq]
+            if need.any():
+                tas_plan = _tb.plan_cycle(eng, w, head_wid, need)
+                for reason, cis in sorted(tas_plan.demote.items()):
+                    m = np.zeros(C, bool)
+                    m[cis] = True
+                    demote(m, reason)
+                # Shared-forest closure: forests also committed by
+                # host-root TAS heads must serialize through one path.
+                closed = _tb.closure_demotions(
+                    tas_plan, _tb.cq_tas_info(eng.cache), w, has_head,
+                    tas_cq, host_root)
+                if closed:
+                    m = np.zeros(C, bool)
+                    m[closed] = True
+                    demote(m, "tas-forest-shared")
+                st = self.tas_stats
+                st["plan_cycles"] += 1
+                st["heads_planned"] += len(tas_plan.placements) + sum(
+                    len(v) for v in tas_plan.demote.values())
+                st["placed_device"] += tas_plan.placed_device
+                st["placed_host"] += tas_plan.placed_host
+                st["memo_hits"] += tas_plan.memo_hits
+                st["encode_s"] += tas_plan.timings["encode"]
+                st["place_s"] += tas_plan.timings["place"]
+                st["decode_s"] += tas_plan.timings["decode"]
+                for n in tas_plan.launch_sizes:
+                    self.tas_heads_per_launch[n] = \
+                        self.tas_heads_per_launch.get(n, 0) + 1
+        _t_tas = _time.perf_counter() - _t_tas0
         cq_on_device = ~host_root[root_of_cq]
 
         # Multi-flavor groups on preemption-enabled CQs: the flavor
@@ -1099,18 +1206,60 @@ class OracleBridge:
         self.last_verdict_digest = _vd
         _t_device = _time.perf_counter()
         _ann.phase("apply")
+
+        # Commit-order re-check for planned TAS admits: serialize them
+        # through the overlay (tas/batched.commit_plan); a nominated
+        # placement beaten to its leaves by an earlier slot DROPS its
+        # admit verdict — the batched form of the sequential commit
+        # skip. Dropped rows were never popped, so they simply stay
+        # pending for the next cycle.
+        tas_attach = None
+        tas_drops: list = []
+        wl_admitted = np.asarray(wl_admitted)
+        slot_position = np.asarray(slot_position)
+        flavor_of_res = np.asarray(flavor_of_res)
+        if tas_plan is not None and tas_plan.placements:
+            for _round in range(C + 1):
+                tas_attach, tas_drops, demote_cis = _tb.commit_plan(
+                    eng, w, wl, tas_plan, wl_admitted, slot_position,
+                    flavor_of_res, cq_on_device, W)
+                if not demote_cis:
+                    break
+                # A drop on a multi-CQ root invalidates the root's
+                # later quota verdicts; the host re-runs the root.
+                m = np.zeros(C, bool)
+                m[demote_cis] = True
+                demote(m, "tas-commit-conflict")
+                cq_on_device = ~host_root[root_of_cq]
+            if tas_drops:
+                self.tas_stats["commit_drops"] += len(tas_drops)
+                wl_admitted = wl_admitted.copy()
+                wl_admitted[tas_drops] = False
+
         apply_rows = device_w & cq_on_device[cq_safe_idx]
         result, finalize = self._apply(
             w, wl, pending_infos,
-            np.asarray(wl_admitted),
+            wl_admitted,
             np.asarray(new_inadmissible),
-            np.asarray(slot_position),
-            np.asarray(flavor_of_res),
+            slot_position,
+            flavor_of_res,
             apply_rows=apply_rows,
             slot_mask=cq_on_device,
             slot_preempting=np.asarray(slot_preempting),
             head_idx=np.asarray(head_idx),
-            preempt_targets=preempt_targets)
+            preempt_targets=preempt_targets,
+            tas_attach=tas_attach)
+        for i in tas_drops:
+            # Sequential stats/entry parity for commit skips
+            # (_process_entry's "no longer fits" verdict). Invisible to
+            # the canonical decision stream, like sequential skips.
+            e = Entry(info=pending_infos[i])
+            e.status = EntryStatus.SKIPPED
+            e.inadmissible_msg = (
+                "Workload no longer fits after processing another "
+                "workload")
+            result.entries.append(e)
+            result.stats.skipped += 1
         _t_apply = _time.perf_counter()
         _ann.phase("finalize")
         finalize()
@@ -1123,7 +1272,8 @@ class OracleBridge:
         _ann.close()
         phases = {"encode": _t_encode - _t0, "device": _t_device - _t_encode,
                   "apply": _t_apply - _t_device,
-                  "finalize": _t_final - _t_apply}
+                  "finalize": _t_final - _t_apply,
+                  "tas_place": _t_tas}
         eng.last_cycle_phases = phases
         for phase, dur in phases.items():
             eng.registry.histogram(
@@ -1161,7 +1311,7 @@ class OracleBridge:
     def _apply(self, w, wls, pending_infos, wl_admitted, parked,
                slot_position, flavor_of_res, apply_rows=None,
                slot_mask=None, slot_preempting=None,
-               head_idx=None, preempt_targets=None):
+               head_idx=None, preempt_targets=None, tas_attach=None):
         """Apply verdicts through the engine's assume path. Rows outside
         ``apply_rows`` / slots outside ``slot_mask`` belong to host roots
         and are left untouched (the sequential tail owns them).
@@ -1209,7 +1359,7 @@ class OracleBridge:
                 parked_of_slot, pending_infos, w, wls,
                 flavor_of_res, slot_position,
                 slot_preempting, head_idx, preempt_targets,
-                eng, bulk, result)
+                eng, bulk, result, tas_attach=tas_attach)
         finally:
             eng._deferred_cohort_requeue = None
 
@@ -1226,7 +1376,7 @@ class OracleBridge:
     def _apply_slots(self, nominate_order, slot_mask, admit_of_slot,
                      parked_of_slot, pending_infos, w, wls, flavor_of_res,
                      slot_position, slot_preempting, head_idx,
-                     preempt_targets, eng, bulk, result):
+                     preempt_targets, eng, bulk, result, tas_attach=None):
         from kueue_tpu.scheduler.preemption import Target
 
         admits = []
@@ -1236,7 +1386,10 @@ class OracleBridge:
             i = admit_of_slot.get(ci)
             if i is not None:
                 info = pending_infos[i]
-                entry = self._make_entry(info, w, wls, flavor_of_res, i)
+                entry = self._make_entry(
+                    info, w, wls, flavor_of_res, i,
+                    topo=None if tas_attach is None
+                    else tas_attach.get(i))
                 entry.status = EntryStatus.ASSUMED
                 entry.commit_position = int(slot_position[ci])
                 admits.append(entry)
@@ -1295,7 +1448,8 @@ class OracleBridge:
         # finalize phase (bulk_finalize_batch).
         return eng.bulk_assume_batch(admits, bulk)
 
-    def _make_entry(self, info, w, wls, flavor_of_res, i) -> Entry:
+    def _make_entry(self, info, w, wls, flavor_of_res, i,
+                    topo=None) -> Entry:
         """Entry for an admitted verdict row. Assignments are FLYWEIGHTS:
         rows with equal scheduling-equivalence hash and equal slot flavor
         picks produce identical Assignment structures, and the bulk-admit
@@ -1309,7 +1463,11 @@ class OracleBridge:
         # Content-addressed key: the scheduling-equivalence hash TUPLE
         # (dense hash ids are recycled and must not key a cache) plus the
         # slot's flavor picks, guarded by the spec version that defines
-        # the flavor-id space.
+        # the flavor-id space. TAS admits carry a per-admission topology
+        # assignment and BYPASS the flyweight both ways (a cached plain
+        # assignment must not serve a placed admission, and a placed one
+        # must not be reused — bulk_assume_batch flyweights by object
+        # identity, so fresh Assignment objects are required).
         ver = self.engine.cache.spec_version
         cache = getattr(self, "_assignment_cache", None)
         if cache is None or cache[0] != ver:
@@ -1317,9 +1475,10 @@ class OracleBridge:
             self._assignment_cache = cache
         rows = self.engine.queues.rows
         key = (rows._hash_tuple[i], flavor_of_res[ci].tobytes())
-        cached = cache[1].get(key)
-        if cached is not None:
-            return Entry(info=info, assignment=cached)
+        if topo is None:
+            cached = cache[1].get(key)
+            if cached is not None:
+                return Entry(info=info, assignment=cached)
         pod_sets = []
         usage: dict[FlavorResource, int] = {}
         for p, psr in enumerate(info.total_requests):
@@ -1334,8 +1493,10 @@ class OracleBridge:
                 usage[fr] = usage.get(fr, 0) + int(wls.requests[i, p, s_i])
             pod_sets.append(PodSetAssignment(
                 name=psr.name, flavors=flavors,
-                requests=dict(psr.requests), count=psr.count))
+                requests=dict(psr.requests), count=psr.count,
+                topology_assignment=None if topo is None
+                else topo.get(psr.name)))
         assignment = Assignment(pod_sets=pod_sets, usage=usage)
-        if key[0] is not None:
+        if topo is None and key[0] is not None:
             cache[1][key] = assignment
         return Entry(info=info, assignment=assignment)

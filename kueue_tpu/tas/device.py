@@ -37,30 +37,48 @@ _VRANK_PAD = 1 << 40
 # phase-1 + descent beats a per-call device launch at every forest size
 # tried (640: host 1.4ms vs device 2.9ms on TPU; 5120: host ~2ms vs
 # device ~8ms on CPU) — the launch+readback overhead never amortizes
-# for a SINGLE placement. The device TAS win is the BATCHED feasibility
-# kernel (tas/feasibility.py: one launch deciding every pending head),
-# so per-placement offload is default-off; KUEUE_TPU_DEVICE_TAS_MIN
-# re-enables it (0 = always, used by the differential suites and for
-# forests beyond anything measured).
-DEVICE_TAS_MIN_DOMAINS = 1 << 30
+# for a SINGLE placement. The device TAS win is the BATCHED paths (the
+# feasibility kernel in tas/feasibility.py and the per-cycle placement
+# batch in tas/batched.py); per-placement offload turns on only when
+# the persisted crossover measurement (tas/calibration.py, written by
+# bench._tas_crossover_measure) says the launch beats the host descent
+# on this backend at this forest shape. KUEUE_TPU_DEVICE_TAS_MIN still
+# overrides both ways (0 = always, used by the differential suites;
+# a huge value = never).
 
 
 def worth_offloading(snap) -> bool:
-    """True when per-placement device offload is enabled for this forest
-    size (KUEUE_TPU_DEVICE_TAS_MIN overrides; 0 = always offload, for
-    the differential suites; default threshold is effectively off — see
-    DEVICE_TAS_MIN_DOMAINS)."""
+    """True when per-placement device offload is enabled for this
+    forest. KUEUE_TPU_DEVICE_TAS_MIN, when set, is an explicit leaf
+    threshold (0 = always offload); otherwise the decision comes from
+    the persisted crossover calibration, and with no calibration the
+    host path wins (the pre-measurement default). Memoized per
+    (structure version, env override) — the batched planner asks once
+    per placement group per cycle."""
     import os
 
-    try:
-        threshold = int(os.environ.get("KUEUE_TPU_DEVICE_TAS_MIN",
-                                       DEVICE_TAS_MIN_DOMAINS))
-    except ValueError:
-        threshold = DEVICE_TAS_MIN_DOMAINS
+    from kueue_tpu.tas import calibration
+
     if not snap.level_keys:
         return False
+    override = os.environ.get("KUEUE_TPU_DEVICE_TAS_MIN")
+    key = (snap._version, override, calibration.generation)
+    cached = getattr(snap, "_worth_memo", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
     nl = len(snap.level_keys)
-    return len(snap.domains_per_level[nl - 1]) >= threshold
+    if override is not None:
+        try:
+            threshold = int(override)
+        except ValueError:
+            snap._worth_memo = (key, False)
+            return False
+        out = len(snap.domains_per_level[nl - 1]) >= threshold
+        snap._worth_memo = (key, out)
+        return out
+    out = calibration.device_placement_wins(snap)
+    snap._worth_memo = (key, out)
+    return out
 
 
 def _structure(snap):
@@ -163,12 +181,32 @@ def _free_matrix(struct, cols: list[str]) -> np.ndarray:
     return free
 
 
+_USAGE_LRU_CAP = 4
+
+
 def _usage_matrix(snap, struct, cols: list[str]) -> np.ndarray:
+    """Dense leaf usage for a column set, behind a small keyed LRU:
+    pod sets with different column axes alternating within one cycle
+    (e.g. a GPU head and a CPU head against the same forest) would
+    thrash a single-entry cache, re-densifying the forest per call.
+    Entries are keyed (usage_version, cols) — the version-restoration
+    purges in snapshot.end_cycle / simulate_workload_removal drop
+    whatever a revert made stale."""
     cols_key = tuple(cols)
     uver = getattr(snap, "_usage_version", 0)
     ucache = getattr(snap, "_usage_matrix_cache", None)
-    if ucache is not None and ucache[0] == (uver, cols_key):
-        return ucache[1]
+    if ucache is None:
+        ucache = snap._usage_matrix_cache = {}
+    hit = ucache.get((uver, cols_key))
+    if hit is not None:
+        snap._usage_matrix_hits = getattr(
+            snap, "_usage_matrix_hits", 0) + 1
+        # Recency bump: re-insert at the back so eviction drops the
+        # least recently USED entry, not merely the oldest.
+        ucache[(uver, cols_key)] = ucache.pop((uver, cols_key))
+        return hit
+    snap._usage_matrix_misses = getattr(
+        snap, "_usage_matrix_misses", 0) + 1
     col_of = {res: i for i, res in enumerate(cols)}
     usage = np.zeros((struct["m"], len(cols)), np.int64)
     used_leaves = getattr(snap, "_used_leaves", None)
@@ -184,7 +222,9 @@ def _usage_matrix(snap, struct, cols: list[str]) -> np.ndarray:
         for res, used in leaf.tas_usage.items():
             if res in col_of:
                 usage[i, col_of[res]] = used
-    snap._usage_matrix_cache = ((uver, cols_key), usage)
+    while len(ucache) >= _USAGE_LRU_CAP:
+        ucache.pop(next(iter(ucache)))
+    ucache[(uver, cols_key)] = usage
     return usage
 
 

@@ -46,6 +46,12 @@ def enabled() -> bool:
     return os.environ.get("KUEUE_TPU_TAS_FEAS", "1") != "0"
 
 
+# Process-wide count of feasibility launches that raised and fell back
+# to the per-entry host path (each is also emitted as a
+# "tas-feas-fallback" trace event with the exception text).
+FALLBACKS = 0
+
+
 @dataclass(frozen=True)
 class Verdict:
     fit_used: bool
@@ -158,12 +164,26 @@ def precompute(heads, snapshot) -> None:
             n[0] += 1
     for snap, reqs, n in by_snap.values():
         snap._feas = None
+        snap._feas_reason = ""
         if n[0] >= min_batch:
             try:
                 snap._feas = _launch(snap, reqs)
                 snap._feas_removals = getattr(snap, "_usage_removals", 0)
-            except Exception:  # noqa: BLE001 — pre-pass is optional
+            except Exception as exc:  # noqa: BLE001 — pre-pass is optional
+                # The pre-pass is an optimization: a failed launch must
+                # never fail the cycle. But it must not fail SILENTLY
+                # either — a permanently-broken batch quietly costs the
+                # host descent per retried head forever. Label the
+                # fallback where operators look (cycle trace + counter).
                 snap._feas = None
+                reason = f"{type(exc).__name__}: {exc}"
+                snap._feas_reason = reason
+                global FALLBACKS
+                FALLBACKS += 1
+                from kueue_tpu.obs import hooks as _obs
+                _obs.emit("tas-feas-fallback",
+                          getattr(snap, "topology_name", "") or "tas",
+                          reason=reason, requests=n[0])
 
 
 def _launch(snap, reqs: dict) -> dict:

@@ -303,6 +303,74 @@ class _AssignState:
         return self._stats_memo
 
 
+class _Phase1Memo:
+    """Phase-1 fill + per-level sort order, shared across the heads of
+    one cycle. The fillInCounts pass depends on the request's per-pod
+    shape, slice geometry, and exclusions — but NOT on its count or
+    requested level — so the nominate loop's (typically homogeneous)
+    heads can share one fill and one sort per level. Between placements
+    only the previous head's descent mutations are reverted: phase 2
+    touches nothing outside the per-level candidate lists (selection
+    is pure, _update_counts_to_minimum and the descent loops clamp only
+    domains handed to them), so the undo log is candidate-list-sized.
+
+    The memo survives usage mutations: every write to a leaf's
+    tas_usage while a memo is live lands the leaf in ``stale``
+    (_apply_deltas / commit_usage), and the next hit repairs exactly
+    those leaves' counts plus their ancestor sums (_p1_repair) instead
+    of refilling the forest. That lets the hybrid device cycle — which
+    never opens an undo scope on the prototype — reuse one fill across
+    cycles, paying only for the handful of leaves each cycle's
+    admissions touched.
+
+    Leaderless only: with no leader, state_with_leader ≡ state and
+    slice_state_with_leader ≡ slice_state at every domain (fillLeafCounts
+    sets them equal at leaves and the bubble's min-diff term is zero),
+    which also makes _sorted_with_leader order coincide with _sorted —
+    the cached per-level sort serves both call sites."""
+
+    __slots__ = ("key", "undo", "sorts", "stale", "_seen")
+
+    def __init__(self, key: tuple):
+        self.key = key
+        self.undo: list = []
+        self.sorts: dict = {}
+        self.stale: set = set()
+        self._seen: set = set()
+
+    def restore(self) -> None:
+        undo = self.undo
+        if not undo:
+            return
+        for d, state, slice_state, slice_swl, leader_state in undo:
+            d.state = state
+            d.slice_state = slice_state
+            d.slice_state_with_leader = slice_swl
+            d.leader_state = leader_state
+        undo.clear()
+        self._seen.clear()
+
+    def save_list(self, domains: list) -> None:
+        """Log the pre-descent state of every domain the next descent
+        step may write: _update_counts_to_minimum mutates only members
+        of the list handed to it (commit / leader_state clears /
+        best-fit swaps all pick from that list), and the slice re-anchor
+        loop writes only the current fit set's children — so logging
+        each level's candidate list is exact, where the old
+        whole-subtree save paid for every descendant of the fit domains
+        (~10x the touched set on block-level fits). Deduped per scope:
+        a domain surviving several levels keeps its FIRST (pre-descent)
+        state."""
+        seen = self._seen
+        save = self.undo.append
+        for d in domains:
+            i = id(d)
+            if i not in seen:
+                seen.add(i)
+                save((d, d.state, d.slice_state,
+                      d.slice_state_with_leader, d.leader_state))
+
+
 class TASFlavorSnapshot:
     """tas_flavor_snapshot.go:115."""
 
@@ -371,8 +439,9 @@ class TASFlavorSnapshot:
         elif txn:
             base = self._txn_base_version
             mc = getattr(self, "_usage_matrix_cache", None)
-            if mc is not None and mc[0][0] != base:
-                self._usage_matrix_cache = None
+            if mc:
+                for k in [k for k in mc if k[0] != base]:
+                    mc.pop(k)
             jc = getattr(self, "_j_usage_cache", None)
             if jc is not None and jc[0][0] != base:
                 self._j_usage_cache = None
@@ -382,6 +451,7 @@ class TASFlavorSnapshot:
         self._feas = None
         self._place_memo = None
         self._stats_memo = None
+        self._p1 = None
 
     def commit_usage(self, values: tuple, deltas: dict[str, int]) -> None:
         """Write-through from the live cache's admitted-side accounting
@@ -396,6 +466,9 @@ class TASFlavorSnapshot:
             self._usage_removals = getattr(self, "_usage_removals", 0) + 1
         if getattr(self, "_txn", None) is not None:
             self._txn_dirty = True
+        p1 = getattr(self, "_p1", None)
+        if p1 is not None:
+            p1.stale.add(leaf)
         self._touch_used(leaf)
         usage = leaf.tas_usage
         for res, d in deltas.items():
@@ -533,6 +606,9 @@ class TASFlavorSnapshot:
         """Apply a usage delta to one leaf, logging it for revert when a
         cycle's undo scope is open (begin_cycle)."""
         self._usage_version = getattr(self, "_usage_version", 0) + 1
+        p1 = getattr(self, "_p1", None)
+        if p1 is not None:
+            p1.stale.add(leaf)
         self._touch_used(leaf)
         txn = getattr(self, "_txn", None)
         if txn is not None:
@@ -755,8 +831,8 @@ class TASFlavorSnapshot:
         ~1-10ms regardless of problem size, so offload only wins once
         the per-level domain count clears a threshold (measured: the
         host path is ~2x faster at the reference's 640-node scale);
-        tas/device.py DEVICE_TAS_MIN_DOMAINS / KUEUE_TPU_DEVICE_TAS_MIN
-        set the crossover."""
+        the measured crossover persisted by tas/calibration.py (or the
+        KUEUE_TPU_DEVICE_TAS_MIN override) sets the switch point."""
         # Within-usage-version memo: an oversubscribed cycle retries
         # many heads with identical (signature, selector) requests — the
         # placement outcome is a pure function of (request, usage state),
@@ -1158,10 +1234,44 @@ class TASFlavorSnapshot:
             workers.pod_set, per_pod, simulate_empty, assumed,
             required_replacement_domain)
 
-        # Phase 1: per-domain fit counts.
-        self._fill_in_counts(workers.pod_set, per_pod, leader_per_pod,
-                             state, simulate_empty, assumed,
-                             required_replacement_domain)
+        # Phase 1: per-domain fit counts — memoized across the heads of
+        # a cycle (_Phase1Memo). Balanced-placement candidates are
+        # excluded because balanced.apply re-aggregates clones through
+        # bubble_up, stomping counts outside any selected subtree.
+        p1 = None
+        if (leader is None and not assumed and not required_replacement_domain
+                and not (features.enabled("TASBalancedPlacement")
+                         and not state.required and not state.unconstrained)):
+            excluded = self._match_excluded(workers.pod_set)
+            p1_key = (
+                self._version, bool(simulate_empty),
+                tuple(sorted(per_pod.items())),
+                state.slice_size, state.slice_level_idx,
+                tuple(sorted(state.slice_size_at_level.items())),
+                id(excluded) if excluded else 0)
+            p1 = getattr(self, "_p1", None)
+            hit = p1 is not None and p1.key == p1_key
+            if hit:
+                p1.restore()
+                if p1.stale:
+                    # Simulate-empty counts ignore usage entirely; live
+                    # counts get the touched leaves recomputed in place.
+                    hit = simulate_empty or self._p1_repair(
+                        p1, per_pod, excluded, state)
+                    p1.stale.clear()
+            if hit:
+                self._p1_shares = getattr(self, "_p1_shares", 0) + 1
+            else:
+                self._fill_in_counts(workers.pod_set, per_pod, None,
+                                     state, simulate_empty, assumed,
+                                     required_replacement_domain)
+                p1 = _Phase1Memo(p1_key)
+                self._p1_fills = getattr(self, "_p1_fills", 0) + 1
+            self._p1 = p1
+        else:
+            self._fill_in_counts(workers.pod_set, per_pod, leader_per_pod,
+                                 state, simulate_empty, assumed,
+                                 required_replacement_domain)
 
         slice_size = state.slice_size
         slice_level_idx = state.slice_level_idx
@@ -1183,7 +1293,8 @@ class TASFlavorSnapshot:
                 used_balanced = not reason
         if not used_balanced:
             fit_level_idx, fit_domains, reason = self._find_level_with_fit(
-                state.requested_level_idx, slice_count, state)
+                state.requested_level_idx, slice_count, state,
+                sort_cache=p1.sorts if p1 is not None else None)
             if reason:
                 return None, reason
 
@@ -1192,6 +1303,8 @@ class TASFlavorSnapshot:
         # children with sortedDomains — leader consumption happens inside
         # the consume walk, not via the with-leader sort (that one is
         # selection-level only, :1387).
+        if p1 is not None:
+            p1.save_list(fit_domains)
         fit_domains = self._update_counts_to_minimum(
             fit_domains, count, state.leader_count, slice_size,
             state.least_free, use_slices=True)
@@ -1201,6 +1314,8 @@ class TASFlavorSnapshot:
         while level < min(len(self.level_keys) - 1, slice_level_idx) \
                 and not used_balanced:
             children = [c for d in fit_domains for c in d.children]
+            if p1 is not None:
+                p1.save_list(children)
             lower = self._sorted(children, state.least_free)
             fit_domains = self._update_counts_to_minimum(
                 lower, count, state.leader_count, slice_size,
@@ -1220,6 +1335,8 @@ class TASFlavorSnapshot:
             new_fit = []
             for d in fit_domains:
                 lower = self._sorted(d.children, state.least_free)
+                if p1 is not None:
+                    p1.save_list(lower)
                 if slice_on_level > 1:
                     for c in lower:
                         c.slice_state = c.state // slice_on_level
@@ -1409,6 +1526,115 @@ class TASFlavorSnapshot:
         # capacity from leaderless domains instead of wasting them.
         leaf.state_with_leader = count_in(per_pod)
 
+    def _p1_repair(self, p1, per_pod: dict[str, int], excluded: dict,
+                   state: _AssignState) -> bool:
+        """Refresh phase-1 counts for the leaves whose tas_usage changed
+        while the memo was live, plus their ancestor sums — the
+        incremental form of fill_in_counts_np for the leaderless,
+        no-assumed-usage, single-slice-size case (the memo's
+        eligibility gate). Mirrors the vectorized leaf formula exactly:
+        per-resource clamped floor-division, "pods" unconstrained for
+        leaves without explicit pod capacity, matchNode exclusions
+        zeroing the leaf. Returns False when the drift is too large to
+        beat a refill or the geometry is out of scope."""
+        if state.slice_size_at_level:
+            return False
+        if len(p1.stale) > 64:
+            return False
+        nl = len(self.level_keys)
+        slice_size = state.slice_size
+        slice_idx = state.slice_level_idx
+        leaf_level = nl - 1
+        changed: list = []
+        parents: dict[int, _Domain] = {}
+        for leaf in sorted(p1.stale, key=lambda d: d.values):
+            if leaf.values not in self.leaves:
+                continue  # removed node: _version bump misses the key
+            if excluded and leaf.values in excluded:
+                cnt = 0
+            else:
+                free = leaf.free_capacity
+                usage = leaf.tas_usage
+                cnt = _INF
+                applied = False
+                for res, need in per_pod.items():
+                    if need <= 0:
+                        continue
+                    c = max(0, free.get(res, 0)
+                            - usage.get(res, 0)) // need
+                    if res == "pods":
+                        if "pods" not in free:
+                            continue  # unconstrained (fillLeafCounts)
+                    applied = True
+                    if c < cnt:
+                        cnt = c
+                if not applied:
+                    cnt = 0
+            leaf.state = cnt
+            leaf.state_with_leader = cnt
+            leaf.leader_state = 0
+            sl = cnt // slice_size if leaf_level == slice_idx else 0
+            leaf.slice_state = sl
+            leaf.slice_state_with_leader = sl
+            changed.append(leaf)
+            d = leaf.parent
+            while d is not None and id(d) not in parents:
+                parents[id(d)] = d
+                d = d.parent
+        # Ancestors bottom-up (deepest level first): each sum reads the
+        # children's already-current counts.
+        for d in sorted(parents.values(), key=lambda a: -len(a.values)):
+            st = 0
+            for c in d.children:
+                st += c.state
+            lvl = len(d.values) - 1
+            if lvl == slice_idx:
+                sl = st // slice_size
+            elif lvl < slice_idx:
+                sl = 0
+                for c in d.children:
+                    sl += c.slice_state
+            else:
+                sl = 0
+            d.state = st
+            d.state_with_leader = st
+            d.leader_state = 0
+            d.slice_state = sl
+            d.slice_state_with_leader = sl
+            changed.append(d)
+        if p1.sorts and changed:
+            from bisect import insort
+            by_level: dict[int, list] = {}
+            for d in changed:
+                by_level.setdefault(len(d.values) - 1, []).append(d)
+            for (lvl, least_free), lst in p1.sorts.items():
+                ch = by_level.get(lvl)
+                if not ch:
+                    continue
+                if least_free:
+                    def keyf(x):
+                        return (-x.leader_state,
+                                x.slice_state_with_leader,
+                                x.state_with_leader, x.values)
+                else:
+                    def keyf(x):
+                        return (-x.leader_state,
+                                -x.slice_state_with_leader,
+                                x.state_with_leader, x.values)
+                try:
+                    for d in ch:
+                        lst.remove(d)  # identity (_Domain has no __eq__)
+                except ValueError:
+                    # A changed domain missing from a cached level order
+                    # means the memo predates a structure change the key
+                    # should have caught — discard it (the caller refills
+                    # and builds a fresh memo).
+                    return False
+                for d in ch:
+                    insort(lst, d, key=keyf)
+        self._p1_repairs = getattr(self, "_p1_repairs", 0) + 1
+        return True
+
     def _fill_in_counts(self, pod_set: PodSet, per_pod: dict[str, int],
                         leader_per_pod: Optional[dict[str, int]],
                         state: _AssignState, simulate_empty: bool,
@@ -1418,6 +1644,11 @@ class TASFlavorSnapshot:
         reductions over the cached leaf matrices (tas/device.py
         fill_in_counts_np — ~10x the per-leaf dict walk); leader
         co-placement keeps the object walk (min-diff bubbling)."""
+        # Any fill stomps every domain's count fields: whoever called —
+        # including balanced pruning via bubble_up after this returns —
+        # owns them now. The memoized host path re-installs its memo
+        # right after this call; every other caller leaves it dead.
+        self._p1 = None
         excluded = self._match_excluded(pod_set)
         if leader_per_pod is None:
             from kueue_tpu.tas import device
@@ -1519,16 +1750,27 @@ class TASFlavorSnapshot:
             d.state_with_leader, d.values))
 
     def _find_level_with_fit(self, level_idx: int, slice_count: int,
-                             state: _AssignState):
-        """findLevelWithFitDomains :1377."""
-        domains = list(self.domains_per_level[level_idx].values()) \
-            if self.level_keys else []
-        if not domains:
-            level_name = (self.level_keys[level_idx]
-                          if self.level_keys else "")
-            return 0, [], f"no topology domains at level: {level_name}"
-        sorted_domains = self._sorted_with_leader(domains,
-                                                 state.least_free)
+                             state: _AssignState, sort_cache=None):
+        """findLevelWithFitDomains :1377. ``sort_cache`` (a _Phase1Memo
+        sorts dict, leaderless callers only) shares the per-level sorted
+        order across the heads of a cycle: selection never mutates
+        counts, and the memo's restore() reverts descent mutations
+        before the next head sorts, so the cached order stays exact."""
+        sorted_domains = None
+        cache_key = (level_idx, state.least_free)
+        if sort_cache is not None:
+            sorted_domains = sort_cache.get(cache_key)
+        if sorted_domains is None:
+            domains = list(self.domains_per_level[level_idx].values()) \
+                if self.level_keys else []
+            if not domains:
+                level_name = (self.level_keys[level_idx]
+                              if self.level_keys else "")
+                return 0, [], f"no topology domains at level: {level_name}"
+            sorted_domains = self._sorted_with_leader(domains,
+                                                     state.least_free)
+            if sort_cache is not None:
+                sort_cache[cache_key] = sorted_domains
         top = sorted_domains[0]
         if not state.least_free \
                 and top.slice_state_with_leader >= slice_count \
@@ -1557,7 +1799,8 @@ class TASFlavorSnapshot:
                                             slice_count, level_idx)
             if level_idx > 0 and not state.unconstrained:
                 return self._find_level_with_fit(level_idx - 1, slice_count,
-                                                 state)
+                                                 state,
+                                                 sort_cache=sort_cache)
             # Multi-domain greedy (:1430-1469): leaders first, then the
             # remaining domains re-sorted by worker capacity.
             results = []
@@ -1579,7 +1822,14 @@ class TASFlavorSnapshot:
                 return 0, [], self._not_fit(
                     state, state.leader_count - remaining_leaders,
                     slice_count, level_idx)
-            rest = self._sorted(sorted_domains[idx:], state.least_free)
+            # Leaderless (sort_cache) with no leader loop entered: the
+            # with-leader order degenerates to the plain order (every
+            # leader_state is 0 and *_with_leader ≡ the plain counts),
+            # so the cached list IS the re-sort — skip it.
+            if sort_cache is not None and idx == 0:
+                rest = sorted_domains
+            else:
+                rest = self._sorted(sorted_domains[idx:], state.least_free)
             for i, d in enumerate(rest):
                 if remaining <= 0:
                     break

@@ -84,6 +84,11 @@ class WorkloadRowCache:
         self._free: list[int] = list(range(self._cap - 1, -1, -1))
         self.info_of: list[Optional[WorkloadInfo]] = [None] * self._cap
         self._hash_tuple: list = [None] * self._cap
+        # Per-row TAS request signatures (tas/feasibility.request_
+        # signature per pod set), computed lazily by tas_requests() and
+        # carried across cycles like _hash_tuple — the batched TAS
+        # planner re-reads only rows that re-encoded.
+        self._tas_req: list = [None] * self._cap
         self._dirty: set[int] = set()
         self._hashes = _HashRegistry()
 
@@ -108,6 +113,10 @@ class WorkloadRowCache:
         self.requests = np.zeros((self._cap, 1, 1), np.int64)
         self.eligible = np.zeros(self._cap, bool)
         self.hash_id = np.zeros(self._cap, np.int32)
+        # Stable digest of the row's TAS request signatures (0 = not
+        # computed / no pod sets): a cheap cross-cycle change marker
+        # for diagnostics; decisions read the _tas_req tuples.
+        self.tas_sig = np.zeros(self._cap, np.int64)
         # [cap, NF]: per-flavor eligibility (taints/selectors/affinity),
         # sized at bind_world.
         self.flavor_ok = None
@@ -183,6 +192,8 @@ class WorkloadRowCache:
         if h is not None:
             self._hashes.release(h)
             self._hash_tuple[i] = None
+        self._tas_req[i] = None
+        self.tas_sig[i] = 0
         self.key_seq[i] = np.int64(1) << 60
         self.requeue_at[i] = -_INF_TS
         self._dirty.discard(i)
@@ -200,7 +211,7 @@ class WorkloadRowCache:
         self._cap = new_cap
         for name in ("priority", "timestamp", "has_qr", "requeue_at",
                      "active", "key_afs", "key_negpri", "key_ts",
-                     "key_seq", "cq", "eligible", "hash_id"):
+                     "key_seq", "cq", "eligible", "hash_id", "tas_sig"):
             arr = getattr(self, name)
             fill = {"requeue_at": -_INF_TS, "cq": -1,
                     "key_seq": np.int64(1) << 60}.get(name, 0)
@@ -216,6 +227,7 @@ class WorkloadRowCache:
             self.flavor_ok = fo
         self.info_of.extend([None] * (new_cap - old))
         self._hash_tuple.extend([None] * (new_cap - old))
+        self._tas_req.extend([None] * (new_cap - old))
         self._free.extend(range(new_cap - 1, old - 1, -1))
 
     def maybe_compact(self) -> None:
@@ -230,7 +242,7 @@ class WorkloadRowCache:
         remap = {old: new for new, old in enumerate(keep)}
         for name in ("priority", "timestamp", "has_qr", "requeue_at",
                      "active", "key_afs", "key_negpri", "key_ts",
-                     "key_seq", "cq", "eligible", "hash_id"):
+                     "key_seq", "cq", "eligible", "hash_id", "tas_sig"):
             arr = getattr(self, name)
             fill = {"requeue_at": -_INF_TS, "cq": -1,
                     "key_seq": np.int64(1) << 60}.get(name, 0)
@@ -250,6 +262,8 @@ class WorkloadRowCache:
         self.info_of = [self.info_of[i] for i in keep] + \
             [None] * (new_cap - used)
         self._hash_tuple = [self._hash_tuple[i] for i in keep] + \
+            [None] * (new_cap - used)
+        self._tas_req = [self._tas_req[i] for i in keep] + \
             [None] * (new_cap - used)
         self._row_of = {k: remap[i] for k, i in self._row_of.items()}
         self._dirty = {remap[i] for i in self._dirty if i in remap}
@@ -309,15 +323,21 @@ class WorkloadRowCache:
         ci = cq_idx.get(info.cluster_queue, -1)
         self.cq[i] = ci
         self.requests[i] = 0
+        # A re-encode means the info (and so its pod-set requests) may
+        # have changed; the TAS side table recomputes on next use.
+        self._tas_req[i] = None
+        self.tas_sig[i] = 0
         from kueue_tpu.tensor.schema import (
-            _dense_shape_eligible,
             flavor_eligibility_mask,
             pow2_bucket,
+            serving_shape_eligible,
         )
         # Serving rows use the RELAXED predicate: node filters become a
         # per-flavor mask consumed by the cycle kernel instead of
-        # demoting the row (round-4 verdict ask #4: head-ineligible).
-        eligible = ci >= 0 and _dense_shape_eligible(info)
+        # demoting the row (round-4 verdict ask #4: head-ineligible),
+        # and topology requests stay on device when the batched TAS
+        # planner is on (it nominates placements pre-kernel).
+        eligible = ci >= 0 and serving_shape_eligible(info)
         if eligible and self.flavor_ok is not None:
             mask = flavor_eligibility_mask(info, world)
             if mask is None:
@@ -363,6 +383,37 @@ class WorkloadRowCache:
                 continue
             ra = info.obj.status.requeue_at
             self.requeue_at[i] = -_INF_TS if ra is None else ra
+
+    def tas_requests(self, i: int) -> tuple:
+        """Per-podset TAS request tuples for a row — (pod_set_name,
+        request_signature, single_pod_requests, count, group_name) per
+        pod set — computed once and carried across cycles with the row
+        (invalidated by _encode_row / on_remove, remapped on compact).
+        The batched TAS planner's collect phase becomes incremental:
+        unchanged retried heads cost a list lookup, not a signature
+        rebuild."""
+        ent = self._tas_req[i]
+        if ent is None:
+            info = self.info_of[i]
+            if info is None:
+                return ()
+            from kueue_tpu.tas.feasibility import request_signature
+            out = []
+            for p, psr in enumerate(info.total_requests):
+                ps = info.obj.pod_sets[p]
+                single = psr.single_pod_requests()
+                tr = ps.topology_request
+                out.append((ps.name,
+                            request_signature(ps, single, psr.count),
+                            single, psr.count,
+                            tr.pod_set_group_name if tr is not None
+                            else None))
+            ent = tuple(out)
+            self._tas_req[i] = ent
+            import zlib
+            self.tas_sig[i] = zlib.crc32(repr(
+                [(e[0], e[1], e[4]) for e in ent]).encode())
+        return ent
 
     # -- views --
 

@@ -574,6 +574,43 @@ def _dense_shape_eligible_impl(info) -> bool:
     return True
 
 
+def serving_shape_eligible(info) -> bool:
+    """Shape eligibility for SERVING rows (tensor/rowcache.py). Same as
+    _dense_shape_eligible, except a topology request no longer demotes
+    the row when the batched TAS planner (tas/batched.py) is on: the
+    planner nominates a placement per head before the cycle kernel and
+    demotes — per head, with a reason — only what it cannot express.
+    Whole-drain encoders keep the strict predicate: they don't run the
+    planner, so a topology row there would admit without a placement.
+    Memoized per (info, planner-enabled) — KUEUE_TPU_TAS_BATCH toggles
+    between engine builds in tests."""
+    from kueue_tpu.tas.batched import enabled
+    flag = enabled()
+    cached = getattr(info, "_serving_shape_elig", None)
+    if cached is not None and cached[0] == flag:
+        return cached[1]
+    if not flag:
+        out = _dense_shape_eligible(info)
+    else:
+        out = _serving_shape_eligible_impl(info)
+    info._serving_shape_elig = (flag, out)
+    return out
+
+
+def _serving_shape_eligible_impl(info) -> bool:
+    if len(info.total_requests) > MAX_FAST_PODSETS:
+        return False
+    if info.obj.replaced_workload_slice is not None:
+        return False
+    for p, psr in enumerate(info.total_requests):
+        ps = info.obj.pod_sets[p]
+        if ps.min_count is not None:
+            return False
+        if any(q == 0 for q in psr.requests.values()):
+            return False
+    return True
+
+
 def flavor_eligibility_mask(info, world):
     """bool[num_flavors] — which of the world's flavors this workload's
     pod sets can match (flavorassigner.flavor_matches_podset: taints vs

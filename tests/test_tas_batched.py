@@ -1,0 +1,360 @@
+"""Batched device TAS (tas/batched.py): the planner that nominates a
+topology assignment for every device-eligible TAS head before the cycle
+kernel launches.
+
+The equivalence contract: with the planner ON (KUEUE_TPU_TAS_BATCH=1,
+the default) and OFF (=0, the legacy demote-every-TAS-root path), a
+drain of the same world must produce byte-identical admissions —
+cluster queue, flavors, AND per-pod-set topology assignments (domains
+and counts). Randomized forests cover 2-4 levels, mixed capacities,
+node-selector exclusions, and tainted flavors (which demote to host
+under both arms); a forced-device arm (KUEUE_TPU_DEVICE_TAS_MIN=0)
+routes the planner's placements through ops/tas.tas_place_batch and
+must match the host descent byte-for-byte.
+"""
+
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PodSetTopologyRequest,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Taint,
+    Topology,
+    TopologyLevel,
+    TopologyMode,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.tas.snapshot import HOSTNAME_LABEL, Node
+
+
+def _forest(rng, levels):
+    """A random forest spec: per-level fanouts and mixed leaf sizes."""
+    fan = [rng.randint(2, 3) for _ in range(levels - 1)]
+    leaves = []
+
+    def walk(prefix):
+        if len(prefix) == levels - 1:
+            leaves.append(prefix)
+            return
+        for i in range(fan[len(prefix)]):
+            walk(prefix + (i,))
+
+    walk(())
+    return fan, leaves
+
+
+_LEVEL_NAMES = ("zone", "block", "rack")
+
+
+def _build_world(rng, levels, n_cqs, n_wl, taint=False, selectors=False):
+    eng = Engine()
+    level_objs = tuple(TopologyLevel(n) for n in
+                       _LEVEL_NAMES[:levels - 1]) + (
+        TopologyLevel(HOSTNAME_LABEL),)
+    eng.create_topology(Topology("dc", level_objs))
+    eng.create_resource_flavor(ResourceFlavor(
+        name="tas", topology_name="dc",
+        node_taints=(Taint("dedicated", "batch", "NoSchedule"),)
+        if taint else ()))
+    _, leaves = _forest(rng, levels)
+    hosts_per_leaf = rng.randint(3, 6)
+    total = 0
+    for leaf in leaves:
+        for h in range(hosts_per_leaf):
+            labels = {HOSTNAME_LABEL: "h-" + "-".join(
+                map(str, leaf)) + f"-{h}"}
+            for li, part in enumerate(leaf):
+                labels[_LEVEL_NAMES[li]] = "-".join(
+                    map(str, leaf[:li + 1]))
+            cap = rng.choice([4000, 8000])
+            # A sprinkling of labeled hosts for selector exclusions.
+            if selectors and rng.random() < 0.3:
+                labels["disk"] = "ssd"
+            total += cap
+            eng.create_node(Node(name=labels[HOSTNAME_LABEL],
+                                 labels=labels,
+                                 capacity={"cpu": cap, "pods": 32}))
+    for i in range(n_cqs):
+        eng.create_cluster_queue(ClusterQueue(
+            name=f"cq-{i}", resource_groups=(ResourceGroup(
+                ("cpu",), (FlavorQuotas("tas", {"cpu": ResourceQuota(
+                    total // n_cqs)}),)),)))
+        eng.create_local_queue(LocalQueue(f"lq-{i}", "default",
+                                          f"cq-{i}"))
+    eng.attach_oracle()
+    req_levels = list(_LEVEL_NAMES[:levels - 1]) or [HOSTNAME_LABEL]
+    for i in range(n_wl):
+        eng.clock += 0.001
+        mode = rng.choice([TopologyMode.REQUIRED, TopologyMode.PREFERRED,
+                           TopologyMode.UNCONSTRAINED])
+        level = None if mode == TopologyMode.UNCONSTRAINED else \
+            rng.choice(req_levels)
+        selector = {"disk": "ssd"} if (selectors and
+                                       rng.random() < 0.4) else {}
+        eng.submit(Workload(
+            name=f"tas-{i}", queue_name=f"lq-{rng.randrange(n_cqs)}",
+            pod_sets=(PodSet(
+                "main", rng.choice([2, 3, 4]), {"cpu": 1000},
+                node_selector=selector,
+                topology_request=PodSetTopologyRequest(
+                    mode=mode, level=level)),)))
+    return eng
+
+
+def _decisions(eng):
+    out = {}
+    for key, w in sorted(eng.workloads.items()):
+        adm = w.status.admission if w.status else None
+        if adm is None:
+            out[key] = None
+            continue
+        pas = []
+        for psa in adm.pod_set_assignments:
+            ta = psa.topology_assignment
+            doms = None if ta is None else tuple(
+                (tuple(d.values), d.count) for d in ta.domains)
+            pas.append((psa.name, tuple(sorted(psa.flavors.items())),
+                        doms))
+        out[key] = (adm.cluster_queue, tuple(pas))
+    return out
+
+
+def _drain(monkeypatch, seed, levels, batch, *, n_cqs=3, n_wl=18,
+           taint=False, selectors=False, device_min=None):
+    monkeypatch.setenv("KUEUE_TPU_TAS_BATCH", batch)
+    if device_min is None:
+        monkeypatch.delenv("KUEUE_TPU_DEVICE_TAS_MIN", raising=False)
+    else:
+        monkeypatch.setenv("KUEUE_TPU_DEVICE_TAS_MIN", device_min)
+    eng = _build_world(random.Random(seed), levels, n_cqs, n_wl,
+                       taint=taint, selectors=selectors)
+    eng.run_until_quiescent()
+    return eng
+
+
+@pytest.mark.parametrize("seed,levels", [(11, 2), (12, 3), (13, 4),
+                                         (14, 3), (15, 4)])
+def test_batched_matches_host_randomized(monkeypatch, seed, levels):
+    """Random forest, mixed modes/counts: planner on == planner off,
+    including every topology assignment."""
+    on = _drain(monkeypatch, seed, levels, "1")
+    dec_on = _decisions(on)
+    assert on.oracle.cycles_on_device > 0
+    assert on.oracle.tas_stats["plan_cycles"] > 0
+    off = _drain(monkeypatch, seed, levels, "0")
+    assert off.oracle.cycles_on_device == 0
+    assert dec_on == _decisions(off)
+
+
+@pytest.mark.parametrize("seed,levels", [(21, 3), (22, 4)])
+def test_batched_device_kernel_matches_host(monkeypatch, seed, levels):
+    """KUEUE_TPU_DEVICE_TAS_MIN=0 forces the planner's placements
+    through the tas_place_batch kernel; decisions must still equal the
+    pure-host arm."""
+    on = _drain(monkeypatch, seed, levels, "1", device_min="0")
+    assert on.oracle.tas_stats["placed_device"] > 0
+    assert sum(on.oracle.tas_heads_per_launch.values()) > 0
+    dec_on = _decisions(on)
+    off = _drain(monkeypatch, seed, levels, "0", device_min="1000000")
+    assert dec_on == _decisions(off)
+
+
+def test_selector_exclusions_equivalent(monkeypatch):
+    """Pod-set node selectors (leaf exclusions in the placement) keep
+    the two arms identical."""
+    on = _drain(monkeypatch, 31, 3, "1", selectors=True)
+    off = _drain(monkeypatch, 31, 3, "0", selectors=True)
+    assert _decisions(on) == _decisions(off)
+
+
+def test_tainted_flavor_demotes_both_arms(monkeypatch):
+    """A tainted TAS flavor is host-path under both toggles (the
+    narrowed predicate still treats taints as unsafe), and decisions
+    agree."""
+    on = _drain(monkeypatch, 41, 3, "1", taint=True, n_wl=10)
+    assert on.oracle.cycles_on_device == 0
+    off = _drain(monkeypatch, 41, 3, "0", taint=True, n_wl=10)
+    assert _decisions(on) == _decisions(off)
+
+
+def test_replay_digest_unchanged_by_toggle(monkeypatch, tmp_path):
+    """Flight-recorder digests: record with the planner on, replay with
+    it off — the decision digest must not move (and vice versa)."""
+    from kueue_tpu.replay.trace import canonical_decisions, decision_digest
+
+    def digests(batch):
+        monkeypatch.setenv("KUEUE_TPU_TAS_BATCH", batch)
+        eng = _build_world(random.Random(51), 3, 3, 16)
+        chain = 0
+        while True:
+            res = eng.schedule_once()
+            if res is None or not res.entries:
+                break
+            chain = decision_digest(canonical_decisions(res), chain)
+        return chain
+
+    assert digests("1") == digests("0")
+
+
+def test_demotion_reasons_are_labeled(monkeypatch):
+    """Heads the planner can't express demote with a tas-* reason, not
+    silently: a multi-podset TAS head carries 'tas-feature'."""
+    monkeypatch.setenv("KUEUE_TPU_TAS_BATCH", "1")
+    eng = _build_world(random.Random(61), 3, 2, 0)
+    eng.clock += 0.001
+    eng.submit(Workload(
+        name="multi", queue_name="lq-0",
+        pod_sets=(
+            PodSet("a", 2, {"cpu": 1000},
+                   topology_request=PodSetTopologyRequest(
+                       mode=TopologyMode.REQUIRED, level="zone")),
+            PodSet("b", 2, {"cpu": 1000},
+                   topology_request=PodSetTopologyRequest(
+                       mode=TopologyMode.REQUIRED, level="zone")),
+        )))
+    eng.run_until_quiescent()
+    reasons = eng.oracle.host_root_reasons
+    assert reasons.get("tas-feature", 0) > 0
+    w = eng.workloads["default/multi"]
+    assert w.status is not None and w.status.admission is not None
+
+
+def test_rowcache_tas_signature_columns(monkeypatch):
+    """The pending-row cache carries per-row TAS request signatures:
+    stable across re-reads, invalidated on re-encode."""
+    monkeypatch.setenv("KUEUE_TPU_TAS_BATCH", "1")
+    eng = _build_world(random.Random(71), 3, 2, 6)
+    eng.oracle.try_cycle()
+    rows = eng.queues.rows
+    sigs = {}
+    for i in rows._row_of.values():
+        ent = rows.tas_requests(i)
+        if ent:
+            assert rows.tas_sig[i] != 0
+            sigs[i] = (rows.tas_sig[i], ent)
+    assert sigs, "no TAS rows encoded"
+    for i, (sig, ent) in sigs.items():
+        assert rows.tas_requests(i) is ent  # memoized, stable
+        assert rows.tas_sig[i] == sig
+
+
+def test_calibration_roundtrip(monkeypatch, tmp_path):
+    from kueue_tpu.tas import calibration
+
+    monkeypatch.setenv("KUEUE_TPU_TAS_CALIBRATION",
+                       str(tmp_path / "xover.json"))
+    calibration.invalidate_cache()
+    try:
+        assert calibration.lookup("cpu", 3, 5000) is None
+        path = calibration.save("cpu", 3, 5000, host_place_ms=0.5,
+                                device_place_ms=0.1)
+        assert path == str(tmp_path / "xover.json")
+        with open(path, encoding="utf-8") as f:
+            table = json.load(f)
+        # Bucketed to the next power of two: 5000 -> 8192.
+        assert "cpu:3:8192" in table
+        entry = calibration.lookup("cpu", 3, 5000)
+        assert entry["device_place_ms"] == 0.1
+        # Same bucket serves nearby forest sizes.
+        assert calibration.lookup("cpu", 3, 8192) == entry
+        assert calibration.lookup("cpu", 3, 4096) is None
+    finally:
+        calibration.invalidate_cache()
+
+
+def test_calibration_drives_worth_offloading(monkeypatch, tmp_path):
+    from kueue_tpu.tas import calibration
+    from kueue_tpu.tas.device import worth_offloading
+
+    monkeypatch.setenv("KUEUE_TPU_TAS_CALIBRATION",
+                       str(tmp_path / "xover.json"))
+    monkeypatch.delenv("KUEUE_TPU_DEVICE_TAS_MIN", raising=False)
+    calibration.invalidate_cache()
+    try:
+        eng = _build_world(random.Random(81), 3, 2, 0)
+        snap = next(iter(eng.cache.tas_prototypes().values()))
+        nl = len(snap.level_keys)
+        leaves = len(snap.domains_per_level[nl - 1])
+        # No record: host path (the pre-measurement default).
+        assert not worth_offloading(snap)
+        import jax
+        calibration.save(jax.default_backend(), nl, leaves,
+                         host_place_ms=5.0, device_place_ms=0.5)
+        assert worth_offloading(snap)
+        calibration.save(jax.default_backend(), nl, leaves,
+                         host_place_ms=0.5, device_place_ms=5.0)
+        assert not worth_offloading(snap)
+        # Env override always wins.
+        monkeypatch.setenv("KUEUE_TPU_DEVICE_TAS_MIN", "0")
+        assert worth_offloading(snap)
+    finally:
+        calibration.invalidate_cache()
+
+
+def test_usage_matrix_lru(monkeypatch):
+    """_usage_matrix keeps a small per-snapshot LRU keyed by
+    (usage_version, columns): alternating column sets within one cycle
+    hit instead of re-densifying the forest, and the cap holds."""
+    from kueue_tpu.tas import device as tdev
+
+    eng = _build_world(random.Random(91), 3, 2, 0)
+    snap = next(iter(eng.cache.tas_prototypes().values()))
+    struct = tdev._structure(snap)
+    base_h = getattr(snap, "_usage_matrix_hits", 0)
+    base_m = getattr(snap, "_usage_matrix_misses", 0)
+    a = tdev._usage_matrix(snap, struct, ["cpu", "pods"])
+    b = tdev._usage_matrix(snap, struct, ["cpu", "memory", "pods"])
+    assert getattr(snap, "_usage_matrix_misses") == base_m + 2
+    a2 = tdev._usage_matrix(snap, struct, ["cpu", "pods"])
+    b2 = tdev._usage_matrix(snap, struct, ["cpu", "memory", "pods"])
+    assert a2 is a and b2 is b
+    assert getattr(snap, "_usage_matrix_hits") == base_h + 2
+    # Fill past the cap; the least recently used key evicts.
+    for cols in (["cpu"], ["pods"], ["memory"]):
+        tdev._usage_matrix(snap, struct, cols)
+    assert len(snap._usage_matrix_cache) <= tdev._USAGE_LRU_CAP
+
+
+def test_feasibility_fallback_labeled(monkeypatch):
+    """A raising feasibility launch increments the fallback counter,
+    parks the reason on the snapshot, and emits a trace event — never
+    silently."""
+    from kueue_tpu.obs import hooks as obs_hooks
+    from kueue_tpu.tas import feasibility as feas
+
+    # Precompute runs on the HOST scheduling path only — force the
+    # batched planner off so the drain takes it.
+    monkeypatch.setenv("KUEUE_TPU_TAS_BATCH", "0")
+    monkeypatch.setenv("KUEUE_TPU_TAS_FEAS_MIN", "1")
+    monkeypatch.setenv("KUEUE_TPU_TAS_FEAS_MIN_LEAVES", "1")
+    eng = _build_world(random.Random(95), 3, 2, 4)
+    monkeypatch.setattr(feas, "_launch",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("boom")))
+    emitted = []
+    real_emit = obs_hooks.emit
+
+    def spy(kind, key, **attrs):
+        emitted.append((kind, key, attrs))
+        return real_emit(kind, key, **attrs)
+
+    monkeypatch.setattr(obs_hooks, "emit", spy)
+    before = feas.FALLBACKS
+    eng.run_until_quiescent()
+    assert feas.FALLBACKS > before
+    assert any(k == "tas-feas-fallback" and "boom" in a.get("reason", "")
+               for k, _key, a in emitted)
+    snap = next(iter(eng.cache.tas_prototypes().values()))
+    assert "RuntimeError" in getattr(snap, "_feas_reason", "")

@@ -23,6 +23,10 @@ from dataclasses import dataclass, field
 DEFAULT_ZONES: tuple = (
     ("kueue_tpu/scheduler/", frozenset({"D1", "J1"})),
     ("kueue_tpu/tas/", frozenset({"D1", "U1", "J1"})),
+    # The batched planner never writes guarded usage state — it
+    # nominates against forks/memos and hands commits to the snapshot's
+    # own custodians — so it carries determinism + jit-purity only.
+    ("kueue_tpu/tas/batched.py", frozenset({"D1", "J1"})),
     ("kueue_tpu/ops/", frozenset({"D1", "J1"})),
     ("kueue_tpu/oracle/", frozenset({"D1", "J1"})),
     ("kueue_tpu/cache/snapshot.py", frozenset({"D1", "U1", "J1"})),

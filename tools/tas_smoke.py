@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""tas-smoke: the end-to-end check behind ``make tas-smoke``.
+
+Drives a bench_tas-shaped TAS world (3-level forest, mixed
+REQUIRED/PREFERRED/UNCONSTRAINED heads across several CQs sharing one
+TAS flavor) through the serving engine twice — batched planner on
+(KUEUE_TPU_TAS_BATCH=1, the default) and off (=0, the legacy
+demote-every-TAS-root path) — in separate subprocesses so each arm
+reads the env fresh, and asserts:
+
+  1. the batched arm actually ran hybrid cycles
+     (oracle.cycles_on_device > 0) and planned TAS heads;
+  2. both arms produce byte-identical admissions INCLUDING the
+     per-pod-set topology assignments (domains and counts).
+
+Exits non-zero with the first divergence otherwise.
+"""
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def drain(out_path: str, want_device: bool) -> None:
+    import random
+
+    import jax
+    jax.config.update("jax_enable_x64", True)
+
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        PodSetTopologyRequest,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Topology,
+        TopologyLevel,
+        TopologyMode,
+        Workload,
+    )
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.tas.snapshot import HOSTNAME_LABEL, Node
+
+    rng = random.Random(7)
+    eng = Engine()
+    eng.create_topology(Topology("dc", (
+        TopologyLevel("block"), TopologyLevel("rack"),
+        TopologyLevel(HOSTNAME_LABEL))))
+    eng.create_resource_flavor(ResourceFlavor(name="tas",
+                                              topology_name="dc"))
+    for b in range(2):
+        for r in range(4):
+            for h in range(10):
+                name = f"b{b}-r{r}-h{h}"
+                eng.create_node(Node(
+                    name=name,
+                    labels={"block": f"b{b}", "rack": f"b{b}-r{r}",
+                            HOSTNAME_LABEL: name},
+                    capacity={"cpu": 8000, "pods": 32}))
+    total = 80 * 8000
+    for i in range(4):
+        eng.create_cluster_queue(ClusterQueue(
+            name=f"cq-{i}", resource_groups=(ResourceGroup(
+                ("cpu",), (FlavorQuotas("tas", {"cpu": ResourceQuota(
+                    total // 4)}),)),)))
+        eng.create_local_queue(LocalQueue(f"lq-{i}", "default",
+                                          f"cq-{i}"))
+    eng.attach_oracle()
+    for i in range(40):
+        eng.clock += 0.001
+        mode = rng.choice([TopologyMode.REQUIRED, TopologyMode.PREFERRED,
+                           TopologyMode.UNCONSTRAINED])
+        level = None if mode == TopologyMode.UNCONSTRAINED else \
+            rng.choice(["block", "rack"])
+        eng.submit(Workload(
+            name=f"tas-{i}", queue_name=f"lq-{rng.randrange(4)}",
+            pod_sets=(PodSet(
+                "main", rng.choice([2, 4, 8]), {"cpu": 1000},
+                topology_request=PodSetTopologyRequest(
+                    mode=mode, level=level)),)))
+    eng.run_until_quiescent()
+
+    decisions = {}
+    for key, w in sorted(eng.workloads.items()):
+        adm = w.status.admission if w.status else None
+        if adm is None:
+            decisions[key] = None
+            continue
+        pas = []
+        for psa in adm.pod_set_assignments:
+            ta = psa.topology_assignment
+            doms = None if ta is None else tuple(
+                (tuple(d.values), d.count) for d in ta.domains)
+            pas.append((psa.name, tuple(sorted(psa.flavors.items())),
+                        doms))
+        decisions[key] = (adm.cluster_queue, tuple(pas))
+    b = eng.oracle
+    report = {
+        "decisions": decisions,
+        "device_cycles": b.cycles_on_device,
+        "tas_stats": dict(b.tas_stats),
+    }
+    if want_device:
+        assert b.cycles_on_device > 0, \
+            "batched arm ran zero device cycles"
+        assert b.tas_stats["plan_cycles"] > 0, \
+            "batched arm planned zero cycles"
+    with open(out_path, "wb") as f:
+        pickle.dump(report, f)
+
+
+def main() -> int:
+    if len(sys.argv) == 3 and sys.argv[1] == "--drain":
+        drain(sys.argv[2], os.environ.get(
+            "KUEUE_TPU_TAS_BATCH", "1") != "0")
+        return 0
+
+    reports = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        for arm in ("1", "0"):
+            out = os.path.join(tmp, f"arm-{arm}.pkl")
+            env = dict(os.environ,
+                       JAX_PLATFORMS="cpu", KUEUE_TPU_TAS_BATCH=arm)
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--drain", out], env=env)
+            if proc.returncode != 0:
+                print(f"tas-smoke FAIL: arm KUEUE_TPU_TAS_BATCH={arm} "
+                      f"exited {proc.returncode}")
+                return 1
+            with open(out, "rb") as f:
+                reports[arm] = pickle.load(f)
+
+    on, off = reports["1"], reports["0"]
+    if on["decisions"] != off["decisions"]:
+        ks = set(on["decisions"]) | set(off["decisions"])
+        diffs = [k for k in sorted(ks)
+                 if on["decisions"].get(k) != off["decisions"].get(k)]
+        print(f"tas-smoke FAIL: {len(diffs)} decision divergence(s) "
+              "between KUEUE_TPU_TAS_BATCH=1 and =0")
+        for k in diffs[:5]:
+            print(f"  {k}")
+            print(f"    on : {on['decisions'].get(k)}")
+            print(f"    off: {off['decisions'].get(k)}")
+        return 1
+
+    st = on["tas_stats"]
+    print("tas-smoke OK: "
+          f"device_cycles={on['device_cycles']} "
+          f"plan_cycles={st['plan_cycles']} "
+          f"placed={st['placed_device'] + st['placed_host']} "
+          f"drops={st['commit_drops']}; decisions byte-identical "
+          "with the planner off")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
