@@ -6,7 +6,7 @@ PY ?= python
 PYTEST_FLAGS ?= -q
 
 .PHONY: all native test test-fast test-device bench multichip-dryrun \
-  replay-smoke obs-smoke tas-smoke lint clean
+  replay-smoke obs-smoke tas-smoke perf-smoke bench-gate lint clean
 
 all: native
 
@@ -70,6 +70,21 @@ tas-smoke: lint
 # lint runs first: O1 violations invalidate digest-neutrality claims.
 obs-smoke: lint
 	JAX_PLATFORMS=cpu $(PY) tools/obs_smoke.py
+
+# Perf-telemetry smoke: two engines over the same short mixed world,
+# bare vs fully instrumented (tracer + perf recorder + SLO engine);
+# asserts digest identity, >=4 apply sub-phase histograms, promcheck /
+# trace_schema cleanliness and a loose overhead tripwire (obs/perf.py,
+# obs/slo.py). lint first: the capture paths live in O1/D1 zones.
+perf-smoke: lint
+	JAX_PLATFORMS=cpu $(PY) tools/perf_smoke.py
+
+# Bench regression sentinel: noise-aware per-scenario gate over the
+# accumulated BENCH_r*/MULTICHIP_r* trajectory (tools/bench_sentinel.py).
+# Fails (exit 1) when the latest round regressed past its scenario's
+# fitted threshold, pointing at the apply sub-phase histogram.
+bench-gate:
+	$(PY) tools/bench_sentinel.py --dir .
 
 # Validate the multi-chip sharding compiles + executes on a virtual mesh.
 multichip-dryrun:

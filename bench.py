@@ -868,12 +868,13 @@ def _tas_crossover_measure(build, n_probe: int = 5) -> dict:
 
 def bench_trace_overhead(n_workloads, n_cohorts=4, repeats=3):
     """Admission tracing must be observationally near-free: the same
-    sequential drain with and without the CycleTracer attached
-    (obs/tracer.py), best-of-N per arm. Budget: <=5% wall-clock
-    overhead — vs_baseline 1.0 means within budget, <1.0 scales by the
-    overrun. Both arms chain their per-cycle decision digests through a
-    listener (costed symmetrically), so the line also proves the
-    tracer's digest-neutrality contract on this exact run."""
+    sequential drain with and without the full observability stack
+    attached (obs/tracer.py + obs/perf.py + obs/slo.py), best-of-N per
+    arm. Budget: <=5% wall-clock overhead — vs_baseline 1.0 means
+    within budget, <1.0 scales by the overrun. Both arms chain their
+    per-cycle decision digests through a listener (costed
+    symmetrically), so the line also proves the stack's
+    digest-neutrality contract on this exact run."""
     from kueue_tpu.bench.scenario import baseline_like
     from kueue_tpu.controllers.engine import Engine
     from kueue_tpu.replay.trace import canonical_decisions, decision_digest
@@ -893,6 +894,8 @@ def bench_trace_overhead(n_workloads, n_cohorts=4, repeats=3):
         eng.cycle_listeners.append(listener)
         if traced:
             eng.attach_tracer(retain=64)
+            eng.attach_perf()
+            eng.attach_slo()
         for rf in scen.flavors:
             eng.create_resource_flavor(rf)
         for co in scen.cohorts:

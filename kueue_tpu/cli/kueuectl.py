@@ -486,6 +486,13 @@ def build_parser() -> argparse.ArgumentParser:
              "--json FILE, paths)")
     lint.add_argument("lint_args", nargs=argparse.REMAINDER)
 
+    slo = sub.add_parser(
+        "slo",
+        help="serving objectives: declared targets, multi-window burn "
+             "rates and ok/warn/breach status (obs/slo.py)")
+    slo.add_argument("--json", action="store_true", dest="as_json",
+                     help="emit the structured summary instead of text")
+
     tr = sub.add_parser(
         "trace", help="span-tree operations (obs/)")
     trs = tr.add_subparsers(dest="trace_command")
@@ -639,6 +646,31 @@ def run(engine, argv: list[str]) -> str:
         if args.as_json:
             return json.dumps(report, indent=2, default=str)
         return render_explain(report)
+    if args.command == "slo":
+        slo = getattr(engine, "slo", None)
+        if slo is None:
+            # Declarative objectives exist without an engine loop — show
+            # the declared targets with empty windows rather than
+            # refusing (a journal-rebuilt engine has no live history).
+            from kueue_tpu.obs.slo import attach_slo
+            slo = attach_slo(engine)
+        summary = slo.summary()
+        if args.as_json:
+            return json.dumps(summary, indent=2)
+        lines = [f"cycles observed: {summary['cyclesObserved']}",
+                 "windows: " + ", ".join(
+                     f"{w}={n} cycles"
+                     for w, n in summary["windows"].items())]
+        header = (f"{'OBJECTIVE':<24} {'KIND':<16} {'TARGET':>10} "
+                  f"{'BURN(fast)':>11} {'BURN(slow)':>11} STATUS")
+        lines.append(header)
+        for name, ev in summary["objectives"].items():
+            burns = ev["burn"]
+            lines.append(
+                f"{name:<24} {ev['kind']:<16} {ev['target']:>10.3g} "
+                f"{burns.get('fast', 0.0):>11.3f} "
+                f"{burns.get('slow', 0.0):>11.3f} {ev['statusName']}")
+        return "\n".join(lines)
     if args.command == "trace":
         if args.trace_command != "export":
             raise SystemExit("usage: kueuectl trace export --out FILE")

@@ -39,6 +39,7 @@ from kueue_tpu.scheduler.cycle import (
     RequeueReason,
     SchedulerCycle,
 )
+from kueue_tpu.obs import perf as _perf
 from kueue_tpu.workload_info import WorkloadInfo, admission_from_assignment
 
 
@@ -188,6 +189,10 @@ class Engine:
         # Admission tracer (obs.CycleTracer attaches itself here); the
         # flight recorder and explain path read it via this slot.
         self.tracer = None
+        # Perf telemetry (obs.perf.PerfRecorder) and SLO engine
+        # (obs.slo.SLOEngine) attach themselves here.
+        self.perf = None
+        self.slo = None
         self.workloads: dict[str, Workload] = {}
         # hook: called with (workload, admission) after each admission.
         self.on_admit: Optional[Callable] = None
@@ -698,6 +703,21 @@ class Engine:
         from kueue_tpu.obs import attach_tracer
         return attach_tracer(self, retain=retain, **kwargs)
 
+    def attach_perf(self):
+        """Enable always-on perf telemetry (obs.perf.PerfRecorder):
+        apply-phase sub-step histograms and device-side counters,
+        surfaced on /metrics. Digest-neutral and cheap enough to leave
+        on in production."""
+        from kueue_tpu.obs.perf import attach_perf
+        return attach_perf(self)
+
+    def attach_slo(self, **kwargs):
+        """Enable the SLO engine (obs.slo.SLOEngine): declarative
+        objectives evaluated over multi-window burn rates, exported on
+        /metrics and queryable via ``kueuectl slo``."""
+        from kueue_tpu.obs.slo import attach_slo
+        return attach_slo(self, **kwargs)
+
     def attach_oracle(self, max_depth: int = 4,
                       remote_address: Optional[tuple] = None) -> None:
         """Enable the batched TPU fast path for scheduling cycles. With
@@ -802,6 +822,11 @@ class Engine:
                     outcome, _time.perf_counter() - t0)
                 return result
             self.oracle.cycles_fallback += 1
+            try:
+                self.registry.counter("oracle_cycles_total").inc(
+                    ("fallback",))
+            except KeyError:
+                pass  # registry predates the oracle families
 
         heads = self.queues.heads(self.clock)
         if not heads:
@@ -1099,10 +1124,12 @@ class Engine:
         if ctx.removed_unadmitted:
             self.unadmitted.remove_many(ctx.removed_unadmitted)
         if self.journal is not None:
+            _pt = _perf.begin()
             for key in dict.fromkeys(ctx.journal_keys):
                 wl = self.workloads.get(key)
                 if wl is not None:
                     self.journal.apply("workload", wl, ts=self.clock)
+            _perf.end("apply.journal_append", _pt)
 
     def bulk_assume_batch(self, entries, bulk: "_BulkAdmitCtx") -> list:
         """In-cycle half of a device cycle's admitted batch: remove the
@@ -1337,7 +1364,9 @@ class Engine:
             self.metrics.admissions_total += n_admitted
             self._flush_admission_metrics(agg, lq_on)
 
+        _pt = _perf.begin()
         self.admission_routine.run(_batch)
+        _perf.end("apply.listener_fanout", _pt)
 
     def _flush_admission_metrics(self, agg: dict, lq_on: bool) -> None:
         """Direct registry writes for a batch's admission metric series:
@@ -1410,6 +1439,7 @@ class Engine:
         Admitted condition follows only when all AdmissionChecks are Ready
         (prepareWorkload :912)."""
         wl = entry.obj
+        _pt = _perf.begin()
         if bulk is not None:
             # Admission objects are immutable; flyweight them per
             # (CQ, assignment) — bridge assignments are themselves
@@ -1453,10 +1483,13 @@ class Engine:
                     wl.set_condition(ctype, False, reason="QuotaReserved",
                                      now=self.clock)
         entry.info.apply_admission(admission)
+        _perf.end("apply.diff_build", _pt)
+        _pt = _perf.begin()
         self.cache.add_or_update_workload(wl, info=entry.info)
         # The workload left the pending world: free its tensor row (the
         # pending heaps already dropped it at pop/delete time).
         self.queues.rows.on_remove(wl.key)
+        _perf.end("apply.rowcache_writeback", _pt)
         # An assumed workload that was itself a pending preemption target
         # satisfies its expectation (scheduler.go:882, kueue#11480).
         self.preemption_expectations.observed_uid(wl.key, wl.uid)
@@ -1810,8 +1843,11 @@ class Engine:
         if defer_journal is not None:
             defer_journal.journal_keys.append(workload)
         elif self.journal is not None and workload in self.workloads:
+            _pt = _perf.begin()
             self.journal.apply("workload", self.workloads[workload],
                                ts=self.clock)
+            _perf.end("apply.journal_append", _pt)
+        _pt = _perf.begin()
         for fn in tuple(self.event_listeners):
             # Handler errors must not unwind the scheduling cycle
             # (client-go informers isolate handler panics the same way).
@@ -1820,3 +1856,4 @@ class Engine:
             except Exception as e:  # noqa: BLE001
                 import warnings
                 warnings.warn(f"event listener {fn!r} raised: {e!r}")
+        _perf.end("apply.listener_fanout", _pt)

@@ -53,6 +53,13 @@ class Gauge:
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60, 300,
                    1800)
 
+# Fixed log-spaced edges for the perf-telemetry histograms (obs.perf):
+# quarter-decade steps spanning 1µs..10s. The edges are a compile-time
+# constant — never derived from observed data — so histograms from any
+# two runs/processes are bucket-compatible and merge by plain
+# element-wise addition (the mergeability contract ISSUE 8 names).
+PERF_BUCKETS = tuple(round(10.0 ** (k / 4.0), 12) for k in range(-24, 5))
+
 
 @dataclass
 class Histogram:
@@ -248,6 +255,33 @@ class MetricsRegistry:
         c("trace_cycles_total", "traced scheduling cycles per mode")
         c("trace_workload_decisions_total",
           "traced workload decision spans per outcome")
+        # oracle fast-path posture: the bridge's diagnostic dicts
+        # (fallback_reasons / host_root_reasons / cycle counts),
+        # promoted from bench-only detail blobs to first-class series.
+        c("oracle_cycles_total",
+          "oracle-path cycles per mode (device|hybrid|fallback)")
+        c("oracle_fallback_total", "whole-cycle fallbacks per reason")
+        c("oracle_host_root_total",
+          "cohort roots demoted to the host path per reason")
+        # perf telemetry (obs.perf): apply-phase micro-attribution and
+        # device-side counters. The subphase histogram uses the fixed
+        # log-spaced PERF_BUCKETS so series merge across processes.
+        h("apply_subphase_duration_seconds",
+          "apply-phase sub-step durations per (subphase, mode)",
+          buckets=PERF_BUCKETS)
+        c("perf_kernel_launches_total", "device program launches per site")
+        c("perf_transfer_bytes_total",
+          "host<->device transfer bytes per (site, direction)")
+        c("perf_jit_cache_events_total",
+          "jit shape-signature cache events per (site, outcome)")
+        c("perf_tas_cycle_mix_total",
+          "TAS placement cycles per kind (batched|host_fallback)")
+        # SLO engine (obs.slo): declarative objectives over multi-window
+        # burn rates.
+        g("slo_burn_rate", "error-budget burn rate per (objective, window)")
+        g("slo_status",
+          "objective status per objective (0 ok | 1 warn | 2 breach)")
+        g("slo_objective_target", "declared target per objective")
         self.gauge("build_info").set(
             (("name", "kueue_tpu"), ("version", "0.2.0")), 1)
 
@@ -257,8 +291,11 @@ class MetricsRegistry:
     def _gauge(self, name, help=""):
         self._metrics[name] = Gauge(name, help)
 
-    def _histogram(self, name, help=""):
-        self._metrics[name] = Histogram(name, help)
+    def _histogram(self, name, help="", buckets=None):
+        if buckets is None:
+            self._metrics[name] = Histogram(name, help)
+        else:
+            self._metrics[name] = Histogram(name, help, buckets=buckets)
 
     def __getitem__(self, name: str):
         return self._metrics[name]

@@ -9,11 +9,13 @@ named scopes that line host spans up with XLA profiles (obs.device).
 
 from kueue_tpu.obs import hooks
 from kueue_tpu.obs.explain import explain_workload, render_explain
+from kueue_tpu.obs.perf import PerfRecorder, PhaseHistogram, attach_perf
 from kueue_tpu.obs.perfetto import (
     spans_from_flight_trace,
     to_perfetto,
     write_perfetto,
 )
+from kueue_tpu.obs.slo import SLO, SLOEngine, attach_slo
 from kueue_tpu.obs.span import Span, correlation_id
 from kueue_tpu.obs.tracer import CycleTracer
 
@@ -29,7 +31,13 @@ def attach_tracer(engine, retain: int = 64, **kwargs) -> CycleTracer:
 
 __all__ = [
     "CycleTracer",
+    "PerfRecorder",
+    "PhaseHistogram",
+    "SLO",
+    "SLOEngine",
     "Span",
+    "attach_perf",
+    "attach_slo",
     "attach_tracer",
     "correlation_id",
     "explain_workload",

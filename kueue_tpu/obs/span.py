@@ -27,9 +27,12 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterator, Optional
 
 
-@dataclass
+@dataclass(slots=True)
 class Span:
-    """One node of a cycle's span tree."""
+    """One node of a cycle's span tree. Slotted: a traced bench drain
+    allocates one span per decided workload per cycle, and the
+    per-instance ``__dict__`` was a measurable share of the tracer's
+    wall-clock overhead."""
 
     name: str
     kind: str                      # "cycle" | "phase" | "workload"

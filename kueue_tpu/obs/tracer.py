@@ -101,13 +101,37 @@ class CycleTracer:
         # path; encode/device/apply/finalize on the device path).
         cursor = ts
         decide_ts = ts
+        apply_span = None
         for phase, secs in eng.last_cycle_phases.items():
             dur = secs * 1e6
-            root.child(f"phase/{phase}", "phase", cursor, dur,
-                       seconds=round(secs, 6))
+            ps = root.child(f"phase/{phase}", "phase", cursor, dur,
+                            seconds=round(secs, 6))
+            if phase == "apply":
+                apply_span = ps
             if phase in ("decide", "device"):
                 decide_ts = cursor
             cursor += dur
+        # Apply micro-attribution (obs.perf): when the perf recorder is
+        # attached, nest this cycle's apply sub-step samples as spans
+        # under phase/apply, laid end-to-end — the span tree and the
+        # aggregated histograms speak the same vocabulary. Samples
+        # aggregate per sub-phase name (a cycle admitting N workloads
+        # records N diff_build scopes): one span per name keeps the
+        # tree bounded regardless of batch size.
+        perf = getattr(eng, "perf", None)
+        if perf is not None and apply_span is not None:
+            agg: dict = {}
+            for name, secs in perf.current_samples():
+                if name.startswith("apply."):
+                    tot, n = agg.get(name, (0.0, 0))
+                    agg[name] = (tot + secs, n + 1)
+            sub_cursor = apply_span.ts
+            for name, (secs, n) in agg.items():
+                sdur = secs * 1e6
+                apply_span.child(f"subphase/{name}", "subphase",
+                                 sub_cursor, sdur,
+                                 seconds=round(secs, 6), samples=n)
+                sub_cursor += sdur
         rationale = buf.by_workload() if buf is not None else {}
         for e in list(result.entries) + list(result.inadmissible):
             root.children.append(
@@ -171,13 +195,21 @@ class CycleTracer:
                 "mode": attrs["mode"], "admitted": attrs["admitted"],
                 "preempting": attrs["preempting"]}, ts=eng.clock)
         if self.emit_events:
-            eng._event(
-                "cycle_trace", "", "",
-                detail=(f"cid={attrs['cid']} mode={attrs['mode']} "
-                        f"admitted={attrs['admitted']} "
-                        f"preempting={attrs['preempting']} "
-                        f"inadmissible={attrs['inadmissible']} "
-                        f"dur_ms={root.dur / 1e3:.3f}"))
+            detail = (f"cid={attrs['cid']} mode={attrs['mode']} "
+                      f"admitted={attrs['admitted']} "
+                      f"preempting={attrs['preempting']} "
+                      f"inadmissible={attrs['inadmissible']} "
+                      f"dur_ms={root.dur / 1e3:.3f}")
+            slo = getattr(eng, "slo", None)
+            if slo is not None:
+                # SLO posture rides the per-cycle summary: a dashboard
+                # following the SSE stream sees burn state change on the
+                # very cycle that turned it.
+                try:
+                    detail += f" slo={slo.status_string()}"
+                except Exception:  # noqa: BLE001 — summary must not
+                    pass           # unwind the cycle listener
+            eng._event("cycle_trace", "", "", detail=detail)
 
     # -- query surface --
 

@@ -56,6 +56,7 @@ from typing import Optional
 import numpy as np
 
 from kueue_tpu.api.types import FlavorResource
+from kueue_tpu.obs import perf as _obs_perf
 from kueue_tpu.scheduler.cycle import (
     CycleResult,
     Entry,
@@ -157,11 +158,24 @@ class OracleBridge:
     def _fallback(self, reason: str) -> None:
         self.fallback_reasons[reason] = \
             self.fallback_reasons.get(reason, 0) + 1
+        self._count("oracle_fallback_total", (reason,))
         return None
 
     def _host_root(self, reason: str, count: int = 1) -> None:
         self.host_root_reasons[reason] = \
             self.host_root_reasons.get(reason, 0) + count
+        self._count("oracle_host_root_total", (reason,), count)
+
+    def _count(self, family: str, labels: tuple,
+               amount: float = 1.0) -> None:
+        """Mirror a bridge diagnostic into the registry so it is
+        visible on /metrics in production, not just in bench detail
+        blobs. Write-only; tolerant of registries predating the
+        oracle_* families (journal-rebuilt old engines)."""
+        try:
+            self.engine.registry.counter(family).inc(labels, amount)
+        except KeyError:
+            pass
 
     def _world_tensors(self):
         """World structure tensors memoized by the cache's spec version;
@@ -1143,9 +1157,13 @@ class OracleBridge:
                     w, pcfg, adm, self._head_pri(wl, head_wid))))
         _t_encode = _time.perf_counter()
         _ann.phase("device")
-        out = self.executor.cycle_step(
-            dict(pending=pending, inadmissible=inadmissible, usage=usage,
-                 **args, **pre_kwargs), statics)
+        _inputs = dict(pending=pending, inadmissible=inadmissible,
+                       usage=usage, **args, **pre_kwargs)
+        if _obs_perf.ACTIVE is not None:
+            _obs_perf.device_call("cycle_step", _inputs, statics)
+        out = self.executor.cycle_step(_inputs, statics)
+        if _obs_perf.ACTIVE is not None:
+            _obs_perf.device_result("cycle_step", out)
         (new_pending, new_inadmissible, usage2, wl_admitted, slot_admitted,
          slot_position, flavor_of_res, any_oracle, slot_oracle,
          slot_preempting, head_idx, slot_overflow, victim_mask,
@@ -1306,6 +1324,7 @@ class OracleBridge:
                 for k, v in hst.preemption_skips.items():
                     st.preemption_skips[k] = \
                         st.preemption_skips.get(k, 0) + v
+        self._count("oracle_cycles_total", (eng.last_cycle_mode,))
         return result
 
     def _apply(self, w, wls, pending_infos, wl_admitted, parked,
@@ -1380,6 +1399,7 @@ class OracleBridge:
         from kueue_tpu.scheduler.preemption import Target
 
         admits = []
+        _pt = _obs_perf.begin()
         for ci in nominate_order:
             if not slot_mask[ci]:
                 continue
@@ -1424,6 +1444,7 @@ class OracleBridge:
                                   requeue_reason=RequeueReason.NO_FIT)
                     entry.inadmissible_msg = "NoFit (batched oracle)"
                     result.entries.append(entry)
+        _obs_perf.end("apply.diff_build", _pt)
         # Preempt-mode slots whose victim set was selected but whose
         # commit lost (capacity claimed by an earlier entry this cycle)
         # are the reference's skipped preemptions
@@ -1446,7 +1467,10 @@ class OracleBridge:
         # applied above — victims are admitted rows, parks are other
         # pending rows). Status finalization is deferred to the
         # finalize phase (bulk_finalize_batch).
-        return eng.bulk_assume_batch(admits, bulk)
+        _pt = _obs_perf.begin()
+        pairs = eng.bulk_assume_batch(admits, bulk)
+        _obs_perf.end("apply.rowcache_writeback", _pt)
+        return pairs
 
     def _make_entry(self, info, w, wls, flavor_of_res, i,
                     topo=None) -> Entry:

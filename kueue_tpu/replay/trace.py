@@ -62,9 +62,16 @@ def canonical_decisions(result) -> list:
     heads are NOT: the host path materializes them as entries while a
     device cycle reports only decided slots, and a cycle that decides
     nothing surfaces as an entry-less result on one path and an idle
-    None on the other — representation, not decisions."""
+    None on the other — representation, not decisions.
+
+    Memoized per result object: at cycle end the flight recorder, the
+    tracer and any digest-chaining listener each canonicalize the same
+    (by then immutable) CycleResult — one walk serves them all."""
     if result is None:
         return []
+    cached = getattr(result, "_canonical_decisions", None)
+    if cached is not None:
+        return cached
     from kueue_tpu.scheduler.cycle import EntryStatus
 
     def topo(psa) -> Optional[list]:
@@ -89,9 +96,10 @@ def canonical_decisions(result) -> list:
             preempting.append([
                 e.info.key,
                 sorted(t.workload.key for t in e.preemption_targets)])
-    if not admitted and not preempting:
-        return []
-    return [sorted(admitted), sorted(preempting)]
+    decisions = ([] if not admitted and not preempting
+                 else [sorted(admitted), sorted(preempting)])
+    result._canonical_decisions = decisions
+    return decisions
 
 
 def decision_digest(decisions: list, prev: int = 0) -> int:

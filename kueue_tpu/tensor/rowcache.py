@@ -34,6 +34,7 @@ from typing import Optional
 
 import numpy as np
 
+from kueue_tpu.obs import perf as _perf
 from kueue_tpu.workload_info import WorkloadInfo
 
 _INF_TS = np.inf
@@ -365,12 +366,14 @@ class WorkloadRowCache:
         self.bind_world(world)
         if not self._dirty:
             return
+        _pt = _perf.begin()
         cq_idx = {n: i for i, n in enumerate(world.cq_names)}
         s_idx = {n: i for i, n in enumerate(world.resource_names)}
         for i in self._dirty:
             if self.info_of[i] is not None:
                 self._encode_row(i, world, cq_idx, s_idx)
         self._dirty.clear()
+        _perf.end("encode.rowcache_flush", _pt)
 
     def refresh_held(self, now: float) -> None:
         """Re-read requeue-at for rows currently held back: eviction
@@ -561,6 +564,7 @@ class AdmittedRows:
         cache.admitted_dirty.clear()
         if dirty is None and self._tensors is not None:
             return self._tensors
+        _pt = _perf.begin()
         if dirty:
             for key in dirty:
                 info = cache.workloads.get(key)
@@ -590,6 +594,7 @@ class AdmittedRows:
             qr_time=self.qr_time, uid_rank=uid_rank,
             evicted=self.evicted, usage=self.usage,
             live=len(self._row_of))
+        _perf.end("encode.admitted_sync", _pt)
         return self._tensors
 
     @property

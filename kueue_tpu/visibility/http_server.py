@@ -19,6 +19,8 @@ from kueue_tpu.visibility.server import (
     dump_state,
     eviction_summary,
     oracle_stats,
+    perf_summary,
+    slo_summary,
     trace_summary,
 )
 
@@ -155,11 +157,19 @@ def make_handler(engine, auth_token=None, apf=None,
                         self.wfile.write(b": keep-alive\n\n")
                         self.wfile.flush()
                         continue
-                    payload = json.dumps({
+                    body = {
                         "time": ev.time, "kind": ev.kind,
                         "workload": ev.workload,
                         "clusterQueue": ev.cluster_queue,
-                        "detail": ev.detail})
+                        "detail": ev.detail}
+                    if ev.detail.startswith("cid="):
+                        # cycle_trace summaries carry the correlation id
+                        # first in detail; surface it structured so a
+                        # browser can join the SSE stream against
+                        # journal records, recorder frames and
+                        # /debug/trace rows without string parsing.
+                        body["cid"] = ev.detail[4:].split(" ", 1)[0]
+                    payload = json.dumps(body)
                     self.wfile.write(
                         f"event: {ev.kind}\ndata: {payload}\n\n"
                         .encode())
@@ -203,6 +213,12 @@ def make_handler(engine, auth_token=None, apf=None,
                 # same race discipline as the other live views.
                 self._send_view("trace", trace_summary,
                                 empty='{"enabled": false, "cycles": []}')
+            elif path == "/debug/perf":
+                self._send_view("perf", perf_summary,
+                                empty='{"enabled": false}')
+            elif path == "/debug/slo":
+                self._send_view("slo", slo_summary,
+                                empty='{"enabled": false}')
             elif path == "/capacity":
                 self._send_view("capacity", capacity_summary)
             elif path == "/cohorts":

@@ -180,8 +180,32 @@ def trace_summary(engine) -> dict:
     tracer = getattr(engine, "tracer", None)
     if tracer is None:
         return {"enabled": False, "cycles": []}
+    cycles = tracer.trees()
+    for row in cycles:
+        # Lift the correlation id to the row envelope: the browser-side
+        # join key against journal cycle_trace records, flight-recorder
+        # frames and SSE summaries, without digging into attrs.
+        row["cid"] = row.get("attrs", {}).get("cid")
     return {"enabled": True,
             "retain": tracer.retain,
             "cyclesTraced": tracer.cycles_traced,
             "lastCid": tracer.last_cid,
-            "cycles": tracer.trees()}
+            "cycles": cycles}
+
+
+def perf_summary(engine) -> dict:
+    """The /debug/perf body: apply sub-phase histogram aggregates from
+    the attached PerfRecorder (obs.perf)."""
+    perf = getattr(engine, "perf", None)
+    if perf is None:
+        return {"enabled": False}
+    return {"enabled": True, **perf.summary()}
+
+
+def slo_summary(engine) -> dict:
+    """The /debug/slo body: declarative objectives with their
+    multi-window burn rates (obs.slo)."""
+    slo = getattr(engine, "slo", None)
+    if slo is None:
+        return {"enabled": False}
+    return {"enabled": True, **slo.summary()}

@@ -1,0 +1,605 @@
+"""Perf telemetry (obs/perf.py), SLO burn rates (obs/slo.py) and the
+bench regression sentinel (tools/bench_sentinel.py).
+
+Covers: PhaseHistogram determinism and mergeability over the fixed
+PERF_BUCKETS edges; apply-phase micro-attribution on both decision
+paths (>= 4 named sub-phase histograms, 5 with a journal attached);
+the digest-neutrality contract (instrumented and bare runs decide
+byte-identically); device counters (kernel launches, transfer bytes,
+jit shape-signature cache events); Perfetto export of phase scopes with
+nested subphase spans; SLO multi-window burn-rate math (ok -> warn ->
+breach) and gauge export; sentinel value parsing, threshold fitting,
+the min-history rule, the synthetic 30%-regression flag, and the real
+checked-in BENCH trajectory passing; and the query surfaces (trace
+rows carrying cid, /debug bodies, kueuectl slo, SSE slo posture)."""
+
+import json
+import math
+import os
+import re
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from kueue_tpu.api.types import (  # noqa: E402
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine  # noqa: E402
+from kueue_tpu.metrics.registry import PERF_BUCKETS  # noqa: E402
+from kueue_tpu.obs import perf as perf_mod  # noqa: E402
+from kueue_tpu.obs.perf import (  # noqa: E402
+    APPLY_SUBPHASES,
+    PhaseHistogram,
+)
+from kueue_tpu.obs.slo import (  # noqa: E402
+    SLO,
+    STATUS_BREACH,
+    STATUS_OK,
+    STATUS_WARN,
+    SLOEngine,
+)
+from tools import bench_sentinel  # noqa: E402
+
+CPU = "cpu"
+CID_RE = re.compile(r"^\d{6}-[0-9a-f]{8}$")
+
+
+@pytest.fixture(autouse=True)
+def _reset_active():
+    """The perf recorder parks itself in a process-global ACTIVE slot
+    (the obs.hooks posture) — never let one test's recorder observe
+    another test's engine."""
+    yield
+    perf_mod.ACTIVE = None
+
+
+def make_engine(nominal=1000):
+    eng = Engine()
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            (CPU,),
+            (FlavorQuotas("default", {CPU: ResourceQuota(nominal)}),)),),
+    ))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng
+
+
+def submit(eng, name, cpu, priority=0):
+    eng.clock += 0.5
+    wl = Workload(name=name, queue_name="lq", priority=priority,
+                  pod_sets=(PodSet("main", 1, {CPU: cpu}),))
+    eng.submit(wl)
+    return wl
+
+
+def drain(eng, limit=50):
+    for _ in range(limit):
+        if eng.schedule_once() is None:
+            break
+
+
+class TestPhaseHistogram:
+    def test_fixed_log_spaced_edges(self):
+        # The merge contract rests on these being compile-time
+        # constants: quarter-decade spacing, never fitted to data.
+        assert PhaseHistogram.edges is PERF_BUCKETS
+        assert len(PERF_BUCKETS) == 29
+        # Edges are rounded to 12 decimal places (sub-microsecond edges
+        # keep ~6 significant digits), so quarter-decade spacing holds
+        # to that precision.
+        for lo, hi in zip(PERF_BUCKETS, PERF_BUCKETS[1:]):
+            assert hi / lo == pytest.approx(10.0 ** 0.25, rel=1e-5)
+
+    def test_observation_order_does_not_matter(self):
+        vals = [3e-6, 2e-4, 0.015, 0.7, 2e-4, 9.0]
+        a, b = PhaseHistogram(), PhaseHistogram()
+        for v in vals:
+            a.observe(v)
+        for v in reversed(vals):
+            b.observe(v)
+        assert a == b
+        da, db = a.to_dict(), b.to_dict()
+        assert da["counts"] == db["counts"]
+        assert da["total"] == db["total"]
+        # sum is float accumulation: order-stable only to epsilon.
+        assert da["sum"] == pytest.approx(db["sum"])
+
+    def test_merge_equals_union_observation(self):
+        xs, ys = [1e-5, 4e-3, 0.2], [7e-4, 0.2, 3.0]
+        merged, union = PhaseHistogram(), PhaseHistogram()
+        other = PhaseHistogram()
+        for v in xs:
+            merged.observe(v)
+        for v in ys:
+            other.observe(v)
+        merged.merge(other)
+        for v in xs + ys:
+            union.observe(v)
+        assert merged == union
+        assert merged.sum == pytest.approx(union.sum)
+
+    def test_quantile_bounds(self):
+        h = PhaseHistogram()
+        assert h.quantile(0.5) == 0.0
+        for _ in range(100):
+            h.observe(2e-3)
+        # Every sample sits in one bucket: any quantile reports that
+        # bucket's upper edge, which must bound the true value.
+        for q in (0.5, 0.95, 0.99):
+            assert h.quantile(q) >= 2e-3
+            assert h.quantile(q) <= 2e-3 * 10.0 ** 0.25 * 1.001
+
+    def test_dict_roundtrip(self):
+        h = PhaseHistogram()
+        for v in (1e-4, 5e-2, 1.5):
+            h.observe(v)
+        assert PhaseHistogram.from_dict(h.to_dict()) == h
+
+
+class TestApplyAttribution:
+    def test_sequential_subphases(self, tmp_path):
+        from kueue_tpu.store.journal import attach_new_journal
+
+        eng = make_engine(nominal=5000)
+        attach_new_journal(eng, str(tmp_path / "j.jsonl"))
+        perf = eng.attach_perf()
+        for i in range(6):
+            submit(eng, f"w{i}", 700)
+        drain(eng)
+        subs = perf.subphases(mode="sequential")
+        applies = {n for n in subs if n.startswith("apply.")}
+        # The acceptance floor: >= 4 named sub-phases; with a journal
+        # attached the full 5-name vocabulary reports.
+        assert applies == set(APPLY_SUBPHASES)
+        for name in applies:
+            assert subs[name].total > 0
+            assert subs[name].sum >= 0.0
+
+    def test_registry_histogram_renders(self):
+        eng = make_engine(nominal=5000)
+        eng.attach_perf()
+        for i in range(4):
+            submit(eng, f"w{i}", 700)
+        drain(eng)
+        text = eng.registry.render()
+        assert "kueue_tpu_apply_subphase_duration_seconds_bucket" in text
+        assert 'label_0="apply.diff_build"' in text
+        assert 'label_1="sequential"' in text
+
+    def test_attach_is_idempotent_and_detach_clears(self):
+        eng = make_engine()
+        perf = eng.attach_perf()
+        assert eng.attach_perf() is perf
+        assert perf_mod.ACTIVE is perf
+        perf.detach()
+        assert eng.perf is None
+        assert perf_mod.ACTIVE is None
+        # Emitting with recording off is free and harmless.
+        assert perf_mod.begin() is None
+        perf_mod.end("apply.diff_build", None)
+        perf_mod.count("perf_kernel_launches_total", ("x",))
+
+
+class TestDigestNeutrality:
+    def _drive(self, instrumented):
+        from kueue_tpu.replay.trace import (
+            canonical_decisions,
+            decision_digest,
+        )
+
+        eng = make_engine(nominal=4000)
+        state = {"digest": 0}
+
+        def listener(seq, result):
+            if result is not None:
+                state["digest"] = decision_digest(
+                    canonical_decisions(result), state["digest"])
+
+        eng.cycle_listeners.append(listener)
+        if instrumented:
+            eng.attach_tracer(retain=32)
+            eng.attach_perf()
+            eng.attach_slo()
+        for i in range(8):
+            submit(eng, f"w{i}", 900)  # forces skips + admissions
+        drain(eng)
+        return state["digest"], eng
+
+    def test_instrumented_run_decides_identically(self):
+        bare, _ = self._drive(instrumented=False)
+        perf_mod.ACTIVE = None
+        inst, eng = self._drive(instrumented=True)
+        assert inst == bare
+        assert eng.perf.cycles_seen > 0
+        assert eng.slo.cycles_observed > 0
+
+
+class TestDevicePath:
+    def _engine(self):
+        pytest.importorskip("jax")
+        eng = make_engine(nominal=3000)
+        eng.attach_oracle()
+        perf = eng.attach_perf()
+        return eng, perf
+
+    def test_device_subphases_and_counters(self):
+        eng, perf = self._engine()
+        for i in range(4):
+            submit(eng, f"w{i}", 1000)
+        drain(eng)
+        device_modes = {m for _, m in perf.hist if m != "sequential"}
+        assert device_modes, \
+            "oracle bridge never ran a device/hybrid cycle"
+        device_subs = {n for n, m in perf.hist if m in device_modes}
+        # The batched apply decomposes on the device path too.
+        assert "apply.diff_build" in device_subs
+        assert "apply.rowcache_writeback" in device_subs
+        text = eng.registry.render()
+        assert re.search(
+            r'kueue_tpu_perf_kernel_launches_total\{[^}]*cycle_step'
+            r'[^}]*\} [1-9]', text)
+        assert 'kueue_tpu_perf_jit_cache_events_total' in text
+        assert re.search(
+            r'kueue_tpu_perf_transfer_bytes_total\{[^}]*h2d[^}]*\} '
+            r'[1-9]', text)
+        assert re.search(
+            r'kueue_tpu_oracle_cycles_total\{[^}]*\} [1-9]', text)
+
+    def test_jit_signature_cache_hits_on_stable_shapes(self):
+        eng, perf = self._engine()
+        for i in range(6):
+            submit(eng, f"w{i}", 500)
+        drain(eng)
+        ctr = eng.registry.counter("perf_jit_cache_events_total")
+        events = {labels: v for labels, v in ctr.values.items()}
+        misses = sum(v for (site, kind), v in events.items()
+                     if kind == "miss")
+        hits = sum(v for (site, kind), v in events.items()
+                   if kind == "hit")
+        assert misses >= 1
+        # Stable world shapes: later launches reuse earlier signatures.
+        assert hits >= 1, f"no signature-cache hits: {events}"
+
+
+class TestPerfettoExport:
+    def test_phase_and_subphase_spans_export(self, tmp_path):
+        from kueue_tpu.obs import write_perfetto
+
+        eng = make_engine(nominal=5000)
+        tracer = eng.attach_tracer()
+        eng.attach_perf()
+        for i in range(5):
+            submit(eng, f"w{i}", 700)
+        drain(eng)
+        out = str(tmp_path / "trace.json")
+        write_perfetto(list(tracer.spans), out)
+        with open(out, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        names = [ev.get("name", "") for ev in doc["traceEvents"]]
+        # PhaseAnnotator vocabulary scopes (phase/snapshot|decide|apply)
+        # and the new apply micro-attribution both land in the export.
+        assert any(n.startswith("phase/apply") for n in names)
+        subs = {n for n in names if n.startswith("subphase/")}
+        assert {"subphase/apply.diff_build",
+                "subphase/apply.rowcache_writeback"} <= subs
+        # Subphase spans nest inside the apply phase window.
+        by_name = {ev["name"]: ev for ev in doc["traceEvents"]
+                   if ev.get("ph") == "X"}
+        apply_ev = next(ev for n, ev in by_name.items()
+                        if n.startswith("phase/apply"))
+        for n, ev in by_name.items():
+            if n.startswith("subphase/"):
+                assert ev["ts"] >= apply_ev["ts"] - 1e-6
+
+    def test_trace_schema_clean(self, tmp_path):
+        from kueue_tpu.obs import write_perfetto
+        from tools.trace_schema import check_trace_events
+
+        eng = make_engine(nominal=5000)
+        tracer = eng.attach_tracer()
+        eng.attach_perf()
+        for i in range(3):
+            submit(eng, f"w{i}", 700)
+        drain(eng)
+        out = str(tmp_path / "trace.json")
+        write_perfetto(list(tracer.spans), out)
+        with open(out, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        assert check_trace_events(doc) == []
+
+
+class TestSLOBurnRates:
+    def _slo(self, objectives=None, windows=(("fast", 4), ("slow", 16))):
+        # Miniature windows need a budget scaled to match: with a
+        # 16-cycle slow window, the production 5% budget burns on a
+        # single violation. 30% keeps the ok/warn/breach edges apart.
+        eng = make_engine()
+        return eng, eng.attach_slo(
+            objectives=objectives or (
+                SLO("lat", kind="latency_p95", target=0.1, budget=0.3),),
+            windows=windows)
+
+    def test_ok_warn_breach_progression(self):
+        eng, slo = self._slo()
+        for _ in range(16):
+            slo.observe_cycle(0.01, admitted=1, is_fallback=False)
+        assert slo.evaluate()["lat"]["status"] == STATUS_OK
+        # Sharp regression: the fast window fills with violations long
+        # before the slow window's violation share crosses its budget.
+        for _ in range(4):
+            slo.observe_cycle(0.5, admitted=1, is_fallback=False)
+        ev = slo.evaluate()["lat"]
+        assert ev["burn"]["fast"] >= 1.0
+        assert ev["status"] == STATUS_WARN
+        # Sustained regression: both windows burn -> page.
+        for _ in range(16):
+            slo.observe_cycle(0.5, admitted=1, is_fallback=False)
+        ev = slo.evaluate()["lat"]
+        assert ev["burn"]["slow"] >= 1.0
+        assert ev["status"] == STATUS_BREACH
+        assert slo.status_string() == "breach:lat"
+
+    def test_single_slow_cycle_cannot_page(self):
+        eng, slo = self._slo()
+        for _ in range(15):
+            slo.observe_cycle(0.01, admitted=1, is_fallback=False)
+        slo.observe_cycle(5.0, admitted=1, is_fallback=False)
+        ev = slo.evaluate()["lat"]
+        assert ev["status"] != STATUS_BREACH
+
+    def test_rate_floor_burn(self):
+        eng, slo = self._slo(objectives=(
+            SLO("rate", kind="rate_floor", target=100.0, budget=0.25),))
+        # 10 admissions over 1s-long cycles = 10/s against a 100/s
+        # floor: 90% shortfall / 25% budget = burn 3.6.
+        for _ in range(4):
+            slo.observe_cycle(1.0, admitted=10, is_fallback=False)
+        ev = slo.evaluate()["rate"]
+        assert ev["burn"]["fast"] == pytest.approx(3.6)
+        # Healthy rate clears it.
+        for _ in range(4):
+            slo.observe_cycle(0.01, admitted=50, is_fallback=False)
+        assert slo.evaluate()["rate"]["burn"]["fast"] < 1.0
+
+    def test_fallback_ratio_burn(self):
+        eng, slo = self._slo(objectives=(
+            SLO("fb", kind="fallback_ratio", target=0.25),))
+        for i in range(4):
+            slo.observe_cycle(0.01, admitted=1, is_fallback=(i % 2 == 0))
+        # 50% fallback share / 25% target = burn 2.0.
+        assert slo.evaluate()["fb"]["burn"]["fast"] == pytest.approx(2.0)
+
+    def test_gauges_exported(self):
+        eng, slo = self._slo()
+        for _ in range(4):
+            slo.observe_cycle(0.01, admitted=1, is_fallback=False)
+        text = eng.registry.render()
+        assert re.search(r'kueue_tpu_slo_burn_rate\{[^}]*"lat"[^}]*\}',
+                         text.replace("'", '"'))
+        assert "kueue_tpu_slo_status" in text
+        assert "kueue_tpu_slo_objective_target" in text
+
+    def test_engine_loop_feeds_observations(self):
+        eng = make_engine(nominal=4000)
+        slo = eng.attach_slo()
+        for i in range(4):
+            submit(eng, f"w{i}", 900)
+        drain(eng)
+        assert slo.cycles_observed > 0
+        # CPU-host cycles are fast and nothing is a fallback (no oracle
+        # attached): every default objective holds.
+        assert slo.status_string() == "ok"
+
+
+class TestBenchSentinel:
+    def _write_round(self, directory, rnd, scenarios):
+        with open(os.path.join(directory, f"BENCH_r{rnd:02d}.json"),
+                  "w", encoding="utf-8") as fh:
+            json.dump({"n": rnd, "rc": 0, "tail": "",
+                       "parsed": {"scenarios": {
+                           name: {"value": v, "unit": unit}
+                           for name, (v, unit) in scenarios.items()}}},
+                      fh)
+
+    def test_value_string_parsing_with_parenthesized_unit(self):
+        assert bench_sentinel._parse_value_str(
+            "85710.1 admissions/s (vs 1993.26)") == \
+            (85710.1, "admissions/s")
+        # The unit itself contains parens — match to the final '(vs'.
+        assert bench_sentinel._parse_value_str(
+            "0.0495 s/cycle (p95) (vs 10.1)") == \
+            (0.0495, "s/cycle (p95)")
+
+    def test_trailer_recovery_from_truncated_tail(self):
+        tail = ('...truncated {"metric": "x", "values": '
+                '{"tas": "281.7 admissions/s (vs 2.2)", '
+                '"cycle_latency": "0.1 s/cycle (p95) (vs 10.1)"}}')
+        vals = bench_sentinel._values_from_trailer(tail)
+        assert vals == {"tas": (281.7, "admissions/s"),
+                        "cycle_latency": (0.1, "s/cycle (p95)")}
+
+    def test_threshold_fit_is_outlier_robust(self):
+        center, sigma = bench_sentinel.fit_threshold(
+            [100.0, 102.0, 98.0, 101.0, 5.0])  # one catastrophic round
+        assert math.exp(center) == pytest.approx(100.0, rel=0.02)
+        assert sigma < 0.1  # the outlier must not widen the band
+
+    def test_flags_injected_30pct_regression(self, tmp_path):
+        d = str(tmp_path)
+        for rnd, v in enumerate([1000.0, 1050.0, 980.0, 1020.0, 1010.0],
+                                start=1):
+            self._write_round(d, rnd, {
+                "throughput_flat": (v, "admissions/s")})
+        clean = bench_sentinel.run_gate(d)
+        assert clean["ok"]
+        injected = bench_sentinel.run_gate(
+            d, inject={"throughput_flat": 0.3})
+        assert not injected["ok"]
+        row = injected["scenarios"][0]
+        assert row["regressed"]
+        # The failure points at the apply micro-attribution.
+        assert "apply_subphase_duration_seconds" in row["status"]
+        assert "mean_phases_s" in row["status"]
+
+    def test_latency_direction_is_lower_better(self, tmp_path):
+        d = str(tmp_path)
+        vals = [0.10, 0.11, 0.09, 0.10, 0.25]  # latest 2.5x slower
+        for rnd, v in enumerate(vals, start=1):
+            self._write_round(d, rnd, {
+                "cycle_latency": (v, "s/cycle (p95)")})
+        report = bench_sentinel.run_gate(d)
+        assert not report["ok"]
+        assert report["scenarios"][0]["regressed"]
+        # An *improvement* of the same magnitude never flags.
+        self._write_round(d, 5, {"cycle_latency": (0.04, "s/cycle (p95)")})
+        assert bench_sentinel.run_gate(d)["ok"]
+
+    def test_min_history_rule(self, tmp_path):
+        d = str(tmp_path)
+        self._write_round(d, 1, {"fresh": (100.0, "admissions/s")})
+        self._write_round(d, 2, {"fresh": (50.0, "admissions/s")})
+        report = bench_sentinel.run_gate(d)
+        row = report["scenarios"][0]
+        # A 50% drop with one history sample must NOT gate: no noise
+        # band can be fit, so the scenario reports and waits.
+        assert not row["gated"]
+        assert "insufficient history" in row["status"]
+        assert report["ok"]
+
+    def test_noise_band_absorbs_wobble(self, tmp_path):
+        d = str(tmp_path)
+        # A genuinely noisy scenario (swings ~2x round to round): a 30%
+        # drop stays inside 3 sigma and must not flag.
+        for rnd, v in enumerate([100.0, 220.0, 90.0, 210.0, 150.0],
+                                start=1):
+            self._write_round(d, rnd, {"churny": (v, "admissions/s")})
+        report = bench_sentinel.run_gate(d)
+        row = report["scenarios"][0]
+        assert row["gated"] and not row["regressed"]
+        assert row["threshold_log"] > math.log(1.15)
+
+    def test_real_checked_in_trajectory_passes(self):
+        report = bench_sentinel.run_gate(REPO)
+        assert report["ok"], json.dumps(report, indent=2)
+        assert report["latest_round"] >= 5
+        assert report["multichip"]["ok"]
+
+    def test_multichip_failure_gates(self, tmp_path):
+        d = str(tmp_path)
+        for rnd in (1, 2):
+            self._write_round(d, rnd, {"s": (100.0, "admissions/s")})
+        with open(os.path.join(d, "MULTICHIP_r02.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump({"n_devices": 8, "rc": 1, "ok": False,
+                       "skipped": False, "tail": "boom"}, fh)
+        report = bench_sentinel.run_gate(d)
+        assert not report["ok"]
+        assert not report["multichip"]["ok"]
+
+
+class TestQuerySurfaces:
+    def test_trace_summary_rows_carry_cid(self):
+        from kueue_tpu.visibility.server import trace_summary
+
+        eng = make_engine()
+        eng.attach_tracer()
+        submit(eng, "ok", 600)
+        drain(eng)
+        view = trace_summary(eng)
+        assert view["enabled"]
+        assert view["cycles"]
+        for row in view["cycles"]:
+            assert CID_RE.match(row["cid"])
+            assert row["cid"] == row["attrs"]["cid"]
+
+    def test_perf_and_slo_debug_bodies(self):
+        from kueue_tpu.visibility.server import perf_summary, slo_summary
+
+        eng = make_engine()
+        assert perf_summary(eng) == {"enabled": False}
+        assert slo_summary(eng) == {"enabled": False}
+        eng.attach_perf()
+        eng.attach_slo()
+        submit(eng, "ok", 600)
+        drain(eng)
+        pview = perf_summary(eng)
+        assert pview["enabled"] and pview["cyclesSeen"] > 0
+        assert any(k.startswith("apply.") for k in pview["subphases"])
+        sview = slo_summary(eng)
+        assert sview["enabled"]
+        assert set(sview["objectives"]) == {
+            "cycle_latency_p95", "admission_rate_floor",
+            "fallback_cycle_ratio"}
+
+    def test_sse_cycle_trace_carries_slo_posture(self):
+        eng = make_engine()
+        eng.attach_tracer()
+        eng.attach_slo()
+        seen = []
+        eng.event_listeners.append(
+            lambda ev: seen.append(ev) if ev.kind == "cycle_trace"
+            else None)
+        submit(eng, "ok", 600)
+        drain(eng)
+        assert seen
+        assert " slo=ok" in seen[-1].detail
+        assert seen[-1].detail.startswith("cid=")
+
+    def test_kueuectl_slo_command(self):
+        from kueue_tpu.cli.kueuectl import run as kueuectl_run
+
+        eng = make_engine()
+        eng.attach_slo()
+        submit(eng, "ok", 600)
+        drain(eng)
+        out = kueuectl_run(eng, ["slo"])
+        assert "cycle_latency_p95" in out
+        assert "OBJECTIVE" in out
+        doc = json.loads(kueuectl_run(eng, ["slo", "--json"]))
+        assert doc["objectives"]["fallback_cycle_ratio"]["statusName"] \
+            in ("ok", "warn", "breach")
+
+    def test_kueuectl_slo_attaches_on_demand(self):
+        # A journal-rebuilt engine has no live SLO engine: the command
+        # still reports the declared targets over empty windows.
+        from kueue_tpu.cli.kueuectl import run as kueuectl_run
+
+        eng = make_engine()
+        doc = json.loads(kueuectl_run(eng, ["slo", "--json"]))
+        assert doc["cyclesObserved"] == 0
+        assert set(doc["objectives"]) == {
+            "cycle_latency_p95", "admission_rate_floor",
+            "fallback_cycle_ratio"}
+        for ev in doc["objectives"].values():
+            assert ev["statusName"] == "ok"
+
+    def test_fallback_reason_counters_surface(self):
+        pytest.importorskip("jax")
+        eng = make_engine(nominal=3000)
+        eng.attach_oracle()
+        for i in range(4):
+            submit(eng, f"w{i}", 1000)
+        drain(eng)
+        text = eng.registry.render()
+        # The bridge mirrors its fallback/host-root dicts into labeled
+        # counter families; with no fallbacks the families still exist.
+        assert "kueue_tpu_oracle_cycles_total" in text
+        assert "kueue_tpu_oracle_fallback_total" in text
+        b = eng.oracle
+        ctr = eng.registry.counter("oracle_cycles_total")
+        total = sum(ctr.values.values())
+        assert total == pytest.approx(
+            b.cycles_on_device + b.cycles_hybrid + b.cycles_fallback)

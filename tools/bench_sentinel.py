@@ -1,0 +1,315 @@
+"""Bench regression sentinel: noise-aware gating over the BENCH_r*.json
+trajectory.
+
+The repo accumulates one ``BENCH_r<N>.json`` / ``MULTICHIP_r<N>.json``
+pair per PR round (driver wrappers: ``{"n", "cmd", "rc", "tail",
+"parsed"}`` where ``tail`` is a possibly-truncated stdout tail). This
+tool turns that pile into a gate (``make bench-gate``): parse every
+round's per-scenario values, fit a noise-aware per-scenario threshold
+from the history, and fail when the latest round regressed past it.
+
+Parsing is defensive by necessity — older rounds have intact
+``parsed.scenarios`` blobs, newer ones only a truncated ``tail`` whose
+JSON line must be recovered from its ``"values": {...}`` trailer (the
+trailer survives truncation because it renders last) or, failing that,
+from per-scenario ``"name": {"value": N, "unit": "u"`` fragments.
+
+Threshold model (per scenario, in log space — bench values are ratios
+of work over time, so noise is multiplicative):
+
+  * history = every round but the latest; the gate needs >= MIN_HISTORY
+    samples, otherwise the scenario is reported "insufficient history"
+    and not gated (sigma cannot be fit from fewer points — this is the
+    noise-awareness, not a loophole: a fresh scenario gates once it has
+    a trajectory).
+  * center = median(log values), spread = 1.4826 * MAD (robust sigma:
+    one outlier round must not widen the gate).
+  * worsening w = direction * (center - log latest); direction from the
+    unit ("s/cycle" / "latency" mean lower-is-better).
+  * flag iff w > max(log(1 + MIN_DROP), 3 * sigma): a regression must
+    be both materially large (>15% by default) AND outside the
+    scenario's own noise band.
+
+A flagged scenario's report points at the apply-phase micro-attribution
+(``kueue_tpu_apply_subphase_duration_seconds`` on /metrics, and the
+scenario's ``mean_phases_s`` detail) — the first question after "it got
+slower" is "which sub-step".
+
+Exit codes: 0 clean, 1 regression(s), 2 no usable trajectory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import sys
+
+MIN_HISTORY = 3     # samples needed to fit a noise band
+MIN_DROP = 0.15     # materiality floor: <15% never flags
+SIGMA_K = 3.0       # noise band width
+
+_VAL_RE = re.compile(r"^\s*([-+0-9.eE]+)\s+(.*)\(vs\b")
+_FRAG_RE = re.compile(
+    r'"(\w+)":\s*\{\s*"value":\s*([-+0-9.eE]+),\s*"unit":\s*"([^"]*)"')
+
+
+def lower_is_better(name: str, unit: str) -> bool:
+    return "latency" in name or "s/cycle" in unit
+
+
+def _parse_value_str(s: str):
+    """'85710.1 admissions/s (vs 1993.26)' -> (85710.1, 'admissions/s').
+    Greedy unit match up to the final '(vs' — units may themselves
+    contain parens ('s/cycle (p95)')."""
+    m = _VAL_RE.match(s)
+    if m is None:
+        return None
+    try:
+        return float(m.group(1)), m.group(2).strip()
+    except ValueError:
+        return None
+
+
+def _values_from_trailer(tail: str):
+    """Recover {scenario: (value, unit)} from the '"values": {...}'
+    trailer of a (possibly truncated) bench JSON line."""
+    idx = tail.rfind('"values"')
+    if idx < 0:
+        return {}
+    brace = tail.find("{", idx)
+    if brace < 0:
+        return {}
+    try:
+        raw, _ = json.JSONDecoder().raw_decode(tail[brace:])
+    except ValueError:
+        return {}
+    out = {}
+    for name, s in raw.items():
+        if isinstance(s, str):
+            parsed = _parse_value_str(s)
+            if parsed is not None:
+                out[name] = parsed
+    return out
+
+
+def _values_from_fragments(tail: str):
+    """Last-resort recovery: per-scenario '"name": {"value": N,
+    "unit": "u"' fragments anywhere in the tail."""
+    out = {}
+    for name, val, unit in _FRAG_RE.findall(tail):
+        try:
+            out[name] = (float(val), unit)
+        except ValueError:
+            continue
+    return out
+
+
+def scenario_values(wrapper: dict) -> dict:
+    """{scenario: (value, unit)} for one BENCH_r*.json wrapper."""
+    parsed = wrapper.get("parsed") or {}
+    scens = parsed.get("scenarios") or {}
+    out = {}
+    for name, blob in scens.items():
+        if isinstance(blob, dict) and "value" in blob:
+            out[name] = (float(blob["value"]), str(blob.get("unit", "")))
+    if out:
+        return out
+    tail = wrapper.get("tail") or ""
+    out = _values_from_trailer(tail)
+    if out:
+        return out
+    out = _values_from_fragments(tail)
+    if out:
+        return out
+    if "value" in parsed:
+        # Single-metric rounds (r01): keep the trajectory point under a
+        # reserved name so it never collides with a real scenario.
+        return {"__top__": (float(parsed["value"]),
+                            str(parsed.get("unit", "")))}
+    return {}
+
+
+def load_trajectory(directory: str):
+    """{scenario: [(round, value, unit), ...]} sorted by round, plus
+    the sorted round numbers seen."""
+    traj: dict[str, list] = {}
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(directory, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m is None:
+            continue
+        rnd = int(m.group(1))
+        try:
+            with open(path, encoding="utf-8") as fh:
+                wrapper = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        vals = scenario_values(wrapper)
+        if not vals:
+            continue
+        rounds.append(rnd)
+        for name, (v, unit) in vals.items():
+            traj.setdefault(name, []).append((rnd, v, unit))
+    for series in traj.values():
+        series.sort()
+    return traj, sorted(rounds)
+
+
+def _median(xs: list) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def fit_threshold(history: list) -> tuple:
+    """(center, sigma) of the history in log space; sigma is the
+    MAD-robust estimate so one outlier round cannot widen the gate."""
+    logs = [math.log(v) for v in history if v > 0]
+    center = _median(logs)
+    sigma = 1.4826 * _median([abs(x - center) for x in logs])
+    return center, sigma
+
+
+def evaluate_scenario(name: str, series: list, latest_round: int) -> dict:
+    """Gate one scenario's trajectory. ``series`` is [(round, value,
+    unit), ...] sorted; only the entry at ``latest_round`` is judged."""
+    unit = series[-1][2]
+    latest = [v for rnd, v, _ in series if rnd == latest_round]
+    history = [v for rnd, v, _ in series if rnd != latest_round and v > 0]
+    report = {"scenario": name, "unit": unit,
+              "history_n": len(history), "gated": False,
+              "regressed": False}
+    if not latest:
+        report["status"] = "absent-latest"
+        return report
+    value = latest[-1]
+    report["latest"] = value
+    if len(history) < MIN_HISTORY:
+        report["status"] = (f"insufficient history "
+                            f"({len(history)} < {MIN_HISTORY})")
+        return report
+    center, sigma = fit_threshold(history)
+    direction = -1.0 if lower_is_better(name, unit) else 1.0
+    worsening = direction * (center - math.log(value)) \
+        if value > 0 else float("inf")
+    threshold = max(math.log(1.0 + MIN_DROP), SIGMA_K * sigma)
+    report.update({
+        "gated": True,
+        "median": math.exp(center),
+        "sigma_log": round(sigma, 4),
+        "worsening_log": round(worsening, 4),
+        "threshold_log": round(threshold, 4),
+        "regressed": worsening > threshold,
+    })
+    if report["regressed"]:
+        drop_pct = (1.0 - math.exp(-worsening)) * 100.0
+        report["status"] = (
+            f"REGRESSION: {value:g} {unit} vs median {math.exp(center):g} "
+            f"({drop_pct:.0f}% worse, threshold "
+            f"{(math.exp(threshold) - 1) * 100:.0f}%) — attribute via the "
+            f"apply sub-phase histogram "
+            f"(kueue_tpu_apply_subphase_duration_seconds on /metrics, "
+            f"or /debug/perf) and the scenario's mean_phases_s detail")
+    else:
+        report["status"] = "ok"
+    return report
+
+
+def check_multichip(directory: str, latest_round: int) -> dict:
+    """The MULTICHIP_r*.json leg carries no scenario values — gate on
+    the latest round's verdict flags only."""
+    path = os.path.join(directory, f"MULTICHIP_r{latest_round:02d}.json")
+    if not os.path.exists(path):
+        return {"present": False, "ok": True}
+    try:
+        with open(path, encoding="utf-8") as fh:
+            w = json.load(fh)
+    except (OSError, ValueError):
+        return {"present": True, "ok": False,
+                "status": "unreadable wrapper"}
+    if w.get("skipped"):
+        return {"present": True, "ok": True, "status": "skipped"}
+    ok = bool(w.get("ok", w.get("rc", 1) == 0))
+    return {"present": True, "ok": ok,
+            "status": "ok" if ok else
+            f"multichip dryrun failed (rc={w.get('rc')})"}
+
+
+def run_gate(directory: str, inject: dict = None) -> dict:
+    """The whole gate: returns the report dict; ``inject`` maps
+    scenario -> fractional regression applied to the latest value
+    (synthetic-regression self-test: `--inject throughput_flat=0.3`)."""
+    traj, rounds = load_trajectory(directory)
+    if not rounds:
+        return {"ok": False, "error": "no usable BENCH_r*.json trajectory",
+                "scenarios": []}
+    latest = rounds[-1]
+    if inject:
+        for name, frac in inject.items():
+            series = traj.get(name)
+            if not series:
+                continue
+            for i, (rnd, v, unit) in enumerate(series):
+                if rnd == latest:
+                    worse = ((1.0 - frac) if not lower_is_better(name, unit)
+                             else (1.0 + frac))
+                    series[i] = (rnd, v * worse, unit)
+    reports = [evaluate_scenario(name, series, latest)
+               for name, series in sorted(traj.items())]
+    multichip = check_multichip(directory, latest)
+    regressed = [r for r in reports if r["regressed"]]
+    return {"ok": not regressed and multichip["ok"],
+            "latest_round": latest, "rounds": rounds,
+            "scenarios": reports, "multichip": multichip}
+
+
+def render(report: dict) -> str:
+    if report.get("error"):
+        return f"bench-sentinel: {report['error']}"
+    lines = [f"bench-sentinel: rounds {report['rounds']} "
+             f"(gating round {report['latest_round']})"]
+    for r in report["scenarios"]:
+        tag = "FAIL" if r["regressed"] else ("gate" if r["gated"]
+                                             else "skip")
+        lines.append(f"  [{tag}] {r['scenario']:<20} {r['status']}")
+    mc = report["multichip"]
+    if mc.get("present"):
+        lines.append(f"  [{'gate' if mc['ok'] else 'FAIL'}] "
+                     f"{'multichip':<20} {mc.get('status', 'ok')}")
+    lines.append("bench-sentinel: " + ("PASS" if report["ok"] else "FAIL"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="noise-aware bench regression gate over the "
+                    "BENCH_r*/MULTICHIP_r* trajectory")
+    p.add_argument("--dir", default=".",
+                   help="directory holding BENCH_r*.json (default: .)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--inject", action="append", default=[],
+                   metavar="SCENARIO=FRAC",
+                   help="synthetically regress SCENARIO's latest value "
+                        "by FRAC (e.g. 0.3) before gating — self-test")
+    args = p.parse_args(argv)
+    inject = {}
+    for spec in args.inject:
+        name, _, frac = spec.partition("=")
+        try:
+            inject[name] = float(frac)
+        except ValueError:
+            p.error(f"bad --inject spec {spec!r}")
+    report = run_gate(args.dir, inject=inject)
+    print(json.dumps(report, indent=2) if args.as_json else render(report))
+    if report.get("error"):
+        return 2
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,11 @@ DEFAULT_ZONES: tuple = (
     ("kueue_tpu/cache/", frozenset({"U1", "J1"})),
     ("kueue_tpu/parallel/", frozenset({"D1", "J1"})),
     ("kueue_tpu/obs/", frozenset({"O1", "J1"})),
+    # Perf telemetry and SLO burn-rate evaluation: explicitly listed so
+    # a future zone re-shuffle cannot silently drop them out of the
+    # write-only discipline their digest-neutrality contract rests on.
+    ("kueue_tpu/obs/perf.py", frozenset({"O1", "J1"})),
+    ("kueue_tpu/obs/slo.py", frozenset({"O1", "J1"})),
 )
 
 GLOBAL_RULES = frozenset({"J1"})

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""perf-smoke: the end-to-end perf-telemetry check behind
+``make perf-smoke``.
+
+Drives the same short mixed world through two engines — one bare, one
+with the full observability stack attached (CycleTracer + PerfRecorder
++ SLOEngine) — and asserts the stack's contracts:
+
+  * digest neutrality: both arms chain byte-identical per-cycle
+    decision digests (telemetry is write-only over engine state);
+  * attribution coverage: the apply phase reports >= 4 named sub-phase
+    histograms, and the SLO engine reports a posture for every
+    declared objective;
+  * exposition hygiene: the /metrics render (with the new
+    perf_*/slo_*/oracle_* families populated) passes tools/promcheck,
+    and the Perfetto export (now carrying subphase/* spans nested
+    under phase/apply) passes tools/trace_schema;
+  * overhead: the instrumented drain stays within a loose wall-clock
+    budget of the bare drain (the strict <=5% gate is the
+    trace_overhead bench scenario; this is the fast tripwire).
+
+Exits non-zero on the first failure.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from promcheck import check_exposition  # noqa: E402
+from trace_schema import check_trace_events  # noqa: E402
+
+# Loose tripwire only — catches an accidentally quadratic capture path,
+# not jitter; the calibrated <=5% gate lives in bench.py.
+OVERHEAD_TRIPWIRE = 0.75
+
+
+def fail(msg: str) -> int:
+    print(f"perf-smoke FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def drive(instrumented: bool):
+    """One arm: build the mixed world, drain it, return the engine, the
+    chained decision digest and the drain wall time."""
+    from kueue_tpu.bench.scenario import baseline_like
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.replay.trace import canonical_decisions, decision_digest
+
+    eng = Engine()
+    state = {"digest": 0}
+
+    def listener(seq, result):
+        if result is not None:
+            state["digest"] = decision_digest(
+                canonical_decisions(result), state["digest"])
+
+    eng.cycle_listeners.append(listener)
+    if instrumented:
+        eng.attach_tracer(retain=128)
+        eng.attach_perf()
+        eng.attach_slo()
+    scen = baseline_like(n_cohorts=2, cqs_per_cohort=2, n_workloads=60,
+                         nominal_per_cq=20_000, sized_to_fit=False)
+    for rf in scen.flavors:
+        eng.create_resource_flavor(rf)
+    for co in scen.cohorts:
+        eng.create_cohort(co)
+    for cq in scen.cluster_queues:
+        eng.create_cluster_queue(cq)
+    for lq in scen.local_queues:
+        eng.create_local_queue(lq)
+    for wl in scen.workloads:
+        eng.clock += 0.001
+        eng.submit(wl)
+    t0 = time.perf_counter()
+    for _ in range(300):
+        if eng.schedule_once() is None:
+            break
+    return eng, f"{state['digest']:08x}", time.perf_counter() - t0
+
+
+def main() -> int:
+    from kueue_tpu.obs import write_perfetto
+
+    _, bare_digest, bare_s = drive(instrumented=False)
+    eng, inst_digest, inst_s = drive(instrumented=True)
+
+    # 1. Digest neutrality: telemetry changed no decision.
+    if bare_digest != inst_digest:
+        return fail(f"digest drift: bare={bare_digest} "
+                    f"instrumented={inst_digest}")
+    print(f"digest neutrality OK (both arms {inst_digest})")
+
+    # 2. Apply micro-attribution coverage.
+    subphases = sorted({name for name, _ in eng.perf.hist})
+    applies = [s for s in subphases if s.startswith("apply.")]
+    if len(applies) < 4:
+        return fail(f"expected >=4 apply sub-phases, got {applies}")
+    print(f"attribution OK ({len(applies)} apply sub-phases: "
+          f"{', '.join(applies)})")
+
+    # 3. SLO posture for every declared objective.
+    evald = eng.slo.evaluate()
+    missing = [o.name for o in eng.slo.objectives if o.name not in evald]
+    if missing:
+        return fail(f"SLO objectives without posture: {missing}")
+    print(f"slo OK ({eng.slo.status_string()}; "
+          f"{len(evald)} objective(s) evaluated)")
+
+    # 4. /metrics exposition hygiene with the new families populated.
+    text = eng.registry.render()
+    errors = check_exposition(text)
+    if errors:
+        for e in errors[:20]:
+            print(f"  {e}", file=sys.stderr)
+        return fail(f"exposition failed promcheck ({len(errors)} error(s))")
+    for family in ("kueue_tpu_apply_subphase_duration_seconds",
+                   "kueue_tpu_oracle_cycles_total",
+                   "kueue_tpu_slo_burn_rate"):
+        if family not in text:
+            return fail(f"{family} absent from exposition")
+    print("exposition OK (promcheck clean, perf/slo families present)")
+
+    # 5. Perfetto export with subphase spans validates.
+    out = os.path.join(tempfile.mkdtemp(prefix="perf-smoke-"),
+                       "trace.json")
+    n = write_perfetto(list(eng.tracer.spans), out)
+    with open(out, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    errors = check_trace_events(doc)
+    if errors:
+        for e in errors[:20]:
+            print(f"  {e}", file=sys.stderr)
+        return fail(f"perfetto export failed trace_schema "
+                    f"({len(errors)} error(s))")
+    subs = sum(1 for ev in doc["traceEvents"]
+               if ev.get("name", "").startswith("subphase/"))
+    if subs == 0:
+        return fail("perfetto export carries no subphase/* spans")
+    print(f"perfetto export OK ({n} events, {subs} subphase spans)")
+
+    # 6. Loose overhead tripwire.
+    overhead = (inst_s - bare_s) / bare_s if bare_s > 0 else 0.0
+    if overhead > OVERHEAD_TRIPWIRE:
+        return fail(f"overhead tripwire: instrumented drain "
+                    f"{overhead * 100:.0f}% over bare "
+                    f"(budget {OVERHEAD_TRIPWIRE * 100:.0f}%)")
+    print(f"overhead OK ({overhead * 100:+.1f}% on this run; "
+          f"calibrated gate is the trace_overhead bench)")
+
+    print("perf-smoke OK: digest identity, attribution, SLO posture, "
+          "exposition and perfetto export all validate")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
