@@ -6,7 +6,8 @@ PY ?= python
 PYTEST_FLAGS ?= -q
 
 .PHONY: all native test test-fast test-device bench multichip-dryrun \
-  replay-smoke obs-smoke tas-smoke perf-smoke bench-gate lint clean
+  replay-smoke obs-smoke tas-smoke perf-smoke ha-smoke bench-gate lint \
+  clean
 
 all: native
 
@@ -78,6 +79,16 @@ obs-smoke: lint
 # obs/slo.py). lint first: the capture paths live in O1/D1 zones.
 perf-smoke: lint
 	JAX_PLATFORMS=cpu $(PY) tools/perf_smoke.py
+
+# HA failover smoke: leader + follower replicas over one journal;
+# the leader is SIGKILLed mid-admission (and, in a second arm, with a
+# torn journal tail); the follower must steal the fenced lease, replay-
+# verify the last ha_digest checkpoint, promote at epoch 2, and drain
+# to a byte-identical admitted-state digest — zero lost or duplicate
+# admissions (kueue_tpu/ha). lint first: the ha/ zone pins (J1, R1
+# kind registration) are part of the contract.
+ha-smoke: lint
+	JAX_PLATFORMS=cpu $(PY) tools/ha_smoke.py
 
 # Bench regression sentinel: noise-aware per-scenario gate over the
 # accumulated BENCH_r*/MULTICHIP_r* trajectory (tools/bench_sentinel.py).

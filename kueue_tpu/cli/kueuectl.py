@@ -493,6 +493,22 @@ def build_parser() -> argparse.ArgumentParser:
     slo.add_argument("--json", action="store_true", dest="as_json",
                      help="emit the structured summary instead of text")
 
+    st = sub.add_parser(
+        "status",
+        help="HA replica status: role, lease epoch, journal replay "
+             "lag, connected SSE clients (kueue_tpu/ha). Query a live "
+             "replica with --endpoint, or inspect the lease/journal "
+             "offline with --journal/--lease")
+    st.add_argument("--endpoint",
+                    help="base URL of a live replica "
+                         "(e.g. http://127.0.0.1:8080): queries "
+                         "/debug/ha")
+    st.add_argument("--lease",
+                    help="lease file for offline inspection "
+                         "(default: <journal>.lease)")
+    st.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the raw status dict")
+
     tr = sub.add_parser(
         "trace", help="span-tree operations (obs/)")
     trs = tr.add_subparsers(dest="trace_command")
@@ -670,6 +686,70 @@ def run(engine, argv: list[str]) -> str:
                 f"{name:<24} {ev['kind']:<16} {ev['target']:>10.3g} "
                 f"{burns.get('fast', 0.0):>11.3f} "
                 f"{burns.get('slow', 0.0):>11.3f} {ev['statusName']}")
+        return "\n".join(lines)
+    if args.command == "status":
+        if args.endpoint:
+            # Live replica: /debug/ha is the authoritative view.
+            import urllib.request
+            url = args.endpoint.rstrip("/") + "/debug/ha"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                status = json.loads(resp.read())
+        elif getattr(engine, "ha", None) is not None:
+            status = engine.ha.status()
+        else:
+            # Offline: read the lease file and the journal's last HA
+            # checkpoint directly (no replica process required).
+            status = {"role": "offline", "identity": ""}
+            journal = getattr(engine, "journal", None)
+            lease_path = args.lease or (
+                journal.path + ".lease" if journal is not None else None)
+            if lease_path:
+                from kueue_tpu.ha.lease import FencedLease
+                lease = FencedLease(lease_path).read()
+                status["leaseHolder"] = lease.holder if lease else ""
+                status["epoch"] = lease.epoch if lease else 0
+            if journal is not None:
+                from kueue_tpu.ha.digest import last_checkpoint
+                records = list(journal.replay())
+                _, ckpt = last_checkpoint(records)
+                status["journalRecords"] = len(records)
+                status["lastCheckpoint"] = (ckpt["obj"] if ckpt
+                                            else None)
+        if args.as_json:
+            return json.dumps(status, indent=2)
+        lines = [f"role: {status.get('role', 'unknown')}"]
+        if status.get("identity"):
+            lines.append(f"identity: {status['identity']}")
+        lines.append(
+            f"lease: holder={status.get('leaseHolder', '')!r} "
+            f"epoch={status.get('epoch', 0)}")
+        if "replayLag" in status:
+            lines.append(f"replay lag: {status['replayLag']} record(s)")
+        if "journalRecords" in status:
+            lines.append(
+                f"journal: {status['journalRecords']} record(s)")
+        ckpt = status.get("lastCheckpoint") or (
+            status.get("tailer") or {}).get("lastCheckpoint")
+        if ckpt:
+            lines.append(
+                f"checkpoint: seq={ckpt.get('seq')} "
+                f"epoch={ckpt.get('epoch')} chain={ckpt.get('chain')} "
+                f"state={ckpt.get('state')}")
+        if "sseClients" in status:
+            sse = status.get("sse", {})
+            lines.append(
+                f"sse clients: {status['sseClients']} connected "
+                f"({sse.get('dropped', 0)} dropped, "
+                f"{sse.get('evicted', 0)} evicted)")
+        if "decisionDigest" in status:
+            lines.append(
+                f"decision digest: {status['decisionDigest']} "
+                f"@ seq {status.get('digestSeq')}")
+        if status.get("shedder"):
+            sh = status["shedder"]
+            lines.append(
+                f"shedder: accepted={sh['accepted']} shed={sh['shed']} "
+                f"factor={sh['factor']}")
         return "\n".join(lines)
     if args.command == "trace":
         if args.trace_command != "export":

@@ -186,6 +186,11 @@ class Engine:
         self.cycle_seq: int = 0
         self.pre_cycle_hooks: list[Callable] = []
         self.cycle_listeners: list[Callable] = []
+        # pre_sync_hooks fire with (seq, result) after a NON-IDLE cycle
+        # but BEFORE journal.sync(): records appended here ride inside
+        # the cycle's fsync boundary (the HA digest checkpoint,
+        # kueue_tpu/ha/digest.py, depends on this ordering).
+        self.pre_sync_hooks: list[Callable] = []
         # Admission tracer (obs.CycleTracer attaches itself here); the
         # flight recorder and explain path read it via this slot.
         self.tracer = None
@@ -193,6 +198,11 @@ class Engine:
         # (obs.slo.SLOEngine) attach themselves here.
         self.perf = None
         self.slo = None
+        # HA serving plane (kueue_tpu/ha): the owning HAReplica, the
+        # SSE fanout hub, and the submit-path shedder attach here.
+        self.ha = None
+        self.fanout = None
+        self.shedder = None
         self.workloads: dict[str, Workload] = {}
         # hook: called with (workload, admission) after each admission.
         self.on_admit: Optional[Callable] = None
@@ -783,6 +793,15 @@ class Engine:
                 gc.freeze()
         self.cycle_seq = seq + 1
         if result is not None and self.journal is not None:
+            # pre_sync_hooks append records that must be durably part
+            # of THIS cycle (the HA ha_digest checkpoint): they run
+            # before sync so the fsync below covers them.
+            for fn in tuple(self.pre_sync_hooks):
+                try:
+                    fn(seq, result)
+                except Exception as e:  # noqa: BLE001 — observers must
+                    import warnings      # not unwind the scheduling loop
+                    warnings.warn(f"pre-sync hook {fn!r} raised: {e!r}")
             # Crash-safe cycle boundary: every record this cycle wrote
             # (admissions, evictions, requeues) reaches the platter
             # before the decisions take further effect — a SIGKILL

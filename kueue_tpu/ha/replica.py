@@ -1,0 +1,301 @@
+"""HAReplica: the per-process orchestrator serve.py runs in HA mode.
+
+One replica = one role at a time (roles.RoleMachine). The drive loop
+calls ``step(now)`` every tick:
+
+  * follower — tail the journal (read model + SSE synthesis), then try
+    the lease; winning it starts the candidate promotion protocol.
+  * candidate (transient, inside ``_promote``) — replay the journal to
+    head, verify the last ``ha_digest`` checkpoint (digest.py), and
+    only then attach a WRITABLE journal handle and go leader.
+  * leader — renew the lease every ``renew_interval``; a failed renew
+    (holder or epoch mismatch: we were deposed) fences the replica
+    before the next journal write can land. Renewal runs on a
+    background thread (``renew_in_background``) so a long admission
+    cycle can't starve it past the lease — the drive-loop renewal in
+    ``step`` remains as a backstop.
+  * fenced — terminal. Keeps tailing for reads; never writes again.
+
+The journal handle a leader holds carries a fence callable
+(store.journal.Journal.fence): every append re-checks
+``roles.is_leader`` inside the flock critical section, so a deposed
+leader's in-flight cycle dies on JournalFenced instead of interleaving
+stale writes with the new leader's.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Callable, Optional
+
+from kueue_tpu.ha.digest import DigestChain, admitted_state_digest, \
+    verify_promotion
+from kueue_tpu.ha.lease import FencedLease
+from kueue_tpu.ha.roles import (
+    CANDIDATE,
+    FENCED,
+    FOLLOWER,
+    LEADER,
+    ROLE_CODES,
+    RoleMachine,
+)
+from kueue_tpu.ha.shedder import AdmissionShedder
+from kueue_tpu.ha.tailer import JournalTailer
+
+
+class HAReplica:
+    def __init__(self, journal_path: str, lease_path: str, identity: str,
+                 lease_duration: float = 15.0,
+                 renew_interval: Optional[float] = None,
+                 hub=None, shedder: Optional[AdmissionShedder] = None,
+                 metrics=None, fsync: bool = True,
+                 engine_kwargs: Optional[dict] = None,
+                 on_promote: Optional[Callable] = None,
+                 on_demote: Optional[Callable] = None,
+                 renew_in_background: bool = True):
+        self.journal_path = journal_path
+        self.identity = identity
+        self.lease = FencedLease(lease_path)
+        self.lease_duration = float(lease_duration)
+        self.renew_interval = float(
+            renew_interval if renew_interval is not None
+            else lease_duration / 3.0)
+        self.roles = RoleMachine(FOLLOWER)
+        self.hub = hub
+        self.shedder = shedder
+        self.metrics = metrics
+        self.fsync = fsync
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self.on_promote = on_promote
+        self.on_demote = on_demote
+        self.epoch = 0
+        self.engine = None              # live engine (leader only)
+        self.digest_chain: Optional[DigestChain] = None
+        self.promotion_report: Optional[dict] = None
+        self.tailer = JournalTailer(journal_path, hub=hub,
+                                    metrics=metrics,
+                                    engine_kwargs=self.engine_kwargs)
+        self.suspend_renewal = False    # fault hook: lease-stall@cycle:N
+        self._last_renew = 0.0
+        # Renewal thread (leaders only): an admission cycle larger than
+        # the lease window must not depose a healthy leader. Tests that
+        # drive step() with a synthetic clock pass False — a wall-clock
+        # renewal would pin the lease un-expirable under synthetic time.
+        self.renew_in_background = renew_in_background
+        self._renew_stop: Optional[threading.Event] = None
+        self._fence_lock = threading.Lock()
+        self.roles.listeners.append(self._on_transition)
+        # A follower must serve reads from tick zero (an empty journal
+        # rebuilds to an empty engine, not a 503).
+        self.tailer.rebuild()
+
+    # -- role-keyed engine access (the HTTP layer resolves per request
+    # because promotion SWAPS the engine object) --
+
+    def engine_ref(self):
+        """Current engine to serve reads from: the live engine when
+        leading, the tailer's read model otherwise."""
+        if self.roles.is_leader and self.engine is not None:
+            return self.engine
+        return self.tailer.engine
+
+    # -- the drive loop --
+
+    def step(self, now: float) -> str:
+        """One HA tick. Returns the post-tick role."""
+        role = self.roles.role
+        if role == LEADER:
+            self._leader_tick(now)
+        elif role == FOLLOWER:
+            self.tailer.poll()
+            state = self.lease.try_acquire(self.identity, now,
+                                           self.lease_duration)
+            if state is not None:
+                self._last_renew = now
+                self._promote(state)
+        else:  # fenced: read-only forever, but stay a useful follower
+            self.tailer.poll()
+        self._export(now)
+        return self.roles.role
+
+    def _leader_tick(self, now: float) -> None:
+        if self.suspend_renewal:
+            return  # fault injection: let the lease expire underneath us
+        if now - self._last_renew < self.renew_interval:
+            return
+        state = self.lease.renew(self.identity, self.epoch, now)
+        if state is None:
+            # Holder or epoch moved on: we were deposed. Fence BEFORE
+            # any further journal write (the journal fence backstops
+            # writes already in flight).
+            self._fence("lease renewal refused (deposed)")
+            return
+        self._last_renew = now
+
+    def _renew_loop(self, stop: threading.Event) -> None:
+        """Leader-lifetime renewal thread: keeps the lease alive even
+        when one admission cycle runs longer than the lease window (the
+        drive loop only reaches ``step`` between cycles). A refused
+        renew fences exactly like the in-loop path."""
+        while not stop.wait(self.renew_interval):
+            if not self.roles.is_leader:
+                return
+            if self.suspend_renewal:
+                continue  # fault injection: let the lease expire
+            now = _time.time()
+            if self.lease.renew(self.identity, self.epoch, now) is None:
+                self._fence("lease renewal refused (deposed)")
+                return
+            self._last_renew = now
+
+    # -- promotion: the replay-verified failover protocol --
+
+    def _promote(self, lease_state) -> None:
+        from kueue_tpu.store.journal import Journal, engine_from_records
+
+        self.roles.to(CANDIDATE,
+                      f"lease acquired epoch={lease_state.epoch}")
+        # replay() repairs a torn tail (the dead leader's SIGKILL
+        # mid-append) under the journal flock before we read.
+        records = list(Journal(self.journal_path).replay())
+        engine = engine_from_records(records, **self.engine_kwargs)
+        report = verify_promotion(records, engine,
+                                  new_epoch=lease_state.epoch)
+        self.promotion_report = report
+        if not report["verified"]:
+            self.lease.release(self.identity)
+            self.roles.to(FENCED,
+                          f"promotion verification failed: "
+                          f"{report['reason']}")
+            return
+        self.epoch = lease_state.epoch
+        journal = Journal(self.journal_path, fsync=self.fsync)
+        journal.fence = self._write_allowed
+        engine.attach_journal(journal, record_existing=False)
+        engine.ha = self
+        self.digest_chain = DigestChain(
+            engine, self.epoch,
+            seed_chain=report["chain_seed"],
+            seed_seq=report["seq_seed"])
+        self.engine = engine
+        if self.hub is not None:
+            self.hub.attach_engine(engine)
+        self.roles.to(LEADER,
+                      f"verified: {report['reason']}")
+        if self.renew_in_background:
+            self._renew_stop = threading.Event()
+            threading.Thread(
+                target=self._renew_loop, args=(self._renew_stop,),
+                name=f"ha-renew-{self.identity}", daemon=True).start()
+        if self.on_promote is not None:
+            self.on_promote(engine, self)
+
+    def _write_allowed(self) -> bool:
+        """Journal fence predicate, evaluated inside the append flock."""
+        return self.roles.is_leader
+
+    def _fence(self, reason: str) -> None:
+        # Idempotent and thread-safe: the renewal thread and the drive
+        # loop (JournalFenced handler) can race to fence the same
+        # deposed leader.
+        with self._fence_lock:
+            if self.roles.is_fenced:
+                return
+            if self._renew_stop is not None:
+                self._renew_stop.set()
+                self._renew_stop = None
+            if self.hub is not None and self.engine is not None:
+                self.hub.detach_engine()
+            if self.digest_chain is not None:
+                self.digest_chain.detach()
+                self.digest_chain = None
+            self.roles.to(FENCED, reason)
+            if self.on_demote is not None:
+                self.on_demote(self.engine, self, reason)
+            self.engine = None
+
+    def resign(self) -> None:
+        """Graceful shutdown handoff: release the lease so a standby
+        can take over without waiting out the expiry window."""
+        if self.roles.is_leader:
+            self.lease.release(self.identity)
+            self._fence("resigned")
+
+    # -- the write front door (HTTP POST /workloads lands here) --
+
+    def submit(self, workload, now: float) -> dict:
+        """Leader check, then dedup, then shed check, then
+        Engine.submit. Shed requests never reach the engine — they must
+        not become flight-recorder input frames (replay would
+        diverge)."""
+        if not self.roles.is_leader or self.engine is None:
+            lease = self.lease.read()
+            return {"accepted": False, "code": 503,
+                    "reason": f"not leader (role={self.roles.role})",
+                    "leaderHint": lease.holder if lease else ""}
+        if workload.key in self.engine.workloads:
+            # Idempotent retry: a client that lost its 201 to a leader
+            # crash re-POSTs after promotion. The name is the dedup key
+            # — re-submitting would reset an already-admitted workload
+            # to pending. At-least-once retries + this ack are the
+            # exactly-once admission story. Checked before the shedder:
+            # a retry of accepted work must not burn bucket tokens.
+            return {"accepted": True, "code": 200,
+                    "workload": workload.name, "deduplicated": True}
+        if self.shedder is not None:
+            verdict = self.shedder.admit(now)
+            if not verdict["accepted"]:
+                return {"accepted": False, "code": 429,
+                        "reason": "shed: admission rate limit",
+                        "retryAfter": verdict["retryAfter"],
+                        "factor": verdict["factor"]}
+        self.engine.submit(workload)
+        return {"accepted": True, "code": 201,
+                "workload": workload.name}
+
+    # -- observability --
+
+    def _on_transition(self, old: str, new: str, reason: str) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.counter("ha_role_transitions_total").inc(
+                    (old, new))
+            except KeyError:
+                pass
+
+    def _export(self, now: float) -> None:
+        if self.metrics is None:
+            return
+        try:
+            self.metrics.gauge("ha_role").set(
+                (), float(ROLE_CODES[self.roles.role]))
+            self.metrics.gauge("ha_lease_epoch").set(
+                (), float(self.epoch or self.lease.epoch_of()))
+        except KeyError:
+            pass
+
+    def status(self) -> dict:
+        lease = self.lease.read()
+        out = {
+            "identity": self.identity,
+            "role": self.roles.role,
+            "epoch": self.epoch or (lease.epoch if lease else 0),
+            "leaseHolder": lease.holder if lease else "",
+            "leaseRenewTime": lease.renew_time if lease else 0.0,
+            "replayLag": self.tailer.replay_lag,
+            "tailer": self.tailer.status(),
+            "transitions": self.roles.history(last=16),
+            "promotion": self.promotion_report,
+        }
+        if self.engine is not None:
+            out["stateDigest"] = admitted_state_digest(self.engine)
+            if self.digest_chain is not None:
+                out["decisionDigest"] = self.digest_chain.digest
+                out["digestSeq"] = self.digest_chain.last_seq
+        if self.hub is not None:
+            out["sse"] = self.hub.stats()
+            out["sseClients"] = self.hub.stats()["clients"]
+        if self.shedder is not None:
+            out["shedder"] = self.shedder.status()
+        return out

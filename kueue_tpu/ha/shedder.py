@@ -1,0 +1,112 @@
+"""Admission-rate control: a token-bucket front door on the submit
+path, with SLO burn rates driving the shed threshold.
+
+The bucket guards the SERVING layer, not the engine: Engine.submit is
+wrapped by the flight recorder as a replayable input frame, so a gate
+inside the engine would make recorded traces diverge on replay (the
+replayer has no shedder attached). Shed submissions are refused before
+they ever become inputs — a shed request leaves a counter and a trace
+event, never a journal record.
+
+Coupling to obs/slo.py: the effective refill rate is
+``rate * factor`` where factor degrades as the worst SLO burns:
+
+    status OK      → 1.00           (full configured rate)
+    status WARN    → 1 / (1+burn)   floored at 0.25
+    status BREACH  → ¼ · 1/(1+burn) floored at 0.05
+
+so a breached SLO with a 4× burn rate sheds ~95% of new submissions —
+back-pressure proportional to how fast the error budget is burning.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+STATUS_OK, STATUS_WARN, STATUS_BREACH = 0, 1, 2
+
+
+class TokenBucket:
+    """Plain token bucket; refill is scaled by an external factor so
+    the shedder can squeeze it without mutating configuration."""
+
+    def __init__(self, rate: float, burst: Optional[float] = None):
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None else max(1.0, rate))
+        self.tokens = self.burst
+        self._last: Optional[float] = None
+
+    def take(self, now: float, n: float = 1.0,
+             factor: float = 1.0) -> bool:
+        if self._last is None:
+            self._last = now
+        elapsed = max(0.0, now - self._last)
+        self._last = now
+        self.tokens = min(self.burst,
+                          self.tokens + elapsed * self.rate * factor)
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+
+class AdmissionShedder:
+    """Decides accept/shed for one submission attempt. Stateless apart
+    from the bucket — safe to consult from HTTP handler threads (the
+    GIL serializes the float updates; drift under contention only
+    mis-sizes the bucket by a token, never corrupts it)."""
+
+    def __init__(self, rate: float = 200.0, burst: Optional[float] = None,
+                 slo=None, metrics=None, hub=None):
+        self.bucket = TokenBucket(rate, burst)
+        self.slo = slo
+        self.metrics = metrics
+        self.hub = hub
+        self.accepted = 0
+        self.shed = 0
+        self.factor = 1.0
+
+    def _factor(self) -> float:
+        if self.slo is None:
+            return 1.0
+        try:
+            status, burn = self.slo.worst()
+        except Exception:  # noqa: BLE001 — SLO eval must not block intake
+            return 1.0
+        if status >= STATUS_BREACH:
+            return max(0.05, 0.25 / (1.0 + burn))
+        if status >= STATUS_WARN:
+            return max(0.25, 1.0 / (1.0 + burn))
+        return 1.0
+
+    def admit(self, now: float, reason: str = "submit") -> dict:
+        """Returns {"accepted": bool, "factor": float, "retryAfter": s}."""
+        self.factor = self._factor()
+        ok = self.bucket.take(now, 1.0, self.factor)
+        if self.metrics is not None:
+            try:
+                self.metrics.gauge("admission_shed_factor").set(
+                    (), self.factor)
+                if not ok:
+                    self.metrics.counter("admission_shed_total").inc(
+                        (reason,))
+            except KeyError:
+                pass
+        if ok:
+            self.accepted += 1
+        else:
+            self.shed += 1
+            if self.hub is not None:
+                import json
+                self.hub.publish("admission_shed", json.dumps({
+                    "reason": reason, "factor": round(self.factor, 4)}))
+        retry = 0.0 if ok else round(
+            1.0 / max(1e-6, self.bucket.rate * self.factor), 3)
+        return {"accepted": ok, "factor": self.factor,
+                "retryAfter": retry}
+
+    def status(self) -> dict:
+        return {"accepted": self.accepted, "shed": self.shed,
+                "factor": round(self.factor, 4),
+                "rate": self.bucket.rate, "burst": self.bucket.burst,
+                "tokens": round(self.bucket.tokens, 3)}

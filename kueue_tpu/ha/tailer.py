@@ -1,0 +1,129 @@
+"""Follower-side journal tailing.
+
+A follower never runs admission cycles; its view of the world is the
+leader's journal, consumed incrementally. The tailer reads complete
+lines past its last offset (a trailing partial line — the torn-tail
+case — is left in place and re-read once the leader's next fsync
+completes it), folds them into counters, forwards synthesized events
+to the SSE fanout hub, and refreshes a cold-rebuilt read-model engine
+that the HTTP layer serves GETs from.
+
+Replay lag is the tailer's headline number: records observed in the
+file but not yet folded into the read model. `kueuectl status` and the
+``ha_replay_lag_records`` gauge both report it, and promotion latency
+is dominated by draining it to zero.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+
+class JournalTailer:
+    """Incremental reader of a live journal file.
+
+    ``poll()`` is cheap and safe to call every tick; the read-model
+    rebuild (a full journal replay) is throttled to at most once per
+    ``rebuild_every`` new records so a chatty leader doesn't make the
+    follower spend its life rebuilding.
+    """
+
+    def __init__(self, path: str, hub=None, metrics=None,
+                 rebuild_every: int = 32, engine_kwargs: Optional[dict] = None):
+        self.path = path
+        self.hub = hub
+        self.metrics = metrics
+        self.rebuild_every = max(1, int(rebuild_every))
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self.engine = None          # the read model (None until 1st poll)
+        self.records_seen = 0
+        self.rebuilds = 0
+        self.last_checkpoint: Optional[dict] = None  # last ha_digest obj
+        self._offset = 0
+        self._pending = 0           # records seen since last rebuild
+
+    @property
+    def replay_lag(self) -> int:
+        """Records durable in the journal but not in the read model."""
+        return self._pending
+
+    def poll(self) -> int:
+        """Consume newly completed journal lines. Returns how many new
+        records were observed (0 when the file hasn't grown)."""
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self._offset)
+                chunk = f.read()
+        except FileNotFoundError:
+            return 0
+        if not chunk:
+            self._gauge()
+            return 0
+        # Only complete lines: a torn tail stays unconsumed until the
+        # leader's next write completes it (or repair truncates it).
+        complete = chunk.rfind(b"\n") + 1
+        if complete == 0:
+            return 0
+        new = 0
+        for line in chunk[:complete].splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # corrupt interior line: repair's problem
+            new += 1
+            self._ingest(rec)
+        self._offset += complete
+        self.records_seen += new
+        self._pending += new
+        if self._pending >= self.rebuild_every or self.engine is None:
+            self.rebuild()
+        self._gauge()
+        return new
+
+    def _ingest(self, rec: dict) -> None:
+        kind = rec.get("kind")
+        if kind == "ha_digest":
+            self.last_checkpoint = rec.get("obj")
+            if self.hub is not None:
+                self.hub.publish("ha_checkpoint",
+                                 json.dumps(self.last_checkpoint))
+        elif self.hub is not None:
+            # Synthesized watch event: followers can't replay the
+            # leader's EngineEvents, but the journal record itself is
+            # the authoritative change feed.
+            obj = rec.get("obj")
+            key = (obj.get("metadata", {}).get("name", "")
+                   if isinstance(obj, dict) else "")
+            self.hub.publish("journal", json.dumps({
+                "kind": kind, "op": rec.get("op"), "key": key,
+                "ts": rec.get("ts"),
+            }))
+
+    def rebuild(self) -> None:
+        """Refresh the read model: full cold replay, no journal attach
+        (followers must never hold a writable journal handle)."""
+        from kueue_tpu.store.journal import Journal, engine_from_records
+
+        records = list(Journal(self.path).replay())
+        self.engine = engine_from_records(records, **self.engine_kwargs)
+        self.rebuilds += 1
+        self._pending = 0
+
+    def _gauge(self) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.gauge("ha_replay_lag_records").set(
+                    (), float(self._pending))
+            except KeyError:
+                pass
+
+    def status(self) -> dict:
+        return {
+            "recordsSeen": self.records_seen,
+            "replayLag": self.replay_lag,
+            "rebuilds": self.rebuilds,
+            "lastCheckpoint": self.last_checkpoint,
+        }

@@ -282,6 +282,22 @@ class MetricsRegistry:
         g("slo_status",
           "objective status per objective (0 ok | 1 warn | 2 breach)")
         g("slo_objective_target", "declared target per objective")
+        # HA serving plane (kueue_tpu/ha): replica role and lease
+        # fencing state, follower replay lag, sharded SSE fanout
+        # accounting, and submit-path load shedding.
+        g("ha_role",
+          "replica role (0 follower | 1 leader | 2 candidate | 3 fenced)")
+        g("ha_lease_epoch", "fencing epoch of the HA lease")
+        c("ha_role_transitions_total", "role transitions per (from, to)")
+        g("ha_replay_lag_records",
+          "journal records not yet folded into the follower read model")
+        g("sse_clients_connected", "fanout hub subscribers")
+        c("sse_events_dropped_total",
+          "events dropped on full client/shard queues")
+        c("sse_clients_evicted_total", "slow consumers evicted")
+        c("admission_shed_total", "submissions shed per reason")
+        g("admission_shed_factor",
+          "current SLO-driven rate factor on the submit token bucket")
         self.gauge("build_info").set(
             (("name", "kueue_tpu"), ("version", "0.2.0")), 1)
 

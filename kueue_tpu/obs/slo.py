@@ -196,6 +196,18 @@ class SLOEngine:
                            "statusName": _STATUS_NAMES[status]}
         return out
 
+    def worst(self) -> tuple:
+        """(status, max_burn) across all objectives and windows — the
+        one-number coupling the HA admission shedder keys its refill
+        factor off (kueue_tpu/ha/shedder.py): the worse the worst
+        objective burns, the harder the front door sheds."""
+        worst_status, worst_burn = STATUS_OK, 0.0
+        for ev in self.evaluate().values():
+            worst_status = max(worst_status, ev["status"])
+            for b in ev["burn"].values():
+                worst_burn = max(worst_burn, b)
+        return worst_status, worst_burn
+
     def _export(self) -> None:
         reg = self.engine.registry
         try:

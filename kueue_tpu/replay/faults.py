@@ -17,6 +17,11 @@ Spec grammar (comma-separated faults):
   delay-verdict@cycle:N:MS the oracle's verdicts arrive MS late on
                            cycle N (slow sidecar) — decisions must be
                            unaffected, only phase timings move
+  lease-stall@cycle:N      stop renewing the HA lease from cycle N on
+                           (a wedged-but-alive leader): a standby must
+                           steal the lease at expiry and the stale
+                           leader's next journal write must die on
+                           JournalFenced, not interleave
 
 The recovery contract these faults exist to prove: reboot via
 store.journal.rebuild_engine and drain, and the admitted set equals an
@@ -56,7 +61,7 @@ class FaultPlan:
                     f"bad fault spec {part!r} "
                     "(want kind@cycle:N or kind@admission:N)") from None
             if kind not in ("sigkill", "torn-tail", "oracle-crash",
-                            "delay-verdict"):
+                            "delay-verdict", "lease-stall"):
                 raise ValueError(f"unknown fault kind {kind!r}")
             if at not in ("cycle", "admission"):
                 raise ValueError(f"unknown fault point {at!r}")
@@ -175,6 +180,13 @@ class FaultInjector:
             elif f.kind == "delay-verdict":
                 self.proxy.delay_ms = f.arg
                 self.fired.append(f"delay-verdict@cycle:{seq}")
+            elif f.kind == "lease-stall":
+                if engine.ha is None:
+                    raise RuntimeError(
+                        "lease-stall fault needs an HA replica "
+                        "(engine.ha unset — not running in HA mode)")
+                engine.ha.suspend_renewal = True
+                self.fired.append(f"lease-stall@cycle:{seq}")
 
     def _post_cycle(self, seq: int, result) -> None:
         # Transient faults clear at the cycle's end: the sidecar

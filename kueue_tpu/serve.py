@@ -29,6 +29,13 @@ Environment:
                            cycle summaries on /events, kueuectl explain
                            / trace export. Value is the span retention
                            ring size ("on"/"1"/empty mean the default)
+  KUEUE_TPU_HA             "1" enables HA mode (--ha): replicas sharing
+                           one journal elect a leader through a fenced
+                           lease file; followers tail the journal and
+                           serve reads/SSE, promotion is replay-verified
+                           (kueue_tpu/ha). Related flags: --replica-id,
+                           --lease, --lease-duration, --shed-rate,
+                           --fanout-shards
 """
 
 from __future__ import annotations
@@ -60,10 +67,29 @@ def main(argv=None) -> None:
                         default=os.environ.get("KUEUE_TPU_FAULT"))
     parser.add_argument("--trace", nargs="?", const="on",
                         default=os.environ.get("KUEUE_TPU_TRACE"))
+    parser.add_argument("--ha", action="store_true",
+                        default=os.environ.get("KUEUE_TPU_HA") == "1")
+    parser.add_argument("--replica-id",
+                        default=os.environ.get("KUEUE_TPU_REPLICA_ID"))
+    parser.add_argument("--lease",
+                        default=os.environ.get("KUEUE_TPU_LEASE"))
+    parser.add_argument("--lease-duration", type=float,
+                        default=float(os.environ.get(
+                            "KUEUE_TPU_LEASE_DURATION", "5.0")))
+    parser.add_argument("--shed-rate", type=float,
+                        default=float(os.environ.get(
+                            "KUEUE_TPU_SHED_RATE", "0")))
+    parser.add_argument("--fanout-shards", type=int,
+                        default=int(os.environ.get(
+                            "KUEUE_TPU_FANOUT_SHARDS", "4")))
     args = parser.parse_args(argv)
 
     from kueue_tpu.store.journal import rebuild_engine
     from kueue_tpu.visibility.http_server import ServingEndpoint
+
+    if args.ha:
+        _main_ha(args)
+        return
 
     # rebuild_engine re-attaches the journal for continued writes.
     eng = rebuild_engine(args.journal)
@@ -122,6 +148,117 @@ def main(argv=None) -> None:
     if recorder is not None:
         recorder.close()
     endpoint.stop()
+
+
+def _main_ha(args) -> None:
+    """HA replica mode: this process is one of N sharing ``--journal``
+    and ``--lease``. It starts as a follower (reads + SSE immediately);
+    winning the lease runs the replay-verified promotion before the
+    first write. The serving endpoint resolves the engine per request
+    because promotion swaps it."""
+    from kueue_tpu.ha.replica import HAReplica
+    from kueue_tpu.ha.shedder import AdmissionShedder
+    from kueue_tpu.visibility.fanout import FanoutHub
+    from kueue_tpu.visibility.http_server import ServingEndpoint
+
+    identity = args.replica_id or f"{os.uname().nodename}-{os.getpid()}"
+    lease_path = args.lease or args.journal + ".lease"
+    hub = FanoutHub(shards=args.fanout_shards)
+    shedder = (AdmissionShedder(rate=args.shed_rate, hub=hub)
+               if args.shed_rate > 0 else None)
+
+    def on_promote(eng, replica) -> None:
+        # The promoted engine gets the full leader toolchain: oracle,
+        # SLO engine (drives the shedder's refill factor), tracer,
+        # flight recorder, and the fault plan (which needs engine.ha —
+        # already set by the promotion protocol).
+        if args.oracle == "local":
+            eng.attach_oracle()
+        elif args.oracle != "off":
+            host, _, port = args.oracle.rpartition(":")
+            eng.attach_oracle(
+                remote_address=(host or "127.0.0.1", int(port)))
+        from kueue_tpu.obs.slo import attach_slo
+        attach_slo(eng)
+        if shedder is not None:
+            shedder.slo = eng.slo
+            shedder.metrics = eng.registry
+            eng.shedder = shedder
+        hub.metrics = eng.registry
+        replica.tailer.metrics = eng.registry
+        replica.metrics = eng.registry
+        if args.trace:
+            retain = (int(args.trace) if args.trace.isdigit()
+                      and int(args.trace) > 1 else 64)
+            eng.attach_tracer(retain=retain)
+        if args.record:
+            from kueue_tpu.replay.recorder import FlightRecorder
+            replica.recorder = FlightRecorder(
+                eng, args.record, bootstrap=True,
+                label=f"serve-ha:{identity}")
+        if args.fault:
+            from kueue_tpu.replay.faults import arm_faults
+            arm_faults(eng, args.fault)
+
+    replica = HAReplica(
+        args.journal, lease_path, identity,
+        lease_duration=args.lease_duration,
+        hub=hub, shedder=shedder, on_promote=on_promote)
+
+    host, _, port = args.http.rpartition(":")
+    endpoint = ServingEndpoint(
+        replica.engine_ref, host=host or "0.0.0.0", port=int(port),
+        auth_token=os.environ.get("KUEUE_TPU_AUTH_TOKEN"),
+        hub=hub, replica=replica)
+    endpoint.start()
+    print(f"kueue-tpu engine serving on {host or '0.0.0.0'}:"
+          f"{endpoint.port} (journal={args.journal}, "
+          f"oracle={args.oracle})", flush=True)
+    print(f"ha: replica={identity} lease={lease_path} "
+          f"duration={args.lease_duration}s", flush=True)
+
+    stop = {"flag": False}
+
+    def _stop(*_a):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+
+    announced = {"role": "follower"}
+    while not stop["flag"]:
+        role = replica.step(time.time())
+        if role != announced["role"]:
+            announced["role"] = role
+            print(f"ha: role={role} epoch={replica.epoch}", flush=True)
+        if role == "leader":
+            # Capture once: the renewal thread can fence (and null out)
+            # replica.engine at any point between ticks.
+            eng = replica.engine
+            if eng is None:
+                continue
+            t0 = time.monotonic()
+            try:
+                result = eng.schedule_once()
+            except Exception as e:  # noqa: BLE001 — a fenced write
+                from kueue_tpu.store.journal import JournalFenced
+                if isinstance(e, JournalFenced):
+                    replica._fence(f"journal fence tripped: {e}")
+                    continue
+                raise
+            eng.tick(
+                time.monotonic() - t0 + args.tick
+                if result is None else time.monotonic() - t0)
+            if result is None:
+                time.sleep(args.tick)
+        else:
+            time.sleep(args.tick)
+    recorder = getattr(replica, "recorder", None)
+    if recorder is not None:
+        recorder.close()
+    replica.resign()
+    endpoint.stop()
+    hub.close()
 
 
 if __name__ == "__main__":

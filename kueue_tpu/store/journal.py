@@ -40,6 +40,13 @@ class JournalCorruption(Exception):
     replaying past it would silently drop every later record."""
 
 
+class JournalFenced(Exception):
+    """A write was refused by the journal's fence predicate: the holder
+    is no longer the leader (HA fencing — a deposed leader's in-flight
+    writes must die here rather than interleave with the new leader's;
+    see kueue_tpu/ha/replica.py)."""
+
+
 class JournalConflict(Exception):
     """Optimistic-concurrency failure: the object was modified by another
     writer since the caller read it (the SSA patch-conflict analog,
@@ -73,6 +80,10 @@ class Journal:
     def __init__(self, path: str, fsync: bool = False):
         self.path = path
         self.fsync = fsync
+        # Optional fence predicate (HA): evaluated INSIDE the append
+        # flock; returning False raises JournalFenced instead of
+        # writing. None (the default) means unfenced.
+        self.fence = None
         self._fh = open(path, "a", encoding="utf-8")
         # Appends since the last sync(): the engine calls sync() on
         # cycle boundaries (write+flush+fsync), so a crash between
@@ -259,6 +270,10 @@ class Journal:
                 # fragment (under the lock) or our record would
                 # concatenate onto it and poison every later replay.
                 self._repair_torn_tail()
+            if self.fence is not None and not self.fence():
+                raise JournalFenced(
+                    f"write of {kind}/{key} refused: fence predicate "
+                    f"failed (no longer leader)")
             self.refresh()
             k = (kind, key)
             current = self._generations.get(k, 0)
@@ -378,19 +393,19 @@ _CREATE = {
 # double-apply nothing (it is pure rationale), and dropping it loses no
 # admission. Every other emitted kind must have a _CREATE entry or an
 # explicit special case above; graftlint rule R1 enforces the union.
-EPHEMERAL_KINDS = frozenset({"cycle_trace"})
+# ``ha_digest`` is the HA failover checkpoint (kueue_tpu/ha/digest.py):
+# pure verification rationale — promotion READS it, rebuild skips it.
+EPHEMERAL_KINDS = frozenset({"cycle_trace", "ha_digest"})
 
 
-def rebuild_engine(path: str, engine=None, attach_oracle: bool = False,
-                   **engine_kwargs):
-    """Cold-start an engine from a journal: the restart path. Returns
-    the rebuilt engine (its caches and queues reconstructed, clock
-    restored to the last persisted timestamp)."""
+def engine_from_records(records, engine=None, **engine_kwargs):
+    """Apply a journal record sequence to an engine — the replay loop,
+    factored out of rebuild_engine so HA promotion can verify a PREFIX
+    of the journal (replay up to a checkpoint, assert the state digest)
+    and followers can hold a read model with NO journal attached."""
     from kueue_tpu.controllers.engine import Engine
 
     eng = engine if engine is not None else Engine(**engine_kwargs)
-    journal = Journal(path)
-    records = list(journal.replay())
     # Last op wins per (kind, key): a later delete tombstones earlier
     # applies (a node that failed must not resurrect on restart).
     live: dict[tuple, bool] = {}
@@ -404,6 +419,8 @@ def rebuild_engine(path: str, engine=None, attach_oracle: bool = False,
         kind = rec["kind"]
         key = _key_of(rec)
         if rec["op"] == "delete" or not live[(kind, key)]:
+            continue
+        if kind in EPHEMERAL_KINDS:
             continue
         if kind == "workload":
             if key not in workloads:
@@ -420,6 +437,17 @@ def rebuild_engine(path: str, engine=None, attach_oracle: bool = False,
     eng.clock = clock
     for key in wl_order:
         eng.restore_workload(from_jsonable(workloads[key]))
+    return eng
+
+
+def rebuild_engine(path: str, engine=None, attach_oracle: bool = False,
+                   **engine_kwargs):
+    """Cold-start an engine from a journal: the restart path. Returns
+    the rebuilt engine (its caches and queues reconstructed, clock
+    restored to the last persisted timestamp)."""
+    journal = Journal(path)
+    eng = engine_from_records(list(journal.replay()), engine=engine,
+                              **engine_kwargs)
     if attach_oracle:
         eng.attach_oracle()
     eng.attach_journal(journal, record_existing=False)

@@ -26,8 +26,13 @@ from kueue_tpu.visibility.server import (
 
 
 def make_handler(engine, auth_token=None, apf=None,
-                 heartbeat_seconds: float = 15.0):
-    vis = VisibilityServer(engine)
+                 heartbeat_seconds: float = 15.0, hub=None,
+                 replica=None):
+    # ``engine`` may be the object itself or a zero-arg callable
+    # resolving to it: HA promotion SWAPS the engine (a follower's read
+    # model becomes a leader's live engine), so handlers must resolve
+    # per request rather than close over one object.
+    resolve = engine if callable(engine) else (lambda: engine)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):  # quiet
@@ -64,7 +69,7 @@ def make_handler(engine, auth_token=None, apf=None,
             failing the request (the /metrics race discipline).
             ``empty`` must match the view's JSON shape."""
             try:
-                body = json.dumps(fn(engine))
+                body = json.dumps(fn(resolve()))
                 Handler._view_cache[name] = body
             except RuntimeError:
                 body = Handler._view_cache.get(name, empty)
@@ -138,6 +143,12 @@ def make_handler(engine, auth_token=None, apf=None,
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Connection", "keep-alive")
             self.end_headers()
+            if hub is not None:
+                self._serve_events_hub()
+                return
+            engine = resolve()
+            if engine is None:
+                return
             q: _queue.Queue = _queue.Queue(maxsize=1024)
 
             def listener(ev):
@@ -182,7 +193,113 @@ def make_handler(engine, auth_token=None, apf=None,
                 except ValueError:
                     pass
 
+        def _serve_events_hub(self):
+            """Hub-backed SSE: this handler thread drains ONE bounded
+            FanoutClient queue; the scheduling thread's publish cost is
+            O(shards) regardless of how many of these are connected.
+            An EVICTED sentinel (we stopped reading fast enough) closes
+            the stream."""
+            import queue as _queue
+
+            from kueue_tpu.visibility.fanout import EVICTED
+
+            client = hub.subscribe()
+            try:
+                self.wfile.write(b": connected\n\n")
+                self.wfile.flush()
+                while True:
+                    try:
+                        item = client.get(timeout=heartbeat_seconds)
+                    except _queue.Empty:
+                        if client.evicted:
+                            break
+                        self.wfile.write(b": keep-alive\n\n")
+                        self.wfile.flush()
+                        continue
+                    if item is EVICTED:
+                        self.wfile.write(
+                            b"event: evicted\n"
+                            b"data: {\"reason\":\"slow consumer\"}\n\n")
+                        self.wfile.flush()
+                        break
+                    kind, data = item
+                    self.wfile.write(
+                        f"event: {kind}\ndata: {data}\n\n".encode())
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass  # client went away
+            finally:
+                hub.unsubscribe(client)
+
+        def do_POST(self):  # noqa: N802
+            """The write front door: POST /workloads submits a workload
+            (serde-tagged JSON body). In HA mode the replica gates it —
+            503 + leader hint off-leader, 429 + Retry-After when the
+            SLO-coupled token bucket sheds — so shed requests never
+            become flight-recorder inputs or journal records."""
+            if not self._authorized():
+                self._send('{"error":"unauthorized"}', code=401)
+                return
+            path = urlparse(self.path).path.rstrip("/")
+            if path != "/workloads":
+                self._send('{"error":"not found"}', code=404)
+                return
+            import time as _time
+
+            from kueue_tpu.api.serde import from_jsonable
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                wl = from_jsonable(json.loads(self.rfile.read(length)))
+            except Exception as e:  # noqa: BLE001 — client error
+                self._send(json.dumps(
+                    {"error": f"bad workload body: {e}"}), code=400)
+                return
+            if replica is not None:
+                verdict = replica.submit(wl, _time.time())
+                code = verdict.pop("code", 500)
+                data = json.dumps(verdict).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                if code == 429:
+                    self.send_header(
+                        "Retry-After",
+                        str(max(1, int(verdict.get("retryAfter", 1)))))
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            engine = resolve()
+            if engine is None:
+                self._send('{"error":"no engine"}', code=503)
+                return
+            shedder = getattr(engine, "shedder", None)
+            if shedder is not None:
+                v = shedder.admit(_time.time())
+                if not v["accepted"]:
+                    data = json.dumps({
+                        "accepted": False,
+                        "reason": "shed: admission rate limit",
+                        "factor": v["factor"]}).encode()
+                    self.send_response(429)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After", str(
+                        max(1, int(v["retryAfter"]))))
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+            engine.submit(wl)
+            self._send(json.dumps({
+                "accepted": True,
+                "workload": wl.name}), code=201)
+
         def _serve_get(self):
+            engine = resolve()
+            if engine is None:
+                # A follower that hasn't built its read model yet.
+                self._send('{"error":"no read model yet"}', code=503)
+                return
+            vis = VisibilityServer(engine)
             path = urlparse(self.path).path.rstrip("/")
             parts = [p for p in path.split("/") if p]
             if path in ("", "/dashboard"):
@@ -219,6 +336,14 @@ def make_handler(engine, auth_token=None, apf=None,
             elif path == "/debug/slo":
                 self._send_view("slo", slo_summary,
                                 empty='{"enabled": false}')
+            elif path == "/debug/ha":
+                if replica is not None:
+                    self._send(json.dumps(replica.status()))
+                elif hub is not None:
+                    self._send(json.dumps(
+                        {"enabled": False, "sse": hub.stats()}))
+                else:
+                    self._send('{"enabled": false}')
             elif path == "/capacity":
                 self._send_view("capacity", capacity_summary)
             elif path == "/cohorts":
@@ -270,6 +395,13 @@ def make_handler(engine, auth_token=None, apf=None,
     return Handler
 
 
+class _FanoutHTTPServer(ThreadingHTTPServer):
+    # Thousands of SSE watchers reconnect in a burst after a failover;
+    # the stdlib default listen backlog of 5 resets most of the stampede
+    # before accept() ever sees it.
+    request_queue_size = 512
+
+
 class ServingEndpoint:
     """The debug/visibility HTTP endpoint. Hardening knobs (the
     reference's pkg/util/cert + visibility APF analog):
@@ -290,16 +422,20 @@ class ServingEndpoint:
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  cert_dir: str = None, auth_token: str = None,
-                 flow_control=True, heartbeat_seconds: float = 15.0):
+                 flow_control=True, heartbeat_seconds: float = 15.0,
+                 hub=None, replica=None):
         from kueue_tpu.visibility.flowcontrol import APFDispatcher
         self.apf = None
+        self.hub = hub
+        self.replica = replica
         if flow_control:
             self.apf = (flow_control if isinstance(
                 flow_control, APFDispatcher) else APFDispatcher())
-        self.httpd = ThreadingHTTPServer(
+        self.httpd = _FanoutHTTPServer(
             (host, port), make_handler(
                 engine, auth_token=auth_token, apf=self.apf,
-                heartbeat_seconds=heartbeat_seconds))
+                heartbeat_seconds=heartbeat_seconds, hub=hub,
+                replica=replica))
         self.tls = cert_dir is not None
         if cert_dir is not None:
             import ssl

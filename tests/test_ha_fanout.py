@@ -1,0 +1,198 @@
+"""Sharded SSE fanout hub (visibility/fanout.py): the slow-consumer
+contract. A client whose bounded queue stays full gets events DROPPED
+and, after ``evict_after`` consecutive drops, is EVICTED — without ever
+stalling the publishing thread (the scheduling loop), the shard
+dispatchers, or any other client."""
+
+import time
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.visibility.fanout import EVICTED, FanoutClient, FanoutHub
+
+
+def drain(client: FanoutClient, timeout=5.0):
+    """Read everything currently deliverable to the client (stops on a
+    short idle gap or the EVICTED sentinel)."""
+    out = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            item = client.get(timeout=0.1)
+        except Exception:  # queue.Empty
+            break
+        out.append(item)
+        if item is EVICTED:
+            break
+    return out
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def test_basic_delivery_all_clients():
+    hub = FanoutHub(shards=2, client_queue_depth=64)
+    clients = [hub.subscribe() for _ in range(5)]
+    try:
+        for i in range(10):
+            hub.publish("tick", str(i))
+        for c in clients:
+            got = drain(c)
+            assert [d for _, d in got] == [str(i) for i in range(10)]
+            assert c.delivered == 10
+            assert not c.evicted
+        assert hub.stats()["published"] == 10
+        assert hub.stats()["dropped"] == 0
+    finally:
+        hub.close()
+
+
+def test_configured_client_depth_is_honored():
+    hub = FanoutHub(shards=1, client_queue_depth=7)
+    try:
+        assert hub.subscribe().queue.maxsize == 7
+        assert hub.subscribe(depth=3).queue.maxsize == 3
+    finally:
+        hub.close()
+
+
+def test_slow_consumer_evicted_other_clients_unharmed():
+    hub = FanoutHub(shards=1, client_queue_depth=4, evict_after=8)
+    slow = hub.subscribe()
+    fast = hub.subscribe(depth=1024)
+    try:
+        n = 4 + 8 + 5  # fill slow's queue, trip eviction, then some
+        t0 = time.monotonic()
+        for i in range(n):
+            hub.publish("ev", str(i))
+        publish_elapsed = time.monotonic() - t0
+        # publish() is O(shards) non-blocking puts: a wedged consumer
+        # must not slow the caller down.
+        assert publish_elapsed < 1.0
+
+        assert wait_until(lambda: slow.evicted)
+        # The victim's queue ends with the sentinel so its handler
+        # thread wakes and closes the stream.
+        assert EVICTED in drain(slow)
+        assert slow.dropped >= 8
+        # The healthy client saw EVERY event despite its neighbor.
+        got = drain(fast)
+        assert [d for _, d in got] == [str(i) for i in range(n)]
+        stats = hub.stats()
+        assert stats["evicted"] == 1
+        assert stats["clients"] == 1  # slow removed from its shard
+    finally:
+        hub.close()
+
+
+def test_evicted_client_receives_no_further_events():
+    hub = FanoutHub(shards=1, client_queue_depth=2, evict_after=3)
+    slow = hub.subscribe()
+    try:
+        for i in range(2 + 3):
+            hub.publish("ev", str(i))
+        assert wait_until(lambda: slow.evicted)
+        hub.publish("late", "x")
+        items = drain(slow)
+        assert EVICTED in items
+        assert ("late", "x") not in items
+    finally:
+        hub.close()
+
+
+def _tiny_world(eng, n_workloads):
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cohort(Cohort("co"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq0", cohort="co",
+        resource_groups=(ResourceGroup(
+            ("cpu",), (FlavorQuotas(
+                "default", {"cpu": ResourceQuota(10_000_000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq0", "default", "cq0"))
+    for i in range(n_workloads):
+        eng.clock += 0.01
+        eng.submit(Workload(name=f"w{i}", queue_name="lq0",
+                            pod_sets=(PodSet("main", 1, {"cpu": 100}),)))
+
+
+def test_engine_attach_single_listener_and_cycle_not_stalled():
+    """The hub bridges EngineEvents with ONE engine listener; a wedged
+    subscriber must not stretch the admission cycle."""
+    hub = FanoutHub(shards=2, client_queue_depth=1, evict_after=4)
+    eng = Engine()
+    before = len(eng.event_listeners)
+    hub.attach_engine(eng)
+    assert len(eng.event_listeners) == before + 1
+    assert eng.fanout is hub
+    stuck = hub.subscribe()  # depth 1, never drained
+    watcher = hub.subscribe(depth=4096)
+    try:
+        _tiny_world(eng, 30)
+        t0 = time.monotonic()
+        while eng.schedule_once() is not None:
+            pass
+        cycle_elapsed = time.monotonic() - t0
+        admitted = sum(1 for w in eng.workloads.values()
+                       if w.is_admitted)
+        assert admitted == 30
+        assert cycle_elapsed < 5.0
+        # The healthy watcher observed the admissions...
+        assert wait_until(
+            lambda: sum(1 for k, _ in drain(watcher, timeout=1.0)
+                        if k == "admitted") >= 1 or watcher.delivered)
+        # ...and the wedged one was evicted instead of back-pressuring.
+        assert wait_until(lambda: stuck.evicted)
+    finally:
+        hub.detach_engine()
+        hub.close()
+    assert len(eng.event_listeners) == before
+    assert eng.fanout is None
+
+
+def test_unsubscribe_removes_client():
+    hub = FanoutHub(shards=2)
+    c = hub.subscribe()
+    try:
+        assert hub.client_count() == 1
+        hub.unsubscribe(c)
+        assert hub.client_count() == 0
+        hub.publish("ev", "x")
+        assert drain(c, timeout=0.3) == []
+    finally:
+        hub.close()
+
+
+def test_metrics_counters_wired(tmp_path):
+    from kueue_tpu.metrics.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    hub = FanoutHub(shards=1, client_queue_depth=1, evict_after=2,
+                    metrics=reg)
+    slow = hub.subscribe()
+    try:
+        for i in range(4):
+            hub.publish("ev", str(i))
+        assert wait_until(lambda: slow.evicted)
+        text = reg.render()
+        assert "sse_clients_evicted_total" in text
+        assert "sse_events_dropped_total" in text
+    finally:
+        hub.close()

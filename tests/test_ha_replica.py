@@ -1,0 +1,399 @@
+"""HA serving plane (kueue_tpu/ha): fenced lease, role machine,
+checkpoint digests, replay-verified promotion, the in-process failover
+protocol, admission load shedding, and the follower journal tailer."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.ha.digest import (
+    DigestChain,
+    admitted_state_digest,
+    last_checkpoint,
+    verify_promotion,
+)
+from kueue_tpu.ha.lease import FencedLease
+from kueue_tpu.ha.replica import HAReplica
+from kueue_tpu.ha.roles import (
+    CANDIDATE,
+    FENCED,
+    FOLLOWER,
+    LEADER,
+    ROLE_CODES,
+    RoleMachine,
+    RoleTransitionError,
+)
+from kueue_tpu.ha.shedder import (
+    STATUS_BREACH,
+    STATUS_OK,
+    STATUS_WARN,
+    AdmissionShedder,
+    TokenBucket,
+)
+from kueue_tpu.ha.tailer import JournalTailer
+from kueue_tpu.store.journal import (
+    Journal,
+    JournalFenced,
+    attach_new_journal,
+    engine_from_records,
+    rebuild_engine,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_world(eng):
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cohort(Cohort("co"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq0", cohort="co",
+        resource_groups=(ResourceGroup(
+            ("cpu",), (FlavorQuotas(
+                "default", {"cpu": ResourceQuota(1_000_000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq0", "default", "cq0"))
+
+
+def submit_wave(eng, n, start=0):
+    for i in range(start, start + n):
+        eng.clock += 0.01
+        eng.submit(Workload(name=f"w{i}", queue_name="lq0",
+                            pod_sets=(PodSet("main", 1, {"cpu": 100}),)))
+
+
+def drain(eng):
+    while eng.schedule_once() is not None:
+        pass
+
+
+# -- fenced lease --
+
+def test_lease_epoch_monotonic_fencing(tmp_path):
+    path = str(tmp_path / "lease.json")
+    lease = FencedLease(path)
+    a = lease.try_acquire("a", now=0.0, duration=5.0)
+    assert a is not None and a.epoch == 1
+    # Held and unexpired: a standby cannot steal it.
+    assert lease.try_acquire("b", now=1.0, duration=5.0) is None
+    # Same-term renew keeps the epoch.
+    assert lease.renew("a", 1, now=3.0).epoch == 1
+    # Expiry: the standby wins a NEW term (epoch bumps).
+    b = lease.try_acquire("b", now=20.0, duration=5.0)
+    assert b is not None and b.epoch == 2
+    # The deposed holder's renew is refused (holder AND epoch mismatch).
+    assert lease.renew("a", 1, now=21.0) is None
+    # Graceful release clears the holder but KEEPS the epoch: the next
+    # acquirer must still fence out term 2.
+    lease.release("b")
+    assert lease.read().holder == ""
+    assert lease.epoch_of() == 2
+    c = lease.try_acquire("c", now=22.0, duration=5.0)
+    assert c.epoch == 3
+
+
+def test_lease_survives_corrupt_file(tmp_path):
+    path = str(tmp_path / "lease.json")
+    lease = FencedLease(path)
+    lease.try_acquire("a", now=0.0, duration=5.0)
+    with open(path, "w") as f:
+        f.write("{not json")
+    assert lease.read() is None
+    # Corruption reads as free; acquisition still works.
+    assert lease.try_acquire("b", now=1.0, duration=5.0) is not None
+
+
+# -- role machine --
+
+def test_role_machine_legal_path_and_history():
+    rm = RoleMachine(FOLLOWER)
+    rm.to(CANDIDATE, "lease acquired")
+    rm.to(LEADER, "verified")
+    rm.to(FENCED, "deposed")
+    assert rm.is_fenced
+    assert [t["to"] for t in rm.history()] == [CANDIDATE, LEADER, FENCED]
+    assert ROLE_CODES[LEADER] == 1 and ROLE_CODES[FENCED] == 3
+
+
+def test_role_machine_rejects_protocol_skips():
+    # follower -> leader without the candidate verification gate.
+    with pytest.raises(RoleTransitionError):
+        RoleMachine(FOLLOWER).to(LEADER)
+    # fenced is terminal.
+    rm = RoleMachine(FENCED)
+    with pytest.raises(RoleTransitionError):
+        rm.to(FOLLOWER)
+
+
+# -- checkpoint digests + promotion verification --
+
+def _checkpointed_journal(tmp_path, waves=((3, 0), (2, 3))):
+    """A leader-shaped journal: world + per-cycle ha_digest checkpoints
+    written through the pre-sync hook, one drain per wave."""
+    path = str(tmp_path / "journal.jsonl")
+    eng = Engine()
+    attach_new_journal(eng, path)
+    build_world(eng)
+    DigestChain(eng, epoch=1)
+    for n, start in waves:
+        submit_wave(eng, n, start=start)
+        drain(eng)
+    return path, eng
+
+
+def test_digest_chain_checkpoints_inside_cycle(tmp_path):
+    path, eng = _checkpointed_journal(tmp_path)
+    records = list(Journal(path).replay())
+    idx, ckpt = last_checkpoint(records)
+    assert ckpt is not None
+    obj = ckpt["obj"]
+    assert obj["epoch"] == 1
+    # The checkpoint is the LAST record of its cycle (pre-sync hook):
+    # nothing but more checkpoints/cycle records may follow.
+    assert idx == len(records) - 1
+    # Live state digest == checkpointed state digest == rebuild digest.
+    assert obj["state"] == admitted_state_digest(eng)
+    reb = rebuild_engine(path)
+    assert admitted_state_digest(reb) == obj["state"]
+
+
+def test_verify_promotion_clean_boundary(tmp_path):
+    path, _ = _checkpointed_journal(tmp_path)
+    records = list(Journal(path).replay())
+    report = verify_promotion(records, engine_from_records(records),
+                              new_epoch=2)
+    assert report["verified"]
+    assert not report["partial_cycle"]
+    assert report["reason"] == "digest identity at checkpoint"
+
+
+def test_verify_promotion_adopts_partial_cycle(tmp_path):
+    path, _ = _checkpointed_journal(tmp_path)
+    records = list(Journal(path).replay())
+    # Drop the final checkpoint: the journal now looks like a leader
+    # SIGKILLed mid-cycle — durable workload records after the last
+    # checkpoint. Verification must prove the PREFIX and adopt the tail.
+    assert records[-1]["kind"] == "ha_digest"
+    torn = records[:-1]
+    report = verify_promotion(torn, engine_from_records(torn),
+                              new_epoch=2)
+    assert report["verified"]
+    assert report["partial_cycle"]
+    assert "adopted" in report["reason"]
+
+
+def test_verify_promotion_fences_on_tamper(tmp_path):
+    path, _ = _checkpointed_journal(tmp_path)
+    records = list(Journal(path).replay())
+    idx, _ = last_checkpoint(records)
+    records[idx]["obj"]["state"] = "deadbeef"
+    report = verify_promotion(records, engine_from_records(records),
+                              new_epoch=2)
+    assert not report["verified"]
+    assert "mismatch" in report["reason"]
+
+
+def test_verify_promotion_fences_on_epoch_violation(tmp_path):
+    path, _ = _checkpointed_journal(tmp_path)
+    records = list(Journal(path).replay())
+    report = verify_promotion(records, engine_from_records(records),
+                              new_epoch=1)  # checkpoint epoch is 1 too
+    assert not report["verified"]
+    assert "fencing violation" in report["reason"]
+
+
+# -- in-process failover: the whole protocol, synthetic clock --
+
+def test_failover_promotes_verified_and_fences_stale_leader(tmp_path):
+    journal = str(tmp_path / "ha.jsonl")
+    lease = journal + ".lease"
+    a = HAReplica(journal, lease, "a", lease_duration=5.0,
+                  renew_in_background=False)
+    assert a.step(0.0) == LEADER  # fresh journal: trivially verified
+    assert a.epoch == 1
+    build_world(a.engine)
+    submit_wave(a.engine, 5)
+    drain(a.engine)
+    digest_a = admitted_state_digest(a.engine)
+    eng_a = a.engine
+
+    # The leader stalls (fault hook) and its lease expires underneath.
+    a.suspend_renewal = True
+    b = HAReplica(journal, lease, "b", lease_duration=5.0,
+                  renew_in_background=False)
+    assert b.step(2.0) == FOLLOWER        # lease still live
+    assert b.step(100.0) == LEADER        # expired: steal + promote
+    assert b.epoch == 2
+    assert b.promotion_report["verified"]
+    assert b.promotion_report["reason"] == "digest identity at checkpoint"
+    # Zero lost, zero duplicate: byte-identical admitted state.
+    assert admitted_state_digest(b.engine) == digest_a
+
+    # The stale leader notices on its next renew attempt and fences.
+    a.suspend_renewal = False
+    assert a.step(101.0) == FENCED
+    assert a.engine is None
+    # Its retained engine handle can never write again: the journal
+    # fence predicate re-checks the role inside the append lock.
+    with pytest.raises(JournalFenced):
+        eng_a.submit(Workload(
+            name="stale", queue_name="lq0",
+            pod_sets=(PodSet("main", 1, {"cpu": 100}),)))
+    # The new leader keeps writing fine.
+    submit_wave(b.engine, 1, start=5)
+    drain(b.engine)
+    assert sum(1 for w in b.engine.workloads.values()
+               if w.is_admitted) == 6
+
+
+def test_submit_front_door_role_and_shed_gates(tmp_path):
+    journal = str(tmp_path / "ha.jsonl")
+    lease = journal + ".lease"
+    leader = HAReplica(journal, lease, "ldr", lease_duration=5.0,
+                       renew_in_background=False,
+                       shedder=AdmissionShedder(rate=1.0, burst=1.0))
+    leader.step(0.0)
+    build_world(leader.engine)
+    wl = Workload(name="front", queue_name="lq0",
+                  pod_sets=(PodSet("main", 1, {"cpu": 100}),))
+    out = leader.submit(wl, now=0.0)
+    assert out["code"] == 201 and out["workload"] == "front"
+    # A retried POST of the same name is deduplicated, not re-submitted
+    # (re-submitting would reset an admitted workload to pending) — and
+    # it doesn't burn a bucket token (burst is 1, already spent).
+    out = leader.submit(wl, now=0.0)
+    assert out["code"] == 200 and out["deduplicated"]
+    # Bucket (burst 1) is empty: the next submit is shed, not queued.
+    out = leader.submit(Workload(
+        name="shedme", queue_name="lq0",
+        pod_sets=(PodSet("main", 1, {"cpu": 100}),)), now=0.0)
+    assert out["code"] == 429
+    assert out["retryAfter"] > 0
+    assert "shedme" not in leader.engine.workloads
+
+    follower = HAReplica(journal, lease, "fol", lease_duration=5.0,
+                         renew_in_background=False)
+    follower.step(1.0)  # lease held by ldr: stays follower
+    out = follower.submit(wl, now=1.0)
+    assert out["code"] == 503
+    assert out["leaderHint"] == "ldr"
+
+
+# -- shedder --
+
+def test_token_bucket_refill_and_factor():
+    tb = TokenBucket(rate=10.0, burst=5.0)
+    assert all(tb.take(0.0) for _ in range(5))
+    assert not tb.take(0.0)
+    assert tb.take(1.0)  # refilled
+    # factor squeezes the refill without touching configuration.
+    tb2 = TokenBucket(rate=10.0, burst=1.0)
+    assert tb2.take(0.0)
+    assert not tb2.take(0.05, factor=0.1)  # 10/s * 0.1 * 0.05s = 0.05 tok
+
+
+class _FakeSLO:
+    def __init__(self, status, burn):
+        self._v = (status, burn)
+
+    def worst(self):
+        return self._v
+
+
+def test_shedder_slo_coupling():
+    assert AdmissionShedder(slo=_FakeSLO(STATUS_OK, 0.0))._factor() == 1.0
+    warn = AdmissionShedder(slo=_FakeSLO(STATUS_WARN, 1.0))._factor()
+    assert warn == pytest.approx(0.5)
+    breach = AdmissionShedder(slo=_FakeSLO(STATUS_BREACH, 3.0))._factor()
+    assert breach == pytest.approx(0.0625)
+    # Floors: back-pressure never rounds to a full stop.
+    assert AdmissionShedder(
+        slo=_FakeSLO(STATUS_BREACH, 1e9))._factor() == pytest.approx(0.05)
+
+
+def test_shedder_counts_and_status():
+    sh = AdmissionShedder(rate=1.0, burst=2.0)
+    assert sh.admit(0.0)["accepted"]
+    assert sh.admit(0.0)["accepted"]
+    verdict = sh.admit(0.0)
+    assert not verdict["accepted"] and verdict["retryAfter"] > 0
+    st = sh.status()
+    assert st["accepted"] == 2 and st["shed"] == 1
+
+
+# -- follower tailer --
+
+def test_tailer_reads_complete_lines_only(tmp_path):
+    path, eng = _checkpointed_journal(tmp_path)
+    tailer = JournalTailer(path, rebuild_every=1)
+    n = tailer.poll()
+    assert n == len(list(Journal(path).replay()))
+    assert tailer.replay_lag == 0
+    assert tailer.last_checkpoint is not None
+    assert tailer.status()["recordsSeen"] == n
+    # Read model reflects the journal (ha_digest skipped by rebuild).
+    assert (admitted_state_digest(tailer.engine)
+            == admitted_state_digest(eng))
+    # A torn tail (flushed, newline-less) stays unconsumed...
+    with open(path, "a") as f:
+        f.write('{"kind": "cycle_trace", "op": "apply"')
+    assert tailer.poll() == 0
+    assert tailer.records_seen == n
+    # ...until the writer completes the line.
+    with open(path, "a") as f:
+        f.write(', "obj": {"name": "t"}, "ts": 1.0}\n')
+    assert tailer.poll() == 1
+
+
+def test_tailer_throttles_rebuilds(tmp_path):
+    path, _ = _checkpointed_journal(tmp_path)
+    tailer = JournalTailer(path, rebuild_every=1000)
+    tailer.poll()
+    first_rebuilds = tailer.rebuilds   # cold rebuild (engine was None)
+    with open(path, "a") as f:
+        f.write(json.dumps({"kind": "cycle_trace", "op": "apply",
+                            "obj": {"name": "t"}, "ts": 2.0}) + "\n")
+    tailer.poll()
+    assert tailer.rebuilds == first_rebuilds  # throttled
+    assert tailer.replay_lag == 1
+
+
+# -- kueuectl status (offline) --
+
+def test_kueuectl_status_offline_renders_checkpoint(tmp_path):
+    from kueue_tpu.cli.kueuectl import run
+
+    path, eng = _checkpointed_journal(tmp_path)
+    engine = rebuild_engine(path)
+    text = run(engine, ["status"])
+    assert "role: offline" in text
+    assert "checkpoint: seq=" in text
+    raw = json.loads(run(engine, ["status", "--json"]))
+    assert raw["role"] == "offline"
+    assert raw["journalRecords"] > 0
+    assert raw["lastCheckpoint"]["state"] == admitted_state_digest(eng)
+
+
+# -- bench sentinel: empty trajectory is a clean exit, not a crash --
+
+def test_bench_sentinel_insufficient_history(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "bench_sentinel.py"),
+         "--dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "insufficient history" in proc.stdout

@@ -38,6 +38,13 @@ DEFAULT_ZONES: tuple = (
     # write-only discipline their digest-neutrality contract rests on.
     ("kueue_tpu/obs/perf.py", frozenset({"O1", "J1"})),
     ("kueue_tpu/obs/slo.py", frozenset({"O1", "J1"})),
+    # HA serving plane: D1 must NOT apply — lease acquisition/renewal
+    # and failover timing are inherently wall-clock (the lease file IS
+    # shared mutable time-keyed state); pinning the zone to J1-only
+    # keeps a future re-shuffle from accidentally demanding determinism
+    # of it. Its journal kind (ha_digest) is registered exhaustively
+    # for R1 via store.journal.EPHEMERAL_KINDS.
+    ("kueue_tpu/ha/", frozenset({"J1"})),
 )
 
 GLOBAL_RULES = frozenset({"J1"})
