@@ -6,8 +6,8 @@ PY ?= python
 PYTEST_FLAGS ?= -q
 
 .PHONY: all native test test-fast test-device bench multichip-dryrun \
-  replay-smoke obs-smoke tas-smoke perf-smoke ha-smoke bench-gate lint \
-  clean
+  replay-smoke obs-smoke tas-smoke perf-smoke ha-smoke chaos-smoke \
+  bench-gate lint clean
 
 all: native
 
@@ -89,6 +89,18 @@ perf-smoke: lint
 # kind registration) are part of the contract.
 ha-smoke: lint
 	JAX_PLATFORMS=cpu $(PY) tools/ha_smoke.py
+
+# Seeded chaos sweep: 8 seeds expanded into deterministic multi-stage
+# fault plans (SIGKILL at cycle/admission/maintenance boundaries, torn
+# journal tails, torn checkpoints, ENOSPC, clock skew, oracle crash
+# storms); every seed must recover to zero lost/duplicate admissions
+# with the checkpoint+suffix rebuild byte-identical to a genesis
+# replay, and the storm arm must demote + re-promote the oracle
+# breaker (store/checkpoint.py, replay/faults.py, oracle/supervisor.py).
+# lint first: the checkpoint and supervisor zone pins are part of the
+# recovery contract.
+chaos-smoke: lint
+	JAX_PLATFORMS=cpu $(PY) tools/chaos_smoke.py
 
 # Bench regression sentinel: noise-aware per-scenario gate over the
 # accumulated BENCH_r*/MULTICHIP_r* trajectory (tools/bench_sentinel.py).

@@ -1200,6 +1200,117 @@ def bench_ha_failover(n_clients=1000, n_workloads=400,
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_recovery_time(waves_small=60, waves_large=600, repeats=3):
+    """Bounded-time recovery (store/checkpoint.py): cold-start cost via
+    sealed checkpoint + journal suffix vs a full genesis replay, at two
+    history depths (10x apart, same live state: every wave evicts and
+    re-admits a fixed workload set, so history grows while the live
+    world stays constant-size).
+
+    The claim under test: genesis replay scales with HISTORY
+    (genesis_ratio ~= waves_large/waves_small) while the checkpoint
+    path scales with LIVE STATE (fast_flatness ~= 1.0 — flat across a
+    10x history spread). History is churn on a FIXED workload set
+    (evict + requeue + re-admit rounds), so both journals fold to the
+    same live state while their record counts differ 10x. value is
+    fast-path recoveries/s at the large depth, so bench-gate catches a
+    regression that drags checkpoint recovery back toward O(history)."""
+    import shutil
+    import tempfile
+
+    from kueue_tpu.api.types import (ClusterQueue, Cohort, FlavorQuotas,
+                                     LocalQueue, PodSet, ResourceFlavor,
+                                     ResourceGroup, ResourceQuota,
+                                     Workload)
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.store.checkpoint import CheckpointStore, recover_engine
+    from kueue_tpu.store.journal import attach_new_journal, rebuild_engine
+
+    workdir = tempfile.mkdtemp(prefix="bench-recovery-")
+    n_workloads = 10
+
+    def build(path, waves):
+        eng = Engine()
+        # Rotation ON: sealed history stays off the checkpoint fast
+        # path (the open-handle scan covers only the active segment),
+        # exactly the shape retention-enabled production runs have.
+        attach_new_journal(eng, path, rotate_records=120)
+        eng.create_resource_flavor(ResourceFlavor("default"))
+        eng.create_cohort(Cohort("co"))
+        eng.create_cluster_queue(ClusterQueue(
+            name="cq0", cohort="co",
+            resource_groups=(ResourceGroup(
+                ("cpu",),
+                (FlavorQuotas("default", {"cpu": ResourceQuota(4000)}),)),)))
+        eng.create_local_queue(LocalQueue("lq0", "default", "cq0"))
+        for i in range(n_workloads):
+            eng.clock += 0.01
+            eng.submit(Workload(name=f"w{i}", queue_name="lq0",
+                                pod_sets=(PodSet("main", 1, {"cpu": 100}),)))
+        eng.schedule_once()
+        for _ in range(waves):
+            eng.clock += 0.01
+            for wl in list(eng.workloads.values()):
+                if wl.status.admission is not None:
+                    eng.evict(wl, "BenchChurn")
+            eng.schedule_once()
+        eng.journal.sync()
+        # One sealed checkpoint near the tail + a short live suffix:
+        # the shape every warm production restart recovers from.
+        CheckpointStore.for_journal(path).write(eng, seq=eng.cycle_seq)
+        for _ in range(3):
+            eng.clock += 0.01
+            for wl in list(eng.workloads.values()):
+                if wl.status.admission is not None:
+                    eng.evict(wl, "BenchChurn")
+            eng.schedule_once()
+        eng.journal.close()
+
+    def measure(path):
+        t_fast = t_genesis = float("inf")
+        report = {}
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            _eng, report = recover_engine(path)
+            t_fast = min(t_fast, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            rebuild_engine(path, use_checkpoint=False).journal.close()
+            t_genesis = min(t_genesis, time.perf_counter() - t0)
+        return t_fast, t_genesis, report
+
+    try:
+        small = os.path.join(workdir, "small.jsonl")
+        large = os.path.join(workdir, "large.jsonl")
+        build(small, waves_small)
+        build(large, waves_large)
+        fast_s, genesis_s, _ = measure(small)
+        fast_l, genesis_l, report = measure(large)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    value = 1.0 / fast_l if fast_l > 0 else 0.0
+    return {
+        "value": round(value, 1), "unit": "recoveries/s",
+        "vs_baseline": None,
+        "detail": {
+            "waves": {"small": waves_small, "large": waves_large},
+            "fast_s": {"small": round(fast_s, 4),
+                       "large": round(fast_l, 4)},
+            "genesis_s": {"small": round(genesis_s, 4),
+                          "large": round(genesis_l, 4)},
+            # ~1.0 = checkpoint recovery is flat in history depth.
+            "fast_flatness": round(fast_l / fast_s, 2) if fast_s else None,
+            # ~waves_large/waves_small = genesis replay is linear in it.
+            "genesis_ratio": (round(genesis_l / genesis_s, 2)
+                              if genesis_s else None),
+            "speedup_at_large": (round(genesis_l / fast_l, 1)
+                                 if fast_l else None),
+            "recovery_source": report.get("source"),
+            "base_records": report.get("base_records"),
+            "suffix_records": report.get("suffix_records"),
+        },
+    }
+
+
 def bench_replay(trace_path, mode="host"):
     """A flight-recorder trace AS a bench scenario: re-execute it through
     the real engine (replay/replayer.py) and report cycle throughput plus
@@ -1375,6 +1486,10 @@ def main() -> None:
     run_scenario("ha_failover", lambda: bench_ha_failover(
         n_clients=128 if fast else 1000,
         n_workloads=120 if fast else 400), min_budget_s=90.0)
+    run_scenario("recovery_time", lambda: bench_recovery_time(
+        waves_small=30 if fast else 60,
+        waves_large=300 if fast else 600,
+        repeats=2 if fast else 3), min_budget_s=60.0)
 
     # Late-round TPU re-probe (round-4 verdict ask #6): when the early
     # probe failed, try once more AFTER the CPU run — a tunnel that

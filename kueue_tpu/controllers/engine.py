@@ -234,6 +234,10 @@ class Engine:
         # Durable store (store/journal.py) — the "K8s API as durable
         # store" analog; attach via attach_journal().
         self.journal = None
+        # Periodic sealed-checkpoint writer (store/checkpoint.py
+        # Checkpointer attaches itself here; fault injection and the
+        # serving endpoints read it through this slot).
+        self.checkpointer = None
         # Effective-requests pipeline inputs (pkg/workload/resources.go):
         # namespaced LimitRanges, RuntimeClass overheads, namespace labels
         # for CQ namespace-selector admissibility, and the Info options

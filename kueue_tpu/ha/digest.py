@@ -115,7 +115,9 @@ def last_checkpoint(records) -> tuple:
 
 
 def verify_promotion(records, rebuilt_engine,
-                     new_epoch: Optional[int] = None) -> dict:
+                     new_epoch: Optional[int] = None,
+                     base_records: Optional[list] = None,
+                     base_meta=None) -> dict:
     """The promotion gate: given the journal's records (replayed to
     head) and the engine rebuilt from them, prove digest identity
     against the dead leader's last checkpoint.
@@ -123,7 +125,14 @@ def verify_promotion(records, rebuilt_engine,
     Returns a report dict; ``verified`` False means the journal does
     NOT reproduce the checkpointed state — the candidate must fence,
     not lead. ``chain_seed``/``seq_seed`` carry the decision chain
-    forward into the new term's DigestChain."""
+    forward into the new term's DigestChain.
+
+    Checkpoint+suffix boot (store/checkpoint.py): ``records`` is then
+    only the journal SUFFIX, ``base_records`` the sealed checkpoint's
+    payload and ``base_meta`` its header. A sealed checkpoint embeds
+    the same chain/state digests an ``ha_digest`` record carries, so
+    when the suffix holds no ha_digest of its own the verification
+    anchors on the sealed header — same protocol, older anchor."""
     report = {
         "verified": True,
         "checkpoint_seq": None,
@@ -131,11 +140,54 @@ def verify_promotion(records, rebuilt_engine,
         "chain_seed": 0,
         "seq_seed": -1,
         "partial_cycle": False,
+        "source": "journal",
         "rebuilt_state": admitted_state_digest(rebuilt_engine),
         "checkpoint_state": None,
         "reason": "no checkpoint (fresh journal)",
     }
+    base_records = base_records or []
     idx, ckpt = last_checkpoint(records)
+    if ckpt is None and base_meta is not None:
+        # No ha_digest in the suffix: anchor on the sealed checkpoint.
+        report.update({
+            "source": "sealed-checkpoint",
+            "checkpoint_seq": base_meta.seq,
+            "checkpoint_epoch": int(base_meta.epoch),
+            "chain_seed": int(base_meta.chain or "0", 16),
+            "seq_seed": int(base_meta.chain_seq),
+            "checkpoint_state": base_meta.state,
+        })
+        if new_epoch is not None and base_meta.epoch >= new_epoch:
+            report["verified"] = False
+            report["reason"] = (
+                f"fencing violation: sealed checkpoint epoch "
+                f"{base_meta.epoch} >= new epoch {new_epoch}")
+            return report
+        tail_writes = [r for r in records
+                       if r.get("kind") == "workload"]
+        if not tail_writes:
+            ok = report["rebuilt_state"] == base_meta.state
+            report["verified"] = ok
+            report["reason"] = (
+                "digest identity at sealed checkpoint" if ok else
+                f"state digest mismatch: rebuilt "
+                f"{report['rebuilt_state']} != sealed checkpoint "
+                f"{base_meta.state}")
+            return report
+        from kueue_tpu.store.journal import engine_from_records
+
+        prefix_state = admitted_state_digest(
+            engine_from_records(list(base_records)))
+        ok = prefix_state == base_meta.state
+        report["partial_cycle"] = True
+        report["verified"] = ok
+        report["reason"] = (
+            f"sealed-checkpoint prefix digest identity + "
+            f"{len(tail_writes)} adopted partial-cycle record(s)"
+            if ok else
+            f"sealed-checkpoint prefix state digest mismatch: "
+            f"{prefix_state} != {base_meta.state}")
+        return report
     if ckpt is None:
         return report
     obj = ckpt["obj"]
@@ -170,7 +222,7 @@ def verify_promotion(records, rebuilt_engine,
     # violate zero-loss).
     from kueue_tpu.store.journal import engine_from_records
 
-    prefix_engine = engine_from_records(records[:idx + 1])
+    prefix_engine = engine_from_records(base_records + records[:idx + 1])
     prefix_state = admitted_state_digest(prefix_engine)
     ok = prefix_state == obj.get("state")
     report["partial_cycle"] = True

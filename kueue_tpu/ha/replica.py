@@ -53,8 +53,22 @@ class HAReplica:
                  engine_kwargs: Optional[dict] = None,
                  on_promote: Optional[Callable] = None,
                  on_demote: Optional[Callable] = None,
-                 renew_in_background: bool = True):
+                 renew_in_background: bool = True,
+                 checkpoint_interval: int = 0,
+                 checkpoint_keep: int = 2,
+                 segment_rotate_bytes: Optional[int] = None,
+                 segment_rotate_records: Optional[int] = None,
+                 retain_segments: bool = True):
         self.journal_path = journal_path
+        # Bounded-time recovery knobs (store/checkpoint.py): a leader
+        # with checkpoint_interval > 0 writes sealed checkpoints every
+        # N non-idle cycles and rotates the journal into segments;
+        # promotion then boots from checkpoint + suffix.
+        self.checkpoint_interval = int(checkpoint_interval)
+        self.checkpoint_keep = int(checkpoint_keep)
+        self.segment_rotate_bytes = segment_rotate_bytes
+        self.segment_rotate_records = segment_rotate_records
+        self.retain_segments = retain_segments
         self.identity = identity
         self.lease = FencedLease(lease_path)
         self.lease_duration = float(lease_duration)
@@ -70,6 +84,12 @@ class HAReplica:
         self.on_promote = on_promote
         self.on_demote = on_demote
         self.epoch = 0
+        # Bounded submit dedup map: key -> submit time, for in-flight
+        # idempotent-retry acks. Entries are evicted by the post-sync
+        # cycle listener once the admission is durably journaled (from
+        # then on engine.workloads + the journal answer retries), so
+        # the map stays O(in-flight), not O(every name ever submitted).
+        self._inflight_submits: dict = {}
         self.engine = None              # live engine (leader only)
         self.digest_chain: Optional[DigestChain] = None
         self.promotion_report: Optional[dict] = None
@@ -152,16 +172,29 @@ class HAReplica:
     # -- promotion: the replay-verified failover protocol --
 
     def _promote(self, lease_state) -> None:
+        from kueue_tpu.store.checkpoint import recover_records
         from kueue_tpu.store.journal import Journal, engine_from_records
+        from kueue_tpu.store.journal import _key_of as _journal_key_of
 
         self.roles.to(CANDIDATE,
                       f"lease acquired epoch={lease_state.epoch}")
-        # replay() repairs a torn tail (the dead leader's SIGKILL
-        # mid-append) under the journal flock before we read.
-        records = list(Journal(self.journal_path).replay())
-        engine = engine_from_records(records, **self.engine_kwargs)
-        report = verify_promotion(records, engine,
-                                  new_epoch=lease_state.epoch)
+        # Journal() repairs a torn tail (the dead leader's SIGKILL
+        # mid-append) under the journal flock before we read. Recovery
+        # is checkpoint base + suffix when a sealed checkpoint exists
+        # (O(delta) promotion), full genesis replay otherwise — and
+        # verify_promotion proves digest identity either way.
+        reader = Journal(self.journal_path)
+        base, suffix, ckpt_meta = recover_records(reader)
+        if ckpt_meta is None:
+            base, suffix = [], list(reader.replay())
+        reader.close()
+        engine = engine_from_records(base + suffix, **self.engine_kwargs)
+        if ckpt_meta is not None:
+            engine.clock = max(engine.clock, ckpt_meta.clock)
+        report = verify_promotion(suffix, engine,
+                                  new_epoch=lease_state.epoch,
+                                  base_records=base,
+                                  base_meta=ckpt_meta)
         self.promotion_report = report
         if not report["verified"]:
             self.lease.release(self.identity)
@@ -170,14 +203,27 @@ class HAReplica:
                           f"{report['reason']}")
             return
         self.epoch = lease_state.epoch
-        journal = Journal(self.journal_path, fsync=self.fsync)
+        journal = Journal(self.journal_path, fsync=self.fsync,
+                          rotate_bytes=self.segment_rotate_bytes,
+                          rotate_records=self.segment_rotate_records)
         journal.fence = self._write_allowed
+        if base:
+            journal.seed_generations(
+                {(r["kind"], _journal_key_of(r)): int(r.get("gen", 0))
+                 for r in base if r.get("gen")})
         engine.attach_journal(journal, record_existing=False)
         engine.ha = self
         self.digest_chain = DigestChain(
             engine, self.epoch,
             seed_chain=report["chain_seed"],
             seed_seq=report["seq_seed"])
+        if self.checkpoint_interval > 0:
+            from kueue_tpu.store.checkpoint import Checkpointer
+            Checkpointer(engine, interval=self.checkpoint_interval,
+                         keep=self.checkpoint_keep,
+                         retain_segments=self.retain_segments)
+        self._inflight_submits.clear()
+        engine.cycle_listeners.append(self._evict_submit_dedup)
         self.engine = engine
         if self.hub is not None:
             self.hub.attach_engine(engine)
@@ -214,6 +260,7 @@ class HAReplica:
             if self.on_demote is not None:
                 self.on_demote(self.engine, self, reason)
             self.engine = None
+            self._inflight_submits.clear()
 
     def resign(self) -> None:
         """Graceful shutdown handoff: release the lease so a standby
@@ -234,13 +281,17 @@ class HAReplica:
             return {"accepted": False, "code": 503,
                     "reason": f"not leader (role={self.roles.role})",
                     "leaderHint": lease.holder if lease else ""}
-        if workload.key in self.engine.workloads:
+        if (workload.key in self._inflight_submits
+                or workload.key in self.engine.workloads):
             # Idempotent retry: a client that lost its 201 to a leader
             # crash re-POSTs after promotion. The name is the dedup key
             # — re-submitting would reset an already-admitted workload
             # to pending. At-least-once retries + this ack are the
             # exactly-once admission story. Checked before the shedder:
             # a retry of accepted work must not burn bucket tokens.
+            # The in-flight map fronts engine.workloads so dedup stays
+            # correct even while a submission is between accept and
+            # its first durable cycle.
             return {"accepted": True, "code": 200,
                     "workload": workload.name, "deduplicated": True}
         if self.shedder is not None:
@@ -251,8 +302,25 @@ class HAReplica:
                         "retryAfter": verdict["retryAfter"],
                         "factor": verdict["factor"]}
         self.engine.submit(workload)
+        self._inflight_submits[workload.key] = now
         return {"accepted": True, "code": 201,
                 "workload": workload.name}
+
+    def _evict_submit_dedup(self, seq: int, result) -> None:
+        """Post-sync cycle listener (runs AFTER journal.sync, so this
+        cycle's admissions are durable): drop dedup entries whose
+        workload reached a durably-journaled admission or terminal
+        state. Keeps the map O(in-flight)."""
+        if result is None or not self._inflight_submits:
+            return
+        eng = self.engine
+        if eng is None:
+            return
+        for key in list(self._inflight_submits):
+            wl = eng.workloads.get(key)
+            if wl is not None and (wl.is_finished
+                                   or wl.status.admission is not None):
+                del self._inflight_submits[key]
 
     # -- observability --
 
@@ -290,9 +358,12 @@ class HAReplica:
         }
         if self.engine is not None:
             out["stateDigest"] = admitted_state_digest(self.engine)
+            out["inflightSubmits"] = len(self._inflight_submits)
             if self.digest_chain is not None:
                 out["decisionDigest"] = self.digest_chain.digest
                 out["digestSeq"] = self.digest_chain.last_seq
+            if self.engine.checkpointer is not None:
+                out["checkpointer"] = self.engine.checkpointer.status()
         if self.hub is not None:
             out["sse"] = self.hub.stats()
             out["sseClients"] = self.hub.stats()["clients"]

@@ -57,7 +57,9 @@ class AdmissionShedder:
     mis-sizes the bucket by a token, never corrupts it)."""
 
     def __init__(self, rate: float = 200.0, burst: Optional[float] = None,
-                 slo=None, metrics=None, hub=None):
+                 slo=None, metrics=None, hub=None,
+                 retry_jitter: float = 0.5, rng=None):
+        import random
         self.bucket = TokenBucket(rate, burst)
         self.slo = slo
         self.metrics = metrics
@@ -65,6 +67,12 @@ class AdmissionShedder:
         self.accepted = 0
         self.shed = 0
         self.factor = 1.0
+        # Retry-After jitter: every shed client computing the same
+        # deterministic retry delay would re-arrive in one synchronized
+        # wave (thundering herd after a failover). Each 429 gets
+        # base * uniform(1-j, 1+j) instead — same mean, decorrelated.
+        self.retry_jitter = max(0.0, min(1.0, float(retry_jitter)))
+        self._rng = rng if rng is not None else random.Random()
 
     def _factor(self) -> float:
         if self.slo is None:
@@ -100,8 +108,11 @@ class AdmissionShedder:
                 import json
                 self.hub.publish("admission_shed", json.dumps({
                     "reason": reason, "factor": round(self.factor, 4)}))
-        retry = 0.0 if ok else round(
-            1.0 / max(1e-6, self.bucket.rate * self.factor), 3)
+        retry = 0.0
+        if not ok:
+            base = 1.0 / max(1e-6, self.bucket.rate * self.factor)
+            j = self.retry_jitter
+            retry = round(base * self._rng.uniform(1.0 - j, 1.0 + j), 3)
         return {"accepted": ok, "factor": self.factor,
                 "retryAfter": retry}
 

@@ -8,6 +8,20 @@ completes it), folds them into counters, forwards synthesized events
 to the SSE fanout hub, and refreshes a cold-rebuilt read-model engine
 that the HTTP layer serves GETs from.
 
+Segment rotation (store/journal.py): the tailer walks the sealed
+segment chain in ordinal order and follows the active file across
+rotations. A gap (retention deleted a segment the follower hadn't
+consumed — it was asleep past the checkpoint horizon) or a lineage
+change (compaction) forces a full resync through the checkpoint
+recovery path, which is also what ``rebuild()`` uses: checkpoint base
++ journal suffix, O(delta) instead of O(history).
+
+Rebuild throttling is jittered: after each throttled rebuild the next
+one is pushed out by a FULL-JITTER exponential backoff
+(uniform(0, min(cap, base·2^streak))), so N followers that all saw the
+same failover burst don't rebuild — and hammer the shared journal
+volume — in lockstep.
+
 Replay lag is the tailer's headline number: records observed in the
 file but not yet folded into the read model. `kueuectl status` and the
 ``ha_replay_lag_records`` gauge both report it, and promotion latency
@@ -17,20 +31,27 @@ is dominated by draining it to zero.
 from __future__ import annotations
 
 import json
+import random
+import time
 from typing import Optional
 
 
 class JournalTailer:
-    """Incremental reader of a live journal file.
+    """Incremental reader of a live (possibly segmented) journal.
 
     ``poll()`` is cheap and safe to call every tick; the read-model
-    rebuild (a full journal replay) is throttled to at most once per
-    ``rebuild_every`` new records so a chatty leader doesn't make the
-    follower spend its life rebuilding.
+    rebuild (checkpoint + suffix replay) is throttled to at most once
+    per ``rebuild_every`` new records, with full-jitter exponential
+    backoff between consecutive throttled rebuilds.
     """
 
     def __init__(self, path: str, hub=None, metrics=None,
-                 rebuild_every: int = 32, engine_kwargs: Optional[dict] = None):
+                 rebuild_every: int = 32,
+                 engine_kwargs: Optional[dict] = None,
+                 rebuild_backoff_base: float = 0.05,
+                 rebuild_backoff_cap: float = 2.0,
+                 rng: Optional[random.Random] = None,
+                 clock=time.monotonic):
         self.path = path
         self.hub = hub
         self.metrics = metrics
@@ -39,32 +60,127 @@ class JournalTailer:
         self.engine = None          # the read model (None until 1st poll)
         self.records_seen = 0
         self.rebuilds = 0
+        self.resyncs = 0
         self.last_checkpoint: Optional[dict] = None  # last ha_digest obj
+        self._ordinal: Optional[int] = None  # file the offset refers to
         self._offset = 0
+        self._lineage = 0
         self._pending = 0           # records seen since last rebuild
+        # Full-jitter rebuild backoff (anti-thundering-herd): streak
+        # counts consecutive throttled rebuilds; one quiet poll resets.
+        self.rebuild_backoff_base = float(rebuild_backoff_base)
+        self.rebuild_backoff_cap = float(rebuild_backoff_cap)
+        self._rng = rng if rng is not None else random.Random()
+        self._clock = clock
+        self._streak = 0
+        self._cooldown_until = 0.0
 
     @property
     def replay_lag(self) -> int:
         """Records durable in the journal but not in the read model."""
         return self._pending
 
+    # -- segment chain helpers --
+
+    def _segments(self) -> list:
+        from kueue_tpu.store.journal import _file_meta, _sealed_segments
+
+        lineage = self._journal_lineage()
+        out = []
+        for ordinal, seg in _sealed_segments(self.path):
+            meta = _file_meta(seg)
+            if int((meta or {}).get("lineage", 0)) == lineage:
+                out.append((ordinal, seg))
+        return out
+
+    def _journal_lineage(self) -> int:
+        from kueue_tpu.store.journal import _file_meta, _sealed_segments
+
+        meta = _file_meta(self.path)
+        if meta is not None:
+            return int(meta.get("lineage", 0))
+        segs = _sealed_segments(self.path)
+        if segs:
+            m = _file_meta(segs[-1][1])
+            if m is not None:
+                return int(m.get("lineage", 0))
+        return 0
+
+    def _active_ordinal(self, segs: list) -> int:
+        from kueue_tpu.store.journal import _file_meta
+
+        meta = _file_meta(self.path)
+        if meta is not None and "seg" in meta:
+            return int(meta["seg"])
+        return (segs[-1][0] + 1) if segs else 0
+
     def poll(self) -> int:
-        """Consume newly completed journal lines. Returns how many new
-        records were observed (0 when the file hasn't grown)."""
+        """Consume newly completed journal lines across the segment
+        chain. Returns how many new records were observed."""
+        segs = self._segments()
+        sealed = dict(segs)
+        active_ord = self._active_ordinal(segs)
+        lineage = self._journal_lineage()
+        if self._ordinal is None:
+            self._ordinal = segs[0][0] if segs else active_ord
+            self._lineage = lineage
+        elif lineage != self._lineage:
+            # Compaction rewrote history: positions are meaningless.
+            self._resync(active_ord, lineage)
+            return 0
+        new = 0
+        while True:
+            if self._ordinal in sealed:
+                n, _complete = self._consume(sealed[self._ordinal])
+                new += n
+                # Sealed files never grow: move on regardless.
+                self._ordinal += 1
+                self._offset = 0
+                continue
+            if self._ordinal != active_ord:
+                # Gap: retention deleted unread segments (we slept past
+                # the checkpoint horizon) — positions are unrecoverable.
+                self._resync(active_ord, lineage)
+                return new
+            n, _complete = self._consume(self.path)
+            new += n
+            break
+        if new == 0:
+            self._streak = 0
+            self._gauge()
+            return 0
+        self.records_seen += new
+        self._pending += new
+        if self.engine is None:
+            self.rebuild()
+        elif self._pending >= self.rebuild_every:
+            now = self._clock()
+            if now >= self._cooldown_until:
+                self.rebuild()
+                self._streak += 1
+                delay = self._rng.uniform(0.0, min(
+                    self.rebuild_backoff_cap,
+                    self.rebuild_backoff_base * (2.0 ** self._streak)))
+                self._cooldown_until = now + delay
+        self._gauge()
+        return new
+
+    def _consume(self, path: str) -> tuple:
+        """Ingest complete lines of ``path`` past the current offset.
+        Returns (records_ingested, consumed_to_eof)."""
         try:
-            with open(self.path, "rb") as f:
+            with open(path, "rb") as f:
                 f.seek(self._offset)
                 chunk = f.read()
         except FileNotFoundError:
-            return 0
+            return 0, True
         if not chunk:
-            self._gauge()
-            return 0
+            return 0, True
         # Only complete lines: a torn tail stays unconsumed until the
         # leader's next write completes it (or repair truncates it).
         complete = chunk.rfind(b"\n") + 1
         if complete == 0:
-            return 0
+            return 0, False
         new = 0
         for line in chunk[:complete].splitlines():
             if not line.strip():
@@ -73,15 +189,27 @@ class JournalTailer:
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue  # corrupt interior line: repair's problem
+            if rec.get("op") == "meta":
+                continue
             new += 1
             self._ingest(rec)
         self._offset += complete
-        self.records_seen += new
-        self._pending += new
-        if self._pending >= self.rebuild_every or self.engine is None:
-            self.rebuild()
+        return new, complete == len(chunk)
+
+    def _resync(self, active_ord: int, lineage: int) -> None:
+        """Full re-read through the checkpoint recovery path, then
+        fast-forward the tail position to the journal's current end."""
+        self.resyncs += 1
+        self.rebuild()
+        self._lineage = lineage
+        self._ordinal = active_ord
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+            self._offset = data.rfind(b"\n") + 1
+        except FileNotFoundError:
+            self._offset = 0
         self._gauge()
-        return new
 
     def _ingest(self, rec: dict) -> None:
         kind = rec.get("kind")
@@ -103,12 +231,20 @@ class JournalTailer:
             }))
 
     def rebuild(self) -> None:
-        """Refresh the read model: full cold replay, no journal attach
+        """Refresh the read model: checkpoint base + journal suffix
+        (genesis replay when no checkpoint exists), no journal attach
         (followers must never hold a writable journal handle)."""
+        from kueue_tpu.store.checkpoint import recover_records
         from kueue_tpu.store.journal import Journal, engine_from_records
 
-        records = list(Journal(self.path).replay())
+        journal = Journal(self.path)
+        base, suffix, meta = recover_records(journal)
+        records = (base + suffix) if meta is not None \
+            else list(journal.replay())
         self.engine = engine_from_records(records, **self.engine_kwargs)
+        if meta is not None:
+            self.engine.clock = max(self.engine.clock, meta.clock)
+        journal.close()
         self.rebuilds += 1
         self._pending = 0
 
@@ -125,5 +261,6 @@ class JournalTailer:
             "recordsSeen": self.records_seen,
             "replayLag": self.replay_lag,
             "rebuilds": self.rebuilds,
+            "resyncs": self.resyncs,
             "lastCheckpoint": self.last_checkpoint,
         }

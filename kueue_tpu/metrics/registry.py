@@ -298,6 +298,18 @@ class MetricsRegistry:
         c("admission_shed_total", "submissions shed per reason")
         g("admission_shed_factor",
           "current SLO-driven rate factor on the submit token bucket")
+        # Bounded-time recovery (store/checkpoint.py + oracle
+        # supervisor): sealed checkpoint cadence and the device
+        # circuit-breaker lifecycle.
+        c("checkpoints_written_total", "sealed checkpoints written")
+        c("checkpoint_failures_total",
+          "checkpoint write failures per errno name")
+        g("checkpoint_last_seq", "cycle seq of the newest checkpoint")
+        c("oracle_retry_total", "executor call retries per site")
+        c("oracle_breaker_transitions_total",
+          "breaker transitions per (from, to)")
+        g("oracle_breaker_state",
+          "breaker state (0 closed | 1 open | 2 half-open)")
         self.gauge("build_info").set(
             (("name", "kueue_tpu"), ("version", "0.2.0")), 1)
 

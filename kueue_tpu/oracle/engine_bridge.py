@@ -95,7 +95,8 @@ def _flavor_predicate():
 
 
 class OracleBridge:
-    def __init__(self, engine, max_depth: int = 4, executor=None):
+    def __init__(self, engine, max_depth: int = 4, executor=None,
+                 supervisor=None):
         self.engine = engine
         self.max_depth = max_depth
         if executor is None:
@@ -105,6 +106,22 @@ class OracleBridge:
         # standalone oracle service over the socket boundary
         # (service.RemoteExecutor).
         self.executor = executor
+        if supervisor is None:
+            import os
+
+            from kueue_tpu.oracle.supervisor import OracleSupervisor
+            supervisor = OracleSupervisor(
+                metrics=getattr(engine, "registry", None),
+                max_attempts=int(os.environ.get(
+                    "KUEUE_TPU_ORACLE_RETRIES", "3")),
+                threshold=int(os.environ.get(
+                    "KUEUE_TPU_ORACLE_BREAKER_N", "3")),
+                cooldown_cycles=int(os.environ.get(
+                    "KUEUE_TPU_ORACLE_BREAKER_COOLDOWN", "8")))
+        # Retry + circuit breaker around executor calls; breaker-open
+        # cycles are refused up front in try_cycle (host path decides,
+        # digest-identical) until a half-open probe re-promotes.
+        self.supervisor = supervisor
         self.cycles_on_device = 0
         self.cycles_fallback = 0
         self.cycles_hybrid = 0  # device cycles with a host-root tail
@@ -165,6 +182,22 @@ class OracleBridge:
         self.host_root_reasons[reason] = \
             self.host_root_reasons.get(reason, 0) + count
         self._count("oracle_host_root_total", (reason,), count)
+
+    def _exec_call(self, site: str, fn, *args, **kwargs):
+        """Route one executor call through the supervisor: transient
+        RemoteOracleErrors are retried with backoff, a call that still
+        fails feeds the circuit breaker before propagating (the engine
+        falls back sequentially for this cycle either way)."""
+        sup = self.supervisor
+        if sup is None:
+            return fn(*args, **kwargs)
+        try:
+            out = sup.call(site, fn, *args, **kwargs)
+        except Exception:
+            sup.record_failure(self.engine.cycle_seq)
+            raise
+        sup.record_success()
+        return out
 
     def _count(self, family: str, labels: tuple,
                amount: float = 1.0) -> None:
@@ -511,7 +544,8 @@ class OracleBridge:
             root_of_cq=w.root_of_cq)
         if slot_cq is not None:
             tensors["slot_cq"] = slot_cq
-        out = self.executor.classical_targets(
+        out = self._exec_call(
+            "classical_targets", self.executor.classical_targets,
             tensors, {"depth": w.depth, "v_cap": v_cap}, derived=derived)
         found, overflow, mask, _n, variant, borrow_after = out
         return (np.array(found), np.array(overflow), np.array(mask),
@@ -807,6 +841,11 @@ class OracleBridge:
         import jax.numpy as jnp
 
         eng = self.engine
+        if (self.supervisor is not None
+                and not self.supervisor.allow_cycle(eng.cycle_seq)):
+            # Breaker open: the device path is known-bad, skip straight
+            # to the host path without paying retries or timeouts.
+            return self._fallback("breaker-open")
         if not self.world_is_fast_path_safe():
             return self._fallback("world")
 
@@ -1161,7 +1200,8 @@ class OracleBridge:
                        usage=usage, **args, **pre_kwargs)
         if _obs_perf.ACTIVE is not None:
             _obs_perf.device_call("cycle_step", _inputs, statics)
-        out = self.executor.cycle_step(_inputs, statics)
+        out = self._exec_call("cycle_step", self.executor.cycle_step,
+                              _inputs, statics)
         if _obs_perf.ACTIVE is not None:
             _obs_perf.device_result("cycle_step", out)
         (new_pending, new_inadmissible, usage2, wl_admitted, slot_admitted,

@@ -22,25 +22,60 @@ Spec grammar (comma-separated faults):
                            steal the lease at expiry and the stale
                            leader's next journal write must die on
                            JournalFenced, not interleave
+  enospc@cycle:N           every checkpoint write during cycle N fails
+                           with ENOSPC (store.checkpoint.WRITE_FAULT) —
+                           the previous checkpoint must stay the
+                           newest valid one, the engine keeps running
+  torn-checkpoint@cycle:N  truncate the newest sealed checkpoint file
+                           to ~60% as cycle N begins — recovery must
+                           reject it on the payload CRC and fall back
+                           to the previous checkpoint + longer suffix
+  sigkill@compaction:N     SIGKILL inside the Nth journal maintenance
+                           event (segment rotation or compaction), at
+                           the nastiest point: after the rename,
+                           before cleanup/reopen
+  clock-skew@cycle:N:MS    jump the engine clock forward MS ms at
+                           cycle N (NTP step / VM freeze-thaw): every
+                           decision downstream of the skewed stamps
+                           must still replay identically from the
+                           journal
+  oracle-crash-storm@cycle:N:M
+                           the executor raises transport errors for M
+                           CONSECUTIVE cycles starting at N — long
+                           enough to trip the supervisor's circuit
+                           breaker (oracle/supervisor.py), which must
+                           demote to the host path and re-promote
+                           after the storm, digest-identical
 
 The recovery contract these faults exist to prove: reboot via
 store.journal.rebuild_engine and drain, and the admitted set equals an
 uninterrupted run's — zero lost, zero duplicate admissions.
+
+``ChaosSchedule`` expands one integer seed into a deterministic
+multi-stage fault plan over those kinds (tools/chaos_smoke.py runs a
+batch of seeds and asserts the recovery contract after every stage).
 """
 
 from __future__ import annotations
 
 import os
+import random
 import signal
 from dataclasses import dataclass, field
+
+KINDS = ("sigkill", "torn-tail", "oracle-crash", "delay-verdict",
+         "lease-stall", "enospc", "torn-checkpoint", "clock-skew",
+         "oracle-crash-storm")
+POINTS = ("cycle", "admission", "compaction")
 
 
 @dataclass
 class Fault:
-    kind: str        # sigkill | torn-tail | oracle-crash | delay-verdict
-    at: str          # cycle | admission
-    n: int           # trigger point (cycle seq or admission ordinal)
-    arg: float = 0.0  # delay-verdict: milliseconds
+    kind: str        # one of KINDS
+    at: str          # cycle | admission | compaction
+    n: int           # trigger point (cycle seq / admission ordinal /
+                     # maintenance-event ordinal)
+    arg: float = 0.0  # delay-verdict + clock-skew: ms; storm: cycles
 
 
 @dataclass
@@ -60,16 +95,38 @@ class FaultPlan:
                 raise ValueError(
                     f"bad fault spec {part!r} "
                     "(want kind@cycle:N or kind@admission:N)") from None
-            if kind not in ("sigkill", "torn-tail", "oracle-crash",
-                            "delay-verdict", "lease-stall"):
+            if kind not in KINDS:
                 raise ValueError(f"unknown fault kind {kind!r}")
-            if at not in ("cycle", "admission"):
+            if at not in POINTS:
                 raise ValueError(f"unknown fault point {at!r}")
-            if at == "admission" and kind != "sigkill":
+            if at != "cycle" and kind != "sigkill":
                 raise ValueError(
                     f"{kind} only triggers at cycle boundaries")
+            if kind == "clock-skew" and len(bits) < 3:
+                raise ValueError(
+                    "clock-skew needs a skew: clock-skew@cycle:N:MS")
+            if kind == "oracle-crash-storm" and (
+                    len(bits) < 3 or arg < 1 or arg != int(arg)):
+                raise ValueError(
+                    "oracle-crash-storm needs a whole cycle count "
+                    ">= 1: oracle-crash-storm@cycle:N:M")
+            if kind == "delay-verdict" and arg < 0:
+                raise ValueError("delay-verdict delay must be >= 0 ms")
             plan.faults.append(Fault(kind, at, n, arg))
         return plan
+
+    @property
+    def lethal(self) -> bool:
+        """True when some fault SIGKILLs the process (the plan's worker
+        is expected to die rather than drain to completion)."""
+        return any(f.kind in ("sigkill", "torn-tail")
+                   for f in self.faults)
+
+    @property
+    def needs_oracle(self) -> bool:
+        return any(f.kind in ("oracle-crash", "delay-verdict",
+                              "oracle-crash-storm")
+                   for f in self.faults)
 
 
 def _die() -> None:
@@ -85,6 +142,12 @@ def _tear_journal_tail(journal) -> None:
         fh.write(b'{"op":"apply","kind":"workload","ts":9')
         fh.flush()
         os.fsync(fh.fileno())
+
+
+def _enospc(fh) -> None:
+    """store.checkpoint.WRITE_FAULT payload: the disk is full."""
+    import errno
+    raise OSError(errno.ENOSPC, "injected: no space left on device")
 
 
 class _ExecutorFaultProxy:
@@ -130,11 +193,22 @@ class FaultInjector:
         self.engine = engine
         self.plan = plan
         self.admissions = 0
+        self.maintenance_events = 0
         self.fired: list[str] = []
         self.proxy = None
+        self._enospc_until = None
+        # Storm coverage: [start, end) cycle ranges the executor stays
+        # crashed through (vs the single-cycle oracle-crash, which the
+        # post-cycle "sidecar restart" clears).
+        self._storms = [(f.n, f.n + int(f.arg)) for f in plan.faults
+                        if f.kind == "oracle-crash-storm"]
         self._kill_at_admission = min(
             (f.n for f in plan.faults
              if f.kind == "sigkill" and f.at == "admission"),
+            default=None)
+        self._kill_at_maintenance = min(
+            (f.n for f in plan.faults
+             if f.kind == "sigkill" and f.at == "compaction"),
             default=None)
         engine.pre_cycle_hooks.append(self._pre_cycle)
         engine.cycle_listeners.append(self._post_cycle)
@@ -147,8 +221,18 @@ class FaultInjector:
                 if self.admissions == self._kill_at_admission:
                     _die()
             engine._admit = admit_and_maybe_die
-        if any(f.kind in ("oracle-crash", "delay-verdict")
-               for f in plan.faults):
+        if self._kill_at_maintenance is not None:
+            from kueue_tpu.store import journal as _journal_mod
+
+            def die_in_maintenance(event: str) -> None:
+                self.maintenance_events += 1
+                if self.maintenance_events == self._kill_at_maintenance:
+                    self.fired.append(
+                        f"sigkill@compaction:{self.maintenance_events}"
+                        f" ({event})")
+                    _die()
+            _journal_mod.MAINTENANCE_CRASH_HOOK = die_in_maintenance
+        if plan.needs_oracle:
             self._ensure_proxy()
 
     def _ensure_proxy(self):
@@ -161,7 +245,30 @@ class FaultInjector:
             bridge.executor = _ExecutorFaultProxy(bridge.executor)
         self.proxy = bridge.executor
 
+    def _storm_covers(self, seq: int) -> bool:
+        return any(start <= seq < end for start, end in self._storms)
+
+    def _tear_newest_checkpoint(self, engine) -> None:
+        ck = getattr(engine, "checkpointer", None)
+        if ck is None:
+            raise RuntimeError(
+                "torn-checkpoint fault needs an attached Checkpointer")
+        files = ck.store._indexed()
+        if not files:
+            return  # nothing sealed yet; the fault is a no-op
+        path = files[-1][1]
+        size = os.path.getsize(path)
+        with open(path, "r+b") as fh:
+            fh.truncate(max(1, int(size * 0.6)))
+
     def _pre_cycle(self, seq: int, engine) -> None:
+        if self._enospc_until is not None and seq >= self._enospc_until:
+            # Cleared at the NEXT cycle's start, not post-cycle: the
+            # Checkpointer writes from a cycle listener that may run
+            # after ours, and the fault must cover it.
+            from kueue_tpu.store import checkpoint as _ckpt
+            _ckpt.WRITE_FAULT = None
+            self._enospc_until = None
         for f in self.plan.faults:
             if f.at != "cycle" or f.n != seq:
                 continue
@@ -177,9 +284,25 @@ class FaultInjector:
             elif f.kind == "oracle-crash":
                 self.proxy.crashed = True
                 self.fired.append(f"oracle-crash@cycle:{seq}")
+            elif f.kind == "oracle-crash-storm":
+                self.proxy.crashed = True
+                self.fired.append(
+                    f"oracle-crash-storm@cycle:{seq}:{int(f.arg)}")
             elif f.kind == "delay-verdict":
                 self.proxy.delay_ms = f.arg
                 self.fired.append(f"delay-verdict@cycle:{seq}")
+            elif f.kind == "enospc":
+                from kueue_tpu.store import checkpoint as _ckpt
+                _ckpt.WRITE_FAULT = _enospc
+                self._enospc_until = seq + 1
+                self.fired.append(f"enospc@cycle:{seq}")
+            elif f.kind == "torn-checkpoint":
+                self._tear_newest_checkpoint(engine)
+                self.fired.append(f"torn-checkpoint@cycle:{seq}")
+            elif f.kind == "clock-skew":
+                engine.clock += f.arg / 1e3
+                self.fired.append(
+                    f"clock-skew@cycle:{seq}:{f.arg:g}")
             elif f.kind == "lease-stall":
                 if engine.ha is None:
                     raise RuntimeError(
@@ -190,9 +313,10 @@ class FaultInjector:
 
     def _post_cycle(self, seq: int, result) -> None:
         # Transient faults clear at the cycle's end: the sidecar
-        # "restarts" and the next cycle reconnects.
+        # "restarts" and the next cycle reconnects. A storm holds the
+        # crash through its whole [start, end) range.
         if self.proxy is not None:
-            self.proxy.crashed = False
+            self.proxy.crashed = self._storm_covers(seq + 1)
             self.proxy.delay_ms = 0.0
 
 
@@ -200,3 +324,81 @@ def arm_faults(engine, plan) -> FaultInjector:
     if isinstance(plan, str):
         plan = FaultPlan.parse(plan)
     return FaultInjector(engine, plan)
+
+
+@dataclass
+class ChaosStage:
+    """One worker process's life: a fault spec, how many drain cycles
+    it gets, and whether the plan is expected to SIGKILL it."""
+    spec: str
+    cycles: int
+    lethal: bool
+    needs_oracle: bool
+
+
+class ChaosSchedule:
+    """Expand one integer seed into a deterministic multi-stage,
+    multi-fault plan (tools/chaos_smoke.py's input).
+
+    Stage = one worker process: it reboots from the journal
+    (checkpoint base + suffix when one exists), drains under its fault
+    plan, and either dies (lethal stage — the next stage is the crash
+    recovery) or drains clean. Every stage before the last is lethal so
+    each seed exercises a chain of crash/recover transitions; the final
+    stage always runs fault-free to completion so the terminal state is
+    comparable with the control arm. Cycle numbers restart per process
+    (Engine.cycle_seq starts at 0 after every reboot), so each stage's
+    triggers are drawn independently in [1, cycles).
+
+    Same seed → byte-identical stages; replay/ is outside graftlint's
+    determinism zones precisely so seeded PRNG expansion like this is
+    legal here.
+    """
+
+    LETHAL = ("sigkill@cycle:{n}",
+              "sigkill@admission:{adm}",
+              "torn-tail@cycle:{n}",
+              "sigkill@compaction:{maint}")
+    BENIGN = ("oracle-crash@cycle:{n}",
+              "oracle-crash-storm@cycle:{n}:{m}",
+              "enospc@cycle:{n}",
+              "torn-checkpoint@cycle:{n}",
+              "clock-skew@cycle:{n}:{ms}")
+
+    def __init__(self, seed: int, stages: int = 3,
+                 cycles_per_stage: int = 24, oracle: bool = True):
+        self.seed = int(seed)
+        self.n_stages = max(2, int(stages))
+        self.cycles_per_stage = max(8, int(cycles_per_stage))
+        self.oracle = oracle
+
+    def stages(self) -> list:
+        rng = random.Random(self.seed)
+        benign = [t for t in self.BENIGN
+                  if self.oracle or not t.startswith("oracle")]
+        out = []
+        for i in range(self.n_stages):
+            last = i == self.n_stages - 1
+            faults = []
+            if not last:
+                lethal_at = rng.randrange(
+                    self.cycles_per_stage // 2, self.cycles_per_stage)
+                for tmpl in rng.sample(benign, rng.randrange(0, 3)):
+                    # Benign faults land strictly before the lethal one
+                    # so they demonstrably fire.
+                    faults.append(tmpl.format(
+                        n=rng.randrange(1, max(2, lethal_at)),
+                        m=rng.randrange(2, 6),
+                        ms=rng.choice([250, 1000, 5000])))
+                faults.append(rng.choice(self.LETHAL).format(
+                    n=lethal_at, adm=rng.randrange(2, 9),
+                    maint=rng.randrange(1, 4)))
+            spec = ",".join(faults)
+            plan = FaultPlan.parse(spec)
+            out.append(ChaosStage(
+                spec=spec, cycles=self.cycles_per_stage,
+                lethal=plan.lethal or any(
+                    f.at in ("admission", "compaction")
+                    for f in plan.faults),
+                needs_oracle=plan.needs_oracle))
+        return out

@@ -36,6 +36,17 @@ Environment:
                            (kueue_tpu/ha). Related flags: --replica-id,
                            --lease, --lease-duration, --shed-rate,
                            --fanout-shards
+  KUEUE_TPU_CKPT_INTERVAL  sealed-checkpoint cadence in non-idle cycles
+                           (--checkpoint-interval; 0 = off). With a
+                           checkpoint on disk, restart/promotion boots
+                           from checkpoint + journal suffix instead of a
+                           full genesis replay (store/checkpoint.py)
+  KUEUE_TPU_CKPT_KEEP      checkpoints retained (--checkpoint-keep)
+  KUEUE_TPU_SEGMENT_RECORDS / KUEUE_TPU_SEGMENT_BYTES
+                           journal segment-rotation thresholds
+                           (--segment-records / --segment-bytes; 0 =
+                           off). Sealed segments older than the oldest
+                           live checkpoint are reclaimed by retention
 """
 
 from __future__ import annotations
@@ -82,6 +93,26 @@ def main(argv=None) -> None:
     parser.add_argument("--fanout-shards", type=int,
                         default=int(os.environ.get(
                             "KUEUE_TPU_FANOUT_SHARDS", "4")))
+    parser.add_argument("--checkpoint-interval", type=int,
+                        default=int(os.environ.get(
+                            "KUEUE_TPU_CKPT_INTERVAL", "0")),
+                        help="write a sealed checkpoint every N non-idle"
+                             " cycles (0 = off); restart then boots from"
+                             " checkpoint + journal suffix")
+    parser.add_argument("--checkpoint-keep", type=int,
+                        default=int(os.environ.get(
+                            "KUEUE_TPU_CKPT_KEEP", "2")),
+                        help="how many sealed checkpoints to retain")
+    parser.add_argument("--segment-records", type=int,
+                        default=int(os.environ.get(
+                            "KUEUE_TPU_SEGMENT_RECORDS", "0")),
+                        help="roll the journal into a sealed segment"
+                             " every N records (0 = off)")
+    parser.add_argument("--segment-bytes", type=int,
+                        default=int(os.environ.get(
+                            "KUEUE_TPU_SEGMENT_BYTES", "0")),
+                        help="roll the journal into a sealed segment"
+                             " past N bytes (0 = off)")
     args = parser.parse_args(argv)
 
     from kueue_tpu.store.journal import rebuild_engine
@@ -91,8 +122,17 @@ def main(argv=None) -> None:
         _main_ha(args)
         return
 
-    # rebuild_engine re-attaches the journal for continued writes.
-    eng = rebuild_engine(args.journal)
+    # rebuild_engine re-attaches the journal for continued writes and
+    # (when a sealed checkpoint exists) boots from checkpoint + suffix
+    # instead of a full genesis replay — the bounded-time restart.
+    eng = rebuild_engine(
+        args.journal,
+        journal_kwargs={"rotate_records": args.segment_records,
+                        "rotate_bytes": args.segment_bytes})
+    if args.checkpoint_interval > 0:
+        from kueue_tpu.store.checkpoint import Checkpointer
+        Checkpointer(eng, interval=args.checkpoint_interval,
+                     keep=args.checkpoint_keep)
     if args.oracle == "local":
         eng.attach_oracle()
     elif args.oracle != "off":
@@ -203,7 +243,11 @@ def _main_ha(args) -> None:
     replica = HAReplica(
         args.journal, lease_path, identity,
         lease_duration=args.lease_duration,
-        hub=hub, shedder=shedder, on_promote=on_promote)
+        hub=hub, shedder=shedder, on_promote=on_promote,
+        checkpoint_interval=args.checkpoint_interval,
+        checkpoint_keep=args.checkpoint_keep,
+        segment_rotate_records=args.segment_records or None,
+        segment_rotate_bytes=args.segment_bytes or None)
 
     host, _, port = args.http.rpartition(":")
     endpoint = ServingEndpoint(

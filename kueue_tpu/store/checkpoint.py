@@ -1,0 +1,436 @@
+"""Sealed state checkpoints: bounded-time recovery for the journal.
+
+A checkpoint is an atomic snapshot of the engine's durable state —
+the same record shapes ``Journal.apply`` writes, folded to one record
+per live (kind, key) — plus a sealed header binding it to a precise
+journal position and to the HA digest chain:
+
+  line 1   header JSON: schema version, cycle seq, journal position
+           (lineage / segment ordinal / line offset), engine clock,
+           decision-chain digest + seq + epoch (when an HA DigestChain
+           is attached), admitted-state digest, payload record count,
+           and a CRC-32 over the payload bytes
+  line 2+  one JSON record per live key (apply records, creation order)
+
+Atomicity is temp-file + flush + fsync + rename (+ directory fsync):
+a crash mid-write leaves only a ``.tmp`` that recovery never reads. A
+torn or corrupt checkpoint (truncated payload, CRC mismatch, record
+count short) is DETECTED — recovery skips it and falls back to the
+previous checkpoint, and with none left to the full genesis replay.
+
+Self-verification: the header's ``state`` field is the
+order-canonical admitted-state digest (ha/digest.py) of the engine at
+snapshot time, and ``chain``/``chain_seq``/``epoch`` carry the
+decision-chain checkpoint the leader's DigestChain had journaled that
+same cycle — so HA promotion can verify a checkpoint+suffix boot with
+the exact protocol it uses against ``ha_digest`` records
+(verify_promotion's ``base_meta``), and a cold rebuild can prove
+digest identity against a full-genesis replay.
+
+Recovery contract (recover_records): base + suffix is record-for-record
+equivalent to the genesis stream for engine_from_records — the fold
+keeps the last record per key in first-seen order and drops
+tombstoned keys, exactly the invariant Journal.compact maintains — so
+the fast path is byte-identical to the slow one, in O(live state +
+delta-since-checkpoint) instead of O(history).
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import zlib
+from dataclasses import dataclass
+from typing import Optional
+
+from kueue_tpu.store.journal import (
+    Journal,
+    JournalCorruption,
+    _key_of,
+)
+
+CKPT_VERSION = 1
+_PREFIX = "ckpt-"
+_SUFFIX = ".json"
+
+# Fault hook (replay/faults.py enospc): called with the open temp-file
+# handle mid-write; the fault implementation writes a partial payload
+# and raises OSError(ENOSPC), proving the abort path leaves the
+# previous checkpoint untouched.
+WRITE_FAULT = None
+
+
+@dataclass
+class CheckpointMeta:
+    """Parsed checkpoint header + where it lives on disk."""
+
+    path: str
+    index: int              # monotonic file index (newest = highest)
+    seq: int                # engine cycle seq at snapshot time
+    lineage: int            # journal lineage the position belongs to
+    segment: int            # active-file ordinal at snapshot time
+    offset: int             # complete lines of that file at snapshot
+    clock: float            # engine clock (compaction can fold away
+    #                         the max-ts record; recovery needs this)
+    chain: Optional[str]    # decision-chain digest (hex) or None
+    chain_seq: int          # last seq folded into the chain (-1 none)
+    epoch: int              # HA lease epoch (0 outside HA)
+    state: str              # admitted-state digest at snapshot time
+    records: int            # payload record count
+    payload_crc: str        # CRC-32 (hex) over the payload bytes
+
+    @property
+    def position(self) -> dict:
+        return {"lineage": self.lineage, "segment": self.segment,
+                "offset": self.offset}
+
+
+class CheckpointStore:
+    """The checkpoint directory next to a journal:
+    ``<journal>.ckpt/ckpt-<NNNNNN>.json``."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+
+    @classmethod
+    def for_journal(cls, journal_path: str) -> "CheckpointStore":
+        return cls(journal_path + ".ckpt")
+
+    # -- enumeration --
+
+    def _indexed(self) -> list:
+        """Sorted [(index, path)] of sealed checkpoint files."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return out
+        for name in names:
+            if (name.startswith(_PREFIX) and name.endswith(_SUFFIX)
+                    and name[len(_PREFIX):-len(_SUFFIX)].isdigit()):
+                out.append((int(name[len(_PREFIX):-len(_SUFFIX)]),
+                            os.path.join(self.directory, name)))
+        out.sort()
+        return out
+
+    def load(self, index: int, path: str):
+        """(meta, payload_records) for one checkpoint file, or None if
+        it is torn/corrupt in any way — the caller falls back."""
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+        except OSError:
+            return None
+        head, _, payload = data.partition(b"\n")
+        try:
+            hdr = json.loads(head)
+        except json.JSONDecodeError:
+            return None
+        if hdr.get("v") != CKPT_VERSION:
+            return None
+        if f"{zlib.crc32(payload):08x}" != hdr.get("payload_crc"):
+            return None
+        records = []
+        for line in payload.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                return None
+        if len(records) != int(hdr.get("records", -1)):
+            return None
+        from kueue_tpu.api.conversion import upgrade_record
+        records = [upgrade_record(r) for r in records]
+        meta = CheckpointMeta(
+            path=path, index=index, seq=int(hdr.get("seq", 0)),
+            lineage=int(hdr.get("lineage", 0)),
+            segment=int(hdr.get("segment", 0)),
+            offset=int(hdr.get("offset", 0)),
+            clock=float(hdr.get("clock", 0.0)),
+            chain=hdr.get("chain"),
+            chain_seq=int(hdr.get("chain_seq", -1)),
+            epoch=int(hdr.get("epoch", 0)),
+            state=str(hdr.get("state", "")),
+            records=len(records),
+            payload_crc=str(hdr.get("payload_crc", "")))
+        return meta, records
+
+    def iter_valid(self):
+        """Yield (meta, records) newest-first, silently skipping every
+        torn/corrupt file — the fallback walk."""
+        for index, path in reversed(self._indexed()):
+            loaded = self.load(index, path)
+            if loaded is not None:
+                yield loaded
+
+    def live_metas(self) -> list:
+        """Headers of all currently valid checkpoints (newest first)."""
+        return [meta for meta, _records in self.iter_valid()]
+
+    # -- writing --
+
+    def write(self, engine, seq: Optional[int] = None) -> CheckpointMeta:
+        """Snapshot the engine behind its attached journal. Raises
+        OSError on write failure (ENOSPC et al.) AFTER removing the
+        temp file — the previous checkpoint stays the latest valid."""
+        journal = engine.journal
+        if journal is None:
+            raise ValueError("checkpoint needs an attached journal")
+        position = journal.position()
+        records = _snapshot_records(engine, journal)
+        payload = b"".join(
+            json.dumps(r).encode("utf-8") + b"\n" for r in records)
+        from kueue_tpu.ha.digest import admitted_state_digest
+        chain = None
+        chain_seq = -1
+        epoch = 0
+        dc = getattr(getattr(engine, "ha", None), "digest_chain", None)
+        if dc is not None:
+            chain, chain_seq, epoch = dc.digest, dc.last_seq, dc.epoch
+        hdr = {
+            "v": CKPT_VERSION,
+            "seq": int(seq if seq is not None else engine.cycle_seq),
+            "lineage": position["lineage"],
+            "segment": position["segment"],
+            "offset": position["offset"],
+            "clock": float(engine.clock),
+            "chain": chain,
+            "chain_seq": chain_seq,
+            "epoch": epoch,
+            "state": admitted_state_digest(engine),
+            "records": len(records),
+            "payload_crc": f"{zlib.crc32(payload):08x}",
+        }
+        os.makedirs(self.directory, exist_ok=True)
+        indexed = self._indexed()
+        index = (indexed[-1][0] + 1) if indexed else 1
+        final = os.path.join(self.directory,
+                             f"{_PREFIX}{index:06d}{_SUFFIX}")
+        tmp = final + ".tmp"
+        try:
+            with open(tmp, "wb") as fh:
+                fh.write(json.dumps(hdr).encode("utf-8") + b"\n")
+                if WRITE_FAULT is not None:
+                    WRITE_FAULT(fh)
+                fh.write(payload)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, final)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        self._dir_sync()
+        loaded = self.load(index, final)
+        if loaded is None:  # unreadable right after rename: disk lies
+            raise OSError(errno.EIO, f"checkpoint unreadable: {final}")
+        return loaded[0]
+
+    def _dir_sync(self) -> None:
+        try:
+            fd = os.open(self.directory, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def retain(self, keep: int = 2) -> int:
+        """Keep the newest ``keep`` checkpoint files (valid or not —
+        a corrupt newest file must not evict the good one before it,
+        so retention counts files, newest first). Returns removed."""
+        removed = 0
+        indexed = self._indexed()
+        for _index, path in indexed[:-keep] if keep > 0 else indexed:
+            try:
+                os.remove(path)
+                removed += 1
+            except FileNotFoundError:
+                pass
+        return removed
+
+
+def _snapshot_records(engine, journal) -> list:
+    """The engine's durable state as apply records — the same
+    enumeration (and order) Engine.attach_journal(record_existing=True)
+    journals, so replaying the payload rebuilds the same engine."""
+    from kueue_tpu.api.conversion import SCHEMA_VERSION
+    from kueue_tpu.api.serde import to_jsonable
+
+    journal.refresh()
+
+    def rec(kind, obj):
+        r = {"op": "apply", "kind": kind, "ts": engine.clock,
+             "v": SCHEMA_VERSION, "obj": to_jsonable(obj)}
+        r["gen"] = journal._generations.get((kind, _key_of(r)), 0)
+        return r
+
+    out = []
+    for cohort in engine.cache.cohorts.values():
+        out.append(rec("cohort", cohort))
+    for rf in engine.cache.resource_flavors.values():
+        out.append(rec("resource_flavor", rf))
+    for cq in engine.cache.cluster_queues.values():
+        out.append(rec("cluster_queue", cq))
+    for lq in engine.queues.local_queues.values():
+        out.append(rec("local_queue", lq))
+    for topo in engine.cache.topologies.values():
+        out.append(rec("topology", topo))
+    for node in engine.cache.nodes.values():
+        out.append(rec("node", node))
+    for name, value in engine.workload_priority_classes.items():
+        out.append(rec("workload_priority_class",
+                       {"name": name, "value": value}))
+    for wl in engine.workloads.values():
+        out.append(rec("workload", wl))
+    return out
+
+
+def recover_records(journal: Journal):
+    """The bounded-time recovery read path: ``(base, suffix, meta)``.
+
+    Walks checkpoints newest-first; the first one that (a) loads clean
+    (CRC + count), (b) matches the journal's lineage, and (c) yields a
+    readable suffix wins. ``meta`` None means no usable checkpoint —
+    the caller replays from genesis."""
+    store = CheckpointStore.for_journal(journal.path)
+    for meta, base in store.iter_valid():
+        if meta.lineage != journal.lineage:
+            continue
+        try:
+            suffix = list(journal.replay_from(meta.position))
+        except (ValueError, JournalCorruption):
+            continue
+        return base, suffix, meta
+    return [], [], None
+
+
+def recover_engine(journal_path: str, engine_kwargs: Optional[dict] = None,
+                   prove_genesis: bool = False):
+    """Build an engine via checkpoint+suffix (falling back to genesis)
+    and return ``(engine, report)``. With ``prove_genesis`` the slow
+    path is ALSO replayed and the two admitted-state digests compared —
+    the fast-path-is-byte-identical proof the chaos smoke asserts."""
+    from kueue_tpu.ha.digest import admitted_state_digest
+    from kueue_tpu.store.journal import engine_from_records
+
+    journal = Journal(journal_path)
+    base, suffix, meta = recover_records(journal)
+    records = (base + suffix) if meta is not None \
+        else list(journal.replay())
+    eng = engine_from_records(records, **(engine_kwargs or {}))
+    if meta is not None:
+        eng.clock = max(eng.clock, meta.clock)
+    report = {
+        "source": "checkpoint" if meta is not None else "genesis",
+        "checkpoint": None if meta is None else {
+            "path": meta.path, "seq": meta.seq,
+            "segment": meta.segment, "offset": meta.offset,
+            "state": meta.state},
+        "base_records": len(base),
+        "suffix_records": len(suffix) if meta is not None else len(records),
+        "state": admitted_state_digest(eng),
+    }
+    if prove_genesis:
+        genesis = engine_from_records(list(journal.replay()),
+                                      **(engine_kwargs or {}))
+        report["genesis_state"] = admitted_state_digest(genesis)
+        report["identical"] = report["genesis_state"] == report["state"]
+    return eng, report
+
+
+class Checkpointer:
+    """Leader-side periodic checkpoint writer, attached to
+    ``engine.cycle_listeners`` — it runs AFTER journal.sync(), so every
+    record the snapshot position covers is already durable. Also owns
+    retention: old checkpoints beyond ``keep`` are deleted, and (with
+    ``retain_segments``) sealed journal segments fully covered by the
+    oldest live checkpoint go with them."""
+
+    def __init__(self, engine, interval: int = 64, keep: int = 2,
+                 retain_segments: bool = True,
+                 store: Optional[CheckpointStore] = None):
+        if engine.journal is None:
+            raise ValueError("Checkpointer needs an attached journal")
+        self.engine = engine
+        self.interval = max(1, int(interval))
+        self.keep = max(1, int(keep))
+        self.retain_segments = retain_segments
+        self.store = store or CheckpointStore.for_journal(
+            engine.journal.path)
+        self.written = 0
+        self.failures = 0
+        self.last_meta: Optional[CheckpointMeta] = None
+        self._since = 0
+        self._hook = self._on_cycle
+        engine.cycle_listeners.append(self._hook)
+        engine.checkpointer = self
+
+    def _on_cycle(self, seq: int, result) -> None:
+        if result is None:
+            return  # idle tick: nothing new to cover
+        self._since += 1
+        if self._since >= self.interval:
+            self.checkpoint(seq)
+
+    def checkpoint(self, seq: Optional[int] = None):
+        """Write one checkpoint now. Failure (ENOSPC, torn disk) is
+        counted and absorbed: the previous checkpoint remains the
+        recovery base, and the next interval retries."""
+        self._since = 0
+        try:
+            meta = self.store.write(self.engine, seq)
+        except OSError as e:
+            self.failures += 1
+            self._count("checkpoint_failures_total",
+                        (errno.errorcode.get(e.errno, "OS"),))
+            return None
+        self.written += 1
+        self.last_meta = meta
+        self._count("checkpoints_written_total", ())
+        self._gauge("checkpoint_last_seq", float(meta.seq))
+        self.store.retain(self.keep)
+        if self.retain_segments:
+            live = [m for m in self.store.live_metas()
+                    if m.lineage == self.engine.journal.lineage]
+            if live:
+                self.engine.journal.retain_segments(
+                    min(m.segment for m in live))
+        return meta
+
+    def detach(self) -> None:
+        try:
+            self.engine.cycle_listeners.remove(self._hook)
+        except ValueError:
+            pass
+        if getattr(self.engine, "checkpointer", None) is self:
+            self.engine.checkpointer = None
+
+    def _count(self, family: str, labels: tuple) -> None:
+        try:
+            self.engine.registry.counter(family).inc(labels)
+        except KeyError:
+            pass
+
+    def _gauge(self, family: str, value: float) -> None:
+        try:
+            self.engine.registry.gauge(family).set((), value)
+        except KeyError:
+            pass
+
+    def status(self) -> dict:
+        return {
+            "written": self.written,
+            "failures": self.failures,
+            "interval": self.interval,
+            "keep": self.keep,
+            "lastSeq": None if self.last_meta is None
+            else self.last_meta.seq,
+            "lastPath": None if self.last_meta is None
+            else self.last_meta.path,
+        }

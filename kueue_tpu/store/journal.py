@@ -24,6 +24,22 @@ cycles; a truncated or corrupt final line is trimmed on reattach and
 ignored on replay, mirroring at-least-once status patching, while
 corruption anywhere else raises (silent record loss is worse than a
 failed restart).
+
+Segment rotation (bounded-time recovery): with ``rotate_bytes`` /
+``rotate_records`` set, ``sync()`` seals the active file as
+``<path>.seg<NNNNNN>`` once it crosses a threshold and reopens a fresh
+active file whose FIRST line is a ``meta`` control record carrying the
+ordinal the new active will take when sealed and the journal's
+*lineage*. The logical journal is the concatenation of the
+lineage-matching sealed segments (ordinal order) and the active file;
+``replay()`` walks exactly that. Compaction bumps the lineage, which
+atomically invalidates every sealed segment (and every checkpoint —
+store/checkpoint.py pins the lineage it snapshotted) left behind by a
+crash mid-cleanup: a stale segment is excluded by its old lineage, not
+by a cleanup step that might never have run. Sealed segments older
+than the oldest live checkpoint are deleted by ``retain_segments``;
+``replay_from`` yields only the records past a checkpoint's
+(lineage, segment, offset) position — the O(delta) recovery path.
 """
 
 from __future__ import annotations
@@ -33,6 +49,53 @@ import os
 from typing import Iterator, Optional
 
 from kueue_tpu.api.serde import from_jsonable, to_jsonable
+
+# Crash hook for fault injection (replay/faults.py sigkill@compaction):
+# called with "rotate" / "compact" at the nastiest point of the
+# maintenance operation — after the rename/replace, before cleanup and
+# reopen — so recovery is proven against a half-finished maintenance
+# pass, not just a half-written record.
+MAINTENANCE_CRASH_HOOK = None
+
+_SEG_WIDTH = 6
+_META_KIND = "__journal__"
+
+
+def _segment_path(path: str, ordinal: int) -> str:
+    return f"{path}.seg{ordinal:0{_SEG_WIDTH}d}"
+
+
+def _sealed_segments(path: str) -> list:
+    """Sorted [(ordinal, segpath)] of the sealed segment files."""
+    base = os.path.basename(path) + ".seg"
+    d = os.path.dirname(path) or "."
+    out = []
+    try:
+        names = os.listdir(d)
+    except FileNotFoundError:
+        return out
+    for name in names:
+        if name.startswith(base) and name[len(base):].isdigit():
+            out.append((int(name[len(base):]), os.path.join(d, name)))
+    out.sort()
+    return out
+
+
+def _file_meta(path: str) -> Optional[dict]:
+    """The ``meta`` control record on a journal file's FIRST line, or
+    None (genesis files predate rotation and carry none)."""
+    try:
+        with open(path, "rb") as fh:
+            line = fh.readline(1 << 16)
+    except FileNotFoundError:
+        return None
+    if not line.endswith(b"\n"):
+        return None
+    try:
+        rec = json.loads(line)
+    except json.JSONDecodeError:
+        return None
+    return rec if rec.get("op") == "meta" else None
 
 
 class JournalCorruption(Exception):
@@ -77,9 +140,15 @@ class Journal:
     O_APPEND single-write records, so concurrent writers interleave at
     record granularity."""
 
-    def __init__(self, path: str, fsync: bool = False):
+    def __init__(self, path: str, fsync: bool = False,
+                 rotate_bytes: Optional[int] = None,
+                 rotate_records: Optional[int] = None):
         self.path = path
         self.fsync = fsync
+        # Segment rotation thresholds (None/0 = rotation off — the
+        # original single-file behavior, byte for byte).
+        self.rotate_bytes = int(rotate_bytes or 0)
+        self.rotate_records = int(rotate_records or 0)
         # Optional fence predicate (HA): evaluated INSIDE the append
         # flock; returning False raises JournalFenced instead of
         # writing. None (the default) means unfenced.
@@ -91,10 +160,77 @@ class Journal:
         # stays optional for the hot path.
         self._dirty = False
         self._locked_repair()
-        # Per-(kind, key) generation table + how far we've read the file.
+        # Per-(kind, key) generation table + how far we've read the
+        # active file, which inode that offset belongs to, and how many
+        # complete LINES of the active file it covers (the checkpoint
+        # position coordinate).
         self._generations: dict[tuple, int] = {}
         self._read_offset = 0
+        self._read_ino = os.fstat(self._fh.fileno()).st_ino
+        self._active_lines = 0
+        # Generations recovered from a checkpoint (seed_generations):
+        # segments the retention pass deleted may hold a key's only
+        # write, so the file scan alone would under-count. Merged as a
+        # floor on every rescan.
+        self._seed_gens: dict[tuple, int] = {}
         self.refresh()
+
+    # -- segment topology --
+
+    def sealed_segments(self) -> list:
+        """Sorted [(ordinal, path)] of sealed segments in the CURRENT
+        lineage (stale-lineage leftovers of a crashed compaction are
+        excluded — their content is superseded by the compacted file)."""
+        lineage = self.lineage
+        out = []
+        for ordinal, seg in _sealed_segments(self.path):
+            meta = _file_meta(seg)
+            if int((meta or {}).get("lineage", 0)) == lineage:
+                out.append((ordinal, seg))
+        return out
+
+    @property
+    def lineage(self) -> int:
+        """Compaction era. Bumped by compact(); sealed segments and
+        checkpoints from older lineages are dead on arrival."""
+        meta = _file_meta(self.path)
+        if meta is not None:
+            return int(meta.get("lineage", 0))
+        segs = _sealed_segments(self.path)
+        if segs:
+            m = _file_meta(segs[-1][1])
+            if m is not None:
+                return int(m.get("lineage", 0))
+        return 0
+
+    def active_ordinal(self) -> int:
+        """The ordinal the active file will take when sealed."""
+        meta = _file_meta(self.path)
+        if meta is not None and "seg" in meta:
+            return int(meta["seg"])
+        segs = _sealed_segments(self.path)
+        return (segs[-1][0] + 1) if segs else 0
+
+    def position(self) -> dict:
+        """Where the journal ends right now, as a recovery coordinate:
+        ``{"lineage", "segment", "offset"}`` — offset counts complete
+        LINES of the active file (meta line included). A checkpoint
+        stores this; ``replay_from`` resumes here."""
+        self.refresh()
+        return {"lineage": self.lineage,
+                "segment": self.active_ordinal(),
+                "offset": self._active_lines}
+
+    def seed_generations(self, gens: dict) -> None:
+        """Floor the generation table with checkpoint-recovered stamps
+        (``{(kind, key): gen}``): retention may have deleted the segment
+        holding a key's latest write, and a fresh handle must not
+        restart that key at generation 1."""
+        for k, g in gens.items():
+            g = int(g)
+            self._seed_gens[k] = max(self._seed_gens.get(k, 0), g)
+            if g > self._generations.get(k, 0):
+                self._generations[k] = g
 
     def refresh(self) -> int:
         """Fold records appended by OTHER writers (or our own) since the
@@ -103,13 +239,14 @@ class Journal:
         n = 0
         try:
             with open(self.path, "rb") as fh:
-                fh.seek(0, os.SEEK_END)
-                size = fh.tell()
-                if size < self._read_offset:
-                    # The file shrank under us (compaction by another
-                    # handle, or torn-tail repair): rescan from scratch.
-                    self._read_offset = 0
-                    self._generations.clear()
+                st = os.fstat(fh.fileno())
+                if (st.st_ino != self._read_ino
+                        or st.st_size < self._read_offset):
+                    # The active file was swapped (rotation/compaction
+                    # by another handle) or shrank (torn-tail repair):
+                    # rescan the whole segment chain from scratch.
+                    self._rescan_base()
+                    self._read_ino = st.st_ino
                 fh.seek(self._read_offset)
                 data = fh.read()
         except FileNotFoundError:
@@ -122,11 +259,14 @@ class Journal:
         if end < 0:
             return 0
         for line in data[:end].split(b"\n"):
+            self._active_lines += 1
             if not line.strip():
                 continue
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
+                continue
+            if rec.get("op") == "meta":
                 continue
             key = (rec.get("kind"), _key_of(rec))
             self._generations[key] = int(rec.get("gen", 0)) or \
@@ -134,6 +274,36 @@ class Journal:
             n += 1
         self._read_offset += end + 1
         return n
+
+    def _rescan_base(self) -> None:
+        """Reset the incremental-read state and fold every sealed
+        segment's generations back in (the active file is re-read by the
+        refresh() that called us). Checkpoint-seeded floors survive."""
+        self._read_offset = 0
+        self._active_lines = 0
+        self._generations.clear()
+        for _ordinal, seg in self.sealed_segments():
+            try:
+                with open(seg, "rb") as fh:
+                    data = fh.read()
+            except FileNotFoundError:
+                continue
+            end = data.rfind(b"\n")
+            for line in data[:end].split(b"\n") if end >= 0 else ():
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("op") == "meta":
+                    continue
+                key = (rec.get("kind"), _key_of(rec))
+                self._generations[key] = int(rec.get("gen", 0)) or \
+                    self._generations.get(key, 0) + 1
+        for k, g in self._seed_gens.items():
+            if g > self._generations.get(k, 0):
+                self._generations[k] = g
 
     def generation_of(self, kind: str, key: str) -> int:
         """The last persisted generation for a key (0 = never written).
@@ -264,6 +434,25 @@ class Journal:
         # (the TOCTOU the SSA conflict contract forbids). flock makes
         # the whole read-modify-append a critical section.
         fcntl.flock(self._fh.fileno(), fcntl.LOCK_EX)
+        # Rotation renames the active file: a handle opened before the
+        # rotation now points at a SEALED segment, and appending there
+        # would land records behind ones already written to the new
+        # active (breaking per-key generation order). Re-check the
+        # inode INSIDE the lock and chase the rename. O_APPEND without
+        # O_CREAT: creating the path here would race the rotating
+        # writer's own reopen and displace its meta line.
+        for _ in range(64):
+            try:
+                if (os.fstat(self._fh.fileno()).st_ino
+                        == os.stat(self.path).st_ino):
+                    break
+                fd = os.open(self.path, os.O_WRONLY | os.O_APPEND)
+            except FileNotFoundError:
+                continue  # mid-rotation window: rename done, reopen not
+            fcntl.flock(self._fh.fileno(), fcntl.LOCK_UN)
+            self._fh.close()
+            self._fh = os.fdopen(fd, "a", encoding="utf-8")
+            fcntl.flock(self._fh.fileno(), fcntl.LOCK_EX)
         try:
             if not self._tail_is_clean():
                 # Another writer crashed mid-append: truncate its torn
@@ -301,50 +490,194 @@ class Journal:
         # advance the read offset so the next refresh() doesn't re-read
         # and re-parse it (one open+parse per record on the hot path).
         self._read_offset += len(line.encode("utf-8"))
+        self._active_lines += 1
 
     def sync(self) -> None:
         """Crash-safe cycle boundary (Engine.schedule_once calls this
         after every non-idle cycle): flush+fsync all appends since the
         last sync. No-op when nothing is pending, so idle serving loops
-        don't touch the disk."""
+        don't touch the disk. With rotation thresholds configured, the
+        sealed-segment roll happens here — on the durability boundary,
+        never mid-cycle."""
         if not self._dirty:
             return
         self._fh.flush()
         os.fsync(self._fh.fileno())
         self._dirty = False
+        self.maybe_rotate()
+
+    def maybe_rotate(self) -> bool:
+        """Seal the active file into ``<path>.seg<NNNNNN>`` and reopen a
+        fresh active when a threshold is crossed. Returns True when a
+        rotation happened."""
+        if not (self.rotate_bytes or self.rotate_records):
+            return False
+        try:
+            size = os.path.getsize(self.path)
+        except FileNotFoundError:
+            return False
+        if not ((self.rotate_bytes and size >= self.rotate_bytes)
+                or (self.rotate_records
+                    and self._active_lines >= self.rotate_records)):
+            return False
+        import fcntl
+
+        fcntl.flock(self._fh.fileno(), fcntl.LOCK_EX)
+        try:
+            try:
+                if (os.fstat(self._fh.fileno()).st_ino
+                        != os.stat(self.path).st_ino):
+                    return False  # another writer rotated first
+            except FileNotFoundError:
+                return False
+            if not self._tail_is_clean():
+                self._repair_torn_tail()
+            ordinal = self.active_ordinal()
+            lineage = self.lineage
+            os.rename(self.path, _segment_path(self.path, ordinal))
+            if MAINTENANCE_CRASH_HOOK is not None:
+                MAINTENANCE_CRASH_HOOK("rotate")
+            fd = os.open(self.path,
+                         os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+            new_fh = os.fdopen(fd, "a", encoding="utf-8")
+            line = json.dumps({"op": "meta", "kind": _META_KIND,
+                               "seg": ordinal + 1,
+                               "lineage": lineage}) + "\n"
+            new_fh.write(line)
+            new_fh.flush()
+            os.fsync(fd)
+            self._dir_sync()
+            old = self._fh
+            self._fh = new_fh
+            self._read_ino = os.fstat(fd).st_ino
+            self._read_offset = len(line.encode("utf-8"))
+            self._active_lines = 1
+            fcntl.flock(old.fileno(), fcntl.LOCK_UN)
+            old.close()
+            return True
+        finally:
+            import contextlib
+            with contextlib.suppress(ValueError, OSError):
+                if self._fh is not None and not self._fh.closed:
+                    fcntl.flock(self._fh.fileno(), fcntl.LOCK_UN)
+
+    def _dir_sync(self) -> None:
+        """fsync the parent directory so a rename survives power loss."""
+        d = os.path.dirname(self.path) or "."
+        try:
+            fd = os.open(d, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def retain_segments(self, min_ordinal: int) -> int:
+        """Delete sealed segments fully covered by a checkpoint
+        (ordinal < ``min_ordinal``) plus any stale-lineage leftovers.
+        Returns how many files were removed."""
+        lineage = self.lineage
+        removed = 0
+        for ordinal, seg in _sealed_segments(self.path):
+            meta = _file_meta(seg)
+            stale = int((meta or {}).get("lineage", 0)) != lineage
+            if stale or ordinal < min_ordinal:
+                try:
+                    os.remove(seg)
+                    removed += 1
+                except FileNotFoundError:
+                    pass
+        return removed
 
     def close(self) -> None:
         if not self._fh.closed:
             self.sync()
         self._fh.close()
 
-    def replay(self) -> Iterator[dict]:
-        """Yield records in append order. A truncated/corrupt FINAL
-        line (crash mid-write) is tolerated and skipped — the same
-        record __init__'s locked repair would trim; corruption anywhere
-        else means records would be silently lost, so it raises
-        JournalCorruption instead of dropping the tail."""
+    def _chain(self) -> list:
+        """The logical journal, in replay order: lineage-matching sealed
+        segments (ordinal order) then the active file, as
+        [(path, is_active)]."""
+        return ([(seg, False) for _o, seg in self.sealed_segments()]
+                + [(self.path, True)])
+
+    def _replay_file(self, path: str, tolerate_torn: bool,
+                     skip_lines: int = 0) -> Iterator[dict]:
         from kueue_tpu.api.conversion import upgrade_record
 
-        with open(self.path, encoding="utf-8") as fh:
-            lines = fh.read().split("\n")
+        try:
+            with open(path, encoding="utf-8") as fh:
+                lines = fh.read().split("\n")
+        except FileNotFoundError:
+            return
         for i, line in enumerate(lines):
+            if i < skip_lines:
+                continue
             line = line.strip()
             if not line:
                 continue
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
-                if any(rest.strip() for rest in lines[i + 1:]):
-                    raise JournalCorruption(
-                        f"{self.path}:{i + 1}: unparseable record "
-                        "with records after it") from None
-                return  # torn tail
+                if tolerate_torn and not any(
+                        rest.strip() for rest in lines[i + 1:]):
+                    return  # torn tail (crash mid-write)
+                raise JournalCorruption(
+                    f"{path}:{i + 1}: unparseable record "
+                    "with records after it") from None
+            if rec.get("op") == "meta":
+                continue
             yield upgrade_record(rec)
+
+    def replay(self) -> Iterator[dict]:
+        """Yield records in append order across the whole segment chain.
+        A truncated/corrupt FINAL line of the ACTIVE file (crash
+        mid-write) is tolerated and skipped — the same record
+        __init__'s locked repair would trim; corruption anywhere else
+        means records would be silently lost, so it raises
+        JournalCorruption instead of dropping the tail."""
+        for path, is_active in self._chain():
+            yield from self._replay_file(path, tolerate_torn=is_active)
+
+    def replay_from(self, position: dict) -> Iterator[dict]:
+        """Yield only the records past a checkpoint ``position()`` —
+        the O(delta-since-checkpoint) recovery suffix. Raises
+        ValueError when the position's lineage doesn't match (a
+        compaction rewrote history; the caller must fall back to a full
+        replay)."""
+        lineage = int(position.get("lineage", 0))
+        segment = int(position.get("segment", 0))
+        offset = int(position.get("offset", 0))
+        if lineage != self.lineage:
+            raise ValueError(
+                f"stale position: lineage {lineage} != journal "
+                f"lineage {self.lineage} (compacted since)")
+        for ordinal, seg in self.sealed_segments():
+            if ordinal < segment:
+                continue
+            yield from self._replay_file(
+                seg, tolerate_torn=False,
+                skip_lines=offset if ordinal == segment else 0)
+        active_ord = self.active_ordinal()
+        if active_ord < segment:
+            raise ValueError(
+                f"stale position: segment {segment} is past the active "
+                f"file (ordinal {active_ord})")
+        yield from self._replay_file(
+            self.path, tolerate_torn=True,
+            skip_lines=offset if active_ord == segment else 0)
 
     def compact(self) -> None:
         """Rewrite the log keeping only the last record per (kind, key),
-        in first-seen order (creation order is preserved for replay)."""
+        in first-seen order (creation order is preserved for replay).
+        The compacted file starts a new LINEAGE: sealed segments and
+        checkpoints taken against the old record stream are invalidated
+        by the lineage bump itself, so a crash anywhere in the cleanup
+        below leaves a journal that still replays to the same state.
+        Not for journals under checkpoint retention (retain_segments
+        deletes history this fold would need; checkpoint recovery
+        subsumes compaction there)."""
         last: dict[tuple, dict] = {}
         order: list[tuple] = []
         for rec in self.replay():
@@ -352,20 +685,40 @@ class Journal:
             if key not in last:
                 order.append(key)
             last[key] = rec
+        lineage = self.lineage
+        ordinal = self.active_ordinal()
         self._fh.close()
         tmp = self.path + ".compact"
         with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps({"op": "meta", "kind": _META_KIND,
+                                 "seg": ordinal + 1,
+                                 "lineage": lineage + 1}) + "\n")
             for key in order:
                 rec = last[key]
                 if rec["op"] != "delete":
                     fh.write(json.dumps(rec) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
         os.replace(tmp, self.path)
+        self._dir_sync()
+        if MAINTENANCE_CRASH_HOOK is not None:
+            MAINTENANCE_CRASH_HOOK("compact")
+        # Old-lineage segments are already dead (excluded by lineage);
+        # deleting them is pure space reclamation.
+        for _ordinal, seg in _sealed_segments(self.path):
+            try:
+                os.remove(seg)
+            except FileNotFoundError:
+                pass
         self._fh = open(self.path, "a", encoding="utf-8")
         # Compaction rewrites the file: re-read the generation table from
         # scratch (gens are preserved in the kept records). Compaction is
         # a leader-only operation — concurrent writers must not compact.
         self._generations.clear()
+        self._seed_gens.clear()
         self._read_offset = 0
+        self._active_lines = 0
+        self._read_ino = os.fstat(self._fh.fileno()).st_ino
         self.refresh()
 
 
@@ -441,22 +794,46 @@ def engine_from_records(records, engine=None, **engine_kwargs):
 
 
 def rebuild_engine(path: str, engine=None, attach_oracle: bool = False,
+                   use_checkpoint: bool = True, journal_kwargs=None,
                    **engine_kwargs):
     """Cold-start an engine from a journal: the restart path. Returns
     the rebuilt engine (its caches and queues reconstructed, clock
-    restored to the last persisted timestamp)."""
-    journal = Journal(path)
-    eng = engine_from_records(list(journal.replay()), engine=engine,
-                              **engine_kwargs)
+    restored to the last persisted timestamp).
+
+    When a sealed checkpoint exists (store/checkpoint.py), recovery is
+    checkpoint base + journal suffix — O(delta-since-checkpoint), and
+    the ONLY complete path once ``retain_segments`` has deleted
+    history the checkpoint covers. Invalid/torn/stale checkpoints are
+    skipped inside recover_records; no checkpoint at all degrades to
+    the full genesis replay. ``journal_kwargs`` configures the
+    re-attached writable handle (fsync, rotation thresholds)."""
+    journal = Journal(path, **(journal_kwargs or {}))
+    base: list = []
+    meta = None
+    if use_checkpoint:
+        from kueue_tpu.store.checkpoint import recover_records
+        base, suffix, meta = recover_records(journal)
+    if meta is None:
+        records = list(journal.replay())
+    else:
+        records = base + suffix
+    eng = engine_from_records(records, engine=engine, **engine_kwargs)
+    if meta is not None:
+        eng.clock = max(eng.clock, float(meta.clock))
+        journal.seed_generations(
+            {(r["kind"], _key_of(r)): int(r.get("gen", 0))
+             for r in base if r.get("gen")})
     if attach_oracle:
         eng.attach_oracle()
     eng.attach_journal(journal, record_existing=False)
     return eng
 
 
-def attach_new_journal(engine, path: str, fsync: bool = False) -> Journal:
+def attach_new_journal(engine, path: str, fsync: bool = False,
+                       **journal_kwargs) -> Journal:
     """Start journaling a live engine, snapshotting its current state
-    first (so a journal can be introduced after boot)."""
-    journal = Journal(path, fsync=fsync)
+    first (so a journal can be introduced after boot). Extra kwargs
+    (rotate_bytes/rotate_records) configure segment rotation."""
+    journal = Journal(path, fsync=fsync, **journal_kwargs)
     engine.attach_journal(journal, record_existing=True)
     return journal

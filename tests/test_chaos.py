@@ -1,0 +1,225 @@
+"""Seeded chaos scheduling (kueue_tpu/replay/faults.py): spec parsing
+for the recovery-fault kinds, ``ChaosSchedule`` determinism and plan
+shape, and the in-process semantics of the non-lethal faults (ENOSPC
+on checkpoint writes, torn checkpoints, clock skew, crash storms)."""
+
+import os
+from types import SimpleNamespace
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.replay.faults import (
+    ChaosSchedule,
+    FaultPlan,
+    _ExecutorFaultProxy,
+    arm_faults,
+)
+from kueue_tpu.store import checkpoint as ckpt_mod
+from kueue_tpu.store.checkpoint import Checkpointer
+from kueue_tpu.store.journal import attach_new_journal
+
+
+def _world(path=None):
+    eng = Engine()
+    if path is not None:
+        attach_new_journal(eng, path)
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cohort(Cohort("co"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq0", cohort="co",
+        resource_groups=(ResourceGroup(
+            ("cpu",), (FlavorQuotas(
+                "default", {"cpu": ResourceQuota(1_000_000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq0", "default", "cq0"))
+    return eng
+
+
+def _submit(eng, n, start=0):
+    for i in range(start, start + n):
+        eng.clock += 0.01
+        eng.submit(Workload(name=f"w{i}", queue_name="lq0",
+                            pod_sets=(PodSet("main", 1, {"cpu": 100}),)))
+
+
+# -- parsing --
+
+def test_parse_accepts_recovery_kinds():
+    plan = FaultPlan.parse(
+        "enospc@cycle:3,torn-checkpoint@cycle:4,"
+        "clock-skew@cycle:5:250,oracle-crash-storm@cycle:6:4,"
+        "sigkill@compaction:2")
+    kinds = [(f.kind, f.at, f.n, f.arg) for f in plan.faults]
+    assert kinds == [("enospc", "cycle", 3, 0.0),
+                     ("torn-checkpoint", "cycle", 4, 0.0),
+                     ("clock-skew", "cycle", 5, 250.0),
+                     ("oracle-crash-storm", "cycle", 6, 4.0),
+                     ("sigkill", "compaction", 2, 0.0)]
+    assert plan.lethal       # sigkill@compaction kills the process
+    assert plan.needs_oracle  # the storm drives the executor proxy
+
+
+@pytest.mark.parametrize("spec", [
+    "enospc@admission:1",            # non-cycle point, not sigkill
+    "torn-checkpoint@compaction:1",  # same
+    "clock-skew@cycle:5",            # missing the skew magnitude
+    "clock-skew@cycle",              # missing everything
+    "oracle-crash-storm@cycle:3",    # missing the storm length
+    "oracle-crash-storm@cycle:3:0",  # storm shorter than one cycle
+    "oracle-crash-storm@cycle:3:-2",  # negative storm
+    "oracle-crash-storm@cycle:3:2.5",  # fractional cycle count
+    "delay-verdict@cycle:1:-5",      # negative delay
+    "enospc@cycle:notanint",         # non-integer trigger
+])
+def test_parse_rejects_malformed_recovery_specs(spec):
+    with pytest.raises(ValueError):
+        FaultPlan.parse(spec)
+
+
+def test_lethal_and_oracle_classification():
+    assert not FaultPlan.parse("enospc@cycle:1").lethal
+    assert FaultPlan.parse("torn-tail@cycle:1").lethal
+    assert FaultPlan.parse("sigkill@admission:2").lethal
+    assert not FaultPlan.parse("clock-skew@cycle:1:100").needs_oracle
+    assert FaultPlan.parse("oracle-crash@cycle:1").needs_oracle
+
+
+# -- ChaosSchedule --
+
+def test_schedule_same_seed_is_identical():
+    a = ChaosSchedule(7).stages()
+    b = ChaosSchedule(7).stages()
+    assert [(s.spec, s.cycles, s.lethal, s.needs_oracle) for s in a] \
+        == [(s.spec, s.cycles, s.lethal, s.needs_oracle) for s in b]
+
+
+def test_schedule_seeds_diverge():
+    specs = {tuple(s.spec for s in ChaosSchedule(seed).stages())
+             for seed in range(1, 9)}
+    assert len(specs) > 1
+
+
+def test_schedule_shape_and_validity():
+    for seed in range(1, 9):
+        stages = ChaosSchedule(seed, stages=3,
+                               cycles_per_stage=24).stages()
+        assert len(stages) == 3
+        # Every stage before the last is lethal; the final stage must
+        # drain fault-free so its terminal state is comparable.
+        assert all(s.lethal for s in stages[:-1])
+        assert stages[-1].spec == "" and not stages[-1].lethal
+        for stage in stages[:-1]:
+            plan = FaultPlan.parse(stage.spec)  # must parse clean
+            lethal_at = max(f.n for f in plan.faults
+                            if f.kind in ("sigkill", "torn-tail")
+                            and f.at == "cycle") if any(
+                f.kind in ("sigkill", "torn-tail") and f.at == "cycle"
+                for f in plan.faults) else stage.cycles
+            # Benign faults land strictly before the lethal trigger.
+            for f in plan.faults:
+                if f.kind not in ("sigkill", "torn-tail"):
+                    assert f.n < lethal_at
+
+
+def test_schedule_oracle_off_excludes_oracle_faults():
+    for seed in range(1, 16):
+        for stage in ChaosSchedule(seed, oracle=False).stages():
+            assert not stage.needs_oracle, stage.spec
+
+
+# -- fault semantics (in-process, non-lethal kinds) --
+
+def test_enospc_covers_exactly_one_cycle(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = _world(path)
+    ck = Checkpointer(eng, interval=1)
+    arm_faults(eng, "enospc@cycle:3")
+    _submit(eng, 6)
+    while eng.schedule_once() is not None:
+        eng.clock += 0.01
+    # The fault fired, a checkpoint write failed, the engine survived,
+    # and the hook was disarmed after its cycle.
+    assert ck.failures >= 1
+    assert ck.written >= 1
+    assert ckpt_mod.WRITE_FAULT is None
+    assert ck.store.live_metas()  # a valid checkpoint still exists
+    eng.journal.close()
+
+
+def test_torn_checkpoint_targets_newest_sealed_file(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = _world(path)
+    ck = Checkpointer(eng, interval=1, keep=4)
+    _submit(eng, 4)
+    while eng.schedule_once() is not None:
+        eng.clock += 0.01
+    metas = ck.store.live_metas()
+    assert len(metas) >= 2
+    injector = arm_faults(eng, f"torn-checkpoint@cycle:{eng.cycle_seq}")
+    eng.schedule_once()
+    assert injector.fired
+    survivors = {m.path for m in ck.store.live_metas()}
+    assert metas[0].path not in survivors   # newest torn, CRC rejects
+    assert metas[1].path in survivors       # fallback intact
+    eng.journal.close()
+
+
+def test_clock_skew_jumps_engine_clock(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = _world(path)
+    _submit(eng, 2)
+    # pre_cycle hooks see the PRE-increment seq: the next cycle runs
+    # as eng.cycle_seq.
+    target = eng.cycle_seq
+    injector = arm_faults(eng, f"clock-skew@cycle:{target}:5000")
+    before = eng.clock
+    eng.schedule_once()
+    assert eng.clock >= before + 5.0
+    assert injector.fired == [f"clock-skew@cycle:{target}:5000"]
+    eng.journal.close()
+
+
+def test_storm_holds_crash_across_its_range(tmp_path):
+    """The proxy stays crashed for the whole [start, start+M) window —
+    unlike oracle-crash, which the post-cycle 'sidecar restart'
+    clears — then recovers."""
+    path = str(tmp_path / "j.jsonl")
+    eng = _world(path)
+    # A stand-in bridge: the injector only needs .executor to wrap,
+    # and the engine needs try_cycle (None = host path owns the cycle).
+    eng.oracle = SimpleNamespace(executor=object(),
+                                 try_cycle=lambda: None,
+                                 cycles_fallback=0)
+    _submit(eng, 8)
+    injector = arm_faults(eng, "oracle-crash-storm@cycle:2:3")
+    proxy = injector.proxy
+    assert isinstance(proxy, _ExecutorFaultProxy)
+    crashed_at = {}
+    # Appended AFTER the injector's hook: observes the state the
+    # executor sees during the cycle itself.
+    eng.pre_cycle_hooks.append(
+        lambda seq, _eng: crashed_at.__setitem__(seq, proxy.crashed))
+    for _ in range(8):
+        eng.clock += 0.01
+        eng.schedule_once()
+    assert [s for s, c in sorted(crashed_at.items()) if c] == [2, 3, 4]
+    eng.journal.close()
+
+
+def test_oracle_faults_require_attached_oracle(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = _world(path)
+    with pytest.raises(RuntimeError):
+        arm_faults(eng, "oracle-crash-storm@cycle:1:2")
+    eng.journal.close()

@@ -1,0 +1,351 @@
+"""Sealed checkpoints + journal segment rotation
+(kueue_tpu/store/checkpoint.py, store/journal.py): atomic snapshot
+write, torn/corrupt detection with fallback, retention, lineage
+invalidation, the bounded-time recovery path, and readers racing
+concurrent rotation/compaction."""
+
+import json
+import os
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.ha.digest import admitted_state_digest
+from kueue_tpu.store import checkpoint as ckpt_mod
+from kueue_tpu.store.checkpoint import (
+    Checkpointer,
+    CheckpointStore,
+    recover_engine,
+    recover_records,
+)
+from kueue_tpu.store.journal import Journal, attach_new_journal, rebuild_engine
+
+
+def build_world(eng):
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cohort(Cohort("co"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq0", cohort="co",
+        resource_groups=(ResourceGroup(
+            ("cpu",), (FlavorQuotas(
+                "default", {"cpu": ResourceQuota(1_000_000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq0", "default", "cq0"))
+
+
+def submit_wave(eng, n, start=0):
+    for i in range(start, start + n):
+        eng.clock += 0.01
+        eng.submit(Workload(name=f"w{i}", queue_name="lq0",
+                            pod_sets=(PodSet("main", 1, {"cpu": 100}),)))
+
+
+def drain(eng):
+    while eng.schedule_once() is not None:
+        eng.clock += 0.01
+
+
+def _journaled_world(path, n=6, **journal_kwargs):
+    eng = Engine()
+    attach_new_journal(eng, path, **journal_kwargs)
+    build_world(eng)
+    submit_wave(eng, n)
+    drain(eng)
+    return eng
+
+
+# -- write / recover roundtrip --
+
+def test_checkpoint_recovery_matches_genesis(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = _journaled_world(path)
+    store = CheckpointStore.for_journal(path)
+    meta = store.write(eng, seq=eng.cycle_seq)
+    assert meta.records > 0
+    assert meta.state == admitted_state_digest(eng)
+    # Live suffix past the checkpoint position.
+    submit_wave(eng, 2, start=6)
+    drain(eng)
+    eng.journal.close()
+
+    rec, report = recover_engine(path, prove_genesis=True)
+    assert report["source"] == "checkpoint"
+    assert report["suffix_records"] > 0
+    assert report["identical"], (report["state"],
+                                 report["genesis_state"])
+    assert admitted_state_digest(rec) == admitted_state_digest(eng)
+
+
+def test_no_checkpoint_degrades_to_genesis(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = _journaled_world(path)
+    eng.journal.close()
+    rec, report = recover_engine(path)
+    assert report["source"] == "genesis"
+    assert admitted_state_digest(rec) == admitted_state_digest(eng)
+
+
+# -- torn / corrupt detection --
+
+def test_torn_checkpoint_falls_back_to_previous(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = _journaled_world(path)
+    store = CheckpointStore.for_journal(path)
+    first = store.write(eng)
+    submit_wave(eng, 2, start=6)
+    drain(eng)
+    second = store.write(eng)
+    # Tear the newest file mid-payload: CRC must reject it.
+    size = os.path.getsize(second.path)
+    with open(second.path, "r+b") as fh:
+        fh.truncate(int(size * 0.6))
+    eng.journal.close()
+
+    journal = Journal(path)
+    base, suffix, meta = recover_records(journal)
+    assert meta is not None and meta.path == first.path
+    rec, report = recover_engine(path, prove_genesis=True)
+    assert report["checkpoint"]["path"] == first.path
+    assert report["identical"]
+
+
+def test_all_checkpoints_corrupt_degrades_to_genesis(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = _journaled_world(path)
+    store = CheckpointStore.for_journal(path)
+    store.write(eng)
+    store.write(eng)
+    for _index, p in store._indexed():
+        with open(p, "r+b") as fh:
+            fh.truncate(10)
+    eng.journal.close()
+    rec, report = recover_engine(path)
+    assert report["source"] == "genesis"
+    assert admitted_state_digest(rec) == admitted_state_digest(eng)
+
+
+def test_leftover_tmp_file_is_never_read(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = _journaled_world(path)
+    store = CheckpointStore.for_journal(path)
+    store.write(eng)
+    # The artifact of a crash mid-write: a temp file recovery must
+    # ignore (it is not ckpt-NNNNNN.json and was never renamed).
+    with open(os.path.join(store.directory,
+                           "ckpt-000099.json.tmp"), "w") as fh:
+        fh.write("{garbage")
+    assert len(store.live_metas()) == 1
+    eng.journal.close()
+    _, report = recover_engine(path)
+    assert report["source"] == "checkpoint"
+
+
+def test_write_fault_aborts_and_keeps_previous(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = _journaled_world(path)
+    ck = Checkpointer(eng, interval=1000)
+    first = ck.checkpoint()
+    assert first is not None
+
+    def die(fh):
+        import errno
+        raise OSError(errno.ENOSPC, "injected")
+
+    ckpt_mod.WRITE_FAULT = die
+    try:
+        assert ck.checkpoint() is None
+    finally:
+        ckpt_mod.WRITE_FAULT = None
+    assert ck.failures == 1 and ck.written == 1
+    # No half-written file survives; the first checkpoint is intact.
+    assert [m.path for m in ck.store.live_metas()] == [first.path]
+    assert not [n for n in os.listdir(ck.store.directory)
+                if n.endswith(".tmp")]
+    # Next attempt (disk recovered) succeeds.
+    assert ck.checkpoint() is not None
+    eng.journal.close()
+
+
+# -- retention --
+
+def test_retention_counts_files_newest_first(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = _journaled_world(path)
+    store = CheckpointStore.for_journal(path)
+    metas = [store.write(eng) for _ in range(4)]
+    removed = store.retain(keep=2)
+    assert removed == 2
+    assert [p for _i, p in store._indexed()] == [metas[2].path,
+                                                 metas[3].path]
+    eng.journal.close()
+
+
+def test_checkpointer_interval_skips_idle(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = Engine()
+    attach_new_journal(eng, path)
+    build_world(eng)
+    ck = Checkpointer(eng, interval=2)
+    # Idle ticks cover no new records: no checkpoint may be written.
+    for _ in range(10):
+        eng.schedule_once()
+    assert ck.written == 0
+    submit_wave(eng, 4)
+    drain(eng)
+    assert ck.written >= 1
+    assert eng.checkpointer is ck
+    ck.detach()
+    assert eng.checkpointer is None
+    eng.journal.close()
+
+
+# -- lineage invalidation --
+
+def test_compaction_invalidates_checkpoints(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = _journaled_world(path)
+    store = CheckpointStore.for_journal(path)
+    store.write(eng)
+    eng.journal.compact()  # lineage bump: the position is meaningless
+    eng.journal.close()
+    journal = Journal(path)
+    _base, _suffix, meta = recover_records(journal)
+    assert meta is None
+    rec, report = recover_engine(path)
+    assert report["source"] == "genesis"
+    assert admitted_state_digest(rec) == admitted_state_digest(eng)
+
+
+# -- segment rotation --
+
+def test_rotation_seals_segments_and_replays_in_order(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    flat = str(tmp_path / "flat.jsonl")
+    eng = _journaled_world(path, n=12, rotate_records=10)
+    control = _journaled_world(flat, n=12)
+    assert len(eng.journal.sealed_segments()) >= 1
+    # The segmented chain replays to the same state as the single file.
+    assert (admitted_state_digest(rebuild_engine(path))
+            == admitted_state_digest(control))
+    eng.journal.close()
+    control.journal.close()
+
+
+def test_replay_from_checkpoint_position_is_suffix_only(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = _journaled_world(path, n=12, rotate_records=10)
+    position = eng.journal.position()
+    submit_wave(eng, 3, start=12)
+    drain(eng)
+    suffix = list(eng.journal.replay_from(position))
+    total = len(list(eng.journal.replay()))
+    assert 0 < len(suffix) < total
+    # Stale lineage must be refused, not silently misread.
+    with pytest.raises(ValueError):
+        list(eng.journal.replay_from(dict(position, lineage=99)))
+    eng.journal.close()
+
+
+def test_retain_segments_bounds_history_but_recovers(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = Engine()
+    attach_new_journal(eng, path, rotate_records=8)
+    build_world(eng)
+    ck = Checkpointer(eng, interval=2, keep=1, retain_segments=True)
+    for start in range(0, 24, 4):
+        submit_wave(eng, 4, start=start)
+        drain(eng)
+    assert ck.written >= 2
+    # Retention deleted sealed segments the checkpoint covers…
+    live = ck.store.live_metas()
+    assert all(o >= min(m.segment for m in live)
+               for o, _p in eng.journal.sealed_segments())
+    digest = admitted_state_digest(eng)
+    eng.journal.close()
+    # …and the checkpoint+suffix boot is the complete recovery path.
+    rec, report = recover_engine(path)
+    assert report["source"] == "checkpoint"
+    assert admitted_state_digest(rec) == digest
+
+
+# -- readers racing concurrent maintenance --
+
+def test_reader_refresh_survives_rotation_swap(tmp_path):
+    """A second handle's incremental read position points into the
+    active file; a rotation under it swaps that inode. refresh() must
+    detect the swap and rescan the chain instead of misreading."""
+    path = str(tmp_path / "j.jsonl")
+    eng = Engine()
+    attach_new_journal(eng, path, rotate_records=6)
+    build_world(eng)
+    reader = Journal(path)
+    reader.refresh()
+    before = reader.position()
+    # Writer churns far past the rotation threshold: the active file
+    # the reader's offset referred to is now a sealed segment.
+    submit_wave(eng, 12)
+    drain(eng)
+    assert len(eng.journal.sealed_segments()) >= 1
+    reader.refresh()
+    after = reader.position()
+    assert after["segment"] >= before["segment"]
+    assert reader.position() == eng.journal.position()
+    reader.close()
+    eng.journal.close()
+
+
+def test_reader_refresh_survives_compaction_shrink(tmp_path):
+    """Compaction by another handle rewrites the file smaller than the
+    reader's offset — the 'file shrank under us' branch: the rescan
+    must reset cleanly and the reader must end at the writer's
+    position, not raise or double-count."""
+    path = str(tmp_path / "j.jsonl")
+    eng = _journaled_world(path, n=10)
+    reader = Journal(path)
+    reader.refresh()
+    assert reader.position()["offset"] > 0
+    eng.journal.compact()
+    reader.refresh()
+    assert reader.position() == eng.journal.position()
+    assert reader.lineage == eng.journal.lineage
+    # And a full replay off the racing handle matches the writer's.
+    assert ([r["kind"] for r in reader.replay()]
+            == [r["kind"] for r in eng.journal.replay()])
+    reader.close()
+    eng.journal.close()
+
+
+def test_maintenance_crash_leaves_replayable_journal(tmp_path):
+    """Simulate a crash at the nastiest maintenance point (after the
+    rename, before cleanup/reopen — MAINTENANCE_CRASH_HOOK's site) by
+    abandoning the handle right after rotation; a fresh boot must
+    replay the full chain."""
+    from kueue_tpu.store import journal as journal_mod
+
+    path = str(tmp_path / "j.jsonl")
+    eng = Engine()
+    attach_new_journal(eng, path, rotate_records=6)
+    build_world(eng)
+
+    events = []
+    journal_mod.MAINTENANCE_CRASH_HOOK = events.append
+    try:
+        submit_wave(eng, 10)
+        drain(eng)
+    finally:
+        journal_mod.MAINTENANCE_CRASH_HOOK = None
+    assert "rotate" in events
+    digest = admitted_state_digest(eng)
+    # No close(): the handle is simply abandoned, as a SIGKILL would.
+    rec = rebuild_engine(path)
+    assert admitted_state_digest(rec) == digest

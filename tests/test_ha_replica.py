@@ -371,6 +371,106 @@ def test_tailer_throttles_rebuilds(tmp_path):
     assert tailer.replay_lag == 1
 
 
+class _FixedRng:
+    """random.Random stand-in: uniform() returns the top of the range
+    scaled by ``frac`` and records the bounds it was asked for."""
+
+    def __init__(self, frac=1.0):
+        self.frac = frac
+        self.calls = []
+
+    def uniform(self, lo, hi):
+        self.calls.append((lo, hi))
+        return lo + (hi - lo) * self.frac
+
+
+def test_tailer_rebuild_backoff_full_jitter(tmp_path):
+    """Consecutive threshold rebuilds back off with FULL jitter
+    (uniform(0, base·2^streak) capped): injectable rng + clock make
+    the envelope assertable."""
+    path, _ = _checkpointed_journal(tmp_path)
+    rng = _FixedRng(frac=1.0)
+    now = {"t": 100.0}
+    tailer = JournalTailer(path, rebuild_every=1, rng=rng,
+                           rebuild_backoff_base=0.5,
+                           rebuild_backoff_cap=4.0,
+                           clock=lambda: now["t"])
+    tailer.poll()  # cold rebuild: no backoff draw
+
+    def append_record(ts):
+        with open(path, "a") as f:
+            f.write(json.dumps({"kind": "cycle_trace", "op": "apply",
+                                "obj": {"name": f"t{ts}"},
+                                "ts": ts}) + "\n")
+
+    append_record(2.0)
+    before = tailer.rebuilds
+    tailer.poll()
+    assert tailer.rebuilds == before + 1
+    # Full-jitter draw over [0, base·2^1], streak now 1.
+    assert rng.calls[-1] == (0.0, 1.0)
+    cooldown_end = now["t"] + 1.0
+    # Inside the cooldown window the rebuild is suppressed (the record
+    # is still consumed — only the fold into the read model waits).
+    append_record(3.0)
+    now["t"] = cooldown_end - 0.25
+    tailer.poll()
+    assert tailer.rebuilds == before + 1
+    assert tailer.replay_lag >= 1
+    # Past the window it rebuilds again, with the streak (and so the
+    # jitter range) grown — and capped at rebuild_backoff_cap.
+    append_record(4.0)
+    now["t"] = cooldown_end + 0.01
+    tailer.poll()
+    assert tailer.rebuilds == before + 2
+    assert rng.calls[-1] == (0.0, 2.0)
+    # A quiet poll resets the streak: the next backoff starts small.
+    tailer.poll()
+    assert tailer._streak == 0
+
+
+def test_shedder_retry_after_jitter_decorrelates():
+    """The 429 Retry-After is base·uniform(1-j, 1+j): same mean,
+    decorrelated clients. With rate=1 and factor=1, base is 1s."""
+    rng = _FixedRng(frac=1.0)
+    sh = AdmissionShedder(rate=1.0, burst=1.0, retry_jitter=0.5,
+                          rng=rng)
+    assert sh.admit(0.0)["accepted"]
+    verdict = sh.admit(0.0)
+    assert not verdict["accepted"]
+    assert rng.calls[-1] == (0.5, 1.5)
+    assert verdict["retryAfter"] == pytest.approx(1.5)
+    # jitter=0 degrades to the deterministic delay.
+    sh0 = AdmissionShedder(rate=1.0, burst=1.0, retry_jitter=0.0,
+                           rng=_FixedRng())
+    sh0.admit(0.0)
+    assert sh0.admit(0.0)["retryAfter"] == pytest.approx(1.0)
+
+
+def test_submit_dedup_map_stays_bounded(tmp_path):
+    """The in-flight submit map fronts engine.workloads for idempotent
+    retries, and the post-sync evictor keeps it O(in-flight): admitted
+    work leaves the map, retries of admitted work still dedup."""
+    journal = str(tmp_path / "ha.jsonl")
+    leader = HAReplica(journal, journal + ".lease", "ldr",
+                       lease_duration=5.0, renew_in_background=False)
+    leader.step(0.0)
+    build_world(leader.engine)
+    wls = [Workload(name=f"d{i}", queue_name="lq0",
+                    pod_sets=(PodSet("main", 1, {"cpu": 100}),))
+           for i in range(8)]
+    for wl in wls:
+        assert leader.submit(wl, now=0.0)["code"] == 201
+    assert len(leader._inflight_submits) == 8
+    drain(leader.engine)
+    # Every admission is durable (post-sync evictor ran): map empty.
+    assert leader._inflight_submits == {}
+    # A late retry of admitted work still dedups via engine.workloads.
+    out = leader.submit(wls[0], now=1.0)
+    assert out["code"] == 200 and out["deduplicated"]
+    assert len(leader._inflight_submits) == 0
+
+
 # -- kueuectl status (offline) --
 
 def test_kueuectl_status_offline_renders_checkpoint(tmp_path):

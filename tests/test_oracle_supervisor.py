@@ -1,0 +1,151 @@
+"""Oracle supervisor (kueue_tpu/oracle/supervisor.py): retry with
+deterministic backoff jitter, the circuit breaker's
+closed/open/half-open protocol, cooldown doubling on failed probes,
+and the metrics surface."""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kueue_tpu.oracle.service import RemoteOracleError  # noqa: E402
+from kueue_tpu.oracle.supervisor import (  # noqa: E402
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    OracleSupervisor,
+    _jitter01,
+)
+
+
+def _sup(**kw):
+    sleeps = []
+    kw.setdefault("sleep", sleeps.append)
+    return OracleSupervisor(**kw), sleeps
+
+
+class _Flaky:
+    """Fails the first ``n`` calls, then answers."""
+
+    def __init__(self, n):
+        self.n = n
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.n:
+            raise RemoteOracleError("injected")
+        return "ok"
+
+
+# -- retry with backoff --
+
+def test_retry_recovers_within_budget():
+    sup, sleeps = _sup(max_attempts=3)
+    fn = _Flaky(2)
+    assert sup.call("cycle_step", fn) == "ok"
+    assert fn.calls == 3
+    assert sup.total_retries == 2
+    assert len(sleeps) == 2
+    # Exponential envelope: attempt k sleeps at most base * 2^k.
+    assert 0.0 <= sleeps[0] <= sup.backoff_base * 2
+    assert 0.0 <= sleeps[1] <= sup.backoff_base * 4
+
+
+def test_retry_exhausts_and_raises():
+    sup, _sleeps = _sup(max_attempts=3)
+    fn = _Flaky(99)
+    with pytest.raises(RemoteOracleError):
+        sup.call("cycle_step", fn)
+    assert fn.calls == 3  # max_attempts total tries, not retries
+
+
+def test_backoff_respects_cap():
+    sup, sleeps = _sup(max_attempts=8, backoff_base=0.5, backoff_cap=1.0)
+    with pytest.raises(RemoteOracleError):
+        sup.call("cycle_step", _Flaky(99))
+    assert all(d <= 1.0 for d in sleeps)
+
+
+def test_jitter_is_deterministic_but_decorrelated():
+    a = _jitter01("salt", "site", 1, 1)
+    assert a == _jitter01("salt", "site", 1, 1)  # replay-stable
+    assert 0.0 <= a < 1.0
+    # Different coordinates (another replica's salt, another attempt)
+    # land elsewhere — the fleet decorrelates without a PRNG.
+    others = {_jitter01(s, "site", 1, 1) for s in "abcdefgh"}
+    assert len(others) > 1
+
+
+# -- circuit breaker --
+
+def test_breaker_opens_after_threshold():
+    sup, _ = _sup(threshold=3, cooldown_cycles=5)
+    for seq in (1, 2):
+        sup.record_failure(seq)
+        assert sup.state == CLOSED and sup.allow_cycle(seq)
+    sup.record_failure(3)
+    assert sup.state == OPEN
+    assert sup.demotions == 1
+    assert not sup.allow_cycle(4)  # demoted: host path, no probe yet
+
+
+def test_breaker_probe_and_repromotion():
+    sup, _ = _sup(threshold=1, cooldown_cycles=5)
+    sup.record_failure(10)
+    assert sup.state == OPEN
+    assert not sup.allow_cycle(14)  # still cooling down
+    assert sup.allow_cycle(15)      # seq >= reopen_at: the probe
+    assert sup.state == HALF_OPEN
+    sup.record_success()
+    assert sup.state == CLOSED
+    assert sup.repromotions == 1
+    assert sup.consecutive_failures == 0
+
+
+def test_failed_probe_doubles_cooldown_with_cap():
+    sup, _ = _sup(threshold=1, cooldown_cycles=4)
+    seq = 0
+    sup.record_failure(seq)
+    cooldowns = []
+    for _round in range(6):
+        seq = sup._reopen_at
+        assert sup.allow_cycle(seq)
+        assert sup.state == HALF_OPEN
+        sup.record_failure(seq)
+        assert sup.state == OPEN
+        cooldowns.append(sup._reopen_at - seq)
+    # 8, 16, 32, then pinned at the 8x cap.
+    assert cooldowns == [8, 16, 32, 32, 32, 32]
+    # Recovery resets the cooldown to its configured base.
+    assert sup.allow_cycle(sup._reopen_at)
+    sup.record_success()
+    assert sup.state == CLOSED
+    assert sup._cooldown == 4
+
+
+def test_success_resets_failure_streak():
+    sup, _ = _sup(threshold=3)
+    sup.record_failure(1)
+    sup.record_failure(2)
+    sup.record_success()
+    sup.record_failure(3)
+    sup.record_failure(4)
+    assert sup.state == CLOSED  # the streak never reached threshold
+
+
+def test_status_and_metrics_surface():
+    from kueue_tpu.metrics.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    sup = OracleSupervisor(metrics=reg, threshold=1, cooldown_cycles=2,
+                           sleep=lambda _d: None)
+    sup.record_failure(1)
+    assert sup.allow_cycle(3)
+    sup.record_success()
+    st = sup.status()
+    assert st["state"] == CLOSED
+    assert st["demotions"] == 1 and st["repromotions"] == 1
+    assert st["totalFailures"] == 1
+    text = reg.render()
+    assert "oracle_breaker_state 0" in text
+    assert "oracle_breaker_transitions_total" in text

@@ -1,0 +1,307 @@
+#!/usr/bin/env python
+"""chaos-smoke: seeded multi-fault crash/recovery sweep behind
+``make chaos-smoke``.
+
+One world (~100 workloads journaled pending, nothing scheduled) is the
+shared origin. Per seed, ``ChaosSchedule`` (replay/faults.py) expands
+the seed into a deterministic chain of worker stages: each stage is a
+fresh process that reboots from the journal — sealed checkpoint base +
+journal suffix when a checkpoint exists (store/checkpoint.py) — drains
+admission cycles under its fault plan with segment rotation and a
+tight checkpoint cadence enabled, and either gets SIGKILLed by the
+plan (the next stage is the crash recovery) or drains clean. Faults in
+the pool: sigkill at cycle/admission/maintenance boundaries, torn
+journal tails, torn checkpoints, ENOSPC on checkpoint writes, clock
+skew, oracle crash storms.
+
+After the final stage each seed must prove, against a fault-free
+control arm over the same origin:
+
+  * zero lost / zero duplicate admissions — the rebuilt admitted set,
+    usage totals, and admitted-state digest are byte-identical to the
+    control arm's;
+  * bounded-time recovery is HONEST — recovering via newest valid
+    checkpoint + suffix yields a digest byte-identical to a full
+    genesis replay of the same journal (store.checkpoint.recover_engine
+    prove_genesis; checkpoints run with retention off here, precisely
+    so the genesis replay stays possible to compare against).
+
+A final dedicated storm arm reruns the drain with the oracle attached
+under ``oracle-crash-storm``: the supervisor's circuit breaker must
+demonstrably demote to the host path, re-promote after the storm, and
+land on the same control digest.
+
+Exits non-zero on the first divergence.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+N_WORKLOADS = 96
+CKPT_INTERVAL = 4        # cycles between checkpoints in every worker
+ROTATE_RECORDS = 30      # journal segment roll threshold
+STAGE_TIMEOUT = 120.0
+
+
+def scenario():
+    from kueue_tpu.bench.scenario import baseline_like
+    return baseline_like(n_cohorts=2, cqs_per_cohort=2,
+                         n_workloads=N_WORKLOADS,
+                         nominal_per_cq=2_000_000, sized_to_fit=True)
+
+
+def seed_journal(path: str) -> None:
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.store.journal import attach_new_journal
+
+    eng = Engine()
+    scen = scenario()
+    attach_new_journal(eng, path)
+    for rf in scen.flavors:
+        eng.create_resource_flavor(rf)
+    for co in scen.cohorts:
+        eng.create_cohort(co)
+    for cq in scen.cluster_queues:
+        eng.create_cluster_queue(cq)
+    for lq in scen.local_queues:
+        eng.create_local_queue(lq)
+    for wl in scen.workloads:
+        eng.clock += 0.001
+        eng.submit(wl)
+    eng.journal.sync()
+
+
+def state_summary(eng) -> dict:
+    from kueue_tpu.api.serde import to_jsonable
+
+    admitted = {k: to_jsonable(w.status.admission)
+                for k, w in sorted(eng.workloads.items())
+                if w.status.admission is not None and not w.is_finished}
+    usage = {
+        name: sorted((str(fr), v)
+                     for fr, v in cqs.node.usage.items() if v)
+        for name, cqs in sorted(
+            eng.cache.snapshot().cluster_queues.items())}
+    return {"admitted": admitted, "usage": usage}
+
+
+# ---------------------------------------------------------------- worker
+
+def run_worker(args) -> int:
+    """One chaos stage: reboot from the journal, drain under the fault
+    plan. SIGKILL mid-flight is the expected exit for lethal plans."""
+    from kueue_tpu.store.checkpoint import Checkpointer
+    from kueue_tpu.store.journal import rebuild_engine
+
+    eng = rebuild_engine(
+        args.journal, attach_oracle=args.oracle,
+        journal_kwargs={"rotate_records": ROTATE_RECORDS})
+    # Retention OFF: the parent proves checkpoint recovery against a
+    # full genesis replay afterwards, which needs the whole history.
+    ck = Checkpointer(eng, interval=CKPT_INTERVAL, keep=2,
+                      retain_segments=False)
+    injector = None
+    if args.spec:
+        from kueue_tpu.replay.faults import arm_faults
+        injector = arm_faults(eng, args.spec)
+
+    idle = 0
+    limit = 500 if args.final else args.cycles
+    for count in range(1, limit + 1):
+        eng.clock += 0.05
+        result = eng.schedule_once()
+        if args.final:
+            # Drain to quiescence, but never stop short of the stage's
+            # cycle budget: late-cycle faults and the breaker's
+            # half-open probe need their window even on an early-idle
+            # world.
+            idle = idle + 1 if result is None else 0
+            if count >= args.cycles and idle >= 3:
+                break
+
+    from kueue_tpu.ha.digest import admitted_state_digest
+    summary = {
+        "digest": admitted_state_digest(eng),
+        "admitted": sum(1 for w in eng.workloads.values()
+                        if w.status.admission is not None),
+        "fired": injector.fired if injector else [],
+        "checkpointer": ck.status(),
+    }
+    if eng.oracle is not None and eng.oracle.supervisor is not None:
+        summary["supervisor"] = eng.oracle.supervisor.status()
+    print("CHAOS_WORKER " + json.dumps(summary), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------- parent
+
+def spawn_stage(journal: str, stage, final: bool, logf):
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+           "--journal", journal, "--spec", stage.spec,
+           "--cycles", str(stage.cycles)]
+    if final:
+        cmd.append("--final")
+    if stage.needs_oracle:
+        cmd.append("--oracle")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    return subprocess.Popen(cmd, stdout=logf, stderr=subprocess.STDOUT,
+                            env=env, cwd=ROOT)
+
+
+def worker_summary(log_path: str) -> dict:
+    with open(log_path) as f:
+        for line in f:
+            if line.startswith("CHAOS_WORKER "):
+                return json.loads(line[len("CHAOS_WORKER "):])
+    return {}
+
+
+def control_arm(seed: str, workdir: str) -> dict:
+    from kueue_tpu.ha.digest import admitted_state_digest
+    from kueue_tpu.store.journal import rebuild_engine
+
+    path = os.path.join(workdir, "control.jsonl")
+    shutil.copy(seed, path)
+    eng = rebuild_engine(path)
+    for _ in range(400):
+        if eng.schedule_once() is None:
+            break
+        eng.clock += 0.05
+    return {"digest": admitted_state_digest(eng),
+            "state": state_summary(eng)}
+
+
+def run_seed(seed_no: int, stages, origin: str, workdir: str,
+             control: dict, tag: str = "") -> dict:
+    """Drive one seed's stage chain to completion; returns the final
+    worker summary. Dies (SystemExit) on any contract violation."""
+    name = tag or f"seed{seed_no}"
+    journal = os.path.join(workdir, f"{name}.jsonl")
+    shutil.copy(origin, journal)
+    last = {}
+    for i, stage in enumerate(stages):
+        final = i == len(stages) - 1
+        log_path = os.path.join(workdir, f"{name}-stage{i}.log")
+        with open(log_path, "w") as logf:
+            proc = spawn_stage(journal, stage, final, logf)
+        try:
+            rc = proc.wait(timeout=STAGE_TIMEOUT)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise SystemExit(
+                f"FAIL[{name}]: stage {i} ({stage.spec!r}) hung; log:\n"
+                + open(log_path).read())
+        if final and rc != 0:
+            raise SystemExit(
+                f"FAIL[{name}]: final stage rc={rc}; log:\n"
+                + open(log_path).read())
+        if not final and rc not in (0, -signal.SIGKILL):
+            raise SystemExit(
+                f"FAIL[{name}]: stage {i} ({stage.spec!r}) rc={rc}, "
+                f"expected 0 or SIGKILL; log:\n" + open(log_path).read())
+        if final:
+            last = worker_summary(log_path)
+
+    # Zero lost / zero duplicate admissions vs the control arm.
+    from kueue_tpu.ha.digest import admitted_state_digest
+    from kueue_tpu.store.checkpoint import recover_engine
+
+    eng, report = recover_engine(journal, prove_genesis=True)
+    chaos_state = state_summary(eng)
+    if chaos_state != control["state"]:
+        lost = set(control["state"]["admitted"]) - set(
+            chaos_state["admitted"])
+        extra = set(chaos_state["admitted"]) - set(
+            control["state"]["admitted"])
+        raise SystemExit(
+            f"FAIL[{name}]: rebuilt state diverged (lost={sorted(lost)} "
+            f"extra={sorted(extra)})")
+    if admitted_state_digest(eng) != control["digest"]:
+        raise SystemExit(f"FAIL[{name}]: rebuilt digest != control")
+    # Bounded-time recovery honesty: checkpoint+suffix == genesis.
+    if report["source"] == "checkpoint" and not report["identical"]:
+        raise SystemExit(
+            f"FAIL[{name}]: checkpoint recovery digest diverged from "
+            f"genesis replay: {report['state']} != "
+            f"{report['genesis_state']}")
+    last["recovery"] = {"source": report["source"],
+                        "base": report["base_records"],
+                        "suffix": report["suffix_records"]}
+    return last
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--journal")
+    ap.add_argument("--spec", default="")
+    ap.add_argument("--cycles", type=int, default=24)
+    ap.add_argument("--final", action="store_true")
+    ap.add_argument("--oracle", action="store_true")
+    ap.add_argument("--seeds", type=int, default=8)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch workdir for inspection")
+    args = ap.parse_args()
+    if args.worker:
+        return run_worker(args)
+
+    from kueue_tpu.replay.faults import ChaosSchedule, ChaosStage
+
+    workdir = tempfile.mkdtemp(prefix="chaos-smoke-")
+    origin = os.path.join(workdir, "origin.jsonl")
+    seed_journal(origin)
+    control = control_arm(origin, workdir)
+    n = len(control["state"]["admitted"])
+    print(f"chaos-smoke: control admitted {n}/{N_WORKLOADS}, "
+          f"digest {control['digest']}")
+    if n != N_WORKLOADS:
+        print("FAIL: control arm must fully admit (sized_to_fit world)")
+        return 1
+
+    for seed_no in range(1, args.seeds + 1):
+        # oracle=False here: storm coverage has its own arm below, and
+        # fault-plan expansion stays jax-free for the seed sweep.
+        stages = ChaosSchedule(seed_no, oracle=False).stages()
+        out = run_seed(seed_no, stages, origin, workdir, control)
+        fired = sum(len(s.spec.split(",")) if s.spec else 0
+                    for s in stages)
+        print(f"chaos-smoke: [seed {seed_no}] {len(stages)} stages, "
+              f"{fired} faults planned, recovery "
+              f"{out['recovery']['source']} "
+              f"(base={out['recovery']['base']} "
+              f"suffix={out['recovery']['suffix']}), digest identical")
+
+    # Dedicated storm arm: breaker demotes, re-promotes, digest holds.
+    storm = ChaosStage(spec="oracle-crash-storm@cycle:2:4", cycles=40,
+                       lethal=False, needs_oracle=True)
+    out = run_seed(0, [storm], origin, workdir, control, tag="storm")
+    sup = out.get("supervisor") or {}
+    if not (sup.get("demotions", 0) >= 1
+            and sup.get("repromotions", 0) >= 1
+            and sup.get("state") == "closed"):
+        print(f"FAIL[storm]: breaker never demoted+re-promoted: {sup}")
+        return 1
+    print(f"chaos-smoke: [storm] breaker demoted x{sup['demotions']}, "
+          f"re-promoted x{sup['repromotions']}, final state "
+          f"{sup['state']}, digest identical")
+
+    print(f"chaos-smoke: PASS — {args.seeds} seeds + storm arm, zero "
+          f"lost/duplicate admissions, checkpoint+suffix recovery "
+          f"byte-identical to genesis replay throughout")
+    if not args.keep:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

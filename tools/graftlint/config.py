@@ -29,6 +29,11 @@ DEFAULT_ZONES: tuple = (
     ("kueue_tpu/tas/batched.py", frozenset({"D1", "J1"})),
     ("kueue_tpu/ops/", frozenset({"D1", "J1"})),
     ("kueue_tpu/oracle/", frozenset({"D1", "J1"})),
+    # The supervisor is recovery machinery, not decision core: its
+    # breaker is a deterministic function of the fault sequence (cycle
+    # counts, CRC jitter), but its retry pacing sleeps wall-clock
+    # between attempts — D1 must not apply. It never touches a verdict.
+    ("kueue_tpu/oracle/supervisor.py", frozenset({"U1", "J1"})),
     ("kueue_tpu/cache/snapshot.py", frozenset({"D1", "U1", "J1"})),
     ("kueue_tpu/cache/", frozenset({"U1", "J1"})),
     ("kueue_tpu/parallel/", frozenset({"D1", "J1"})),
@@ -45,6 +50,12 @@ DEFAULT_ZONES: tuple = (
     # of it. Its journal kind (ha_digest) is registered exhaustively
     # for R1 via store.journal.EPHEMERAL_KINDS.
     ("kueue_tpu/ha/", frozenset({"J1"})),
+    # Sealed checkpoints serialize the guarded usage/queue state but
+    # must never MUTATE it (a snapshot that writes back would corrupt
+    # the very state it claims to preserve): pinned under the undo-log
+    # discipline so a reader that grows a "fixup" sneaks past review
+    # but not past lint.
+    ("kueue_tpu/store/checkpoint.py", frozenset({"U1", "J1"})),
 )
 
 GLOBAL_RULES = frozenset({"J1"})
