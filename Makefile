@@ -7,7 +7,7 @@ PYTEST_FLAGS ?= -q
 
 .PHONY: all native test test-fast test-device bench multichip-dryrun \
   replay-smoke obs-smoke tas-smoke perf-smoke ha-smoke chaos-smoke \
-  bench-gate lint clean
+  federation-smoke bench-gate lint clean
 
 all: native
 
@@ -101,6 +101,19 @@ ha-smoke: lint
 # recovery contract.
 chaos-smoke: lint
 	JAX_PLATFORMS=cpu $(PY) tools/chaos_smoke.py
+
+# Multi-cell federation chaos sweep: 8 seeds, each a deterministic
+# fault chain over three real HA cells behind the dispatcher tier —
+# whole-cell SIGKILL mid-admission, dispatcher crash between route-
+# intent fsync and handoff, bounded network partition, zombie rejoin
+# under the fence epoch. Every seed must end with per-cell live
+# digests identical to cold journal rebuilds and the union of
+# per-cell admitted sets equal to the submitted set, pairwise
+# disjoint (kueue_tpu/federation, replay/faults.py). lint first: the
+# federation zone pin and R1 kind registration are part of the
+# contract.
+federation-smoke: lint
+	JAX_PLATFORMS=cpu $(PY) tools/federation_smoke.py
 
 # Bench regression sentinel: noise-aware per-scenario gate over the
 # accumulated BENCH_r*/MULTICHIP_r* trajectory (tools/bench_sentinel.py).

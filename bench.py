@@ -1200,6 +1200,232 @@ def bench_ha_failover(n_clients=1000, n_workloads=400,
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_federation_failover(n_workloads=96):
+    """Whole-cell failover latency in the federation dispatcher tier
+    (kueue_tpu/federation). Three HA cells (real ``serve --ha``
+    processes over one shared world definition) sit behind an
+    in-process FederationDispatcher with the aggregated-SSE tailers
+    attached. Workloads stream through the dispatcher; at the halfway
+    point the busiest cell is SIGKILLed under load. The value is the
+    p95 of per-route re-dispatch latency — seconds from the observed
+    kill to each drained route being re-acked on a survivor (breaker
+    detection + fence + drain + handoff, the whole failure path). The
+    arm also asserts every route converges to ADMITTED, no submitted
+    workload is lost across the kill, and the aggregated event stream
+    keeps relaying survivor events after the cell death."""
+    import shutil
+    import tempfile
+
+    from kueue_tpu.bench.scenario import baseline_like
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.federation import CellHandle, FederationDispatcher
+    from kueue_tpu.federation.aggregator import EventAggregator
+    from kueue_tpu.federation.cells import HTTPCellTransport
+    from kueue_tpu.store.journal import attach_new_journal, rebuild_engine
+    from kueue_tpu.visibility.fanout import FanoutHub
+
+    workdir = tempfile.mkdtemp(prefix="bench-fed-")
+    cells = ("cell-a", "cell-b", "cell-c")
+    scen = baseline_like(n_cohorts=2, cqs_per_cohort=2,
+                         n_workloads=n_workloads,
+                         nominal_per_cq=20_000 * n_workloads,
+                         sized_to_fit=True)
+    world = os.path.join(workdir, "world.jsonl")
+    eng = Engine()
+    attach_new_journal(eng, world)
+    for rf in scen.flavors:
+        eng.create_resource_flavor(rf)
+    for co in scen.cohorts:
+        eng.create_cohort(co)
+    for cq in scen.cluster_queues:
+        eng.create_cluster_queue(cq)
+    for lq in scen.local_queues:
+        eng.create_local_queue(lq)
+    eng.journal.sync()
+
+    def spawn(name, logf):
+        journal = os.path.join(workdir, f"{name}.jsonl")
+        shutil.copy(world, journal)
+        cmd = [sys.executable, "-m", "kueue_tpu.serve", "--ha",
+               "--journal", journal, "--lease", journal + ".lease",
+               "--replica-id", name, "--oracle", "off",
+               "--http", "127.0.0.1:0", "--tick", "0.05",
+               "--lease-duration", "1.5"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+        return subprocess.Popen(cmd, stdout=logf,
+                                stderr=subprocess.STDOUT, env=env)
+
+    def wait_line(path, needle, proc, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                text = open(path).read()
+            except FileNotFoundError:
+                text = ""
+            if needle in text:
+                return text
+            if proc.poll() is not None and needle not in text:
+                raise RuntimeError(
+                    f"cell died (rc={proc.returncode}) before "
+                    f"{needle!r}: {text[-500:]}")
+            time.sleep(0.05)
+        raise RuntimeError(f"timeout waiting for {needle!r}")
+
+    def port_of(path, proc):
+        line = next(ln for ln in wait_line(
+            path, "serving on", proc).splitlines() if "serving on" in ln)
+        return int(line.split("serving on", 1)[1].split("(", 1)[0]
+                   .strip().rsplit(":", 1)[1])
+
+    procs, hub, aggregator, dispatcher = {}, None, None, None
+    try:
+        ports = {}
+        for name in cells:
+            log_path = os.path.join(workdir, f"{name}.log")
+            with open(log_path, "w") as lf:
+                procs[name] = spawn(name, lf)
+            wait_line(log_path, "ha: role=leader", procs[name])
+            ports[name] = port_of(log_path, procs[name])
+        handles = [CellHandle(
+            name, HTTPCellTransport(f"http://127.0.0.1:{ports[name]}",
+                                    timeout=3.0),
+            probe_interval_ticks=1, breaker_threshold=2,
+            breaker_cooldown_ticks=2) for name in cells]
+        hub = FanoutHub(shards=2)
+        dispatcher = FederationDispatcher(
+            os.path.join(workdir, "dispatcher.jsonl"), handles,
+            hub=hub, confirm_interval_ticks=1)
+        aggregator = EventAggregator(dispatcher.cells.values(), hub,
+                                     reconnect_seconds=0.5)
+        aggregator.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            dispatcher.tick(time.time())
+            if all(c.up for c in dispatcher.cells.values()):
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("cells never all came up")
+
+        kill_at = n_workloads // 2
+        t_kill = None
+        victim = None
+        drained_keys: set = set()
+        relays_at_kill: dict = {}
+        for i, wl in enumerate(scen.workloads, start=1):
+            verdict = dispatcher.submit(wl, time.time())
+            if verdict.get("code") not in (200, 201, 202):
+                raise RuntimeError(f"submit refused: {verdict}")
+            dispatcher.tick(time.time())
+            if i == kill_at:
+                # Kill the busiest cell: the one holding the most
+                # not-yet-confirmed routes (maximum drained work);
+                # fall back to total routes if everything confirmed.
+                pending = {name: 0 for name in cells}
+                for rec in dispatcher.routes.values():
+                    pending[rec["cell"]] += (
+                        1 if rec["state"] != "admitted" else 0)
+                if not any(pending.values()):
+                    for rec in dispatcher.routes.values():
+                        pending[rec["cell"]] += 1
+                victim = max(sorted(pending), key=lambda c: pending[c])
+                drained_keys = {
+                    k for k, rec in dispatcher.routes.items()
+                    if rec["cell"] == victim
+                    and rec["state"] != "admitted"}
+                relays_at_kill = aggregator.stats()
+                procs[victim].kill()
+                procs[victim].wait()
+                t_kill = time.monotonic()
+
+        # Converge: every drained route re-acked on a survivor, every
+        # route ADMITTED. Per-route re-dispatch latency is measured
+        # the moment the route leaves INTENT on a non-victim cell.
+        latencies: dict = {}
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            dispatcher.tick(time.time())
+            now = time.monotonic()
+            for k in drained_keys - set(latencies):
+                rec = dispatcher.routes.get(k)
+                if (rec is not None and rec["cell"] != victim
+                        and rec["state"] != "intent"):
+                    latencies[k] = now - t_kill
+            counts = dispatcher.route_counts()
+            if counts.get("admitted", 0) == n_workloads:
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError(
+                f"routes never converged: {dispatcher.route_counts()}")
+
+        # Aggregated SSE view stayed live: survivor tailers kept
+        # relaying events after the cell death. Tailer threads can lag
+        # the dispatcher's convergence by a beat; give them a grace
+        # window before calling the stream dark.
+        grace = time.monotonic() + 10
+        sse_gain: dict = {}
+        while time.monotonic() < grace:
+            relays_after = aggregator.stats()
+            sse_gain = {
+                name: (relays_after.get(name, {}).get("relayed", 0)
+                       - relays_at_kill.get(name, {}).get("relayed", 0))
+                for name in cells if name != victim}
+            if any(v > 0 for v in sse_gain.values()):
+                break
+            time.sleep(0.1)
+        else:
+            raise RuntimeError(
+                f"aggregated SSE stream went dark after the kill: "
+                f"{sse_gain}")
+
+        # Zero lost: victim's durable story + survivors' live stories
+        # must cover every submitted workload. (Disjointness is the
+        # zombie-rejoin reconcile's job — tools/federation_smoke.py —
+        # and the victim never rejoins in this arm.)
+        covered: set = set()
+        for cell in dispatcher.cells.values():
+            if cell.name == victim:
+                continue
+            for w in cell.transport.workloads():
+                if w.get("status") in ("Admitted", "QuotaReserved",
+                                       "Finished"):
+                    covered.add(f"{w['namespace']}/{w['name']}")
+        reb = rebuild_engine(os.path.join(workdir, f"{victim}.jsonl"))
+        covered |= {k for k, w in reb.workloads.items()
+                    if w.status.admission is not None}
+        lost = {wl.key for wl in scen.workloads} - covered
+
+        vals = sorted(latencies.values())
+        p95 = vals[int(0.95 * (len(vals) - 1))] if vals else 0.0
+        p50 = vals[len(vals) // 2] if vals else 0.0
+        return {
+            "value": round(p95, 3), "unit": "s redispatch (p95)",
+            "vs_baseline": None,
+            "detail": {
+                "workloads": n_workloads, "victim": victim,
+                "drained_routes": len(drained_keys),
+                "redispatch_p50_s": round(p50, 3),
+                "redispatches": dispatcher.redispatches,
+                "sse_relayed_after_kill": sse_gain,
+                "zero_lost": not lost,
+                "lost": sorted(lost)[:5],
+            },
+        }
+    finally:
+        if aggregator is not None:
+            aggregator.stop()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        if dispatcher is not None:
+            dispatcher.close()
+        if hub is not None:
+            hub.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_recovery_time(waves_small=60, waves_large=600, repeats=3):
     """Bounded-time recovery (store/checkpoint.py): cold-start cost via
     sealed checkpoint + journal suffix vs a full genesis replay, at two
@@ -1486,6 +1712,8 @@ def main() -> None:
     run_scenario("ha_failover", lambda: bench_ha_failover(
         n_clients=128 if fast else 1000,
         n_workloads=120 if fast else 400), min_budget_s=90.0)
+    run_scenario("federation_failover", lambda: bench_federation_failover(
+        n_workloads=40 if fast else 96), min_budget_s=90.0)
     run_scenario("recovery_time", lambda: bench_recovery_time(
         waves_small=30 if fast else 60,
         waves_large=300 if fast else 600,

@@ -375,6 +375,14 @@ class Kueuectl:
         return VERSION
 
 
+def _endpoint_url(endpoint: str, path: str) -> str:
+    """Accept both host:port and full http://host:port endpoints."""
+    base = endpoint.rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+    return base + path
+
+
 def _parse_quota_pairs(pairs: list[str]) -> dict:
     """--nominal-quota flavor:resource=value [...]"""
     out = {}
@@ -507,6 +515,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="lease file for offline inspection "
                          "(default: <journal>.lease)")
     st.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the raw status dict")
+
+    cl = sub.add_parser(
+        "cells",
+        help="federation cell status: per-cell health, breaker state, "
+             "fence epoch and route-state counts (kueue_tpu/federation)."
+             " Query a live dispatcher with --endpoint, or fold a "
+             "dispatcher route journal offline with --journal")
+    cl.add_argument("--endpoint",
+                    help="base URL of a live federation dispatcher "
+                         "(e.g. http://127.0.0.1:8080): queries /cells")
+    cl.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the raw status dict")
 
     tr = sub.add_parser(
@@ -691,7 +711,7 @@ def run(engine, argv: list[str]) -> str:
         if args.endpoint:
             # Live replica: /debug/ha is the authoritative view.
             import urllib.request
-            url = args.endpoint.rstrip("/") + "/debug/ha"
+            url = _endpoint_url(args.endpoint, "/debug/ha")
             with urllib.request.urlopen(url, timeout=5) as resp:
                 status = json.loads(resp.read())
         elif getattr(engine, "ha", None) is not None:
@@ -750,6 +770,74 @@ def run(engine, argv: list[str]) -> str:
             lines.append(
                 f"shedder: accepted={sh['accepted']} shed={sh['shed']} "
                 f"factor={sh['factor']}")
+        return "\n".join(lines)
+    if args.command == "cells":
+        if args.endpoint:
+            # Live dispatcher: /cells is the authoritative view.
+            import urllib.request
+            url = _endpoint_url(args.endpoint, "/cells")
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                status = json.loads(resp.read())
+        else:
+            # Offline: fold the dispatcher's route journal directly.
+            # fed_route/fed_cell are EPHEMERAL_KINDS, so the engine
+            # rebuild skipped them — replay the raw record stream.
+            journal = getattr(engine, "journal", None)
+            if journal is None:
+                raise SystemExit(
+                    "kueuectl cells needs --endpoint or --journal "
+                    "pointed at a dispatcher route journal")
+            routes: dict = {}
+            epochs: dict = {}
+            for rec in journal.replay():
+                obj = rec.get("obj", {})
+                if rec["kind"] == "fed_route":
+                    if rec["op"] == "delete":
+                        routes.pop(rec["key"], None)
+                    else:
+                        routes[obj["name"]] = obj
+                elif rec["kind"] == "fed_cell" and rec["op"] != "delete":
+                    epochs[obj["name"]] = obj
+            per_cell: dict = {}
+            route_counts: dict = {}
+            for r in routes.values():
+                d = per_cell.setdefault(r["cell"], {})
+                d[r["state"]] = d.get(r["state"], 0) + 1
+                route_counts[r["state"]] = (
+                    route_counts.get(r["state"], 0) + 1)
+            status = {
+                "offline": True, "routes": route_counts,
+                "cells": [dict(name=n, epoch=st.get("epoch", 1),
+                               up=st.get("up"),
+                               routes=per_cell.get(n, {}))
+                          for n, st in sorted(epochs.items())]}
+            for name in sorted(set(per_cell) - set(epochs)):
+                status["cells"].append(
+                    dict(name=name, epoch=1, up=None,
+                         routes=per_cell[name]))
+        if args.as_json:
+            return json.dumps(status, indent=2)
+        lines = []
+        rc = status.get("routes", {})
+        lines.append(
+            "routes: " + (", ".join(
+                f"{s}={rc[s]}" for s in sorted(rc)) or "none"))
+        if "handoffs" in status:
+            lines.append(
+                f"handoffs: {status['handoffs']} "
+                f"redispatches: {status.get('redispatches', 0)} "
+                f"revocations: {status.get('revocations', 0)}")
+        header = (f"{'CELL':<16} {'UP':<6} {'EPOCH':>6} "
+                  f"{'BREAKER':<10} ROUTES")
+        lines.append(header)
+        for c in status.get("cells", []):
+            up = {True: "yes", False: "no"}.get(c.get("up"), "?")
+            breaker = (c.get("breaker") or {}).get("state", "-")
+            rts = ", ".join(f"{s}={n}" for s, n in
+                            sorted((c.get("routes") or {}).items()))
+            lines.append(f"{c['name']:<16} {up:<6} "
+                         f"{c.get('epoch', 1):>6} {breaker:<10} "
+                         f"{rts or '-'}")
         return "\n".join(lines)
     if args.command == "trace":
         if args.trace_command != "export":

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import threading
 import time as _time
+from collections import OrderedDict
 from typing import Callable, Optional
 
 from kueue_tpu.ha.digest import DigestChain, admitted_state_digest, \
@@ -58,7 +59,8 @@ class HAReplica:
                  checkpoint_keep: int = 2,
                  segment_rotate_bytes: Optional[int] = None,
                  segment_rotate_records: Optional[int] = None,
-                 retain_segments: bool = True):
+                 retain_segments: bool = True,
+                 dedup_capacity: int = 4096):
         self.journal_path = journal_path
         # Bounded-time recovery knobs (store/checkpoint.py): a leader
         # with checkpoint_interval > 0 writes sealed checkpoints every
@@ -89,7 +91,22 @@ class HAReplica:
         # cycle listener once the admission is durably journaled (from
         # then on engine.workloads + the journal answer retries), so
         # the map stays O(in-flight), not O(every name ever submitted).
-        self._inflight_submits: dict = {}
+        # ``dedup_capacity`` is the hard backstop on top of that:
+        # insertion order (OrderedDict) evicts the OLDEST entry at the
+        # bound, so a submit storm that outruns the cycle listener
+        # cannot grow the map without limit. An evicted key whose
+        # workload is also gone from engine.workloads re-acks as a
+        # fresh 201, not a stale idempotent 200 — pinned by
+        # tests/test_ha_replica.py.
+        self.dedup_capacity = max(1, int(dedup_capacity))
+        self._inflight_submits: OrderedDict = OrderedDict()
+        # Federation fencing surface: key -> fence epoch at revocation.
+        # A handoff replay carrying a route epoch <= the recorded one
+        # is refused with 409 (the zombie double-admit guard); a NEWER
+        # epoch means the dispatcher deliberately routed the key back
+        # here and clears the tombstone.
+        self._revoked: dict = {}
+        self.route_epoch = 0
         self.engine = None              # live engine (leader only)
         self.digest_chain: Optional[DigestChain] = None
         self.promotion_report: Optional[dict] = None
@@ -271,16 +288,36 @@ class HAReplica:
 
     # -- the write front door (HTTP POST /workloads lands here) --
 
-    def submit(self, workload, now: float) -> dict:
-        """Leader check, then dedup, then shed check, then
-        Engine.submit. Shed requests never reach the engine — they must
-        not become flight-recorder input frames (replay would
-        diverge)."""
+    def submit(self, workload, now: float,
+               route_epoch: Optional[int] = None) -> dict:
+        """Leader check, then fencing, then dedup, then shed check,
+        then Engine.submit. Shed requests never reach the engine — they
+        must not become flight-recorder input frames (replay would
+        diverge). ``route_epoch`` is the federation dispatcher's fence
+        epoch for this cell (X-Route-Epoch): a handoff for a revoked
+        key at a stale epoch is refused so a zombie cell rejoining the
+        federation cannot double-admit."""
         if not self.roles.is_leader or self.engine is None:
             lease = self.lease.read()
-            return {"accepted": False, "code": 503,
-                    "reason": f"not leader (role={self.roles.role})",
-                    "leaderHint": lease.holder if lease else ""}
+            out = {"accepted": False, "code": 503,
+                   "reason": f"not leader (role={self.roles.role})",
+                   "leaderHint": lease.holder if lease else ""}
+            if self.shedder is not None:
+                # Same clamped backoff guidance as the 429 path, so
+                # failover-window retries stay jittered + bounded.
+                out["retryAfter"] = self.shedder.retry_after_hint()
+            return out
+        if route_epoch is not None:
+            self.route_epoch = max(self.route_epoch, int(route_epoch))
+            fenced_at = self._revoked.get(workload.key)
+            if fenced_at is not None:
+                if int(route_epoch) <= fenced_at:
+                    return {"accepted": False, "code": 409,
+                            "reason": f"fenced: revoked at epoch "
+                                      f"{fenced_at}",
+                            "workload": workload.name,
+                            "fencedEpoch": fenced_at}
+                del self._revoked[workload.key]
         if (workload.key in self._inflight_submits
                 or workload.key in self.engine.workloads):
             # Idempotent retry: a client that lost its 201 to a leader
@@ -303,8 +340,38 @@ class HAReplica:
                         "factor": verdict["factor"]}
         self.engine.submit(workload)
         self._inflight_submits[workload.key] = now
+        while len(self._inflight_submits) > self.dedup_capacity:
+            # Oldest-entry eviction at the capacity bound: the oldest
+            # in-flight entry is the most likely to already be durable
+            # (answered by engine.workloads + the journal on retry).
+            self._inflight_submits.popitem(last=False)
         return {"accepted": True, "code": 201,
                 "workload": workload.name}
+
+    def revoke(self, keys, epoch: int, now: float) -> dict:
+        """Federation fencing: tombstone ``keys`` at ``epoch`` and
+        delete any that this cell registered (journaled delete, usage
+        released) — the cell side of zombie-rejoin reconciliation. The
+        tombstone outlives the delete so a late handoff replay at a
+        stale route epoch gets 409, not a fresh admission."""
+        if not self.roles.is_leader or self.engine is None:
+            return {"accepted": False, "code": 503,
+                    "reason": f"not leader (role={self.roles.role})"}
+        from kueue_tpu.cli.kueuectl import Kueuectl
+
+        ctl = Kueuectl(self.engine)
+        deleted = []
+        for key in keys:
+            self._revoked[key] = max(self._revoked.get(key, 0),
+                                     int(epoch))
+            self._inflight_submits.pop(key, None)
+            if key in self.engine.workloads:
+                ctl.delete_workload(key)
+                deleted.append(key)
+        if deleted and self.engine.journal is not None:
+            self.engine.journal.sync()
+        return {"accepted": True, "code": 200, "epoch": int(epoch),
+                "revoked": len(keys), "deleted": deleted}
 
     def _evict_submit_dedup(self, seq: int, result) -> None:
         """Post-sync cycle listener (runs AFTER journal.sync, so this
@@ -359,6 +426,16 @@ class HAReplica:
         if self.engine is not None:
             out["stateDigest"] = admitted_state_digest(self.engine)
             out["inflightSubmits"] = len(self._inflight_submits)
+            out["dedupCapacity"] = self.dedup_capacity
+            # Federation routing inputs: registered/admitted load is
+            # the dispatcher's quota-headroom proxy; revoked/routeEpoch
+            # surface the fencing state for kueuectl cells.
+            out["workloads"] = len(self.engine.workloads)
+            out["admittedWorkloads"] = sum(
+                1 for w in self.engine.workloads.values()
+                if w.status.admission is not None and not w.is_finished)
+            out["revoked"] = len(self._revoked)
+            out["routeEpoch"] = self.route_epoch
             if self.digest_chain is not None:
                 out["decisionDigest"] = self.digest_chain.digest
                 out["digestSeq"] = self.digest_chain.last_seq

@@ -56,9 +56,20 @@ class AdmissionShedder:
     GIL serializes the float updates; drift under contention only
     mis-sizes the bucket by a token, never corrupts it)."""
 
+    # Hard ceiling on any Retry-After this shedder hands out, in
+    # seconds. The jittered delay is 1/(rate*factor) scaled by the
+    # jitter band, and a breached SLO can squeeze factor to 0.05 — at
+    # low configured rates the "mean inter-admission gap" blows up to
+    # minutes, which is not backoff guidance but a client lockout. 30 s
+    # also bounds the 503 hint during failover windows: a lease expiry
+    # plus replay-verified promotion completes well inside it, so a
+    # clamped retry lands after the new leader is serving.
+    RETRY_AFTER_MAX = 30.0
+
     def __init__(self, rate: float = 200.0, burst: Optional[float] = None,
                  slo=None, metrics=None, hub=None,
-                 retry_jitter: float = 0.5, rng=None):
+                 retry_jitter: float = 0.5, rng=None,
+                 retry_after_max: Optional[float] = None):
         import random
         self.bucket = TokenBucket(rate, burst)
         self.slo = slo
@@ -72,6 +83,9 @@ class AdmissionShedder:
         # wave (thundering herd after a failover). Each 429 gets
         # base * uniform(1-j, 1+j) instead — same mean, decorrelated.
         self.retry_jitter = max(0.0, min(1.0, float(retry_jitter)))
+        self.retry_after_max = float(
+            retry_after_max if retry_after_max is not None
+            else self.RETRY_AFTER_MAX)
         self._rng = rng if rng is not None else random.Random()
 
     def _factor(self) -> float:
@@ -108,16 +122,24 @@ class AdmissionShedder:
                 import json
                 self.hub.publish("admission_shed", json.dumps({
                     "reason": reason, "factor": round(self.factor, 4)}))
-        retry = 0.0
-        if not ok:
-            base = 1.0 / max(1e-6, self.bucket.rate * self.factor)
-            j = self.retry_jitter
-            retry = round(base * self._rng.uniform(1.0 - j, 1.0 + j), 3)
+        retry = self.retry_after_hint() if not ok else 0.0
         return {"accepted": ok, "factor": self.factor,
                 "retryAfter": retry}
+
+    def retry_after_hint(self) -> float:
+        """One jittered, clamped Retry-After value. Shared by the 429
+        shed path and the 503 failover path (ha/replica.py submit off-
+        leader), so client backoff guidance is consistent across both:
+        base 1/(rate*factor) scaled by the jitter band, never above
+        ``retry_after_max``."""
+        base = 1.0 / max(1e-6, self.bucket.rate * self.factor)
+        j = self.retry_jitter
+        retry = round(base * self._rng.uniform(1.0 - j, 1.0 + j), 3)
+        return min(retry, self.retry_after_max)
 
     def status(self) -> dict:
         return {"accepted": self.accepted, "shed": self.shed,
                 "factor": round(self.factor, 4),
                 "rate": self.bucket.rate, "burst": self.bucket.burst,
-                "tokens": round(self.bucket.tokens, 3)}
+                "tokens": round(self.bucket.tokens, 3),
+                "retryAfterMax": self.retry_after_max}

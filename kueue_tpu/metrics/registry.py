@@ -310,6 +310,23 @@ class MetricsRegistry:
           "breaker transitions per (from, to)")
         g("oracle_breaker_state",
           "breaker state (0 closed | 1 open | 2 half-open)")
+        # Federation dispatcher (kueue_tpu/federation): per-cell health
+        # and breaker lifecycle, route-state population, and the
+        # dispatch/redispatch/revocation flow of cross-cell handoffs.
+        g("federation_cell_up", "cell availability per cell (0|1)")
+        g("federation_cell_breaker_state",
+          "per-cell breaker state (0 closed | 1 open | 2 half-open)")
+        c("federation_breaker_transitions_total",
+          "per-cell breaker transitions per (cell, from, to)")
+        c("federation_dispatch_total",
+          "workload handoffs attempted per (cell, outcome)")
+        c("federation_redispatch_total",
+          "routes re-pointed off a drained/dead cell per cell")
+        c("federation_revocations_total",
+          "zombie-cell admissions revoked on rejoin per cell")
+        g("federation_routes", "routes per state (intent|acked|admitted)")
+        h("federation_handoff_latency_seconds",
+          "intent-durable to cell-ack latency per cell")
         self.gauge("build_info").set(
             (("name", "kueue_tpu"), ("version", "0.2.0")), 1)
 

@@ -47,6 +47,13 @@ Environment:
                            (--segment-records / --segment-bytes; 0 =
                            off). Sealed segments older than the oldest
                            live checkpoint are reclaimed by retention
+  KUEUE_TPU_FEDERATE       cell spec "name[@zone]=URL,..." (--federate):
+                           run this process as a FEDERATION DISPATCHER
+                           instead of an engine — no local engine; POST
+                           /workloads routes to member cells with a
+                           durable route journal (--journal), per-cell
+                           breakers, whole-cell drain and zombie
+                           fencing (kueue_tpu/federation)
 """
 
 from __future__ import annotations
@@ -80,6 +87,10 @@ def main(argv=None) -> None:
                         default=os.environ.get("KUEUE_TPU_TRACE"))
     parser.add_argument("--ha", action="store_true",
                         default=os.environ.get("KUEUE_TPU_HA") == "1")
+    parser.add_argument("--federate",
+                        default=os.environ.get("KUEUE_TPU_FEDERATE"),
+                        help="run as a federation dispatcher over cells"
+                             ' "name[@zone]=URL,..." (no local engine)')
     parser.add_argument("--replica-id",
                         default=os.environ.get("KUEUE_TPU_REPLICA_ID"))
     parser.add_argument("--lease",
@@ -118,6 +129,9 @@ def main(argv=None) -> None:
     from kueue_tpu.store.journal import rebuild_engine
     from kueue_tpu.visibility.http_server import ServingEndpoint
 
+    if args.federate:
+        _main_federation(args)
+        return
     if args.ha:
         _main_ha(args)
         return
@@ -188,6 +202,80 @@ def main(argv=None) -> None:
     if recorder is not None:
         recorder.close()
     endpoint.stop()
+
+
+def _main_federation(args) -> None:
+    """Federation dispatcher mode: this process owns no engine. It
+    routes POST /workloads to member cells (each a serve --ha deployment
+    reached over HTTP), journals every route intent to ``--journal``
+    before the handoff leaves the process, probes cell health through
+    per-cell circuit breakers, drains a dead cell's unconfirmed routes
+    to survivors, and fences zombie rejoins. The aggregated /events SSE
+    stream republishes every member cell's events tagged with the cell
+    name."""
+    from kueue_tpu.federation import (
+        CellHandle,
+        FederationDispatcher,
+        HTTPCellTransport,
+    )
+    from kueue_tpu.federation.aggregator import EventAggregator
+    from kueue_tpu.metrics.registry import MetricsRegistry
+    from kueue_tpu.visibility.fanout import FanoutHub
+    from kueue_tpu.visibility.http_server import ServingEndpoint
+
+    token = os.environ.get("KUEUE_TPU_AUTH_TOKEN")
+    registry = MetricsRegistry()
+    cells = []
+    for spec in args.federate.split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        ident, sep, url = spec.partition("=")
+        if not sep or not url:
+            raise SystemExit(f"bad --federate cell spec {spec!r}"
+                             ' (want "name[@zone]=URL")')
+        name, _, zone = ident.partition("@")
+        cells.append(CellHandle(
+            name.strip(), HTTPCellTransport(url.strip(),
+                                            auth_token=token),
+            zone=zone.strip(), metrics=registry))
+    if not cells:
+        raise SystemExit('--federate requires "name[@zone]=URL,..."')
+
+    hub = FanoutHub(shards=args.fanout_shards)
+    hub.metrics = registry
+    dispatcher = FederationDispatcher(
+        args.journal, cells, metrics=registry, hub=hub)
+    aggregator = EventAggregator(cells, hub)
+    aggregator.start()
+
+    host, _, port = args.http.rpartition(":")
+    endpoint = ServingEndpoint(
+        None, host=host or "0.0.0.0", port=int(port),
+        auth_token=token, hub=hub, federation=dispatcher)
+    endpoint.start()
+    print(f"kueue-tpu federation dispatcher serving on "
+          f"{host or '0.0.0.0'}:{endpoint.port} "
+          f"(journal={args.journal}, cells={len(cells)})", flush=True)
+    for c in cells:
+        print(f"federation: cell={c.name} zone={c.zone or '-'} "
+              f"url={c.transport.base_url}", flush=True)
+
+    stop = {"flag": False}
+
+    def _stop(*_a):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+
+    while not stop["flag"]:
+        dispatcher.tick(time.time())
+        time.sleep(args.tick)
+    aggregator.stop()
+    dispatcher.close()
+    endpoint.stop()
+    hub.close()
 
 
 def _main_ha(args) -> None:

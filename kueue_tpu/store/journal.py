@@ -748,7 +748,12 @@ _CREATE = {
 # explicit special case above; graftlint rule R1 enforces the union.
 # ``ha_digest`` is the HA failover checkpoint (kueue_tpu/ha/digest.py):
 # pure verification rationale — promotion READS it, rebuild skips it.
-EPHEMERAL_KINDS = frozenset({"cycle_trace", "ha_digest"})
+# ``fed_route`` / ``fed_cell`` are the federation dispatcher's durable
+# route intents and cell fencing epochs (kueue_tpu/federation): they
+# describe WHERE workloads were sent, not engine state — the dispatcher
+# folds them itself on restart; an engine rebuild must skip them.
+EPHEMERAL_KINDS = frozenset(
+    {"cycle_trace", "ha_digest", "fed_route", "fed_cell"})
 
 
 def engine_from_records(records, engine=None, **engine_kwargs):

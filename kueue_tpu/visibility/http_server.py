@@ -27,7 +27,7 @@ from kueue_tpu.visibility.server import (
 
 def make_handler(engine, auth_token=None, apf=None,
                  heartbeat_seconds: float = 15.0, hub=None,
-                 replica=None):
+                 replica=None, federation=None):
     # ``engine`` may be the object itself or a zero-arg callable
     # resolving to it: HA promotion SWAPS the engine (a follower's read
     # model becomes a leader's live engine), so handlers must resolve
@@ -241,11 +241,32 @@ def make_handler(engine, auth_token=None, apf=None,
                 self._send('{"error":"unauthorized"}', code=401)
                 return
             path = urlparse(self.path).path.rstrip("/")
+            import time as _time
+
+            if path == "/federation/revoke":
+                # Cell-side fencing surface: the dispatcher revokes keys
+                # it re-routed away from this (zombie) cell before the
+                # cell re-enters rotation. Only meaningful with an HA
+                # replica in front of the engine.
+                if replica is None:
+                    self._send('{"error":"not federated"}', code=404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length))
+                    keys = list(body["keys"])
+                    epoch = int(body["epoch"])
+                except Exception as e:  # noqa: BLE001 — client error
+                    self._send(json.dumps(
+                        {"error": f"bad revoke body: {e}"}), code=400)
+                    return
+                verdict = replica.revoke(keys, epoch, _time.time())
+                self._send(json.dumps(verdict),
+                           code=verdict.pop("code", 500))
+                return
             if path != "/workloads":
                 self._send('{"error":"not found"}', code=404)
                 return
-            import time as _time
-
             from kueue_tpu.api.serde import from_jsonable
             try:
                 length = int(self.headers.get("Content-Length", 0))
@@ -254,16 +275,38 @@ def make_handler(engine, auth_token=None, apf=None,
                 self._send(json.dumps(
                     {"error": f"bad workload body: {e}"}), code=400)
                 return
-            if replica is not None:
-                verdict = replica.submit(wl, _time.time())
+            if federation is not None:
+                verdict = federation.submit(wl, _time.time())
                 code = verdict.pop("code", 500)
                 data = json.dumps(verdict).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
-                if code == 429:
+                if code in (429, 503) and verdict.get("retryAfter"):
                     self.send_header(
                         "Retry-After",
-                        str(max(1, int(verdict.get("retryAfter", 1)))))
+                        str(max(1, int(verdict["retryAfter"]))))
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            if replica is not None:
+                route_epoch = None
+                hdr = self.headers.get("X-Route-Epoch")
+                if hdr is not None:
+                    try:
+                        route_epoch = int(hdr)
+                    except ValueError:
+                        pass  # malformed header: treat as non-federated
+                verdict = replica.submit(wl, _time.time(),
+                                         route_epoch=route_epoch)
+                code = verdict.pop("code", 500)
+                data = json.dumps(verdict).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                if code in (429, 503) and verdict.get("retryAfter"):
+                    self.send_header(
+                        "Retry-After",
+                        str(max(1, int(verdict["retryAfter"]))))
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -271,6 +314,14 @@ def make_handler(engine, auth_token=None, apf=None,
             engine = resolve()
             if engine is None:
                 self._send('{"error":"no engine"}', code=503)
+                return
+            if wl.key in engine.workloads:
+                # Same dedup contract as the HA front door: the
+                # federation dispatcher's at-least-once resend must be
+                # idempotent even against a bare (non-HA) cell.
+                self._send(json.dumps({
+                    "accepted": True, "deduplicated": True,
+                    "workload": wl.name}), code=200)
                 return
             shedder = getattr(engine, "shedder", None)
             if shedder is not None:
@@ -295,6 +346,24 @@ def make_handler(engine, auth_token=None, apf=None,
 
         def _serve_get(self):
             engine = resolve()
+            fpath = urlparse(self.path).path.rstrip("/")
+            if federation is not None:
+                # The dispatcher tier has no engine of its own: its
+                # routes are served from FederationDispatcher state and
+                # must not fall through to the engine-backed views.
+                if fpath in ("/cells", "/debug/federation"):
+                    self._send(json.dumps(federation.status()))
+                    return
+                if engine is None:
+                    if fpath == "/healthz":
+                        self._send('{"status":"ok"}')
+                    elif fpath == "/metrics" and (
+                            federation.metrics is not None):
+                        self._send(federation.metrics.render(),
+                                   content_type="text/plain")
+                    else:
+                        self._send('{"error":"not found"}', code=404)
+                    return
             if engine is None:
                 # A follower that hasn't built its read model yet.
                 self._send('{"error":"no read model yet"}', code=503)
@@ -423,11 +492,12 @@ class ServingEndpoint:
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  cert_dir: str = None, auth_token: str = None,
                  flow_control=True, heartbeat_seconds: float = 15.0,
-                 hub=None, replica=None):
+                 hub=None, replica=None, federation=None):
         from kueue_tpu.visibility.flowcontrol import APFDispatcher
         self.apf = None
         self.hub = hub
         self.replica = replica
+        self.federation = federation
         if flow_control:
             self.apf = (flow_control if isinstance(
                 flow_control, APFDispatcher) else APFDispatcher())
@@ -435,7 +505,7 @@ class ServingEndpoint:
             (host, port), make_handler(
                 engine, auth_token=auth_token, apf=self.apf,
                 heartbeat_seconds=heartbeat_seconds, hub=hub,
-                replica=replica))
+                replica=replica, federation=federation))
         self.tls = cert_dir is not None
         if cert_dir is not None:
             import ssl

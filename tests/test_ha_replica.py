@@ -447,6 +447,45 @@ def test_shedder_retry_after_jitter_decorrelates():
     assert sh0.admit(0.0)["retryAfter"] == pytest.approx(1.0)
 
 
+def test_shedder_retry_after_clamped():
+    """1/(rate*factor) at low rates is a lockout, not guidance: the
+    hint is capped at RETRY_AFTER_MAX (or the per-shedder override),
+    even at the jitter band's top."""
+    sh = AdmissionShedder(rate=0.001, burst=1.0, retry_jitter=0.5,
+                          rng=_FixedRng(frac=1.0))
+    assert sh.admit(0.0)["accepted"]
+    verdict = sh.admit(0.0)  # base delay would be 1000 s * 1.5
+    assert not verdict["accepted"]
+    assert verdict["retryAfter"] == AdmissionShedder.RETRY_AFTER_MAX
+    assert sh.retry_after_hint() == AdmissionShedder.RETRY_AFTER_MAX
+    assert sh.status()["retryAfterMax"] == 30.0
+    # Per-shedder override tightens the ceiling.
+    sh5 = AdmissionShedder(rate=0.001, burst=1.0, retry_after_max=5.0,
+                           rng=_FixedRng(frac=1.0))
+    sh5.admit(0.0)
+    assert sh5.admit(0.0)["retryAfter"] == 5.0
+
+
+def test_follower_503_carries_clamped_retry_after(tmp_path):
+    """The 503 failover window gives the same clamped, jittered
+    backoff guidance as the 429 shed path, so clients retrying into a
+    mid-election cell stay decorrelated and bounded."""
+    journal = str(tmp_path / "ha.jsonl")
+    lease = journal + ".lease"
+    leader = HAReplica(journal, lease, "ldr", lease_duration=5.0,
+                       renew_in_background=False)
+    leader.step(0.0)
+    follower = HAReplica(journal, lease, "fol", lease_duration=5.0,
+                         renew_in_background=False,
+                         shedder=AdmissionShedder(rate=0.001, burst=1.0))
+    follower.step(1.0)
+    out = follower.submit(Workload(
+        name="w", queue_name="lq0",
+        pod_sets=(PodSet("main", 1, {"cpu": 100}),)), now=1.0)
+    assert out["code"] == 503
+    assert 0 < out["retryAfter"] <= AdmissionShedder.RETRY_AFTER_MAX
+
+
 def test_submit_dedup_map_stays_bounded(tmp_path):
     """The in-flight submit map fronts engine.workloads for idempotent
     retries, and the post-sync evictor keeps it O(in-flight): admitted
@@ -469,6 +508,40 @@ def test_submit_dedup_map_stays_bounded(tmp_path):
     out = leader.submit(wls[0], now=1.0)
     assert out["code"] == 200 and out["deduplicated"]
     assert len(leader._inflight_submits) == 0
+
+
+def test_submit_dedup_capacity_evicts_oldest(tmp_path):
+    """The capacity backstop: a submit storm that outruns the cycle
+    evictor caps the map by dropping the OLDEST entries, and an
+    evicted key whose workload is also gone from the engine re-acks
+    as a fresh 201, not a stale idempotent 200."""
+    from kueue_tpu.cli.kueuectl import Kueuectl
+
+    journal = str(tmp_path / "ha.jsonl")
+    leader = HAReplica(journal, journal + ".lease", "ldr",
+                       lease_duration=5.0, renew_in_background=False,
+                       dedup_capacity=4)
+    leader.step(0.0)
+    build_world(leader.engine)
+    wls = [Workload(name=f"c{i}", queue_name="lq0",
+                    pod_sets=(PodSet("main", 1, {"cpu": 100}),))
+           for i in range(6)]
+    for wl in wls:
+        assert leader.submit(wl, now=0.0)["code"] == 201
+    # Pinned AT capacity: insertion-ordered eviction dropped c0/c1.
+    assert list(leader._inflight_submits) == [
+        "default/c2", "default/c3", "default/c4", "default/c5"]
+    # An evicted key still pending in the engine dedups via
+    # engine.workloads — eviction never re-opens the double-submit
+    # window for live work.
+    out = leader.submit(wls[0], now=1.0)
+    assert out["code"] == 200 and out["deduplicated"]
+    assert len(leader._inflight_submits) == 4
+    # Evicted AND deleted from the engine: the name is genuinely free
+    # again, so the retry is a fresh admission, not a stale ack.
+    Kueuectl(leader.engine).delete_workload("default/c1")
+    out = leader.submit(wls[1], now=2.0)
+    assert out["code"] == 201
 
 
 # -- kueuectl status (offline) --

@@ -61,7 +61,11 @@ _FRAG_RE = re.compile(
 
 
 def lower_is_better(name: str, unit: str) -> bool:
-    return "latency" in name or "s/cycle" in unit
+    # federation_failover reports re-dispatch p95 in seconds — smaller
+    # is healthier. ha_failover is NOT in this set: its value is
+    # submissions recovered per second of failover, so higher wins.
+    return ("latency" in name or "s/cycle" in unit
+            or name == "federation_failover")
 
 
 def _parse_value_str(s: str):

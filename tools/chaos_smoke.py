@@ -198,6 +198,7 @@ def run_seed(seed_no: int, stages, origin: str, workdir: str,
             rc = proc.wait(timeout=STAGE_TIMEOUT)
         except subprocess.TimeoutExpired:
             proc.kill()
+            proc.wait()  # reap: no zombie children on the bail-out path
             raise SystemExit(
                 f"FAIL[{name}]: stage {i} ({stage.spec!r}) hung; log:\n"
                 + open(log_path).read())

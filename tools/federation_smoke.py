@@ -1,0 +1,446 @@
+#!/usr/bin/env python
+"""federation-smoke: seeded multi-cell chaos sweep behind
+``make federation-smoke``.
+
+Per seed, three HA cells (each a real ``serve --ha`` process with its
+own journal and lease, all sharing one world definition) sit behind an
+in-process FederationDispatcher whose route journal lives on disk.
+``FederationChaosSchedule`` (replay/faults.py) expands the seed into a
+deterministic multi-fault chain over the submission stream:
+
+  cell-sigkill       the victim cell is SIGKILLed mid-admission
+                     stream — the dispatcher's breaker must open,
+                     fence the cell (epoch bump, journaled), and
+                     drain every unconfirmed route to survivors;
+  dispatcher-crash   the dispatcher dies between the route-intent
+                     fsync and the handoff send (HANDOFF_CRASH_HOOK,
+                     the nastiest crash point) and is rebuilt cold
+                     from its journal — the orphaned intent must be
+                     re-sent and deduplicated, never lost or doubled;
+  partition          a surviving cell becomes unreachable for a
+                     bounded window (the process stays healthy) —
+                     drain + reconcile must treat reconnection as a
+                     rejoin without losing the cell's own state;
+  zombie-rejoin      the SIGKILLed cell restarts on its own journal —
+                     before it re-enters rotation the dispatcher must
+                     revoke every admission it holds for keys that
+                     were drained to survivors, under the bumped
+                     fence epoch.
+
+Assertions per seed, after the chain converges:
+
+  * every submitted workload's route reaches ADMITTED;
+  * per-cell digest identity — each cell's live admitted-state digest
+    equals a cold rebuild of its journal (the cell's durable story
+    agrees with its live one);
+  * global reconciliation — the union of per-cell admitted sets
+    equals the submitted set and the sets are pairwise disjoint:
+    zero lost, zero duplicate admissions across the federation.
+
+Exits non-zero on the first divergence.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+CELLS = ("cell-a", "cell-b", "cell-c")
+N_WORKLOADS = 24
+LEASE_DURATION = 1.5
+TICK = 0.05
+CONVERGE_TIMEOUT = 90.0
+
+
+class InjectedDispatcherCrash(Exception):
+    pass
+
+
+def scenario():
+    from kueue_tpu.bench.scenario import baseline_like
+    # Quota far above demand: the smoke measures routing fidelity
+    # under faults, not capacity pressure — any cell can admit any
+    # workload, so every submission must land exactly once.
+    return baseline_like(n_cohorts=2, cqs_per_cohort=2,
+                         n_workloads=N_WORKLOADS,
+                         nominal_per_cq=2_000_000, sized_to_fit=True)
+
+
+def seed_world(path: str) -> None:
+    """World only (flavors/cohorts/queues), no workloads: every cell
+    starts from the same durable definition and admissions arrive
+    solely through the dispatcher's front door."""
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.store.journal import attach_new_journal
+
+    eng = Engine()
+    scen = scenario()
+    attach_new_journal(eng, path)
+    for rf in scen.flavors:
+        eng.create_resource_flavor(rf)
+    for co in scen.cohorts:
+        eng.create_cohort(co)
+    for cq in scen.cluster_queues:
+        eng.create_cluster_queue(cq)
+    for lq in scen.local_queues:
+        eng.create_local_queue(lq)
+    eng.journal.sync()
+
+
+def spawn_cell(journal: str, ident: str, logf,
+               port: int = 0) -> subprocess.Popen:
+    cmd = [sys.executable, "-m", "kueue_tpu.serve", "--ha",
+           "--journal", journal, "--lease", journal + ".lease",
+           "--replica-id", ident, "--oracle", "off",
+           "--http", f"127.0.0.1:{port}", "--tick", str(TICK),
+           "--lease-duration", str(LEASE_DURATION)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    return subprocess.Popen(cmd, stdout=logf, stderr=subprocess.STDOUT,
+                            env=env, cwd=ROOT)
+
+
+def wait_for_line(log_path: str, needle: str, proc,
+                  timeout: float = 30.0) -> str:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(log_path) as f:
+                for line in f:
+                    if needle in line:
+                        return line.strip()
+        except FileNotFoundError:
+            pass
+        if proc.poll() is not None and needle not in open(log_path).read():
+            raise SystemExit(
+                f"FAIL: cell exited (rc={proc.returncode}) before "
+                f"printing {needle!r}; log:\n{open(log_path).read()}")
+        time.sleep(0.05)
+    raise SystemExit(f"FAIL: timeout waiting for {needle!r} in "
+                     f"{log_path}:\n{open(log_path).read()}")
+
+
+def port_of(log_path: str, proc) -> int:
+    line = wait_for_line(log_path, "serving on", proc)
+    return int(line.split("serving on", 1)[1].split("(", 1)[0]
+               .strip().rsplit(":", 1)[1])
+
+
+def debug_ha(port: int) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/ha", timeout=5) as r:
+        return json.loads(r.read())
+
+
+def cell_admitted(eng) -> dict:
+    from kueue_tpu.api.serde import to_jsonable
+    return {k: to_jsonable(w.status.admission)
+            for k, w in sorted(eng.workloads.items())
+            if w.status.admission is not None and not w.is_finished}
+
+
+class SeedRun:
+    """One seed's fault chain over a fresh three-cell federation."""
+
+    def __init__(self, seed_no: int, workdir: str, world: str):
+        self.seed_no = seed_no
+        self.dir = os.path.join(workdir, f"seed{seed_no}")
+        os.makedirs(self.dir, exist_ok=True)
+        self.world = world
+        self.procs: dict = {}
+        self.ports: dict = {}
+        self.incarnations: dict = {}
+        self.proxies: dict = {}
+        self.dispatcher = None
+        self.rebuilds = 0
+        self.partition_heal_tick = None
+        self.partition_cell = None
+
+    # -- cell lifecycle --
+
+    def cell_journal(self, name: str) -> str:
+        return os.path.join(self.dir, f"{name}.jsonl")
+
+    def start_cell(self, name: str, fresh: bool) -> None:
+        journal = self.cell_journal(name)
+        if fresh:
+            shutil.copy(self.world, journal)
+        log_path = os.path.join(
+            self.dir, f"{name}.{'boot' if fresh else 'rejoin'}.log")
+        # A restarted process is a NEW incarnation and must carry a
+        # fresh replica identity (a restarted pod gets a new name): the
+        # lease's renew path would hand the old identity its old epoch
+        # back, and verify_promotion rightly fences a term that cannot
+        # prove it is newer than the last journaled digest.
+        self.incarnations[name] = self.incarnations.get(name, -1) + 1
+        ident = (name if self.incarnations[name] == 0
+                 else f"{name}-r{self.incarnations[name]}")
+        with open(log_path, "w") as lf:
+            # A zombie rejoin must come back on the SAME port: the
+            # dispatcher's transport (and any fence tombstone test)
+            # addresses the cell, not the process.
+            proc = spawn_cell(journal, ident, lf,
+                              port=0 if fresh else self.ports[name])
+        self.procs[name] = (proc, log_path)
+        wait_for_line(log_path, "ha: role=leader", proc)
+        self.ports[name] = port_of(log_path, proc)
+
+    def kill_cell(self, name: str) -> None:
+        proc, _ = self.procs[name]
+        proc.kill()
+        proc.wait()  # reap: no zombie children while the run continues
+
+    def stop_all(self) -> None:
+        for proc, _ in self.procs.values():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc, _ in self.procs.values():
+            if proc.poll() is None:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+
+    # -- dispatcher lifecycle --
+
+    def build_dispatcher(self):
+        """Fresh dispatcher over the SAME route journal and the same
+        transports — cold state, everything folded from disk (the
+        crash-recovery path when called with rebuilds > 0)."""
+        from kueue_tpu.federation import (
+            CellHandle,
+            FederationDispatcher,
+        )
+        from kueue_tpu.replay.faults import PartitionedTransport
+        if not self.proxies:
+            from kueue_tpu.federation.cells import HTTPCellTransport
+            for name in CELLS:
+                self.proxies[name] = PartitionedTransport(
+                    HTTPCellTransport(
+                        f"http://127.0.0.1:{self.ports[name]}",
+                        timeout=3.0))
+        handles = [CellHandle(name, self.proxies[name],
+                              probe_interval_ticks=1,
+                              breaker_threshold=2,
+                              breaker_cooldown_ticks=2)
+                   for name in CELLS]
+        self.dispatcher = FederationDispatcher(
+            os.path.join(self.dir, "dispatcher.jsonl"), handles,
+            confirm_interval_ticks=1)
+        return self.dispatcher
+
+    def crash_dispatcher(self) -> None:
+        """The intent is already durable (fsync-before-handoff); the
+        old object is abandoned and a cold one folds the journal."""
+        self.dispatcher.journal.close()
+        self.rebuilds += 1
+        self.build_dispatcher()
+
+    def tick(self) -> None:
+        d = self.dispatcher
+        try:
+            d.tick(time.time())
+        except InjectedDispatcherCrash:
+            self.crash_dispatcher()
+        if (self.partition_heal_tick is not None
+                and self.dispatcher.tick_seq >= self.partition_heal_tick):
+            self.proxies[self.partition_cell].partitioned = False
+            self.partition_heal_tick = None
+
+    def wait_all_up(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.tick()
+            if all(c.up for c in self.dispatcher.cells.values()):
+                return
+            time.sleep(TICK)
+        raise SystemExit(
+            f"FAIL[seed {self.seed_no}]: cells never all came up: "
+            f"{json.dumps(self.dispatcher.status(), indent=2)}")
+
+    # -- the chain --
+
+    def run(self) -> dict:
+        from kueue_tpu.federation import dispatcher as dmod
+        from kueue_tpu.replay.faults import FederationChaosSchedule
+
+        for name in CELLS:
+            self.start_cell(name, fresh=True)
+        self.build_dispatcher()
+        self.wait_all_up()
+
+        events = FederationChaosSchedule(
+            self.seed_no, CELLS, workloads=N_WORKLOADS).events()
+        by_submission: dict = {}
+        crash_at_handoff = None
+        for ev in events:
+            if ev.kind == "dispatcher-crash":
+                crash_at_handoff = ev.at
+            else:
+                by_submission.setdefault(ev.at, []).append(ev)
+
+        def handoff_hook(ordinal: int, key: str) -> None:
+            if ordinal == crash_at_handoff:
+                dmod.HANDOFF_CRASH_HOOK = None
+                raise InjectedDispatcherCrash(key)
+        if crash_at_handoff is not None:
+            dmod.HANDOFF_CRASH_HOOK = handoff_hook
+
+        submitted = []
+        try:
+            for i, wl in enumerate(scenario().workloads, start=1):
+                for ev in by_submission.get(i, ()):
+                    self._fire(ev)
+                self._submit(wl)
+                submitted.append(wl.key)
+                self.tick()
+                time.sleep(TICK / 2)
+            # Late events (zombie-rejoin drawn at/after the last
+            # submission ordinal) still fire.
+            for at in sorted(by_submission):
+                for ev in by_submission[at]:
+                    if at > len(submitted):
+                        self._fire(ev)
+            return self._converge_and_check(submitted, events)
+        finally:
+            dmod.HANDOFF_CRASH_HOOK = None
+            self.stop_all()
+
+    def _fire(self, ev) -> None:
+        if ev.kind == "cell-sigkill":
+            self.kill_cell(ev.cell)
+        elif ev.kind == "partition":
+            self.proxies[ev.cell].partitioned = True
+            self.partition_cell = ev.cell
+            self.partition_heal_tick = self.dispatcher.tick_seq + ev.arg
+        elif ev.kind == "zombie-rejoin":
+            self.start_cell(ev.cell, fresh=False)
+
+    def _submit(self, wl) -> None:
+        """Submit through the dispatcher, retrying healthy refusals
+        (503 no-cell / mid-election windows). A dispatcher crash on
+        the handoff is recovered and the RETRY must deduplicate."""
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                verdict = self.dispatcher.submit(wl, time.time())
+            except InjectedDispatcherCrash:
+                self.crash_dispatcher()
+                continue  # re-submit: the journaled intent dedups it
+            if verdict.get("code") in (200, 201, 202):
+                return
+            if time.monotonic() > deadline:
+                raise SystemExit(
+                    f"FAIL[seed {self.seed_no}]: submit {wl.key} "
+                    f"never accepted: {verdict}")
+            self.tick()
+            time.sleep(TICK)
+
+    def _converge_and_check(self, submitted: list,
+                            events: list) -> dict:
+        from kueue_tpu.ha.digest import admitted_state_digest
+        from kueue_tpu.store.journal import rebuild_engine
+
+        deadline = time.monotonic() + CONVERGE_TIMEOUT
+        d = self.dispatcher
+        while time.monotonic() < deadline:
+            self.tick()
+            d = self.dispatcher
+            counts = d.route_counts()
+            if (counts.get("admitted", 0) == len(submitted)
+                    and all(c.up for c in d.cells.values())):
+                break
+            time.sleep(TICK)
+        else:
+            raise SystemExit(
+                f"FAIL[seed {self.seed_no}]: never converged: "
+                f"{json.dumps(d.status(), indent=2)}")
+
+        # Per-cell digest identity: live == cold rebuild.
+        live = {name: debug_ha(self.ports[name]) for name in CELLS}
+        self.stop_all()
+        per_cell: dict = {}
+        for name in CELLS:
+            eng = rebuild_engine(self.cell_journal(name))
+            digest = admitted_state_digest(eng)
+            if digest != live[name].get("stateDigest"):
+                raise SystemExit(
+                    f"FAIL[seed {self.seed_no}]: {name} live digest "
+                    f"{live[name].get('stateDigest')} != cold rebuild "
+                    f"{digest}")
+            per_cell[name] = set(cell_admitted(eng))
+
+        # Global reconciliation: union == submitted, pairwise disjoint.
+        union: set = set()
+        dupes: set = set()
+        for name, keys in per_cell.items():
+            dupes |= union & keys
+            union |= keys
+        lost = set(submitted) - union
+        extra = union - set(submitted)
+        if lost or extra or dupes:
+            raise SystemExit(
+                f"FAIL[seed {self.seed_no}]: global reconciliation "
+                f"broken: lost={sorted(lost)} extra={sorted(extra)} "
+                f"duplicates={sorted(dupes)} "
+                f"per_cell={ {k: len(v) for k, v in per_cell.items()} }")
+        return {
+            "faults": [f"{e.kind}@{e.at}"
+                       + (f":{e.cell}" if e.cell else "") for e in events],
+            "rebuilds": self.rebuilds,
+            "redispatches": d.redispatches,
+            "revocations": d.revocations,
+            "per_cell": {k: len(v) for k, v in sorted(per_cell.items())},
+        }
+
+    def close(self) -> None:
+        self.stop_all()
+        if self.dispatcher is not None:
+            self.dispatcher.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=8)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch workdir for inspection")
+    args = ap.parse_args()
+
+    workdir = tempfile.mkdtemp(prefix="federation-smoke-")
+    world = os.path.join(workdir, "world.jsonl")
+    seed_world(world)
+
+    for seed_no in range(1, args.seeds + 1):
+        run = SeedRun(seed_no, workdir, world)
+        try:
+            out = run.run()
+        finally:
+            run.close()
+        print(f"federation-smoke: [seed {seed_no}] "
+              f"faults={','.join(out['faults'])} "
+              f"dispatcher_rebuilds={out['rebuilds']} "
+              f"redispatches={out['redispatches']} "
+              f"revocations={out['revocations']} "
+              f"spread={out['per_cell']} — digests identical, "
+              f"union==submitted, disjoint")
+
+    print(f"federation-smoke: PASS — {args.seeds} seeded multi-fault "
+          f"chains, zero lost / zero duplicate admissions across the "
+          f"federation")
+    if not args.keep:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

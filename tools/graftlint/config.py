@@ -50,6 +50,13 @@ DEFAULT_ZONES: tuple = (
     # of it. Its journal kind (ha_digest) is registered exhaustively
     # for R1 via store.journal.EPHEMERAL_KINDS.
     ("kueue_tpu/ha/", frozenset({"J1"})),
+    # Federation dispatcher: same posture as ha/ plus the undo-log
+    # discipline. D1 must NOT apply — health probing, decorrelated
+    # probe jitter, and handoff latency are inherently wall-clock.
+    # Its journal kinds (fed_route, fed_cell) are registered for R1
+    # via store.journal.EPHEMERAL_KINDS: they fold into the
+    # dispatcher's routing table, never into an engine rebuild.
+    ("kueue_tpu/federation/", frozenset({"J1", "U1"})),
     # Sealed checkpoints serialize the guarded usage/queue state but
     # must never MUTATE it (a snapshot that writes back would corrupt
     # the very state it claims to preserve): pinned under the undo-log

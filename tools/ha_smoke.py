@@ -276,9 +276,11 @@ def chaos_arm(name: str, seed: str, workdir: str, fault: str,
         finally:
             if follower.poll() is None:
                 follower.kill()
+                follower.wait()  # reap: no zombies on the failure path
     finally:
         if leader.poll() is None:
             leader.kill()
+            leader.wait()  # reap: no zombies on the failure path
 
 
 def main() -> int:
