@@ -7,7 +7,7 @@ PYTEST_FLAGS ?= -q
 
 .PHONY: all native test test-fast test-device bench multichip-dryrun \
   replay-smoke obs-smoke tas-smoke perf-smoke ha-smoke chaos-smoke \
-  federation-smoke bench-gate lint clean
+  federation-smoke overload-smoke bench-gate lint clean
 
 all: native
 
@@ -114,6 +114,19 @@ chaos-smoke: lint
 # contract.
 federation-smoke: lint
 	JAX_PLATFORMS=cpu $(PY) tools/federation_smoke.py
+
+# Overload survival, end to end through the real HTTP front door: a
+# deterministic open-loop storm (kueue_tpu/loadgen) at 5x the shed
+# rate while a fault plan wedges a cycle (hang -> watchdog sampler
+# catches it with stacks) and collapses free disk (disk-pressure-ramp
+# -> journal read-only, submits 503, budget re-arms). Excess load must
+# shed 429 with clamped Retry-After, the ladder must walk back to rung
+# 0, and a cold journal rebuild must show exactly the accepted set
+# admitted — zero lost/duplicate (tools/overload_smoke.py). lint
+# first: the watchdog/diskguard/loadgen zone pins are part of the
+# contract.
+overload-smoke: lint
+	JAX_PLATFORMS=cpu $(PY) tools/overload_smoke.py
 
 # Bench regression sentinel: noise-aware per-scenario gate over the
 # accumulated BENCH_r*/MULTICHIP_r* trajectory (tools/bench_sentinel.py).

@@ -203,6 +203,11 @@ class Engine:
         self.ha = None
         self.fanout = None
         self.shedder = None
+        # Overload survival: the cycle watchdog (obs.watchdog) and the
+        # degradation ladder (ha.ladder) attach themselves here; the
+        # debug endpoints and the ladder's trigger scan read the slots.
+        self.watchdog = None
+        self.ladder = None
         self.workloads: dict[str, Workload] = {}
         # hook: called with (workload, admission) after each admission.
         self.on_admit: Optional[Callable] = None
@@ -782,7 +787,16 @@ class Engine:
         seq = self.cycle_seq
         for fn in tuple(self.pre_cycle_hooks):
             fn(seq, self)
-        if not self._serving_gc:
+        writable = getattr(self.journal, "writable", None)
+        if writable is not None and not writable():
+            # Disk budget exhausted (store/diskguard.py): scheduling
+            # would admit workloads the journal cannot record. Park
+            # this cycle as idle — seq still advances, listeners (the
+            # degradation ladder, the watchdog) still run, and the
+            # writable() probe re-arms the budget and resumes
+            # scheduling the moment the filesystem has headroom.
+            result = None
+        elif not self._serving_gc:
             result = self._schedule_once_impl()
         else:
             try:

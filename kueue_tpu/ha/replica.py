@@ -60,8 +60,13 @@ class HAReplica:
                  segment_rotate_bytes: Optional[int] = None,
                  segment_rotate_records: Optional[int] = None,
                  retain_segments: bool = True,
-                 dedup_capacity: int = 4096):
+                 dedup_capacity: int = 4096,
+                 min_free_bytes: int = 0):
         self.journal_path = journal_path
+        # Disk budget (store/diskguard.py): the promoted leader's
+        # journal refuses appends below this free-space floor and the
+        # submit path sheds with 503 until the budget re-arms.
+        self.min_free_bytes = int(min_free_bytes)
         # Bounded-time recovery knobs (store/checkpoint.py): a leader
         # with checkpoint_interval > 0 writes sealed checkpoints every
         # N non-idle cycles and rotates the journal into segments;
@@ -222,7 +227,9 @@ class HAReplica:
         self.epoch = lease_state.epoch
         journal = Journal(self.journal_path, fsync=self.fsync,
                           rotate_bytes=self.segment_rotate_bytes,
-                          rotate_records=self.segment_rotate_records)
+                          rotate_records=self.segment_rotate_records,
+                          min_free_bytes=self.min_free_bytes,
+                          metrics=self.metrics)
         journal.fence = self._write_allowed
         if base:
             journal.seed_generations(
@@ -331,6 +338,18 @@ class HAReplica:
             # its first durable cycle.
             return {"accepted": True, "code": 200,
                     "workload": workload.name, "deduplicated": True}
+        journal = getattr(self.engine, "journal", None)
+        if journal is not None and journal.degraded:
+            # Disk budget exhausted (store/diskguard.py): the journal
+            # is read-only, so an accept here could never be made
+            # durable. 503 (retryable elsewhere / later), checked
+            # after dedup (acked work still answers 200) and before
+            # the shedder (don't burn bucket tokens on a full disk).
+            out = {"accepted": False, "code": 503,
+                   "reason": "journal degraded: disk budget exhausted"}
+            if self.shedder is not None:
+                out["retryAfter"] = self.shedder.retry_after_hint()
+            return out
         if self.shedder is not None:
             verdict = self.shedder.admit(now)
             if not verdict["accepted"]:

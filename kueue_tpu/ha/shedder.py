@@ -25,6 +25,32 @@ from typing import Optional
 
 STATUS_OK, STATUS_WARN, STATUS_BREACH = 0, 1, 2
 
+# Hard ceiling on any Retry-After the serving plane hands out, in
+# seconds (shared by the shedder 429/503 paths and the APF 429 path —
+# one clamp, not two code paths). 30 s bounds the hint during failover
+# windows: lease expiry + replay-verified promotion completes well
+# inside it, so a clamped retry lands after the new leader is serving.
+RETRY_AFTER_MAX = 30.0
+
+
+def clamped_retry_after(base: float, jitter: float = 0.5, rng=None,
+                        cap: float = RETRY_AFTER_MAX) -> float:
+    """One jittered, clamped Retry-After value from a base delay.
+
+    Every shed client computing the same deterministic delay would
+    re-arrive in one synchronized wave (thundering herd after a
+    failover) — each refusal gets ``base * uniform(1-j, 1+j)``
+    instead: same mean, decorrelated, and never above ``cap``.
+    The single code path behind AdmissionShedder.retry_after_hint
+    (429 shed / 503 failover) and the APF 429s in
+    visibility/http_server.py."""
+    import random
+
+    j = max(0.0, min(1.0, float(jitter)))
+    r = rng if rng is not None else random
+    retry = round(max(0.0, base) * r.uniform(1.0 - j, 1.0 + j), 3)
+    return min(retry, cap)
+
 
 class TokenBucket:
     """Plain token bucket; refill is scaled by an external factor so
@@ -56,15 +82,9 @@ class AdmissionShedder:
     GIL serializes the float updates; drift under contention only
     mis-sizes the bucket by a token, never corrupts it)."""
 
-    # Hard ceiling on any Retry-After this shedder hands out, in
-    # seconds. The jittered delay is 1/(rate*factor) scaled by the
-    # jitter band, and a breached SLO can squeeze factor to 0.05 — at
-    # low configured rates the "mean inter-admission gap" blows up to
-    # minutes, which is not backoff guidance but a client lockout. 30 s
-    # also bounds the 503 hint during failover windows: a lease expiry
-    # plus replay-verified promotion completes well inside it, so a
-    # clamped retry lands after the new leader is serving.
-    RETRY_AFTER_MAX = 30.0
+    # Backward-compat alias for the module-level clamp (tests and
+    # callers configured against the class attribute keep working).
+    RETRY_AFTER_MAX = RETRY_AFTER_MAX
 
     def __init__(self, rate: float = 200.0, burst: Optional[float] = None,
                  slo=None, metrics=None, hub=None,
@@ -78,6 +98,12 @@ class AdmissionShedder:
         self.accepted = 0
         self.shed = 0
         self.factor = 1.0
+        # Degradation-ladder override (ha/ladder.py): when set, the
+        # effective factor is capped at this value regardless of what
+        # the SLO coupling computes — the "new submissions" rung
+        # squeezing the front door below its own floors (0.0 = shed
+        # everything, the disk-degraded posture).
+        self.degraded_factor: Optional[float] = None
         # Retry-After jitter: every shed client computing the same
         # deterministic retry delay would re-arrive in one synchronized
         # wave (thundering herd after a failover). Each 429 gets
@@ -89,6 +115,12 @@ class AdmissionShedder:
         self._rng = rng if rng is not None else random.Random()
 
     def _factor(self) -> float:
+        computed = self._slo_factor()
+        if self.degraded_factor is not None:
+            return min(computed, max(0.0, self.degraded_factor))
+        return computed
+
+    def _slo_factor(self) -> float:
         if self.slo is None:
             return 1.0
         try:
@@ -127,19 +159,20 @@ class AdmissionShedder:
                 "retryAfter": retry}
 
     def retry_after_hint(self) -> float:
-        """One jittered, clamped Retry-After value. Shared by the 429
-        shed path and the 503 failover path (ha/replica.py submit off-
-        leader), so client backoff guidance is consistent across both:
-        base 1/(rate*factor) scaled by the jitter band, never above
-        ``retry_after_max``."""
+        """One jittered, clamped Retry-After value for this shedder's
+        current posture: base 1/(rate*factor), through the shared
+        ``clamped_retry_after`` helper (also used verbatim by the 503
+        failover path in ha/replica.py and the APF 429 path in
+        visibility/http_server.py)."""
         base = 1.0 / max(1e-6, self.bucket.rate * self.factor)
-        j = self.retry_jitter
-        retry = round(base * self._rng.uniform(1.0 - j, 1.0 + j), 3)
-        return min(retry, self.retry_after_max)
+        return clamped_retry_after(base, jitter=self.retry_jitter,
+                                   rng=self._rng,
+                                   cap=self.retry_after_max)
 
     def status(self) -> dict:
         return {"accepted": self.accepted, "shed": self.shed,
                 "factor": round(self.factor, 4),
+                "degradedFactor": self.degraded_factor,
                 "rate": self.bucket.rate, "burst": self.bucket.burst,
                 "tokens": round(self.bucket.tokens, 3),
                 "retryAfterMax": self.retry_after_max}

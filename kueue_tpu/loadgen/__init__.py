@@ -1,0 +1,46 @@
+"""Deterministic open-loop traffic generation (the million-user storm).
+
+Every bench before this package was closed-loop: the driver submits a
+wave, drains it, and submits the next — so the offered load can never
+exceed what the engine absorbs, and overload is unobservable by
+construction. An open-loop generator decides arrival times INDEPENDENT
+of service: arrivals keep coming whether or not the engine keeps up,
+which is the only way to measure offered-vs-admitted-vs-shed under
+sustained burn (the Metastable Failures posture — see PAPERS.md).
+
+Three pieces:
+
+  * ``patterns`` — rate curves λ(t): constant, diurnal (sinusoid
+    between trough and peak), burst (square-wave spikes riding a
+    base), plus the adversarial hot-key mix that concentrates a
+    fraction of the traffic on one queue.
+  * ``arrivals`` — Lewis-Shedler Poisson thinning over a pattern:
+    draw a homogeneous Poisson stream at the pattern's peak rate and
+    keep each point with probability λ(t)/peak. Seeded
+    ``random.Random`` end to end: same seed → byte-identical arrival
+    schedule, so a storm is replayable evidence, not noise.
+  * ``OpenLoopGenerator`` — pattern + mix + seed → the concrete
+    ``Arrival`` schedule bench.py and tools/overload_smoke.py drive.
+"""
+
+from kueue_tpu.loadgen.arrivals import (
+    Arrival,
+    OpenLoopGenerator,
+    thinned_arrivals,
+)
+from kueue_tpu.loadgen.patterns import (
+    BurstPattern,
+    ConstantPattern,
+    DiurnalPattern,
+    HotkeyMix,
+)
+
+__all__ = [
+    "Arrival",
+    "BurstPattern",
+    "ConstantPattern",
+    "DiurnalPattern",
+    "HotkeyMix",
+    "OpenLoopGenerator",
+    "thinned_arrivals",
+]

@@ -1,0 +1,87 @@
+"""Poisson-thinned arrival sampling over a rate pattern.
+
+Lewis-Shedler thinning: draw a homogeneous Poisson process at the
+pattern's peak rate (exponential gaps, one seeded ``random.Random``),
+then keep each candidate point with probability λ(t)/peak. The kept
+points are a non-homogeneous Poisson process with intensity λ(t) —
+open-loop by construction, since nothing downstream of the sampler can
+slow the schedule down.
+
+Determinism contract: the entire schedule — arrival times, queue
+targeting, names — is a function of (pattern, mix, seed, horizon).
+bench.py and tools/overload_smoke.py both lean on this: a storm that
+found a bug IS its own reproducer.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+
+@dataclass(frozen=True)
+class Arrival:
+    """One offered request: when it arrives, what it is, where it
+    lands. ``ordinal`` is the position in the offered stream (stable
+    across re-generation — the dedup/bookkeeping key)."""
+
+    t: float
+    name: str
+    queue: str
+    ordinal: int
+
+
+def thinned_arrivals(pattern, horizon_s: float,
+                     seed: int = 0) -> Iterator[float]:
+    """Yield arrival timestamps in [0, horizon_s) drawn from the
+    non-homogeneous Poisson process with intensity ``pattern.rate_at``.
+    """
+    peak = float(pattern.peak)
+    if peak <= 0.0 or horizon_s <= 0.0:
+        return
+    rng = random.Random(seed)
+    t = 0.0
+    while True:
+        t += rng.expovariate(peak)
+        if t >= horizon_s:
+            return
+        # Thinning: accept with probability λ(t)/peak.
+        if rng.random() * peak < pattern.rate_at(t):
+            yield t
+
+
+class OpenLoopGenerator:
+    """Pattern + hot-key mix + seed → the concrete arrival schedule.
+
+    ``events(horizon_s)`` materializes the whole schedule up front
+    (offered load must not depend on how fast the consumer iterates);
+    a million-arrival storm is ~100 MB of small objects, well inside
+    bench budgets, and the bench compresses time anyway.
+    """
+
+    def __init__(self, pattern, mix=None, seed: int = 0,
+                 name_prefix: str = "storm"):
+        self.pattern = pattern
+        self.mix = mix
+        self.seed = int(seed)
+        self.name_prefix = name_prefix
+
+    def events(self, horizon_s: float) -> list:
+        rng = random.Random(self.seed ^ 0x5EED)
+        out = []
+        for i, t in enumerate(thinned_arrivals(self.pattern, horizon_s,
+                                               seed=self.seed)):
+            if self.mix is not None:
+                queue = self.mix.queue_for(rng.random(), rng.random())
+            else:
+                queue = ""
+            out.append(Arrival(t=t, name=f"{self.name_prefix}-{i}",
+                               queue=queue, ordinal=i))
+        return out
+
+    def offered_rate(self, horizon_s: float,
+                     events: Optional[list] = None) -> float:
+        """Realized offered rate over the horizon (arrivals/s)."""
+        evs = events if events is not None else self.events(horizon_s)
+        return len(evs) / max(horizon_s, 1e-9)

@@ -1,0 +1,95 @@
+"""Rate curves λ(t) and the adversarial key mix for open-loop traffic.
+
+A pattern is a tiny object with ``rate(t) -> float`` (arrivals per
+second at time ``t``) and a ``peak`` attribute bounding it from above —
+the thinning envelope arrivals.py rejects against. Patterns are pure
+functions of time: all randomness lives in the arrival sampler, keyed
+by one seed, so a pattern can be evaluated anywhere (bench driver,
+smoke tool, test) and agree everywhere.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ConstantPattern:
+    """Flat λ(t) = rate — the simplest sustained-overload storm."""
+
+    rate: float
+
+    @property
+    def peak(self) -> float:
+        return self.rate
+
+    def rate_at(self, t: float) -> float:
+        return self.rate
+
+
+@dataclass(frozen=True)
+class DiurnalPattern:
+    """Sinusoid between ``trough`` and ``peak_rate`` with period
+    ``period_s``: λ(t) = trough + (peak-trough)·(1-cos(2πt/p))/2, so
+    t=0 starts at the trough and the crest lands mid-period — the
+    classic day/night user curve, compressed to bench timescales."""
+
+    trough: float
+    peak_rate: float
+    period_s: float
+    phase: float = 0.0
+
+    @property
+    def peak(self) -> float:
+        return max(self.trough, self.peak_rate)
+
+    def rate_at(self, t: float) -> float:
+        frac = (t / max(self.period_s, 1e-9) + self.phase) % 1.0
+        shape = 0.5 * (1.0 - math.cos(2.0 * math.pi * frac))
+        return self.trough + (self.peak_rate - self.trough) * shape
+
+
+@dataclass(frozen=True)
+class BurstPattern:
+    """Square-wave spikes riding a base rate: every ``interval_s``
+    seconds the rate jumps to ``burst_rate`` for ``burst_s`` seconds —
+    the thundering-herd / retry-wave shape that open-loop load makes
+    visible and closed-loop load cannot."""
+
+    base: float
+    burst_rate: float
+    interval_s: float
+    burst_s: float
+    offset_s: float = 0.0
+
+    @property
+    def peak(self) -> float:
+        return max(self.base, self.burst_rate)
+
+    def rate_at(self, t: float) -> float:
+        pos = (t - self.offset_s) % max(self.interval_s, 1e-9)
+        if 0.0 <= pos < self.burst_s:
+            return self.burst_rate
+        return self.base
+
+
+@dataclass(frozen=True)
+class HotkeyMix:
+    """Adversarial queue targeting: ``hot_fraction`` of arrivals all
+    hit ``queues[hot_index]``; the rest spread uniformly over the
+    others. hot_fraction=1/len(queues) degenerates to uniform. The
+    mix consumes uniform draws handed in by the sampler (it owns no
+    RNG) so the whole schedule stays a function of one seed."""
+
+    queues: tuple
+    hot_index: int = 0
+    hot_fraction: float = 0.5
+
+    def queue_for(self, u_hot: float, u_pick: float) -> str:
+        qs = self.queues
+        if len(qs) == 1 or u_hot < self.hot_fraction:
+            return qs[self.hot_index % len(qs)]
+        cold = [q for i, q in enumerate(qs)
+                if i != self.hot_index % len(qs)]
+        return cold[min(len(cold) - 1, int(u_pick * len(cold)))]

@@ -327,6 +327,31 @@ class MetricsRegistry:
         g("federation_routes", "routes per state (intent|acked|admitted)")
         h("federation_handoff_latency_seconds",
           "intent-durable to cell-ack latency per cell")
+        # Overload survival (obs/watchdog.py, ha/ladder.py,
+        # store/diskguard.py, visibility/fanout.py): cycle watchdog
+        # breaker lifecycle, the degradation-ladder rung, disk-budget
+        # read-only posture, and suppressed SSE detail chatter.
+        c("watchdog_cycle_overruns_total",
+          "completed cycles past the deadline per mode")
+        c("watchdog_hung_cycles_total",
+          "in-flight cycles past the hang threshold")
+        g("watchdog_state",
+          "watchdog breaker state (0 closed | 1 open | 2 half-open)")
+        c("watchdog_transitions_total",
+          "watchdog breaker transitions per (from, to)")
+        c("watchdog_demotions_total",
+          "watchdog demotions per offending cycle mode")
+        g("overload_ladder_rung",
+          "degradation rung (0 normal | 1 trace | 2 fanout | "
+          "3 submit | 4 device)")
+        c("overload_ladder_transitions_total",
+          "ladder rung transitions per (from, to)")
+        g("disk_budget_state",
+          "disk budget state (0 armed | 1 degraded)")
+        c("disk_budget_transitions_total",
+          "disk budget transitions per resulting state")
+        c("sse_detail_suppressed_total",
+          "detail events suppressed at the fanout boundary per kind")
         self.gauge("build_info").set(
             (("name", "kueue_tpu"), ("version", "0.2.0")), 1)
 

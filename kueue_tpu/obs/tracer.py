@@ -47,6 +47,12 @@ class CycleTracer:
         self.retain = retain
         self.journal_correlation = journal_correlation
         self.emit_events = emit_events
+        # Degradation-ladder lever (ha/ladder.py rung "trace"): False
+        # skips span-tree construction entirely — the cheapest work to
+        # drop under overload, since traces are a debugging aid, not a
+        # correctness artifact. Flipping it is digest-neutral (nothing
+        # here feeds a decision either way).
+        self.capture = True
         self.spans: deque[Span] = deque(maxlen=retain)
         self.cycles_traced = 0
         self.last_cid: Optional[str] = None
@@ -73,6 +79,8 @@ class CycleTracer:
         self._t0 = None
         if result is None:
             return  # idle: no decisions, no span tree
+        if not self.capture:
+            return  # shed by the degradation ladder (rung "trace")
         root = self._build(seq, result, buf, t0, end)
         self.spans.append(root)
         self.cycles_traced += 1

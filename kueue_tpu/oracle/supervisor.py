@@ -147,6 +147,21 @@ class OracleSupervisor:
                              f"{self.consecutive_failures} consecutive "
                              f"failures")
 
+    def demote(self, seq: int, reason: str = "external demotion") -> None:
+        """Force the breaker OPEN from outside its own failure
+        accounting — the cycle watchdog (obs/watchdog.py) and the
+        degradation ladder (ha/ladder.py) demote the device path
+        through here. Probing re-promotion is unchanged: after the
+        cooldown a half-open probe re-closes on success. Already-OPEN
+        just extends the probe window (no double-counted demotion)."""
+        if self.state == OPEN:
+            self._reopen_at = max(self._reopen_at or 0,
+                                  seq + self._cooldown)
+            return
+        self.demotions += 1
+        self._reopen_at = seq + self._cooldown
+        self._transition(OPEN, reason)
+
     def _transition(self, to: str, reason: str) -> None:
         if to == self.state:
             return

@@ -46,6 +46,32 @@ Spec grammar (comma-separated faults):
                            breaker (oracle/supervisor.py), which must
                            demote to the host path and re-promote
                            after the storm, digest-identical
+  hang@cycle:N:MS          wedge the engine thread for MS ms as cycle
+                           N begins (a GC stall / wedged device call):
+                           the cycle watchdog's sampler thread
+                           (obs/watchdog.py) must notice the in-flight
+                           cycle mid-hang, capture stacks, and feed
+                           its breaker. Attach the watchdog BEFORE
+                           arming faults — its pre-cycle hook must
+                           stamp the cycle start before the sleep.
+  arrival-storm@cycle:N:M  submit M synthetic workloads as cycle N
+                           begins (an open-loop burst landing straight
+                           on the engine, past any front door):
+                           admission stays exact — every storm
+                           workload is journaled, zero lost/duplicate
+  slow-consumer-flood@cycle:N:M
+                           subscribe M never-draining SSE clients to
+                           the fanout hub at cycle N: the hub's
+                           slow-consumer policy must evict them
+                           without stalling the cycle loop or any
+                           live client
+  disk-pressure-ramp@cycle:N:M
+                           simulated free space collapses to zero for
+                           M cycles starting at N (diskguard
+                           FREE_BYTES_PROBE): the disk budget must
+                           degrade read-only, scheduling park, and
+                           the budget re-arm when the window passes —
+                           no restart, nothing lost
 
 The recovery contract these faults exist to prove: reboot via
 store.journal.rebuild_engine and drain, and the admitted set equals an
@@ -74,7 +100,8 @@ from dataclasses import dataclass, field
 
 KINDS = ("sigkill", "torn-tail", "oracle-crash", "delay-verdict",
          "lease-stall", "enospc", "torn-checkpoint", "clock-skew",
-         "oracle-crash-storm")
+         "oracle-crash-storm", "hang", "arrival-storm",
+         "slow-consumer-flood", "disk-pressure-ramp")
 POINTS = ("cycle", "admission", "compaction")
 
 
@@ -121,6 +148,15 @@ class FaultPlan:
                     ">= 1: oracle-crash-storm@cycle:N:M")
             if kind == "delay-verdict" and arg < 0:
                 raise ValueError("delay-verdict delay must be >= 0 ms")
+            if kind == "hang" and (len(bits) < 3 or arg <= 0):
+                raise ValueError(
+                    "hang needs a duration: hang@cycle:N:MS")
+            if kind in ("arrival-storm", "slow-consumer-flood",
+                        "disk-pressure-ramp") and (
+                    len(bits) < 3 or arg < 1 or arg != int(arg)):
+                raise ValueError(
+                    f"{kind} needs a whole count >= 1: "
+                    f"{kind}@cycle:N:M")
             plan.faults.append(Fault(kind, at, n, arg))
         return plan
 
@@ -206,6 +242,8 @@ class FaultInjector:
         self.fired: list[str] = []
         self.proxy = None
         self._enospc_until = None
+        self._disk_ramp_until = None
+        self._flood_clients: list = []
         # Storm coverage: [start, end) cycle ranges the executor stays
         # crashed through (vs the single-cycle oracle-crash, which the
         # post-cycle "sidecar restart" clears).
@@ -257,6 +295,24 @@ class FaultInjector:
     def _storm_covers(self, seq: int) -> bool:
         return any(start <= seq < end for start, end in self._storms)
 
+    def _arrival_storm(self, engine, seq: int, count: int) -> None:
+        """Inject ``count`` synthetic workloads straight into the
+        engine (the open-loop burst, bypassing any serving front
+        door). Deterministic: names carry the cycle seq, the target is
+        the lexicographically first local queue."""
+        from kueue_tpu.api.types import PodSet, Workload
+
+        lqs = sorted(engine.queues.local_queues)
+        if not lqs:
+            raise RuntimeError(
+                "arrival-storm needs at least one local queue")
+        lq = engine.queues.local_queues[lqs[0]]
+        for i in range(count):
+            engine.submit(Workload(
+                name=f"storm-{seq}-{i}", namespace=lq.namespace,
+                queue_name=lq.name,
+                pod_sets=(PodSet("main", 1, {"cpu": 100}),)))
+
     def _tear_newest_checkpoint(self, engine) -> None:
         ck = getattr(engine, "checkpointer", None)
         if ck is None:
@@ -278,6 +334,11 @@ class FaultInjector:
             from kueue_tpu.store import checkpoint as _ckpt
             _ckpt.WRITE_FAULT = None
             self._enospc_until = None
+        if (self._disk_ramp_until is not None
+                and seq >= self._disk_ramp_until):
+            from kueue_tpu.store import diskguard as _dg
+            _dg.FREE_BYTES_PROBE = None
+            self._disk_ramp_until = None
         for f in self.plan.faults:
             if f.at != "cycle" or f.n != seq:
                 continue
@@ -319,6 +380,35 @@ class FaultInjector:
                         "(engine.ha unset — not running in HA mode)")
                 engine.ha.suspend_renewal = True
                 self.fired.append(f"lease-stall@cycle:{seq}")
+            elif f.kind == "hang":
+                import time as _time
+                self.fired.append(f"hang@cycle:{seq}:{f.arg:g}")
+                # The engine thread wedges here, mid-cycle from the
+                # watchdog's point of view (its pre-cycle hook already
+                # stamped the start when it was attached first).
+                _time.sleep(f.arg / 1e3)
+            elif f.kind == "arrival-storm":
+                self._arrival_storm(engine, seq, int(f.arg))
+                self.fired.append(
+                    f"arrival-storm@cycle:{seq}:{int(f.arg)}")
+            elif f.kind == "slow-consumer-flood":
+                hub = getattr(engine, "fanout", None)
+                if hub is None:
+                    raise RuntimeError(
+                        "slow-consumer-flood needs a fanout hub "
+                        "(engine.fanout unset)")
+                # Subscribed, never drained: their queues fill, drops
+                # accrue, and the hub's eviction policy must fire.
+                self._flood_clients.extend(
+                    hub.subscribe() for _ in range(int(f.arg)))
+                self.fired.append(
+                    f"slow-consumer-flood@cycle:{seq}:{int(f.arg)}")
+            elif f.kind == "disk-pressure-ramp":
+                from kueue_tpu.store import diskguard as _dg
+                _dg.FREE_BYTES_PROBE = lambda path: 0
+                self._disk_ramp_until = seq + int(f.arg)
+                self.fired.append(
+                    f"disk-pressure-ramp@cycle:{seq}:{int(f.arg)}")
 
     def _post_cycle(self, seq: int, result) -> None:
         # Transient faults clear at the cycle's end: the sidecar
@@ -368,11 +458,21 @@ class ChaosSchedule:
               "sigkill@admission:{adm}",
               "torn-tail@cycle:{n}",
               "sigkill@compaction:{maint}")
+    # BENIGN faults must be INPUT-NEUTRAL: the terminal state is
+    # compared byte-for-byte against a fault-free control arm, so a
+    # benign fault may delay or reroute decisions but never add or
+    # remove inputs. disk-pressure-ramp qualifies (scheduling parks,
+    # then resumes — same admitted set, later). arrival-storm does NOT
+    # (it injects workloads the control arm never saw); hang and
+    # slow-consumer-flood need a watchdog/fanout hub the chaos workers
+    # don't attach — all three are exercised by tools/overload_smoke.py
+    # and tests/test_overload.py instead.
     BENIGN = ("oracle-crash@cycle:{n}",
               "oracle-crash-storm@cycle:{n}:{m}",
               "enospc@cycle:{n}",
               "torn-checkpoint@cycle:{n}",
-              "clock-skew@cycle:{n}:{ms}")
+              "clock-skew@cycle:{n}:{ms}",
+              "disk-pressure-ramp@cycle:{n}:{m}")
 
     def __init__(self, seed: int, stages: int = 3,
                  cycles_per_stage: int = 24, oracle: bool = True):

@@ -47,6 +47,19 @@ Environment:
                            (--segment-records / --segment-bytes; 0 =
                            off). Sealed segments older than the oldest
                            live checkpoint are reclaimed by retention
+  KUEUE_TPU_MIN_FREE_BYTES disk budget floor (--min-free-bytes; 0 =
+                           off): journal appends are refused below this
+                           much free space — read-only degraded mode,
+                           submits shed with 503 + Retry-After, the
+                           scheduling loop parks, and the budget
+                           re-arms automatically when space recovers
+                           (store/diskguard.py)
+  KUEUE_TPU_WATCHDOG_DEADLINE / KUEUE_TPU_WATCHDOG_HANG
+                           cycle watchdog thresholds in seconds
+                           (--watchdog-deadline / --watchdog-hang;
+                           0/0 = watchdog off): overrun and hung-cycle
+                           detection with stack capture and
+                           breaker-style demotion (obs/watchdog.py)
   KUEUE_TPU_FEDERATE       cell spec "name[@zone]=URL,..." (--federate):
                            run this process as a FEDERATION DISPATCHER
                            instead of an engine — no local engine; POST
@@ -61,6 +74,32 @@ from __future__ import annotations
 import os
 import signal
 import time
+
+
+def _attach_overload(eng, args) -> None:
+    """Overload-survival toolchain: cycle watchdog (when enabled by
+    the flags) + the degradation ladder (always — it idles at rung 0
+    until a trigger fires). Call BEFORE arming any fault plan: the
+    hang fault relies on the watchdog's pre-cycle hook stamping the
+    cycle start first."""
+    if args.watchdog_deadline > 0 or args.watchdog_hang > 0:
+        from kueue_tpu.obs.watchdog import attach_watchdog
+        deadline = args.watchdog_deadline or args.watchdog_hang / 5.0
+        hang = args.watchdog_hang or deadline * 5.0
+        attach_watchdog(eng, deadline_s=deadline, hang_after_s=hang)
+    if args.shed_rate > 0 and getattr(eng, "shedder", None) is None:
+        # Plain (non-HA) serving gets the same admission front door the
+        # HA replica wires in _main_ha: SLO-coupled token bucket on the
+        # POST /workloads path. The ladder below squeezes it further.
+        from kueue_tpu.ha.shedder import AdmissionShedder
+        from kueue_tpu.obs.slo import attach_slo
+        if getattr(eng, "slo", None) is None:
+            attach_slo(eng)
+        eng.shedder = AdmissionShedder(
+            rate=args.shed_rate, slo=eng.slo, metrics=eng.registry,
+            hub=getattr(eng, "fanout", None))
+    from kueue_tpu.ha.ladder import attach_ladder
+    attach_ladder(eng)
 
 
 def main(argv=None) -> None:
@@ -124,6 +163,30 @@ def main(argv=None) -> None:
                             "KUEUE_TPU_SEGMENT_BYTES", "0")),
                         help="roll the journal into a sealed segment"
                              " past N bytes (0 = off)")
+    parser.add_argument("--min-free-bytes", type=int,
+                        default=int(os.environ.get(
+                            "KUEUE_TPU_MIN_FREE_BYTES", "0")),
+                        help="disk budget floor: refuse journal appends"
+                             " (read-only degraded mode, submits shed"
+                             " 503) when the filesystem's free space"
+                             " drops below N bytes; re-arms"
+                             " automatically (0 = off)")
+    parser.add_argument("--watchdog-deadline", type=float,
+                        default=float(os.environ.get(
+                            "KUEUE_TPU_WATCHDOG_DEADLINE", "0")),
+                        help="cycle watchdog deadline in seconds:"
+                             " cycles slower than this count as"
+                             " overruns and feed the watchdog breaker"
+                             " (0 = watchdog off unless"
+                             " --watchdog-hang is set)")
+    parser.add_argument("--watchdog-hang", type=float,
+                        default=float(os.environ.get(
+                            "KUEUE_TPU_WATCHDOG_HANG", "0")),
+                        help="hung-cycle threshold in seconds: an"
+                             " in-flight cycle older than this gets"
+                             " its stacks captured and the breaker"
+                             " fed mid-cycle (0 = default 5x deadline"
+                             " when the watchdog is on)")
     args = parser.parse_args(argv)
 
     from kueue_tpu.store.journal import rebuild_engine
@@ -142,16 +205,19 @@ def main(argv=None) -> None:
     eng = rebuild_engine(
         args.journal,
         journal_kwargs={"rotate_records": args.segment_records,
-                        "rotate_bytes": args.segment_bytes})
+                        "rotate_bytes": args.segment_bytes,
+                        "min_free_bytes": args.min_free_bytes})
     if args.checkpoint_interval > 0:
         from kueue_tpu.store.checkpoint import Checkpointer
         Checkpointer(eng, interval=args.checkpoint_interval,
-                     keep=args.checkpoint_keep)
+                     keep=args.checkpoint_keep,
+                     min_free_bytes=args.min_free_bytes)
     if args.oracle == "local":
         eng.attach_oracle()
     elif args.oracle != "off":
         host, _, port = args.oracle.rpartition(":")
         eng.attach_oracle(remote_address=(host or "127.0.0.1", int(port)))
+    _attach_overload(eng, args)
 
     recorder = None
     if args.record:
@@ -192,9 +258,17 @@ def main(argv=None) -> None:
     # The wait.UntilWithBackoff loop (scheduler.go:207): schedule while
     # fruitful, idle-tick otherwise; engine time advances with the wall
     # clock so backoffs and timeouts fire.
+    from kueue_tpu.store.journal import JournalDegraded
     while not stop["flag"]:
         t0 = time.monotonic()
-        result = eng.schedule_once()
+        try:
+            result = eng.schedule_once()
+        except JournalDegraded as e:
+            # A mid-cycle ENOSPC raced past the cycle-boundary
+            # writable() gate: park as idle — the next cycle's gate
+            # probes and re-arms when the filesystem recovers.
+            print(f"journal degraded, parking: {e}", flush=True)
+            result = None
         eng.tick(time.monotonic() - t0 + args.tick
                  if result is None else time.monotonic() - t0)
         if result is None:
@@ -319,6 +393,7 @@ def _main_ha(args) -> None:
             retain = (int(args.trace) if args.trace.isdigit()
                       and int(args.trace) > 1 else 64)
             eng.attach_tracer(retain=retain)
+        _attach_overload(eng, args)
         if args.record:
             from kueue_tpu.replay.recorder import FlightRecorder
             replica.recorder = FlightRecorder(
@@ -335,7 +410,8 @@ def _main_ha(args) -> None:
         checkpoint_interval=args.checkpoint_interval,
         checkpoint_keep=args.checkpoint_keep,
         segment_rotate_records=args.segment_records or None,
-        segment_rotate_bytes=args.segment_bytes or None)
+        segment_rotate_bytes=args.segment_bytes or None,
+        min_free_bytes=args.min_free_bytes)
 
     host, _, port = args.http.rpartition(":")
     endpoint = ServingEndpoint(
@@ -373,9 +449,20 @@ def _main_ha(args) -> None:
             try:
                 result = eng.schedule_once()
             except Exception as e:  # noqa: BLE001 — a fenced write
-                from kueue_tpu.store.journal import JournalFenced
+                from kueue_tpu.store.journal import (
+                    JournalDegraded,
+                    JournalFenced,
+                )
                 if isinstance(e, JournalFenced):
                     replica._fence(f"journal fence tripped: {e}")
+                    continue
+                if isinstance(e, JournalDegraded):
+                    # Mid-cycle ENOSPC raced past the cycle-boundary
+                    # gate: stay leader, park this tick; the gate
+                    # re-arms the budget when space recovers.
+                    print(f"ha: journal degraded, parking: {e}",
+                          flush=True)
+                    time.sleep(args.tick)
                     continue
                 raise
             eng.tick(

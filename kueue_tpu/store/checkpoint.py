@@ -90,12 +90,20 @@ class CheckpointStore:
     """The checkpoint directory next to a journal:
     ``<journal>.ckpt/ckpt-<NNNNNN>.json``."""
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, min_free_bytes: int = 0):
+        from kueue_tpu.store.diskguard import DiskBudget
+
         self.directory = directory
+        # Disk budget (0 = guard off): a checkpoint is the LARGEST
+        # single write in the system, so it preflights payload size on
+        # top of the floor — refusing up front instead of fsyncing a
+        # half-written snapshot into a full disk.
+        self.budget = DiskBudget(directory, min_free_bytes)
 
     @classmethod
-    def for_journal(cls, journal_path: str) -> "CheckpointStore":
-        return cls(journal_path + ".ckpt")
+    def for_journal(cls, journal_path: str,
+                    min_free_bytes: int = 0) -> "CheckpointStore":
+        return cls(journal_path + ".ckpt", min_free_bytes=min_free_bytes)
 
     # -- enumeration --
 
@@ -204,6 +212,15 @@ class CheckpointStore:
             "payload_crc": f"{zlib.crc32(payload):08x}",
         }
         os.makedirs(self.directory, exist_ok=True)
+        # Preflight the whole payload against the disk budget BEFORE
+        # opening the temp file: a refused checkpoint leaves zero new
+        # bytes behind. OSError(ENOSPC) keeps the Checkpointer's
+        # absorb-and-retry contract — the budget re-arms on a later
+        # interval's preflight once space returns.
+        if not self.budget.preflight(len(payload) + 4096):
+            raise OSError(
+                errno.ENOSPC,
+                f"checkpoint preflight refused: {self.budget.reason}")
         indexed = self._indexed()
         index = (indexed[-1][0] + 1) if indexed else 1
         final = os.path.join(self.directory,
@@ -218,7 +235,9 @@ class CheckpointStore:
                 fh.flush()
                 os.fsync(fh.fileno())
             os.replace(tmp, final)
-        except OSError:
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                self.budget.note_enospc(e)
             try:
                 os.remove(tmp)
             except OSError:
@@ -354,7 +373,8 @@ class Checkpointer:
 
     def __init__(self, engine, interval: int = 64, keep: int = 2,
                  retain_segments: bool = True,
-                 store: Optional[CheckpointStore] = None):
+                 store: Optional[CheckpointStore] = None,
+                 min_free_bytes: int = 0):
         if engine.journal is None:
             raise ValueError("Checkpointer needs an attached journal")
         self.engine = engine
@@ -362,7 +382,7 @@ class Checkpointer:
         self.keep = max(1, int(keep))
         self.retain_segments = retain_segments
         self.store = store or CheckpointStore.for_journal(
-            engine.journal.path)
+            engine.journal.path, min_free_bytes=min_free_bytes)
         self.written = 0
         self.failures = 0
         self.last_meta: Optional[CheckpointMeta] = None
@@ -433,4 +453,5 @@ class Checkpointer:
             else self.last_meta.seq,
             "lastPath": None if self.last_meta is None
             else self.last_meta.path,
+            "diskBudget": self.store.budget.status(),
         }

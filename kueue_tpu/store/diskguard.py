@@ -1,0 +1,168 @@
+"""Disk-budget guard: preflight free-space checks with a read-only
+degraded mode and automatic re-arm.
+
+Before this module the only answer to a filling disk was the chaos
+handler: the seeded ``enospc`` fault proved a checkpoint write failure
+is absorbed, but a journal append hitting a genuinely full disk still
+raised out of the engine loop. The guard turns disk pressure into an
+ORDERLY rung of the degradation ladder (kueue_tpu/ha/ladder.py)
+instead of a crash:
+
+  * **preflight** — every append/checkpoint first checks free bytes on
+    the target filesystem against ``min_free_bytes``. Refusal happens
+    BEFORE the write syscall, so the tail of the journal never holds a
+    torn record from a mid-write ENOSPC.
+  * **degraded mode** — a failed preflight (or a real ENOSPC from the
+    kernel) trips the budget into read-only: journal appends raise
+    ``JournalDegraded`` (store/journal.py), the serving front door
+    sheds new submissions, and the drive loop parks scheduling. Reads
+    and replay stay live — a degraded cell still answers queries.
+  * **automatic re-arm** — ``rearm_probe()`` re-checks free space;
+    the moment the filesystem has headroom again the budget re-arms
+    and writes resume, no process restart. Probing is cheap (one
+    statvfs) and rate-limited by the probe_every counter so a parked
+    drive loop polling each tick doesn't hammer statvfs.
+
+``FREE_BYTES_PROBE`` is the chaos seam (the MAINTENANCE_CRASH_HOOK /
+WRITE_FAULT idiom): tests and the ``disk-pressure-ramp`` fault kind
+(replay/faults.py) install a fake probe to walk free space down and
+back up without actually filling a disk.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# Chaos/test seam: when set, called with (path) and must return the
+# simulated free byte count for that path's filesystem. None = ask
+# statvfs (production).
+FREE_BYTES_PROBE = None
+
+ARMED, DEGRADED = "armed", "degraded"
+_STATE_CODE = {ARMED: 0.0, DEGRADED: 1.0}
+
+
+def free_bytes(path: str) -> int:
+    """Free bytes available to this process on ``path``'s filesystem
+    (f_bavail, not f_bfree: root reserve doesn't count)."""
+    if FREE_BYTES_PROBE is not None:
+        return int(FREE_BYTES_PROBE(path))
+    st = os.statvfs(os.path.dirname(os.path.abspath(path)) or ".")
+    return int(st.f_bavail) * int(st.f_frsize)
+
+
+class DiskBudget:
+    """Free-space budget for one durable artifact (journal file or
+    checkpoint directory). min_free_bytes <= 0 disables the guard
+    entirely (the pre-PR behavior, byte for byte)."""
+
+    def __init__(self, path: str, min_free_bytes: int = 0,
+                 probe_every: int = 16, metrics=None):
+        self.path = path
+        self.min_free_bytes = max(0, int(min_free_bytes))
+        # Re-arm probing cadence: while degraded, only every Nth
+        # refused operation (plus every explicit rearm_probe call)
+        # re-checks the filesystem.
+        self.probe_every = max(1, int(probe_every))
+        self.metrics = metrics
+        self.state = ARMED
+        self.reason = ""
+        self.checks = 0
+        self.refusals = 0
+        self.degradations = 0
+        self.rearms = 0
+        self._since_probe = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.min_free_bytes > 0
+
+    @property
+    def degraded(self) -> bool:
+        return self.state == DEGRADED
+
+    def preflight(self, need_bytes: int = 0) -> bool:
+        """True when the write may proceed. A False return means the
+        budget is (now) degraded; the caller refuses the write without
+        touching the file."""
+        if not self.enabled:
+            return True
+        self.checks += 1
+        if self.state == DEGRADED:
+            # Rate-limited re-arm probe on the refusal path: a parked
+            # writer retrying each cycle re-arms within probe_every
+            # attempts of space coming back.
+            self._since_probe += 1
+            if self._since_probe >= self.probe_every:
+                self._since_probe = 0
+                if self._probe_ok(need_bytes):
+                    self._rearm("probe: free space recovered")
+                    return True
+            self.refusals += 1
+            return False
+        if self._probe_ok(need_bytes):
+            return True
+        self._degrade(f"preflight: free < min_free_bytes="
+                      f"{self.min_free_bytes}")
+        self.refusals += 1
+        return False
+
+    def note_enospc(self, err: OSError) -> None:
+        """A write syscall hit the real thing (preflight raced the
+        filesystem): degrade exactly as a failed preflight would."""
+        if self.enabled and self.state == ARMED:
+            self._degrade(f"ENOSPC from kernel: {err}")
+
+    def rearm_probe(self, need_bytes: int = 0) -> bool:
+        """Explicit re-arm attempt (the drive loop's park check).
+        Returns True when the budget is armed after the probe."""
+        if not self.enabled or self.state == ARMED:
+            return True
+        self._since_probe = 0
+        if self._probe_ok(need_bytes):
+            self._rearm("rearm_probe: free space recovered")
+            return True
+        return False
+
+    def _probe_ok(self, need_bytes: int) -> bool:
+        try:
+            free = free_bytes(self.path)
+        except OSError:
+            return True  # can't stat: never wedge writes on a probe
+        return free >= self.min_free_bytes + max(0, int(need_bytes))
+
+    def _degrade(self, reason: str) -> None:
+        self.state = DEGRADED
+        self.reason = reason
+        self.degradations += 1
+        self._since_probe = 0
+        self._export()
+
+    def _rearm(self, reason: str) -> None:
+        self.state = ARMED
+        self.reason = reason
+        self.rearms += 1
+        self._export()
+
+    def _export(self) -> None:
+        if self.metrics is None:
+            return
+        try:
+            self.metrics.gauge("disk_budget_state").set(
+                (), _STATE_CODE[self.state])
+            self.metrics.counter("disk_budget_transitions_total").inc(
+                (self.state,))
+        except KeyError:
+            pass  # registry predates the disk-budget families
+
+    def status(self) -> dict:
+        return {
+            "state": self.state,
+            "minFreeBytes": self.min_free_bytes,
+            "reason": self.reason,
+            "checks": self.checks,
+            "refusals": self.refusals,
+            "degradations": self.degradations,
+            "rearms": self.rearms,
+        }

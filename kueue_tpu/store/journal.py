@@ -126,6 +126,15 @@ class JournalConflict(Exception):
         self.found = found
 
 
+class JournalDegraded(Exception):
+    """A write was refused by the disk budget (store/diskguard.py):
+    the filesystem is at or below min_free_bytes and the journal is in
+    read-only degraded mode. Distinct from ENOSPC-the-OSError on
+    purpose — the caller (serving front door, drive loop) sheds and
+    parks instead of crashing, and the budget re-arms itself when
+    space returns."""
+
+
 class Journal:
     """Append-only JSONL journal with per-key GENERATION stamps.
 
@@ -142,13 +151,20 @@ class Journal:
 
     def __init__(self, path: str, fsync: bool = False,
                  rotate_bytes: Optional[int] = None,
-                 rotate_records: Optional[int] = None):
+                 rotate_records: Optional[int] = None,
+                 min_free_bytes: int = 0, metrics=None):
+        from kueue_tpu.store.diskguard import DiskBudget
+
         self.path = path
         self.fsync = fsync
         # Segment rotation thresholds (None/0 = rotation off — the
         # original single-file behavior, byte for byte).
         self.rotate_bytes = int(rotate_bytes or 0)
         self.rotate_records = int(rotate_records or 0)
+        # Disk budget (0 = guard off): preflight every append against
+        # free space and degrade to read-only instead of crashing on a
+        # filling disk. See store/diskguard.py.
+        self.budget = DiskBudget(path, min_free_bytes, metrics=metrics)
         # Optional fence predicate (HA): evaluated INSIDE the append
         # flock; returning False raises JournalFenced instead of
         # writing. None (the default) means unfenced.
@@ -220,6 +236,31 @@ class Journal:
         return {"lineage": self.lineage,
                 "segment": self.active_ordinal(),
                 "offset": self._active_lines}
+
+    @property
+    def degraded(self) -> bool:
+        """True while the disk budget holds the journal read-only.
+        The serving front door checks this to shed new submissions
+        (503 disk-pressure) and the drive loop checks it to park
+        scheduling until ``rearm_probe`` succeeds."""
+        return self.budget.degraded
+
+    def rearm_probe(self) -> bool:
+        """Re-check free space and re-arm if recovered. Returns True
+        when the journal is writable (armed) after the probe."""
+        return self.budget.rearm_probe()
+
+    def writable(self) -> bool:
+        """Cycle-boundary gate for drive loops: True when appends may
+        proceed. Unlike ``degraded`` (a passive flag), this actively
+        probes — an armed budget over a newly-full filesystem degrades
+        HERE, before the engine schedules work it cannot journal, and
+        a degraded budget re-arms the moment space recovers."""
+        if not self.budget.enabled:
+            return True
+        if self.budget.degraded:
+            return self.budget.rearm_probe()
+        return self.budget.preflight(256)
 
     def seed_generations(self, gens: dict) -> None:
         """Floor the generation table with checkpoint-recovered stamps
@@ -463,6 +504,13 @@ class Journal:
                 raise JournalFenced(
                     f"write of {kind}/{key} refused: fence predicate "
                     f"failed (no longer leader)")
+            # Disk preflight AFTER the fence (a fenced writer must hear
+            # "fenced", not "disk full") and INSIDE the flock, so the
+            # degrade/re-arm decision is serialized across writers.
+            if not self.budget.preflight(256):
+                raise JournalDegraded(
+                    f"write of {kind}/{key} refused: journal degraded "
+                    f"read-only ({self.budget.reason})")
             self.refresh()
             k = (kind, key)
             current = self._generations.get(k, 0)
@@ -479,13 +527,25 @@ class Journal:
             fcntl.flock(self._fh.fileno(), fcntl.LOCK_UN)
 
     def _write(self, rec: dict) -> None:
+        import errno as _errno
+
         line = json.dumps(rec) + "\n"
-        self._fh.write(line)
-        self._fh.flush()
-        if self.fsync:
-            os.fsync(self._fh.fileno())
-        else:
-            self._dirty = True
+        try:
+            self._fh.write(line)
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+            else:
+                self._dirty = True
+        except OSError as e:
+            if e.errno == _errno.ENOSPC:
+                # Preflight raced the filesystem: degrade instead of
+                # crashing. The flushed-or-not fragment (if any) is the
+                # torn tail the next locked repair truncates.
+                self.budget.note_enospc(e)
+                raise JournalDegraded(
+                    f"append hit ENOSPC: {e}") from e
+            raise
         # Our own append is already folded into the generation table —
         # advance the read offset so the next refresh() doesn't re-read
         # and re-parse it (one open+parse per record on the hot path).
@@ -501,8 +561,18 @@ class Journal:
         never mid-cycle."""
         if not self._dirty:
             return
-        self._fh.flush()
-        os.fsync(self._fh.fileno())
+        import errno as _errno
+        try:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        except OSError as e:
+            if e.errno == _errno.ENOSPC:
+                # Degrade, keep _dirty set: a later sync (after the
+                # budget re-arms) retries the fsync rather than
+                # silently dropping the durability boundary.
+                self.budget.note_enospc(e)
+                return
+            raise
         self._dirty = False
         self.maybe_rotate()
 

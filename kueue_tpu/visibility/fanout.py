@@ -31,6 +31,15 @@ from typing import Optional
 EVICTED = object()
 _STOP = object()
 
+# Event kinds suppressed when the hub drops to detail=False (the
+# degradation ladder's "fanout" rung): per-cycle chatter that scales
+# with cycle rate, not with state changes. Lifecycle events (admitted,
+# evicted, preempted, leadership) keep flowing so watchers never lose
+# track of WHAT happened — only the high-frequency commentary stops.
+DETAIL_KINDS = frozenset({
+    "cycle_trace", "admission_shed", "rationale", "heartbeat_detail",
+})
+
 
 class FanoutClient:
     __slots__ = ("id", "queue", "dropped", "consecutive_drops",
@@ -128,6 +137,12 @@ class FanoutHub:
         self.events_published = 0
         self.events_dropped = 0
         self.clients_evicted = 0
+        # Degradation-ladder lever (ha/ladder.py rung "fanout"): False
+        # suppresses DETAIL_KINDS at the publish boundary — the
+        # scheduling thread stops paying even the O(shards) puts for
+        # per-cycle chatter while lifecycle events keep flowing.
+        self.detail = True
+        self.detail_suppressed = 0
         self._engine_hook = None
         self._engine = None
         self.shards = [
@@ -138,6 +153,15 @@ class FanoutHub:
     # -- producer side --
 
     def publish(self, kind: str, data: str) -> None:
+        if not self.detail and kind in DETAIL_KINDS:
+            self.detail_suppressed += 1
+            if self.metrics is not None:
+                try:
+                    self.metrics.counter(
+                        "sse_detail_suppressed_total").inc((kind,))
+                except KeyError:
+                    pass
+            return
         self.events_published += 1
         item = (kind, data)
         for shard in self.shards:
@@ -231,6 +255,8 @@ class FanoutHub:
             "dropped": self.events_dropped,
             "evicted": self.clients_evicted,
             "inboxDropped": sum(s.inbox_dropped for s in self.shards),
+            "detail": self.detail,
+            "detailSuppressed": self.detail_suppressed,
         }
 
     def close(self) -> None:

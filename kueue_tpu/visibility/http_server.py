@@ -62,6 +62,24 @@ def make_handler(engine, auth_token=None, apf=None,
 
         _view_cache: dict = {}
 
+        def _retry_after_hint(self) -> float:
+            """The serving plane's ONE Retry-After computation: the
+            shedder's clamped, jittered hint when a shedder is wired
+            (HA replica or bare engine), else the shared clamp over a
+            1 s base — so APF 429s, shed 429s and failover 503s all
+            hand out consistent backoff guidance."""
+            from kueue_tpu.ha.shedder import clamped_retry_after
+
+            shedder = getattr(replica, "shedder", None) \
+                if replica is not None else None
+            if shedder is None:
+                eng = resolve()
+                shedder = getattr(eng, "shedder", None) \
+                    if eng is not None else None
+            if shedder is not None:
+                return shedder.retry_after_hint()
+            return clamped_retry_after(1.0)
+
         def _send_view(self, name: str, fn, empty: str = "[]") -> None:
             """Serve a live-state view; these iterate mutable engine
             dicts from an HTTP thread, so a collision with the
@@ -105,12 +123,17 @@ def make_handler(engine, auth_token=None, apf=None,
                     ticket = apf.admit(self._flow_user(),
                                        urlparse(self.path).path)
                 except RejectedError as e:
-                    # The apiserver's overload answer: 429 + Retry-After.
+                    # The apiserver's overload answer: 429 + the same
+                    # clamped Retry-After hint the shed/failover paths
+                    # use (one helper, not two code paths).
+                    hint = self._retry_after_hint()
                     data = json.dumps({"error": "too many requests",
-                                       "reason": str(e)}).encode()
+                                       "reason": str(e),
+                                       "retryAfter": hint}).encode()
                     self.send_response(429)
                     self.send_header("Content-Type", "application/json")
-                    self.send_header("Retry-After", "1")
+                    self.send_header("Retry-After",
+                                     str(max(1, int(hint))))
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)
@@ -322,6 +345,24 @@ def make_handler(engine, auth_token=None, apf=None,
                 self._send(json.dumps({
                     "accepted": True, "deduplicated": True,
                     "workload": wl.name}), code=200)
+                return
+            journal = getattr(engine, "journal", None)
+            if journal is not None and getattr(journal, "degraded",
+                                               False):
+                # Disk budget exhausted: accepting would journal into
+                # a read-only store. Same posture as the HA front door.
+                hint = self._retry_after_hint()
+                data = json.dumps({
+                    "accepted": False,
+                    "reason": "journal degraded: disk budget "
+                              "exhausted",
+                    "retryAfter": hint}).encode()
+                self.send_response(503)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After", str(max(1, int(hint))))
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
                 return
             shedder = getattr(engine, "shedder", None)
             if shedder is not None:

@@ -204,8 +204,23 @@ def perf_summary(engine) -> dict:
 
 def slo_summary(engine) -> dict:
     """The /debug/slo body: declarative objectives with their
-    multi-window burn rates (obs.slo)."""
+    multi-window burn rates (obs.slo), plus the overload posture —
+    degradation-ladder rung and cycle-watchdog breaker — when those
+    components are attached (the one endpoint answering "how degraded
+    are we, and why")."""
     slo = getattr(engine, "slo", None)
-    if slo is None:
-        return {"enabled": False}
-    return {"enabled": True, **slo.summary()}
+    out = {"enabled": False} if slo is None else {
+        "enabled": True, **slo.summary()}
+    ladder = getattr(engine, "ladder", None)
+    if ladder is not None:
+        out["ladder"] = ladder.status()
+    watchdog = getattr(engine, "watchdog", None)
+    if watchdog is not None:
+        out["watchdog"] = watchdog.status()
+    budget = getattr(getattr(engine, "journal", None), "budget", None)
+    if budget is not None and budget.enabled:
+        out["diskBudget"] = budget.status()
+    shedder = getattr(engine, "shedder", None)
+    if shedder is not None:
+        out["shedder"] = shedder.status()
+    return out

@@ -1,0 +1,117 @@
+"""Open-loop load generation (kueue_tpu/loadgen/): the determinism
+contract — the whole arrival schedule is a function of (pattern, mix,
+seed, horizon) — plus pattern shapes and thinning fidelity. A storm
+that found a bug must BE its own reproducer."""
+
+import math
+
+import pytest
+
+from kueue_tpu.loadgen import (
+    Arrival,
+    BurstPattern,
+    ConstantPattern,
+    DiurnalPattern,
+    HotkeyMix,
+    OpenLoopGenerator,
+    thinned_arrivals,
+)
+
+
+class TestPatterns:
+    def test_constant(self):
+        p = ConstantPattern(rate=40.0)
+        assert p.peak == 40.0
+        assert p.rate_at(0.0) == p.rate_at(123.4) == 40.0
+
+    def test_diurnal_trough_at_zero_crest_mid_period(self):
+        p = DiurnalPattern(trough=10.0, peak_rate=100.0, period_s=8.0)
+        assert p.peak == 100.0
+        assert p.rate_at(0.0) == pytest.approx(10.0)
+        assert p.rate_at(4.0) == pytest.approx(100.0)
+        assert p.rate_at(8.0) == pytest.approx(10.0)   # periodic
+        assert p.rate_at(2.0) == pytest.approx(55.0)   # halfway up
+
+    def test_burst_square_wave(self):
+        p = BurstPattern(base=5.0, burst_rate=500.0,
+                         interval_s=10.0, burst_s=1.0)
+        assert p.peak == 500.0
+        assert p.rate_at(0.5) == 500.0     # inside the first burst
+        assert p.rate_at(1.5) == 5.0       # after it
+        assert p.rate_at(10.5) == 500.0    # next interval's burst
+        assert p.rate_at(9.99) == 5.0
+
+    def test_hotkey_mix_routing(self):
+        mix = HotkeyMix(("q0", "q1", "q2", "q3"), hot_index=1,
+                        hot_fraction=0.5)
+        assert mix.queue_for(0.49, 0.0) == "q1"    # hot draw
+        assert mix.queue_for(0.51, 0.0) == "q0"    # cold: first cold
+        assert mix.queue_for(0.51, 0.99) == "q3"   # cold: last cold
+        # Single-queue mix degenerates to that queue.
+        assert HotkeyMix(("only",)).queue_for(0.9, 0.9) == "only"
+
+
+class TestThinnedArrivals:
+    def test_times_sorted_within_horizon(self):
+        ts = list(thinned_arrivals(ConstantPattern(200.0), 5.0, seed=7))
+        assert ts == sorted(ts)
+        assert all(0.0 <= t < 5.0 for t in ts)
+
+    def test_empty_when_rate_or_horizon_zero(self):
+        assert not list(thinned_arrivals(ConstantPattern(0.0), 5.0))
+        assert not list(thinned_arrivals(ConstantPattern(10.0), 0.0))
+
+    def test_realized_rate_tracks_pattern(self):
+        # Deterministic given the seed; expected count 1000, Poisson
+        # sigma ~32 — a 10% tolerance is ~3 sigma of slack.
+        ts = list(thinned_arrivals(ConstantPattern(200.0), 5.0, seed=7))
+        assert abs(len(ts) - 1000) < 100
+
+    def test_thinning_concentrates_at_crest(self):
+        # Diurnal over one period: the middle half (around the crest)
+        # must hold the bulk of arrivals.
+        p = DiurnalPattern(trough=5.0, peak_rate=200.0, period_s=8.0)
+        ts = list(thinned_arrivals(p, 8.0, seed=11))
+        mid = [t for t in ts if 2.0 <= t < 6.0]
+        assert len(mid) > 0.7 * len(ts)
+
+
+class TestOpenLoopGenerator:
+    def _gen(self, seed=42):
+        return OpenLoopGenerator(
+            ConstantPattern(150.0),
+            mix=HotkeyMix(("q0", "q1", "q2", "q3"), hot_index=0,
+                          hot_fraction=0.5),
+            seed=seed)
+
+    def test_same_seed_identical_schedule(self):
+        assert self._gen(1).events(3.0) == self._gen(1).events(3.0)
+
+    def test_different_seed_different_schedule(self):
+        assert self._gen(1).events(3.0) != self._gen(2).events(3.0)
+
+    def test_ordinals_contiguous_names_stable(self):
+        evs = self._gen().events(3.0)
+        assert [e.ordinal for e in evs] == list(range(len(evs)))
+        assert all(e.name == f"storm-{e.ordinal}" for e in evs)
+        assert isinstance(evs[0], Arrival)
+
+    def test_hot_fraction_realized(self):
+        evs = self._gen().events(5.0)
+        hot = sum(1 for e in evs if e.queue == "q0")
+        frac = hot / len(evs)
+        assert abs(frac - 0.5) < 0.08
+        # Cold arrivals spread over the other three queues.
+        assert {e.queue for e in evs} == {"q0", "q1", "q2", "q3"}
+
+    def test_offered_rate_helper(self):
+        gen = self._gen()
+        evs = gen.events(5.0)
+        rate = gen.offered_rate(5.0, events=evs)
+        assert rate == pytest.approx(len(evs) / 5.0)
+        assert abs(rate - 150.0) < 20.0
+
+    def test_no_mix_leaves_queue_blank(self):
+        gen = OpenLoopGenerator(ConstantPattern(50.0), seed=3)
+        evs = gen.events(2.0)
+        assert evs and all(e.queue == "" for e in evs)

@@ -1,0 +1,692 @@
+"""Overload-survival stack: shedder floors and token-bucket time
+safety, disk-budget guard (journal + checkpoint preflight, degraded
+mode, automatic re-arm), cycle watchdog (overrun/hang detection,
+breaker demote/re-promote), the degradation ladder's escalate/relax
+machinery and its component levers, and the new overload fault kinds
+(hang / arrival-storm / slow-consumer-flood / disk-pressure-ramp)."""
+
+import os
+import time
+import types
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.ha.ladder import (
+    R_DEVICE,
+    R_FANOUT,
+    R_NORMAL,
+    R_SUBMIT,
+    R_TRACE,
+    attach_ladder,
+)
+from kueue_tpu.ha.shedder import (
+    AdmissionShedder,
+    TokenBucket,
+    clamped_retry_after,
+)
+from kueue_tpu.obs.watchdog import CLOSED, HALF_OPEN, OPEN, CycleWatchdog, \
+    attach_watchdog
+from kueue_tpu.store import diskguard
+from kueue_tpu.store.diskguard import DiskBudget
+from kueue_tpu.store.journal import JournalDegraded, attach_new_journal
+
+
+@pytest.fixture(autouse=True)
+def _restore_probe():
+    """FREE_BYTES_PROBE is a module-global chaos seam: never leak a
+    fake probe into the next test."""
+    yield
+    diskguard.FREE_BYTES_PROBE = None
+
+
+def _world(journal_path=None, min_free_bytes=0):
+    eng = Engine()
+    if journal_path is not None:
+        attach_new_journal(eng, str(journal_path),
+                           min_free_bytes=min_free_bytes)
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq",
+        resource_groups=(ResourceGroup(
+            ("cpu",),
+            (FlavorQuotas("default", {"cpu": ResourceQuota(100_000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq", "default", "cq"))
+    return eng
+
+
+def _wl(name):
+    return Workload(name=name, queue_name="lq",
+                    pod_sets=(PodSet("main", 1, {"cpu": 100}),))
+
+
+class _FakeSLO:
+    """worst() stub driving the shedder/ladder couplings directly."""
+
+    def __init__(self, status=0, burn=0.0):
+        self.status = status
+        self.burn = burn
+
+    def worst(self):
+        return self.status, self.burn
+
+
+# ---------------------------------------------------------------------------
+# shedder: retry-after clamp, token bucket, SLO floors
+# ---------------------------------------------------------------------------
+
+
+class TestClampedRetryAfter:
+    def test_cap_is_hard(self):
+        assert clamped_retry_after(1e9) == 30.0
+        assert clamped_retry_after(1e9, cap=5.0) == 5.0
+
+    def test_jitter_bounds(self):
+        import random
+        rng = random.Random(7)
+        for _ in range(200):
+            v = clamped_retry_after(2.0, jitter=0.5, rng=rng)
+            assert 1.0 <= v <= 3.0
+
+    def test_zero_jitter_is_exact(self):
+        assert clamped_retry_after(2.0, jitter=0.0) == 2.0
+
+    def test_negative_base_is_zero(self):
+        assert clamped_retry_after(-1.0) == 0.0
+
+
+class TestTokenBucketTimeSafety:
+    def test_backwards_now_grants_nothing(self):
+        tb = TokenBucket(rate=10.0, burst=1.0)
+        assert tb.take(100.0)          # the single burst token
+        assert not tb.take(100.0)
+        # now going BACKWARDS (NTP step, monotonic mixup in a caller)
+        # must neither crash nor mint tokens out of negative elapsed.
+        assert not tb.take(99.0)
+        assert tb.tokens >= 0.0
+
+    def test_refill_resumes_after_backwards_step(self):
+        tb = TokenBucket(rate=10.0, burst=1.0)
+        assert tb.take(100.0)
+        assert not tb.take(99.0)       # rewinds _last to 99.0
+        # 0.2s of forward progress at 10/s refills (capped at burst).
+        assert tb.take(99.2)
+
+    def test_refill_scaled_by_factor(self):
+        tb = TokenBucket(rate=10.0, burst=1.0)
+        assert tb.take(0.0)
+        # One second at factor 0.05 refills 0.5 tokens: not enough.
+        assert not tb.take(1.0, factor=0.05)
+        # Another second at full factor tops it back up.
+        assert tb.take(2.0, factor=1.0)
+
+
+class TestShedderFloors:
+    def test_ok_is_full_rate(self):
+        s = AdmissionShedder(rate=100.0, slo=_FakeSLO(0, 0.0))
+        assert s._slo_factor() == 1.0
+
+    def test_warn_floor_quarter(self):
+        s = AdmissionShedder(rate=100.0, slo=_FakeSLO(1, 100.0))
+        assert s._slo_factor() == pytest.approx(0.25)
+
+    def test_warn_tracks_burn_above_floor(self):
+        s = AdmissionShedder(rate=100.0, slo=_FakeSLO(1, 0.5))
+        assert s._slo_factor() == pytest.approx(1.0 / 1.5)
+
+    def test_breach_floor_five_percent(self):
+        s = AdmissionShedder(rate=100.0, slo=_FakeSLO(2, 100.0))
+        assert s._slo_factor() == pytest.approx(0.05)
+
+    def test_breach_mild_burn_keeps_quarter_scale(self):
+        s = AdmissionShedder(rate=100.0, slo=_FakeSLO(2, 0.0))
+        assert s._slo_factor() == pytest.approx(0.25)
+
+    def test_slo_error_never_blocks_intake(self):
+        class _Boom:
+            def worst(self):
+                raise RuntimeError("slo eval exploded")
+        s = AdmissionShedder(rate=100.0, slo=_Boom())
+        assert s._slo_factor() == 1.0
+
+    def test_degraded_factor_caps_computed(self):
+        s = AdmissionShedder(rate=100.0, slo=_FakeSLO(0, 0.0))
+        s.degraded_factor = 0.05
+        assert s._factor() == pytest.approx(0.05)
+        s.degraded_factor = None
+        assert s._factor() == 1.0
+
+    def test_degraded_zero_sheds_everything_with_retry_hint(self):
+        # Factor scales REFILL, not stored tokens: whatever burst is
+        # already banked drains, then factor 0.0 admits nothing ever
+        # again no matter how much time passes.
+        s = AdmissionShedder(rate=100.0, burst=1.0)
+        s.degraded_factor = 0.0
+        assert s.admit(now=10.0)["accepted"]     # banked burst token
+        for dt in (1.0, 10.0, 1000.0):
+            out = s.admit(now=10.0 + dt)
+            assert not out["accepted"]
+            assert 0.0 < out["retryAfter"] <= s.retry_after_max
+
+
+# ---------------------------------------------------------------------------
+# disk budget: preflight, degraded mode, re-arm
+# ---------------------------------------------------------------------------
+
+
+class TestDiskBudget:
+    def test_disabled_budget_never_refuses(self):
+        b = DiskBudget("/nonexistent/x.jsonl", min_free_bytes=0)
+        diskguard.FREE_BYTES_PROBE = lambda p: 0
+        assert b.preflight(1 << 30)
+        assert not b.degraded
+
+    def test_degrades_on_failed_preflight(self):
+        b = DiskBudget("x.jsonl", min_free_bytes=1 << 20)
+        diskguard.FREE_BYTES_PROBE = lambda p: 0
+        assert not b.preflight(256)
+        assert b.degraded
+        assert b.degradations == 1
+
+    def test_rearm_probe_recovers(self):
+        b = DiskBudget("x.jsonl", min_free_bytes=1 << 20)
+        diskguard.FREE_BYTES_PROBE = lambda p: 0
+        assert not b.preflight(256)
+        assert not b.rearm_probe()     # still no space
+        diskguard.FREE_BYTES_PROBE = lambda p: 1 << 30
+        assert b.rearm_probe()
+        assert not b.degraded
+        assert b.rearms == 1
+
+    def test_degraded_preflight_reprobes_every_nth(self):
+        b = DiskBudget("x.jsonl", min_free_bytes=1 << 20, probe_every=4)
+        diskguard.FREE_BYTES_PROBE = lambda p: 0
+        assert not b.preflight(256)
+        diskguard.FREE_BYTES_PROBE = lambda p: 1 << 30
+        # Rate-limited: the first probe_every-1 refusals don't re-probe.
+        results = [b.preflight(256) for _ in range(4)]
+        assert results[-1] is True
+        assert not any(results[:-1])
+        assert not b.degraded
+
+    def test_note_enospc_degrades(self):
+        b = DiskBudget("x.jsonl", min_free_bytes=1 << 20)
+        b.note_enospc(OSError(28, "No space left on device"))
+        assert b.degraded
+
+    def test_status_counters(self):
+        b = DiskBudget("x.jsonl", min_free_bytes=1 << 20)
+        diskguard.FREE_BYTES_PROBE = lambda p: 0
+        b.preflight(256)
+        st = b.status()
+        assert st["state"] == "degraded"
+        assert st["degradations"] == 1
+        assert st["refusals"] == 1
+
+
+class TestJournalDiskGuard:
+    def test_degraded_submit_refused_before_write(self, tmp_path):
+        path = tmp_path / "j.jsonl"
+        eng = _world(path, min_free_bytes=1 << 20)
+        eng.submit(_wl("a"))
+        eng.schedule_once()
+        eng.journal.sync()
+        size0 = os.path.getsize(path)
+        diskguard.FREE_BYTES_PROBE = lambda p: 0
+        assert not eng.journal.writable()
+        assert eng.journal.degraded
+        with pytest.raises(JournalDegraded):
+            eng.submit(_wl("b"))
+        eng.journal.sync()
+        # Refusal happened BEFORE the write syscall: not one byte of
+        # torn record landed on the (simulated-full) disk.
+        assert os.path.getsize(path) == size0
+        eng.journal.close()
+
+    def test_engine_parks_cycles_while_degraded_then_resumes(self, tmp_path):
+        path = tmp_path / "j.jsonl"
+        eng = _world(path, min_free_bytes=1 << 20)
+        eng.submit(_wl("a"))
+        diskguard.FREE_BYTES_PROBE = lambda p: 0
+        seq0 = eng.cycle_seq
+        result = eng.schedule_once()
+        # Parked as idle: no scheduling happened, seq still advanced
+        # (listeners — ladder, watchdog — must keep running).
+        assert result is None
+        assert eng.cycle_seq == seq0 + 1
+        assert eng.workloads["default/a"].status.admission is None
+        # Space returns: the parked check's writable() re-arms the
+        # budget at the cycle boundary and scheduling resumes.
+        diskguard.FREE_BYTES_PROBE = None
+        assert eng.schedule_once() is not None
+        assert eng.journal.budget.rearms == 1
+        assert eng.workloads["default/a"].status.admission is not None
+        eng.journal.close()
+
+
+class TestCheckpointDiskGuard:
+    def test_checkpoint_preflight_refuses_whole_payload(self, tmp_path):
+        from kueue_tpu.store.checkpoint import CheckpointStore
+
+        path = str(tmp_path / "j.jsonl")
+        eng = _world(path)
+        eng.submit(_wl("a"))
+        eng.schedule_once()
+        eng.journal.sync()
+        store = CheckpointStore.for_journal(path, min_free_bytes=1 << 20)
+        diskguard.FREE_BYTES_PROBE = lambda p: 0
+        with pytest.raises(OSError):
+            store.write(eng, seq=eng.cycle_seq)
+        # A refused checkpoint leaves zero new bytes behind.
+        leftovers = [f for f in os.listdir(store.directory)] \
+            if os.path.isdir(store.directory) else []
+        assert not [f for f in leftovers if not f.endswith(".tmp")] or \
+            not leftovers
+        diskguard.FREE_BYTES_PROBE = None
+        assert store.budget.rearm_probe()
+        meta = store.write(eng, seq=eng.cycle_seq)
+        assert meta.seq == eng.cycle_seq
+        assert store.budget.rearms >= 1
+        eng.journal.close()
+
+
+# ---------------------------------------------------------------------------
+# cycle watchdog
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    """Just enough engine surface for direct watchdog hook driving."""
+
+    def __init__(self):
+        self.pre_cycle_hooks = []
+        self.cycle_listeners = []
+        self.last_cycle_mode = "sequential"
+        self.oracle = None
+        self.watchdog = None
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _drive(wd, clk, seq, dur):
+    wd._pre_cycle(seq, wd.engine)
+    clk.t += dur
+    wd._on_cycle(seq, object())
+
+
+class TestWatchdogBreaker:
+    def _mk(self, **kw):
+        eng = _StubEngine()
+        clk = _FakeClock()
+        kw.setdefault("deadline_s", 0.1)
+        kw.setdefault("threshold", 3)
+        kw.setdefault("cooldown_cycles", 4)
+        wd = CycleWatchdog(eng, watch_thread=False, clock=clk, **kw)
+        return eng, clk, wd
+
+    def test_overruns_counted_and_breaker_opens(self):
+        eng, clk, wd = self._mk()
+        for seq in range(3):
+            _drive(wd, clk, seq, 0.2)
+        assert wd.overruns == 3
+        assert wd.state == OPEN
+        assert wd.demotions == 1
+        assert wd.last_overrun["seq"] == 2
+
+    def test_good_cycle_resets_consecutive(self):
+        eng, clk, wd = self._mk()
+        _drive(wd, clk, 0, 0.2)
+        _drive(wd, clk, 1, 0.2)
+        _drive(wd, clk, 2, 0.01)       # recovers before the third miss
+        _drive(wd, clk, 3, 0.2)
+        assert wd.state == CLOSED
+        assert wd.consecutive_bad == 1
+
+    def test_halfopen_probe_recloses(self):
+        eng, clk, wd = self._mk()
+        for seq in range(3):
+            _drive(wd, clk, seq, 0.2)   # opens, reopen_at = 2 + 4
+        for seq in range(3, 6):
+            _drive(wd, clk, seq, 0.01)  # cooling down, still OPEN
+        assert wd.state == OPEN
+        wd._pre_cycle(6, eng)           # seq >= reopen_at: probe window
+        assert wd.state == HALF_OPEN
+        clk.t += 0.01
+        wd._on_cycle(6, object())
+        assert wd.state == CLOSED
+        assert wd.repromotions == 1
+
+    def test_bad_probe_doubles_cooldown_capped(self):
+        eng, clk, wd = self._mk()
+        for seq in range(3):
+            _drive(wd, clk, seq, 0.2)
+        base = wd.cooldown_cycles
+        seq = 3
+        for _ in range(6):              # repeated bad probes
+            seq = wd._reopen_at
+            _drive(wd, clk, seq, 0.2)
+        assert wd._cooldown == base * 8  # doubling is capped
+
+    def test_device_mode_demotes_oracle_supervisor(self):
+        eng, clk, wd = self._mk()
+        calls = []
+        eng.oracle = types.SimpleNamespace(supervisor=types.SimpleNamespace(
+            demote=lambda seq, reason: calls.append((seq, reason))))
+        eng.last_cycle_mode = "device"
+        for seq in range(3):
+            _drive(wd, clk, seq, 0.2)
+        assert len(calls) == 1
+        assert "watchdog" in calls[0][1]
+
+    def test_attach_idempotent_and_detach(self):
+        eng = _StubEngine()
+        wd = attach_watchdog(eng, watch_thread=False)
+        assert attach_watchdog(eng) is wd
+        wd.detach()
+        assert eng.watchdog is None
+        assert not eng.pre_cycle_hooks and not eng.cycle_listeners
+
+
+class TestWatchdogHangSampler:
+    def test_hung_cycle_detected_with_stacks(self):
+        eng = _world()
+        wd = attach_watchdog(eng, deadline_s=5.0, hang_after_s=0.02,
+                             poll_s=0.005, threshold=100)
+        try:
+            hang = {"done": False}
+
+            def _hang_hook(seq, engine):
+                if not hang["done"]:
+                    hang["done"] = True
+                    time.sleep(0.15)    # >= 6x hang_after_s: the
+                                        # sampler cannot miss it
+            eng.pre_cycle_hooks.append(_hang_hook)
+            eng.schedule_once()
+            assert wd.hung_cycles == 1
+            assert wd.last_hang is not None
+            assert wd.last_hang["stacks"]          # post-mortem frames
+            assert wd.state == CLOSED              # threshold not hit
+            assert wd.status()["lastHang"] is not None
+            assert "stacks" not in wd.status()["lastHang"]
+        finally:
+            wd.detach()
+
+    def test_fast_cycles_never_flag(self):
+        eng = _world()
+        wd = attach_watchdog(eng, deadline_s=5.0, hang_after_s=1.0,
+                             poll_s=0.01)
+        try:
+            for _ in range(5):
+                eng.schedule_once()
+            assert wd.hung_cycles == 0
+            assert wd.overruns == 0
+            assert wd.state == CLOSED
+        finally:
+            wd.detach()
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder
+# ---------------------------------------------------------------------------
+
+
+def _ladder_world(relax_cycles=2):
+    eng = _world()
+    slo = _FakeSLO()
+    eng.slo = slo
+    shedder = AdmissionShedder(rate=10.0)
+    eng.shedder = shedder
+    eng.attach_tracer()
+    ladder = attach_ladder(eng, relax_cycles=relax_cycles)
+    return eng, slo, shedder, ladder
+
+
+class TestDegradationLadder:
+    def test_normal_world_stays_normal(self):
+        eng, slo, shedder, ladder = self._cycle_world()
+        assert ladder.rung == R_NORMAL
+        assert eng.tracer.capture
+        assert shedder.degraded_factor is None
+
+    def _cycle_world(self, **kw):
+        eng, slo, shedder, ladder = _ladder_world(**kw)
+        eng.schedule_once()
+        return eng, slo, shedder, ladder
+
+    def test_warn_sheds_trace_first(self):
+        eng, slo, shedder, ladder = self._cycle_world()
+        slo.status, slo.burn = 1, 1.2
+        eng.schedule_once()
+        assert ladder.rung == R_TRACE
+        assert not eng.tracer.capture
+        assert shedder.degraded_factor is None
+
+    def test_hot_warn_sheds_fanout(self):
+        eng, slo, shedder, ladder = self._cycle_world()
+        from kueue_tpu.visibility.fanout import FanoutHub
+        hub = FanoutHub(shards=1)
+        eng.fanout = hub
+        slo.status, slo.burn = 1, 3.0
+        eng.schedule_once()
+        assert ladder.rung == R_FANOUT
+        assert not hub.detail
+        hub.close()
+
+    def test_breach_squeezes_submissions(self):
+        eng, slo, shedder, ladder = self._cycle_world()
+        slo.status, slo.burn = 2, 5.0
+        eng.schedule_once()
+        assert ladder.rung == R_SUBMIT
+        assert shedder.degraded_factor == pytest.approx(0.05)
+
+    def test_disk_degraded_sheds_everything(self):
+        eng, slo, shedder, ladder = self._cycle_world()
+        eng.journal = types.SimpleNamespace(
+            degraded=True, sync=lambda: None, writable=lambda: False)
+        eng.schedule_once()
+        assert ladder.rung == R_SUBMIT
+        # Nothing may be admitted that cannot be journaled: 0.0, not
+        # the 0.05 trickle of the SLO-breach posture.
+        assert shedder.degraded_factor == 0.0
+        eng.journal = None
+
+    def test_watchdog_demotion_hits_device_rung(self):
+        eng, slo, shedder, ladder = self._cycle_world()
+        calls = []
+        eng.oracle = types.SimpleNamespace(
+            try_cycle=lambda: None,     # defer to the sequential path
+            cycles_fallback=0,
+            supervisor=types.SimpleNamespace(
+                demote=lambda seq, reason: calls.append((seq, reason))))
+        eng.watchdog = types.SimpleNamespace(
+            demoted=True, state="open", last_transition_reason="hung")
+        eng.schedule_once()
+        assert ladder.rung == R_DEVICE
+        assert calls and "ladder" in calls[-1][1]
+        eng.watchdog = None
+        eng.oracle = None
+
+    def test_relax_one_rung_per_clean_window(self):
+        eng, slo, shedder, ladder = self._cycle_world(relax_cycles=2)
+        slo.status, slo.burn = 2, 5.0
+        eng.schedule_once()
+        assert ladder.rung == R_SUBMIT
+        slo.status, slo.burn = 0, 0.0
+        rungs = []
+        for _ in range(6):
+            eng.schedule_once()
+            rungs.append(ladder.rung)
+        # One rung per 2 clean cycles: 3,2 then 2,1 then 1,0.
+        assert rungs == [R_SUBMIT, R_FANOUT, R_FANOUT, R_TRACE,
+                         R_TRACE, R_NORMAL]
+        assert eng.tracer.capture
+        assert shedder.degraded_factor is None
+        assert ladder.relaxations == 3
+
+    def test_flap_resets_clean_counter(self):
+        eng, slo, shedder, ladder = self._cycle_world(relax_cycles=3)
+        slo.status, slo.burn = 2, 5.0
+        eng.schedule_once()
+        slo.status, slo.burn = 0, 0.0
+        eng.schedule_once()
+        eng.schedule_once()
+        slo.status, slo.burn = 2, 5.0   # trigger returns pre-relax
+        eng.schedule_once()
+        assert ladder.rung == R_SUBMIT
+        assert ladder.status()["cleanCycles"] == 0
+
+    def test_attach_idempotent_and_detach(self):
+        eng, slo, shedder, ladder = self._cycle_world()
+        assert attach_ladder(eng) is ladder
+        ladder.detach()
+        assert eng.ladder is None
+
+
+# ---------------------------------------------------------------------------
+# component levers the ladder pulls
+# ---------------------------------------------------------------------------
+
+
+class TestFanoutDetailLever:
+    def test_detail_kinds_suppressed_when_off(self):
+        from kueue_tpu.visibility.fanout import DETAIL_KINDS, FanoutHub
+
+        hub = FanoutHub(shards=1)
+        try:
+            hub.detail = False
+            for kind in sorted(DETAIL_KINDS):
+                hub.publish(kind, "{}")
+            hub.publish("heartbeat", "{}")   # essential kind flows
+            assert hub.detail_suppressed == len(DETAIL_KINDS)
+            assert hub.events_published == 1
+            st = hub.stats()
+            assert st["detail"] is False
+            assert st["detailSuppressed"] == len(DETAIL_KINDS)
+        finally:
+            hub.close()
+
+
+class TestTracerCaptureLever:
+    def test_capture_off_stops_trees_not_attachment(self):
+        eng = _world()
+        tracer = eng.attach_tracer()
+        eng.submit(_wl("a"))
+        eng.schedule_once()
+        traced = tracer.cycles_traced
+        assert traced >= 1
+        tracer.capture = False
+        eng.submit(_wl("b"))
+        eng.schedule_once()
+        assert tracer.cycles_traced == traced
+        tracer.capture = True
+        eng.submit(_wl("c"))
+        eng.schedule_once()
+        assert tracer.cycles_traced == traced + 1
+
+
+# ---------------------------------------------------------------------------
+# overload fault kinds
+# ---------------------------------------------------------------------------
+
+
+class TestOverloadFaultKinds:
+    def test_parse_new_kinds(self):
+        from kueue_tpu.replay.faults import FaultPlan
+
+        plan = FaultPlan.parse(
+            "hang@cycle:2:250,arrival-storm@cycle:3:5,"
+            "slow-consumer-flood@cycle:1:4,disk-pressure-ramp@cycle:2:3")
+        kinds = [(f.kind, f.n, f.arg) for f in plan.faults]
+        assert ("hang", 2, 250.0) in kinds
+        assert ("arrival-storm", 3, 5.0) in kinds
+        assert ("slow-consumer-flood", 1, 4.0) in kinds
+        assert ("disk-pressure-ramp", 2, 3.0) in kinds
+
+    @pytest.mark.parametrize("spec", [
+        "hang@cycle:2",                 # no duration
+        "hang@cycle:2:0",               # zero duration
+        "arrival-storm@cycle:1:0",      # zero count
+        "disk-pressure-ramp@cycle:1:1.5",  # fractional cycle count
+        "slow-consumer-flood@cycle:1",  # no count
+    ])
+    def test_parse_rejects_bad_specs(self, spec):
+        from kueue_tpu.replay.faults import FaultPlan
+
+        with pytest.raises(ValueError):
+            FaultPlan.parse(spec)
+
+    def test_arrival_storm_injects_workloads(self):
+        from kueue_tpu.replay.faults import arm_faults
+
+        eng = _world()
+        arm_faults(eng, "arrival-storm@cycle:1:5")
+        eng.schedule_once()
+        eng.schedule_once()
+        storm = [k for k in eng.workloads if "/storm-1-" in k]
+        assert len(storm) == 5
+
+    def test_slow_consumer_flood_needs_hub(self):
+        from kueue_tpu.replay.faults import arm_faults
+
+        eng = _world()
+        arm_faults(eng, "slow-consumer-flood@cycle:0:2")
+        with pytest.raises(RuntimeError):
+            eng.schedule_once()
+
+    def test_slow_consumer_flood_subscribes_undrained_clients(self):
+        from kueue_tpu.replay.faults import arm_faults
+        from kueue_tpu.visibility.fanout import FanoutHub
+
+        eng = _world()
+        eng.fanout = FanoutHub(shards=1)
+        try:
+            injector = arm_faults(eng, "slow-consumer-flood@cycle:0:3")
+            eng.schedule_once()
+            assert len(injector._flood_clients) == 3
+        finally:
+            eng.fanout.close()
+
+    def test_disk_pressure_ramp_parks_then_rearms(self, tmp_path):
+        from kueue_tpu.replay.faults import arm_faults
+
+        path = tmp_path / "j.jsonl"
+        eng = _world(path, min_free_bytes=1 << 20)
+        # Two workloads: admission is one per CQ per cycle, so "b"
+        # stays pending across the whole pressure window.
+        eng.submit(_wl("a"))
+        eng.submit(_wl("b"))
+        arm_faults(eng, "disk-pressure-ramp@cycle:1:2")
+        assert eng.schedule_once() is not None      # cycle 0: admits a
+        assert eng.schedule_once() is None          # cycle 1: ramp on
+        assert eng.journal.degraded
+        assert eng.schedule_once() is None          # cycle 2: still on
+        assert eng.workloads["default/b"].status.admission is None
+        # cycle 3: seq >= ramp end — probe restored, budget re-arms at
+        # the parked check and scheduling resumes in the SAME cycle.
+        assert eng.schedule_once() is not None
+        assert diskguard.FREE_BYTES_PROBE is None
+        assert not eng.journal.degraded
+        assert eng.journal.budget.rearms >= 1
+        assert eng.workloads["default/b"].status.admission is not None
+        eng.journal.close()
+
+    def test_disk_pressure_ramp_in_benign_chaos_set(self):
+        from kueue_tpu.replay.faults import ChaosSchedule
+
+        assert any("disk-pressure-ramp" in t for t in ChaosSchedule.BENIGN)

@@ -55,6 +55,13 @@ MIN_HISTORY = 3     # samples needed to fit a noise band
 MIN_DROP = 0.15     # materiality floor: <15% never flags
 SIGMA_K = 3.0       # noise band width
 
+# Per-scenario materiality overrides. traffic_storm's value is
+# admitted/s of WALL time and its chaos leg sleeps a fixed ~0.5s
+# (injected hang + disk-pressure window) inside a sub-second busy
+# span, so its round-to-round noise is structurally wider than the
+# compute-bound scenarios — gate it, but only on large drops.
+MIN_DROP_OVERRIDES = {"traffic_storm": 0.30}
+
 _VAL_RE = re.compile(r"^\s*([-+0-9.eE]+)\s+(.*)\(vs\b")
 _FRAG_RE = re.compile(
     r'"(\w+)":\s*\{\s*"value":\s*([-+0-9.eE]+),\s*"unit":\s*"([^"]*)"')
@@ -64,6 +71,10 @@ def lower_is_better(name: str, unit: str) -> bool:
     # federation_failover reports re-dispatch p95 in seconds — smaller
     # is healthier. ha_failover is NOT in this set: its value is
     # submissions recovered per second of failover, so higher wins.
+    # traffic_storm / traffic_diurnal report admitted/s of wall time
+    # (admissions/s), so they gate in the default higher-is-better
+    # direction — their latency claims (p99_admit_s) live in detail
+    # and are asserted by tests, not gated here.
     return ("latency" in name or "s/cycle" in unit
             or name == "federation_failover")
 
@@ -204,7 +215,8 @@ def evaluate_scenario(name: str, series: list, latest_round: int) -> dict:
     direction = -1.0 if lower_is_better(name, unit) else 1.0
     worsening = direction * (center - math.log(value)) \
         if value > 0 else float("inf")
-    threshold = max(math.log(1.0 + MIN_DROP), SIGMA_K * sigma)
+    min_drop = MIN_DROP_OVERRIDES.get(name, MIN_DROP)
+    threshold = max(math.log(1.0 + min_drop), SIGMA_K * sigma)
     report.update({
         "gated": True,
         "median": math.exp(center),

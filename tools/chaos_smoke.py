@@ -103,9 +103,14 @@ def run_worker(args) -> int:
     from kueue_tpu.store.checkpoint import Checkpointer
     from kueue_tpu.store.journal import rebuild_engine
 
+    # min_free_bytes arms the disk budget so the benign
+    # disk-pressure-ramp fault (diskguard.FREE_BYTES_PROBE → 0) has a
+    # guard to trip; 1 MiB is trivially satisfied by any real
+    # filesystem, so unfaulted stages behave identically.
     eng = rebuild_engine(
         args.journal, attach_oracle=args.oracle,
-        journal_kwargs={"rotate_records": ROTATE_RECORDS})
+        journal_kwargs={"rotate_records": ROTATE_RECORDS,
+                        "min_free_bytes": 1 << 20})
     # Retention OFF: the parent proves checkpoint recovery against a
     # full genesis replay afterwards, which needs the whole history.
     ck = Checkpointer(eng, interval=CKPT_INTERVAL, keep=2,

@@ -63,6 +63,24 @@ DEFAULT_ZONES: tuple = (
     # discipline so a reader that grows a "fixup" sneaks past review
     # but not past lint.
     ("kueue_tpu/store/checkpoint.py", frozenset({"U1", "J1"})),
+    # The cycle watchdog observes cycle durations and may demote the
+    # device path at the oracle breaker — WHERE a decision runs, never
+    # WHAT it decides (both paths are digest-proven identical). Pinned
+    # explicitly under the write-only discipline so the demote seam
+    # can never quietly grow into an engine mutation.
+    ("kueue_tpu/obs/watchdog.py", frozenset({"O1", "J1"})),
+    # Disk-budget guard + journal: guardians of durable state, not
+    # decision core. D1 must NOT apply (statvfs probing and fsync
+    # pacing are inherently wall-clock); pinned so a zone re-shuffle
+    # cannot accidentally demand determinism of the degrade/re-arm
+    # path.
+    ("kueue_tpu/store/diskguard.py", frozenset({"U1", "J1"})),
+    ("kueue_tpu/store/journal.py", frozenset({"U1", "J1"})),
+    # Open-loop load generation: pure functions of (pattern, seed) by
+    # contract, but it is BENCH input machinery, not decision core —
+    # its own docstring determinism contract (seeded random.Random) is
+    # exactly what D1 bans, so only the global jit-purity rule applies.
+    ("kueue_tpu/loadgen/", frozenset({"J1"})),
 )
 
 GLOBAL_RULES = frozenset({"J1"})
