@@ -6,8 +6,9 @@ PY ?= python
 PYTEST_FLAGS ?= -q
 
 .PHONY: all native test test-fast test-device bench multichip-dryrun \
-  replay-smoke obs-smoke tas-smoke perf-smoke ha-smoke chaos-smoke \
-  federation-smoke overload-smoke bench-gate lint clean
+  replay-smoke obs-smoke tas-smoke perf-smoke apply-smoke ha-smoke \
+  chaos-smoke federation-smoke overload-smoke smoke bench-gate lint \
+  clean
 
 all: native
 
@@ -80,6 +81,17 @@ obs-smoke: lint
 perf-smoke: lint
 	JAX_PLATFORMS=cpu $(PY) tools/perf_smoke.py
 
+# Columnar-apply / pipelined-cycle smoke: one churn world drained
+# through every KUEUE_TPU_PIPELINE x KUEUE_TPU_COLUMNAR arm to
+# byte-identical digests and final state, the full arm proven to use
+# speculative encodes, then two lethal subprocess stages (SIGKILL at
+# the Nth bulk admission, torn journal tail) whose journal rebuilds
+# must converge to the uninterrupted control — zero lost/duplicate
+# admissions (controllers/colapply.py, oracle/engine_bridge.py,
+# replay/faults.py). lint first: colapply sits in a U1/D1 zone.
+apply-smoke: lint
+	JAX_PLATFORMS=cpu $(PY) tools/apply_smoke.py
+
 # HA failover smoke: leader + follower replicas over one journal;
 # the leader is SIGKILLed mid-admission (and, in a second arm, with a
 # torn journal tail); the follower must steal the fenced lease, replay-
@@ -134,6 +146,12 @@ overload-smoke: lint
 # fitted threshold, pointing at the apply sub-phase histogram.
 bench-gate:
 	$(PY) tools/bench_sentinel.py --dir .
+
+# The full CI smoke chain: every subsystem smoke, ending on the bench
+# regression gate so a perf regression fails the same entry point as a
+# correctness one.
+smoke: replay-smoke tas-smoke obs-smoke perf-smoke apply-smoke \
+  ha-smoke chaos-smoke federation-smoke overload-smoke bench-gate
 
 # Validate the multi-chip sharding compiles + executes on a virtual mesh.
 multichip-dryrun:

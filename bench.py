@@ -907,10 +907,22 @@ def bench_trace_overhead(n_workloads, n_cohorts=4, repeats=3):
         for wl in scen.workloads:
             eng.clock += 0.0001
             eng.submit(wl)
-        t0 = time.perf_counter()
-        while eng.schedule_once() is not None:
-            pass
-        elapsed = time.perf_counter() - t0
+        # Serving GC posture in BOTH arms (bench_cycle_latency stance:
+        # part of the system under test). Without it the traced arm is
+        # billed for full-heap collections the serving daemon never
+        # runs: the retention ring's survivors push extra gen-2 marks
+        # across the whole workload world, and that GC drag — not
+        # tracer CPU — dominated the measured overhead.
+        import gc
+        eng.apply_serving_gc_posture()
+        try:
+            t0 = time.perf_counter()
+            while eng.schedule_once() is not None:
+                pass
+            elapsed = time.perf_counter() - t0
+        finally:
+            gc.enable()
+            gc.unfreeze()
         admitted = sum(1 for w in eng.workloads.values()
                        if w.is_admitted)
         return elapsed, f"{state['digest']:08x}", state["cycles"], admitted

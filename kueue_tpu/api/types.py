@@ -51,10 +51,22 @@ class FlavorResource:
     """A (ResourceFlavor, resource name) pair — the quota coordinate.
 
     Reference: pkg/resources.FlavorResource.
+
+    The hash is cached: quota coordinates key the usage/quota dicts on
+    every accounting touch, and the generated dataclass __hash__
+    rebuilds a field tuple per call — measurable at serving batch
+    sizes.
     """
 
     flavor: str
     resource: str
+
+    def __hash__(self) -> int:
+        h = self.__dict__.get("_hash")
+        if h is None:
+            h = hash((self.flavor, self.resource))
+            object.__setattr__(self, "_hash", h)
+        return h
 
 
 @dataclass(frozen=True)

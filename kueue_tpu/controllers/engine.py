@@ -1162,9 +1162,17 @@ class Engine:
             self.unadmitted.remove_many(ctx.removed_unadmitted)
         if self.journal is not None:
             _pt = _perf.begin()
-            for key in dict.fromkeys(ctx.journal_keys):
-                wl = self.workloads.get(key)
-                if wl is not None:
+            wls = [wl for wl in (self.workloads.get(key)
+                                 for key in dict.fromkeys(ctx.journal_keys))
+                   if wl is not None]
+            apply_many = getattr(self.journal, "apply_many", None)
+            if apply_many is not None:
+                # One encode + one locked write for the cycle's whole
+                # admitted batch (same record stream as the per-record
+                # loop, store/journal.py apply_many).
+                apply_many("workload", wls, ts=self.clock)
+            else:
+                for wl in wls:
                     self.journal.apply("workload", wl, ts=self.clock)
             _perf.end("apply.journal_append", _pt)
 
@@ -1181,7 +1189,23 @@ class Engine:
         with reclaimable pods, preemption targets (slice replacement),
         or configured admission checks take the exact per-entry _admit
         path — only the hot plain-admission shape is flattened.
+
+        The batch is applied columnar by default (controllers/colapply:
+        vectorized rowcache release, batched dirty marks and
+        expectation observations); KUEUE_TPU_COLUMNAR=0 falls back to
+        the per-entry loop below. Both produce identical state —
+        tests/test_colapply.py holds them to the same digests.
         """
+        from kueue_tpu.controllers import colapply
+
+        if colapply.columnar_enabled():
+            return colapply.columnar_assume_batch(self, entries, bulk)
+        return self._assume_batch_serial(entries, bulk)
+
+    def _assume_batch_serial(self, entries, bulk: "_BulkAdmitCtx") -> list:
+        """The reference per-entry assume loop (KUEUE_TPU_COLUMNAR=0
+        escape hatch, and the semantic yardstick the columnar path is
+        tested against)."""
         if not entries:
             return []
         cache = self.cache

@@ -53,7 +53,7 @@ class CycleTracer:
         # correctness artifact. Flipping it is digest-neutral (nothing
         # here feeds a decision either way).
         self.capture = True
-        self.spans: deque[Span] = deque(maxlen=retain)
+        self._spans: deque[Span] = deque(maxlen=retain)
         self.cycles_traced = 0
         self.last_cid: Optional[str] = None
         self._epoch = time.perf_counter()
@@ -82,10 +82,10 @@ class CycleTracer:
         if not self.capture:
             return  # shed by the degradation ladder (rung "trace")
         root = self._build(seq, result, buf, t0, end)
-        self.spans.append(root)
+        self._spans.append(root)
         self.cycles_traced += 1
         self.last_cid = root.attrs["cid"]
-        self._report(root)
+        self._report(root, result)
 
     # -- span-tree construction --
 
@@ -140,57 +140,118 @@ class CycleTracer:
                                  sub_cursor, sdur,
                                  seconds=round(secs, 6), samples=n)
                 sub_cursor += sdur
+        # Workload spans are captured COLUMNAR and materialized lazily:
+        # the cycle-time capture flattens each decided entry into a
+        # tuple of primitives (strings/ints/nested tuples) and the
+        # query surface expands those into Span objects on first read.
+        # Two costs disappear from the serving loop: the per-workload
+        # Span+attrs constructions, and — the larger one — the GC drag
+        # of retaining object graphs. CPython untracks tuples and dicts
+        # that hold only untracked values, so a retention ring of
+        # primitive columns drops out of every generational scan, while
+        # a ring of Span trees (or retained Entry graphs) is re-scanned
+        # for the whole ``retain`` window.
         rationale = buf.by_workload() if buf is not None else {}
-        for e in list(result.entries) + list(result.inadmissible):
-            root.children.append(
-                self._workload_span(e, rationale, decide_ts))
+        root.attrs["_pending"] = (
+            tuple(self._workload_cols(e, rationale)
+                  for e in result.entries),
+            tuple(self._workload_cols(e, rationale)
+                  for e in result.inadmissible),
+            decide_ts)
         return root
 
-    def _workload_span(self, e, rationale: dict, ts: float) -> Span:
-        key = e.info.key
-        attrs = {
-            "decision": _STATUS_TO_DECISION.get(e.status.value,
-                                                e.status.value),
-            "cluster_queue": e.info.cluster_queue,
-        }
+    def _workload_cols(self, e, rationale: dict) -> tuple:
+        """One entry flattened to primitives — the columnar capture
+        record behind a lazy workload span. Field order matches
+        _span_from_cols."""
         a = e.assignment
-        if a is not None:
-            flavors = {ps.name: {res: fa.name
-                                 for res, fa in ps.flavors.items()}
-                       for ps in a.pod_sets if ps.flavors}
-            reasons = {ps.name: list(ps.reasons)
-                       for ps in a.pod_sets if ps.reasons}
+        if a is None:
+            flavors = reasons = borrowing = None
+        else:
+            flavors = tuple(
+                (ps.name, tuple((res, fa.name)
+                                for res, fa in ps.flavors.items()))
+                for ps in a.pod_sets if ps.flavors)
+            reasons = tuple((ps.name, tuple(ps.reasons))
+                            for ps in a.pod_sets if ps.reasons)
+            borrowing = a.borrowing
+        key = e.info.key
+        status = e.status.value
+        return (
+            key,
+            _STATUS_TO_DECISION.get(status, status),
+            e.info.cluster_queue,
+            flavors, reasons, borrowing,
+            tuple((t.workload.key, t.reason)
+                  for t in e.preemption_targets)
+            if e.preemption_targets else (),
+            e.inadmissible_msg,
+            None if status in ("assumed", "") else e.requeue_reason.value,
+            e.commit_position,
+            tuple((kind, tuple(ev.items()))
+                  for kind, ev in rationale.get(key, ())),
+        )
+
+    # -- lazy materialization --
+
+    @property
+    def spans(self) -> deque:
+        """Retained cycle span trees, workload spans materialized."""
+        for root in self._spans:
+            if "_pending" in root.attrs:
+                self._materialize(root)
+        return self._spans
+
+    def _materialize(self, root: Span) -> None:
+        entries, inadmissible, decide_ts = root.attrs.pop("_pending")
+        for cols in entries + inadmissible:
+            root.children.append(self._span_from_cols(cols, decide_ts))
+
+    def _span_from_cols(self, cols: tuple, ts: float) -> Span:
+        """Expand one columnar capture record (_workload_cols) into the
+        workload Span the eager path used to build — same names, same
+        attrs, same to_dict shape."""
+        (key, decision, cq, flavors, reasons, borrowing, preempt,
+         msg, requeue, commit_position, rationale) = cols
+        attrs = {"decision": decision, "cluster_queue": cq}
+        if borrowing is not None:  # assignment was present
             if flavors:
-                attrs["flavors"] = flavors
+                attrs["flavors"] = {ps: dict(fl) for ps, fl in flavors}
             if reasons:
-                attrs["reasons"] = reasons
-            attrs["borrowing"] = a.borrowing
-        if e.preemption_targets:
+                attrs["reasons"] = {ps: list(rs) for ps, rs in reasons}
+            attrs["borrowing"] = borrowing
+        if preempt:
             attrs["preemption_chosen"] = sorted(
-                [t.workload.key, t.reason] for t in e.preemption_targets)
-        if e.inadmissible_msg:
-            attrs["message"] = e.inadmissible_msg
-        if e.status.value not in ("assumed", ""):
-            attrs["requeue_reason"] = e.requeue_reason.value
-        if e.commit_position >= 0:
-            attrs["commit_position"] = e.commit_position
-        for kind, ev in rationale.get(key, ()):
+                [k, r] for k, r in preempt)
+        if msg:
+            attrs["message"] = msg
+        if requeue is not None:
+            attrs["requeue_reason"] = requeue
+        if commit_position >= 0:
+            attrs["commit_position"] = commit_position
+        for kind, ev in rationale:
             attrs.setdefault("rationale", []).append(
-                {"kind": kind, **ev})
+                {"kind": kind, **dict(ev)})
         return Span(f"workload/{key}", "workload", ts, 0.0, attrs)
 
     # -- side channels: metrics, journal correlation, SSE summary --
 
-    def _report(self, root: Span) -> None:
+    def _report(self, root: Span, result) -> None:
         eng = self.engine
         attrs = root.attrs
         try:
             reg = eng.registry
             reg.counter("trace_cycles_total").inc((attrs["mode"],))
             dec = reg.counter("trace_workload_decisions_total")
-            for s in root.children:
-                if s.kind == "workload":
-                    dec.inc((s.attrs["decision"],))
+            # Decision counts straight from the entry statuses — the
+            # workload spans that used to carry them are now lazy.
+            counts: dict = {}
+            for e in result.entries:
+                counts[e.status.value] = counts.get(e.status.value, 0) + 1
+            for e in result.inadmissible:
+                counts[e.status.value] = counts.get(e.status.value, 0) + 1
+            for status, n in counts.items():
+                dec.inc((_STATUS_TO_DECISION.get(status, status),), n)
         except KeyError:
             pass  # registry predates the trace families
         if self.journal_correlation and eng.journal is not None:

@@ -73,6 +73,41 @@ from kueue_tpu.scheduler.flavorassigner import (
 _HOST_BIG = np.int64(1) << 60
 
 
+def pipeline_enabled() -> bool:
+    """Double-buffered cycle loop toggle (ISSUE 16).
+
+    KUEUE_TPU_PIPELINE=0 restores the strictly serial loop (no
+    speculative encode/dispatch of cycle N+1 — the escape hatch the
+    digest-identity suite flips). KUEUE_TPU_PIPELINE_DEPTH bounds the
+    in-flight speculative cycles; the implementation is single-slot, so
+    any depth >= 1 runs one cycle ahead and 0 disables like
+    KUEUE_TPU_PIPELINE=0.
+    """
+    import os
+
+    if os.environ.get("KUEUE_TPU_PIPELINE", "1") == "0":
+        return False
+    try:
+        depth = int(os.environ.get("KUEUE_TPU_PIPELINE_DEPTH", "1"))
+    except ValueError:
+        depth = 1
+    return depth > 0
+
+
+class _CycleExit:
+    """An early-exit verdict from :meth:`OracleBridge._encode_cycle`:
+    either a named fallback (``fallback_reason``) or a literal return
+    value (idle cycles). Speculative encodes that hit one are simply
+    discarded — the exit is re-derived (with its stats counted exactly
+    once) by the next synchronous attempt."""
+
+    __slots__ = ("fallback_reason", "value")
+
+    def __init__(self, fallback_reason=None, value=None):
+        self.fallback_reason = fallback_reason
+        self.value = value
+
+
 def _flavor_taint_unsafe(rf) -> bool:
     """A flavor whose workloads must take the host path regardless of
     the batched TAS planner: taints need the host toleration
@@ -140,6 +175,25 @@ class OracleBridge:
             "placed_host": 0, "memo_hits": 0, "commit_drops": 0,
             "encode_s": 0.0, "place_s": 0.0, "decode_s": 0.0}
         self.tas_heads_per_launch: dict[int, int] = {}
+        # Double-buffered cycle loop (ISSUE 16): the speculatively
+        # encoded + device-dispatched next cycle, as (state_token, enc),
+        # or ("error", exc) when the speculative dispatch failed — the
+        # error surfaces at the next try_cycle, exactly where the
+        # serial loop would have hit it. None = nothing in flight.
+        self._spec = None
+        self.pipeline_stats: dict[str, int] = {
+            "speculated": 0, "used": 0, "discarded": 0, "skipped": 0}
+        # Discard backoff: a world whose state token flips every cycle
+        # (heavy churn between schedule_once calls) discards every
+        # speculation, turning the pipeline into pure encode waste.
+        # After two consecutive discards, probe only every other cycle
+        # (skip one, speculate, repeat); any USED speculation resets to
+        # every-cycle speculation. Halves the worst-case waste while
+        # re-engaging the pipeline within two cycles of the world going
+        # quiet. Digest-neutral by construction — speculation only
+        # moves work earlier, never changes a decision.
+        self._spec_miss = 0
+        self._spec_backoff = 0
 
     def world_is_fast_path_safe(self) -> bool:
         eng = self.engine
@@ -837,16 +891,26 @@ class OracleBridge:
 
     def try_cycle(self) -> Optional[CycleResult]:
         """Attempt one hybrid cycle. Returns None to request full
-        sequential fallback (nothing has been mutated in that case)."""
-        import jax.numpy as jnp
+        sequential fallback (nothing has been mutated in that case).
 
+        With the pipeline on (KUEUE_TPU_PIPELINE, default 1) this
+        cycle's encode + device dispatch may have been SPECULATED at
+        the end of the previous one (_maybe_speculate);
+        _take_speculation validates the state token and either consumes
+        the in-flight cycle or falls through to a fresh encode.
+        Decisions are byte-identical either way: a speculation is used
+        only when the engine state it encoded is bit-for-bit the state
+        this cycle would encode.
+        """
         eng = self.engine
         if (self.supervisor is not None
                 and not self.supervisor.allow_cycle(eng.cycle_seq)):
             # Breaker open: the device path is known-bad, skip straight
             # to the host path without paying retries or timeouts.
+            self._spec = None
             return self._fallback("breaker-open")
         if not self.world_is_fast_path_safe():
+            self._spec = None
             return self._fallback("world")
 
         if not any(pcq.items for pcq in
@@ -860,15 +924,152 @@ class OracleBridge:
 
         import time as _time
 
+        _t0 = _time.perf_counter()
+        enc = self._take_speculation()
+        if enc is None:
+            enc = self._encode_cycle()
+            if isinstance(enc, _CycleExit):
+                if enc.fallback_reason is not None:
+                    return self._fallback(enc.fallback_reason)
+                return enc.value
+        _t_encode = _time.perf_counter()
+        result = self._commit_cycle(enc, _t0, _t_encode)
+        if result is not None:
+            self._maybe_speculate()
+        return result
+
+    # -- the double-buffered cycle loop (ISSUE 16) --
+
+    def _state_token(self) -> tuple:
+        """Everything _encode_cycle reads, versioned. A speculation is
+        valid iff the token at dispatch equals the token at use: the
+        engine clock, the world spec, admitted-set churn, every
+        pending-row transition (rowcache mutation counter) and every
+        journaled write are covered — any engine mutation between
+        cycles flips at least one component, so a stale speculation can
+        never be committed."""
+        eng = self.engine
+        return (eng.clock,
+                eng.cache.spec_version,
+                eng.cache.admitted_version,
+                eng.queues.rows.mutation_seq,
+                getattr(eng.journal, "writes_seq", 0),
+                len(eng.workloads),
+                len(eng.namespace_labels))
+
+    def _take_speculation(self):
+        """Consume the in-flight speculative cycle if it is still
+        valid; None forces a fresh synchronous encode."""
+        slot = self._spec
+        if slot is None:
+            return None
+        self._spec = None
+        head, payload = slot
+        if head == "error":
+            # The speculative dispatch failed. Surface the error HERE —
+            # the point where the serial loop would have raised it —
+            # so the engine's RemoteOracleError fallback (and every
+            # chaos-injected oracle fault) behaves identically with the
+            # pipeline on.
+            raise payload
+        if head != self._state_token():
+            self.pipeline_stats["discarded"] += 1
+            self._count("oracle_pipeline_total", ("discarded",))
+            self._spec_miss += 1
+            if self._spec_miss >= 2:
+                self._spec_backoff = 1
+            return None
+        for fn, a in payload.deferred:
+            fn(*a)
+        payload.deferred = ()
+        self.pipeline_stats["used"] += 1
+        self._count("oracle_pipeline_total", ("used",))
+        self._spec_miss = 0
+        self._spec_backoff = 0
+        return payload
+
+    def _maybe_speculate(self) -> None:
+        """Encode cycle N+1 and dispatch its device program NOW — while
+        the host finishes this schedule_once (journal fsync, listener
+        fanout, span build) the device is already solving the next
+        cycle. Counter/stat side effects of the speculative encode are
+        deferred and committed only when the speculation is USED, so a
+        discarded one leaves every diagnostic exactly as the serial
+        loop would have."""
+        eng = self.engine
+        if not pipeline_enabled():
+            self._spec = None
+            return
+        if self._spec_backoff > 0:
+            # Discard backoff in force: this world has been invalidating
+            # speculations faster than it can use them — sit out the
+            # window rather than burn another encode that will be
+            # thrown away.
+            self._spec_backoff -= 1
+            self.pipeline_stats["skipped"] += 1
+            self._spec = None
+            return
+        if not any(pcq.items for pcq in
+                   eng.queues.cluster_queues.values()):
+            self._spec = None
+            return
+        try:
+            enc = self._encode_cycle(defer_stats=True)
+        except Exception as e:
+            self._spec = ("error", e)
+            return
+        if isinstance(enc, _CycleExit):
+            self._spec = None
+            return
+        enc.speculative = True
+        self._spec = (self._state_token(), enc)
+        self.pipeline_stats["speculated"] += 1
+
+    def _commit_tas_stats(self, tas_plan) -> None:
+        st = self.tas_stats
+        st["plan_cycles"] += 1
+        st["heads_planned"] += len(tas_plan.placements) + sum(
+            len(v) for v in tas_plan.demote.values())
+        st["placed_device"] += tas_plan.placed_device
+        st["placed_host"] += tas_plan.placed_host
+        st["memo_hits"] += tas_plan.memo_hits
+        st["encode_s"] += tas_plan.timings["encode"]
+        st["place_s"] += tas_plan.timings["place"]
+        st["decode_s"] += tas_plan.timings["decode"]
+        for n in tas_plan.launch_sizes:
+            self.tas_heads_per_launch[n] = \
+                self.tas_heads_per_launch.get(n, 0) + 1
+
+    def _encode_cycle(self, defer_stats: bool = False):
+        """The encode phase: world + row tensors, head selection with
+        hold-back, per-root host/device partitioning, batched TAS
+        nomination, sim-augmented multi-flavor nomination, and the
+        (async) device dispatch. Returns the in-flight cycle for
+        _commit_cycle, or a _CycleExit.
+
+        ``defer_stats`` (speculative mode) buffers every counter/stat
+        side effect into ``enc.deferred`` instead of committing it, so
+        discarding a speculation cannot skew diagnostics."""
+        import jax.numpy as jnp
+        import time as _time
+
         from kueue_tpu.obs.device import PhaseAnnotator
 
-        _t0 = _time.perf_counter()
-        # Named profiler scopes mirroring the phase marks below: a JAX
+        eng = self.engine
+        _t_start = _time.perf_counter()
+        deferred: list = []
+        if defer_stats:
+            def emit(fn, *a):
+                deferred.append((fn, a))
+        else:
+            def emit(fn, *a):
+                fn(*a)
+        # Named profiler scopes mirroring the phase marks: a JAX
         # profiler capture shows kueue_tpu.oracle.{encode,device,apply,
         # finalize} lined up with the host span tree (no-op unless a
         # cycle tracer is active). Sequential phase()/close() calls
-        # because this function times phases with perf_counter marks,
-        # not nested blocks; every early return below must close().
+        # because the cycle times phases with perf_counter marks, not
+        # nested blocks; every early return below must close().
         _ann = PhaseAnnotator()
         _ann.phase("encode")
         now = eng.clock
@@ -920,7 +1121,7 @@ class OracleBridge:
         else:
             # Pathological hold churn: give up on the fast path.
             _ann.close()
-            return self._fallback("held-head-churn")
+            return _CycleExit(fallback_reason="held-head-churn")
 
         head_eligible = np.zeros(C, bool)
         head_eligible[has_head] = wl.eligible[head_wid[has_head]]
@@ -951,7 +1152,7 @@ class OracleBridge:
             roots = np.unique(root_of_cq[cq_mask])
             new = roots[~host_root[roots]]
             if new.size:
-                self._host_root(reason, int(new.size))
+                emit(self._host_root, reason, int(new.size))
                 host_root[new] = True
 
         demote(has_head & ~head_eligible, "head-ineligible")
@@ -1016,19 +1217,7 @@ class OracleBridge:
                     m = np.zeros(C, bool)
                     m[closed] = True
                     demote(m, "tas-forest-shared")
-                st = self.tas_stats
-                st["plan_cycles"] += 1
-                st["heads_planned"] += len(tas_plan.placements) + sum(
-                    len(v) for v in tas_plan.demote.values())
-                st["placed_device"] += tas_plan.placed_device
-                st["placed_host"] += tas_plan.placed_host
-                st["memo_hits"] += tas_plan.memo_hits
-                st["encode_s"] += tas_plan.timings["encode"]
-                st["place_s"] += tas_plan.timings["place"]
-                st["decode_s"] += tas_plan.timings["decode"]
-                for n in tas_plan.launch_sizes:
-                    self.tas_heads_per_launch[n] = \
-                        self.tas_heads_per_launch.get(n, 0) + 1
+                emit(self._commit_tas_stats, tas_plan)
         _t_tas = _time.perf_counter() - _t_tas0
         cq_on_device = ~host_root[root_of_cq]
 
@@ -1106,7 +1295,7 @@ class OracleBridge:
             & cq_on_device[cq_safe_idx]
         if not device_w.any():
             _ann.close()
-            return self._fallback("all-host")
+            return _CycleExit(fallback_reason="all-host")
 
         # --- device cycle ---
         # World-structure arrays are device-resident across cycles
@@ -1194,14 +1383,62 @@ class OracleBridge:
                 adm_by_root=ap["adm_by_root"],
                 slot_maybe=jnp.asarray(self._slot_maybe(
                     w, pcfg, adm, self._head_pri(wl, head_wid))))
-        _t_encode = _time.perf_counter()
         _ann.phase("device")
         _inputs = dict(pending=pending, inadmissible=inadmissible,
                        usage=usage, **args, **pre_kwargs)
-        if _obs_perf.ACTIVE is not None:
-            _obs_perf.device_call("cycle_step", _inputs, statics)
+        emit(_obs_perf.device_call, "cycle_step", _inputs, statics)
+        # JAX dispatch is asynchronous: the call returns device futures
+        # without blocking, so a speculative encode leaves the kernel
+        # solving while the host finishes the previous cycle's
+        # bookkeeping. _commit_cycle blocks on the results.
         out = self._exec_call("cycle_step", self.executor.cycle_step,
                               _inputs, statics)
+        _ann.close()
+
+        from types import SimpleNamespace
+        return SimpleNamespace(
+            out=out, w=w, wl=wl, pending_infos=pending_infos, now=now,
+            W=W, C=C, cq_safe_idx=cq_safe_idx, device_w=device_w,
+            cq_on_device=cq_on_device, host_root=host_root,
+            root_of_cq=root_of_cq, has_head=has_head,
+            tas_plan=tas_plan, fused=fused, admitted=admitted,
+            preempt_targets=preempt_targets,
+            encode_s=_time.perf_counter() - _t_start, t_tas=_t_tas,
+            deferred=deferred, speculative=False)
+
+    def _commit_cycle(self, enc, _t0: float,
+                      _t_encode: float) -> Optional[CycleResult]:
+        """Block on the in-flight device verdicts and commit the cycle:
+        TAS commit-order recheck, columnar apply, finalize, host tail.
+        ``enc`` comes from _encode_cycle — fresh this cycle or used
+        from the speculation slot (byte-identical either way)."""
+        import time as _time
+
+        from kueue_tpu.obs.device import PhaseAnnotator
+        from kueue_tpu.tas import batched as _tb
+
+        eng = self.engine
+        w, wl, out = enc.w, enc.wl, enc.out
+        pending_infos = enc.pending_infos
+        now, W, C = enc.now, enc.W, enc.C
+        cq_safe_idx, device_w = enc.cq_safe_idx, enc.device_w
+        cq_on_device = enc.cq_on_device
+        host_root, root_of_cq = enc.host_root, enc.root_of_cq
+        has_head = enc.has_head
+        tas_plan, fused = enc.tas_plan, enc.fused
+        admitted = enc.admitted
+        preempt_targets = enc.preempt_targets
+        _t_tas = enc.t_tas
+
+        def demote(cq_mask: np.ndarray, reason: str) -> None:
+            roots = np.unique(root_of_cq[cq_mask])
+            new = roots[~host_root[roots]]
+            if new.size:
+                self._host_root(reason, int(new.size))
+                host_root[new] = True
+
+        _ann = PhaseAnnotator()
+        _ann.phase("device")
         if _obs_perf.ACTIVE is not None:
             _obs_perf.device_result("cycle_step", out)
         (new_pending, new_inadmissible, usage2, wl_admitted, slot_admitted,
@@ -1332,6 +1569,12 @@ class OracleBridge:
                   "apply": _t_apply - _t_device,
                   "finalize": _t_final - _t_apply,
                   "tas_place": _t_tas}
+        if enc.speculative:
+            # Honest attribution for pipelined cycles: "encode" above is
+            # just the token validation; the real encode+dispatch cost
+            # was paid inside the PREVIOUS cycle's wall time and is
+            # reported here under its own key.
+            phases["spec_encode"] = enc.encode_s
         eng.last_cycle_phases = phases
         for phase, dur in phases.items():
             eng.registry.histogram(
@@ -1394,10 +1637,12 @@ class OracleBridge:
         # sparse relative to the row space).
         admit_of_slot: dict[int, int] = {}
         parked_of_slot: dict[int, list[int]] = {}
-        for i in np.nonzero(wl_admitted[:W] & apply_rows)[0]:
-            admit_of_slot[int(wls.cq[i])] = int(i)
-        for i in np.nonzero(parked[:W] & apply_rows)[0]:
-            parked_of_slot.setdefault(int(wls.cq[i]), []).append(int(i))
+        adm_rows = np.nonzero(wl_admitted[:W] & apply_rows)[0]
+        for ci, i in zip(wls.cq[adm_rows].tolist(), adm_rows.tolist()):
+            admit_of_slot[ci] = i
+        park_rows = np.nonzero(parked[:W] & apply_rows)[0]
+        for ci, i in zip(wls.cq[park_rows].tolist(), park_rows.tolist()):
+            parked_of_slot.setdefault(ci, []).append(i)
 
         # Apply per slot in the host's nominate order (the queue manager's
         # ClusterQueue iteration order). Cohort-inadmissible requeues
@@ -1410,8 +1655,8 @@ class OracleBridge:
         nominate_order = [cq_idx[n] for n in eng.queues.cluster_queues
                           if n in cq_idx]
         bulk = eng.begin_bulk_admit()
-        deferred: set = set()
-        eng._deferred_cohort_requeue = deferred
+        requeue_cohorts: set = set()
+        eng._deferred_cohort_requeue = requeue_cohorts
         try:
             pairs = self._apply_slots(
                 nominate_order, slot_mask, admit_of_slot,
@@ -1427,7 +1672,7 @@ class OracleBridge:
             after the apply span's clock stops, timed as its own
             phase."""
             eng.bulk_finalize_batch(pairs, bulk)
-            eng._requeue_cohorts_bulk(deferred)
+            eng._requeue_cohorts_bulk(requeue_cohorts)
             eng.flush_bulk_admit(bulk)
 
         return result, finalize
@@ -1440,8 +1685,21 @@ class OracleBridge:
 
         admits = []
         _pt = _obs_perf.begin()
+        # Columnar diff build: the per-slot loop reads its verdict
+        # columns through plain Python lists — one bulk tolist() per
+        # column instead of a numpy scalar index (about a microsecond
+        # each) per slot —
+        # and the assignment-flyweight key bytes for ALL slots come from
+        # one contiguous tobytes() sliced per slot.
+        slot_mask_l = slot_mask.tolist()
+        slot_preempting_l = slot_preempting.tolist()
+        slot_position_l = slot_position.tolist()
+        head_idx_l = head_idx.tolist() if head_idx is not None else None
+        fob = np.ascontiguousarray(flavor_of_res)
+        fb_stride = fob.shape[1] * fob.shape[2] * fob.itemsize
+        fb_all = fob.tobytes()
         for ci in nominate_order:
-            if not slot_mask[ci]:
+            if not slot_mask_l[ci]:
                 continue
             i = admit_of_slot.get(ci)
             if i is not None:
@@ -1449,14 +1707,16 @@ class OracleBridge:
                 entry = self._make_entry(
                     info, w, wls, flavor_of_res, i,
                     topo=None if tas_attach is None
-                    else tas_attach.get(i))
+                    else tas_attach.get(i),
+                    fbytes=fb_all[ci * fb_stride:(ci + 1) * fb_stride],
+                    ci=ci)
                 entry.status = EntryStatus.ASSUMED
-                entry.commit_position = int(slot_position[ci])
+                entry.commit_position = slot_position_l[ci]
                 admits.append(entry)
                 result.entries.append(entry)
                 result.stats.admitted += 1
-            if slot_preempting[ci]:
-                wid = int(head_idx[ci])
+            if slot_preempting_l[ci]:
+                wid = head_idx_l[ci]
                 info = pending_infos[wid]
                 entry = self._make_entry(info, w, wls, flavor_of_res, wid)
                 entry.status = EntryStatus.PREEMPTING
@@ -1469,7 +1729,7 @@ class OracleBridge:
                 eng._issue_preemptions(entry, bulk=bulk)
                 result.entries.append(entry)
                 result.stats.preempting += 1
-            head_row = int(head_idx[ci]) if head_idx is not None else -1
+            head_row = head_idx_l[ci] if head_idx_l is not None else -1
             for i in parked_of_slot.get(ci, ()):
                 info = pending_infos[i]
                 pcq = eng.queues.cluster_queues.get(info.cluster_queue)
@@ -1513,7 +1773,7 @@ class OracleBridge:
         return pairs
 
     def _make_entry(self, info, w, wls, flavor_of_res, i,
-                    topo=None) -> Entry:
+                    topo=None, fbytes=None, ci=None) -> Entry:
         """Entry for an admitted verdict row. Assignments are FLYWEIGHTS:
         rows with equal scheduling-equivalence hash and equal slot flavor
         picks produce identical Assignment structures, and the bulk-admit
@@ -1523,7 +1783,8 @@ class OracleBridge:
 
         ``flavor_of_res[ci]`` is [P, S]: one PodSetAssignment per real
         pod set (flavorassigner.go:707 builds one per podset)."""
-        ci = int(wls.cq[i])
+        if ci is None:
+            ci = int(wls.cq[i])
         # Content-addressed key: the scheduling-equivalence hash TUPLE
         # (dense hash ids are recycled and must not key a cache) plus the
         # slot's flavor picks, guarded by the spec version that defines
@@ -1538,8 +1799,17 @@ class OracleBridge:
             cache = (ver, {})
             self._assignment_cache = cache
         rows = self.engine.queues.rows
-        key = (rows._hash_tuple[i], flavor_of_res[ci].tobytes())
-        if topo is None:
+        if fbytes is None:
+            fbytes = flavor_of_res[ci].tobytes()
+        # The scheduling-equivalence hash's FIRST element is the cluster
+        # queue (cache/queues.scheduling_hash) — but Assignment content
+        # is CQ-independent (pod-set shapes plus the slot's flavor
+        # picks, which fbytes covers), so the flyweight key drops it:
+        # equivalent admissions across the whole CQ axis share one
+        # Assignment instead of one per queue.
+        h = rows._hash_tuple[i]
+        key = (h[1:] if h is not None else None, fbytes)
+        if topo is None and h is not None:
             cached = cache[1].get(key)
             if cached is not None:
                 return Entry(info=info, assignment=cached)
@@ -1561,6 +1831,6 @@ class OracleBridge:
                 topology_assignment=None if topo is None
                 else topo.get(psr.name)))
         assignment = Assignment(pod_sets=pod_sets, usage=usage)
-        if topo is None and key[0] is not None:
+        if topo is None and h is not None:
             cache[1][key] = assignment
         return Entry(info=info, assignment=assignment)

@@ -6,7 +6,9 @@ Spec grammar (comma-separated faults):
   sigkill@cycle:N          SIGKILL this process as cycle N begins
   sigkill@admission:N      SIGKILL mid-apply, at the Nth admission —
                            the journal's torn-tail + crash-recovery
-                           path under a real half-applied cycle
+                           path under a real half-applied cycle; the
+                           ordinal counts per-entry (_admit) and bulk
+                           (device-cycle columnar) admissions alike
   torn-tail@cycle:N        append a partial (newline-less, invalid)
                            record to the journal, fsync it, SIGKILL —
                            the exact artifact of a crash mid-append
@@ -268,6 +270,34 @@ class FaultInjector:
                 if self.admissions == self._kill_at_admission:
                     _die()
             engine._admit = admit_and_maybe_die
+
+            # The bulk assume path (oracle bridge device cycles) admits
+            # its fast shape without per-entry _admit calls, so the
+            # ordinal must count those too — sigkill@admission:N means
+            # the same thing on every decision path. A batch that
+            # crosses the ordinal applies exactly the prefix that
+            # reaches it and dies mid-apply: in-memory state mutated,
+            # the batch's journal records still buffered in the bulk
+            # ctx (flush_bulk_admit never runs) — the widest torn
+            # window the recovery contract covers. Slow entries inside
+            # the prefix still count (and can kill) through the _admit
+            # wrap above; the returned pairs are fast-path only, so the
+            # two counters never double-count an admission.
+            orig_bulk = engine.bulk_assume_batch
+
+            def bulk_and_maybe_die(entries, bulk):
+                entries = list(entries)
+                budget = self._kill_at_admission - self.admissions
+                if 0 < budget <= len(entries):
+                    orig_bulk(entries[:budget], bulk)
+                    self.admissions = self._kill_at_admission
+                    self.fired.append(
+                        f"sigkill@admission:{self._kill_at_admission}")
+                    _die()
+                pairs = orig_bulk(entries, bulk)
+                self.admissions += len(pairs)
+                return pairs
+            engine.bulk_assume_batch = bulk_and_maybe_die
         if self._kill_at_maintenance is not None:
             from kueue_tpu.store import journal as _journal_mod
 

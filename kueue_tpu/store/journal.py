@@ -184,6 +184,10 @@ class Journal:
         self._read_offset = 0
         self._read_ino = os.fstat(self._fh.fileno()).st_ino
         self._active_lines = 0
+        # Monotone count of lines written through this handle; the
+        # pipelined cycle loop folds it into its speculation token so a
+        # speculative encode is discarded after any journaled mutation.
+        self.writes_seq = 0
         # Generations recovered from a checkpoint (seed_generations):
         # segments the retention pass deleted may hold a key's only
         # write, so the file scan alone would under-count. Merged as a
@@ -433,6 +437,69 @@ class Journal:
         return self._stamp_and_write(rec, kind, _key_of(rec),
                                      expected_generation)
 
+    def apply_many(self, kind: str, objs, ts: float = 0.0) -> list:
+        """Batched :meth:`apply`: journal a sequence of same-kind
+        objects in ONE locked append.
+
+        Record-for-record identical to calling ``apply(kind, obj, ts)``
+        per object in order — same JSON lines, same sequential
+        generation stamps (repeated keys advance per occurrence) — but
+        the flock / inode-chase / tail-repair / fence / disk-preflight
+        / refresh round-trip is paid once per batch, and the lines land
+        in a single ``write()``. The cycle commit's journal_append step
+        turns N admissions into one of these.
+
+        Returns the list of stamped generations, in input order. On
+        failure (fence / degraded / ENOSPC) NO generation is recorded
+        in-process: whatever full lines reached the disk sit beyond
+        ``_read_offset`` and the next ``refresh()`` folds them back in,
+        exactly like an append from a foreign writer.
+        """
+        import fcntl
+
+        objs = list(objs)
+        if not objs:
+            return []
+        from kueue_tpu.api.conversion import SCHEMA_VERSION
+
+        recs = [{"op": "apply", "kind": kind, "ts": ts,
+                 "v": SCHEMA_VERSION, "obj": to_jsonable(obj)}
+                for obj in objs]
+        self._lock_active()
+        try:
+            if not self._tail_is_clean():
+                self._repair_torn_tail()
+            if self.fence is not None and not self.fence():
+                raise JournalFenced(
+                    f"batched write of {len(recs)} {kind} record(s) "
+                    f"refused: fence predicate failed (no longer "
+                    f"leader)")
+            if not self.budget.preflight(256 * len(recs)):
+                raise JournalDegraded(
+                    f"batched write of {len(recs)} {kind} record(s) "
+                    f"refused: journal degraded read-only "
+                    f"({self.budget.reason})")
+            self.refresh()
+            # Stamp generations into a LOCAL overlay first: the table
+            # only advances after the write succeeds, so a failed batch
+            # leaves in-process state untouched (refresh() self-heals
+            # any lines that made it to disk).
+            pending: dict = {}
+            gens: list = []
+            lines: list = []
+            for rec in recs:
+                k = (kind, _key_of(rec))
+                gen = pending.get(k, self._generations.get(k, 0)) + 1
+                rec["gen"] = gen
+                pending[k] = gen
+                gens.append(gen)
+                lines.append(json.dumps(rec) + "\n")
+            self._write_lines(lines)
+            self._generations.update(pending)
+            return gens
+        finally:
+            fcntl.flock(self._fh.fileno(), fcntl.LOCK_UN)
+
     def delete(self, kind: str, key: str, ts: float = 0.0,
                expected_generation: Optional[int] = None) -> int:
         from kueue_tpu.api.conversion import SCHEMA_VERSION
@@ -466,22 +533,25 @@ class Journal:
         except FileNotFoundError:
             return True
 
-    def _stamp_and_write(self, rec: dict, kind: str, key: str,
-                         expected_generation: Optional[int]) -> int:
+    def _lock_active(self) -> None:
+        """flock the ACTIVE journal file, chasing rotation renames.
+
+        The refresh+check+append must be ATOMIC across processes, or
+        two writers could both pass the generation check and clobber
+        (the TOCTOU the SSA conflict contract forbids). flock makes
+        the whole read-modify-append a critical section.
+
+        Rotation renames the active file: a handle opened before the
+        rotation now points at a SEALED segment, and appending there
+        would land records behind ones already written to the new
+        active (breaking per-key generation order). Re-check the
+        inode INSIDE the lock and chase the rename. O_APPEND without
+        O_CREAT: creating the path here would race the rotating
+        writer's own reopen and displace its meta line.
+        """
         import fcntl
 
-        # The refresh+check+append must be ATOMIC across processes, or
-        # two writers could both pass the generation check and clobber
-        # (the TOCTOU the SSA conflict contract forbids). flock makes
-        # the whole read-modify-append a critical section.
         fcntl.flock(self._fh.fileno(), fcntl.LOCK_EX)
-        # Rotation renames the active file: a handle opened before the
-        # rotation now points at a SEALED segment, and appending there
-        # would land records behind ones already written to the new
-        # active (breaking per-key generation order). Re-check the
-        # inode INSIDE the lock and chase the rename. O_APPEND without
-        # O_CREAT: creating the path here would race the rotating
-        # writer's own reopen and displace its meta line.
         for _ in range(64):
             try:
                 if (os.fstat(self._fh.fileno()).st_ino
@@ -494,6 +564,12 @@ class Journal:
             self._fh.close()
             self._fh = os.fdopen(fd, "a", encoding="utf-8")
             fcntl.flock(self._fh.fileno(), fcntl.LOCK_EX)
+
+    def _stamp_and_write(self, rec: dict, kind: str, key: str,
+                         expected_generation: Optional[int]) -> int:
+        import fcntl
+
+        self._lock_active()
         try:
             if not self._tail_is_clean():
                 # Another writer crashed mid-append: truncate its torn
@@ -527,11 +603,14 @@ class Journal:
             fcntl.flock(self._fh.fileno(), fcntl.LOCK_UN)
 
     def _write(self, rec: dict) -> None:
+        self._write_lines([json.dumps(rec) + "\n"])
+
+    def _write_lines(self, lines: list) -> None:
         import errno as _errno
 
-        line = json.dumps(rec) + "\n"
+        blob = "".join(lines)
         try:
-            self._fh.write(line)
+            self._fh.write(blob)
             self._fh.flush()
             if self.fsync:
                 os.fsync(self._fh.fileno())
@@ -549,8 +628,9 @@ class Journal:
         # Our own append is already folded into the generation table —
         # advance the read offset so the next refresh() doesn't re-read
         # and re-parse it (one open+parse per record on the hot path).
-        self._read_offset += len(line.encode("utf-8"))
-        self._active_lines += 1
+        self._read_offset += len(blob.encode("utf-8"))
+        self._active_lines += len(lines)
+        self.writes_seq += len(lines)
 
     def sync(self) -> None:
         """Crash-safe cycle boundary (Engine.schedule_once calls this

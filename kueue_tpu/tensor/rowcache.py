@@ -92,6 +92,11 @@ class WorkloadRowCache:
         self._tas_req: list = [None] * self._cap
         self._dirty: set[int] = set()
         self._hashes = _HashRegistry()
+        # Monotone mutation counter: bumped on every structural change
+        # (push/park/pop/remove/world-bind).  The pipelined cycle loop
+        # folds it into its speculation token so a speculative encode is
+        # only reused when the cache is bit-for-bit unchanged.
+        self.mutation_seq = 0
 
         # world-independent columns
         self.priority = np.zeros(self._cap, np.int64)
@@ -126,6 +131,7 @@ class WorkloadRowCache:
 
     def on_push(self, info: WorkloadInfo, sort_key: tuple) -> None:
         """Workload entered (or re-entered) a pending heap."""
+        self.mutation_seq += 1
         i = self._row_of.get(info.key)
         wl = info.obj
         if i is None:
@@ -166,6 +172,7 @@ class WorkloadRowCache:
     def on_park(self, info: WorkloadInfo) -> None:
         """Workload moved to the inadmissible side map (row kept: a
         cluster event can re-activate it)."""
+        self.mutation_seq += 1
         i = self._row_of.get(info.key)
         if i is None:  # parked without ever being pushed
             from kueue_tpu.workload_info import queue_order_timestamp
@@ -178,12 +185,14 @@ class WorkloadRowCache:
 
     def on_pop(self, key: str) -> None:
         """Workload popped (in flight with the sequential path)."""
+        self.mutation_seq += 1
         i = self._row_of.get(key)
         if i is not None:
             self.active[i] = False
 
     def on_remove(self, key: str) -> None:
         """Workload left the pending world (admitted / deleted)."""
+        self.mutation_seq += 1
         i = self._row_of.pop(key, None)
         if i is None:
             return
@@ -199,6 +208,61 @@ class WorkloadRowCache:
         self.requeue_at[i] = -_INF_TS
         self._dirty.discard(i)
         self._free.append(i)
+
+    def on_remove_batch(self, keys) -> None:
+        """Batched :meth:`on_remove`: clear every departing row's
+        columns in four vectorized writes instead of one
+        row-at-a-time walk. The per-row Python that remains is only
+        the bookkeeping numpy can't express (dict pop, flyweight
+        release, free-list push); row order is preserved so the
+        free-list matches the serial path exactly.
+        """
+        self.mutation_seq += 1
+        rows = []
+        row_pop = self._row_of.pop
+        info_of = self.info_of
+        hash_tuple = self._hash_tuple
+        tas_req = self._tas_req
+        dirty_discard = self._dirty.discard
+        free_append = self._free.append
+        append = rows.append
+        # _HashRegistry.release, inlined: the per-key method call is
+        # measurable at batch sizes (~1k keys/cycle in the serving
+        # drain) and the registry's dicts are stable for the whole
+        # batch.
+        hashes = self._hashes
+        id_of = hashes._id_of
+        count = hashes._count
+        hash_free = hashes._free
+        heappush = heapq.heappush
+        for key in keys:
+            i = row_pop(key, None)
+            if i is None:
+                continue
+            append(i)
+            info_of[i] = None
+            h = hash_tuple[i]
+            if h is not None:
+                hid = id_of.get(h)
+                if hid is not None:
+                    c = count[hid] - 1
+                    if c <= 0:
+                        del count[hid]
+                        del id_of[h]
+                        heappush(hash_free, hid)
+                    else:
+                        count[hid] = c
+                hash_tuple[i] = None
+            tas_req[i] = None
+            dirty_discard(i)
+            free_append(i)
+        if not rows:
+            return
+        idx = np.asarray(rows, np.int64)
+        self.active[idx] = False
+        self.tas_sig[idx] = 0
+        self.key_seq[idx] = np.int64(1) << 60
+        self.requeue_at[idx] = -_INF_TS
 
     # -- capacity management --
 
@@ -296,6 +360,7 @@ class WorkloadRowCache:
         sig = self.world_signature(world)
         if sig == self._signature:
             return
+        self.mutation_seq += 1
         self._signature = sig
         S = max(world.num_resources, 1)
         if S != self.requests.shape[2]:

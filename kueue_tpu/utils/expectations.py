@@ -43,6 +43,22 @@ class Store:
             if not stored:
                 del self._store[key]
 
+    def observed_uids(self, items) -> None:
+        """Batched :meth:`observed_uid`: one lock round trip for a whole
+        admitted batch (``items`` is an iterable of ``(key, uid)``), and
+        a free pass when nothing is expected — the steady serving shape."""
+        with self._lock:
+            store = self._store
+            if not store:
+                return
+            for key, uid in items:
+                stored = store.get(key)
+                if stored is None:
+                    continue
+                stored.discard(uid)
+                if not stored:
+                    del store[key]
+
     def satisfied(self, key: str) -> bool:
         """True when nothing is pending for ``key``."""
         with self._lock:

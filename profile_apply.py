@@ -39,6 +39,7 @@ def main():
     else:
         scen = baseline_like(n_cohorts=n_cohorts, n_workloads=n_workloads)
     eng = build_cycle_engine(scen, fair=fair)
+    eng.attach_perf()
     eng.apply_serving_gc_posture()
 
     # untimed first cycle: compile + initial encode
@@ -70,6 +71,24 @@ def main():
     print(f"mean phases (ms): "
           f"{ {p: round(v*1000,1) for p,v in mean.items()} }",
           file=sys.stderr)
+
+    # The always-on attribution table, in the same apply.* vocabulary
+    # as /metrics and the bench detail — so cProfile rows below and
+    # production telemetry name the same sub-steps.
+    subs = eng.perf.subphases()
+    if subs:
+        print("\nobs/perf apply-subphase attribution "
+              f"(all timed cycles, n={len(phases)}):")
+        print(f"  {'subphase':<26} {'n':>5} {'sum_ms':>9} "
+              f"{'mean_ms':>9} {'p95_ms':>9}")
+        for name in sorted(subs):
+            h = subs[name]
+            mean_ms = (h.sum / h.total * 1000.0) if h.total else 0.0
+            print(f"  {name:<26} {h.total:>5} {h.sum * 1000.0:>9.2f} "
+                  f"{mean_ms:>9.3f} {h.quantile(0.95) * 1000.0:>9.3f}")
+    else:
+        print("\nobs/perf apply-subphase attribution: no samples "
+              "(perf recorder not attached?)")
 
     s = io.StringIO()
     ps = pstats.Stats(prof, stream=s).sort_stats("cumulative")

@@ -193,7 +193,8 @@ def fit_threshold(history: list) -> tuple:
     return center, sigma
 
 
-def evaluate_scenario(name: str, series: list, latest_round: int) -> dict:
+def evaluate_scenario(name: str, series: list, latest_round: int,
+                      prev_round: int = None) -> dict:
     """Gate one scenario's trajectory. ``series`` is [(round, value,
     unit), ...] sorted; only the entry at ``latest_round`` is judged."""
     unit = series[-1][2]
@@ -203,7 +204,22 @@ def evaluate_scenario(name: str, series: list, latest_round: int) -> dict:
               "history_n": len(history), "gated": False,
               "regressed": False}
     if not latest:
-        report["status"] = "absent-latest"
+        # A scenario that ran in the round immediately before the one
+        # being gated but is MISSING from it is a failure, not a skip:
+        # a crashed/deadline-dropped scenario would otherwise vanish
+        # from the gate silently (the exact blind spot a perf PR can
+        # hide a broken scenario in). Scenarios retired before the
+        # previous round stay exempt — they have no live trajectory.
+        if prev_round is not None and any(rnd == prev_round
+                                          for rnd, _, _ in series):
+            report.update({"gated": True, "regressed": True})
+            report["status"] = (
+                f"MISSING: present in round {prev_round}, absent from "
+                f"round {latest_round} — the scenario crashed, timed "
+                f"out, or was dropped; re-run the bench or retire the "
+                f"scenario explicitly")
+        else:
+            report["status"] = "absent-latest (retired)"
         return report
     value = latest[-1]
     report["latest"] = value
@@ -285,7 +301,8 @@ def run_gate(directory: str, inject: dict = None) -> dict:
                     worse = ((1.0 - frac) if not lower_is_better(name, unit)
                              else (1.0 + frac))
                     series[i] = (rnd, v * worse, unit)
-    reports = [evaluate_scenario(name, series, latest)
+    prev = rounds[-2] if len(rounds) > 1 else None
+    reports = [evaluate_scenario(name, series, latest, prev_round=prev)
                for name, series in sorted(traj.items())]
     multichip = check_multichip(directory, latest)
     regressed = [r for r in reports if r["regressed"]]

@@ -1,0 +1,46 @@
+#!/bin/sh
+# Capture one BENCH_r<N>.json / MULTICHIP_r<N>.json trajectory pair in
+# the driver's wrapper shape ({"n","cmd","rc","tail","parsed"}), so
+# locally-captured rounds and driver-captured rounds read identically
+# to tools/bench_sentinel.py.  Usage: tools/capture_round.sh <N>
+set -eu
+N="$1"
+PAD=$(printf "%02d" "$N")
+OUT_BENCH="BENCH_r${PAD}.json"
+OUT_MC="MULTICHIP_r${PAD}.json"
+
+python bench.py > "/tmp/_bench_r${PAD}.out" 2>"/tmp/_bench_r${PAD}.err" || rc=$?
+rc=${rc:-0}
+python - "$N" "$rc" "/tmp/_bench_r${PAD}.out" "$OUT_BENCH" <<'EOF'
+import json, sys
+n, rc, src, out = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+raw = open(src, encoding="utf-8").read().strip()
+line = raw.splitlines()[-1] if raw else ""
+try:
+    parsed = json.loads(line)
+except ValueError:
+    parsed = None
+wrapper = {"n": n, "cmd": "python bench.py", "rc": rc,
+           "tail": line[-4000:]}
+if parsed is not None:
+    wrapper["parsed"] = parsed
+with open(out, "w", encoding="utf-8") as fh:
+    json.dump(wrapper, fh, indent=2)
+    fh.write("\n")
+print(f"wrote {out} (rc={rc})")
+EOF
+
+mc_rc=0
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -c "import __graft_entry__ as g; g.dryrun_multichip(8)" \
+  > "/tmp/_mc_r${PAD}.out" 2>&1 || mc_rc=$?
+python - "$mc_rc" "/tmp/_mc_r${PAD}.out" "$OUT_MC" <<'EOF'
+import json, sys
+rc, src, out = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+tail = open(src, encoding="utf-8", errors="replace").read()[-2000:]
+with open(out, "w", encoding="utf-8") as fh:
+    json.dump({"n_devices": 8, "rc": rc, "ok": rc == 0,
+               "skipped": False, "tail": tail}, fh, indent=2)
+    fh.write("\n")
+print(f"wrote {out} (rc={rc})")
+EOF

@@ -36,6 +36,13 @@ DEFAULT_ZONES: tuple = (
     ("kueue_tpu/oracle/supervisor.py", frozenset({"U1", "J1"})),
     ("kueue_tpu/cache/snapshot.py", frozenset({"D1", "U1", "J1"})),
     ("kueue_tpu/cache/", frozenset({"U1", "J1"})),
+    # Columnar diff application: the cycle commit path's batched
+    # rowcache/cache writes. It must stay bit-deterministic (the serial
+    # escape hatch is digest-proven identical, so any nondeterminism
+    # here IS a divergence) and must route guarded usage mutations
+    # through the snapshot custodians — pinned explicitly so the
+    # controllers/ tree growing a zone later cannot relax it.
+    ("kueue_tpu/controllers/colapply.py", frozenset({"D1", "U1", "J1"})),
     ("kueue_tpu/parallel/", frozenset({"D1", "J1"})),
     ("kueue_tpu/obs/", frozenset({"O1", "J1"})),
     # Perf telemetry and SLO burn-rate evaluation: explicitly listed so
