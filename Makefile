@@ -7,8 +7,8 @@ PYTEST_FLAGS ?= -q
 
 .PHONY: all native test test-fast test-device bench multichip-dryrun \
   replay-smoke obs-smoke tas-smoke perf-smoke apply-smoke ha-smoke \
-  chaos-smoke federation-smoke overload-smoke smoke bench-gate lint \
-  clean
+  chaos-smoke federation-smoke overload-smoke sim-smoke smoke \
+  bench-gate lint clean
 
 all: native
 
@@ -140,6 +140,16 @@ federation-smoke: lint
 overload-smoke: lint
 	JAX_PLATFORMS=cpu $(PY) tools/overload_smoke.py
 
+# World-simulator smoke: 8 fuzzed world-seed triples through the full
+# invariant oracle (host-vs-device differential + metamorphic
+# catalog), a multi-day compressed fault-storm arm that must re-run
+# digest-identically, and a planted lost-arrival regression that must
+# auto-shrink to a minimal reproducer exiting 3 under `kueuectl sim
+# run --repro` (tools/sim_smoke.py). lint first: the sim/loadgen/
+# watchdog/ladder C1 clock-discipline pins are part of the contract.
+sim-smoke: lint
+	JAX_PLATFORMS=cpu $(PY) tools/sim_smoke.py
+
 # Bench regression sentinel: noise-aware per-scenario gate over the
 # accumulated BENCH_r*/MULTICHIP_r* trajectory (tools/bench_sentinel.py).
 # Fails (exit 1) when the latest round regressed past its scenario's
@@ -151,7 +161,8 @@ bench-gate:
 # regression gate so a perf regression fails the same entry point as a
 # correctness one.
 smoke: replay-smoke tas-smoke obs-smoke perf-smoke apply-smoke \
-  ha-smoke chaos-smoke federation-smoke overload-smoke bench-gate
+  ha-smoke chaos-smoke federation-smoke overload-smoke sim-smoke \
+  bench-gate
 
 # Validate the multi-chip sharding compiles + executes on a virtual mesh.
 multichip-dryrun:

@@ -494,6 +494,45 @@ def build_parser() -> argparse.ArgumentParser:
              "--json FILE, paths)")
     lint.add_argument("lint_args", nargs=argparse.REMAINDER)
 
+    # Parsed in main() before engine construction, like lint: the
+    # simulator builds its own engines from the world seeds.
+    sim = sub.add_parser(
+        "sim",
+        help="time-compressed world simulator (kueue_tpu/sim): "
+             "regenerate a world from its seed triple, replay it on "
+             "the virtual clock, check invariants, shrink failures")
+    sims = sim.add_subparsers(dest="sim_command")
+    srun = sims.add_parser(
+        "run",
+        help="replay one world; exit 3 when --check finds an "
+             "invariant violation")
+    srun.add_argument("--world-seed", type=int, default=0)
+    srun.add_argument("--traffic-seed", type=int, default=0)
+    srun.add_argument("--fault-seed", type=int, default=0)
+    srun.add_argument("--horizon", type=float, default=None,
+                      help="virtual horizon seconds (default: drawn "
+                           "from the world seed)")
+    srun.add_argument("--cycle", type=float, default=None,
+                      help="scheduling cadence in virtual seconds")
+    srun.add_argument("--device", action="store_true",
+                      help="include the host-vs-device differential "
+                           "(needs JAX)")
+    srun.add_argument("--check", action="store_true",
+                      help="run the invariant oracle instead of a "
+                           "bare replay")
+    srun.add_argument("--repro",
+                      help="reproducer JSON written by the shrinker; "
+                           "overrides the seed/dim flags")
+    srun.add_argument("--json", action="store_true", dest="as_json",
+                      help="emit the structured result")
+    sshr = sims.add_parser(
+        "shrink",
+        help="shrink a failing triple to a minimal reproducer")
+    sshr.add_argument("--world-seed", type=int, required=True)
+    sshr.add_argument("--traffic-seed", type=int, default=0)
+    sshr.add_argument("--fault-seed", type=int, default=0)
+    sshr.add_argument("--out", help="write the reproducer JSON here")
+
     slo = sub.add_parser(
         "slo",
         help="serving objectives: declared targets, multi-window burn "
@@ -546,6 +585,79 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _sim_main(argv: list) -> int:
+    """`kueuectl sim ...`: replay/check/shrink generated worlds.
+    Exit codes: 0 clean, 2 usage, 3 invariant violation (or, with
+    --repro, the reproducer's failure still reproducing)."""
+    import json as _json
+
+    args = build_parser().parse_args(argv)
+    if args.sim_command == "run":
+        from kueue_tpu.sim.oracle import check_world
+        from kueue_tpu.sim.shrink import Reproducer, reproduce
+
+        if args.repro:
+            rep = Reproducer.load(args.repro)
+            still = reproduce(rep)
+            out = {"reproducer": rep.to_dict(), "reproduces": still}
+            print(_json.dumps(out, indent=2, sort_keys=True)
+                  if args.as_json else
+                  f"{rep.command}\n  invariant {rep.invariant}: "
+                  + ("STILL FAILING" if still else "no longer fails"))
+            return 3 if still else 0
+        horizon = args.horizon if args.horizon is not None else 240.0
+        cycle = args.cycle if args.cycle is not None else 2.0
+        if args.check:
+            report = check_world(args.world_seed, args.traffic_seed,
+                                 args.fault_seed, device=args.device,
+                                 horizon_s=horizon, cycle_s=cycle)
+            d = report.to_dict()
+            if args.as_json:
+                print(_json.dumps(d, indent=2, sort_keys=True))
+            else:
+                verdict = ("OK" if d["ok"]
+                           else "FAIL " + ",".join(d["failed"]))
+                print(f"world={args.world_seed} "
+                      f"traffic={args.traffic_seed} "
+                      f"fault={args.fault_seed}: {verdict}")
+                for name, r in d["results"].items():
+                    print(f"  {name}: "
+                          f"{'ok' if r.get('ok') else 'VIOLATED'}")
+            return 0 if d["ok"] else 3
+        from kueue_tpu.sim.harness import run_sim
+        from kueue_tpu.sim.worlds import generate_world
+
+        spec = generate_world(args.world_seed, horizon_s=horizon,
+                              cycle_s=cycle)
+        res = run_sim(spec, args.traffic_seed, args.fault_seed,
+                      device=args.device)
+        d = res.to_dict()
+        d.pop("admittedSet", None)
+        print(_json.dumps(d, indent=2, sort_keys=True) if args.as_json
+              else f"world={args.world_seed} cycles={res.cycles} "
+                   f"offered={res.offered} admitted={res.admitted} "
+                   f"digest={res.decision_digest:08x} "
+                   f"virtual={res.virtual_s:.0f}s "
+                   f"wall={res.wall_s:.2f}s "
+                   f"({res.virtual_s / max(res.wall_s, 1e-9):.0f}x)")
+        return 0
+    if args.sim_command == "shrink":
+        from kueue_tpu.sim.shrink import shrink_failure
+
+        rep = shrink_failure(args.world_seed, args.traffic_seed,
+                             args.fault_seed)
+        if rep is None:
+            print("triple does not fail any invariant; nothing to "
+                  "shrink")
+            return 1
+        if args.out:
+            rep.write(args.out)
+        print(_json.dumps(rep.to_dict(), indent=2, sort_keys=True))
+        return 0
+    build_parser().parse_args(["sim", "--help"])
+    return 2
+
+
 def main(argv=None) -> None:
     """Console entry point: operate on a journal-backed engine
     (--journal points at the durable store; commands replay it, apply,
@@ -571,6 +683,10 @@ def main(argv=None) -> None:
             rest = [os.path.join(Config().root, "kueue_tpu"),
                     "--self-check"] + rest
         raise SystemExit(lint_main(rest))
+    if argv and argv[0] == "sim":
+        # Pre-engine like lint: the simulator regenerates worlds from
+        # seeds and builds its own engines on the virtual clock.
+        raise SystemExit(_sim_main(argv))
     journal = None
     if "--journal" in argv:
         i = argv.index("--journal")

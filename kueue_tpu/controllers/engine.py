@@ -153,6 +153,12 @@ class Engine:
         self.cycle.namespace_labels_of = \
             lambda ns: self.namespace_labels.get(ns)
         self.clock: float = 0.0
+        # Wall-clock source for phase timing / metrics. Purely
+        # observational (never feeds a decision); the simulator
+        # (kueue_tpu/sim) injects its virtual clock here so phase
+        # histograms stay deterministic under time compression.
+        import time as _time
+        self.wall_clock: Callable[[], float] = _time.perf_counter
         self.events: list[EngineEvent] = []
         # Watch fan-out (client-go informer analog): called with each
         # EngineEvent as it is recorded.
@@ -834,13 +840,11 @@ class Engine:
         return result
 
     def _schedule_once_impl(self) -> Optional[CycleResult]:
-        import time as _time
-
         self._process_second_pass()
         if self.oracle is not None:
             from kueue_tpu.oracle.service import RemoteOracleError
 
-            t0 = _time.perf_counter()
+            t0 = self.wall_clock()
             try:
                 result = self.oracle.try_cycle()
             except RemoteOracleError:
@@ -856,7 +860,7 @@ class Engine:
                 outcome = ("success" if result.stats.admitted
                            else "inadmissible")
                 self.registry.report_admission_attempt(
-                    outcome, _time.perf_counter() - t0)
+                    outcome, self.wall_clock() - t0)
                 return result
             self.oracle.cycles_fallback += 1
             try:
@@ -884,14 +888,12 @@ class Engine:
         device roots is cycle-equivalent). The bridge passes
         count_cycle=False: the host tail is part of ONE hybrid cycle,
         which schedule_once() counts and times as a whole."""
-        import time as _time
-
-        t0 = _time.perf_counter()
+        t0 = self.wall_clock()
         if count_cycle:
             self.metrics.admission_cycles += 1
             self.last_cycle_mode = "sequential"
         snapshot = self.cache.snapshot()
-        t_snap = _time.perf_counter()
+        t_snap = self.wall_clock()
         already = set(self.cache.workloads)
         try:
             result = self.cycle.schedule(heads, snapshot, now=self.clock,
@@ -901,7 +903,7 @@ class Engine:
             # live forests BEFORE the apply loop commits the assumed
             # entries through the cache (tas/snapshot.py begin_cycle).
             snapshot.close()
-        t_decide = _time.perf_counter()
+        t_decide = self.wall_clock()
         deferred: set = set()
         self._deferred_cohort_requeue = deferred
         try:
@@ -929,7 +931,7 @@ class Engine:
         # went). Gated on count_cycle: a hybrid cycle's host tail must
         # not overwrite the bridge's encode/device/apply record.
         if count_cycle:
-            t_apply = _time.perf_counter()
+            t_apply = self.wall_clock()
             phases = {"snapshot": t_snap - t0,
                       "decide": t_decide - t_snap,
                       "apply": t_apply - t_decide}
@@ -941,7 +943,7 @@ class Engine:
         if count_cycle:
             outcome = "success" if result.assumed else "inadmissible"
             self.registry.report_admission_attempt(
-                outcome, _time.perf_counter() - t0)
+                outcome, self.wall_clock() - t0)
         for name, pcq in self.queues.cluster_queues.items():
             self.registry.report_pending(name, len(pcq.items),
                                          len(pcq.inadmissible))

@@ -85,3 +85,20 @@ class OpenLoopGenerator:
         """Realized offered rate over the horizon (arrivals/s)."""
         evs = events if events is not None else self.events(horizon_s)
         return len(evs) / max(horizon_s, 1e-9)
+
+    def replay(self, horizon_s: float, clock,
+               events: Optional[list] = None) -> Iterator[Arrival]:
+        """Yield the schedule paced against an injected Clock
+        (kueue_tpu/sim/clock.py): each arrival is delivered no earlier
+        than its timestamp on the clock's monotonic scale. With the
+        real clock this serves live open-loop traffic; with a virtual
+        clock the sleeps are instant advances, so the yielded stream
+        is byte-identical to ``events()`` at zero wall cost — the
+        determinism contract tests/test_loadgen.py pins."""
+        evs = self.events(horizon_s) if events is None else events
+        start = clock.monotonic()
+        for a in evs:
+            lag = a.t - (clock.monotonic() - start)
+            if lag > 0:
+                clock.sleep(lag)
+            yield a

@@ -148,31 +148,42 @@ class CycleWatchdog:
     def _watch_loop(self) -> None:
         me = threading.get_ident()
         while not self._stop.wait(self.poll_s):
-            with self._mu:
-                inflight = self._inflight
-                seq = inflight[0] if inflight else -1
-                reported = self._hang_reported
-            if inflight is None or seq == reported:
-                continue
-            elapsed = self._clock() - inflight[1]
-            if elapsed < self.hang_after_s:
-                continue
-            # Hung: the engine thread is wedged mid-cycle. Capture the
-            # evidence now — by the time (if ever) the cycle returns,
-            # the interesting frames are gone.
-            stacks = capture_stacks(skip_thread_ids=(me,))
-            mode = getattr(self.engine, "last_cycle_mode",
-                           None) or "sequential"
-            with self._mu:
-                if self._hang_reported == seq:
-                    continue  # raced another report
-                self._hang_reported = seq
-            self.hung_cycles += 1
-            self.last_hang = {"seq": seq, "mode": mode,
-                              "elapsed_s": round(elapsed, 3),
-                              "stacks": stacks}
-            self._count("watchdog_hung_cycles_total", ())
-            self._record_bad(seq, mode)
+            self.poll_once(skip_thread_ids=(me,))
+
+    def poll_once(self, skip_thread_ids=()) -> bool:
+        """One hang-sampler observation: report the in-flight cycle as
+        HUNG when it is older than ``hang_after_s``. The sampler thread
+        calls this every ``poll_s`` of wall time; a simulation with
+        ``watch_thread=False`` schedules it as daemon events on the
+        virtual clock's heap instead (kueue_tpu/sim/harness.py) — same
+        detection logic, zero threads, deterministic. Returns True when
+        a hang was reported."""
+        with self._mu:
+            inflight = self._inflight
+            seq = inflight[0] if inflight else -1
+            reported = self._hang_reported
+        if inflight is None or seq == reported:
+            return False
+        elapsed = self._clock() - inflight[1]
+        if elapsed < self.hang_after_s:
+            return False
+        # Hung: the engine thread is wedged mid-cycle. Capture the
+        # evidence now — by the time (if ever) the cycle returns,
+        # the interesting frames are gone.
+        stacks = capture_stacks(skip_thread_ids=skip_thread_ids)
+        mode = getattr(self.engine, "last_cycle_mode",
+                       None) or "sequential"
+        with self._mu:
+            if self._hang_reported == seq:
+                return False  # raced another report
+            self._hang_reported = seq
+        self.hung_cycles += 1
+        self.last_hang = {"seq": seq, "mode": mode,
+                          "elapsed_s": round(elapsed, 3),
+                          "stacks": stacks}
+        self._count("watchdog_hung_cycles_total", ())
+        self._record_bad(seq, mode)
+        return True
 
     # -- the breaker (supervisor shape) --
 

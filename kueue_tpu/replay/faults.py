@@ -201,12 +201,16 @@ class _ExecutorFaultProxy:
     """Wraps the oracle bridge's executor: raises transport errors while
     ``crashed`` is set, sleeps ``delay_ms`` before returning otherwise."""
 
-    def __init__(self, inner):
+    def __init__(self, inner, sleep=None):
         self.inner = inner
         self.crashed = False
         self.delay_ms = 0.0
         self.injected_errors = 0
         self.delayed_calls = 0
+        if sleep is None:
+            import time
+            sleep = time.sleep
+        self._sleep = sleep
 
     def _gate(self):
         from kueue_tpu.oracle.service import RemoteOracleError
@@ -214,8 +218,7 @@ class _ExecutorFaultProxy:
             self.injected_errors += 1
             raise RemoteOracleError("injected oracle crash")
         if self.delay_ms > 0:
-            import time
-            time.sleep(self.delay_ms / 1e3)
+            self._sleep(self.delay_ms / 1e3)
             self.delayed_calls += 1
 
     def cycle_step(self, tensors, statics):
@@ -236,9 +239,17 @@ class FaultInjector:
     """Armed on an engine: hooks the cycle boundary (pre_cycle_hooks)
     and the admission apply path (_admit)."""
 
-    def __init__(self, engine, plan: FaultPlan):
+    def __init__(self, engine, plan: FaultPlan, sleep=None):
         self.engine = engine
         self.plan = plan
+        # Injected wait primitive: wall-clock sleep by default; the
+        # simulator passes its virtual clock's sleep so a `hang` fault
+        # advances compressed time instead of burning it
+        # (kueue_tpu/sim/clock.py).
+        if sleep is None:
+            import time as _time
+            sleep = _time.sleep
+        self._sleep = sleep
         self.admissions = 0
         self.maintenance_events = 0
         self.fired: list[str] = []
@@ -319,7 +330,8 @@ class FaultInjector:
                 "oracle faults need an attached oracle "
                 "(engine.attach_oracle() first)")
         if not isinstance(bridge.executor, _ExecutorFaultProxy):
-            bridge.executor = _ExecutorFaultProxy(bridge.executor)
+            bridge.executor = _ExecutorFaultProxy(bridge.executor,
+                                                  sleep=self._sleep)
         self.proxy = bridge.executor
 
     def _storm_covers(self, seq: int) -> bool:
@@ -411,12 +423,14 @@ class FaultInjector:
                 engine.ha.suspend_renewal = True
                 self.fired.append(f"lease-stall@cycle:{seq}")
             elif f.kind == "hang":
-                import time as _time
                 self.fired.append(f"hang@cycle:{seq}:{f.arg:g}")
                 # The engine thread wedges here, mid-cycle from the
                 # watchdog's point of view (its pre-cycle hook already
-                # stamped the start when it was attached first).
-                _time.sleep(f.arg / 1e3)
+                # stamped the start when it was attached first). Under
+                # a virtual clock the sleep is an instant advance and
+                # the watchdog's daemon poll events observe the hang
+                # inside this very call.
+                self._sleep(f.arg / 1e3)
             elif f.kind == "arrival-storm":
                 self._arrival_storm(engine, seq, int(f.arg))
                 self.fired.append(
@@ -449,10 +463,10 @@ class FaultInjector:
             self.proxy.delay_ms = 0.0
 
 
-def arm_faults(engine, plan) -> FaultInjector:
+def arm_faults(engine, plan, sleep=None) -> FaultInjector:
     if isinstance(plan, str):
         plan = FaultPlan.parse(plan)
-    return FaultInjector(engine, plan)
+    return FaultInjector(engine, plan, sleep=sleep)
 
 
 @dataclass

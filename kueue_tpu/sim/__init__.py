@@ -1,0 +1,52 @@
+"""Time-compressed world simulator with property-based world fuzzing
+and auto-shrinking reproducers (ROADMAP item 7).
+
+Every adversarial ingredient already existed — seeded open-loop arrival
+schedules (kueue_tpu/loadgen), seeded chaos fault chains
+(replay/faults.py), the watchdog's injectable clock (obs/watchdog.py),
+cycle-counted checkpoint cadence (store/checkpoint.py), the fenced
+lease's explicit ``now`` (ha/lease.py) — but each burned real wall
+time, so nobody composed them into whole adversarial *worlds*. This
+package puts all of them on one discrete-event heap:
+
+  * ``clock``  — the deterministic virtual clock: one event heap
+    unifying arrival schedules, cycle cadence, fault chains, watchdog
+    polls and lease renewals. A week of virtual seconds costs minutes
+    of wall time; ``sleep()`` is an instant advance.
+  * ``worlds`` — property-based world generation: cohort forests,
+    flavor generations, topology shapes, quota/lending/borrowing and
+    priority/preemption policies, each a pure function of a
+    world-seed (plus explicit dims so a failure can be shrunk).
+  * ``harness`` — wires a generated world, its traffic and its fault
+    chain onto the heap and drains it to a decision-digest chain.
+  * ``oracle`` — the differential (host vs device decision digests)
+    and metamorphic (quota/priority monotonicity, benign-fault
+    neutrality) checkers.
+  * ``shrink`` — reduces any failing ``(world-seed, traffic-seed,
+    fault-seed)`` triple to a minimal self-contained reproducer that
+    ``kueuectl sim run --repro`` replays.
+
+This is the host-vs-device differential-oracle discipline (PAPER.md)
+extended from single decisions to whole worlds.
+"""
+
+from kueue_tpu.sim.clock import Clock, SystemClock, VirtualClock
+from kueue_tpu.sim.harness import SimResult, run_sim
+from kueue_tpu.sim.oracle import CheckReport, check_world
+from kueue_tpu.sim.shrink import Reproducer, shrink_failure
+from kueue_tpu.sim.worlds import WorldSpec, build_engine, generate_world
+
+__all__ = [
+    "CheckReport",
+    "Clock",
+    "Reproducer",
+    "SimResult",
+    "SystemClock",
+    "VirtualClock",
+    "WorldSpec",
+    "build_engine",
+    "check_world",
+    "generate_world",
+    "run_sim",
+    "shrink_failure",
+]

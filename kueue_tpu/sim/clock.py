@@ -1,0 +1,190 @@
+"""The deterministic discrete-event virtual clock.
+
+One heap, one notion of time. Everything in the stack that waits —
+loadgen arrival schedules, chaos fault chains, the watchdog's hang
+sampler, lease renewal, checkpoint cadence, the cycle timer itself —
+becomes an event ``(t, seq, fn)`` on the same heap, popped in
+deterministic ``(t, seq)`` order. ``sleep()`` does not wait: it
+advances ``now`` instantly, which is the whole time-compression trick
+— a week-long diurnal horizon (~6x10^5 virtual seconds) costs only the
+scheduling work actually performed.
+
+Determinism contract: given the same initial events and the same
+callbacks, every run pops the heap in the same order — ties break on
+the monotonically assigned ``seq``, never on object identity or wall
+time. The flight recorder's digest-identity claims for simulated
+worlds rest on this.
+
+Two event classes:
+
+  * **task** events (arrivals, cycles, fault windows) fire only from
+    the top-level ``run_until`` loop — a cycle can never re-enter
+    itself.
+  * **daemon** events (watchdog polls, lease renewals — observational
+    timers) may ALSO fire from inside ``sleep()``: a fault that wedges
+    the engine mid-cycle advances virtual time through the sleep, and
+    the watchdog's poll genuinely observes the hang *while the cycle
+    is still in flight*, single-threaded — exactly what the real
+    sampler thread does with wall time.
+
+``SystemClock`` is the default adapter everywhere a ``clock=`` seam
+was threaded: production behavior is unchanged unless a simulation
+injects ``VirtualClock``.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time as _time
+from typing import Callable, Optional
+
+
+class Clock:
+    """The injection interface. ``time()`` is epoch-like (lease files,
+    submission stamps), ``monotonic()`` is duration-like (watchdog,
+    phase timing), ``sleep()`` parks the caller. A virtual clock keeps
+    the two scales equal; the real one maps them to the time module."""
+
+    def time(self) -> float:
+        raise NotImplementedError
+
+    def monotonic(self) -> float:
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> None:
+        raise NotImplementedError
+
+
+class SystemClock(Clock):
+    """The real clock — the production default for every threaded seam.
+    This adapter IS the process's one sanctioned wall-clock boundary in
+    the simulated zone, hence the C1 pragmas."""
+
+    def time(self) -> float:
+        return _time.time()  # graftlint: allow[C1] the real-clock adapter is the boundary C1 exists to funnel callers through
+
+    def monotonic(self) -> float:
+        return _time.monotonic()  # graftlint: allow[C1] the real-clock adapter is the boundary C1 exists to funnel callers through
+
+    def sleep(self, seconds: float) -> None:
+        _time.sleep(seconds)  # graftlint: allow[C1] the real-clock adapter is the boundary C1 exists to funnel callers through
+
+
+class _Event:
+    __slots__ = ("t", "seq", "fn", "daemon", "cancelled")
+
+    def __init__(self, t: float, seq: int, fn: Callable[[], None],
+                 daemon: bool):
+        self.t = t
+        self.seq = seq
+        self.fn = fn
+        self.daemon = daemon
+        self.cancelled = False
+
+    def __lt__(self, other: "_Event") -> bool:
+        return (self.t, self.seq) < (other.t, other.seq)
+
+
+class VirtualClock(Clock):
+    """Discrete-event virtual time. ``now`` only moves when an event
+    fires or ``sleep``/``advance`` is called; nothing here ever touches
+    the time module."""
+
+    def __init__(self, start: float = 0.0):
+        self.now = float(start)
+        self._heap: list = []
+        self._seq = 0
+        self._sleep_depth = 0
+        self.fired = 0
+
+    # -- Clock interface --
+
+    def time(self) -> float:
+        return self.now
+
+    def monotonic(self) -> float:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        """Advance instantly. Daemon events due inside the slept window
+        fire mid-sleep (at their own timestamps), which is how a
+        virtual-clocked watchdog catches a virtual hang: the wedged
+        "thread" is this very call frame, and the poll runs inside it."""
+        target = self.now + max(0.0, float(seconds))
+        self._sleep_depth += 1
+        try:
+            while self._heap and self._heap[0].t <= target:
+                ev = self._heap[0]
+                if ev.cancelled:
+                    heapq.heappop(self._heap)
+                    continue
+                if not ev.daemon:
+                    break  # task events never re-enter; run loop owns them
+                heapq.heappop(self._heap)
+                self.now = max(self.now, ev.t)
+                self.fired += 1
+                ev.fn()
+        finally:
+            self._sleep_depth -= 1
+        self.now = max(self.now, target)
+
+    # -- scheduling --
+
+    def call_at(self, t: float, fn: Callable[[], None],
+                daemon: bool = False) -> _Event:
+        ev = _Event(max(float(t), self.now), self._seq, fn, daemon)
+        self._seq += 1
+        heapq.heappush(self._heap, ev)
+        return ev
+
+    def call_after(self, dt: float, fn: Callable[[], None],
+                   daemon: bool = False) -> _Event:
+        return self.call_at(self.now + max(0.0, float(dt)), fn, daemon)
+
+    @staticmethod
+    def cancel(ev: _Event) -> None:
+        ev.cancelled = True
+
+    def every(self, period: float, fn: Callable[[], None],
+              daemon: bool = False, until: Optional[float] = None,
+              start: Optional[float] = None) -> None:
+        """Self-rescheduling periodic event — the cadence primitive for
+        cycles, watchdog polls and lease renewals."""
+        period = max(1e-9, float(period))
+        first = self.now + period if start is None else float(start)
+
+        def _tick():
+            fn()
+            nxt = self.now + period
+            if until is None or nxt <= until:
+                self.call_at(nxt, _tick, daemon=daemon)
+
+        self.call_at(first, _tick, daemon=daemon)
+
+    # -- the run loop --
+
+    def pending(self) -> int:
+        return sum(1 for ev in self._heap if not ev.cancelled)
+
+    def run_next(self) -> bool:
+        """Pop and fire the earliest live event; False when drained."""
+        while self._heap:
+            ev = heapq.heappop(self._heap)
+            if ev.cancelled:
+                continue
+            self.now = max(self.now, ev.t)
+            self.fired += 1
+            ev.fn()
+            return True
+        return False
+
+    def run_until(self, horizon: float) -> int:
+        """Fire every event with ``t <= horizon`` in deterministic
+        ``(t, seq)`` order, then land ``now`` on the horizon. Events a
+        callback schedules inside the horizon fire in the same pass."""
+        fired = 0
+        while self._heap and self._heap[0].t <= horizon:
+            if self.run_next():
+                fired += 1
+        self.now = max(self.now, horizon)
+        return fired

@@ -1,0 +1,297 @@
+"""The simulation harness: one WorldSpec + traffic-seed + fault-seed
+driven end to end on the virtual clock's event heap.
+
+Everything that happens is an event with a virtual timestamp:
+
+  * each offered arrival (loadgen schedule) is a task event that runs
+    the front door (optional token-bucket shedder, journal
+    writability) and submits;
+  * the scheduling cycle is a self-rescheduling task event every
+    ``cycle_s`` — the engine's own ``clock`` is driven from the
+    event's NOMINAL time, never from wall time, so a virtual hang
+    perturbs only the watchdog's timescale and decision inputs stay a
+    pure function of the schedule;
+  * the fault chain is armed through replay/faults.py with the
+    virtual clock's ``sleep`` injected — a ``hang`` fault advances
+    virtual time mid-cycle, and the watchdog poll events (daemon
+    events, allowed to fire inside ``sleep``) catch the wedged cycle
+    exactly the way the real sampler thread does;
+  * checkpoint cadence (virtual-interval Checkpointer) and lease
+    renewal (daemon events calling the fenced lease with virtual
+    ``now``) ride the same heap in the full-stack arm.
+
+The result is a decision-digest chain: the same triple replays to the
+same digests, on any machine, at time-compression ratios of ~10^4
+virtual seconds per wall second.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.sim.clock import VirtualClock
+from kueue_tpu.sim.worlds import WorldSpec, build_engine, fault_chain, \
+    offered_workloads
+
+# The planted regression (tools/sim_smoke.py): when armed, the harness
+# silently DROPS the first arrival that lands after a hang fault has
+# fired — the "fault handling loses an input" bug class. The oracle's
+# benign-fault-neutrality invariant catches it, and the shrinker must
+# reduce whatever world it was caught in to a minimal triple.
+PLANT_LOST_ARRIVAL = os.environ.get("KUEUE_TPU_SIM_PLANT", "") == "1"
+
+
+@dataclass
+class SimResult:
+    world_seed: int
+    traffic_seed: int
+    fault_seed: int
+    cycles: int = 0
+    idle_cycles: int = 0
+    offered: int = 0
+    submitted: int = 0
+    shed: int = 0
+    degraded_shed: int = 0
+    planted_drops: int = 0
+    admitted: int = 0
+    decision_digest: int = 0
+    admitted_digest: str = ""
+    admitted_set: tuple = ()
+    faults_fired: tuple = ()
+    watchdog: dict = field(default_factory=dict)
+    lease: dict = field(default_factory=dict)
+    checkpoints: int = 0
+    max_rung: int = 0
+    virtual_s: float = 0.0
+    events_fired: int = 0
+    wall_s: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "worldSeed": self.world_seed,
+            "trafficSeed": self.traffic_seed,
+            "faultSeed": self.fault_seed,
+            "cycles": self.cycles, "idleCycles": self.idle_cycles,
+            "offered": self.offered, "submitted": self.submitted,
+            "shed": self.shed, "degradedShed": self.degraded_shed,
+            "plantedDrops": self.planted_drops,
+            "admitted": self.admitted,
+            "decisionDigest": f"{self.decision_digest:08x}",
+            "admittedDigest": self.admitted_digest,
+            "faultsFired": list(self.faults_fired),
+            "watchdog": self.watchdog, "lease": self.lease,
+            "checkpoints": self.checkpoints, "maxRung": self.max_rung,
+            "virtualSeconds": round(self.virtual_s, 3),
+            "eventsFired": self.events_fired,
+            "wallSeconds": round(self.wall_s, 3),
+            "compressionX": round(self.virtual_s / self.wall_s, 1)
+            if self.wall_s > 0 else None,
+        }
+
+
+def run_sim(spec: WorldSpec, traffic_seed: int = 0, fault_seed: int = 0,
+            *, device: bool = False, full_stack: bool = False,
+            workdir: Optional[str] = None, quota_add: int = 0,
+            raise_priority_of: Optional[str] = None,
+            horizon_s: Optional[float] = None,
+            storm_faults: bool = False,
+            shed_rate: Optional[float] = None,
+            drain_cycles: int = 96) -> SimResult:
+    """Drive one complete simulated world; see module docstring.
+
+    Lean arm (default): bare engine — the decision-comparison
+    substrate the oracle's invariants run on. Full-stack arm
+    (``full_stack=True`` + ``workdir``): journal with disk budget,
+    virtual-cadence checkpoints, token-bucket shedder, SLO engine,
+    degradation ladder, fenced lease on virtual renewal timers."""
+    import time as _time
+
+    from kueue_tpu.ha.digest import admitted_state_digest
+    from kueue_tpu.obs.watchdog import attach_watchdog
+    from kueue_tpu.replay.faults import arm_faults
+    from kueue_tpu.replay.trace import canonical_decisions, \
+        decision_digest
+
+    if full_stack and workdir is None:
+        raise ValueError("full_stack arm needs a workdir")
+    horizon = float(spec.horizon_s if horizon_s is None else horizon_s)
+    cycle_s = spec.cycle_s
+    wall0 = _time.perf_counter()
+    clock = VirtualClock()
+
+    journal_path = None
+    if full_stack:
+        os.makedirs(workdir, exist_ok=True)
+        journal_path = os.path.join(workdir, "sim.jsonl")
+    eng, world = build_engine(spec, quota_add=quota_add, device=device,
+                              journal_path=journal_path,
+                              min_free_bytes=(1 << 20) if full_stack
+                              else 0)
+    # Engine phase timing on virtual time: deterministic metrics, and
+    # the phase histograms stop charging real nanoseconds to the run.
+    eng.wall_clock = clock.monotonic
+
+    res = SimResult(world_seed=spec.world_seed,
+                    traffic_seed=int(traffic_seed),
+                    fault_seed=int(fault_seed))
+
+    # Watchdog BEFORE the fault injector: its pre-cycle stamp must
+    # bracket the injector's in-cycle sleeps. hang_after sits below the
+    # shortest generated hang so the daemon polls scheduled inside each
+    # cycle window observe every virtual hang deterministically.
+    hang_after = 0.02
+    wd = attach_watchdog(eng, deadline_s=10.0, hang_after_s=hang_after,
+                         poll_s=hang_after, watch_thread=False,
+                         clock=clock.monotonic)
+
+    chain = fault_chain(spec, fault_seed, neutral_only=not storm_faults,
+                        oracle=device, storm=storm_faults)
+    injector = arm_faults(eng, chain, sleep=clock.sleep) if chain else None
+
+    shedder = None
+    ladder = None
+    checkpointer = None
+    lease_stats: dict = {}
+    if full_stack:
+        from kueue_tpu.ha.ladder import attach_ladder
+        from kueue_tpu.ha.lease import FencedLease
+        from kueue_tpu.ha.shedder import AdmissionShedder
+        from kueue_tpu.store.checkpoint import Checkpointer
+
+        eng.attach_slo()
+        rate = shed_rate if shed_rate is not None else \
+            0.6 * len(world.queue_names) / cycle_s
+        shedder = AdmissionShedder(rate=rate, burst=max(1.0, rate / 4.0),
+                                   slo=eng.slo)
+        eng.shedder = shedder
+        ladder = attach_ladder(eng, relax_cycles=8)
+        # Checkpoint cadence in VIRTUAL seconds — the store/ timer seam.
+        checkpointer = Checkpointer(eng, interval=1 << 30,
+                                    interval_s=25 * cycle_s,
+                                    clock=clock.monotonic)
+        # Fenced lease renewed by daemon heap events with virtual `now`
+        # — the ha/ timer seam. A missed renewal would expire the term
+        # and a re-acquire would bump the epoch, so epoch stability at
+        # the end proves the virtual renewal cadence held the lease.
+        lease = FencedLease(os.path.join(workdir, "sim.lease"))
+        duration = 12 * cycle_s
+        held = lease.try_acquire("sim-leader", clock.time(), duration)
+        lease_stats = {"epoch": held.epoch if held else 0, "renewals": 0}
+
+        def _renew():
+            got = lease.try_acquire("sim-leader", clock.time(), duration)
+            if got is not None:
+                lease_stats["renewals"] += 1
+                lease_stats["epoch"] = got.epoch
+
+        clock.every(duration / 3.0, _renew, daemon=True, until=horizon)
+
+    # -- digest chain --
+    state = {"digest": 0, "planted": False}
+
+    def _on_cycle(seq, result):
+        if ladder is not None:
+            res.max_rung = max(res.max_rung, ladder.rung)
+        if result is None:
+            res.idle_cycles += 1
+            return
+        res.cycles += 1
+        decisions = canonical_decisions(result)
+        if decisions:
+            # Skip nothing-decided cycles: the host path surfaces them
+            # as entry-less results where a device cycle reports idle
+            # None (replay/trace.py) — chaining [] would make the
+            # digest partition-sensitive and break the differential.
+            state["digest"] = decision_digest(decisions,
+                                              state["digest"])
+
+    eng.cycle_listeners.append(_on_cycle)
+
+    # -- arrivals --
+    offered = offered_workloads(spec, traffic_seed, world=world,
+                                horizon_s=horizon,
+                                raise_priority_of=raise_priority_of)
+
+    def _make_submit(t, wl):
+        def _submit():
+            res.offered += 1
+            if (PLANT_LOST_ARRIVAL and not state["planted"]
+                    and injector is not None
+                    and any(f.startswith("hang@")
+                            for f in injector.fired)):
+                state["planted"] = True
+                res.planted_drops += 1
+                return
+            if shedder is not None and not shedder.admit(t)["accepted"]:
+                res.shed += 1
+                return
+            if eng.journal is not None and not eng.journal.writable():
+                res.degraded_shed += 1
+                return
+            eng.clock = max(eng.clock, t)
+            eng.submit(wl)
+            res.submitted += 1
+        return _submit
+
+    for t, wl in offered:
+        clock.call_at(t, _make_submit(t, wl))
+
+    # -- the cycle cadence (nominal-time driven) --
+    def _schedule_cycle(t):
+        def _run():
+            eng.clock = max(eng.clock, t)
+            # In-cycle hang observation points: daemon polls that fire
+            # only if a fault's virtual sleep carries `now` past them
+            # while this cycle is still in flight.
+            for j in (1, 2, 3):
+                clock.call_at(t + hang_after * (j + 1),
+                              wd.poll_once, daemon=True)
+            eng.schedule_once()
+            nxt = t + cycle_s
+            if nxt <= horizon + cycle_s / 2.0:
+                _schedule_cycle(nxt)
+        clock.call_at(t, _run)
+
+    _schedule_cycle(cycle_s)
+
+    clock.run_until(horizon)
+
+    # Drain tail: cadence-only cycles until the backlog stops moving —
+    # the bounded post-horizon settle every open-loop driver needs.
+    idle_streak = 0
+    for _ in range(max(0, int(drain_cycles))):
+        if idle_streak >= 3:
+            break
+        clock.now += cycle_s
+        eng.clock = max(eng.clock, clock.now)
+        r = eng.schedule_once()
+        idle_streak = idle_streak + 1 if r is None else 0
+
+    # -- finalize --
+    res.admitted_set = tuple(sorted(
+        k for k, w in eng.workloads.items()
+        if w.status.admission is not None))
+    res.admitted = len(res.admitted_set)
+    res.decision_digest = state["digest"] & 0xFFFFFFFF
+    res.admitted_digest = admitted_state_digest(eng)
+    res.faults_fired = tuple(injector.fired) if injector else ()
+    res.watchdog = {"state": wd.state, "hungCycles": wd.hung_cycles,
+                    "overruns": wd.overruns,
+                    "demotions": wd.demotions,
+                    "cyclesObserved": wd.cycles_observed}
+    res.lease = lease_stats
+    res.checkpoints = checkpointer.written if checkpointer else 0
+    res.virtual_s = clock.now
+    res.events_fired = clock.fired
+    eng.cycle_listeners.remove(_on_cycle)
+    wd.detach()
+    if eng.journal is not None:
+        eng.journal.sync()
+        eng.journal.close()
+    # Wall-clock is REAL here on purpose: the compression ratio
+    # (virtual seconds per wall second) is the one measurement that
+    # must come from the actual machine — graftlint C1 baseline.
+    res.wall_s = _time.perf_counter() - wall0
+    return res

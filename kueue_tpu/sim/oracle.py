@@ -1,0 +1,179 @@
+"""The world-level correctness oracle: differential + metamorphic.
+
+The repo's founding discipline is differential: the Go semantics are
+the oracle for the device kernels, and host-vs-device decision digests
+must match cycle for cycle (PAPER.md). This module extends that from
+single decisions to whole generated worlds, and adds metamorphic
+invariants — properties that must hold across *related* worlds even
+when no ground truth exists for either:
+
+  * **determinism** — re-running the same triple is decision-digest
+    identical (the precondition for every other claim);
+  * **differential** — the same world driven through the host path and
+    the device path (``attach_oracle``) produces identical decision
+    digests and identical final admitted digests;
+  * **quota monotonicity** — adding nominal quota to every CQ never
+    shrinks the admitted count;
+  * **priority monotonicity** — raising one workload's priority never
+    turns its admission into a rejection;
+  * **benign-fault neutrality** — an input-neutral fault chain
+    (virtual hangs, checkpoint write faults, oracle crashes) never
+    changes the final admitted set.
+
+A violated invariant is a *failure of the triple*: `shrink` reduces it
+and `tools/sim_smoke.py` proves the loop end to end with a planted
+regression.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kueue_tpu.sim.harness import run_sim
+from kueue_tpu.sim.worlds import generate_world
+
+INVARIANTS = ("determinism", "differential", "quota_monotonic",
+              "priority_monotonic", "benign_fault_neutral")
+
+
+@dataclass
+class CheckReport:
+    world_seed: int
+    traffic_seed: int
+    fault_seed: int
+    dims: dict = field(default_factory=dict)
+    results: dict = field(default_factory=dict)  # invariant -> dict
+    base: Optional[dict] = None
+
+    @property
+    def ok(self) -> bool:
+        return all(r.get("ok") for r in self.results.values())
+
+    def failed(self) -> list:
+        return [name for name, r in self.results.items()
+                if not r.get("ok")]
+
+    def to_dict(self) -> dict:
+        return {"worldSeed": self.world_seed,
+                "trafficSeed": self.traffic_seed,
+                "faultSeed": self.fault_seed,
+                "dims": self.dims, "ok": self.ok,
+                "failed": self.failed(),
+                "results": self.results, "base": self.base}
+
+
+def check_world(world_seed: int, traffic_seed: int, fault_seed: int,
+                dims: Optional[dict] = None, device: bool = True,
+                invariants: tuple = INVARIANTS,
+                horizon_s: float = 240.0,
+                cycle_s: float = 2.0) -> CheckReport:
+    """Run every requested invariant over one seed triple. ``dims``
+    (shrink overrides) clamp the generated world; ``device=False``
+    skips the host-vs-device differential arm (the shrinker's fast
+    predicate — metamorphic failures never need the device path)."""
+    spec = generate_world(world_seed, horizon_s=horizon_s,
+                          cycle_s=cycle_s, overrides=dims)
+    report = CheckReport(world_seed=int(world_seed),
+                         traffic_seed=int(traffic_seed),
+                         fault_seed=int(fault_seed), dims=spec.dims())
+
+    base = run_sim(spec, traffic_seed, fault_seed=0)
+    report.base = {"admitted": base.admitted,
+                   "cycles": base.cycles,
+                   "decisionDigest": f"{base.decision_digest:08x}",
+                   "admittedDigest": base.admitted_digest}
+
+    if "determinism" in invariants:
+        again = run_sim(spec, traffic_seed, fault_seed=0)
+        report.results["determinism"] = {
+            "ok": (again.decision_digest == base.decision_digest
+                   and again.admitted_digest == base.admitted_digest),
+            "digest": f"{base.decision_digest:08x}",
+            "rerunDigest": f"{again.decision_digest:08x}",
+        }
+
+    if "differential" in invariants and device:
+        dev = run_sim(spec, traffic_seed, fault_seed=0, device=True)
+        report.results["differential"] = {
+            "ok": (dev.decision_digest == base.decision_digest
+                   and dev.admitted_digest == base.admitted_digest),
+            "hostDigest": f"{base.decision_digest:08x}",
+            "deviceDigest": f"{dev.decision_digest:08x}",
+            "hostAdmitted": base.admitted, "deviceAdmitted": dev.admitted,
+        }
+
+    if "quota_monotonic" in invariants:
+        richer = run_sim(spec, traffic_seed, fault_seed=0,
+                         quota_add=4_000)
+        report.results["quota_monotonic"] = {
+            "ok": richer.admitted >= base.admitted,
+            "admitted": base.admitted,
+            "admittedWithQuota": richer.admitted,
+        }
+
+    if "priority_monotonic" in invariants:
+        target = _priority_target(base)
+        if target is None:
+            report.results["priority_monotonic"] = {
+                "ok": True, "skipped": "no admitted workload to raise"}
+        else:
+            key, name = target
+            raised = run_sim(spec, traffic_seed, fault_seed=0,
+                             raise_priority_of=name)
+            report.results["priority_monotonic"] = {
+                "ok": key in raised.admitted_set,
+                "workload": key,
+                "admittedBefore": True,
+                "admittedAfterRaise": key in raised.admitted_set,
+            }
+
+    if "benign_fault_neutral" in invariants:
+        faulted = run_sim(spec, traffic_seed, fault_seed=fault_seed)
+        report.results["benign_fault_neutral"] = {
+            "ok": faulted.admitted_set == base.admitted_set,
+            "admitted": base.admitted,
+            "admittedUnderFaults": faulted.admitted,
+            "faultsFired": list(faulted.faults_fired),
+            "plantedDrops": faulted.planted_drops,
+            "lost": sorted(set(base.admitted_set)
+                           - set(faulted.admitted_set))[:5],
+            "extra": sorted(set(faulted.admitted_set)
+                            - set(base.admitted_set))[:5],
+            "hungCycles": faulted.watchdog.get("hungCycles", 0),
+        }
+
+    return report
+
+
+def _priority_target(base) -> Optional[tuple]:
+    """An admitted workload whose raise must keep it admitted. Admitted
+    is the strongest outcome we can assert monotone without replaying
+    queue internals: raising the priority of an already-admitted
+    workload must never evict it from the final admitted set."""
+    if not base.admitted_set:
+        return None
+    key = base.admitted_set[len(base.admitted_set) // 2]
+    return key, key.split("/", 1)[1]
+
+
+def storm_world(world_seed: int, traffic_seed: int, fault_seed: int,
+                horizon_s: float, cycle_s: float,
+                workdir: Optional[str] = None,
+                n_workloads: int = 4_000):
+    """The full-stack time-compression arm (bench sim_week and the
+    acceptance run): one big diurnal world with an embedded fault
+    storm behind journal + checkpoints + shedder + ladder + lease,
+    all on the virtual heap. Returns the SimResult."""
+    spec = generate_world(world_seed, horizon_s=horizon_s,
+                          cycle_s=cycle_s,
+                          overrides=None).with_dims(
+        n_workload_cap=n_workloads, n_faults=6, topology_levels=0)
+    if workdir is not None:
+        return run_sim(spec, traffic_seed, fault_seed,
+                       full_stack=True, workdir=workdir,
+                       storm_faults=True)
+    with tempfile.TemporaryDirectory(prefix="sim-week-") as wd:
+        return run_sim(spec, traffic_seed, fault_seed,
+                       full_stack=True, workdir=wd, storm_faults=True)

@@ -1,0 +1,184 @@
+"""Auto-shrinking: reduce a failing ``(world-seed, traffic-seed,
+fault-seed)`` triple to a minimal self-contained reproducer.
+
+An opaque seed cannot be shrunk — but the *world it draws* can,
+because every structural dimension is carried explicitly on the
+WorldSpec and generation clamps each drawn value by an override
+(worlds.py). The shrinker is classic greedy delta-debugging over that
+dimension vector plus the seeds themselves:
+
+  1. walk the shrink axes in fixed priority order (workload count and
+     horizon first — they dominate replay cost), halving each toward
+     its floor while the failure predicate still fires;
+  2. repeat passes until a full pass makes no progress (fixed point);
+  3. finally try to canonicalize each seed downward (0, 1, 2): a
+     different seed is a different world, so a replacement is kept
+     only when the SAME invariant still fails.
+
+Every candidate evaluation re-runs the real oracle check, so a kept
+step is a *verified* smaller failure — no heuristics to trust. The
+result is written as a reproducer JSON that names the triple, the
+clamped dims and the violated invariant; ``kueuectl sim run --repro``
+replays it, exit code 3 iff the failure still reproduces.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kueue_tpu.sim.worlds import SHRINK_AXES, generate_world
+
+# Axis floors mirror worlds.generate_world: shrinking below these
+# would no longer describe a runnable world.
+_FLOORS = {"n_workload_cap": 1, "horizon_s": 8.0, "n_faults": 1,
+           "cqs_per_cohort": 1, "n_cohort_roots": 1, "forest_depth": 1,
+           "n_generations": 1, "topology_levels": 0}
+
+
+@dataclass
+class Reproducer:
+    """A minimal failing world, self-contained: everything needed to
+    regenerate and re-check it without the session that found it."""
+
+    world_seed: int
+    traffic_seed: int
+    fault_seed: int
+    dims: dict
+    invariant: str
+    attempts: int = 0
+    steps_kept: int = 0
+    detail: dict = field(default_factory=dict)
+
+    @property
+    def command(self) -> str:
+        return (f"kueuectl sim run --world-seed {self.world_seed}"
+                f" --traffic-seed {self.traffic_seed}"
+                f" --fault-seed {self.fault_seed} --check")
+
+    def to_dict(self) -> dict:
+        return {"version": 1,
+                "worldSeed": self.world_seed,
+                "trafficSeed": self.traffic_seed,
+                "faultSeed": self.fault_seed,
+                "dims": self.dims, "invariant": self.invariant,
+                "shrinkAttempts": self.attempts,
+                "shrinkStepsKept": self.steps_kept,
+                "command": self.command, "detail": self.detail}
+
+    def write(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(self.to_dict(), fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    @classmethod
+    def load(cls, path: str) -> "Reproducer":
+        with open(path, encoding="utf-8") as fh:
+            raw = json.load(fh)
+        return cls(world_seed=int(raw["worldSeed"]),
+                   traffic_seed=int(raw["trafficSeed"]),
+                   fault_seed=int(raw["faultSeed"]),
+                   dims=dict(raw.get("dims") or {}),
+                   invariant=raw["invariant"],
+                   attempts=int(raw.get("shrinkAttempts", 0)),
+                   steps_kept=int(raw.get("shrinkStepsKept", 0)),
+                   detail=dict(raw.get("detail") or {}))
+
+
+def default_predicate(world_seed: int, traffic_seed: int,
+                      fault_seed: int, dims: dict) -> Optional[str]:
+    """The standard failure predicate: run the host-path invariants
+    (the device differential never shrinks — metamorphic failures are
+    host-reproducible) and name the first violated one."""
+    from kueue_tpu.sim.oracle import check_world
+
+    report = check_world(world_seed, traffic_seed, fault_seed,
+                         dims=dims, device=False)
+    failed = report.failed()
+    return failed[0] if failed else None
+
+
+def shrink_failure(world_seed: int, traffic_seed: int, fault_seed: int,
+                   invariant: Optional[str] = None,
+                   predicate: Callable = default_predicate,
+                   dims: Optional[dict] = None,
+                   max_attempts: int = 96) -> Optional[Reproducer]:
+    """Greedy delta-debugging; see module docstring. ``predicate``
+    returns the violated invariant name (or None). Returns None when
+    the initial triple does not fail at all."""
+    attempts = 0
+    kept = 0
+
+    def _fails(ws, ts, fs, d) -> Optional[str]:
+        nonlocal attempts
+        attempts += 1
+        got = predicate(ws, ts, fs, d)
+        if got is None:
+            return None
+        # Pin the shrink to ONE invariant: a candidate that fails a
+        # *different* invariant is a different bug, not a smaller
+        # instance of this one.
+        if invariant is not None and got != invariant:
+            return None
+        return got
+
+    cur = dict(dims) if dims else generate_world(world_seed).dims()
+    invariant_seen = _fails(world_seed, traffic_seed, fault_seed, cur)
+    if invariant_seen is None:
+        return None
+    if invariant is None:
+        invariant = invariant_seen
+
+    ws, ts, fs = int(world_seed), int(traffic_seed), int(fault_seed)
+
+    # Phase 1+2: halve axes to fixed point.
+    progressed = True
+    while progressed and attempts < max_attempts:
+        progressed = False
+        for axis in SHRINK_AXES:
+            floor = _FLOORS[axis]
+            while attempts < max_attempts:
+                val = cur[axis]
+                if val <= floor:
+                    break
+                smaller = (max(floor, val / 2.0)
+                           if isinstance(val, float)
+                           else max(floor, val // 2))
+                cand = dict(cur, **{axis: smaller})
+                if _fails(ws, ts, fs, cand) == invariant:
+                    cur = cand
+                    kept += 1
+                    progressed = True
+                else:
+                    break
+
+    # Phase 3: canonicalize seeds downward where the same invariant
+    # still fails.
+    for which in ("world", "traffic", "fault"):
+        for small in (0, 1, 2):
+            if attempts >= max_attempts:
+                break
+            trial = {"world": (small, ts, fs),
+                     "traffic": (ws, small, fs),
+                     "fault": (ws, ts, small)}[which]
+            if trial == (ws, ts, fs):
+                continue
+            if which == "fault" and small == 0:
+                continue  # fault-seed 0 is the reserved fault-free chain
+            if _fails(*trial, cur) == invariant:
+                ws, ts, fs = trial
+                kept += 1
+                break
+
+    return Reproducer(world_seed=ws, traffic_seed=ts, fault_seed=fs,
+                      dims=dict(cur), invariant=invariant,
+                      attempts=attempts, steps_kept=kept)
+
+
+def reproduce(rep: Reproducer,
+              predicate: Callable = default_predicate) -> bool:
+    """Replay a reproducer: True iff its invariant still fails."""
+    got = predicate(rep.world_seed, rep.traffic_seed, rep.fault_seed,
+                    rep.dims)
+    return got == rep.invariant

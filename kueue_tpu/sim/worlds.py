@@ -1,0 +1,386 @@
+"""Property-based world generation: a whole adversarial cluster as a
+pure function of one world-seed.
+
+A *world* is everything the engine schedules against — a cohort forest
+with parent pointers, heterogeneous flavor generations (the Gavel
+observation: accelerator fleets are never homogeneous), an optional
+topology-aware segment with real nodes, per-CQ quota with randomized
+lending/borrowing limits, queueing strategies, preemption policies and
+fair-sharing weights. The traffic offered to it (a diurnal open-loop
+arrival schedule with a hot-key mix, workload sizes and priorities)
+is a pure function of a traffic-seed, and the fault chain a pure
+function of a fault-seed — so a triple ``(world-seed, traffic-seed,
+fault-seed)`` names one complete, replayable experiment.
+
+Shrinkability is designed in, not bolted on: every structural dimension
+a seed draws (cohort roots, forest depth, CQs per cohort, flavor
+generations, workload count, fault count, horizon) is carried
+explicitly on the ``WorldSpec``, and generation takes each dimension
+as ``min(drawn, override)`` — so the shrinker can halve axes while the
+rest of the world stays pinned to the same seed. Same spec → identical
+world, byte for byte (explicit uids; no global RNG, no wall clock).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+from kueue_tpu.api.types import (
+    BorrowWithinCohort,
+    BorrowWithinCohortPolicy,
+    ClusterQueue,
+    ClusterQueuePreemption,
+    Cohort,
+    FairSharing,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PodSetTopologyRequest,
+    PreemptionPolicy,
+    QueueingStrategy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Topology,
+    TopologyLevel,
+    TopologyMode,
+    Workload,
+)
+
+HOSTNAME_LABEL = "kubernetes.io/hostname"
+SIM_TOPOLOGY_LEVELS = ("sim.kueue/block", "sim.kueue/rack")
+
+# The axes the shrinker may reduce, in reduction-priority order: the
+# expensive dimensions (workloads, horizon, cycles of faults) first,
+# structure last.
+SHRINK_AXES = ("n_workload_cap", "horizon_s", "n_faults",
+               "cqs_per_cohort", "n_cohort_roots", "forest_depth",
+               "n_generations", "topology_levels")
+
+
+@dataclass(frozen=True)
+class WorldSpec:
+    """One generated world's identity: the seed plus the explicit
+    structural dimensions drawn from it (carried so they can be shrunk
+    independently of the seed)."""
+
+    world_seed: int
+    n_cohort_roots: int
+    forest_depth: int
+    cqs_per_cohort: int
+    n_generations: int
+    topology_levels: int       # 0 = no TAS segment
+    n_workload_cap: int        # traffic cap (arrivals beyond it drop)
+    n_faults: int
+    horizon_s: float
+    cycle_s: float
+
+    def dims(self) -> dict:
+        return {axis: getattr(self, axis) for axis in SHRINK_AXES}
+
+    def with_dims(self, **dims) -> "WorldSpec":
+        return replace(self, **dims)
+
+
+def generate_world(world_seed: int, horizon_s: float = 240.0,
+                   cycle_s: float = 2.0,
+                   overrides: Optional[dict] = None) -> WorldSpec:
+    """world-seed → WorldSpec. Every drawn dimension is clamped by the
+    matching ``overrides`` entry (the shrinker's handle); the seed and
+    the clamps together fully determine the world."""
+    rng = random.Random(world_seed)
+    drawn = {
+        "n_cohort_roots": rng.randint(1, 3),
+        "forest_depth": rng.randint(1, 3),
+        "cqs_per_cohort": rng.randint(1, 3),
+        "n_generations": rng.randint(1, 3),
+        # Bias toward flat worlds: the TAS segment is the expensive
+        # minority case, like real fleets.
+        "topology_levels": rng.choice((0, 0, 0, 2, 3)),
+        "n_workload_cap": rng.randint(24, 96),
+        "n_faults": rng.randint(1, 4),
+        "horizon_s": float(horizon_s),
+    }
+    for axis, cap in (overrides or {}).items():
+        if axis not in drawn:
+            raise ValueError(f"unknown shrink axis {axis!r}")
+        kind = type(drawn[axis])
+        drawn[axis] = kind(min(drawn[axis], cap))
+    floor = {"n_cohort_roots": 1, "forest_depth": 1, "cqs_per_cohort": 1,
+             "n_generations": 1, "topology_levels": 0,
+             "n_workload_cap": 1, "n_faults": 0, "horizon_s": cycle_s}
+    for axis, lo in floor.items():
+        drawn[axis] = max(drawn[axis], lo)
+    return WorldSpec(world_seed=int(world_seed), cycle_s=float(cycle_s),
+                     **drawn)
+
+
+@dataclass
+class World:
+    """The materialized API objects of one WorldSpec."""
+
+    spec: WorldSpec
+    flavors: list = field(default_factory=list)
+    topologies: list = field(default_factory=list)
+    nodes: list = field(default_factory=list)
+    cohorts: list = field(default_factory=list)
+    cluster_queues: list = field(default_factory=list)
+    local_queues: list = field(default_factory=list)
+
+    @property
+    def queue_names(self) -> tuple:
+        return tuple(lq.name for lq in self.local_queues)
+
+
+_PREEMPTION_POLICIES = (PreemptionPolicy.NEVER,
+                        PreemptionPolicy.LOWER_PRIORITY,
+                        PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY)
+
+
+def build_world(spec: WorldSpec, quota_add: int = 0) -> World:
+    """WorldSpec → API objects. ``quota_add`` raises every nominal
+    quota by a constant — the quota-monotonicity metamorphic handle
+    (everything else stays bit-identical to the unperturbed world)."""
+    rng = random.Random(spec.world_seed ^ 0x57D1)
+    world = World(spec=spec)
+
+    # Flavor generations, newest first in every CQ's try-order (the
+    # flavor assigner walks the tuple in order).
+    gens = [f"gen{g}" for g in range(spec.n_generations)]
+    tas_flavor = None
+    if spec.topology_levels > 0:
+        levels = tuple(
+            TopologyLevel(n)
+            for n in SIM_TOPOLOGY_LEVELS[:spec.topology_levels - 1]
+        ) + (TopologyLevel(HOSTNAME_LABEL),)
+        world.topologies.append(Topology("sim-dc", levels))
+        tas_flavor = "tas"
+        world.flavors.append(ResourceFlavor(
+            name="tas", topology_name="sim-dc"))
+        _build_nodes(rng, spec, world)
+    for g in gens:
+        world.flavors.append(ResourceFlavor(name=g))
+
+    # Cohort forest: roots, then a chain of descendants per root up to
+    # forest_depth — parents created before children.
+    leaf_cohorts = []
+    for r in range(spec.n_cohort_roots):
+        parent = None
+        depth = rng.randint(1, spec.forest_depth)
+        for d in range(depth):
+            name = f"co{r}" if d == 0 else f"co{r}d{d}"
+            world.cohorts.append(Cohort(
+                name, parent=parent,
+                fair_sharing=FairSharing(rng.choice((1.0, 1.0, 2.0)))))
+            parent = name
+        leaf_cohorts.append(parent)
+
+    # CQs per leaf cohort: randomized quota, lending/borrowing limits,
+    # queueing strategy, preemption posture and fair weight.
+    for ci, cohort in enumerate(leaf_cohorts):
+        for q in range(spec.cqs_per_cohort):
+            name = f"cq{ci}-{q}"
+            nominal = rng.choice((2_000, 4_000, 8_000)) + quota_add
+            lending = rng.choice((None, None, nominal // 2))
+            borrowing = rng.choice((None, None, nominal))
+            fqs = tuple(
+                FlavorQuotas(g, {"cpu": ResourceQuota(
+                    nominal, borrowing_limit=borrowing,
+                    lending_limit=lending)})
+                for g in gens)
+            if tas_flavor is not None and rng.random() < 0.5:
+                fqs = (FlavorQuotas(tas_flavor, {"cpu": ResourceQuota(
+                    nominal, borrowing_limit=borrowing,
+                    lending_limit=lending)}),) + fqs
+            preemption = ClusterQueuePreemption(
+                within_cluster_queue=rng.choice(_PREEMPTION_POLICIES),
+                reclaim_within_cohort=rng.choice(_PREEMPTION_POLICIES),
+                # No max_priority_threshold: a priority ceiling makes
+                # raising a workload's priority REMOVE preemption
+                # rights above it, which would falsify the
+                # priority-monotonicity invariant by design rather
+                # than by bug.
+                borrow_within_cohort=rng.choice((
+                    None, None,
+                    BorrowWithinCohort(
+                        BorrowWithinCohortPolicy.LOWER_PRIORITY))))
+            world.cluster_queues.append(ClusterQueue(
+                name=name, cohort=cohort,
+                resource_groups=(ResourceGroup(("cpu",), fqs),),
+                queueing_strategy=rng.choice((
+                    QueueingStrategy.BEST_EFFORT_FIFO,
+                    QueueingStrategy.BEST_EFFORT_FIFO,
+                    QueueingStrategy.STRICT_FIFO)),
+                preemption=preemption,
+                fair_sharing=FairSharing(rng.choice((1.0, 1.0, 3.0)))))
+            world.local_queues.append(LocalQueue(
+                f"lq{ci}-{q}", "default", name))
+    return world
+
+
+def _build_nodes(rng, spec: WorldSpec, world: World) -> None:
+    """A small topology forest of capacity-bearing hosts for the TAS
+    flavor: blocks → racks → hosts, sizes drawn from the seed."""
+    blocks = rng.randint(1, 2)
+    racks = rng.randint(1, 2) if spec.topology_levels >= 3 else 1
+    hosts = rng.randint(2, 4)
+    for b in range(blocks):
+        for r in range(racks):
+            for h in range(hosts):
+                name = f"h-{b}-{r}-{h}"
+                labels = {HOSTNAME_LABEL: name,
+                          SIM_TOPOLOGY_LEVELS[0]: f"b{b}"}
+                if spec.topology_levels >= 3:
+                    labels[SIM_TOPOLOGY_LEVELS[1]] = f"b{b}r{r}"
+                world.nodes.append({
+                    "name": name, "labels": labels,
+                    "capacity": {"cpu": rng.choice((4_000, 8_000)),
+                                 "pods": 32}})
+
+
+def build_engine(spec: WorldSpec, quota_add: int = 0, device: bool = False,
+                 engine_factory=None, journal_path: Optional[str] = None,
+                 min_free_bytes: int = 0):
+    """Materialize a WorldSpec into a live Engine. ``device=True``
+    attaches the oracle so cycles run the device decision path —
+    the differential arm."""
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.tas.snapshot import Node
+
+    world = build_world(spec, quota_add=quota_add)
+    eng = Engine() if engine_factory is None else engine_factory()
+    if journal_path is not None:
+        from kueue_tpu.store.journal import attach_new_journal
+        attach_new_journal(eng, journal_path,
+                           min_free_bytes=min_free_bytes)
+    for topo in world.topologies:
+        eng.create_topology(topo)
+    for rf in world.flavors:
+        eng.create_resource_flavor(rf)
+    for nd in world.nodes:
+        eng.create_node(Node(name=nd["name"], labels=nd["labels"],
+                             capacity=nd["capacity"]))
+    for co in world.cohorts:
+        eng.create_cohort(co)
+    for cq in world.cluster_queues:
+        eng.create_cluster_queue(cq)
+    for lq in world.local_queues:
+        eng.create_local_queue(lq)
+    if device:
+        eng.attach_oracle()
+    return eng, world
+
+
+# -- traffic: traffic-seed → the offered workload schedule --
+
+_SIZE_CLASSES = ((500, 0.5), (1_000, 0.3), (4_000, 0.2))
+_PRIORITIES = (0, 0, 0, 50, 100)
+
+
+def offered_workloads(spec: WorldSpec, traffic_seed: int,
+                      world: Optional[World] = None,
+                      horizon_s: Optional[float] = None,
+                      raise_priority_of: Optional[str] = None,
+                      priority_raise: int = 1_000) -> list:
+    """traffic-seed → the full offered schedule as ``(t, Workload)``
+    pairs, a pure function of (spec, traffic_seed, horizon): diurnal
+    arrival times from the open-loop generator, sizes/priorities/TAS
+    shapes from a second stream keyed off the same seed. Explicit uids
+    keep re-materialization byte-identical across processes.
+
+    ``raise_priority_of`` names one workload whose priority is lifted
+    by ``priority_raise`` — the priority-monotonicity metamorphic
+    handle; everything else is untouched."""
+    from kueue_tpu.loadgen import DiurnalPattern, HotkeyMix, \
+        OpenLoopGenerator
+
+    if world is None:
+        world = build_world(spec)
+    horizon = spec.horizon_s if horizon_s is None else horizon_s
+    queues = world.queue_names
+    # Mean offered rate sized so the horizon carries about
+    # n_workload_cap arrivals; the diurnal swing crosses the engine's
+    # one-admission-per-CQ-per-cycle drain capacity both ways.
+    mean_rate = spec.n_workload_cap / max(horizon, 1e-9)
+    pattern = DiurnalPattern(trough=0.3 * mean_rate,
+                             peak_rate=1.7 * mean_rate,
+                             period_s=horizon / 2.0)
+    gen = OpenLoopGenerator(
+        pattern,
+        mix=HotkeyMix(queues, hot_index=0, hot_fraction=0.4),
+        seed=int(traffic_seed), name_prefix="sim")
+    shapes = random.Random(int(traffic_seed) ^ 0x7AFF1C)
+    tas = spec.topology_levels > 0
+    out = []
+    for a in gen.events(horizon):
+        if a.ordinal >= spec.n_workload_cap:
+            break
+        size = _pick_class(shapes.random())
+        prio = shapes.choice(_PRIORITIES)
+        tr = None
+        if tas and shapes.random() < 0.3:
+            mode = shapes.choice((TopologyMode.REQUIRED,
+                                  TopologyMode.PREFERRED))
+            tr = PodSetTopologyRequest(
+                mode=mode, level=SIM_TOPOLOGY_LEVELS[0])
+        if raise_priority_of == a.name:
+            prio += priority_raise
+        out.append((a.t, Workload(
+            name=a.name, queue_name=a.queue, priority=prio,
+            uid=f"sim-{a.ordinal}",
+            pod_sets=(PodSet("main", shapes.choice((1, 1, 2)),
+                             {"cpu": size}, topology_request=tr),))))
+    return out
+
+
+def _pick_class(u: float) -> int:
+    acc = 0.0
+    for size, frac in _SIZE_CLASSES:
+        acc += frac
+        if u < acc:
+            return size
+    return _SIZE_CLASSES[-1][0]
+
+
+# -- faults: fault-seed → a deterministic chain of fault specs --
+
+# Input-neutral kinds: safe for the benign-fault-neutrality invariant
+# on a lean (no journal / no oracle) engine. ``hang`` advances only
+# the VIRTUAL clock (the injector's sleep is the harness's clock),
+# never the engine's decision clock; ``enospc`` arms a checkpoint
+# write fault that a lean engine never exercises and a full-stack one
+# absorbs (the previous checkpoint stays the recovery base).
+NEUTRAL_KINDS = ("hang@cycle:{n}:{ms}", "enospc@cycle:{n}")
+ORACLE_KINDS = ("oracle-crash@cycle:{n}",
+                "oracle-crash-storm@cycle:{n}:{m}")
+# Storm-only kinds: legitimate chaos for the full-stack storm arm, but
+# allowed to perturb decision inputs/ordering (clock-skew moves the
+# engine's own clock), so excluded from neutrality comparisons.
+STORM_KINDS = ("clock-skew@cycle:{n}:{ms}", "torn-checkpoint@cycle:{n}",
+               "disk-pressure-ramp@cycle:{n}:{m}")
+
+
+def fault_chain(spec: WorldSpec, fault_seed: int,
+                neutral_only: bool = True, oracle: bool = False,
+                storm: bool = False) -> str:
+    """fault-seed → a comma-joined fault spec for ``arm_faults``.
+    Seed 0 is the reserved fault-free control chain."""
+    if int(fault_seed) == 0 or spec.n_faults == 0:
+        return ""
+    rng = random.Random(int(fault_seed) ^ 0xFA017)
+    pool = list(NEUTRAL_KINDS)
+    if oracle:
+        pool += list(ORACLE_KINDS)
+    if storm and not neutral_only:
+        pool += list(STORM_KINDS)
+    n_cycles = max(2, int(spec.horizon_s / spec.cycle_s))
+    faults = []
+    for _ in range(spec.n_faults):
+        tmpl = rng.choice(pool)
+        faults.append(tmpl.format(
+            n=rng.randrange(1, n_cycles),
+            m=rng.randrange(2, 5),
+            ms=rng.choice((50, 250, 1000))))
+    return ",".join(faults)

@@ -40,6 +40,7 @@ from __future__ import annotations
 import errno
 import json
 import os
+import time
 import zlib
 from dataclasses import dataclass
 from typing import Optional
@@ -374,7 +375,8 @@ class Checkpointer:
     def __init__(self, engine, interval: int = 64, keep: int = 2,
                  retain_segments: bool = True,
                  store: Optional[CheckpointStore] = None,
-                 min_free_bytes: int = 0):
+                 min_free_bytes: int = 0,
+                 interval_s: Optional[float] = None, clock=None):
         if engine.journal is None:
             raise ValueError("Checkpointer needs an attached journal")
         self.engine = engine
@@ -383,6 +385,15 @@ class Checkpointer:
         self.retain_segments = retain_segments
         self.store = store or CheckpointStore.for_journal(
             engine.journal.path, min_free_bytes=min_free_bytes)
+        # Optional time-based cadence: when ``interval_s`` is set, a
+        # checkpoint is also due once that many seconds pass on
+        # ``clock`` since the last write. The simulator injects its
+        # virtual monotonic clock here so checkpoint cadence rides
+        # the compressed timeline instead of the cycle counter.
+        self.interval_s = (None if interval_s is None
+                          else max(1e-9, float(interval_s)))
+        self._clock = clock if clock is not None else time.monotonic
+        self._last_t = self._clock()
         self.written = 0
         self.failures = 0
         self.last_meta: Optional[CheckpointMeta] = None
@@ -395,7 +406,10 @@ class Checkpointer:
         if result is None:
             return  # idle tick: nothing new to cover
         self._since += 1
-        if self._since >= self.interval:
+        due = self._since >= self.interval
+        if not due and self.interval_s is not None:
+            due = self._clock() - self._last_t >= self.interval_s
+        if due:
             self.checkpoint(seq)
 
     def checkpoint(self, seq: Optional[int] = None):
@@ -403,6 +417,7 @@ class Checkpointer:
         counted and absorbed: the previous checkpoint remains the
         recovery base, and the next interval retries."""
         self._since = 0
+        self._last_t = self._clock()
         try:
             meta = self.store.write(self.engine, seq)
         except OSError as e:

@@ -1,0 +1,11 @@
+"""Planted C1 violations (simulated zone). Test data, never run."""
+import time
+import datetime
+from time import monotonic as mono
+
+
+def wait_for_lease(backoff):
+    t0 = time.monotonic()
+    time.sleep(backoff)
+    stamp = datetime.datetime.now()
+    return mono() - t0, stamp

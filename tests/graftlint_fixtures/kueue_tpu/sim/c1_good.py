@@ -1,0 +1,15 @@
+"""Sanctioned clock idiom: the injectable seam, real by default.
+Referencing time.monotonic as a default parameter is legal — only
+calls are flagged — and everything else reads the injected clock."""
+import time
+
+
+class Poller:
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+
+    def elapsed(self, t0):
+        return self._clock() - t0
+
+    def nap(self, clock, seconds):
+        clock.sleep(seconds)

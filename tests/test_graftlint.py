@@ -106,6 +106,34 @@ def test_o1_good_clean(fixture_result):
     assert _hits(fixture_result, "kueue_tpu/obs/o1_good.py") == []
 
 
+def test_c1_bad_exact_locations(fixture_result):
+    assert _hits(fixture_result, "kueue_tpu/sim/c1_bad.py") == [
+        (8, "C1", "wait_for_lease"),   # time.monotonic()
+        (9, "C1", "wait_for_lease"),   # time.sleep()
+        (10, "C1", "wait_for_lease"),  # datetime.datetime.now()
+        (11, "C1", "wait_for_lease"),  # aliased monotonic
+    ]
+
+
+def test_c1_good_clean(fixture_result):
+    # clock=time.monotonic default params and injected-clock calls
+    # are the sanctioned idiom, not violations.
+    assert _hits(fixture_result, "kueue_tpu/sim/c1_good.py") == []
+
+
+def test_c1_zone_gating(fixture_result):
+    # util/helpers.py calls time.time() outside every C1 zone — the
+    # shared zone-gating fixture covers C1 too (no hits there is
+    # asserted by test_d1_zone_gating).
+    from tools.graftlint.config import Config as _C
+    assert "C1" in _C().rules_for("kueue_tpu/sim/clock.py")
+    assert "C1" in _C().rules_for("kueue_tpu/loadgen/arrivals.py")
+    assert "C1" in _C().rules_for("kueue_tpu/obs/watchdog.py")
+    assert "C1" in _C().rules_for("kueue_tpu/ha/ladder.py")
+    assert "C1" not in _C().rules_for("kueue_tpu/util/helpers.py")
+    assert "C1" not in _C().rules_for("kueue_tpu/ha/lease.py")
+
+
 def test_r1_unhandled_journal_kind(fixture_result):
     hits = _hits(fixture_result, "kueue_tpu/engine_emit.py")
     assert hits == [(7, "R1", "persist")]  # only 'pod_group' unhandled

@@ -1,0 +1,123 @@
+"""kueue_tpu/sim/clock.py: the deterministic discrete-event clock.
+
+Covers: event ordering (time then insertion sequence), daemon-vs-task
+event semantics during sleep, periodic scheduling, cancellation, and
+the determinism of a full heap drain.
+"""
+
+import pytest
+
+from kueue_tpu.sim.clock import SystemClock, VirtualClock
+
+
+class TestVirtualClock:
+    def test_starts_at_zero(self):
+        c = VirtualClock()
+        assert c.time() == 0.0
+        assert c.monotonic() == 0.0
+
+    def test_sleep_advances_instantly(self):
+        c = VirtualClock()
+        c.sleep(3600.0)
+        assert c.time() == 3600.0
+
+    def test_run_until_fires_in_time_order(self):
+        c = VirtualClock()
+        fired = []
+        c.call_at(3.0, lambda: fired.append("c"))
+        c.call_at(1.0, lambda: fired.append("a"))
+        c.call_at(2.0, lambda: fired.append("b"))
+        c.run_until(10.0)
+        assert fired == ["a", "b", "c"]
+        assert c.time() == 10.0
+
+    def test_same_time_fires_in_insertion_order(self):
+        c = VirtualClock()
+        fired = []
+        for tag in ("first", "second", "third"):
+            c.call_at(5.0, lambda t=tag: fired.append(t))
+        c.run_until(5.0)
+        assert fired == ["first", "second", "third"]
+
+    def test_call_at_in_past_clamps_to_now(self):
+        c = VirtualClock()
+        c.sleep(10.0)
+        fired = []
+        c.call_at(1.0, lambda: fired.append(True))
+        c.run_until(10.0)
+        assert fired == [True]
+        assert c.time() == 10.0
+
+    def test_events_may_schedule_more_events(self):
+        c = VirtualClock()
+        fired = []
+
+        def chain(n):
+            fired.append(n)
+            if n < 5:
+                c.call_after(1.0, lambda: chain(n + 1))
+
+        c.call_at(0.0, lambda: chain(0))
+        c.run_until(100.0)
+        assert fired == [0, 1, 2, 3, 4, 5]
+        assert c.time() == 100.0
+
+    def test_sleep_fires_daemon_but_not_task_events(self):
+        # The re-entrancy contract: a component sleeping mid-cycle
+        # (a fault-injected hang) must see watchdog-style daemon
+        # events fire, but never a nested scheduling task.
+        c = VirtualClock()
+        fired = []
+        c.call_at(1.0, lambda: fired.append("daemon"), daemon=True)
+        c.call_at(1.0, lambda: fired.append("task"))
+        c.sleep(2.0)
+        assert fired == ["daemon"]
+        c.run_until(2.0)
+        assert fired == ["daemon", "task"]
+
+    def test_cancel(self):
+        c = VirtualClock()
+        fired = []
+        ev = c.call_at(1.0, lambda: fired.append(True))
+        c.cancel(ev)
+        c.run_until(5.0)
+        assert fired == []
+
+    def test_every_reschedules_until_horizon(self):
+        c = VirtualClock()
+        ticks = []
+        c.every(10.0, lambda: ticks.append(c.time()), until=35.0)
+        c.run_until(100.0)
+        assert ticks == [10.0, 20.0, 30.0]
+
+    def test_run_next_steps_one_event(self):
+        c = VirtualClock()
+        fired = []
+        c.call_at(1.0, lambda: fired.append(1))
+        c.call_at(2.0, lambda: fired.append(2))
+        assert c.run_next() is True
+        assert fired == [1] and c.time() == 1.0
+        assert c.run_next() is True
+        assert c.run_next() is False
+
+    def test_determinism_full_drain(self):
+        def drive():
+            c = VirtualClock()
+            out = []
+            for i in range(50):
+                c.call_at(float(i % 7), lambda i=i: out.append(i))
+            c.every(1.5, lambda: out.append(-1), until=9.0)
+            c.run_until(9.0)
+            return out, c.fired
+
+        assert drive() == drive()
+
+
+class TestSystemClock:
+    def test_tracks_real_time(self):
+        c = SystemClock()
+        a = c.monotonic()
+        c.sleep(0.01)
+        assert c.monotonic() - a >= 0.009
+        assert c.time() == pytest.approx(__import__("time").time(),
+                                         abs=5.0)

@@ -1,0 +1,115 @@
+"""kueue_tpu/sim/harness.py + oracle.py: the simulated run and its
+invariants.
+
+Covers: the lean arm's determinism and virtual/wall compression, the
+full-stack arm's timer seams (checkpoints, lease renewal, watchdog
+hang detection on virtual daemon events), the metamorphic invariant
+catalog on clean worlds, and the planted lost-arrival regression
+flipping exactly the benign-fault-neutrality invariant.
+
+The device differential is exercised by tools/sim_smoke.py (it needs
+a JAX program compile per world — too heavy for tier-1); everything
+here runs the host path.
+"""
+
+import pytest
+
+from kueue_tpu.sim import harness as harness_mod
+from kueue_tpu.sim.harness import run_sim
+from kueue_tpu.sim.oracle import INVARIANTS, check_world
+from kueue_tpu.sim.worlds import generate_world
+
+HOST_INVARIANTS = tuple(i for i in INVARIANTS if i != "differential")
+
+
+@pytest.fixture
+def spec():
+    return generate_world(3, horizon_s=60.0, cycle_s=2.0)
+
+
+class TestLeanArm:
+    def test_runs_and_admits(self, spec):
+        res = run_sim(spec, traffic_seed=1)
+        assert res.offered > 0
+        assert res.submitted == res.offered
+        assert res.admitted > 0
+        assert res.cycles > 0
+
+    def test_rerun_digest_identical(self, spec):
+        a = run_sim(spec, traffic_seed=1)
+        b = run_sim(spec, traffic_seed=1)
+        assert a.decision_digest == b.decision_digest
+        assert a.admitted_digest == b.admitted_digest
+        assert a.admitted_set == b.admitted_set
+
+    def test_compresses_time(self, spec):
+        res = run_sim(spec, traffic_seed=1)
+        # The whole point: virtual seconds vastly outrun wall seconds
+        # even on a tiny world.
+        assert res.virtual_s >= spec.horizon_s
+        assert res.virtual_s / max(res.wall_s, 1e-9) > 20.0
+
+    def test_virtual_hang_detected_without_wall_delay(self, spec):
+        # fault seeds draw hang faults eventually; find one.
+        for fault_seed in range(1, 20):
+            res = run_sim(spec, traffic_seed=1, fault_seed=fault_seed)
+            if any(f.startswith("hang@") for f in res.faults_fired):
+                assert res.watchdog["hungCycles"] >= 1
+                assert res.wall_s < 10.0  # virtual, not slept
+                return
+        pytest.fail("no hang fault drawn in 20 seeds")
+
+    def test_fault_seed_zero_fault_free(self, spec):
+        res = run_sim(spec, traffic_seed=1, fault_seed=0)
+        assert not res.faults_fired
+
+
+class TestFullStackArm:
+    def test_timers_ride_virtual_clock(self, spec, tmp_path):
+        res = run_sim(spec, traffic_seed=1, fault_seed=0,
+                      full_stack=True, workdir=str(tmp_path))
+        # Checkpoint cadence is 25 cycles' worth of virtual seconds:
+        # a 60s-horizon world must have written at least one, and the
+        # lease (renewed every duration/3 on daemon events) must have
+        # held its original epoch throughout.
+        assert res.checkpoints >= 1
+        assert res.lease["epoch"] == 1
+        assert res.lease["renewals"] >= 2
+
+    def test_full_stack_deterministic(self, spec, tmp_path):
+        a = run_sim(spec, traffic_seed=1, fault_seed=5,
+                    full_stack=True, workdir=str(tmp_path / "a"))
+        b = run_sim(spec, traffic_seed=1, fault_seed=5,
+                    full_stack=True, workdir=str(tmp_path / "b"))
+        assert a.decision_digest == b.decision_digest
+        assert a.shed == b.shed
+
+
+class TestInvariants:
+    @pytest.mark.parametrize("world_seed,traffic_seed,fault_seed",
+                             [(3, 1, 5), (7, 2, 11), (11, 4, 9)])
+    def test_clean_worlds_pass_all_host_invariants(
+            self, world_seed, traffic_seed, fault_seed):
+        report = check_world(world_seed, traffic_seed, fault_seed,
+                             device=False, horizon_s=60.0)
+        assert report.ok, report.to_dict()
+        assert set(report.results) == set(HOST_INVARIANTS)
+
+    def test_planted_regression_flips_exactly_neutrality(
+            self, monkeypatch):
+        # The planted bug drops the first arrival after a hang fault
+        # fires — visible only to benign-fault neutrality, invisible
+        # to the fault-free arms every other invariant compares.
+        monkeypatch.setattr(harness_mod, "PLANT_LOST_ARRIVAL", True)
+        report = check_world(7, 2, 11, device=False, horizon_s=60.0)
+        assert report.failed() == ["benign_fault_neutral"]
+        detail = report.results["benign_fault_neutral"]
+        assert detail["plantedDrops"] == 1
+        assert detail["lost"]
+
+    def test_report_shape(self):
+        report = check_world(3, 1, 5, device=False, horizon_s=30.0)
+        d = report.to_dict()
+        assert d["worldSeed"] == 3
+        assert set(d["dims"]) == set(generate_world(3).dims())
+        assert "decisionDigest" in d["base"]

@@ -1,0 +1,146 @@
+"""kueue_tpu/sim/worlds.py: property-based world generation.
+
+Covers: seed→world purity (same triple, byte-identical structures and
+traffic), shrink-axis override clamping, fault-chain pools, and the
+design guarantees the metamorphic invariants lean on (no borrow
+priority thresholds, no ANY preemption in generated worlds).
+"""
+
+import pytest
+
+from kueue_tpu.api.types import PreemptionPolicy
+from kueue_tpu.sim.worlds import (
+    SHRINK_AXES,
+    build_world,
+    fault_chain,
+    generate_world,
+    offered_workloads,
+)
+
+
+def _world_fingerprint(world):
+    return (
+        [(c.name, c.parent) for c in world.cohorts],
+        [(cq.name, cq.cohort,
+          [(fq.name, sorted((r, q.nominal, q.borrowing_limit,
+                             q.lending_limit)
+                            for r, q in fq.resources.items()))
+           for rg in cq.resource_groups for fq in rg.flavors])
+         for cq in world.cluster_queues],
+        [(lq.name, lq.cluster_queue) for lq in world.local_queues],
+        [n.name for n in world.nodes],
+    )
+
+
+class TestGeneration:
+    def test_same_seed_identical_spec_and_world(self):
+        a, b = generate_world(42), generate_world(42)
+        assert a == b
+        assert _world_fingerprint(build_world(a)) == \
+            _world_fingerprint(build_world(b))
+
+    def test_different_seeds_differ(self):
+        specs = {tuple(sorted(generate_world(s).dims().items()))
+                 for s in range(12)}
+        assert len(specs) > 1
+
+    def test_override_clamps_never_raises_dims(self):
+        spec = generate_world(42)
+        clamped = generate_world(
+            42, overrides={"n_workload_cap": 3, "forest_depth": 1})
+        assert clamped.n_workload_cap == min(3, spec.n_workload_cap)
+        assert clamped.forest_depth == 1
+        # Un-overridden axes keep their drawn values.
+        assert clamped.n_cohort_roots == spec.n_cohort_roots
+
+    def test_unknown_axis_rejected(self):
+        with pytest.raises(ValueError):
+            generate_world(1, overrides={"bogus": 1})
+
+    def test_dims_round_trip(self):
+        spec = generate_world(7)
+        assert set(spec.dims()) == set(SHRINK_AXES)
+        assert spec.with_dims(**spec.dims()) == spec
+
+    def test_no_borrow_thresholds_or_any_policy(self):
+        # Design invariant: thresholds/ANY would falsify priority
+        # monotonicity without a scheduler bug (worlds.py comment).
+        for seed in range(8):
+            world = build_world(generate_world(seed))
+            for cq in world.cluster_queues:
+                p = cq.preemption
+                assert p.reclaim_within_cohort != PreemptionPolicy.ANY
+                assert p.within_cluster_queue != PreemptionPolicy.ANY
+                b = p.borrow_within_cohort
+                assert b is None or b.max_priority_threshold is None
+
+
+class TestTraffic:
+    def test_same_triple_identical_traffic(self):
+        spec = generate_world(5)
+        a = offered_workloads(spec, traffic_seed=9)
+        b = offered_workloads(spec, traffic_seed=9)
+        assert [(t, w.name, w.uid, w.priority, w.queue_name,
+                 [(ps.count, sorted(ps.requests.items()))
+                  for ps in w.pod_sets])
+                for t, w in a] == \
+            [(t, w.name, w.uid, w.priority, w.queue_name,
+              [(ps.count, sorted(ps.requests.items()))
+               for ps in w.pod_sets])
+             for t, w in b]
+
+    def test_different_traffic_seed_differs(self):
+        spec = generate_world(5)
+        a = offered_workloads(spec, traffic_seed=1)
+        b = offered_workloads(spec, traffic_seed=2)
+        assert [t for t, _ in a] != [t for t, _ in b]
+
+    def test_capped_and_in_horizon(self):
+        spec = generate_world(5)
+        evs = offered_workloads(spec, traffic_seed=3)
+        assert len(evs) <= spec.n_workload_cap
+        assert all(0.0 <= t < spec.horizon_s for t, _ in evs)
+
+    def test_explicit_uids(self):
+        # Cross-process digest identity: uids must come from the
+        # ordinal, not the process-global Workload counter.
+        spec = generate_world(5)
+        for _, w in offered_workloads(spec, traffic_seed=3):
+            assert w.uid.startswith("sim-")
+
+    def test_priority_raise_targets_one_workload(self):
+        spec = generate_world(5)
+        base = offered_workloads(spec, traffic_seed=3)
+        name = base[len(base) // 2][1].name
+        raised = offered_workloads(spec, traffic_seed=3,
+                                   raise_priority_of=name)
+        deltas = [(w.name, r.priority - w.priority)
+                  for (_, w), (_, r) in zip(base, raised)
+                  if r.priority != w.priority]
+        assert deltas == [(name, 1000)]
+
+
+class TestFaultChain:
+    def test_seed_zero_reserved_fault_free(self):
+        assert fault_chain(generate_world(3), 0) == ""
+
+    def test_pure_function_of_seed(self):
+        spec = generate_world(3)
+        assert fault_chain(spec, 7) == fault_chain(spec, 7)
+
+    def test_neutral_pool_only_hang_enospc(self):
+        spec = generate_world(3)
+        for seed in range(1, 12):
+            for f in fault_chain(spec, seed).split(";"):
+                assert f.split("@", 1)[0] in ("hang", "enospc")
+
+    def test_storm_pool_wider(self):
+        spec = generate_world(3).with_dims(n_faults=8)
+        kinds = set()
+        for seed in range(1, 16):
+            chain = fault_chain(spec, seed, neutral_only=False,
+                                storm=True)
+            kinds |= {f.split("@", 1)[0]
+                      for f in chain.split(";") if f}
+        assert "clock-skew" in kinds or "torn-checkpoint" in kinds \
+            or "disk-pressure-ramp" in kinds

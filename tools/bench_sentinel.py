@@ -60,7 +60,12 @@ SIGMA_K = 3.0       # noise band width
 # (injected hang + disk-pressure window) inside a sub-second busy
 # span, so its round-to-round noise is structurally wider than the
 # compute-bound scenarios — gate it, but only on large drops.
-MIN_DROP_OVERRIDES = {"traffic_storm": 0.30}
+MIN_DROP_OVERRIDES = {"traffic_storm": 0.30,
+                      # sim_week's value is virtual-s per wall-s of a
+                      # single multi-day storm run — wall-clock
+                      # throughput with one sample per round, so give
+                      # it the same widened noise floor as the storm.
+                      "sim_week": 0.30}
 
 _VAL_RE = re.compile(r"^\s*([-+0-9.eE]+)\s+(.*)\(vs\b")
 _FRAG_RE = re.compile(
@@ -74,7 +79,10 @@ def lower_is_better(name: str, unit: str) -> bool:
     # traffic_storm / traffic_diurnal report admitted/s of wall time
     # (admissions/s), so they gate in the default higher-is-better
     # direction — their latency claims (p99_admit_s) live in detail
-    # and are asserted by tests, not gated here.
+    # and are asserted by tests, not gated here. sim_week reports
+    # virtual-s simulated per wall-s (time compression), also
+    # higher-is-better; its determinism claim is vs_baseline (1.0 =
+    # digest-identical re-run) and is asserted by make sim-smoke.
     return ("latency" in name or "s/cycle" in unit
             or name == "federation_failover")
 

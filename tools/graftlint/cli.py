@@ -18,6 +18,7 @@ from tools.graftlint import baseline as baseline_mod
 from tools.graftlint.config import Config
 from tools.graftlint.core import Rule, RunResult, run
 from tools.graftlint.report import render_json, render_text, write_json
+from tools.graftlint.rules_clock import ClockDisciplineRule
 from tools.graftlint.rules_determinism import DeterminismRule
 from tools.graftlint.rules_jit import JitPurityRule
 from tools.graftlint.rules_journal import KindExhaustivenessRule
@@ -31,6 +32,7 @@ def build_rules(config: Config) -> list[Rule]:
         JitPurityRule(),
         UndoLogRule(config.u1_custodians),
         ObsWriteOnlyRule(),
+        ClockDisciplineRule(),
         KindExhaustivenessRule(config.journal_handler_files,
                                config.trace_handler_files),
     ]

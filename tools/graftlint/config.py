@@ -74,8 +74,10 @@ DEFAULT_ZONES: tuple = (
     # device path at the oracle breaker — WHERE a decision runs, never
     # WHAT it decides (both paths are digest-proven identical). Pinned
     # explicitly under the write-only discipline so the demote seam
-    # can never quietly grow into an engine mutation.
-    ("kueue_tpu/obs/watchdog.py", frozenset({"O1", "J1"})),
+    # can never quietly grow into an engine mutation. C1: hang
+    # detection reads elapsed time through its injected clock so the
+    # simulator can drive it on virtual daemon events.
+    ("kueue_tpu/obs/watchdog.py", frozenset({"O1", "J1", "C1"})),
     # Disk-budget guard + journal: guardians of durable state, not
     # decision core. D1 must NOT apply (statvfs probing and fsync
     # pacing are inherently wall-clock); pinned so a zone re-shuffle
@@ -87,10 +89,32 @@ DEFAULT_ZONES: tuple = (
     # contract, but it is BENCH input machinery, not decision core —
     # its own docstring determinism contract (seeded random.Random) is
     # exactly what D1 bans, so only the global jit-purity rule applies.
-    ("kueue_tpu/loadgen/", frozenset({"J1"})),
+    # C1: the paced replay seam must read time from the injected clock
+    # so the simulator can serve the same schedule instantly.
+    ("kueue_tpu/loadgen/", frozenset({"J1", "C1"})),
+    # The world simulator: every timer in this zone lives on the
+    # virtual event heap, so the wall clock is reached only through
+    # the SystemClock adapter (inline-pragma'd) — see rules_clock.py.
+    ("kueue_tpu/sim/", frozenset({"C1", "J1"})),
+    # Degradation ladder: rung decisions are cycle-counted functions
+    # of observed pressure — a wall-clock read here would decouple
+    # them from the virtual timeline the simulator replays.
+    ("kueue_tpu/ha/ladder.py", frozenset({"J1", "C1"})),
 )
 
 GLOBAL_RULES = frozenset({"J1"})
+
+# -- C1: wall-clock reads banned in simulated zones --
+
+# Dotted-prefix match after import-alias resolution (rules_clock.py).
+# Referencing these as injectable defaults (clock=time.monotonic) is
+# legal — only *calls* are flagged.
+C1_BANNED_CALLS: tuple = (
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.sleep",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today",
+)
 
 # -- D1: nondeterminism sources banned in decision-core zones --
 

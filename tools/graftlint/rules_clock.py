@@ -1,0 +1,95 @@
+"""C1: clock discipline in simulated zones.
+
+The world simulator's compression claim (kueue_tpu/sim/) rests on one
+architectural rule: everything that happens *in* a simulated zone reads
+time from an injected ``Clock`` and never from the process clock. A
+single direct ``time.time()`` on a simulated path re-couples virtual
+and wall time — the run still passes every functional test, but a
+week-long world silently waits out real sleeps, or worse, mixes the
+two timescales into one comparison and produces a timing decision no
+reproducer can replay.
+
+Checks (zones: ``kueue_tpu/sim/``, ``kueue_tpu/loadgen/``,
+``kueue_tpu/obs/watchdog.py``, ``kueue_tpu/ha/ladder.py``): calls to
+wall-clock reads and sleeps (``time.time`` / ``time.monotonic`` /
+``time.perf_counter`` / ``time.sleep`` and the ``_ns`` variants,
+``datetime.datetime.now`` / ``utcnow``, ``datetime.date.today``),
+resolved through import aliases.
+
+What stays legal — and is exactly the sanctioned idiom:
+
+  * *referencing* ``time.monotonic`` as an injectable default
+    (``def __init__(self, clock=time.monotonic)``) — C1 flags calls,
+    not references; the default-parameter seam is how real-clock
+    behavior is selected without re-reading the wall clock inline;
+  * calling the injected clock (``self._clock()``, ``clock.sleep()``).
+
+The real-clock adapter (``sim/clock.py SystemClock``) carries inline
+``allow[C1]`` pragmas: it is the one place the simulated world touches
+the process clock, by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.config import C1_BANNED_CALLS
+from tools.graftlint.core import (
+    Finding,
+    Module,
+    Rule,
+    dotted,
+    enclosing_function,
+    import_aliases,
+)
+
+
+class ClockDisciplineRule(Rule):
+    name = "C1"
+    title = "simulated zones read time only through an injected Clock"
+    rationale = (
+        "kueue_tpu/sim compresses ~6e5 virtual seconds into minutes by "
+        "running every timer — arrivals, fault chains, watchdog polls, "
+        "checkpoint cadence, lease renewal — on one virtual event "
+        "heap. That only works if simulated code takes its clock as a "
+        "parameter (defaulting to the real one) instead of calling "
+        "time.time()/time.monotonic()/time.sleep() directly: a direct "
+        "read mixes wall time into virtual timelines, making runs "
+        "non-reproducible and un-shrinkable in ways no functional "
+        "test catches. Referencing time.monotonic as an injectable "
+        "default parameter is the sanctioned idiom; calling it inline "
+        "is the violation.")
+    example = (
+        "    # BAD: wall-clock read on a simulated path\n"
+        "    elapsed = time.monotonic() - t0\n"
+        "    # BAD: real sleep inside a virtual-time component\n"
+        "    time.sleep(backoff)\n"
+        "    # GOOD: the clock is a seam, real by default\n"
+        "    def __init__(self, clock=time.monotonic):\n"
+        "        self._clock = clock\n"
+        "    ...\n"
+        "    elapsed = self._clock() - t0")
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        aliases = import_aliases(mod.tree)
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            path = dotted(node.func, aliases)
+            if not path:
+                continue
+            for banned in C1_BANNED_CALLS:
+                if path == banned or path.startswith(banned + "."):
+                    qual = enclosing_function(mod.tree, node)
+                    findings.append(Finding(
+                        self.name, mod.relpath, node.lineno,
+                        node.col_offset, qual,
+                        f"direct wall-clock call {path}() in a "
+                        "simulated zone — take a Clock (or a "
+                        "clock=time.monotonic parameter) and call "
+                        "that instead, so virtual time can be "
+                        "injected"))
+                    break
+        return findings

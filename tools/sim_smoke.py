@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""sim-smoke: the world-fuzzing loop proven end to end, behind
+``make sim-smoke``.
+
+Three stages:
+
+  1. **Fuzz sweep** — 8 world-seed triples through the full invariant
+     oracle (kueue_tpu/sim/oracle.py): host-vs-device differential
+     (decision-digest identity with the attached JAX oracle) plus the
+     metamorphic catalog (determinism, quota monotonicity, priority
+     monotonicity, benign-fault neutrality). Every seed must pass —
+     a failure here is a real scheduler bug (that's the point).
+
+  2. **Compression arm** — one multi-day diurnal world with an
+     embedded full-stack fault storm (journal, virtual-cadence
+     checkpoints, shedder, ladder, lease on virtual renewal timers)
+     must hold the compression floor and re-run digest-identically.
+
+  3. **Planted regression** — re-runs the oracle in a subprocess with
+     ``KUEUE_TPU_SIM_PLANT=1`` (a harness-level lost-arrival bug):
+     the violation must be detected, auto-shrunk to a minimal
+     (world-seed, traffic-seed, fault-seed) reproducer, and the
+     written reproducer must exit 3 under ``kueuectl sim run --repro``
+     with the plant and exit 0 without — proving the shrinker's
+     output is a real, self-contained reproducer, not a heuristic.
+
+Exits non-zero on the first failure.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+FUZZ_TRIPLES = [(s, s * 3 + 1, s * 7 + 3) for s in range(1, 9)]
+FUZZ_HORIZON_S = 60.0
+STORM_VIRTUAL_DAYS = 2.0
+STORM_CYCLE_S = 30.0
+MIN_COMPRESSION_X = 200.0
+PLANT_TRIPLE = (7, 2, 11)
+
+
+def fail(msg: str) -> None:
+    print(f"sim-smoke: FAIL: {msg}")
+    raise SystemExit(1)
+
+
+def stage_fuzz() -> None:
+    from kueue_tpu.sim.oracle import check_world
+
+    for i, (ws, ts, fs) in enumerate(FUZZ_TRIPLES):
+        t0 = time.perf_counter()
+        report = check_world(ws, ts, fs, device=True,
+                             horizon_s=FUZZ_HORIZON_S)
+        wall = time.perf_counter() - t0
+        if not report.ok:
+            print(json.dumps(report.to_dict(), indent=2,
+                             sort_keys=True))
+            fail(f"triple ({ws},{ts},{fs}) violated "
+                 f"{report.failed()}")
+        diff = report.results["differential"]
+        print(f"  fuzz {i + 1}/{len(FUZZ_TRIPLES)} "
+              f"({ws},{ts},{fs}): ok "
+              f"digest={diff['hostDigest']} "
+              f"admitted={diff['hostAdmitted']} "
+              f"[{wall:.1f}s]")
+    print(f"sim-smoke: fuzz sweep OK "
+          f"({len(FUZZ_TRIPLES)} worlds, differential + "
+          f"{len(report.results) - 1} metamorphic invariants each)")
+
+
+def stage_storm() -> None:
+    from kueue_tpu.sim.oracle import storm_world
+
+    horizon = STORM_VIRTUAL_DAYS * 86_400.0
+    a = storm_world(11, 3, 7, horizon_s=horizon,
+                    cycle_s=STORM_CYCLE_S)
+    compression = a.virtual_s / max(a.wall_s, 1e-9)
+    print(f"  storm: {a.virtual_s:.0f} virtual s in {a.wall_s:.1f} "
+          f"wall s ({compression:.0f}x), {a.cycles} cycles, "
+          f"faults={list(a.faults_fired)}, "
+          f"checkpoints={a.checkpoints}, rung<= {a.max_rung}, "
+          f"lease epoch {a.lease.get('epoch')}")
+    if compression < MIN_COMPRESSION_X:
+        fail(f"compression {compression:.0f}x below the "
+             f"{MIN_COMPRESSION_X:.0f}x floor")
+    if not a.faults_fired:
+        fail("storm arm fired no faults")
+    if a.lease.get("epoch") != 1:
+        fail(f"lease epoch {a.lease.get('epoch')} != 1 — virtual "
+             "renewal cadence lost the lease")
+    b = storm_world(11, 3, 7, horizon_s=horizon,
+                    cycle_s=STORM_CYCLE_S)
+    if (b.decision_digest != a.decision_digest
+            or b.admitted_digest != a.admitted_digest):
+        fail("storm re-run digests diverged: "
+             f"{a.decision_digest:08x}/{a.admitted_digest} vs "
+             f"{b.decision_digest:08x}/{b.admitted_digest}")
+    print("sim-smoke: compression arm OK (re-run digest-identical)")
+
+
+def _kueuectl_sim(args, plant: bool) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["KUEUE_TPU_SIM_PLANT"] = "1" if plant else "0"
+    env["PYTHONPATH"] = ROOT
+    return subprocess.run(
+        [sys.executable, "-m", "kueue_tpu.cli.kueuectl", "sim"] + args,
+        cwd=ROOT, env=env, capture_output=True, text=True,
+        timeout=600)
+
+
+def stage_planted() -> None:
+    ws, ts, fs = PLANT_TRIPLE
+    with tempfile.TemporaryDirectory(prefix="sim-smoke-") as td:
+        repro_path = os.path.join(td, "repro.json")
+        proc = _kueuectl_sim(
+            ["shrink", "--world-seed", str(ws), "--traffic-seed",
+             str(ts), "--fault-seed", str(fs), "--out", repro_path],
+            plant=True)
+        if proc.returncode != 0:
+            fail("shrink did not produce a reproducer for the "
+                 f"planted regression:\n{proc.stdout}\n{proc.stderr}")
+        rep = json.load(open(repro_path))
+        if rep["invariant"] != "benign_fault_neutral":
+            fail(f"planted bug shrank to {rep['invariant']!r}, "
+                 "expected benign_fault_neutral")
+        if rep["dims"]["n_workload_cap"] > 4 or rep["dims"]["n_faults"] > 1:
+            fail(f"shrinker did not converge: dims={rep['dims']}")
+        print(f"  planted: shrank to triple "
+              f"({rep['worldSeed']},{rep['trafficSeed']},"
+              f"{rep['faultSeed']}) dims={rep['dims']} in "
+              f"{rep['shrinkAttempts']} attempts")
+
+        with_plant = _kueuectl_sim(["run", "--repro", repro_path],
+                                   plant=True)
+        if with_plant.returncode != 3:
+            fail("reproducer under the plant exited "
+                 f"{with_plant.returncode}, expected 3:\n"
+                 f"{with_plant.stdout}\n{with_plant.stderr}")
+        without = _kueuectl_sim(["run", "--repro", repro_path],
+                                plant=False)
+        if without.returncode != 0:
+            fail("reproducer without the plant exited "
+                 f"{without.returncode}, expected 0:\n"
+                 f"{without.stdout}\n{without.stderr}")
+    print("sim-smoke: planted regression OK (shrunk, exit 3 with "
+          "plant, exit 0 without)")
+
+
+def main() -> None:
+    if os.environ.get("KUEUE_TPU_SIM_PLANT") == "1":
+        fail("refusing to run with KUEUE_TPU_SIM_PLANT=1 in the "
+             "environment — the fuzz sweep would fail by design")
+    t0 = time.perf_counter()
+    stage_fuzz()
+    stage_storm()
+    stage_planted()
+    print(f"sim-smoke: OK ({time.perf_counter() - t0:.1f}s)")
+
+
+if __name__ == "__main__":
+    main()
