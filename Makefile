@@ -7,7 +7,8 @@ PYTEST_FLAGS ?= -q
 
 .PHONY: all native test test-fast test-device bench multichip-dryrun \
   replay-smoke obs-smoke tas-smoke perf-smoke apply-smoke ha-smoke \
-  chaos-smoke federation-smoke overload-smoke sim-smoke smoke \
+  chaos-smoke federation-smoke overload-smoke sim-smoke \
+  readplane-smoke smoke \
   bench-gate lint clean
 
 all: native
@@ -150,6 +151,16 @@ overload-smoke: lint
 sim-smoke: lint
 	JAX_PLATFORMS=cpu $(PY) tools/sim_smoke.py
 
+# Read-plane smoke: leader + two stateless read replicas over one
+# journal; reads routed exclusively to the replicas (the leader's
+# /metrics must prove zero read queries served), every answer stamped
+# with its staleness envelope, leader SIGKILLed mid-storm while the
+# replicas keep answering within bound and watch streams stay live
+# (tools/readplane_smoke.py). lint first: the readplane/ J1 zone pin
+# is part of the contract.
+readplane-smoke: lint
+	JAX_PLATFORMS=cpu $(PY) tools/readplane_smoke.py
+
 # Bench regression sentinel: noise-aware per-scenario gate over the
 # accumulated BENCH_r*/MULTICHIP_r* trajectory (tools/bench_sentinel.py).
 # Fails (exit 1) when the latest round regressed past its scenario's
@@ -162,7 +173,7 @@ bench-gate:
 # correctness one.
 smoke: replay-smoke tas-smoke obs-smoke perf-smoke apply-smoke \
   ha-smoke chaos-smoke federation-smoke overload-smoke sim-smoke \
-  bench-gate
+  readplane-smoke bench-gate
 
 # Validate the multi-chip sharding compiles + executes on a virtual mesh.
 multichip-dryrun:

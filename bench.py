@@ -1438,6 +1438,241 @@ def bench_federation_failover(n_workloads=96):
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_read_qps(n_workloads=200, n_reads=400, staleness_bound_s=10.0):
+    """Global read plane throughput under a write storm with a leader
+    SIGKILL in the middle (kueue_tpu/readplane). One plain leader and
+    two ``serve --read-replica`` processes share a journal; every read
+    goes through the ReadFrontend (replicas ONLY — the leader is
+    structurally unreachable from the read path). The first half of
+    the reads interleave with workload POSTs to the leader; the leader
+    is then SIGKILLed and the second half must keep answering from the
+    replicas' journal-rebuilt models. The value is serial read
+    queries/s over the whole run (higher is better); the arm asserts
+    every answer's staleness wall age stays inside
+    ``staleness_bound_s``, every answer routed to a replica, and the
+    leader's own visibility counter never saw a single read."""
+    import shutil
+    import signal
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from kueue_tpu.api.serde import to_jsonable
+    from kueue_tpu.bench.scenario import baseline_like
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.readplane.frontend import ReadFrontend
+    from kueue_tpu.store.journal import attach_new_journal
+
+    workdir = tempfile.mkdtemp(prefix="bench-readplane-")
+    journal = os.path.join(workdir, "read.jsonl")
+    scen = baseline_like(n_cohorts=2, cqs_per_cohort=2,
+                         n_workloads=n_workloads,
+                         nominal_per_cq=20_000 * n_workloads,
+                         sized_to_fit=True)
+    eng = Engine()
+    attach_new_journal(eng, journal)
+    for rf in scen.flavors:
+        eng.create_resource_flavor(rf)
+    for co in scen.cohorts:
+        eng.create_cohort(co)
+    for cq in scen.cluster_queues:
+        eng.create_cluster_queue(cq)
+    for lq in scen.local_queues:
+        eng.create_local_queue(lq)
+    eng.journal.sync()
+    eng.journal.close()
+
+    def spawn(logf, extra):
+        cmd = [sys.executable, "-m", "kueue_tpu.serve",
+               "--journal", journal, "--oracle", "off",
+               "--http", "127.0.0.1:0", "--tick", "0.02"] + extra
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+        return subprocess.Popen(cmd, stdout=logf,
+                                stderr=subprocess.STDOUT, env=env)
+
+    def wait_line(path, needle, proc, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                text = open(path).read()
+            except FileNotFoundError:
+                text = ""
+            if needle in text:
+                return text
+            if proc.poll() is not None and needle not in text:
+                raise RuntimeError(
+                    f"process died (rc={proc.returncode}) before "
+                    f"{needle!r}: {text[-500:]}")
+            time.sleep(0.05)
+        raise RuntimeError(f"timeout waiting for {needle!r}")
+
+    def port_of(path, proc):
+        line = next(ln for ln in wait_line(
+            path, "serving on", proc).splitlines() if "serving on" in ln)
+        return int(line.split("serving on", 1)[1].split("(", 1)[0]
+                   .strip().rsplit(":", 1)[1])
+
+    def get_json(port, path, timeout=5):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def post(port, wl, timeout=5):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/workloads",
+            data=json.dumps(to_jsonable(wl)).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status
+
+    def post_retry(port, wl, proc, log_path, attempts=3):
+        # Workload names are the dedup key, so re-POSTing after a
+        # transient connection drop (loaded box, handler-thread race)
+        # is idempotent: a retry of already-journaled work gets 200.
+        for i in range(attempts):
+            try:
+                return post(port, wl)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        "leader died during the storm: "
+                        + open(log_path).read()[-300:])
+                time.sleep(0.1 * (i + 1))
+        raise RuntimeError("leader unreachable after retries")
+
+    leader = None
+    replicas = []
+    try:
+        leader_log = os.path.join(workdir, "leader.log")
+        with open(leader_log, "w") as lf:
+            leader = spawn(lf, ["--segment-records", "200"])
+        lport = port_of(leader_log, leader)
+        rports = []
+        for ident in ("bench-ra", "bench-rb"):
+            rlog = os.path.join(workdir, f"{ident}.log")
+            with open(rlog, "w") as rf:
+                replicas.append(spawn(rf, ["--read-replica",
+                                           "--replica-id", ident]))
+            rports.append(port_of(rlog, replicas[-1]))
+        # A replica without a read model ranks last-but-routable in the
+        # frontend; wait for both first rebuilds so the measured span
+        # is steady-state tailing, not boot.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            ready = 0
+            for rp in rports:
+                try:
+                    if get_json(rp, "/debug/readplane").get("staleness"):
+                        ready += 1
+                except (OSError, ValueError):
+                    pass
+            if ready == len(rports):
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("replicas never built a read model")
+
+        bases = [f"http://127.0.0.1:{p}" for p in rports]
+        fe = ReadFrontend(bases, timeout=5.0)
+        cq0 = scen.cluster_queues[0].name
+        kinds = ("quota", "pending", "position")
+        latencies, ages = [], []
+
+        def timed_read(i):
+            kind = kinds[i % len(kinds)]
+            arg = cq0 if kind == "position" else None
+            t0 = time.perf_counter()
+            out = fe.query(kind, arg)
+            latencies.append(time.perf_counter() - t0)
+            st = out.get("staleness") or {}
+            age = st.get("wallAgeSeconds")
+            if age is None or age > staleness_bound_s:
+                raise RuntimeError(
+                    f"staleness bound violated: age={age} "
+                    f"bound={staleness_bound_s}")
+            if out.get("routedTo") not in bases:
+                raise RuntimeError(
+                    f"read answered off-plane: {out.get('routedTo')}")
+            ages.append(float(age))
+
+        # Storm phase: every POST to the leader is chased by a read
+        # through the front end, then the read budget's first half
+        # drains against the still-live fleet.
+        reads = 0
+        for wl in scen.workloads:
+            if post_retry(lport, wl, leader, leader_log) not in (200, 201):
+                raise RuntimeError("leader refused a storm workload")
+            if reads < n_reads // 2:
+                timed_read(reads)
+                reads += 1
+        while reads < n_reads // 2:
+            timed_read(reads)
+            reads += 1
+
+        # Zero-leader-reads proof, from the leader's own exposition:
+        # no visibility_queries_total SAMPLE may exist (HELP/TYPE
+        # headers render even for empty families).
+        expo = ""
+        for attempt in range(3):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{lport}/metrics",
+                        timeout=5) as r:
+                    expo = r.read().decode()
+                break
+            except (urllib.error.URLError, ConnectionError, OSError):
+                if attempt == 2:
+                    raise
+                time.sleep(0.1)
+        zero_leader_reads = not any(
+            ln.startswith("kueue_tpu_visibility_queries_total")
+            for ln in expo.splitlines())
+        if not zero_leader_reads:
+            raise RuntimeError("leader served read queries")
+
+        leader.send_signal(signal.SIGKILL)
+        leader.wait(timeout=15)
+        try:
+            post(lport, scen.workloads[0], timeout=2)
+            raise RuntimeError("dead leader accepted a POST")
+        except (urllib.error.URLError, ConnectionError, OSError):
+            pass
+
+        # Post-kill phase: the tails go quiet at the leader's final
+        # position; the quiet-tail fold must keep answers inside the
+        # staleness bound with zero live writers.
+        post_kill_reads = 0
+        while reads < n_reads:
+            timed_read(reads)
+            reads += 1
+            post_kill_reads += 1
+
+        vals = sorted(latencies)
+        p99 = vals[int(0.99 * (len(vals) - 1))] if vals else 0.0
+        qps = (len(latencies) / sum(latencies)) if latencies else 0.0
+        return {
+            "value": round(qps, 1), "unit": "reads/s",
+            "vs_baseline": None,
+            "detail": {
+                "reads": len(latencies),
+                "reads_after_leader_kill": post_kill_reads,
+                "read_p99_ms": round(p99 * 1000, 2),
+                "staleness_max_s": round(max(ages), 3) if ages else 0.0,
+                "staleness_bound_s": staleness_bound_s,
+                "zero_leader_reads": zero_leader_reads,
+                "replicas": len(replicas),
+                "workloads_posted": n_workloads,
+                "frontend_routes": fe.routes,
+            },
+        }
+    finally:
+        for proc in [leader] + replicas:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_recovery_time(waves_small=60, waves_large=600, repeats=3):
     """Bounded-time recovery (store/checkpoint.py): cold-start cost via
     sealed checkpoint + journal suffix vs a full genesis replay, at two
@@ -2161,6 +2396,9 @@ def main() -> None:
         n_workloads=120 if fast else 400), min_budget_s=90.0)
     run_scenario("federation_failover", lambda: bench_federation_failover(
         n_workloads=40 if fast else 96), min_budget_s=90.0)
+    run_scenario("read_qps", lambda: bench_read_qps(
+        n_workloads=80 if fast else 200,
+        n_reads=120 if fast else 400), min_budget_s=90.0)
     run_scenario("recovery_time", lambda: bench_recovery_time(
         waves_small=30 if fast else 60,
         waves_large=300 if fast else 600,

@@ -830,6 +830,18 @@ def run(engine, argv: list[str]) -> str:
             url = _endpoint_url(args.endpoint, "/debug/ha")
             with urllib.request.urlopen(url, timeout=5) as resp:
                 status = json.loads(resp.read())
+            # Read replicas answer /debug/readplane with their
+            # staleness envelope; HA replicas/leaders answer
+            # {"enabled": false}. Either way the fetch is additive —
+            # a pre-readplane server 404s and we show nothing.
+            try:
+                rp_url = _endpoint_url(args.endpoint, "/debug/readplane")
+                with urllib.request.urlopen(rp_url, timeout=5) as resp:
+                    rp = json.loads(resp.read())
+                if rp.get("enabled"):
+                    status["readplane"] = rp
+            except (OSError, ValueError):
+                pass
         elif getattr(engine, "ha", None) is not None:
             status = engine.ha.status()
         else:
@@ -886,6 +898,35 @@ def run(engine, argv: list[str]) -> str:
             lines.append(
                 f"shedder: accepted={sh['accepted']} shed={sh['shed']} "
                 f"factor={sh['factor']}")
+        rp = status.get("readplane")
+        if rp:
+            lines.append(f"read replica: {rp.get('replica', '?')} "
+                         f"(journal={rp.get('journal', '?')}, "
+                         f"queries={rp.get('queries', 0)})")
+            st = rp.get("staleness")
+            if st:
+                pos = st.get("position") or {}
+                lines.append(
+                    f"  rebuilt @ lineage {pos.get('lineage', '?')} "
+                    f"seg {pos.get('segment', '?')} "
+                    f"offset {pos.get('offset', '?')} "
+                    f"cid={st.get('cid') or '-'}")
+                age = st.get("wallAgeSeconds")
+                lines.append(
+                    f"  staleness: lag={st.get('lagRecords', '?')} "
+                    f"record(s), age="
+                    + (f"{age:.3f}s" if age is not None else "?"))
+            else:
+                lines.append("  staleness: no rebuild yet")
+            slo = rp.get("readSlo") or {}
+            worst = None
+            for name, ev in (slo.get("objectives") or {}).items():
+                if worst is None or ev["status"] > worst[1]["status"]:
+                    worst = (name, ev)
+            if worst is not None:
+                lines.append(
+                    f"  read SLO worst: {worst[0]} "
+                    f"{worst[1]['statusName']}")
         return "\n".join(lines)
     if args.command == "cells":
         if args.endpoint:

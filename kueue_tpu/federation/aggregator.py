@@ -77,6 +77,40 @@ class CellEventTailer:
         self.events_relayed += 1
 
 
+class CellReadAggregator:
+    """Aggregated per-cell reads over the global read plane: one
+    ReadFrontend per cell (each routing to that cell's replicas, never
+    its leader), fanned out per query and merged with per-cell
+    staleness envelopes intact. A dead cell degrades to an explicit
+    per-cell error entry — the federation answer never silently drops
+    a cell, and the caller sees exactly which cell answered from which
+    journal position at what age."""
+
+    def __init__(self, frontends: dict):
+        """``frontends``: {cell_name: kueue_tpu.readplane.ReadFrontend}."""
+        self.frontends = dict(frontends)
+        self.queries = 0
+
+    def query(self, kind: str, arg: str = None) -> dict:
+        self.queries += 1
+        cells: dict = {}
+        for name in sorted(self.frontends):
+            try:
+                cells[name] = self.frontends[name].query(kind, arg)
+            except Exception as e:  # noqa: BLE001 — cell-wide outage
+                cells[name] = {"error": str(e), "staleness": None}
+        return {"kind": kind, "cells": cells,
+                "staleness": {
+                    name: (ans.get("staleness") or {}).get(
+                        "wallAgeSeconds")
+                    for name, ans in cells.items()}}
+
+    def status(self) -> dict:
+        return {"queries": self.queries,
+                "cells": {name: fe.status()
+                          for name, fe in sorted(self.frontends.items())}}
+
+
 class EventAggregator:
     """Owns one tailer per cell; lifecycle matches the dispatcher."""
 

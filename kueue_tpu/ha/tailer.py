@@ -64,8 +64,17 @@ class JournalTailer:
         self.last_checkpoint: Optional[dict] = None  # last ha_digest obj
         self._ordinal: Optional[int] = None  # file the offset refers to
         self._offset = 0
+        self._lines = 0             # complete lines consumed of _ordinal
         self._lineage = 0
         self._pending = 0           # records seen since last rebuild
+        # Staleness envelope inputs (kueue_tpu/readplane): the journal
+        # position the read model was rebuilt at, when that happened on
+        # this process's clock, and the correlation id of the last
+        # admission cycle whose trace record passed through the tail.
+        self.applied_position: Optional[dict] = None
+        self.applied_at: Optional[float] = None
+        self.last_cycle_cid: Optional[str] = None
+        self.last_record_ts: Optional[float] = None
         # Full-jitter rebuild backoff (anti-thundering-herd): streak
         # counts consecutive throttled rebuilds; one quiet poll resets.
         self.rebuild_backoff_base = float(rebuild_backoff_base)
@@ -79,6 +88,16 @@ class JournalTailer:
     def replay_lag(self) -> int:
         """Records durable in the journal but not in the read model."""
         return self._pending
+
+    def position(self) -> Optional[dict]:
+        """The consumed tail position in ``Journal.position()``
+        coordinates ({lineage, segment, offset} — offset in complete
+        LINES of the file named by segment, meta line included), or
+        None before the first poll."""
+        if self._ordinal is None:
+            return None
+        return {"lineage": self._lineage, "segment": self._ordinal,
+                "offset": self._lines}
 
     # -- segment chain helpers --
 
@@ -136,6 +155,7 @@ class JournalTailer:
                 # Sealed files never grow: move on regardless.
                 self._ordinal += 1
                 self._offset = 0
+                self._lines = 0
                 continue
             if self._ordinal != active_ord:
                 # Gap: retention deleted unread segments (we slept past
@@ -147,6 +167,13 @@ class JournalTailer:
             break
         if new == 0:
             self._streak = 0
+            if self._pending and self.engine is not None:
+                # The tail went quiet with records still unfolded (a
+                # dead leader stops the stream exactly here): fold now
+                # — a quiet journal is the cheapest moment to rebuild,
+                # and below-threshold lag would otherwise never clear,
+                # pinning every replica answer behind the final writes.
+                self.rebuild()
             self._gauge()
             return 0
         self.records_seen += new
@@ -181,6 +208,10 @@ class JournalTailer:
         complete = chunk.rfind(b"\n") + 1
         if complete == 0:
             return 0, False
+        # Line-position bookkeeping mirrors Journal._active_lines: every
+        # complete line counts (meta lines included), so position() is
+        # directly comparable with the leader journal's position().
+        self._lines += chunk[:complete].count(b"\n")
         new = 0
         for line in chunk[:complete].splitlines():
             if not line.strip():
@@ -207,12 +238,21 @@ class JournalTailer:
             with open(self.path, "rb") as f:
                 data = f.read()
             self._offset = data.rfind(b"\n") + 1
+            self._lines = data[:self._offset].count(b"\n")
         except FileNotFoundError:
             self._offset = 0
+            self._lines = 0
         self._gauge()
 
     def _ingest(self, rec: dict) -> None:
         kind = rec.get("kind")
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)):
+            self.last_record_ts = float(ts)
+        if kind == "cycle_trace":
+            obj = rec.get("obj")
+            if isinstance(obj, dict) and obj.get("name"):
+                self.last_cycle_cid = str(obj["name"])
         if kind == "ha_digest":
             self.last_checkpoint = rec.get("obj")
             if self.hub is not None:
@@ -244,6 +284,13 @@ class JournalTailer:
         self.engine = engine_from_records(records, **self.engine_kwargs)
         if meta is not None:
             self.engine.clock = max(self.engine.clock, meta.clock)
+        # The rebuild folded everything durable at this instant: stamp
+        # the position it answered from (readplane staleness envelope,
+        # and `kueuectl explain` honesty about rebuilt engines).
+        self.applied_position = journal.position()
+        self.applied_at = self._clock()
+        self.engine.rebuild_position = self.applied_position
+        self.engine.rebuild_wall = time.time()
         journal.close()
         self.rebuilds += 1
         self._pending = 0
@@ -263,4 +310,7 @@ class JournalTailer:
             "rebuilds": self.rebuilds,
             "resyncs": self.resyncs,
             "lastCheckpoint": self.last_checkpoint,
+            "position": self.position(),
+            "appliedPosition": self.applied_position,
+            "lastCycleCid": self.last_cycle_cid,
         }

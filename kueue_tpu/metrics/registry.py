@@ -352,6 +352,30 @@ class MetricsRegistry:
           "disk budget transitions per resulting state")
         c("sse_detail_suppressed_total",
           "detail events suppressed at the fanout boundary per kind")
+        # Global read plane (kueue_tpu/readplane): staleness-bounded
+        # query replicas. The staleness histogram shares PERF_BUCKETS
+        # so per-replica series merge; the visibility counter is the
+        # leader-side proof of zero read traffic (it must stay flat on
+        # a leader fronted by the read plane).
+        h("readplane_staleness_seconds",
+          "advertised staleness bound per answered query",
+          buckets=PERF_BUCKETS)
+        h("readplane_query_duration_seconds",
+          "read-query service latency per kind",
+          buckets=PERF_BUCKETS)
+        c("readplane_queries_total", "read queries per (kind, result)")
+        g("readplane_replay_lag_records",
+          "journal records durable but not folded into the replica "
+          "read model")
+        g("readplane_last_applied_age_seconds",
+          "wall age of the replica read model's rebuild point")
+        h("readplane_rebuild_seconds",
+          "read-model rebuild (checkpoint base + suffix) durations",
+          buckets=PERF_BUCKETS)
+        c("readplane_frontend_routes_total",
+          "front-end read routings per (target, reason)")
+        c("visibility_queries_total",
+          "engine-backed read queries served per route class")
         self.gauge("build_info").set(
             (("name", "kueue_tpu"), ("version", "0.2.0")), 1)
 

@@ -43,9 +43,31 @@ def explain_workload(engine, key: str, probe: bool = True) -> dict:
                 "cid": cycle.attrs["cid"], "seq": cycle.attrs["seq"],
                 "mode": cycle.attrs["mode"], "clock": cycle.attrs["clock"],
                 **span.attrs}
+    rebuild = _rebuild_stamp(engine)
+    if rebuild is not None:
+        report["rebuild"] = rebuild
     if probe and report["status"] == "pending":
         report["probe"] = _probe(engine, wl)
     return report
+
+
+def _rebuild_stamp(engine) -> Optional[dict]:
+    """Provenance of a journal-rebuilt engine: the position recovery
+    replayed to and how stale that state is now. None for a live
+    engine — the distinction the report must never blur (a rebuilt
+    engine presenting as live answers "why is my workload pending"
+    from a past world)."""
+    pos = getattr(engine, "rebuild_position", None)
+    if pos is None:
+        return None
+    out = {"position": pos}
+    wall = getattr(engine, "rebuild_wall", None)
+    if wall is not None:
+        import time
+
+        out["wall"] = wall
+        out["staleness_s"] = round(max(0.0, time.time() - wall), 3)
+    return out
 
 
 def _lifecycle(wl) -> str:
@@ -120,6 +142,16 @@ def render_explain(report: dict) -> str:
         return "\n".join(lines)
     lines.append(f"  Status:        {report['status']}")
     lines.append(f"  ClusterQueue:  {report['cluster_queue']}")
+    rb = report.get("rebuild")
+    if rb is not None:
+        pos = rb.get("position") or {}
+        where = (f"lineage {pos.get('lineage', '?')} "
+                 f"seg {pos.get('segment', '?')} "
+                 f"offset {pos.get('offset', '?')}")
+        age = rb.get("staleness_s")
+        lines.append(f"  Source:        journal rebuild @ {where}"
+                     + (f" ({age:.1f}s ago)" if age is not None
+                        else ""))
     tr = report.get("trace")
     if tr is not None:
         lines.append(f"  Last traced decision (cycle {tr['seq']}, "

@@ -65,6 +65,17 @@ DEFAULT_OBJECTIVES = (
 # slow confirms sustained burn.
 DEFAULT_WINDOWS = (("fast", 16), ("slow", 128))
 
+# Read-plane objectives (kueue_tpu/readplane): both are quantile-bound
+# shaped, so they reuse the latency_p95 burn semantics over their own
+# sample series — read service latency per query, and the advertised
+# staleness bound stamped on each answer. Budget 0.01 ⇒ "p99 ≤ target".
+READ_OBJECTIVES = (
+    SLO("read_latency_p99", kind="latency_p95", target=0.05,
+        budget=0.01),
+    SLO("read_staleness_bound", kind="latency_p95", target=5.0,
+        budget=0.05),
+)
+
 
 class _Window:
     """One sliding window's running aggregates. Maintained
@@ -254,3 +265,113 @@ def attach_slo(engine, objectives=DEFAULT_OBJECTIVES,
     if existing is not None:
         return existing
     return SLOEngine(engine, objectives=objectives, windows=windows)
+
+
+class ReadSLOEngine:
+    """Multi-window burn evaluation for the read plane.
+
+    Unlike :class:`SLOEngine` this is not attached to an engine — a
+    read replica's engine is rebuilt (replaced) on every tail rebuild,
+    so the evaluator and its exported gauges must outlive any one
+    engine object. The replica owns one of these, feeds it a
+    (latency, staleness-bound) pair per answered query, and exports
+    through the replica's own stable registry via the same ``slo_*``
+    gauge families the cycle-side engine uses.
+
+    Both READ_OBJECTIVES are quantile bounds, so burn per objective is
+    simply (violation share / budget) over each window's own sample
+    ring — the same multi-window page/warn semantics as the cycle SLOs
+    (breach only when every window burns).
+    """
+
+    def __init__(self, registry=None, objectives=READ_OBJECTIVES,
+                 windows=DEFAULT_WINDOWS):
+        self.registry = registry
+        self.objectives = tuple(objectives)
+        self.windows = tuple(windows)
+        # {objective: {window: deque of samples}}
+        self._rings = {
+            o.name: {w: deque(maxlen=n) for w, n in self.windows}
+            for o in self.objectives}
+        self.reads_observed = 0
+        self._export_targets()
+
+    def _export_targets(self) -> None:
+        if self.registry is None:
+            return
+        try:
+            g = self.registry.gauge("slo_objective_target")
+        except KeyError:
+            return
+        for o in self.objectives:
+            g.set((o.name, o.kind), o.target)
+
+    def observe_read(self, latency_s: float,
+                     staleness_s: Optional[float]) -> None:
+        """Append one answered query: its service latency and the
+        staleness bound it advertised (None — no bound computable yet,
+        e.g. before the first rebuild — counts as a staleness
+        violation: an answer that cannot bound its own staleness has
+        already busted the objective)."""
+        samples = {"read_latency_p99": float(latency_s),
+                   "read_staleness_bound": (
+                       float("inf") if staleness_s is None
+                       else float(staleness_s))}
+        for o in self.objectives:
+            v = samples.get(o.name)
+            if v is None:
+                continue
+            for _, ring in self._rings[o.name].items():
+                ring.append(v)
+        self.reads_observed += 1
+        self._export()
+
+    def evaluate(self) -> dict:
+        out: dict[str, dict] = {}
+        for o in self.objectives:
+            burns: dict[str, float] = {}
+            for wname, ring in self._rings[o.name].items():
+                n = len(ring)
+                if n == 0:
+                    burns[wname] = 0.0
+                    continue
+                frac = sum(1 for v in ring if v > o.target) / n
+                burns[wname] = frac / max(o.budget, 1e-9)
+            burning = [w for w, b in burns.items() if b >= 1.0]
+            if len(burning) == len(self.windows) and burning:
+                status = STATUS_BREACH
+            elif burning:
+                status = STATUS_WARN
+            else:
+                status = STATUS_OK
+            out[o.name] = {"kind": o.kind, "target": o.target,
+                           "budget": o.budget, "burn": burns,
+                           "status": status,
+                           "statusName": _STATUS_NAMES[status]}
+        return out
+
+    def worst(self) -> tuple:
+        worst_status, worst_burn = STATUS_OK, 0.0
+        for ev in self.evaluate().values():
+            worst_status = max(worst_status, ev["status"])
+            for b in ev["burn"].values():
+                worst_burn = max(worst_burn, b)
+        return worst_status, worst_burn
+
+    def _export(self) -> None:
+        if self.registry is None:
+            return
+        try:
+            burn_g = self.registry.gauge("slo_burn_rate")
+            status_g = self.registry.gauge("slo_status")
+        except KeyError:
+            return
+        for name, ev in self.evaluate().items():
+            for wname, b in ev["burn"].items():
+                burn_g.set((name, wname), round(min(b, 1e9), 6))
+            status_g.set((name,), ev["status"])
+
+    def summary(self) -> dict:
+        return {"readsObserved": self.reads_observed,
+                "windows": {w: n for w, n in self.windows},
+                "objectives": self.evaluate()}

@@ -60,6 +60,11 @@ Environment:
                            0/0 = watchdog off): overrun and hung-cycle
                            detection with stack capture and
                            breaker-style demotion (obs/watchdog.py)
+  KUEUE_TPU_READ_REPLICA   "1" runs this process as a READ replica
+                           (--read-replica): no admission cycles, no
+                           writable journal handle — tail --journal,
+                           serve staleness-stamped /read/* + SSE from
+                           the rebuilt read model (kueue_tpu/readplane)
   KUEUE_TPU_FEDERATE       cell spec "name[@zone]=URL,..." (--federate):
                            run this process as a FEDERATION DISPATCHER
                            instead of an engine — no local engine; POST
@@ -126,6 +131,15 @@ def main(argv=None) -> None:
                         default=os.environ.get("KUEUE_TPU_TRACE"))
     parser.add_argument("--ha", action="store_true",
                         default=os.environ.get("KUEUE_TPU_HA") == "1")
+    parser.add_argument("--read-replica", action="store_true",
+                        default=os.environ.get(
+                            "KUEUE_TPU_READ_REPLICA") == "1",
+                        help="run as a stateless READ replica"
+                             " (kueue_tpu/readplane): boot from sealed"
+                             " checkpoints + journal suffix tail of"
+                             " --journal, serve staleness-stamped"
+                             " /read/* queries and SSE from the local"
+                             " read model, never write, never lead")
     parser.add_argument("--federate",
                         default=os.environ.get("KUEUE_TPU_FEDERATE"),
                         help="run as a federation dispatcher over cells"
@@ -194,6 +208,9 @@ def main(argv=None) -> None:
 
     if args.federate:
         _main_federation(args)
+        return
+    if args.read_replica:
+        _main_read_replica(args)
         return
     if args.ha:
         _main_ha(args)
@@ -348,6 +365,60 @@ def _main_federation(args) -> None:
         time.sleep(args.tick)
     aggregator.stop()
     dispatcher.close()
+    endpoint.stop()
+    hub.close()
+
+
+def _main_read_replica(args) -> None:
+    """Read-replica mode (kueue_tpu/readplane): this process never
+    runs admission cycles and never holds a writable journal handle.
+    It tails ``--journal`` (checkpoint base + suffix rebuilds), serves
+    staleness-stamped /read/* queries and /events SSE from its local
+    read model, and rejects every write. Kill the leader and this
+    process keeps answering — its answers just age, and they say so."""
+    from kueue_tpu.metrics.registry import MetricsRegistry
+    from kueue_tpu.readplane import ReadReplica
+    from kueue_tpu.visibility.fanout import FanoutHub
+    from kueue_tpu.visibility.http_server import ServingEndpoint
+
+    identity = args.replica_id or f"read-{os.getpid()}"
+    registry = MetricsRegistry()
+    hub = FanoutHub(shards=args.fanout_shards, metrics=registry)
+    replica = ReadReplica(args.journal, replica_id=identity, hub=hub,
+                          metrics=registry)
+
+    host, _, port = args.http.rpartition(":")
+    endpoint = ServingEndpoint(
+        lambda: replica.engine, host=host or "0.0.0.0", port=int(port),
+        auth_token=os.environ.get("KUEUE_TPU_AUTH_TOKEN"),
+        hub=hub, readplane=replica)
+    endpoint.start()
+    print(f"kueue-tpu read replica serving on {host or '0.0.0.0'}:"
+          f"{endpoint.port} (journal={args.journal})", flush=True)
+    print(f"readplane: replica={identity} journal={args.journal}",
+          flush=True)
+
+    stop = {"flag": False}
+
+    def _stop(*_a):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+
+    # Tail fast, sleep only when the journal is quiet: staleness is the
+    # product this process sells, so the tail tick is a fraction of the
+    # scheduling tick.
+    tail_tick = min(args.tick, 0.05)
+    while not stop["flag"]:
+        try:
+            n = replica.poll()
+        except FileNotFoundError:
+            # The leader hasn't created the journal yet: stay up,
+            # answer "no read model", retry.
+            n = 0
+        if n == 0:
+            time.sleep(tail_tick)
     endpoint.stop()
     hub.close()
 

@@ -64,6 +64,7 @@ class SimResult:
     lease: dict = field(default_factory=dict)
     checkpoints: int = 0
     max_rung: int = 0
+    read_probe: dict = field(default_factory=dict)
     virtual_s: float = 0.0
     events_fired: int = 0
     wall_s: float = 0.0
@@ -83,6 +84,7 @@ class SimResult:
             "faultsFired": list(self.faults_fired),
             "watchdog": self.watchdog, "lease": self.lease,
             "checkpoints": self.checkpoints, "maxRung": self.max_rung,
+            "readProbe": self.read_probe or None,
             "virtualSeconds": round(self.virtual_s, 3),
             "eventsFired": self.events_fired,
             "wallSeconds": round(self.wall_s, 3),
@@ -98,6 +100,7 @@ def run_sim(spec: WorldSpec, traffic_seed: int = 0, fault_seed: int = 0,
             horizon_s: Optional[float] = None,
             storm_faults: bool = False,
             shed_rate: Optional[float] = None,
+            probe_read_at: Optional[float] = None,
             drain_cycles: int = 96) -> SimResult:
     """Drive one complete simulated world; see module docstring.
 
@@ -237,6 +240,40 @@ def run_sim(spec: WorldSpec, traffic_seed: int = 0, fault_seed: int = 0,
 
     for t, wl in offered:
         clock.call_at(t, _make_submit(t, wl))
+
+    # -- the read-plane probe (oracle invariant: replica == leader) --
+    if probe_read_at is not None:
+        if not full_stack:
+            raise ValueError("probe_read_at needs the full_stack arm "
+                             "(the invariant is about the journal)")
+
+        def _read_probe():
+            # The heap is between events, so the journal position P
+            # frozen here is exact: sync, snapshot the leader's
+            # canonical read answer at P, then rebuild a stateless
+            # read replica from the very same journal and demand a
+            # byte-identical answer at the identical position.
+            import hashlib
+
+            from kueue_tpu.ha.tailer import JournalTailer
+            from kueue_tpu.readplane.queries import canonical_answer
+
+            eng.journal.sync()
+            pos = eng.journal.position()
+            leader = canonical_answer(eng)
+            tailer = JournalTailer(eng.journal.path)
+            tailer.rebuild()
+            replica = canonical_answer(tailer.engine)
+            res.read_probe = {
+                "position": pos,
+                "replicaPosition": tailer.applied_position,
+                "match": (leader == replica
+                          and tailer.applied_position == pos),
+                "leaderSha": hashlib.sha256(leader).hexdigest()[:16],
+                "replicaSha": hashlib.sha256(replica).hexdigest()[:16],
+            }
+
+        clock.call_at(float(probe_read_at), _read_probe)
 
     # -- the cycle cadence (nominal-time driven) --
     def _schedule_cycle(t):

@@ -18,7 +18,11 @@ when no ground truth exists for either:
     turns its admission into a rejection;
   * **benign-fault neutrality** — an input-neutral fault chain
     (virtual hangs, checkpoint write faults, oracle crashes) never
-    changes the final admitted set.
+    changes the final admitted set;
+  * **read-replica fidelity** — a stateless read replica rebuilt from
+    the journal at position P answers the read-plane queries
+    byte-identically to the leader at P (the global read plane's
+    correctness contract: staleness is bounded, divergence is zero).
 
 A violated invariant is a *failure of the triple*: `shrink` reduces it
 and `tools/sim_smoke.py` proves the loop end to end with a planted
@@ -35,7 +39,8 @@ from kueue_tpu.sim.harness import run_sim
 from kueue_tpu.sim.worlds import generate_world
 
 INVARIANTS = ("determinism", "differential", "quota_monotonic",
-              "priority_monotonic", "benign_fault_neutral")
+              "priority_monotonic", "benign_fault_neutral",
+              "read_replica")
 
 
 @dataclass
@@ -142,6 +147,29 @@ def check_world(world_seed: int, traffic_seed: int, fault_seed: int,
             "extra": sorted(set(faulted.admitted_set)
                             - set(base.admitted_set))[:5],
             "hungCycles": faulted.watchdog.get("hungCycles", 0),
+        }
+
+    if "read_replica" in invariants:
+        # Read-plane invariant: mid-run, freeze the journal at
+        # position P and demand that a stateless read replica rebuilt
+        # from that same journal answers every read-plane query
+        # (pending / quota / per-workload explain) byte-identically to
+        # the leader at P. Runs the full-stack arm — the invariant is
+        # about the journal, and a lean sim has none. The probe point
+        # comes from the SPEC's horizon, not this function's
+        # parameter: shrink dims clamp the world shorter, and a probe
+        # scheduled past the end of a shrunk world never fires.
+        with tempfile.TemporaryDirectory(prefix="sim-read-") as wd:
+            probed = run_sim(spec, traffic_seed, fault_seed=0,
+                             full_stack=True, workdir=wd,
+                             probe_read_at=spec.horizon_s / 2.0)
+        rp = probed.read_probe
+        report.results["read_replica"] = {
+            "ok": bool(rp.get("match")),
+            "position": rp.get("position"),
+            "replicaPosition": rp.get("replicaPosition"),
+            "leaderSha": rp.get("leaderSha"),
+            "replicaSha": rp.get("replicaSha"),
         }
 
     return report

@@ -346,8 +346,15 @@ def recover_engine(journal_path: str, engine_kwargs: Optional[dict] = None,
     eng = engine_from_records(records, **(engine_kwargs or {}))
     if meta is not None:
         eng.clock = max(eng.clock, meta.clock)
+    # Rebuild provenance: what journal position this engine answers
+    # from (readplane staleness envelope; `kueuectl explain` honesty).
+    eng.rebuild_position = journal.position()
+    import time as _time
+
+    eng.rebuild_wall = _time.time()
     report = {
         "source": "checkpoint" if meta is not None else "genesis",
+        "position": eng.rebuild_position,
         "checkpoint": None if meta is None else {
             "path": meta.path, "seq": meta.seq,
             "segment": meta.segment, "offset": meta.offset,

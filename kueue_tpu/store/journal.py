@@ -980,6 +980,14 @@ def rebuild_engine(path: str, engine=None, attach_oracle: bool = False,
              for r in base if r.get("gen")})
     if attach_oracle:
         eng.attach_oracle()
+    # Stamp the rebuild provenance: any consumer of this engine (most
+    # visibly `kueuectl explain --journal`) is answering from a
+    # journal rebuild, not live scheduling state, and must be able to
+    # say which position — and how old — that state is.
+    eng.rebuild_position = journal.position()
+    import time as _time
+
+    eng.rebuild_wall = _time.time()
     eng.attach_journal(journal, record_existing=False)
     return eng
 

@@ -27,7 +27,7 @@ from kueue_tpu.visibility.server import (
 
 def make_handler(engine, auth_token=None, apf=None,
                  heartbeat_seconds: float = 15.0, hub=None,
-                 replica=None, federation=None):
+                 replica=None, federation=None, readplane=None):
     # ``engine`` may be the object itself or a zero-arg callable
     # resolving to it: HA promotion SWAPS the engine (a follower's read
     # model becomes a leader's live engine), so handlers must resolve
@@ -263,6 +263,13 @@ def make_handler(engine, auth_token=None, apf=None,
             if not self._authorized():
                 self._send('{"error":"unauthorized"}', code=401)
                 return
+            if readplane is not None:
+                # A read replica owns no writable journal: accepting a
+                # submit would mutate a read model the next tail
+                # rebuild silently discards. Writes go to the leader.
+                self._send('{"error":"read replica: writes not '
+                           'accepted here"}', code=403)
+                return
             path = urlparse(self.path).path.rstrip("/")
             import time as _time
 
@@ -385,9 +392,77 @@ def make_handler(engine, auth_token=None, apf=None,
                 "accepted": True,
                 "workload": wl.name}), code=201)
 
+        # Route classes whose GETs are *read queries* (engine-state
+        # reads a client asked for). /metrics, /healthz, /debug/ha and
+        # /debug/readplane are infrastructure probes, not reads — the
+        # readplane smoke scrapes/probes the leader without tripping
+        # the zero-leader-reads proof.
+        _READ_PREFIXES = ("/read/", "/clusterqueues", "/localqueues",
+                          "/workloads", "/capacity", "/cohorts",
+                          "/evictions", "/oracle", "/debug/dump",
+                          "/debug/trace", "/debug/perf", "/debug/slo")
+
+        def _count_read(self, engine, path: str) -> None:
+            """visibility_queries_total on the serving engine's own
+            registry: the journal-independent proof of WHO served
+            reads. A leader fronted by the read plane must hold this
+            at zero."""
+            route = next((p for p in self._READ_PREFIXES
+                          if path == p.rstrip("/")
+                          or path.startswith(p)), None)
+            if route is None:
+                return
+            reg = getattr(engine, "registry", None) \
+                if engine is not None else None
+            if readplane is not None:
+                reg = readplane.metrics
+            if reg is None:
+                return
+            try:
+                reg.counter("visibility_queries_total").inc(
+                    (route.strip("/").replace("/", "_"),))
+            except KeyError:
+                pass
+
+        def _serve_readplane(self, path: str) -> bool:
+            """The /read/* query surface + /debug/readplane. Returns
+            True when the route was handled here."""
+            if path == "/debug/readplane":
+                if readplane is None:
+                    self._send('{"enabled": false}')
+                else:
+                    self._send(json.dumps(readplane.status()))
+                return True
+            if not path.startswith("/read/"):
+                return False
+            if readplane is None:
+                self._send('{"error":"not a read replica"}', code=404)
+                return True
+            parts = path.split("/", 3)  # ["", "read", kind, arg?]
+            kind = parts[2] if len(parts) > 2 else ""
+            arg = parts[3] if len(parts) > 3 else None
+            if kind not in ("position", "quota", "pending", "explain"):
+                self._send('{"error":"unknown read kind"}', code=404)
+                return True
+            out = readplane.query(kind, arg)
+            self._send(json.dumps(out),
+                       code=503 if "error" in out else 200)
+            return True
+
         def _serve_get(self):
             engine = resolve()
             fpath = urlparse(self.path).path.rstrip("/")
+            self._count_read(engine, fpath)
+            if self._serve_readplane(fpath):
+                return
+            if readplane is not None and fpath == "/metrics":
+                # A read replica's metrics identity must survive its
+                # engine being replaced on every tail rebuild: serve
+                # the replica-owned registry, not the read model's.
+                readplane._gauges()
+                self._send(readplane.metrics.render(),
+                           content_type="text/plain")
+                return
             if federation is not None:
                 # The dispatcher tier has no engine of its own: its
                 # routes are served from FederationDispatcher state and
@@ -533,12 +608,14 @@ class ServingEndpoint:
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  cert_dir: str = None, auth_token: str = None,
                  flow_control=True, heartbeat_seconds: float = 15.0,
-                 hub=None, replica=None, federation=None):
+                 hub=None, replica=None, federation=None,
+                 readplane=None):
         from kueue_tpu.visibility.flowcontrol import APFDispatcher
         self.apf = None
         self.hub = hub
         self.replica = replica
         self.federation = federation
+        self.readplane = readplane
         if flow_control:
             self.apf = (flow_control if isinstance(
                 flow_control, APFDispatcher) else APFDispatcher())
@@ -546,7 +623,8 @@ class ServingEndpoint:
             (host, port), make_handler(
                 engine, auth_token=auth_token, apf=self.apf,
                 heartbeat_seconds=heartbeat_seconds, hub=hub,
-                replica=replica, federation=federation))
+                replica=replica, federation=federation,
+                readplane=readplane))
         self.tls = cert_dir is not None
         if cert_dir is not None:
             import ssl

@@ -1,0 +1,414 @@
+"""The global read plane (kueue_tpu/readplane): stateless read
+replicas over the HA follower tailer — staleness envelopes, canonical
+byte-identity with the leader at the same journal position, the
+freshest-replica front end, read SLOs, and the tailer's behavior
+across segment rotation and compaction lineage bumps (the inode
+swap / file-shrink rescan path a long-lived tail must survive)."""
+
+import json
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controllers.engine import Engine
+from kueue_tpu.ha.digest import admitted_state_digest
+from kueue_tpu.ha.tailer import JournalTailer
+from kueue_tpu.obs.slo import ReadSLOEngine
+from kueue_tpu.readplane import (
+    QUERY_KINDS,
+    ReadFrontend,
+    ReadReplica,
+    answer_query,
+    canonical_answer,
+)
+from kueue_tpu.store.journal import Journal, attach_new_journal, \
+    rebuild_engine
+
+
+def build_world(eng):
+    eng.create_resource_flavor(ResourceFlavor("default"))
+    eng.create_cohort(Cohort("co"))
+    eng.create_cluster_queue(ClusterQueue(
+        name="cq0", cohort="co",
+        resource_groups=(ResourceGroup(
+            ("cpu",), (FlavorQuotas(
+                "default", {"cpu": ResourceQuota(1_000)}),)),)))
+    eng.create_local_queue(LocalQueue("lq0", "default", "cq0"))
+
+
+def submit_wave(eng, n, start=0, cpu=100):
+    for i in range(start, start + n):
+        eng.clock += 0.01
+        eng.submit(Workload(name=f"w{i}", queue_name="lq0",
+                            pod_sets=(PodSet("main", 1, {"cpu": cpu}),)))
+
+
+def drain(eng):
+    while eng.schedule_once() is not None:
+        pass
+
+
+def leader_journal(tmp_path, waves=((4, 0),), **journal_kwargs):
+    path = str(tmp_path / "journal.jsonl")
+    eng = Engine()
+    attach_new_journal(eng, path, **journal_kwargs)
+    build_world(eng)
+    for n, start in waves:
+        submit_wave(eng, n, start=start)
+        drain(eng)
+    eng.journal.sync()
+    return path, eng
+
+
+# -- tailer: segment rotation + compaction lineage bump (satellite) --
+
+def test_tailer_position_tracks_journal_position(tmp_path):
+    path, eng = leader_journal(tmp_path)
+    tailer = JournalTailer(path, rebuild_every=1)
+    assert tailer.position() is None  # nothing consumed yet
+    tailer.poll()
+    # Line-for-line parity with the writer's own (lineage, segment,
+    # offset) — the coordinate every staleness envelope is stamped in.
+    assert tailer.position() == eng.journal.position()
+    assert tailer.applied_position == eng.journal.position()
+    assert tailer.applied_at is not None
+
+
+def test_tailer_follows_across_segment_rotation(tmp_path):
+    # Rotate every 8 records: multiple sealed segments plus an active
+    # tail, with the tailer polling INCREMENTALLY through the swaps
+    # (each rotation replaces the active file with a fresh, smaller
+    # inode — the rescan path).
+    path, eng = leader_journal(tmp_path, waves=((3, 0),),
+                               rotate_records=8)
+    tailer = JournalTailer(path, rebuild_every=1,
+                           rebuild_backoff_base=0.0)
+    tailer.poll()
+    for start in (3, 6, 9, 12):
+        submit_wave(eng, 3, start=start)
+        drain(eng)
+        eng.journal.sync()
+        tailer.poll()
+    assert eng.journal.active_ordinal() > 0  # rotation actually fired
+    assert tailer.position() == eng.journal.position()
+    assert tailer.records_seen == len(list(Journal(path).replay()))
+    assert (admitted_state_digest(tailer.engine)
+            == admitted_state_digest(eng))
+
+
+def test_tailer_resyncs_on_compaction_lineage_bump(tmp_path):
+    path, eng = leader_journal(tmp_path, waves=((5, 0),))
+    tailer = JournalTailer(path, rebuild_every=1,
+                           rebuild_backoff_base=0.0)
+    tailer.poll()
+    old_pos = tailer.position()
+    # Compaction rewrites the file in place: new lineage, new inode,
+    # FEWER lines than the tailer already consumed. A naive tail would
+    # read from a stale byte offset into the middle of a record; the
+    # lineage bump must force a full rescan instead.
+    eng.journal.compact()
+    submit_wave(eng, 2, start=5)
+    drain(eng)
+    eng.journal.sync()
+    tailer.poll()
+    new_pos = tailer.position()
+    assert new_pos["lineage"] == eng.journal.lineage > old_pos["lineage"]
+    assert new_pos == eng.journal.position()
+    assert (admitted_state_digest(tailer.engine)
+            == admitted_state_digest(eng))
+
+
+# -- canonical answers: replica == leader at the same position --
+
+def test_canonical_answer_byte_identical_after_rebuild(tmp_path):
+    path, eng = leader_journal(tmp_path, waves=((4, 0),))
+    # Oversubscribe so a pending backlog exists (quota 1000, 100 each).
+    submit_wave(eng, 12, start=4)
+    drain(eng)
+    eng.journal.sync()
+    tailer = JournalTailer(path, rebuild_every=1,
+                           rebuild_backoff_base=0.0)
+    tailer.poll()
+    assert canonical_answer(tailer.engine) == canonical_answer(eng)
+    # And the answer is genuinely position-dependent: more journal
+    # records move the leader's bytes away from the replica's frozen
+    # view until the next poll catches it up.
+    submit_wave(eng, 1, start=100)
+    drain(eng)
+    eng.journal.sync()
+    assert canonical_answer(tailer.engine) != canonical_answer(eng)
+    tailer.poll()
+    assert canonical_answer(tailer.engine) == canonical_answer(eng)
+
+
+def test_pending_answer_ignores_backoff_parking(tmp_path):
+    # Heap membership (active vs inadmissible backoff) is transient
+    # scheduler state, not journaled: the read-plane pending view must
+    # not depend on it, or replicas could never match the leader.
+    path, eng = leader_journal(tmp_path, waves=((2, 0),))
+    submit_wave(eng, 3, start=2, cpu=900)  # cannot fit: parked
+    drain(eng)
+    pcq = eng.queues.cluster_queues["cq0"]
+    assert pcq.inadmissible  # the parking lot is actually in play
+    names = [it["name"]
+             for it in answer_query(eng, "pending")["pending"]["cq0"]]
+    assert set(names) >= {"w2", "w3", "w4"}
+    pos = answer_query(eng, "position", "cq0")
+    assert [it["position_in_cluster_queue"]
+            for it in pos["items"]] == list(range(len(pos["items"])))
+
+
+# -- the replica: staleness envelopes + stamped queries --
+
+def test_replica_query_stamps_staleness_envelope(tmp_path):
+    path, eng = leader_journal(tmp_path, waves=((6, 0),))
+    replica = ReadReplica(path, replica_id="r1", rebuild_every=1)
+    replica.poll()
+    out = replica.query("quota")
+    st = out["staleness"]
+    assert st["replica"] == "r1"
+    assert st["position"] == eng.journal.position()
+    assert st["tailPosition"] == eng.journal.position()
+    assert st["lagRecords"] == 0
+    assert st["wallAgeSeconds"] >= 0.0
+    assert out["answer"]["capacity"]
+    # Same staleness scalar the SLO engine consumed.
+    assert replica.slo.reads_observed == 1
+    # Query counters live on the replica, not the rebuilt engine.
+    ctr = replica.metrics.counter("readplane_queries_total")
+    assert ctr.values[("quota", "ok")] == 1.0
+
+
+def test_replica_answers_before_first_rebuild_degrade(tmp_path):
+    path, _ = leader_journal(tmp_path)
+    replica = ReadReplica(path)
+    # No poll yet: no read model. 503-shaped, never an exception.
+    out = replica.query("pending")
+    assert out["error"] == "no read model yet"
+    assert out["staleness"] is None
+    assert replica.staleness_bound() is None
+    replica.poll()  # cold rebuild: read model online
+    bad = replica.query("nonsense")
+    assert "unknown read-query kind" in bad["error"]
+    st = replica.status()
+    assert st["enabled"] and st["queries"] == 2
+
+
+def test_replica_cid_rides_the_tail(tmp_path):
+    path, eng = leader_journal(tmp_path)
+    with open(path, "a") as f:
+        f.write(json.dumps({"kind": "cycle_trace", "op": "apply",
+                            "obj": {"name": "cid-abc"},
+                            "ts": 9.0}) + "\n")
+    replica = ReadReplica(path, rebuild_every=1)
+    replica.poll()
+    assert replica.staleness()["cid"] == "cid-abc"
+
+
+def test_replica_explain_matches_leader(tmp_path):
+    path, eng = leader_journal(tmp_path, waves=((4, 0),))
+    submit_wave(eng, 12, start=4)
+    drain(eng)
+    eng.journal.sync()
+    replica = ReadReplica(path, rebuild_every=1)
+    replica.poll()
+    key = sorted(eng.workloads)[0]
+    assert (replica.query("explain", key)["answer"]
+            == answer_query(eng, "explain", key))
+
+
+# -- the front end: freshest-first routing, degradation --
+
+def _fake_fleet(ages):
+    """{base: wall_age_or_None_or_'dead'} -> injectable fetch."""
+    def fetch(url, timeout):
+        base, _, path = url.partition("/debug/")
+        if not path:
+            base = url.rsplit("/read/", 1)[0]
+        state = ages[base]
+        if state == "dead":
+            raise OSError("connection refused")
+        if url.endswith("/debug/readplane"):
+            st = None if state is None else {"wallAgeSeconds": state}
+            return {"enabled": True, "staleness": st}
+        return {"kind": "quota", "answer": {"capacity": []},
+                "staleness": {"wallAgeSeconds": state}, "base": base}
+    return fetch
+
+
+def test_frontend_routes_to_freshest_replica():
+    ages = {"http://a": 3.0, "http://b": 0.5}
+    fe = ReadFrontend(["http://a", "http://b"],
+                      fetch=_fake_fleet(ages))
+    out = fe.query("quota")
+    assert out["routedTo"] == "http://b"
+    ranked = fe.status()["ranked"]
+    assert [r["base"] for r in ranked] == ["http://b", "http://a"]
+
+
+def test_frontend_degrades_past_dead_replica():
+    ages = {"http://a": 0.1, "http://b": 2.0}
+    calls = {"n": 0}
+    inner = _fake_fleet(ages)
+
+    def fetch(url, timeout):
+        # The freshest replica dies AFTER the probe ranked it first.
+        if url.startswith("http://a/read/"):
+            raise OSError("connection reset")
+        return inner(url, timeout)
+
+    from kueue_tpu.metrics.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    fe = ReadFrontend(["http://a", "http://b"], metrics=reg,
+                      fetch=fetch)
+    out = fe.query("quota")
+    assert out["routedTo"] == "http://b"
+    ctr = reg.counter("readplane_frontend_routes_total")
+    assert ctr.values[("http://a", "unreachable")] == 1.0
+    assert ctr.values[("http://b", "degraded")] == 1.0
+
+
+def test_frontend_raises_only_when_all_dead():
+    import pytest
+
+    fe = ReadFrontend(["http://a"],
+                      fetch=_fake_fleet({"http://a": "dead"}))
+    with pytest.raises(RuntimeError, match="no live replica"):
+        fe.query("pending")
+
+
+def test_frontend_replica_without_model_ranks_last_but_routable():
+    ages = {"http://a": None, "http://b": "dead"}
+    fe = ReadFrontend(["http://a", "http://b"],
+                      fetch=_fake_fleet(ages))
+    out = fe.query("quota")  # stale beats nothing: still answered
+    assert out["routedTo"] == "http://a"
+
+
+# -- read SLOs --
+
+def test_read_slo_none_staleness_is_a_violation():
+    slo = ReadSLOEngine()
+    for _ in range(10):
+        slo.observe_read(0.001, None)  # unboundable staleness
+    ev = slo.evaluate()["read_staleness_bound"]
+    assert ev["status"] > 0  # burning
+    ok = ReadSLOEngine()
+    for _ in range(10):
+        ok.observe_read(0.001, 0.2)
+    assert ok.evaluate()["read_staleness_bound"]["status"] == 0
+    assert ok.worst()[0] == 0
+
+
+# -- kueuectl explain provenance (satellite: rebuilt != live) --
+
+def test_explain_on_rebuilt_engine_stamps_journal_position(tmp_path):
+    from kueue_tpu.cli.kueuectl import run
+
+    path, eng = leader_journal(tmp_path, waves=((2, 0),))
+    submit_wave(eng, 12, start=2)
+    drain(eng)
+    eng.journal.sync()
+    pos = eng.journal.position()
+    rebuilt = rebuild_engine(path)
+    pending = sorted(k for k, w in rebuilt.workloads.items()
+                     if w.status.admission is None)
+    name = pending[0].split("/", 1)[1]
+    text = run(rebuilt, ["explain", name])
+    assert "Source:        journal rebuild @" in text
+    assert f"lineage {pos['lineage']} seg {pos['segment']}" in text
+    raw = json.loads(run(rebuilt, ["explain", name, "--json"]))
+    assert raw["rebuild"]["position"] == pos
+    assert raw["rebuild"]["staleness_s"] >= 0.0
+    # A LIVE engine must not carry the stamp — the distinction is the
+    # whole point.
+    live_text = run(eng, ["explain", name])
+    assert "journal rebuild" not in live_text
+
+
+# -- HTTP: /read/*, /debug/readplane, write rejection, leader proof --
+
+def test_http_read_surface_and_write_rejection(tmp_path):
+    import urllib.error
+    import urllib.request
+
+    from kueue_tpu.visibility.http_server import ServingEndpoint
+
+    path, eng = leader_journal(tmp_path, waves=((4, 0),))
+    submit_wave(eng, 12, start=4)
+    drain(eng)
+    eng.journal.sync()
+    replica = ReadReplica(path, replica_id="rp", rebuild_every=1)
+    replica.poll()
+    ep = ServingEndpoint(lambda: replica.engine, port=0,
+                         hub=replica.hub, readplane=replica)
+    ep.start()
+    try:
+        base = f"http://127.0.0.1:{ep.port}"
+
+        def get(p):
+            with urllib.request.urlopen(base + p, timeout=10) as r:
+                return r.headers.get("Content-Type", ""), r.read()
+
+        _, body = get("/read/quota")
+        out = json.loads(body)
+        assert out["kind"] == "quota"
+        assert out["staleness"]["replica"] == "rp"
+        _, body = get("/read/position/cq0")
+        assert json.loads(body)["answer"]["clusterQueue"] == "cq0"
+        _, body = get("/debug/readplane")
+        st = json.loads(body)
+        assert st["enabled"] and st["replica"] == "rp"
+        # Replica /metrics serves the REPLICA registry (stable across
+        # rebuilds), carrying the readplane_* families.
+        ct, body = get("/metrics")
+        assert ct.startswith("text/plain")
+        text = body.decode()
+        assert "kueue_tpu_readplane_queries_total" in text
+        assert "kueue_tpu_visibility_queries_total" in text
+        # Writes are structurally rejected before parsing.
+        req = urllib.request.Request(
+            base + "/workloads", data=b"{}", method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("POST must be rejected on a replica")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+    finally:
+        ep.stop()
+
+
+def test_leader_counts_read_queries_for_zero_read_proof(tmp_path):
+    import urllib.request
+
+    from kueue_tpu.visibility.http_server import ServingEndpoint
+
+    path, eng = leader_journal(tmp_path)
+    ep = ServingEndpoint(eng, port=0)
+    ep.start()
+    try:
+        base = f"http://127.0.0.1:{ep.port}"
+        for p in ("/clusterqueues", "/capacity"):
+            urllib.request.urlopen(base + p, timeout=10).read()
+        # Infra routes (scrapes, probes) are NOT read queries.
+        urllib.request.urlopen(base + "/healthz", timeout=10).read()
+        with urllib.request.urlopen(base + "/metrics",
+                                    timeout=10) as r:
+            text = r.read().decode()
+    finally:
+        ep.stop()
+    ctr = eng.registry.counter("visibility_queries_total")
+    assert ctr.values[("clusterqueues",)] == 1.0
+    assert ctr.values[("capacity",)] == 1.0
+    assert not any("healthz" in k or "metrics" in k
+                   for (k,) in ctr.values)
+    assert 'kueue_tpu_visibility_queries_total{label_0="capacity"} 1' \
+        in text

@@ -43,6 +43,7 @@ def test_bearer_token_auth(tmp_path):
 
 
 def test_tls_serving_with_generated_cert(tmp_path):
+    pytest.importorskip("cryptography")  # cert generation needs it
     eng = Engine()
     cert_dir = str(tmp_path / "certs")
     ep = ServingEndpoint(eng, cert_dir=cert_dir)
@@ -64,6 +65,7 @@ def test_tls_serving_with_generated_cert(tmp_path):
 
 
 def test_tls_plus_token(tmp_path):
+    pytest.importorskip("cryptography")  # cert generation needs it
     eng = Engine()
     cert_dir = str(tmp_path / "certs")
     ep = ServingEndpoint(eng, cert_dir=cert_dir, auth_token="tok")

@@ -65,7 +65,12 @@ MIN_DROP_OVERRIDES = {"traffic_storm": 0.30,
                       # single multi-day storm run — wall-clock
                       # throughput with one sample per round, so give
                       # it the same widened noise floor as the storm.
-                      "sim_week": 0.30}
+                      "sim_week": 0.30,
+                      # read_qps is serial HTTP round-trips against
+                      # subprocess replicas (scheduler + loopback
+                      # noise dominates the per-read cost), gated in
+                      # the default higher-is-better direction.
+                      "read_qps": 0.30}
 
 _VAL_RE = re.compile(r"^\s*([-+0-9.eE]+)\s+(.*)\(vs\b")
 _FRAG_RE = re.compile(

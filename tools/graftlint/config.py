@@ -57,6 +57,14 @@ DEFAULT_ZONES: tuple = (
     # of it. Its journal kind (ha_digest) is registered exhaustively
     # for R1 via store.journal.EPHEMERAL_KINDS.
     ("kueue_tpu/ha/", frozenset({"J1"})),
+    # Read plane: same posture as ha/ — tail pacing, staleness
+    # envelopes, and probe TTLs are inherently wall-clock, so D1 must
+    # NOT apply. O1 must not apply either: a read replica OWNS its
+    # rebuilt engine (it is the read model, not telemetry bolted onto
+    # someone else's engine); the zero-mutation guarantee is enforced
+    # structurally instead (POST is rejected at the HTTP layer and
+    # every rebuild discards the old engine wholesale).
+    ("kueue_tpu/readplane/", frozenset({"J1"})),
     # Federation dispatcher: same posture as ha/ plus the undo-log
     # discipline. D1 must NOT apply — health probing, decorrelated
     # probe jitter, and handoff latency are inherently wall-clock.

@@ -1,0 +1,365 @@
+#!/usr/bin/env python
+"""readplane-smoke: the end-to-end read-plane check behind
+``make readplane-smoke``.
+
+One leader (plain ``serve``) and TWO stateless read replicas
+(``serve --read-replica``) share one journal. A submit storm POSTs to
+the leader while every read goes through the ReadFrontend — which
+knows only the replica endpoints, so the leader is structurally
+unreachable for reads. Mid-storm the leader is SIGKILLed.
+
+Assertions:
+
+  * every frontend answer carries a staleness envelope whose wall age
+    stays within STALENESS_BOUND_S, before AND after the kill;
+  * the replicas keep answering after the leader is gone (their
+    answers just age, and say so);
+  * the SSE watch stream served from a replica's own tail sees events
+    during the storm and the connection survives the failover;
+  * the leader served ZERO read queries: its /metrics exposition has
+    no visibility_queries_total samples (the journal-independent
+    proof; scrapes and probes are infra routes and don't count);
+  * once the tails drain, both replicas and a cold local rebuild
+    agree on the answer at the same journal position — the
+    replica-vs-leader byte-identity spot check between real processes;
+  * each replica's /metrics passes the promcheck exposition parser
+    and carries the readplane_* families.
+
+Exits non-zero on the first divergence.
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+N_SEED = 40             # journaled pending before anyone boots
+N_STORM = 24            # POSTed to the leader during the storm
+STALENESS_BOUND_S = 10.0
+TICK = 0.02
+SETTLE_TIMEOUT = 45.0
+
+
+def scenario():
+    from kueue_tpu.bench.scenario import baseline_like
+    return baseline_like(n_cohorts=2, cqs_per_cohort=2,
+                         n_workloads=N_SEED + N_STORM,
+                         nominal_per_cq=2_000_000, sized_to_fit=True)
+
+
+def seed_journal(path: str) -> None:
+    from kueue_tpu.controllers.engine import Engine
+    from kueue_tpu.store.journal import attach_new_journal
+
+    eng = Engine()
+    scen = scenario()
+    attach_new_journal(eng, path)
+    for rf in scen.flavors:
+        eng.create_resource_flavor(rf)
+    for co in scen.cohorts:
+        eng.create_cohort(co)
+    for cq in scen.cluster_queues:
+        eng.create_cluster_queue(cq)
+    for lq in scen.local_queues:
+        eng.create_local_queue(lq)
+    for wl in scen.workloads[:N_SEED]:
+        eng.clock += 0.001
+        eng.submit(wl)
+    eng.journal.sync()
+    eng.journal.close()
+
+
+def spawn(cmd_extra, logf):
+    cmd = [sys.executable, "-m", "kueue_tpu.serve",
+           "--oracle", "off", "--http", "127.0.0.1:0",
+           "--tick", str(TICK)] + cmd_extra
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    return subprocess.Popen(cmd, stdout=logf, stderr=subprocess.STDOUT,
+                            env=env, cwd=ROOT)
+
+
+def wait_for_line(log_path: str, needle: str, proc,
+                  timeout: float = 30.0) -> str:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(log_path) as f:
+                for line in f:
+                    if needle in line:
+                        return line.strip()
+        except FileNotFoundError:
+            pass
+        if proc.poll() is not None \
+                and needle not in open(log_path).read():
+            raise SystemExit(
+                f"FAIL: process exited (rc={proc.returncode}) before "
+                f"printing {needle!r}; log:\n{open(log_path).read()}")
+        time.sleep(0.05)
+    raise SystemExit(f"FAIL: timeout waiting for {needle!r} in "
+                     f"{log_path}:\n{open(log_path).read()}")
+
+
+def port_of(log_path: str, proc) -> int:
+    line = wait_for_line(log_path, "serving on", proc)
+    return int(line.split("serving on", 1)[1].split("(", 1)[0]
+               .strip().rsplit(":", 1)[1])
+
+
+def get_json(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def post_workload(port: int, wl) -> int:
+    from kueue_tpu.api.serde import to_jsonable
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/workloads",
+        data=json.dumps(to_jsonable(wl)).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+class SSEWatch:
+    """One raw /events connection: counts data frames, detects EOF."""
+
+    def __init__(self, port: int):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=5)
+        self.sock.sendall(b"GET /events HTTP/1.1\r\n"
+                          b"Host: 127.0.0.1\r\nAccept: text/event-stream"
+                          b"\r\n\r\n")
+        self.frames = 0
+        self.closed = False
+        self._buf = b""
+
+    def pump(self, seconds: float) -> None:
+        """Read whatever arrives within ``seconds``; a timeout means a
+        quiet-but-open stream, an empty read means the server closed."""
+        deadline = time.monotonic() + seconds
+        self.sock.settimeout(0.25)
+        while time.monotonic() < deadline and not self.closed:
+            try:
+                chunk = self.sock.recv(65536)
+            except socket.timeout:
+                continue
+            if not chunk:
+                self.closed = True
+                return
+            self._buf += chunk
+            self.frames += self._buf.count(b"data:")
+            self._buf = self._buf[-64:]  # keep a split-frame tail only
+
+
+def check_read(fe, replica_bases, killed: bool) -> None:
+    for kind in ("quota", "pending"):
+        out = fe.query(kind)
+        if out.get("error"):
+            raise SystemExit(f"FAIL: read {kind!r} errored "
+                             f"(killed={killed}): {out}")
+        st = out.get("staleness") or {}
+        age = st.get("wallAgeSeconds")
+        if age is None or age > STALENESS_BOUND_S:
+            raise SystemExit(
+                f"FAIL: staleness bound busted (killed={killed}): "
+                f"age={age} > {STALENESS_BOUND_S}: {st}")
+        if out.get("routedTo") not in replica_bases:
+            raise SystemExit(
+                f"FAIL: read routed outside the replica fleet: "
+                f"{out.get('routedTo')}")
+
+
+def wait_ready(base: str) -> None:
+    deadline = time.monotonic() + SETTLE_TIMEOUT
+    while time.monotonic() < deadline:
+        st = get_json(base + "/debug/readplane")
+        if st.get("staleness") is not None:
+            return
+        time.sleep(0.1)
+    raise SystemExit(f"FAIL: {base} never built a read model")
+
+
+def wait_drained(bases, path: str) -> dict:
+    """Both replicas caught up to the (now quiescent) journal: equal
+    positions, zero lag. Returns the common position."""
+    deadline = time.monotonic() + SETTLE_TIMEOUT
+    while time.monotonic() < deadline:
+        sts = [get_json(b + "/debug/readplane") for b in bases]
+        envs = [s.get("staleness") or {} for s in sts]
+        positions = [e.get("position") for e in envs]
+        if (all(p is not None for p in positions)
+                and positions.count(positions[0]) == len(positions)
+                and all(e.get("lagRecords") == 0 for e in envs)):
+            return positions[0]
+        time.sleep(0.2)
+    raise SystemExit(f"FAIL: replicas never converged: {sts}")
+
+
+def main() -> int:
+    from kueue_tpu.readplane import ReadFrontend, answer_query
+    from promcheck import check_exposition
+
+    workdir = tempfile.mkdtemp(prefix="readplane-smoke-")
+    journal = os.path.join(workdir, "journal.jsonl")
+    seed_journal(journal)
+
+    logs = {n: os.path.join(workdir, f"{n}.log")
+            for n in ("leader", "ra", "rb")}
+    with open(logs["leader"], "w") as lf:
+        leader = spawn(["--journal", journal,
+                        "--segment-records", "200"], lf)
+    procs = [leader]
+    try:
+        lport = port_of(logs["leader"], leader)
+        replicas = []
+        for ident, log in (("ra", logs["ra"]), ("rb", logs["rb"])):
+            with open(log, "w") as f:
+                p = spawn(["--read-replica", "--journal", journal,
+                           "--replica-id", ident], f)
+            procs.append(p)
+            wait_for_line(log, "read replica serving on", p)
+            replicas.append((ident, log, p))
+        bases = [f"http://127.0.0.1:{port_of(log, p)}"
+                 for _, log, p in replicas]
+        for b in bases:
+            wait_ready(b)
+        print(f"readplane-smoke: leader :{lport}, replicas "
+              f"{[b.rsplit(':', 1)[1] for b in bases]}")
+
+        fe = ReadFrontend(bases, timeout=5.0)
+        watch = SSEWatch(int(bases[0].rsplit(":", 1)[1]))
+        storm = scenario().workloads[N_SEED:]
+
+        # -- storm, half before the kill --
+        half = len(storm) // 2
+        for i, wl in enumerate(storm[:half]):
+            code = post_workload(lport, wl)
+            if code != 201:
+                raise SystemExit(f"FAIL: POST #{i} -> {code}")
+            if i % 4 == 0:
+                check_read(fe, bases, killed=False)
+        watch.pump(0.5)
+        frames_before = watch.frames
+        if frames_before == 0:
+            raise SystemExit("FAIL: no SSE frames reached the "
+                             "replica-served watch during the storm")
+
+        # Zero-leader-reads proof, from the leader's own mouth, while
+        # it is still alive to testify.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{lport}/metrics", timeout=5) as r:
+            leader_metrics = r.read().decode()
+        served = [ln for ln in leader_metrics.splitlines()
+                  if ln.startswith("kueue_tpu_visibility_queries_total")]
+        if served:
+            raise SystemExit(
+                "FAIL: the leader served read queries:\n"
+                + "\n".join(served))
+
+        # -- SIGKILL the leader mid-storm --
+        leader.send_signal(signal.SIGKILL)
+        leader.wait(timeout=15)
+        print("readplane-smoke: leader SIGKILLed mid-storm")
+        for wl in storm[half:half + 2]:
+            if post_workload_safe(lport, wl):
+                raise SystemExit("FAIL: dead leader accepted a POST")
+
+        # Reads keep flowing from the surviving replicas, stamped.
+        for _ in range(5):
+            check_read(fe, bases, killed=True)
+            time.sleep(0.1)
+        watch.pump(1.0)
+        if watch.closed:
+            raise SystemExit("FAIL: replica watch stream died with "
+                             "the leader")
+
+        # -- convergence + byte-identity at the common position --
+        pos = wait_drained(bases, journal)
+        answers = []
+        for b in bases:
+            out = {k: get_json(b + f"/read/{k}")["answer"]
+                   for k in ("pending", "quota")}
+            answers.append(json.dumps(out, sort_keys=True))
+        if answers[0] != answers[1]:
+            raise SystemExit("FAIL: replicas disagree at the same "
+                             f"position {pos}")
+        # Cold local rebuild of a COPY (repair must not perturb the
+        # file the live tails are following).
+        from kueue_tpu.store.journal import rebuild_engine
+        cold = os.path.join(workdir, "cold.jsonl")
+        shutil.copy(journal, cold)
+        for seg in os.listdir(os.path.dirname(journal)):
+            if seg.startswith(os.path.basename(journal) + ".seg."):
+                shutil.copy(os.path.join(workdir, seg),
+                            os.path.join(workdir, seg.replace(
+                                "journal.jsonl", "cold.jsonl")))
+        reb = rebuild_engine(cold)
+        local = json.dumps({k: answer_query(reb, k)
+                            for k in ("pending", "quota")},
+                           sort_keys=True)
+        if answers[0] != local:
+            i = next((j for j in range(min(len(answers[0]), len(local)))
+                      if answers[0][j] != local[j]),
+                     min(len(answers[0]), len(local)))
+            raise SystemExit(
+                "FAIL: replica answer != cold rebuild at position "
+                f"{pos}\nreplica: ...{answers[0][max(0, i - 80):i + 160]}"
+                f"\ncold:    ...{local[max(0, i - 80):i + 160]}")
+        print(f"readplane-smoke: both replicas byte-identical to cold "
+              f"rebuild at {pos}; {watch.frames} SSE frames; "
+              f"watch stream live across failover")
+
+        # -- replica metrics: valid exposition, readplane_* families --
+        for b in bases:
+            with urllib.request.urlopen(b + "/metrics", timeout=5) as r:
+                text = r.read().decode()
+            errs = check_exposition(text)
+            if errs:
+                raise SystemExit(f"FAIL: replica exposition invalid: "
+                                 f"{errs[:5]}")
+            for fam in ("kueue_tpu_readplane_queries_total",
+                        "kueue_tpu_readplane_staleness_seconds",
+                        "kueue_tpu_readplane_replay_lag_records"):
+                if fam not in text:
+                    raise SystemExit(f"FAIL: {fam} missing from {b}")
+        st = get_json(bases[0] + "/debug/readplane")
+        slo = st.get("readSlo", {}).get("objectives", {})
+        if "read_staleness_bound" not in slo:
+            raise SystemExit(f"FAIL: read SLOs missing: {slo}")
+        print("readplane-smoke: PASS — zero leader reads, staleness "
+              f"bound {STALENESS_BOUND_S}s held across leader SIGKILL, "
+              "replica answers byte-identical at equal positions")
+        shutil.rmtree(workdir, ignore_errors=True)
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+def post_workload_safe(port: int, wl) -> bool:
+    """True only if a POST to a supposedly-dead endpoint SUCCEEDED."""
+    try:
+        return post_workload(port, wl) == 201
+    except OSError:
+        return False
+
+
+if __name__ == "__main__":
+    sys.exit(main())
