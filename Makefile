@@ -9,7 +9,7 @@ PYTEST_FLAGS ?= -q
   replay-smoke obs-smoke tas-smoke perf-smoke apply-smoke ha-smoke \
   chaos-smoke federation-smoke overload-smoke sim-smoke \
   readplane-smoke smoke \
-  bench-gate lint clean
+  bench-gate lint lint-sanitize clean
 
 all: native
 
@@ -50,6 +50,15 @@ bench-fast:
 # One entry point, one exit code, one JSON report (--json FILE).
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m tools.graftlint kueue_tpu/ --self-check
+
+# Runtime sanitizer (dynamic D1 + F1): sim triples replayed across
+# PYTHONHASHSEED values must keep identical decision digests, and an
+# instrumented federation run must never fire an effect (handoff,
+# revoke, SSE publish) while the route journal has unsynced appends.
+# --self-test also arms both planted regressions (shuffle, fsync-drop)
+# in subprocesses and requires each to FAIL with the violation named.
+lint-sanitize: lint
+	JAX_PLATFORMS=cpu $(PY) -m tools.graftlint.sanitize --self-test
 
 # Flight-recorder determinism smoke: record a 50-workload scenario,
 # replay it twice, diff the decision-stream checksums (replay/).
@@ -171,9 +180,9 @@ bench-gate:
 # The full CI smoke chain: every subsystem smoke, ending on the bench
 # regression gate so a perf regression fails the same entry point as a
 # correctness one.
-smoke: replay-smoke tas-smoke obs-smoke perf-smoke apply-smoke \
-  ha-smoke chaos-smoke federation-smoke overload-smoke sim-smoke \
-  readplane-smoke bench-gate
+smoke: lint-sanitize replay-smoke tas-smoke obs-smoke perf-smoke \
+  apply-smoke ha-smoke chaos-smoke federation-smoke overload-smoke \
+  sim-smoke readplane-smoke bench-gate
 
 # Validate the multi-chip sharding compiles + executes on a virtual mesh.
 multichip-dryrun:

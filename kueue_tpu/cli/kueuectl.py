@@ -491,7 +491,8 @@ def build_parser() -> argparse.ArgumentParser:
         "lint",
         help="run the graftlint static analyzer (tools/graftlint) over "
              "the package; extra args pass through (--explain RULE, "
-             "--json FILE, paths)")
+             "--json FILE, --sarif FILE, --rule F1,S1, --sanitize, "
+             "paths)")
     lint.add_argument("lint_args", nargs=argparse.REMAINDER)
 
     # Parsed in main() before engine construction, like lint: the
@@ -679,7 +680,25 @@ def main(argv=None) -> None:
         import os
 
         rest = argv[1:]
-        if not any(not a.startswith("-") for a in rest):
+        # Decide whether the user gave explicit paths. Flags that take
+        # a value consume the next token, so `--rule F1,S1` does not
+        # read as a path; `--flag=value` forms carry their own value.
+        value_flags = {"--json", "--sarif", "--rule", "--baseline",
+                       "--explain", "--metrics", "--trace-json",
+                       "--root", "--write-baseline"}
+        has_paths = False
+        skip = False
+        for a in rest:
+            if skip:
+                skip = False
+                continue
+            if a.startswith("-"):
+                if a in value_flags:
+                    skip = True
+                continue
+            has_paths = True
+            break
+        if not has_paths:
             rest = [os.path.join(Config().root, "kueue_tpu"),
                     "--self-check"] + rest
         raise SystemExit(lint_main(rest))

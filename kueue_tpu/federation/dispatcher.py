@@ -180,6 +180,7 @@ class FederationDispatcher:
         if HANDOFF_CRASH_HOOK is not None:
             HANDOFF_CRASH_HOOK(self.handoffs, rec["name"])
         try:
+            # graftlint: allow[F1] at-least-once handoff of an already-durable intent: every caller journals+fsyncs the route record before invoking _handoff; the ACK can only be journaled after the RPC returns
             verdict = cell.transport.submit(rec["wl"],
                                             route_epoch=rec["epoch"])
         except CellTransportError as e:
@@ -237,6 +238,7 @@ class FederationDispatcher:
             if opened:
                 self._drain(cell, now)
             elif was_up:
+                # graftlint: allow[F1] pure health notification: probe transitions are transient cell state, never journaled — there is nothing for durability to order against
                 self._publish("federation_cell",
                               {"cell": cell.name, "up": False,
                                "reason": "probe failed"})
@@ -255,6 +257,7 @@ class FederationDispatcher:
                 return
         if not cell.up:
             cell.up = True
+            # graftlint: allow[F1] pure health notification: probe transitions are transient cell state, never journaled — there is nothing for durability to order against
             self._publish("federation_cell",
                           {"cell": cell.name, "up": True,
                            "epoch": cell.epoch})
@@ -360,6 +363,7 @@ class FederationDispatcher:
             revoke.append(key)
         if revoke:
             try:
+                # graftlint: allow[F1] reconcile revokes keys whose re-route is already journaled+fsynced (the drain fence); the zombie's tombstones are the RPC's outcome, journaled after it returns
                 cell.transport.revoke(revoke, epoch=cell.epoch)
             except CellTransportError:
                 return False

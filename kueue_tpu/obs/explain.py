@@ -22,7 +22,8 @@ from __future__ import annotations
 from typing import Optional
 
 
-def explain_workload(engine, key: str, probe: bool = True) -> dict:
+def explain_workload(engine, key: str, probe: bool = True,
+                     now: Optional[float] = None) -> dict:
     report: dict = {"workload": key, "found": False}
     wl = engine.workloads.get(key)
     if wl is None:
@@ -43,7 +44,7 @@ def explain_workload(engine, key: str, probe: bool = True) -> dict:
                 "cid": cycle.attrs["cid"], "seq": cycle.attrs["seq"],
                 "mode": cycle.attrs["mode"], "clock": cycle.attrs["clock"],
                 **span.attrs}
-    rebuild = _rebuild_stamp(engine)
+    rebuild = _rebuild_stamp(engine, now)
     if rebuild is not None:
         report["rebuild"] = rebuild
     if probe and report["status"] == "pending":
@@ -51,22 +52,28 @@ def explain_workload(engine, key: str, probe: bool = True) -> dict:
     return report
 
 
-def _rebuild_stamp(engine) -> Optional[dict]:
+def _rebuild_stamp(engine,
+                   now: Optional[float] = None) -> Optional[dict]:
     """Provenance of a journal-rebuilt engine: the position recovery
     replayed to and how stale that state is now. None for a live
     engine — the distinction the report must never blur (a rebuilt
     engine presenting as live answers "why is my workload pending"
-    from a past world)."""
+    from a past world). ``now`` is the injectable clock seam: callers
+    under virtual time pass their clock's reading; the read plane
+    strips the whole stamp (explain_answer pops "rebuild")."""
     pos = getattr(engine, "rebuild_position", None)
     if pos is None:
         return None
     out = {"position": pos}
     wall = getattr(engine, "rebuild_wall", None)
     if wall is not None:
-        import time
+        if now is None:
+            import time
 
+            # graftlint: allow[C1] display-only staleness stamp behind the now= seam; sim/readplane callers inject now or strip the field
+            now = time.time()
         out["wall"] = wall
-        out["staleness_s"] = round(max(0.0, time.time() - wall), 3)
+        out["staleness_s"] = round(max(0.0, now - wall), 3)
     return out
 
 

@@ -68,6 +68,7 @@ def begin() -> Optional[float]:
     (one global load + identity check on the hot path)."""
     if ACTIVE is None:
         return None
+    # graftlint: allow[D1] digest-neutral phase timing; samples flow only to the write-only obs registry (O1), never into decisions
     return time.perf_counter()
 
 
@@ -76,6 +77,7 @@ def end(name: str, t0: Optional[float]) -> None:
     off or the scope was opened while it was off."""
     rec = ACTIVE
     if rec is not None and t0 is not None:
+        # graftlint: allow[D1] digest-neutral phase timing; samples flow only to the write-only obs registry (O1), never into decisions
         rec._samples.append((name, time.perf_counter() - t0))
 
 

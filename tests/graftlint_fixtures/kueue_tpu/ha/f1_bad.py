@@ -1,0 +1,19 @@
+"""Planted F1 violations (durability zone). Test data, never run."""
+
+
+class Router:
+    def announce_then_sync(self, wl, rec):
+        self.hub.publish("accepted", wl.key)
+        self.journal.apply("workload", rec)
+        self.journal.sync()
+
+    def handoff_then_sync(self, wl):
+        self.transport.submit(wl, route_epoch=2)
+        self.journal.sync()
+
+    def _notify(self, key):
+        self.hub.publish("routed", key)
+
+    def helper_then_sync(self, wl, rec):
+        self._notify(wl.key)
+        self.journal.apply("workload", rec)

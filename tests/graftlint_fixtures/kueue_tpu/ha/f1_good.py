@@ -1,0 +1,30 @@
+"""The sanctioned F1 orderings: durable first, effect-only rejection
+arms, and pure notification paths that never reach a durability point.
+Test data, never run."""
+
+
+class Router:
+    def sync_then_announce(self, wl, rec):
+        self.journal.apply("workload", rec)
+        self.journal.sync()
+        self.hub.publish("accepted", wl.key)
+
+    def reject_arm_is_dead(self, wl):
+        if wl.quota_exceeded:
+            self.hub.publish("rejected", wl.key)
+            return None
+        self.journal.apply("workload", self.rec(wl))
+        self.journal.sync()
+        return wl.key
+
+    def _notify_durable(self, wl, rec):
+        self.journal.apply("workload", rec)
+        self.journal.sync()
+        self.hub.publish("routed", wl.key)
+
+    def helper_is_self_durable(self, wl, rec):
+        self._notify_durable(wl, rec)
+        self.journal.sync()
+
+    def probe_note(self, cell):
+        self.hub.publish("probe", cell.name)

@@ -1,0 +1,12 @@
+"""D1 zone entries whose hazards live across the module boundary: the
+helper's module never had the zone bit, so only the whole-program pass
+can see the chains. Test data, never run."""
+from kueue_tpu.util.impure_helper import first_of, jittered_deadline
+
+
+def pick_deadline(base):
+    return jittered_deadline(base)
+
+
+def pick_first(names):
+    return first_of(names)

@@ -1,0 +1,14 @@
+"""Planted S1 violations (pjit cut-over worklist). Test data."""
+import jax.numpy as jnp
+
+
+class Planner:
+    def encode_all(self, world):
+        for i in range(self.num_rows()):
+            self.encode_row(i, world)
+
+    def admit_mask(self, usage, quota):
+        mask = jnp.greater(usage, quota)
+        if mask.any():
+            return self.spill(mask)
+        return None

@@ -1,0 +1,16 @@
+"""Sanctioned device idioms: vectorized ops over the row axis,
+identity (cache-presence) branches, bounded non-row loops. Test data."""
+import jax.numpy as jnp
+
+
+class Planner:
+    def encode_all(self, world):
+        return jnp.take(world.row_tensor, self.order)
+
+    def admit_mask(self, usage, quota):
+        mask = jnp.greater(usage, quota)
+        if self._memo is None:
+            self._memo = mask
+        for attempt in range(3):
+            usage = self.step(usage)
+        return mask

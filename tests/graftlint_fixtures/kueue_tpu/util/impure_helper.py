@@ -1,0 +1,12 @@
+"""Out-of-zone helper holding the actual hazards the interprocedural
+pass must chase across the module boundary. Test data, never run."""
+import time
+
+
+def jittered_deadline(base):
+    return base + time.time() % 1.0
+
+
+def first_of(names: set):
+    for n in names:
+        return n

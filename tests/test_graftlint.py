@@ -134,6 +134,69 @@ def test_c1_zone_gating(fixture_result):
     assert "C1" not in _C().rules_for("kueue_tpu/ha/lease.py")
 
 
+def test_f1_bad_exact_locations(fixture_result):
+    assert _hits(fixture_result, "kueue_tpu/ha/f1_bad.py") == [
+        (6, "F1", "Router.announce_then_sync"),   # publish before apply
+        (11, "F1", "Router.handoff_then_sync"),   # RPC before sync
+        (18, "F1", "Router.helper_then_sync"),    # effect via helper
+    ]
+
+
+def test_f1_chain_attribution(fixture_result):
+    # The helper-mediated finding names the exposed effect, its line
+    # inside the helper, and the helper itself — the caller learns
+    # exactly which call leaked the publish.
+    (msg,) = [f.message for f in fixture_result.findings
+              if f.file == "kueue_tpu/ha/f1_bad.py" and f.line == 18]
+    assert "reaches self.hub.publish() at 15" in msg
+    assert "Router._notify" in msg
+
+
+def test_f1_good_clean(fixture_result):
+    # Durable-first ordering, effects in early-return rejection arms
+    # (no durability point ever follows on that path), self-durable
+    # helpers, and pure notification paths are all legal.
+    assert _hits(fixture_result, "kueue_tpu/ha/f1_good.py") == []
+
+
+def test_s1_bad_exact_locations(fixture_result):
+    assert _hits(fixture_result, "kueue_tpu/scheduler/s1_bad.py") == [
+        (7, "S1", "Planner.encode_all"),    # per-row host loop
+        (12, "S1", "Planner.admit_mask"),   # host branch on device arr
+    ]
+
+
+def test_s1_good_clean(fixture_result):
+    # Vectorized row ops, is-None cache branches, and bounded non-row
+    # loops are the sanctioned idioms.
+    assert _hits(fixture_result, "kueue_tpu/scheduler/s1_good.py") == []
+
+
+def test_d1_interprocedural_chain(fixture_result):
+    # The hazards live in kueue_tpu/util (no D1 zone); findings are
+    # attributed to the zone-entry call sites with the full chain.
+    assert _hits(fixture_result,
+                 "kueue_tpu/scheduler/d1_interproc.py") == [
+        (8, "D1", "pick_deadline"),    # chain to time.time()
+        (12, "D1", "pick_first"),      # chain to set iteration
+    ]
+    clock_msg, set_msg = [
+        f.message for f in fixture_result.findings
+        if f.file == "kueue_tpu/scheduler/d1_interproc.py"]
+    assert "call to time.time() at " \
+           "kueue_tpu/util/impure_helper.py:7" in clock_msg
+    assert "pick_deadline -> jittered_deadline" in clock_msg
+    assert "kueue_tpu/util/impure_helper.py:11" in set_msg
+    assert "pick_first -> first_of" in set_msg
+
+
+def test_d1_interproc_helper_not_reported_directly(fixture_result):
+    # The helper module itself is out of zone: its facts surface only
+    # through callers, never as direct findings.
+    assert _hits(fixture_result,
+                 "kueue_tpu/util/impure_helper.py") == []
+
+
 def test_r1_unhandled_journal_kind(fixture_result):
     hits = _hits(fixture_result, "kueue_tpu/engine_emit.py")
     assert hits == [(7, "R1", "persist")]  # only 'pod_group' unhandled
@@ -248,7 +311,7 @@ def test_self_check_live_emitters_are_valid():
 # -- CLI surface --
 
 def test_cli_explain_every_rule(capsys):
-    for rule in ("D1", "J1", "U1", "O1", "R1"):
+    for rule in ("D1", "J1", "U1", "O1", "R1", "F1", "S1"):
         assert cli_main(["--explain", rule]) == 0
         out = capsys.readouterr().out
         assert out.startswith(f"{rule}: ") and "Example:" in out
@@ -262,7 +325,8 @@ def test_cli_explain_unknown_rule(capsys):
 def test_cli_list_rules(capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rule in ("D1", "J1", "U1", "O1", "R1", "V1", "V2"):
+    for rule in ("D1", "J1", "U1", "O1", "R1", "F1", "S1", "V1",
+                 "V2"):
         assert rule in out
 
 
@@ -276,6 +340,77 @@ def test_cli_json_report_shape(capsys):
     f = doc["findings"][0]
     assert set(f) == {"rule", "file", "line", "col", "symbol", "message"}
     assert f["file"] == "kueue_tpu/tas/u1_bad.py" and f["line"] == 5
+
+
+def test_cli_rule_filter(capsys):
+    # Only the named rules run; everything else's findings vanish.
+    rc = cli_main([os.path.join(FIXTURES, "kueue_tpu"),
+                   "--root", FIXTURES, "--no-baseline",
+                   "--rule", "S1", "--json", "-"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc["summary"]) == {"S1"}
+    rc = cli_main([os.path.join(FIXTURES, "kueue_tpu/tas/u1_bad.py"),
+                   "--root", FIXTURES, "--no-baseline",
+                   "--rule", "F1"])
+    capsys.readouterr()
+    assert rc == 0  # U1 violations exist but F1 alone was requested
+
+
+def test_cli_rule_filter_unknown_rule(capsys):
+    assert cli_main([os.path.join(FIXTURES, "kueue_tpu"),
+                     "--root", FIXTURES, "--rule", "Z9"]) == 2
+    assert "unknown rule(s)" in capsys.readouterr().err
+
+
+def test_cli_rule_filter_skips_unrelated_staleness(tmp_path, capsys):
+    # A baseline entry for a rule OUTSIDE the --rule filter cannot be
+    # judged stale by the filtered run — only in-scope entries can.
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"version": 1, "entries": [
+        {"rule": "U1", "file": "kueue_tpu/tas/u1_bad.py",
+         "symbol": "place", "justification": "fixture grandfathering"},
+    ]}))
+    rc = cli_main([os.path.join(FIXTURES, "kueue_tpu/scheduler"),
+                   "--root", FIXTURES, "--baseline", str(bl),
+                   "--rule", "S1", "--json", "-"])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["baseline"]["stale"] == []
+    assert rc == 1  # the S1 fixtures still fire
+
+
+def test_cli_sarif_report_shape(capsys):
+    rc = cli_main([os.path.join(FIXTURES, "kueue_tpu/ha"),
+                   "--root", FIXTURES, "--no-baseline",
+                   "--sarif", "-"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    (sarif_run,) = doc["runs"]
+    rule_ids = {r["id"] for r in sarif_run["tool"]["driver"]["rules"]}
+    assert {"D1", "F1", "S1", "V1", "V2"} <= rule_ids
+    results = sarif_run["results"]
+    assert [r["ruleId"] for r in results] == ["F1", "F1", "F1"]
+    loc = results[0]["locations"][0]
+    phys = loc["physicalLocation"]
+    assert phys["artifactLocation"]["uri"] == "kueue_tpu/ha/f1_bad.py"
+    assert phys["region"]["startLine"] == 6
+    assert loc["logicalLocations"][0]["fullyQualifiedName"] == \
+        "Router.announce_then_sync"
+    assert sarif_run["invocations"][0]["executionSuccessful"] is False
+
+
+def test_cli_sarif_carries_suppressions(capsys):
+    # Pragma-suppressed findings ride along as suppressed results with
+    # kind inSource; nothing the text report shows is dropped.
+    rc = cli_main([os.path.join(FIXTURES,
+                                "kueue_tpu/scheduler/d1_pragma.py"),
+                   "--root", FIXTURES, "--no-baseline", "--sarif", "-"])
+    doc = json.loads(capsys.readouterr().out)
+    del rc
+    (sarif_run,) = doc["runs"]
+    sup = [r for r in sarif_run["results"] if "suppressions" in r]
+    assert sup and sup[0]["suppressions"][0]["kind"] == "inSource"
 
 
 def test_cli_exit_codes(capsys):

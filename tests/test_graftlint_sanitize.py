@@ -1,0 +1,38 @@
+"""The graftlint runtime sanitizer (tools/graftlint/sanitize.py) —
+the in-process fsync check: the federation scenario must drive every
+F1 effect point (handoff, revoke, SSE publish) against a durable
+journal, and the planted fsync-drop regression must be caught with
+the violation named.
+
+The hash-shuffle check (subprocess matrix over PYTHONHASHSEED) is
+exercised by ``make lint-sanitize`` / ``--self-test`` — too slow for
+the unit tier.
+"""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graftlint.sanitize import (  # noqa: E402
+    SanitizeViolation,
+    run_fsync_check,
+)
+
+
+def test_fsync_check_clean_on_real_dispatcher(capsys):
+    run_fsync_check(plant=False)
+    out = capsys.readouterr().out
+    assert "every effect point saw a durable journal" in out
+
+
+def test_fsync_check_catches_planted_fsync_drop():
+    with pytest.raises(SanitizeViolation) as exc:
+        run_fsync_check(plant=True)
+    msg = str(exc.value)
+    assert "F1 runtime violation" in msg
+    assert "not yet fsynced" in msg

@@ -67,7 +67,13 @@ def apply(result: RunResult, path: Optional[str]) -> dict:
         else:
             kept.append(f)
     result.findings = kept
-    stale = [e for k, e in index.items() if k not in matched]
+    # Staleness is only decidable for entries that were in scope this
+    # run: a --rule filter or a partial path list legitimately leaves
+    # other entries unmatched without making them stale.
+    stale = [e for k, e in index.items()
+             if k not in matched
+             and (result.only_rules is None or k[0] in result.only_rules)
+             and (not result.relpaths or k[1] in result.relpaths)]
     for e in stale:
         result.errors.append(
             f"baseline entry is stale (no longer matches anything): "
@@ -79,21 +85,36 @@ def apply(result: RunResult, path: Optional[str]) -> dict:
 
 
 def write(findings: list[Finding], path: str) -> None:
-    """--write-baseline: emit the current finding set as a baseline
-    skeleton. Justifications are intentionally TODO so a human must
-    fill each in — an unjustified entry fails load()."""
-    entries = []
-    seen: set = set()
+    """--write-baseline: emit the current finding set as a baseline.
+
+    Deterministic and merge-aware: entries are sorted by (rule, file,
+    symbol) so two runs over the same tree produce byte-identical
+    output, justifications already present in the target file are
+    carried over for keys that still match, and keys that no longer
+    fire are pruned (the stale-entry check would fail lint on them
+    anyway). New entries get a TODO justification a human must fill
+    in — an unjustified entry fails load()."""
+    existing: dict[tuple, str] = {}
+    if os.path.exists(path):
+        try:
+            for e in load(path):
+                existing[(e["rule"], e["file"], e["symbol"])] = \
+                    str(e["justification"])
+        except (BaselineError, json.JSONDecodeError, OSError):
+            pass   # unreadable target: emit a fresh skeleton
+    by_key: dict[tuple, Finding] = {}
     for f in findings:
-        if f.key() in seen:
-            continue
-        seen.add(f.key())
+        by_key.setdefault(f.key(), f)
+    entries = []
+    for key in sorted(by_key):
+        f = by_key[key]
+        justification = existing.get(key, "TODO: justify or fix")
         entries.append({
             "rule": f.rule, "file": f.file, "symbol": f.symbol,
             "message": f.message,
-            "justification": "TODO: justify or fix",
+            "justification": justification,
         })
     with open(path, "w", encoding="utf-8") as fh:
         json.dump({"version": BASELINE_VERSION, "entries": entries},
-                  fh, indent=2)
+                  fh, indent=2, sort_keys=True)
         fh.write("\n")

@@ -1,0 +1,245 @@
+"""graftlint whole-program layer: symbol table + call graph.
+
+One parse pass over the analyzed tree (core.load_modules, shared AST
+cache) feeds a project-wide index:
+
+  * every function/method definition, keyed by a file-qualified id
+    ``relpath::qualname`` (FunctionInfo);
+  * a best-effort, *conservative* call graph: a call site resolves to
+    a project function only when the evidence is unambiguous —
+    a module-level name defined in the same file, a ``from``-import /
+    module-attribute path that lands on a known module's function, or
+    ``self.method()`` against the enclosing class. Anything else
+    (duck-typed receivers, callbacks, builtins) is left unresolved and
+    simply not traversed: the interprocedural rules prefer missing an
+    edge to inventing one.
+
+The graph is what lets a zone rule judge a function by everything
+reachable from it (summaries.py) instead of by its own body alone.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tools.graftlint.core import (
+    Module,
+    dotted,
+    import_aliases,
+    own_nodes,
+    qualname_index,
+)
+
+
+@dataclass(frozen=True)
+class CallSite:
+    """One resolved project-internal call edge."""
+
+    callee: str     # FunctionInfo id ("relpath::qualname")
+    line: int
+    col: int
+    text: str       # the call head as written ("helpers.jitter")
+
+
+@dataclass
+class FunctionInfo:
+    fid: str                      # "relpath::qualname"
+    qualname: str                 # dotted qualname within the module
+    module: Module
+    node: ast.AST                 # FunctionDef / AsyncFunctionDef
+    class_name: str = ""          # enclosing class ("" for free fns)
+    _project: object = None       # back-ref for lazy call linking
+    _calls: Optional[list] = None
+
+    @property
+    def calls(self) -> list:
+        """Resolved call sites, linked lazily per function: only zone
+        entries and functions actually reached from one ever pay for
+        edge resolution (most of the tree is neither)."""
+        if self._calls is None:
+            self._project._link_function(self)
+        return self._calls
+
+    @property
+    def relpath(self) -> str:
+        return self.module.relpath
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+def module_dotted(relpath: str) -> str:
+    """'kueue_tpu/tas/batched.py' -> 'kueue_tpu.tas.batched';
+    package __init__ files resolve to the package path."""
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+class _LazyFunctionTable(dict):
+    """fid -> FunctionInfo, indexing a module on first miss for one of
+    its fids. The fid format carries the module ("relpath::qualname"),
+    so a lookup is all the trigger lazy symbol-table construction
+    needs — modules nobody reaches are never indexed."""
+
+    def __init__(self, project: "Project"):
+        super().__init__()
+        self._project = project
+
+    def _demand(self, fid) -> None:
+        if isinstance(fid, str) and "::" in fid:
+            self._project._ensure_indexed(fid.split("::", 1)[0])
+
+    def get(self, fid, default=None):
+        if not super().__contains__(fid):
+            self._demand(fid)
+        return super().get(fid, default)
+
+    def __getitem__(self, fid):
+        if not super().__contains__(fid):
+            self._demand(fid)
+        return super().__getitem__(fid)
+
+    def __contains__(self, fid) -> bool:
+        if not super().__contains__(fid):
+            self._demand(fid)
+        return super().__contains__(fid)
+
+
+class Project:
+    """The whole-program index shared by every interprocedural rule."""
+
+    def __init__(self, modules: list):
+        self.modules: list[Module] = modules
+        self.by_rel: dict[str, Module] = {m.relpath: m for m in modules}
+        # dotted module path -> Module
+        self.by_dotted: dict[str, Module] = {
+            module_dotted(m.relpath): m for m in modules}
+        self.functions: dict[str, FunctionInfo] = \
+            _LazyFunctionTable(self)
+        # per-module: qualname -> fid (for local resolution)
+        self._locals: dict[str, dict[str, str]] = {}
+        # per-module: class qualname -> {method name -> fid}
+        self._methods: dict[str, dict[str, dict[str, str]]] = {}
+        self._indexed: set = set()
+
+    # -- indexing --
+
+    def _ensure_indexed(self, relpath: str) -> None:
+        if relpath in self._indexed:
+            return
+        self._indexed.add(relpath)
+        mod = self.by_rel.get(relpath)
+        if mod is not None:
+            self._index_module(mod)
+
+    def _index_module(self, mod: Module) -> None:
+        qns = qualname_index(mod.tree)
+        local: dict[str, str] = {}
+        methods: dict[str, dict[str, str]] = {}
+        class_of: dict[str, str] = {}
+        for node, qn in qns.items():
+            if isinstance(node, ast.ClassDef):
+                methods.setdefault(qn, {})
+                continue
+            fid = f"{mod.relpath}::{qn}"
+            cls = qn.rsplit(".", 1)[0] if "." in qn else ""
+            cls_name = cls if cls in {q for n, q in qns.items()
+                                      if isinstance(n, ast.ClassDef)} \
+                else ""
+            info = FunctionInfo(fid=fid, qualname=qn, module=mod,
+                                node=node, class_name=cls_name,
+                                _project=self)
+            self.functions[fid] = info
+            local[qn] = fid
+            if cls_name:
+                methods.setdefault(cls_name, {})[node.name] = fid
+                class_of[qn] = cls_name
+        self._locals[mod.relpath] = local
+        self._methods[mod.relpath] = methods
+
+    # -- call-edge resolution --
+
+    def _link_function(self, info: "FunctionInfo") -> None:
+        """Resolve the call edges of ONE function — the graph is only
+        ever as large as the set of functions actually walked."""
+        if info._calls is not None:
+            return
+        mod = info.module
+        self._ensure_indexed(mod.relpath)
+        aliases = import_aliases(mod.tree)
+        local = self._locals[mod.relpath]
+        methods = self._methods[mod.relpath]
+        info._calls = []
+        for call in self._own_calls(info.node):
+            site = self._resolve(call, mod, aliases, local, methods,
+                                 info)
+            if site is not None:
+                info._calls.append(site)
+
+    def _link_module(self, mod: Module) -> None:
+        self._ensure_indexed(mod.relpath)
+        for fid in self._locals[mod.relpath].values():
+            self._link_function(self.functions[fid])
+
+    @staticmethod
+    def _own_calls(fn: ast.AST):
+        """Call nodes lexically inside ``fn`` but not inside a nested
+        function/class definition (those own their calls)."""
+        return [n for n in own_nodes(fn) if isinstance(n, ast.Call)]
+
+    def _resolve(self, call: ast.Call, mod: Module, aliases: dict,
+                 local: dict, methods: dict,
+                 caller: FunctionInfo) -> Optional[CallSite]:
+        func = call.func
+        # self.method() / cls.method(): the enclosing class's namespace.
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in ("self", "cls") \
+                and caller.class_name:
+            fid = methods.get(caller.class_name, {}).get(func.attr)
+            if fid is not None and fid != caller.fid:
+                return CallSite(fid, call.lineno, call.col_offset,
+                                f"self.{func.attr}")
+            return None
+        path = dotted(func, aliases)
+        if not path:
+            return None
+        # Bare name: module-level function in this file, unless the
+        # name is really an import alias (dotted() already resolved).
+        if isinstance(func, ast.Name) and path == func.id:
+            fid = local.get(func.id)
+            if fid is not None and fid != caller.fid:
+                return CallSite(fid, call.lineno, call.col_offset,
+                                func.id)
+            return None
+        # Dotted path: longest prefix naming a project module, with
+        # the remainder a function qualname inside it.
+        parts = path.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod_path = ".".join(parts[:cut])
+            target = self.by_dotted.get(mod_path)
+            if target is None:
+                continue
+            qn = ".".join(parts[cut:])
+            self._ensure_indexed(target.relpath)
+            fid = self._locals.get(target.relpath, {}).get(qn)
+            if fid is not None and fid != caller.fid:
+                return CallSite(fid, call.lineno, call.col_offset, path)
+            return None
+        return None
+
+    # -- lookups --
+
+    def function_at(self, relpath: str, qualname: str) \
+            -> Optional[FunctionInfo]:
+        return self.functions.get(f"{relpath}::{qualname}")
+
+    def functions_in(self, relpath: str):
+        self._ensure_indexed(relpath)
+        return [self.functions[fid]
+                for fid in self._locals.get(relpath, {}).values()]

@@ -17,12 +17,16 @@ import sys
 from tools.graftlint import baseline as baseline_mod
 from tools.graftlint.config import Config
 from tools.graftlint.core import Rule, RunResult, run
-from tools.graftlint.report import render_json, render_text, write_json
+from tools.graftlint.report import render_json, render_sarif, \
+    render_text, write_json
 from tools.graftlint.rules_clock import ClockDisciplineRule
 from tools.graftlint.rules_determinism import DeterminismRule
+from tools.graftlint.rules_durability import DurabilityOrderingRule
+from tools.graftlint.rules_interproc import InterproceduralRule
 from tools.graftlint.rules_jit import JitPurityRule
 from tools.graftlint.rules_journal import KindExhaustivenessRule
 from tools.graftlint.rules_obs import ObsWriteOnlyRule
+from tools.graftlint.rules_sharding import ShardingReadinessRule
 from tools.graftlint.rules_undo import UndoLogRule
 
 
@@ -33,6 +37,9 @@ def build_rules(config: Config) -> list[Rule]:
         UndoLogRule(config.u1_custodians),
         ObsWriteOnlyRule(),
         ClockDisciplineRule(),
+        InterproceduralRule(),
+        DurabilityOrderingRule(),
+        ShardingReadinessRule(),
         KindExhaustivenessRule(config.journal_handler_files,
                                config.trace_handler_files),
     ]
@@ -71,6 +78,17 @@ def main(argv=None) -> int:
                    help="files/directories to analyze")
     p.add_argument("--json", metavar="FILE", dest="json_out",
                    help="write the JSON report to FILE ('-' = stdout)")
+    p.add_argument("--sarif", metavar="FILE", dest="sarif_out",
+                   help="write a SARIF 2.1.0 report to FILE "
+                        "('-' = stdout)")
+    p.add_argument("--rule", metavar="RULES", default="",
+                   help="comma-separated rule filter (e.g. F1,S1): "
+                        "only these rules run and only their findings "
+                        "are reported")
+    p.add_argument("--sanitize", action="store_true",
+                   help="after a clean static pass, run the runtime "
+                        "sanitizer (hash-shuffle digest identity + "
+                        "durable-before-effect ordering)")
     p.add_argument("--baseline", default=baseline_mod.DEFAULT_BASELINE,
                    help="baseline file of grandfathered findings "
                         "(default: tools/graftlint/baseline.json)")
@@ -115,8 +133,21 @@ def main(argv=None) -> int:
               "--metrics/--trace-json/--self-check", file=sys.stderr)
         return 2
 
+    only_rules = None
+    if args.rule:
+        only_rules = frozenset(r.strip().upper()
+                               for r in args.rule.split(",")
+                               if r.strip())
+        known = {n for r in rules for n in r.emitted()} | {"V1", "V2"}
+        unknown = sorted(only_rules - known)
+        if unknown:
+            print(f"graftlint: unknown rule(s) in --rule: "
+                  f"{', '.join(unknown)} (known: "
+                  f"{', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+
     if args.paths:
-        result = run(args.paths, config, rules)
+        result = run(args.paths, config, rules, only_rules=only_rules)
     else:
         result = RunResult()
 
@@ -150,9 +181,20 @@ def main(argv=None) -> int:
         else:
             with open(args.json_out, "w", encoding="utf-8") as fh:
                 write_json(doc, fh)
-    if args.json_out != "-":
+    if args.sarif_out:
+        sarif = render_sarif(result, rules)
+        if args.sarif_out == "-":
+            write_json(sarif, sys.stdout)
+        else:
+            with open(args.sarif_out, "w", encoding="utf-8") as fh:
+                write_json(sarif, fh)
+    if args.json_out != "-" and args.sarif_out != "-":
         render_text(result, sys.stdout, verbose=args.verbose)
-    return 1 if (result.findings or result.errors) else 0
+    rc = 1 if (result.findings or result.errors) else 0
+    if args.sanitize and rc == 0:
+        from tools.graftlint.sanitize import run_checks
+        rc = run_checks("all", "")
+    return rc
 
 
 if __name__ == "__main__":

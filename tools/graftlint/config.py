@@ -21,14 +21,14 @@ from dataclasses import dataclass, field
 # rules (J1 applies wherever jit-wrapped code appears; R1 is a
 # whole-tree pass anchored on the emitter/handler files).
 DEFAULT_ZONES: tuple = (
-    ("kueue_tpu/scheduler/", frozenset({"D1", "J1"})),
-    ("kueue_tpu/tas/", frozenset({"D1", "U1", "J1"})),
+    ("kueue_tpu/scheduler/", frozenset({"D1", "J1", "S1"})),
+    ("kueue_tpu/tas/", frozenset({"D1", "U1", "J1", "S1"})),
     # The batched planner never writes guarded usage state — it
     # nominates against forks/memos and hands commits to the snapshot's
     # own custodians — so it carries determinism + jit-purity only.
-    ("kueue_tpu/tas/batched.py", frozenset({"D1", "J1"})),
-    ("kueue_tpu/ops/", frozenset({"D1", "J1"})),
-    ("kueue_tpu/oracle/", frozenset({"D1", "J1"})),
+    ("kueue_tpu/tas/batched.py", frozenset({"D1", "J1", "S1"})),
+    ("kueue_tpu/ops/", frozenset({"D1", "J1", "S1"})),
+    ("kueue_tpu/oracle/", frozenset({"D1", "J1", "S1"})),
     # The supervisor is recovery machinery, not decision core: its
     # breaker is a deterministic function of the fault sequence (cycle
     # counts, CRC jitter), but its retry pacing sleeps wall-clock
@@ -42,8 +42,14 @@ DEFAULT_ZONES: tuple = (
     # here IS a divergence) and must route guarded usage mutations
     # through the snapshot custodians — pinned explicitly so the
     # controllers/ tree growing a zone later cannot relax it.
-    ("kueue_tpu/controllers/colapply.py", frozenset({"D1", "U1", "J1"})),
-    ("kueue_tpu/parallel/", frozenset({"D1", "J1"})),
+    ("kueue_tpu/controllers/colapply.py",
+     frozenset({"D1", "U1", "J1", "S1"})),
+    ("kueue_tpu/parallel/", frozenset({"D1", "J1", "S1"})),
+    # Columnar row store: the pjit cut-over worklist (ROADMAP item 1)
+    # lives here — per-row host loops and shape-unstable signatures
+    # are exactly what blocks the sharded decision core. D1 is NOT
+    # pinned (the cache is host bookkeeping, not verdict-bearing).
+    ("kueue_tpu/tensor/", frozenset({"J1", "S1"})),
     ("kueue_tpu/obs/", frozenset({"O1", "J1"})),
     # Perf telemetry and SLO burn-rate evaluation: explicitly listed so
     # a future zone re-shuffle cannot silently drop them out of the
@@ -56,7 +62,7 @@ DEFAULT_ZONES: tuple = (
     # keeps a future re-shuffle from accidentally demanding determinism
     # of it. Its journal kind (ha_digest) is registered exhaustively
     # for R1 via store.journal.EPHEMERAL_KINDS.
-    ("kueue_tpu/ha/", frozenset({"J1"})),
+    ("kueue_tpu/ha/", frozenset({"J1", "F1"})),
     # Read plane: same posture as ha/ — tail pacing, staleness
     # envelopes, and probe TTLs are inherently wall-clock, so D1 must
     # NOT apply. O1 must not apply either: a read replica OWNS its
@@ -64,14 +70,14 @@ DEFAULT_ZONES: tuple = (
     # someone else's engine); the zero-mutation guarantee is enforced
     # structurally instead (POST is rejected at the HTTP layer and
     # every rebuild discards the old engine wholesale).
-    ("kueue_tpu/readplane/", frozenset({"J1"})),
+    ("kueue_tpu/readplane/", frozenset({"J1", "F1"})),
     # Federation dispatcher: same posture as ha/ plus the undo-log
     # discipline. D1 must NOT apply — health probing, decorrelated
     # probe jitter, and handoff latency are inherently wall-clock.
     # Its journal kinds (fed_route, fed_cell) are registered for R1
     # via store.journal.EPHEMERAL_KINDS: they fold into the
     # dispatcher's routing table, never into an engine rebuild.
-    ("kueue_tpu/federation/", frozenset({"J1", "U1"})),
+    ("kueue_tpu/federation/", frozenset({"J1", "U1", "F1"})),
     # Sealed checkpoints serialize the guarded usage/queue state but
     # must never MUTATE it (a snapshot that writes back would corrupt
     # the very state it claims to preserve): pinned under the undo-log
@@ -184,6 +190,37 @@ O1_ENGINE_NAMES = frozenset({"engine", "eng"})
 
 # Lifecycle functions allowed to attach/detach themselves on the engine.
 O1_ATTACH_OK = frozenset({"__init__", "detach", "attach", "close"})
+
+# -- F1: durability ordering (fsync-before-handoff) --
+
+# A call is an externally visible *effect* when its terminal attribute
+# matches (SSE hub fanout / the dispatcher's publish wrapper) or its
+# dotted text ends with a suffix (handoff RPCs to a remote cell).
+# Project-function calls whose transitive body performs an exposed
+# effect are classified by the call graph, not by this vocabulary.
+F1_EFFECT_TERMINALS = frozenset({"publish", "_publish"})
+F1_EFFECT_SUFFIXES: tuple = ("transport.submit", "transport.revoke")
+
+# A call is a *durability point* when its terminal attribute matches
+# AND the receiver chain mentions a journal (``self.journal.sync()``,
+# ``journal.apply(...)``). ``apply``/``append`` count because the
+# write path fsyncs per append on fsync=True journals — the runtime
+# sanitizer (sanitize.py) is what enforces that dynamic half.
+F1_DURABLE_TERMINALS = frozenset({"sync", "fsync", "apply", "append"})
+F1_DURABLE_RECEIVER_HINT = "journal"
+
+# -- S1: sharding readiness (the pjit cut-over worklist) --
+
+# range()/len() argument text fragments that mark a loop as per-row
+# over the columnar store; also matched against dotted iterator text.
+S1_ROW_ITER_HINTS: tuple = ("num_rows", "rows", "row_of", "live_rows")
+
+# Call heads that produce device arrays: a host branch on a value from
+# one of these is an implicit device→host sync in the decision core.
+S1_DEVICE_HEADS = frozenset({"jnp", "jax", "lax"})
+
+# Terminal attributes that force a device→host transfer.
+S1_SYNC_TERMINALS = frozenset({"item", "tolist"})
 
 # -- J1: jit purity --
 

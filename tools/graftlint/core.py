@@ -79,13 +79,20 @@ class Module:
 
 
 class Rule:
-    """Base class: one invariant class, one visitor pass per module."""
+    """Base class: one invariant class, one visitor pass per module.
+    Cross-file rules see the whole module list; whole-program rules
+    see the call-graph Project + SummaryIndex instead."""
 
     name = ""
     title = ""
     rationale = ""     # --explain body
     example = ""       # --explain example violation
     cross_file = False
+    whole_program = False
+    emits: tuple = ()  # finding rule names (defaults to (name,))
+
+    def emitted(self) -> tuple:
+        return self.emits or (self.name,)
 
     def check_module(self, mod: Module) -> Iterable[Finding]:
         return ()
@@ -94,15 +101,24 @@ class Rule:
         """Cross-file rules override this instead."""
         return ()
 
+    def check_project(self, project, summaries) -> Iterable[Finding]:
+        """Whole-program rules override this instead."""
+        return ()
+
 
 # -- dotted-name resolution helpers shared by the rules --
 
 def import_aliases(tree: ast.Module) -> dict[str, str]:
     """Local name -> dotted origin, from the module's imports.
     ``import time`` -> {"time": "time"}; ``from os import urandom as u``
-    -> {"u": "os.urandom"}; ``import numpy as np`` -> {"np": "numpy"}."""
+    -> {"u": "os.urandom"}; ``import numpy as np`` -> {"np": "numpy"}.
+    Memoized on the tree — every rule, the call-graph linker and the
+    summary passes ask for the same table (single-parse contract)."""
+    cached = getattr(tree, "_graftlint_aliases", None)
+    if cached is not None:
+        return cached
     out: dict[str, str] = {}
-    for node in ast.walk(tree):
+    for node in iter_stmts(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 out[a.asname or a.name.split(".")[0]] = \
@@ -111,7 +127,23 @@ def import_aliases(tree: ast.Module) -> dict[str, str]:
                 and node.level == 0:
             for a in node.names:
                 out[a.asname or a.name] = f"{node.module}.{a.name}"
+    tree._graftlint_aliases = out  # type: ignore[attr-defined]
     return out
+
+
+def iter_stmts(root: ast.AST) -> Iterable[ast.AST]:
+    """Every *statement* under ``root`` (root included), skipping
+    expression subtrees entirely. Imports, AnnAssigns and def headers
+    are statements, so passes that only need those (alias tables,
+    annotation scans) get a walk ~5x smaller than ast.walk."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for fld in ("body", "orelse", "finalbody", "handlers", "cases"):
+            val = getattr(node, fld, None)
+            if val:
+                stack.extend(val)
 
 
 def dotted(expr: ast.AST, aliases: dict[str, str]) -> str:
@@ -130,7 +162,12 @@ def dotted(expr: ast.AST, aliases: dict[str, str]) -> str:
 
 
 def qualname_index(tree: ast.Module) -> dict[ast.AST, str]:
-    """Map every function/class def node to its dotted qualname."""
+    """Map every function/class def node to its dotted qualname.
+    Memoized on the tree: every rule and the call-graph pass share one
+    index per parse (the single-parse contract of the v2 engine)."""
+    cached = getattr(tree, "_graftlint_qualnames", None)
+    if cached is not None:
+        return cached
     out: dict[ast.AST, str] = {}
 
     def visit(node: ast.AST, prefix: str) -> None:
@@ -144,6 +181,29 @@ def qualname_index(tree: ast.Module) -> dict[ast.AST, str]:
                 visit(child, prefix)
 
     visit(tree, "")
+    tree._graftlint_qualnames = out  # type: ignore[attr-defined]
+    return out
+
+
+def own_nodes(fn: ast.AST) -> list:
+    """Every node lexically inside ``fn`` but outside nested function/
+    class definitions (those own their bodies). Memoized on the node:
+    the call-graph linker, the J1 impurity scan, and the S1 host-idiom
+    scan all need exactly this list, and walking it once per function
+    instead of once per pass is a measurable share of lint wall time."""
+    cached = getattr(fn, "_graftlint_own", None)
+    if cached is not None:
+        return cached
+    out: list = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    fn._graftlint_own = out  # type: ignore[attr-defined]
     return out
 
 
@@ -173,6 +233,8 @@ class RunResult:
     suppressed: list[tuple[Finding, str]] = field(default_factory=list)
     errors: list[str] = field(default_factory=list)   # pragma misuse etc.
     files: int = 0
+    relpaths: frozenset = frozenset()   # files actually scanned
+    only_rules: Optional[frozenset] = None   # --rule filter, if any
 
 
 def _iter_py_files(path: str) -> Iterable[str]:
@@ -185,6 +247,26 @@ def _iter_py_files(path: str) -> Iterable[str]:
         for f in sorted(files):
             if f.endswith(".py"):
                 yield os.path.join(root, f)
+
+
+# One parsed AST per file per process, shared by every rule AND the
+# call-graph pass, keyed on (mtime, size) so an edited file re-parses.
+# Entries carry the qualname memo with the tree for free.
+_AST_CACHE: dict[str, tuple] = {}
+
+
+def _parse_cached(fp: str, rel: str):
+    st = os.stat(fp)
+    key = (st.st_mtime_ns, st.st_size)
+    hit = _AST_CACHE.get(fp)
+    if hit is not None and hit[0] == key:
+        return hit[1], hit[2]
+    with open(fp, encoding="utf-8") as fh:
+        src = fh.read()
+    tree = ast.parse(src, filename=rel)
+    lines = src.split("\n")
+    _AST_CACHE[fp] = (key, tree, lines)
+    return tree, lines
 
 
 def load_modules(paths: list[str], config) -> tuple[list[Module],
@@ -203,9 +285,7 @@ def load_modules(paths: list[str], config) -> tuple[list[Module],
             seen.add(fp)
             rel = os.path.relpath(fp, root).replace(os.sep, "/")
             try:
-                with open(fp, encoding="utf-8") as fh:
-                    src = fh.read()
-                tree = ast.parse(src, filename=rel)
+                tree, lines = _parse_cached(fp, rel)
             except SyntaxError as e:
                 errors.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
                 continue
@@ -213,19 +293,38 @@ def load_modules(paths: list[str], config) -> tuple[list[Module],
                 errors.append(f"{rel}: unreadable: {e}")
                 continue
             modules.append(Module(
-                path=fp, relpath=rel, tree=tree,
-                lines=src.split("\n"),
+                path=fp, relpath=rel, tree=tree, lines=lines,
                 rules=config.rules_for(rel)))
     return modules, errors
 
 
-def run(paths: list[str], config, rules: list[Rule]) -> RunResult:
+def run(paths: list[str], config, rules: list[Rule],
+        only_rules: Optional[frozenset] = None) -> RunResult:
+    """Drive every rule over the parsed tree. ``only_rules`` filters
+    both which rule objects run and which findings survive (a rule
+    that can emit several names — the interprocedural pass — runs if
+    any of them is wanted)."""
     modules, errors = load_modules(paths, config)
-    result = RunResult(errors=errors, files=len(modules))
+    result = RunResult(errors=errors, files=len(modules),
+                       relpaths=frozenset(m.relpath for m in modules),
+                       only_rules=only_rules)
     raw: list[tuple[Finding, Module]] = []
     by_rel = {m.relpath: m for m in modules}
+    if only_rules is not None:
+        rules = [r for r in rules
+                 if only_rules.intersection(r.emitted())]
+    project = None
+    summaries = None
+    if any(r.whole_program for r in rules):
+        from tools.graftlint.callgraph import Project
+        from tools.graftlint.summaries import SummaryIndex
+        project = Project(modules)
+        summaries = SummaryIndex(project)
     for rule in rules:
-        if rule.cross_file:
+        if rule.whole_program:
+            for f in rule.check_project(project, summaries):
+                raw.append((f, by_rel.get(f.file)))
+        elif rule.cross_file:
             for f in rule.check_tree(modules):
                 raw.append((f, by_rel.get(f.file)))
         else:
@@ -234,6 +333,8 @@ def run(paths: list[str], config, rules: list[Rule]) -> RunResult:
                     continue
                 for f in rule.check_module(mod):
                     raw.append((f, mod))
+    if only_rules is not None:
+        raw = [(f, m) for f, m in raw if f.rule in only_rules]
     for f, mod in sorted(raw, key=lambda fm: (fm[0].file, fm[0].line,
                                               fm[0].col, fm[0].rule)):
         pragma = mod.pragma_for(f.line) if mod is not None else None

@@ -57,3 +57,81 @@ def _summary(findings: Iterable[Finding]) -> dict:
 def write_json(doc: dict, out: TextIO) -> None:
     json.dump(doc, out, indent=2, sort_keys=False)
     out.write("\n")
+
+
+# -- SARIF 2.1.0 (code-scanning upload format) --
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                 "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _sarif_result(f: Finding, suppression: Optional[dict] = None) \
+        -> dict:
+    res = {
+        "ruleId": f.rule,
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.file,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(1, f.line),
+                           "startColumn": max(1, f.col + 1)},
+            },
+            "logicalLocations": [{"fullyQualifiedName": f.symbol}]
+            if f.symbol else [],
+        }],
+    }
+    if suppression is not None:
+        res["suppressions"] = [suppression]
+    return res
+
+
+def render_sarif(result: RunResult, rules: list) -> dict:
+    """One SARIF run: findings as error-level results, pragma/baseline
+    suppressions carried as suppressed results (kind inSource vs
+    external), runner errors as tool notifications — nothing the text
+    report shows is dropped on the SARIF path."""
+    rule_meta = [{
+        "id": r.name,
+        "name": r.title or r.name,
+        "shortDescription": {"text": r.title or r.name},
+        "fullDescription": {"text": r.rationale or r.title or r.name},
+    } for r in rules if r.name]
+    rule_meta += [
+        {"id": "V1", "name": "prometheus exposition validity",
+         "shortDescription": {"text": "prometheus exposition validity"},
+         "fullDescription": {"text": "emitted metrics artifacts must "
+                             "parse as valid prometheus exposition"}},
+        {"id": "V2", "name": "trace-event JSON validity",
+         "shortDescription": {"text": "trace-event JSON validity"},
+         "fullDescription": {"text": "emitted trace artifacts must be "
+                             "valid trace-event JSON"}},
+    ]
+    results = [_sarif_result(f) for f in result.findings]
+    for f, reason in result.suppressed:
+        kind = "external" if reason.startswith("baseline:") \
+            else "inSource"
+        results.append(_sarif_result(f, {
+            "kind": kind, "justification": reason}))
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "tools/graftlint (in-repo static analyzer)",
+                "rules": rule_meta,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+            "invocations": [{
+                "executionSuccessful": not (result.findings
+                                            or result.errors),
+                "toolExecutionNotifications": [
+                    {"level": "error", "message": {"text": e}}
+                    for e in result.errors],
+            }],
+        }],
+    }

@@ -35,6 +35,7 @@ from tools.graftlint.core import (
     Rule,
     dotted,
     import_aliases,
+    iter_stmts,
 )
 
 _ORDER_INSENSITIVE = {"any", "all", "sum", "min", "max", "len",
@@ -96,9 +97,11 @@ class DeterminismRule(Rule):
     @staticmethod
     def _annotated_set_names(tree: ast.Module) -> set:
         """Names/attribute-names annotated as sets anywhere in the
-        module (function params, AnnAssign locals, dataclass fields)."""
+        module (function params, AnnAssign locals, dataclass fields).
+        Annotations only appear on statements and def headers, so the
+        statement-only walk suffices (lambdas cannot annotate)."""
         out: set = set()
-        for node in ast.walk(tree):
+        for node in iter_stmts(tree):
             if isinstance(node, ast.AnnAssign) \
                     and _is_set_annotation(node.annotation):
                 t = node.target
@@ -106,9 +109,19 @@ class DeterminismRule(Rule):
                     out.add(t.id)
                 elif isinstance(t, ast.Attribute):
                     out.add(t.attr)
-            elif isinstance(node, ast.arg) and node.annotation \
-                    is not None and _is_set_annotation(node.annotation):
-                out.add(node.arg)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                a = node.args
+                params = list(a.posonlyargs) + list(a.args) \
+                    + list(a.kwonlyargs)
+                if a.vararg:
+                    params.append(a.vararg)
+                if a.kwarg:
+                    params.append(a.kwarg)
+                for arg in params:
+                    if arg.annotation is not None \
+                            and _is_set_annotation(arg.annotation):
+                        out.add(arg.arg)
         return out
 
     @staticmethod
@@ -139,6 +152,7 @@ class DeterminismRule(Rule):
         """Walk one function (or module) body; recurse into nested
         defs with their own local-set tables."""
         local_sets: set = set()
+        reduced: set = set()   # id()s of comps fed to a reducer call
         body = scope.body if hasattr(scope, "body") else []
 
         def visit(node: ast.AST) -> None:
@@ -167,12 +181,22 @@ class DeterminismRule(Rule):
                     local_sets.discard(node.targets[0].id)
             if isinstance(node, ast.Call):
                 self._check_call(mod, node, qual, aliases, findings)
+                # A reducer call marks its direct comp arguments as
+                # order-insensitive BEFORE the walk descends into them
+                # (parent is always visited first) — no parent map.
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id in _ORDER_INSENSITIVE:
+                    for a in node.args:
+                        if isinstance(a, (ast.ListComp,
+                                          ast.GeneratorExp,
+                                          ast.DictComp, ast.SetComp)):
+                            reduced.add(id(a))
             if isinstance(node, ast.For):
                 self._check_iter(mod, node.iter, qual, local_sets,
                                  set_attrs, findings)
             if isinstance(node, (ast.ListComp, ast.GeneratorExp,
                                  ast.DictComp, ast.SetComp)):
-                if not self._comp_is_reduced(mod, node):
+                if id(node) not in reduced:
                     for gen in node.generators:
                         self._check_iter(mod, gen.iter, qual,
                                          local_sets, set_attrs,
@@ -182,23 +206,6 @@ class DeterminismRule(Rule):
 
         for stmt in body:
             visit(stmt)
-
-    def _comp_is_reduced(self, mod: Module, comp: ast.AST) -> bool:
-        """True when the comprehension/genexp is the direct argument of
-        an order-insensitive reducer (any/sum/sorted/...) — its
-        iteration order cannot reach a decision."""
-        parents = getattr(mod, "_d1_parents", None)
-        if parents is None:
-            parents = {}
-            for n in ast.walk(mod.tree):
-                for c in ast.iter_child_nodes(n):
-                    parents[c] = n
-            mod._d1_parents = parents  # type: ignore[attr-defined]
-        p = parents.get(comp)
-        return (isinstance(p, ast.Call)
-                and isinstance(p.func, ast.Name)
-                and p.func.id in _ORDER_INSENSITIVE
-                and comp in p.args)
 
     def _check_iter(self, mod: Module, it: ast.AST, qual: str,
                     local_sets: set, set_attrs: set,

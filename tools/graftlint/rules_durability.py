@@ -1,0 +1,361 @@
+"""F1 — durability ordering: fsync happens-before external visibility.
+
+The repo-wide contract (PRs 6/12/18): on admission, handoff, and
+read-plane paths, the journal append+fsync *happens-before* every
+externally visible effect — an SSE publish, a federation handoff RPC,
+an acceptance response. An effect emitted first opens the classic
+window: a consumer observes state, the process dies before the fsync,
+recovery replays a journal that never heard of it.
+
+What the checker actually flags — and, as important, what it doesn't:
+
+  * Statements are walked **in path order** per function. An effect
+    call becomes *pending*; it turns into a finding only when a
+    durability point (``journal.sync()`` / ``journal.apply(...)`` —
+    config.F1_DURABLE_TERMINALS against a journal receiver) follows
+    it on the same control-flow path. "You synced AFTER telling the
+    world" is the bug; the sync is proof the path was meant to be
+    durable.
+  * A path that ends (return / raise / function end) with pending
+    effects is clean: no durability point ever followed, so the path
+    is a pure notification path (probe up/down transitions, 429/503
+    refusals) where ordering is vacuous.
+  * Branches are walked independently and merged: an effect inside an
+    early-return rejection arm never leaks into the fallthrough path.
+
+Interprocedural half: a call to a project function counts as an effect
+iff that function's **exposed effects** are non-empty — effects on
+some path through its body with no durability point *before* them in
+that body. ``_confirm`` (journal.apply, then publish) exposes
+nothing: its publish is dominated by its own durability point, so
+callers may order it freely. A bare wrapper around ``hub.publish``
+exposes the publish, so `self._notify(...); journal.sync()` is caught
+with the chain in the message. Exposure is computed over the call
+graph with memoization and a cycle guard.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from tools.graftlint.callgraph import FunctionInfo, Project
+from tools.graftlint.config import (
+    F1_DURABLE_RECEIVER_HINT,
+    F1_DURABLE_TERMINALS,
+    F1_EFFECT_SUFFIXES,
+    F1_EFFECT_TERMINALS,
+)
+from tools.graftlint.core import Finding, Module, Rule
+
+_TERMINATED = object()
+
+
+def _attr_text(expr: ast.AST) -> str:
+    """'self.journal.sync' for the Attribute chain, '' otherwise."""
+    parts: list[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("<expr>")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+class _Effect:
+    """One pending effect: where it happened, and how (direct call or
+    a chain into a helper whose exposed effect is ``via``)."""
+
+    __slots__ = ("line", "col", "desc", "via")
+
+    def __init__(self, line: int, col: int, desc: str,
+                 via: Optional[str] = None):
+        self.line = line
+        self.col = col
+        self.desc = desc
+        self.via = via
+
+
+class DurabilityOrderingRule(Rule):
+    name = "F1"
+    title = "durability ordering (fsync before external visibility)"
+    whole_program = True
+    rationale = (
+        "On admission/handoff/read-plane paths the journal append+"
+        "fsync must happen-before every externally visible effect "
+        "(SSE publish, federation handoff RPC, acceptance response). "
+        "An effect emitted first lets a consumer observe state that a "
+        "crash-then-recover erases — the journal never heard of it. "
+        "The checker walks each function's statements in path order: "
+        "an effect followed by a durability point on the same path is "
+        "a finding (the sync proves the path was meant to be durable, "
+        "and it came too late); paths that never reach a durability "
+        "point (probe notifications, 429/503 refusals) are clean. "
+        "Calls into helpers are classified by the call graph: a "
+        "helper whose own body syncs before it publishes exposes "
+        "nothing to its callers.")
+    example = (
+        "    def submit(self, wl):\n"
+        "        self.hub.publish('accepted', wl.key)  # FINDING\n"
+        "        self.journal.apply('route', rec)\n"
+        "        self.journal.sync()   # durability point AFTER the\n"
+        "                              # world already heard about it")
+
+    def check_project(self, project: Project,
+                      summaries) -> Iterable[Finding]:
+        self._exposed_memo: dict[str, list] = {}
+        self._project = project
+        findings: list[Finding] = []
+        for mod in project.modules:
+            if "F1" not in mod.rules:
+                continue
+            for info in sorted(project.functions_in(mod.relpath),
+                               key=lambda i: i.fid):
+                self._check_function(mod, info, findings)
+        return findings
+
+    # -- per-function ordered walk --
+
+    def _check_function(self, mod: Module, info: FunctionInfo,
+                        findings: list) -> None:
+        calls_by_pos = {(s.line, s.col): s for s in info.calls}
+        body = getattr(info.node, "body", [])
+        self._cur_emitted: set = set()
+        self._walk(body, [], mod, info, calls_by_pos, findings)
+
+    def _walk(self, stmts, pending: list, mod: Module,
+              info: FunctionInfo, calls_by_pos, findings: list):
+        """Walk ``stmts`` in order; returns the surviving pending list
+        or _TERMINATED when every sub-path ended (return/raise)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                taken = self._walk(list(stmt.body), list(pending), mod,
+                                   info, calls_by_pos, findings)
+                other = self._walk(list(stmt.orelse), list(pending),
+                                   mod, info, calls_by_pos, findings)
+                if taken is _TERMINATED and other is _TERMINATED:
+                    return _TERMINATED
+                pending = self._merge(taken, other)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                # One symbolic iteration: a durability point inside
+                # the body flags earlier pendings; effects in the body
+                # join the pending set after the loop (a later sync
+                # still post-dates them).
+                looped = self._walk(list(stmt.body), list(pending),
+                                    mod, info, calls_by_pos, findings)
+                after = self._walk(list(stmt.orelse), list(pending),
+                                   mod, info, calls_by_pos, findings)
+                pending = self._merge(looped, self._merge(after,
+                                                          pending))
+                continue
+            if isinstance(stmt, ast.Try):
+                merged = self._walk(list(stmt.body), list(pending),
+                                    mod, info, calls_by_pos, findings)
+                for h in stmt.handlers:
+                    caught = self._walk(list(h.body),
+                                        list(pending), mod, info,
+                                        calls_by_pos, findings)
+                    merged = self._merge(merged, caught)
+                if merged is not _TERMINATED:
+                    got = self._walk(list(stmt.orelse), list(merged),
+                                     mod, info, calls_by_pos,
+                                     findings)
+                    if got is not _TERMINATED:
+                        merged = got
+                base = [] if merged is _TERMINATED else list(merged)
+                fin = self._walk(list(stmt.finalbody), base, mod,
+                                 info, calls_by_pos, findings)
+                if merged is _TERMINATED:
+                    return _TERMINATED
+                pending = fin if fin is not _TERMINATED else merged
+                continue
+            if isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    self._scan_calls(item.context_expr, pending, mod,
+                                     info, calls_by_pos, findings)
+                got = self._walk(list(stmt.body), pending, mod, info,
+                                 calls_by_pos, findings)
+                if got is _TERMINATED:
+                    return _TERMINATED
+                pending = got
+                continue
+            # Simple statement: classify its calls in source order.
+            self._scan_calls(stmt, pending, mod, info, calls_by_pos,
+                             findings)
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                return _TERMINATED
+        return pending
+
+    @staticmethod
+    def _merge(a, b):
+        parts = [p for p in (a, b)
+                 if p is not _TERMINATED and p is not None]
+        if not parts:
+            return _TERMINATED
+        out: list = []
+        seen: set = set()
+        for p in parts:
+            for e in p:
+                k = (e.line, e.col)
+                if k not in seen:
+                    seen.add(k)
+                    out.append(e)
+        return out
+
+    def _scan_calls(self, node: ast.AST, pending: list, mod: Module,
+                    info: FunctionInfo, calls_by_pos,
+                    findings: list) -> None:
+        calls = [n for n in ast.walk(node) if isinstance(n, ast.Call)]
+        for call in sorted(calls, key=lambda c: (c.lineno,
+                                                 c.col_offset)):
+            text = _attr_text(call.func)
+            if self._is_durable(text):
+                for eff in pending:
+                    self._emit(eff, call, text, mod, info, findings)
+                pending.clear()
+                continue
+            eff = self._classify_effect(call, text, calls_by_pos)
+            if eff is not None:
+                pending.append(eff)
+
+    @staticmethod
+    def _is_durable(text: str) -> bool:
+        if not text or "." not in text:
+            return False
+        recv, _, term = text.rpartition(".")
+        return term in F1_DURABLE_TERMINALS \
+            and F1_DURABLE_RECEIVER_HINT in recv.lower()
+
+    def _classify_effect(self, call: ast.Call, text: str,
+                         calls_by_pos) -> Optional[_Effect]:
+        term = text.rpartition(".")[2] if text else ""
+        if term in F1_EFFECT_TERMINALS:
+            return _Effect(call.lineno, call.col_offset,
+                           f"{text}()")
+        for suffix in F1_EFFECT_SUFFIXES:
+            if text == suffix or text.endswith("." + suffix):
+                return _Effect(call.lineno, call.col_offset,
+                               f"{text}()")
+        site = calls_by_pos.get((call.lineno, call.col_offset))
+        if site is not None:
+            exposed = self._exposed(site.callee)
+            if exposed:
+                first = exposed[0]
+                return _Effect(
+                    call.lineno, call.col_offset, f"{text}()",
+                    via=f"{first.desc} at {first.line} in "
+                        f"{site.callee}")
+        return None
+
+    def _emit(self, eff: _Effect, durable_call: ast.Call,
+              durable_text: str, mod: Module, info: FunctionInfo,
+              findings: list) -> None:
+        if (eff.line, eff.col) in self._cur_emitted:
+            return
+        self._cur_emitted.add((eff.line, eff.col))
+        via = f" (reaches {eff.via})" if eff.via else ""
+        findings.append(Finding(
+            "F1", mod.relpath, eff.line, eff.col, info.qualname,
+            f"externally visible effect {eff.desc}{via} precedes the "
+            f"durability point {durable_text}() at line "
+            f"{durable_call.lineno} — a consumer can observe state "
+            "the journal has not fsynced; journal+sync first, then "
+            "publish/handoff (or baseline with a reason if the "
+            "effect is provably derived from already-durable state)"))
+
+    # -- exposed-effect summaries over the call graph --
+
+    def _exposed(self, fid: str) -> list:
+        """Effects in ``fid``'s body (or transitively through its
+        callees) that are NOT preceded by a durability point on their
+        path — what a caller inherits by calling it."""
+        cached = self._exposed_memo.get(fid)
+        if cached is not None:
+            return cached
+        self._exposed_memo[fid] = []      # cycle guard
+        info = self._project.functions.get(fid)
+        if info is None:
+            return []
+        out: list = []
+        calls_by_pos = {(s.line, s.col): s for s in info.calls}
+        self._expose_walk(getattr(info.node, "body", []), True,
+                          info.module, calls_by_pos, out)
+        self._exposed_memo[fid] = out
+        return out
+
+    def _expose_walk(self, stmts, live: bool, mod: Module,
+                     calls_by_pos, out: list) -> bool:
+        """``live`` = no durability point has dominated this path yet.
+        Returns the liveness after the statement sequence."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                a = self._expose_walk(list(stmt.body), live, mod,
+                                      calls_by_pos, out)
+                b = self._expose_walk(list(stmt.orelse), live, mod,
+                                      calls_by_pos, out)
+                live = a or b
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                live = self._expose_walk(
+                    list(stmt.body) + list(stmt.orelse), live, mod,
+                    calls_by_pos, out)
+                continue
+            if isinstance(stmt, ast.Try):
+                after_try = self._expose_walk(list(stmt.body), live,
+                                              mod, calls_by_pos, out)
+                for h in stmt.handlers:
+                    after_try = self._expose_walk(
+                        list(h.body), live, mod, calls_by_pos,
+                        out) or after_try
+                after_try = self._expose_walk(
+                    list(stmt.orelse), after_try, mod, calls_by_pos,
+                    out)
+                live = self._expose_walk(list(stmt.finalbody),
+                                         after_try, mod, calls_by_pos,
+                                         out)
+                continue
+            if isinstance(stmt, ast.With):
+                live = self._expose_scan(stmt, live, mod,
+                                         calls_by_pos, out,
+                                         only_items=True)
+                live = self._expose_walk(list(stmt.body), live, mod,
+                                         calls_by_pos, out)
+                continue
+            live = self._expose_scan(stmt, live, mod, calls_by_pos,
+                                     out)
+        return live
+
+    def _expose_scan(self, stmt: ast.AST, live: bool, mod: Module,
+                     calls_by_pos, out: list,
+                     only_items: bool = False) -> bool:
+        roots = ([i.context_expr for i in stmt.items] if only_items
+                 else [stmt])
+        calls = [n for r in roots for n in ast.walk(r)
+                 if isinstance(n, ast.Call)]
+        for call in sorted(calls, key=lambda c: (c.lineno,
+                                                 c.col_offset)):
+            text = _attr_text(call.func)
+            if self._is_durable(text):
+                live = False
+                continue
+            if not live:
+                continue
+            pragma = mod.pragma_for(call.lineno)
+            if pragma is not None and "F1" in pragma[0] and pragma[1]:
+                continue
+            eff = self._classify_effect(call, text, calls_by_pos)
+            if eff is not None:
+                out.append(eff)
+        return live

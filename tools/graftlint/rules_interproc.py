@@ -1,0 +1,116 @@
+"""Whole-program re-basing of D1 / C1 / J1.
+
+The per-module visitors judge a function by its own body; this pass
+judges a zone function by everything *reachable* from it. A scheduler
+function that calls a helper in ``kueue_tpu/utils/`` doing
+``time.time()`` or bare set iteration carries exactly the same replay
+hazard as doing it inline — the helper's module just never had the D1
+zone bit, so the per-file pass sailed past it.
+
+Entries per rule:
+
+  * D1 / C1 — every function defined in a module the zone map marks
+    with the rule;
+  * J1 — every jit root (decorated or pallas_call kernel), because
+    jit-purity is a property of the *trace*, which inlines callees.
+
+For each entry, every resolved call edge leaving the zone is walked
+(summaries.SummaryIndex.closure): facts found in out-of-zone functions
+come back with their call path, and the finding is attributed to the
+zone-entry call site — ``file:line`` where the tainted chain is
+entered, symbol = the entry function — with the chain and the
+offending helper's own location in the message. Suppression composes
+the usual two ways: an ``allow[RULE]`` pragma at the entry call site,
+or a baseline entry keyed (rule, entry file, entry symbol). A pragma
+at the fact's own line in the helper suppresses it for every caller
+(summaries honors it at collection time).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from tools.graftlint.callgraph import Project
+from tools.graftlint.core import Finding, Rule
+from tools.graftlint.summaries import SummaryIndex
+
+_RULES = ("D1", "C1", "J1")
+
+_WHY = {
+    "D1": "nondeterminism reaches a decision-core zone through this "
+          "call (breaks flight-recorder replay)",
+    "C1": "a wall-clock read reaches a simulated zone through this "
+          "call (re-couples virtual and wall time)",
+    "J1": "an impure call reaches a jit trace through this call "
+          "(executes at trace time only)",
+}
+
+
+class InterproceduralRule(Rule):
+    name = "IP"
+    title = "whole-program D1/C1/J1 (call-chain findings)"
+    emits = _RULES
+    whole_program = True
+    rationale = (
+        "The interprocedural pass (tools/graftlint/callgraph.py + "
+        "summaries.py) builds a project-wide call graph and judges "
+        "every zone function by the helpers it reaches outside its "
+        "zone: a decision-core function calling a utils helper that "
+        "does time.time() or iterates a set diverges replays exactly "
+        "as if the call were inline, and a jit root tracing through "
+        "an impure helper bakes the side effect into the compiled "
+        "program. Findings are attributed to the zone-entry call site "
+        "with the full call chain, so the fix (or the baseline entry) "
+        "lands where the zone is breached, not in the helper.")
+    example = (
+        "    # kueue_tpu/scheduler/cycle.py (D1 zone)\n"
+        "    def pick(self, heads):\n"
+        "        return pick_jittered(heads)   # FINDING: chain\n"
+        "    # kueue_tpu/utils/mixers.py (no zone)\n"
+        "    def pick_jittered(heads):\n"
+        "        random.shuffle(heads)         # the actual hazard\n"
+        "        return heads[0]")
+
+    def check_project(self, project: Project,
+                      summaries: SummaryIndex) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        for rule in _RULES:
+            for entry in self._entries(rule, project, summaries):
+                self._check_entry(rule, entry, project, summaries,
+                                  findings)
+        return findings
+
+    @staticmethod
+    def _entries(rule: str, project: Project, summaries: SummaryIndex):
+        if rule == "J1":
+            for fid in sorted(summaries.jit_roots()):
+                info = project.functions.get(fid)
+                if info is not None:
+                    yield info
+            return
+        for mod in project.modules:
+            if rule not in mod.rules:
+                continue
+            for info in sorted(project.functions_in(mod.relpath),
+                               key=lambda i: i.fid):
+                yield info
+
+    def _check_entry(self, rule: str, entry, project: Project,
+                     summaries: SummaryIndex, findings: list) -> None:
+        for site in entry.calls:
+            callee = project.functions.get(site.callee)
+            if callee is None or summaries.in_zone(rule, callee):
+                continue
+            for path, fact in summaries.closure(rule, site.callee):
+                chain = " -> ".join(
+                    [entry.qualname]
+                    + [project.functions[f].qualname
+                       for f in (site.callee,) + path
+                       if f in project.functions])
+                findings.append(Finding(
+                    rule, entry.relpath, site.line, site.col,
+                    entry.qualname,
+                    f"{fact.desc} at {fact.relpath}:{fact.line} "
+                    f"reached via call chain {chain} — {_WHY[rule]}; "
+                    "fix the helper, thread the seam through the "
+                    "call, or baseline this entry with a reason"))

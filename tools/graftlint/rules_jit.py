@@ -41,6 +41,7 @@ from tools.graftlint.core import (
     Rule,
     dotted,
     import_aliases,
+    qualname_index,
 )
 
 
@@ -108,7 +109,8 @@ class JitPurityRule(Rule):
         aliases = import_aliases(mod.tree)
         findings: list[Finding] = []
         jit_aliases = self._module_jit_aliases(mod.tree, aliases)
-        roots = self._find_roots(mod.tree, aliases, jit_aliases)
+        roots = self._find_roots(mod.tree, aliases, jit_aliases,
+                                 lines=mod.lines)
         for fn, static, qual, how in roots:
             self._check_body(mod, fn, static, qual, how, aliases,
                              findings)
@@ -130,48 +132,47 @@ class JitPurityRule(Rule):
         return out
 
     def _find_roots(self, tree: ast.Module, aliases: dict,
-                    jit_aliases: set) -> list:
-        """(fn, static_params, qualname, how) for every jit root."""
+                    jit_aliases: set, lines=None) -> list:
+        """(fn, static_params, qualname, how) for every jit root.
+        Memoized on the tree: the intra-module pass and the
+        whole-program jit-entry scan (summaries.jit_roots) both need
+        the same answer, and the scan covers every module. Function
+        discovery rides the shared qualname index (one traversal per
+        parse); the pallas kernel walk is skipped outright when the
+        source never mentions pallas_call (``lines`` prefilter)."""
+        cached = getattr(tree, "_graftlint_jit_roots", None)
+        if cached is not None:
+            return cached
         roots: list = []
         fns_by_name: dict[str, ast.FunctionDef] = {}
-
-        def collect(node: ast.AST, prefix: str) -> None:
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, ast.FunctionDef):
-                    qn = f"{prefix}.{child.name}" if prefix \
-                        else child.name
-                    fns_by_name.setdefault(child.name, child)
-                    dec_info = self._jit_decorator(child, aliases,
-                                                   jit_aliases)
-                    if dec_info is not None:
-                        roots.append((child, dec_info, qn, "decorator"))
-                    collect(child, qn)
-                elif isinstance(child, ast.ClassDef):
-                    collect(child,
-                            f"{prefix}.{child.name}" if prefix
-                            else child.name)
-                else:
-                    collect(child, prefix)
-
-        collect(tree, "")
+        for node, qn in qualname_index(tree).items():
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            fns_by_name.setdefault(node.name, node)
+            dec_info = self._jit_decorator(node, aliases, jit_aliases)
+            if dec_info is not None:
+                roots.append((node, dec_info, qn, "decorator"))
 
         # pallas_call kernels: pl.pallas_call(kernel, ...) — resolve a
         # Name first-arg to a module function.
-        seen = {id(fn) for fn, _s, _q, _h in roots}
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            path = dotted(node.func, aliases)
-            if not path.endswith("pallas_call"):
-                continue
-            cand: Optional[str] = None
-            if node.args and isinstance(node.args[0], ast.Name):
-                cand = node.args[0].id
-            fn = fns_by_name.get(cand or "")
-            if fn is not None and id(fn) not in seen:
-                seen.add(id(fn))
-                roots.append((fn, set(), fn.name, "pallas_call"))
-        return [(fn, st, qn, how) for fn, st, qn, how in roots]
+        if lines is None \
+                or any("pallas_call" in ln for ln in lines):
+            seen = {id(fn) for fn, _s, _q, _h in roots}
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                path = dotted(node.func, aliases)
+                if not path.endswith("pallas_call"):
+                    continue
+                cand: Optional[str] = None
+                if node.args and isinstance(node.args[0], ast.Name):
+                    cand = node.args[0].id
+                fn = fns_by_name.get(cand or "")
+                if fn is not None and id(fn) not in seen:
+                    seen.add(id(fn))
+                    roots.append((fn, set(), fn.name, "pallas_call"))
+        tree._graftlint_jit_roots = roots  # type: ignore[attr-defined]
+        return roots
 
     @staticmethod
     def _jit_decorator(fn: ast.FunctionDef, aliases: dict,

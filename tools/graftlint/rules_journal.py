@@ -151,6 +151,14 @@ class KindExhaustivenessRule(Rule):
                                   self.trace_handler_files)
 
         for mod in modules:
+            # Emit sites must literally say ".apply(" / ".delete(";
+            # trace-kind dicts only matter in the handler files. A
+            # source-text prefilter skips the full AST walk for the
+            # large majority of modules that do neither.
+            if mod.relpath not in self.trace_handler_files \
+                    and not any(".apply" in ln or ".delete" in ln
+                                for ln in mod.lines):
+                continue
             for node in ast.walk(mod.tree):
                 if have_journal_handlers and isinstance(node, ast.Call) \
                         and isinstance(node.func, ast.Attribute) \

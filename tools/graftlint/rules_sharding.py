@@ -1,0 +1,204 @@
+"""S1 — sharding readiness: the pjit cut-over worklist.
+
+ROADMAP item 1 moves the decision core under pjit with sharded row
+axes. Three host-side idioms block that cut-over, and each is cheap
+to spot statically long before the migration lands:
+
+  * **Per-row Python loops** over the columnar store (``for i in
+    range(self.num_rows())``, loops over ``*_rows`` index vectors):
+    under pjit these serialize on the host what the device would do
+    in one vectorized program, and they read rows the local shard may
+    not own.
+  * **Data-dependent host branches on device arrays**: branching on a
+    value produced by ``jnp.*``/``lax.*`` (or forcing it over with
+    ``.item()``/``.tolist()``) is an implicit device→host sync — a
+    pipeline bubble today and a cross-shard collective tomorrow.
+  * **Shape-unstable jit signatures**: passing a Python list /
+    comprehension straight into a jit root re-traces on every new
+    length; pjit requires padded fixed-shape buckets.
+
+S1 findings are a *worklist*, not bugs: current behavior is correct,
+and entries are expected to live in the baseline with a justification
+naming the cut-over step that will retire them. The rule exists so
+the worklist is exhaustive and new code cannot quietly grow more
+host-loop surface while the migration is in flight.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.callgraph import FunctionInfo, Project
+from tools.graftlint.config import (
+    S1_DEVICE_HEADS,
+    S1_ROW_ITER_HINTS,
+    S1_SYNC_TERMINALS,
+)
+from tools.graftlint.core import Finding, Module, Rule, dotted, \
+    import_aliases, own_nodes
+
+
+class ShardingReadinessRule(Rule):
+    name = "S1"
+    title = "sharding readiness (pjit cut-over worklist)"
+    whole_program = True
+    rationale = (
+        "The pjit cut-over (ROADMAP item 1) shards the decision "
+        "core's row axis across devices. Per-row Python loops over "
+        "the columnar store, host branches on device arrays "
+        "(implicit device→host syncs), and shape-unstable jit "
+        "arguments (Python lists re-trace per length) all have to go "
+        "first. S1 keeps that worklist exhaustive: every entry is "
+        "baselined with the cut-over step that retires it, and new "
+        "host-loop surface fails lint instead of growing silently.")
+    example = (
+        "    for i in range(self.num_rows()):   # FINDING: per-row\n"
+        "        self._encode_row(i, world)     # host loop\n"
+        "    mask = jnp.greater(usage, quota)\n"
+        "    if mask.any():                     # FINDING: host branch\n"
+        "        ...                            # on a device array")
+
+    def check_project(self, project: Project,
+                      summaries) -> Iterable[Finding]:
+        jit_roots = summaries.jit_roots()
+        findings: list[Finding] = []
+        for mod in project.modules:
+            if "S1" not in mod.rules:
+                continue
+            for info in sorted(project.functions_in(mod.relpath),
+                               key=lambda i: i.fid):
+                self._check_function(mod, info, jit_roots, findings)
+        return findings
+
+    def _check_function(self, mod: Module, info: FunctionInfo,
+                        jit_roots: set, findings: list) -> None:
+        if info.fid in jit_roots:
+            return   # inside the trace everything is device-side
+        aliases = import_aliases(mod.tree)
+        # One pass: bucket the interesting nodes, deriving the
+        # device-name table from the assignments seen along the way
+        # (the table must be complete before branch tests consult it).
+        loops: list = []
+        branches: list = []
+        calls: list = []
+        device_names: set = set()
+        for node in self._own_nodes(info.node):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                loops.append(node)
+            elif isinstance(node, (ast.If, ast.While)):
+                branches.append(node)
+            elif isinstance(node, ast.Call):
+                calls.append(node)
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                path = dotted(node.value.func, aliases)
+                head = path.split(".", 1)[0] if path else ""
+                if head in S1_DEVICE_HEADS:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            device_names.add(tgt.id)
+        for node in loops:
+            self._check_row_loop(node, mod, info, findings)
+        for node in branches:
+            self._check_host_branch(node, mod, info, device_names,
+                                    aliases, findings)
+        if calls:
+            calls_by_pos = {(s.line, s.col): s for s in info.calls}
+            for node in calls:
+                self._check_jit_signature(node, mod, info, jit_roots,
+                                          calls_by_pos, findings)
+
+    # -- per-row loops --
+
+    def _check_row_loop(self, node, mod: Module, info: FunctionInfo,
+                        findings: list) -> None:
+        try:
+            iter_text = ast.unparse(node.iter)
+        except Exception:
+            return
+        hit = next((h for h in S1_ROW_ITER_HINTS if h in iter_text),
+                   None)
+        if hit is None:
+            return
+        findings.append(Finding(
+            "S1", mod.relpath, node.lineno, node.col_offset,
+            info.qualname,
+            f"per-row host loop over the columnar store (iterates "
+            f"{iter_text!r}) — serializes what pjit shards; replace "
+            "with a vectorized device op or baseline as a cut-over "
+            "worklist entry"))
+
+    # -- host branches on device arrays --
+
+    def _check_host_branch(self, node, mod: Module,
+                           info: FunctionInfo, device_names: set,
+                           aliases: dict, findings: list) -> None:
+        test = node.test
+        if self._identity_only(test):
+            return   # ``if j_free is None:`` cache population — the
+            # branch is on *presence*, not on device data
+        culprit = None
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Name) and sub.id in device_names:
+                culprit = sub.id
+                break
+            if isinstance(sub, ast.Call):
+                path = dotted(sub.func, aliases)
+                head = path.split(".", 1)[0] if path else ""
+                if head in S1_DEVICE_HEADS:
+                    culprit = path
+                    break
+                if isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in S1_SYNC_TERMINALS \
+                        and isinstance(sub.func.value, ast.Name) \
+                        and sub.func.value.id in device_names:
+                    culprit = f"{sub.func.value.id}.{sub.func.attr}()"
+                    break
+        if culprit is None:
+            return
+        findings.append(Finding(
+            "S1", mod.relpath, node.lineno, node.col_offset,
+            info.qualname,
+            f"data-dependent host branch on device array "
+            f"({culprit}) — an implicit device→host sync the pjit "
+            "cut-over forbids; fold the branch into the traced "
+            "program (jnp.where / lax.cond) or baseline as a "
+            "cut-over worklist entry"))
+
+    @staticmethod
+    def _identity_only(test: ast.AST) -> bool:
+        """True when every comparison in the test is ``is``/``is
+        not`` — identity against None never forces a device sync."""
+        comparisons = [n for n in ast.walk(test)
+                       if isinstance(n, ast.Compare)]
+        return bool(comparisons) and all(
+            isinstance(op, (ast.Is, ast.IsNot))
+            for c in comparisons for op in c.ops)
+
+    # -- shape-unstable jit signatures --
+
+    def _check_jit_signature(self, call: ast.Call, mod: Module,
+                             info: FunctionInfo, jit_roots: set,
+                             calls_by_pos: dict,
+                             findings: list) -> None:
+        site = calls_by_pos.get((call.lineno, call.col_offset))
+        if site is None or site.callee not in jit_roots:
+            return
+        for arg in list(call.args) + [kw.value for kw in
+                                      call.keywords]:
+            if isinstance(arg, (ast.List, ast.ListComp,
+                                ast.GeneratorExp)):
+                findings.append(Finding(
+                    "S1", mod.relpath, call.lineno, call.col_offset,
+                    info.qualname,
+                    f"shape-unstable jit signature: {site.text}() "
+                    "traced with a Python list argument re-compiles "
+                    "per length — pad to fixed-shape buckets before "
+                    "the pjit cut-over, or baseline as a worklist "
+                    "entry"))
+                return
+
+    @staticmethod
+    def _own_nodes(fn: ast.AST):
+        return own_nodes(fn)

@@ -1,0 +1,373 @@
+"""graftlint runtime sanitizer: the dynamic half of D1 and F1.
+
+Static rules prove the *absence of known bad shapes*; the sanitizer
+proves the corresponding runtime properties on real executions, so a
+violation the AST rules cannot see (order-dependence smuggled through
+data, a dropped fsync behind a helper) still fails the build:
+
+  * **shuffle** (D1 at runtime) — the sim fuzzer's seed triples are
+    replayed in subprocesses under different ``PYTHONHASHSEED`` values.
+    Hash randomization shuffles every str-keyed set/dict iteration
+    order in the process; if any decision-zone code depends on one,
+    the decision-digest chain diverges between seeds. Digest identity
+    across seeds is the runtime form of D1's no-set-iteration rule.
+  * **fsync** (F1 at runtime) — an in-process federation scenario
+    (dispatcher + fake cell transports + SSE hub) instrumented at
+    exactly the effect points the F1 rule names: ``transport.submit``,
+    ``transport.revoke``, ``hub.publish``. Each effect asserts the
+    route journal has no unsynced appends (the journal's own
+    ``_dirty`` flag — set by non-fsync appends, cleared by ``sync()``).
+    An effect while dirty means a consumer can observe state a crash
+    would erase: the exact ordering bug F1 proves absent statically.
+
+``--plant shuffle`` / ``--plant fsync-drop`` arm a known regression
+(an iteration-order-dependent digest; a dropped fsync+sync) and the
+run MUST then fail with the violation named — the self-test that the
+sanitizer would actually catch the bug class it claims to.
+``--self-test`` runs the clean checks plus both planted arms in
+subprocesses, asserting the planted runs fail. Exit codes: 0 clean,
+2 internal/usage error, 3 violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import zlib
+
+# Seed triples from the sim fuzzer's family (tools/sim_smoke.py
+# FUZZ_TRIPLES pattern s -> (s, 3s+1, 7s+3)); two worlds is enough to
+# cover admit/preempt/fault paths at the 60s horizon in ~1s each.
+SHUFFLE_TRIPLES = ((1, 4, 10), (2, 7, 17))
+SHUFFLE_HORIZON_S = 60.0
+HASH_SEEDS = (0, 1, 4242)
+
+PLANT_ENV = "GRAFTLINT_SANITIZE_PLANT"
+VIOLATION_BANNER = "SANITIZE VIOLATION"
+
+
+class SanitizeViolation(AssertionError):
+    """A runtime invariant the static rules mirror was broken."""
+
+
+# ---------------------------------------------------------------------------
+# Check 1: hash-shuffle digest identity (runtime D1)
+# ---------------------------------------------------------------------------
+
+def _worker(triple: str) -> int:
+    """Subprocess body: run one sim triple, print the digest pair."""
+    ws, ts, fs = (int(x) for x in triple.split(","))
+    from kueue_tpu.sim.harness import run_sim
+    from kueue_tpu.sim.worlds import generate_world
+
+    spec = generate_world(ws, horizon_s=SHUFFLE_HORIZON_S, cycle_s=2.0)
+    res = run_sim(spec, ts, fault_seed=fs)
+    digest = res.decision_digest
+    if os.environ.get(PLANT_ENV) == "shuffle":
+        # The planted D1 regression: fold the first element of a
+        # str set into the digest. Which element is "first" is
+        # PYTHONHASHSEED-dependent — exactly the bug class the check
+        # exists to catch (a decision path ordered by set iteration).
+        names = {f"wl-{i:03d}" for i in range(64)}
+        first = next(iter(names))
+        digest ^= zlib.crc32(first.encode())
+    print(json.dumps({
+        "decisionDigest": f"{digest & 0xFFFFFFFF:08x}",
+        "admittedDigest": res.admitted_digest,
+        "admitted": res.admitted,
+        "cycles": res.cycles,
+    }))
+    return 0
+
+
+def run_shuffle_check(plant: bool = False) -> None:
+    """Digest identity across PYTHONHASHSEED values, per seed triple."""
+    triples = SHUFFLE_TRIPLES[:1] if plant else SHUFFLE_TRIPLES
+    for triple in triples:
+        arg = ",".join(str(x) for x in triple)
+        seen: dict[int, dict] = {}
+        for seed in HASH_SEEDS:
+            env = dict(os.environ, PYTHONHASHSEED=str(seed),
+                       JAX_PLATFORMS="cpu")
+            if plant:
+                env[PLANT_ENV] = "shuffle"
+            else:
+                env.pop(PLANT_ENV, None)
+            proc = subprocess.run(
+                [sys.executable, "-m", "tools.graftlint.sanitize",
+                 "--worker", arg],
+                capture_output=True, text=True, env=env,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__)))))
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"shuffle worker {arg} (PYTHONHASHSEED={seed}) "
+                    f"died: {proc.stderr.strip()[-400:]}")
+            seen[seed] = json.loads(proc.stdout.strip().splitlines()[-1])
+        baseline = seen[HASH_SEEDS[0]]
+        for seed, got in seen.items():
+            if (got["decisionDigest"] != baseline["decisionDigest"]
+                    or got["admittedDigest"]
+                    != baseline["admittedDigest"]):
+                raise SanitizeViolation(
+                    f"D1 runtime violation (iteration-order "
+                    f"dependence): world triple {triple} decided "
+                    f"differently under hash shuffling — "
+                    f"PYTHONHASHSEED={HASH_SEEDS[0]} gave decision "
+                    f"digest {baseline['decisionDigest']} / admitted "
+                    f"{baseline['admittedDigest']}, PYTHONHASHSEED="
+                    f"{seed} gave {got['decisionDigest']} / "
+                    f"{got['admittedDigest']}. Some decision-zone "
+                    "path iterates a set/dict in hash order; find it "
+                    "with `make lint` (rule D1) or bisect the cycle "
+                    "stream.")
+        print(f"  shuffle: triple {triple} digest "
+              f"{baseline['decisionDigest']} identical across "
+              f"PYTHONHASHSEED {list(HASH_SEEDS)} "
+              f"(admitted={baseline['admitted']}, "
+              f"cycles={baseline['cycles']})")
+
+
+# ---------------------------------------------------------------------------
+# Check 2: dirty-journal effect ordering (runtime F1)
+# ---------------------------------------------------------------------------
+
+class _FakeCellTransport:
+    """In-memory stand-in for HTTPCellTransport: admits everything,
+    fails on demand (breaker/drain/reconcile paths)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.admitted: dict[str, str] = {}
+        self.fail = False
+
+    def _check_up(self) -> None:
+        if self.fail:
+            from kueue_tpu.federation.cells import CellTransportError
+            raise CellTransportError(f"{self.name}: injected outage")
+
+    def submit(self, wl_jsonable: dict, route_epoch: int = 0) -> dict:
+        self._check_up()
+        key = f"{wl_jsonable['namespace']}/{wl_jsonable['name']}"
+        self.admitted[key] = "Admitted"
+        return {"code": 201, "workload": key}
+
+    def health(self) -> dict:
+        self._check_up()
+        return {"role": "leader", "workloads": len(self.admitted)}
+
+    def workloads(self) -> list:
+        self._check_up()
+        return [{"namespace": k.split("/", 1)[0],
+                 "name": k.split("/", 1)[1], "status": s}
+                for k, s in sorted(self.admitted.items())]
+
+    def revoke(self, keys: list, epoch: int = 0) -> dict:
+        self._check_up()
+        for k in keys:
+            self.admitted.pop(k, None)
+        return {"code": 200, "revoked": list(keys)}
+
+
+def _assert_durable(holder: dict, effect: str) -> None:
+    journal = holder.get("journal")
+    if journal is not None and getattr(journal, "_dirty", False):
+        raise SanitizeViolation(
+            f"F1 runtime violation (effect before durability): "
+            f"{effect} fired while the route journal has appends "
+            "not yet fsynced — a consumer could observe state a "
+            "crash would erase. Order every externally visible "
+            "effect AFTER journal.sync() on its path (rule F1 "
+            "names the static shape).")
+
+
+class _GuardedTransport:
+    """Wraps a cell transport; the F1 effect points assert durability
+    before delegating. Non-effect calls pass through untouched."""
+
+    def __init__(self, inner, label: str, holder: dict):
+        self._inner = inner
+        self._label = label
+        self._holder = holder
+
+    def submit(self, *a, **kw):
+        _assert_durable(self._holder, f"{self._label}.submit()")
+        return self._inner.submit(*a, **kw)
+
+    def revoke(self, *a, **kw):
+        _assert_durable(self._holder, f"{self._label}.revoke()")
+        return self._inner.revoke(*a, **kw)
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+class _GuardedHub:
+    """SSE-hub stand-in: publish is an F1 effect point."""
+
+    def __init__(self, holder: dict):
+        self._holder = holder
+        self.events: list = []
+
+    def publish(self, kind: str, data: str) -> None:
+        _assert_durable(self._holder, f"hub.publish({kind!r})")
+        self.events.append((kind, data))
+
+
+def run_fsync_check(plant: bool = False) -> None:
+    """Drive the federation dispatcher end to end (handoffs, confirm
+    publishes, a breaker-open drain, a zombie reconcile+revoke) with
+    every F1 effect point asserting journal durability."""
+    from kueue_tpu.bench.scenario import baseline_like
+    from kueue_tpu.federation.cells import CellHandle
+    from kueue_tpu.federation.dispatcher import FederationDispatcher
+
+    tmp = tempfile.mkdtemp(prefix="graftlint-sanitize-")
+    holder: dict = {}
+    transports = {name: _FakeCellTransport(name)
+                  for name in ("cell-a", "cell-b")}
+    cells = [CellHandle(name,
+                        _GuardedTransport(tr, f"{name}.transport",
+                                          holder),
+                        probe_interval_ticks=1, breaker_threshold=2,
+                        breaker_cooldown_ticks=2)
+             for name, tr in sorted(transports.items())]
+    hub = _GuardedHub(holder)
+    disp = FederationDispatcher(os.path.join(tmp, "routes.jsonl"),
+                                cells, hub=hub, fsync=True,
+                                confirm_interval_ticks=1)
+    holder["journal"] = disp.journal
+    if plant:
+        # The planted F1 regression: durability silently dropped.
+        # Per-append fsync off AND sync() a no-op is what "someone
+        # removed the fsync" looks like from the effects' side.
+        disp.journal.fsync = False
+        disp.journal.sync = lambda: None  # type: ignore[assignment]
+
+    scen = baseline_like(n_cohorts=1, cqs_per_cohort=1, n_workloads=8,
+                         nominal_per_cq=1_000_000, sized_to_fit=True)
+    try:
+        now = 0.0
+        disp.tick(now)                      # probe both cells up
+        for wl in scen.workloads:
+            now += 0.1
+            disp.submit(wl, now)            # journal+sync, then handoff
+        # Whole-cell outage BEFORE any confirm tick: cell-b's routes
+        # are still ACKED, so the breaker-open drain must fence (epoch
+        # journaled + synced), re-route them to cell-a (handoffs), and
+        # publish only after the sync.
+        transports["cell-b"].fail = True
+        for _ in range(6):
+            now += 0.1
+            disp.tick(now)
+        # Zombie rejoin: cell-b still holds admissions for keys now
+        # routed to cell-a — reconcile must revoke them (an effect),
+        # journal the rejoin, and publish after the sync.
+        transports["cell-b"].fail = False
+        for _ in range(8):
+            now += 0.1
+            disp.tick(now)
+        counts = disp.route_counts()
+        publishes = len(hub.events)
+        handoffs = disp.handoffs
+        redispatches = disp.redispatches
+        revocations = disp.revocations
+        disp.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    # The guard only proved something if the effects actually fired.
+    if handoffs < len(scen.workloads):
+        raise RuntimeError(
+            f"fsync scenario under-drove the dispatcher: "
+            f"{handoffs} handoffs < {len(scen.workloads)} submissions")
+    if publishes == 0 or redispatches == 0 or revocations == 0:
+        raise RuntimeError(
+            f"fsync scenario under-drove the effect points "
+            f"(publishes={publishes}, redispatches={redispatches}, "
+            f"revocations={revocations}) — drain/reconcile never ran")
+    print(f"  fsync: federation scenario clean — {handoffs} handoffs, "
+          f"{redispatches} redispatches, {revocations} revocations, "
+          f"{publishes} publishes, routes={counts}, every effect "
+          "point saw a durable journal")
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def run_checks(check: str, plant: str) -> int:
+    try:
+        if check in ("shuffle", "all"):
+            run_shuffle_check(plant=(plant == "shuffle"))
+        if check in ("fsync", "all"):
+            run_fsync_check(plant=(plant == "fsync-drop"))
+    except SanitizeViolation as e:
+        print(f"{VIOLATION_BANNER}: {e}")
+        return 3
+    if plant:
+        print(f"sanitize: FAIL: planted regression {plant!r} was NOT "
+              "detected — the sanitizer is blind to its own bug class")
+        return 2
+    print("sanitize OK: digest identity under hash shuffling + "
+          "durable-before-effect ordering hold at runtime")
+    return 0
+
+
+def _self_test() -> int:
+    """Clean checks must pass; each planted arm must fail naming the
+    violation. This is the CI entry (make lint-sanitize)."""
+    rc = run_checks("all", "")
+    if rc != 0:
+        return rc
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    for plant, check in (("shuffle", "shuffle"),
+                         ("fsync-drop", "fsync")):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint.sanitize",
+             "--check", check, "--plant", plant],
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=root)
+        if proc.returncode != 3 or VIOLATION_BANNER not in proc.stdout:
+            print(f"sanitize: FAIL: planted {plant!r} self-test did "
+                  f"not fail as required (rc={proc.returncode}):\n"
+                  f"{proc.stdout}{proc.stderr}")
+            return 2
+        named = [ln for ln in proc.stdout.splitlines()
+                 if VIOLATION_BANNER in ln][0]
+        print(f"  self-test: planted {plant!r} caught -> "
+              f"{named[:120]}...")
+    print("sanitize self-test OK: clean run passes, both planted "
+          "regressions fail with the violation named")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint-sanitize",
+        description="runtime determinism/durability sanitizer "
+                    "(dynamic D1 + F1)")
+    ap.add_argument("--check", choices=("shuffle", "fsync", "all"),
+                    default="all")
+    ap.add_argument("--plant", choices=("shuffle", "fsync-drop"),
+                    default="", help="arm a known regression; the run "
+                    "must then FAIL with the violation named")
+    ap.add_argument("--self-test", action="store_true",
+                    help="clean checks + both planted arms (CI entry)")
+    ap.add_argument("--worker", default="",
+                    help=argparse.SUPPRESS)  # internal: one sim triple
+    args = ap.parse_args(argv)
+    if args.worker:
+        return _worker(args.worker)
+    if args.self_test:
+        return _self_test()
+    return run_checks(args.check, args.plant)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,215 @@
+"""Per-function summaries over the call graph (callgraph.Project).
+
+A summary is the answer to "what does calling this function *imply*",
+computed lazily and shared by every interprocedural rule:
+
+  * **facts** — rule-scoped violations found in a function's own body
+    (a ``time.time()`` call, a set iteration, an impure call a jit
+    trace would bake in). Fact tables are built per module, on demand,
+    only for modules actually reached from a zone entry — the
+    whole-program pass must not pay for files nobody reaches;
+  * **reachability** — the transitive closure of facts over resolved
+    call edges, with the call path preserved so a finding can say
+    *how* the zone entry reaches the offending line.
+
+Zone-aware descent: walking outward from a zone entry stops at any
+function that is itself inside the rule's zone — that function is its
+own entry, its body is already covered by the intra-module pass, and
+double-reporting would make one bug cost two baselines.
+
+Inline ``allow[RULE]`` pragmas at the fact's own line are honored when
+facts are collected, so the sanctioned escape hatches (SystemClock's
+C1 pragmas, digest-neutral timing) do not re-surface as call-chain
+findings in every caller.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from tools.graftlint.callgraph import FunctionInfo, Project
+from tools.graftlint.config import J1_BANNED_CALLS
+from tools.graftlint.core import Module, dotted, import_aliases, \
+    own_nodes
+
+
+@dataclass(frozen=True)
+class Fact:
+    """One rule-scoped violation inside a function's own body."""
+
+    relpath: str
+    line: int
+    col: int
+    desc: str       # short human description ("call to time.time()")
+
+
+def _suppressed(mod: Module, rule: str, line: int) -> bool:
+    pragma = mod.pragma_for(line)
+    return pragma is not None and rule in pragma[0] and bool(pragma[1])
+
+
+class SummaryIndex:
+    """Lazy per-rule, per-module fact tables + memoized closures."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        # (rule, relpath) -> {fid: [Fact]}
+        self._module_facts: dict[tuple, dict] = {}
+        # (rule, fid) -> [(path, Fact)]
+        self._closure: dict[tuple, list] = {}
+        self._jit_roots: set = set()
+        self._jit_scanned = False
+
+    # -- direct facts (per module, on demand) --
+
+    def facts_for(self, rule: str, fid: str) -> list:
+        info = self.project.functions.get(fid)
+        if info is None:
+            return []
+        return self._facts_in(rule, info.module).get(fid, [])
+
+    def _facts_in(self, rule: str, mod: Module) -> dict:
+        key = (rule, mod.relpath)
+        cached = self._module_facts.get(key)
+        if cached is None:
+            if rule in ("D1", "C1"):
+                cached = self._rule_driven_facts(rule, mod)
+            elif rule == "J1":
+                cached = self._impurity_facts(mod)
+            else:
+                cached = {}
+            self._module_facts[key] = cached
+        return cached
+
+    def _rule_driven_facts(self, rule: str, mod: Module) -> dict:
+        """Run the intra-module visitor for ``rule`` over ``mod`` and
+        bucket its findings by owning function — the fact table is
+        literally the rule's own judgment, applied without the zone
+        filter."""
+        if rule == "D1":
+            from tools.graftlint.rules_determinism import DeterminismRule
+            checker = DeterminismRule()
+        else:
+            from tools.graftlint.rules_clock import ClockDisciplineRule
+            checker = ClockDisciplineRule()
+        out: dict[str, list] = {}
+        for f in checker.check_module(mod):
+            if not f.symbol or _suppressed(mod, rule, f.line):
+                continue
+            fid = f"{mod.relpath}::{f.symbol}"
+            if fid not in self.project.functions:
+                continue
+            # Short description: the clause before the rationale dash
+            # or the first colon ("call to time.time()"), whichever
+            # cut comes first — chain findings repeat it per caller.
+            desc = f.message.split(" — ")[0].split(":")[0].strip() \
+                or f.message
+            out.setdefault(fid, []).append(
+                Fact(mod.relpath, f.line, f.col, desc))
+        return out
+
+    def _impurity_facts(self, mod: Module) -> dict:
+        """J1 facts: calls a jit trace must never reach (I/O, os/time/
+        random, logging, metrics) plus global/nonlocal, found in any
+        project function — jit ROOTS are excluded (the intra-module
+        pass owns them); these facts matter when a root *calls* the
+        function."""
+        out: dict[str, list] = {}
+        aliases = import_aliases(mod.tree)
+        for info in self.project.functions_in(mod.relpath):
+            if info.fid in self.jit_roots():
+                continue
+            facts = []
+            for node in self._own_nodes(info.node):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    if not _suppressed(mod, "J1", node.lineno):
+                        kind = "global" if isinstance(node, ast.Global) \
+                            else "nonlocal"
+                        facts.append(Fact(
+                            mod.relpath, node.lineno, node.col_offset,
+                            f"{kind} closure mutation"))
+                elif isinstance(node, ast.Call):
+                    path = dotted(node.func, aliases)
+                    if not path:
+                        continue
+                    head = path.split(".", 1)[0]
+                    for banned in J1_BANNED_CALLS:
+                        if path == banned \
+                                or path.startswith(banned + ".") \
+                                or head == banned:
+                            if not _suppressed(mod, "J1", node.lineno):
+                                facts.append(Fact(
+                                    mod.relpath, node.lineno,
+                                    node.col_offset,
+                                    f"impure call {path}()"))
+                            break
+            if facts:
+                out[info.fid] = facts
+        return out
+
+    @staticmethod
+    def _own_nodes(fn: ast.AST):
+        """Nodes lexically inside ``fn`` but outside nested defs."""
+        return own_nodes(fn)
+
+    # -- jit roots (J1 entries) --
+
+    def jit_roots(self) -> set:
+        if not self._jit_scanned:
+            self._jit_scanned = True
+            from tools.graftlint.rules_jit import JitPurityRule
+            jr = JitPurityRule()
+            for mod in self.project.modules:
+                aliases = import_aliases(mod.tree)
+                jit_aliases = jr._module_jit_aliases(mod.tree, aliases)
+                for _fn, _static, qual, _how in jr._find_roots(
+                        mod.tree, aliases, jit_aliases,
+                        lines=mod.lines):
+                    self._jit_roots.add(f"{mod.relpath}::{qual}")
+        return self._jit_roots
+
+    # -- transitive closure --
+
+    def in_zone(self, rule: str, info: FunctionInfo) -> bool:
+        """Is this function already covered by the intra-module pass
+        for ``rule``? (Descent stops here; it is its own entry.)"""
+        if rule == "J1":
+            return info.fid in self.jit_roots()
+        return rule in info.module.rules
+
+    def closure(self, rule: str, fid: str) -> list:
+        """[(path, Fact)] reachable from ``fid`` THROUGH out-of-zone
+        functions, including ``fid``'s own facts (empty path). ``path``
+        is the tuple of fids from ``fid``'s callees down to the fact's
+        owner."""
+        key = (rule, fid)
+        cached = self._closure.get(key)
+        if cached is not None:
+            return cached
+        self._closure[key] = []     # cycle guard: in-flight -> empty
+        out = [((), fact) for fact in self.facts_for(rule, fid)]
+        info = self.project.functions.get(fid)
+        for site in (info.calls if info is not None else ()):
+            callee = self.project.functions.get(site.callee)
+            if callee is None or self.in_zone(rule, callee):
+                continue
+            for path, fact in self.closure(rule, site.callee):
+                out.append(((site.callee,) + path, fact))
+        seen: set = set()
+        uniq = []
+        # Diamond call shapes reach the same fact twice; report each
+        # fact once per origin, shortest path first, in a stable order.
+        for path, fact in sorted(
+                out, key=lambda pf: (fact_key(pf[1]), len(pf[0]))):
+            k = fact_key(fact)
+            if k in seen:
+                continue
+            seen.add(k)
+            uniq.append((path, fact))
+        self._closure[key] = uniq
+        return uniq
+
+
+def fact_key(fact: Fact) -> tuple:
+    return (fact.relpath, fact.line, fact.col, fact.desc)
